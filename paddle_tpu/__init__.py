@@ -1,0 +1,63 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle Fluid (~1.8/2.0-beta), built on jax/XLA/pallas/pjit.
+
+Architecture (vs. the reference, see SURVEY.md):
+  * Program/Block/Operator IR mirrors fluid's ProgramDesc, but execution
+    lowers whole blocks to single XLA computations (no op interpreter).
+  * Collectives are sharding annotations + XLA collectives over ICI,
+    not NCCL ops.
+  * The imperative mode shares the same op lowerings via an eager tracer.
+"""
+from . import ops  # registers the operator library
+from .framework.core import (Program, Variable, Parameter, OpRole,  # noqa
+                             default_main_program, default_startup_program,
+                             program_guard, unique_name, in_dygraph_mode,
+                             convert_dtype, grad_var_name)
+from .framework.executor import (Executor, Scope, global_scope,  # noqa
+                                 scope_guard)
+from .framework.backward import append_backward, gradients  # noqa
+from .framework.layer_helper import ParamAttr, WeightNormParamAttr  # noqa
+from .framework import initializer  # noqa
+from . import layers  # noqa
+from . import optimizer  # noqa
+from . import regularizer  # noqa
+from .layers.tensor import data  # noqa
+
+__version__ = "0.1.0"
+
+
+# -- device places (API parity; jax owns actual placement) -------------------
+class CPUPlace:
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace:
+    """The TPU device place — the reference's CUDAPlace analog."""
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+CUDAPlace = TPUPlace  # scripts written for the reference keep working
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    import jax
+    return any(d.platform in ("tpu", "axon") for d in jax.devices())
+
+
+def device_count() -> int:
+    import jax
+    return jax.device_count()
+
+
+# fluid-compat namespace: `import paddle_tpu.fluid as fluid`
+from . import fluid  # noqa  (must come after the symbols above exist)

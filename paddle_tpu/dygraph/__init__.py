@@ -1,0 +1,1 @@
+"""Imperative (dygraph) mode — placeholder, populated in later milestones."""

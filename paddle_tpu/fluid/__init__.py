@@ -1,0 +1,28 @@
+"""`paddle_tpu.fluid` — compatibility namespace mirroring
+`paddle.fluid` (reference python/paddle/fluid/__init__.py) so reference-era
+user programs port by changing one import.
+"""
+import paddle_tpu as _root
+
+from ..framework.core import (Program, Variable, Parameter,  # noqa
+                              default_main_program, default_startup_program,
+                              program_guard, unique_name, in_dygraph_mode)
+from ..framework.executor import (Executor, Scope, global_scope,  # noqa
+                                  scope_guard)
+from ..framework.backward import append_backward, gradients  # noqa
+from ..framework.layer_helper import ParamAttr, WeightNormParamAttr  # noqa
+from ..framework import initializer  # noqa
+from ..framework.initializer import (Constant, Normal, TruncatedNormal,  # noqa
+                                     Uniform, Xavier, MSRA)
+from .. import layers  # noqa
+from .. import optimizer  # noqa
+from .. import regularizer  # noqa
+from ..layers.tensor import data  # noqa
+
+CPUPlace = _root.CPUPlace
+TPUPlace = _root.TPUPlace
+CUDAPlace = _root.CUDAPlace
+is_compiled_with_cuda = _root.is_compiled_with_cuda
+
+from .. import framework  # noqa
+backward = framework.backward

@@ -1,0 +1,8 @@
+from .core import (Block, OpRole, Operator, Parameter, Program, Variable,  # noqa
+                   convert_dtype, default_main_program,
+                   default_startup_program, grad_var_name, in_dygraph_mode,
+                   program_guard, unique_name)
+from .executor import Executor, Scope, global_scope, scope_guard  # noqa
+from .backward import append_backward, calc_gradient, gradients  # noqa
+from . import initializer  # noqa
+from .layer_helper import LayerHelper, ParamAttr  # noqa

@@ -1,0 +1,192 @@
+"""Static reverse-mode autodiff on the Program IR.
+
+Mirrors the reference `fluid.backward.append_backward`
+(python/paddle/fluid/backward.py:1276) — backward is a source-to-source
+IR transform emitting ``<op>_grad`` ops, NOT jax.grad: this preserves the
+static-graph API (grad vars are named, inspectable, rewritable by
+distributed passes).  The emitted grad ops lower to jax.vjp of the
+forward lowerings (ops/registry.py), so the numerical engine is still
+XLA-differentiated code.
+
+Gradient accumulation: grad ops carry ``__accumulate__`` so that multiple
+consumers of one forward var sum into the same ``X@GRAD`` value during
+lowering (replaces the reference's @RENAME@ + sum_op dance,
+backward.py:141 _addup_repetitive_outputs_).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..ops.registry import (build_auto_grad_specs, ensure_grad_op_registered,
+                            get_op_def)
+from .core import (Block, OpRole, Operator, Parameter, Program, Variable,
+                   grad_var_name)
+
+__all__ = ["append_backward", "gradients", "calc_gradient"]
+
+
+class GradHelper:
+    """Context handed to custom grad makers."""
+
+    def __init__(self, block: Block, no_grad_set: Set[str]):
+        self.block = block
+        self.no_grad_set = no_grad_set
+
+
+def _collect_no_grad(block: Block, no_grad_set) -> Set[str]:
+    s = set(no_grad_set or ())
+    for v in block.vars.values():
+        if v.stop_gradient:
+            s.add(v.name)
+    return s
+
+
+def append_backward(loss: Variable,
+                    parameter_list: Optional[Sequence] = None,
+                    no_grad_set=None,
+                    callbacks=None,
+                    checkpoints=None) -> List[Tuple[Variable, Variable]]:
+    """Emit backward ops for `loss` into its program; returns
+    [(param, grad_var)] like the reference (fluid/backward.py:1276).
+
+    `checkpoints` enables recompute-style segmentation
+    (reference _append_backward_ops_with_checkpoints_, backward.py:689):
+    here remat is expressed per-op via the vjp recompute structure and
+    jax.checkpoint in the recompute meta-optimizer, so checkpoints only
+    tags the program (see distributed/fleet recompute).
+    """
+    block = loss.block.program.global_block()
+    program = block.program
+    no_grad = _collect_no_grad(block, no_grad_set)
+
+    if loss.shape not in ((), (1,)):
+        raise ValueError(f"loss must be scalar, got shape {loss.shape}")
+
+    # 1. init loss@GRAD = 1
+    loss_grad = grad_var_name(loss.name)
+    block.append_op(
+        "fill_any_like",
+        inputs={"X": [loss.name]},
+        outputs={"Out": [loss_grad]},
+        attrs={"value": 1.0, "dtype": loss.dtype,
+               "op_role": OpRole.Backward | OpRole.Loss})
+
+    # 2. reverse sweep over forward ops
+    fwd_ops = [op for op in block.ops
+               if op.attr("op_role", OpRole.Forward) in
+               (OpRole.Forward, OpRole.Forward | OpRole.Loss)]
+    grads_available: Set[str] = {loss.name}
+    emitted: List[dict] = []
+    helper = GradHelper(block, no_grad)
+
+    for op in reversed(fwd_ops):
+        if not any(o in grads_available for o in op.output_arg_names()):
+            continue
+        opdef = get_op_def(op.type)
+        if opdef.grad is None:
+            continue
+        if callable(opdef.grad):
+            specs = opdef.grad(op, block, helper)
+        else:  # 'auto'
+            specs = build_auto_grad_specs(op, block, no_grad)
+        for spec in specs:
+            spec["attrs"]["op_role"] = OpRole.Backward
+            spec["attrs"]["__accumulate__"] = True
+            ensure_grad_op_registered(op.type)
+            emitted.append(spec)
+        for slot, names in op.inputs.items():
+            for n in names:
+                v = block._find_var_recursive(n)
+                if v is not None and not v.stop_gradient and n not in no_grad:
+                    grads_available.add(n)
+
+    for spec in emitted:
+        block.append_op(spec["type"], inputs=spec["inputs"],
+                        outputs=spec["outputs"], attrs=spec["attrs"])
+
+    # 3. collect (param, grad) pairs
+    if parameter_list is not None:
+        params = [block.var(p) if isinstance(p, str) else p
+                  for p in parameter_list]
+    else:
+        params = [v for v in block.vars.values()
+                  if getattr(v, "is_parameter", False) and v.trainable]
+    params_grads: List[Tuple[Variable, Variable]] = []
+    for p in params:
+        gname = grad_var_name(p.name)
+        if p.name in no_grad:
+            continue
+        if block.has_var_local(gname) and gname in _written_names(block):
+            g = block.var(gname)
+            g.persistable = False
+            params_grads.append((p, g))
+    program.bump()
+    return params_grads
+
+
+def _written_names(block: Block) -> Set[str]:
+    s: Set[str] = set()
+    for op in block.ops:
+        s.update(op.output_arg_names())
+    return s
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference fluid.backward.gradients (calc_gradient, backward.py:1729):
+    grads of sum(targets) w.r.t. inputs."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    block = targets[0].block
+    no_grad = _collect_no_grad(block, no_grad_set)
+
+    for i, t in enumerate(targets):
+        tg = (target_gradients[i]
+              if target_gradients and i < len(target_gradients) else None)
+        gname = grad_var_name(t.name)
+        if tg is None:
+            block.append_op("fill_any_like", inputs={"X": [t.name]},
+                            outputs={"Out": [gname]},
+                            attrs={"value": 1.0, "dtype": t.dtype,
+                                   "op_role": OpRole.Backward})
+        else:
+            block.append_op("assign", inputs={"X": [tg.name]},
+                            outputs={"Out": [gname]},
+                            attrs={"op_role": OpRole.Backward})
+
+    target_names = {t.name for t in targets}
+    fwd_ops = [op for op in block.ops
+               if op.attr("op_role") in (OpRole.Forward,
+                                         OpRole.Forward | OpRole.Loss)]
+    grads_available = set(target_names)
+    helper = GradHelper(block, no_grad)
+    emitted = []
+    for op in reversed(fwd_ops):
+        if not any(o in grads_available for o in op.output_arg_names()):
+            continue
+        opdef = get_op_def(op.type)
+        if opdef.grad is None:
+            continue
+        specs = (opdef.grad(op, block, helper) if callable(opdef.grad)
+                 else build_auto_grad_specs(op, block, no_grad))
+        for spec in specs:
+            spec["attrs"]["op_role"] = OpRole.Backward
+            spec["attrs"]["__accumulate__"] = True
+            ensure_grad_op_registered(op.type)
+            emitted.append(spec)
+        for names in op.inputs.values():
+            for n in names:
+                v = block._find_var_recursive(n)
+                if v is not None and not v.stop_gradient and n not in no_grad:
+                    grads_available.add(n)
+    for spec in emitted:
+        block.append_op(spec["type"], inputs=spec["inputs"],
+                        outputs=spec["outputs"], attrs=spec["attrs"])
+    block.program.bump()
+    outs = []
+    for x in inputs:
+        gname = grad_var_name(x.name)
+        outs.append(block.var(gname) if block.has_var(gname) else None)
+    return outs
+
+
+calc_gradient = gradients

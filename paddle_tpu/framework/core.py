@@ -1,0 +1,612 @@
+"""Core IR: Program / Block / Operator / Variable.
+
+TPU-native re-design of the reference's protobuf ProgramDesc IR
+(reference: paddle/fluid/framework/framework.proto:42-203 and the Python
+mirrors in python/paddle/fluid/framework.py:924,1923,2520,4005).
+
+Design notes (tpu-first):
+  * The IR is a build-time artifact only.  Execution never interprets it
+    op-by-op; the Executor lowers a whole block into a single traced JAX
+    function compiled once by XLA (see framework/executor.py).  This is the
+    fundamental architectural inversion vs. the reference, whose
+    Executor::Run loop (framework/executor.cc:474-480) dispatches a kernel
+    per op per step.
+  * Shape/dtype inference runs at op-append time (mirroring the reference's
+    compile-time InferShape) so that graph construction errors surface
+    eagerly and the lowered function can be traced with static shapes --
+    a hard requirement for the MXU/XLA compilation model.
+  * Serialization is JSON-based (framework/serde.py) rather than protobuf:
+    the wire format carries the same information (ops, vars, blocks,
+    attrs, version) without a C++ proto dependency.
+"""
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# dtype handling
+# ---------------------------------------------------------------------------
+
+# Canonical dtype strings.  Mirrors reference VarType.Type dtype enum
+# (framework/framework.proto:104) but stored as strings for readability.
+_DTYPE_ALIASES = {
+    "float32": "float32", "fp32": "float32", "f32": "float32",
+    "float64": "float64", "fp64": "float64", "double": "float64",
+    "float16": "float16", "fp16": "float16", "half": "float16",
+    "bfloat16": "bfloat16", "bf16": "bfloat16",
+    "int8": "int8", "uint8": "uint8",
+    "int16": "int16", "int32": "int32", "int64": "int64",
+    "bool": "bool",
+    "complex64": "complex64", "complex128": "complex128",
+}
+
+
+def convert_dtype(dtype) -> str:
+    """Normalize any dtype spec (str / numpy / jax) to a canonical string."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key in _DTYPE_ALIASES:
+            return _DTYPE_ALIASES[key]
+        raise ValueError(f"unsupported dtype string: {dtype!r}")
+    # numpy / jax dtype objects
+    name = np.dtype(dtype).name if not hasattr(dtype, "name") else dtype.name
+    if name in _DTYPE_ALIASES:
+        return _DTYPE_ALIASES[name]
+    raise ValueError(f"unsupported dtype: {dtype!r}")
+
+
+def dtype_to_np(dtype: str):
+    import jax.numpy as jnp
+
+    d = convert_dtype(dtype)
+    if d == "bfloat16":
+        return jnp.bfloat16
+    return np.dtype(d)
+
+
+# ---------------------------------------------------------------------------
+# Variable type enum (subset of reference VarType.Type,
+# framework/framework.proto:104)
+# ---------------------------------------------------------------------------
+class VarType:
+    DENSE_TENSOR = "dense_tensor"   # reference LOD_TENSOR
+    SELECTED_ROWS = "selected_rows"  # sparse row-slab gradients
+    TENSOR_ARRAY = "tensor_array"   # reference LOD_TENSOR_ARRAY
+    STEP_SCOPES = "step_scopes"
+    READER = "reader"
+    RAW = "raw"
+
+
+# ---------------------------------------------------------------------------
+# Operator roles (reference framework/op_proto_maker.h OpRole) -- used by
+# backward/optimizer passes and the pipeline scheduler to classify ops.
+# ---------------------------------------------------------------------------
+class OpRole:
+    Forward = 0
+    Backward = 1
+    Optimize = 2
+    RPC = 3
+    Dist = 4
+    LRSched = 16
+    Loss = 256
+
+
+# ---------------------------------------------------------------------------
+# Variable
+# ---------------------------------------------------------------------------
+class Variable:
+    """Build-time variable descriptor + graph handle.
+
+    Mirrors reference ``fluid.framework.Variable``
+    (python/paddle/fluid/framework.py:924): name, shape, dtype,
+    persistable/stop_gradient flags, owning block.  A shape entry of -1
+    denotes a data-dependent dimension (typically batch); the Executor
+    specializes it at compile time from the feed.
+    """
+
+    def __init__(self, block: "Block", name: str, shape=None, dtype="float32",
+                 type: str = VarType.DENSE_TENSOR, persistable: bool = False,
+                 stop_gradient: bool = False, is_data: bool = False,
+                 initializer=None, trainable: bool = True,
+                 need_check_feed: bool = False, **kwargs):
+        self.block = block
+        self.name = name
+        self.shape = tuple(int(s) for s in shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype)
+        self.type = type
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.trainable = trainable
+        self.need_check_feed = need_check_feed
+        # optional sharding annotation: PartialSpec-like tuple of mesh axis
+        # names (or None) per dim.  Consumed by the distributed lowering.
+        self.dist_attr: Optional[tuple] = kwargs.get("dist_attr")
+        self.initializer = initializer
+        # Regularization / clipping attachments (mirrors ParamAttr behavior)
+        self.regularizer = kwargs.get("regularizer")
+        self.optimize_attr = kwargs.get("optimize_attr", {"learning_rate": 1.0})
+        self.do_model_average = kwargs.get("do_model_average", False)
+        self.is_distributed = False
+
+    # -- mirrors of the reference Variable API ------------------------------
+    @property
+    def ndim(self) -> int:
+        return len(self.shape) if self.shape is not None else 0
+
+    @property
+    def lod_level(self):  # ragged sequences are bucketing/masking-based here
+        return 0
+
+    def numel(self) -> int:
+        n = 1
+        for s in self.shape or ():
+            n *= max(s, 1) if s != -1 else 1
+        return n
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "shape": list(self.shape) if self.shape is not None else None,
+            "dtype": self.dtype,
+            "type": self.type,
+            "persistable": self.persistable,
+            "stop_gradient": self.stop_gradient,
+            "is_data": self.is_data,
+            "trainable": self.trainable,
+        }
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={self.shape}, "
+                f"dtype={self.dtype}, persistable={self.persistable})")
+
+    # graph-builder sugar so `x + y`, `x * 2` work in static mode like the
+    # reference's monkey-patched Variable (fluid/layers/math_op_patch.py)
+    def _binary(self, op_type, other, reverse=False):
+        from ..layers import math_op_patch
+        return math_op_patch.binary(self, other, op_type, reverse)
+
+    def __add__(self, other):
+        return self._binary("elementwise_add", other)
+
+    def __radd__(self, other):
+        return self._binary("elementwise_add", other, reverse=True)
+
+    def __sub__(self, other):
+        return self._binary("elementwise_sub", other)
+
+    def __rsub__(self, other):
+        return self._binary("elementwise_sub", other, reverse=True)
+
+    def __mul__(self, other):
+        return self._binary("elementwise_mul", other)
+
+    def __rmul__(self, other):
+        return self._binary("elementwise_mul", other, reverse=True)
+
+    def __truediv__(self, other):
+        return self._binary("elementwise_div", other)
+
+    def __rtruediv__(self, other):
+        return self._binary("elementwise_div", other, reverse=True)
+
+    def __pow__(self, other):
+        return self._binary("elementwise_pow", other)
+
+    def __matmul__(self, other):
+        from ..layers import math_op_patch
+        return math_op_patch.binary(self, other, "matmul_v2", False)
+
+    def __neg__(self):
+        return self._binary("elementwise_mul", -1.0)
+
+    def __lt__(self, other):
+        return self._binary("less_than", other)
+
+    def __le__(self, other):
+        return self._binary("less_equal", other)
+
+    def __gt__(self, other):
+        return self._binary("greater_than", other)
+
+    def __ge__(self, other):
+        return self._binary("greater_equal", other)
+
+    def astype(self, dtype):
+        from ..layers import tensor as tensor_layers
+        return tensor_layers.cast(self, dtype)
+
+
+class Parameter(Variable):
+    """Persistable trainable variable (reference fluid/framework.py:5230)."""
+
+    def __init__(self, block, name, shape, dtype="float32", **kwargs):
+        kwargs.setdefault("persistable", True)
+        super().__init__(block, name, shape=shape, dtype=dtype, **kwargs)
+        self.is_parameter = True
+
+
+# ---------------------------------------------------------------------------
+# Operator
+# ---------------------------------------------------------------------------
+class Operator:
+    """One IR op: type + slotted input/output var names + attrs.
+
+    Mirrors reference OpDesc (framework/framework.proto:42,
+    python/paddle/fluid/framework.py:1923).  Inputs/outputs are
+    slot-name -> [var names] like the reference's named Var lists.
+    """
+
+    __slots__ = ("block", "type", "inputs", "outputs", "attrs", "idx")
+
+    def __init__(self, block: "Block", type: str,
+                 inputs: Optional[Dict[str, Any]] = None,
+                 outputs: Optional[Dict[str, Any]] = None,
+                 attrs: Optional[Dict[str, Any]] = None):
+        self.block = block
+        self.type = type
+        self.inputs = {k: _as_name_list(v) for k, v in (inputs or {}).items()}
+        self.outputs = {k: _as_name_list(v) for k, v in (outputs or {}).items()}
+        self.attrs = dict(attrs or {})
+        self.attrs.setdefault("op_role", OpRole.Forward)
+        self.idx = -1
+
+    # -- reference OpDesc-style accessors -----------------------------------
+    def input(self, slot: str) -> List[str]:
+        return self.inputs.get(slot, [])
+
+    def output(self, slot: str) -> List[str]:
+        return self.outputs.get(slot, [])
+
+    def single_input(self, slot: str) -> Optional[str]:
+        names = self.inputs.get(slot, [])
+        return names[0] if names else None
+
+    def single_output(self, slot: str) -> Optional[str]:
+        names = self.outputs.get(slot, [])
+        return names[0] if names else None
+
+    def input_arg_names(self) -> List[str]:
+        return [n for ns in self.inputs.values() for n in ns]
+
+    def output_arg_names(self) -> List[str]:
+        return [n for ns in self.outputs.values() for n in ns]
+
+    def attr(self, name: str, default=None):
+        return self.attrs.get(name, default)
+
+    def set_attr(self, name: str, val):
+        self.attrs[name] = val
+
+    def has_attr(self, name: str) -> bool:
+        return name in self.attrs
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type,
+            "inputs": {k: list(v) for k, v in self.inputs.items()},
+            "outputs": {k: list(v) for k, v in self.outputs.items()},
+            "attrs": _jsonable_attrs(self.attrs),
+        }
+
+    def __repr__(self):
+        ins = {k: v for k, v in self.inputs.items()}
+        outs = {k: v for k, v in self.outputs.items()}
+        return f"Op({self.type}, in={ins}, out={outs})"
+
+
+def _as_name_list(v) -> List[str]:
+    if v is None:
+        return []
+    if isinstance(v, (list, tuple)):
+        return [x.name if isinstance(x, Variable) else str(x) for x in v]
+    if isinstance(v, Variable):
+        return [v.name]
+    return [str(v)]
+
+
+def _jsonable_attrs(attrs: dict) -> dict:
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, np.ndarray):
+            out[k] = {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+        elif isinstance(v, (np.integer,)):
+            out[k] = int(v)
+        elif isinstance(v, (np.floating,)):
+            out[k] = float(v)
+        else:
+            out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Block
+# ---------------------------------------------------------------------------
+class Block:
+    """Ordered op list + var map; nestable for control flow.
+
+    Mirrors reference BlockDesc (framework/framework.proto:174,
+    python/paddle/fluid/framework.py:2520).
+    """
+
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[Operator] = []
+
+    # -- var management -----------------------------------------------------
+    def create_var(self, name: Optional[str] = None, **kwargs) -> Variable:
+        if name is None:
+            name = unique_name("tmp")
+        if name in self.vars:
+            return self.vars[name]
+        var = Variable(self, name, **kwargs)
+        self.vars[name] = var
+        return var
+
+    def create_parameter(self, name, shape, dtype="float32", **kwargs) -> Parameter:
+        # Parameters live in block-0 (global block), like the reference.
+        gb = self.program.global_block()
+        if name in gb.vars:
+            return gb.vars[name]  # type: ignore[return-value]
+        p = Parameter(gb, name, shape, dtype, **kwargs)
+        gb.vars[name] = p
+        return p
+
+    def var(self, name: str) -> Variable:
+        v = self._find_var_recursive(name)
+        if v is None:
+            raise KeyError(f"variable {name!r} not found in block {self.idx}")
+        return v
+
+    def has_var(self, name: str) -> bool:
+        return self._find_var_recursive(name) is not None
+
+    def has_var_local(self, name: str) -> bool:
+        return name in self.vars
+
+    def _find_var_recursive(self, name: str) -> Optional[Variable]:
+        blk: Optional[Block] = self
+        while blk is not None:
+            if name in blk.vars:
+                return blk.vars[name]
+            blk = (self.program.blocks[blk.parent_idx]
+                   if blk.parent_idx >= 0 else None)
+        return None
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for v in self.vars.values()
+                if isinstance(v, Parameter) or getattr(v, "is_parameter", False)]
+
+    # -- op management ------------------------------------------------------
+    def append_op(self, type: str, inputs=None, outputs=None, attrs=None,
+                  infer_shape: bool = True) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        op.idx = len(self.ops)
+        self.ops.append(op)
+        if infer_shape:
+            from ..ops.registry import infer_op_shape
+            infer_op_shape(op, self)
+        return op
+
+    def _insert_op(self, index: int, type: str, inputs=None, outputs=None,
+                   attrs=None, infer_shape: bool = True) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.insert(index, op)
+        for i, o in enumerate(self.ops):
+            o.idx = i
+        if infer_shape:
+            from ..ops.registry import infer_op_shape
+            infer_op_shape(op, self)
+        return op
+
+    def _remove_op(self, index: int):
+        del self.ops[index]
+        for i, o in enumerate(self.ops):
+            o.idx = i
+
+    def to_dict(self) -> dict:
+        return {
+            "idx": self.idx,
+            "parent_idx": self.parent_idx,
+            "vars": [v.to_dict() for v in self.vars.values()],
+            "ops": [op.to_dict() for op in self.ops],
+        }
+
+    def __repr__(self):
+        lines = [f"Block[{self.idx}] ({len(self.vars)} vars, {len(self.ops)} ops)"]
+        for op in self.ops:
+            lines.append("  " + repr(op))
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Program
+# ---------------------------------------------------------------------------
+class Program:
+    """A whole computation: list of blocks, block 0 global.
+
+    Mirrors reference ``fluid.Program`` (python/paddle/fluid/framework.py:4005).
+    """
+
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0)]
+        self._current_block_idx = 0
+        self.random_seed = 0
+        self._version = 1
+        # cache token: executors key compiled artifacts on (id, _mod_count);
+        # any mutation helper must bump _mod_count.
+        self._mod_count = 0
+        self._is_startup = False
+
+    # -- block management ---------------------------------------------------
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[self._current_block_idx]
+
+    def _create_block(self, parent_idx: Optional[int] = None) -> Block:
+        parent = self._current_block_idx if parent_idx is None else parent_idx
+        blk = Block(self, len(self.blocks), parent)
+        self.blocks.append(blk)
+        self._current_block_idx = blk.idx
+        return blk
+
+    def _rollback(self):
+        self._current_block_idx = self.current_block().parent_idx
+
+    def block(self, idx: int) -> Block:
+        return self.blocks[idx]
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.blocks)
+
+    def bump(self):
+        """Invalidate compiled-function caches after mutation."""
+        self._mod_count += 1
+
+    # -- cloning / pruning (reference framework.py:4457 clone, :4652 prune) --
+    def clone(self, for_test: bool = False) -> "Program":
+        p = copy.deepcopy(self)
+        if for_test:
+            for blk in p.blocks:
+                for op in blk.ops:
+                    if op.type in ("dropout", "batch_norm", "sync_batch_norm"):
+                        op.attrs["is_test"] = True
+                    if op.type == "dropout":
+                        # inference keeps scale-at-train (upscale_in_train)
+                        pass
+                blk.ops = [op for op in blk.ops
+                           if op.attr("op_role") not in
+                           (OpRole.Backward, OpRole.Optimize)]
+        p.bump()
+        return p
+
+    def list_vars(self) -> Iterator[Variable]:
+        for blk in self.blocks:
+            yield from blk.vars.values()
+
+    def all_parameters(self) -> List[Parameter]:
+        return self.global_block().all_parameters()
+
+    def to_dict(self) -> dict:
+        return {"version": self._version,
+                "blocks": [b.to_dict() for b in self.blocks]}
+
+    def __repr__(self):
+        return "\n".join(repr(b) for b in self.blocks)
+
+
+# ---------------------------------------------------------------------------
+# global default programs + guards (reference fluid/framework.py:5443-5601)
+# ---------------------------------------------------------------------------
+_main_program = Program()
+_startup_program = Program()
+_startup_program._is_startup = True
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def switch_main_program(p: Program) -> Program:
+    global _main_program
+    prev, _main_program = _main_program, p
+    return prev
+
+
+def switch_startup_program(p: Program) -> Program:
+    global _startup_program
+    prev, _startup_program = _startup_program, p
+    return prev
+
+
+class program_guard:
+    """`with program_guard(main, startup):` context, as in the reference."""
+
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self._main = main_program
+        self._startup = startup_program
+
+    def __enter__(self):
+        self._prev_main = switch_main_program(self._main)
+        if self._startup is not None:
+            self._prev_startup = switch_startup_program(self._startup)
+        return self
+
+    def __exit__(self, *exc):
+        switch_main_program(self._prev_main)
+        if self._startup is not None:
+            switch_startup_program(self._prev_startup)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# unique name generator (reference fluid/unique_name.py)
+# ---------------------------------------------------------------------------
+class _UniqueNameGenerator:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ids: Dict[str, int] = {}
+
+    def __call__(self, prefix: str) -> str:
+        with self._lock:
+            i = self._ids.get(prefix, 0)
+            self._ids[prefix] = i + 1
+        return f"{prefix}_{i}"
+
+    def reset(self):
+        with self._lock:
+            self._ids.clear()
+
+
+_name_gen = _UniqueNameGenerator()
+
+
+def unique_name(prefix: str = "tmp") -> str:
+    return _name_gen(prefix)
+
+
+def reset_unique_name():
+    _name_gen.reset()
+
+
+# grad var naming, as in reference fluid/backward.py (`X@GRAD`)
+GRAD_SUFFIX = "@GRAD"
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+# ---------------------------------------------------------------------------
+# dygraph-mode tracer switch (reference framework.py:181 in_dygraph_mode)
+# ---------------------------------------------------------------------------
+_dygraph_tracer_holder = threading.local()
+
+
+def _dygraph_tracer():
+    return getattr(_dygraph_tracer_holder, "tracer", None)
+
+
+def _set_dygraph_tracer(tracer):
+    _dygraph_tracer_holder.tracer = tracer
+
+
+def in_dygraph_mode() -> bool:
+    return _dygraph_tracer() is not None

@@ -1,0 +1,280 @@
+"""Executor: whole-block XLA compilation with a functional scope.
+
+TPU-native replacement for the reference Executor
+(framework/executor.cc:183,474 — a per-op interpreter loop) and its Python
+front-end (python/paddle/fluid/executor.py:914).  Instead of dispatching a
+kernel per op per step, `Executor.run` lowers the entire block into ONE
+JAX function:
+
+    fn(feed_values, state_values, step) -> (fetch_values, new_state_values)
+
+jit-compiled once per (program, feed-signature, fetch-list) and cached.
+`state` is the set of persistable variables (parameters, optimizer moments,
+BN running stats, learning rate): the reference's mutable Scope becomes a
+functional state-threading with donated buffers, which XLA updates in-place
+in HBM.  Garbage collection (framework/garbage_collector.h) disappears:
+intermediate lifetimes are managed by XLA's buffer assignment.
+
+Randomness is stateless: a per-run step counter is folded into a base key
+derived from program.random_seed (replaces cuRAND generator state).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.registry import LowerContext, get_op_def, lower_op
+from .core import (Block, Operator, Program, Variable, convert_dtype,
+                   default_main_program, dtype_to_np)
+
+__all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
+
+
+# ---------------------------------------------------------------------------
+# Scope: name -> device array holder (reference framework/scope.h:52)
+# ---------------------------------------------------------------------------
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, Any] = {}
+        self.parent = parent
+        self._kids: List[Scope] = []
+
+    def var(self, name: str):
+        """Create-or-get, like reference Scope::Var."""
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s.parent
+        return False
+
+    def set_var(self, name: str, value):
+        self._vars[name] = value
+
+    def erase(self, names: Sequence[str]):
+        for n in names:
+            self._vars.pop(n, None)
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def local_var_names(self) -> List[str]:
+        return list(self._vars)
+
+    def drop_kids(self):
+        self._kids.clear()
+
+
+_global_scope = Scope()
+_scope_stack: List[Scope] = [_global_scope]
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    def __init__(self, scope: Scope):
+        self.scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self.scope)
+        return self.scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Block analysis: classify vars into feed / state-in / state-out / temps
+# ---------------------------------------------------------------------------
+
+def analyze_block(block: Block, feed_names: Sequence[str]):
+    """Returns (state_in, state_out): persistable vars the compiled function
+    must consume from / produce back into the scope."""
+    written: set = set()
+    state_in: List[str] = []
+    state_out: List[str] = []
+    seen_in: set = set(feed_names)
+    seen_out: set = set()
+    for op in block.ops:
+        for name in op.input_arg_names():
+            if name in seen_in or name in written or not name:
+                continue
+            v = block._find_var_recursive(name)
+            if v is not None and (v.persistable or v.is_data):
+                state_in.append(name)
+                seen_in.add(name)
+            elif v is not None and not v.persistable and name not in written:
+                # temp read before write inside the block: must come from
+                # scope too (e.g. a fetched var from a previous partial run)
+                state_in.append(name)
+                seen_in.add(name)
+        for name in op.output_arg_names():
+            if not name:
+                continue
+            written.add(name)
+            v = block._find_var_recursive(name)
+            if v is not None and v.persistable and name not in seen_out:
+                state_out.append(name)
+                seen_out.add(name)
+    return state_in, state_out
+
+
+def lower_block(block: Block, env: Dict[str, Any], base_key,
+                is_test: bool = False, mesh=None) -> LowerContext:
+    ctx = LowerContext(block, env, base_key=base_key, is_test=is_test,
+                       mesh=mesh)
+    for op in block.ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        lower_op(ctx, op)
+    return ctx
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+class Executor:
+    """`Executor(place)` — place is advisory; jax selects the backend.
+
+    API mirrors reference fluid.Executor (python/paddle/fluid/executor.py):
+    run(program, feed, fetch_list, scope, return_numpy).
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Tuple, Any] = {}
+        self._step = 0
+
+    # -- public API ---------------------------------------------------------
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence] = None,
+            scope: Optional[Scope] = None,
+            return_numpy: bool = True,
+            use_program_cache: bool = True):
+        import jax
+
+        if program is None:
+            program = default_main_program()
+        # CompiledProgram (data-parallel wrapper) delegates here
+        if hasattr(program, "_compile_and_run"):
+            return program._compile_and_run(self, feed, fetch_list, scope,
+                                            return_numpy)
+        feed = dict(feed or {})
+        fetch_names = _fetch_names(fetch_list)
+        scope = scope or global_scope()
+
+        block = program.global_block()
+        feed_arrays = _prepare_feed(block, feed)
+        sig = tuple((n, tuple(np.shape(a)), str(np.asarray(a).dtype))
+                    for n, a in sorted(feed_arrays.items()))
+        key = (id(program), program._mod_count, sig, tuple(fetch_names))
+
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None:
+            entry = self._build(program, block, list(feed_arrays),
+                                fetch_names)
+            if use_program_cache:
+                self._cache[key] = entry
+        fn, mut_in, const_in, state_out = entry
+
+        def _val(name):
+            val = scope.find_var(name)
+            if val is None:
+                raise RuntimeError(
+                    f"variable {name!r} has no value in scope; did you run "
+                    f"the startup program first?")
+            return val
+
+        mut_vals = tuple(_val(n) for n in mut_in)
+        const_vals = tuple(_val(n) for n in const_in)
+
+        self._step += 1
+        step = np.int32(self._step)
+        fetches, new_state = fn(tuple(feed_arrays.values()),
+                                mut_vals, const_vals, step)
+        for name, val in zip(state_out, new_state):
+            scope.set_var(name, val)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    # -- compilation --------------------------------------------------------
+    def _build(self, program: Program, block: Block,
+               feed_names: List[str], fetch_names: List[str]):
+        import jax
+
+        state_in, state_out = analyze_block(block, feed_names)
+        # fetched temps must be emitted; ensure they exist in the block
+        for n in fetch_names:
+            block.var(n)  # raises if unknown
+
+        out_set = set(state_out)
+        mut_in = [n for n in state_in if n in out_set]
+        const_in = [n for n in state_in if n not in out_set]
+        seed = program.random_seed or 0
+
+        def step_fn(feed_vals, mut_vals, const_vals, step):
+            base_key = jax.random.fold_in(
+                jax.random.key(np.uint32(seed)), step)
+            env: Dict[str, Any] = {}
+            env.update(zip(feed_names, feed_vals))
+            env.update(zip(mut_in, mut_vals))
+            env.update(zip(const_in, const_vals))
+            lower_block(block, env, base_key)
+            fetches = tuple(env[n] for n in fetch_names)
+            new_state = tuple(env[n] for n in state_out)
+            return fetches, new_state
+
+        # Donate only rebound state: params update in place in HBM.
+        fn = jax.jit(step_fn, donate_argnums=(1,))
+        return fn, mut_in, const_in, state_out
+
+    def close(self):
+        self._cache.clear()
+
+
+def _fetch_names(fetch_list) -> List[str]:
+    names = []
+    for f in fetch_list or []:
+        if isinstance(f, Variable):
+            names.append(f.name)
+        elif isinstance(f, str):
+            names.append(f)
+        else:
+            raise TypeError(f"bad fetch entry: {f!r}")
+    return names
+
+
+def _prepare_feed(block: Block, feed: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for name, value in feed.items():
+        arr = np.asarray(value)
+        if block.has_var(name):
+            v = block.var(name)
+            want = dtype_to_np(v.dtype)
+            if arr.dtype != want:
+                arr = arr.astype(want)
+            if v.shape is not None and len(v.shape) == arr.ndim + 1 and \
+                    v.shape and v.shape[-1] == 1:
+                # labels fed as (N,) for (N,1) vars, as the reference allows
+                arr = arr.reshape(arr.shape + (1,))
+        out[name] = arr
+    return out

@@ -1,0 +1,107 @@
+"""LayerHelper: shared param-creation / op-append plumbing for layers.
+
+Mirrors reference python/paddle/fluid/layer_helper.py + param_attr.py.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .core import (Parameter, Variable, default_main_program,
+                   default_startup_program, in_dygraph_mode, unique_name)
+from .initializer import (ConstantInitializer, Initializer,
+                          XavierInitializer)
+
+
+class ParamAttr:
+    """Mirrors reference fluid.ParamAttr (python/paddle/fluid/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 gradient_clip=None):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.gradient_clip = gradient_clip
+
+    @staticmethod
+    def _to_attr(arg):
+        if arg is None:
+            return ParamAttr()
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if isinstance(arg, Initializer):
+            return ParamAttr(initializer=arg)
+        if arg is False:
+            return False
+        raise TypeError(f"cannot convert {arg!r} to ParamAttr")
+
+
+WeightNormParamAttr = ParamAttr  # placeholder parity alias
+
+
+class LayerHelper:
+    def __init__(self, layer_type: str, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+        self.name = kwargs.get("name") or unique_name(layer_type)
+
+    @property
+    def main_program(self):
+        return default_main_program()
+
+    @property
+    def startup_program(self):
+        return default_startup_program()
+
+    def create_parameter(self, attr, shape, dtype="float32",
+                         is_bias: bool = False,
+                         default_initializer: Optional[Initializer] = None
+                         ) -> Parameter:
+        attr = ParamAttr._to_attr(attr)
+        name = attr.name or unique_name(f"{self.name}.w")
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = (ConstantInitializer(0.0) if is_bias
+                    else XavierInitializer())
+        if in_dygraph_mode():
+            from ..dygraph.base import create_dygraph_parameter
+            return create_dygraph_parameter(name, shape, dtype, init, attr)
+        block = self.main_program.global_block()
+        p = block.create_parameter(
+            name, shape, dtype, trainable=attr.trainable,
+            regularizer=attr.regularizer,
+            optimize_attr={"learning_rate": attr.learning_rate})
+        init(p, self.startup_program.global_block())
+        return p
+
+    def create_variable_for_type_inference(self, dtype="float32",
+                                           stop_gradient=False) -> Variable:
+        if in_dygraph_mode():
+            from ..dygraph.base import create_dygraph_tmp
+            return create_dygraph_tmp(dtype)
+        return self.main_program.current_block().create_var(
+            name=unique_name(f"{self.name}.tmp"), dtype=dtype,
+            stop_gradient=stop_gradient)
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        if in_dygraph_mode():
+            from ..framework.core import _dygraph_tracer
+            return _dygraph_tracer().trace_op(type, inputs or {},
+                                              outputs or {}, attrs or {})
+        return self.main_program.current_block().append_op(
+            type, inputs=inputs, outputs=outputs, attrs=attrs)
+
+    def append_activation(self, out: Variable, act: Optional[str]):
+        if act is None:
+            return out
+        act_out = self.create_variable_for_type_inference(out.dtype)
+        self.append_op(act, inputs={"X": [out]}, outputs={"Out": [act_out]})
+        return act_out
+
+    def input(self, x):
+        return x

@@ -1,0 +1,10 @@
+"""Graph-building layer functions (reference python/paddle/fluid/layers/)."""
+from . import math_op_patch  # noqa
+from .nn import *  # noqa
+from .tensor import *  # noqa
+from .loss import *  # noqa
+from .metric_op import accuracy, auc  # noqa
+from . import nn  # noqa
+from . import tensor  # noqa
+from . import loss  # noqa
+from . import metric_op  # noqa

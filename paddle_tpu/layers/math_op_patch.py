@@ -1,0 +1,29 @@
+"""Operator-overloading support for static Variables
+(reference fluid/layers/math_op_patch.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def binary(x, other, op_type, reverse=False):
+    from ..framework.core import Variable, in_dygraph_mode
+    from ..framework.layer_helper import LayerHelper
+    if in_dygraph_mode():
+        from ..dygraph import varbase_patch
+        return varbase_patch.binary(x, other, op_type, reverse)
+    helper = LayerHelper(op_type)
+    if isinstance(other, (int, float, np.number)):
+        const = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op("fill_constant", outputs={"Out": [const]},
+                         attrs={"shape": [1], "dtype": x.dtype,
+                                "value": float(other)})
+        const.stop_gradient = True
+        other = const
+    a, b = (other, x) if reverse else (x, other)
+    out_dtype = "bool" if op_type in (
+        "less_than", "less_equal", "greater_than", "greater_equal",
+        "equal", "not_equal") else a.dtype
+    out = helper.create_variable_for_type_inference(out_dtype)
+    helper.append_op(op_type, inputs={"X": [a], "Y": [b]},
+                     outputs={"Out": [out]}, attrs={"axis": -1})
+    return out

@@ -1,0 +1,12 @@
+"""Operator library: importing this package registers all op lowerings.
+
+TPU-native equivalent of the reference's operator library
+(paddle/fluid/operators/ — see SURVEY.md §2.3); ops here are JAX lowering
+rules compiled by XLA instead of per-op CUDA kernels.
+"""
+from . import math_ops  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from .registry import (LowerContext, all_registered_ops, get_op_def,  # noqa
+                       has_op, register_op)

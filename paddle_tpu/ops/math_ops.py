@@ -1,0 +1,489 @@
+"""Math / elementwise / reduction / matmul op lowerings.
+
+Replaces the reference's hand-written CPU/CUDA kernels for these ops
+(operators/elementwise/*, operators/reduce_ops/*, operators/matmul_op.cc,
+operators/activation_op.*, operators/scale_op.cc, operators/sum_op.cc,
+operators/cast_op.cc, operators/clip_op.cc) with jax.numpy/lax lowerings
+fused by XLA.  Broadcasting follows the reference's axis-aligned rule
+(operators/elementwise/elementwise_op_function.h).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Block, Operator, convert_dtype, dtype_to_np
+from .registry import (LowerContext, broadcast_shapes, in_var, register_op,
+                       same_as_input, set_out)
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary ops (broadcast with paddle `axis` semantics)
+# ---------------------------------------------------------------------------
+
+def _ew_infer(op: Operator, block: Block):
+    x = in_var(op, block, "X")
+    y = in_var(op, block, "Y")
+    axis = op.attr("axis", -1)
+    shape = broadcast_shapes(list(x.shape), list(y.shape), axis)
+    set_out(op, block, "Out", shape, x.dtype)
+
+
+def _align_y(x, y, axis):
+    jnp = _jnp()
+    xr, yr = jnp.ndim(x), jnp.ndim(y)
+    if yr < xr:
+        if axis == -1:
+            axis = xr - yr
+        y = jnp.reshape(y, (1,) * axis + tuple(jnp.shape(y)) +
+                        (1,) * (xr - axis - yr))
+    elif xr < yr:
+        if axis == -1:
+            axis = yr - xr
+        x = jnp.reshape(x, (1,) * axis + tuple(jnp.shape(x)) +
+                        (1,) * (yr - axis - xr))
+    return x, y
+
+
+def _make_ew(op_type, fn):
+    def lower(ctx: LowerContext, op: Operator):
+        x = ctx.get_input(op, "X")
+        y = ctx.get_input(op, "Y")
+        x, y = _align_y(x, y, op.attr("axis", -1))
+        ctx.set_output(op, "Out", fn(x, y))
+    register_op(op_type, infer=_ew_infer, lower=lower)
+
+
+_make_ew("elementwise_add", lambda x, y: x + y)
+_make_ew("elementwise_sub", lambda x, y: x - y)
+_make_ew("elementwise_mul", lambda x, y: x * y)
+_make_ew("elementwise_div", lambda x, y: x / y)
+_make_ew("elementwise_min", lambda x, y: _jnp().minimum(x, y))
+_make_ew("elementwise_max", lambda x, y: _jnp().maximum(x, y))
+_make_ew("elementwise_pow", lambda x, y: _jnp().power(x, y))
+_make_ew("elementwise_mod", lambda x, y: _jnp().mod(x, y))
+_make_ew("elementwise_floordiv", lambda x, y: _jnp().floor_divide(x, y))
+
+
+# ---------------------------------------------------------------------------
+# comparison / logical (non-differentiable)
+# ---------------------------------------------------------------------------
+
+def _cmp_infer(op: Operator, block: Block):
+    x = in_var(op, block, "X")
+    y = in_var(op, block, "Y")
+    shape = broadcast_shapes(list(x.shape), list(y.shape), op.attr("axis", -1))
+    set_out(op, block, "Out", shape, "bool")
+
+
+def _make_cmp(op_type, fn):
+    def lower(ctx, op):
+        x, y = ctx.get_input(op, "X"), ctx.get_input(op, "Y")
+        ctx.set_output(op, "Out", fn(x, y))
+    register_op(op_type, infer=_cmp_infer, lower=lower, grad=None)
+
+
+_make_cmp("less_than", lambda x, y: x < y)
+_make_cmp("less_equal", lambda x, y: x <= y)
+_make_cmp("greater_than", lambda x, y: x > y)
+_make_cmp("greater_equal", lambda x, y: x >= y)
+_make_cmp("equal", lambda x, y: x == y)
+_make_cmp("not_equal", lambda x, y: x != y)
+_make_cmp("logical_and", lambda x, y: _jnp().logical_and(x, y))
+_make_cmp("logical_or", lambda x, y: _jnp().logical_or(x, y))
+_make_cmp("logical_xor", lambda x, y: _jnp().logical_xor(x, y))
+
+
+@register_op("logical_not", infer=same_as_input(), grad=None)
+def _logical_not(ctx, op):
+    ctx.set_output(op, "Out", _jnp().logical_not(ctx.get_input(op, "X")))
+
+
+@register_op("isfinite_v2", infer=same_as_input(), grad=None)
+def _isfinite(ctx, op):
+    ctx.set_output(op, "Out", _jnp().isfinite(ctx.get_input(op, "X")))
+
+
+def _isfinite_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, "bool")
+
+
+for _t in ("isfinite_v2", "isnan_v2", "isinf_v2"):
+    pass  # shapes fixed below
+
+register_op("isnan_v2", infer=_isfinite_infer, grad=None,
+            lower=lambda ctx, op: ctx.set_output(
+                op, "Out", _jnp().isnan(ctx.get_input(op, "X"))))
+register_op("isinf_v2", infer=_isfinite_infer, grad=None,
+            lower=lambda ctx, op: ctx.set_output(
+                op, "Out", _jnp().isinf(ctx.get_input(op, "X"))))
+_REG_FIX = True
+# fix isfinite_v2 infer (bool output)
+from .registry import _REGISTRY  # noqa: E402
+_REGISTRY["isfinite_v2"].infer = _isfinite_infer
+
+
+# ---------------------------------------------------------------------------
+# unary activations & pointwise math
+# ---------------------------------------------------------------------------
+
+def _make_unary(op_type, fn, grad="auto"):
+    def lower(ctx: LowerContext, op: Operator):
+        ctx.set_output(op, "Out", fn(ctx.get_input(op, "X"), op))
+    register_op(op_type, infer=same_as_input(), lower=lower, grad=grad)
+
+
+def _jnn():
+    import jax.nn
+    return jax.nn
+
+
+_make_unary("relu", lambda x, op: _jnp().maximum(x, 0))
+_make_unary("relu6", lambda x, op: _jnp().clip(x, 0, op.attr("threshold", 6.0)))
+_make_unary("sigmoid", lambda x, op: _jnn().sigmoid(x))
+_make_unary("tanh", lambda x, op: _jnp().tanh(x))
+_make_unary("exp", lambda x, op: _jnp().exp(x))
+_make_unary("log", lambda x, op: _jnp().log(x))
+_make_unary("log2", lambda x, op: _jnp().log2(x))
+_make_unary("log10", lambda x, op: _jnp().log10(x))
+_make_unary("log1p", lambda x, op: _jnp().log1p(x))
+_make_unary("sqrt", lambda x, op: _jnp().sqrt(x))
+_make_unary("rsqrt", lambda x, op: 1.0 / _jnp().sqrt(x))
+_make_unary("square", lambda x, op: x * x)
+_make_unary("abs", lambda x, op: _jnp().abs(x))
+_make_unary("reciprocal", lambda x, op: 1.0 / x)
+_make_unary("floor", lambda x, op: _jnp().floor(x))
+_make_unary("ceil", lambda x, op: _jnp().ceil(x))
+_make_unary("round", lambda x, op: _jnp().round(x))
+_make_unary("sin", lambda x, op: _jnp().sin(x))
+_make_unary("cos", lambda x, op: _jnp().cos(x))
+_make_unary("tan", lambda x, op: _jnp().tan(x))
+_make_unary("asin", lambda x, op: _jnp().arcsin(x))
+_make_unary("acos", lambda x, op: _jnp().arccos(x))
+_make_unary("atan", lambda x, op: _jnp().arctan(x))
+_make_unary("sinh", lambda x, op: _jnp().sinh(x))
+_make_unary("cosh", lambda x, op: _jnp().cosh(x))
+_make_unary("erf", lambda x, op: __import__("jax").scipy.special.erf(x))
+_make_unary("gelu", lambda x, op: _jnn().gelu(
+    x, approximate=op.attr("approximate", False)))
+_make_unary("softplus", lambda x, op: _jnn().softplus(x))
+_make_unary("softsign", lambda x, op: _jnn().soft_sign(x))
+_make_unary("silu", lambda x, op: _jnn().silu(x))
+_make_unary("swish", lambda x, op: x * _jnn().sigmoid(
+    op.attr("beta", 1.0) * x))
+_make_unary("mish", lambda x, op: x * _jnp().tanh(_jnn().softplus(x)))
+_make_unary("hard_sigmoid", lambda x, op: _jnp().clip(
+    op.attr("slope", 0.2) * x + op.attr("offset", 0.5), 0, 1))
+_make_unary("hard_swish", lambda x, op: x * _jnp().clip(
+    x + op.attr("offset", 3.0), 0, op.attr("threshold", 6.0))
+    / op.attr("scale", 6.0))
+_make_unary("leaky_relu", lambda x, op: _jnn().leaky_relu(
+    x, op.attr("alpha", 0.02)))
+_make_unary("elu", lambda x, op: _jnn().elu(x, op.attr("alpha", 1.0)))
+_make_unary("logsigmoid", lambda x, op: _jnn().log_sigmoid(x))
+_make_unary("sign", lambda x, op: _jnp().sign(x), grad=None)
+_make_unary("clip", lambda x, op: _jnp().clip(
+    x, op.attr("min", float("-inf")), op.attr("max", float("inf"))))
+_make_unary("assign", lambda x, op: x)
+_make_unary("share_data", lambda x, op: x)
+
+
+@register_op("scale", infer=same_as_input())
+def _scale(ctx: LowerContext, op: Operator):
+    x = ctx.get_input(op, "X")
+    scale = op.attr("scale", 1.0)
+    if op.single_input("ScaleTensor"):
+        scale = ctx.get_input(op, "ScaleTensor")
+    bias = op.attr("bias", 0.0)
+    if op.attr("bias_after_scale", True):
+        out = x * scale + bias
+    else:
+        out = (x + bias) * scale
+    ctx.set_output(op, "Out", out)
+
+
+@register_op("pow", infer=same_as_input())
+def _pow(ctx, op):
+    x = ctx.get_input(op, "X")
+    factor = op.attr("factor", 1.0)
+    if op.single_input("FactorTensor"):
+        factor = ctx.get_input(op, "FactorTensor")
+    ctx.set_output(op, "Out", _jnp().power(x, factor))
+
+
+def _cast_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, op.attr("out_dtype", "float32"))
+
+
+@register_op("cast", infer=_cast_infer)
+def _cast(ctx, op):
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out",
+                   x.astype(dtype_to_np(op.attr("out_dtype", "float32"))))
+
+
+# ---------------------------------------------------------------------------
+# matmul family
+# ---------------------------------------------------------------------------
+
+def _matmul_shape(xs, ys, tx, ty):
+    xs, ys = list(xs), list(ys)
+    x1 = len(xs) == 1
+    y1 = len(ys) == 1
+    if x1:
+        xs = [1, xs[0]]
+    if y1:
+        ys = [ys[0], 1]
+    if tx:
+        xs = xs[:-2] + [xs[-1], xs[-2]]
+    if ty:
+        ys = ys[:-2] + [ys[-1], ys[-2]]
+    batch = xs[:-2] if len(xs) >= len(ys) else ys[:-2]
+    out = list(batch) + [xs[-2], ys[-1]]
+    if x1:
+        out.pop(-2)
+    if y1:
+        out.pop(-1)
+    if not out:
+        out = [1]
+    return tuple(out)
+
+
+def _matmul_infer(op: Operator, block: Block):
+    x, y = in_var(op, block, "X"), in_var(op, block, "Y")
+    tx = op.attr("trans_x", op.attr("transpose_X", False))
+    ty = op.attr("trans_y", op.attr("transpose_Y", False))
+    set_out(op, block, "Out", _matmul_shape(x.shape, y.shape, tx, ty), x.dtype)
+
+
+def _matmul_lower(ctx: LowerContext, op: Operator):
+    jnp = _jnp()
+    x, y = ctx.get_input(op, "X"), ctx.get_input(op, "Y")
+    tx = op.attr("trans_x", op.attr("transpose_X", False))
+    ty = op.attr("trans_y", op.attr("transpose_Y", False))
+    if tx and jnp.ndim(x) >= 2:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty and jnp.ndim(y) >= 2:
+        y = jnp.swapaxes(y, -1, -2)
+    # On the MXU, accumulate matmuls in f32 even for bf16 operands.
+    out = jnp.matmul(x, y, preferred_element_type=_acc_dtype(x.dtype),
+                     precision=_mm_precision(x.dtype))
+    out = out.astype(x.dtype)
+    alpha = op.attr("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    ctx.set_output(op, "Out", out)
+
+
+def _acc_dtype(dtype):
+    jnp = _jnp()
+    if dtype in (jnp.bfloat16, np.float16):
+        return jnp.float32
+    return dtype
+
+
+def _mm_precision(dtype):
+    """f32 operands compute at full precision (reference cuBLAS semantics);
+    bf16/f16 operands ride the fast MXU path — speed is an explicit
+    dtype/AMP choice, not a silent truncation.  On CPU, DEFAULT is already
+    full f32 (and non-default precisions compile pathologically slowly)."""
+    import jax
+    jnp = _jnp()
+    if dtype in (jnp.bfloat16, np.float16):
+        return None
+    if jax.default_backend() == "cpu":
+        return None
+    return jax.lax.Precision.HIGHEST
+
+
+register_op("matmul_v2", infer=_matmul_infer, lower=_matmul_lower)
+register_op("matmul", infer=_matmul_infer, lower=_matmul_lower)
+
+
+def _mul_infer(op: Operator, block: Block):
+    # reference `mul_op`: flatten x to 2-D at x_num_col_dims, y likewise.
+    x, y = in_var(op, block, "X"), in_var(op, block, "Y")
+    xd = op.attr("x_num_col_dims", 1)
+    yd = op.attr("y_num_col_dims", 1)
+    out = list(x.shape[:xd]) + list(y.shape[yd:])
+    set_out(op, block, "Out", out, x.dtype)
+
+
+@register_op("mul", infer=_mul_infer)
+def _mul_lower(ctx: LowerContext, op: Operator):
+    jnp = _jnp()
+    x, y = ctx.get_input(op, "X"), ctx.get_input(op, "Y")
+    xd = op.attr("x_num_col_dims", 1)
+    yd = op.attr("y_num_col_dims", 1)
+    xs, ys = jnp.shape(x), jnp.shape(y)
+    x2 = jnp.reshape(x, (int(np.prod(xs[:xd])), -1))
+    y2 = jnp.reshape(y, (int(np.prod(ys[:yd])), -1))
+    out = jnp.matmul(x2, y2, preferred_element_type=_acc_dtype(x2.dtype),
+                     precision=_mm_precision(x2.dtype))
+    out = out.astype(x2.dtype)
+    ctx.set_output(op, "Out", jnp.reshape(out, xs[:xd] + ys[yd:]))
+
+
+@register_op("dot", infer=lambda op, block: set_out(
+    op, block, "Out", list(in_var(op, block, "X").shape[:-1]) or [1],
+    in_var(op, block, "X").dtype))
+def _dot(ctx, op):
+    jnp = _jnp()
+    x, y = ctx.get_input(op, "X"), ctx.get_input(op, "Y")
+    ctx.set_output(op, "Out", jnp.sum(x * y, axis=-1))
+
+
+@register_op("bmm", infer=_matmul_infer)
+def _bmm(ctx, op):
+    jnp = _jnp()
+    x, y = ctx.get_input(op, "X"), ctx.get_input(op, "Y")
+    out = jnp.matmul(x, y, preferred_element_type=_acc_dtype(x.dtype),
+                     precision=_mm_precision(x.dtype))
+    ctx.set_output(op, "Out", out.astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def _reduce_infer(op: Operator, block: Block):
+    x = in_var(op, block, "X")
+    dims = op.attr("dim", [0])
+    keep = op.attr("keep_dim", False)
+    if op.attr("reduce_all", False) or dims is None or dims == []:
+        shape = [1] * len(x.shape) if keep else []
+    else:
+        dims = [d % len(x.shape) for d in
+                (dims if isinstance(dims, (list, tuple)) else [dims])]
+        shape = [(1 if i in dims else s) if keep else s
+                 for i, s in enumerate(x.shape) if keep or i not in dims]
+    if not shape:
+        shape = []
+    dtype = op.attr("out_dtype") or x.dtype
+    set_out(op, block, "Out", shape, dtype)
+
+
+def _make_reduce(op_type, fn, grad="auto"):
+    def lower(ctx: LowerContext, op: Operator):
+        jnp = _jnp()
+        x = ctx.get_input(op, "X")
+        keep = op.attr("keep_dim", False)
+        if op.attr("reduce_all", False) or not op.attr("dim", [0]):
+            axis = None
+        else:
+            dims = op.attr("dim", [0])
+            dims = dims if isinstance(dims, (list, tuple)) else [dims]
+            axis = tuple(d % jnp.ndim(x) for d in dims)
+        out = fn(x, axis, keep)
+        if op.attr("out_dtype"):
+            out = out.astype(dtype_to_np(op.attr("out_dtype")))
+        ctx.set_output(op, "Out", out)
+    register_op(op_type, infer=_reduce_infer, lower=lower, grad=grad)
+
+
+_make_reduce("reduce_sum", lambda x, a, k: _jnp().sum(x, axis=a, keepdims=k))
+_make_reduce("reduce_mean", lambda x, a, k: _jnp().mean(x, axis=a, keepdims=k))
+_make_reduce("reduce_max", lambda x, a, k: _jnp().max(x, axis=a, keepdims=k))
+_make_reduce("reduce_min", lambda x, a, k: _jnp().min(x, axis=a, keepdims=k))
+_make_reduce("reduce_prod", lambda x, a, k: _jnp().prod(x, axis=a, keepdims=k))
+_make_reduce("reduce_any",
+             lambda x, a, k: _jnp().any(x, axis=a, keepdims=k), grad=None)
+_make_reduce("reduce_all",
+             lambda x, a, k: _jnp().all(x, axis=a, keepdims=k), grad=None)
+_make_reduce("logsumexp", lambda x, a, k: __import__("jax").scipy.special
+             .logsumexp(x, axis=a, keepdims=k))
+
+
+def _mean_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", [], x.dtype)
+
+
+@register_op("mean", infer=_mean_infer)
+def _mean(ctx, op):
+    ctx.set_output(op, "Out", _jnp().mean(ctx.get_input(op, "X")))
+
+
+def _sum_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+
+
+@register_op("sum", infer=_sum_infer)
+def _sum(ctx, op):
+    """Add N tensors (reference sum_op, used for gradient accumulation)."""
+    xs = ctx.get_inputs(op, "X")
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    ctx.set_output(op, "Out", out)
+
+
+@register_op("p_norm", infer=lambda op, block: _reduce_like_pnorm(op, block))
+def _p_norm(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    porder = op.attr("porder", 2.0)
+    axis = op.attr("axis", -1)
+    keep = op.attr("keepdim", False)
+    if op.attr("asvector", False):
+        axis = None
+    out = jnp.linalg.norm(x, ord=porder,
+                          axis=axis if axis is None else int(axis),
+                          keepdims=keep)
+    ctx.set_output(op, "Out", out)
+
+
+def _reduce_like_pnorm(op, block):
+    x = in_var(op, block, "X")
+    if op.attr("asvector", False):
+        set_out(op, block, "Out", [], x.dtype)
+        return
+    axis = op.attr("axis", -1) % len(x.shape)
+    keep = op.attr("keepdim", False)
+    shape = [(1 if i == axis else s) for i, s in enumerate(x.shape)
+             if keep or i != axis]
+    set_out(op, block, "Out", shape, x.dtype)
+
+
+# cumulative ops
+@register_op("cumsum", infer=same_as_input())
+def _cumsum(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    axis = op.attr("axis", -1)
+    if op.attr("flatten", False):
+        x = jnp.ravel(x)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    if op.attr("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    if op.attr("exclusive", False):
+        out = out - x
+    ctx.set_output(op, "Out", out)
+
+
+@register_op("clip_by_norm", infer=same_as_input())
+def _clip_by_norm(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    max_norm = op.attr("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(x * x))
+    ctx.set_output(op, "Out",
+                   jnp.where(norm > max_norm, x * (max_norm / norm), x))
+
+
+@register_op("max", infer=_reduce_infer)
+def _max(ctx, op):
+    _REGISTRY["reduce_max"].lower(ctx, op)
+
+
+@register_op("min", infer=_reduce_infer)
+def _min(ctx, op):
+    _REGISTRY["reduce_min"].lower(ctx, op)

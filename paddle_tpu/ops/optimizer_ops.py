@@ -1,0 +1,357 @@
+"""Optimizer op lowerings: sgd/momentum/adam/adamw/adagrad/rmsprop/lamb/...
+
+Replaces the reference optimizer kernels (operators/optimizers/*.cc/.cu:
+sgd_op, momentum_op, adam_op, adamax_op, adagrad_op, adadelta_op,
+rmsprop_op, ftrl_op, lamb_op, lars_momentum_op, dgc_momentum_op).  Each is
+a pure update function over (param, grad, state) -> (param', state'); the
+Executor threads the state through the single compiled step function, so
+"in-place param update" becomes a donated-buffer rebind, which XLA turns
+into a true in-place update on TPU HBM.
+
+All are registered grad=None (optimize-role ops are never differentiated)
+and declare their aliased outputs via `stateful_outputs`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Block, Operator
+from .registry import LowerContext, in_var, register_op, set_out
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _opt_infer(*alias_pairs):
+    """Outputs mirror the shape/dtype of the aliased input slot."""
+    def infer(op: Operator, block: Block):
+        for out_slot, in_slot in alias_pairs:
+            if not op.output(out_slot):
+                continue
+            src = in_var(op, block, in_slot)
+            set_out(op, block, out_slot, src.shape, src.dtype)
+    return infer
+
+
+def _reg_opt(op_type, alias_pairs, lower):
+    register_op(op_type, infer=_opt_infer(*alias_pairs), lower=lower,
+                grad=None,
+                stateful_outputs=tuple(p[0] for p in alias_pairs))
+
+
+# ---------------------------------------------------------------------------
+
+def _sgd(ctx: LowerContext, op: Operator):
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad")
+    lr = ctx.get_input(op, "LearningRate")
+    ctx.set_output(op, "ParamOut", p - lr * g.astype(p.dtype))
+
+
+_reg_opt("sgd", [("ParamOut", "Param")], _sgd)
+
+
+def _momentum(ctx, op):
+    jnp = _jnp()
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad").astype(p.dtype)
+    v = ctx.get_input(op, "Velocity")
+    lr = ctx.get_input(op, "LearningRate")
+    mu = op.attr("mu", 0.9)
+    decay = op.attr("regularization_coeff", 0.0)
+    if op.attr("regularization_method", "") == "l2_decay":
+        g = g + decay * p
+    v_new = mu * v + g
+    if op.attr("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    ctx.set_output(op, "ParamOut", p_new)
+    ctx.set_output(op, "VelocityOut", v_new)
+
+
+_reg_opt("momentum", [("ParamOut", "Param"), ("VelocityOut", "Velocity")],
+         _momentum)
+
+
+def _adam_infer(op, block):
+    _opt_infer(("ParamOut", "Param"), ("Moment1Out", "Moment1"),
+               ("Moment2Out", "Moment2"), ("Beta1PowOut", "Beta1Pow"),
+               ("Beta2PowOut", "Beta2Pow"))(op, block)
+
+
+def _adam(ctx: LowerContext, op: Operator):
+    jnp = _jnp()
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad").astype("float32")
+    m1 = ctx.get_input(op, "Moment1")
+    m2 = ctx.get_input(op, "Moment2")
+    b1p = ctx.get_input(op, "Beta1Pow")
+    b2p = ctx.get_input(op, "Beta2Pow")
+    lr = ctx.get_input(op, "LearningRate")
+    b1 = op.attr("beta1", 0.9)
+    b2 = op.attr("beta2", 0.999)
+    if op.single_input("Beta1Tensor"):
+        b1 = ctx.get_input(op, "Beta1Tensor")
+    if op.single_input("Beta2Tensor"):
+        b2 = ctx.get_input(op, "Beta2Tensor")
+    eps = op.attr("epsilon", 1e-8)
+
+    if op.type == "adamw":
+        # decoupled weight decay (AdamW): param scaled before update
+        coeff = op.attr("coeff", 0.01)
+        if not op.attr("with_decay", True):
+            coeff = 0.0
+        p = p * (1.0 - lr * coeff)
+
+    pf = p.astype("float32")
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * g * g
+    # reference adam_op.h: lr_t = lr * sqrt(1-b2^t) / (1-b1^t)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    pf = pf - lr_t * m1n / (jnp.sqrt(m2n) + eps * jnp.sqrt(1 - b2p))
+    ctx.set_output(op, "ParamOut", pf.astype(p.dtype))
+    ctx.set_output(op, "Moment1Out", m1n)
+    ctx.set_output(op, "Moment2Out", m2n)
+    ctx.set_output(op, "Beta1PowOut", b1p * b1)
+    ctx.set_output(op, "Beta2PowOut", b2p * b2)
+
+
+for _t in ("adam", "adamw"):
+    register_op(_t, infer=_adam_infer, lower=_adam, grad=None,
+                stateful_outputs=("ParamOut", "Moment1Out", "Moment2Out",
+                                  "Beta1PowOut", "Beta2PowOut"))
+
+
+def _adamax(ctx, op):
+    jnp = _jnp()
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad").astype("float32")
+    m = ctx.get_input(op, "Moment")
+    inf_norm = ctx.get_input(op, "InfNorm")
+    b1p = ctx.get_input(op, "Beta1Pow")
+    lr = ctx.get_input(op, "LearningRate")
+    b1, b2 = op.attr("beta1", 0.9), op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-8)
+    mn = b1 * m + (1 - b1) * g
+    inf_n = jnp.maximum(b2 * inf_norm, jnp.abs(g))
+    p_new = p.astype("float32") - (lr / (1 - b1p)) * (mn / (inf_n + eps))
+    ctx.set_output(op, "ParamOut", p_new.astype(p.dtype))
+    ctx.set_output(op, "MomentOut", mn)
+    ctx.set_output(op, "InfNormOut", inf_n)
+
+
+_reg_opt("adamax", [("ParamOut", "Param"), ("MomentOut", "Moment"),
+                    ("InfNormOut", "InfNorm")], _adamax)
+
+
+def _adagrad(ctx, op):
+    jnp = _jnp()
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad").astype("float32")
+    m = ctx.get_input(op, "Moment")
+    lr = ctx.get_input(op, "LearningRate")
+    eps = op.attr("epsilon", 1e-6)
+    mn = m + g * g
+    p_new = p.astype("float32") - lr * g / (jnp.sqrt(mn) + eps)
+    ctx.set_output(op, "ParamOut", p_new.astype(p.dtype))
+    ctx.set_output(op, "MomentOut", mn)
+
+
+_reg_opt("adagrad", [("ParamOut", "Param"), ("MomentOut", "Moment")],
+         _adagrad)
+
+
+def _adadelta(ctx, op):
+    jnp = _jnp()
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad").astype("float32")
+    avg_sq = ctx.get_input(op, "AvgSquaredGrad")
+    avg_upd = ctx.get_input(op, "AvgSquaredUpdate")
+    rho = op.attr("rho", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    avg_sq_n = rho * avg_sq + (1 - rho) * g * g
+    upd = -jnp.sqrt((avg_upd + eps) / (avg_sq_n + eps)) * g
+    avg_upd_n = rho * avg_upd + (1 - rho) * upd * upd
+    ctx.set_output(op, "ParamOut", (p.astype("float32") + upd).astype(p.dtype))
+    ctx.set_output(op, "AvgSquaredGradOut", avg_sq_n)
+    ctx.set_output(op, "AvgSquaredUpdateOut", avg_upd_n)
+
+
+_reg_opt("adadelta", [("ParamOut", "Param"),
+                      ("AvgSquaredGradOut", "AvgSquaredGrad"),
+                      ("AvgSquaredUpdateOut", "AvgSquaredUpdate")], _adadelta)
+
+
+def _rmsprop(ctx, op):
+    jnp = _jnp()
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad").astype("float32")
+    ms = ctx.get_input(op, "MeanSquare")
+    mom = ctx.get_input(op, "Moment")
+    lr = ctx.get_input(op, "LearningRate")
+    rho = op.attr("decay", 0.9)
+    eps = op.attr("epsilon", 1e-10)
+    momentum = op.attr("momentum", 0.0)
+    centered = op.attr("centered", False)
+    ms_n = rho * ms + (1 - rho) * g * g
+    if centered:
+        mg = ctx.get_input(op, "MeanGrad")
+        mg_n = rho * mg + (1 - rho) * g
+        denom = ms_n - mg_n * mg_n + eps
+        ctx.set_output(op, "MeanGradOut", mg_n)
+    else:
+        denom = ms_n + eps
+    mom_n = momentum * mom + lr * g / jnp.sqrt(denom)
+    ctx.set_output(op, "ParamOut",
+                   (p.astype("float32") - mom_n).astype(p.dtype))
+    ctx.set_output(op, "MeanSquareOut", ms_n)
+    ctx.set_output(op, "MomentOut", mom_n)
+
+
+_reg_opt("rmsprop", [("ParamOut", "Param"), ("MeanSquareOut", "MeanSquare"),
+                     ("MomentOut", "Moment"), ("MeanGradOut", "MeanGrad")],
+         _rmsprop)
+
+
+def _lamb(ctx, op):
+    jnp = _jnp()
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad").astype("float32")
+    m1 = ctx.get_input(op, "Moment1")
+    m2 = ctx.get_input(op, "Moment2")
+    b1p = ctx.get_input(op, "Beta1Pow")
+    b2p = ctx.get_input(op, "Beta2Pow")
+    lr = ctx.get_input(op, "LearningRate")
+    b1, b2 = op.attr("beta1", 0.9), op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-6)
+    wd = op.attr("weight_decay", 0.01)
+    pf = p.astype("float32")
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * g * g
+    m1h = m1n / (1 - b1p)
+    m2h = m2n / (1 - b2p)
+    r = m1h / (jnp.sqrt(m2h) + eps) + wd * pf
+    p_norm = jnp.linalg.norm(pf)
+    r_norm = jnp.linalg.norm(r)
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    pf = pf - lr * trust * r
+    ctx.set_output(op, "ParamOut", pf.astype(p.dtype))
+    ctx.set_output(op, "Moment1Out", m1n)
+    ctx.set_output(op, "Moment2Out", m2n)
+    ctx.set_output(op, "Beta1PowOut", b1p * b1)
+    ctx.set_output(op, "Beta2PowOut", b2p * b2)
+
+
+_reg_opt("lamb", [("ParamOut", "Param"), ("Moment1Out", "Moment1"),
+                  ("Moment2Out", "Moment2"), ("Beta1PowOut", "Beta1Pow"),
+                  ("Beta2PowOut", "Beta2Pow")], _lamb)
+
+
+def _lars_momentum(ctx, op):
+    jnp = _jnp()
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad").astype("float32")
+    v = ctx.get_input(op, "Velocity")
+    lr = ctx.get_input(op, "LearningRate")
+    mu = op.attr("mu", 0.9)
+    coeff = op.attr("lars_coeff", 0.001)
+    decay = op.attr("lars_weight_decay", 0.0005)
+    eps = op.attr("epsilon", 0.0)
+    pf = p.astype("float32")
+    p_norm = jnp.linalg.norm(pf)
+    g_norm = jnp.linalg.norm(g)
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + decay * p_norm + eps), lr)
+    v_new = mu * v + local_lr * (g + decay * pf)
+    ctx.set_output(op, "ParamOut", (pf - v_new).astype(p.dtype))
+    ctx.set_output(op, "VelocityOut", v_new)
+
+
+_reg_opt("lars_momentum", [("ParamOut", "Param"),
+                           ("VelocityOut", "Velocity")], _lars_momentum)
+
+
+def _ftrl(ctx, op):
+    jnp = _jnp()
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad").astype("float32")
+    sq = ctx.get_input(op, "SquaredAccumulator")
+    lin = ctx.get_input(op, "LinearAccumulator")
+    lr = ctx.get_input(op, "LearningRate")
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    power = op.attr("lr_power", -0.5)
+    pf = p.astype("float32")
+    new_sq = sq + g * g
+    sigma = (jnp.power(new_sq, -power) - jnp.power(sq, -power)) / lr
+    new_lin = lin + g - sigma * pf
+    x = jnp.clip(new_lin, -l1, l1) - new_lin
+    y = jnp.power(new_sq, -power) / lr + 2 * l2
+    p_new = jnp.where(jnp.abs(new_lin) > l1, x / y, 0.0)
+    ctx.set_output(op, "ParamOut", p_new.astype(p.dtype))
+    ctx.set_output(op, "SquaredAccumOut", new_sq)
+    ctx.set_output(op, "LinearAccumOut", new_lin)
+
+
+_reg_opt("ftrl", [("ParamOut", "Param"),
+                  ("SquaredAccumOut", "SquaredAccumulator"),
+                  ("LinearAccumOut", "LinearAccumulator")], _ftrl)
+
+
+def _dpsgd(ctx, op):
+    """Differentially-private SGD (reference operators/optimizers/dpsgd_op.h):
+    clip grad to clip-norm, add gaussian noise scaled by sigma."""
+    import jax
+    jnp = _jnp()
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad").astype("float32")
+    lr = ctx.get_input(op, "LearningRate")
+    clip = op.attr("clip", 10.0)
+    sigma = op.attr("sigma", 1.0)
+    batch_size = op.attr("batch_size", 16.0)
+    g_norm = jnp.linalg.norm(g)
+    scale = jnp.minimum(1.0, clip / (g_norm + 1e-12))
+    noise = jax.random.normal(ctx.rng(op), jnp.shape(g)) * sigma * clip
+    g_priv = (g * scale + noise) / batch_size
+    ctx.set_output(op, "ParamOut",
+                   (p.astype("float32") - lr * g_priv).astype(p.dtype))
+
+
+_reg_opt("dpsgd", [("ParamOut", "Param")], _dpsgd)
+
+
+def _proximal_gd(ctx, op):
+    jnp = _jnp()
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad").astype("float32")
+    lr = ctx.get_input(op, "LearningRate")
+    l1, l2 = op.attr("l1", 0.0), op.attr("l2", 0.0)
+    prox = p.astype("float32") - lr * g
+    p_new = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+             / (1.0 + lr * l2))
+    ctx.set_output(op, "ParamOut", p_new.astype(p.dtype))
+
+
+_reg_opt("proximal_gd", [("ParamOut", "Param")], _proximal_gd)
+
+
+def _decayed_adagrad(ctx, op):
+    jnp = _jnp()
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad").astype("float32")
+    m = ctx.get_input(op, "Moment")
+    lr = ctx.get_input(op, "LearningRate")
+    decay = op.attr("decay", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    mn = decay * m + (1 - decay) * g * g
+    p_new = p.astype("float32") - lr * g / (jnp.sqrt(mn) + eps)
+    ctx.set_output(op, "ParamOut", p_new.astype(p.dtype))
+    ctx.set_output(op, "MomentOut", mn)
+
+
+_reg_opt("decayed_adagrad", [("ParamOut", "Param"), ("MomentOut", "Moment")],
+         _decayed_adagrad)

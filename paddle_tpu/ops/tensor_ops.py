@@ -1,0 +1,845 @@
+"""Tensor creation / manipulation op lowerings.
+
+Replaces reference fill/random/reshape/transpose/concat/split/slice/
+gather/scatter/embedding kernels (operators/fill_constant_op.cc,
+gaussian_random_op.*, uniform_random_op.*, reshape_op.cc, transpose_op.*,
+concat_op.*, split_op.*, slice_op.*, gather_op.*, lookup_table_v2_op.*,
+one_hot_v2_op.*, expand_v2_op.*, …).  Randomness is stateless
+counter-based jax.random keyed per-op — the TPU-native replacement for
+cuRAND generators.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Block, Operator, convert_dtype, dtype_to_np
+from .registry import (LowerContext, in_var, register_op, same_as_input,
+                       set_out)
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# creation ops
+# ---------------------------------------------------------------------------
+
+def _fill_infer(op: Operator, block: Block):
+    set_out(op, block, "Out", op.attr("shape", []),
+            op.attr("dtype", "float32"))
+
+
+@register_op("fill_constant", infer=_fill_infer, grad=None)
+def _fill_constant(ctx: LowerContext, op: Operator):
+    jnp = _jnp()
+    dtype = dtype_to_np(op.attr("dtype", "float32"))
+    value = op.attr("value", 0.0)
+    if op.attr("str_value", ""):
+        value = float(op.attr("str_value"))
+    shape = tuple(op.attr("shape", []))
+    if op.single_input("ValueTensor"):
+        value = ctx.get_input(op, "ValueTensor")
+    ctx.set_output(op, "Out", jnp.full(shape, value, dtype=dtype))
+
+
+def _fill_like_infer(op, block):
+    x = in_var(op, block, "X")
+    dt = op.attr("dtype", -1)
+    dtype = x.dtype if dt in (-1, None, "") else dt
+    set_out(op, block, "Out", x.shape, dtype)
+
+
+@register_op("fill_any_like", infer=_fill_like_infer, grad=None)
+def _fill_any_like(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    dt = op.attr("dtype", -1)
+    dtype = x.dtype if dt in (-1, None, "") else dtype_to_np(dt)
+    ctx.set_output(op, "Out", jnp.full(jnp.shape(x), op.attr("value", 0.0),
+                                       dtype=dtype))
+
+
+@register_op("fill_zeros_like", infer=same_as_input(), grad=None)
+def _fill_zeros_like(ctx, op):
+    ctx.set_output(op, "Out", _jnp().zeros_like(ctx.get_input(op, "X")))
+
+
+@register_op("assign_value", infer=_fill_infer, grad=None)
+def _assign_value(ctx, op):
+    jnp = _jnp()
+    dtype = dtype_to_np(op.attr("dtype", "float32"))
+    shape = tuple(op.attr("shape", []))
+    for key in ("fp32_values", "int32_values", "int64_values", "bool_values",
+                "values"):
+        vals = op.attr(key)
+        if vals is not None and (not isinstance(vals, list) or vals):
+            if isinstance(vals, dict) and "__ndarray__" in vals:
+                vals = np.asarray(vals["__ndarray__"], dtype=vals["dtype"])
+            arr = jnp.asarray(np.asarray(vals).reshape(shape), dtype=dtype)
+            ctx.set_output(op, "Out", arr)
+            return
+    ctx.set_output(op, "Out", jnp.zeros(shape, dtype=dtype))
+
+
+def _range_infer(op, block):
+    # shape only known statically when start/end/step are attrs
+    try:
+        n = int(np.ceil((op.attr("end") - op.attr("start")) / op.attr("step")))
+    except TypeError:
+        n = -1
+    set_out(op, block, "Out", [n], op.attr("dtype", "float32"))
+
+
+@register_op("range", infer=_range_infer, grad=None)
+def _range(ctx, op):
+    jnp = _jnp()
+    dtype = dtype_to_np(op.attr("dtype", "float32"))
+    ctx.set_output(op, "Out", jnp.arange(op.attr("start"), op.attr("end"),
+                                         op.attr("step"), dtype=dtype))
+
+
+@register_op("linspace", infer=lambda op, block: set_out(
+    op, block, "Out", [op.attr("num", 0)], op.attr("dtype", "float32")),
+    grad=None)
+def _linspace(ctx, op):
+    jnp = _jnp()
+    ctx.set_output(op, "Out", jnp.linspace(
+        op.attr("start"), op.attr("stop"), op.attr("num"),
+        dtype=dtype_to_np(op.attr("dtype", "float32"))))
+
+
+@register_op("eye", infer=lambda op, block: set_out(
+    op, block, "Out",
+    [op.attr("num_rows"), op.attr("num_columns", op.attr("num_rows"))],
+    op.attr("dtype", "float32")), grad=None)
+def _eye(ctx, op):
+    jnp = _jnp()
+    ctx.set_output(op, "Out", jnp.eye(
+        op.attr("num_rows"), op.attr("num_columns", op.attr("num_rows")),
+        dtype=dtype_to_np(op.attr("dtype", "float32"))))
+
+
+# ---------------------------------------------------------------------------
+# random ops (stateless, per-op folded keys)
+# ---------------------------------------------------------------------------
+
+@register_op("gaussian_random", infer=_fill_infer, grad=None)
+def _gaussian_random(ctx: LowerContext, op: Operator):
+    import jax
+    dtype = dtype_to_np(op.attr("dtype", "float32"))
+    shape = tuple(op.attr("shape", []))
+    mean, std = op.attr("mean", 0.0), op.attr("std", 1.0)
+    out = jax.random.normal(ctx.rng(op), shape, dtype="float32") * std + mean
+    ctx.set_output(op, "Out", out.astype(dtype))
+
+
+@register_op("uniform_random", infer=_fill_infer, grad=None)
+def _uniform_random(ctx, op):
+    import jax
+    dtype = dtype_to_np(op.attr("dtype", "float32"))
+    shape = tuple(op.attr("shape", []))
+    out = jax.random.uniform(ctx.rng(op), shape, dtype="float32",
+                             minval=op.attr("min", -1.0),
+                             maxval=op.attr("max", 1.0))
+    ctx.set_output(op, "Out", out.astype(dtype))
+
+
+@register_op("truncated_gaussian_random", infer=_fill_infer, grad=None)
+def _truncated_gaussian_random(ctx, op):
+    import jax
+    dtype = dtype_to_np(op.attr("dtype", "float32"))
+    shape = tuple(op.attr("shape", []))
+    mean, std = op.attr("mean", 0.0), op.attr("std", 1.0)
+    out = jax.random.truncated_normal(ctx.rng(op), -2.0, 2.0, shape,
+                                      dtype="float32") * std + mean
+    ctx.set_output(op, "Out", out.astype(dtype))
+
+
+@register_op("randint", infer=_fill_infer, grad=None)
+def _randint(ctx, op):
+    import jax
+    shape = tuple(op.attr("shape", []))
+    out = jax.random.randint(ctx.rng(op), shape, op.attr("low", 0),
+                             op.attr("high", 100))
+    ctx.set_output(op, "Out",
+                   out.astype(dtype_to_np(op.attr("dtype", "int64"))))
+
+
+@register_op("randperm", infer=lambda op, block: set_out(
+    op, block, "Out", [op.attr("n")], op.attr("dtype", "int64")), grad=None)
+def _randperm(ctx, op):
+    import jax
+    out = jax.random.permutation(ctx.rng(op), op.attr("n"))
+    ctx.set_output(op, "Out",
+                   out.astype(dtype_to_np(op.attr("dtype", "int64"))))
+
+
+@register_op("bernoulli", infer=same_as_input(), grad=None)
+def _bernoulli(ctx, op):
+    import jax
+    x = ctx.get_input(op, "X")
+    out = jax.random.bernoulli(ctx.rng(op), x)
+    ctx.set_output(op, "Out", out.astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+def _infer_reshape_shape(in_shape, target):
+    target = list(target)
+    if -1 in target:
+        known = 1
+        for s in target:
+            if s not in (-1, 0):
+                known *= s
+        for i, s in enumerate(target):
+            if s == 0:
+                target[i] = in_shape[i]
+                known *= in_shape[i]
+        total = int(np.prod([s for s in in_shape]))
+        target[target.index(-1)] = (total // known) if known else -1
+    else:
+        for i, s in enumerate(target):
+            if s == 0:
+                target[i] = in_shape[i]
+    return target
+
+
+def _reshape_infer(op: Operator, block: Block):
+    x = in_var(op, block, "X")
+    shape = op.attr("shape", [])
+    if -1 in (x.shape or ()):  # dynamic batch flows through
+        out = list(shape)
+        for i, s in enumerate(out):
+            if s == 0:
+                out[i] = x.shape[i]
+    else:
+        out = _infer_reshape_shape(list(x.shape), shape)
+    set_out(op, block, "Out", out, x.dtype)
+    if op.output("XShape"):
+        set_out(op, block, "XShape", [0] + list(x.shape), x.dtype)
+
+
+def _reshape_lower(ctx: LowerContext, op: Operator):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    if op.single_input("Shape"):
+        shape = list(np.asarray(ctx.get_input(op, "Shape")))
+    else:
+        shape = list(op.attr("shape", []))
+    shape = _infer_reshape_shape(list(jnp.shape(x)), shape)
+    ctx.set_output(op, "Out", jnp.reshape(x, shape))
+    if op.output("XShape"):
+        ctx.set_output(op, "XShape", jnp.zeros((0,), dtype=x.dtype))
+
+
+register_op("reshape", infer=_reshape_infer, lower=_reshape_lower)
+register_op("reshape2", infer=_reshape_infer, lower=_reshape_lower)
+
+
+def _transpose_infer(op, block):
+    x = in_var(op, block, "X")
+    axis = op.attr("axis", [])
+    set_out(op, block, "Out", [x.shape[a] for a in axis], x.dtype)
+    if op.output("XShape"):
+        set_out(op, block, "XShape", [0] + list(x.shape), x.dtype)
+
+
+def _transpose_lower(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", jnp.transpose(x, op.attr("axis", [])))
+    if op.output("XShape"):
+        ctx.set_output(op, "XShape", jnp.zeros((0,), dtype=x.dtype))
+
+
+register_op("transpose", infer=_transpose_infer, lower=_transpose_lower)
+register_op("transpose2", infer=_transpose_infer, lower=_transpose_lower)
+
+
+def _flatten_infer(op, block):
+    x = in_var(op, block, "X")
+    start = op.attr("start_axis", op.attr("axis", 1))
+    stop = op.attr("stop_axis", -1)
+    nd = len(x.shape)
+    if op.type == "flatten_contiguous_range":
+        start, stop = start % nd, stop % nd
+        mid = int(np.prod(x.shape[start:stop + 1]))
+        out = list(x.shape[:start]) + [mid] + list(x.shape[stop + 1:])
+    else:  # reference flatten/flatten2: 2-D at `axis`
+        out = [int(np.prod(x.shape[:start])) if start else 1,
+               int(np.prod(x.shape[start:]))]
+    set_out(op, block, "Out", out, x.dtype)
+    if op.output("XShape"):
+        set_out(op, block, "XShape", [0] + list(x.shape), x.dtype)
+
+
+def _flatten_lower(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    nd = jnp.ndim(x)
+    if op.type == "flatten_contiguous_range":
+        start = op.attr("start_axis", 1) % nd
+        stop = op.attr("stop_axis", -1) % nd
+        shape = jnp.shape(x)
+        out = jnp.reshape(x, shape[:start] + (-1,) + shape[stop + 1:])
+    else:
+        axis = op.attr("axis", 1)
+        out = jnp.reshape(x, (int(np.prod(jnp.shape(x)[:axis])) if axis else 1,
+                              -1))
+    ctx.set_output(op, "Out", out)
+    if op.output("XShape"):
+        ctx.set_output(op, "XShape", jnp.zeros((0,), dtype=x.dtype))
+
+
+for _t in ("flatten", "flatten2", "flatten_contiguous_range"):
+    register_op(_t, infer=_flatten_infer, lower=_flatten_lower)
+
+
+def _sq_axes(op, shape):
+    axes = op.attr("axes", [])
+    if not axes:
+        return [i for i, s in enumerate(shape) if s == 1]
+    return [a % len(shape) for a in axes]
+
+
+def _squeeze_infer(op, block):
+    x = in_var(op, block, "X")
+    axes = _sq_axes(op, x.shape)
+    out = [s for i, s in enumerate(x.shape) if i not in axes]
+    set_out(op, block, "Out", out, x.dtype)
+    if op.output("XShape"):
+        set_out(op, block, "XShape", [0] + list(x.shape), x.dtype)
+
+
+def _squeeze_lower(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    axes = _sq_axes(op, jnp.shape(x))
+    ctx.set_output(op, "Out", jnp.squeeze(x, axis=tuple(axes)))
+    if op.output("XShape"):
+        ctx.set_output(op, "XShape", jnp.zeros((0,), dtype=x.dtype))
+
+
+def _unsqueeze_infer(op, block):
+    x = in_var(op, block, "X")
+    out = list(x.shape)
+    for a in op.attr("axes", []):
+        out.insert(a if a >= 0 else a + len(out) + 1, 1)
+    set_out(op, block, "Out", out, x.dtype)
+    if op.output("XShape"):
+        set_out(op, block, "XShape", [0] + list(x.shape), x.dtype)
+
+
+def _unsqueeze_lower(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    for a in op.attr("axes", []):
+        x = jnp.expand_dims(x, a)
+    ctx.set_output(op, "Out", x)
+    if op.output("XShape"):
+        ctx.set_output(op, "XShape", jnp.zeros((0,), dtype=x.dtype))
+
+
+for _t in ("squeeze", "squeeze2"):
+    register_op(_t, infer=_squeeze_infer, lower=_squeeze_lower)
+for _t in ("unsqueeze", "unsqueeze2"):
+    register_op(_t, infer=_unsqueeze_infer, lower=_unsqueeze_lower)
+
+
+def _concat_infer(op, block):
+    xs = [block.var(n) for n in op.input("X")]
+    axis = op.attr("axis", 0) % len(xs[0].shape)
+    out = list(xs[0].shape)
+    out[axis] = sum(v.shape[axis] for v in xs)
+    set_out(op, block, "Out", out, xs[0].dtype)
+
+
+@register_op("concat", infer=_concat_infer)
+def _concat(ctx, op):
+    jnp = _jnp()
+    xs = ctx.get_inputs(op, "X")
+    axis = op.attr("axis", 0)
+    if op.single_input("AxisTensor"):
+        axis = int(np.asarray(ctx.get_input(op, "AxisTensor")))
+    ctx.set_output(op, "Out", jnp.concatenate(xs, axis=axis))
+
+
+def _split_infer(op, block):
+    x = in_var(op, block, "X")
+    axis = op.attr("axis", 0) % len(x.shape)
+    sections = op.attr("sections", [])
+    num = op.attr("num", 0)
+    outs = op.output("Out")
+    if sections:
+        sizes = sections
+    else:
+        n = num or len(outs)
+        sizes = [x.shape[axis] // n] * n
+    for name, size in zip(outs, sizes):
+        v = block._find_var_recursive(name) or block.create_var(name=name)
+        shape = list(x.shape)
+        shape[axis] = size
+        v.shape, v.dtype = tuple(shape), x.dtype
+
+
+@register_op("split", infer=_split_infer)
+def _split(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    axis = op.attr("axis", 0)
+    sections = op.attr("sections", [])
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        n = op.attr("num", 0) or len(op.output("Out"))
+        outs = jnp.split(x, n, axis=axis)
+    ctx.set_outputs(op, "Out", outs)
+
+
+def _stack_infer(op, block):
+    xs = [block.var(n) for n in op.input("X")]
+    axis = op.attr("axis", 0)
+    out = list(xs[0].shape)
+    out.insert(axis if axis >= 0 else axis + len(out) + 1, len(xs))
+    set_out(op, block, "Y", out, xs[0].dtype)
+
+
+@register_op("stack", infer=_stack_infer)
+def _stack(ctx, op):
+    xs = ctx.get_inputs(op, "X")
+    ctx.set_output(op, "Y", _jnp().stack(xs, axis=op.attr("axis", 0)))
+
+
+@register_op("unstack", infer=lambda op, block: _unstack_infer(op, block))
+def _unstack(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    axis = op.attr("axis", 0)
+    outs = [jnp.squeeze(s, axis) for s in
+            jnp.split(x, jnp.shape(x)[axis], axis=axis)]
+    ctx.set_outputs(op, "Y", outs)
+
+
+def _unstack_infer(op, block):
+    x = in_var(op, block, "X")
+    axis = op.attr("axis", 0) % len(x.shape)
+    shape = [s for i, s in enumerate(x.shape) if i != axis]
+    for name in op.output("Y"):
+        v = block._find_var_recursive(name) or block.create_var(name=name)
+        v.shape, v.dtype = tuple(shape), x.dtype
+
+
+def _slice_infer(op, block):
+    x = in_var(op, block, "Input")
+    axes = op.attr("axes", [])
+    starts, ends = op.attr("starts", []), op.attr("ends", [])
+    out = list(x.shape)
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        if dim == -1:
+            continue
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        out[a] = max(e - s, 0)
+    for a in sorted(op.attr("decrease_axis", []), reverse=True):
+        out.pop(a)
+    set_out(op, block, "Out", out, x.dtype)
+
+
+@register_op("slice", infer=_slice_infer)
+def _slice(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    axes = op.attr("axes", [])
+    starts, ends = list(op.attr("starts", [])), list(op.attr("ends", []))
+    idx = [slice(None)] * jnp.ndim(x)
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = slice(s, e if e < np.iinfo(np.int32).max else None)
+    out = x[tuple(idx)]
+    dec = op.attr("decrease_axis", [])
+    if dec:
+        out = jnp.squeeze(out, axis=tuple(dec))
+    ctx.set_output(op, "Out", out)
+
+
+def _strided_slice_infer(op, block):
+    x = in_var(op, block, "Input")
+    out = list(x.shape)
+    for a, s, e, st in zip(op.attr("axes", []), op.attr("starts", []),
+                           op.attr("ends", []), op.attr("strides", [])):
+        dim = x.shape[a]
+        if dim == -1:
+            continue
+        r = len(range(*slice(s, e, st).indices(dim)))
+        out[a] = r
+    set_out(op, block, "Out", out, x.dtype)
+
+
+@register_op("strided_slice", infer=_strided_slice_infer)
+def _strided_slice(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    idx = [slice(None)] * jnp.ndim(x)
+    for a, s, e, st in zip(op.attr("axes", []), op.attr("starts", []),
+                           op.attr("ends", []), op.attr("strides", [])):
+        idx[a] = slice(s, e, st)
+    ctx.set_output(op, "Out", x[tuple(idx)])
+
+
+def _expand_infer(op, block):
+    x = in_var(op, block, "X")
+    shape = op.attr("shape", op.attr("expand_shape", []))
+    if op.type == "expand":  # v1: expand_times multiplies dims
+        times = op.attr("expand_times", [])
+        out = [s * t for s, t in zip(x.shape, times)]
+    else:
+        out = list(shape)
+        xs = [1] * (len(out) - len(x.shape)) + list(x.shape)
+        out = [xs[i] if o == -1 else o for i, o in enumerate(out)]
+    set_out(op, block, "Out", out, x.dtype)
+
+
+@register_op("expand_v2", infer=_expand_infer)
+def _expand_v2(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    shape = list(op.attr("shape", []))
+    xs = [1] * (len(shape) - jnp.ndim(x)) + list(jnp.shape(x))
+    shape = [xs[i] if s == -1 else s for i, s in enumerate(shape)]
+    ctx.set_output(op, "Out", jnp.broadcast_to(jnp.reshape(x, xs), shape))
+
+
+@register_op("expand", infer=_expand_infer)
+def _expand(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", jnp.tile(x, op.attr("expand_times", [])))
+
+
+@register_op("tile", infer=lambda op, block: set_out(
+    op, block, "Out",
+    [s * t for s, t in zip(
+        [1] * (len(op.attr("repeat_times", [])) -
+               len(in_var(op, block, "X").shape)) +
+        list(in_var(op, block, "X").shape),
+        op.attr("repeat_times", []))] or in_var(op, block, "X").shape,
+    in_var(op, block, "X").dtype))
+def _tile(ctx, op):
+    ctx.set_output(op, "Out",
+                   _jnp().tile(ctx.get_input(op, "X"),
+                               op.attr("repeat_times", [])))
+
+
+@register_op("shape", infer=lambda op, block: set_out(
+    op, block, "Out", [len(in_var(op, block, "Input").shape)], "int32"),
+    grad=None)
+def _shape(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    ctx.set_output(op, "Out", jnp.asarray(jnp.shape(x), dtype="int32"))
+
+
+# ---------------------------------------------------------------------------
+# indexing: gather / scatter / embedding / one-hot
+# ---------------------------------------------------------------------------
+
+def _gather_infer(op, block):
+    x, idx = in_var(op, block, "X"), in_var(op, block, "Index")
+    axis = op.attr("axis", 0)
+    out = list(x.shape)
+    if len(idx.shape) == 0:
+        out.pop(axis)
+    else:
+        out[axis] = idx.shape[0]
+    set_out(op, block, "Out", out, x.dtype)
+
+
+@register_op("gather", infer=_gather_infer)
+def _gather(ctx, op):
+    jnp = _jnp()
+    x, idx = ctx.get_input(op, "X"), ctx.get_input(op, "Index")
+    axis = op.attr("axis", 0)
+    if op.single_input("Axis"):
+        axis = int(np.asarray(ctx.get_input(op, "Axis")))
+    ctx.set_output(op, "Out", jnp.take(x, idx, axis=axis))
+
+
+def _gather_nd_infer(op, block):
+    x, idx = in_var(op, block, "X"), in_var(op, block, "Index")
+    out = list(idx.shape[:-1]) + list(x.shape[idx.shape[-1]:])
+    set_out(op, block, "Out", out, x.dtype)
+
+
+@register_op("gather_nd", infer=_gather_nd_infer)
+def _gather_nd(ctx, op):
+    jnp = _jnp()
+    x, idx = ctx.get_input(op, "X"), ctx.get_input(op, "Index")
+    k = jnp.shape(idx)[-1]
+    out = x[tuple(jnp.moveaxis(idx, -1, 0))] if k == jnp.ndim(x) else \
+        x[tuple(jnp.moveaxis(idx, -1, 0))]
+    ctx.set_output(op, "Out", out)
+
+
+@register_op("scatter", infer=same_as_input())
+def _scatter(ctx, op):
+    x = ctx.get_input(op, "X")
+    idx = ctx.get_input(op, "Ids")
+    upd = ctx.get_input(op, "Updates")
+    if op.attr("overwrite", True):
+        out = x.at[idx].set(upd)
+    else:
+        out = x.at[idx].add(upd)
+    ctx.set_output(op, "Out", out)
+
+
+@register_op("scatter_nd_add", infer=same_as_input())
+def _scatter_nd_add(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    idx = ctx.get_input(op, "Index")
+    upd = ctx.get_input(op, "Updates")
+    ctx.set_output(op, "Out", x.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd))
+
+
+@register_op("index_select", infer=_gather_infer)
+def _index_select(ctx, op):
+    jnp = _jnp()
+    x, idx = ctx.get_input(op, "X"), ctx.get_input(op, "Index")
+    ctx.set_output(op, "Out", jnp.take(x, idx, axis=op.attr("dim", 0)))
+
+
+def _lookup_infer(op, block):
+    w, ids = in_var(op, block, "W"), in_var(op, block, "Ids")
+    ids_shape = list(ids.shape)
+    if op.type == "lookup_table" and ids_shape and ids_shape[-1] == 1:
+        ids_shape = ids_shape[:-1]  # v1 keeps a trailing 1-dim
+        out = ids_shape + [1, w.shape[-1]] if False else ids_shape + [w.shape[-1]]
+    else:
+        out = ids_shape + [w.shape[-1]]
+    set_out(op, block, "Out", out, w.dtype)
+
+
+def _lookup_lower(ctx: LowerContext, op: Operator):
+    jnp = _jnp()
+    w, ids = ctx.get_input(op, "W"), ctx.get_input(op, "Ids")
+    if op.type == "lookup_table" and jnp.shape(ids)[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    padding_idx = op.attr("padding_idx", -1)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    ctx.set_output(op, "Out", out)
+
+
+register_op("lookup_table", infer=_lookup_infer, lower=_lookup_lower)
+register_op("lookup_table_v2", infer=_lookup_infer, lower=_lookup_lower)
+register_op("embedding", infer=_lookup_infer, lower=_lookup_lower)
+
+
+def _one_hot_infer(op, block):
+    x = in_var(op, block, "X")
+    depth = op.attr("depth", 0)
+    shape = list(x.shape)
+    if op.type == "one_hot" and shape and shape[-1] == 1:
+        shape = shape[:-1]
+    set_out(op, block, "Out", shape + [depth], "float32")
+
+
+def _one_hot_lower(ctx, op):
+    import jax
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    if op.type == "one_hot" and jnp.shape(x)[-1] == 1:
+        x = jnp.squeeze(x, -1)
+    ctx.set_output(op, "Out",
+                   jax.nn.one_hot(x, op.attr("depth", 0), dtype="float32"))
+
+
+register_op("one_hot", infer=_one_hot_infer, lower=_one_hot_lower, grad=None)
+register_op("one_hot_v2", infer=_one_hot_infer, lower=_one_hot_lower,
+            grad=None)
+
+
+# ---------------------------------------------------------------------------
+# selection / search
+# ---------------------------------------------------------------------------
+
+def _where_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+
+
+@register_op("where", infer=_where_infer)
+def _where(ctx, op):
+    jnp = _jnp()
+    cond = ctx.get_input(op, "Condition")
+    x, y = ctx.get_input(op, "X"), ctx.get_input(op, "Y")
+    ctx.set_output(op, "Out", jnp.where(cond, x, y))
+
+
+def _argminmax_infer(op, block):
+    x = in_var(op, block, "X")
+    axis = op.attr("axis", -1)
+    keep = op.attr("keepdims", False)
+    if op.attr("flatten", False):
+        shape = []
+    else:
+        axis = axis % len(x.shape)
+        shape = [(1 if i == axis else s) for i, s in enumerate(x.shape)
+                 if keep or i != axis]
+    set_out(op, block, "Out", shape, op.attr("dtype", "int64"))
+
+
+def _make_argminmax(op_type, fn):
+    def lower(ctx, op):
+        jnp = _jnp()
+        x = ctx.get_input(op, "X")
+        if op.attr("flatten", False):
+            out = fn(jnp.ravel(x), 0, False)
+        else:
+            out = fn(x, op.attr("axis", -1), op.attr("keepdims", False))
+        ctx.set_output(op, "Out",
+                       out.astype(dtype_to_np(op.attr("dtype", "int64"))))
+    register_op(op_type, infer=_argminmax_infer, lower=lower, grad=None)
+
+
+_make_argminmax("arg_max",
+                lambda x, a, k: _jnp().argmax(x, axis=a, keepdims=k))
+_make_argminmax("arg_min",
+                lambda x, a, k: _jnp().argmin(x, axis=a, keepdims=k))
+
+
+def _topk_infer(op, block):
+    x = in_var(op, block, "X")
+    k = op.attr("k", 1)
+    axis = op.attr("axis", -1) % len(x.shape)
+    shape = list(x.shape)
+    shape[axis] = k
+    set_out(op, block, "Out", shape, x.dtype)
+    set_out(op, block, "Indices", shape, "int64")
+
+
+def _topk_lower(ctx, op):
+    import jax
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    k = op.attr("k", 1)
+    if op.single_input("K"):
+        k = int(np.asarray(ctx.get_input(op, "K")))
+    axis = op.attr("axis", -1) % jnp.ndim(x)
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(xm, k)
+    if op.attr("largest", True) is False:
+        vals, idx = jax.lax.top_k(-xm, k)
+        vals = -vals
+    ctx.set_output(op, "Out", jnp.moveaxis(vals, -1, axis))
+    ctx.set_output(op, "Indices",
+                   jnp.moveaxis(idx, -1, axis).astype("int64"))
+
+
+register_op("top_k", infer=_topk_infer, lower=_topk_lower, grad=None)
+register_op("top_k_v2", infer=_topk_infer, lower=_topk_lower, grad=None)
+
+
+def _argsort_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+    set_out(op, block, "Indices", x.shape, "int64")
+
+
+@register_op("argsort", infer=_argsort_infer, grad=None)
+def _argsort(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    axis = op.attr("axis", -1)
+    desc = op.attr("descending", False)
+    key = -x if desc else x
+    idx = jnp.argsort(key, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    ctx.set_output(op, "Out", out)
+    ctx.set_output(op, "Indices", idx.astype("int64"))
+
+
+@register_op("unique", infer=lambda op, block: set_out(
+    op, block, "Out", in_var(op, block, "X").shape,
+    in_var(op, block, "X").dtype), grad=None)
+def _unique(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    # static-shape variant: sorted unique with padding (size= required by XLA)
+    out = jnp.unique(jnp.ravel(x), size=jnp.size(x), fill_value=0)
+    ctx.set_output(op, "Out", out)
+
+
+@register_op("masked_select", infer=same_as_input())
+def _masked_select(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    mask = ctx.get_input(op, "Mask")
+    # static-shape: zero-out unselected (dynamic gather unsupported under jit)
+    ctx.set_output(op, "Out", jnp.where(mask, x, 0))
+
+
+@register_op("take_along_axis", infer=lambda op, block: set_out(
+    op, block, "Result", in_var(op, block, "Index").shape,
+    in_var(op, block, "Input").dtype))
+def _take_along_axis(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    idx = ctx.get_input(op, "Index")
+    ctx.set_output(op, "Result",
+                   jnp.take_along_axis(x, idx, axis=op.attr("Axis", 0)))
+
+
+@register_op("flip", infer=same_as_input())
+def _flip(ctx, op):
+    ctx.set_output(op, "Out", _jnp().flip(ctx.get_input(op, "X"),
+                                          axis=op.attr("axis", [0])))
+
+
+@register_op("roll", infer=same_as_input())
+def _roll(ctx, op):
+    jnp = _jnp()
+    ctx.set_output(op, "Out", jnp.roll(
+        ctx.get_input(op, "X"), op.attr("shifts", [0]),
+        axis=op.attr("axis", None) or None))
+
+
+@register_op("pad", infer=lambda op, block: _pad_infer(op, block))
+def _pad(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    pads = op.attr("paddings", [])
+    pairs = [(pads[2 * i], pads[2 * i + 1]) for i in range(jnp.ndim(x))]
+    ctx.set_output(op, "Out", jnp.pad(x, pairs,
+                                      constant_values=op.attr("pad_value", 0.0)))
+
+
+def _pad_infer(op, block):
+    x = in_var(op, block, "X")
+    pads = op.attr("paddings", [])
+    out = [s + pads[2 * i] + pads[2 * i + 1] if s != -1 else -1
+           for i, s in enumerate(x.shape)]
+    set_out(op, block, "Out", out, x.dtype)
+
+
+def _pad3d_infer(op, block):
+    x = in_var(op, block, "X")
+    p = op.attr("paddings", [0] * 6)
+    fmt = op.attr("data_format", "NCDHW")
+    out = list(x.shape)
+    if fmt == "NCDHW":
+        out[4] += p[0] + p[1]
+        out[3] += p[2] + p[3]
+        out[2] += p[4] + p[5]
+    else:
+        out[3] += p[0] + p[1]
+        out[2] += p[2] + p[3]
+        out[1] += p[4] + p[5]
+    set_out(op, block, "Out", out, x.dtype)

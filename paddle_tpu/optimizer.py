@@ -1,0 +1,546 @@
+"""Static-graph optimizers (reference python/paddle/fluid/optimizer.py:57).
+
+Each Optimizer builds graph ops: `minimize(loss)` = append_backward (IR
+autodiff) + regularization/clip rewrites + one optimizer op per param,
+with accumulator state vars initialized in the startup program.  The whole
+update compiles into the same XLA step function as forward+backward.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .framework.backward import append_backward
+from .framework.core import (OpRole, Parameter, Program, Variable,
+                             default_main_program, default_startup_program,
+                             in_dygraph_mode, unique_name)
+from .framework.initializer import ConstantInitializer
+from .regularizer import append_regularization_ops
+
+__all__ = [
+    "Optimizer", "SGD", "SGDOptimizer", "Momentum", "MomentumOptimizer",
+    "Adam", "AdamOptimizer", "AdamW", "Adagrad", "AdagradOptimizer",
+    "Adamax", "AdamaxOptimizer", "Adadelta", "AdadeltaOptimizer",
+    "RMSProp", "RMSPropOptimizer", "Ftrl", "FtrlOptimizer", "Lamb",
+    "LambOptimizer", "LarsMomentum", "LarsMomentumOptimizer",
+    "DecayedAdagrad", "DecayedAdagradOptimizer", "Dpsgd", "DpsgdOptimizer",
+]
+
+
+class Optimizer:
+    op_type = None
+
+    def __init__(self, learning_rate=0.001, parameter_list=None,
+                 regularization=None, grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = parameter_list
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name or unique_name(self.__class__.__name__.lower())
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self._lr_var: Optional[Variable] = None
+        # dygraph state: name -> DeviceArray accumulators
+        self._dy_accumulators: Dict[str, Dict[str, object]] = {}
+
+    # -- learning rate ------------------------------------------------------
+    def _create_lr_var(self, program: Program) -> Variable:
+        if self._lr_var is not None and \
+                self._lr_var.block.program is program:
+            return self._lr_var
+        from .layers.tensor import create_global_var
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return self._lr_var
+        lr_name = unique_name(f"{self._name}.lr")
+        self._lr_var = create_global_var(
+            [1], float(self._learning_rate), "float32", persistable=True,
+            name=lr_name)
+        return self._lr_var
+
+    @property
+    def learning_rate(self):
+        return self._learning_rate
+
+    def current_step_lr(self):
+        if isinstance(self._learning_rate, (int, float)):
+            return float(self._learning_rate)
+        try:
+            return float(self._learning_rate())
+        except TypeError:
+            return self._learning_rate
+
+    # -- accumulators -------------------------------------------------------
+    def _add_accumulator(self, name: str, param: Variable, shape=None,
+                         fill_value=0.0, dtype="float32") -> Variable:
+        key = param.name
+        acc = self._accumulators.setdefault(name, {})
+        if key in acc:
+            return acc[key]
+        shape = list(shape if shape is not None else param.shape)
+        main_block = default_main_program().global_block()
+        var_name = unique_name(f"{self._name}.{key}.{name}")
+        v = main_block.create_var(name=var_name, shape=shape, dtype=dtype,
+                                  persistable=True, stop_gradient=True)
+        ConstantInitializer(fill_value)(
+            v, default_startup_program().global_block())
+        acc[key] = v
+        return v
+
+    def _get_accumulator(self, name: str, param: Variable) -> Variable:
+        return self._accumulators[name][param.name]
+
+    # -- main API -----------------------------------------------------------
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if in_dygraph_mode():
+            params_grads = self._dygraph_params_grads(parameter_list)
+            self._dygraph_apply(params_grads)
+            return None, params_grads
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        opt_ops = self.apply_gradients(params_grads)
+        return opt_ops, params_grads
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss,
+                               parameter_list or self._parameter_list,
+                               no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads: List[Tuple[Variable, Variable]]):
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        return self.apply_optimize(params_grads)
+
+    def apply_optimize(self, params_grads):
+        program = default_main_program()
+        lr = self._create_lr_var(program)
+        for p, g in params_grads:
+            self._create_accumulators(p)
+        ops = []
+        for p, g in params_grads:
+            op = self._append_optimize_op(p, g, lr)
+            if op is not None:
+                op.attrs["op_role"] = OpRole.Optimize
+                ops.append(op)
+        program.bump()
+        return ops
+
+    # -- per-optimizer hooks ------------------------------------------------
+    def _create_accumulators(self, param: Variable):
+        pass
+
+    def _append_optimize_op(self, param, grad, lr):
+        raise NotImplementedError
+
+    # -- dygraph path -------------------------------------------------------
+    def _dygraph_params_grads(self, parameter_list=None):
+        params = parameter_list or self._parameter_list or []
+        pg = []
+        for p in params:
+            if getattr(p, "grad_value", None) is not None and p.trainable:
+                pg.append((p, p.grad_value))
+        return pg
+
+    def _dygraph_apply(self, params_grads):
+        from .dygraph.optimizer_engine import apply_dygraph_update
+        apply_dygraph_update(self, params_grads)
+
+    def step(self):
+        """dygraph-style step(): uses grads stashed on parameters."""
+        self._dygraph_apply(self._dygraph_params_grads())
+
+    def clear_grad(self):
+        for p in (self._parameter_list or []):
+            if hasattr(p, "clear_gradient"):
+                p.clear_gradient()
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        from .framework.executor import global_scope
+        out = {}
+        for name, accs in self._accumulators.items():
+            for pname, var in accs.items():
+                val = global_scope().find_var(var.name)
+                if val is not None:
+                    out[var.name] = np.asarray(val)
+        for pname, accs in self._dy_accumulators.items():
+            for aname, val in accs.items():
+                out[f"{pname}.{aname}"] = np.asarray(val)
+        return out
+
+    def set_state_dict(self, state):
+        from .framework.executor import global_scope
+        for name, accs in self._accumulators.items():
+            for pname, var in accs.items():
+                if var.name in state:
+                    global_scope().set_var(var.name,
+                                           np.asarray(state[var.name]))
+
+    set_dict = set_state_dict
+
+
+class SGDOptimizer(Optimizer):
+    """reference fluid/optimizer.py:956."""
+    op_type = "sgd"
+
+    def _append_optimize_op(self, param, grad, lr):
+        block = default_main_program().global_block()
+        return block.append_op(
+            "sgd",
+            inputs={"Param": [param], "Grad": [grad],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param]})
+
+
+class MomentumOptimizer(Optimizer):
+    """reference fluid/optimizer.py:1050."""
+    op_type = "momentum"
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("velocity", param)
+
+    def _append_optimize_op(self, param, grad, lr):
+        v = self._get_accumulator("velocity", param)
+        block = default_main_program().global_block()
+        return block.append_op(
+            "momentum",
+            inputs={"Param": [param], "Grad": [grad], "Velocity": [v],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param], "VelocityOut": [v]},
+            attrs={"mu": self._momentum,
+                   "use_nesterov": self._use_nesterov})
+
+
+class LarsMomentumOptimizer(Optimizer):
+    """reference fluid/optimizer.py:1605."""
+    op_type = "lars_momentum"
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, epsilon=0.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("velocity", param)
+
+    def _append_optimize_op(self, param, grad, lr):
+        v = self._get_accumulator("velocity", param)
+        block = default_main_program().global_block()
+        return block.append_op(
+            "lars_momentum",
+            inputs={"Param": [param], "Grad": [grad], "Velocity": [v],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param], "VelocityOut": [v]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay,
+                   "epsilon": self._epsilon})
+
+
+class AdamOptimizer(Optimizer):
+    """reference fluid/optimizer.py:1853."""
+    op_type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("moment1", param)
+        self._add_accumulator("moment2", param)
+        self._add_accumulator("beta1_pow", param, shape=[1],
+                              fill_value=self._beta1)
+        self._add_accumulator("beta2_pow", param, shape=[1],
+                              fill_value=self._beta2)
+
+    def _append_optimize_op(self, param, grad, lr):
+        m1 = self._get_accumulator("moment1", param)
+        m2 = self._get_accumulator("moment2", param)
+        b1p = self._get_accumulator("beta1_pow", param)
+        b2p = self._get_accumulator("beta2_pow", param)
+        block = default_main_program().global_block()
+        return block.append_op(
+            self.op_type,
+            inputs={"Param": [param], "Grad": [grad], "Moment1": [m1],
+                    "Moment2": [m2], "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param], "Moment1Out": [m1],
+                     "Moment2Out": [m2], "Beta1PowOut": [b1p],
+                     "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, **self._extra_attrs()})
+
+    def _extra_attrs(self):
+        return {}
+
+
+class AdamW(AdamOptimizer):
+    """Decoupled weight decay (paddle 2.0 AdamW; no fluid analog —
+    reference adamw appears in fleet meta-optimizers only)."""
+    op_type = "adamw"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, weight_decay=0.01, apply_decay_param_fun=None,
+                 **kwargs):
+        kwargs.pop("coeff", None)
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kwargs)
+        self._coeff = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _extra_attrs(self):
+        return {"coeff": self._coeff}
+
+    def _append_optimize_op(self, param, grad, lr):
+        op = super()._append_optimize_op(param, grad, lr)
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(param.name):
+            op.attrs["with_decay"] = False
+        return op
+
+
+class AdagradOptimizer(Optimizer):
+    """reference fluid/optimizer.py:1737."""
+    op_type = "adagrad"
+
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("moment", param, fill_value=self._initial)
+
+    def _append_optimize_op(self, param, grad, lr):
+        m = self._get_accumulator("moment", param)
+        block = default_main_program().global_block()
+        return block.append_op(
+            "adagrad",
+            inputs={"Param": [param], "Grad": [grad], "Moment": [m],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param], "MomentOut": [m]},
+            attrs={"epsilon": self._epsilon})
+
+
+class AdamaxOptimizer(Optimizer):
+    """reference fluid/optimizer.py:2119."""
+    op_type = "adamax"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("moment", param)
+        self._add_accumulator("inf_norm", param)
+        self._add_accumulator("beta1_pow", param, shape=[1],
+                              fill_value=self._beta1)
+
+    def _append_optimize_op(self, param, grad, lr):
+        m = self._get_accumulator("moment", param)
+        inf = self._get_accumulator("inf_norm", param)
+        b1p = self._get_accumulator("beta1_pow", param)
+        block = default_main_program().global_block()
+        op = block.append_op(
+            "adamax",
+            inputs={"Param": [param], "Grad": [grad], "Moment": [m],
+                    "InfNorm": [inf], "Beta1Pow": [b1p],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param], "MomentOut": [m],
+                     "InfNormOut": [inf]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+        # beta1_pow updated by a scale op, as the reference does
+        block.append_op("scale", inputs={"X": [b1p]},
+                        outputs={"Out": [b1p]},
+                        attrs={"scale": self._beta1,
+                               "op_role": OpRole.Optimize})
+        return op
+
+
+class AdadeltaOptimizer(Optimizer):
+    """reference fluid/optimizer.py:2496."""
+    op_type = "adadelta"
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("avg_squared_grad", param)
+        self._add_accumulator("avg_squared_update", param)
+
+    def _append_optimize_op(self, param, grad, lr):
+        g1 = self._get_accumulator("avg_squared_grad", param)
+        g2 = self._get_accumulator("avg_squared_update", param)
+        block = default_main_program().global_block()
+        return block.append_op(
+            "adadelta",
+            inputs={"Param": [param], "Grad": [grad],
+                    "AvgSquaredGrad": [g1], "AvgSquaredUpdate": [g2]},
+            outputs={"ParamOut": [param], "AvgSquaredGradOut": [g1],
+                     "AvgSquaredUpdateOut": [g2]},
+            attrs={"epsilon": self._epsilon, "rho": self._rho})
+
+
+class RMSPropOptimizer(Optimizer):
+    """reference fluid/optimizer.py:2615."""
+    op_type = "rmsprop"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("mean_square", param)
+        self._add_accumulator("moment", param)
+        self._add_accumulator("mean_grad", param)
+
+    def _append_optimize_op(self, param, grad, lr):
+        ms = self._get_accumulator("mean_square", param)
+        mom = self._get_accumulator("moment", param)
+        mg = self._get_accumulator("mean_grad", param)
+        block = default_main_program().global_block()
+        return block.append_op(
+            "rmsprop",
+            inputs={"Param": [param], "Grad": [grad], "MeanSquare": [ms],
+                    "Moment": [mom], "MeanGrad": [mg],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param], "MeanSquareOut": [ms],
+                     "MomentOut": [mom], "MeanGradOut": [mg]},
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered})
+
+
+class FtrlOptimizer(Optimizer):
+    """reference fluid/optimizer.py:2803."""
+    op_type = "ftrl"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("squared", param)
+        self._add_accumulator("linear", param)
+
+    def _append_optimize_op(self, param, grad, lr):
+        sq = self._get_accumulator("squared", param)
+        lin = self._get_accumulator("linear", param)
+        block = default_main_program().global_block()
+        return block.append_op(
+            "ftrl",
+            inputs={"Param": [param], "Grad": [grad],
+                    "SquaredAccumulator": [sq], "LinearAccumulator": [lin],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param], "SquaredAccumOut": [sq],
+                     "LinearAccumOut": [lin]},
+            attrs={"l1": self._l1, "l2": self._l2,
+                   "lr_power": self._lr_power})
+
+
+class LambOptimizer(AdamOptimizer):
+    """reference fluid/optimizer.py:2962."""
+    op_type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 exclude_from_weight_decay_fn=None, **kwargs):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kwargs)
+        self._weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, param, grad, lr):
+        m1 = self._get_accumulator("moment1", param)
+        m2 = self._get_accumulator("moment2", param)
+        b1p = self._get_accumulator("beta1_pow", param)
+        b2p = self._get_accumulator("beta2_pow", param)
+        wd = self._weight_decay
+        if self._exclude_fn is not None and self._exclude_fn(param):
+            wd = 0.0
+        block = default_main_program().global_block()
+        return block.append_op(
+            "lamb",
+            inputs={"Param": [param], "Grad": [grad], "Moment1": [m1],
+                    "Moment2": [m2], "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param], "Moment1Out": [m1],
+                     "Moment2Out": [m2], "Beta1PowOut": [b1p],
+                     "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "weight_decay": wd})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    """reference fluid/optimizer.py:2386."""
+    op_type = "decayed_adagrad"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("moment", param)
+
+    def _append_optimize_op(self, param, grad, lr):
+        m = self._get_accumulator("moment", param)
+        block = default_main_program().global_block()
+        return block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": [param], "Grad": [grad], "Moment": [m],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param], "MomentOut": [m]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon})
+
+
+class DpsgdOptimizer(Optimizer):
+    """reference fluid/optimizer.py:2291."""
+    op_type = "dpsgd"
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
+
+    def _append_optimize_op(self, param, grad, lr):
+        block = default_main_program().global_block()
+        return block.append_op(
+            "dpsgd",
+            inputs={"Param": [param], "Grad": [grad],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param]},
+            attrs={"clip": self._clip, "batch_size": self._batch_size,
+                   "sigma": self._sigma})
+
+
+# 2.0-style short aliases
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+Adagrad = AdagradOptimizer
+Adamax = AdamaxOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Dpsgd = DpsgdOptimizer

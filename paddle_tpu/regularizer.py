@@ -1,0 +1,71 @@
+"""Weight-decay regularizers (reference python/paddle/fluid/regularizer.py).
+
+append_regularization_ops rewrites grads: g' = g + coeff * op(p), emitted
+as IR ops so AMP / distributed passes see them.
+"""
+from __future__ import annotations
+
+from .framework.core import OpRole, default_main_program, unique_name
+
+__all__ = ["L2Decay", "L1Decay", "L2DecayRegularizer",
+           "L1DecayRegularizer", "append_regularization_ops"]
+
+
+class Regularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(Regularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        decay = block.create_var(name=unique_name(f"{param.name}.l2decay"),
+                                 dtype=grad.dtype)
+        block.append_op("scale", inputs={"X": [param]},
+                        outputs={"Out": [decay]},
+                        attrs={"scale": self._coeff,
+                               "op_role": OpRole.Backward})
+        return decay
+
+
+class L1DecayRegularizer(Regularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        sign = block.create_var(name=unique_name(f"{param.name}.sign"),
+                                dtype=grad.dtype)
+        block.append_op("sign", inputs={"X": [param]},
+                        outputs={"Out": [sign]},
+                        attrs={"op_role": OpRole.Backward})
+        decay = block.create_var(name=unique_name(f"{param.name}.l1decay"),
+                                 dtype=grad.dtype)
+        block.append_op("scale", inputs={"X": [sign]},
+                        outputs={"Out": [decay]},
+                        attrs={"scale": self._coeff,
+                               "op_role": OpRole.Backward})
+        return decay
+
+
+def append_regularization_ops(params_grads, regularization=None):
+    out = []
+    block = default_main_program().global_block()
+    for p, g in params_grads:
+        reg = getattr(p, "regularizer", None) or regularization
+        if reg is None:
+            out.append((p, g))
+            continue
+        decay = reg(p, g, block)
+        new_g = block.create_var(name=unique_name(f"{g.name}.reg"),
+                                 dtype=g.dtype)
+        block.append_op("sum", inputs={"X": [g, decay]},
+                        outputs={"Out": [new_g]},
+                        attrs={"op_role": OpRole.Backward})
+        out.append((p, new_g))
+    return out
+
+
+L2Decay = L2DecayRegularizer
+L1Decay = L1DecayRegularizer

@@ -1,0 +1,45 @@
+"""Test config: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference test strategy (SURVEY.md §4): distributed
+correctness is tested without real hardware — here via
+xla_force_host_platform_device_count, replacing the reference's
+multi-process-localhost NCCL harness.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # overwrite: env presets e.g. 'axon'
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment may pre-import jax at interpreter startup (sitecustomize
+# registering an accelerator plugin), in which case the env var above is
+# read too late — force the platform through the live config instead.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Each test gets fresh default programs and a fresh scope."""
+    import paddle_tpu
+    from paddle_tpu.framework import core
+    from paddle_tpu.framework import executor as ex
+    main, startup = core.Program(), core.Program()
+    startup._is_startup = True
+    prev_m = core.switch_main_program(main)
+    prev_s = core.switch_startup_program(startup)
+    old_scope = ex._global_scope
+    ex._global_scope = ex.Scope()
+    ex._scope_stack[:] = [ex._global_scope]
+    np.random.seed(0)
+    yield
+    core.switch_main_program(prev_m)
+    core.switch_startup_program(prev_s)
+    ex._global_scope = old_scope
+    ex._scope_stack[:] = [old_scope]

@@ -1,0 +1,190 @@
+"""Static backward + optimizer training tests — the analog of the
+reference's book tests (tests/book/test_fit_a_line.py,
+test_recognize_digits.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.optimizer import (Adam, Momentum, SGDOptimizer)
+
+
+def test_linear_regression_converges():
+    np.random.seed(1)
+    true_w = np.array([[2.0], [-3.4]], dtype="float32")
+    true_b = 4.2
+
+    x = fluid.data(name="x", shape=[2], dtype="float32")
+    y = fluid.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    sgd = SGDOptimizer(learning_rate=0.1)
+    sgd.minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for _ in range(100):
+        xs = np.random.randn(64, 2).astype("float32")
+        ys = xs @ true_w + true_b
+        (lv,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < 1e-3, f"did not converge: {losses[-1]}"
+
+
+def test_gradient_values_match_numpy():
+    """Analytic grads from the IR backward == hand-derived numpy grads."""
+    x = fluid.data(name="x", shape=[4, 3], append_batch_size=False)
+    w = np.random.randn(3, 2).astype("float32")
+    main = fluid.default_main_program()
+    wp = main.global_block().create_parameter("w_test", [3, 2])
+    from paddle_tpu.framework.initializer import NumpyArrayInitializer
+    NumpyArrayInitializer(w)(wp)
+    out = layers.mul(x, wp)
+    loss = layers.reduce_sum(out)
+    from paddle_tpu.framework.backward import append_backward
+    pg = append_backward(loss)
+    assert len(pg) == 1
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.random.randn(4, 3).astype("float32")
+    (gw,) = exe.run(feed={"x": xv}, fetch_list=[pg[0][1]])
+    # d(sum(x@w))/dw = x^T @ ones
+    expected = xv.T @ np.ones((4, 2), "float32")
+    np.testing.assert_allclose(gw, expected, rtol=1e-5)
+
+
+def test_grad_accumulation_multi_consumer():
+    """A var consumed by two ops accumulates grads from both paths."""
+    x = fluid.data(name="x", shape=[3], append_batch_size=False,
+                   stop_gradient=False)
+    a = layers.scale(x, scale=2.0)
+    b = layers.scale(x, scale=5.0)
+    out = layers.reduce_sum(layers.elementwise_add(a, b))
+    from paddle_tpu.framework.backward import gradients
+    (gx,) = gradients(out, x)
+    exe = fluid.Executor()
+    (g,) = exe.run(feed={"x": np.ones(3, "float32")}, fetch_list=[gx])
+    np.testing.assert_allclose(g, np.full(3, 7.0), rtol=1e-6)
+
+
+def test_stop_gradient_blocks_flow():
+    x = fluid.data(name="x", shape=[3], append_batch_size=False,
+                   stop_gradient=False)
+    frozen = layers.scale(x, scale=2.0)
+    frozen.stop_gradient = True
+    out = layers.reduce_sum(frozen + layers.scale(x, 3.0))
+    from paddle_tpu.framework.backward import gradients
+    (gx,) = gradients(out, x)
+    exe = fluid.Executor()
+    (g,) = exe.run(feed={"x": np.ones(3, "float32")}, fetch_list=[gx])
+    np.testing.assert_allclose(g, np.full(3, 3.0), rtol=1e-6)
+
+
+def _lenet(img, label):
+    conv1 = layers.conv2d(img, num_filters=6, filter_size=5, padding=2,
+                          act="relu")
+    pool1 = layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = layers.conv2d(pool1, num_filters=16, filter_size=5, act="relu")
+    pool2 = layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    fc1 = layers.fc(pool2, size=120, act="relu")
+    fc2 = layers.fc(fc1, size=84, act="relu")
+    logits = layers.fc(fc2, size=10)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return loss, acc
+
+
+def test_mnist_lenet_learns_synthetic():
+    """MNIST LeNet milestone (BASELINE.json config 1) on synthetic digits:
+    loss must drop decisively within a few steps."""
+    np.random.seed(0)
+    img = fluid.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = fluid.data(name="label", shape=[1], dtype="int64")
+    loss, acc = _lenet(img, label)
+    opt = Momentum(learning_rate=0.05, momentum=0.9)
+    opt.minimize(loss)
+
+    exe = fluid.Executor(pt.TPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    # synthetic "digits": class k = distinct fixed random template + noise
+    templates = np.random.randn(10, 1, 28, 28).astype("float32")
+    def batch(bs=32):
+        ys = np.random.randint(0, 10, size=bs)
+        xs = templates[ys] + 0.1 * np.random.randn(bs, 1, 28, 28)
+        return xs.astype("float32"), ys.astype("int64").reshape(bs, 1)
+
+    first, last = None, None
+    for i in range(30):
+        xs, ys = batch()
+        lv, av = exe.run(feed={"img": xs, "label": ys},
+                         fetch_list=[loss, acc])
+        if first is None:
+            first = float(lv)
+        last, last_acc = float(lv), float(av)
+    assert first > 1.5  # ~log(10) at init
+    assert last < 0.2 * first, f"loss {first} -> {last}: not learning"
+    assert last_acc > 0.9
+
+
+def test_adam_optimizer_state_threading():
+    x = fluid.data(name="x", shape=[4], dtype="float32")
+    y = fluid.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    adam = Adam(learning_rate=0.01)
+    adam.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    w = np.random.randn(8, 4).astype("float32")
+    losses = []
+    for _ in range(50):
+        xs = np.random.randn(8, 4).astype("float32")
+        ys = (xs.sum(1, keepdims=True)).astype("float32")
+        (lv,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0]
+    # beta pow accumulators advanced
+    b1p = adam._get_accumulator("beta1_pow",
+                                fluid.default_main_program()
+                                .all_parameters()[0])
+    val = fluid.global_scope().find_var(b1p.name)
+    assert 0 < float(np.asarray(val)) < 0.9 ** 10
+
+
+def test_dropout_grad_replays_same_mask():
+    """auto-vjp grads of stochastic ops must replay identical randomness:
+    grad(x) of sum(dropout(x)) must be exactly mask/keep_prob pattern."""
+    x = fluid.data(name="x", shape=[1000], append_batch_size=False,
+                   stop_gradient=False)
+    out = layers.dropout(x, dropout_prob=0.5,
+                         dropout_implementation="upscale_in_train")
+    s = layers.reduce_sum(out)
+    from paddle_tpu.framework.backward import gradients
+    (gx,) = gradients(s, x)
+    exe = fluid.Executor()
+    xv = np.ones(1000, "float32")
+    ov, gv = exe.run(feed={"x": xv}, fetch_list=[out, gx])
+    # grad equals d out/d x elementwise = 2.0 where kept, 0 where dropped
+    np.testing.assert_allclose(gv, ov, rtol=1e-6)
+    assert set(np.unique(gv)).issubset({0.0, 2.0})
+
+
+def test_batch_norm_running_stats_update():
+    x = fluid.data(name="x", shape=[4, 8, 8], dtype="float32")
+    y = layers.batch_norm(x, momentum=0.5)
+    loss = layers.mean(y)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    main = fluid.default_main_program()
+    bn_op = [op for op in main.global_block().ops
+             if op.type == "batch_norm"][0]
+    mean_name = bn_op.single_input("Mean")
+    xs = (3.0 + np.random.randn(16, 4, 8, 8)).astype("float32")
+    exe.run(feed={"x": xs}, fetch_list=[loss])
+    m = np.asarray(fluid.global_scope().find_var(mean_name))
+    # after one step: 0.5*0 + 0.5*batch_mean ≈ 1.5
+    assert np.all(m > 1.0), m

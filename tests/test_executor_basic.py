@@ -1,0 +1,93 @@
+"""Basic Program/Executor smoke tests (the reference's
+tests/unittests/test_executor_and_mul.py analog)."""
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def test_feed_fetch_add():
+    x = fluid.data(name="x", shape=[3, 4], append_batch_size=False)
+    y = fluid.data(name="y", shape=[3, 4], append_batch_size=False)
+    out = layers.elementwise_add(x, y)
+    exe = fluid.Executor(pt.CPUPlace())
+    a = np.random.rand(3, 4).astype("float32")
+    b = np.random.rand(3, 4).astype("float32")
+    (res,) = exe.run(feed={"x": a, "y": b}, fetch_list=[out])
+    np.testing.assert_allclose(res, a + b, rtol=1e-6)
+
+
+def test_mul_and_activation():
+    x = fluid.data(name="x", shape=[2, 3], append_batch_size=False)
+    y = fluid.data(name="y", shape=[3, 5], append_batch_size=False)
+    out = layers.relu(layers.mul(x, y))
+    exe = fluid.Executor(pt.CPUPlace())
+    a = np.random.randn(2, 3).astype("float32")
+    b = np.random.randn(3, 5).astype("float32")
+    (res,) = exe.run(feed={"x": a, "y": b}, fetch_list=[out])
+    np.testing.assert_allclose(res, np.maximum(a @ b, 0), rtol=1e-5)
+
+
+def test_dynamic_batch_dim():
+    x = fluid.data(name="x", shape=[4], dtype="float32")  # (-1, 4)
+    out = layers.reduce_sum(x, dim=1)
+    exe = fluid.Executor()
+    for bs in (2, 5):
+        a = np.random.rand(bs, 4).astype("float32")
+        (res,) = exe.run(feed={"x": a}, fetch_list=[out])
+        np.testing.assert_allclose(res, a.sum(1), rtol=1e-6)
+
+
+def test_startup_program_initializes_params():
+    x = fluid.data(name="x", shape=[4, 8], append_batch_size=False)
+    y = layers.fc(x, size=3)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    params = fluid.default_main_program().all_parameters()
+    assert len(params) == 2  # weight + bias
+    for p in params:
+        val = scope.find_var(p.name)
+        assert val is not None
+        assert tuple(np.shape(val)) == tuple(p.shape)
+    (res,) = exe.run(feed={"x": np.ones((4, 8), "float32")},
+                     fetch_list=[y])
+    assert res.shape == (4, 3)
+
+
+def test_persistable_state_updates():
+    # counter += 1 per run, state carried in scope
+    c = layers.create_global_var([1], 0.0, "float32", persistable=True,
+                                 name="counter")
+    layers.increment(c, value=1.0)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for expected in (1.0, 2.0, 3.0):
+        (res,) = exe.run(fetch_list=[c])
+        assert float(res) == expected
+
+
+def test_program_guard_isolation():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[2, 2], append_batch_size=False)
+        out = layers.scale(x, scale=3.0)
+        assert x.block.program is main
+    exe = fluid.Executor()
+    a = np.ones((2, 2), "float32")
+    (res,) = exe.run(main, feed={"x": a}, fetch_list=[out])
+    np.testing.assert_allclose(res, 3 * a)
+
+
+def test_random_ops_deterministic_per_program_seed():
+    prog = fluid.Program()
+    prog.random_seed = 42
+    with fluid.program_guard(prog, fluid.Program()):
+        u = layers.uniform_random([16], min=0.0, max=1.0)
+    exe = fluid.Executor()
+    (r1,) = exe.run(prog, fetch_list=[u])
+    (r2,) = exe.run(prog, fetch_list=[u])
+    # different steps fold different counters -> different draws
+    assert not np.allclose(r1, r2)
+    assert r1.min() >= 0.0 and r1.max() <= 1.0
