@@ -20,6 +20,7 @@ __all__ = [
     "swish", "hard_sigmoid", "hard_swish", "prelu", "matmul", "bmm", "mul",
     "one_hot", "topk", "flatten", "l2_normalize", "label_smooth", "maxout",
     "soft_relu", "log_loss", "clip", "clip_by_norm", "mean", "pad",
+    "adaptive_pool2d",
 ]
 
 

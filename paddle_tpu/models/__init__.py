@@ -1,0 +1,15 @@
+"""Model zoo covering the tracked benchmark configs (BASELINE.json):
+
+* MNIST LeNet        — models.lenet          (static, single device)
+* ResNet-50 ImageNet — models.resnet         (data-parallel)
+* BERT/ERNIE-base    — models.bert           (Fleet collective)
+* Wide&Deep CTR      — planned (parameter-server sparse path)
+* Llama-style LLM    — planned (DP + recompute + tp/sp)
+
+All are built with the paddle_tpu static-graph layers API (the reference
+keeps its equivalents in separate repos — PaddleClas/PaddleNLP — plus the
+in-tree book tests python/paddle/fluid/tests/book/).
+"""
+from .lenet import lenet, build_mnist_train  # noqa
+from .resnet import resnet, build_resnet_train  # noqa
+from .bert import bert_encoder, build_bert_pretrain  # noqa

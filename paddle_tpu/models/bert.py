@@ -1,0 +1,136 @@
+"""BERT/ERNIE-base encoder + pretraining heads.
+
+Parity target: the reference's ERNIE/BERT configs (PaddleNLP; in-tree
+multihead precursor ops at paddle/fluid/operators/fused/multihead_matmul_op*
+and bert_encoder_functor.cu). Config 3 of BASELINE.json — the north-star
+throughput model.
+
+TPU-first design notes:
+  * one fused QKV projection per layer (one big MXU matmul instead of 3),
+  * attention kept as batched matmuls over [B, H, S, D] — XLA maps these to
+    the MXU directly; the pallas flash-attention kernel (ops/pallas) is the
+    drop-in for long sequences,
+  * bf16-friendly: all matmul weights created float32, AMP rewrites to bf16.
+"""
+from __future__ import annotations
+
+import math
+
+from .. import layers
+
+
+def _attention(x, hidden, num_heads, seq_len, attn_bias=None, dropout=0.0,
+               is_test=False):
+    """Multi-head self-attention. x: [-1, S, H]."""
+    head_dim = hidden // num_heads
+    qkv = layers.fc(x, size=3 * hidden, num_flatten_dims=2)  # [B,S,3H]
+    qkv = layers.reshape(qkv, [0, seq_len, 3, num_heads, head_dim])
+    qkv = layers.transpose(qkv, [2, 0, 3, 1, 4])  # [3,B,Hd,S,D]
+    q = layers.squeeze(layers.slice(qkv, axes=[0], starts=[0], ends=[1]), [0])
+    k = layers.squeeze(layers.slice(qkv, axes=[0], starts=[1], ends=[2]), [0])
+    v = layers.squeeze(layers.slice(qkv, axes=[0], starts=[2], ends=[3]), [0])
+    scores = layers.matmul(q, k, transpose_y=True,
+                           alpha=1.0 / math.sqrt(head_dim))  # [B,Hd,S,S]
+    if attn_bias is not None:
+        scores = layers.elementwise_add(scores, attn_bias)
+    probs = layers.softmax(scores)
+    if dropout and not is_test:
+        probs = layers.dropout(probs, dropout, is_test=is_test,
+                               dropout_implementation="upscale_in_train")
+    ctx = layers.matmul(probs, v)  # [B,Hd,S,D]
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [0, seq_len, hidden])
+    return layers.fc(ctx, size=hidden, num_flatten_dims=2)
+
+
+def _ffn(x, hidden, intermediate):
+    h = layers.fc(x, size=intermediate, num_flatten_dims=2, act="gelu")
+    return layers.fc(h, size=hidden, num_flatten_dims=2)
+
+
+def bert_encoder(input_ids, token_type_ids=None, attn_mask=None,
+                 vocab_size=30522, hidden=768, num_layers=12, num_heads=12,
+                 seq_len=128, intermediate=3072, max_position=512,
+                 type_vocab=2, dropout=0.1, is_test=False):
+    """Returns final hidden states [-1, S, H].
+
+    input_ids/token_type_ids: [-1, S] int64; attn_mask: [-1, S] float32
+    (1 = attend, 0 = pad) or None.
+    """
+    word_emb = layers.embedding(input_ids, size=[vocab_size, hidden])
+    pos_ids = layers.range(0, seq_len, 1, dtype="int64")
+    pos_emb = layers.embedding(pos_ids, size=[max_position, hidden])
+    emb = layers.elementwise_add(word_emb, pos_emb, axis=-1)
+    if token_type_ids is not None:
+        type_emb = layers.embedding(token_type_ids, size=[type_vocab, hidden])
+        emb = layers.elementwise_add(emb, type_emb)
+    x = layers.layer_norm(emb, begin_norm_axis=2)
+    if dropout and not is_test:
+        x = layers.dropout(x, dropout, is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+
+    attn_bias = None
+    if attn_mask is not None:
+        # [B,S] -> additive bias [B,1,1,S]
+        neg = layers.scale(attn_mask, scale=10000.0, bias=-10000.0)
+        attn_bias = layers.unsqueeze(layers.unsqueeze(neg, [1]), [1])
+
+    for _ in range(num_layers):
+        attn = _attention(x, hidden, num_heads, seq_len, attn_bias,
+                          dropout, is_test)
+        if dropout and not is_test:
+            attn = layers.dropout(attn, dropout, is_test=is_test,
+                                  dropout_implementation="upscale_in_train")
+        x = layers.layer_norm(layers.elementwise_add(x, attn),
+                              begin_norm_axis=2)
+        ffn = _ffn(x, hidden, intermediate)
+        if dropout and not is_test:
+            ffn = layers.dropout(ffn, dropout, is_test=is_test,
+                                 dropout_implementation="upscale_in_train")
+        x = layers.layer_norm(layers.elementwise_add(x, ffn),
+                              begin_norm_axis=2)
+    return x
+
+
+def build_bert_pretrain(batch_size=None, seq_len=128, vocab_size=30522,
+                        hidden=768, num_layers=12, num_heads=12,
+                        intermediate=3072, dropout=0.1, is_test=False):
+    """MLM pretraining graph (masked positions scored over full vocab).
+
+    Feeds: input_ids, token_type_ids, attn_mask [B,S]; mlm_labels [B,S]
+    int64 with -100 on unmasked positions (ignore_index semantics via
+    label weights).
+    Returns (feed_names, {'loss': ...}).
+    """
+    b = -1 if batch_size is None else batch_size
+    input_ids = layers.data("input_ids", [b, seq_len], dtype="int64",
+                            append_batch_size=False)
+    token_type_ids = layers.data("token_type_ids", [b, seq_len],
+                                 dtype="int64", append_batch_size=False)
+    attn_mask = layers.data("attn_mask", [b, seq_len], dtype="float32",
+                            append_batch_size=False)
+    mlm_mask = layers.data("mlm_mask", [b, seq_len], dtype="float32",
+                           append_batch_size=False)
+    mlm_labels = layers.data("mlm_labels", [b, seq_len], dtype="int64",
+                             append_batch_size=False)
+
+    enc = bert_encoder(input_ids, token_type_ids, attn_mask,
+                       vocab_size=vocab_size, hidden=hidden,
+                       num_layers=num_layers, num_heads=num_heads,
+                       seq_len=seq_len, intermediate=intermediate,
+                       dropout=dropout, is_test=is_test)
+    # MLM head: transform + layernorm + vocab projection
+    h = layers.fc(enc, size=hidden, num_flatten_dims=2, act="gelu")
+    h = layers.layer_norm(h, begin_norm_axis=2)
+    logits = layers.fc(h, size=vocab_size, num_flatten_dims=2)  # [B,S,V]
+    labels = layers.unsqueeze(mlm_labels, [2])
+    loss = layers.softmax_with_cross_entropy(logits, labels)  # [B,S,1]
+    loss = layers.squeeze(loss, [2])
+    masked = layers.elementwise_mul(loss, mlm_mask)
+    denom = layers.elementwise_add(
+        layers.reduce_sum(mlm_mask),
+        layers.fill_constant([1], "float32", 1e-5))
+    mean_loss = layers.elementwise_div(layers.reduce_sum(masked), denom)
+    feeds = ["input_ids", "token_type_ids", "attn_mask", "mlm_mask",
+             "mlm_labels"]
+    return feeds, {"loss": mean_loss}

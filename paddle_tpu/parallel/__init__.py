@@ -1,0 +1,14 @@
+"""Distributed / parallel execution (TPU-native).
+
+The reference implements every parallelism strategy as a source-to-source
+rewrite of the ProgramDesc that inserts NCCL communication ops, executed by
+hand-built engines (ParallelExecutor SSA graph, Fleet transpilers — see
+SURVEY.md §2.6). On TPU the idiomatic equivalent is GSPMD: one program, a
+`jax.sharding.Mesh` with named axes, sharding annotations on inputs and
+parameters, and XLA inserting the collectives over ICI. This package keeps
+the reference's *API surface* (CompiledProgram, fleet.init,
+DistributedStrategy…) on top of that compilation model.
+"""
+from .mesh import make_mesh, dp_mesh, MeshConfig  # noqa
+from .sharded import (ShardingRules, data_parallel_rules,  # noqa
+                      megatron_rules, build_sharded_step)

@@ -1,0 +1,72 @@
+"""Device mesh construction.
+
+Replaces the reference's NCCL ring/communicator bootstrap
+(platform/collective_helper.h:62 NCCLCommContext keyed by ring_id;
+c_gen_nccl_id/c_comm_init ops): a ring_id becomes a *named mesh axis*, and
+"communicator init" becomes constructing a `jax.sharding.Mesh` once.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+# Canonical axis names used across the framework.
+DP_AXIS = "dp"      # data parallel (batch)
+MP_AXIS = "mp"      # tensor/model parallel
+PP_AXIS = "pp"      # pipeline stages
+SP_AXIS = "sp"      # sequence/context parallel
+EP_AXIS = "ep"      # expert parallel
+
+
+@dataclass
+class MeshConfig:
+    """Topology spec: axis name -> size. Unspecified capacity goes to dp."""
+    mp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+    dp: Optional[int] = None  # None: fill with remaining devices
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        denom = self.mp * self.pp * self.sp * self.ep
+        if n_devices % denom:
+            raise ValueError(
+                f"{n_devices} devices not divisible by mp*pp*sp*ep={denom}")
+        dp = self.dp if self.dp is not None else n_devices // denom
+        if dp * denom != n_devices:
+            raise ValueError(
+                f"dp({dp})*mp({self.mp})*pp({self.pp})*sp({self.sp})"
+                f"*ep({self.ep}) != {n_devices}")
+        axes = {DP_AXIS: dp, MP_AXIS: self.mp, PP_AXIS: self.pp,
+                SP_AXIS: self.sp, EP_AXIS: self.ep}
+        return {k: v for k, v in axes.items() if v > 1} or {DP_AXIS: dp}
+
+
+def make_mesh(axis_sizes: Dict[str, int] = None, devices=None, **kw):
+    """Build a Mesh. ``make_mesh({'dp': 4, 'mp': 2})``.
+
+    Axis order follows the dict order; put the most bandwidth-hungry axis
+    (mp) innermost so its collectives ride the fastest ICI links.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    axis_sizes = dict(axis_sizes or {}, **kw)
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = tuple(axis_sizes.values())
+    n = int(np.prod(sizes)) if sizes else 1
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    dev_array = np.array(devices[:n]).reshape(sizes)
+    return Mesh(dev_array, tuple(axis_sizes))
+
+
+def dp_mesh(n: Optional[int] = None, devices=None):
+    """Pure data-parallel mesh over all (or n) devices."""
+    import jax
+    devices = list(devices if devices is not None else jax.devices())
+    n = n or len(devices)
+    return make_mesh({DP_AXIS: n}, devices=devices)

@@ -1,0 +1,63 @@
+"""Model zoo smoke tests: each tracked config builds, trains, and the loss
+decreases (reference analog: the book tests,
+python/paddle/fluid/tests/book/test_recognize_digits.py etc.)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import models, optimizer
+
+
+def _train(build, feed_fn, opt, steps=5):
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        feeds, outs = build()
+        opt.minimize(outs["loss"])
+    exe = pt.Executor()
+    exe.run(startup)
+    feed = feed_fn()
+    first = exe.run(main, feed=feed, fetch_list=[outs["loss"]])[0]
+    for _ in range(steps):
+        last = exe.run(main, feed=feed, fetch_list=[outs["loss"]])[0]
+    return float(first), float(last)
+
+
+def test_lenet_trains():
+    def feed():
+        return {"images": np.random.rand(8, 1, 28, 28).astype("float32"),
+                "label": np.random.randint(0, 10, (8, 1)).astype("int64")}
+    first, last = _train(
+        lambda: models.build_mnist_train(batch_size=8), feed,
+        optimizer.SGDOptimizer(learning_rate=0.05), steps=8)
+    assert last < first
+
+
+def test_resnet18_trains():
+    def feed():
+        return {"images": np.random.rand(2, 3, 32, 32).astype("float32"),
+                "label": np.random.randint(0, 10, (2, 1)).astype("int64")}
+    first, last = _train(
+        lambda: models.build_resnet_train(batch_size=2, depth=18,
+                                          image_size=32, class_num=10),
+        feed, optimizer.MomentumOptimizer(0.01, 0.9), steps=5)
+    assert last < first
+
+
+def test_bert_tiny_trains():
+    B, S, V = 2, 16, 64
+
+    def feed():
+        rng = np.random.RandomState(1)
+        return {
+            "input_ids": rng.randint(0, V, (B, S)).astype("int64"),
+            "token_type_ids": np.zeros((B, S), "int64"),
+            "attn_mask": np.ones((B, S), "float32"),
+            "mlm_mask": (rng.rand(B, S) < 0.3).astype("float32"),
+            "mlm_labels": rng.randint(0, V, (B, S)).astype("int64"),
+        }
+    first, last = _train(
+        lambda: models.build_bert_pretrain(batch_size=B, seq_len=S,
+                                           vocab_size=V, hidden=32,
+                                           num_layers=2, num_heads=4,
+                                           intermediate=64, dropout=0.0),
+        feed, optimizer.AdamOptimizer(1e-3), steps=10)
+    assert last < first
