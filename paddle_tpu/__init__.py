@@ -22,6 +22,7 @@ from . import layers  # noqa
 from . import optimizer  # noqa
 from . import regularizer  # noqa
 from .layers.tensor import data  # noqa
+from . import dygraph  # noqa
 
 __version__ = "0.1.0"
 

@@ -1,1 +1,15 @@
-"""Imperative (dygraph) mode — placeholder, populated in later milestones."""
+"""Imperative (dygraph) mode — eager execution with tape autograd.
+
+Reference: paddle/fluid/imperative/ (C++ Tracer/BasicEngine) +
+python/paddle/fluid/dygraph/. See tracer.py for the TPU-native design.
+"""
+from .base import (guard, enabled, enable_dygraph, disable_dygraph,  # noqa
+                   no_grad, to_variable)
+from .layers import Layer, Sequential, LayerList, ParameterList  # noqa
+from .varbase import VarBase, ParamBase  # noqa
+from .nn import (Linear, Conv2D, Pool2D, BatchNorm, LayerNorm,  # noqa
+                 Embedding, Dropout, GroupNorm, Flatten)
+from .parallel import (DataParallel, ParallelEnv, prepare_context,  # noqa
+                       ParallelStrategy)
+from .jit import declarative, dygraph_to_static_func, TracedLayer  # noqa
+from .checkpoint import save_dygraph, load_dygraph  # noqa

@@ -1,0 +1,130 @@
+"""Dygraph mode entry points: guard / to_variable / no_grad.
+
+Reference: python/paddle/fluid/dygraph/base.py (guard:162, to_variable).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import numpy as np
+
+from ..framework.core import (_dygraph_tracer, _set_dygraph_tracer,
+                              in_dygraph_mode)
+from .tracer import Tracer
+from .varbase import ParamBase, VarBase
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Enable eager mode (reference fluid.dygraph.guard)."""
+    prev = _dygraph_tracer()
+    _set_dygraph_tracer(Tracer())
+    try:
+        yield
+    finally:
+        _set_dygraph_tracer(prev)
+
+
+def enabled() -> bool:
+    return in_dygraph_mode()
+
+
+def enable_dygraph(place=None):
+    _set_dygraph_tracer(Tracer())
+
+
+def disable_dygraph():
+    _set_dygraph_tracer(None)
+
+
+enable_imperative = enable_dygraph
+disable_imperative = disable_dygraph
+
+
+class no_grad:
+    """Context manager AND decorator disabling tape recording
+    (reference dygraph.no_grad)."""
+
+    def __enter__(self):
+        tr = _dygraph_tracer()
+        self._tr, self._prev = tr, tr._no_grad if tr else None
+        if tr:
+            tr._no_grad = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._tr:
+            self._tr._no_grad = self._prev
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with no_grad():
+                return fn(*a, **kw)
+        return wrapper
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None) -> VarBase:
+    """numpy / list / scalar -> VarBase (reference dygraph.to_variable)."""
+    import jax.numpy as jnp
+    if isinstance(value, VarBase):
+        return value
+    arr = np.asarray(value)
+    if dtype is not None:
+        from ..framework.core import dtype_to_np
+        arr = arr.astype(dtype_to_np(dtype))
+    elif arr.dtype == np.float64:
+        arr = arr.astype(np.float32)  # framework default precision
+    return VarBase(jnp.asarray(arr), name=name, stop_gradient=True)
+
+
+# ---------------------------------------------------------------------------
+# hooks used by LayerHelper when in dygraph mode
+# ---------------------------------------------------------------------------
+
+class _EagerInitBlock:
+    """Block facade routing initializer ops through the tracer so every
+    static Initializer works eagerly unmodified."""
+
+    def __init__(self, target: VarBase):
+        self._target = target
+
+    def create_var(self, **kw):
+        return None
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        tr = _dygraph_tracer()
+        prev = tr._no_grad
+        tr._no_grad = True
+        try:
+            tr.trace_op(type, inputs or {}, {"Out": [self._target]},
+                        attrs or {})
+        finally:
+            tr._no_grad = prev
+
+
+class _VarMeta:
+    """Name/shape/dtype triple quacking like a static Variable for
+    Initializer.__call__."""
+
+    def __init__(self, name, shape, dtype):
+        self.name, self.shape, self.dtype = name, tuple(
+            int(s) for s in shape), dtype
+
+
+def create_dygraph_parameter(name, shape, dtype, initializer, attr):
+    p = ParamBase(None, name=name, trainable=attr.trainable)
+    initializer(_VarMeta(name, shape, dtype), _EagerInitBlock(p))
+    p.optimize_attr = {"learning_rate": attr.learning_rate}
+    p.regularizer = attr.regularizer
+    _parameter_registry[name] = p
+    return p
+
+
+def create_dygraph_tmp(dtype) -> VarBase:
+    return VarBase(None)
+
+
+# name -> ParamBase; used by dygraph-to-static to materialize static vars
+_parameter_registry = {}

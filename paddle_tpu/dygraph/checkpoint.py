@@ -1,0 +1,45 @@
+"""Dygraph save/load (reference fluid/dygraph/checkpoint.py:
+save_dygraph -> .pdparams / .pdopt, load_dygraph)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .varbase import VarBase
+
+
+def _to_plain(state_dict):
+    out = {}
+    for k, v in state_dict.items():
+        out[k] = v.numpy() if isinstance(v, VarBase) else np.asarray(v)
+    return out
+
+
+def save_dygraph(state_dict, model_path: str):
+    """state_dict from Layer.state_dict() (-> .pdparams) or
+    Optimizer.state_dict() (-> .pdopt)."""
+    is_opt = not any(isinstance(v, VarBase) for v in state_dict.values()) \
+        and state_dict  # optimizer dicts hold raw arrays
+    suffix = ".pdopt" if is_opt else ".pdparams"
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(model_path + suffix, "wb") as f:
+        pickle.dump(_to_plain(state_dict), f, protocol=2)
+
+
+def load_dygraph(model_path: str):
+    """Returns (param_dict or None, opt_dict or None)."""
+    params, opt = None, None
+    if os.path.exists(model_path + ".pdparams"):
+        with open(model_path + ".pdparams", "rb") as f:
+            params = pickle.load(f)
+    if os.path.exists(model_path + ".pdopt"):
+        with open(model_path + ".pdopt", "rb") as f:
+            opt = pickle.load(f)
+    if params is None and opt is None:
+        raise FileNotFoundError(
+            f"no checkpoint at {model_path}(.pdparams/.pdopt)")
+    return params, opt

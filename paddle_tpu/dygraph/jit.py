@@ -1,0 +1,131 @@
+"""Dygraph-to-static: @declarative / TracedLayer.
+
+Reference: ProgramTranslator + @declarative (fluid/dygraph/jit.py:159,
+dygraph_to_static/program_translator.py:711). The reference rewrites Python
+ASTs to turn imperative code into Program-building code; here the layer
+functions themselves are dual-mode (they append ops when no tracer is
+active), so "translation" is simply: run the function in static mode,
+capture the eager ParamBases it references as program parameters, compile
+via the Executor (one XLA computation), and sync state back.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..framework.core import (Program, Variable, _dygraph_tracer,
+                              _set_dygraph_tracer, program_guard)
+from ..framework.executor import Executor, Scope
+from .varbase import VarBase
+
+
+def _to_numpy(v):
+    if isinstance(v, VarBase):
+        return np.asarray(v._value)
+    return np.asarray(v)
+
+
+class _StaticFunction:
+    def __init__(self, fn):
+        self._fn = fn
+        self._cache: Dict[tuple, tuple] = {}
+        self._exe = Executor()
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args):
+        arrs = [_to_numpy(a) for a in args]
+        sig = tuple((a.shape, str(a.dtype)) for a in arrs)
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._trace(arrs)
+            self._cache[sig] = entry
+        main, feed_names, out_vars, structure, scope, captures = entry
+
+        feed = dict(zip(feed_names, arrs))
+        results = self._exe.run(main, feed=feed, fetch_list=out_vars,
+                                scope=scope, return_numpy=False)
+        # sync mutated persistable state (params, BN stats) back to eager
+        for name, vb in captures.items():
+            val = scope.find_var(name)
+            if val is not None:
+                vb._value = val
+        out_vbs = [VarBase(r, stop_gradient=True) for r in results]
+        return _unflatten(structure, out_vbs)
+
+    def _trace(self, arrs):
+        from ..layers import tensor as T
+
+        main, startup = Program(), Program()
+        startup._is_startup = True
+        tracer = _dygraph_tracer()
+        _set_dygraph_tracer(None)
+        try:
+            with program_guard(main, startup):
+                static_args = []
+                feed_names = []
+                for i, a in enumerate(arrs):
+                    name = f"__ts_arg_{i}"
+                    v = T.data(name, list(a.shape), dtype=str(a.dtype),
+                               append_batch_size=False)
+                    static_args.append(v)
+                    feed_names.append(name)
+                outs = self._fn(*static_args)
+        finally:
+            _set_dygraph_tracer(tracer)
+
+        structure, out_vars = _flatten(outs)
+        scope = Scope()
+        # initialize any params created during the trace itself
+        self._exe.run(startup, scope=scope)
+        # inject captured eager parameters/buffers
+        captures = dict(getattr(main, "_captures", {}))
+        for name, vb in captures.items():
+            scope.set_var(name, vb._value)
+        return main, feed_names, out_vars, structure, scope, captures
+
+
+def _flatten(outs):
+    if isinstance(outs, (list, tuple)):
+        return ("seq", type(outs), len(outs)), list(outs)
+    return ("one", None, 1), [outs]
+
+
+def _unflatten(structure, vals):
+    kind, typ, n = structure
+    if kind == "one":
+        return vals[0]
+    return typ(vals)
+
+
+def declarative(fn=None, input_spec=None):
+    """@declarative / @paddle.jit.to_static."""
+    if fn is None:
+        return lambda f: _StaticFunction(f)
+    return _StaticFunction(fn)
+
+
+to_static = declarative
+dygraph_to_static_func = declarative
+
+
+class TracedLayer:
+    """reference fluid.dygraph.TracedLayer (jit.py TracedLayer.trace)."""
+
+    def __init__(self, layer, static_fn):
+        self._layer = layer
+        self._static_fn = static_fn
+
+    @staticmethod
+    def trace(layer, inputs):
+        out = layer(*inputs)
+        static_fn = _StaticFunction(lambda *a: layer(*a))
+        traced = TracedLayer(layer, static_fn)
+        return out, traced
+
+    def __call__(self, *inputs):
+        return self._static_fn(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        raise NotImplementedError("wired up with io.save_inference_model")

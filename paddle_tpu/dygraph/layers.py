@@ -1,0 +1,270 @@
+"""Layer: the dygraph module system.
+
+Reference: python/paddle/fluid/dygraph/layers.py (Layer:867 __call__,
+sublayers/parameters/state_dict machinery).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..framework.core import unique_name
+from ..framework.layer_helper import LayerHelper, ParamAttr
+from .varbase import ParamBase, VarBase
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None,
+                 dtype: str = "float32"):
+        self._full_name = unique_name(
+            name_scope or self.__class__.__name__.lower())
+        self._dtype = dtype
+        self.training = True
+        self._parameters: "OrderedDict[str, ParamBase]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, VarBase]" = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+
+    # -- naming -------------------------------------------------------------
+    def full_name(self) -> str:
+        return self._full_name
+
+    # -- attribute capture --------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        if isinstance(value, ParamBase) and params is not None:
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer) and subs is not None:
+            subs[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                del params[name]
+            if subs is not None and name in subs:
+                del subs[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        params = self.__dict__.get("_parameters")
+        if params is not None and name in params:
+            return params[name]
+        subs = self.__dict__.get("_sub_layers")
+        if subs is not None and name in subs:
+            return subs[name]
+        bufs = self.__dict__.get("_buffers")
+        if bufs is not None and name in bufs:
+            return bufs[name]
+        raise AttributeError(
+            f"{self.__class__.__name__} has no attribute {name!r}")
+
+    # -- registration -------------------------------------------------------
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def add_parameter(self, name: str, parameter: ParamBase) -> ParamBase:
+        self._parameters[name] = parameter
+        return parameter
+
+    def register_buffer(self, name: str, tensor: VarBase,
+                        persistable: bool = True):
+        tensor.persistable = persistable
+        self._buffers[name] = tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None) -> ParamBase:
+        helper = LayerHelper(self.full_name())
+        return helper.create_parameter(attr, shape, dtype or self._dtype,
+                                       is_bias, default_initializer)
+
+    # -- traversal ----------------------------------------------------------
+    def children(self) -> Iterator["Layer"]:
+        return iter(self._sub_layers.values())
+
+    def named_children(self):
+        return iter(self._sub_layers.items())
+
+    def sublayers(self, include_self: bool = False):
+        out = [self] if include_self else []
+        for s in self._sub_layers.values():
+            out.extend(s.sublayers(include_self=True))
+        return out
+
+    def parameters(self, include_sublayers: bool = True):
+        return [p for _, p in self.named_parameters()]
+
+    def named_parameters(self, prefix: str = ""):
+        seen = set()
+        for name, p in self._parameters.items():
+            if id(p) not in seen:
+                seen.add(id(p))
+                yield (f"{prefix}.{name}" if prefix else name), p
+        for sname, sub in self._sub_layers.items():
+            sub_prefix = f"{prefix}.{sname}" if prefix else sname
+            for n, p in sub.named_parameters(sub_prefix):
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    yield n, p
+
+    def named_buffers(self, prefix: str = ""):
+        for name, b in self._buffers.items():
+            yield (f"{prefix}.{name}" if prefix else name), b
+        for sname, sub in self._sub_layers.items():
+            sub_prefix = f"{prefix}.{sname}" if prefix else sname
+            yield from sub.named_buffers(sub_prefix)
+
+    # -- modes --------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for s in self._sub_layers.values():
+            s.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for s in self._sub_layers.values():
+            s.eval()
+        return self
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, include_sublayers: bool = True,
+                   structured_name_prefix: str = ""):
+        out = OrderedDict()
+        for name, p in self.named_parameters(structured_name_prefix):
+            out[name] = p
+        for name, b in self.named_buffers(structured_name_prefix):
+            out[name] = b
+        return out
+
+    def set_state_dict(self, state_dict, include_sublayers=True,
+                       use_structured_name=True):
+        own = self.state_dict()
+        missing = []
+        for name, target in own.items():
+            if name in state_dict:
+                v = state_dict[name]
+                if isinstance(v, VarBase):
+                    v = v.numpy()
+                target.set_value(np.asarray(v))
+            else:
+                missing.append(name)
+        return missing
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # -- hooks --------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        key = len(self._forward_pre_hooks)
+        self._forward_pre_hooks[key] = hook
+        return _HookRemover(self._forward_pre_hooks, key)
+
+    def register_forward_post_hook(self, hook):
+        key = len(self._forward_post_hooks)
+        self._forward_post_hooks[key] = hook
+        return _HookRemover(self._forward_post_hooks, key)
+
+    # -- call ---------------------------------------------------------------
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def __repr__(self):
+        extra = ", ".join(self._sub_layers)
+        return f"{self.__class__.__name__}({extra})"
+
+
+class _HookRemover:
+    def __init__(self, store, key):
+        self._store, self._key = store, key
+
+    def remove(self):
+        self._store.pop(self._key, None)
+
+
+class Sequential(Layer):
+    """reference fluid.dygraph.Sequential."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], (list, tuple)):
+            for name, layer in layers[0]:
+                self.add_sublayer(str(name), layer)
+        else:
+            for i, layer in enumerate(layers):
+                self.add_sublayer(str(i), layer)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        for i, l in enumerate(sublayers or []):
+            self.add_sublayer(str(i), l)
+
+    def append(self, sublayer):
+        self.add_sublayer(str(len(self._sub_layers)), sublayer)
+        return self
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def forward(self, *a, **kw):
+        raise NotImplementedError("LayerList is a container")
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        for i, p in enumerate(parameters or []):
+            self.add_parameter(str(i), p)
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self
+
+    def __getitem__(self, idx):
+        return list(self._parameters.values())[idx]
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def __len__(self):
+        return len(self._parameters)

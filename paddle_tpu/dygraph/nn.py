@@ -1,0 +1,273 @@
+"""Dygraph module library (reference python/paddle/fluid/dygraph/nn.py).
+
+Each module owns its ParamBase weights and traces the same ops the static
+layer functions append — one op library, two modes (the reference shares
+kernels identically: dygraph PreparedOp reuses the static registry,
+imperative/prepared_operator.cc:129).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import unique_name
+from ..framework.initializer import (ConstantInitializer,
+                                     NormalInitializer)
+from ..framework.layer_helper import LayerHelper, ParamAttr
+from .base import to_variable
+from .layers import Layer
+
+__all__ = ["Linear", "Conv2D", "Pool2D", "BatchNorm", "LayerNorm",
+           "Embedding", "Dropout", "GroupNorm", "SpectralNorm", "Flatten"]
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        helper = LayerHelper(self.full_name())
+        self.weight = helper.create_parameter(param_attr,
+                                              [input_dim, output_dim], dtype)
+        self.bias = None if bias_attr is False else helper.create_parameter(
+            bias_attr, [output_dim], dtype, is_bias=True)
+
+    def forward(self, input):
+        helper = LayerHelper(self.full_name(), name=None)
+        out = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("matmul_v2",
+                         inputs={"X": [input], "Y": [self.weight]},
+                         outputs={"Out": [out]}, attrs={})
+        if self.bias is not None:
+            pre = out
+            out = helper.create_variable_for_type_inference(input.dtype)
+            helper.append_op("elementwise_add",
+                             inputs={"X": [pre], "Y": [self.bias]},
+                             outputs={"Out": [out]}, attrs={"axis": -1})
+        return helper.append_activation(out, self._act)
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+
+        def _pair(v):
+            return [v, v] if isinstance(v, int) else list(v)
+        self._stride, self._padding = _pair(stride), _pair(padding)
+        self._dilation, self._groups = _pair(dilation), groups
+        fs = _pair(filter_size)
+        w_shape = [num_filters, num_channels // groups] + fs
+        fan_in = (num_channels // groups) * fs[0] * fs[1]
+        helper = LayerHelper(self.full_name())
+        self.weight = helper.create_parameter(
+            param_attr, w_shape, dtype,
+            default_initializer=NormalInitializer(0.0,
+                                                  (2.0 / fan_in) ** 0.5))
+        self.bias = None if bias_attr is False else helper.create_parameter(
+            bias_attr, [num_filters], dtype, is_bias=True)
+
+    def forward(self, input):
+        helper = LayerHelper(self.full_name())
+        out = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("conv2d",
+                         inputs={"Input": [input], "Filter": [self.weight]},
+                         outputs={"Output": [out]},
+                         attrs={"strides": self._stride,
+                                "paddings": self._padding,
+                                "dilations": self._dilation,
+                                "groups": self._groups,
+                                "data_format": "NCHW"})
+        if self.bias is not None:
+            pre = out
+            out = helper.create_variable_for_type_inference(input.dtype)
+            helper.append_op("elementwise_add",
+                             inputs={"X": [pre], "Y": [self.bias]},
+                             outputs={"Out": [out]}, attrs={"axis": 1})
+        return helper.append_activation(out, self._act)
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True):
+        super().__init__()
+
+        def _pair(v):
+            return [v, v] if isinstance(v, int) else list(v)
+        self._attrs = {
+            "pooling_type": pool_type, "ksize": _pair(pool_size),
+            "strides": _pair(pool_stride), "paddings": _pair(pool_padding),
+            "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+            "exclusive": exclusive, "adaptive": False}
+
+    def forward(self, input):
+        helper = LayerHelper(self.full_name())
+        out = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("pool2d", inputs={"X": [input]},
+                         outputs={"Out": [out]}, attrs=dict(self._attrs))
+        return out
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", in_place=False,
+                 moving_mean_name=None, moving_variance_name=None,
+                 do_model_average_for_mean_and_var=True,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__()
+        self._momentum, self._epsilon = momentum, epsilon
+        self._data_layout = data_layout
+        self._use_global_stats = use_global_stats
+        self._act = act
+        c = num_channels
+        helper = LayerHelper(self.full_name())
+        self.weight = helper.create_parameter(
+            param_attr, [c], "float32",
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = helper.create_parameter(bias_attr, [c], "float32",
+                                            is_bias=True)
+        mean = to_variable(np.zeros([c], "float32"),
+                           name=moving_mean_name or
+                           unique_name(f"{self.full_name()}.mean"))
+        var = to_variable(np.ones([c], "float32"),
+                          name=moving_variance_name or
+                          unique_name(f"{self.full_name()}.var"))
+        self.register_buffer("_mean", mean)
+        self.register_buffer("_variance", var)
+
+    def forward(self, input):
+        helper = LayerHelper(self.full_name())
+        out = helper.create_variable_for_type_inference(input.dtype)
+        saved_m = helper.create_variable_for_type_inference("float32")
+        saved_v = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            "batch_norm",
+            inputs={"X": [input], "Scale": [self.weight],
+                    "Bias": [self.bias], "Mean": [self._mean],
+                    "Variance": [self._variance]},
+            outputs={"Y": [out], "MeanOut": [self._mean],
+                     "VarianceOut": [self._variance],
+                     "SavedMean": [saved_m], "SavedVariance": [saved_v]},
+            attrs={"momentum": self._momentum, "epsilon": self._epsilon,
+                   "is_test": not self.training,
+                   "data_layout": self._data_layout,
+                   "use_global_stats": self._use_global_stats})
+        return helper.append_activation(out, self._act)
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True,
+                 epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._epsilon, self._act = epsilon, act
+        n = int(np.prod(normalized_shape))
+        self._begin_from_size = len(normalized_shape)
+        helper = LayerHelper(self.full_name())
+        self.weight = None if not scale else helper.create_parameter(
+            param_attr, [n], "float32",
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = None if not shift else helper.create_parameter(
+            bias_attr, [n], "float32", is_bias=True)
+
+    def forward(self, input):
+        helper = LayerHelper(self.full_name())
+        inputs = {"X": [input]}
+        if self.weight is not None:
+            inputs["Scale"] = [self.weight]
+        if self.bias is not None:
+            inputs["Bias"] = [self.bias]
+        out = helper.create_variable_for_type_inference(input.dtype)
+        mean = helper.create_variable_for_type_inference("float32")
+        var = helper.create_variable_for_type_inference("float32")
+        axis = len(input.shape) - self._begin_from_size
+        helper.append_op("layer_norm", inputs=inputs,
+                         outputs={"Y": [out], "Mean": [mean],
+                                  "Variance": [var]},
+                         attrs={"epsilon": self._epsilon,
+                                "begin_norm_axis": axis})
+        return helper.append_activation(out, self._act)
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__()
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+        helper = LayerHelper(self.full_name())
+        self.weight = helper.create_parameter(param_attr, list(size), dtype)
+
+    def forward(self, input):
+        helper = LayerHelper(self.full_name())
+        out = helper.create_variable_for_type_inference(self.weight.dtype)
+        helper.append_op("lookup_table_v2",
+                         inputs={"W": [self.weight], "Ids": [input]},
+                         outputs={"Out": [out]},
+                         attrs={"padding_idx": self._padding_idx})
+        return out
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, seed=None,
+                 dropout_implementation="downgrade_in_infer",
+                 is_test=False):
+        super().__init__()
+        self._p = p
+        self._seed = seed
+        self._impl = dropout_implementation
+
+    def forward(self, input):
+        from .. import layers
+        return layers.dropout(input, self._p, is_test=not self.training,
+                              seed=self._seed,
+                              dropout_implementation=self._impl)
+
+
+class GroupNorm(Layer):
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, data_layout="NCHW",
+                 dtype="float32"):
+        super().__init__()
+        self._groups, self._epsilon, self._act = groups, epsilon, act
+        helper = LayerHelper(self.full_name())
+        self.weight = helper.create_parameter(
+            param_attr, [channels], "float32",
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = helper.create_parameter(bias_attr, [channels], "float32",
+                                            is_bias=True)
+
+    def forward(self, input):
+        helper = LayerHelper(self.full_name())
+        out = helper.create_variable_for_type_inference(input.dtype)
+        mean = helper.create_variable_for_type_inference("float32")
+        var = helper.create_variable_for_type_inference("float32")
+        helper.append_op("group_norm",
+                         inputs={"X": [input], "Scale": [self.weight],
+                                 "Bias": [self.bias]},
+                         outputs={"Y": [out], "Mean": [mean],
+                                  "Variance": [var]},
+                         attrs={"groups": self._groups,
+                                "epsilon": self._epsilon,
+                                "data_layout": "NCHW"})
+        return helper.append_activation(out, self._act)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, *a, **kw):
+        super().__init__()
+        raise NotImplementedError("SpectralNorm: planned")
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self._start = start_axis
+
+    def forward(self, input):
+        from .. import layers
+        return layers.flatten(input, axis=self._start)

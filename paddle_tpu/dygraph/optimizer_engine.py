@@ -1,0 +1,114 @@
+"""Eager optimizer application.
+
+The reference dygraph mode runs the *same* optimizer ops as static mode
+through the eager kernel path (optimizer.minimize after loss.backward).
+We reproduce that sharing mechanically: build a micro-Program containing
+exactly the ops the optimizer's static `_append_optimize_op` (+ grad clip +
+regularization) would emit, then lower it to ONE jitted update function for
+all parameters — so a dygraph train step pays a single XLA dispatch for the
+whole update instead of the reference's per-op kernel launches.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..framework.core import (Program, program_guard, grad_var_name)
+from ..framework.executor import analyze_block, lower_block
+
+
+class _EagerOptState:
+    __slots__ = ("fn", "param_names", "grad_names", "state_names",
+                 "lr_name", "state_vals")
+
+    def __init__(self):
+        self.state_vals: Dict[str, object] = {}
+
+
+def _build(opt, params_grads) -> _EagerOptState:
+    import jax
+
+    st = _EagerOptState()
+    prog, startup = Program(), Program()
+    startup._is_startup = True
+    # the optimizer caches vars per-program; reset so accumulators/lr are
+    # created fresh inside the micro-program
+    opt._accumulators = {}
+    opt._lr_var = None
+
+    with program_guard(prog, startup):
+        from ..framework.core import _set_dygraph_tracer, _dygraph_tracer
+        tracer = _dygraph_tracer()
+        _set_dygraph_tracer(None)  # build statically
+        try:
+            block = prog.global_block()
+            pg = []
+            for p, g in params_grads:
+                pv = block.create_parameter(p.name, p.shape, p.dtype)
+                gv = block.create_var(name=grad_var_name(p.name),
+                                      shape=p.shape, dtype=p.dtype)
+                pg.append((pv, gv))
+            opt.apply_gradients(pg)
+        finally:
+            _set_dygraph_tracer(tracer)
+
+    st.param_names = [p.name for p, _ in params_grads]
+    st.grad_names = [grad_var_name(p.name) for p, _ in params_grads]
+    st.lr_name = opt._lr_var.name
+
+    feed = set(st.param_names) | set(st.grad_names) | {st.lr_name}
+    state_in, state_out = analyze_block(block, list(feed))
+    st.state_names = [n for n in state_in if n not in feed]
+
+    # initialize accumulator values by lowering the startup block eagerly
+    env: Dict[str, object] = {}
+    lower_block(startup.global_block(), env, base_key=jax.random.key(0))
+    for n in st.state_names:
+        if n in env:
+            st.state_vals[n] = env[n]
+        else:
+            raise RuntimeError(f"accumulator {n} has no initializer")
+
+    names_p, names_g, names_s = (list(st.param_names), list(st.grad_names),
+                                 list(st.state_names))
+
+    def update(param_vals, grad_vals, state_vals, lr_val):
+        env = dict(zip(names_p, param_vals))
+        env.update(zip(names_g, grad_vals))
+        env.update(zip(names_s, state_vals))
+        env[st.lr_name] = lr_val
+        lower_block(block, env, base_key=jax.random.key(0))
+        return (tuple(env[n] for n in names_p),
+                tuple(env[n] for n in names_s))
+
+    st.fn = jax.jit(update, donate_argnums=(0, 2))
+    return st
+
+
+def apply_dygraph_update(opt, params_grads: List[Tuple]):
+    """Apply one optimizer step to eager (param, grad) pairs."""
+    if not params_grads:
+        return
+    sig = tuple((p.name, p.shape, p.dtype) for p, _ in params_grads)
+    cache = getattr(opt, "_eager_engine_cache", None)
+    if cache is None or cache[0] != sig:
+        st = _build(opt, params_grads)
+        opt._eager_engine_cache = (sig, st)
+    else:
+        st = cache[1]
+
+    param_vals = tuple(p._value for p, _ in params_grads)
+    grad_vals = tuple(g._value if hasattr(g, "_value") else g
+                      for _, g in params_grads)
+    state_vals = tuple(st.state_vals[n] for n in st.state_names)
+    lr = np.asarray([opt.current_step_lr()], "float32")
+
+    new_params, new_state = st.fn(param_vals, grad_vals, state_vals, lr)
+    for (p, _), v in zip(params_grads, new_params):
+        p._value = v
+    for n, v in zip(st.state_names, new_state):
+        st.state_vals[n] = v
+    # mirror into _dy_accumulators for optimizer.state_dict()
+    for n, v in zip(st.state_names, new_state):
+        opt._dy_accumulators.setdefault("state", {})[n] = v

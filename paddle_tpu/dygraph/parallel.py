@@ -1,0 +1,129 @@
+"""Dygraph data parallel (reference python/paddle/fluid/dygraph/parallel.py
+DataParallel:433 + imperative/nccl_context.cc bootstrap).
+
+TPU-native: a single host process drives all local chips through XLA, so
+the reference's one-process-per-GPU + NCCL-allreduce layout collapses.
+`DataParallel` here is the API-compatible wrapper; gradient averaging uses
+an in-jit psum when running under `to_static`/pjit over a dp mesh, and is
+the identity at world_size 1. Multi-host scale-out goes through
+jax.distributed (parallel/ package) rather than per-process NCCL rings.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .layers import Layer
+
+
+class ParallelEnv:
+    """reference dygraph.parallel.Env / ParallelEnv: env-var topology."""
+
+    def __init__(self):
+        self._rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._endpoints = [e for e in eps.split(",") if e]
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    # reference-era aliases
+    local_rank = rank
+    nranks = world_size
+
+    @property
+    def trainer_endpoints(self):
+        return self._endpoints
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+
+Env = ParallelEnv
+
+
+def prepare_context(strategy=None):
+    return ParallelEnv()
+
+
+class ParallelStrategy:
+    def __init__(self):
+        env = ParallelEnv()
+        self.nranks = env.world_size
+        self.local_rank = env.rank
+        self.trainer_endpoints = env.trainer_endpoints
+        self.current_endpoint = env.current_endpoint
+
+
+class DataParallel(Layer):
+    """Wraps a Layer for data-parallel training.
+
+    reference semantics: scale_loss divides by nranks;
+    apply_collective_grads coalesces + allreduces gradients
+    (fluid/dygraph/parallel.py:288-339). Here: at world_size 1 (single
+    host process driving all chips) both are identity — batch-level
+    parallelism happens inside the jitted step via GSPMD instead.
+    """
+
+    def __init__(self, layers: Layer, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._strategy = strategy or ParallelStrategy()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @property
+    def nranks(self):
+        return self._strategy.nranks
+
+    def scale_loss(self, loss):
+        if self._strategy.nranks <= 1:
+            return loss
+        from .. import layers as L
+        return L.scale(loss, scale=1.0 / self._strategy.nranks)
+
+    def apply_collective_grads(self):
+        if self._strategy.nranks <= 1:
+            return
+        import jax
+        if jax.process_count() <= 1:
+            return
+        raise NotImplementedError(
+            "multi-process eager allreduce: use to_static + dp mesh "
+            "(paddle_tpu.parallel), or fleet collective training")
+
+    # delegate module protocol to the wrapped layers
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix=""):
+        return self._layers.named_parameters(prefix)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    def train(self):
+        self.training = True
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        self._layers.eval()
+        return self

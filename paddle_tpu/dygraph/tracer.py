@@ -1,0 +1,194 @@
+"""Eager tracer + tape autograd.
+
+Reference: imperative::Tracer::TraceOp (imperative/tracer.cc:59) executes an
+op through the static-kernel registry and records a grad-op node; the
+BasicEngine (imperative/basic_engine.cc:171) later runs a dep-counted
+reverse sweep.
+
+TPU-native redesign: TraceOp executes the op's *JAX lowering* eagerly (the
+same lowering the static Executor compiles — one op library, two modes,
+exactly like the reference shares kernels between modes). When gradients
+are required, the forward runs under jax.vjp and the tape stores the vjp
+closure; backward() is a reverse sweep accumulating cotangents. No grad-op
+descs, no kernel lookup: XLA jit-caches each op's computation by shape.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..framework.core import Operator
+from ..ops.registry import LowerContext, lower_op
+from .varbase import VarBase
+
+
+class _EagerVarMeta:
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape, self.dtype = shape, dtype
+
+
+class _EagerBlock:
+    """Minimal Block facade for LowerContext in eager mode: exposes shape /
+    dtype of live values only."""
+
+    def __init__(self, metas: Dict[str, _EagerVarMeta]):
+        self._metas = metas
+
+    def var(self, name: str):
+        try:
+            return self._metas[name]
+        except KeyError:
+            raise KeyError(f"eager var {name!r} unknown to this op") from None
+
+    def _find_var_recursive(self, name: str):
+        return self._metas.get(name)
+
+
+class _TapeNode:
+    __slots__ = ("op_type", "inputs", "outputs", "vjp_fn", "out_avals")
+
+    def __init__(self, op_type, inputs, outputs, vjp_fn, out_avals):
+        self.op_type = op_type
+        self.inputs = inputs      # List[VarBase] (flat, traced order)
+        self.outputs = outputs    # List[VarBase]
+        self.vjp_fn = vjp_fn
+        self.out_avals = out_avals  # List[(shape, dtype)]
+
+
+class Tracer:
+    """One per dygraph guard (reference fluid/dygraph/base.py guard)."""
+
+    def __init__(self, seed: int = 0):
+        self._nodes: List[_TapeNode] = []
+        self._no_grad = False
+        self._train_mode = True
+        self._op_counter = itertools.count()
+        self._seed = seed
+
+    # ------------------------------------------------------------------
+    def trace_op(self, type: str, inputs: Dict[str, Any],
+                 outputs: Dict[str, Any], attrs: Dict[str, Any]):
+        import jax
+
+        in_slots = {k: [v for v in (vs if isinstance(vs, (list, tuple))
+                                    else [vs])]
+                    for k, vs in inputs.items()}
+        out_slots = {k: [v for v in (vs if isinstance(vs, (list, tuple))
+                                     else [vs])]
+                     for k, vs in outputs.items()}
+
+        flat_in: List[VarBase] = []
+        for vs in in_slots.values():
+            for v in vs:
+                if not isinstance(v, VarBase):
+                    raise TypeError(
+                        f"op {type}: eager inputs must be VarBase, got "
+                        f"{v!r}")
+                if v._value is None:
+                    raise ValueError(
+                        f"op {type}: input {v.name} has no value")
+                flat_in.append(v)
+        flat_out: List[VarBase] = [v for vs in out_slots.values() for v in vs]
+
+        op = Operator(None, type,
+                      {k: [v.name for v in vs] for k, vs in in_slots.items()},
+                      {k: [v.name for v in vs]
+                       for k, vs in out_slots.items()},
+                      dict(attrs))
+        op.set_attr("__op_seed__", next(self._op_counter))
+
+        metas = {v.name: _EagerVarMeta(v.shape, v.dtype) for v in flat_in}
+        block = _EagerBlock(metas)
+        in_names = [v.name for v in flat_in]
+        out_names = [v.name for v in flat_out]
+        base_key = jax.random.fold_in(
+            jax.random.key(np.uint32(self._seed)),
+            op.attr("__op_seed__", 0))
+
+        def fn(*in_vals):
+            env = dict(zip(in_names, in_vals))
+            ctx = LowerContext(block, env, base_key=base_key,
+                               is_test=not self._train_mode)
+            lower_op(ctx, op)
+            return tuple(env[n] for n in out_names)
+
+        in_vals = tuple(v._value for v in flat_in)
+        needs_grad = (not self._no_grad and self._train_mode and
+                      any(not v.stop_gradient for v in flat_in))
+        if needs_grad:
+            out_vals, vjp_fn = jax.vjp(fn, *in_vals)
+            node = _TapeNode(type, list(flat_in), list(flat_out), vjp_fn,
+                             [(np.shape(o), o.dtype) for o in out_vals])
+            self._nodes.append(node)
+            for v in flat_out:
+                v._producer = node
+                v.stop_gradient = False
+        else:
+            out_vals = fn(*in_vals)
+            for v in flat_out:
+                # persistable vars (params, buffers) own their flag — e.g.
+                # a trainable ParamBase being *initialized* under no_grad
+                # must stay differentiable for later ops
+                if not v.persistable:
+                    v.stop_gradient = True
+        for v, val in zip(flat_out, out_vals):
+            v._value = val
+        # single-output convenience: return the traced outputs as given
+        return flat_out[0] if len(flat_out) == 1 else flat_out
+
+
+def _zero_cotangent(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+    if np.issubdtype(np.dtype(dtype) if dtype != "bfloat16" else np.float32,
+                     np.floating) or str(dtype) == "bfloat16":
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def backward(loss: VarBase, retain_graph: bool = False):
+    """Reverse sweep over the tape (reference BasicEngine::Execute,
+    imperative/basic_engine.cc:171): accumulate cotangents per VarBase,
+    deposit gradients on leaves."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.core import _dygraph_tracer
+    tracer = _dygraph_tracer()
+    if tracer is None:
+        raise RuntimeError("backward() outside dygraph guard")
+
+    cts: Dict[int, Any] = {
+        id(loss): jnp.ones(np.shape(loss._value),
+                           np.asarray(loss._value).dtype)}
+
+    for node in reversed(tracer._nodes):
+        out_cts = []
+        any_ct = False
+        for v, (shape, dtype) in zip(node.outputs, node.out_avals):
+            ct = cts.get(id(v))
+            if ct is None:
+                out_cts.append(_zero_cotangent(shape, dtype))
+            else:
+                any_ct = True
+                out_cts.append(ct)
+        if not any_ct:
+            continue
+        in_cts = node.vjp_fn(tuple(out_cts))
+        for v, ct in zip(node.inputs, in_cts):
+            if v.stop_gradient or ct is None:
+                continue
+            if getattr(ct, "dtype", None) == jax.dtypes.float0:
+                continue
+            prev = cts.get(id(v))
+            cts[id(v)] = ct if prev is None else prev + ct
+            if v.is_leaf:
+                v._grad_value = (ct if v._grad_value is None
+                                 else v._grad_value + ct)
+
+    if not retain_graph:
+        tracer._nodes.clear()

@@ -1,0 +1,172 @@
+"""VarBase: the eager tensor (reference imperative/layer.h:65 VarBase and
+fluid/dygraph dygraph.core.VarBase pybind).
+
+TPU-native: wraps a jax.Array. Autograd is a tape of jax.vjp closures
+recorded by the Tracer (see tracer.py) instead of the reference's grad-op
+graph + BasicEngine dependency counting (imperative/basic_engine.cc:38).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..framework.core import convert_dtype, unique_name
+
+
+class VarBase:
+    def __init__(self, value=None, name: Optional[str] = None,
+                 stop_gradient: bool = False, persistable: bool = False,
+                 trainable: bool = True):
+        self._value = value
+        self.name = name or unique_name("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = trainable
+        self._grad_value = None     # accumulated gradient (jax array)
+        self._producer = None       # tape node that produced this var
+
+    # -- value access -------------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    def set_value(self, v):
+        import jax.numpy as jnp
+        if isinstance(v, VarBase):
+            v = v._value
+        self._value = jnp.asarray(v)
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    @property
+    def shape(self):
+        return tuple(np.shape(self._value)) if self._value is not None \
+            else None
+
+    @property
+    def dtype(self):
+        return convert_dtype(np.asarray(self._value).dtype) \
+            if self._value is not None else "float32"
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._producer is None
+
+    # -- autograd -----------------------------------------------------------
+    @property
+    def grad_value(self):
+        return self._grad_value
+
+    def gradient(self):
+        return None if self._grad_value is None \
+            else np.asarray(self._grad_value)
+
+    @property
+    def grad(self):
+        return self.gradient()
+
+    def clear_gradient(self):
+        self._grad_value = None
+
+    def backward(self, retain_graph: bool = False):
+        from .tracer import backward as _backward
+        _backward(self, retain_graph=retain_graph)
+
+    def detach(self) -> "VarBase":
+        return VarBase(self._value, name=unique_name(self.name + ".detach"),
+                       stop_gradient=True, persistable=self.persistable)
+
+    # -- conversions / convenience -----------------------------------------
+    def astype(self, dtype) -> "VarBase":
+        from .. import layers
+        return layers.cast(self, convert_dtype(dtype))
+
+    def reshape(self, shape):
+        from .. import layers
+        return layers.reshape(self, list(shape))
+
+    def __len__(self):
+        s = self.shape
+        return int(s[0]) if s else 0
+
+    def __float__(self):
+        return float(np.asarray(self._value).reshape(-1)[0])
+
+    def __repr__(self):
+        g = "" if self.stop_gradient else ", grad"
+        return (f"VarBase(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype}{g})\n{self.numpy()!r}")
+
+    # -- math operators (route through the traced op library) ---------------
+    def _binary(self, other, fwd, rev=False):
+        from .. import layers
+        fn = getattr(layers, fwd)
+        if isinstance(other, VarBase):
+            a, b = (other, self) if rev else (self, other)
+            return fn(a, b)
+        from .base import to_variable
+        o = to_variable(np.asarray(other, dtype=self.dtype))
+        o.stop_gradient = True
+        a, b = (o, self) if rev else (self, o)
+        return fn(a, b)
+
+    def __add__(self, o):
+        return self._binary(o, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elementwise_sub", rev=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elementwise_div")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "elementwise_div", rev=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "elementwise_pow")
+
+    def __neg__(self):
+        from .. import layers
+        return layers.scale(self, scale=-1.0)
+
+    def __matmul__(self, o):
+        from .. import layers
+        return layers.matmul(self, o)
+
+    def __getitem__(self, idx):
+        from .. import layers
+        if isinstance(idx, int):
+            out = layers.slice(self, axes=[0], starts=[idx], ends=[idx + 1])
+            return layers.squeeze(out, [0])
+        if isinstance(idx, slice):
+            start = idx.start or 0
+            stop = idx.stop if idx.stop is not None else int(self.shape[0])
+            return layers.slice(self, axes=[0], starts=[start], ends=[stop])
+        raise TypeError(f"unsupported index {idx!r}")
+
+
+class ParamBase(VarBase):
+    """Eager parameter (reference ParamBase / dygraph Parameter)."""
+
+    def __init__(self, value=None, name=None, trainable=True, **kw):
+        super().__init__(value, name=name, stop_gradient=not trainable,
+                         persistable=True, trainable=trainable, **kw)
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+
+    def __repr__(self):
+        return (f"Parameter(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype}, trainable={self.trainable})")

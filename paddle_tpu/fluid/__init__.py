@@ -24,5 +24,6 @@ TPUPlace = _root.TPUPlace
 CUDAPlace = _root.CUDAPlace
 is_compiled_with_cuda = _root.is_compiled_with_cuda
 
+from .. import dygraph  # noqa
 from .. import framework  # noqa
 backward = framework.backward

@@ -305,10 +305,9 @@ def _as_name_list(v) -> List[str]:
     if v is None:
         return []
     if isinstance(v, (list, tuple)):
-        return [x.name if isinstance(x, Variable) else str(x) for x in v]
-    if isinstance(v, Variable):
-        return [v.name]
-    return [str(v)]
+        return [getattr(x, "name", None) or str(x) for x in v]
+    name = getattr(v, "name", None)
+    return [name if name is not None else str(v)]
 
 
 def _jsonable_attrs(attrs: dict) -> dict:

@@ -93,8 +93,44 @@ class LayerHelper:
             from ..framework.core import _dygraph_tracer
             return _dygraph_tracer().trace_op(type, inputs or {},
                                               outputs or {}, attrs or {})
+        self._capture_eager_vars(inputs)
+        self._capture_eager_vars(outputs)
         return self.main_program.current_block().append_op(
             type, inputs=inputs, outputs=outputs, attrs=attrs)
+
+    def _capture_eager_vars(self, slots):
+        """dygraph-to-static support: an eager VarBase referenced while
+        building a Program (a module parameter / BN buffer) is materialized
+        as a static parameter var and recorded on the program so the
+        executor scope can be seeded with its live value (reference
+        ProgramTranslator param gathering,
+        dygraph_to_static/program_translator.py)."""
+        from ..dygraph.varbase import ParamBase, VarBase
+        if not slots:
+            return
+        block = self.main_program.current_block()
+        captures = self.main_program.__dict__.setdefault("_captures", {})
+        for vs in slots.values():
+            for v in (vs if isinstance(vs, (list, tuple)) else [vs]):
+                if not isinstance(v, VarBase):
+                    continue
+                if v.name in captures:
+                    continue
+                if v._value is None:
+                    raise ValueError(
+                        f"eager var {v.name} used in static graph before "
+                        f"it has a value")
+                if block._find_var_recursive(v.name) is not None:
+                    captures[v.name] = v
+                    continue
+                gb = self.main_program.global_block()
+                if isinstance(v, ParamBase) and v.trainable:
+                    gb.create_parameter(v.name, list(v.shape), v.dtype)
+                else:
+                    gb.create_var(name=v.name, shape=list(v.shape),
+                                  dtype=v.dtype, persistable=True,
+                                  stop_gradient=True)
+                captures[v.name] = v
 
     def append_activation(self, out: Variable, act: Optional[str]):
         if act is None:

@@ -1,0 +1,203 @@
+"""Imperative-mode tests (reference tests/unittests/test_imperative_*.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import dygraph, layers, optimizer
+from paddle_tpu.dygraph import (BatchNorm, Conv2D, Embedding, LayerNorm,
+                                Linear, Pool2D, Sequential, declarative,
+                                load_dygraph, no_grad, save_dygraph,
+                                to_variable)
+
+
+def test_basic_autograd():
+    with dygraph.guard():
+        x = to_variable(np.array([[1.0, 2.0], [3.0, 4.0]], "float32"))
+        x.stop_gradient = False
+        y = x * x + 2.0 * x          # dy/dx = 2x + 2
+        loss = layers.reduce_sum(y)
+        loss.backward()
+        np.testing.assert_allclose(x.gradient(),
+                                   2 * x.numpy() + 2, rtol=1e-6)
+
+
+def test_gradient_accumulation_and_clear():
+    with dygraph.guard():
+        x = to_variable(np.ones((2, 2), "float32"))
+        x.stop_gradient = False
+        layers.reduce_sum(x * 3.0).backward()
+        np.testing.assert_allclose(x.gradient(), 3 * np.ones((2, 2)))
+        layers.reduce_sum(x * 3.0).backward()
+        np.testing.assert_allclose(x.gradient(), 6 * np.ones((2, 2)))
+        x.clear_gradient()
+        assert x.gradient() is None
+
+
+def test_no_grad():
+    with dygraph.guard():
+        x = to_variable(np.ones((2,), "float32"))
+        x.stop_gradient = False
+        with no_grad():
+            y = x * 2.0
+        assert y.stop_gradient
+
+
+def test_mlp_trains():
+    with dygraph.guard():
+        model = Sequential(Linear(16, 32, act="relu"), Linear(32, 4))
+        opt = optimizer.AdamOptimizer(1e-2,
+                                      parameter_list=model.parameters())
+        rng = np.random.RandomState(0)
+        x_np = rng.rand(8, 16).astype("float32")
+        y_np = (x_np @ rng.rand(16, 4)).argmax(1).reshape(-1, 1)
+        for i in range(20):
+            x, y = to_variable(x_np), to_variable(y_np)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(model(x), y))
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            if i == 0:
+                first = float(loss)
+        assert float(loss) < first * 0.7
+
+
+def test_cnn_batchnorm_train_eval():
+    with dygraph.guard():
+        class Net(dygraph.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = Conv2D(1, 6, 5, act="relu")
+                self.bn = BatchNorm(6)
+                self.pool = Pool2D(2, "max", 2)
+                self.fc = Linear(6 * 12 * 12, 10)
+
+            def forward(self, x):
+                x = self.pool(self.bn(self.conv(x)))
+                return self.fc(layers.reshape(x, [0, -1]))
+
+        m = Net()
+        opt = optimizer.SGDOptimizer(0.1, parameter_list=m.parameters())
+        x = to_variable(np.random.rand(4, 1, 28, 28).astype("float32"))
+        y = to_variable(np.random.randint(0, 10, (4, 1)).astype("int64"))
+        for _ in range(3):
+            loss = layers.mean(layers.softmax_with_cross_entropy(m(x), y))
+            loss.backward()
+            opt.minimize(loss)
+            m.clear_gradients()
+        assert not np.allclose(m.bn._mean.numpy(), 0)  # stats updated
+        m.eval()
+        mean_before = m.bn._mean.numpy().copy()
+        m(x)
+        np.testing.assert_allclose(m.bn._mean.numpy(), mean_before)
+
+
+def test_embedding_layernorm():
+    with dygraph.guard():
+        emb = Embedding([50, 8])
+        ln = LayerNorm(8)
+        ids = to_variable(np.random.randint(0, 50, (4, 6)).astype("int64"))
+        out = ln(emb(ids))
+        assert out.shape == (4, 6, 8)
+        np.testing.assert_allclose(np.asarray(out._value).mean(-1),
+                                   np.zeros((4, 6)), atol=1e-5)
+
+
+def test_state_dict_save_load():
+    with dygraph.guard():
+        m = Sequential(Linear(4, 8), Linear(8, 2))
+        tmp = tempfile.mkdtemp()
+        path = os.path.join(tmp, "model")
+        save_dygraph(m.state_dict(), path)
+        m2 = Sequential(Linear(4, 8), Linear(8, 2))
+        params, opt_state = load_dygraph(path)
+        assert opt_state is None
+        m2.set_state_dict(params)
+        for (n1, p1), (n2, p2) in zip(m.named_parameters(),
+                                      m2.named_parameters()):
+            np.testing.assert_array_equal(p1.numpy(), p2.numpy())
+
+
+def test_declarative_matches_eager():
+    with dygraph.guard():
+        m = Sequential(Linear(6, 12, act="relu"), Linear(12, 3))
+        x_np = np.random.rand(5, 6).astype("float32")
+        eager_out = m(to_variable(x_np)).numpy()
+
+        static_fn = declarative(lambda x: m(x))
+        static_out = static_fn(x_np).numpy()
+        np.testing.assert_allclose(eager_out, static_out, rtol=1e-5)
+        # cached second call, different data
+        x2 = np.random.rand(5, 6).astype("float32")
+        np.testing.assert_allclose(static_fn(x2).numpy(),
+                                   m(to_variable(x2)).numpy(), rtol=1e-5)
+
+
+def test_dygraph_dataparallel_api():
+    with dygraph.guard():
+        m = dygraph.DataParallel(Linear(4, 2))
+        x = to_variable(np.random.rand(3, 4).astype("float32"))
+        out = m(x)
+        assert out.shape == (3, 2)
+        loss = layers.mean(out)
+        scaled = m.scale_loss(loss)       # world_size 1: identity
+        scaled.backward()
+        m.apply_collective_grads()        # no-op at world_size 1
+        assert m.parameters()[0].gradient() is not None
+
+
+def test_dropout_modes():
+    with dygraph.guard():
+        d = dygraph.Dropout(p=0.5)
+        x = to_variable(np.ones((100, 100), "float32"))
+        out_train = d(x).numpy()
+        assert (out_train == 0).mean() > 0.3
+        d.eval()
+        out_eval = d(x).numpy()
+        np.testing.assert_allclose(out_eval, 0.5 * np.ones((100, 100)),
+                                   rtol=1e-6)  # downgrade_in_infer
+
+
+def test_optimizer_momentum_matches_static():
+    """Same model/data/optimizer: dygraph loop == static executor loop."""
+    rng = np.random.RandomState(3)
+    x_np = rng.rand(6, 5).astype("float32")
+    y_np = rng.rand(6, 1).astype("float32")
+    w0 = rng.rand(5, 1).astype("float32")
+
+    # static
+    from paddle_tpu.framework.initializer import NumpyArrayInitializer
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [6, 5], append_batch_size=False)
+        y = layers.data("y", [6, 1], append_batch_size=False)
+        pred = layers.fc(x, 1, param_attr=pt.ParamAttr(
+            initializer=NumpyArrayInitializer(w0)), bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    static_losses = [float(exe.run(main, feed={"x": x_np, "y": y_np},
+                                   fetch_list=[loss], scope=scope)[0])
+                     for _ in range(5)]
+
+    # dygraph
+    with dygraph.guard():
+        lin = Linear(5, 1, param_attr=pt.ParamAttr(
+            initializer=NumpyArrayInitializer(w0)), bias_attr=False)
+        opt = optimizer.MomentumOptimizer(0.1, 0.9,
+                                          parameter_list=lin.parameters())
+        dy_losses = []
+        for _ in range(5):
+            xv, yv = to_variable(x_np), to_variable(y_np)
+            l = layers.mean(layers.square_error_cost(lin(xv), yv))
+            l.backward()
+            opt.minimize(l)
+            lin.clear_gradients()
+            dy_losses.append(float(l))
+    np.testing.assert_allclose(dy_losses, static_losses, rtol=1e-5)
