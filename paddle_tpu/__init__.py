@@ -23,6 +23,9 @@ from . import optimizer  # noqa
 from . import regularizer  # noqa
 from .layers.tensor import data  # noqa
 from . import dygraph  # noqa
+from .framework.compiler import (CompiledProgram, BuildStrategy,  # noqa
+                                 ExecutionStrategy, ParallelExecutor)
+from . import distributed  # noqa
 
 __version__ = "0.1.0"
 

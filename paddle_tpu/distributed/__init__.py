@@ -1,0 +1,9 @@
+"""Distributed training (reference python/paddle/distributed/).
+
+fleet          -- collective/PS training orchestration (fleet 2.0 API)
+launch         -- process launcher (python -m paddle_tpu.distributed.launch)
+collective fns -- all_reduce/all_gather/broadcast for dygraph/static
+"""
+from . import fleet  # noqa
+from .parallel_env import (init_parallel_env, get_rank, get_world_size,  # noqa
+                           ParallelEnv)

@@ -1,0 +1,32 @@
+"""fleet 2.0-style module API: ``from paddle_tpu.distributed import fleet;
+fleet.init(is_collective=True)`` (reference distributed/fleet/__init__.py
+binds the Fleet singleton's methods at module level)."""
+from .distributed_strategy import DistributedStrategy  # noqa
+from .fleet_base import Fleet  # noqa
+from .role_maker import (PaddleCloudRoleMaker, Role, RoleMakerBase,  # noqa
+                         UserDefinedRoleMaker)
+
+_fleet_singleton = Fleet()
+
+init = _fleet_singleton.init
+is_first_worker = _fleet_singleton.is_first_worker
+worker_index = _fleet_singleton.worker_index
+worker_num = _fleet_singleton.worker_num
+is_worker = _fleet_singleton.is_worker
+worker_endpoints = _fleet_singleton.worker_endpoints
+server_num = _fleet_singleton.server_num
+server_index = _fleet_singleton.server_index
+server_endpoints = _fleet_singleton.server_endpoints
+is_server = _fleet_singleton.is_server
+barrier_worker = _fleet_singleton.barrier_worker
+distributed_optimizer = _fleet_singleton.distributed_optimizer
+minimize = _fleet_singleton.minimize
+save_persistables = _fleet_singleton.save_persistables
+save_inference_model = _fleet_singleton.save_inference_model
+stop_worker = _fleet_singleton.stop_worker
+main_program = _fleet_singleton.main_program
+startup_program = _fleet_singleton.startup_program
+
+
+def fleet_instance() -> Fleet:
+    return _fleet_singleton

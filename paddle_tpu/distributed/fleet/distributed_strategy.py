@@ -1,0 +1,92 @@
+"""DistributedStrategy: the strategy-flag surface.
+
+Reference: python/paddle/distributed/fleet/base/distributed_strategy.py:101
+backed by framework/distributed_strategy.proto:77-101. Here plain Python
+attributes + per-strategy config dicts (same keys as the proto messages).
+"""
+from __future__ import annotations
+
+import copy
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # collective execution
+        self.auto = False
+        self.a_sync = False                 # parameter-server async mode
+        self.a_sync_configs = {"k_steps": -1, "batch_merge_repeat": 1}
+
+        # mixed precision (proto AMPConfig)
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0,
+            "incr_every_n_steps": 1000,
+            "decr_every_n_nan_or_inf": 2,
+            "incr_ratio": 2.0,
+            "decr_ratio": 0.5,
+            "use_dynamic_loss_scaling": True,
+            "use_pure_bf16": False,
+            "custom_white_list": [],
+            "custom_black_list": [],
+        }
+
+        # activation recompute (proto RecomputeConfig)
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+
+        # pipeline (proto PipelineConfig)
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1,
+                                 "schedule_mode": "F-then-B"}
+
+        # gradient merge (proto GradientMergeConfig)
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+
+        # ZeRO-style sharding (proto ShardingConfig)
+        self.sharding = False
+        self.sharding_configs = {"sharding_degree": 8,
+                                 "segment_broadcast_MB": 32.0}
+
+        # localsgd / dgc / large-batch optimizers
+        self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
+        self.adaptive_localsgd = False
+        self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1,
+                            "sparsity": [0.999]}
+        self.lamb = False
+        self.lamb_configs = {"lamb_weight_decay": 0.01,
+                             "exclude_from_weight_decay": []}
+        self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                             "epsilon": 0.0,
+                             "exclude_from_weight_decay": []}
+        self.fp16_allreduce = False
+
+        # tensor/sequence parallel (new capability; absent in reference)
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.sequence_parallel = False
+        self.sequence_parallel_configs = {"sequence_parallel_degree": 1,
+                                          "mode": "ring"}
+
+        # execution
+        self.nccl_comm_num = 1
+        self.sync_nccl_allreduce = True
+        self.fuse_grad_size_in_MB = 32
+        self.fuse_all_reduce_ops = True
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 1
+
+        self.build_strategy = None
+        self.execution_strategy = None
+
+    def copy(self) -> "DistributedStrategy":
+        return copy.deepcopy(self)
+
+    def __repr__(self):
+        on = [k for k, v in self.__dict__.items()
+              if isinstance(v, bool) and v]
+        return f"DistributedStrategy(enabled={on})"

@@ -1,0 +1,118 @@
+"""Fleet: the distributed-training facade.
+
+Reference: python/paddle/distributed/fleet/base/fleet_base.py (init:125,
+distributed_optimizer:554, minimize:946 with meta-optimizer ranking at
+:1019-1061).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .distributed_strategy import DistributedStrategy
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+from .strategy_compiler import StrategyCompiler
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._is_collective = False
+        self._user_defined_strategy: Optional[DistributedStrategy] = None
+        self._user_defined_optimizer = None
+        self._final_strategy = None
+        self._applied_meta_optimizers = []
+        self._origin_main_program = None
+        self._origin_startup_program = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def init(self, role_maker: Optional[RoleMakerBase] = None,
+             is_collective: bool = False, strategy=None):
+        self._is_collective = is_collective or role_maker is None
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=self._is_collective)
+        if strategy is not None:
+            self._user_defined_strategy = strategy
+        return self
+
+    # -- cluster queries ----------------------------------------------------
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker.get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self):
+        return self._role_maker.server_num()
+
+    def server_index(self):
+        return self._role_maker.server_index()
+
+    def server_endpoints(self, to_string=False):
+        eps = self._role_maker.get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def barrier_worker(self):
+        pass  # single-program SPMD: XLA orders everything
+
+    # -- optimizer ----------------------------------------------------------
+    def distributed_optimizer(self, optimizer,
+                              strategy: Optional[DistributedStrategy] = None
+                              ) -> "Fleet":
+        self._user_defined_optimizer = optimizer
+        self._user_defined_strategy = (strategy or
+                                       self._user_defined_strategy or
+                                       DistributedStrategy())
+        return self
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if self._role_maker is None:
+            raise RuntimeError("fleet.init() must be called before minimize")
+        from ...framework.core import (default_main_program,
+                                       default_startup_program)
+        self._origin_main_program = loss.block.program
+        self._origin_startup_program = (startup_program or
+                                        default_startup_program())
+        compiler = StrategyCompiler()
+        final_opt, applied, valid = compiler.generate_optimizer(
+            loss, self._role_maker, self._user_defined_optimizer,
+            self._user_defined_strategy)
+        self._applied_meta_optimizers = applied
+        self._final_strategy = valid
+        return final_opt.minimize(loss, self._origin_startup_program,
+                                  parameter_list, no_grad_set)
+
+    # -- program accessors --------------------------------------------------
+    def main_program(self):
+        return self._origin_main_program
+
+    def startup_program(self):
+        return self._origin_startup_program
+
+    # -- io passthroughs (wired to paddle_tpu.io) ---------------------------
+    def save_persistables(self, executor, dirname, main_program=None):
+        from ... import io
+        return io.save_persistables(executor, dirname, main_program)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None, **kw):
+        from ... import io
+        return io.save_inference_model(dirname, feeded_var_names,
+                                       target_vars, executor,
+                                       main_program=main_program)
+
+    def stop_worker(self):
+        pass

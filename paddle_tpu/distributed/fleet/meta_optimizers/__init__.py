@@ -1,0 +1,26 @@
+"""Meta-optimizer stack (reference distributed/fleet/meta_optimizers/).
+
+Each meta optimizer wraps an inner optimizer and rewrites the program (or
+the lowering) to implement one distributed-training strategy; the strategy
+compiler stacks the applicable ones (fleet_base.py:1019-1061 ranking).
+"""
+from .meta_optimizer_base import MetaOptimizerBase  # noqa
+from .graph_execution_optimizer import GraphExecutionOptimizer  # noqa
+from .lamb_optimizer import LambOptimizer  # noqa
+from .lars_optimizer import LarsOptimizer  # noqa
+
+META_OPTIMIZER_CLASSES = [
+    # inner-most applied first; order mirrors the reference ranking
+    LambOptimizer,
+    LarsOptimizer,
+    GraphExecutionOptimizer,
+]
+
+
+def register_meta_optimizer(cls, index=None):
+    """Extension point used by amp/recompute/... as they land."""
+    if index is None:
+        META_OPTIMIZER_CLASSES.append(cls)
+    else:
+        META_OPTIMIZER_CLASSES.insert(index, cls)
+    return cls

@@ -1,0 +1,73 @@
+"""Collective data-parallel rewrite.
+
+Reference: the transpiler inserts, after the backward pass, a
+`scale + c_allreduce_sum (+ c_sync_*)` per gradient and comm bootstrap ops
+into the startup program (transpiler/collective.py:178 GradAllReduce,
+fleet meta_optimizers/graph_execution_optimizer.py).
+
+Same rewrite here — and because the program executes as one shard_map'd
+SPMD computation (parallel/spmd.py), each inserted c_allreduce_sum lowers
+to one lax.psum over the dp mesh axis (ICI), with XLA free to fuse/overlap
+them (the reference needed fuse_all_reduce_op_pass + stream juggling for
+that).
+"""
+from __future__ import annotations
+
+from ....framework.core import OpRole
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class GraphExecutionOptimizer(MetaOptimizerBase):
+    strategy_flag = "_collective_dp"  # applied by default in collective mode
+
+    def _can_apply(self):
+        return self.role_maker is not None and \
+            self.role_maker.worker_num() > 1
+
+    def _disable_strategy(self, strategy):
+        pass
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        opt_ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        nranks = self.role_maker.worker_num()
+        main = loss.block.program
+        self._insert_allreduce(main, params_grads, nranks)
+        self._init_communicator(startup_program)
+        main.bump()
+        return opt_ops, params_grads
+
+    def _init_communicator(self, startup_program):
+        from ....framework.core import default_startup_program
+        startup = startup_program or default_startup_program()
+        block = startup.global_block()
+        nccl_id = block.create_var(name="nccl_id_0", shape=(1,),
+                                   dtype="int32", persistable=True)
+        block.append_op("c_gen_nccl_id", outputs={"Out": [nccl_id]},
+                        attrs={"ring_id": 0})
+        block.append_op("c_comm_init", inputs={"X": [nccl_id]},
+                        attrs={"ring_id": 0})
+
+    @staticmethod
+    def _insert_allreduce(main, params_grads, nranks):
+        block = main.global_block()
+        grad_names = {g.name for _, g in params_grads if g is not None}
+        # first optimize-role op = end of backward
+        insert_at = len(block.ops)
+        for i, op in enumerate(block.ops):
+            if op.attr("op_role") == OpRole.Optimize:
+                insert_at = i
+                break
+        for _, g in params_grads:
+            if g is None:
+                continue
+            block._insert_op(
+                insert_at, "scale", inputs={"X": [g]}, outputs={"Out": [g]},
+                attrs={"scale": 1.0 / nranks, "op_role": OpRole.Backward})
+            block._insert_op(
+                insert_at + 1, "c_allreduce_sum",
+                inputs={"X": [g]}, outputs={"Out": [g]},
+                attrs={"ring_id": 0, "op_role": OpRole.Backward})
+            insert_at += 2
+        return grad_names

@@ -1,0 +1,37 @@
+"""Reference: distributed/fleet/meta_optimizers/lamb_optimizer.py —
+swap the inner optimizer for LAMB when strategy.lamb is on."""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class LambOptimizer(MetaOptimizerBase):
+    strategy_flag = "lamb"
+
+    def _can_apply(self):
+        from ....optimizer import AdamOptimizer
+        return bool(self.user_defined_strategy.lamb) and \
+            isinstance(self.user_defined_optimizer, AdamOptimizer)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        from ....optimizer import LambOptimizer as Lamb
+        cfg = self.user_defined_strategy.lamb_configs
+        inner = self.user_defined_optimizer
+        exclude = set(cfg.get("exclude_from_weight_decay", []))
+
+        def _exclude_fn(pname):
+            return any(e in pname for e in exclude)
+
+        lamb = Lamb(
+            learning_rate=inner._learning_rate,
+            lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
+            beta1=getattr(inner, "_beta1", 0.9),
+            beta2=getattr(inner, "_beta2", 0.999),
+            epsilon=getattr(inner, "_epsilon", 1e-6),
+            exclude_from_weight_decay_fn=_exclude_fn if exclude else None,
+            parameter_list=inner._parameter_list,
+            regularization=inner.regularization,
+            grad_clip=inner._grad_clip)
+        return lamb.minimize(loss, startup_program, parameter_list,
+                             no_grad_set)

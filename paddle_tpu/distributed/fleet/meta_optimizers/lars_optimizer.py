@@ -1,0 +1,30 @@
+"""Reference: distributed/fleet/meta_optimizers/lars_optimizer.py —
+swap Momentum for LARS-Momentum when strategy.lars is on."""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class LarsOptimizer(MetaOptimizerBase):
+    strategy_flag = "lars"
+
+    def _can_apply(self):
+        from ....optimizer import MomentumOptimizer
+        return bool(self.user_defined_strategy.lars) and \
+            isinstance(self.user_defined_optimizer, MomentumOptimizer)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        from ....optimizer import LarsMomentumOptimizer
+        cfg = self.user_defined_strategy.lars_configs
+        inner = self.user_defined_optimizer
+        lars = LarsMomentumOptimizer(
+            learning_rate=inner._learning_rate,
+            momentum=getattr(inner, "_momentum", 0.9),
+            lars_coeff=cfg.get("lars_coeff", 0.001),
+            lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+            parameter_list=inner._parameter_list,
+            regularization=inner.regularization,
+            grad_clip=inner._grad_clip)
+        return lars.minimize(loss, startup_program, parameter_list,
+                             no_grad_set)

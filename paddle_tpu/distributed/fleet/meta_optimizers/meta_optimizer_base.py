@@ -1,0 +1,40 @@
+"""Reference: distributed/fleet/meta_optimizers/meta_optimizer_base.py."""
+from __future__ import annotations
+
+
+class MetaOptimizerBase:
+    # strategy attribute that switches this optimizer on
+    strategy_flag: str = ""
+
+    def __init__(self, optimizer):
+        self.inner_opt = optimizer
+        self.role_maker = None
+        self.user_defined_strategy = None
+
+    def _set_basic_info(self, loss, role_maker, user_defined_optimizer,
+                        user_defined_strategy):
+        self.loss = loss
+        self.role_maker = role_maker
+        self.user_defined_optimizer = user_defined_optimizer
+        self.user_defined_strategy = user_defined_strategy
+
+    def _can_apply(self) -> bool:
+        return bool(getattr(self.user_defined_strategy, self.strategy_flag,
+                            False))
+
+    def _disable_strategy(self, strategy):
+        setattr(strategy, self.strategy_flag, False)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        return self.inner_opt.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self.minimize_impl(loss, startup_program, parameter_list,
+                                  no_grad_set)
+
+    # pass through attributes optimizers expose
+    def __getattr__(self, item):
+        return getattr(self.__dict__["inner_opt"], item)

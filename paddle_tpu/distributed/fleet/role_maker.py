@@ -1,0 +1,110 @@
+"""Role makers: who am I in the cluster.
+
+Reference: python/paddle/distributed/fleet/base/role_maker.py (Role enum:33,
+PaddleCloudRoleMaker:535 parsing PADDLE_* env, Gloo rendezvous:364).
+TPU-native: rendezvous is jax.distributed; in the common single-host case
+"workers" are the local mesh devices.
+"""
+from __future__ import annotations
+
+import os
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._worker_endpoints = []
+        self._server_endpoints = []
+        self._role = Role.WORKER
+        self._current_id = 0
+
+    def is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def is_first_worker(self) -> bool:
+        return self.is_worker() and self.worker_index() == 0
+
+    def worker_index(self) -> int:
+        return self._current_id
+
+    def server_index(self) -> int:
+        return self._current_id
+
+    def worker_num(self) -> int:
+        return max(1, len(self._worker_endpoints))
+
+    def server_num(self) -> int:
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+    # reference underscore-aliases used throughout fleet
+    _is_worker = is_worker
+    _is_server = is_server
+    _is_first_worker = is_first_worker
+    _worker_index = worker_index
+    _server_index = server_index
+    _worker_num = worker_num
+    _server_num = server_num
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-var cluster spec (reference role_maker.py:535). With no env set
+    and is_collective, the local device mesh is the cluster."""
+
+    def __init__(self, is_collective: bool = False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        self._generate_role()
+
+    def _generate_role(self):
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = [e for e in eps.split(",") if e]
+        self._server_endpoints = [
+            e for e in os.getenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                                 "").split(",") if e]
+        role = os.getenv("TRAINING_ROLE", "TRAINER").upper()
+        self._role = Role.SERVER if role == "PSERVER" else Role.WORKER
+        if self._role == Role.SERVER:
+            self._current_id = int(os.getenv("PADDLE_PSERVER_ID", "0"))
+        else:
+            self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        if not self._worker_endpoints and self._is_collective:
+            # single host: each local device is a data-parallel participant
+            import jax
+            self._worker_endpoints = [
+                f"local:{i}" for i in range(jax.device_count())]
+
+    def worker_num(self) -> int:
+        n = os.getenv("PADDLE_TRAINERS_NUM")
+        if n is not None:
+            return int(n)
+        return max(1, len(self._worker_endpoints))
+
+    _worker_num = worker_num
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """Explicit cluster spec (reference fleet 1.x UserDefinedRoleMaker)."""
+
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=0,
+                 server_endpoints=None, worker_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._server_endpoints = server_endpoints or []
+        self._worker_endpoints = worker_endpoints or \
+            [f"w:{i}" for i in range(worker_num)]

@@ -1,0 +1,23 @@
+"""Reference: distributed/fleet/base/strategy_compiler.py:112,168 —
+pick applicable meta optimizers and stack them inner-to-outer."""
+from __future__ import annotations
+
+from .meta_optimizers import META_OPTIMIZER_CLASSES
+
+
+class StrategyCompiler:
+    def generate_optimizer(self, loss, role_maker, optimizer,
+                           user_defined_strategy):
+        applied = []
+        current = optimizer
+        valid_strategy = user_defined_strategy.copy()
+        for cls in META_OPTIMIZER_CLASSES:
+            meta = cls(current)
+            meta._set_basic_info(loss, role_maker, optimizer,
+                                 valid_strategy)
+            if meta._can_apply():
+                applied.append(cls.__name__)
+                current = meta
+            else:
+                meta._disable_strategy(valid_strategy)
+        return current, applied, valid_strategy

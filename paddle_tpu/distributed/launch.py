@@ -1,0 +1,75 @@
+"""Process launcher: ``python -m paddle_tpu.distributed.launch [opts]
+train.py args...``.
+
+Reference: python/paddle/distributed/fleet/launch.py:196 launch_collective —
+one subprocess per GPU with PADDLE_TRAINER_ID/ENDPOINTS env.
+
+TPU-native: one process per *host* (all local chips belong to it). For
+single-host (the common case) this execs the script directly; for
+multi-host it sets the jax.distributed coordinator env consumed by
+init_parallel_env().
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def _parse():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes on this host (TPU: 1 — chips are "
+                        "driven by the mesh, not by processes)")
+    p.add_argument("--ips", type=str, default="127.0.0.1",
+                   help="comma-separated host ips")
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--coordinator_port", type=int, default=12355)
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def main():
+    args = _parse()
+    hosts = [h for h in args.ips.split(",") if h]
+    nnodes = max(1, len(hosts))
+    procs = []
+    for local_rank in range(args.nproc_per_node):
+        rank = args.node_rank * args.nproc_per_node + local_rank
+        world = nnodes * args.nproc_per_node
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(
+                f"{h}:{args.coordinator_port + i}"
+                for h in hosts for i in range(args.nproc_per_node)),
+            "PADDLE_CURRENT_ENDPOINT":
+                f"{hosts[min(args.node_rank, nnodes - 1)]}:"
+                f"{args.coordinator_port + local_rank}",
+        })
+        if world > 1:
+            env["PADDLE_COORDINATOR"] = \
+                f"{hosts[0]}:{args.coordinator_port}"
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        stdout = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            stdout = open(os.path.join(args.log_dir,
+                                       f"worker.{rank}.log"), "w")
+        procs.append(subprocess.Popen(cmd, env=env, stdout=stdout,
+                                      stderr=subprocess.STDOUT
+                                      if stdout else None))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

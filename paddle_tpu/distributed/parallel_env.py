@@ -1,0 +1,41 @@
+"""Process-level distributed environment.
+
+Reference: python/paddle/distributed/parallel.py:57 (init_parallel_env —
+TCP exchange of ncclUniqueId, imperative/nccl_context.cc). TPU-native:
+multi-host rendezvous is jax.distributed.initialize; within one host, all
+chips belong to this process and rank/world refer to *hosts*.
+"""
+from __future__ import annotations
+
+import os
+
+from ..dygraph.parallel import ParallelEnv  # re-export
+
+
+def get_rank() -> int:
+    import jax
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return int(os.getenv("PADDLE_TRAINER_ID", "0"))
+
+
+def get_world_size() -> int:
+    import jax
+    try:
+        return jax.process_count()
+    except RuntimeError:
+        return int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+
+
+def init_parallel_env():
+    """Bootstrap multi-host jax.distributed from PADDLE_* / coordinator
+    env vars; no-op single-host."""
+    import jax
+    coord = os.getenv("PADDLE_COORDINATOR", os.getenv("JAX_COORDINATOR"))
+    nprocs = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+    pid = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+    if coord and nprocs > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nprocs, process_id=pid)
+    return ParallelEnv()

@@ -4,6 +4,7 @@ from .nn import *  # noqa
 from .tensor import *  # noqa
 from .loss import *  # noqa
 from .metric_op import accuracy, auc  # noqa
+from . import collective  # noqa
 from . import nn  # noqa
 from . import tensor  # noqa
 from . import loss  # noqa

@@ -1,0 +1,87 @@
+"""Raw collective layers (reference python/paddle/fluid/layers/collective.py
+_c_allreduce:64 / _c_broadcast:93 and the c_* op wrappers used by the fleet
+transpilers)."""
+from __future__ import annotations
+
+from ..framework.layer_helper import LayerHelper
+
+__all__ = ["_c_allreduce", "_c_broadcast", "_c_allgather",
+           "_c_reducescatter", "_c_identity", "_c_concat", "_c_split",
+           "_allreduce", "barrier"]
+
+
+def _c_allreduce(x, out=None, reduce_type="sum", ring_id=0,
+                 use_calc_stream=False):
+    helper = LayerHelper("c_allreduce_" + reduce_type)
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("c_allreduce_" + reduce_type,
+                     inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"ring_id": ring_id,
+                            "use_calc_stream": use_calc_stream})
+    return out
+
+
+_allreduce = _c_allreduce
+
+
+def _c_broadcast(x, root=0, ring_id=0, use_calc_stream=False):
+    helper = LayerHelper("c_broadcast")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("c_broadcast", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"root": root, "ring_id": ring_id,
+                            "use_calc_stream": use_calc_stream})
+    return out
+
+
+def _c_allgather(x, nranks, ring_id=0, use_calc_stream=False):
+    helper = LayerHelper("c_allgather")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("c_allgather", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"nranks": nranks, "ring_id": ring_id,
+                            "use_calc_stream": use_calc_stream})
+    return out
+
+
+def _c_reducescatter(x, nranks, ring_id=0, use_calc_stream=False):
+    helper = LayerHelper("c_reducescatter")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("c_reducescatter", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"nranks": nranks, "ring_id": ring_id,
+                            "use_calc_stream": use_calc_stream})
+    return out
+
+
+def _c_identity(x, ring_id=0):
+    helper = LayerHelper("c_identity")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("c_identity", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"ring_id": ring_id})
+    return out
+
+
+def _c_concat(x, nranks, ring_id=0):
+    helper = LayerHelper("c_concat")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("c_concat", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"nranks": nranks, "ring_id": ring_id})
+    return out
+
+
+def _c_split(x, nranks, ring_id=0):
+    helper = LayerHelper("c_split")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("c_split", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"nranks": nranks, "ring_id": ring_id})
+    return out
+
+
+def barrier(ring_id=0):
+    helper = LayerHelper("barrier")
+    out = helper.create_variable_for_type_inference("int32")
+    helper.append_op("barrier", inputs={}, outputs={"Out": [out]},
+                     attrs={"ring_id": ring_id})
+    return out

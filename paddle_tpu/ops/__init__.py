@@ -8,5 +8,6 @@ from . import math_ops  # noqa: F401
 from . import tensor_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import collective_ops  # noqa: F401
 from .registry import (LowerContext, all_registered_ops, get_op_def,  # noqa
                        has_op, register_op)

@@ -1,0 +1,283 @@
+"""Collective communication ops.
+
+Reference: paddle/fluid/operators/collective/ — the NCCL op set
+(c_allreduce_op.h:109-158 CAllReduceOpCUDAKernel -> ncclAllReduce,
+c_allgather_op.cu.cc, c_reducescatter_op.cu.cc, c_broadcast_op.cu.cc,
+send_v2/recv_v2, comm bootstrap c_gen_nccl_id/c_comm_init, stream syncs).
+
+TPU-native redesign: a ring_id is a *named mesh axis*; kernels are XLA
+collectives (lax.psum/all_gather/psum_scatter/ppermute) that compile to ICI
+transfers. Ops only have collective meaning when lowered inside shard_map
+with mesh axes bound (parallel/spmd.py); lowered outside any mesh they take
+their single-participant meaning (allreduce = identity, allgather = expand
+with group size 1), which is also the reference behavior with one rank.
+Bootstrap/stream ops (c_gen_nccl_id, c_comm_init, c_sync_*_stream,
+barrier) are structural no-ops: XLA owns scheduling and jax.distributed
+owns rendezvous.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import (LowerContext, in_var, register_op, same_as_input,
+                       set_out)
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _axis_name(ctx: LowerContext, op):
+    """Resolve the mesh axis for this op's ring_id.
+
+    Priority: explicit 'axis_name' attr; then the ring table installed by
+    the SPMD lowering context (ring_id -> axis); None when no axes bound
+    (single participant).
+    """
+    name = op.attr("axis_name", None)
+    axes = getattr(ctx, "axis_names", None) or ()
+    if name:
+        return name if name in axes else None
+    ring = op.attr("ring_id", 0)
+    table = getattr(ctx, "ring_table", None) or {}
+    if ring in table and table[ring] in axes:
+        return table[ring]
+    return axes[0] if axes else None
+
+
+def _group_size(ctx, op):
+    import jax
+    name = _axis_name(ctx, op)
+    if name is None:
+        return 1
+    mesh = ctx.mesh
+    return dict(zip(mesh.axis_names, mesh.devices.shape))[name]
+
+
+# -- allreduce family -------------------------------------------------------
+
+def _make_allreduce(suffix, reducer):
+    op_type = f"c_allreduce_{suffix}"
+
+    @register_op(op_type, infer=same_as_input("X", "Out"), grad="auto")
+    def _lower(ctx, op, _reducer=reducer):
+        import jax.lax as lax
+        x = ctx.get_input(op, "X")
+        axis = _axis_name(ctx, op)
+        if axis is None:
+            ctx.set_output(op, "Out", x)
+            return
+        ctx.set_output(op, "Out", _reducer(x, axis))
+    return _lower
+
+
+def _psum(x, a):
+    import jax.lax as lax
+    return lax.psum(x, a)
+
+
+def _pmax(x, a):
+    import jax.lax as lax
+    return lax.pmax(x, a)
+
+
+def _pmin(x, a):
+    import jax.lax as lax
+    return lax.pmin(x, a)
+
+
+def _pprod(x, a):
+    import jax.lax as lax
+    import jax.numpy as jnp
+    return jnp.prod(lax.all_gather(x, a), axis=0)
+
+
+_make_allreduce("sum", _psum)
+_make_allreduce("max", _pmax)
+_make_allreduce("min", _pmin)
+_make_allreduce("prod", _pprod)
+
+# c_reduce_*: result only meaningful on root; SPMD model keeps it on all
+# participants (superset of reference semantics)
+for _s, _r in (("sum", _psum), ("max", _pmax), ("min", _pmin)):
+    register_op(f"c_reduce_{_s}", infer=same_as_input("X", "Out"),
+                lower=(lambda ctx, op, _r=_r: ctx.set_output(
+                    op, "Out",
+                    ctx.get_input(op, "X") if _axis_name(ctx, op) is None
+                    else _r(ctx.get_input(op, "X"), _axis_name(ctx, op)))),
+                grad="auto")
+
+
+@register_op("c_broadcast", infer=same_as_input("X", "Out"), grad="auto")
+def _c_broadcast(ctx, op):
+    """Root's value to all: implemented as select(root)+psum so it stays a
+    single ICI collective."""
+    import jax.lax as lax
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    axis = _axis_name(ctx, op)
+    if axis is None:
+        ctx.set_output(op, "Out", x)
+        return
+    root = op.attr("root", 0)
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    ctx.set_output(op, "Out", lax.psum(masked, axis))
+
+
+def _allgather_infer(op, block):
+    x = in_var(op, block, "X")
+    n = op.attr("nranks", 1)
+    shape = list(x.shape)
+    shape[0] = shape[0] * n if shape[0] != -1 else -1
+    set_out(op, block, "Out", shape, x.dtype)
+
+
+@register_op("c_allgather", infer=_allgather_infer, grad="auto")
+def _c_allgather(ctx, op):
+    import jax.lax as lax
+    x = ctx.get_input(op, "X")
+    axis = _axis_name(ctx, op)
+    if axis is None:
+        ctx.set_output(op, "Out", x)
+        return
+    out = lax.all_gather(x, axis, tiled=True)
+    ctx.set_output(op, "Out", out)
+
+
+def _reducescatter_infer(op, block):
+    x = in_var(op, block, "X")
+    n = op.attr("nranks", 1)
+    shape = list(x.shape)
+    if shape[0] != -1:
+        assert shape[0] % n == 0, \
+            f"c_reducescatter: dim0 {shape[0]} not divisible by {n}"
+        shape[0] //= n
+    set_out(op, block, "Out", shape, x.dtype)
+
+
+@register_op("c_reducescatter", infer=_reducescatter_infer, grad="auto")
+def _c_reducescatter(ctx, op):
+    import jax.lax as lax
+    x = ctx.get_input(op, "X")
+    axis = _axis_name(ctx, op)
+    if axis is None:
+        ctx.set_output(op, "Out", x)
+        return
+    ctx.set_output(op, "Out", lax.psum_scatter(x, axis, tiled=True))
+
+
+@register_op("c_concat", infer=lambda op, block: set_out(
+    op, block, "Out",
+    [in_var(op, block, "X").shape[0],
+     in_var(op, block, "X").shape[-1] * op.attr("nranks", 1)]
+    if len(in_var(op, block, "X").shape) == 2
+    else list(in_var(op, block, "X").shape),
+    in_var(op, block, "X").dtype), grad="auto")
+def _c_concat(ctx, op):
+    """Gather along the last dim (model-parallel activation gather)."""
+    import jax.lax as lax
+    x = ctx.get_input(op, "X")
+    axis = _axis_name(ctx, op)
+    if axis is None:
+        ctx.set_output(op, "Out", x)
+        return
+    ndim = x.ndim
+    ctx.set_output(op, "Out",
+                   lax.all_gather(x, axis, axis=ndim - 1, tiled=True))
+
+
+def _c_split_infer(op, block):
+    x = in_var(op, block, "X")
+    n = op.attr("nranks", 1)
+    shape = list(x.shape)
+    if shape[-1] != -1:
+        shape[-1] //= n
+    set_out(op, block, "Out", shape, x.dtype)
+
+
+@register_op("c_split", infer=_c_split_infer, grad="auto")
+def _c_split(ctx, op):
+    """Keep this rank's last-dim slice (model-parallel activation split)."""
+    import jax.lax as lax
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    axis = _axis_name(ctx, op)
+    if axis is None:
+        ctx.set_output(op, "Out", x)
+        return
+    n = _group_size(ctx, op)
+    idx = lax.axis_index(axis)
+    piece = x.shape[-1] // n
+    out = lax.dynamic_slice_in_dim(x, idx * piece, piece, axis=x.ndim - 1)
+    ctx.set_output(op, "Out", out)
+
+
+@register_op("c_identity", infer=same_as_input("X", "Out"), grad="auto")
+def _c_identity(ctx, op):
+    ctx.set_output(op, "Out", ctx.get_input(op, "X"))
+
+
+@register_op("send_v2", infer=lambda op, block: None, grad=None)
+def _send_v2(ctx, op):
+    """Point-to-point send: paired with recv_v2 as a ppermute in the SPMD
+    program (pipeline stage boundary). The SPMD lowering fuses matched
+    send/recv pairs; a lone send lowers to nothing."""
+    # value forwarded through ctx for the matching recv
+    x = ctx.get_input(op, "X")
+    peer = op.attr("peer", 0)
+    ctx.env[f"__p2p_{op.attr('ring_id', 0)}_{peer}"] = x
+
+
+def _recv_v2_infer(op, block):
+    shape = op.attr("out_shape", [1])
+    set_out(op, block, "Out", shape, op.attr("dtype", "float32"))
+
+
+@register_op("recv_v2", infer=_recv_v2_infer, grad=None)
+def _recv_v2(ctx, op):
+    import jax.lax as lax
+    jnp = _jnp()
+    axis = _axis_name(ctx, op)
+    key = f"__p2p_{op.attr('ring_id', 0)}_{op.attr('peer', 0)}"
+    # single-program pipeline: value was produced by the paired send
+    if key in ctx.env:
+        x = ctx.env[key]
+        if axis is not None:
+            n = _group_size(ctx, op)
+            x = lax.ppermute(x, axis,
+                             [(i, (i + 1) % n) for i in range(n)])
+        ctx.set_output(op, "Out", x)
+        return
+    shape = op.attr("out_shape", [1])
+    from ..framework.core import dtype_to_np
+    ctx.set_output(op, "Out",
+                   jnp.zeros(shape, dtype_to_np(op.attr("dtype",
+                                                        "float32"))))
+
+
+# -- bootstrap / sync ops: structural no-ops under XLA ----------------------
+
+def _noop_infer(op, block):
+    for slot in list(op.outputs):
+        for name in op.output(slot):
+            v = block._find_var_recursive(name)
+            if v is not None and v.shape is None:
+                v.shape, v.dtype = (1,), "int32"
+
+
+def _register_noop(op_type):
+    @register_op(op_type, infer=_noop_infer, grad=None)
+    def _lower(ctx, op):
+        jnp = _jnp()
+        for slot in list(op.outputs):
+            for name in op.output(slot):
+                if name and name not in ctx.env:
+                    ctx.env[name] = jnp.zeros((1,), "int32")
+
+
+for _t in ("c_gen_nccl_id", "c_comm_init", "c_comm_init_all",
+           "c_sync_calc_stream", "c_sync_comm_stream", "barrier",
+           "c_wait_comm", "c_wait_compute"):
+    _register_noop(_t)

@@ -151,6 +151,12 @@ def all_registered_ops() -> List[str]:
 _OP_SEED = [0]
 
 
+def reset_op_seed(value: int = 0):
+    """Reset the per-op randomness counter (test isolation / building two
+    programs that must draw identical init randomness)."""
+    _OP_SEED[0] = value
+
+
 def infer_op_shape(op: Operator, block: Block):
     _OP_SEED[0] += 1
     op.attrs.setdefault("__op_seed__", _OP_SEED[0])

@@ -38,6 +38,8 @@ def _fresh_programs():
     ex._global_scope = ex.Scope()
     ex._scope_stack[:] = [ex._global_scope]
     np.random.seed(0)
+    from paddle_tpu.ops.registry import reset_op_seed
+    reset_op_seed()
     yield
     core.switch_main_program(prev_m)
     core.switch_startup_program(prev_s)

@@ -104,7 +104,7 @@ def test_mnist_lenet_learns_synthetic():
     img = fluid.data(name="img", shape=[1, 28, 28], dtype="float32")
     label = fluid.data(name="label", shape=[1], dtype="int64")
     loss, acc = _lenet(img, label)
-    opt = Momentum(learning_rate=0.05, momentum=0.9)
+    opt = Momentum(learning_rate=0.01, momentum=0.9)
     opt.minimize(loss)
 
     exe = fluid.Executor(pt.TPUPlace())
@@ -118,7 +118,7 @@ def test_mnist_lenet_learns_synthetic():
         return xs.astype("float32"), ys.astype("int64").reshape(bs, 1)
 
     first, last = None, None
-    for i in range(30):
+    for i in range(40):
         xs, ys = batch()
         lv, av = exe.run(feed={"img": xs, "label": ys},
                          fetch_list=[loss, acc])
