@@ -26,6 +26,7 @@ from . import dygraph  # noqa
 from .framework.compiler import (CompiledProgram, BuildStrategy,  # noqa
                                  ExecutionStrategy, ParallelExecutor)
 from . import distributed  # noqa
+from . import contrib  # noqa
 
 __version__ = "0.1.0"
 

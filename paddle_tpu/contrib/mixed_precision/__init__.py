@@ -1,0 +1,4 @@
+"""Static-graph automatic mixed precision
+(reference python/paddle/fluid/contrib/mixed_precision/)."""
+from .fp16_lists import AutoMixedPrecisionLists  # noqa
+from .decorator import OptimizerWithMixedPrecision, decorate  # noqa

@@ -1,0 +1,120 @@
+"""OptimizerWithMixedPrecision.
+
+Reference: contrib/mixed_precision/decorator.py:30,235 — wraps an optimizer
+so that minimize() = scale loss -> backward -> check_finite_and_unscale ->
+update_loss_scaling -> (conditionally) apply gradients.
+
+TPU deltas vs reference:
+  * compute autocast happens at lowering time (program._amp_lowering; see
+    ops/registry._lower_with_amp) instead of a ProgramDesc rewrite — fp32
+    master weights fall out naturally since scope params stay fp32;
+  * bf16 (TPU-native, default) needs no loss scaling: same exponent range
+    as fp32 — use_dynamic_loss_scaling only engages for float16;
+  * the "skip update on inf" is realized by zeroing non-finite grads in
+    update_loss_scaling (optimizer ops still run; a zero-grad adam step
+    only advances beta-pow state) rather than a conditional block.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.core import OpRole, default_main_program
+from ...framework.layer_helper import LayerHelper
+from ...layers import tensor as T
+from .fp16_lists import AutoMixedPrecisionLists
+
+
+class OptimizerWithMixedPrecision:
+    def __init__(self, optimizer, amp_lists=None,
+                 init_loss_scaling=2.0 ** 15,
+                 use_dynamic_loss_scaling=True,
+                 incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                 incr_ratio=2.0, decr_ratio=0.5, dtype="bfloat16"):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._dtype = dtype
+        # bf16 has fp32's exponent range: scaling is pointless
+        self._use_scaling = use_dynamic_loss_scaling and dtype == "float16"
+        self._init_loss_scaling = init_loss_scaling if self._use_scaling \
+            else 1.0
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._loss_scaling = None
+
+    def get_loss_scaling(self):
+        return self._loss_scaling
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        program = loss.block.program
+        program._amp_lowering = {
+            "dtype": self._dtype,
+            "white": self._amp_lists.white_list,
+            "black": self._amp_lists.black_list,
+        }
+        self._loss_scaling = T.create_global_var(
+            [1], self._init_loss_scaling, "float32", persistable=True,
+            name="loss_scaling_0")
+        if self._use_scaling:
+            from ... import layers
+            scaled = layers.elementwise_mul(loss, self._loss_scaling)
+        else:
+            scaled = loss
+        params_grads = self._optimizer.backward(
+            scaled, startup_program, parameter_list, no_grad_set, callbacks)
+        return params_grads
+
+    def apply_gradients(self, params_grads):
+        if self._use_scaling:
+            params_grads = self._unscale_and_update_scaling(params_grads)
+        return self._optimizer.apply_gradients(params_grads)
+
+    def _unscale_and_update_scaling(self, params_grads):
+        helper = LayerHelper("amp_check")
+        grads = [g for _, g in params_grads if g is not None]
+        found_inf = helper.create_variable_for_type_inference("bool")
+        helper.append_op(
+            "check_finite_and_unscale",
+            inputs={"X": grads, "Scale": [self._loss_scaling]},
+            outputs={"Out": grads, "FoundInfinite": [found_inf]},
+            attrs={"op_role": OpRole.Backward})
+        good = T.create_global_var([1], 0, "int32", persistable=True,
+                                   name="loss_scaling_good_0")
+        bad = T.create_global_var([1], 0, "int32", persistable=True,
+                                  name="loss_scaling_bad_0")
+        helper.append_op(
+            "update_loss_scaling",
+            inputs={"X": grads, "FoundInfinite": [found_inf],
+                    "PrevLossScaling": [self._loss_scaling],
+                    "InGoodSteps": [good], "InBadSteps": [bad]},
+            outputs={"Out": grads, "LossScaling": [self._loss_scaling],
+                     "OutGoodSteps": [good], "OutBadSteps": [bad]},
+            attrs={"incr_every_n_steps": self._incr_every_n_steps,
+                   "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+                   "incr_ratio": self._incr_ratio,
+                   "decr_ratio": self._decr_ratio,
+                   "op_role": OpRole.Backward})
+        return params_grads
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        opt_ops = self.apply_gradients(params_grads)
+        return opt_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_optimizer"], item)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.5,
+             use_dynamic_loss_scaling=True, dtype="bfloat16"):
+    """reference mixed_precision.decorate:235."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
+        incr_every_n_steps, decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+        dtype=dtype)

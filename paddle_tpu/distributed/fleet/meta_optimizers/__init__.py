@@ -8,11 +8,26 @@ from .meta_optimizer_base import MetaOptimizerBase  # noqa
 from .graph_execution_optimizer import GraphExecutionOptimizer  # noqa
 from .lamb_optimizer import LambOptimizer  # noqa
 from .lars_optimizer import LarsOptimizer  # noqa
+from .amp_optimizer import AMPOptimizer  # noqa
+from .dgc_optimizer import DGCOptimizer  # noqa
+from .recompute_optimizer import RecomputeOptimizer  # noqa
+from .gradient_merge_optimizer import GradientMergeOptimizer  # noqa
+from .localsgd_optimizer import LocalSGDOptimizer  # noqa
+from .sharding_optimizer import ShardingOptimizer  # noqa
 
 META_OPTIMIZER_CLASSES = [
     # inner-most applied first; order mirrors the reference ranking
+    # (fleet_base.py:1019-1061): optimizer swaps, then backward-shaping
+    # (amp/recompute), then update-shaping (gradient merge / localsgd),
+    # then communication (dgc/sharding/graph execution)
     LambOptimizer,
     LarsOptimizer,
+    DGCOptimizer,
+    AMPOptimizer,
+    RecomputeOptimizer,
+    GradientMergeOptimizer,
+    LocalSGDOptimizer,
+    ShardingOptimizer,
     GraphExecutionOptimizer,
 ]
 

@@ -1,0 +1,50 @@
+"""Reference: distributed/fleet/meta_optimizers/amp_optimizer.py — apply
+mixed precision per strategy.amp_configs."""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class AMPOptimizer(MetaOptimizerBase):
+    strategy_flag = "amp"
+
+    # expose backward/apply_gradients so outer meta optimizers (gradient
+    # merge, localsgd) compose with the decorated optimizer
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._decorated().backward(loss, startup_program,
+                                          parameter_list, no_grad_set,
+                                          callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._decorated().apply_gradients(params_grads)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        return self._decorated().minimize(loss, startup_program,
+                                          parameter_list, no_grad_set)
+
+    def _decorated(self):
+        cached = getattr(self, "_dec", None)
+        if cached is not None:
+            return cached
+        from ....contrib.mixed_precision import (AutoMixedPrecisionLists,
+                                                 decorate)
+        cfg = self.user_defined_strategy.amp_configs
+        lists = AutoMixedPrecisionLists(
+            custom_white_list=cfg.get("custom_white_list"),
+            custom_black_list=cfg.get("custom_black_list"))
+        # TPU default is bf16; float16 engages dynamic loss scaling
+        dtype = "float16" if cfg.get("use_fp16", False) else "bfloat16"
+        dec = decorate(
+            self.inner_opt, lists,
+            init_loss_scaling=cfg.get("init_loss_scaling", 2.0 ** 15),
+            incr_every_n_steps=cfg.get("incr_every_n_steps", 1000),
+            decr_every_n_nan_or_inf=cfg.get("decr_every_n_nan_or_inf", 2),
+            incr_ratio=cfg.get("incr_ratio", 2.0),
+            decr_ratio=cfg.get("decr_ratio", 0.5),
+            use_dynamic_loss_scaling=cfg.get("use_dynamic_loss_scaling",
+                                             True),
+            dtype=dtype)
+        self._dec = dec
+        return dec

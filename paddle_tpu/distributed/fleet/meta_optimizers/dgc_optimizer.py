@@ -1,0 +1,33 @@
+"""Reference: distributed/fleet/meta_optimizers/dgc_optimizer.py — swap
+Momentum for DGCMomentum when strategy.dgc is on."""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class DGCOptimizer(MetaOptimizerBase):
+    strategy_flag = "dgc"
+
+    def _can_apply(self):
+        from ....optimizer import MomentumOptimizer
+        return bool(self.user_defined_strategy.dgc) and \
+            isinstance(self.user_defined_optimizer, MomentumOptimizer)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        from ....optimizer import DGCMomentumOptimizer
+        cfg = self.user_defined_strategy.dgc_configs
+        inner = self.user_defined_optimizer
+        dgc = DGCMomentumOptimizer(
+            learning_rate=inner._learning_rate,
+            momentum=getattr(inner, "_momentum", 0.9),
+            rampup_begin_step=cfg.get("rampup_begin_step", 0),
+            rampup_step=cfg.get("rampup_step", 1),
+            sparsity=cfg.get("sparsity", [0.999]),
+            use_nesterov=getattr(inner, "_use_nesterov", False),
+            num_trainers=self.role_maker.worker_num(),
+            parameter_list=inner._parameter_list,
+            regularization=inner.regularization,
+            grad_clip=inner._grad_clip)
+        return dgc.minimize(loss, startup_program, parameter_list,
+                            no_grad_set)

@@ -1,0 +1,22 @@
+"""Reference: distributed/fleet/meta_optimizers/gradient_merge_optimizer.py."""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    strategy_flag = "gradient_merge"
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.gradient_merge) and \
+            self.user_defined_strategy.gradient_merge_configs.get(
+                "k_steps", 1) > 1
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        from ....optimizer import GradientMergeOptimizer as GM
+        cfg = self.user_defined_strategy.gradient_merge_configs
+        gm = GM(self.inner_opt, k_steps=cfg.get("k_steps", 1),
+                avg=cfg.get("avg", True))
+        return gm.minimize(loss, startup_program, parameter_list,
+                           no_grad_set)

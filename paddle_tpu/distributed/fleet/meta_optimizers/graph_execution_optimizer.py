@@ -21,6 +21,10 @@ class GraphExecutionOptimizer(MetaOptimizerBase):
     strategy_flag = "_collective_dp"  # applied by default in collective mode
 
     def _can_apply(self):
+        s = self.user_defined_strategy
+        # strategies that own their own communication pattern
+        if s.localsgd or s.sharding or s.dgc or s.a_sync:
+            return False
         return self.role_maker is not None and \
             self.role_maker.worker_num() > 1
 
@@ -33,7 +37,9 @@ class GraphExecutionOptimizer(MetaOptimizerBase):
             loss, startup_program, parameter_list, no_grad_set)
         nranks = self.role_maker.worker_num()
         main = loss.block.program
-        self._insert_allreduce(main, params_grads, nranks)
+        fp16_ar = bool(self.user_defined_strategy.fp16_allreduce)
+        self._insert_allreduce(main, params_grads, nranks,
+                               fp16_allreduce=fp16_ar)
         self._init_communicator(startup_program)
         main.bump()
         return opt_ops, params_grads
@@ -50,7 +56,11 @@ class GraphExecutionOptimizer(MetaOptimizerBase):
                         attrs={"ring_id": 0})
 
     @staticmethod
-    def _insert_allreduce(main, params_grads, nranks):
+    def _insert_allreduce(main, params_grads, nranks,
+                          fp16_allreduce=False):
+        """fp16_allreduce (reference fp16_allreduce_optimizer.py):
+        compress the wire format of the allreduce — here a bf16 cast pair
+        around the collective (bf16 is the TPU-native half type)."""
         block = main.global_block()
         grad_names = {g.name for _, g in params_grads if g is not None}
         # first optimize-role op = end of backward
@@ -65,9 +75,24 @@ class GraphExecutionOptimizer(MetaOptimizerBase):
             block._insert_op(
                 insert_at, "scale", inputs={"X": [g]}, outputs={"Out": [g]},
                 attrs={"scale": 1.0 / nranks, "op_role": OpRole.Backward})
+            insert_at += 1
+            if fp16_allreduce:
+                block._insert_op(
+                    insert_at, "cast", inputs={"X": [g]},
+                    outputs={"Out": [g]},
+                    attrs={"out_dtype": "bfloat16",
+                           "op_role": OpRole.Backward}, infer_shape=False)
+                insert_at += 1
             block._insert_op(
-                insert_at + 1, "c_allreduce_sum",
+                insert_at, "c_allreduce_sum",
                 inputs={"X": [g]}, outputs={"Out": [g]},
                 attrs={"ring_id": 0, "op_role": OpRole.Backward})
-            insert_at += 2
+            insert_at += 1
+            if fp16_allreduce:
+                block._insert_op(
+                    insert_at, "cast", inputs={"X": [g]},
+                    outputs={"Out": [g]},
+                    attrs={"out_dtype": "float32",
+                           "op_role": OpRole.Backward}, infer_shape=False)
+                insert_at += 1
         return grad_names

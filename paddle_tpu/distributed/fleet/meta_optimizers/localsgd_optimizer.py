@@ -1,0 +1,57 @@
+"""LocalSGD: k local steps, then average parameters across workers.
+
+Reference: distributed/fleet/meta_optimizers/localsgd_optimizer.py and
+transpiler/collective.py:270 (LocalSGD transpiler) — no per-step gradient
+allreduce; every k steps the params are synchronized. Here the periodic
+sync is a conditional block whose c_allreduce_sum+scale lower to one
+lax.cond-guarded psum over the dp axis.
+"""
+from __future__ import annotations
+
+from ....framework.core import OpRole, unique_name
+from ....framework.layer_helper import LayerHelper
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class LocalSGDOptimizer(MetaOptimizerBase):
+    strategy_flag = "localsgd"
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        from ....layers import tensor as T
+        opt_ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        cfg = self.user_defined_strategy.localsgd_configs
+        k = int(cfg.get("k_steps", 1))
+        nranks = self.role_maker.worker_num()
+        main = loss.block.program
+        block = main.global_block()
+        helper = LayerHelper("localsgd")
+
+        step = T.create_global_var([1], 0.0, "float32", persistable=True,
+                                   name=unique_name("localsgd_step"))
+        T.increment(step, 1.0)
+        mod = T.elementwise_mod(step, T.fill_constant([1], "float32",
+                                                      float(k)))
+        cond_var = T.equal(mod, T.fill_constant([1], "float32", 0.0))
+
+        sub = main._create_block()
+        params = [p for p, _ in params_grads]
+        for p in params:
+            helper.append_op("c_allreduce_sum", inputs={"X": [p]},
+                             outputs={"Out": [p]},
+                             attrs={"ring_id": 0,
+                                    "op_role": OpRole.Optimize})
+            helper.append_op("scale", inputs={"X": [p]},
+                             outputs={"Out": [p]},
+                             attrs={"scale": 1.0 / nranks,
+                                    "op_role": OpRole.Optimize})
+        main._rollback()
+        block.append_op("conditional_block",
+                        inputs={"Cond": [cond_var]},
+                        outputs={"Out": params},
+                        attrs={"sub_block": sub.idx,
+                               "op_role": OpRole.Optimize},
+                        infer_shape=False)
+        main.bump()
+        return opt_ops, params_grads

@@ -1,0 +1,31 @@
+"""Reference: distributed/fleet/meta_optimizers/recompute_optimizer.py."""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class RecomputeOptimizer(MetaOptimizerBase):
+    strategy_flag = "recompute"
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.recompute) and \
+            bool(self.user_defined_strategy.recompute_configs.get(
+                "checkpoints"))
+
+    def _wrapped(self):
+        from ....optimizer import RecomputeOptimizer as Recompute
+        cfg = self.user_defined_strategy.recompute_configs
+        rec = Recompute(self.inner_opt)
+        rec._set_checkpoints(list(cfg["checkpoints"]))
+        return rec
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._wrapped().backward(loss, startup_program,
+                                        parameter_list, no_grad_set,
+                                        callbacks)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        return self._wrapped().minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)

@@ -13,3 +13,5 @@ from .parallel import (DataParallel, ParallelEnv, prepare_context,  # noqa
                        ParallelStrategy)
 from .jit import declarative, dygraph_to_static_func, TracedLayer  # noqa
 from .checkpoint import save_dygraph, load_dygraph  # noqa
+from . import amp  # noqa
+from .amp import amp_guard, auto_cast, GradScaler  # noqa

@@ -1,0 +1,101 @@
+"""Dygraph AMP: auto_cast context + GradScaler.
+
+Reference: fluid/dygraph/amp/{auto_cast,loss_scaler}.py and the C++
+autocast in imperative/amp_auto_cast.cc (AutoCastInputs on TraceOp).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ..contrib.mixed_precision.fp16_lists import AutoMixedPrecisionLists
+from ..framework.core import _dygraph_tracer
+
+
+@contextlib.contextmanager
+def amp_guard(enable=True, custom_white_list=None, custom_black_list=None,
+              dtype="bfloat16"):
+    """Autocast region: white-list ops trace in low precision."""
+    tracer = _dygraph_tracer()
+    if tracer is None:
+        raise RuntimeError("amp_guard outside dygraph guard")
+    prev = getattr(tracer, "_amp", None)
+    if enable:
+        lists = AutoMixedPrecisionLists(custom_white_list,
+                                        custom_black_list)
+        tracer._amp = {"dtype": dtype, "white": lists.white_list,
+                       "black": lists.black_list}
+    else:
+        tracer._amp = None
+    try:
+        yield
+    finally:
+        tracer._amp = prev
+
+
+auto_cast = amp_guard
+
+
+class GradScaler:
+    """Dynamic loss scaling for float16 dygraph training
+    (reference dygraph/amp/loss_scaler.py AmpScaler). With bf16 (the TPU
+    default) scaling is unnecessary; enable only for fp16."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good, self._bad = 0, 0
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        from .. import layers
+        return layers.scale(loss, scale=self._scale)
+
+    def minimize(self, optimizer, scaled_loss):
+        params_grads = optimizer._dygraph_params_grads()
+        if not self._enable:
+            optimizer._dygraph_apply(params_grads)
+            return
+        found_inf = False
+        unscaled = []
+        for p, g in params_grads:
+            arr = np.asarray(g, dtype=np.float32) / self._scale
+            if not np.all(np.isfinite(arr)):
+                found_inf = True
+            unscaled.append((p, arr))
+        if not found_inf:
+            optimizer._dygraph_apply(unscaled)
+        self._update(found_inf)
+
+    step = minimize
+
+    def _update(self, found_inf):
+        if not self._dynamic:
+            return
+        if found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1e-8)
+                self._bad = 0
+        else:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def get_scale(self):
+        return self._scale

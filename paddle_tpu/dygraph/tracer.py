@@ -68,6 +68,7 @@ class Tracer:
         self._train_mode = True
         self._op_counter = itertools.count()
         self._seed = seed
+        self._amp = None  # set by dygraph.amp.amp_guard
 
     # ------------------------------------------------------------------
     def trace_op(self, type: str, inputs: Dict[str, Any],
@@ -112,7 +113,8 @@ class Tracer:
         def fn(*in_vals):
             env = dict(zip(in_names, in_vals))
             ctx = LowerContext(block, env, base_key=base_key,
-                               is_test=not self._train_mode)
+                               is_test=not self._train_mode,
+                               amp=self._amp)
             lower_op(ctx, op)
             return tuple(env[n] for n in out_names)
 

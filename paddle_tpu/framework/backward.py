@@ -76,10 +76,11 @@ def append_backward(loss: Variable,
                if op.attr("op_role", OpRole.Forward) in
                (OpRole.Forward, OpRole.Forward | OpRole.Loss)]
     grads_available: Set[str] = {loss.name}
-    emitted: List[dict] = []
+    emitted: List[Tuple[int, dict]] = []  # (fwd_ops position, spec)
     helper = GradHelper(block, no_grad)
 
-    for op in reversed(fwd_ops):
+    for pos in range(len(fwd_ops) - 1, -1, -1):
+        op = fwd_ops[pos]
         if not any(o in grads_available for o in op.output_arg_names()):
             continue
         opdef = get_op_def(op.type)
@@ -93,16 +94,19 @@ def append_backward(loss: Variable,
             spec["attrs"]["op_role"] = OpRole.Backward
             spec["attrs"]["__accumulate__"] = True
             ensure_grad_op_registered(op.type)
-            emitted.append(spec)
+            emitted.append((pos, spec))
         for slot, names in op.inputs.items():
             for n in names:
                 v = block._find_var_recursive(n)
                 if v is not None and not v.stop_gradient and n not in no_grad:
                     grads_available.add(n)
 
-    for spec in emitted:
-        block.append_op(spec["type"], inputs=spec["inputs"],
-                        outputs=spec["outputs"], attrs=spec["attrs"])
+    if checkpoints:
+        _emit_with_recompute(block, fwd_ops, emitted, checkpoints)
+    else:
+        for _, spec in emitted:
+            block.append_op(spec["type"], inputs=spec["inputs"],
+                            outputs=spec["outputs"], attrs=spec["attrs"])
 
     # 3. collect (param, grad) pairs
     if parameter_list is not None:
@@ -122,6 +126,114 @@ def append_backward(loss: Variable,
             params_grads.append((p, g))
     program.bump()
     return params_grads
+
+
+def _emit_with_recompute(block: Block, fwd_ops, emitted, checkpoints):
+    """Segmented (recompute/checkpoint) backward emission.
+
+    Reference: _append_backward_ops_with_checkpoints_
+    (python/paddle/fluid/backward.py:689): the forward is cut into
+    segments at checkpoint vars; before each segment's grad ops, its
+    forward ops are RE-EMITTED with renamed internal outputs, so grad ops
+    consume recomputed activations. XLA then dead-code-eliminates the
+    original intermediates: only checkpoints (and cross-segment vars)
+    stay live across the forward — activation memory ~ sqrt-depth.
+
+    Renaming rules:
+      * internal, non-persistable outputs of a segment -> name@RC<k>
+      * grad (@GRAD) names are NEVER renamed — cotangent plumbing spans
+        segments through the original names
+      * persistable / checkpoint outputs of re-emitted ops -> discarded
+        dummies (no double state update)
+    """
+    ckpt_names = [c.name if isinstance(c, Variable) else str(c)
+                  for c in checkpoints]
+    ckpt_set = set(ckpt_names)
+
+    producer_pos = {}
+    for pos, op in enumerate(fwd_ops):
+        for n in op.output_arg_names():
+            producer_pos.setdefault(n, pos)
+    boundaries = sorted({producer_pos[c] for c in ckpt_names
+                         if c in producer_pos})
+    # segments as [start, end] inclusive position ranges
+    segments = []
+    start = 0
+    for b in boundaries:
+        segments.append((start, b))
+        start = b + 1
+    if start < len(fwd_ops):
+        segments.append((start, len(fwd_ops) - 1))
+
+    specs_by_pos: Dict[int, List[dict]] = {}
+    for pos, spec in emitted:
+        specs_by_pos.setdefault(pos, []).append(spec)
+
+    def _rename_values(names, rmap):
+        return [rmap.get(n, n) if "@GRAD" not in n else n for n in names]
+
+    dummy_count = [0]
+    for k in range(len(segments) - 1, -1, -1):
+        s, e = segments[k]
+        seg_ops = fwd_ops[s:e + 1]
+        # build rename map for this segment's internal outputs
+        rmap: Dict[str, str] = {}
+        for op in seg_ops:
+            for n in op.output_arg_names():
+                if not n or n in ckpt_set or n in rmap:
+                    continue
+                v = block._find_var_recursive(n)
+                if v is not None and v.persistable:
+                    continue
+                rmap[n] = f"{n}@RC{k}"
+        if not any(specs_by_pos.get(p) for p in range(s, e + 1)):
+            continue  # nothing in this segment needs grads
+        # 2a. re-emit forward ops with renamed outputs/internal inputs
+        for op in seg_ops:
+            new_inputs = {slot: _rename_values(ns, rmap)
+                          for slot, ns in op.inputs.items()}
+            new_outputs = {}
+            for slot, ns in op.outputs.items():
+                outs = []
+                for n in ns:
+                    if n in rmap:
+                        outs.append(rmap[n])
+                    elif n:
+                        dummy_count[0] += 1
+                        outs.append(f"{n}@RC_DISCARD{dummy_count[0]}")
+                    else:
+                        outs.append(n)
+                new_outputs[slot] = outs
+            attrs = dict(op.attrs)
+            attrs["op_role"] = OpRole.Backward
+            block.append_op(op.type, inputs=new_inputs,
+                            outputs=new_outputs, attrs=attrs,
+                            infer_shape=False)
+            # register renamed vars' metadata for later shape queries
+            for slot, ns in op.outputs.items():
+                for n, rn in zip(ns, new_outputs[slot]):
+                    if n and rn != n:
+                        src = block._find_var_recursive(n)
+                        nv = block.create_var(name=rn)
+                        if src is not None:
+                            nv.shape, nv.dtype = src.shape, src.dtype
+                            nv.stop_gradient = src.stop_gradient
+        # 2b. grad ops of this segment (already reverse-ordered in
+        # `emitted`), with value references renamed
+        for pos, spec in emitted:
+            if not (s <= pos <= e):
+                continue
+            inputs = {slot: _rename_values(ns, rmap)
+                      for slot, ns in spec["inputs"].items()}
+            attrs = dict(spec["attrs"])
+            if "__fwd_inputs__" in attrs:
+                attrs["__fwd_inputs__"] = {
+                    slot: _rename_values(ns, rmap)
+                    for slot, ns in attrs["__fwd_inputs__"].items()}
+            # __fwd_outputs__ stays original: cotangents are looked up by
+            # grad_var_name(<original fwd output>)
+            block.append_op(spec["type"], inputs=inputs,
+                            outputs=spec["outputs"], attrs=attrs)
 
 
 def _written_names(block: Block) -> Set[str]:

@@ -134,15 +134,26 @@ class CompiledProgram:
 
         n = len(self._places) if self._places else len(jax.devices())
         mesh = dp_mesh(n)
-        has_collectives = any(
-            op.type.startswith(("c_", "send_v2", "recv_v2", "barrier"))
-            for op in self._program.global_block().ops)
-        if has_collectives:
+
+        def _has_collective(blk):
+            return any(
+                op.type.startswith(("c_", "send_v2", "recv_v2", "barrier"))
+                or any(op.attr(k) is not None and _has_collective(
+                       self._program.block(op.attr(k)))
+                       for k in ("sub_block", "true_block", "false_block"))
+                for op in blk.ops)
+
+        if _has_collective(self._program.global_block()):
             fn, mut_in, const_in, extra = build_spmd_step(
                 self._program, feed_names, fetch_names, mesh)
             return fn, mut_in, const_in, mesh, "spmd"
+        rules = None
+        if getattr(self._program, "_zero_sharding", None):
+            from ..distributed.fleet.meta_optimizers.sharding_optimizer \
+                import zero_sharding_rules
+            rules = zero_sharding_rules(mesh)
         fn, mut_in, const_in, extra = build_sharded_step(
-            self._program, feed_names, fetch_names, mesh)
+            self._program, feed_names, fetch_names, mesh, rules=rules)
         return fn, mut_in, const_in, mesh, "gspmd"
 
 

@@ -104,6 +104,26 @@ class scope_guard:
 # Block analysis: classify vars into feed / state-in / state-out / temps
 # ---------------------------------------------------------------------------
 
+def _op_io(op, block):
+    """Effective (reads, writes) of an op, descending into control-flow
+    sub-blocks (conditional_block / cond2 / while) so state read only
+    inside a branch/loop still threads through the compiled step."""
+    reads = list(op.input_arg_names())
+    writes = list(op.output_arg_names())
+    prog = block.program
+    for key in ("sub_block", "true_block", "false_block"):
+        idx = op.attr(key, None)
+        if idx is None:
+            continue
+        sub = prog.block(idx)
+        sub_written: set = set()
+        for o in sub.ops:
+            r, w = _op_io(o, sub)
+            reads.extend(n for n in r if n not in sub_written)
+            sub_written.update(w)
+    return reads, writes
+
+
 def analyze_block(block: Block, feed_names: Sequence[str]):
     """Returns (state_in, state_out): persistable vars the compiled function
     must consume from / produce back into the scope."""
@@ -113,7 +133,8 @@ def analyze_block(block: Block, feed_names: Sequence[str]):
     seen_in: set = set(feed_names)
     seen_out: set = set()
     for op in block.ops:
-        for name in op.input_arg_names():
+        op_reads, _ = _op_io(op, block)
+        for name in op_reads:
             if name in seen_in or name in written or not name:
                 continue
             v = block._find_var_recursive(name)
@@ -139,7 +160,8 @@ def analyze_block(block: Block, feed_names: Sequence[str]):
 def lower_block(block: Block, env: Dict[str, Any], base_key,
                 is_test: bool = False, mesh=None) -> LowerContext:
     ctx = LowerContext(block, env, base_key=base_key, is_test=is_test,
-                       mesh=mesh)
+                       mesh=mesh,
+                       amp=getattr(block.program, "_amp_lowering", None))
     for op in block.ops:
         if op.type in ("feed", "fetch"):
             continue

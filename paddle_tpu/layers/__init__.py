@@ -5,6 +5,8 @@ from .tensor import *  # noqa
 from .loss import *  # noqa
 from .metric_op import accuracy, auc  # noqa
 from . import collective  # noqa
+from .control_flow import cond, While, Switch  # noqa
+from . import control_flow  # noqa
 from . import nn  # noqa
 from . import tensor  # noqa
 from . import loss  # noqa

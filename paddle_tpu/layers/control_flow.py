@@ -1,0 +1,189 @@
+"""Control-flow layers (reference fluid/layers/control_flow.py: cond,
+While, Switch, increment...).
+
+TPU-first: `cond` builds one two-branch op lowered to a single lax.cond
+(the reference builds two conditional_block ops + select_input merges);
+`While` builds the while op lowered to lax.while_loop. Static shapes
+required on all carries — the XLA contract.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..framework.core import Variable, default_main_program
+from ..framework.layer_helper import LayerHelper
+
+__all__ = ["cond", "While", "Switch", "increment", "array_write",
+           "array_read", "array_length"]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """reference layers.cond (fluid/layers/control_flow.py): functional
+    two-branch conditional; both branches must return matching
+    shapes/dtypes."""
+    helper = LayerHelper("cond", name=name)
+    main = helper.main_program
+
+    true_blk = main._create_block()
+    true_outs = _as_list(true_fn() if true_fn else None)
+    main._rollback()
+
+    false_blk = main._create_block()
+    false_outs = _as_list(false_fn() if false_fn else None)
+    main._rollback()
+
+    if len(true_outs) != len(false_outs):
+        raise ValueError(
+            f"cond: branch arity mismatch {len(true_outs)} vs "
+            f"{len(false_outs)}")
+    results = []
+    for t, f in zip(true_outs, false_outs):
+        if tuple(t.shape) != tuple(f.shape) or t.dtype != f.dtype:
+            raise ValueError(
+                f"cond: branch output mismatch {t.shape}/{t.dtype} vs "
+                f"{f.shape}/{f.dtype}")
+        r = main.current_block().create_var(
+            name=helper.name + f".out_{len(results)}", shape=t.shape,
+            dtype=t.dtype)
+        results.append(r)
+    main.current_block().append_op(
+        "cond2", inputs={"Cond": [pred]},
+        outputs={"Out": results},
+        attrs={"true_block": true_blk.idx, "false_block": false_blk.idx,
+               "true_outs": [v.name for v in true_outs],
+               "false_outs": [v.name for v in false_outs]},
+        infer_shape=False)
+    if not results:
+        return None
+    return results[0] if len(results) == 1 else results
+
+
+class While:
+    """reference fluid.layers.While: build the loop body in a sub-block;
+    carries are the vars the body writes that exist outside.
+
+        i = fill_constant([1], 'int64', 0)
+        c = layers.less_than(i, n)
+        w = While(c)
+        with w.block():
+            ...
+            layers.increment(i)
+            layers.assign(layers.less_than(i, n), c)
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self._cond = cond
+        self._helper = LayerHelper("while", name=name)
+
+    @contextlib.contextmanager
+    def block(self):
+        main = self._helper.main_program
+        parent = main.current_block()
+        sub = main._create_block()
+        yield
+        main._rollback()
+        written = []
+        for op in sub.ops:
+            for n in op.output_arg_names():
+                if n and n not in written and \
+                        parent._find_var_recursive(n) is not None:
+                    written.append(n)
+        carries = [parent._find_var_recursive(n) for n in written
+                   if n != self._cond.name]
+        parent.append_op(
+            "while",
+            inputs={"Condition": [self._cond], "X": carries},
+            outputs={"Out": carries},
+            attrs={"sub_block": sub.idx}, infer_shape=False)
+
+
+class Switch:
+    """reference fluid.layers.Switch — sequential case chain built on
+    cond2 ops. Usage:
+
+        with Switch() as switch:
+            with switch.case(cond1): ...assign...
+            with switch.default(): ...assign...
+    """
+
+    def __init__(self, name=None):
+        self._helper = LayerHelper("switch", name=name)
+        self._cases = []  # (pred or None, block_idx)
+
+    def __enter__(self):
+        return self
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        main = self._helper.main_program
+        blk = main._create_block()
+        yield
+        main._rollback()
+        self._cases.append((condition, blk))
+
+    @contextlib.contextmanager
+    def default(self):
+        main = self._helper.main_program
+        blk = main._create_block()
+        yield
+        main._rollback()
+        self._cases.append((None, blk))
+
+    def __exit__(self, exc_type, *a):
+        if exc_type is not None:
+            return False
+        main = self._helper.main_program
+        parent = main.current_block()
+        # chain: first matching case wins. Lower as nested conditional
+        # blocks, conditioned on "this case and no earlier case".
+        prev_not = None
+        from . import tensor as T
+        from .nn import mean  # noqa
+        for pred, blk in self._cases:
+            written = []
+            for op in blk.ops:
+                for n in op.output_arg_names():
+                    if n and n not in written and \
+                            parent._find_var_recursive(n) is not None:
+                        written.append(n)
+            outs = [parent._find_var_recursive(n) for n in written]
+            if pred is None:
+                effective = prev_not
+                if effective is None:
+                    raise ValueError("Switch.default with no prior case")
+            else:
+                effective = pred if prev_not is None else \
+                    T.logical_and(prev_not, pred)
+            if effective is None:
+                continue
+            parent.append_op(
+                "conditional_block",
+                inputs={"Cond": [effective]},
+                outputs={"Out": outs},
+                attrs={"sub_block": blk.idx}, infer_shape=False)
+            this_not = T.logical_not(pred) if pred is not None else None
+            if this_not is not None:
+                prev_not = this_not if prev_not is None else \
+                    T.logical_and(prev_not, this_not)
+        return False
+
+
+from .tensor import increment  # noqa  (re-export, reference parity)
+
+
+def array_write(x, i, array=None):
+    raise NotImplementedError("tensor_array: planned (LoD-era API)")
+
+
+def array_read(array, i):
+    raise NotImplementedError("tensor_array: planned (LoD-era API)")
+
+
+def array_length(array):
+    raise NotImplementedError("tensor_array: planned (LoD-era API)")

@@ -1,0 +1,95 @@
+"""AMP support ops.
+
+Reference: paddle/fluid/operators/amp/ — check_finite_and_unscale_op
+(gradient overflow detection + unscaling) and update_loss_scaling_op (the
+dynamic loss-scale state machine: grow after incr_every_n_steps good
+steps, shrink on decr_every_n_nan_or_inf bad ones).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import in_var, register_op, set_out
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _cfau_infer(op, block):
+    for xn, on in zip(op.input("X"), op.output("Out")):
+        xv = block.var(xn)
+        ov = block.var(on)
+        ov.shape, ov.dtype = xv.shape, xv.dtype
+    fi = op.single_output("FoundInfinite")
+    if fi:
+        v = block.var(fi)
+        v.shape, v.dtype = (1,), "bool"
+
+
+@register_op("check_finite_and_unscale", infer=_cfau_infer, grad=None,
+             stateful_outputs=("Out",))
+def _check_finite_and_unscale(ctx, op):
+    jnp = _jnp()
+    scale = ctx.get_input(op, "Scale")
+    found = jnp.zeros((1,), bool)
+    outs = []
+    for x in ctx.get_inputs(op, "X"):
+        xf = x.astype("float32") / scale
+        bad = ~jnp.all(jnp.isfinite(xf))
+        found = found | bad
+        outs.append(xf.astype(x.dtype))
+    ctx.set_outputs(op, "Out", outs)
+    ctx.set_output(op, "FoundInfinite", found)
+
+
+def _uls_infer(op, block):
+    for slot in ("Out",):
+        for xn, on in zip(op.input("X"), op.output(slot)):
+            xv, ov = block.var(xn), block.var(on)
+            ov.shape, ov.dtype = xv.shape, xv.dtype
+    for slot, dt in (("LossScaling", "float32"),
+                     ("OutGoodSteps", "int32"), ("OutBadSteps", "int32")):
+        n = op.single_output(slot)
+        if n:
+            v = block.var(n)
+            v.shape, v.dtype = (1,), dt
+
+
+@register_op("update_loss_scaling", infer=_uls_infer, grad=None,
+             stateful_outputs=("Out", "LossScaling", "OutGoodSteps",
+                               "OutBadSteps"))
+def _update_loss_scaling(ctx, op):
+    """reference update_loss_scaling_op.h UpdateLossScalingFunctor."""
+    jnp = _jnp()
+    found = ctx.get_input(op, "FoundInfinite").reshape(())
+    scale = ctx.get_input(op, "PrevLossScaling")
+    good = ctx.get_input(op, "InGoodSteps")
+    bad = ctx.get_input(op, "InBadSteps")
+    incr_n = op.attr("incr_every_n_steps", 1000)
+    decr_n = op.attr("decr_every_n_nan_or_inf", 2)
+    incr_ratio = op.attr("incr_ratio", 2.0)
+    decr_ratio = op.attr("decr_ratio", 0.5)
+
+    bad_n = jnp.where(found, bad + 1, jnp.zeros_like(bad))
+    good_n = jnp.where(found, jnp.zeros_like(good), good + 1)
+    shrink = bad_n >= decr_n
+    grow = good_n >= incr_n
+    new_scale = jnp.where(shrink, scale * decr_ratio,
+                          jnp.where(grow, scale * incr_ratio, scale))
+    new_scale = jnp.maximum(new_scale, 1e-8)
+    bad_n = jnp.where(shrink, jnp.zeros_like(bad_n), bad_n)
+    good_n = jnp.where(grow, jnp.zeros_like(good_n), good_n)
+
+    # zero non-finite grads so the (unconditional) optimizer ops become
+    # no-ops for this step (reference: conditional skip; see decorator.py)
+    xs = ctx.get_inputs(op, "X")
+    if op.attr("stop_update", False):
+        outs = xs
+    else:
+        outs = [jnp.where(found, jnp.zeros_like(x), x) for x in xs]
+    ctx.set_outputs(op, "Out", outs)
+    ctx.set_output(op, "LossScaling", new_scale)
+    ctx.set_output(op, "OutGoodSteps", good_n)
+    ctx.set_output(op, "OutBadSteps", bad_n)

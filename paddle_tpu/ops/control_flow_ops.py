@@ -1,0 +1,160 @@
+"""Control-flow ops.
+
+Reference: paddle/fluid/operators/controlflow/ — conditional_block_op.cc
+and while_op.cc run their sub-block with a *nested Executor* on a child
+scope per iteration. TPU-native: the sub-block lowers into the SAME traced
+computation under lax.cond / lax.while_loop — no nested interpreter, fixed
+shapes, fully fused by XLA (the compiler-friendly control flow the MXU
+needs).
+
+Contract (matches the reference op defs):
+  conditional_block: Cond (bool, scalar or [1]); attr sub_block (block
+    idx); Out = vars the branch assigns that must be visible outside. The
+    false path keeps each Out var's incoming value (it must already have
+    one — same as the reference, where an unset conditional output is an
+    error when read).
+  while: Condition + X (loop carries); sub_block must re-assign Condition;
+    Out = final carries.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .registry import LowerContext, lower_op, register_op
+
+
+def _sub_block(ctx: LowerContext, op):
+    return ctx.block.program.block(op.attr("sub_block"))
+
+
+def _external_reads(block, defined_outside) -> List[str]:
+    """Names a sub-block reads before writing them (loop/branch inputs)."""
+    written = set()
+    reads: List[str] = []
+    for o in block.ops:
+        for n in o.input_arg_names():
+            if n and n not in written and n not in reads:
+                reads.append(n)
+        for n in o.output_arg_names():
+            written.add(n)
+    return reads
+
+
+def _lower_sub(ctx: LowerContext, block, env: Dict[str, object]):
+    sub = LowerContext(block, env, base_key=ctx.base_key,
+                       is_test=ctx.is_test, mesh=ctx.mesh, amp=ctx.amp)
+    sub.axis_names = getattr(ctx, "axis_names", ())
+    sub.ring_table = getattr(ctx, "ring_table", {})
+    for o in block.ops:
+        lower_op(sub, o)
+    return env
+
+
+def _cond_infer(op, block):
+    # Out vars mirror their existing (outer) shapes; nothing to infer here —
+    # the sub-block ops ran their own infer at append time.
+    pass
+
+
+@register_op("conditional_block", infer=_cond_infer, grad=None)
+def _conditional_block(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    sub = _sub_block(ctx, op)
+    out_names = [n for n in op.output("Out") if n]
+    reads = [n for n in _external_reads(sub, None) if n in ctx.env]
+    # carry = reads + current values of outs (for the unchanged branch)
+    carry_names = list(dict.fromkeys(reads + out_names))
+    for n in carry_names:
+        if n not in ctx.env:
+            raise KeyError(
+                f"conditional_block: {n!r} has no value before the branch; "
+                f"outputs must be initialized (reference semantics)")
+    pred = ctx.get_input(op, "Cond")
+    pred = jnp.reshape(pred, ()).astype(bool)
+
+    def true_fn(carry):
+        env = dict(zip(carry_names, carry))
+        _lower_sub(ctx, sub, env)
+        return tuple(env[n] for n in out_names)
+
+    def false_fn(carry):
+        env = dict(zip(carry_names, carry))
+        return tuple(env[n] for n in out_names)
+
+    carry = tuple(ctx.env[n] for n in carry_names)
+    outs = jax.lax.cond(pred, true_fn, false_fn, carry)
+    for n, v in zip(out_names, outs):
+        ctx.env[n] = v
+
+
+@register_op("cond2", infer=lambda op, block: None, grad=None)
+def _cond2(ctx, op):
+    """Two-branch functional conditional (layers.cond): one lax.cond.
+    Branch side effects on outer vars are not propagated — only the
+    declared branch outputs (reference cond has the same contract via
+    select_input)."""
+    import jax
+    import jax.numpy as jnp
+
+    tblk = ctx.block.program.block(op.attr("true_block"))
+    fblk = ctx.block.program.block(op.attr("false_block"))
+    t_outs = op.attr("true_outs")
+    f_outs = op.attr("false_outs")
+    out_names = [n for n in op.output("Out") if n]
+    reads = [n for n in dict.fromkeys(_external_reads(tblk, None) +
+                                      _external_reads(fblk, None))
+             if n in ctx.env]
+    pred = jnp.reshape(ctx.get_input(op, "Cond"), ()).astype(bool)
+
+    def _branch(blk, outs):
+        def fn(carry):
+            env = dict(zip(reads, carry))
+            _lower_sub(ctx, blk, env)
+            return tuple(env[n] for n in outs)
+        return fn
+
+    carry = tuple(ctx.env[n] for n in reads)
+    vals = jax.lax.cond(pred, _branch(tblk, t_outs),
+                        _branch(fblk, f_outs), carry)
+    for n, v in zip(out_names, vals):
+        ctx.env[n] = v
+
+
+@register_op("while", infer=lambda op, block: None, grad=None)
+def _while(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    sub = _sub_block(ctx, op)
+    cond_name = op.single_input("Condition")
+    loop_names = [n for n in op.input("X") if n]
+    out_names = [n for n in op.output("Out") if n] or loop_names
+    reads = [n for n in _external_reads(sub, None) if n in ctx.env]
+    carry_names = list(dict.fromkeys(loop_names + out_names + reads +
+                                     [cond_name]))
+
+    def cond_fn(carry):
+        env = dict(zip(carry_names, carry))
+        return jnp.reshape(env[cond_name], ()).astype(bool)
+
+    def body_fn(carry):
+        env = dict(zip(carry_names, carry))
+        _lower_sub(ctx, sub, env)
+        return tuple(env[n] for n in carry_names)
+
+    carry = tuple(ctx.env[n] for n in carry_names)
+    final = jax.lax.while_loop(cond_fn, body_fn, carry)
+    env = dict(zip(carry_names, final))
+    for n in carry_names:
+        ctx.env[n] = env[n]
+
+
+@register_op("increment", infer=lambda op, block: None, grad=None,
+             stateful_outputs=("Out",))
+def _increment(ctx, op):
+    import jax.numpy as jnp
+    x = ctx.get_input(op, "X")
+    step = op.attr("step", 1.0)
+    ctx.set_output(op, "Out", x + jnp.asarray(step, x.dtype))

@@ -45,12 +45,19 @@ class LowerContext:
     """
 
     def __init__(self, block: Block, env: Dict[str, Any], base_key=None,
-                 is_test: bool = False, mesh=None):
+                 is_test: bool = False, mesh=None, amp=None):
         self.block = block
         self.env = env
         self.base_key = base_key
         self.is_test = is_test
         self.mesh = mesh
+        # amp: None or {"dtype": "bfloat16", "white": set, "black": set} —
+        # lowering-level autocast (see _lower_with_amp). The reference
+        # rewrites the ProgramDesc to fp16 (contrib/mixed_precision/
+        # fp16_utils.py:193 rewrite_program); casting at lowering time is
+        # equivalent under XLA (casts fuse into the matmul/conv kernels)
+        # and keeps fp32 master params in the scope for free.
+        self.amp = amp
 
     def get(self, name: str):
         if name not in self.env:
@@ -167,11 +174,40 @@ def infer_op_shape(op: Operator, block: Block):
         opdef.infer(op, block)
 
 
+_AMP_CASTABLE = ("float16", "bfloat16", "float32")
+
+
+def _lower_with_amp(ctx: LowerContext, opdef: "OpDef", op: Operator):
+    """Autocast wrapper: white-list ops see low-precision float inputs,
+    black-list ops see float32; env bindings are restored afterwards so
+    other consumers keep the original precision."""
+    amp = ctx.amp
+    target = None
+    if amp is not None:
+        if op.type in amp["white"]:
+            target = amp["dtype"]
+        elif op.type in amp["black"]:
+            target = "float32"
+    if target is None:
+        opdef.lower(ctx, op)
+        return
+    saved = {}
+    for name in op.input_arg_names():
+        v = ctx.env.get(name)
+        dt = str(getattr(v, "dtype", ""))
+        if v is not None and dt in _AMP_CASTABLE and dt != target:
+            saved[name] = v
+            ctx.env[name] = v.astype(target)
+    opdef.lower(ctx, op)
+    for n, v in saved.items():
+        ctx.env[n] = v
+
+
 def lower_op(ctx: LowerContext, op: Operator):
     opdef = _REGISTRY.get(op.type)
     if opdef is None or opdef.lower is None:
         raise NotImplementedError(f"no lowering for op {op.type!r}")
-    opdef.lower(ctx, op)
+    _lower_with_amp(ctx, opdef, op)
 
 
 # ---------------------------------------------------------------------------
@@ -312,8 +348,10 @@ def _lower_auto_grad(ctx: LowerContext, gop: Operator):
         env = dict(const_env)
         env.update(zip(diff_names, diff_vals))
         sub = LowerContext(ctx.block, env, base_key=ctx.base_key,
-                           is_test=ctx.is_test, mesh=ctx.mesh)
-        opdef.lower(sub, fwd_op)
+                           is_test=ctx.is_test, mesh=ctx.mesh, amp=ctx.amp)
+        sub.axis_names = getattr(ctx, "axis_names", ())
+        sub.ring_table = getattr(ctx, "ring_table", {})
+        _lower_with_amp(sub, opdef, fwd_op)
         return tuple(env[n] for n in out_order)
 
     primals = tuple(ctx.get(n) for n in diff_names)

@@ -189,7 +189,7 @@ class SGDOptimizer(Optimizer):
     op_type = "sgd"
 
     def _append_optimize_op(self, param, grad, lr):
-        block = default_main_program().global_block()
+        block = default_main_program().current_block()
         return block.append_op(
             "sgd",
             inputs={"Param": [param], "Grad": [grad],
@@ -212,7 +212,7 @@ class MomentumOptimizer(Optimizer):
 
     def _append_optimize_op(self, param, grad, lr):
         v = self._get_accumulator("velocity", param)
-        block = default_main_program().global_block()
+        block = default_main_program().current_block()
         return block.append_op(
             "momentum",
             inputs={"Param": [param], "Grad": [grad], "Velocity": [v],
@@ -220,6 +220,46 @@ class MomentumOptimizer(Optimizer):
             outputs={"ParamOut": [param], "VelocityOut": [v]},
             attrs={"mu": self._momentum,
                    "use_nesterov": self._use_nesterov})
+
+
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """Momentum + deep gradient compression (reference
+    fluid/optimizer.py:1185 DGCMomentumOptimizer, dgc_op.cc). See
+    ops/dgc_ops.py for the TPU translation of the sparse allreduce."""
+
+    def __init__(self, learning_rate, momentum,
+                 rampup_begin_step, rampup_step=1, sparsity=(0.999,),
+                 use_nesterov=False, num_trainers=None, **kwargs):
+        super().__init__(learning_rate, momentum,
+                         use_nesterov=use_nesterov, **kwargs)
+        self._rampup_begin_step = float(rampup_begin_step)
+        self._sparsity = list(sparsity)[-1] if sparsity else 0.999
+        self._num_trainers = num_trainers
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("dgc_u", param)
+        self._add_accumulator("dgc_v", param)
+        self._add_accumulator("dgc_step", param, shape=[1])
+
+    def _append_optimize_op(self, param, grad, lr):
+        u = self._get_accumulator("dgc_u", param)
+        v = self._get_accumulator("dgc_v", param)
+        step = self._get_accumulator("dgc_step", param)
+        block = default_main_program().current_block()
+        block.append_op("scale", inputs={"X": [step]},
+                        outputs={"Out": [step]},
+                        attrs={"scale": 1.0, "bias": 1.0,
+                               "bias_after_scale": True,
+                               "op_role": OpRole.Optimize})
+        nranks = self._num_trainers or 1
+        return block.append_op(
+            "dgc_momentum",
+            inputs={"Param": [param], "Grad": [grad], "U": [u], "V": [v],
+                    "LearningRate": [lr], "CurrentStep": [step]},
+            outputs={"ParamOut": [param], "UOut": [u], "VOut": [v]},
+            attrs={"m": self._momentum, "sparsity": self._sparsity,
+                   "rampup_begin_step": self._rampup_begin_step,
+                   "nranks": nranks, "ring_id": 0})
 
 
 class LarsMomentumOptimizer(Optimizer):
@@ -239,7 +279,7 @@ class LarsMomentumOptimizer(Optimizer):
 
     def _append_optimize_op(self, param, grad, lr):
         v = self._get_accumulator("velocity", param)
-        block = default_main_program().global_block()
+        block = default_main_program().current_block()
         return block.append_op(
             "lars_momentum",
             inputs={"Param": [param], "Grad": [grad], "Velocity": [v],
@@ -272,7 +312,7 @@ class AdamOptimizer(Optimizer):
         m2 = self._get_accumulator("moment2", param)
         b1p = self._get_accumulator("beta1_pow", param)
         b2p = self._get_accumulator("beta2_pow", param)
-        block = default_main_program().global_block()
+        block = default_main_program().current_block()
         return block.append_op(
             self.op_type,
             inputs={"Param": [param], "Grad": [grad], "Moment1": [m1],
@@ -327,7 +367,7 @@ class AdagradOptimizer(Optimizer):
 
     def _append_optimize_op(self, param, grad, lr):
         m = self._get_accumulator("moment", param)
-        block = default_main_program().global_block()
+        block = default_main_program().current_block()
         return block.append_op(
             "adagrad",
             inputs={"Param": [param], "Grad": [grad], "Moment": [m],
@@ -355,7 +395,7 @@ class AdamaxOptimizer(Optimizer):
         m = self._get_accumulator("moment", param)
         inf = self._get_accumulator("inf_norm", param)
         b1p = self._get_accumulator("beta1_pow", param)
-        block = default_main_program().global_block()
+        block = default_main_program().current_block()
         op = block.append_op(
             "adamax",
             inputs={"Param": [param], "Grad": [grad], "Moment": [m],
@@ -388,7 +428,7 @@ class AdadeltaOptimizer(Optimizer):
     def _append_optimize_op(self, param, grad, lr):
         g1 = self._get_accumulator("avg_squared_grad", param)
         g2 = self._get_accumulator("avg_squared_update", param)
-        block = default_main_program().global_block()
+        block = default_main_program().current_block()
         return block.append_op(
             "adadelta",
             inputs={"Param": [param], "Grad": [grad],
@@ -417,7 +457,7 @@ class RMSPropOptimizer(Optimizer):
         ms = self._get_accumulator("mean_square", param)
         mom = self._get_accumulator("moment", param)
         mg = self._get_accumulator("mean_grad", param)
-        block = default_main_program().global_block()
+        block = default_main_program().current_block()
         return block.append_op(
             "rmsprop",
             inputs={"Param": [param], "Grad": [grad], "MeanSquare": [ms],
@@ -445,7 +485,7 @@ class FtrlOptimizer(Optimizer):
     def _append_optimize_op(self, param, grad, lr):
         sq = self._get_accumulator("squared", param)
         lin = self._get_accumulator("linear", param)
-        block = default_main_program().global_block()
+        block = default_main_program().current_block()
         return block.append_op(
             "ftrl",
             inputs={"Param": [param], "Grad": [grad],
@@ -476,7 +516,7 @@ class LambOptimizer(AdamOptimizer):
         wd = self._weight_decay
         if self._exclude_fn is not None and self._exclude_fn(param):
             wd = 0.0
-        block = default_main_program().global_block()
+        block = default_main_program().current_block()
         return block.append_op(
             "lamb",
             inputs={"Param": [param], "Grad": [grad], "Moment1": [m1],
@@ -502,7 +542,7 @@ class DecayedAdagradOptimizer(Optimizer):
 
     def _append_optimize_op(self, param, grad, lr):
         m = self._get_accumulator("moment", param)
-        block = default_main_program().global_block()
+        block = default_main_program().current_block()
         return block.append_op(
             "decayed_adagrad",
             inputs={"Param": [param], "Grad": [grad], "Moment": [m],
@@ -521,7 +561,7 @@ class DpsgdOptimizer(Optimizer):
         self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
 
     def _append_optimize_op(self, param, grad, lr):
-        block = default_main_program().global_block()
+        block = default_main_program().current_block()
         return block.append_op(
             "dpsgd",
             inputs={"Param": [param], "Grad": [grad],
@@ -529,6 +569,133 @@ class DpsgdOptimizer(Optimizer):
             outputs={"ParamOut": [param]},
             attrs={"clip": self._clip, "batch_size": self._batch_size,
                    "sigma": self._sigma})
+
+
+class RecomputeOptimizer:
+    """Activation checkpointing (reference fluid/optimizer.py:4491
+    RecomputeOptimizer + backward.py:689 checkpoint segmentation).
+    Set checkpoints via `_set_checkpoints([...vars...])`, then minimize.
+    """
+
+    def __init__(self, optimizer):
+        self.inner_optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = list(checkpoints)
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        if not self._checkpoints:
+            raise ValueError("RecomputeOptimizer: call _set_checkpoints "
+                             "before minimize (reference semantics)")
+        return append_backward(loss,
+                               parameter_list or
+                               self.inner_optimizer._parameter_list,
+                               no_grad_set, callbacks,
+                               checkpoints=self._checkpoints)
+
+    def apply_gradients(self, params_grads):
+        return self.inner_optimizer.apply_gradients(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        opt_ops = self.apply_gradients(params_grads)
+        return opt_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["inner_optimizer"], item)
+
+
+class GradientMergeOptimizer:
+    """Accumulate gradients for k steps, then apply one update.
+
+    Reference: fluid/optimizer.py:4969 GradientMergeOptimizer — builds a
+    conditional update block guarded by (step % k == 0). Same program
+    structure here; the conditional block lowers to one lax.cond inside
+    the compiled step (ops/control_flow_ops.py) instead of a nested
+    executor run.
+    """
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .layers import tensor as T
+        from .framework.layer_helper import LayerHelper
+
+        params_grads = self.inner_optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set)
+        main = loss.block.program
+        block = main.global_block()
+        helper = LayerHelper("gradient_merge")
+
+        step = T.create_global_var([1], 0.0, "float32", persistable=True,
+                                   name=unique_name("gm_step"))
+        T.increment(step, 1.0)
+        k_const = T.fill_constant([1], "float32", float(self.k_steps))
+        mod = T.elementwise_mod(step, k_const)
+        cond_var = T.equal(mod, T.fill_constant([1], "float32", 0.0))
+
+        accs = []
+        for p, g in params_grads:
+            acc = T.create_global_var(list(g.shape), 0.0, "float32",
+                                      persistable=True,
+                                      name=unique_name(f"{p.name}.gm_acc"))
+            helper.append_op("elementwise_add",
+                             inputs={"X": [acc], "Y": [g]},
+                             outputs={"Out": [acc]},
+                             attrs={"op_role": OpRole.Backward})
+            accs.append(acc)
+
+        # conditional update sub-block
+        sub = main._create_block()
+        merged = []
+        for acc in accs:
+            if self.avg:
+                m = helper.create_variable_for_type_inference("float32")
+                helper.append_op("scale", inputs={"X": [acc]},
+                                 outputs={"Out": [m]},
+                                 attrs={"scale": 1.0 / self.k_steps,
+                                        "op_role": OpRole.Optimize})
+            else:
+                m = acc
+            merged.append(m)
+        self.inner_optimizer.apply_gradients(
+            [(p, m) for (p, _), m in zip(params_grads, merged)])
+        for acc in accs:
+            helper.append_op("scale", inputs={"X": [acc]},
+                             outputs={"Out": [acc]},
+                             attrs={"scale": 0.0,
+                                    "op_role": OpRole.Optimize})
+        main._rollback()
+
+        written = []
+        for op in sub.ops:
+            for n in op.output_arg_names():
+                if n and n not in written and \
+                        block._find_var_recursive(n) is not None:
+                    written.append(n)
+        outs = [block._find_var_recursive(n) for n in written]
+        block.append_op("conditional_block",
+                        inputs={"Cond": [cond_var]},
+                        outputs={"Out": outs},
+                        attrs={"sub_block": sub.idx,
+                               "op_role": OpRole.Optimize},
+                        infer_shape=False)
+        main.bump()
+        return [], params_grads
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["inner_optimizer"], item)
 
 
 # 2.0-style short aliases

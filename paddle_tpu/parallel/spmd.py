@@ -26,7 +26,8 @@ from .mesh import DP_AXIS
 def _lower_block_spmd(block, env, base_key, mesh, axis_names, ring_table,
                       is_test=False):
     ctx = LowerContext(block, env, base_key=base_key, is_test=is_test,
-                       mesh=mesh)
+                       mesh=mesh,
+                       amp=getattr(block.program, "_amp_lowering", None))
     ctx.axis_names = tuple(axis_names)
     ctx.ring_table = dict(ring_table or {})
     for op in block.ops:

@@ -1,0 +1,263 @@
+"""Meta-optimizer tests.
+
+Reference analogs: test_fleet_{amp,dgc,lamb,lars,localsgd,gradient_merge,
+recompute,sharding}_meta_optimizer.py — single-process: build strategy,
+minimize, assert on the rewritten program — plus numeric checks our
+compiled-execution model makes cheap.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.distributed import fleet
+
+
+def _net(n_in=8, n_hidden=16, n_out=4, batch=16):
+    x = layers.data("x", [batch, n_in], append_batch_size=False)
+    y = layers.data("y", [batch, 1], dtype="int64", append_batch_size=False)
+    h = layers.fc(x, n_hidden, act="relu")
+    logits = layers.fc(h, n_out)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+    return loss, h
+
+
+def _minimize_with(strategy, opt):
+    fleet.init(is_collective=True)
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        loss, h = _net()
+        strategy_obj = strategy(h) if callable(strategy) else strategy
+        fopt = fleet.distributed_optimizer(opt, strategy_obj)
+        fopt.minimize(loss)
+    return main, startup, loss
+
+
+def _optypes(program):
+    types = []
+
+    def walk(blk):
+        for op in blk.ops:
+            types.append(op.type)
+            for k in ("sub_block", "true_block", "false_block"):
+                idx = op.attr(k, None)
+                if idx is not None:
+                    walk(program.block(idx))
+    walk(program.global_block())
+    return types
+
+
+def test_amp_meta_optimizer():
+    s = fleet.DistributedStrategy()
+    s.amp = True
+    main, _, _ = _minimize_with(s, optimizer.AdamOptimizer(1e-3))
+    assert main._amp_lowering is not None
+    assert main._amp_lowering["dtype"] == "bfloat16"
+    assert "AMPOptimizer" in fleet.fleet_instance()._applied_meta_optimizers
+
+
+def test_recompute_meta_optimizer():
+    s_fn_calls = {}
+
+    def strat(h):
+        s = fleet.DistributedStrategy()
+        s.recompute = True
+        s.recompute_configs = {"checkpoints": [h.name]}
+        return s
+    main, _, _ = _minimize_with(strat, optimizer.AdamOptimizer(1e-3))
+    types = _optypes(main)
+    # recomputed forward ops appear again in backward region
+    assert types.count("mul") >= 3  # 2 forward + >=1 recomputed
+    assert "RecomputeOptimizer" in \
+        fleet.fleet_instance()._applied_meta_optimizers
+
+
+def test_gradient_merge_meta_optimizer():
+    s = fleet.DistributedStrategy()
+    s.gradient_merge = True
+    s.gradient_merge_configs = {"k_steps": 4, "avg": True}
+    main, _, _ = _minimize_with(s, optimizer.SGDOptimizer(0.1))
+    types = _optypes(main)
+    assert "conditional_block" in types
+    assert "sgd" in types  # inside the conditional block
+
+
+def test_localsgd_meta_optimizer():
+    s = fleet.DistributedStrategy()
+    s.localsgd = True
+    s.localsgd_configs = {"k_steps": 2}
+    main, _, _ = _minimize_with(s, optimizer.SGDOptimizer(0.1))
+    types = _optypes(main)
+    assert "conditional_block" in types
+    assert "c_allreduce_sum" in types
+    # no per-step grad allreduce outside the sync block
+    top_types = [op.type for op in main.global_block().ops]
+    assert "c_allreduce_sum" not in top_types
+
+
+def test_dgc_meta_optimizer():
+    s = fleet.DistributedStrategy()
+    s.dgc = True
+    main, _, _ = _minimize_with(s, optimizer.MomentumOptimizer(0.1, 0.9))
+    types = _optypes(main)
+    assert "dgc_momentum" in types
+    assert "momentum" not in types
+
+
+def test_sharding_meta_optimizer():
+    s = fleet.DistributedStrategy()
+    s.sharding = True
+    main, _, _ = _minimize_with(s, optimizer.AdamOptimizer(1e-3))
+    assert getattr(main, "_zero_sharding", None) is not None
+    # placement-based: no collective rewrite
+    assert "c_allreduce_sum" not in _optypes(main)
+
+
+def test_fp16_allreduce_meta():
+    s = fleet.DistributedStrategy()
+    s.fp16_allreduce = True
+    main, _, _ = _minimize_with(s, optimizer.SGDOptimizer(0.1))
+    types = [op.type for op in main.global_block().ops]
+    assert "cast" in types and "c_allreduce_sum" in types
+
+
+def test_gradient_merge_numeric():
+    """k=4 merge: no update for 3 steps, exact averaged update at step 4."""
+    from paddle_tpu.framework.initializer import NumpyArrayInitializer
+    rng = np.random.RandomState(0)
+    xv = rng.rand(8, 4).astype("float32")
+    w0 = rng.rand(4, 1).astype("float32")
+
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8, 4], append_batch_size=False)
+        pred = layers.fc(x, 1, param_attr=pt.ParamAttr(
+            initializer=NumpyArrayInitializer(w0)), bias_attr=False)
+        loss = layers.mean(pred)
+        opt = optimizer.GradientMergeOptimizer(
+            optimizer.SGDOptimizer(0.1), k_steps=4, avg=True)
+        opt.minimize(loss)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    wname = main.global_block().all_parameters()[0].name
+    w_before = np.asarray(scope.find_var(wname)).copy()
+    for _ in range(3):
+        exe.run(main, feed={"x": xv}, fetch_list=[loss], scope=scope)
+    np.testing.assert_allclose(np.asarray(scope.find_var(wname)), w_before)
+    exe.run(main, feed={"x": xv}, fetch_list=[loss], scope=scope)
+    expected = w_before - 0.1 * xv.mean(0, keepdims=True).T
+    np.testing.assert_allclose(np.asarray(scope.find_var(wname)), expected,
+                               atol=1e-6)
+
+
+def test_amp_static_trains_bf16():
+    from paddle_tpu.contrib.mixed_precision import decorate
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        loss, _ = _net()
+        opt = decorate(optimizer.AdamOptimizer(1e-2))
+        opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(16, 8).astype("float32"),
+            "y": rng.randint(0, 4, (16, 1)).astype("int64")}
+    l0 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    for _ in range(15):
+        l = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    assert l < l0
+
+
+def test_fp16_loss_scaling_recovers_from_inf():
+    """Force an inf gradient via a huge loss scale: step is skipped
+    (params unchanged) and the scale halves after decr_every_n=1."""
+    from paddle_tpu.contrib.mixed_precision import decorate
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4, 4], append_batch_size=False)
+        pred = layers.fc(x, 1, bias_attr=False)
+        loss = layers.mean(pred)
+        opt = decorate(optimizer.SGDOptimizer(0.1), dtype="float16",
+                       init_loss_scaling=1e38, decr_every_n_nan_or_inf=1,
+                       use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    wname = main.global_block().all_parameters()[0].name
+    w0 = np.asarray(scope.find_var(wname)).copy()
+    exe.run(main, feed={"x": np.ones((4, 4), "float32")},
+            fetch_list=[loss], scope=scope)
+    w1 = np.asarray(scope.find_var(wname))
+    np.testing.assert_allclose(w0, w1)  # inf step skipped
+    scale = float(np.asarray(scope.find_var("loss_scaling_0")))
+    assert scale < 1e38
+
+
+def test_recompute_matches_plain_training():
+    from paddle_tpu.ops.registry import reset_op_seed
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(16, 8).astype("float32"),
+            "y": rng.randint(0, 4, (16, 1)).astype("int64")}
+    results = []
+    for use_rc in (False, True):
+        reset_op_seed()
+        main, startup = pt.Program(), pt.Program()
+        startup._is_startup = True
+        with pt.program_guard(main, startup):
+            loss, h = _net()
+            if use_rc:
+                opt = optimizer.RecomputeOptimizer(
+                    optimizer.AdamOptimizer(1e-2))
+                opt._set_checkpoints([h])
+            else:
+                opt = optimizer.AdamOptimizer(1e-2)
+            opt.minimize(loss)
+        exe = pt.Executor()
+        scope = pt.Scope()
+        exe.run(startup, scope=scope)
+        results.append([float(exe.run(main, feed=feed, fetch_list=[loss],
+                                      scope=scope)[0]) for _ in range(5)])
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-5)
+
+
+def test_dgc_trains():
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        loss, _ = _net()
+        opt = optimizer.DGCMomentumOptimizer(
+            0.1, 0.9, rampup_begin_step=2, sparsity=[0.9])
+        opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(16, 8).astype("float32"),
+            "y": rng.randint(0, 4, (16, 1)).astype("int64")}
+    l0 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    for _ in range(20):
+        l = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    assert l < l0
+
+
+def test_zero_sharding_runs_on_mesh():
+    fleet.init(is_collective=True)
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        loss, _ = _net()
+        s = fleet.DistributedStrategy()
+        s.sharding = True
+        fopt = fleet.distributed_optimizer(optimizer.AdamOptimizer(1e-2), s)
+        fopt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    compiled = pt.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(16, 8).astype("float32"),
+            "y": rng.randint(0, 4, (16, 1)).astype("int64")}
+    l0 = exe.run(compiled, feed=feed, fetch_list=[loss])[0]
+    for _ in range(8):
+        l = exe.run(compiled, feed=feed, fetch_list=[loss])[0]
+    assert compiled._compiled[-1] == "gspmd"
+    assert float(np.mean(l)) < float(np.mean(l0))
