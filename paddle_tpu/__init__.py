@@ -12,7 +12,7 @@ from . import ops  # registers the operator library
 from .framework.core import (Program, Variable, Parameter, OpRole,  # noqa
                              default_main_program, default_startup_program,
                              program_guard, unique_name, in_dygraph_mode,
-                             convert_dtype, grad_var_name)
+                             convert_dtype, grad_var_name, device_guard)
 from .framework.executor import (Executor, Scope, global_scope,  # noqa
                                  scope_guard)
 from .framework.backward import append_backward, gradients  # noqa

@@ -14,6 +14,7 @@ from .recompute_optimizer import RecomputeOptimizer  # noqa
 from .gradient_merge_optimizer import GradientMergeOptimizer  # noqa
 from .localsgd_optimizer import LocalSGDOptimizer  # noqa
 from .sharding_optimizer import ShardingOptimizer  # noqa
+from .pipeline_optimizer import PipelineOptimizer  # noqa
 
 META_OPTIMIZER_CLASSES = [
     # inner-most applied first; order mirrors the reference ranking
@@ -27,6 +28,7 @@ META_OPTIMIZER_CLASSES = [
     RecomputeOptimizer,
     GradientMergeOptimizer,
     LocalSGDOptimizer,
+    PipelineOptimizer,
     ShardingOptimizer,
     GraphExecutionOptimizer,
 ]

@@ -1,0 +1,18 @@
+"""Reference: distributed/fleet/meta_optimizers/pipeline_optimizer.py —
+wrap with the fluid PipelineOptimizer per strategy.pipeline_configs."""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class PipelineOptimizer(MetaOptimizerBase):
+    strategy_flag = "pipeline"
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        from ....optimizer import PipelineOptimizer as Pipe
+        cfg = self.user_defined_strategy.pipeline_configs
+        pipe = Pipe(self.inner_opt,
+                    num_microbatches=cfg.get("accumulate_steps", 1))
+        return pipe.minimize(loss, startup_program, parameter_list,
+                             no_grad_set)

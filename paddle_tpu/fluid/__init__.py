@@ -6,7 +6,8 @@ import paddle_tpu as _root
 
 from ..framework.core import (Program, Variable, Parameter,  # noqa
                               default_main_program, default_startup_program,
-                              program_guard, unique_name, in_dygraph_mode)
+                              program_guard, unique_name, in_dygraph_mode,
+                              device_guard)
 from ..framework.executor import (Executor, Scope, global_scope,  # noqa
                                   scope_guard)
 from ..framework.backward import append_backward, gradients  # noqa

@@ -389,6 +389,9 @@ class Block:
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None,
                   infer_shape: bool = True) -> Operator:
         op = Operator(self, type, inputs, outputs, attrs)
+        stage = current_stage()
+        if stage is not None and "__stage__" not in op.attrs:
+            op.attrs["__stage__"] = stage
         op.idx = len(self.ops)
         self.ops.append(op)
         if infer_shape:
@@ -594,6 +597,44 @@ def grad_var_name(name: str) -> str:
 
 
 # ---------------------------------------------------------------------------
+# ---------------------------------------------------------------------------
+# device_guard: pipeline-stage placement (reference fluid/framework.py:5603)
+# ---------------------------------------------------------------------------
+_device_guard_state = threading.local()
+
+
+class device_guard:
+    """``with device_guard("gpu:2"):`` tags appended ops with pipeline
+    stage 2 (attr __stage__). The reference splits the program into
+    per-device sections executed by SectionWorker; here the stage tag
+    drives the microbatch-scan pipeline (parallel/pipeline.py)."""
+
+    def __init__(self, device: Optional[str] = None):
+        self._device = device
+
+    def __enter__(self):
+        self._prev = getattr(_device_guard_state, "device", None)
+        _device_guard_state.device = self._device
+        return self
+
+    def __exit__(self, *exc):
+        _device_guard_state.device = self._prev
+
+
+def current_device() -> Optional[str]:
+    return getattr(_device_guard_state, "device", None)
+
+
+def current_stage() -> Optional[int]:
+    d = current_device()
+    if d is None or ":" not in d:
+        return None
+    try:
+        return int(d.split(":")[1])
+    except ValueError:
+        return None
+
+
 # dygraph-mode tracer switch (reference framework.py:181 in_dygraph_mode)
 # ---------------------------------------------------------------------------
 _dygraph_tracer_holder = threading.local()

@@ -199,6 +199,9 @@ class Executor:
         if hasattr(program, "_compile_and_run"):
             return program._compile_and_run(self, feed, fetch_list, scope,
                                             return_numpy)
+        if getattr(program, "_pipeline", None):
+            return self._run_pipeline(program, feed, fetch_list, scope,
+                                      return_numpy)
         feed = dict(feed or {})
         fetch_names = _fetch_names(fetch_list)
         scope = scope or global_scope()
@@ -268,6 +271,51 @@ class Executor:
         # Donate only rebound state: params update in place in HBM.
         fn = jax.jit(step_fn, donate_argnums=(1,))
         return fn, mut_in, const_in, state_out
+
+    def _run_pipeline(self, program, feed, fetch_list, scope, return_numpy):
+        """Programs marked by PipelineOptimizer: microbatch-scan schedule
+        (parallel/pipeline.py) replacing the reference PipelineTrainer/
+        SectionWorker dispatch (fluid/executor.py:1209 trainer branch)."""
+        from ..parallel.pipeline import build_pipeline_step
+
+        feed = dict(feed or {})
+        fetch_names = _fetch_names(fetch_list)
+        scope = scope or global_scope()
+        block = program.global_block()
+        feed_arrays = _prepare_feed(block, feed)
+        sig = tuple((n, tuple(np.shape(a)), str(np.asarray(a).dtype))
+                    for n, a in sorted(feed_arrays.items()))
+        key = ("pipeline", id(program), program._mod_count, sig,
+               tuple(fetch_names))
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = build_pipeline_step(
+                program, list(feed_arrays), fetch_names,
+                program._pipeline["num_microbatches"])
+            self._cache[key] = entry
+        fn, mut_in, const_in, extra_out = entry
+
+        def _val(name):
+            v = scope.find_var(name)
+            if v is None:
+                raise RuntimeError(
+                    f"variable {name!r} has no value in scope; did you "
+                    f"run the startup program first?")
+            return v
+
+        mut_vals = tuple(_val(n) for n in mut_in)
+        const_vals = tuple(_val(n) for n in const_in)
+        self._step += 1
+        fetches, new_mut, extra = fn(tuple(feed_arrays.values()),
+                                     mut_vals, const_vals,
+                                     np.int32(self._step))
+        for n, v in zip(mut_in, new_mut):
+            scope.set_var(n, v)
+        for n, v in zip(extra_out, extra):
+            scope.set_var(n, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
 
     def close(self):
         self._cache.clear()

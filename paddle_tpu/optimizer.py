@@ -612,6 +612,38 @@ class RecomputeOptimizer:
         return getattr(self.__dict__["inner_optimizer"], item)
 
 
+class PipelineOptimizer:
+    """Pipeline-parallel training (reference fluid/optimizer.py:3695).
+
+    Usage matches the reference: mark stages with
+    ``fluid.device_guard("gpu:<k>")`` while building, wrap the optimizer,
+    minimize. Execution is the microbatch-scan GPipe schedule
+    (parallel/pipeline.py) instead of SectionWorker threads.
+    """
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        self.inner_optimizer = optimizer
+        self._num_microbatches = int(num_microbatches)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        res = self.inner_optimizer.minimize(loss, startup_program,
+                                            parameter_list, no_grad_set)
+        program = loss.block.program
+        stages = {op.attr("__stage__") for op in
+                  program.global_block().ops
+                  if op.attr("__stage__") is not None}
+        program._pipeline = {
+            "num_microbatches": self._num_microbatches,
+            "num_stages": (max(stages) + 1) if stages else 1,
+        }
+        program.bump()
+        return res
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["inner_optimizer"], item)
+
+
 class GradientMergeOptimizer:
     """Accumulate gradients for k steps, then apply one update.
 

@@ -1,0 +1,144 @@
+"""Pipeline-parallel execution: microbatch scan.
+
+Reference: PipelineOptimizer (fluid/optimizer.py:3695) + PipelineTrainer /
+SectionWorker (framework/section_worker.cc:44-119): the program is split
+into per-device sections by device_guard; each SectionWorker thread runs
+the GPipe flush schedule — all microbatches forward, all backward, then
+one update — filtered by op_role.
+
+TPU-native: the same schedule is a `lax.scan` over microbatches INSIDE the
+single compiled step:
+  * scan body lowers the Forward+Backward-role ops on one microbatch and
+    accumulates gradients (the Σ over microbatches the flush schedule
+    produces);
+  * Optimize-role ops run once after the scan on the averaged gradients;
+  * persistable state written in the body (BN stats, loss-scale state)
+    is threaded as scan carry.
+GPipe's memory profile comes for free: XLA keeps one microbatch of
+activations live per scan iteration. Stage tags (__stage__, from
+device_guard) are preserved for placement; on a pp mesh the uniform-stage
+fast path (stacked stage params + ppermute rotation) applies — see
+models/ transformer configs.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.core import OpRole, Program
+from ..framework.executor import analyze_block
+from ..ops.registry import LowerContext, lower_op
+
+
+def _is_fwd_bwd(op) -> bool:
+    role = op.attr("op_role", OpRole.Forward)
+    return role in (OpRole.Forward, OpRole.Backward,
+                    OpRole.Forward | OpRole.Loss,
+                    OpRole.Backward | OpRole.Loss, OpRole.LRSched)
+
+
+def build_pipeline_step(program: Program, feed_names: Sequence[str],
+                        fetch_names: Sequence[str], num_microbatches: int,
+                        mesh=None):
+    """Returns (fn, mut_in, const_in, extra_out) with the same contract as
+    parallel.sharded.build_sharded_step. Feed batch dims must divide
+    num_microbatches. Fetches return the LAST microbatch's values
+    (reference SectionWorker exposes the final section's fetch)."""
+    import jax
+    import jax.numpy as jnp
+
+    M = int(num_microbatches)
+    block = program.global_block()
+    state_in, state_out = analyze_block(block, feed_names)
+    out_set = set(state_out)
+    mut_in = [n for n in state_in if n in out_set]
+    const_in = [n for n in state_in if n not in out_set]
+    extra_out = [n for n in state_out if n not in set(mut_in)]
+    seed = program.random_seed or 0
+
+    fwd_bwd = [op for op in block.ops
+               if op.type not in ("feed", "fetch") and _is_fwd_bwd(op)]
+    opt_ops = [op for op in block.ops
+               if op.type not in ("feed", "fetch") and not _is_fwd_bwd(op)]
+
+    # gradient names consumed by the update ops = accumulation carries
+    opt_reads = {n for op in opt_ops for n in op.input_arg_names()}
+    fwdbwd_written: List[str] = []
+    for op in fwd_bwd:
+        for n in op.output_arg_names():
+            if n and n not in fwdbwd_written:
+                fwdbwd_written.append(n)
+    grad_accs = [n for n in fwdbwd_written if n in opt_reads]
+    # persistable state written inside the body: thread as carry
+    body_state = [n for n in fwdbwd_written
+                  if n in out_set and n not in grad_accs]
+
+    def step_fn(feed_vals, mut_vals, const_vals, step):
+        base_key = jax.random.fold_in(jax.random.key(np.uint32(seed)), step)
+        outer: Dict[str, object] = {}
+        outer.update(zip(mut_in, mut_vals))
+        outer.update(zip(const_in, const_vals))
+
+        # [B, ...] -> [M, B/M, ...]
+        chunked = []
+        for v in feed_vals:
+            v = jnp.asarray(v)
+            b = v.shape[0]
+            if b % M:
+                raise ValueError(
+                    f"pipeline: batch {b} not divisible by "
+                    f"num_microbatches {M}")
+            chunked.append(v.reshape((M, b // M) + v.shape[1:]))
+
+        def body(carry, xs):
+            mb_idx, accs, states = carry
+            env = dict(outer)
+            env.update(zip(body_state, states))
+            env.update(zip(feed_names, xs))
+            ctx = LowerContext(block, env,
+                               base_key=jax.random.fold_in(base_key,
+                                                           mb_idx),
+                               mesh=mesh,
+                               amp=getattr(program, "_amp_lowering", None))
+            for op in fwd_bwd:
+                lower_op(ctx, op)
+            new_accs = tuple(a + env[g].astype(a.dtype)
+                             for a, g in zip(accs, grad_accs))
+            new_states = tuple(env[n] for n in body_state)
+            fetches = tuple(env[n] for n in fetch_names)
+            return (mb_idx + 1, new_accs, new_states), fetches
+
+        # init zero accumulators by abstract-eval of one microbatch
+        def one_mb(xs):
+            env = dict(outer)
+            env.update(zip(feed_names, xs))
+            ctx = LowerContext(block, env, base_key=base_key, mesh=mesh,
+                               amp=getattr(program, "_amp_lowering", None))
+            for op in fwd_bwd:
+                lower_op(ctx, op)
+            return tuple(env[g] for g in grad_accs)
+
+        mb0 = tuple(c[0] for c in chunked)
+        acc_shapes = jax.eval_shape(one_mb, mb0)
+        accs0 = tuple(jnp.zeros(a.shape, "float32") for a in acc_shapes)
+        states0 = tuple(outer[n] for n in body_state)
+        (_, accs, states), fetch_seq = jax.lax.scan(
+            body, (jnp.int32(0), accs0, states0),
+            tuple(chunked))
+
+        env = dict(outer)
+        env.update(zip(body_state, states))
+        # GPipe flush: update on the microbatch-mean gradient
+        env.update({g: (a / M) for g, a in zip(grad_accs, accs)})
+        ctx = LowerContext(block, env, base_key=base_key, mesh=mesh)
+        for op in opt_ops:
+            lower_op(ctx, op)
+
+        fetches = tuple(jnp.asarray(f)[-1] for f in fetch_seq)
+        return (fetches,
+                tuple(env[n] for n in mut_in),
+                tuple(env[n] for n in extra_out))
+
+    fn = jax.jit(step_fn, donate_argnums=(1,))
+    return fn, mut_in, const_in, extra_out

@@ -1,0 +1,98 @@
+"""Pipeline-parallel tests (reference: test_pipeline.py +
+test_fleet_pipeline_meta_optimizer.py)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.framework.core import reset_unique_name
+from paddle_tpu.ops.registry import reset_op_seed
+
+
+def _build(pipeline, microbatches=4):
+    reset_op_seed()
+    reset_unique_name()
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [16, 8], append_batch_size=False)
+        y = layers.data("y", [16, 1], dtype="int64",
+                        append_batch_size=False)
+        with pt.device_guard("gpu:0"):
+            h = layers.fc(x, 32, act="relu")
+        with pt.device_guard("gpu:1"):
+            logits = layers.fc(h, 4)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, y))
+        if pipeline:
+            opt = optimizer.PipelineOptimizer(
+                optimizer.SGDOptimizer(0.1),
+                num_microbatches=microbatches)
+        else:
+            opt = optimizer.SGDOptimizer(0.1)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def test_device_guard_tags_stages():
+    main, _, _ = _build(pipeline=True)
+    stages = {op.attr("__stage__") for op in main.global_block().ops
+              if op.attr("__stage__") is not None}
+    assert stages == {0, 1}
+    assert main._pipeline == {"num_microbatches": 4, "num_stages": 2}
+
+
+def test_pipeline_matches_plain_param_trajectory():
+    """GPipe flush on M equal microbatches == plain full-batch step: the
+    parameter trajectories must coincide (reference SectionWorker
+    correctness criterion)."""
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 8).astype("float32")
+    yv = rng.randint(0, 4, (16, 1)).astype("int64")
+    params = []
+    for pipe in (False, True):
+        main, startup, loss = _build(pipe)
+        exe = pt.Executor()
+        scope = pt.Scope()
+        exe.run(startup, scope=scope)
+        for _ in range(5):
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss],
+                    scope=scope)
+        names = sorted(p.name for p in main.global_block().all_parameters())
+        params.append([np.asarray(scope.find_var(n)) for n in names])
+    for a, b in zip(*params):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_batch_not_divisible_raises():
+    main, startup, loss = _build(pipeline=True, microbatches=3)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    try:
+        exe.run(main, feed={"x": np.zeros((16, 8), "float32"),
+                            "y": np.zeros((16, 1), "int64")},
+                fetch_list=[loss], scope=scope)
+        raised = False
+    except ValueError as e:
+        raised = "not divisible" in str(e)
+    assert raised
+
+
+def test_fleet_pipeline_meta_optimizer():
+    fleet.init(is_collective=True)
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8, 4], append_batch_size=False)
+        with pt.device_guard("gpu:0"):
+            h = layers.fc(x, 8, act="relu")
+        with pt.device_guard("gpu:1"):
+            loss = layers.mean(layers.fc(h, 2))
+        s = fleet.DistributedStrategy()
+        s.pipeline = True
+        s.pipeline_configs = {"accumulate_steps": 2}
+        fopt = fleet.distributed_optimizer(optimizer.SGDOptimizer(0.1), s)
+        fopt.minimize(loss)
+    assert main._pipeline["num_microbatches"] == 2
+    assert "PipelineOptimizer" in \
+        fleet.fleet_instance()._applied_meta_optimizers
