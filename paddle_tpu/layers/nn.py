@@ -20,7 +20,7 @@ __all__ = [
     "swish", "hard_sigmoid", "hard_swish", "prelu", "matmul", "bmm", "mul",
     "one_hot", "topk", "flatten", "l2_normalize", "label_smooth", "maxout",
     "soft_relu", "log_loss", "clip", "clip_by_norm", "mean", "pad",
-    "adaptive_pool2d",
+    "adaptive_pool2d", "flash_attention",
 ]
 
 
@@ -517,4 +517,22 @@ def pad(x, paddings, pad_value=0.0, name=None):
     out = helper.create_variable_for_type_inference(x.dtype)
     helper.append_op("pad", inputs={"X": [x]}, outputs={"Out": [out]},
                      attrs={"paddings": paddings, "pad_value": pad_value})
+    return out
+
+
+def flash_attention(q, k, v, causal=False, scale=None,
+                    seq_parallel_mode="ring", name=None):
+    """Fused multi-head attention; q/k/v: [B, H, S, D].
+
+    Lowers to the pallas TPU kernel, or ring/Ulysses attention when the
+    sequence is sharded over the `sp` mesh axis (ops/attention_ops.py).
+    """
+    helper = LayerHelper("flash_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    attrs = {"causal": causal, "seq_parallel_mode": seq_parallel_mode}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op("flash_attention",
+                     inputs={"Q": [q], "K": [k], "V": [v]},
+                     outputs={"Out": [out]}, attrs=attrs)
     return out

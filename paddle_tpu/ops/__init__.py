@@ -12,5 +12,6 @@ from . import collective_ops  # noqa: F401
 from . import amp_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import dgc_ops  # noqa: F401
+from . import attention_ops  # noqa: F401
 from .registry import (LowerContext, all_registered_ops, get_op_def,  # noqa
                        has_op, register_op)

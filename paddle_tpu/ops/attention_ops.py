@@ -1,0 +1,44 @@
+"""Fused attention op.
+
+Reference analog: operators/fused/multihead_matmul_op.cu (inference-only,
+fixed layout). Here a first-class training op that picks the best TPU
+execution per context:
+  * `sp` mesh axis bound (shard_map)  -> ring attention over ICI
+  * TPU backend                       -> pallas flash-attention kernel
+  * CPU (tests/virtual mesh)          -> blockwise scan formulation
+"""
+from __future__ import annotations
+
+from ..parallel.mesh import SP_AXIS
+from .registry import in_var, register_op, set_out
+
+
+def _attn_infer(op, block):
+    q = in_var(op, block, "Q")
+    set_out(op, block, "Out", q.shape, q.dtype)
+
+
+@register_op("flash_attention", infer=_attn_infer, grad="auto")
+def _flash_attention(ctx, op):
+    import jax
+
+    from .pallas.flash_attention import blockwise_attention, flash_attention
+    from ..parallel.ring import ring_attention, ulysses_attention
+
+    q = ctx.get_input(op, "Q")
+    k = ctx.get_input(op, "K")
+    v = ctx.get_input(op, "V")
+    causal = op.attr("causal", False)
+    sm_scale = op.attr("scale", None)
+    mode = op.attr("seq_parallel_mode", "ring")
+
+    axes = getattr(ctx, "axis_names", ()) or ()
+    if SP_AXIS in axes:
+        fn = ring_attention if mode == "ring" else ulysses_attention
+        out = fn(q, k, v, SP_AXIS, causal=causal, sm_scale=sm_scale)
+    elif jax.default_backend() == "tpu":
+        out = flash_attention(q, k, v, causal, sm_scale)
+    else:
+        out, _ = blockwise_attention(q, k, v, causal=causal,
+                                     sm_scale=sm_scale)
+    ctx.set_output(op, "Out", out)

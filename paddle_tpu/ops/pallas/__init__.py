@@ -1,0 +1,7 @@
+"""Hand-written TPU kernels (pallas).
+
+The reference's analog is its hand-CUDA operator set
+(operators/fused/multihead_matmul_op.cu, math/bert_encoder_functor.cu);
+here the hot ops are Mosaic kernels tiled for MXU/VMEM.
+"""
+from .flash_attention import flash_attention, blockwise_attention  # noqa
