@@ -20,7 +20,8 @@ __all__ = [
     "swish", "hard_sigmoid", "hard_swish", "prelu", "matmul", "bmm", "mul",
     "one_hot", "topk", "flatten", "l2_normalize", "label_smooth", "maxout",
     "soft_relu", "log_loss", "clip", "clip_by_norm", "mean", "pad",
-    "adaptive_pool2d", "flash_attention",
+    "adaptive_pool2d", "flash_attention", "rms_norm", "rope",
+    "silu", "mish",
 ]
 
 
@@ -535,4 +536,30 @@ def flash_attention(q, k, v, causal=False, scale=None,
     helper.append_op("flash_attention",
                      inputs={"Q": [q], "K": [k], "V": [v]},
                      outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+silu = _unary("silu")
+mish = _unary("mish")
+
+
+def rms_norm(x, epsilon=1e-6, param_attr=None, name=None):
+    """RMSNorm over the last dim (LLM configs; no fluid-era analog)."""
+    helper = LayerHelper("rms_norm", name=name)
+    scale = helper.create_parameter(
+        param_attr, [x.shape[-1]], "float32",
+        default_initializer=ConstantInitializer(1.0))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("rms_norm", inputs={"X": [x], "Scale": [scale]},
+                     outputs={"Y": [out]}, attrs={"epsilon": epsilon})
+    return out
+
+
+def rope(x, base=10000.0, position_offset=0, name=None):
+    """Rotary position embedding; x: [B, H, S, D]."""
+    helper = LayerHelper("rope", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("rope", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"base": base,
+                            "position_offset": position_offset})
     return out

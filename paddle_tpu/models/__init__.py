@@ -3,8 +3,8 @@
 * MNIST LeNet        — models.lenet          (static, single device)
 * ResNet-50 ImageNet — models.resnet         (data-parallel)
 * BERT/ERNIE-base    — models.bert           (Fleet collective)
+* Llama-style LLM    — models.llama          (DP + recompute + tp/sp)
 * Wide&Deep CTR      — planned (parameter-server sparse path)
-* Llama-style LLM    — planned (DP + recompute + tp/sp)
 
 All are built with the paddle_tpu static-graph layers API (the reference
 keeps its equivalents in separate repos — PaddleClas/PaddleNLP — plus the
@@ -13,3 +13,4 @@ in-tree book tests python/paddle/fluid/tests/book/).
 from .lenet import lenet, build_mnist_train  # noqa
 from .resnet import resnet, build_resnet_train  # noqa
 from .bert import bert_encoder, build_bert_pretrain  # noqa
+from .llama import llama, llama_block, build_llama_train  # noqa

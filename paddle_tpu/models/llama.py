@@ -1,0 +1,91 @@
+"""Llama-style decoder LM (config 5 of BASELINE.json: Llama-2-7B, DyGraph
+DP + recompute — stretch the fluid-era API to a modern LLM).
+
+Architecture: pre-RMSNorm, fused QKV with GQA, RoPE, causal flash
+attention (pallas / ring under sp), SwiGLU MLP, untied LM head.
+
+TPU-first notes:
+  * attention via the flash_attention op — pallas kernel single-chip,
+    ring attention when the sequence is sharded over `sp`;
+  * all projections are single large matmuls (fused QKV, fused gate+up)
+    to keep the MXU busy;
+  * weights stay fp32 in the scope; AMP lowers matmuls to bf16.
+"""
+from __future__ import annotations
+
+from .. import layers
+
+
+def _linear(x, size, name=None):
+    return layers.fc(x, size, num_flatten_dims=2, bias_attr=False,
+                     name=name)
+
+
+def llama_block(x, hidden, num_heads, num_kv_heads, seq_len, head_dim,
+                intermediate):
+    """One decoder layer. x: [B, S, H]."""
+    q_size = num_heads * head_dim
+    kv_size = num_kv_heads * head_dim
+    h = layers.rms_norm(x)
+    qkv = _linear(h, q_size + 2 * kv_size)
+    q = layers.slice(qkv, axes=[2], starts=[0], ends=[q_size])
+    k = layers.slice(qkv, axes=[2], starts=[q_size],
+                     ends=[q_size + kv_size])
+    v = layers.slice(qkv, axes=[2], starts=[q_size + kv_size],
+                     ends=[q_size + 2 * kv_size])
+
+    def heads(t, n):
+        t = layers.reshape(t, [0, seq_len, n, head_dim])
+        return layers.transpose(t, [0, 2, 1, 3])  # [B,n,S,D]
+
+    q, k, v = heads(q, num_heads), heads(k, num_kv_heads), \
+        heads(v, num_kv_heads)
+    q = layers.rope(q)
+    k = layers.rope(k)
+    if num_kv_heads != num_heads:
+        rep = num_heads // num_kv_heads
+        k = layers.tile(k, [1, rep, 1, 1])
+        v = layers.tile(v, [1, rep, 1, 1])
+    attn = layers.flash_attention(q, k, v, causal=True)
+    attn = layers.transpose(attn, [0, 2, 1, 3])
+    attn = layers.reshape(attn, [0, seq_len, q_size])
+    x = layers.elementwise_add(x, _linear(attn, hidden))
+
+    h = layers.rms_norm(x)
+    gate_up = _linear(h, 2 * intermediate)
+    gate = layers.slice(gate_up, axes=[2], starts=[0], ends=[intermediate])
+    up = layers.slice(gate_up, axes=[2], starts=[intermediate],
+                      ends=[2 * intermediate])
+    ffn = layers.elementwise_mul(layers.silu(gate), up)
+    return layers.elementwise_add(x, _linear(ffn, hidden))
+
+
+def llama(input_ids, vocab_size=32000, hidden=4096, num_layers=32,
+          num_heads=32, num_kv_heads=None, intermediate=11008,
+          seq_len=2048):
+    """Returns logits [B, S, V]. input_ids: [B, S] int64."""
+    num_kv_heads = num_kv_heads or num_heads
+    head_dim = hidden // num_heads
+    x = layers.embedding(input_ids, size=[vocab_size, hidden])
+    for _ in range(num_layers):
+        x = llama_block(x, hidden, num_heads, num_kv_heads, seq_len,
+                        head_dim, intermediate)
+    x = layers.rms_norm(x)
+    return _linear(x, vocab_size)
+
+
+def build_llama_train(batch_size=None, seq_len=2048, vocab_size=32000,
+                      hidden=4096, num_layers=32, num_heads=32,
+                      num_kv_heads=None, intermediate=11008):
+    """Causal-LM training graph: feeds input_ids + labels [B, S]."""
+    b = -1 if batch_size is None else batch_size
+    input_ids = layers.data("input_ids", [b, seq_len], dtype="int64",
+                            append_batch_size=False)
+    labels = layers.data("labels", [b, seq_len], dtype="int64",
+                         append_batch_size=False)
+    logits = llama(input_ids, vocab_size, hidden, num_layers, num_heads,
+                   num_kv_heads, intermediate, seq_len)
+    loss = layers.softmax_with_cross_entropy(
+        logits, layers.unsqueeze(labels, [2]))
+    mean_loss = layers.mean(layers.squeeze(loss, [2]))
+    return ["input_ids", "labels"], {"loss": mean_loss, "logits": logits}

@@ -13,5 +13,6 @@ from . import amp_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import dgc_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
+from . import rope_ops  # noqa: F401
 from .registry import (LowerContext, all_registered_ops, get_op_def,  # noqa
                        has_op, register_op)

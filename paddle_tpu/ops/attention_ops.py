@@ -33,12 +33,16 @@ def _flash_attention(ctx, op):
     mode = op.attr("seq_parallel_mode", "ring")
 
     axes = getattr(ctx, "axis_names", ()) or ()
+    mesh = ctx.mesh
+    multi_device = mesh is not None and mesh.devices.size > 1
     if SP_AXIS in axes:
         fn = ring_attention if mode == "ring" else ulysses_attention
         out = fn(q, k, v, SP_AXIS, causal=causal, sm_scale=sm_scale)
-    elif jax.default_backend() == "tpu":
+    elif jax.default_backend() == "tpu" and not multi_device:
         out = flash_attention(q, k, v, causal, sm_scale)
     else:
+        # multi-device GSPMD: the einsum formulation lets the partitioner
+        # shard batch/head/seq dims freely (pallas_call pins the layout)
         out, _ = blockwise_attention(q, k, v, causal=causal,
                                      sm_scale=sm_scale)
     ctx.set_output(op, "Out", out)

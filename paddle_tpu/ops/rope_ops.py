@@ -1,0 +1,37 @@
+"""Rotary position embedding op (new capability for the LLM configs;
+no reference analog — the reference vintage predates RoPE adoption)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import in_var, register_op, set_out
+
+
+def _rope_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+
+
+@register_op("rope", infer=_rope_infer, grad="auto")
+def _rope(ctx, op):
+    """X: [B, H, S, D] (D even). Rotates pairs (x[..., :D/2], x[..., D/2:])
+    by position-dependent angles — the 'rotate_half' convention."""
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    base = op.attr("base", 10000.0)
+    pos_offset = op.attr("position_offset", 0)
+    B, H, S, D = x.shape
+    half = D // 2
+
+    inv_freq = 1.0 / (base ** (np.arange(0, half) / half))
+    pos = jnp.arange(pos_offset, pos_offset + S, dtype=jnp.float32)
+    freqs = jnp.outer(pos, inv_freq)              # [S, half]
+    cos = jnp.cos(freqs)[None, None]              # [1,1,S,half]
+    sin = jnp.sin(freqs)[None, None]
+
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x2 * cos + x1 * sin], axis=-1)
+    ctx.set_output(op, "Out", out.astype(x.dtype))

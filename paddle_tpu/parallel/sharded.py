@@ -85,7 +85,8 @@ def build_sharded_step(program: Program, feed_names: Sequence[str],
                        fetch_names: Sequence[str], mesh,
                        rules: Optional[ShardingRules] = None,
                        batch_axes: Sequence[str] = (DP_AXIS,),
-                       donate_state: bool = True):
+                       donate_state: bool = True,
+                       feed_pspecs: Optional[Dict[str, tuple]] = None):
     """Lower block 0 of `program` into one jitted SPMD step function.
 
     Returns (fn, mut_in, const_in, extra_out) where
@@ -116,7 +117,10 @@ def build_sharded_step(program: Program, feed_names: Sequence[str],
         shape = v.shape if v is not None else ()
         return NamedSharding(mesh, rules.spec(name, shape))
 
-    feed_sh = tuple(NamedSharding(mesh, batch_spec) for _ in feed_names)
+    feed_pspecs = feed_pspecs or {}
+    feed_sh = tuple(
+        NamedSharding(mesh, feed_pspecs.get(n, batch_spec))
+        for n in feed_names)
     mut_sh = tuple(_state_sharding(n) for n in mut_in)
     const_sh = tuple(_state_sharding(n) for n in const_in)
     extra_sh = tuple(_state_sharding(n) for n in extra_out)

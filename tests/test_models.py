@@ -61,3 +61,55 @@ def test_bert_tiny_trains():
                                            intermediate=64, dropout=0.0),
         feed, optimizer.AdamOptimizer(1e-3), steps=10)
     assert last < first
+
+
+def test_llama_tiny_trains():
+    from paddle_tpu.models.llama import build_llama_train
+
+    def feed():
+        rng = np.random.RandomState(2)
+        return {"input_ids": rng.randint(0, 128, (2, 32)).astype("int64"),
+                "labels": rng.randint(0, 128, (2, 32)).astype("int64")}
+    first, last = _train(
+        lambda: build_llama_train(batch_size=2, seq_len=32, vocab_size=128,
+                                  hidden=64, num_layers=2, num_heads=4,
+                                  num_kv_heads=2, intermediate=128),
+        feed, optimizer.AdamW(1e-3, weight_decay=0.01), steps=12)
+    assert last < first * 0.8
+
+
+def test_llama_sharded_dp_mp_sp():
+    """Full training step over dp2 x mp2 x sp2 (the dryrun_multichip
+    configuration) on the virtual mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.models.llama import build_llama_train
+    from paddle_tpu.parallel import (MeshConfig, make_mesh, megatron_rules,
+                                     build_sharded_step)
+
+    axes = MeshConfig(mp=2, sp=2).resolve(8)
+    mesh = make_mesh(axes)
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        feeds, outs = build_llama_train(
+            batch_size=4, seq_len=32, vocab_size=128, hidden=64,
+            num_layers=2, num_heads=4, intermediate=128)
+        optimizer.AdamW(1e-3).minimize(outs["loss"])
+    scope = pt.Scope()
+    pt.Executor().run(startup, scope=scope)
+    spec = P("dp", "sp")
+    fn, mut_in, const_in, _ = build_sharded_step(
+        main, feeds, [outs["loss"].name], mesh,
+        rules=megatron_rules(mesh), feed_pspecs={n: spec for n in feeds})
+    rng = np.random.RandomState(0)
+    feed = {"input_ids": rng.randint(0, 128, (4, 32)).astype("int64"),
+            "labels": rng.randint(0, 128, (4, 32)).astype("int64")}
+    fv = tuple(jax.device_put(feed[n], NamedSharding(mesh, spec))
+               for n in feeds)
+    mut = tuple(scope.find_var(n) for n in mut_in)
+    const = tuple(scope.find_var(n) for n in const_in)
+    losses = []
+    for i in range(4):
+        fetches, mut, _ = fn(fv, mut, const, np.int32(i + 1))
+        losses.append(float(np.asarray(fetches[0])))
+    assert losses[-1] < losses[0]
