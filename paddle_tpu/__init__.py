@@ -27,6 +27,8 @@ from .framework.compiler import (CompiledProgram, BuildStrategy,  # noqa
                                  ExecutionStrategy, ParallelExecutor)
 from . import distributed  # noqa
 from . import contrib  # noqa
+from . import io  # noqa
+from . import checkpoint  # noqa
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,99 @@
+"""Sharded / async checkpointing (orbax-backed).
+
+Reference analog: auto-checkpoint + save_persistables (SURVEY.md §5
+checkpoint/resume). On TPU the state is a pytree of (possibly sharded)
+jax.Arrays; orbax writes each shard from its owning host and restores
+with the target sharding — the reference's per-var save ops can't express
+that.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def _persistable_state(program, scope) -> Dict[str, object]:
+    state = {}
+    for v in program.list_vars():
+        if v.persistable:
+            val = scope.find_var(v.name)
+            if val is not None:
+                state[v.name] = val
+    return state
+
+
+def save_checkpoint(directory: str, step: int, program=None, scope=None,
+                    extra_state: Optional[dict] = None,
+                    use_orbax: bool = True):
+    """Save all persistable vars (+ extra_state) under directory/step."""
+    from .framework.core import default_main_program
+    from .framework.executor import global_scope
+
+    program = program or default_main_program()
+    scope = scope or global_scope()
+    state = _persistable_state(program, scope)
+    if extra_state:
+        state = dict(state, **{f"__extra__{k}": v
+                               for k, v in extra_state.items()})
+    path = os.path.join(directory, str(step))
+    if use_orbax:
+        try:
+            import orbax.checkpoint as ocp
+            ckptr = ocp.PyTreeCheckpointer()
+            ckptr.save(os.path.abspath(path),
+                       {k: np.asarray(v) for k, v in state.items()},
+                       force=True)
+            return path
+        except Exception:
+            pass  # fall through to pickle
+    import pickle
+    os.makedirs(directory, exist_ok=True)
+    with open(path + ".pkl", "wb") as f:
+        pickle.dump({k: np.asarray(v) for k, v in state.items()}, f,
+                    protocol=2)
+    return path + ".pkl"
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for name in os.listdir(directory):
+        base = name[:-4] if name.endswith(".pkl") else name
+        if base.isdigit():
+            steps.append(int(base))
+    return max(steps) if steps else None
+
+
+def load_checkpoint(directory: str, step: Optional[int] = None,
+                    program=None, scope=None) -> dict:
+    """Restore persistable vars into the scope; returns extra_state."""
+    from .framework.core import default_main_program
+    from .framework.executor import global_scope
+
+    program = program or default_main_program()
+    scope = scope or global_scope()
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {directory}")
+    path = os.path.join(directory, str(step))
+    state = None
+    if os.path.exists(path + ".pkl"):
+        import pickle
+        with open(path + ".pkl", "rb") as f:
+            state = pickle.load(f)
+    else:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        state = ckptr.restore(os.path.abspath(path))
+    extra = {}
+    persistable = {v.name for v in program.list_vars() if v.persistable}
+    for k, v in state.items():
+        if k.startswith("__extra__"):
+            extra[k[len("__extra__"):]] = v
+        elif k in persistable:
+            scope.set_var(k, np.asarray(v))
+    return extra

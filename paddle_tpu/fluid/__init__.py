@@ -27,6 +27,7 @@ is_compiled_with_cuda = _root.is_compiled_with_cuda
 
 from .. import dygraph  # noqa
 from .. import framework  # noqa
+from .. import io  # noqa
 from ..framework.compiler import (CompiledProgram, BuildStrategy,  # noqa
                                   ExecutionStrategy, ParallelExecutor)
 backward = framework.backward

@@ -1,0 +1,202 @@
+"""Model / variable save & load.
+
+Reference: python/paddle/fluid/io.py — save_vars:238,
+save_persistables:620, save_inference_model:1198, load_inference_model:1411,
+save:1714 / load:1785, load_program_state:1962. Same API surface; the
+serialized program is JSON (framework/serde.py) instead of protobuf, and
+tensors are pickled name->ndarray dicts.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .framework.core import (Parameter, Program, Variable,
+                             default_main_program)
+from .framework.executor import Executor, Scope, global_scope
+from .framework.serde import program_from_json, program_to_json
+
+__all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
+           "load_params", "load_persistables", "save_inference_model",
+           "load_inference_model", "save", "load", "load_program_state",
+           "set_program_state", "get_program_persistable_vars"]
+
+_PARAMS_SUFFIX = ".pdparams"
+_OPT_SUFFIX = ".pdopt"
+_MODEL_SUFFIX = ".pdmodel"
+
+
+def get_program_persistable_vars(program: Program) -> List[Variable]:
+    return [v for v in program.list_vars() if v.persistable]
+
+
+def _collect(scope: Scope, vars: Sequence[Variable]) -> dict:
+    out = {}
+    for v in vars:
+        val = scope.find_var(v.name)
+        if val is None:
+            raise RuntimeError(f"variable {v.name!r} has no value in scope")
+        out[v.name] = np.asarray(val)
+    return out
+
+
+def _write(path: str, payload: dict):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(payload, f, protocol=2)
+
+
+def _read(path: str) -> dict:
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+# -- var-level API (reference save_vars/load_vars) --------------------------
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in program.list_vars()
+                if (predicate or (lambda v: v.persistable))(v)]
+    scope = global_scope()
+    if filename is not None:
+        _write(os.path.join(dirname, filename), _collect(scope, vars))
+    else:
+        for v in vars:
+            _write(os.path.join(dirname, v.name),
+                   {v.name: _collect(scope, [v])[v.name]})
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in program.list_vars()
+                if (predicate or (lambda v: v.persistable))(v)]
+    scope = global_scope()
+    if filename is not None:
+        payload = _read(os.path.join(dirname, filename))
+        for v in vars:
+            scope.set_var(v.name, payload[v.name])
+    else:
+        for v in vars:
+            payload = _read(os.path.join(dirname, v.name))
+            scope.set_var(v.name, payload[v.name])
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: isinstance(v, Parameter),
+                     filename=filename)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=lambda v: isinstance(v, Parameter),
+                     filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """reference io.py:620."""
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: v.persistable, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=lambda v: v.persistable, filename=filename)
+
+
+# -- inference model (reference io.py:1198/1411) ----------------------------
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, program_only=False):
+    """Prunes to the feed->fetch subgraph (test clone), serializes program
+    + params."""
+    program = main_program or default_main_program()
+    inference_program = program.clone(for_test=True)
+    target_names = [t.name if isinstance(t, Variable) else str(t)
+                    for t in target_vars]
+    inference_program._inference_meta = {
+        "feeds": list(feeded_var_names), "fetches": target_names}
+
+    os.makedirs(dirname, exist_ok=True)
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    meta = program_to_json(inference_program)
+    import json
+    payload = json.loads(meta)
+    payload["inference_meta"] = inference_program._inference_meta
+    with open(model_path, "w") as f:
+        json.dump(payload, f)
+    if not program_only:
+        save_persistables(executor, dirname, program,
+                          filename=params_filename or "__params__")
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """Returns (program, feed_names, fetch_vars)."""
+    import json
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path) as f:
+        payload = json.load(f)
+    meta = payload.pop("inference_meta", {"feeds": [], "fetches": []})
+    program = program_from_json(json.dumps(payload))
+    if os.path.exists(os.path.join(dirname,
+                                   params_filename or "__params__")):
+        load_persistables(executor, dirname, program,
+                          filename=params_filename or "__params__")
+    fetch_vars = [program.global_block().var(n) for n in meta["fetches"]]
+    return program, meta["feeds"], fetch_vars
+
+
+# -- whole-state API (reference io.py:1714 save / :1785 load) ---------------
+
+def save(program: Program, model_path: str):
+    base = model_path
+    params = {v.name: _collect(global_scope(), [v])[v.name]
+              for v in program.list_vars() if isinstance(v, Parameter)}
+    others = {v.name: _collect(global_scope(), [v])[v.name]
+              for v in program.list_vars()
+              if v.persistable and not isinstance(v, Parameter)}
+    _write(base + _PARAMS_SUFFIX, params)
+    _write(base + _OPT_SUFFIX, others)
+    with open(base + _MODEL_SUFFIX, "w") as f:
+        f.write(program_to_json(program))
+
+
+def load(program: Program, model_path: str, executor=None,
+         var_list=None):
+    scope = global_scope()
+    if os.path.exists(model_path + _PARAMS_SUFFIX):
+        for name, val in _read(model_path + _PARAMS_SUFFIX).items():
+            scope.set_var(name, val)
+    if os.path.exists(model_path + _OPT_SUFFIX):
+        for name, val in _read(model_path + _OPT_SUFFIX).items():
+            scope.set_var(name, val)
+
+
+def load_program_state(model_path: str, var_list=None) -> dict:
+    """reference io.py:1962 — returns name -> ndarray."""
+    state = {}
+    for suffix in (_PARAMS_SUFFIX, _OPT_SUFFIX):
+        if os.path.exists(model_path + suffix):
+            state.update(_read(model_path + suffix))
+    if not state:
+        raise FileNotFoundError(f"no saved state at {model_path}")
+    return state
+
+
+def set_program_state(program: Program, state_dict: dict):
+    scope = global_scope()
+    for v in get_program_persistable_vars(program):
+        if v.name in state_dict:
+            scope.set_var(v.name, np.asarray(state_dict[v.name]))

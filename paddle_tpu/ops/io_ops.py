@@ -1,0 +1,109 @@
+"""save / load ops.
+
+Reference: operators/save_op.cc, load_op.cc, save_combine_op.cc,
+load_combine_op.cc — checkpointing as *graph ops* run by the Executor, so
+it composes with distributed execution.
+
+TPU note: a save inside a jitted computation would force a device->host
+sync, so these ops run as host callbacks via jax.experimental.io_callback
+(ordered) — the XLA-native equivalent of the reference's synchronous
+file-writing kernels.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .registry import register_op
+
+
+def _save_arrays(path, names, arrays):
+    path = str(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = {str(n): np.asarray(a) for n, a in zip(names, arrays)}
+    with open(path, "wb") as f:
+        pickle.dump(payload, f, protocol=2)
+    return np.int32(0)
+
+
+@register_op("save", infer=lambda op, block: None, grad=None)
+def _save(ctx, op):
+    import jax
+    x = ctx.get_input(op, "X")
+    path = op.attr("file_path")
+    name = op.single_input("X")
+    jax.experimental.io_callback(
+        lambda a: _save_arrays(path, [name], [a]),
+        jax.ShapeDtypeStruct((), np.int32), x, ordered=True)
+
+
+@register_op("save_combine", infer=lambda op, block: None, grad=None)
+def _save_combine(ctx, op):
+    import jax
+    xs = ctx.get_inputs(op, "X")
+    names = op.input("X")
+    path = op.attr("file_path")
+    jax.experimental.io_callback(
+        lambda *arrs: _save_arrays(path, names, arrs),
+        jax.ShapeDtypeStruct((), np.int32), *xs, ordered=True)
+
+
+def _load_infer(op, block):
+    # target var must already carry shape/dtype metadata (reference load_op
+    # reads them from the serialized tensor; we require declared vars)
+    pass
+
+
+@register_op("load", infer=_load_infer, grad=None,
+             stateful_outputs=("Out",))
+def _load(ctx, op):
+    import jax
+    from ..framework.core import dtype_to_np
+    path = op.attr("file_path")
+    name = op.single_output("Out")
+    v = ctx.block._find_var_recursive(name)
+    if v is None or v.shape is None:
+        raise ValueError(f"load op: target var {name} needs declared "
+                         f"shape/dtype")
+
+    def _read():
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        key = name if name in payload else list(payload)[0]
+        return np.asarray(payload[key], dtype=dtype_to_np(v.dtype))
+
+    out = jax.experimental.io_callback(
+        _read, jax.ShapeDtypeStruct(tuple(v.shape), dtype_to_np(v.dtype)),
+        ordered=True)
+    ctx.set_output(op, "Out", out)
+
+
+@register_op("load_combine", infer=lambda op, block: None, grad=None,
+             stateful_outputs=("Out",))
+def _load_combine(ctx, op):
+    import jax
+    from ..framework.core import dtype_to_np
+    path = op.attr("file_path")
+    names = op.output("Out")
+    metas = []
+    for n in names:
+        v = ctx.block._find_var_recursive(n)
+        if v is None or v.shape is None:
+            raise ValueError(f"load_combine: target var {n} needs "
+                             f"declared shape/dtype")
+        metas.append((tuple(v.shape), dtype_to_np(v.dtype)))
+
+    def _read():
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        return tuple(np.asarray(payload[n], dtype=dt)
+                     for n, (sh, dt) in zip(names, metas))
+
+    outs = jax.experimental.io_callback(
+        _read, tuple(jax.ShapeDtypeStruct(sh, dt) for sh, dt in metas),
+        ordered=True)
+    ctx.set_outputs(op, "Out", list(outs))

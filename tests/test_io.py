@@ -1,0 +1,151 @@
+"""IO tests (reference: test_save_load*, test_inference_model_io)."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import io, layers, optimizer
+from paddle_tpu.framework.serde import program_from_json, program_to_json
+
+
+def _train_net():
+    x = layers.data("x", [8, 4], append_batch_size=False)
+    y = layers.data("y", [8, 1], append_batch_size=False)
+    pred = layers.fc(x, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.AdamOptimizer(1e-2).minimize(loss)
+    return loss, pred
+
+
+def _feed():
+    rng = np.random.RandomState(0)
+    return {"x": rng.rand(8, 4).astype("float32"),
+            "y": rng.rand(8, 1).astype("float32")}
+
+
+def test_program_serde_roundtrip():
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        loss, _ = _train_net()
+    s = program_to_json(main)
+    p2 = program_from_json(s)
+    assert len(p2.global_block().ops) == len(main.global_block().ops)
+    assert sorted(p2.global_block().vars) == sorted(main.global_block().vars)
+    # the restored program must still EXECUTE; snapshot state between the
+    # two runs (each training step mutates the shared scope)
+    exe = pt.Executor()
+    exe.run(startup)
+    scope = pt.global_scope()
+    snap = {n: np.asarray(scope.find_var(n)).copy()
+            for n in scope.local_var_names()}
+    l1 = exe.run(main, feed=_feed(), fetch_list=[loss])[0]
+    for n, v in snap.items():
+        scope.set_var(n, v)
+    l2 = exe.run(p2, feed=_feed(), fetch_list=[loss.name])[0]
+    np.testing.assert_allclose(l1, l2, rtol=1e-6)
+
+
+def test_save_load_persistables():
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        loss, _ = _train_net()
+    exe = pt.Executor()
+    exe.run(startup)
+    for _ in range(3):
+        exe.run(main, feed=_feed(), fetch_list=[loss])
+    tmp = tempfile.mkdtemp()
+    io.save_persistables(exe, tmp, main, filename="ckpt")
+    w = main.global_block().all_parameters()[0]
+    saved = np.asarray(pt.global_scope().find_var(w.name)).copy()
+    pt.global_scope().set_var(w.name, np.zeros_like(saved))
+    io.load_persistables(exe, tmp, main, filename="ckpt")
+    np.testing.assert_array_equal(
+        np.asarray(pt.global_scope().find_var(w.name)), saved)
+
+
+def test_save_load_whole_program():
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        loss, _ = _train_net()
+    exe = pt.Executor()
+    exe.run(startup)
+    exe.run(main, feed=_feed(), fetch_list=[loss])
+    tmp = os.path.join(tempfile.mkdtemp(), "model")
+    io.save(main, tmp)
+    state = io.load_program_state(tmp)
+    assert any(k.endswith(".w_0") or "fc" in k for k in state)
+    io.set_program_state(main, {k: np.zeros_like(v)
+                                for k, v in state.items()})
+    io.load(main, tmp)
+    w = main.global_block().all_parameters()[0]
+    np.testing.assert_array_equal(
+        np.asarray(pt.global_scope().find_var(w.name)), state[w.name])
+
+
+def test_inference_model_roundtrip():
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        loss, pred = _train_net()
+    exe = pt.Executor()
+    exe.run(startup)
+    feed = _feed()
+    # reference output via the test clone (no optimizer mutation)
+    ref = exe.run(main.clone(for_test=True), feed=feed,
+                  fetch_list=[pred.name])[0]
+    tmp = tempfile.mkdtemp()
+    io.save_inference_model(tmp, ["x"], [pred], exe, main_program=main)
+
+    exe2 = pt.Executor()
+    scope2 = pt.Scope()
+    with pt.scope_guard(scope2):
+        prog, feeds, fetches = io.load_inference_model(tmp, exe2)
+        assert feeds == ["x"]
+        out = exe2.run(prog, feed={"x": feed["x"], "y": feed["y"]},
+                       fetch_list=fetches, scope=scope2)[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_save_load_ops_in_graph():
+    """save/load as graph ops (reference save_op.cc semantics)."""
+    tmp = os.path.join(tempfile.mkdtemp(), "weights.bin")
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4, 4], append_batch_size=False)
+        w = layers.fc(x, 2)
+        from paddle_tpu.framework.layer_helper import LayerHelper
+        h = LayerHelper("saver")
+        h.append_op("save_combine",
+                    inputs={"X": [w]}, outputs={},
+                    attrs={"file_path": tmp})
+    exe = pt.Executor()
+    exe.run(startup)
+    out = exe.run(main, feed={"x": np.ones((4, 4), "float32")},
+                  fetch_list=[w])[0]
+    assert os.path.exists(tmp)
+    import pickle
+    with open(tmp, "rb") as f:
+        payload = pickle.load(f)
+    np.testing.assert_allclose(payload[w.name], out, rtol=1e-6)
+
+
+def test_checkpoint_save_restore():
+    from paddle_tpu import checkpoint as ckpt
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        loss, _ = _train_net()
+    exe = pt.Executor()
+    exe.run(startup)
+    for _ in range(2):
+        exe.run(main, feed=_feed(), fetch_list=[loss])
+    tmp = tempfile.mkdtemp()
+    ckpt.save_checkpoint(tmp, step=7, program=main,
+                         extra_state={"epoch": np.int32(3)})
+    w = main.global_block().all_parameters()[0]
+    orig = np.asarray(pt.global_scope().find_var(w.name)).copy()
+    pt.global_scope().set_var(w.name, np.zeros_like(orig))
+    assert ckpt.latest_step(tmp) == 7
+    extra = ckpt.load_checkpoint(tmp, program=main)
+    np.testing.assert_array_equal(
+        np.asarray(pt.global_scope().find_var(w.name)), orig)
+    assert int(extra["epoch"]) == 3
