@@ -22,6 +22,7 @@ Design notes (tpu-first):
 from __future__ import annotations
 
 import copy
+import itertools
 import threading
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
@@ -439,14 +440,18 @@ class Program:
     Mirrors reference ``fluid.Program`` (python/paddle/fluid/framework.py:4005).
     """
 
+    _uid_counter = itertools.count()
+
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self._current_block_idx = 0
         self.random_seed = 0
         self._version = 1
-        # cache token: executors key compiled artifacts on (id, _mod_count);
-        # any mutation helper must bump _mod_count.
+        # cache token: executors key compiled artifacts on (_uid, _mod_count);
+        # any mutation helper must bump _mod_count. _uid is monotonic, never
+        # reused (unlike id(), which can alias after GC).
         self._mod_count = 0
+        self._uid = next(Program._uid_counter)
         self._is_startup = False
 
     # -- block management ---------------------------------------------------
@@ -480,6 +485,7 @@ class Program:
     # -- cloning / pruning (reference framework.py:4457 clone, :4652 prune) --
     def clone(self, for_test: bool = False) -> "Program":
         p = copy.deepcopy(self)
+        p._uid = next(Program._uid_counter)  # distinct cache identity
         if for_test:
             for blk in p.blocks:
                 for op in blk.ops:

@@ -209,8 +209,8 @@ class Executor:
         block = program.global_block()
         feed_arrays = _prepare_feed(block, feed)
         sig = tuple((n, tuple(np.shape(a)), str(np.asarray(a).dtype))
-                    for n, a in sorted(feed_arrays.items()))
-        key = (id(program), program._mod_count, sig, tuple(fetch_names))
+                    for n, a in feed_arrays.items())
+        key = (program._uid, program._mod_count, sig, tuple(fetch_names))
 
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
@@ -284,8 +284,8 @@ class Executor:
         block = program.global_block()
         feed_arrays = _prepare_feed(block, feed)
         sig = tuple((n, tuple(np.shape(a)), str(np.asarray(a).dtype))
-                    for n, a in sorted(feed_arrays.items()))
-        key = ("pipeline", id(program), program._mod_count, sig,
+                    for n, a in feed_arrays.items())
+        key = ("pipeline", program._uid, program._mod_count, sig,
                tuple(fetch_names))
         entry = self._cache.get(key)
         if entry is None:
@@ -334,8 +334,11 @@ def _fetch_names(fetch_list) -> List[str]:
 
 
 def _prepare_feed(block: Block, feed: Dict[str, Any]) -> Dict[str, Any]:
+    """Canonical (sorted-name) feed order: the cache signature and the
+    positional binding of values to the compiled step must agree regardless
+    of the caller's dict insertion order."""
     out = {}
-    for name, value in feed.items():
+    for name, value in sorted(feed.items()):
         arr = np.asarray(value)
         if block.has_var(name):
             v = block.var(name)

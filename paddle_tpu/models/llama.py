@@ -43,9 +43,17 @@ def llama_block(x, hidden, num_heads, num_kv_heads, seq_len, head_dim,
     q = layers.rope(q)
     k = layers.rope(k)
     if num_kv_heads != num_heads:
+        # repeat_interleave-style expansion [k1,k1,..,k2,k2,..]: query-head
+        # group g maps to kv head g//rep, matching canonical Llama GQA
+        # (block-order tile would pair queries with the wrong kv heads).
         rep = num_heads // num_kv_heads
-        k = layers.tile(k, [1, rep, 1, 1])
-        v = layers.tile(v, [1, rep, 1, 1])
+
+        def expand_kv(t):
+            t = layers.reshape(t, [0, num_kv_heads, 1, seq_len, head_dim])
+            t = layers.tile(t, [1, 1, rep, 1, 1])
+            return layers.reshape(t, [0, num_heads, seq_len, head_dim])
+
+        k, v = expand_kv(k), expand_kv(v)
     attn = layers.flash_attention(q, k, v, causal=True)
     attn = layers.transpose(attn, [0, 2, 1, 3])
     attn = layers.reshape(attn, [0, seq_len, q_size])

@@ -168,13 +168,15 @@ def _c_reducescatter(ctx, op):
     ctx.set_output(op, "Out", lax.psum_scatter(x, axis, tiled=True))
 
 
-@register_op("c_concat", infer=lambda op, block: set_out(
-    op, block, "Out",
-    [in_var(op, block, "X").shape[0],
-     in_var(op, block, "X").shape[-1] * op.attr("nranks", 1)]
-    if len(in_var(op, block, "X").shape) == 2
-    else list(in_var(op, block, "X").shape),
-    in_var(op, block, "X").dtype), grad="auto")
+def _c_concat_infer(op, block):
+    x = in_var(op, block, "X")
+    shape = list(x.shape)
+    if shape and shape[-1] != -1:
+        shape[-1] *= op.attr("nranks", 1)
+    set_out(op, block, "Out", shape, x.dtype)
+
+
+@register_op("c_concat", infer=_c_concat_infer, grad="auto")
 def _c_concat(ctx, op):
     """Gather along the last dim (model-parallel activation gather)."""
     import jax.lax as lax
@@ -223,11 +225,15 @@ def _c_identity(ctx, op):
 def _send_v2(ctx, op):
     """Point-to-point send: paired with recv_v2 as a ppermute in the SPMD
     program (pipeline stage boundary). The SPMD lowering fuses matched
-    send/recv pairs; a lone send lowers to nothing."""
-    # value forwarded through ctx for the matching recv
+    send/recv pairs; a lone send lowers to nothing.
+
+    Stash is keyed by ring_id only (reference pairs send_v2/recv_v2 per
+    ring, send_v2_op.cc / recv_v2_op.cc); the send's `peer` attr (the
+    destination rank of the logical edge) rides along so recv can derive
+    the actual src->dst shift for the ppermute.
+    """
     x = ctx.get_input(op, "X")
-    peer = op.attr("peer", 0)
-    ctx.env[f"__p2p_{op.attr('ring_id', 0)}_{peer}"] = x
+    ctx.env[f"__p2p_{op.attr('ring_id', 0)}"] = (x, op.attr("peer", 0))
 
 
 def _recv_v2_infer(op, block):
@@ -238,23 +244,23 @@ def _recv_v2_infer(op, block):
 @register_op("recv_v2", infer=_recv_v2_infer, grad=None)
 def _recv_v2(ctx, op):
     import jax.lax as lax
-    jnp = _jnp()
     axis = _axis_name(ctx, op)
-    key = f"__p2p_{op.attr('ring_id', 0)}_{op.attr('peer', 0)}"
-    # single-program pipeline: value was produced by the paired send
-    if key in ctx.env:
-        x = ctx.env[key]
-        if axis is not None:
-            n = _group_size(ctx, op)
+    key = f"__p2p_{op.attr('ring_id', 0)}"
+    if key not in ctx.env:
+        raise RuntimeError(
+            f"recv_v2(ring_id={op.attr('ring_id', 0)}): no paired send_v2 "
+            "lowered before this recv in the program; a lone recv would "
+            "silently compute on zeros")
+    x, send_peer = ctx.env.pop(key)  # consume: one send pairs one recv
+    if axis is not None:
+        n = _group_size(ctx, op)
+        # One logical edge encodes (dst=send.peer, src=recv.peer); the
+        # SPMD shift is their difference, e.g. stage s -> s+1 gives 1.
+        shift = (send_peer - op.attr("peer", 0)) % n
+        if shift:
             x = lax.ppermute(x, axis,
-                             [(i, (i + 1) % n) for i in range(n)])
-        ctx.set_output(op, "Out", x)
-        return
-    shape = op.attr("out_shape", [1])
-    from ..framework.core import dtype_to_np
-    ctx.set_output(op, "Out",
-                   jnp.zeros(shape, dtype_to_np(op.attr("dtype",
-                                                        "float32"))))
+                             [(i, (i + shift) % n) for i in range(n)])
+    ctx.set_output(op, "Out", x)
 
 
 # -- bootstrap / sync ops: structural no-ops under XLA ----------------------

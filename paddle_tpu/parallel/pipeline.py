@@ -32,10 +32,14 @@ from ..ops.registry import LowerContext, lower_op
 
 
 def _is_fwd_bwd(op) -> bool:
+    """Per-microbatch ops. LRSched ops run with the post-scan optimize
+    group, once per step — in the reference SectionWorker schedule the LR
+    update happens at the flush, not per microbatch
+    (framework/section_worker.cc:61-116 op_role filter)."""
     role = op.attr("op_role", OpRole.Forward)
     return role in (OpRole.Forward, OpRole.Backward,
                     OpRole.Forward | OpRole.Loss,
-                    OpRole.Backward | OpRole.Loss, OpRole.LRSched)
+                    OpRole.Backward | OpRole.Loss)
 
 
 def build_pipeline_step(program: Program, feed_names: Sequence[str],

@@ -38,7 +38,7 @@ def _run_collective(op_type, xv, attrs):
 def test_c_allreduce_sum():
     xv = np.arange(8, dtype="float32").reshape(8, 1)
     got = _run_collective("c_allreduce_sum", xv, {"ring_id": 0})
-    #每 participant holds the sum; fetch concatenates the 8 copies
+    # every participant holds the sum; fetch concatenates the 8 copies
     np.testing.assert_allclose(got, np.full((8, 1), xv.sum()))
 
 

@@ -1,0 +1,102 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md).
+
+- send_v2/recv_v2 pairing + ppermute shift derivation
+- lone recv_v2 raises instead of silently yielding zeros
+- c_concat shape inference for rank != 2
+- executor feed binding independent of feed-dict insertion order
+- Llama GQA kv expansion is repeat_interleave, not block tile
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework.layer_helper import LayerHelper
+from paddle_tpu.parallel import make_mesh
+from paddle_tpu.parallel.spmd import build_spmd_step
+
+
+def test_send_recv_pair_shifts_by_peer_distance():
+    """send(peer=dst) / recv(peer=src) on one edge: value moves src->dst.
+
+    Reference pairing: send_v2_op.cc (peer = receiver), recv_v2_op.cc
+    (peer = sender); edge 0->1 must shift every rank's value by +1."""
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8, 1], append_batch_size=False)
+        h = LayerHelper("send_v2")
+        h.append_op("send_v2", inputs={"X": [x]}, outputs={},
+                    attrs={"ring_id": 0, "peer": 1})
+        out = h.create_variable_for_type_inference("float32")
+        h.append_op("recv_v2", inputs={}, outputs={"Out": [out]},
+                    attrs={"ring_id": 0, "peer": 0, "out_shape": [1, 1],
+                           "dtype": "float32"})
+    mesh = make_mesh({"dp": 8})
+    fn, _, _, _ = build_spmd_step(main, ["x"], [out.name], mesh)
+    xv = np.arange(8, dtype="float32").reshape(8, 1)
+    fetches, _, _ = fn((xv,), (), (), np.int32(1))
+    got = np.asarray(fetches[0]).reshape(-1)
+    # rank i receives from rank i-1
+    np.testing.assert_allclose(got, np.roll(np.arange(8.0), 1))
+
+
+def test_lone_recv_raises():
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8, 1], append_batch_size=False)
+        h = LayerHelper("recv_v2")
+        out = h.create_variable_for_type_inference("float32")
+        h.append_op("recv_v2", inputs={}, outputs={"Out": [out]},
+                    attrs={"ring_id": 5, "peer": 0, "out_shape": [1, 1],
+                           "dtype": "float32"})
+    mesh = make_mesh({"dp": 8})
+    with pytest.raises(Exception, match="no paired send"):
+        fn, _, _, _ = build_spmd_step(main, ["x"], [out.name], mesh)
+        fn((np.zeros((8, 1), "float32"),), (), (), np.int32(1))
+
+
+def test_c_concat_shape_inference_3d():
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [2, 3, 4], append_batch_size=False)
+        h = LayerHelper("c_concat")
+        out = h.create_variable_for_type_inference("float32")
+        h.append_op("c_concat", inputs={"X": [x]},
+                    outputs={"Out": [out]},
+                    attrs={"ring_id": 0, "nranks": 8})
+    assert list(out.shape) == [2, 3, 32]
+
+
+def test_feed_dict_order_does_not_change_binding():
+    """Two same-shape/dtype feeds in different dict orders must bind by
+    name, not position (advisor finding on the cache signature)."""
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        a = layers.data("a", [2, 2], append_batch_size=False)
+        b = layers.data("b", [2, 2], append_batch_size=False)
+        out = layers.elementwise_sub(a, b)
+    exe = pt.Executor()
+    exe.run(startup)
+    av = np.full((2, 2), 5.0, "float32")
+    bv = np.full((2, 2), 2.0, "float32")
+    r1, = exe.run(main, feed={"a": av, "b": bv}, fetch_list=[out])
+    r2, = exe.run(main, feed={"b": bv, "a": av}, fetch_list=[out])
+    np.testing.assert_allclose(r1, np.full((2, 2), 3.0))
+    np.testing.assert_allclose(r2, np.full((2, 2), 3.0))
+
+
+def test_gqa_expansion_is_repeat_interleave():
+    """reshape+tile+reshape in models/llama.py must equal
+    np.repeat(k, rep, axis=1) (canonical GQA head grouping)."""
+    B, nkv, S, D, rep = 2, 2, 3, 4, 3
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        k = layers.data("k", [B, nkv, S, D], append_batch_size=False)
+        t = layers.reshape(k, [0, nkv, 1, S, D])
+        t = layers.tile(t, [1, 1, rep, 1, 1])
+        out = layers.reshape(t, [0, nkv * rep, S, D])
+    exe = pt.Executor()
+    exe.run(startup)
+    kv = np.random.RandomState(0).randn(B, nkv, S, D).astype("float32")
+    got, = exe.run(main, feed={"k": kv}, fetch_list=[out])
+    np.testing.assert_allclose(got, np.repeat(kv, rep, axis=1), rtol=1e-6)
