@@ -21,6 +21,7 @@ from .framework import initializer  # noqa
 from . import layers  # noqa
 from . import optimizer  # noqa
 from . import regularizer  # noqa
+from . import clip  # noqa
 from .layers.tensor import data  # noqa
 from . import dygraph  # noqa
 from .framework.compiler import (CompiledProgram, BuildStrategy,  # noqa

@@ -1,0 +1,151 @@
+"""Gradient clipping (reference python/paddle/fluid/clip.py).
+
+Clip strategies are applied by ``Optimizer.apply_gradients`` between
+backward and the update ops (same seam as the reference's
+``_append_clip_op`` / ``GradientClipBase._static_clip``).  The clip math is
+graph ops, so it fuses into the one compiled XLA step; ByGlobalNorm's
+norm-reduce + scale costs one fused reduction over the grads rather than
+the reference's per-tensor kernel launches.
+
+Dygraph mode clips eagerly on jax arrays (`_dygraph_clip`).
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from .framework.core import OpRole, op_role_guard
+
+__all__ = ["GradientClipBase", "GradientClipByValue", "GradientClipByNorm",
+           "GradientClipByGlobalNorm", "set_gradient_clip", "ClipByValue",
+           "ClipByNorm", "ClipByGlobalNorm"]
+
+
+class GradientClipBase:
+    def __call__(self, params_grads):
+        from .framework.core import in_dygraph_mode
+        if in_dygraph_mode():
+            return self._dygraph_clip(params_grads)
+        with op_role_guard(OpRole.Optimize):
+            return self._static_clip(params_grads)
+
+    def _static_clip(self, params_grads):
+        raise NotImplementedError
+
+    def _dygraph_clip(self, params_grads):
+        raise NotImplementedError
+
+
+class GradientClipByValue(GradientClipBase):
+    """Clip each gradient elementwise into [min, max]
+    (reference fluid/clip.py GradientClipByValue)."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _static_clip(self, params_grads):
+        from .layers import tensor as T
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "trainable", True):
+                out.append((p, g))
+                continue
+            out.append((p, T.clip(g, self.min, self.max)))
+        return out
+
+    def _dygraph_clip(self, params_grads):
+        import jax.numpy as jnp
+        return [(p, None if g is None else jnp.clip(g, self.min, self.max))
+                for p, g in params_grads]
+
+
+class GradientClipByNorm(GradientClipBase):
+    """Per-tensor L2-norm clip: g * clip_norm / max(norm(g), clip_norm)
+    (reference fluid/clip.py GradientClipByNorm / clip_by_norm op)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _static_clip(self, params_grads):
+        from .layers import nn
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "trainable", True):
+                out.append((p, g))
+                continue
+            out.append((p, nn.clip_by_norm(g, self.clip_norm)))
+        return out
+
+    def _dygraph_clip(self, params_grads):
+        import jax.numpy as jnp
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(g * g))
+            out.append((p, g * (self.clip_norm /
+                                jnp.maximum(norm, self.clip_norm))))
+        return out
+
+
+class GradientClipByGlobalNorm(GradientClipBase):
+    """Scale ALL gradients by clip_norm / max(global_norm, clip_norm)
+    where global_norm = sqrt(sum_i ||g_i||^2)
+    (reference fluid/clip.py:339 GradientClipByGlobalNorm)."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _static_clip(self, params_grads):
+        from .layers import tensor as T
+        sq_sums = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "trainable", True):
+                continue
+            sq_sums.append(T.reduce_sum(T.elementwise_mul(g, g)))
+        if not sq_sums:
+            return params_grads
+        from .layers import nn
+        helper_sqrt = nn.sqrt(T.sums(sq_sums))
+        clip_var = T.fill_constant([1], "float32", self.clip_norm)
+        scale_var = T.elementwise_div(
+            clip_var, T.elementwise_max(helper_sqrt, clip_var))
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "trainable", True):
+                out.append((p, g))
+                continue
+            out.append((p, T.elementwise_mul(g, scale_var)))
+        return out
+
+    def _dygraph_clip(self, params_grads):
+        import jax.numpy as jnp
+        sq = [jnp.sum(g * g) for _, g in params_grads if g is not None]
+        if not sq:
+            return params_grads
+        gn = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        return [(p, None if g is None else g * scale)
+                for p, g in params_grads]
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """Program-level default clip (reference fluid/clip.py set_gradient_clip);
+    optimizers without an explicit grad_clip pick it up in
+    apply_gradients."""
+    from .framework.core import default_main_program
+    if clip is not None and not isinstance(clip, GradientClipBase):
+        raise TypeError("clip must be a GradientClipBase instance or None")
+    program = program or default_main_program()
+    program._gradient_clip = clip
+    program._gradient_clip_params = (
+        [p.name if hasattr(p, "name") else p for p in param_list]
+        if param_list else None)
+
+
+# reference exposes the strategies under both names
+ClipByValue = GradientClipByValue
+ClipByNorm = GradientClipByNorm
+ClipByGlobalNorm = GradientClipByGlobalNorm

@@ -18,6 +18,7 @@ from ..framework.initializer import (Constant, Normal, TruncatedNormal,  # noqa
 from .. import layers  # noqa
 from .. import optimizer  # noqa
 from .. import regularizer  # noqa
+from .. import clip  # noqa
 from ..layers.tensor import data  # noqa
 
 CPUPlace = _root.CPUPlace

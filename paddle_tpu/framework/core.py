@@ -98,6 +98,25 @@ class OpRole:
     Loss = 256
 
 
+_op_role_stack: List[int] = []
+
+
+class op_role_guard:
+    """Ops appended inside the guard default to the given role (reference
+    Program._optimized_guard / op_role attr, fluid/framework.py:4160)."""
+
+    def __init__(self, role: int):
+        self.role = role
+
+    def __enter__(self):
+        _op_role_stack.append(self.role)
+        return self
+
+    def __exit__(self, *exc):
+        _op_role_stack.pop()
+        return False
+
+
 # ---------------------------------------------------------------------------
 # Variable
 # ---------------------------------------------------------------------------
@@ -255,7 +274,9 @@ class Operator:
         self.inputs = {k: _as_name_list(v) for k, v in (inputs or {}).items()}
         self.outputs = {k: _as_name_list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
-        self.attrs.setdefault("op_role", OpRole.Forward)
+        self.attrs.setdefault(
+            "op_role",
+            _op_role_stack[-1] if _op_role_stack else OpRole.Forward)
         self.idx = -1
 
     # -- reference OpDesc-style accessors -----------------------------------

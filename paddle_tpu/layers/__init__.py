@@ -6,6 +6,11 @@ from .loss import *  # noqa
 from .metric_op import accuracy, auc  # noqa
 from . import collective  # noqa
 from .control_flow import cond, While, Switch  # noqa
+from .learning_rate_scheduler import (noam_decay, exponential_decay,  # noqa
+                                      natural_exp_decay, inverse_time_decay,
+                                      polynomial_decay, piecewise_decay,
+                                      cosine_decay, linear_lr_warmup)
+from . import learning_rate_scheduler  # noqa
 from . import control_flow  # noqa
 from . import nn  # noqa
 from . import tensor  # noqa

@@ -22,7 +22,7 @@ __all__ = [
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal", "logical_and", "logical_or", "logical_not", "clip",
     "uniform_random", "gaussian_random", "create_tensor",
-    "create_global_var",
+    "create_global_var", "create_parameter",
 ]
 
 
@@ -48,6 +48,22 @@ def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
                             "value": float(value)})
     out.stop_gradient = True
     return out
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference fluid.layers.create_parameter
+    (fluid/layers/tensor.py:create_parameter)."""
+    import copy
+    from ..framework.layer_helper import LayerHelper, ParamAttr
+    helper = LayerHelper("create_parameter")
+    attr = ParamAttr(name=name) if attr is None \
+        else copy.deepcopy(ParamAttr._to_attr(attr))
+    if name is not None and attr.name is None:
+        attr.name = name
+    return helper.create_parameter(attr, list(shape), dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
 
 
 def create_tensor(dtype, name=None, persistable=False):
@@ -220,9 +236,8 @@ def increment(x, value=1.0, in_place=True):
     helper = LayerHelper("increment")
     out = x if in_place else helper.create_variable_for_type_inference(
         x.dtype)
-    helper.append_op("scale", inputs={"X": [x]}, outputs={"Out": [out]},
-                     attrs={"scale": 1.0, "bias": float(value),
-                            "bias_after_scale": True})
+    helper.append_op("increment", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"step": float(value)})
     return out
 
 

@@ -207,6 +207,16 @@ def _scale(ctx: LowerContext, op: Operator):
     ctx.set_output(op, "Out", out)
 
 
+@register_op("increment", infer=same_as_input())
+def _increment(ctx: LowerContext, op: Operator):
+    """Out = X + step, dtype-preserving (reference increment_op.cc) — used
+    for int step counters, where a scale op would promote to float."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    step = op.attr("step", 1.0)
+    ctx.set_output(op, "Out", x + jnp.asarray(step).astype(x.dtype))
+
+
 @register_op("pow", infer=same_as_input())
 def _pow(ctx, op):
     x = ctx.get_input(op, "X")

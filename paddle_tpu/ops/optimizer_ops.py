@@ -355,3 +355,67 @@ def _decayed_adagrad(ctx, op):
 
 _reg_opt("decayed_adagrad", [("ParamOut", "Param"), ("MomentOut", "Moment")],
          _decayed_adagrad)
+
+
+def _average_accumulates(ctx, op):
+    """Sliding-window parameter accumulation for ModelAverage.
+
+    Reference semantics (operators/average_accumulates_op.h, driven by
+    fluid/optimizer.py:3134 ModelAverage):
+        num_updates += 1; num_accumulates += 1; sum_1 += param
+        if num_updates % max_acc == 0: sum_2 += sum_1; sum_1 = 0
+        if num_accumulates >= max_average_window
+           or num_accumulates >= num_updates * average_window_rate (once
+           past min_average_window):
+            sum_3 = sum_1 + sum_2; sum_1 = sum_2 = 0
+            old_num_accumulates = num_accumulates; num_accumulates = 0
+    The scalar branches become jnp.where selects — fully fused by XLA.
+    """
+    jnp = _jnp()
+    p = ctx.get_input(op, "Param").astype("float32")
+    s1 = ctx.get_input(op, "InSum1")
+    s2 = ctx.get_input(op, "InSum2")
+    s3 = ctx.get_input(op, "InSum3")
+    n_acc = ctx.get_input(op, "InNumAccumulates")
+    old_n = ctx.get_input(op, "InOldNumAccumulates")
+    n_upd = ctx.get_input(op, "InNumUpdates")
+
+    avg_rate = op.attr("average_window", 0.0)
+    max_win = op.attr("max_average_window", 2 ** 31 - 1)
+    min_win = op.attr("min_average_window", 10000)
+    max_acc = 16384  # kMaxNumAccumulates in the reference kernel
+
+    n_upd = n_upd + 1
+    n_acc = n_acc + 1
+    s1 = s1 + p
+
+    spill = (n_upd % max_acc) == 0
+    s2 = jnp.where(spill, s2 + s1, s2)
+    s1 = jnp.where(spill, jnp.zeros_like(s1), s1)
+
+    window = jnp.maximum(
+        jnp.minimum(jnp.asarray(float(max_win), "float32"),
+                    n_upd.astype("float32") * avg_rate),
+        float(min_win))
+    rotate = n_acc.astype("float32") >= window
+    s3 = jnp.where(rotate, s1 + s2, s3)
+    s1 = jnp.where(rotate, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(rotate, jnp.zeros_like(s2), s2)
+    old_n = jnp.where(rotate, n_acc, old_n)
+    n_acc = jnp.where(rotate, jnp.zeros_like(n_acc), n_acc)
+
+    ctx.set_output(op, "OutSum1", s1)
+    ctx.set_output(op, "OutSum2", s2)
+    ctx.set_output(op, "OutSum3", s3)
+    ctx.set_output(op, "OutNumAccumulates", n_acc)
+    ctx.set_output(op, "OutOldNumAccumulates", old_n)
+    ctx.set_output(op, "OutNumUpdates", n_upd)
+
+
+_reg_opt("average_accumulates",
+         [("OutSum1", "InSum1"), ("OutSum2", "InSum2"),
+          ("OutSum3", "InSum3"),
+          ("OutNumAccumulates", "InNumAccumulates"),
+          ("OutOldNumAccumulates", "InOldNumAccumulates"),
+          ("OutNumUpdates", "InNumUpdates")],
+         _average_accumulates)

@@ -25,6 +25,9 @@ __all__ = [
     "RMSProp", "RMSPropOptimizer", "Ftrl", "FtrlOptimizer", "Lamb",
     "LambOptimizer", "LarsMomentum", "LarsMomentumOptimizer",
     "DecayedAdagrad", "DecayedAdagradOptimizer", "Dpsgd", "DpsgdOptimizer",
+    "ExponentialMovingAverage", "ModelAverage", "LookaheadOptimizer",
+    "RecomputeOptimizer", "PipelineOptimizer", "GradientMergeOptimizer",
+    "DGCMomentumOptimizer",
 ]
 
 
@@ -109,8 +112,20 @@ class Optimizer:
                                no_grad_set, callbacks)
 
     def apply_gradients(self, params_grads: List[Tuple[Variable, Variable]]):
-        if self._grad_clip is not None:
-            params_grads = self._grad_clip(params_grads)
+        clip = self._grad_clip
+        if clip is None:
+            # program-level default installed by fluid.clip.set_gradient_clip
+            clip = getattr(default_main_program(), "_gradient_clip", None)
+            only = getattr(default_main_program(),
+                           "_gradient_clip_params", None)
+            if clip is not None and only:
+                keep = [(p, g) for p, g in params_grads if p.name in only]
+                rest = [(p, g) for p, g in params_grads
+                        if p.name not in only]
+                params_grads = clip(keep) + rest
+                clip = None
+        if clip is not None:
+            params_grads = clip(params_grads)
         params_grads = append_regularization_ops(params_grads,
                                                  self.regularization)
         return self.apply_optimize(params_grads)
@@ -725,6 +740,299 @@ class GradientMergeOptimizer:
                         infer_shape=False)
         main.bump()
         return [], params_grads
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["inner_optimizer"], item)
+
+
+class _ParamSwapBase:
+    """Shared apply()/restore() scaffolding for strategies that evaluate
+    with substituted parameter values (EMA, ModelAverage).  Subclasses
+    implement `_substitute_value(scope, param) -> ndarray or None`."""
+
+    _params: List[Variable]
+    _backups: Dict[str, object]
+
+    def apply(self, executor=None, need_restore=True):
+        """Context manager: swap params to the substituted values."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            self._swap_in()
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+        return _ctx()
+
+    def _swap_in(self):
+        from .framework.executor import global_scope
+        scope = global_scope()
+        self._backups = {}
+        for p in self._params:
+            cur = scope.find_var(p.name)
+            if cur is None:
+                continue  # startup not run / foreign scope: skip quietly
+            sub = self._substitute_value(scope, p)
+            if sub is None:
+                continue
+            self._backups[p.name] = cur
+            scope.set_var(p.name, sub.astype(np.asarray(cur).dtype))
+
+    def _substitute_value(self, scope, param):
+        raise NotImplementedError
+
+    def restore(self, executor=None):
+        from .framework.executor import global_scope
+        scope = global_scope()
+        for name, val in self._backups.items():
+            scope.set_var(name, val)
+        self._backups = {}
+
+
+class ExponentialMovingAverage(_ParamSwapBase):
+    """EMA of trainable parameters (reference fluid/optimizer.py:3443).
+
+    Usage matches the reference:
+        ema = ExponentialMovingAverage(0.999)
+        ema.update()                      # after optimizer.minimize
+        ...train...
+        with ema.apply(exe):              # params <- bias-corrected EMA
+            ...evaluate...
+    The update is graph ops fused into the training step; apply/restore
+    swap values in the scope host-side (the reference builds tiny swap
+    programs — here the scope IS the state store, no program needed).
+
+    `thres_steps` enables the reference's ramped decay
+    min(decay, (1 + t) / (10 + t)): pass a step Variable, or True to use
+    the EMA's own update counter.  Bias correction divides by
+    (1 - prod_t decay_t), tracked exactly in-graph via a decay-power
+    accumulator (works for both constant and ramped decay).
+    """
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        from .framework.core import op_role_guard
+        from .layers import tensor as T
+
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._name = name or unique_name("ema")
+        self._ema_vars: Dict[str, Variable] = {}
+        self._params: List[Variable] = []
+        self._backups: Dict[str, object] = {}
+
+        main = default_main_program()
+        for p in main.global_block().all_parameters():
+            if not p.trainable:
+                continue
+            self._params.append(p)
+        with op_role_guard(OpRole.Optimize):
+            self._step = T.create_global_var(
+                [1], 0.0, "int64", persistable=True,
+                name=unique_name(f"{self._name}.step"))
+            # prod of decay_t; bias correction = 1 - decay_pow
+            self._decay_pow = T.create_global_var(
+                [1], 1.0, "float32", persistable=True,
+                name=unique_name(f"{self._name}.decay_pow"))
+            for p in self._params:
+                ema = T.create_global_var(
+                    list(p.shape), 0.0, "float32", persistable=True,
+                    name=unique_name(f"{p.name}.ema"))
+                self._ema_vars[p.name] = ema
+
+    def _decay_var(self):
+        """[1] float32 decay for this step (constant or thres ramp)."""
+        from .layers import tensor as T
+        const = T.fill_constant([1], "float32", self._decay)
+        if self._thres_steps is None:
+            return const
+        t = (self._thres_steps if isinstance(self._thres_steps, Variable)
+             else self._step)
+        tf = T.cast(t, "float32")
+        ramp = T.elementwise_div(
+            T.scale(tf, 1.0, bias=1.0),
+            T.scale(tf, 1.0, bias=10.0))
+        return T.elementwise_min(const, ramp)
+
+    def update(self):
+        """Append the EMA update ops (call after optimizer.minimize, as the
+        reference does)."""
+        from .framework.core import op_role_guard
+        from .framework.layer_helper import LayerHelper
+        from .layers import tensor as T
+
+        with op_role_guard(OpRole.Optimize):
+            T.increment(self._step, 1.0)
+            helper = LayerHelper("ema_update")
+            decay = self._decay_var()
+            helper.append_op(
+                "elementwise_mul",
+                inputs={"X": [self._decay_pow], "Y": [decay]},
+                outputs={"Out": [self._decay_pow]})
+            one_minus = T.scale(decay, -1.0, bias=1.0,
+                                bias_after_scale=True)
+            for p in self._params:
+                ema = self._ema_vars[p.name]
+                # ema = decay * ema + (1 - decay) * p, written back in place
+                scaled_e = T.elementwise_mul(ema, decay)
+                scaled_p = T.elementwise_mul(p, one_minus)
+                helper.append_op(
+                    "elementwise_add",
+                    inputs={"X": [scaled_e], "Y": [scaled_p]},
+                    outputs={"Out": [ema]})
+        default_main_program().bump()
+
+    def _substitute_value(self, scope, param):
+        ema = scope.find_var(self._ema_vars[param.name].name)
+        decay_pow = scope.find_var(self._decay_pow.name)
+        if ema is None:
+            return None
+        correction = 1.0
+        if decay_pow is not None:
+            dp = float(np.asarray(decay_pow).reshape(-1)[0])
+            if dp < 1.0:
+                correction = 1.0 - dp
+        return np.asarray(ema) / correction
+
+
+class ModelAverage(_ParamSwapBase):
+    """Sliding-window average of parameters (reference
+    fluid/optimizer.py:3134 ModelAverage + average_accumulates op).
+
+    Accumulation is one `average_accumulates` graph op per parameter
+    (exact reference rotation semantics, ops/optimizer_ops.py); apply()/
+    restore() swap the averaged value into the scope for evaluation.
+    """
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        from .framework.core import op_role_guard
+        from .framework.layer_helper import LayerHelper
+        from .layers import tensor as T
+
+        self._name = name or unique_name("model_average")
+        self._avg_rate = float(average_window_rate)
+        self._min_win = int(min_average_window)
+        self._max_win = int(max_average_window)
+        self._accs: Dict[str, Dict[str, Variable]] = {}
+        self._params = [p for p in
+                        default_main_program().global_block()
+                        .all_parameters() if p.trainable]
+        self._backups: Dict[str, object] = {}
+
+        helper = LayerHelper("model_average")
+        with op_role_guard(OpRole.Optimize):
+            for p in self._params:
+                a = {}
+                for nm, shape, dtype in (
+                        ("sum_1", p.shape, "float32"),
+                        ("sum_2", p.shape, "float32"),
+                        ("sum_3", p.shape, "float32"),
+                        ("num_accumulates", [1], "int64"),
+                        ("old_num_accumulates", [1], "int64"),
+                        ("num_updates", [1], "int64")):
+                    a[nm] = T.create_global_var(
+                        list(shape), 0.0, dtype, persistable=True,
+                        name=unique_name(f"{p.name}.{self._name}.{nm}"))
+                self._accs[p.name] = a
+                helper.append_op(
+                    "average_accumulates",
+                    inputs={"Param": [p],
+                            "InSum1": [a["sum_1"]],
+                            "InSum2": [a["sum_2"]],
+                            "InSum3": [a["sum_3"]],
+                            "InNumAccumulates": [a["num_accumulates"]],
+                            "InOldNumAccumulates":
+                                [a["old_num_accumulates"]],
+                            "InNumUpdates": [a["num_updates"]]},
+                    outputs={"OutSum1": [a["sum_1"]],
+                             "OutSum2": [a["sum_2"]],
+                             "OutSum3": [a["sum_3"]],
+                             "OutNumAccumulates": [a["num_accumulates"]],
+                             "OutOldNumAccumulates":
+                                 [a["old_num_accumulates"]],
+                             "OutNumUpdates": [a["num_updates"]]},
+                    attrs={"average_window": self._avg_rate,
+                           "min_average_window": self._min_win,
+                           "max_average_window": self._max_win})
+        default_main_program().bump()
+
+    def _substitute_value(self, scope, param):
+        a = self._accs[param.name]
+
+        def val(nm):
+            v = scope.find_var(a[nm].name)
+            return None if v is None else np.asarray(v)
+
+        arrs = {nm: val(nm) for nm in a}
+        if any(v is None for v in arrs.values()):
+            return None
+        total = float(arrs["num_accumulates"].reshape(-1)[0] +
+                      arrs["old_num_accumulates"].reshape(-1)[0])
+        if total <= 0:
+            return None
+        return (arrs["sum_1"] + arrs["sum_2"] + arrs["sum_3"]) / total
+
+
+class LookaheadOptimizer:
+    """Lookahead (k steps forward, 1 step back) over a fast inner
+    optimizer (reference fluid/optimizer.py:4797).
+
+    Every k steps: slow += alpha * (fast - slow); fast = slow.  The
+    conditional is a pair of where-selects fused into the step (the
+    reference builds a switch block; lax.select is the XLA-native form).
+    """
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .framework.core import op_role_guard
+        from .framework.layer_helper import LayerHelper
+        from .layers import tensor as T
+
+        result = self.inner_optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+
+        main = default_main_program()
+        startup = default_startup_program()
+        params = [p for p in main.global_block().all_parameters()
+                  if p.trainable]
+        helper = LayerHelper("lookahead")
+        with op_role_guard(OpRole.Optimize):
+            step = T.create_global_var([1], 0.0, "int64",
+                                       persistable=True,
+                                       name=unique_name("lookahead_step"))
+            T.increment(step, 1.0)
+            mod = T.elementwise_mod(
+                step, T.fill_constant([1], "int64", float(self.k)))
+            sync = T.equal(mod, T.fill_constant([1], "int64", 0.0))
+            for p in params:
+                slow = T.create_global_var(
+                    list(p.shape), 0.0, "float32", persistable=True,
+                    name=unique_name(f"{p.name}.slow"))
+                # slow starts at the initialized param value
+                startup.global_block().append_op(
+                    "assign", inputs={"X": [p.name]},
+                    outputs={"Out": [slow.name]})
+                new_slow = T.elementwise_add(
+                    T.scale(slow, 1.0 - self.alpha),
+                    T.scale(p, self.alpha))
+                sel_slow = T.where(sync, new_slow, slow)
+                sel_fast = T.where(sync, new_slow, p)
+                helper.append_op("assign", inputs={"X": [sel_slow]},
+                                 outputs={"Out": [slow]})
+                helper.append_op("assign", inputs={"X": [sel_fast]},
+                                 outputs={"Out": [p]})
+        main.bump()
+        return result
 
     def __getattr__(self, item):
         return getattr(self.__dict__["inner_optimizer"], item)

@@ -1,0 +1,267 @@
+"""LR schedulers / gradient clipping / EMA / ModelAverage / Lookahead.
+
+Reference test models: test_learning_rate_scheduler.py (closed-form
+comparison per schedule), test_gradient_clip.py, test_ema.py,
+test_lookahead.py.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import clip, layers, optimizer
+
+
+def _run_schedule(build_fn, steps=8):
+    """Build schedule in a fresh program, run `steps` steps, return lrs."""
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        lr = build_fn()
+    exe = pt.Executor()
+    exe.run(startup)
+    out = []
+    for _ in range(steps):
+        v, = exe.run(main, feed={}, fetch_list=[lr])
+        out.append(float(np.asarray(v).reshape(-1)[0]))
+    return out
+
+
+def test_exponential_decay():
+    got = _run_schedule(lambda: layers.exponential_decay(
+        learning_rate=0.1, decay_steps=4, decay_rate=0.5))
+    want = [0.1 * 0.5 ** (s / 4) for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_exponential_decay_staircase():
+    got = _run_schedule(lambda: layers.exponential_decay(
+        learning_rate=0.1, decay_steps=4, decay_rate=0.5, staircase=True))
+    want = [0.1 * 0.5 ** (s // 4) for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_natural_exp_decay():
+    got = _run_schedule(lambda: layers.natural_exp_decay(
+        learning_rate=0.1, decay_steps=4, decay_rate=0.5))
+    want = [0.1 * math.exp(-0.5 * s / 4) for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_inverse_time_decay():
+    got = _run_schedule(lambda: layers.inverse_time_decay(
+        learning_rate=0.1, decay_steps=4, decay_rate=0.5))
+    want = [0.1 / (1 + 0.5 * s / 4) for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_noam_decay():
+    d_model, warmup = 64, 4
+    got = _run_schedule(lambda: layers.noam_decay(d_model, warmup))
+    want = [d_model ** -0.5 * min((s + 1) ** -0.5,
+                                  (s + 1) * warmup ** -1.5)
+            for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_polynomial_decay():
+    got = _run_schedule(lambda: layers.polynomial_decay(
+        learning_rate=0.1, decay_steps=4, end_learning_rate=0.01,
+        power=2.0))
+    want = [(0.1 - 0.01) * (1 - min(s, 4) / 4) ** 2 + 0.01
+            for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_piecewise_decay():
+    got = _run_schedule(lambda: layers.piecewise_decay(
+        boundaries=[3, 6], values=[0.1, 0.01, 0.001]), steps=9)
+    want = [0.1] * 3 + [0.01] * 3 + [0.001] * 3
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_cosine_decay():
+    got = _run_schedule(lambda: layers.cosine_decay(
+        learning_rate=0.1, step_each_epoch=2, epochs=4))
+    want = [0.05 * (math.cos((s // 2) * math.pi / 4) + 1)
+            for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_linear_lr_warmup_wraps_decay():
+    got = _run_schedule(lambda: layers.linear_lr_warmup(
+        layers.exponential_decay(0.1, 4, 0.5), warmup_steps=4,
+        start_lr=0.0, end_lr=0.1))
+    want = [0.1 * s / 4 if s < 4 else 0.1 * 0.5 ** (s / 4)
+            for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_scheduler_drives_sgd():
+    """LR schedule actually scales the update."""
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], append_batch_size=False)
+        w = layers.create_parameter([4], "float32", name="w",
+                                    default_initializer=pt.initializer.
+                                    Constant(1.0))
+        loss = layers.reduce_sum(layers.elementwise_mul(w, x))
+        lr = layers.piecewise_decay([2], [0.1, 0.0])
+        optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.ones(4, "float32")
+    for _ in range(4):
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    from paddle_tpu.framework.executor import global_scope
+    w_val = np.asarray(global_scope().find_var("w"))
+    # 2 steps at lr=0.1 (grad = 1), then lr=0 -> w = 1 - 0.2
+    np.testing.assert_allclose(w_val, np.full(4, 0.8), rtol=1e-5)
+
+
+def _grad_clip_setup(grad_clip, xv):
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], append_batch_size=False)
+        w = layers.create_parameter([4], "float32", name="w",
+                                    default_initializer=pt.initializer.
+                                    Constant(0.0))
+        loss = layers.reduce_sum(layers.elementwise_mul(w, x))
+        optimizer.SGD(learning_rate=1.0, grad_clip=grad_clip).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    from paddle_tpu.framework.executor import global_scope
+    return np.asarray(global_scope().find_var("w"))
+
+
+def test_grad_clip_by_global_norm():
+    xv = np.array([3.0, 4.0, 0.0, 0.0], "float32")  # ||g|| = 5
+    w = _grad_clip_setup(clip.GradientClipByGlobalNorm(1.0), xv)
+    np.testing.assert_allclose(w, -xv / 5.0, rtol=1e-5)
+
+
+def test_grad_clip_by_norm():
+    xv = np.array([3.0, 4.0, 0.0, 0.0], "float32")
+    w = _grad_clip_setup(clip.GradientClipByNorm(2.5), xv)
+    np.testing.assert_allclose(w, -xv / 2.0, rtol=1e-5)
+
+
+def test_grad_clip_by_value():
+    xv = np.array([3.0, -4.0, 0.5, 0.0], "float32")
+    w = _grad_clip_setup(clip.GradientClipByValue(1.0), xv)
+    np.testing.assert_allclose(w, -np.clip(xv, -1, 1), rtol=1e-5)
+
+
+def test_grad_clip_no_clip_when_under_norm():
+    xv = np.array([0.3, 0.4, 0.0, 0.0], "float32")  # ||g|| = 0.5 < 1
+    w = _grad_clip_setup(clip.GradientClipByGlobalNorm(1.0), xv)
+    np.testing.assert_allclose(w, -xv, rtol=1e-5)
+
+
+def test_set_gradient_clip_program_default():
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], append_batch_size=False)
+        w = layers.create_parameter([4], "float32", name="w",
+                                    default_initializer=pt.initializer.
+                                    Constant(0.0))
+        loss = layers.reduce_sum(layers.elementwise_mul(w, x))
+        clip.set_gradient_clip(clip.GradientClipByGlobalNorm(1.0))
+        optimizer.SGD(learning_rate=1.0).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.array([3.0, 4.0, 0.0, 0.0], "float32")
+    exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    from paddle_tpu.framework.executor import global_scope
+    np.testing.assert_allclose(np.asarray(global_scope().find_var("w")),
+                               -xv / 5.0, rtol=1e-5)
+
+
+def test_ema():
+    decay = 0.5
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [2], append_batch_size=False)
+        w = layers.create_parameter([2], "float32", name="w",
+                                    default_initializer=pt.initializer.
+                                    Constant(1.0))
+        loss = layers.reduce_sum(layers.elementwise_mul(w, x))
+        optimizer.SGD(learning_rate=0.5).minimize(loss)
+        ema = optimizer.ExponentialMovingAverage(decay)
+        ema.update()
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.ones(2, "float32")
+    # replicate: w_t = w_{t-1} - 0.5 (grad = 1); ema after update
+    w_host, ema_host = 1.0, 0.0
+    for _ in range(3):
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        w_host -= 0.5
+        ema_host = decay * ema_host + (1 - decay) * w_host
+    from paddle_tpu.framework.executor import global_scope
+    np.testing.assert_allclose(np.asarray(global_scope().find_var("w")),
+                               np.full(2, w_host), rtol=1e-5)
+    corrected = ema_host / (1 - decay ** 3)
+    with ema.apply(exe):
+        np.testing.assert_allclose(
+            np.asarray(global_scope().find_var("w")),
+            np.full(2, corrected), rtol=1e-5)
+    # restored afterwards
+    np.testing.assert_allclose(np.asarray(global_scope().find_var("w")),
+                               np.full(2, w_host), rtol=1e-5)
+
+
+def test_model_average():
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [2], append_batch_size=False)
+        w = layers.create_parameter([2], "float32", name="w",
+                                    default_initializer=pt.initializer.
+                                    Constant(1.0))
+        loss = layers.reduce_sum(layers.elementwise_mul(w, x))
+        optimizer.SGD(learning_rate=1.0).minimize(loss)
+        avg = optimizer.ModelAverage(0.5, min_average_window=2,
+                                     max_average_window=100)
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.ones(2, "float32")
+    seen = []
+    w_host = 1.0
+    for _ in range(4):
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        w_host -= 1.0
+        seen.append(w_host)  # accumulates post-update value
+    from paddle_tpu.framework.executor import global_scope
+    with avg.apply(exe):
+        got = np.asarray(global_scope().find_var("w"))
+    # window covers the last steps; average of accumulated params
+    assert got[0] <= seen[0] + 1e-6 and got[0] >= seen[-1] - 1e-6
+    np.testing.assert_allclose(np.asarray(global_scope().find_var("w")),
+                               np.full(2, w_host), rtol=1e-5)
+
+
+def test_lookahead():
+    alpha, k = 0.5, 2
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [2], append_batch_size=False)
+        w = layers.create_parameter([2], "float32", name="w",
+                                    default_initializer=pt.initializer.
+                                    Constant(1.0))
+        loss = layers.reduce_sum(layers.elementwise_mul(w, x))
+        inner = optimizer.SGD(learning_rate=1.0)
+        optimizer.LookaheadOptimizer(inner, alpha=alpha, k=k).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.ones(2, "float32")
+    fast, slow = 1.0, 1.0
+    for step in range(1, 5):
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        fast -= 1.0
+        if step % k == 0:
+            slow = slow + alpha * (fast - slow)
+            fast = slow
+    from paddle_tpu.framework.executor import global_scope
+    np.testing.assert_allclose(np.asarray(global_scope().find_var("w")),
+                               np.full(2, fast), rtol=1e-5)
