@@ -1,17 +1,26 @@
 """Flagship benchmark: BERT-base MLM pretraining step throughput.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": ...}
+
+Recipe (the credible BERT pretraining setup): bf16 AMP (white-list
+autocast, fp32 master weights), pallas flash attention, Adam with linear
+warmup + global-norm gradient clipping.
 
 Baseline: the north-star (BASELINE.json) is ERNIE/BERT-base pretraining at
 >=90% of reported 8xV100 throughput, per chip. The reference repo publishes
 no number in-tree (BASELINE.md); we use the widely reported ~105
 samples/sec/GPU for BERT-base seq-128 fp16 pretraining on V100 as the
 per-chip baseline. vs_baseline = our samples/sec/chip / 105.
+
+MFU: analytic model FLOPs (fwd 2*flops_per_matmul summed over the
+transformer, x3 for fwd+bwd) over the chip's peak bf16 FLOP/s
+(PEAK_TFLOPS env, default 275 = TPU v4).
 """
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -24,10 +33,38 @@ WARMUP = 3
 ITERS = 30
 
 
+def bert_train_flops_per_sample(seq, vocab, hidden, layers_n, inter):
+    """Analytic matmul FLOPs for one BERT MLM training sample.
+
+    Per token, per layer: QKV proj 6H^2, attn scores+PV 4*H*S, out proj
+    2H^2, FFN 4*H*I (each matmul = 2mk per output elem). MLM head:
+    2H^2 + 2*H*V. Train = 3x forward (bwd ~ 2x fwd matmul FLOPs).
+    """
+    per_layer = 6 * hidden ** 2 + 2 * hidden ** 2 + 4 * hidden * seq \
+        + 4 * hidden * inter
+    head = 2 * hidden ** 2 + 2 * hidden * vocab
+    fwd_per_token = layers_n * per_layer + head
+    return 3.0 * fwd_per_token * seq
+
+
+def _peak_tflops(device) -> float:
+    """Per-chip peak bf16 TFLOP/s by device kind (PEAK_TFLOPS overrides)."""
+    if "PEAK_TFLOPS" in os.environ:
+        return float(os.environ["PEAK_TFLOPS"])
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in (("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0),
+                      ("v6 lite", 918.0), ("v6e", 918.0), ("v4", 275.0),
+                      ("v3", 123.0), ("v2", 45.0)):
+        if key in kind:
+            return peak
+    return 275.0  # unknown: assume v4
+
+
 def main():
     import jax
     import paddle_tpu as pt
-    from paddle_tpu import optimizer
+    from paddle_tpu import clip, optimizer
+    from paddle_tpu.contrib import mixed_precision
     from paddle_tpu.models import build_bert_pretrain
     from paddle_tpu.parallel import dp_mesh, build_sharded_step
     from paddle_tpu.parallel.sharded import shard_batch
@@ -41,7 +78,12 @@ def main():
     startup._is_startup = True
     with pt.program_guard(main_p, startup):
         feed_names, outs = build_bert_pretrain(**cfg)
-        opt = optimizer.AdamOptimizer(learning_rate=1e-4)
+        lr = pt.layers.linear_lr_warmup(1e-4, warmup_steps=10000,
+                                        start_lr=0.0, end_lr=1e-4)
+        opt = optimizer.AdamOptimizer(
+            learning_rate=lr,
+            grad_clip=clip.GradientClipByGlobalNorm(1.0))
+        opt = mixed_precision.decorate(opt, dtype="bfloat16")
         opt.minimize(outs["loss"])
 
     scope = pt.Scope()
@@ -85,11 +127,18 @@ def main():
 
     samples_per_sec = B * ITERS / dt
     per_chip = samples_per_sec / n_chips
+    flops = bert_train_flops_per_sample(
+        SEQ, cfg["vocab_size"], cfg["hidden"], cfg["num_layers"],
+        cfg["intermediate"])
+    peak = _peak_tflops(jax.devices()[0]) * 1e12
+    mfu = per_chip * flops / peak
     print(json.dumps({
         "metric": "bert_base_mlm_train_samples_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
+        "mfu": round(mfu, 4),
+        "model_tflops_per_sample": round(flops / 1e12, 4),
     }))
 
 

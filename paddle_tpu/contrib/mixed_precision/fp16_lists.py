@@ -9,7 +9,7 @@ from __future__ import annotations
 white_list = {
     "conv2d", "conv2d_transpose", "depthwise_conv2d",
     "matmul", "matmul_v2", "mul", "bmm", "dot",
-    "fused_attention",
+    "fused_attention", "flash_attention",
 }
 
 black_list = {

@@ -521,20 +521,24 @@ def pad(x, paddings, pad_value=0.0, name=None):
     return out
 
 
-def flash_attention(q, k, v, causal=False, scale=None,
+def flash_attention(q, k, v, bias=None, causal=False, scale=None,
                     seq_parallel_mode="ring", name=None):
     """Fused multi-head attention; q/k/v: [B, H, S, D].
 
     Lowers to the pallas TPU kernel, or ring/Ulysses attention when the
     sequence is sharded over the `sp` mesh axis (ops/attention_ops.py).
+    bias: optional additive score bias [B, S] (or [B,1,1,S]) — the padding
+    mask, 0 = attend / -1e4 = pad.
     """
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     attrs = {"causal": causal, "seq_parallel_mode": seq_parallel_mode}
     if scale is not None:
         attrs["scale"] = float(scale)
-    helper.append_op("flash_attention",
-                     inputs={"Q": [q], "K": [k], "V": [v]},
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    helper.append_op("flash_attention", inputs=inputs,
                      outputs={"Out": [out]}, attrs=attrs)
     return out
 

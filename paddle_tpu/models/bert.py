@@ -20,8 +20,15 @@ from .. import layers
 
 
 def _attention(x, hidden, num_heads, seq_len, attn_bias=None, dropout=0.0,
-               is_test=False):
-    """Multi-head self-attention. x: [-1, S, H]."""
+               is_test=False, use_flash=True):
+    """Multi-head self-attention. x: [-1, S, H].
+
+    use_flash=True routes through the fused flash_attention op (pallas on
+    TPU). Attention-probability dropout is folded out on that path — the
+    standard trade of fused-attention kernels; output dropout is kept.
+    use_flash=False keeps the unfused batched-matmul formulation (exact
+    reference math incl. prob dropout, and the parity baseline in tests).
+    """
     head_dim = hidden // num_heads
     qkv = layers.fc(x, size=3 * hidden, num_flatten_dims=2)  # [B,S,3H]
     qkv = layers.reshape(qkv, [0, seq_len, 3, num_heads, head_dim])
@@ -29,15 +36,25 @@ def _attention(x, hidden, num_heads, seq_len, attn_bias=None, dropout=0.0,
     q = layers.squeeze(layers.slice(qkv, axes=[0], starts=[0], ends=[1]), [0])
     k = layers.squeeze(layers.slice(qkv, axes=[0], starts=[1], ends=[2]), [0])
     v = layers.squeeze(layers.slice(qkv, axes=[0], starts=[2], ends=[3]), [0])
-    scores = layers.matmul(q, k, transpose_y=True,
-                           alpha=1.0 / math.sqrt(head_dim))  # [B,Hd,S,S]
-    if attn_bias is not None:
-        scores = layers.elementwise_add(scores, attn_bias)
-    probs = layers.softmax(scores)
-    if dropout and not is_test:
-        probs = layers.dropout(probs, dropout, is_test=is_test,
-                               dropout_implementation="upscale_in_train")
-    ctx = layers.matmul(probs, v)  # [B,Hd,S,D]
+    if use_flash:
+        if dropout and not is_test:
+            import warnings
+            warnings.warn(
+                "bert: flash attention folds out attention-probability "
+                "dropout (output dropout kept); use use_flash=False for "
+                "exact reference regularization", stacklevel=3)
+        ctx = layers.flash_attention(q, k, v, bias=attn_bias)
+    else:
+        scores = layers.matmul(q, k, transpose_y=True,
+                               alpha=1.0 / math.sqrt(head_dim))  # [B,Hd,S,S]
+        if attn_bias is not None:
+            bias4d = layers.unsqueeze(layers.unsqueeze(attn_bias, [1]), [1])
+            scores = layers.elementwise_add(scores, bias4d)
+        probs = layers.softmax(scores)
+        if dropout and not is_test:
+            probs = layers.dropout(probs, dropout, is_test=is_test,
+                                   dropout_implementation="upscale_in_train")
+        ctx = layers.matmul(probs, v)  # [B,Hd,S,D]
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
     ctx = layers.reshape(ctx, [0, seq_len, hidden])
     return layers.fc(ctx, size=hidden, num_flatten_dims=2)
@@ -51,7 +68,7 @@ def _ffn(x, hidden, intermediate):
 def bert_encoder(input_ids, token_type_ids=None, attn_mask=None,
                  vocab_size=30522, hidden=768, num_layers=12, num_heads=12,
                  seq_len=128, intermediate=3072, max_position=512,
-                 type_vocab=2, dropout=0.1, is_test=False):
+                 type_vocab=2, dropout=0.1, is_test=False, use_flash=True):
     """Returns final hidden states [-1, S, H].
 
     input_ids/token_type_ids: [-1, S] int64; attn_mask: [-1, S] float32
@@ -71,13 +88,13 @@ def bert_encoder(input_ids, token_type_ids=None, attn_mask=None,
 
     attn_bias = None
     if attn_mask is not None:
-        # [B,S] -> additive bias [B,1,1,S]
-        neg = layers.scale(attn_mask, scale=10000.0, bias=-10000.0)
-        attn_bias = layers.unsqueeze(layers.unsqueeze(neg, [1]), [1])
+        # [B,S] additive bias rows (flash path broadcasts over heads/q;
+        # unfused path unsqueezes to [B,1,1,S])
+        attn_bias = layers.scale(attn_mask, scale=10000.0, bias=-10000.0)
 
     for _ in range(num_layers):
         attn = _attention(x, hidden, num_heads, seq_len, attn_bias,
-                          dropout, is_test)
+                          dropout, is_test, use_flash=use_flash)
         if dropout and not is_test:
             attn = layers.dropout(attn, dropout, is_test=is_test,
                                   dropout_implementation="upscale_in_train")
@@ -94,7 +111,8 @@ def bert_encoder(input_ids, token_type_ids=None, attn_mask=None,
 
 def build_bert_pretrain(batch_size=None, seq_len=128, vocab_size=30522,
                         hidden=768, num_layers=12, num_heads=12,
-                        intermediate=3072, dropout=0.1, is_test=False):
+                        intermediate=3072, dropout=0.1, is_test=False,
+                        use_flash=True):
     """MLM pretraining graph (masked positions scored over full vocab).
 
     Feeds: input_ids, token_type_ids, attn_mask [B,S]; mlm_labels [B,S]
@@ -118,7 +136,8 @@ def build_bert_pretrain(batch_size=None, seq_len=128, vocab_size=30522,
                        vocab_size=vocab_size, hidden=hidden,
                        num_layers=num_layers, num_heads=num_heads,
                        seq_len=seq_len, intermediate=intermediate,
-                       dropout=dropout, is_test=is_test)
+                       dropout=dropout, is_test=is_test,
+                       use_flash=use_flash)
     # MLM head: transform + layernorm + vocab projection
     h = layers.fc(enc, size=hidden, num_flatten_dims=2, act="gelu")
     h = layers.layer_norm(h, begin_norm_axis=2)

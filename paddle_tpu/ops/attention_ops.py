@@ -22,12 +22,18 @@ def _attn_infer(op, block):
 def _flash_attention(ctx, op):
     import jax
 
-    from .pallas.flash_attention import blockwise_attention, flash_attention
+    from .pallas.flash_attention import (blockwise_attention,
+                                         flash_attention,
+                                         flash_attention_bias)
     from ..parallel.ring import ring_attention, ulysses_attention
 
     q = ctx.get_input(op, "Q")
     k = ctx.get_input(op, "K")
     v = ctx.get_input(op, "V")
+    bias = ctx.get_input(op, "Bias") if op.single_input("Bias") else None
+    if bias is not None and bias.ndim != 2:
+        # accept [B,1,1,S]-style additive masks; flatten to rows [B, S]
+        bias = bias.reshape(bias.shape[0], bias.shape[-1])
     causal = op.attr("causal", False)
     sm_scale = op.attr("scale", None)
     mode = op.attr("seq_parallel_mode", "ring")
@@ -36,13 +42,20 @@ def _flash_attention(ctx, op):
     mesh = ctx.mesh
     multi_device = mesh is not None and mesh.devices.size > 1
     if SP_AXIS in axes:
+        if bias is not None:
+            raise NotImplementedError(
+                "flash_attention: padding bias under sequence parallelism "
+                "not supported yet — pad-free bucketing or causal only")
         fn = ring_attention if mode == "ring" else ulysses_attention
         out = fn(q, k, v, SP_AXIS, causal=causal, sm_scale=sm_scale)
     elif jax.default_backend() == "tpu" and not multi_device:
-        out = flash_attention(q, k, v, causal, sm_scale)
+        if bias is not None:
+            out = flash_attention_bias(q, k, v, bias, causal, sm_scale)
+        else:
+            out = flash_attention(q, k, v, causal, sm_scale)
     else:
         # multi-device GSPMD: the einsum formulation lets the partitioner
         # shard batch/head/seq dims freely (pallas_call pins the layout)
         out, _ = blockwise_attention(q, k, v, causal=causal,
-                                     sm_scale=sm_scale)
+                                     sm_scale=sm_scale, bias=bias)
     ctx.set_output(op, "Out", out)

@@ -30,12 +30,13 @@ NEG_INF = -1e30
 # ---------------------------------------------------------------------------
 
 def blockwise_attention(q, k, v, causal=False, sm_scale=None,
-                        block_k=DEFAULT_BLOCK_K, kv_offset=0):
+                        block_k=DEFAULT_BLOCK_K, kv_offset=0, bias=None):
     """Online-softmax attention, scanning kv blocks.
 
     q: [B, H, Sq, D], k/v: [B, H, Sk, D]. kv_offset shifts the global kv
     position for causal masking (ring attention passes the rotating
-    shard's offset).
+    shard's offset). bias: optional [B, Sk] additive score bias
+    (padding mask: 0 attend / -1e4 pad), broadcast over heads and q.
     Returns (out, (m, l)): out [B,H,Sq,D], m/l the softmax running stats
     [B,H,Sq] (used by ring accumulation).
     """
@@ -55,13 +56,21 @@ def blockwise_attention(q, k, v, causal=False, sm_scale=None,
     vf = v.astype(jnp.float32).reshape(B, H, nblocks, bk, D)
     kf = jnp.moveaxis(kf, 2, 0)  # [n, B, H, bk, D]
     vf = jnp.moveaxis(vf, 2, 0)
+    if bias is not None:
+        bf = bias.astype(jnp.float32).reshape(B, nblocks, bk)
+        bf = jnp.moveaxis(bf, 1, 0)  # [n, B, bk]
+        xs = (kf, vf, bf)
+    else:
+        xs = (kf, vf)
 
     q_pos = jnp.arange(Sq)[:, None]
 
     def body(carry, blk):
         m, l, acc, j = carry
-        kb, vb = blk
+        kb, vb = blk[:2]
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb)  # [B,H,Sq,bk]
+        if len(blk) == 3:
+            s = s + blk[2][:, None, None, :]
         if causal:
             k_pos = j * bk + jnp.arange(bk)[None, :] + kv_offset
             mask = q_pos >= k_pos
@@ -82,7 +91,7 @@ def blockwise_attention(q, k, v, causal=False, sm_scale=None,
     m0 = qf[..., 0] * 0 + NEG_INF
     l0 = qf[..., 0] * 0
     acc0 = qf * 0
-    (m, l, acc, _), _ = jax.lax.scan(body, (m0, l0, acc0, 0), (kf, vf))
+    (m, l, acc, _), _ = jax.lax.scan(body, (m0, l0, acc0, 0), xs)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype), (m, l)
 
@@ -91,12 +100,16 @@ def blockwise_attention(q, k, v, causal=False, sm_scale=None,
 # pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
-               seq_k):
+def _fa_kernel(q_ref, k_ref, v_ref, *rest, block_k, causal, scale,
+               seq_k, has_bias=False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    if has_bias:
+        b_ref, o_ref = rest
+    else:
+        (o_ref,) = rest
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # [Bq, D]
     bq, d = q.shape
@@ -109,6 +122,10 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [Bq, Bk]
+        if has_bias:
+            bb = b_ref[0, 0, pl.ds(j * block_k, block_k)].astype(
+                jnp.float32)
+            s = s + bb[None, :]
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
@@ -135,7 +152,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+                   bias=None):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -156,19 +174,29 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     vr = v.reshape(B * H, Sk, D)
 
     kernel = functools.partial(_fa_kernel, block_k=bk, causal=causal,
-                               scale=scale, seq_k=Sk)
+                               scale=scale, seq_k=Sk,
+                               has_bias=bias is not None)
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+    ]
+    args = [qr, kr, vr]
+    if bias is not None:
+        # one bias row per batch, shared across the H heads in the grid;
+        # [B, 1, Sk] so the block's trailing dims (1, Sk) match the array
+        # (Mosaic tiling requires 8/128-divisible or full-dim blocks)
+        in_specs.append(
+            pl.BlockSpec((1, 1, Sk), lambda b, i: (b // H, 0, 0)))
+        args.append(bias.reshape(B, 1, Sk))
     out = pl.pallas_call(
         kernel,
         grid=(B * H, Sq // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
         interpret=interpret,
-    )(qr, kr, vr)
+    )(*args)
     return out.reshape(B, H, Sq, D)
 
 
@@ -204,3 +232,35 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def flash_attention_bias(q, k, v, bias, causal=False, sm_scale=None,
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                         interpret=False):
+    """flash_attention with an additive [B, Sk] score bias (padding
+    mask). Separate entry so the unbiased path keeps its 3-arg vjp."""
+    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret, bias=bias)
+
+
+def _fab_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                         interpret, bias=bias)
+    return out, (q, k, v, bias)
+
+
+def _fab_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    import jax
+    q, k, v, bias = res
+
+    def ref(q, k, v, bias):
+        return blockwise_attention(q, k, v, causal=causal,
+                                   sm_scale=sm_scale, block_k=block_k,
+                                   bias=bias)[0]
+
+    _, vjp = jax.vjp(ref, q, k, v, bias)
+    return vjp(g)
+
+
+flash_attention_bias.defvjp(_fab_fwd, _fab_bwd)

@@ -753,40 +753,50 @@ class _ParamSwapBase:
     _params: List[Variable]
     _backups: Dict[str, object]
 
-    def apply(self, executor=None, need_restore=True):
-        """Context manager: swap params to the substituted values."""
+    def apply(self, executor=None, need_restore=True, scope=None):
+        """Context manager: swap params to the substituted values.
+
+        Pass `scope` when training ran in an explicit (non-global) scope;
+        the `executor` arg exists for reference API parity only."""
         import contextlib
 
         @contextlib.contextmanager
         def _ctx():
-            self._swap_in()
+            self._swap_in(scope)
             try:
                 yield
             finally:
                 if need_restore:
-                    self.restore(executor)
+                    self.restore(executor, scope=scope)
         return _ctx()
 
-    def _swap_in(self):
+    def _swap_in(self, scope=None):
         from .framework.executor import global_scope
-        scope = global_scope()
+        scope = scope or global_scope()
         self._backups = {}
+        swapped = 0
         for p in self._params:
             cur = scope.find_var(p.name)
             if cur is None:
-                continue  # startup not run / foreign scope: skip quietly
+                continue  # startup not run in this scope
             sub = self._substitute_value(scope, p)
             if sub is None:
                 continue
             self._backups[p.name] = cur
             scope.set_var(p.name, sub.astype(np.asarray(cur).dtype))
+            swapped += 1
+        if self._params and not swapped:
+            raise RuntimeError(
+                f"{type(self).__name__}.apply(): no parameter values found "
+                "in the scope — did training run in a different scope? "
+                "Pass it via apply(..., scope=your_scope).")
 
     def _substitute_value(self, scope, param):
         raise NotImplementedError
 
-    def restore(self, executor=None):
+    def restore(self, executor=None, scope=None):
         from .framework.executor import global_scope
-        scope = global_scope()
+        scope = scope or global_scope()
         for name, val in self._backups.items():
             scope.set_var(name, val)
         self._backups = {}
