@@ -368,13 +368,20 @@ def _lower_auto_grad(ctx: LowerContext, gop: Operator):
     in_grads = vjp_fn(tuple(cotangents))
     grad_by_name = dict(zip(diff_names, in_grads))
 
+    written = set()
     for slot, i, gname in wanted:
         src = fwd_inputs[slot][i]
         val = grad_by_name[src]
-        # accumulate if two fwd slots fed from the same var
+        if gname in written:
+            # same fwd var feeds multiple slots of THIS op (e.g. x*x):
+            # jax.vjp already summed all paths into grad_by_name[src] —
+            # writing again would double-count
+            continue
+        # accumulate across DIFFERENT consumers of the fwd var
         if gname in ctx.env and gop.attr("__accumulate__", False):
             val = ctx.env[gname] + val
         ctx.env[gname] = val
+        written.add(gname)
 
 
 def infer_auto_grad(gop: Operator, block: Block):

@@ -774,18 +774,18 @@ class _ParamSwapBase:
         from .framework.executor import global_scope
         scope = scope or global_scope()
         self._backups = {}
-        swapped = 0
+        found = 0
         for p in self._params:
             cur = scope.find_var(p.name)
             if cur is None:
                 continue  # startup not run in this scope
+            found += 1
             sub = self._substitute_value(scope, p)
             if sub is None:
-                continue
+                continue  # e.g. nothing accumulated yet: keep raw value
             self._backups[p.name] = cur
             scope.set_var(p.name, sub.astype(np.asarray(cur).dtype))
-            swapped += 1
-        if self._params and not swapped:
+        if self._params and not found:
             raise RuntimeError(
                 f"{type(self).__name__}.apply(): no parameter values found "
                 "in the scope — did training run in a different scope? "

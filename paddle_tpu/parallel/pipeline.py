@@ -15,10 +15,14 @@ single compiled step:
   * persistable state written in the body (BN stats, loss-scale state)
     is threaded as scan carry.
 GPipe's memory profile comes for free: XLA keeps one microbatch of
-activations live per scan iteration. Stage tags (__stage__, from
-device_guard) are preserved for placement; on a pp mesh the uniform-stage
-fast path (stacked stage params + ppermute rotation) applies — see
-models/ transformer configs.
+activations live per scan iteration.
+
+This module is the single-mesh schedule-emulation path (exact parameter
+trajectory, no cross-device placement). For REAL pipeline parallelism —
+stage params physically placed per device over a `pp` mesh axis, with
+microbatch activations rotated via lax.ppermute — use
+parallel/pipeline_pp.py (build_pp_pipeline_step), the stacked-stage
+fast path for structurally uniform stages.
 """
 from __future__ import annotations
 

@@ -1,0 +1,526 @@
+"""Cross-device pipeline parallelism over a `pp` mesh axis.
+
+Reference semantics: PipelineTrainer/SectionWorker (framework/
+section_worker.cc:44-119) — each device owns one program section;
+microbatches stream through the sections with the GPipe flush schedule
+(all-F, all-B, update).
+
+TPU-native formulation (the "stacked-stage fast path" — scaling-book
+pipelining recipe):
+  * the P structurally-identical stages' parameters are STACKED on a
+    leading dim sharded over `pp` — each device physically holds exactly
+    its stage's weights (true placement, not just schedule emulation);
+  * execution is one `lax.scan` over T = M + P - 1 ticks inside
+    `shard_map`; at tick t device s computes microbatch t - s, then the
+    activation rotates to s+1 via `lax.ppermute` (one ICI hop);
+  * the backward pipeline is NOT hand-written: `jax.grad` through the
+    tick scan transposes every ppermute into the reverse rotation, which
+    IS the GPipe backward schedule — bubbles included;
+  * the loss lives on the last stage; psum over `pp` publishes it.
+    Composes with `dp`: microbatch rows shard over `dp`, gradients psum
+    over `dp` (the usual data-parallel all-reduce).
+
+Requirements on the program (checked at build):
+  * every Forward-role compute op is tagged with `__stage__` (via
+    ``device_guard``) except a loss epilogue after the last stage;
+  * the P stages are structurally identical: same op-type sequence, same
+    parameter shapes in the same order (a transformer's layer stack);
+  * exactly one activation var crosses each stage boundary;
+  * the epilogue owns no trainable parameters.
+The IR's Backward-role ops are intentionally unused here — AD of the
+staged forward replaces them (same math, pipeline-shaped schedule); the
+Optimize-role ops run on the stacked state so the update rule (and its
+optimizer-state vars) match plain training.
+
+Scope layout: stacked state lives under ``__ppstack__/<stage0-name>``.
+``prepare_scope(scope)`` stacks the per-stage values from the startup
+program into placed arrays (NamedSharding over pp) once;
+``sync_scope(scope)`` writes them back per-stage for save/load.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..framework.core import OpRole, Program
+from ..ops.registry import LowerContext, lower_op
+from .mesh import DP_AXIS, PP_AXIS
+
+STACK_PREFIX = "__ppstack__/"
+
+
+def _is_forward(op) -> bool:
+    role = op.attr("op_role", OpRole.Forward)
+    return role in (OpRole.Forward, OpRole.Forward | OpRole.Loss)
+
+
+def _is_optimize(op) -> bool:
+    role = op.attr("op_role", OpRole.Forward)
+    return role in (OpRole.Optimize, OpRole.LRSched,
+                    OpRole.Optimize | OpRole.Loss)
+
+
+def _op_signature(op):
+    """Structural identity of an op, ignoring variable names."""
+    attrs = {k: v for k, v in op.attrs.items()
+             if k not in ("__stage__", "__op_seed__") and
+             not isinstance(v, np.ndarray)}
+    return (op.type, tuple(sorted(op.inputs)), tuple(sorted(op.outputs)),
+            tuple(sorted((k, str(v)) for k, v in attrs.items())))
+
+
+def _reads(ops):
+    return [n for op in ops for n in op.input_arg_names() if n]
+
+
+def _writes(ops):
+    return {n for op in ops for n in op.output_arg_names() if n}
+
+
+class _PPPlan:
+    """Static analysis of a staged program (see module docstring)."""
+
+    def __init__(self, program: Program, feed_names: Sequence[str],
+                 loss_name: str):
+        block = program.global_block()
+        self.block = block
+        self.loss_name = loss_name
+
+        fwd = [op for op in block.ops
+               if op.type not in ("feed", "fetch") and _is_forward(op)]
+        staged = [op for op in fwd if op.attr("__stage__") is not None]
+        if not staged:
+            raise ValueError("pp pipeline: no ops tagged with a stage "
+                             "(use device_guard while building)")
+        stages = sorted({op.attr("__stage__") for op in staged})
+        if stages != list(range(len(stages))):
+            raise ValueError(f"pp pipeline: stage tags must be 0..P-1, "
+                             f"got {stages}")
+        self.num_stages = len(stages)
+        self.stage_ops: List[list] = [
+            [op for op in staged if op.attr("__stage__") == s]
+            for s in stages]
+        last_staged_idx = max(op.idx for op in staged)
+        self.epilogue_ops = [op for op in fwd
+                             if op.attr("__stage__") is None]
+        for op in self.epilogue_ops:
+            if op.idx < last_staged_idx:
+                raise ValueError(
+                    f"pp pipeline: untagged forward op {op.type!r} appears "
+                    "between staged ops; only a trailing loss epilogue may "
+                    "be untagged")
+
+        sig0 = [_op_signature(op) for op in self.stage_ops[0]]
+        for s in range(1, self.num_stages):
+            if [_op_signature(op) for op in self.stage_ops[s]] != sig0:
+                raise ValueError(
+                    f"pp pipeline: stage {s} is not structurally identical "
+                    "to stage 0 (the stacked fast path needs uniform "
+                    "stages)")
+
+        feed_set = set(feed_names)
+        stage_writes = [_writes(ops) for ops in self.stage_ops]
+
+        # per-stage trainable params, first-read order
+        self.stage_params: List[List[str]] = []
+        for ops in self.stage_ops:
+            params, seen = [], set()
+            for n in _reads(ops):
+                if n in seen:
+                    continue
+                seen.add(n)
+                v = block._find_var_recursive(n)
+                if v is not None and v.persistable and \
+                        getattr(v, "trainable", False):
+                    params.append(n)
+            self.stage_params.append(params)
+        shapes0 = [tuple(block.var(n).shape) for n in self.stage_params[0]]
+        for s in range(1, self.num_stages):
+            shapes = [tuple(block.var(n).shape)
+                      for n in self.stage_params[s]]
+            if shapes != shapes0:
+                raise ValueError(
+                    f"pp pipeline: stage {s} parameter shapes {shapes} != "
+                    f"stage 0 {shapes0}")
+
+        # boundary vars: one activation in/out per stage
+        self.boundary_in: List[str] = []
+        self.boundary_out: List[str] = []
+        epi_reads = set(_reads(self.epilogue_ops))
+        for s, ops in enumerate(self.stage_ops):
+            prev_w = stage_writes[s - 1] if s > 0 else feed_set
+            cand = list(dict.fromkeys(
+                n for n in _reads(ops) if n in prev_w))
+            if len(cand) != 1:
+                src = "the feed" if s == 0 else f"stage {s - 1}"
+                raise ValueError(
+                    f"pp pipeline: stage {s} must read exactly one "
+                    f"activation from {src}, got {cand}")
+            self.boundary_in.append(cand[0])
+            nxt = (set(_reads(self.stage_ops[s + 1]))
+                   if s + 1 < self.num_stages else epi_reads)
+            outs = list(dict.fromkeys(
+                o for op in ops for o in op.output_arg_names()
+                if o in nxt))
+            if len(outs) != 1:
+                raise ValueError(
+                    f"pp pipeline: stage {s} must hand exactly one "
+                    f"activation forward, got {outs}")
+            self.boundary_out.append(outs[0])
+
+        for n in epi_reads:
+            v = block._find_var_recursive(n)
+            if v is not None and v.persistable and \
+                    getattr(v, "trainable", False):
+                raise ValueError(
+                    "pp pipeline: the loss epilogue reads trainable "
+                    f"parameter {n!r}; keep head weights inside the last "
+                    "stage")
+        self.x_feed = self.boundary_in[0]
+        self.label_feeds = [n for n in feed_names
+                            if n in epi_reads and n != self.x_feed]
+        extra = [n for n in feed_names
+                 if n not in (self.x_feed, *self.label_feeds)]
+        if extra:
+            raise ValueError(f"pp pipeline: feeds {extra} are consumed by "
+                             "neither stage 0 nor the loss epilogue")
+
+        self._plan_optimizer(block)
+
+    def _plan_optimizer(self, block):
+        """Split Optimize/LRSched ops into per-param templates (replayed on
+        the stacked state) and shared ops (LR schedules, counters — run
+        once, replicated), and build the positional name mapping
+        stage0-name -> [per-stage names] for all stage-local state."""
+        opt_ops = [op for op in block.ops
+                   if op.type not in ("feed", "fetch") and _is_optimize(op)]
+        pos_of: Dict[str, Tuple[int, int]] = {}
+        for s, params in enumerate(self.stage_params):
+            for j, n in enumerate(params):
+                pos_of[n] = (s, j)
+
+        per_pos: Dict[Tuple[int, int], list] = {}
+        self.shared_opt_ops = []
+        for op in opt_ops:
+            touched = [n for n in list(op.input_arg_names()) +
+                       list(op.output_arg_names()) if n in pos_of]
+            if not touched:
+                if any(n.endswith("@GRAD") for n in op.input_arg_names()):
+                    raise ValueError(
+                        f"pp pipeline: optimize-role op {op.type!r} reads "
+                        "gradients across parameters (grad clip / "
+                        "regularizer rewrites); program-level gradient "
+                        "transformations are not supported on the stacked "
+                        "pp path yet — clip via the optimizer's per-param "
+                        "update or drop grad_clip")
+                self.shared_opt_ops.append(op)
+            else:
+                per_pos.setdefault(pos_of[touched[0]], []).append(op)
+
+        n_pos = len(self.stage_params[0])
+        self.opt_templates: List[list] = [per_pos.get((0, j), [])
+                                          for j in range(n_pos)]
+        shared_rw = set()
+        for op in self.shared_opt_ops:
+            shared_rw.update(op.input_arg_names())
+            shared_rw.update(op.output_arg_names())
+
+        # stage0 name -> list of per-stage names (params + optimizer state)
+        self.state_map: Dict[str, List[str]] = {}
+        for j in range(n_pos):
+            for s in range(self.num_stages):
+                self.state_map.setdefault(
+                    self.stage_params[0][j],
+                    [None] * self.num_stages)[s] = self.stage_params[s][j]
+        for j in range(n_pos):
+            tmpl = self.opt_templates[j]
+            for s in range(self.num_stages):
+                ops_s = per_pos.get((s, j), [])
+                if [_op_signature(o) for o in ops_s] != \
+                        [_op_signature(o) for o in tmpl]:
+                    raise ValueError(
+                        f"pp pipeline: optimizer ops for stage {s} param "
+                        f"{self.stage_params[s][j]!r} differ from stage 0")
+                for op0, ops_op in zip(tmpl, ops_s):
+                    pairs = []
+                    for slot in sorted(op0.inputs):
+                        pairs += list(zip(op0.input(slot),
+                                          ops_op.input(slot)))
+                    for slot in sorted(op0.outputs):
+                        pairs += list(zip(op0.output(slot),
+                                          ops_op.output(slot)))
+                    for n0, ns in pairs:
+                        v = block._find_var_recursive(n0)
+                        if v is None or not v.persistable or \
+                                n0 in shared_rw:
+                            continue
+                        row = self.state_map.setdefault(
+                            n0, [None] * self.num_stages)
+                        if row[s] is not None and row[s] != ns:
+                            raise ValueError(
+                                f"pp pipeline: ambiguous state mapping for "
+                                f"{n0!r} at stage {s}: {row[s]} vs {ns}")
+                        row[s] = ns
+        for n0, row in self.state_map.items():
+            if any(r is None for r in row):
+                raise ValueError(
+                    f"pp pipeline: incomplete stage mapping for {n0!r}: "
+                    f"{row}")
+        # grad var names the optimizer templates consume (non-persistable)
+        self.grad_names: List[Optional[str]] = []
+        for j, p0 in enumerate(self.stage_params[0]):
+            gname = None
+            for op in self.opt_templates[j]:
+                for n in op.input_arg_names():
+                    v = block._find_var_recursive(n)
+                    if (v is None or not v.persistable) and \
+                            n.endswith("@GRAD"):
+                        gname = n
+            self.grad_names.append(gname)
+
+
+def build_pp_pipeline_step(program: Program, feed_names: Sequence[str],
+                           fetch_names: Sequence[str],
+                           num_microbatches: int, mesh,
+                           loss_name: Optional[str] = None):
+    """Build the stacked-stage GPipe step over a mesh with a `pp` axis.
+
+    Same contract as build_sharded_step: returns
+    (fn, mut_in, const_in, extra_out) with
+    ``fn(feed_vals, mut_vals, const_vals, step) ->
+        (fetches, new_mut, extra)``.
+    mut_in contains STACK names (``__ppstack__/<stage0-name>``) for staged
+    state plus plain names for shared state; call ``fn.prepare_scope(s)``
+    once after the startup program to create the placed stacks, and
+    ``fn.sync_scope(s)`` to write them back per-stage (save/load).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    loss_name = loss_name or (fetch_names[0] if fetch_names else None)
+    if not loss_name:
+        raise ValueError("pp pipeline: need a loss to fetch")
+    for n in fetch_names:
+        if n != loss_name:
+            raise ValueError(
+                f"pp pipeline: only the loss is fetchable, got {n!r}")
+
+    plan = _PPPlan(program, feed_names, loss_name)
+    Pn = plan.num_stages
+    M = int(num_microbatches)
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if axis_sizes.get(PP_AXIS, 1) != Pn:
+        raise ValueError(
+            f"pp pipeline: program has {Pn} stages but mesh "
+            f"{PP_AXIS}={axis_sizes.get(PP_AXIS, 1)}")
+    ndp = axis_sizes.get(DP_AXIS, 1)
+    block = plan.block
+    seed = program.random_seed or 0
+
+    stack_names = list(plan.state_map)          # stage0 names
+    mut_stack = [STACK_PREFIX + n for n in stack_names]
+
+    # shared state: everything the shared opt ops + epilogue read/write
+    # that persists (lr vars, counters)
+    shared_state, seen = [], set()
+    for op in plan.shared_opt_ops + plan.epilogue_ops:
+        for n in list(op.input_arg_names()) + list(op.output_arg_names()):
+            if n in seen or not n:
+                continue
+            seen.add(n)
+            v = block._find_var_recursive(n)
+            if v is not None and v.persistable and n not in plan.state_map:
+                shared_state.append(n)
+    shared_written = _writes(plan.shared_opt_ops)
+    shared_mut = [n for n in shared_state if n in shared_written]
+    shared_const = [n for n in shared_state if n not in shared_written]
+
+    mut_in = mut_stack + shared_mut
+    const_in = list(shared_const)
+    extra_out: List[str] = []
+
+    def stage_forward(env_consts, params_pos, x, key):
+        """Lower the stage-0 op template with stage-local params."""
+        env = dict(env_consts)
+        env.update(zip(plan.stage_params[0], params_pos))
+        env[plan.boundary_in[0]] = x
+        ctx = LowerContext(block, env, base_key=key,
+                           amp=getattr(program, "_amp_lowering", None))
+        for op in plan.stage_ops[0]:
+            lower_op(ctx, op)
+        return env[plan.boundary_out[0]]
+
+    def epilogue(env_consts, y, labels, key):
+        env = dict(env_consts)
+        env[plan.boundary_out[-1]] = y
+        env.update(labels)
+        ctx = LowerContext(block, env, base_key=key,
+                           amp=getattr(program, "_amp_lowering", None))
+        for op in plan.epilogue_ops:
+            lower_op(ctx, op)
+        return env[loss_name]
+
+    def shard_body(feed_vals, mut_vals, const_vals, step):
+        base_key = jax.random.fold_in(jax.random.key(np.uint32(seed)),
+                                      step)
+        s_idx = jax.lax.axis_index(PP_AXIS)
+        if DP_AXIS in mesh.axis_names:
+            base_key = jax.random.fold_in(
+                base_key, jax.lax.axis_index(DP_AXIS))
+        base_key = jax.random.fold_in(base_key, s_idx)
+
+        stacks = {n: v for n, v in zip(stack_names, mut_vals)}
+        shared_vals = dict(zip(shared_mut,
+                               mut_vals[len(stack_names):]))
+        shared_vals.update(zip(shared_const, const_vals))
+        feeds = dict(zip(feed_names, feed_vals))
+
+        # [M, mb_local, ...] microbatched feeds (dp split by shard_map)
+        def chunk(a):
+            b = a.shape[0]
+            return a.reshape((M, b // M) + a.shape[1:])
+
+        x_mb = chunk(feeds[plan.x_feed])
+        lbl_mb = {n: chunk(feeds[n]) for n in plan.label_feeds}
+
+        local_params = [stacks[n][0] for n in plan.stage_params[0]]
+        other_state = {n: stacks[n][0] for n in stack_names
+                       if n not in plan.stage_params[0]}
+
+        T = M + Pn - 1
+        x_shape = x_mb.shape[1:]
+
+        def loss_of(local_params):
+            def tick(carry, t):
+                x_buf, loss_sum = carry
+                mb = jnp.clip(t, 0, M - 1)
+                x0 = jax.lax.dynamic_index_in_dim(
+                    x_mb, mb, 0, keepdims=False).astype(x_buf.dtype)
+                x_in = jnp.where(s_idx == 0, x0, x_buf)
+                key_t = jax.random.fold_in(base_key, t)
+                y = stage_forward(shared_vals, local_params, x_in, key_t)
+                lbl_idx = jnp.clip(t - (Pn - 1), 0, M - 1)
+                labels = {n: jax.lax.dynamic_index_in_dim(
+                    v, lbl_idx, 0, keepdims=False)
+                    for n, v in lbl_mb.items()}
+                loss_t = jnp.reshape(
+                    epilogue(shared_vals, y, labels, key_t), ())
+                valid = jnp.logical_and(t >= Pn - 1, s_idx == Pn - 1)
+                loss_sum = loss_sum + jnp.where(valid, loss_t, 0.0)
+                x_next = jax.lax.ppermute(
+                    y, PP_AXIS, [(i, (i + 1) % Pn) for i in range(Pn)])
+                return (x_next, loss_sum), None
+
+            x0_buf = jnp.zeros(x_shape,
+                               x_mb.dtype if
+                               jnp.issubdtype(x_mb.dtype, jnp.floating)
+                               else jnp.float32)
+            (xf, loss_sum), _ = jax.lax.scan(
+                tick, (x0_buf, jnp.float32(0.0)), jnp.arange(T))
+            # LOCAL microbatch-mean loss: no cross-device reduction in
+            # here — differentiating through psum would scale cotangents
+            # by the group size. The ppermute chain alone carries the
+            # backward pipeline; reductions happen explicitly below.
+            return loss_sum / M
+
+        local_loss, grads = jax.value_and_grad(loss_of)(local_params)
+        if DP_AXIS in mesh.axis_names:
+            # data-parallel gradient mean (the classic grad all-reduce)
+            grads = [jax.lax.psum(g, DP_AXIS) / ndp for g in grads]
+        loss = jax.lax.psum(local_loss, PP_AXIS)  # last stage holds it
+        if DP_AXIS in mesh.axis_names:
+            loss = jax.lax.psum(loss, DP_AXIS) / ndp
+
+        # shared optimizer ops (LR schedule, counters) once, replicated
+        env = dict(shared_vals)
+        ctx = LowerContext(block, env, base_key=base_key)
+        for op in plan.shared_opt_ops:
+            lower_op(ctx, op)
+
+        # per-position optimizer templates on the stacked local state
+        env.update(zip(plan.stage_params[0], local_params))
+        env.update(other_state)
+        for j, tmpl in enumerate(plan.opt_templates):
+            if plan.grad_names[j] is not None:
+                env[plan.grad_names[j]] = grads[j].astype("float32")
+            ctx2 = LowerContext(block, env, base_key=base_key)
+            for op in tmpl:
+                lower_op(ctx2, op)
+
+        # re-add the local pp dim so shard_map stitches the stage shards
+        new_stacks = tuple(env[n][None] for n in stack_names)
+        new_shared = tuple(env.get(n, shared_vals[n]) for n in shared_mut)
+        loss_out = jnp.reshape(loss, (1,))
+        return (loss_out,), new_stacks + new_shared
+
+    # shard specs: stacked state P('pp', ...); shared replicated; feeds
+    # batch-sharded over dp on dim 0
+    feed_spec = tuple(P(DP_AXIS) if DP_AXIS in mesh.axis_names else P()
+                      for _ in feed_names)
+    mut_spec = tuple([P(PP_AXIS) for _ in stack_names] +
+                     [P() for _ in shared_mut])
+    const_spec = tuple(P() for _ in const_in)
+
+    mapped = shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(feed_spec, mut_spec, const_spec, P()),
+        out_specs=((P(),), mut_spec),
+        check_vma=False)
+
+    def _step(feed_vals, mut_vals, const_vals, step):
+        fetches, new_mut = mapped(feed_vals, mut_vals, const_vals, step)
+        return fetches, new_mut, ()
+
+    jitted = jax.jit(_step, donate_argnums=(1,))
+
+    def fn(feed_vals, mut_vals, const_vals, step):
+        out = jitted(feed_vals, mut_vals, const_vals, step)
+        # mut_vals were donated; remember the live replacements so
+        # sync_scope works even if the caller hasn't written them back
+        fn._last_mut = out[1]
+        return out
+
+    fn._last_mut = None
+
+    def prepare_scope(scope):
+        """Stack per-stage scope values into placed pp-sharded arrays."""
+        for n0, stack_name in zip(stack_names, mut_stack):
+            if scope.find_var(stack_name) is not None:
+                continue
+            vals = [np.asarray(scope.find_var(ns))
+                    for ns in plan.state_map[n0]]
+            stacked = np.stack(vals)
+            sh = NamedSharding(mesh, P(PP_AXIS))
+            scope.set_var(stack_name, jax.device_put(stacked, sh))
+
+    def sync_scope(scope, mut_vals=None):
+        """Write stacked state back to the per-stage names (save/load).
+
+        Prefers `mut_vals` (the latest step's returned state), then the
+        last values fn returned (the step donates its inputs, so values
+        still sitting in the scope from prepare_scope are dead buffers),
+        then whatever the scope holds."""
+        vals = mut_vals if mut_vals is not None else fn._last_mut
+        by_name = dict(zip(mut_in, vals)) if vals is not None else {}
+        for n0, stack_name in zip(stack_names, mut_stack):
+            arr = by_name.get(stack_name)
+            if arr is None:
+                arr = scope.find_var(stack_name)
+            if arr is None:
+                continue
+            scope.set_var(stack_name, arr)  # refresh the live buffer
+            host = np.asarray(arr)
+            for s, ns in enumerate(plan.state_map[n0]):
+                scope.set_var(ns, host[s])
+
+    fn.prepare_scope = prepare_scope
+    fn.sync_scope = sync_scope
+    fn.plan = plan
+    return fn, mut_in, const_in, extra_out

@@ -1,0 +1,160 @@
+"""Cross-device pipeline parallelism (pp mesh axis, stacked stages).
+
+Reference semantics to beat: framework/section_worker.cc:44-119 (GPipe
+flush schedule with real per-device stage placement). Asserts:
+  * stage params are physically placed per stage (`.sharding` over pp),
+  * the parameter trajectory matches plain (non-pipelined) training,
+  * composes with dp (pp2 x dp4 on the 8-device CPU mesh).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.framework.core import device_guard
+from paddle_tpu.parallel import build_pp_pipeline_step, make_mesh
+from paddle_tpu.parallel.pipeline_pp import STACK_PREFIX
+
+HID = 8
+
+
+def _build_staged(num_stages, lr=0.1, opt_cls=optimizer.SGD):
+    """num_stages uniform fc+tanh stages, mse loss epilogue."""
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [HID], dtype="float32")
+        label = layers.data("label", [HID], dtype="float32")
+        h = x
+        for s in range(num_stages):
+            with device_guard(f"gpu:{s}"):
+                h = layers.fc(h, size=HID, act="tanh",
+                              name=f"stage{s}")
+        diff = layers.elementwise_sub(h, label)
+        loss = layers.reduce_mean(layers.elementwise_mul(diff, diff))
+        opt_cls(learning_rate=lr).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(batch, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(batch, HID).astype("float32")
+    y = np.tanh(x @ rng.randn(HID, HID).astype("float32") * 0.5)
+    return {"x": x, "label": y.astype("float32")}
+
+
+def _run_plain(num_stages, feed, steps, lr=0.1, opt_cls=optimizer.SGD):
+    """Ground truth: same program, single-device whole-batch training."""
+    main, startup, loss = _build_staged(num_stages, lr, opt_cls)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    init = {p.name: np.asarray(scope.find_var(p.name))
+            for p in main.global_block().all_parameters()}
+    losses = []
+    for i in range(steps):
+        l, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    params = {p.name: np.asarray(scope.find_var(p.name))
+              for p in main.global_block().all_parameters()}
+    return init, losses, params
+
+
+def _run_pp(num_stages, mesh, feed, steps, num_microbatches, init,
+            lr=0.1, opt_cls=optimizer.SGD):
+    from paddle_tpu.framework.core import default_main_program
+    main, startup, loss = _build_staged(num_stages, lr, opt_cls)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    # identical starting point as the plain run: params are created in the
+    # same order in both builds, so copy positionally (names differ via
+    # the global unique_name counter)
+    pnames = [p.name for p in main.global_block().all_parameters()]
+    assert len(pnames) == len(init)
+    for n, v in zip(pnames, init.values()):
+        assert np.asarray(scope.find_var(n)).shape == v.shape
+        scope.set_var(n, v)
+
+    fn, mut_in, const_in, extra = build_pp_pipeline_step(
+        main, ["x", "label"], [loss.name], num_microbatches, mesh)
+    fn.prepare_scope(scope)
+    mut_vals = tuple(scope.find_var(n) for n in mut_in)
+    const_vals = tuple(scope.find_var(n) for n in const_in)
+    losses = []
+    for i in range(steps):
+        fetches, mut_vals, _ = fn(
+            tuple(feed[n] for n in ["x", "label"]), mut_vals, const_vals,
+            np.int32(i + 1))
+        losses.append(float(np.asarray(fetches[0]).reshape(-1)[0]))
+    for n, v in zip(mut_in, mut_vals):
+        scope.set_var(n, v)
+    fn.sync_scope(scope)
+    params = {n: np.asarray(scope.find_var(n)) for n in pnames}
+    return losses, params, scope, mut_in, mut_vals
+
+
+def test_pp4_placement_and_trajectory():
+    """4 stages on a pp4x dp2 mesh: placement + exact trajectory parity."""
+    mesh = make_mesh({"pp": 4, "dp": 2})
+    feed = _feed(16)
+    init, plain_losses, plain_params = _run_plain(4, feed, steps=4)
+    pp_losses, pp_params, scope, mut_in, mut_vals = _run_pp(
+        4, mesh, feed, steps=4, num_microbatches=4, init=init)
+
+    # params truly placed: each stack sharded over pp on dim 0
+    from jax.sharding import NamedSharding
+    placed = 0
+    for n, v in zip(mut_in, mut_vals):
+        if not n.startswith(STACK_PREFIX):
+            continue
+        sh = v.sharding
+        assert isinstance(sh, NamedSharding)
+        assert sh.spec[0] == "pp", (n, sh.spec)
+        # each device holds 1/4 of the stack (its stage)
+        assert v.addressable_shards[0].data.shape[0] == 1
+        placed += 1
+    assert placed >= 2  # weights + biases at least
+
+    # GPipe with full-batch-equivalent microbatching follows the same
+    # trajectory as plain training (same mean loss & gradient)
+    np.testing.assert_allclose(pp_losses, plain_losses, rtol=2e-4,
+                               atol=1e-5)
+    for (n_pp, v_pp), (n_pl, v_pl) in zip(pp_params.items(),
+                                          plain_params.items()):
+        np.testing.assert_allclose(v_pp, v_pl, rtol=2e-4, atol=1e-5,
+                                   err_msg=f"param {n_pp}/{n_pl} diverged")
+
+
+def test_pp2_dp4_adam():
+    """pp2 x dp4 with Adam (stacked optimizer state follows its params)."""
+    mesh = make_mesh({"pp": 2, "dp": 4})
+    feed = _feed(16, seed=1)
+    init, plain_losses, plain_params = _run_plain(
+        2, feed, steps=3, lr=0.01, opt_cls=optimizer.Adam)
+    pp_losses, pp_params, scope, mut_in, mut_vals = _run_pp(
+        2, mesh, feed, steps=3, num_microbatches=2, init=init,
+        lr=0.01, opt_cls=optimizer.Adam)
+    np.testing.assert_allclose(pp_losses, plain_losses, rtol=2e-4,
+                               atol=1e-5)
+    for (n_pp, v_pp), (n_pl, v_pl) in zip(pp_params.items(),
+                                          plain_params.items()):
+        np.testing.assert_allclose(v_pp, v_pl, rtol=2e-4, atol=2e-5,
+                                   err_msg=f"param {n_pp}/{n_pl} diverged")
+
+
+def test_pp_rejects_nonuniform_stages():
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [HID], dtype="float32")
+        label = layers.data("label", [HID], dtype="float32")
+        with device_guard("gpu:0"):
+            h = layers.fc(x, size=HID, act="tanh")
+        with device_guard("gpu:1"):
+            h = layers.fc(h, size=HID, act="relu")  # different activation
+        diff = layers.elementwise_sub(h, label)
+        loss = layers.reduce_mean(layers.elementwise_mul(diff, diff))
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    mesh = make_mesh({"pp": 2, "dp": 4})
+    with pytest.raises(ValueError, match="not structurally identical"):
+        build_pp_pipeline_step(main, ["x", "label"], [loss.name], 2, mesh)
