@@ -1,0 +1,310 @@
+"""Host-resident large-scale sparse embedding tables.
+
+TPU-native replacement for the reference parameter-server sparse storage
+(operators/distributed/large_scale_kv.h — server-side hash table of
+feature-id -> embedding row + optimizer slots) and the sparse optimizer
+kernels (operators/optimizers/*_op.* SelectedRows paths).
+
+Design: feature ids index a *hash table*, not a dense array — capacity is
+host RAM (and, sharded over pservers, the cluster), not device HBM.  Rows
+are materialized lazily on first touch with a deterministic per-id
+initializer, so a table declared as [2**40, dim] costs nothing until ids
+are actually seen (the reference's "10^11 features / 10^12 parameters"
+capability, README.md:52).  The dense XLA step never sees the table: the
+trainer *pulls* the rows for the current batch (gather -> dense [n, dim]
+feed), computes on device, and *pushes* the gradient rows back, where the
+sparse optimizer (sgd / adagrad / adam, each with its own slots) applies
+the update — the DownpourWorker pull/compute/push cycle
+(framework/device_worker.h:268, framework/fleet/fleet_wrapper.h:66,111).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["TableConfig", "SparseShard", "SparseTable"]
+
+_GROW = 1024  # arena growth granularity (rows)
+
+
+class TableConfig:
+    """Declarative config for one sparse table (reference
+    large_scale_kv.h ValueDesc / distributed_strategy sparse_table_configs).
+    """
+
+    def __init__(self, name: str, dim: int, dtype: str = "float32",
+                 initializer: Tuple = ("uniform", -0.05, 0.05),
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8, seed: int = 0):
+        self.name = name
+        self.dim = int(dim)
+        self.dtype = dtype
+        self.initializer = tuple(initializer)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.seed = int(seed)
+
+    def to_dict(self):
+        return dict(name=self.name, dim=self.dim, dtype=self.dtype,
+                    initializer=list(self.initializer),
+                    optimizer=self.optimizer, lr=self.lr, beta1=self.beta1,
+                    beta2=self.beta2, epsilon=self.epsilon, seed=self.seed)
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        d["initializer"] = tuple(d.get("initializer", ("uniform", -.05, .05)))
+        return TableConfig(**d)
+
+    # number of extra slot vectors the optimizer needs per row
+    def n_slots(self) -> int:
+        return {"sgd": 0, "momentum": 1, "adagrad": 1, "adam": 2}[
+            self.optimizer]
+
+
+def _init_rows(cfg: TableConfig, ids: np.ndarray) -> np.ndarray:
+    """Deterministic per-id row init: the same id always materializes the
+    same row, on any shard/server — this is what makes geo-sync and
+    restart-from-scratch reproducible without coordination."""
+    kind = cfg.initializer[0]
+    if kind == "constant":
+        return np.full((len(ids), cfg.dim), cfg.initializer[1],
+                       dtype=cfg.dtype)
+    if kind == "uniform":
+        low, high = cfg.initializer[1], cfg.initializer[2]
+        out = np.empty((len(ids), cfg.dim), dtype=cfg.dtype)
+        for i, fid in enumerate(ids):
+            # counter-based per-id stream: Philox keyed by (table seed, id)
+            g = np.random.Generator(
+                np.random.Philox(key=(cfg.seed & 0xFFFFFFFF, int(fid))))
+            out[i] = g.uniform(low, high, cfg.dim).astype(cfg.dtype)
+        return out
+    raise ValueError(f"unknown sparse initializer {cfg.initializer!r}")
+
+
+class SparseShard:
+    """One shard: id -> arena row index; value + optimizer slot arenas.
+
+    Mirrors large_scale_kv.h ValueBlock (rows in flat arenas, free-list
+    — here append-only growable numpy arenas).
+    """
+
+    def __init__(self, cfg: TableConfig):
+        self.cfg = cfg
+        self._index: Dict[int, int] = {}
+        self._n = 0
+        self._value = np.empty((0, cfg.dim), dtype=cfg.dtype)
+        self._slots = [np.empty((0, cfg.dim), dtype="float32")
+                       for _ in range(cfg.n_slots())]
+        self._counts = np.empty((0,), dtype="int64")  # per-row step count
+        self._lock = threading.Lock()
+
+    def __len__(self):
+        return self._n
+
+    def _ensure_capacity(self, need: int):
+        cap = self._value.shape[0]
+        if need <= cap:
+            return
+        new_cap = max(need, cap + max(_GROW, cap // 2))
+        self._value = np.resize(self._value, (new_cap, self.cfg.dim))
+        self._slots = [np.resize(s, (new_cap, self.cfg.dim))
+                       for s in self._slots]
+        self._counts = np.resize(self._counts, (new_cap,))
+
+    def _rows_for(self, ids: np.ndarray, create: bool) -> np.ndarray:
+        """id array -> arena row indices, materializing missing rows."""
+        idx = np.empty(len(ids), dtype=np.int64)
+        missing: List[int] = []
+        mpos: List[int] = []
+        for i, fid in enumerate(ids):
+            r = self._index.get(int(fid), -1)
+            if r < 0:
+                if not create:
+                    r = -1
+                else:
+                    missing.append(int(fid))
+                    mpos.append(i)
+                    continue
+            idx[i] = r
+        if missing:
+            self._ensure_capacity(self._n + len(missing))
+            fresh = _init_rows(self.cfg, np.asarray(missing))
+            for j, fid in enumerate(missing):
+                r = self._index.get(fid, -1)
+                if r < 0:  # dedupe within this batch of missing ids
+                    r = self._n
+                    self._index[fid] = r
+                    self._n += 1
+                    self._value[r] = fresh[j]
+                    for s in self._slots:
+                        s[r] = 0.0
+                    self._counts[r] = 0
+                idx[mpos[j]] = r
+        return idx
+
+    def pull(self, ids: np.ndarray, create: bool = True) -> np.ndarray:
+        with self._lock:
+            idx = self._rows_for(ids, create=create)
+            out = self._value[idx].copy()
+            if not create:
+                out[idx < 0] = 0.0
+            return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray,
+             lr_scale: float = 1.0):
+        """Apply merged (unique-id) gradient rows with the table optimizer.
+
+        Caller must have merged duplicates already (SparseTable.push does);
+        reference: MergeAdd in operators/math/selected_rows_functor.*.
+        """
+        cfg = self.cfg
+        lr = cfg.lr * lr_scale
+        with self._lock:
+            idx = self._rows_for(ids, create=True)
+            g = grads.astype("float32", copy=False)
+            if cfg.optimizer == "sgd":
+                self._value[idx] -= (lr * g).astype(cfg.dtype)
+            elif cfg.optimizer == "momentum":
+                vel = self._slots[0]
+                vel[idx] = 0.9 * vel[idx] + g
+                self._value[idx] -= (lr * vel[idx]).astype(cfg.dtype)
+            elif cfg.optimizer == "adagrad":
+                acc = self._slots[0]
+                acc[idx] += g * g
+                self._value[idx] -= (
+                    lr * g / (np.sqrt(acc[idx]) + cfg.epsilon)
+                ).astype(cfg.dtype)
+            elif cfg.optimizer == "adam":
+                m, v = self._slots
+                self._counts[idx] += 1
+                t = self._counts[idx].astype("float32")[:, None]
+                m[idx] = cfg.beta1 * m[idx] + (1 - cfg.beta1) * g
+                v[idx] = cfg.beta2 * v[idx] + (1 - cfg.beta2) * g * g
+                mhat = m[idx] / (1 - cfg.beta1 ** t)
+                vhat = v[idx] / (1 - cfg.beta2 ** t)
+                self._value[idx] -= (
+                    lr * mhat / (np.sqrt(vhat) + cfg.epsilon)
+                ).astype(cfg.dtype)
+            else:
+                raise ValueError(f"unknown sparse optimizer "
+                                 f"{cfg.optimizer!r}")
+
+    def push_delta(self, ids: np.ndarray, deltas: np.ndarray):
+        """Geo-SGD: server adds the trainer's parameter delta directly
+        (reference GeoCommunicator / geo_sgd_transpiler semantics)."""
+        with self._lock:
+            idx = self._rows_for(ids, create=True)
+            self._value[idx] += deltas.astype(self.cfg.dtype)
+
+    def export(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(ids, values) snapshot of every materialized row."""
+        with self._lock:
+            ids = np.fromiter(self._index.keys(), dtype=np.int64,
+                              count=len(self._index))
+            idx = np.fromiter(self._index.values(), dtype=np.int64,
+                              count=len(self._index))
+            return ids, self._value[idx].copy()
+
+    def load(self, ids: np.ndarray, values: np.ndarray):
+        with self._lock:
+            idx = self._rows_for(np.asarray(ids, dtype=np.int64),
+                                 create=True)
+            self._value[idx] = values.astype(self.cfg.dtype)
+
+
+def merge_sparse_grad(ids: np.ndarray, grads: np.ndarray
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Sum gradient rows of duplicate ids (SelectedRows MergeAdd,
+    operators/math/selected_rows_functor.h)."""
+    ids = np.asarray(ids, dtype=np.int64).ravel()
+    grads = np.asarray(grads)
+    grads = grads.reshape(len(ids), -1)
+    uids, inv = np.unique(ids, return_inverse=True)
+    merged = np.zeros((len(uids), grads.shape[1]), dtype=grads.dtype)
+    np.add.at(merged, inv, grads)
+    return uids, merged
+
+
+class SparseTable:
+    """A sharded sparse table (in one process).  Multi-server deployments
+    hold one SparseTable per server, each owning the ids whose
+    ``hash(id) % n_servers`` equals its server index — routing done by the
+    TableClient (rpc.py), mirroring DistributeTranspiler's id-sharding
+    (transpiler/distribute_transpiler.py:256).
+    """
+
+    def __init__(self, cfg: TableConfig, n_shards: int = 8):
+        self.cfg = cfg
+        self.n_shards = int(n_shards)
+        self.shards = [SparseShard(cfg) for _ in range(self.n_shards)]
+
+    def _route(self, ids: np.ndarray):
+        shard_of = ids % self.n_shards
+        return shard_of
+
+    def size(self) -> int:
+        return sum(len(s) for s in self.shards)
+
+    def pull(self, ids, create: bool = True) -> np.ndarray:
+        ids = np.asarray(ids, dtype=np.int64).ravel()
+        out = np.empty((len(ids), self.cfg.dim), dtype=self.cfg.dtype)
+        shard_of = self._route(ids)
+        for k in range(self.n_shards):
+            m = shard_of == k
+            if m.any():
+                out[m] = self.shards[k].pull(ids[m], create=create)
+        return out
+
+    def push(self, ids, grads, lr_scale: float = 1.0):
+        uids, merged = merge_sparse_grad(ids, grads)
+        shard_of = self._route(uids)
+        for k in range(self.n_shards):
+            m = shard_of == k
+            if m.any():
+                self.shards[k].push(uids[m], merged[m], lr_scale=lr_scale)
+
+    def push_delta(self, ids, deltas):
+        ids = np.asarray(ids, dtype=np.int64).ravel()
+        shard_of = self._route(ids)
+        for k in range(self.n_shards):
+            m = shard_of == k
+            if m.any():
+                self.shards[k].push_delta(ids[m], deltas[m])
+
+    def export(self):
+        parts = [s.export() for s in self.shards]
+        ids = np.concatenate([p[0] for p in parts])
+        vals = np.concatenate([p[1] for p in parts])
+        return ids, vals
+
+    def load(self, ids, values):
+        ids = np.asarray(ids, dtype=np.int64).ravel()
+        values = np.asarray(values).reshape(len(ids), self.cfg.dim)
+        shard_of = self._route(ids)
+        for k in range(self.n_shards):
+            m = shard_of == k
+            if m.any():
+                self.shards[k].load(ids[m], values[m])
+
+    def save(self, path: str):
+        ids, vals = self.export()
+        np.savez(path, ids=ids, values=vals,
+                 meta=np.frombuffer(
+                     repr(self.cfg.to_dict()).encode(), dtype=np.uint8))
+
+    @staticmethod
+    def restore(path: str, cfg: Optional[TableConfig] = None,
+                n_shards: int = 8) -> "SparseTable":
+        import ast
+        z = np.load(path if path.endswith(".npz") else path + ".npz")
+        if cfg is None:
+            cfg = TableConfig.from_dict(
+                ast.literal_eval(bytes(z["meta"]).decode()))
+        t = SparseTable(cfg, n_shards=n_shards)
+        t.load(z["ids"], z["values"])
+        return t
