@@ -24,6 +24,10 @@ minimize = _fleet_singleton.minimize
 save_persistables = _fleet_singleton.save_persistables
 save_inference_model = _fleet_singleton.save_inference_model
 stop_worker = _fleet_singleton.stop_worker
+init_server = _fleet_singleton.init_server
+run_server = _fleet_singleton.run_server
+init_worker = _fleet_singleton.init_worker
+ps_trainer = _fleet_singleton.ps_trainer
 main_program = _fleet_singleton.main_program
 startup_program = _fleet_singleton.startup_program
 

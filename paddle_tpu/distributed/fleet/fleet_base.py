@@ -30,6 +30,21 @@ class Fleet:
         self._is_collective = is_collective or role_maker is None
         self._role_maker = role_maker or PaddleCloudRoleMaker(
             is_collective=self._is_collective)
+        # a fresh init is a fresh deployment: shut down and drop any PS
+        # runtime left from a previous one (communicator threads, bound
+        # server sockets)
+        t = getattr(self, "_ps_trainer", None)
+        if t is not None:
+            try:
+                t.stop_worker()
+            except Exception:
+                pass
+        s = getattr(self, "_ps_server", None)
+        if s is not None:
+            s.stop()
+        for attr in ("_ps_service", "_ps_trainer", "_ps_server"):
+            if hasattr(self, attr):
+                delattr(self, attr)
         if strategy is not None:
             self._user_defined_strategy = strategy
         return self
@@ -65,7 +80,11 @@ class Fleet:
         return self._role_maker.is_server()
 
     def barrier_worker(self):
-        pass  # single-program SPMD: XLA orders everything
+        # collective mode: XLA orders everything within the single SPMD
+        # program.  PS mode: fence through the server.
+        t = getattr(self, "_ps_trainer", None)
+        if t is not None and t.n_workers > 1:
+            t.comm.barrier()
 
     # -- optimizer ----------------------------------------------------------
     def distributed_optimizer(self, optimizer,
@@ -114,5 +133,64 @@ class Fleet:
                                        target_vars, executor,
                                        main_program=main_program)
 
+    # -- parameter-server runtime ------------------------------------------
+    # Reference: fleet.init_server/run_server/init_worker/stop_worker
+    # (distributed/fleet/base/fleet_base.py + the pslib runtime).  Two
+    # deployments share the code path: in-process (no server endpoints —
+    # a LocalClient fronting an embedded PSService, the single-node dev
+    # mode) and RPC (PServer processes at get_pserver_endpoints).
+
+    def _ps_ctx(self):
+        ctx = getattr(self._origin_main_program, "_ps_ctx", None)
+        if ctx is None:
+            raise RuntimeError(
+                "no PS context: fleet.minimize must run with a "
+                "non-collective role or strategy.a_sync first")
+        return ctx
+
+    def init_server(self, *args, **kwargs):
+        from ..ps import build_service
+        from ...framework.executor import global_scope
+        self._ps_service = build_service(self._ps_ctx(),
+                                         scope=global_scope())
+
+    def run_server(self):
+        """Serve forever on this role's endpoint (RPC deployments)."""
+        from ..ps import PServer
+        eps = self._role_maker.get_pserver_endpoints()
+        me = eps[self.server_index()]
+        server = PServer(self._ps_service, endpoint=me,
+                         n_workers=self.worker_num())
+        server.start()
+        self._ps_server = server
+        return server
+
+    def init_worker(self):
+        from ..ps import (LocalClient, PSTrainer, RPCClient, ShardedClient,
+                          build_service, make_communicator)
+        ctx = self._ps_ctx()
+        eps = self._role_maker.get_pserver_endpoints()
+        if eps:
+            client = ShardedClient([RPCClient(ep) for ep in eps])
+        else:
+            if not hasattr(self, "_ps_service"):
+                self.init_server()
+            client = LocalClient(self._ps_service,
+                                 n_workers=max(1, self.worker_num()))
+        comm = make_communicator(ctx.mode, client,
+                                 sparse_configs=ctx.table_configs(),
+                                 k_steps=ctx.k_steps)
+        self._ps_trainer = PSTrainer(
+            self._origin_main_program, ctx, comm,
+            worker_index=self.worker_index(),
+            n_workers=max(1, self.worker_num()))
+        self._ps_trainer.init_worker()
+        return self._ps_trainer
+
+    def ps_trainer(self):
+        return self._ps_trainer
+
     def stop_worker(self):
-        pass
+        t = getattr(self, "_ps_trainer", None)
+        if t is not None:
+            t.stop_worker()

@@ -15,6 +15,7 @@ from .gradient_merge_optimizer import GradientMergeOptimizer  # noqa
 from .localsgd_optimizer import LocalSGDOptimizer  # noqa
 from .sharding_optimizer import ShardingOptimizer  # noqa
 from .pipeline_optimizer import PipelineOptimizer  # noqa
+from .parameter_server_optimizer import ParameterServerOptimizer  # noqa
 
 META_OPTIMIZER_CLASSES = [
     # inner-most applied first; order mirrors the reference ranking
@@ -31,6 +32,9 @@ META_OPTIMIZER_CLASSES = [
     PipelineOptimizer,
     ShardingOptimizer,
     GraphExecutionOptimizer,
+    # outermost: PS mode replaces the whole update path (server-side
+    # optimize); reference ranks it exclusive with collective metas
+    ParameterServerOptimizer,
 ]
 
 

@@ -1,0 +1,91 @@
+"""ParameterServerOptimizer: fleet's PS-mode program rewrite.
+
+Reference: distributed/fleet/meta_optimizers/parameter_server_optimizer.py
+(+ the fluid DistributeTranspiler it drives).  Applies when the role
+maker is non-collective (a PS cluster) or ``strategy.a_sync`` is set.
+``minimize`` rewrites sparse lookups to the pulled-row form, appends
+backward only (dense optimizer updates run on the server), and attaches a
+``PSContext`` to the program that ``fleet.init_server / init_worker`` and
+the ``PSTrainer`` consume.
+"""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+# inner-optimizer class name -> server-side table optimizer
+_OPT_MAP = {
+    "SGDOptimizer": "sgd",
+    "MomentumOptimizer": "momentum",
+    "AdagradOptimizer": "adagrad",
+    "AdamOptimizer": "adam",
+}
+
+
+class ParameterServerOptimizer(MetaOptimizerBase):
+    strategy_flag = "a_sync"
+
+    def _can_apply(self) -> bool:
+        rm = self.role_maker
+        non_collective = rm is not None and not getattr(
+            rm, "_is_collective", True)
+        return bool(getattr(self.user_defined_strategy, "a_sync", False)
+                    or non_collective)
+
+    def _disable_strategy(self, strategy):
+        strategy.a_sync = False
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        from ....framework.backward import append_backward
+        from ....framework.core import grad_var_name
+        from ....distributed.ps.worker import (PSContext, _strip_startup_init,
+                                               transpile_to_ps)
+
+        program = loss.block.program
+        sections = transpile_to_ps(program)
+        lazy = [s.table_name for s in sections if s.lazy_init]
+        if lazy and startup_program is not None:
+            _strip_startup_init(startup_program, lazy)
+
+        params_grads = append_backward(loss, parameter_list, no_grad_set)
+
+        inner = self.user_defined_optimizer
+        opt_name = _OPT_MAP.get(type(inner).__name__)
+        if opt_name is None:
+            raise NotImplementedError(
+                f"PS mode supports {sorted(_OPT_MAP)}; got "
+                f"{type(inner).__name__}")
+        opt_kwargs = {}
+        if opt_name == "adam":
+            opt_kwargs = {"beta1": getattr(inner, "_beta1", 0.9),
+                          "beta2": getattr(inner, "_beta2", 0.999),
+                          "epsilon": getattr(inner, "_epsilon", 1e-8)}
+        elif opt_name == "momentum":
+            opt_kwargs = {"momentum": getattr(inner, "_momentum", 0.9)}
+        elif opt_name == "adagrad":
+            opt_kwargs = {"epsilon": getattr(inner, "_epsilon", 1e-6)}
+        if not isinstance(getattr(inner, "_learning_rate", 0.01),
+                          (int, float)):
+            import warnings
+            warnings.warn(
+                "PS mode freezes the learning rate at its current value; "
+                "server-side LR schedules are not applied. Scale per-step "
+                "via PSTrainer.lr_scale instead.")
+
+        strategy = self.user_defined_strategy
+        k_steps = int(strategy.a_sync_configs.get("k_steps", -1))
+        if not getattr(strategy, "a_sync", False):
+            mode = "sync"
+        elif k_steps > 0:
+            mode = "geo"
+        else:
+            mode = "async"
+
+        dense = [(p.name, grad_var_name(p.name), tuple(p.shape))
+                 for p, _g in params_grads]
+        program._ps_ctx = PSContext(
+            sections=sections, dense_params=dense, optimizer=opt_name,
+            lr=float(inner.current_step_lr()), opt_kwargs=opt_kwargs,
+            mode=mode, k_steps=max(k_steps, 1))
+        # no optimize ops on the trainer: the server applies updates
+        return [], params_grads

@@ -105,6 +105,7 @@ class UserDefinedRoleMaker(RoleMakerBase):
         super().__init__()
         self._current_id = current_id
         self._role = role
+        self._is_collective = False  # PS-style cluster spec
         self._server_endpoints = server_endpoints or []
         self._worker_endpoints = worker_endpoints or \
             [f"w:{i}" for i in range(worker_num)]

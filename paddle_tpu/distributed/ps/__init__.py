@@ -1,0 +1,16 @@
+"""Parameter-server subsystem: host-resident sparse tables, the
+pull/compute/push trainer, and sync/async/geo communicators.
+
+Reference: paddle/fluid/operators/distributed/ (communicator, grpc/brpc
+transport, large_scale_kv), framework/fleet/fleet_wrapper.h, and
+transpiler/distribute_transpiler.py — re-architected so the XLA-compiled
+dense step stays pure and static-shape while the unbounded sparse state
+lives on the host/servers.
+"""
+from .table import DenseTable, SparseTable, TableConfig, merge_sparse_grad  # noqa
+from .rpc import (LocalClient, PServer, PSService, RPCClient,  # noqa
+                  ShardedClient)
+from .communicator import (AsyncCommunicator, Communicator,  # noqa
+                           GeoCommunicator, make_communicator)
+from .worker import (PSContext, PSTrainer, SparseSection,  # noqa
+                     build_service, transpile_to_ps)

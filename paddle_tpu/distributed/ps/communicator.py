@@ -1,0 +1,276 @@
+"""Trainer-side communicator: sync / async / geo gradient traffic.
+
+Reference: operators/distributed/communicator.h — AsyncCommunicator
+(:195 send queue + merge thread), HalfAsyncCommunicator (:268 barrier'd
+k-step merge), SyncCommunicator (:340), GeoCommunicator (:383 delta
+push / pull of touched rows).  Python/launch surface:
+fleet.init_worker() starts it, fleet.stop_worker() flushes and stops.
+
+The communicator sits between the PSTrainer (which fetches gradients
+from the XLA step) and a client (LocalClient / RPCClient /
+ShardedClient).  Modes:
+
+  * sync:   push immediately, server applies optimizer, pull fresh next
+            step; a server barrier fences every trainer per step.
+  * async:  pushes enqueue; a background thread merges duplicate ids and
+            sends; pulls read whatever the server has (HogWild-style
+            staleness, the reference's default CTR mode).
+  * geo:    trainers train *locally* (local sparse optimizer applies the
+            update) and every k steps exchange parameter deltas with the
+            server, which accumulates them; then the trainer adopts the
+            server value.  Dense params follow the same delta protocol.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .table import SparseTable, TableConfig, merge_sparse_grad
+
+__all__ = ["Communicator", "AsyncCommunicator", "GeoCommunicator",
+           "make_communicator"]
+
+
+class Communicator:
+    """Sync mode: every push applied before the call returns."""
+
+    mode = "sync"
+
+    def __init__(self, client):
+        self.client = client
+        self.running = False
+
+    def start(self):
+        self.running = True
+
+    def stop(self):
+        self.flush()
+        self.running = False
+
+    def flush(self):
+        pass
+
+    def step_done(self):
+        """Called by the trainer once per training step (geo keys its
+        k_steps interval on this, not on push counts)."""
+
+    # -- sparse -------------------------------------------------------------
+    def pull_sparse(self, table: str, ids: np.ndarray) -> np.ndarray:
+        return self.client.pull_sparse(table, ids)
+
+    def push_sparse(self, table: str, ids: np.ndarray, grads: np.ndarray,
+                    lr_scale: float = 1.0):
+        self.client.push_sparse(table, ids, grads, lr_scale)
+
+    # -- dense --------------------------------------------------------------
+    def pull_dense(self, name: str) -> np.ndarray:
+        return self.client.pull_dense(name)
+
+    def push_dense(self, name: str, grad: np.ndarray,
+                   lr_scale: float = 1.0):
+        self.client.push_dense(name, grad, lr_scale)
+
+    def barrier(self):
+        self.client.barrier()
+
+
+class AsyncCommunicator(Communicator):
+    """Async mode: a send thread drains a bounded queue, merging rows of
+    duplicate ids before sending (communicator.h:195 MergeVars +
+    send_threadpool)."""
+
+    mode = "async"
+
+    def __init__(self, client, send_queue_size: int = 64,
+                 merge_steps: int = 1):
+        super().__init__(client)
+        self._q: "queue.Queue[Optional[Tuple]]" = queue.Queue(
+            maxsize=send_queue_size)
+        self._thread: Optional[threading.Thread] = None
+        self.merge_steps = max(1, merge_steps)
+        self._err: Optional[BaseException] = None
+
+    def start(self):
+        self.running = True
+        self._thread = threading.Thread(target=self._send_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if self.running:
+            self.running = False
+            self._q.put(None)
+            if self._thread is not None:
+                self._thread.join(timeout=30)
+        if self._err is not None:
+            raise self._err
+
+    def flush(self):
+        self._q.join()
+
+    def push_sparse(self, table, ids, grads, lr_scale=1.0):
+        self._q.put(("sparse", table, np.asarray(ids, np.int64).ravel(),
+                     np.asarray(grads, np.float32), lr_scale))
+
+    def push_dense(self, name, grad, lr_scale=1.0):
+        self._q.put(("dense", name, None, np.asarray(grad, np.float32),
+                     lr_scale))
+
+    def _send_loop(self):
+        pending: List[Tuple] = []
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                break
+            pending.append(item)
+            # opportunistically batch whatever is queued, up to merge_steps
+            while len(pending) < self.merge_steps:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._q.task_done()
+                    self._drain(pending)
+                    return
+                pending.append(nxt)
+            self._drain(pending)
+            pending = []
+
+    def _drain(self, items: List[Tuple]):
+        # merge per destination before sending (MergeVars); merged sends
+        # use the latest lr_scale seen for that destination
+        sparse: Dict[str, List[Tuple[np.ndarray, np.ndarray]]] = {}
+        dense: Dict[str, List[np.ndarray]] = {}
+        scales: Dict[str, float] = {}
+        for kind, name, ids, g, lr_scale in items:
+            scales[name] = lr_scale
+            if kind == "sparse":
+                sparse.setdefault(name, []).append((ids, g))
+            else:
+                dense.setdefault(name, []).append(g)
+        try:
+            for name, parts in sparse.items():
+                ids = np.concatenate([p[0] for p in parts])
+                grads = np.concatenate(
+                    [p[1].reshape(len(p[0]), -1) for p in parts])
+                uids, merged = merge_sparse_grad(ids, grads)
+                self.client.push_sparse(name, uids, merged,
+                                        lr_scale=scales[name])
+            for name, gs in dense.items():
+                g = gs[0] if len(gs) == 1 else np.sum(gs, axis=0)
+                self.client.push_dense(name, g, lr_scale=scales[name])
+        except BaseException as e:  # surfaced on stop()
+            self._err = e
+        finally:
+            for _ in items:
+                self._q.task_done()
+
+
+class GeoCommunicator(Communicator):
+    """Geo-SGD: local training + k-step delta exchange.
+
+    The trainer holds a local mirror of each sparse table (same config +
+    seed, so lazily-materialized rows match the server's deterministic
+    init) and a *base* snapshot of every row it has touched.  Updates are
+    applied locally; every ``k_steps`` pushes, the delta
+    ``local - base`` for touched ids goes to the server (which adds it),
+    then the trainer adopts the server's value as the new local + base —
+    communicator.h:383 GeoCommunicator / geo_sgd_transpiler semantics.
+    """
+
+    mode = "geo"
+
+    def __init__(self, client, sparse_configs: Sequence[TableConfig],
+                 k_steps: int = 100):
+        super().__init__(client)
+        self.k_steps = max(1, k_steps)
+        self.local: Dict[str, SparseTable] = {
+            c.name: SparseTable(c) for c in sparse_configs}
+        self.base: Dict[str, SparseTable] = {
+            c.name: SparseTable(c) for c in sparse_configs}
+        self._touched: Dict[str, set] = {c.name: set()
+                                         for c in sparse_configs}
+        self._dense_local: Dict[str, np.ndarray] = {}
+        self._dense_base: Dict[str, np.ndarray] = {}
+        self._dense_lr: Dict[str, float] = {}
+        self._step_count = 0
+        self._lock = threading.Lock()
+
+    # dense params in geo mode are trainer-optimized locally; the trainer
+    # registers its local view so deltas can be computed.
+    def register_dense(self, name: str, value: np.ndarray, lr: float):
+        self._dense_local[name] = np.array(value, "float32")
+        self._dense_base[name] = np.array(value, "float32")
+        self._dense_lr[name] = lr
+
+    def pull_sparse(self, table, ids):
+        return self.local[table].pull(ids)
+
+    def push_sparse(self, table, ids, grads, lr_scale=1.0):
+        with self._lock:
+            ids = np.asarray(ids, np.int64).ravel()
+            # snapshot base rows for ids never seen before the update
+            tbl, base = self.local[table], self.base[table]
+            new = [i for i in np.unique(ids) if int(i)
+                   not in self._touched[table]]
+            if new:
+                base.load(np.asarray(new, np.int64),
+                          tbl.pull(np.asarray(new, np.int64)))
+                self._touched[table].update(int(i) for i in new)
+            tbl.push(ids, grads, lr_scale=lr_scale)
+
+    def pull_dense(self, name):
+        return self._dense_local[name].copy()
+
+    def push_dense(self, name, grad, lr_scale=1.0):
+        with self._lock:
+            g = np.asarray(grad, "float32").reshape(
+                self._dense_local[name].shape)
+            self._dense_local[name] -= self._dense_lr[name] * lr_scale * g
+
+    def step_done(self):
+        with self._lock:
+            self._step_count += 1
+            if self._step_count % self.k_steps == 0:
+                self._sync_locked()
+
+    def flush(self):
+        with self._lock:
+            self._sync_locked()
+
+    def _sync_locked(self):
+        for name, tbl in self.local.items():
+            touched = self._touched[name]
+            if touched:
+                ids = np.fromiter(touched, np.int64, len(touched))
+                delta = tbl.pull(ids) - self.base[name].pull(ids)
+                self.client.push_sparse_delta(name, ids, delta)
+                fresh = self.client.pull_sparse(name, ids)
+                tbl.load(ids, fresh)
+                self.base[name].load(ids, fresh)
+                touched.clear()
+        for name, local in self._dense_local.items():
+            delta = local - self._dense_base[name]
+            if np.any(delta):
+                self.client.push_dense_delta(name, delta)
+                fresh = self.client.pull_dense(name).reshape(local.shape)
+                self._dense_local[name] = fresh.copy()
+                self._dense_base[name] = fresh.copy()
+
+
+def make_communicator(mode: str, client, sparse_configs=(),
+                      k_steps: int = 100, **kw):
+    """Factory keyed by DistributedStrategy: a_sync=False -> sync,
+    a_sync=True -> async, a_sync + k_steps>0 -> geo (reference
+    fleet/base/distributed_strategy.py a_sync_configs)."""
+    if mode == "sync":
+        return Communicator(client)
+    if mode == "async":
+        return AsyncCommunicator(client, **kw)
+    if mode == "geo":
+        return GeoCommunicator(client, sparse_configs, k_steps=k_steps)
+    raise ValueError(f"unknown communicator mode {mode!r}")

@@ -1,0 +1,459 @@
+"""Parameter-server service + transport.
+
+TPU-native replacement for the reference's gRPC parameter-server data
+plane (operators/distributed/grpc/*, listen_and_serv_op.cc,
+brpc_server.*).  The service semantics are the same — pull/push sparse
+rows, pull/push dense blocks, barrier — but the stack is inverted: the
+reference interleaves send/recv *ops inside the graph* per variable; here
+the XLA-compiled step is a pure dense function and the transport runs
+around it at the host level (pull -> feed, fetch -> push), so device
+execution never blocks on the network mid-step.
+
+Three client/server flavors share one duck-typed API:
+
+  * ``PSService``      — the in-process service object (tables + dispatch).
+  * ``LocalClient``    — direct method calls (single-process deployments,
+                         also the backend reached after RPC decode).
+  * ``PServer``/``RPCClient`` — length-prefixed binary protocol over TCP
+                         sockets, threaded server; multi-server routing by
+                         ``id % n_servers`` is done in ``ShardedClient``.
+
+Wire format: 4-byte big-endian length + payload.  Payload = 1-byte
+method id + msgpack-free manual encoding (numpy buffers are sent raw with
+a small header) — no pickle on the data plane.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import zlib
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .table import DenseTable, SparseTable, TableConfig
+
+__all__ = ["PSService", "LocalClient", "PServer", "RPCClient",
+           "ShardedClient"]
+
+
+# ---------------------------------------------------------------------------
+# Service: the tables + operations (server-side brain)
+# ---------------------------------------------------------------------------
+class PSService:
+    """Holds sparse + dense tables; every client flavor dispatches here."""
+
+    def __init__(self):
+        self.sparse: Dict[str, SparseTable] = {}
+        self.dense: Dict[str, DenseTable] = {}
+        self._barrier_lock = threading.Lock()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._barrier_cv = threading.Condition(self._barrier_lock)
+
+    # -- table management ---------------------------------------------------
+    def create_sparse_table(self, cfg: TableConfig, n_shards: int = 8):
+        if cfg.name not in self.sparse:
+            self.sparse[cfg.name] = SparseTable(cfg, n_shards=n_shards)
+
+    def create_dense_table(self, name: str, init_value, optimizer="sgd",
+                           lr=0.01, **kw):
+        if name not in self.dense:
+            self.dense[name] = DenseTable(name, init_value,
+                                          optimizer=optimizer, lr=lr, **kw)
+
+    # -- sparse -------------------------------------------------------------
+    def pull_sparse(self, table: str, ids: np.ndarray) -> np.ndarray:
+        return self.sparse[table].pull(ids)
+
+    def push_sparse(self, table: str, ids: np.ndarray, grads: np.ndarray,
+                    lr_scale: float = 1.0):
+        self.sparse[table].push(ids, grads, lr_scale=lr_scale)
+
+    def push_sparse_delta(self, table: str, ids: np.ndarray,
+                          deltas: np.ndarray):
+        self.sparse[table].push_delta(ids, deltas)
+
+    # -- dense --------------------------------------------------------------
+    def pull_dense(self, name: str) -> np.ndarray:
+        return self.dense[name].pull()
+
+    def push_dense(self, name: str, grad: np.ndarray, lr_scale: float = 1.0):
+        self.dense[name].push(grad, lr_scale=lr_scale)
+
+    def push_dense_delta(self, name: str, delta: np.ndarray):
+        self.dense[name].push_delta(delta)
+
+    def set_dense(self, name: str, value: np.ndarray):
+        self.dense[name].set(value)
+
+    # -- coordination -------------------------------------------------------
+    def barrier(self, n_workers: int):
+        """Block until n_workers callers arrive (sync-mode step fence;
+        reference: fetch_barrier/send_barrier ops)."""
+        with self._barrier_cv:
+            gen = self._barrier_gen
+            self._barrier_count += 1
+            if self._barrier_count >= n_workers:
+                self._barrier_count = 0
+                self._barrier_gen += 1
+                self._barrier_cv.notify_all()
+            else:
+                while gen == self._barrier_gen:
+                    self._barrier_cv.wait(timeout=30)
+
+
+class LocalClient:
+    """In-process client: direct dispatch to a PSService."""
+
+    def __init__(self, service: PSService, n_workers: int = 1):
+        self.service = service
+        self.n_workers = n_workers
+
+    def pull_sparse(self, table, ids):
+        return self.service.pull_sparse(table, np.asarray(ids, np.int64))
+
+    def push_sparse(self, table, ids, grads, lr_scale=1.0):
+        self.service.push_sparse(table, ids, grads, lr_scale)
+
+    def push_sparse_delta(self, table, ids, deltas):
+        self.service.push_sparse_delta(table, ids, deltas)
+
+    def pull_dense(self, name):
+        return self.service.pull_dense(name)
+
+    def push_dense(self, name, grad, lr_scale=1.0):
+        self.service.push_dense(name, grad, lr_scale)
+
+    def push_dense_delta(self, name, delta):
+        self.service.push_dense_delta(name, delta)
+
+    def set_dense(self, name, value):
+        self.service.set_dense(name, value)
+
+    def barrier(self):
+        self.service.barrier(self.n_workers)
+
+    def close(self):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Wire protocol
+# ---------------------------------------------------------------------------
+# method ids
+_PULL_SPARSE, _PUSH_SPARSE, _PUSH_SPARSE_DELTA = 1, 2, 3
+_PULL_DENSE, _PUSH_DENSE, _SET_DENSE = 4, 5, 6
+_BARRIER, _STOP, _PUSH_DENSE_DELTA = 7, 8, 9
+
+_HDR = struct.Struct("!I")
+
+
+def _pack_array(a: np.ndarray) -> bytes:
+    a = np.ascontiguousarray(a)
+    dt = a.dtype.str.encode()
+    shape = np.asarray(a.shape, dtype=np.int64).tobytes()
+    return (struct.pack("!BB", len(dt), a.ndim) + dt + shape + a.tobytes())
+
+
+def _unpack_array(buf: memoryview, off: int):
+    ndt, ndim = struct.unpack_from("!BB", buf, off)
+    off += 2
+    dt = bytes(buf[off:off + ndt]).decode()
+    off += ndt
+    shape = np.frombuffer(buf, dtype=np.int64, count=ndim, offset=off)
+    off += 8 * ndim
+    n = int(np.prod(shape)) if ndim else 1
+    a = np.frombuffer(buf, dtype=np.dtype(dt), count=n, offset=off)
+    off += a.nbytes
+    return a.reshape(shape), off
+
+
+def _pack_str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack("!H", len(b)) + b
+
+
+def _unpack_str(buf: memoryview, off: int):
+    (n,) = struct.unpack_from("!H", buf, off)
+    off += 2
+    return bytes(buf[off:off + n]).decode(), off + n
+
+
+def _send_msg(sock: socket.socket, payload: bytes):
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> Optional[memoryview]:
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (n,) = _HDR.unpack(hdr)
+    body = _recv_exact(sock, n)
+    return memoryview(body) if body is not None else None
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            return None
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+class PServer:
+    """Threaded TCP parameter server fronting a PSService.
+
+    Reference: listen_and_serv_op.cc (blocking RPC loop embedded as a
+    graph op) — here a plain host service, started by
+    ``fleet.run_server()`` on server-role processes.
+    """
+
+    def __init__(self, service: PSService, endpoint: str = "127.0.0.1:0",
+                 n_workers: int = 1):
+        self.service = service
+        self.n_workers = n_workers
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_server((host, int(port)))
+        self.endpoint = "%s:%d" % self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._accept_thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket):
+        svc = self.service
+        try:
+            while not self._stop.is_set():
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return
+                method = msg[0]
+                off = 1
+                if method == _PULL_SPARSE:
+                    table, off = _unpack_str(msg, off)
+                    ids, off = _unpack_array(msg, off)
+                    _send_msg(conn, _pack_array(svc.pull_sparse(table, ids)))
+                elif method == _PUSH_SPARSE:
+                    table, off = _unpack_str(msg, off)
+                    (scale,) = struct.unpack_from("!f", msg, off)
+                    off += 4
+                    ids, off = _unpack_array(msg, off)
+                    grads, off = _unpack_array(msg, off)
+                    svc.push_sparse(table, ids, grads, lr_scale=scale)
+                    _send_msg(conn, b"\x00")
+                elif method == _PUSH_SPARSE_DELTA:
+                    table, off = _unpack_str(msg, off)
+                    ids, off = _unpack_array(msg, off)
+                    deltas, off = _unpack_array(msg, off)
+                    svc.push_sparse_delta(table, ids, deltas)
+                    _send_msg(conn, b"\x00")
+                elif method == _PULL_DENSE:
+                    name, off = _unpack_str(msg, off)
+                    _send_msg(conn, _pack_array(svc.pull_dense(name)))
+                elif method == _PUSH_DENSE:
+                    name, off = _unpack_str(msg, off)
+                    (scale,) = struct.unpack_from("!f", msg, off)
+                    off += 4
+                    grad, off = _unpack_array(msg, off)
+                    svc.push_dense(name, grad, lr_scale=scale)
+                    _send_msg(conn, b"\x00")
+                elif method == _PUSH_DENSE_DELTA:
+                    name, off = _unpack_str(msg, off)
+                    delta, off = _unpack_array(msg, off)
+                    svc.push_dense_delta(name, delta)
+                    _send_msg(conn, b"\x00")
+                elif method == _SET_DENSE:
+                    name, off = _unpack_str(msg, off)
+                    value, off = _unpack_array(msg, off)
+                    svc.set_dense(name, value)
+                    _send_msg(conn, b"\x00")
+                elif method == _BARRIER:
+                    svc.barrier(self.n_workers)
+                    _send_msg(conn, b"\x00")
+                elif method == _STOP:
+                    _send_msg(conn, b"\x00")
+                    self.stop()
+                    return
+                else:
+                    raise RuntimeError(f"bad PS method {method}")
+        except (ConnectionError, OSError):
+            return
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RPCClient:
+    """Client for one PServer endpoint (one persistent connection,
+    serialized by a lock — matches per-variable ordered gRPC channels in
+    the reference grpc_client.cc)."""
+
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=30)
+        # blocking calls (barrier on a straggler, large-table seeding) may
+        # legitimately exceed the connect timeout
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def _call(self, payload: bytes) -> memoryview:
+        with self._lock:
+            _send_msg(self._sock, payload)
+            resp = _recv_msg(self._sock)
+        if resp is None:
+            raise ConnectionError("pserver closed connection")
+        return resp
+
+    def pull_sparse(self, table, ids):
+        ids = np.asarray(ids, np.int64)
+        resp = self._call(bytes([_PULL_SPARSE]) + _pack_str(table)
+                          + _pack_array(ids))
+        arr, _ = _unpack_array(resp, 0)
+        return arr.copy()
+
+    def push_sparse(self, table, ids, grads, lr_scale=1.0):
+        self._call(bytes([_PUSH_SPARSE]) + _pack_str(table)
+                   + struct.pack("!f", lr_scale)
+                   + _pack_array(np.asarray(ids, np.int64))
+                   + _pack_array(np.asarray(grads, np.float32)))
+
+    def push_sparse_delta(self, table, ids, deltas):
+        self._call(bytes([_PUSH_SPARSE_DELTA]) + _pack_str(table)
+                   + _pack_array(np.asarray(ids, np.int64))
+                   + _pack_array(np.asarray(deltas, np.float32)))
+
+    def pull_dense(self, name):
+        resp = self._call(bytes([_PULL_DENSE]) + _pack_str(name))
+        arr, _ = _unpack_array(resp, 0)
+        return arr.copy()
+
+    def push_dense(self, name, grad, lr_scale=1.0):
+        self._call(bytes([_PUSH_DENSE]) + _pack_str(name)
+                   + struct.pack("!f", lr_scale)
+                   + _pack_array(np.asarray(grad, np.float32)))
+
+    def push_dense_delta(self, name, delta):
+        self._call(bytes([_PUSH_DENSE_DELTA]) + _pack_str(name)
+                   + _pack_array(np.asarray(delta, np.float32)))
+
+    def set_dense(self, name, value):
+        self._call(bytes([_SET_DENSE]) + _pack_str(name)
+                   + _pack_array(np.asarray(value, np.float32)))
+
+    def barrier(self):
+        self._call(bytes([_BARRIER]))
+
+    def stop_server(self):
+        try:
+            self._call(bytes([_STOP]))
+        except ConnectionError:
+            pass
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ShardedClient:
+    """Routes sparse ids over multiple servers by ``id % n_servers`` and
+    dense tables by round-robin of name hash — DistributeTranspiler's
+    placement policy (transpiler/distribute_transpiler.py:256
+    slice_variable / id-mod routing)."""
+
+    def __init__(self, clients: Sequence):
+        self.clients = list(clients)
+        self.n = len(self.clients)
+
+    def _dense_owner(self, name: str):
+        # crc32, not hash(): every process must route a parameter to the
+        # same server regardless of PYTHONHASHSEED salting
+        return self.clients[zlib.crc32(name.encode()) % self.n]
+
+    def pull_sparse(self, table, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        out = None
+        owner = ids % self.n
+        for k, c in enumerate(self.clients):
+            m = owner == k
+            if not m.any():
+                continue
+            rows = c.pull_sparse(table, ids[m])
+            if out is None:
+                out = np.empty((len(ids), rows.shape[1]), rows.dtype)
+            out[m] = rows
+        if out is None:  # empty batch
+            out = np.empty((0, 1), np.float32)
+        return out
+
+    def push_sparse(self, table, ids, grads, lr_scale=1.0):
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads).reshape(len(ids), -1)
+        owner = ids % self.n
+        for k, c in enumerate(self.clients):
+            m = owner == k
+            if m.any():
+                c.push_sparse(table, ids[m], grads[m], lr_scale)
+
+    def push_sparse_delta(self, table, ids, deltas):
+        ids = np.asarray(ids, np.int64).ravel()
+        deltas = np.asarray(deltas).reshape(len(ids), -1)
+        owner = ids % self.n
+        for k, c in enumerate(self.clients):
+            m = owner == k
+            if m.any():
+                c.push_sparse_delta(table, ids[m], deltas[m])
+
+    def pull_dense(self, name):
+        return self._dense_owner(name).pull_dense(name)
+
+    def push_dense(self, name, grad, lr_scale=1.0):
+        self._dense_owner(name).push_dense(name, grad, lr_scale)
+
+    def push_dense_delta(self, name, delta):
+        self._dense_owner(name).push_dense_delta(name, delta)
+
+    def set_dense(self, name, value):
+        self._dense_owner(name).set_dense(name, value)
+
+    def barrier(self):
+        self.clients[0].barrier()
+
+    def close(self):
+        for c in self.clients:
+            c.close()

@@ -24,7 +24,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["TableConfig", "SparseShard", "SparseTable"]
+__all__ = ["TableConfig", "SparseShard", "SparseTable", "DenseTable"]
 
 _GROW = 1024  # arena growth granularity (rows)
 
@@ -38,7 +38,8 @@ class TableConfig:
                  initializer: Tuple = ("uniform", -0.05, 0.05),
                  optimizer: str = "sgd", lr: float = 0.01,
                  beta1: float = 0.9, beta2: float = 0.999,
-                 epsilon: float = 1e-8, seed: int = 0):
+                 epsilon: float = 1e-8, momentum: float = 0.9,
+                 seed: int = 0):
         self.name = name
         self.dim = int(dim)
         self.dtype = dtype
@@ -46,13 +47,15 @@ class TableConfig:
         self.optimizer = optimizer
         self.lr = float(lr)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.momentum = momentum
         self.seed = int(seed)
 
     def to_dict(self):
         return dict(name=self.name, dim=self.dim, dtype=self.dtype,
                     initializer=list(self.initializer),
                     optimizer=self.optimizer, lr=self.lr, beta1=self.beta1,
-                    beta2=self.beta2, epsilon=self.epsilon, seed=self.seed)
+                    beta2=self.beta2, epsilon=self.epsilon,
+                    momentum=self.momentum, seed=self.seed)
 
     @staticmethod
     def from_dict(d):
@@ -171,7 +174,7 @@ class SparseShard:
                 self._value[idx] -= (lr * g).astype(cfg.dtype)
             elif cfg.optimizer == "momentum":
                 vel = self._slots[0]
-                vel[idx] = 0.9 * vel[idx] + g
+                vel[idx] = cfg.momentum * vel[idx] + g
                 self._value[idx] -= (lr * vel[idx]).astype(cfg.dtype)
             elif cfg.optimizer == "adagrad":
                 acc = self._slots[0]
@@ -228,6 +231,73 @@ def merge_sparse_grad(ids: np.ndarray, grads: np.ndarray
     merged = np.zeros((len(uids), grads.shape[1]), dtype=grads.dtype)
     np.add.at(merged, inv, grads)
     return uids, merged
+
+
+class DenseTable:
+    """Server-side dense parameter + optimizer state.
+
+    The PS-mode trainer program carries forward/backward only; dense
+    optimizer updates run here, mirroring the reference's scheme of moving
+    optimize ops onto the pserver program
+    (transpiler/distribute_transpiler.py:256 get_pserver_program).  One
+    DenseTable per parameter; multi-server deployments split the flat
+    vector into contiguous blocks per server.
+    """
+
+    def __init__(self, name: str, init_value: np.ndarray,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8, momentum: float = 0.9):
+        self.name = name
+        self.value = np.array(init_value, dtype="float32")
+        self.optimizer = optimizer
+        self.lr, self.beta1, self.beta2 = lr, beta1, beta2
+        self.epsilon, self.momentum = epsilon, momentum
+        self._t = 0
+        n_slots = {"sgd": 0, "momentum": 1, "adagrad": 1, "adam": 2}[optimizer]
+        self.slots = [np.zeros_like(self.value) for _ in range(n_slots)]
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self.value.copy()
+
+    def push(self, grad: np.ndarray, lr_scale: float = 1.0):
+        g = np.asarray(grad, dtype="float32").reshape(self.value.shape)
+        lr = self.lr * lr_scale
+        with self._lock:
+            if self.optimizer == "sgd":
+                self.value -= lr * g
+            elif self.optimizer == "momentum":
+                vel = self.slots[0]
+                vel *= self.momentum
+                vel += g
+                self.value -= lr * vel
+            elif self.optimizer == "adagrad":
+                acc = self.slots[0]
+                acc += g * g
+                self.value -= lr * g / (np.sqrt(acc) + self.epsilon)
+            elif self.optimizer == "adam":
+                m, v = self.slots
+                self._t += 1
+                m *= self.beta1
+                m += (1 - self.beta1) * g
+                v *= self.beta2
+                v += (1 - self.beta2) * g * g
+                mhat = m / (1 - self.beta1 ** self._t)
+                vhat = v / (1 - self.beta2 ** self._t)
+                self.value -= lr * mhat / (np.sqrt(vhat) + self.epsilon)
+            else:
+                raise ValueError(f"unknown optimizer {self.optimizer!r}")
+
+    def push_delta(self, delta: np.ndarray):
+        with self._lock:
+            self.value += np.asarray(delta, "float32").reshape(
+                self.value.shape)
+
+    def set(self, value: np.ndarray):
+        with self._lock:
+            self.value = np.array(value, dtype="float32")
 
 
 class SparseTable:

@@ -1,0 +1,307 @@
+"""PS-mode program transpilation + the trainer pull/compute/push loop.
+
+TPU-native counterpart of the reference DistributeTranspiler
+(python/paddle/fluid/transpiler/distribute_transpiler.py:256) +
+DownpourWorker/HogwildWorker (framework/device_worker.h:268,
+framework/downpour_worker.cc): the trainer program is rewritten so that
+sparse ``lookup_table`` ops read a *fed* dense row block instead of a
+device-resident table, and dense parameters lose their optimizer ops
+(the server applies updates).  Every step the PSTrainer:
+
+  1. pulls the embedding rows for the batch's feature ids (and the
+     current dense params) from the server,
+  2. runs the XLA-compiled dense step — which stays a pure, static-shape
+     function; the table never touches HBM,
+  3. fetches the row gradients and pushes them back.
+
+This is the inversion that makes the trillion-parameter sparse claim
+(reference README.md:52) TPU-native: device memory holds only the rows
+the current batch touches.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...framework.core import Program, Variable, grad_var_name
+from .communicator import (AsyncCommunicator, Communicator, GeoCommunicator)
+from .rpc import LocalClient, PSService
+from .table import TableConfig
+
+__all__ = ["SparseSection", "PSContext", "transpile_to_ps",
+           "build_service", "PSTrainer"]
+
+# vocab sizes at or above this never materialize densely; rows lazy-init
+# on the server (reference large_scale_kv.h path)
+LARGE_VOCAB = 1 << 30
+
+
+@dataclass
+class SparseSection:
+    """One rewritten sparse lookup."""
+    table_name: str          # original W parameter name == server table
+    ids_name: str            # feed var holding feature ids
+    pulled_name: str         # new feed var: gathered rows [*, dim]
+    out_name: str            # original lookup output
+    dim: int
+    padding_idx: int = -1
+    version: int = 2         # lookup_table (1: ids [N,1]) vs _v2
+    vocab: int = 0
+    lazy_init: bool = False  # True: never densely initialized anywhere
+
+    @property
+    def grad_name(self) -> str:
+        return grad_var_name(self.pulled_name)
+
+
+@dataclass
+class PSContext:
+    """Everything the runtime needs, attached to the trainer program."""
+    sections: List[SparseSection]
+    dense_params: List[Tuple[str, str, tuple]]  # (name, grad_name, shape)
+    optimizer: str = "sgd"
+    lr: float = 0.01
+    opt_kwargs: dict = field(default_factory=dict)
+    mode: str = "sync"       # sync | async | geo
+    k_steps: int = 100       # geo sync interval
+
+    def table_configs(self) -> List[TableConfig]:
+        return [TableConfig(s.table_name, s.dim, optimizer=self.optimizer,
+                            lr=self.lr, **self.opt_kwargs)
+                for s in self.sections]
+
+
+def transpile_to_ps(program: Program) -> List[SparseSection]:
+    """Rewrite sparse lookups in-place; call BEFORE append_backward so
+    gradients flow to the pulled rows.
+
+    Each ``lookup_table(_v2)`` with ``is_sparse``/``is_distributed``
+    becomes ``assign(Out <- W@PULLED)`` where ``W@PULLED`` is a feed var;
+    W leaves the parameter list (the server owns it).  Startup
+    initialization of W is kept for normal vocabs — ``PSTrainer.
+    init_worker`` seeds the server from it, preserving exact parity with
+    a dense baseline — and stripped for LARGE_VOCAB/is_distributed
+    tables, which lazy-init server-side.
+    """
+    block = program.global_block()
+    sections: List[SparseSection] = []
+    for op in list(block.ops):
+        if op.type not in ("lookup_table", "lookup_table_v2"):
+            continue
+        if not (op.attrs.get("is_sparse") or op.attrs.get("is_distributed")):
+            continue
+        w_name = op.single_input("W")
+        ids_name = op.single_input("Ids")
+        out_name = op.single_output("Out")
+        w = block.var(w_name)
+        out = block.var(out_name)
+        vocab, dim = int(w.shape[0]), int(w.shape[-1])
+        lazy = bool(op.attrs.get("is_distributed")) or vocab >= LARGE_VOCAB
+        padding_idx = int(op.attrs.get("padding_idx", -1))
+        version = 1 if op.type == "lookup_table" else 2
+        pulled_name = w_name + "@PULLED"
+        block.create_var(name=pulled_name, shape=out.shape, dtype=w.dtype,
+                         is_data=True, stop_gradient=False, trainable=False)
+        # rewrite in place (keeps op position and the Out consumers)
+        op.type = "assign"
+        op.inputs = {"X": [pulled_name]}
+        op.outputs = {"Out": [out_name]}
+        op.attrs = {k: v for k, v in op.attrs.items() if k == "op_role"}
+        sections.append(SparseSection(
+            table_name=w_name, ids_name=ids_name, pulled_name=pulled_name,
+            out_name=out_name, dim=dim, padding_idx=padding_idx,
+            version=version, vocab=vocab, lazy_init=lazy))
+        # the W parameter is now server-owned
+        if w_name in block.vars:
+            del block.vars[w_name]
+    return sections
+
+
+def _strip_startup_init(startup: Program, names: Sequence[str]):
+    """Remove init ops (and vars) for server-lazy tables from the startup
+    program so a 2^40-row table never materializes host- or device-side."""
+    block = startup.global_block()
+    names = set(names)
+    for i in reversed(range(len(block.ops))):
+        op = block.ops[i]
+        if set(op.output_arg_names()) & names:
+            block._remove_op(i) if hasattr(block, "_remove_op") else \
+                block.ops.pop(i)
+    for n in names:
+        block.vars.pop(n, None)
+
+
+def build_service(ctx: PSContext, scope=None,
+                  dense_init: Optional[Dict[str, np.ndarray]] = None
+                  ) -> PSService:
+    """Construct the server-side service for a PSContext.
+
+    Sparse tables are created empty (rows lazy-init or seeded by
+    ``PSTrainer.init_worker``).  Dense tables are created from
+    ``dense_init``/scope values when available, else zeros — the first
+    worker's init push overwrites them (reference: trainer0 sends initial
+    params to pservers).
+    """
+    svc = PSService()
+    for cfg in ctx.table_configs():
+        svc.create_sparse_table(cfg)
+    for name, _g, shape in ctx.dense_params:
+        init = None
+        if dense_init and name in dense_init:
+            init = dense_init[name]
+        elif scope is not None and scope.find_var(name) is not None:
+            init = np.asarray(scope.find_var(name))
+        if init is None:
+            init = np.zeros(shape, "float32")
+        svc.create_dense_table(name, init, optimizer=ctx.optimizer,
+                               lr=ctx.lr, **ctx.opt_kwargs)
+    return svc
+
+
+class PSTrainer:
+    """Runs one worker's pull/compute/push loop around an Executor.
+
+    ``init_worker()`` must run after the startup program: it seeds the
+    server's sparse tables from any densely-initialized W still in scope
+    (non-lazy tables), pushes initial dense params (worker 0), and drops
+    the dense W copy from the trainer (reference
+    fleet.init_worker / communicator start).
+    """
+
+    def __init__(self, program: Program, ctx: PSContext,
+                 communicator: Communicator, executor=None, scope=None,
+                 worker_index: int = 0, n_workers: int = 1):
+        from ...framework.executor import Executor, global_scope
+        self.program = program
+        self.ctx = ctx
+        self.comm = communicator
+        self.exe = executor or Executor()
+        self.scope = scope or global_scope()
+        self.worker_index = worker_index
+        self.n_workers = n_workers
+        # per-step LR multiplier (host-side LR schedules in PS mode: the
+        # server applies base_lr * lr_scale)
+        self.lr_scale = 1.0
+        self._dense_names = [d[0] for d in ctx.dense_params]
+        self._dense_grads = [d[1] for d in ctx.dense_params]
+        self._dense_shapes = {d[0]: tuple(d[2]) for d in ctx.dense_params}
+
+    # -- lifecycle ----------------------------------------------------------
+    def init_worker(self):
+        client = self.comm.client
+        if self.worker_index == 0:
+            for sec in self.ctx.sections:
+                if sec.lazy_init:
+                    continue
+                v = self.scope.find_var(sec.table_name)
+                if v is not None:
+                    w = np.asarray(v)
+                    if isinstance(client, LocalClient):
+                        client.service.sparse[sec.table_name].load(
+                            np.arange(w.shape[0], dtype=np.int64), w)
+                    else:
+                        _rpc_seed_sparse(client, sec, w)
+                    self.scope.erase([sec.table_name])
+            for name in self._dense_names:
+                v = self.scope.find_var(name)
+                if v is not None:
+                    client.set_dense(name, np.asarray(v, dtype="float32"))
+        if isinstance(self.comm, GeoCommunicator):
+            # geo trains dense locally: register local copies
+            for name in self._dense_names:
+                v = self.scope.find_var(name)
+                init = (np.asarray(v) if v is not None
+                        else self.comm.client.pull_dense(name).reshape(
+                            self._dense_shapes[name]))
+                self.comm.register_dense(name, np.asarray(init, "float32"),
+                                         lr=self.ctx.lr)
+            # local mirrors of seeded (non-lazy) tables must match the
+            # server; lazy tables already agree via the shared TableConfig
+            # seed + deterministic per-id init.
+            for sec in self.ctx.sections:
+                if sec.lazy_init:
+                    continue
+                ids = np.arange(sec.vocab, dtype=np.int64)
+                vals = client.pull_sparse(sec.table_name, ids)
+                self.comm.local[sec.table_name].load(ids, vals)
+                self.comm.base[sec.table_name].load(ids, vals)
+        self.comm.start()
+        if self.n_workers > 1:
+            # no worker may train until worker 0 finished seeding
+            client.barrier()
+
+    def stop_worker(self):
+        self.comm.stop()
+
+    # -- the per-step cycle --------------------------------------------------
+    def run(self, feed: Dict[str, np.ndarray], fetch_list=None,
+            return_numpy: bool = True):
+        feed = dict(feed)
+        fetch_list = list(fetch_list or [])
+        user_fetch_n = len(fetch_list)
+
+        # 1. pull dense params into scope (server-owned unless geo-local)
+        for name in self._dense_names:
+            val = self.comm.pull_dense(name).reshape(
+                self._dense_shapes[name])
+            self.scope.set_var(name, val)
+
+        # 2. pull sparse rows -> feed
+        masks = {}
+        for sec in self.ctx.sections:
+            ids = np.asarray(feed[sec.ids_name], np.int64)
+            flat = ids.ravel()
+            rows = np.asarray(
+                self.comm.pull_sparse(sec.table_name, flat),
+                dtype="float32").reshape(len(flat), sec.dim)
+            if sec.padding_idx >= 0:
+                pad = flat == sec.padding_idx
+                rows[pad] = 0.0
+                masks[sec.pulled_name] = pad
+            if sec.version == 1:
+                out_shape = (ids.shape[0], sec.dim)
+            else:
+                out_shape = tuple(ids.shape) + (sec.dim,)
+            feed[sec.pulled_name] = rows.reshape(out_shape)
+
+        # 3. run the compiled dense step, fetching user targets + grads
+        grad_names = [sec.grad_name for sec in self.ctx.sections] + \
+            self._dense_grads
+        outs = self.exe.run(self.program, feed=feed,
+                            fetch_list=fetch_list + grad_names,
+                            scope=self.scope, return_numpy=True)
+        user_outs, grads = outs[:user_fetch_n], outs[user_fetch_n:]
+
+        # 4. push gradients
+        for sec, g in zip(self.ctx.sections, grads):
+            ids = np.asarray(feed[sec.ids_name], np.int64).ravel()
+            g = np.asarray(g, "float32").reshape(len(ids), sec.dim)
+            pad = masks.get(sec.pulled_name)
+            if pad is not None and pad.any():
+                keep = ~pad
+                ids, g = ids[keep], g[keep]
+            self.comm.push_sparse(sec.table_name, ids, g,
+                                  lr_scale=self.lr_scale)
+        for name, g in zip(self._dense_names,
+                           grads[len(self.ctx.sections):]):
+            self.comm.push_dense(name, np.asarray(g, "float32"),
+                                 lr_scale=self.lr_scale)
+
+        self.comm.step_done()
+        if self.ctx.mode == "sync" and self.n_workers > 1:
+            self.comm.barrier()
+        return user_outs
+
+
+def _rpc_seed_sparse(client, sec: SparseSection, w: np.ndarray,
+                     chunk: int = 65536):
+    """Seed a server table over RPC: rows start at deterministic init, so
+    send (value - init) as a delta in chunks."""
+    n = w.shape[0]
+    for lo in range(0, n, chunk):
+        ids = np.arange(lo, min(lo + chunk, n), dtype=np.int64)
+        cur = client.pull_sparse(sec.table_name, ids)  # materializes init
+        client.push_sparse_delta(sec.table_name, ids,
+                                 np.asarray(w[lo:lo + len(ids)]) - cur)

@@ -1,0 +1,65 @@
+"""Wide & Deep CTR model (Cheng et al. 2016) — the reference's flagship
+parameter-server workload (BASELINE.md tracked config; reference trains
+it via PaddleRec on the CPU PS cluster, README.md:52).
+
+Criteo-style input: ``num_sparse`` categorical slots (int64 feature ids,
+hashed into one shared table space) + ``num_dense`` continuous features.
+
+  * wide: per-slot 1-d embeddings summed with the dense features through
+    a linear layer — a (sparse) logistic regression.
+  * deep: per-slot ``embed_dim`` embeddings concatenated with the dense
+    features through an MLP.
+  * logit = wide + deep; loss = sigmoid cross entropy; metric = AUC.
+
+With ``is_sparse=True`` (the default) the embedding tables take the
+lookup_table sparse path, so under fleet PS mode they are transpiled to
+server-resident tables (distributed/ps/worker.py) and the declared vocab
+can exceed device HBM — set ``is_distributed=True`` for the
+lazy-initialized LARGE_VOCAB server tables.
+"""
+from __future__ import annotations
+
+from .. import layers
+
+__all__ = ["wide_deep_net"]
+
+
+def wide_deep_net(num_sparse: int = 26, num_dense: int = 13,
+                  vocab_size: int = 1000001, embed_dim: int = 10,
+                  hidden: (tuple) = (400, 400, 400),
+                  is_sparse: bool = True, is_distributed: bool = False):
+    """Build the static-graph Wide&Deep; returns a dict of handles."""
+    sparse_ids = layers.data("sparse_ids", shape=[num_sparse], dtype="int64",
+                             append_batch_size=True)
+    dense_x = layers.data("dense_x", shape=[num_dense], dtype="float32",
+                          append_batch_size=True)
+    label = layers.data("label", shape=[1], dtype="float32",
+                        append_batch_size=True)
+
+    # ---- wide: 1-d embeddings + linear on dense --------------------------
+    wide_emb = layers.embedding(
+        sparse_ids, size=[vocab_size, 1], is_sparse=is_sparse,
+        is_distributed=is_distributed, name="wide_embedding",
+        param_attr="wide_embedding_w")
+    # [b, num_sparse, 1] -> sum over slots -> [b, 1]
+    wide_sum = layers.reduce_sum(wide_emb, dim=1)
+    wide_dense = layers.fc(dense_x, size=1, name="wide_fc")
+    wide_logit = wide_sum + wide_dense
+
+    # ---- deep: embed_dim embeddings -> MLP -------------------------------
+    deep_emb = layers.embedding(
+        sparse_ids, size=[vocab_size, embed_dim], is_sparse=is_sparse,
+        is_distributed=is_distributed, name="deep_embedding",
+        param_attr="deep_embedding_w")
+    flat = layers.flatten(deep_emb, axis=1)        # [b, num_sparse*dim]
+    x = layers.concat([flat, dense_x], axis=1)
+    for i, h in enumerate(hidden):
+        x = layers.fc(x, size=h, act="relu", name=f"deep_fc{i}")
+    deep_logit = layers.fc(x, size=1, name="deep_out")
+
+    logit = wide_logit + deep_logit
+    prob = layers.sigmoid(logit)
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logit, label))
+    return {"sparse_ids": sparse_ids, "dense_x": dense_x, "label": label,
+            "logit": logit, "prob": prob, "loss": loss}

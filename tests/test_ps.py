@@ -1,0 +1,363 @@
+"""Parameter-server / sparse path tests.
+
+Reference analogs: tests/unittests/test_dist_fleet_ps*.py,
+test_communicator_{sync,async,geo}.py, test_lookup_table_op.py sparse
+branches, and the large-scale-kv unit tests — here against the
+host-resident SparseTable + pull/compute/push PSTrainer.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.framework.core import reset_unique_name
+from paddle_tpu.ops.registry import reset_op_seed
+from paddle_tpu.distributed.ps import (
+    AsyncCommunicator, Communicator, GeoCommunicator, LocalClient, PServer,
+    PSService, PSTrainer, RPCClient, ShardedClient, SparseTable, TableConfig,
+    build_service, make_communicator, merge_sparse_grad, transpile_to_ps)
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.distributed_strategy import (
+    DistributedStrategy)
+from paddle_tpu.distributed.fleet.role_maker import (Role,
+                                                     UserDefinedRoleMaker)
+
+
+# ---------------------------------------------------------------------------
+# table-level tests
+# ---------------------------------------------------------------------------
+def test_sparse_table_lazy_and_deterministic():
+    cfg = TableConfig("t", dim=4, seed=7)
+    t = SparseTable(cfg)
+    # ids far beyond any dense capacity: 2^40-range feature space
+    ids = np.array([3, 2**40 - 1, 3, 12345678901], dtype=np.int64)
+    rows = t.pull(ids)
+    assert rows.shape == (4, 4)
+    assert t.size() == 3  # duplicates dedupe; only touched rows exist
+    np.testing.assert_array_equal(rows[0], rows[2])
+    # same id -> same init in a *fresh* table (deterministic per-id stream)
+    t2 = SparseTable(cfg)
+    np.testing.assert_array_equal(t2.pull(ids), rows)
+    # different seed -> different init
+    t3 = SparseTable(TableConfig("t", dim=4, seed=8))
+    assert not np.array_equal(t3.pull(ids[:1]), rows[:1])
+
+
+def test_sparse_table_adam_matches_dense_reference():
+    cfg = TableConfig("t", dim=3, optimizer="adam", lr=0.01, seed=1)
+    t = SparseTable(cfg, n_shards=2)
+    ids = np.array([5, 9], dtype=np.int64)
+    w = t.pull(ids).astype("float64")
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    rng = np.random.RandomState(0)
+    for step in range(1, 6):
+        g = rng.randn(2, 3)
+        t.push(ids, g.astype("float32"))
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mh = m / (1 - 0.9 ** step)
+        vh = v / (1 - 0.999 ** step)
+        w -= 0.01 * mh / (np.sqrt(vh) + 1e-8)
+    np.testing.assert_allclose(t.pull(ids), w, rtol=1e-5, atol=1e-6)
+
+
+def test_merge_sparse_grad():
+    ids = np.array([7, 3, 7, 7], dtype=np.int64)
+    g = np.arange(8, dtype="float32").reshape(4, 2)
+    uids, merged = merge_sparse_grad(ids, g)
+    np.testing.assert_array_equal(uids, [3, 7])
+    np.testing.assert_allclose(merged[0], g[1])
+    np.testing.assert_allclose(merged[1], g[0] + g[2] + g[3])
+
+
+def test_sparse_table_save_restore(tmp_path):
+    cfg = TableConfig("t", dim=4, seed=3)
+    t = SparseTable(cfg)
+    ids = np.array([1, 2**33, 9], dtype=np.int64)
+    t.push(ids, np.ones((3, 4), "float32"))
+    path = str(tmp_path / "table.npz")
+    t.save(path)
+    r = SparseTable.restore(path)
+    got_ids, got_vals = r.export()
+    want_ids, want_vals = t.export()
+    order_g, order_w = np.argsort(got_ids), np.argsort(want_ids)
+    np.testing.assert_array_equal(got_ids[order_g], want_ids[order_w])
+    np.testing.assert_allclose(got_vals[order_g], want_vals[order_w])
+
+
+# ---------------------------------------------------------------------------
+# rpc transport
+# ---------------------------------------------------------------------------
+def _make_service():
+    svc = PSService()
+    svc.create_sparse_table(TableConfig("emb", dim=4, seed=2))
+    svc.create_dense_table("w", np.zeros((3, 2), "float32"), lr=0.1)
+    return svc
+
+
+def test_rpc_matches_local():
+    svc = _make_service()
+    server = PServer(svc, n_workers=1).start()
+    try:
+        rpc = RPCClient(server.endpoint)
+        local = LocalClient(_make_service())
+        ids = np.array([4, 99, 2**35], dtype=np.int64)
+        np.testing.assert_array_equal(rpc.pull_sparse("emb", ids),
+                                      local.pull_sparse("emb", ids))
+        g = np.ones((3, 4), "float32")
+        rpc.push_sparse("emb", ids, g)
+        local.push_sparse("emb", ids, g)
+        np.testing.assert_allclose(rpc.pull_sparse("emb", ids),
+                                   local.pull_sparse("emb", ids))
+        rpc.push_dense("w", np.ones((3, 2)))
+        local.push_dense("w", np.ones((3, 2)))
+        np.testing.assert_allclose(rpc.pull_dense("w"),
+                                   local.pull_dense("w"))
+        rpc.close()
+    finally:
+        server.stop()
+
+
+def test_sharded_client_routes_by_id():
+    servers = [PServer(_make_service(), n_workers=1).start()
+               for _ in range(2)]
+    try:
+        sc = ShardedClient([RPCClient(s.endpoint) for s in servers])
+        ids = np.array([0, 1, 2, 3, 101], dtype=np.int64)
+        rows = sc.pull_sparse("emb", ids)
+        # single-table reference: values must agree with an unsharded pull
+        ref = LocalClient(_make_service()).pull_sparse("emb", ids)
+        np.testing.assert_array_equal(rows, ref)
+        # rows landed on the right shard: even ids on server0, odd on 1
+        assert servers[0].service.sparse["emb"].size() == 2
+        assert servers[1].service.sparse["emb"].size() == 3
+        sc.push_sparse("emb", ids, np.ones((5, 4), "float32"))
+        np.testing.assert_allclose(
+            sc.pull_sparse("emb", ids), ref - 0.01)  # sgd lr=0.01 default
+        sc.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: transpiled program + trainer
+# ---------------------------------------------------------------------------
+VOCAB, DIM, SLOTS, DENSE = 50, 8, 3, 4
+
+
+def _ctr_net(is_sparse):
+    ids = layers.data("ids", [SLOTS], dtype="int64")
+    dx = layers.data("dx", [DENSE])
+    label = layers.data("label", [1])
+    emb = layers.embedding(ids, [VOCAB, DIM], is_sparse=is_sparse,
+                           param_attr="emb_w")
+    x = layers.concat([layers.flatten(emb, axis=1), dx], axis=1)
+    h = layers.fc(x, 16, act="relu", name="fc1")
+    logit = layers.fc(h, 1, name="fc2")
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logit, label))
+    return loss
+
+
+def _batches(n, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        ids = rng.randint(0, VOCAB, (batch, SLOTS)).astype("int64")
+        dx = rng.rand(batch, DENSE).astype("float32")
+        # learnable signal: label depends on the dense features AND on a
+        # fixed per-id weight, so both paths must train for loss to drop
+        label = ((dx.sum(1) + (ids.sum(1) % 7) / 7.0) >
+                 DENSE / 2.0 + 0.5).astype("float32")[:, None]
+        out.append({"ids": ids, "dx": dx, "label": label})
+    return out
+
+
+def _dense_baseline(feeds, lr=0.1):
+    """Plain single-process training with a device-resident embedding."""
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    reset_unique_name()
+    reset_op_seed()
+    with pt.program_guard(main, startup):
+        loss = _ctr_net(is_sparse=False)
+        optimizer.SGDOptimizer(lr).minimize(loss)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    return [float(exe.run(main, feed=f, fetch_list=[loss], scope=scope)[0])
+            for f in feeds]
+
+
+def _build_ps_program(lr=0.1, strategy=None):
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    reset_unique_name()
+    reset_op_seed()
+    with pt.program_guard(main, startup):
+        loss = _ctr_net(is_sparse=True)
+        role = UserDefinedRoleMaker(current_id=0, role=Role.WORKER,
+                                    worker_num=1)
+        fleet.init(role, strategy=strategy or DistributedStrategy())
+        fleet.distributed_optimizer(
+            optimizer.SGDOptimizer(lr)).minimize(loss, startup)
+    return main, startup, loss
+
+
+def test_ps_sync_parity_vs_dense_baseline():
+    """Sync PS must trace the dense baseline exactly: same init, same SGD,
+    same batches -> same per-step losses (reference
+    test_dist_fleet_ps parity methodology)."""
+    feeds = _batches(5)
+    ref = _dense_baseline(feeds)
+
+    main, startup, loss = _build_ps_program()
+    ctx = main._ps_ctx
+    assert ctx.mode == "sync"
+    assert [s.table_name for s in ctx.sections] == ["emb_w"]
+    # the embedding is no longer a trainer parameter
+    assert "emb_w" not in [p.name for p in main.all_parameters()]
+
+    exe = pt.Executor()
+    exe.run(startup)
+    trainer = fleet.init_worker()
+    got = [float(trainer.run(f, fetch_list=[loss])[0]) for f in feeds]
+    fleet.stop_worker()
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+
+
+def test_ps_async_two_trainers_hogwild():
+    """Async mode: two trainer threads sharing one service; staleness is
+    allowed but training must still converge (loss drops)."""
+    strategy = DistributedStrategy()
+    strategy.a_sync = True
+
+    service = {}
+    results = {}
+
+    def run_trainer(idx):
+        main, startup = pt.Program(), pt.Program()
+        startup._is_startup = True
+        # NOTE: program build mutates global name counter; serialize builds
+        with build_lock:
+            reset_unique_name()
+            reset_op_seed()
+            with pt.program_guard(main, startup):
+                loss = _ctr_net(is_sparse=True)
+                from paddle_tpu.distributed.fleet.fleet_base import Fleet
+                fl = Fleet()
+                fl.init(UserDefinedRoleMaker(current_id=idx,
+                                             role=Role.WORKER, worker_num=2),
+                        strategy=strategy)
+                fl.distributed_optimizer(
+                    optimizer.SGDOptimizer(0.1)).minimize(loss, startup)
+            ctx = main._ps_ctx
+            assert ctx.mode == "async"
+            if "svc" not in service:
+                scope = pt.Scope()
+                pt.Executor().run(startup, scope=scope)
+                service["svc"] = build_service(ctx, scope=scope)
+                service["scope0"] = scope
+        client = LocalClient(service["svc"], n_workers=2)
+        comm = make_communicator("async", client)
+        # worker 0 seeds the server from its startup-initialized scope;
+        # init_worker's barrier fences worker 1 until seeding is done
+        scope = service["scope0"] if idx == 0 else pt.Scope()
+        trainer = PSTrainer(main, ctx, comm, scope=scope,
+                            worker_index=idx, n_workers=2)
+        trainer.init_worker()
+        losses = [float(trainer.run(f, fetch_list=[loss.name])[0])
+                  for f in _batches(30, batch=16, seed=10 + idx)]
+        comm.flush()
+        comm.stop()
+        results[idx] = losses
+
+    build_lock = threading.Lock()
+    ts = [threading.Thread(target=run_trainer, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=300)
+    assert set(results) == {0, 1}
+    for idx, losses in results.items():
+        assert np.mean(losses[-8:]) < np.mean(losses[:8]), (idx, losses)
+
+
+def test_ps_geo_mode_converges_and_syncs():
+    strategy = DistributedStrategy()
+    strategy.a_sync = True
+    strategy.a_sync_configs["k_steps"] = 2
+    main, startup, loss = _build_ps_program(strategy=strategy)
+    ctx = main._ps_ctx
+    assert ctx.mode == "geo" and ctx.k_steps == 2
+
+    exe = pt.Executor()
+    exe.run(startup)
+    trainer = fleet.init_worker()
+    assert isinstance(trainer.comm, GeoCommunicator)
+    feeds = _batches(30)
+    losses = [float(trainer.run(f, fetch_list=[loss])[0]) for f in feeds]
+    fleet.stop_worker()
+    assert np.mean(losses[-8:]) < np.mean(losses[:8]), losses
+    # server table actually received the deltas: its rows moved away from
+    # the seeded init for touched ids
+    svc = fleet.fleet_instance()._ps_service
+    ids = np.unique(np.concatenate([f["ids"].ravel() for f in feeds]))
+    server_rows = svc.sparse["emb_w"].pull(ids)
+    local_rows = trainer.comm.local["emb_w"].pull(ids)
+    np.testing.assert_allclose(server_rows, local_rows, atol=1e-6)
+
+
+def test_wide_deep_ps_trains():
+    """The tracked Wide&Deep CTR config end-to-end through fleet PS mode,
+    with a declared vocab no device could hold densely (lazy server
+    rows)."""
+    from paddle_tpu.models.wide_deep import wide_deep_net
+
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    reset_unique_name()
+    reset_op_seed()
+    with pt.program_guard(main, startup):
+        net = wide_deep_net(num_sparse=6, num_dense=4,
+                            vocab_size=1 << 40,  # 10^12-scale feature space
+                            embed_dim=8, hidden=(32, 16),
+                            is_sparse=True, is_distributed=True)
+        fleet.init(UserDefinedRoleMaker(current_id=0, role=Role.WORKER,
+                                        worker_num=1),
+                   strategy=DistributedStrategy())
+        fleet.distributed_optimizer(
+            optimizer.AdamOptimizer(1e-2)).minimize(net["loss"], startup)
+
+    ctx = main._ps_ctx
+    assert all(s.lazy_init for s in ctx.sections)
+    assert ctx.optimizer == "adam"
+    # huge tables must NOT appear in the startup program
+    snames = [n for b in startup.blocks for n in b.vars]
+    assert "wide_embedding_w" not in snames
+    assert "deep_embedding_w" not in snames
+
+    exe = pt.Executor()
+    exe.run(startup)
+    trainer = fleet.init_worker()
+
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(15):
+        ids = rng.randint(0, 1 << 40, (16, 6)).astype("int64")
+        # make the label learnable from the dense features
+        dx = rng.rand(16, 4).astype("float32")
+        label = (dx.sum(1, keepdims=True) > 2.0).astype("float32")
+        out = trainer.run({"sparse_ids": ids, "dense_x": dx, "label": label},
+                          fetch_list=[net["loss"]])
+        losses.append(float(out[0]))
+    fleet.stop_worker()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+    # only touched rows materialized: 15 steps * 16 rows * 6 slots upper
+    # bound, out of the 2^40 declared
+    svc = fleet.fleet_instance()._ps_service
+    assert 0 < svc.sparse["deep_embedding_w"].size() <= 15 * 16 * 6
