@@ -1,11 +1,28 @@
 """Flagship benchmark: BERT-base MLM pretraining step throughput.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": ...}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": ...,
+   "stats": {...}, "device_kind": ..., "anomaly": null|str, ...}
 
 Recipe (the credible BERT pretraining setup): bf16 AMP (white-list
-autocast, fp32 master weights), pallas flash attention, Adam with linear
-warmup + global-norm gradient clipping.
+autocast incl. bf16 activation stream, fp32 master weights), XLA fused
+attention (measured faster than the pallas kernel at every length on
+v5e — see BENCH_FLASH), masked-position MLM head (vocab projection on
+the P masked tokens only — the standard create_pretraining_data format),
+Adam with linear warmup + global-norm gradient clipping, input stream
+staged through the DataLoader's device-prefetch path (no cached-batch
+feeding).
+
+Measurement discipline (round-2 postmortem: a driver capture once
+published 28.5 samples/s for a run that reproduces at 606 — chip
+contention that the bench could neither detect nor explain):
+  * W windows of K steps, fenced by a host readback of the final loss of
+    each window (one fence per window, not per step).
+  * reports median/p10/p90/min/max over windows + device_kind.
+  * anomaly detection: window spread (max/min) > 2x, or per-chip
+    throughput below a device-kind sanity floor -> the whole measurement
+    re-runs once; if still anomalous the JSON carries "anomaly": <reason>
+    so a garbage number can never be published silently.
 
 Baseline: the north-star (BASELINE.json) is ERNIE/BERT-base pretraining at
 >=90% of reported 8xV100 throughput, per chip. The reference repo publishes
@@ -13,9 +30,12 @@ no number in-tree (BASELINE.md); we use the widely reported ~105
 samples/sec/GPU for BERT-base seq-128 fp16 pretraining on V100 as the
 per-chip baseline. vs_baseline = our samples/sec/chip / 105.
 
-MFU: analytic model FLOPs (fwd 2*flops_per_matmul summed over the
-transformer, x3 for fwd+bwd) over the chip's peak bf16 FLOP/s
-(PEAK_TFLOPS env, default 275 = TPU v4).
+Config via env: BENCH_SEQ (128|512), BENCH_BATCH (per-chip, default 64),
+PEAK_TFLOPS (per-chip peak override).
+
+Known deviation from the reference recipe: the flash-attention path folds
+out attention-probability dropout (output dropout kept) — reported in the
+JSON as "deviations".
 """
 from __future__ import annotations
 
@@ -27,24 +47,32 @@ import numpy as np
 
 BASELINE_SAMPLES_PER_SEC_PER_CHIP = 105.0
 
-BATCH = 32
-SEQ = 128
+SEQ = int(os.environ.get("BENCH_SEQ", "128"))
+BATCH_PER_CHIP = int(os.environ.get("BENCH_BATCH", "64"))
+MAX_PRED = max(1, int(round(0.15 * SEQ)))
 WARMUP = 3
-ITERS = 30
+WINDOWS = 6
+STEPS_PER_WINDOW = 5
+
+# sanity floors (samples/s/chip) by device kind — far below any healthy
+# run, far above a contended/broken one
+FLOORS = {"tpu": 100.0, "cpu": 0.0}
 
 
-def bert_train_flops_per_sample(seq, vocab, hidden, layers_n, inter):
+def bert_train_flops_per_sample(seq, vocab, hidden, layers_n, inter,
+                                n_pred):
     """Analytic matmul FLOPs for one BERT MLM training sample.
 
     Per token, per layer: QKV proj 6H^2, attn scores+PV 4*H*S, out proj
-    2H^2, FFN 4*H*I (each matmul = 2mk per output elem). MLM head:
-    2H^2 + 2*H*V. Train = 3x forward (bwd ~ 2x fwd matmul FLOPs).
+    2H^2, FFN 4*H*I (each matmul = 2mk per output elem). MLM head runs on
+    the n_pred gathered positions only: (2H^2 + 2*H*V) per prediction.
+    Train = 3x forward (bwd ~ 2x fwd matmul FLOPs).
     """
     per_layer = 6 * hidden ** 2 + 2 * hidden ** 2 + 4 * hidden * seq \
         + 4 * hidden * inter
     head = 2 * hidden ** 2 + 2 * hidden * vocab
-    fwd_per_token = layers_n * per_layer + head
-    return 3.0 * fwd_per_token * seq
+    fwd = layers_n * per_layer * seq + head * n_pred
+    return 3.0 * fwd
 
 
 def _peak_tflops(device) -> float:
@@ -60,20 +88,94 @@ def _peak_tflops(device) -> float:
     return 275.0  # unknown: assume v4
 
 
+def _batch_stream(feed_names, B, S, V, mesh, n_distinct=4):
+    """Endless stream of device-staged, dp-sharded training batches.
+
+    n_distinct host batches are generated up front (host RNG off the
+    timed path) and cycled; every yield is already on device via the
+    DataLoader's double-buffer staging (reader.device_prefetch).
+    """
+    import itertools
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.reader import device_prefetch
+
+    rng = np.random.RandomState(0)
+    host = []
+    for _ in range(n_distinct):
+        pos = np.sort(
+            np.stack([rng.choice(S, MAX_PRED, replace=False)
+                      for _ in range(B)]), axis=1).astype("int64")
+        host.append({
+            "input_ids": rng.randint(0, V, (B, S)).astype("int64"),
+            "token_type_ids": np.zeros((B, S), "int64"),
+            "attn_mask": np.ones((B, S), "float32"),
+            "mlm_positions": pos,
+            "mlm_labels": rng.randint(0, V, (B, MAX_PRED)).astype("int64"),
+            "mlm_weights": np.ones((B, MAX_PRED), "float32"),
+        })
+    sh = NamedSharding(mesh, P("dp"))
+    stream = (tuple(b[n] for n in feed_names)
+              for b in itertools.cycle(host))
+    return device_prefetch(stream, depth=2, device=sh)
+
+
+def _measure(fn, batches, mut_vals, const_vals, step0, B):
+    """One measurement: WINDOWS windows, fence per window, per-window
+    samples/s."""
+    step = step0
+    rates = []
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(STEPS_PER_WINDOW):
+            step += 1
+            fetches, mut_vals, _ = fn(next(batches), mut_vals, const_vals,
+                                      np.int32(step))
+        loss = float(np.asarray(fetches[0]).reshape(-1)[0])  # fence
+        dt = time.perf_counter() - t0
+        if not np.isfinite(loss):
+            raise RuntimeError(f"non-finite loss {loss}")
+        rates.append(B * STEPS_PER_WINDOW / dt)
+    return rates, mut_vals, step, loss
+
+
 def main():
     import jax
+
+    # rbg PRNG: threefry dropout-mask generation costs ~10% of the step
+    # on TPU; rbg makes it free (measured 600 -> 660 samples/s).  The
+    # env may pre-import jax (sitecustomize), so set the live config —
+    # an env var would be read too late.
+    if "JAX_DEFAULT_PRNG_IMPL" not in os.environ:
+        jax.config.update("jax_default_prng_impl", "rbg")
+
     import paddle_tpu as pt
     from paddle_tpu import clip, optimizer
     from paddle_tpu.contrib import mixed_precision
     from paddle_tpu.models import build_bert_pretrain
     from paddle_tpu.parallel import dp_mesh, build_sharded_step
-    from paddle_tpu.parallel.sharded import shard_batch
 
     n_chips = jax.device_count()
+    device = jax.devices()[0]
+    device_kind = getattr(device, "device_kind", str(device))
     mesh = dp_mesh(n_chips)
 
-    cfg = dict(batch_size=BATCH * n_chips, seq_len=SEQ, vocab_size=30522,
-               hidden=768, num_layers=12, num_heads=12, intermediate=3072)
+    B = BATCH_PER_CHIP * n_chips
+    # BENCH_LAYERS/BENCH_HIDDEN: debug-scale smoke runs (CI on CPU)
+    hidden = int(os.environ.get("BENCH_HIDDEN", "768"))
+    cfg = dict(batch_size=B, seq_len=SEQ, vocab_size=30522,
+               hidden=hidden,
+               num_layers=int(os.environ.get("BENCH_LAYERS", "12")),
+               num_heads=max(1, hidden // 64), intermediate=3072,
+               max_predictions=MAX_PRED,
+               # XLA's fused attention beats the pallas kernel at every
+               # measured length on v5e (S=128: 772 vs 704; S=512: 155 vs
+               # 141; S=2048: 21.9 vs 6.4 samples/s/chip) — the pallas
+               # path remains for ring/sequence-parallel composition
+               use_flash=os.environ.get("BENCH_FLASH", "0") == "1",
+               dropout=float(os.environ.get("BENCH_DROPOUT", "0.1")))
+    cfg["intermediate"] = 4 * cfg["hidden"]
     main_p, startup = pt.Program(), pt.Program()
     startup._is_startup = True
     with pt.program_guard(main_p, startup):
@@ -82,63 +184,95 @@ def main():
                                         start_lr=0.0, end_lr=1e-4)
         opt = optimizer.AdamOptimizer(
             learning_rate=lr,
-            grad_clip=clip.GradientClipByGlobalNorm(1.0))
-        opt = mixed_precision.decorate(opt, dtype="bfloat16")
+            grad_clip=clip.GradientClipByGlobalNorm(1.0)
+            if os.environ.get("BENCH_CLIP", "1") == "1" else None)
+        # bf16 activation stream: embeddings/layernorm/residual adds join
+        # the white list (BENCH_BF16_STREAM=0 for the conservative
+        # matmul-only autocast).  Master weights stay f32 either way; the
+        # step is HBM-bound, so halving activation bytes is the lever.
+        extra_white = []
+        if os.environ.get("BENCH_BF16_STREAM", "1") == "1":
+            extra_white = ["lookup_table", "lookup_table_v2", "layer_norm",
+                           "elementwise_add", "elementwise_mul", "dropout",
+                           "gelu", "relu", "scale", "transpose2", "softmax",
+                           "reshape2", "gather_nd", "squeeze2", "unsqueeze2"]
+        opt = mixed_precision.decorate(
+            opt, dtype="bfloat16",
+            amp_lists=mixed_precision.AutoMixedPrecisionLists(
+                custom_white_list=extra_white) if extra_white else None)
         opt.minimize(outs["loss"])
 
     scope = pt.Scope()
     pt.Executor().run(startup, scope=scope)
 
-    fn, mut_in, const_in, extra_out = build_sharded_step(
+    fn, mut_in, const_in, _ = build_sharded_step(
         main_p, feed_names, [outs["loss"].name], mesh)
 
-    rng = np.random.RandomState(0)
-    B, S, V = cfg["batch_size"], SEQ, cfg["vocab_size"]
-    feed = {
-        "input_ids": rng.randint(0, V, (B, S)).astype("int64"),
-        "token_type_ids": np.zeros((B, S), "int64"),
-        "attn_mask": np.ones((B, S), "float32"),
-        "mlm_mask": (rng.rand(B, S) < 0.15).astype("float32"),
-        "mlm_labels": rng.randint(0, V, (B, S)).astype("int64"),
-    }
-    feed_vals = tuple(shard_batch(mesh, [feed[n] for n in feed_names]))
+    batches = _batch_stream(feed_names, B, SEQ, cfg["vocab_size"], mesh)
     mut_vals = tuple(scope.find_var(n) for n in mut_in)
     const_vals = tuple(scope.find_var(n) for n in const_in)
 
-    # NOTE: some transports (axon tunnel) return from block_until_ready
-    # before execution completes; a host readback of a value that depends on
-    # the whole step chain is the only reliable fence. Each step's mut state
-    # is donated into the next, so reading the final loss forces every step.
+    # warmup (compile + first dispatches), fenced
     step = 0
     for _ in range(WARMUP):
         step += 1
-        fetches, mut_vals, _ = fn(feed_vals, mut_vals, const_vals,
+        fetches, mut_vals, _ = fn(next(batches), mut_vals, const_vals,
                                   np.int32(step))
-    float(np.asarray(fetches[0]))
+    float(np.asarray(fetches[0]).reshape(-1)[0])
 
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        step += 1
-        fetches, mut_vals, _ = fn(feed_vals, mut_vals, const_vals,
-                                  np.int32(step))
-    final_loss = float(np.asarray(fetches[0]))
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
+    floor = FLOORS["tpu" if "tpu" in device.platform.lower() else "cpu"]
+    anomaly = None
+    for attempt in range(2):
+        rates, mut_vals, step, loss = _measure(
+            fn, batches, mut_vals, const_vals, step, B)
+        med = float(np.median(rates))
+        spread = max(rates) / max(min(rates), 1e-9)
+        per_chip = med / n_chips
+        anomaly = None
+        if spread > 2.0:
+            anomaly = (f"window spread {spread:.2f}x > 2x "
+                       f"(chip contention?): {sorted(rates)}")
+        elif per_chip < floor:
+            anomaly = (f"throughput {per_chip:.1f} below sanity floor "
+                       f"{floor} for {device_kind}")
+        if anomaly is None:
+            break  # clean measurement
+        # re-run once before publishing an anomalous number
 
-    samples_per_sec = B * ITERS / dt
-    per_chip = samples_per_sec / n_chips
     flops = bert_train_flops_per_sample(
         SEQ, cfg["vocab_size"], cfg["hidden"], cfg["num_layers"],
-        cfg["intermediate"])
-    peak = _peak_tflops(jax.devices()[0]) * 1e12
+        cfg["intermediate"], MAX_PRED)
+    peak = _peak_tflops(device) * 1e12
     mfu = per_chip * flops / peak
     print(json.dumps({
         "metric": "bert_base_mlm_train_samples_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "samples/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
+        "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC_PER_CHIP,
+                             3),
         "mfu": round(mfu, 4),
         "model_tflops_per_sample": round(flops / 1e12, 4),
+        "stats": {
+            "windows": WINDOWS, "steps_per_window": STEPS_PER_WINDOW,
+            "median": round(med / n_chips, 2),
+            "p10": round(float(np.percentile(rates, 10)) / n_chips, 2),
+            "p90": round(float(np.percentile(rates, 90)) / n_chips, 2),
+            "min": round(min(rates) / n_chips, 2),
+            "max": round(max(rates) / n_chips, 2),
+            "spread": round(spread, 3),
+        },
+        "config": {"seq": SEQ, "batch_per_chip": BATCH_PER_CHIP,
+                   "max_predictions": MAX_PRED, "n_chips": n_chips,
+                   "amp": "bfloat16",
+                   "bf16_stream": bool(extra_white),
+                   "attention": "flash" if cfg["use_flash"] else "xla",
+                   "head": "masked_gather"},
+        "device_kind": device_kind,
+        "final_loss": round(loss, 4),
+        "anomaly": anomaly,
+        "deviations": (["flash attention folds out attention-probability "
+                        "dropout (output dropout kept)"]
+                       if cfg["use_flash"] else []),
     }))
 
 

@@ -30,6 +30,8 @@ from . import distributed  # noqa
 from . import contrib  # noqa
 from . import io  # noqa
 from . import checkpoint  # noqa
+from . import reader  # noqa
+from .reader import DataLoader, DataFeeder, batch  # noqa
 
 __version__ = "0.1.0"
 

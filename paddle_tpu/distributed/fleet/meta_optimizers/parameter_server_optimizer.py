@@ -41,6 +41,14 @@ class ParameterServerOptimizer(MetaOptimizerBase):
         from ....distributed.ps.worker import (PSContext, _strip_startup_init,
                                                transpile_to_ps)
 
+        # PS replaces the whole update path; composing it with other meta
+        # optimizers (gradient merge, recompute, ...) would silently drop
+        # them — the reference treats PS as exclusive, so do we, loudly.
+        if self.inner_opt is not self.user_defined_optimizer:
+            raise ValueError(
+                "parameter-server mode cannot stack with other meta "
+                "optimizers; disable the extra strategy flags")
+
         program = loss.block.program
         sections = transpile_to_ps(program)
         lazy = [s.table_name for s in sections if s.lazy_init]
@@ -80,6 +88,11 @@ class ParameterServerOptimizer(MetaOptimizerBase):
             mode = "geo"
         else:
             mode = "async"
+        if mode == "geo" and opt_name != "sgd":
+            # geo-SGD is SGD by construction (local updates exchanged as
+            # parameter deltas); the reference geo transpiler is SGD-only
+            raise NotImplementedError(
+                f"geo mode supports SGD only, got {type(inner).__name__}")
 
         dense = [(p.name, grad_var_name(p.name), tuple(p.shape))
                  for p, _g in params_grads]

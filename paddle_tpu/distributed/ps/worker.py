@@ -87,6 +87,7 @@ def transpile_to_ps(program: Program) -> List[SparseSection]:
     """
     block = program.global_block()
     sections: List[SparseSection] = []
+    server_owned = set()
     for op in list(block.ops):
         if op.type not in ("lookup_table", "lookup_table_v2"):
             continue
@@ -101,7 +102,9 @@ def transpile_to_ps(program: Program) -> List[SparseSection]:
         lazy = bool(op.attrs.get("is_distributed")) or vocab >= LARGE_VOCAB
         padding_idx = int(op.attrs.get("padding_idx", -1))
         version = 1 if op.type == "lookup_table" else 2
-        pulled_name = w_name + "@PULLED"
+        # keyed by the *output* so a table shared by several lookups
+        # (tied embeddings) gets one pulled var per lookup site
+        pulled_name = out_name + "@PULLED"
         block.create_var(name=pulled_name, shape=out.shape, dtype=w.dtype,
                          is_data=True, stop_gradient=False, trainable=False)
         # rewrite in place (keeps op position and the Out consumers)
@@ -113,9 +116,9 @@ def transpile_to_ps(program: Program) -> List[SparseSection]:
             table_name=w_name, ids_name=ids_name, pulled_name=pulled_name,
             out_name=out_name, dim=dim, padding_idx=padding_idx,
             version=version, vocab=vocab, lazy_init=lazy))
-        # the W parameter is now server-owned
-        if w_name in block.vars:
-            del block.vars[w_name]
+        server_owned.add(w_name)
+    for w_name in server_owned:  # the W parameters are now server-owned
+        block.vars.pop(w_name, None)
     return sections
 
 

@@ -312,6 +312,52 @@ def test_ps_geo_mode_converges_and_syncs():
     np.testing.assert_allclose(server_rows, local_rows, atol=1e-6)
 
 
+def test_ps_shared_table_two_lookups():
+    """One table feeding two lookup sites (tied embeddings): each site
+    gets its own pulled var; both push into the same server table."""
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    reset_unique_name()
+    reset_op_seed()
+    with pt.program_guard(main, startup):
+        ids_a = layers.data("ids_a", [2], dtype="int64")
+        ids_b = layers.data("ids_b", [2], dtype="int64")
+        label = layers.data("label", [1])
+        ea = layers.embedding(ids_a, [VOCAB, DIM], is_sparse=True,
+                              param_attr="tied_w")
+        eb = layers.embedding(ids_b, [VOCAB, DIM], is_sparse=True,
+                              param_attr="tied_w")
+        x = layers.concat([layers.flatten(ea, axis=1),
+                           layers.flatten(eb, axis=1)], axis=1)
+        logit = layers.fc(x, 1, name="fc")
+        loss = layers.mean(
+            layers.sigmoid_cross_entropy_with_logits(logit, label))
+        fleet.init(UserDefinedRoleMaker(current_id=0, role=Role.WORKER,
+                                        worker_num=1),
+                   strategy=DistributedStrategy())
+        fleet.distributed_optimizer(
+            optimizer.SGDOptimizer(0.1)).minimize(loss, startup)
+    ctx = main._ps_ctx
+    assert len(ctx.sections) == 2
+    assert {s.table_name for s in ctx.sections} == {"tied_w"}
+    assert len({s.pulled_name for s in ctx.sections}) == 2
+    exe = pt.Executor()
+    exe.run(startup)
+    trainer = fleet.init_worker()
+    rng = np.random.RandomState(3)
+    losses = []
+    for _ in range(25):
+        f = {"ids_a": rng.randint(0, VOCAB, (8, 2)).astype("int64"),
+             "ids_b": rng.randint(0, VOCAB, (8, 2)).astype("int64")}
+        f["label"] = ((f["ids_a"].sum(1) + f["ids_b"].sum(1)) % 2
+                      ).astype("float32")[:, None]
+        losses.append(float(trainer.run(f, fetch_list=[loss])[0]))
+    fleet.stop_worker()
+    assert np.isfinite(losses).all()
+    # exactly one shared table exists server-side
+    assert list(fleet.fleet_instance()._ps_service.sparse) == ["tied_w"]
+
+
 def test_wide_deep_ps_trains():
     """The tracked Wide&Deep CTR config end-to-end through fleet PS mode,
     with a declared vocab no device could hold densely (lazy server
