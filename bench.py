@@ -167,7 +167,7 @@ def main():
     cfg = dict(batch_size=B, seq_len=SEQ, vocab_size=30522,
                hidden=hidden,
                num_layers=int(os.environ.get("BENCH_LAYERS", "12")),
-               num_heads=max(1, hidden // 64), intermediate=3072,
+               num_heads=max(1, hidden // 64),
                max_predictions=MAX_PRED,
                # XLA's fused attention beats the pallas kernel at every
                # measured length on v5e (S=128: 772 vs 704; S=512: 155 vs

@@ -203,7 +203,14 @@ def _mse(ctx, op):
     ctx.set_output(op, "Out", (x - y) ** 2)
 
 
-@register_op("kldiv_loss", infer=same_as_input())
+def _kldiv_infer(op, block):
+    x = in_var(op, block, "X")
+    red = op.attrs.get("reduction", "mean")
+    shape = x.shape if red == "none" else ()
+    set_out(op, block, "Loss", shape, x.dtype)
+
+
+@register_op("kldiv_loss", infer=_kldiv_infer)
 def _kldiv(ctx, op):
     jnp = _jnp()
     x = ctx.get_input(op, "X")

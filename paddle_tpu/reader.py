@@ -320,8 +320,14 @@ class DataLoader:
             src = (self._batches_process() if self.use_process
                    else self._batches_threaded())
         else:
+            if self.num_workers > 0:
+                import warnings
+                warnings.warn(
+                    "DataLoader: num_workers has no effect on an "
+                    "IterableDataset (a stream has no index space to "
+                    "partition); reading single-threaded")
             src = self._batches_sync()
-        if self.feed_names:
+        if self.feed_names and not self.return_list:
             src = (dict(zip(self.feed_names,
                             b if isinstance(b, (tuple, list)) else (b,)))
                    for b in src)
@@ -337,7 +343,9 @@ class DataLoader:
         """reference fluid/reader.py:418 — returns a loader whose
         ``set_batch_generator(fn)`` installs a python generator of
         ready-made batches."""
-        return _GeneratorLoader(feed_list, capacity, use_double_buffer)
+        return _GeneratorLoader(feed_list, capacity, use_double_buffer,
+                                return_list=return_list,
+                                drop_last=drop_last)
 
 
 class _CollateJob:
@@ -354,10 +362,13 @@ class _CollateJob:
 class _GeneratorLoader:
     """from_generator flavor: user supplies batch/sample generators."""
 
-    def __init__(self, feed_list, capacity, use_double_buffer):
+    def __init__(self, feed_list, capacity, use_double_buffer,
+                 return_list=False, drop_last=True):
         self.feed_names = [getattr(v, "name", v) for v in feed_list or []]
         self.capacity = capacity
         self.use_double_buffer = use_double_buffer
+        self.return_list = return_list
+        self.drop_last = drop_last
         self._gen = None
         self._mode = "batch"
 
@@ -371,12 +382,13 @@ class _GeneratorLoader:
         self._mode = "sample_list"
         return self
 
-    def set_sample_generator(self, fn, batch_size, drop_last=True,
+    def set_sample_generator(self, fn, batch_size, drop_last=None,
                              places=None):
         self._gen = fn
         self._mode = "sample"
         self._batch_size = batch_size
-        self._drop_last = drop_last
+        if drop_last is not None:  # explicit arg wins over constructor
+            self.drop_last = drop_last
         return self
 
     def __iter__(self):
@@ -388,8 +400,8 @@ class _GeneratorLoader:
             src = (default_collate(s) for s in self._gen())
         else:
             src = (default_collate(s) for s in
-                   batch(self._gen, self._batch_size, self._drop_last)())
-        if self.feed_names:
+                   batch(self._gen, self._batch_size, self.drop_last)())
+        if self.feed_names and not self.return_list:
             src = (dict(zip(self.feed_names,
                             b if isinstance(b, (tuple, list)) else (b,)))
                    for b in src)
