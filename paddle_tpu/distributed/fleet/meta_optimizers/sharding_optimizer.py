@@ -7,14 +7,18 @@ into broadcast/allreduce segments with pruned non-owned vars
 _prune_main_program).
 
 TPU-native: ZeRO is a *placement decision*, not a program rewrite. The
-program is untouched; the CompiledProgram GSPMD path shards every
-parameter and optimizer-state array over the dp axis (dim-0, when
-divisible) and XLA inserts exactly the ZeRO collectives: all-gather of
-params before use, reduce-scatter of grads, sharded optimizer update.
+program is untouched; the CompiledProgram GSPMD path splits the device
+axis into ("dp", "zero") with |zero| = sharding_degree, shards the batch
+over both, shards every parameter and optimizer-state array over "zero"
+(dim-0, when divisible), and XLA inserts exactly the ZeRO collectives:
+all-gather of params before use, reduce-scatter of grads, sharded
+optimizer update replicated across the dp groups.
 """
 from __future__ import annotations
 
 from .meta_optimizer_base import MetaOptimizerBase
+
+ZERO_AXIS = "zero"
 
 
 class ShardingOptimizer(MetaOptimizerBase):
@@ -33,8 +37,28 @@ class ShardingOptimizer(MetaOptimizerBase):
         return res
 
 
-def zero_sharding_rules(mesh, axis: str = "dp"):
-    """Shard dim 0 of every sharding-eligible state array over `axis`."""
+def zero_mesh(n_devices: int, degree: int):
+    """(mesh, batch_axes) for ZeRO at `degree` over `n_devices`.
+
+    Mirrors the reference's world-size check (sharding_optimizer.py
+    degree asserts): a degree that doesn't divide the device count is an
+    error, not a silent clamp."""
+    from ....parallel.mesh import DP_AXIS, make_mesh
+
+    degree = int(degree)
+    if degree < 1 or degree > n_devices or n_devices % degree:
+        raise ValueError(
+            f"sharding_degree={degree} must divide the device count "
+            f"{n_devices}")
+    mesh = make_mesh({DP_AXIS: n_devices // degree, ZERO_AXIS: degree})
+    return mesh, (DP_AXIS, ZERO_AXIS)
+
+
+def zero_sharding_rules(mesh, axis: str = ZERO_AXIS):
+    """Shard dim 0 of every sharding-eligible state array over `axis`.
+
+    Covers parameters AND their optimizer moments (same shapes); scalars
+    (lr, beta pows, loss-scale) and indivisible dims stay replicated."""
     from jax.sharding import PartitionSpec as P
     from ....parallel.sharded import ShardingRules
 

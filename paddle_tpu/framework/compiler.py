@@ -68,7 +68,8 @@ class CompiledProgram:
         self._loss_name = None
         self._exec_strategy = None
         self._places = None
-        self._compiled = None  # (sig, fn, mut_in, const_in, mesh, mode)
+        # (sig, fn, mut_in, const_in, mesh, mode, batch_axes)
+        self._compiled = None
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
@@ -100,7 +101,7 @@ class CompiledProgram:
         if self._compiled is None or self._compiled[0] != key:
             self._compiled = (key,) + self._build(list(feed_arrays),
                                                   fetch_names)
-        _, fn, mut_in, const_in, mesh, mode = self._compiled
+        _, fn, mut_in, const_in, mesh, mode, batch_axes = self._compiled
 
         def _val(n):
             v = scope.find_var(n)
@@ -114,8 +115,8 @@ class CompiledProgram:
         exe._step += 1
         if mode == "gspmd":
             from ..parallel.sharded import shard_batch
-            feed_vals = tuple(shard_batch(mesh,
-                                          list(feed_arrays.values())))
+            feed_vals = tuple(shard_batch(mesh, list(feed_arrays.values()),
+                                          batch_axes=batch_axes))
         else:
             feed_vals = tuple(feed_arrays.values())
         fetches, new_mut, _extra = fn(feed_vals, mut_vals, const_vals,
@@ -134,6 +135,7 @@ class CompiledProgram:
 
         n = len(self._places) if self._places else len(jax.devices())
         mesh = dp_mesh(n)
+        batch_axes = ("dp",)
 
         def _has_collective(blk):
             return any(
@@ -146,15 +148,18 @@ class CompiledProgram:
         if _has_collective(self._program.global_block()):
             fn, mut_in, const_in, extra = build_spmd_step(
                 self._program, feed_names, fetch_names, mesh)
-            return fn, mut_in, const_in, mesh, "spmd"
+            return fn, mut_in, const_in, mesh, "spmd", batch_axes
         rules = None
-        if getattr(self._program, "_zero_sharding", None):
+        zs = getattr(self._program, "_zero_sharding", None)
+        if zs:
             from ..distributed.fleet.meta_optimizers.sharding_optimizer \
-                import zero_sharding_rules
+                import zero_mesh, zero_sharding_rules
+            mesh, batch_axes = zero_mesh(n, zs.get("degree", n))
             rules = zero_sharding_rules(mesh)
         fn, mut_in, const_in, extra = build_sharded_step(
-            self._program, feed_names, fetch_names, mesh, rules=rules)
-        return fn, mut_in, const_in, mesh, "gspmd"
+            self._program, feed_names, fetch_names, mesh, rules=rules,
+            batch_axes=batch_axes)
+        return fn, mut_in, const_in, mesh, "gspmd", batch_axes
 
 
 class ParallelExecutor:

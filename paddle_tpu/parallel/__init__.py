@@ -13,3 +13,4 @@ from .mesh import make_mesh, dp_mesh, MeshConfig  # noqa
 from .sharded import (ShardingRules, data_parallel_rules,  # noqa
                       megatron_rules, build_sharded_step)
 from .pipeline_pp import build_pp_pipeline_step  # noqa
+from .pipeline_hetero import build_hetero_pp_step  # noqa

@@ -1,0 +1,633 @@
+"""Heterogeneous-stage pipeline parallelism (pp mesh axis).
+
+Lifts the stacked-stage fast path's restrictions (pipeline_pp.py:24-35):
+stages may differ structurally (an embedding front stage + transformer
+stages + a head), splits may be uneven, any number of activation vars may
+cross a boundary (incl. skip connections across non-adjacent stages), and
+any stage may consume feeds (labels at the tail, ids at the front).
+
+Reference semantics: PipelineTrainer/SectionWorker
+(framework/section_worker.cc:44-119) runs arbitrary per-device program
+sections — this module is the TPU-native equivalent.
+
+SPMD formulation: XLA compiles ONE program for all devices, so per-stage
+heterogeneity is expressed as data, not code placement:
+
+  * per-stage state (params + optimizer slots) is FLATTENED into one f32
+    vector per stage, zero-padded to the max stage length, and stacked
+    [P, maxlen] sharded over `pp` — each device physically holds only its
+    stage's weights;
+  * each tick, `lax.switch(axis_index(pp), branches)` runs exactly the
+    local stage's lowered ops; every branch unpacks its own segment spec
+    (static metadata), so the switch is the only "MPMD" surface XLA sees;
+  * inter-stage activations travel as one zero-padded f32 transport
+    buffer (all boundary vars flattened + concatenated), rotated with
+    `lax.ppermute` — multi-var boundaries and skip connections ride the
+    same buffer;
+  * feeds never transport: they are dp-sharded/pp-replicated, and each
+    stage dynamic-indexes the microbatch it is currently processing.
+
+Two schedules:
+  * "gpipe": forward tick-scan; `jax.grad` transposes the ppermute chain
+    into the flush backward (activation stash grows with M);
+  * "1f1b": hand-scheduled one-forward-one-backward with recompute — the
+    stash holds only boundary INPUTS for at most 2P-1 in-flight
+    microbatches (O(P), independent of M); each backward slot recomputes
+    its stage forward under `jax.vjp` with the same per-microbatch PRNG
+    key, so stochastic ops (dropout) replay exactly.  Gradients are
+    mathematically identical to gpipe — only the schedule and memory
+    differ.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.core import Program, grad_var_name
+from ..ops.registry import LowerContext, lower_op
+from .mesh import DP_AXIS, PP_AXIS
+from .pipeline_pp import (STACK_PREFIX, _is_forward, _is_optimize, _reads,
+                          _writes)
+
+FLAT_NAME = STACK_PREFIX + "flat_state"
+
+
+# ---------------------------------------------------------------------------
+# plan
+# ---------------------------------------------------------------------------
+class _Seg:
+    __slots__ = ("name", "shape", "dtype", "offset", "size")
+
+    def __init__(self, name, shape, dtype, offset):
+        self.name = name
+        self.shape = tuple(int(d) for d in (shape or ()))
+        self.dtype = dtype
+        self.offset = int(offset)
+        self.size = int(np.prod(self.shape)) if self.shape else 1
+
+
+def _make_specs(names, block, offset0=0):
+    segs, off = [], offset0
+    for n in names:
+        v = block._find_var_recursive(n)
+        segs.append(_Seg(n, v.shape, v.dtype, off))
+        off += segs[-1].size
+    return segs, off
+
+
+class _HeteroPlan:
+    def __init__(self, program: Program, feed_names: Sequence[str],
+                 loss_name: str):
+        block = program.global_block()
+        self.block = block
+        self.loss_name = loss_name
+
+        fwd = [op for op in block.ops
+               if op.type not in ("feed", "fetch") and _is_forward(op)]
+        staged = [op for op in fwd if op.attr("__stage__") is not None]
+        if not staged:
+            raise ValueError("hetero pp: no ops tagged with a stage "
+                             "(use device_guard while building)")
+        stage_ids = sorted({op.attr("__stage__") for op in staged})
+        if stage_ids != list(range(len(stage_ids))):
+            raise ValueError(f"hetero pp: stage tags must be 0..P-1, got "
+                             f"{stage_ids}")
+        P = len(stage_ids)
+        self.num_stages = P
+        self.stage_ops: List[list] = [
+            [op for op in staged if op.attr("__stage__") == s]
+            for s in stage_ids]
+        # trailing untagged forward ops (the loss epilogue) run on the
+        # last stage
+        last_idx = max(op.idx for op in staged)
+        for op in fwd:
+            if op.attr("__stage__") is None:
+                if op.idx < last_idx:
+                    raise ValueError(
+                        f"hetero pp: untagged forward op {op.type!r} "
+                        "appears between staged ops")
+                self.stage_ops[-1].append(op)
+
+        feed_set = set(feed_names)
+        reads = [list(dict.fromkeys(_reads(ops))) for ops in self.stage_ops]
+        writes = [_writes(ops) for ops in self.stage_ops]
+
+        # forward ops must not write persistable state: the flat buffer
+        # only writes back through the optimizer path, so running
+        # statistics (batch_norm mean/variance) would silently freeze
+        for s, w in enumerate(writes):
+            for n in w:
+                v = block._find_var_recursive(n)
+                if v is not None and v.persistable:
+                    raise ValueError(
+                        f"hetero pp: stage {s} forward writes persistable "
+                        f"var {n!r} (running statistics?); "
+                        "forward-mutated state is not supported on the "
+                        "pipeline path — use layer_norm/group_norm "
+                        "instead of batch_norm")
+
+        # feeds each stage consumes directly
+        self.stage_feeds = [[n for n in reads[s] if n in feed_set]
+                            for s in range(P)]
+        used = {n for fs in self.stage_feeds for n in fs}
+        unused = [n for n in feed_names if n not in used]
+        if unused:
+            raise ValueError(f"hetero pp: feeds {unused} consumed by no "
+                             "stage")
+
+        # per-stage trainable params
+        self.stage_params: List[List[str]] = []
+        for s in range(P):
+            ps = []
+            for n in reads[s]:
+                v = block._find_var_recursive(n)
+                if v is not None and v.persistable and \
+                        getattr(v, "trainable", False):
+                    ps.append(n)
+            self.stage_params.append(ps)
+        owner = {}
+        for s, ps in enumerate(self.stage_params):
+            for n in ps:
+                if n in owner:
+                    raise ValueError(
+                        f"hetero pp: parameter {n!r} is read by stages "
+                        f"{owner[n]} and {s}; shared parameters cannot be "
+                        "placed on one device")
+                owner[n] = s
+
+        # boundary transport: var written by stage < s, read by stage >= s
+        self.boundary: List[List[str]] = [[] for _ in range(P)]
+        for s in range(1, P):
+            before = set()
+            for w in writes[:s]:
+                before |= w
+            needed = []
+            for t in range(s, P):
+                for n in reads[t]:
+                    if n in before and n not in needed:
+                        v = block._find_var_recursive(n)
+                        if v is not None and v.persistable:
+                            continue  # params/state don't transport
+                        needed.append(n)
+            self.boundary[s] = needed
+        for s in range(1, P):
+            for n in self.boundary[s]:
+                v = block._find_var_recursive(n)
+                if v.dtype not in ("float32", "bfloat16", "float16"):
+                    # f64 excluded too: the f32 transport buffer would
+                    # silently truncate it every hop
+                    raise ValueError(
+                        f"hetero pp: boundary var {n!r} has dtype "
+                        f"{v.dtype}; the f32 transport carries "
+                        "f32/bf16/f16 activations only")
+
+        self._plan_optimizer(block)
+
+        # flat segment specs per stage: params then optimizer state
+        self.state_segs: List[List[_Seg]] = []
+        self.param_segs: List[List[_Seg]] = []
+        maxlen = 0
+        for s in range(P):
+            psegs, off = _make_specs(self.stage_params[s], block)
+            ssegs, off = _make_specs(self.stage_opt_state[s], block, off)
+            self.param_segs.append(psegs)
+            self.state_segs.append(psegs + ssegs)
+            maxlen = max(maxlen, off)
+        self.flat_len = max(maxlen, 1)
+
+        # boundary packing specs (runtime shapes may carry a microbatch
+        # dim unknown at plan time -> sizes resolved from block shapes
+        # with -1 replaced by the microbatch rows; see _act_spec below)
+        self.act_vars = self.boundary
+
+    def _plan_optimizer(self, block):
+        opt_ops = [op for op in block.ops
+                   if op.type not in ("feed", "fetch") and _is_optimize(op)]
+        owner = {}
+        for s, ps in enumerate(self.stage_params):
+            for n in ps:
+                owner[n] = s
+        self.stage_opt_ops: List[list] = [[] for _ in
+                                          range(self.num_stages)]
+        self.shared_opt_ops: List = []
+        for op in opt_ops:
+            touched = sorted({owner[n] for n in
+                              list(op.input_arg_names()) +
+                              list(op.output_arg_names()) if n in owner})
+            if not touched:
+                if any(n.endswith("@GRAD") for n in op.input_arg_names()):
+                    raise ValueError(
+                        f"hetero pp: optimize-role op {op.type!r} reads "
+                        "gradients across parameters (global grad clip); "
+                        "not supported on the pp path — clip per-param or "
+                        "drop grad_clip")
+                self.shared_opt_ops.append(op)
+            elif len(touched) > 1:
+                raise ValueError(
+                    f"hetero pp: optimize op {op.type!r} touches params of "
+                    f"stages {touched}; cross-stage optimizer transforms "
+                    "are not supported")
+            else:
+                self.stage_opt_ops[touched[0]].append(op)
+
+        # per-stage persistable optimizer state (accumulators, beta pows)
+        shared_rw = set()
+        for op in self.shared_opt_ops:
+            shared_rw.update(op.input_arg_names())
+            shared_rw.update(op.output_arg_names())
+        self.stage_opt_state: List[List[str]] = []
+        for s in range(self.num_stages):
+            st, seen = [], set(self.stage_params[s])
+            for op in self.stage_opt_ops[s]:
+                for n in list(op.input_arg_names()) + \
+                        list(op.output_arg_names()):
+                    if n in seen or n in shared_rw or not n:
+                        continue
+                    seen.add(n)
+                    v = block._find_var_recursive(n)
+                    if v is not None and v.persistable:
+                        st.append(n)
+            self.stage_opt_state.append(st)
+
+        # shared persistable state (lr vars, counters)
+        self.shared_state, seen = [], set()
+        for op in self.shared_opt_ops:
+            for n in list(op.input_arg_names()) + \
+                    list(op.output_arg_names()):
+                if n in seen or not n:
+                    continue
+                seen.add(n)
+                v = block._find_var_recursive(n)
+                if v is not None and v.persistable:
+                    self.shared_state.append(n)
+        shared_written = _writes(self.shared_opt_ops)
+        self.shared_mut = [n for n in self.shared_state
+                           if n in shared_written]
+        self.shared_const = [n for n in self.shared_state
+                             if n not in shared_written]
+
+
+# ---------------------------------------------------------------------------
+# pack / unpack
+# ---------------------------------------------------------------------------
+def _pack(jnp, segs, env, total):
+    import jax
+    buf = jnp.zeros((total,), "float32")
+    for g in segs:
+        val = jnp.asarray(env[g.name], "float32").reshape((g.size,))
+        buf = jax.lax.dynamic_update_slice(buf, val, (g.offset,))
+    return buf
+
+
+def _unpack(jnp, segs, buf, env, cast=True):
+    import jax
+    for g in segs:
+        val = jax.lax.dynamic_slice(buf, (g.offset,), (g.size,))
+        val = val.reshape(g.shape)
+        if cast:
+            val = val.astype(g.dtype)
+        env[g.name] = val
+
+
+# ---------------------------------------------------------------------------
+# build
+# ---------------------------------------------------------------------------
+def build_hetero_pp_step(program: Program, feed_names: Sequence[str],
+                         fetch_names: Sequence[str],
+                         num_microbatches: int, mesh,
+                         loss_name: Optional[str] = None,
+                         schedule: str = "gpipe"):
+    """Heterogeneous-stage pipeline step (GPipe or 1F1B schedule).
+
+    Contract mirrors build_pp_pipeline_step: returns
+    (fn, mut_in, const_in, extra_out); staged state lives in ONE flat
+    stacked buffer under ``__ppstack__/flat_state`` — call
+    ``fn.prepare_scope(scope)`` once after startup and
+    ``fn.sync_scope(scope)`` to write per-var values back.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P_
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pp schedule {schedule!r}")
+    loss_name = loss_name or (fetch_names[0] if fetch_names else None)
+    if not loss_name:
+        raise ValueError("hetero pp: need a loss to fetch")
+    for n in fetch_names:
+        if n != loss_name:
+            raise ValueError("hetero pp: only the loss is fetchable")
+
+    plan = _HeteroPlan(program, feed_names, loss_name)
+    Pn = plan.num_stages
+    M = int(num_microbatches)
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if axis_sizes.get(PP_AXIS, 1) != Pn:
+        raise ValueError(f"hetero pp: program has {Pn} stages but mesh "
+                         f"{PP_AXIS}={axis_sizes.get(PP_AXIS, 1)}")
+    ndp = axis_sizes.get(DP_AXIS, 1)
+    block = plan.block
+    seed = program.random_seed or 0
+
+    mut_in = [FLAT_NAME] + plan.shared_mut
+    const_in = list(plan.shared_const)
+
+    def _mb_rows(batch_rows):
+        if batch_rows % (M * ndp):
+            raise ValueError(
+                f"hetero pp: batch {batch_rows} not divisible by "
+                f"microbatches*dp = {M}*{ndp}")
+        return batch_rows // (M * ndp)
+
+    def _act_specs(mb_rows):
+        """Boundary segment specs at runtime microbatch size."""
+        specs, total = [], 0
+        for s in range(Pn):
+            segs, off = [], 0
+            for n in plan.boundary[s]:
+                v = block._find_var_recursive(n)
+                shape = tuple(mb_rows if d == -1 else int(d)
+                              for d in (v.shape or ()))
+                g = _Seg(n, shape, v.dtype, off)
+                segs.append(g)
+                off += g.size
+            specs.append(segs)
+            total = max(total, off)
+        return specs, max(total, 1)
+
+    def build(feed_shapes):
+        """Close over runtime feed shapes (mb rows)."""
+        mb_rows = _mb_rows(feed_shapes[feed_names.index(
+            plan.stage_feeds[0][0])][0]) if plan.stage_feeds[0] else \
+            _mb_rows(feed_shapes[0][0])
+        act_specs, act_len = _act_specs(mb_rows)
+        amp = getattr(program, "_amp_lowering", None)
+
+        def stage_branch(s):
+            """(flat_local, x_flat, feeds_mb, key) -> (y_flat, loss)."""
+            def f(flat_local, x_flat, feeds_mb, key):
+                env: Dict[str, object] = {}
+                _unpack(jnp, plan.param_segs[s], flat_local, env)
+                env.update(feeds_mb)
+                if s > 0:
+                    _unpack(jnp, act_specs[s], x_flat, env)
+                ctx = LowerContext(block, env, base_key=key, amp=amp)
+                for op in plan.stage_ops[s]:
+                    lower_op(ctx, op)
+                if s + 1 < Pn:
+                    y = _pack(jnp, act_specs[s + 1], env, act_len)
+                    loss = jnp.float32(0.0)
+                else:
+                    y = jnp.zeros((act_len,), "float32")
+                    loss = jnp.reshape(env[loss_name], ()).astype(
+                        "float32")
+                return y, loss
+            return f
+
+        branches = [stage_branch(s) for s in range(Pn)]
+
+        def shard_body(feed_vals, mut_vals, const_vals, step):
+            base_key = jax.random.fold_in(
+                jax.random.key(np.uint32(seed)), step)
+            s_idx = jax.lax.axis_index(PP_AXIS)
+            if DP_AXIS in mesh.axis_names:
+                base_key = jax.random.fold_in(
+                    base_key, jax.lax.axis_index(DP_AXIS))
+            base_key = jax.random.fold_in(base_key, s_idx)
+
+            flat_stack = mut_vals[0]          # [1(local), flat_len]
+            flat_local = flat_stack[0]
+            shared_vals = dict(zip(plan.shared_mut, mut_vals[1:]))
+            shared_vals.update(zip(plan.shared_const, const_vals))
+            feeds = dict(zip(feed_names, feed_vals))
+
+            def chunk(a):
+                return a.reshape((M, a.shape[0] // M) + a.shape[1:])
+
+            feeds_mb_all = {n: chunk(v) for n, v in feeds.items()}
+
+            def feeds_at(mb):
+                return {n: jax.lax.dynamic_index_in_dim(
+                    v, mb, 0, keepdims=False)
+                    for n, v in feeds_mb_all.items()}
+
+            def run_branch(flat, x_in, mb, key):
+                fmb = feeds_at(mb)
+                # constants the stage lowering may read (shared lr etc.)
+                def wrap(i):
+                    def g(args):
+                        flat, x_in, fmb, key = args
+                        env_extra = dict(shared_vals)
+                        # branch closures read shared_vals via env seed
+                        out = branches[i](flat, x_in,
+                                          {**env_extra, **fmb}, key)
+                        return out
+                    return g
+                return jax.lax.switch(
+                    s_idx, [wrap(i) for i in range(Pn)],
+                    (flat, x_in, fmb, key))
+
+            if schedule == "gpipe":
+                T = M + Pn - 1
+
+                def loss_of(flat_local):
+                    def tick(carry, t):
+                        x_buf, loss_sum = carry
+                        mb = jnp.clip(t - s_idx, 0, M - 1)
+                        key_t = jax.random.fold_in(base_key, mb)
+                        y, loss_t = run_branch(flat_local, x_buf, mb,
+                                               key_t)
+                        valid = jnp.logical_and(t - s_idx >= 0,
+                                                t - s_idx <= M - 1)
+                        lvalid = jnp.logical_and(valid,
+                                                 s_idx == Pn - 1)
+                        loss_sum = loss_sum + jnp.where(lvalid, loss_t,
+                                                        0.0)
+                        x_next = jax.lax.ppermute(
+                            y, PP_AXIS,
+                            [(i, (i + 1) % Pn) for i in range(Pn)])
+                        return (x_next, loss_sum), None
+
+                    x0 = jnp.zeros((act_len,), "float32")
+                    (_, loss_sum), _ = jax.lax.scan(
+                        tick, (x0, jnp.float32(0.0)), jnp.arange(T))
+                    return loss_sum / M
+
+                local_loss, gflat = jax.value_and_grad(loss_of)(
+                    flat_local)
+            else:  # 1f1b
+                local_loss, gflat = _one_f_one_b(
+                    jax, jnp, run_branch, flat_local, base_key, s_idx,
+                    M, Pn, act_len)
+
+            if DP_AXIS in mesh.axis_names:
+                gflat = jax.lax.psum(gflat, DP_AXIS) / ndp
+            loss = jax.lax.psum(local_loss, PP_AXIS)
+            if DP_AXIS in mesh.axis_names:
+                loss = jax.lax.psum(loss, DP_AXIS) / ndp
+
+            # shared optimizer ops once (replicated)
+            env = dict(shared_vals)
+            ctx = LowerContext(block, env, base_key=base_key)
+            for op in plan.shared_opt_ops:
+                lower_op(ctx, op)
+
+            # per-stage optimizer via switch on the flat state
+            def opt_branch(s):
+                def g(args):
+                    flat, gf = args
+                    benv = dict(env)
+                    _unpack(jnp, plan.state_segs[s], flat, benv)
+                    for seg in plan.param_segs[s]:
+                        gseg = jax.lax.dynamic_slice(
+                            gf, (seg.offset,), (seg.size,))
+                        benv[grad_var_name(seg.name)] = \
+                            gseg.reshape(seg.shape).astype("float32")
+                    bctx = LowerContext(block, benv, base_key=base_key)
+                    for op in plan.stage_opt_ops[s]:
+                        lower_op(bctx, op)
+                    return _pack(jnp, plan.state_segs[s], benv,
+                                 plan.flat_len)
+                return g
+
+            new_flat = jax.lax.switch(
+                s_idx, [opt_branch(s) for s in range(Pn)],
+                (flat_local, gflat))
+            new_shared = tuple(env.get(n, shared_vals[n])
+                               for n in plan.shared_mut)
+            return ((jnp.reshape(loss, (1,)),),
+                    (new_flat[None],) + new_shared)
+
+        feed_spec = tuple(
+            P_(DP_AXIS) if DP_AXIS in mesh.axis_names else P_()
+            for _ in feed_names)
+        mut_spec = tuple([P_(PP_AXIS)] +
+                         [P_() for _ in plan.shared_mut])
+        const_spec = tuple(P_() for _ in const_in)
+        return shard_map(shard_body, mesh=mesh,
+                         in_specs=(feed_spec, mut_spec, const_spec, P_()),
+                         out_specs=((P_(),), mut_spec),
+                         check_vma=False)
+
+    _cache: Dict[tuple, object] = {}
+
+    def fn(feed_vals, mut_vals, const_vals, step):
+        shapes = tuple(tuple(np.shape(v)) for v in feed_vals)
+        if shapes not in _cache:
+            mapped = build(shapes)
+            _cache[shapes] = jax.jit(mapped, donate_argnums=(1,))
+        fetches, new_mut = _cache[shapes](feed_vals, mut_vals,
+                                          const_vals, step)
+        fn._last_mut = new_mut
+        return fetches, new_mut, ()
+
+    fn._last_mut = None
+
+    def prepare_scope(scope):
+        if scope.find_var(FLAT_NAME) is not None:
+            return
+        rows = []
+        for s in range(Pn):
+            buf = np.zeros((plan.flat_len,), "float32")
+            for g in plan.state_segs[s]:
+                v = np.asarray(scope.find_var(g.name), "float32")
+                buf[g.offset:g.offset + g.size] = v.reshape(-1)
+            rows.append(buf)
+        stacked = np.stack(rows)
+        sh = NamedSharding(mesh, P_(PP_AXIS))
+        scope.set_var(FLAT_NAME, jax.device_put(stacked, sh))
+
+    def sync_scope(scope, mut_vals=None):
+        vals = mut_vals if mut_vals is not None else fn._last_mut
+        arr = None
+        if vals is not None:
+            arr = dict(zip(mut_in, vals)).get(FLAT_NAME)
+        if arr is None:
+            arr = scope.find_var(FLAT_NAME)
+        if arr is None:
+            return
+        scope.set_var(FLAT_NAME, arr)
+        host = np.asarray(arr)
+        for s in range(Pn):
+            for g in plan.state_segs[s]:
+                scope.set_var(g.name,
+                              host[s, g.offset:g.offset + g.size]
+                              .reshape(g.shape).astype(g.dtype))
+
+    fn.prepare_scope = prepare_scope
+    fn.sync_scope = sync_scope
+    fn.plan = plan
+    return fn, mut_in, const_in, []
+
+
+def _one_f_one_b(jax, jnp, run_branch, flat_local, base_key, s_idx,
+                 M, Pn, act_len):
+    """1F1B with recompute: per round, one forward slot + one backward
+    slot.  Device s forwards microbatch (r - s) and backwards microbatch
+    (r - 2(P-1) + s); the stash holds boundary INPUTS only, ring-buffered
+    over K = 2P-1 slots (max in-flight per device).  Backward recomputes
+    the stage forward under jax.vjp with the forward's own PRNG key.
+    """
+    K = max(2 * Pn - 1, 1)
+    R = M + 2 * (Pn - 1)
+
+    fwd_perm = [(i, (i + 1) % Pn) for i in range(Pn)]
+    bwd_perm = [(i, (i - 1) % Pn) for i in range(Pn)]
+
+    def round_fn(carry, r):
+        x_buf, ct_buf, rbuf, gacc, loss_sum = carry
+
+        # ---- forward slot ----
+        f = r - s_idx
+        valid_f = jnp.logical_and(f >= 0, f <= M - 1)
+        mbf = jnp.clip(f, 0, M - 1)
+        key_f = jax.random.fold_in(base_key, mbf)
+        y, loss_t = run_branch(flat_local, x_buf, mbf, key_f)
+        lvalid = jnp.logical_and(valid_f, s_idx == Pn - 1)
+        loss_sum = loss_sum + jnp.where(lvalid, loss_t, 0.0)
+        # stash this microbatch's boundary input for its backward slot
+        slot = jnp.mod(mbf, K)
+        rbuf = jnp.where(
+            valid_f,
+            jax.lax.dynamic_update_index_in_dim(rbuf, x_buf, slot, 0),
+            rbuf)
+        x_next = jax.lax.ppermute(y, PP_AXIS, fwd_perm)
+
+        # ---- backward slot ----
+        b = r - 2 * (Pn - 1) + s_idx
+        valid_b = jnp.logical_and(b >= 0, b <= M - 1)
+        mbb = jnp.clip(b, 0, M - 1)
+        key_b = jax.random.fold_in(base_key, mbb)
+        x_res = jax.lax.dynamic_index_in_dim(
+            rbuf, jnp.mod(mbb, K), 0, keepdims=False)
+
+        def g(flat, x_in):
+            return run_branch(flat, x_in, mbb, key_b)
+
+        _outs, vjp = jax.vjp(g, flat_local, x_res)
+        # cotangents: last stage seeds d(loss)/dloss = 1/M; others feed
+        # the incoming activation cotangent
+        is_last = (s_idx == Pn - 1).astype("float32")
+        ct_y = ct_buf * (1.0 - is_last)
+        ct_loss = is_last / M
+        dflat, dx = vjp((ct_y, jnp.asarray(ct_loss, "float32")))
+        gacc = gacc + jnp.where(valid_b, dflat, 0.0)
+        ct_next = jax.lax.ppermute(
+            jnp.where(valid_b, dx, jnp.zeros_like(dx)), PP_AXIS,
+            bwd_perm)
+        return (x_next, ct_next, rbuf, gacc, loss_sum), None
+
+    x0 = jnp.zeros((act_len,), "float32")
+    ct0 = jnp.zeros((act_len,), "float32")
+    rbuf0 = jnp.zeros((K, act_len), "float32")
+    gacc0 = jnp.zeros_like(flat_local)
+    (x_f, ct_f, rb_f, gacc, loss_sum), _ = jax.lax.scan(
+        round_fn, (x0, ct0, rbuf0, gacc0, jnp.float32(0.0)),
+        jnp.arange(R))
+    return loss_sum / M, gacc

@@ -183,5 +183,5 @@ def test_compiled_program_gspmd_path():
     xv = np.random.rand(16, 8).astype("float32")
     l0 = exe.run(compiled, feed={"x": xv}, fetch_list=[loss])[0]
     l1 = exe.run(compiled, feed={"x": xv}, fetch_list=[loss])[0]
-    assert compiled._compiled[-1] == "gspmd"
+    assert "gspmd" in compiled._compiled
     assert float(np.mean(l1)) < float(np.mean(l0))

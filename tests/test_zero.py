@@ -1,0 +1,135 @@
+"""Real-ZeRO tests: optimizer state is physically sharded, degree is
+respected, and training trajectories match the unsharded baseline.
+
+Reference: distributed/fleet/meta_optimizers/sharding_optimizer.py:67
+(program-surgery ZeRO); here placement-based GSPMD ZeRO over a
+(dp, zero) mesh split — see sharding_optimizer.py in this repo.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.framework.core import reset_unique_name
+from paddle_tpu.ops.registry import reset_op_seed
+
+HID = 32  # dim0 of fc1 weight transposed? fc w shape [in, out]
+
+
+def _net():
+    x = layers.data("x", [8, 16], append_batch_size=False)
+    y = layers.data("y", [8, 1], dtype="int64", append_batch_size=False)
+    h = layers.fc(x, size=HID, act="relu", name="fc1")
+    logits = layers.fc(h, size=4, name="fc2")
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+    return loss
+
+
+def _feed():
+    rng = np.random.RandomState(0)
+    return {"x": rng.rand(8, 16).astype("float32"),
+            "y": rng.randint(0, 4, (8, 1)).astype("int64")}
+
+
+def _run_zero(degree, steps=4):
+    reset_unique_name()
+    reset_op_seed()
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        loss = _net()
+        fleet.init(is_collective=True)
+        s = fleet.DistributedStrategy()
+        s.sharding = True
+        s.sharding_configs["sharding_degree"] = degree
+        fleet.distributed_optimizer(
+            optimizer.AdamOptimizer(1e-2), s).minimize(loss)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    compiled = pt.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    feed = _feed()
+    losses = [float(np.mean(exe.run(compiled, feed=feed,
+                                    fetch_list=[loss], scope=scope)[0]))
+              for _ in range(steps)]
+    return losses, scope, compiled
+
+
+def _baseline(steps=4):
+    reset_unique_name()
+    reset_op_seed()
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        loss = _net()
+        optimizer.AdamOptimizer(1e-2).minimize(loss)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    feed = _feed()
+    return [float(exe.run(main, feed=feed, fetch_list=[loss],
+                          scope=scope)[0]) for _ in range(steps)]
+
+
+@pytest.mark.parametrize("degree", [2, 4, 8])
+def test_zero_trajectory_matches_unsharded(degree):
+    ref = _baseline()
+    got, _, _ = _run_zero(degree)
+    np.testing.assert_allclose(got, ref, rtol=3e-5, atol=1e-6)
+
+
+def test_zero_degree_respected_and_state_sharded():
+    """degree=4 on the 8-device mesh: mesh splits (dp=2, zero=4); adam
+    moments and eligible params are physically 4-way sharded — the
+    round-2 gap (degree stored-and-ignored, no .sharding assertion)."""
+    _losses, scope, compiled = _run_zero(4)
+    mesh = compiled._compiled[4]
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    assert sizes == {"dp": 2, "zero": 4}
+
+    from jax.sharding import PartitionSpec as P
+    checked = 0
+    for name in scope.local_var_names():
+        if "moment" not in name:
+            continue
+        arr = scope.find_var(name)
+        if not hasattr(arr, "sharding") or np.ndim(arr) == 0:
+            continue
+        shape = np.shape(arr)
+        if not shape or shape[0] % 4:
+            continue
+        spec = arr.sharding.spec
+        assert spec[0] == "zero", (name, spec)
+        # physical shard: dim0 cut 4 ways on every device
+        shard_shape = arr.sharding.shard_shape(shape)
+        assert shard_shape[0] == shape[0] // 4, (name, shard_shape)
+        checked += 1
+    assert checked >= 4, "expected adam moment1/moment2 for both fc layers"
+
+
+def test_zero_memory_footprint_scales_with_degree():
+    """Per-device optimizer-state bytes at degree 8 ~ 1/8 of replicated."""
+    def opt_state_bytes_per_device(scope):
+        total = 0
+        for name in scope.local_var_names():
+            if "moment" not in name:
+                continue
+            arr = scope.find_var(name)
+            if not hasattr(arr, "addressable_shards"):
+                continue
+            # bytes this state costs on device 0
+            for sh in arr.addressable_shards:
+                if sh.device == arr.addressable_shards[0].device:
+                    total += sh.data.nbytes
+        return total
+
+    _l1, scope1, _ = _run_zero(1)
+    _l8, scope8, _ = _run_zero(8)
+    b1 = opt_state_bytes_per_device(scope1)
+    b8 = opt_state_bytes_per_device(scope8)
+    assert b1 > 0 and b8 > 0
+    # fc1 w [16,32], fc2 w [32,4], biases [32],[4]; all dim0 divisible
+    # by 8 except fc2 bias [4] and fc1 w dim0=16? 16%8==0 ok, [4] not
+    assert b8 <= b1 / 4, (b1, b8)
