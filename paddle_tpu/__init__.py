@@ -32,6 +32,7 @@ from . import io  # noqa
 from . import checkpoint  # noqa
 from . import reader  # noqa
 from .reader import DataLoader, DataFeeder, batch  # noqa
+from . import inference  # noqa
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,102 @@
+"""Host-level cross-process collectives.
+
+Reference: the NCCL/gloo eager collectives behind
+paddle.distributed.{all_reduce,all_gather,broadcast,barrier}
+(collective.py + imperative/nccl_context.cc).  TPU-native: there is no
+eager cross-host primitive — a collective is a tiny jitted program over a
+one-device-per-process mesh; XLA lowers it onto ICI/DCN.  These helpers
+serve the *host-loop* uses (dygraph DataParallel gradient sync, metric
+reduction, rendezvous); inside compiled steps, collectives are the c_*
+ops / GSPMD shardings.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["all_reduce", "all_gather", "broadcast", "barrier",
+           "ReduceOp"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+
+
+def _world_mesh():
+    """One device per process, in process order."""
+    import jax
+    from jax.sharding import Mesh
+
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    nproc = jax.process_count()
+    devs = np.array([per_proc[i] for i in range(nproc)])
+    return Mesh(devs, ("w",)), per_proc[jax.process_index()], nproc
+
+
+def _global_stack(x, mesh, my_dev, nproc):
+    """Stack each process's local array into a [world, ...] global."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = np.asarray(x)
+    sh = NamedSharding(mesh, P("w"))
+    local = jax.device_put(x[None], my_dev)
+    return jax.make_array_from_single_device_arrays(
+        (nproc,) + x.shape, sh, [local])
+
+
+def _replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P())
+
+
+def all_reduce(x, op: str = ReduceOp.SUM):
+    """Cross-process allreduce of a host array; returns the reduced
+    value (identical on every process)."""
+    import jax
+
+    x = np.asarray(x)
+    mesh, my_dev, nproc = _world_mesh()
+    if nproc == 1:
+        return x
+    garr = _global_stack(x, mesh, my_dev, nproc)
+    red = {"sum": lambda a: a.sum(0), "max": lambda a: a.max(0),
+           "min": lambda a: a.min(0), "prod": lambda a: a.prod(0)}[op]
+    out = jax.jit(red, out_shardings=_replicated(mesh))(garr)
+    return np.asarray(out.addressable_shards[0].data)
+
+
+def all_gather(x):
+    """[world, ...] stack of every process's array, on every process."""
+    import jax
+
+    x = np.asarray(x)
+    mesh, my_dev, nproc = _world_mesh()
+    if nproc == 1:
+        return x[None]
+    garr = _global_stack(x, mesh, my_dev, nproc)
+    out = jax.jit(lambda a: a, out_shardings=_replicated(mesh))(garr)
+    return np.asarray(out.addressable_shards[0].data)
+
+
+def broadcast(x, src: int = 0):
+    import jax
+
+    x = np.asarray(x)
+    mesh, my_dev, nproc = _world_mesh()
+    if nproc == 1:
+        return x
+    garr = _global_stack(x, mesh, my_dev, nproc)
+    out = jax.jit(lambda a: a[src],
+                  out_shardings=_replicated(mesh))(garr)
+    return np.asarray(out.addressable_shards[0].data)
+
+
+def barrier():
+    all_reduce(np.zeros((1,), "float32"))

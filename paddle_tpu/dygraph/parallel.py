@@ -93,14 +93,28 @@ class DataParallel(Layer):
         return L.scale(loss, scale=1.0 / self._strategy.nranks)
 
     def apply_collective_grads(self):
+        """Allreduce-SUM every parameter gradient across processes
+        (reference fluid/dygraph/parallel.py:288 coalesce + allreduce).
+        The mean comes from ``scale_loss`` having divided the loss by
+        nranks — the canonical sequence is
+        ``loss = model.scale_loss(loss); loss.backward();
+        model.apply_collective_grads()``."""
         if self._strategy.nranks <= 1:
             return
         import jax
         if jax.process_count() <= 1:
             return
-        raise NotImplementedError(
-            "multi-process eager allreduce: use to_static + dp mesh "
-            "(paddle_tpu.parallel), or fleet collective training")
+        if jax.process_count() != self._strategy.nranks:
+            raise RuntimeError(
+                f"ParallelStrategy.nranks={self._strategy.nranks} but "
+                f"jax.process_count()={jax.process_count()}; gradient "
+                "scaling would be wrong")
+        from ..distributed.collective import all_reduce
+        for p in self.parameters():
+            g = getattr(p, "_grad_value", None)
+            if g is None:
+                continue
+            p._grad_value = all_reduce(np.asarray(g))
 
     # delegate module protocol to the wrapped layers
     def parameters(self, include_sublayers=True):

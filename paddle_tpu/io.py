@@ -115,6 +115,48 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
 
 # -- inference model (reference io.py:1198/1411) ----------------------------
 
+def _prune_by_fetch(program: Program, feed_names, fetch_names):
+    """Keep only the ops on a path from the feeds to the fetches
+    (reference Prune(), framework/prune.cc via fluid/io.py:1305): a saved
+    inference program must not demand labels/loss inputs at serve time.
+    """
+    block = program.global_block()
+
+    def op_reads(op):
+        """Direct inputs plus everything the op's sub-blocks read
+        (conditional_block/while don't list branch-external reads as
+        inputs)."""
+        reads = set(n for n in op.input_arg_names() if n)
+        for key in ("sub_block", "true_block", "false_block"):
+            bid = op.attrs.get(key)
+            if bid is None:
+                continue
+            sub = program.block(bid)
+            for sop in sub.ops:
+                reads.update(op_reads(sop))
+        return reads
+
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        if op.type in ("feed", "fetch"):
+            continue
+        if set(op.output_arg_names()) & needed:
+            keep.append(op)
+            needed.update(op_reads(op))
+    keep.reverse()
+    block.ops[:] = keep
+    for i, op in enumerate(block.ops):
+        op.idx = i
+    # drop vars no kept op references (feeds stay regardless)
+    referenced = set(feed_names) | needed
+    for op in keep:
+        referenced.update(op.output_arg_names())
+    for name in [n for n in block.vars if n not in referenced]:
+        del block.vars[name]
+    program.bump()
+
+
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, program_only=False):
@@ -124,6 +166,7 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     inference_program = program.clone(for_test=True)
     target_names = [t.name if isinstance(t, Variable) else str(t)
                     for t in target_vars]
+    _prune_by_fetch(inference_program, feeded_var_names, target_names)
     inference_program._inference_meta = {
         "feeds": list(feeded_var_names), "fetches": target_names}
 

@@ -136,16 +136,22 @@ class _HeteroPlan:
             raise ValueError(f"hetero pp: feeds {unused} consumed by no "
                              "stage")
 
-        # per-stage trainable params
+        # per-stage trainable params + frozen/buffer persistables (both
+        # are device-placed stage state; only params get gradients)
         self.stage_params: List[List[str]] = []
+        self.stage_buffers: List[List[str]] = []
         for s in range(P):
-            ps = []
+            ps, bs = [], []
             for n in reads[s]:
                 v = block._find_var_recursive(n)
-                if v is not None and v.persistable and \
-                        getattr(v, "trainable", False):
+                if v is None or not v.persistable:
+                    continue
+                if getattr(v, "trainable", False):
                     ps.append(n)
+                else:
+                    bs.append(n)
             self.stage_params.append(ps)
+            self.stage_buffers.append(bs)
         owner = {}
         for s, ps in enumerate(self.stage_params):
             for n in ps:
@@ -184,15 +190,18 @@ class _HeteroPlan:
 
         self._plan_optimizer(block)
 
-        # flat segment specs per stage: params then optimizer state
+        # flat segment specs per stage: params, buffers, optimizer state
         self.state_segs: List[List[_Seg]] = []
         self.param_segs: List[List[_Seg]] = []
+        self.fwd_segs: List[List[_Seg]] = []
         maxlen = 0
         for s in range(P):
             psegs, off = _make_specs(self.stage_params[s], block)
+            bsegs, off = _make_specs(self.stage_buffers[s], block, off)
             ssegs, off = _make_specs(self.stage_opt_state[s], block, off)
             self.param_segs.append(psegs)
-            self.state_segs.append(psegs + ssegs)
+            self.fwd_segs.append(psegs + bsegs)
+            self.state_segs.append(psegs + bsegs + ssegs)
             maxlen = max(maxlen, off)
         self.flat_len = max(maxlen, 1)
 
@@ -373,7 +382,7 @@ def build_hetero_pp_step(program: Program, feed_names: Sequence[str],
             """(flat_local, x_flat, feeds_mb, key) -> (y_flat, loss)."""
             def f(flat_local, x_flat, feeds_mb, key):
                 env: Dict[str, object] = {}
-                _unpack(jnp, plan.param_segs[s], flat_local, env)
+                _unpack(jnp, plan.fwd_segs[s], flat_local, env)
                 env.update(feeds_mb)
                 if s > 0:
                     _unpack(jnp, act_specs[s], x_flat, env)

@@ -1,0 +1,100 @@
+"""Worker script for the multi-process harness test; launched by
+``python -m paddle_tpu.distributed.launch --nproc_per_node 2`` (see
+test_multiprocess.py).  Mirrors the reference's test_dist_base.py
+runtime-main pattern (tests/unittests/test_dist_base.py:642).
+"""
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.distributed.parallel_env import (  # noqa: E402
+    get_rank, get_world_size, init_parallel_env)
+
+
+def main(out_dir):
+    env = init_parallel_env()
+    rank, world = get_rank(), get_world_size()
+    assert world == 2, f"expected world 2, got {world}"
+    assert jax.process_count() == 2
+
+    from paddle_tpu.distributed.collective import (all_gather, all_reduce,
+                                                   broadcast)
+
+    # -- collective smoke over the 2-process cpu ring -----------------------
+    red = all_reduce(np.full((3,), float(rank + 1), "float32"))
+    gat = all_gather(np.full((2,), float(rank), "float32"))
+    bc = broadcast(np.full((2,), float(rank + 7), "float32"), src=1)
+
+    # -- dygraph DataParallel grad parity -----------------------------------
+    import paddle_tpu as pt
+    from paddle_tpu import dygraph
+    from paddle_tpu.dygraph.parallel import DataParallel, ParallelStrategy
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(8, 4).astype("float32")
+    ys = (xs.sum(1, keepdims=True) * 0.5).astype("float32")
+    w0 = rng.rand(4, 1).astype("float32")
+
+    def build_model():
+        with dygraph.guard():
+            lin = dygraph.nn.Linear(
+                4, 1, param_attr=pt.initializer.NumpyArrayInitializer(w0),
+                bias_attr=pt.initializer.ConstantInitializer(0.0))
+            return lin
+
+    def grads_of(model, x, y, dp=None):
+        with dygraph.guard():
+            xv = dygraph.to_variable(x)
+            yv = dygraph.to_variable(y)
+            pred = model(xv)
+            diff = pred - yv
+            loss = pt.layers.reduce_mean(diff * diff)
+            if dp is not None:
+                # canonical DataParallel sequence: scaled loss ->
+                # backward -> allreduce-sum == mean over ranks
+                loss = dp.scale_loss(loss)
+            loss.backward()
+            if dp is not None:
+                dp.apply_collective_grads()
+            return {n: p.gradient()
+                    for n, p in model.named_parameters()}
+
+    # reference: full-batch grads, single process
+    ref_model = build_model()
+    ref = grads_of(ref_model, xs, ys)
+
+    # distributed: each rank a half-batch through DataParallel
+    model = build_model()
+    strategy = ParallelStrategy()
+    strategy.nranks = world
+    dp = DataParallel(model, strategy)
+    shard = slice(rank * 4, (rank + 1) * 4)
+    got = grads_of(dp, xs[shard], ys[shard], dp=dp)
+
+    result = {
+        "rank": rank,
+        "endpoint": env.current_endpoint if hasattr(
+            env, "current_endpoint") else "",
+        "all_reduce": red.tolist(),
+        "all_gather": gat.tolist(),
+        "broadcast": bc.tolist(),
+        "grad_max_err": max(
+            float(np.abs(got[n] - ref[n]).max()) for n in ref),
+    }
+    with open(os.path.join(out_dir, f"result.{rank}.json"), "w") as f:
+        json.dump(result, f)
+    print(f"WORKER {rank} DONE")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

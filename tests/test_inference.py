@@ -1,0 +1,126 @@
+"""Inference predictor + export tests.
+
+Reference analogs: inference/tests/api/analyzer_*_tester.cc (save, load
+in a fresh predictor, compare against train-time outputs, Clone), and
+the frozen-program export path.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.inference import (Config, Predictor, create_predictor,
+                                  load_portable)
+
+
+def _train_and_save(tmpdir, steps=8):
+    x = layers.data("x", [6])
+    y = layers.data("y", [1])
+    h = layers.fc(x, 12, act="relu", name="fc1")
+    pred = layers.fc(h, 1, name="fc2")
+    loss = layers.mean(pt.layers.square_error_cost(pred, y))
+    optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 6).astype("float32")
+    ys = xs.sum(1, keepdims=True).astype("float32") * 0.5
+    for _ in range(steps):
+        exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+    pt.io.save_inference_model(tmpdir, ["x"], [pred], exe)
+    # train-process reference output (test-mode clone; unpruned, so it
+    # still wants the label feed)
+    test_prog = pt.default_main_program().clone(for_test=True)
+    ref = exe.run(test_prog, feed={"x": xs, "y": ys},
+                  fetch_list=[pred.name])[0]
+    return xs, np.asarray(ref)
+
+
+def test_predictor_matches_train_eval(tmp_path):
+    d = str(tmp_path / "model")
+    xs, ref = _train_and_save(d)
+    p = Predictor(d)
+    assert p.get_input_names() == ["x"]
+    out = p.run({"x": xs})
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-6)
+    # positional feed + repeat call hits the AOT cache
+    out2 = p.run([xs])
+    np.testing.assert_allclose(out2[0], ref, rtol=1e-5, atol=1e-6)
+    assert len(p._cache) == 1
+    # new shape -> new compile, still correct
+    out3 = p.run({"x": xs[:4]})
+    np.testing.assert_allclose(out3[0], ref[:4], rtol=1e-5, atol=1e-6)
+    assert len(p._cache) == 2
+
+
+def test_predictor_clone_shares_weights(tmp_path):
+    d = str(tmp_path / "model")
+    xs, ref = _train_and_save(d)
+    p = Predictor(d)
+    q = p.clone()
+    assert q.scope is p.scope  # zero-copy shared weights
+    np.testing.assert_allclose(q.run({"x": xs})[0], ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_create_predictor_config_api(tmp_path):
+    d = str(tmp_path / "model")
+    xs, ref = _train_and_save(d)
+    cfg = Config(model_dir=d)
+    cfg.disable_gpu()
+    cfg.switch_ir_optim(True)
+    p = create_predictor(cfg)
+    np.testing.assert_allclose(p.run({"x": xs})[0], ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_stablehlo_export(tmp_path):
+    d = str(tmp_path / "model")
+    xs, _ref = _train_and_save(d)
+    p = Predictor(d)
+    mlir = p.export_stablehlo(str(tmp_path / "model.stablehlo.mlir"),
+                              {"x": (16, 6)})
+    assert "stablehlo" in mlir and "module" in mlir
+    assert os.path.getsize(str(tmp_path / "model.stablehlo.mlir")) > 0
+
+
+def test_serve_in_fresh_process(tmp_path):
+    """Save here; a clean subprocess loads both the model dir (Predictor)
+    and the portable artifact (load_portable) and must reproduce the
+    train-process outputs."""
+    d = str(tmp_path / "model")
+    xs, ref = _train_and_save(d)
+    p = Predictor(d)
+    portable = str(tmp_path / "model.jaxport")
+    p.export_portable(portable, {"x": (16, 6)})
+    np.save(str(tmp_path / "x.npy"), xs)
+
+    child = textwrap.dedent(f"""
+        import os
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \\
+            " --xla_force_host_platform_device_count=8"
+        import jax; jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        from paddle_tpu.inference import Predictor, load_portable
+        xs = np.load({str(tmp_path / 'x.npy')!r})
+        out1 = Predictor({d!r}).run({{"x": xs}})[0]
+        out2 = load_portable({portable!r}).run({{"x": xs}})[0]
+        np.save({str(tmp_path / 'out1.npy')!r}, out1)
+        np.save({str(tmp_path / 'out2.npy')!r}, out2)
+        print("SERVED")
+    """)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", child], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert "SERVED" in r.stdout, (r.stdout, r.stderr)
+    np.testing.assert_allclose(np.load(str(tmp_path / "out1.npy")), ref,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.load(str(tmp_path / "out2.npy")), ref,
+                               rtol=1e-5, atol=1e-6)
