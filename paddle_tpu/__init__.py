@@ -33,6 +33,8 @@ from . import checkpoint  # noqa
 from . import reader  # noqa
 from .reader import DataLoader, DataFeeder, batch  # noqa
 from . import inference  # noqa
+from . import profiler  # noqa
+from .flags import get_flags, set_flags  # noqa
 
 __version__ = "0.1.0"
 

@@ -26,17 +26,61 @@ class ReduceOp:
     PROD = "prod"
 
 
+_MESH_CACHE = {}
+_JIT_CACHE = {}
+
+
 def _world_mesh():
-    """One device per process, in process order."""
+    """One device per process, in process order (cached — the process
+    topology is fixed for the life of the runtime)."""
     import jax
     from jax.sharding import Mesh
 
-    per_proc = {}
-    for d in jax.devices():
-        per_proc.setdefault(d.process_index, d)
-    nproc = jax.process_count()
-    devs = np.array([per_proc[i] for i in range(nproc)])
-    return Mesh(devs, ("w",)), per_proc[jax.process_index()], nproc
+    if "mesh" not in _MESH_CACHE:
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        nproc = jax.process_count()
+        devs = np.array([per_proc[i] for i in range(nproc)])
+        _MESH_CACHE["mesh"] = (Mesh(devs, ("w",)),
+                               per_proc[jax.process_index()], nproc)
+    return _MESH_CACHE["mesh"]
+
+
+def _sum0(a):
+    return a.sum(0)
+
+
+def _max0(a):
+    return a.max(0)
+
+
+def _min0(a):
+    return a.min(0)
+
+
+def _prod0(a):
+    return a.prod(0)
+
+
+def _ident(a):
+    return a
+
+
+def _take(src):
+    def f(a):
+        return a[src]
+    return f
+
+
+def _jitted(key, fn, mesh):
+    """jit cache keyed by op — a fresh lambda per call would force a
+    retrace+recompile on every collective."""
+    import jax
+
+    if key not in _JIT_CACHE:
+        _JIT_CACHE[key] = jax.jit(fn, out_shardings=_replicated(mesh))
+    return _JIT_CACHE[key]
 
 
 def _global_stack(x, mesh, my_dev, nproc):
@@ -66,9 +110,8 @@ def all_reduce(x, op: str = ReduceOp.SUM):
     if nproc == 1:
         return x
     garr = _global_stack(x, mesh, my_dev, nproc)
-    red = {"sum": lambda a: a.sum(0), "max": lambda a: a.max(0),
-           "min": lambda a: a.min(0), "prod": lambda a: a.prod(0)}[op]
-    out = jax.jit(red, out_shardings=_replicated(mesh))(garr)
+    red = {"sum": _sum0, "max": _max0, "min": _min0, "prod": _prod0}[op]
+    out = _jitted(("reduce", op), red, mesh)(garr)
     return np.asarray(out.addressable_shards[0].data)
 
 
@@ -81,7 +124,7 @@ def all_gather(x):
     if nproc == 1:
         return x[None]
     garr = _global_stack(x, mesh, my_dev, nproc)
-    out = jax.jit(lambda a: a, out_shardings=_replicated(mesh))(garr)
+    out = _jitted(("gather",), _ident, mesh)(garr)
     return np.asarray(out.addressable_shards[0].data)
 
 
@@ -93,8 +136,7 @@ def broadcast(x, src: int = 0):
     if nproc == 1:
         return x
     garr = _global_stack(x, mesh, my_dev, nproc)
-    out = jax.jit(lambda a: a[src],
-                  out_shardings=_replicated(mesh))(garr)
+    out = _jitted(("broadcast", src), _take(src), mesh)(garr)
     return np.asarray(out.addressable_shards[0].data)
 
 

@@ -112,8 +112,11 @@ class DataParallel(Layer):
         from ..distributed.collective import all_reduce
         for p in self.parameters():
             g = getattr(p, "_grad_value", None)
+            # every rank must issue every collective in the same order —
+            # a rank whose batch didn't touch p contributes zeros
+            # (reference parallel.py fills zero grads for exactly this)
             if g is None:
-                continue
+                g = np.zeros(p.shape, "float32")
             p._grad_value = all_reduce(np.asarray(g))
 
     # delegate module protocol to the wrapped layers

@@ -206,6 +206,11 @@ class Executor:
         fetch_names = _fetch_names(fetch_list)
         scope = scope or global_scope()
 
+        from ..flags import flag_value
+        if flag_value("FLAGS_check_nan_inf"):
+            return self._run_debug(program, feed, fetch_names, scope,
+                                   return_numpy)
+
         block = program.global_block()
         feed_arrays = _prepare_feed(block, feed)
         sig = tuple((n, tuple(np.shape(a)), str(np.asarray(a).dtype))
@@ -240,6 +245,57 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    def _run_debug(self, program, feed, fetch_names, scope, return_numpy):
+        """check_nan_inf mode: lower op-by-op on concrete (eager) arrays
+        and raise, naming the op, on the first non-finite float output.
+
+        Reference: framework/details/nan_inf_utils_detail.cc
+        CheckVarHasNanOrInf under FLAGS_check_nan_inf — per-op host
+        checks in exchange for speed (no jit here by design).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.registry import LowerContext, lower_op
+
+        block = program.global_block()
+        feed_arrays = _prepare_feed(block, feed)
+        state_in, state_out = analyze_block(block, list(feed_arrays))
+        env: Dict[str, Any] = dict(feed_arrays)
+        for n in state_in:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"variable {n!r} has no value in scope; did you run "
+                    f"the startup program first?")
+            env[n] = v
+        self._step += 1
+        base_key = jax.random.fold_in(
+            jax.random.key(np.uint32(program.random_seed or 0)),
+            np.int32(self._step))
+        ctx = LowerContext(block, env, base_key=base_key,
+                           amp=getattr(program, "_amp_lowering", None))
+        for op in block.ops:
+            if op.type in ("feed", "fetch"):
+                continue
+            lower_op(ctx, op)
+            for name in op.output_arg_names():
+                val = env.get(name)
+                if val is None or not jnp.issubdtype(
+                        jnp.asarray(val).dtype, jnp.floating):
+                    continue
+                if not bool(jnp.isfinite(val).all()):
+                    raise FloatingPointError(
+                        f"FLAGS_check_nan_inf: non-finite value in "
+                        f"output {name!r} of op {op.type!r} "
+                        f"(op index {op.idx})")
+        for name in state_out:
+            scope.set_var(name, env[name])
+        fetches = [env[n] for n in fetch_names]
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return fetches
 
     # -- compilation --------------------------------------------------------
     def _build(self, program: Program, block: Block,

@@ -68,7 +68,6 @@ class Predictor:
                  fetch_vars=None, scope: Optional[Scope] = None,
                  model_filename=None, params_filename=None):
         from . import io
-        from .framework.executor import Executor
 
         if isinstance(model_dir_or_program, Program):
             program = model_dir_or_program
@@ -77,22 +76,29 @@ class Predictor:
                                  "and fetch_vars")
             self.scope = scope or Scope()
         else:
+            # load program + params directly into OUR scope: serving must
+            # never touch (or clobber) a live training process's global
+            # scope (the reference predictor owns a private Scope too,
+            # analysis_predictor.cc scope_)
+            import json
+
             self.scope = scope or Scope()
-            exe = Executor()
-            program, feed_names, fetch_vars = io.load_inference_model(
-                model_dir_or_program, exe, model_filename=model_filename,
-                params_filename=params_filename)
-            # load_inference_model loads persistables into global scope
-            # via the executor path; re-load into OUR scope for isolation
-            from .framework import executor as ex
-            if self.scope is not ex.global_scope():
-                io.load_persistables(exe, model_dir_or_program, program,
-                                     filename=params_filename
-                                     or "__params__")
-                for v in io.get_program_persistable_vars(program):
-                    val = ex.global_scope().find_var(v.name)
-                    if val is not None:
-                        self.scope.set_var(v.name, val)
+            dirname = model_dir_or_program
+            model_path = os.path.join(dirname,
+                                      model_filename or "__model__")
+            with open(model_path) as f:
+                payload = json.load(f)
+            meta = payload.pop("inference_meta",
+                               {"feeds": [], "fetches": []})
+            from .framework.serde import program_from_json
+            program = program_from_json(json.dumps(payload))
+            params_path = os.path.join(dirname,
+                                       params_filename or "__params__")
+            if os.path.exists(params_path):
+                for name, val in io._read(params_path).items():
+                    self.scope.set_var(name, val)
+            feed_names = meta["feeds"]
+            fetch_vars = meta["fetches"]
         self.program = program
         self.feed_names = list(feed_names)
         self.fetch_names = [getattr(v, "name", v) for v in fetch_vars]

@@ -120,22 +120,9 @@ def _prune_by_fetch(program: Program, feed_names, fetch_names):
     (reference Prune(), framework/prune.cc via fluid/io.py:1305): a saved
     inference program must not demand labels/loss inputs at serve time.
     """
+    from .framework.executor import _op_io
+
     block = program.global_block()
-
-    def op_reads(op):
-        """Direct inputs plus everything the op's sub-blocks read
-        (conditional_block/while don't list branch-external reads as
-        inputs)."""
-        reads = set(n for n in op.input_arg_names() if n)
-        for key in ("sub_block", "true_block", "false_block"):
-            bid = op.attrs.get(key)
-            if bid is None:
-                continue
-            sub = program.block(bid)
-            for sop in sub.ops:
-                reads.update(op_reads(sop))
-        return reads
-
     needed = set(fetch_names)
     keep = []
     for op in reversed(block.ops):
@@ -143,7 +130,10 @@ def _prune_by_fetch(program: Program, feed_names, fetch_names):
             continue
         if set(op.output_arg_names()) & needed:
             keep.append(op)
-            needed.update(op_reads(op))
+            # _op_io descends into control-flow sub-blocks, so vars read
+            # only inside a branch/loop stay live
+            reads, _writes = _op_io(op, block)
+            needed.update(n for n in reads if n)
     keep.reverse()
     block.ops[:] = keep
     for i, op in enumerate(block.ops):

@@ -177,6 +177,25 @@ class Switch:
 from .tensor import increment  # noqa  (re-export, reference parity)
 
 
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """reference layers/control_flow.py Print -> print_op: logs the
+    tensor at run time (host callback under jit), passes it through."""
+    from ..framework.layer_helper import LayerHelper
+
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("print", inputs={"In": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"first_n": first_n,
+                            "message": message or input.name,
+                            "summarize": summarize,
+                            "print_phase": print_phase})
+    return out
+
+
 def array_write(x, i, array=None):
     raise NotImplementedError("tensor_array: planned (LoD-era API)")
 

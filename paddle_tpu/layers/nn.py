@@ -22,6 +22,8 @@ __all__ = [
     "soft_relu", "log_loss", "clip", "clip_by_norm", "mean", "pad",
     "adaptive_pool2d", "flash_attention", "rms_norm", "rope",
     "silu", "mish",
+    "exp", "log", "sqrt", "square", "reciprocal", "softplus",
+    "softsign", "sin", "cos", "erf", "ceil", "floor", "round", "abs",
 ]
 
 

@@ -1,0 +1,69 @@
+"""Debug ops: print.
+
+Reference: operators/print_op.cc (pass-through op that logs tensor
+stats/values at run time; layers.Print builds it).  Lowering uses
+jax.debug.print, which survives jit (host callback) — the TPU analog of
+the reference's CPU-side LogTensor.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import grad_var_name
+from .registry import in_var, register_op, set_out
+
+
+def _print_infer(op, block):
+    x = in_var(op, block, "In")
+    set_out(op, block, "Out", x.shape, x.dtype)
+
+
+def _print_grad_maker(fwd_op, block, helper):
+    """Backward: print the gradient when print_phase asks for it
+    (reference print_op is_forward=false instance), else identity."""
+    out_g = grad_var_name(fwd_op.single_output("Out"))
+    in_g = grad_var_name(fwd_op.single_input("In"))
+    phase = fwd_op.attrs.get("print_phase", "both")
+    if phase in ("backward", "both"):
+        attrs = {k: v for k, v in fwd_op.attrs.items()
+                 if k in ("first_n", "message", "summarize")}
+        attrs["message"] = (attrs.get("message") or "") + "@GRAD"
+        attrs["print_phase"] = "forward"  # grad-of-grad stays silent
+        return [dict(type="print", inputs={"In": [out_g]},
+                     outputs={"Out": [in_g]}, attrs=attrs)]
+    return [dict(type="assign", inputs={"X": [out_g]},
+                 outputs={"Out": [in_g]}, attrs={})]
+
+
+def _emit(message, shape, dtype, first_n, counter, head):
+    if first_n > 0:
+        if counter["n"] >= first_n:
+            return
+        counter["n"] += 1
+    print(f"{message} shape={shape} dtype={dtype} data={head}",
+          flush=True)
+
+
+@register_op("print", infer=_print_infer, grad=_print_grad_maker)
+def _print(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "In")
+    message = op.attr("message", "") or ""
+    summarize = op.attr("summarize", 20)
+    first_n = int(op.attr("first_n", -1) or -1)
+    phase = op.attr("print_phase", "both")
+    if phase in ("forward", "both"):
+        flat = jnp.ravel(x)
+        n = int(np.prod(jnp.shape(x))) if jnp.shape(x) else 1
+        head = flat[:max(0, min(summarize if summarize > 0 else n, n))]
+        counter = {"n": 0}  # first_n: host-side per-op-instance count
+        shape, dtype = tuple(jnp.shape(x)), str(x.dtype)
+
+        def cb(vals):
+            _emit(message, shape, dtype, first_n, counter,
+                  np.asarray(vals))
+
+        jax.debug.callback(cb, head)
+    ctx.set_output(op, "Out", x)

@@ -1,0 +1,122 @@
+"""Profiler: device traces + host event annotation.
+
+Reference: platform/profiler.h:127 (RecordEvent, EnableProfiler /
+DisableProfiler, profiler.py:start_profiler/stop_profiler) +
+platform/device_tracer.h:43 (CUPTI device tracer).  TPU-native: the
+device tracer IS jax.profiler (XLA/TPU runtime events, HLO timelines,
+memory viewer); this module gives it the reference's API shape and adds
+a host-side summary so ``stop_profiler('total')`` can print a table
+without TensorBoard.
+"""
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import time
+from collections import Counter
+from typing import Optional
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "RecordEvent",
+           "load_trace", "summarize_trace"]
+
+_active_dir: Optional[str] = None
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default",
+                   trace_dir: Optional[str] = None):
+    """reference fluid.profiler.start_profiler; `state` is advisory (the
+    XLA trace always captures host+device)."""
+    import jax
+
+    global _active_dir
+    if _active_dir is not None:
+        raise RuntimeError("profiler already running")
+    _active_dir = trace_dir or os.path.join(
+        os.getcwd(), f"paddle_tpu_profile_{int(time.time())}")
+    jax.profiler.start_trace(_active_dir)
+    return _active_dir
+
+
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: Optional[str] = None) -> Optional[str]:
+    """Stop tracing; optionally print the reference-style op table
+    (sorted_key in {'total', 'calls', 'ave'}) and return the trace dir."""
+    import jax
+
+    global _active_dir
+    if _active_dir is None:
+        return None
+    jax.profiler.stop_trace()
+    trace_dir, _active_dir = _active_dir, None
+    if sorted_key:
+        table = summarize_trace(trace_dir, sorted_key)
+        print(table)
+        if profile_path:
+            with open(profile_path, "w") as f:
+                f.write(table)
+    return trace_dir
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: Optional[str] = None,
+             profile_path: Optional[str] = None,
+             trace_dir: Optional[str] = None):
+    """reference fluid.profiler.profiler context manager."""
+    start_profiler(state, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+class RecordEvent:
+    """Annotate a host region; shows on the trace timeline (reference
+    platform/profiler.h RecordEvent -> jax.profiler.TraceAnnotation)."""
+
+    def __init__(self, name: str):
+        import jax
+
+        self.name = name
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._ann.__exit__(*exc)
+
+
+def load_trace(trace_dir: str) -> dict:
+    """Load the captured trace's event JSON (the .trace.json.gz the XLA
+    profiler writes; also what TensorBoard reads)."""
+    files = sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True))
+    if not files:
+        raise FileNotFoundError(f"no trace found under {trace_dir}")
+    with gzip.open(files[-1]) as f:
+        return json.load(f)
+
+
+def summarize_trace(trace_dir: str, sorted_key: str = "total",
+                    top: int = 30) -> str:
+    """Reference-style event table (profiler.cc PrintProfiler analog)."""
+    trace = load_trace(trace_dir)
+    dur, calls = Counter(), Counter()
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") == "X" and "dur" in e and e.get("name"):
+            dur[e["name"]] += e["dur"]
+            calls[e["name"]] += 1
+    rows = [(n, dur[n] / 1e3, calls[n], dur[n] / 1e3 / calls[n])
+            for n in dur]
+    key = {"total": lambda r: -r[1], "calls": lambda r: -r[2],
+           "ave": lambda r: -r[3]}.get(sorted_key, lambda r: -r[1])
+    rows.sort(key=key)
+    lines = [f"{'Event':60s} {'Total(ms)':>12s} {'Calls':>8s} "
+             f"{'Ave(ms)':>10s}"]
+    for n, tot, c, ave in rows[:top]:
+        lines.append(f"{n[:60]:60s} {tot:12.3f} {c:8d} {ave:10.4f}")
+    return "\n".join(lines)

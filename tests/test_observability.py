@@ -1,0 +1,108 @@
+"""Observability tests: profiler trace capture, flags registry,
+check_nan_inf op naming, print op.
+
+Reference analogs: tests/unittests/test_profiler.py, test_flags_*.py,
+test_nan_inf.py, test_print_op.py.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.flags import flag_value
+
+
+def test_flags_set_get_and_env_defaults():
+    got = pt.get_flags("FLAGS_check_nan_inf")
+    assert got == {"FLAGS_check_nan_inf": False}
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        assert flag_value("FLAGS_check_nan_inf") is True
+        multi = pt.get_flags(["FLAGS_check_nan_inf", "FLAGS_benchmark"])
+        assert multi["FLAGS_check_nan_inf"] is True
+        assert multi["FLAGS_benchmark"] is False
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+    with pytest.raises(ValueError, match="unknown flag"):
+        pt.set_flags({"FLAGS_bogus": 1})
+
+
+def test_profiler_trace_saved_and_loadable(tmp_path):
+    from paddle_tpu.profiler import (RecordEvent, load_trace, profiler,
+                                     summarize_trace)
+
+    x = layers.data("x", [4])
+    h = layers.fc(x, 8, act="relu")
+    loss = layers.mean(h)
+    optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.random.rand(8, 4).astype("float32")
+    exe.run(feed={"x": xv}, fetch_list=[loss])  # compile outside trace
+
+    d = str(tmp_path / "trace")
+    with profiler(trace_dir=d):
+        with RecordEvent("bench_step"):
+            for _ in range(3):
+                exe.run(feed={"x": xv}, fetch_list=[loss])
+
+    trace = load_trace(d)
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert "bench_step" in names  # our annotation is on the timeline
+    table = summarize_trace(d, "total")
+    assert "bench_step" in table and "Total(ms)" in table
+
+
+def test_check_nan_inf_names_the_op():
+    """Inject a NaN-producing op (log of a negative number) and assert
+    the failure names it."""
+    x = layers.data("x", [3])
+    h = layers.fc(x, 4, name="ok_fc")
+    bad = layers.log(h)       # h can be negative -> nan
+    loss = layers.mean(bad)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = -np.ones((2, 3), "float32")
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(FloatingPointError, match="op 'log'"):
+            exe.run(feed={"x": xv}, fetch_list=[loss])
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_check_nan_inf_clean_run_matches_jit():
+    x = layers.data("x", [3])
+    loss = layers.mean(layers.fc(x, 4, act="sigmoid"))
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.random.RandomState(0).rand(2, 3).astype("float32")
+    ref = float(exe.run(feed={"x": xv}, fetch_list=[loss])[0])
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        got = float(np.asarray(
+            exe.run(feed={"x": xv}, fetch_list=[loss])[0]))
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_print_op_passthrough_and_grad(capfd):
+    import jax
+
+    x = layers.data("x", [3])
+    h = layers.fc(x, 4, name="pfc")
+    p = layers.Print(h, message="h_values")
+    loss = layers.mean(p)
+    optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.ones((2, 3), "float32")
+    l1 = float(exe.run(feed={"x": xv}, fetch_list=[loss])[0])
+    l2 = float(exe.run(feed={"x": xv}, fetch_list=[loss])[0])
+    assert np.isfinite(l1) and l2 < l1  # pass-through + identity grad
+    jax.effects_barrier()
+    out = capfd.readouterr()
+    assert "h_values" in out.out or "h_values" in out.err

@@ -936,6 +936,7 @@ SKIP = {
     "average_accumulates": "tests/test_lr_clip_ema.py (ModelAverage)",
     # dynamic output shapes: cannot run under a static-shape jit; the
     # lowering pads/masks — exercised via layers tests
+    "print": "tests/test_observability.py (passthrough, grad, output)",
     "masked_select": "dynamic shape; covered via layers.masked_select "
                      "usage in tests/test_models.py",
     "unique": "dynamic shape; lowering returns padded/size pair",
