@@ -116,7 +116,8 @@ class DataParallel(Layer):
             # a rank whose batch didn't touch p contributes zeros
             # (reference parallel.py fills zero grads for exactly this)
             if g is None:
-                g = np.zeros(p.shape, "float32")
+                from ..framework.core import dtype_to_np
+                g = np.zeros(p.shape, dtype_to_np(p.dtype))
             p._grad_value = all_reduce(np.asarray(g))
 
     # delegate module protocol to the wrapped layers

@@ -62,7 +62,8 @@ def flag_value(name: str):
 register_flag("FLAGS_check_nan_inf", False,
               "run ops eagerly and raise, naming the op, on the first "
               "non-finite output (framework/details/nan_inf_utils)")
-register_flag("FLAGS_benchmark", False, "sync + time each executor run")
+register_flag("FLAGS_benchmark", False,
+              "sync and print per-run wall time in Executor.run")
 register_flag("FLAGS_eager_delete_tensor_gb", 0.0,
               "GC threshold (advisory: XLA owns buffer lifetime)")
 register_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92,

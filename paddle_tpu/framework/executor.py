@@ -238,8 +238,18 @@ class Executor:
 
         self._step += 1
         step = np.int32(self._step)
+        from ..flags import flag_value
+        bench = flag_value("FLAGS_benchmark")
+        if bench:
+            import time
+            jax.block_until_ready(mut_vals)
+            t0 = time.perf_counter()
         fetches, new_state = fn(tuple(feed_arrays.values()),
                                 mut_vals, const_vals, step)
+        if bench:
+            jax.block_until_ready((fetches, new_state))
+            print(f"[FLAGS_benchmark] step {self._step}: "
+                  f"{(time.perf_counter() - t0) * 1e3:.3f} ms")
         for name, val in zip(state_out, new_state):
             scope.set_var(name, val)
         if return_numpy:

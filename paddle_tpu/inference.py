@@ -80,18 +80,9 @@ class Predictor:
             # never touch (or clobber) a live training process's global
             # scope (the reference predictor owns a private Scope too,
             # analysis_predictor.cc scope_)
-            import json
-
             self.scope = scope or Scope()
             dirname = model_dir_or_program
-            model_path = os.path.join(dirname,
-                                      model_filename or "__model__")
-            with open(model_path) as f:
-                payload = json.load(f)
-            meta = payload.pop("inference_meta",
-                               {"feeds": [], "fetches": []})
-            from .framework.serde import program_from_json
-            program = program_from_json(json.dumps(payload))
+            program, meta = io._load_model_payload(dirname, model_filename)
             params_path = os.path.join(dirname,
                                        params_filename or "__params__")
             if os.path.exists(params_path):

@@ -174,15 +174,21 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     return target_names
 
 
-def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None):
-    """Returns (program, feed_names, fetch_vars)."""
+def _load_model_payload(dirname, model_filename=None):
+    """Shared loader for the serialized inference program: returns
+    (program, meta) — used by load_inference_model and the Predictor."""
     import json
     model_path = os.path.join(dirname, model_filename or "__model__")
     with open(model_path) as f:
         payload = json.load(f)
     meta = payload.pop("inference_meta", {"feeds": [], "fetches": []})
-    program = program_from_json(json.dumps(payload))
+    return program_from_json(json.dumps(payload)), meta
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """Returns (program, feed_names, fetch_vars)."""
+    program, meta = _load_model_payload(dirname, model_filename)
     if os.path.exists(os.path.join(dirname,
                                    params_filename or "__params__")):
         load_persistables(executor, dirname, program,

@@ -12,6 +12,8 @@ from .learning_rate_scheduler import (noam_decay, exponential_decay,  # noqa
                                       cosine_decay, linear_lr_warmup)
 from . import learning_rate_scheduler  # noqa
 from . import control_flow  # noqa
+from .sequence import *  # noqa
+from . import sequence  # noqa
 from . import nn  # noqa
 from . import tensor  # noqa
 from . import loss  # noqa

@@ -196,13 +196,6 @@ def Print(input, first_n=-1, message=None, summarize=20,
     return out
 
 
-def array_write(x, i, array=None):
-    raise NotImplementedError("tensor_array: planned (LoD-era API)")
-
-
-def array_read(array, i):
-    raise NotImplementedError("tensor_array: planned (LoD-era API)")
-
-
-def array_length(array):
-    raise NotImplementedError("tensor_array: planned (LoD-era API)")
+# TensorArray: fixed-capacity dense-buffer formulation (layers/sequence.py)
+from .sequence import (array_length, array_read, array_write,  # noqa
+                       create_array)

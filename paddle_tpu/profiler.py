@@ -34,9 +34,10 @@ def start_profiler(state: str = "All", tracer_option: str = "Default",
     global _active_dir
     if _active_dir is not None:
         raise RuntimeError("profiler already running")
-    _active_dir = trace_dir or os.path.join(
+    target = trace_dir or os.path.join(
         os.getcwd(), f"paddle_tpu_profile_{int(time.time())}")
-    jax.profiler.start_trace(_active_dir)
+    jax.profiler.start_trace(target)
+    _active_dir = target  # only after start succeeded
     return _active_dir
 
 
