@@ -35,6 +35,12 @@ from .reader import DataLoader, DataFeeder, batch  # noqa
 from . import inference  # noqa
 from . import profiler  # noqa
 from .flags import get_flags, set_flags  # noqa
+from . import metrics  # noqa
+from . import metric  # noqa
+from . import nn  # noqa
+from . import static  # noqa
+from . import hapi  # noqa
+from .hapi import Model  # noqa
 
 __version__ = "0.1.0"
 

@@ -238,7 +238,6 @@ class Executor:
 
         self._step += 1
         step = np.int32(self._step)
-        from ..flags import flag_value
         bench = flag_value("FLAGS_benchmark")
         if bench:
             import time

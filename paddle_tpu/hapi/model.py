@@ -1,0 +1,168 @@
+"""hapi Model: fit / evaluate / predict over a dygraph network.
+
+Reference: python/paddle/hapi/model.py (Model.prepare:1558, fit:1637,
+evaluate:1783, predict:1853, train_batch/eval_batch/predict_batch,
+save/load).  Runs the imperative engine; each batch is one traced+jitted
+step under the dygraph tracer.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .. import dygraph
+from ..reader import DataLoader, Dataset
+
+__all__ = ["Model"]
+
+
+def _as_loader(data, batch_size, shuffle):
+    if data is None:
+        return None
+    if isinstance(data, DataLoader):
+        return data
+    return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                      use_double_buffer=False)
+
+
+def _split_batch(batch):
+    """(inputs..., label) convention — the last element is the label."""
+    if isinstance(batch, dict):
+        raise TypeError("hapi Model takes tuple-style batches "
+                        "(inputs..., label); got a dict")
+    batch = list(batch) if isinstance(batch, (tuple, list)) else [batch]
+    return batch[:-1], batch[-1]
+
+
+class Model:
+    """2.0-style training facade around a dygraph Layer."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List = []
+
+    # -- configuration ------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        else:
+            self._metrics = list(metrics) if isinstance(
+                metrics, (list, tuple)) else [metrics]
+        return self
+
+    # -- single-batch engines ----------------------------------------------
+    def train_batch(self, inputs, labels):
+        assert self._optimizer is not None and self._loss is not None, \
+            "call prepare(optimizer, loss) first"
+        with dygraph.guard():
+            self.network.train()
+            ins = [dygraph.to_variable(np.asarray(x)) for x in inputs]
+            y = dygraph.to_variable(np.asarray(labels))
+            pred = self.network(*ins)
+            loss = self._loss(pred, y)
+            loss.backward()
+            self._optimizer.minimize(
+                loss, parameter_list=self.network.parameters())
+            self.network.clear_gradients()
+            return float(np.asarray(loss.numpy()).reshape(-1)[0]), pred
+
+    def eval_batch(self, inputs, labels):
+        with dygraph.guard():
+            self.network.eval()
+            ins = [dygraph.to_variable(np.asarray(x)) for x in inputs]
+            y = dygraph.to_variable(np.asarray(labels))
+            pred = self.network(*ins)
+            loss = self._loss(pred, y) if self._loss else None
+            return (None if loss is None else
+                    float(np.asarray(loss.numpy()).reshape(-1)[0]), pred)
+
+    def predict_batch(self, inputs):
+        with dygraph.guard():
+            self.network.eval()
+            ins = [dygraph.to_variable(np.asarray(x)) for x in inputs]
+            return self.network(*ins)
+
+    # -- loops --------------------------------------------------------------
+    def fit(self, train_data, eval_data=None, batch_size=1, epochs=1,
+            shuffle=True, verbose=1, log_freq=50):
+        loader = _as_loader(train_data, batch_size, shuffle)
+        history = {"loss": []}
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                inputs, labels = _split_batch(batch)
+                loss, pred = self.train_batch(inputs, labels)
+                history["loss"].append(loss)
+                self._update_metrics(pred, labels)
+                if verbose and step % log_freq == 0:
+                    print(f"epoch {epoch} step {step}: loss={loss:.4f} "
+                          + self._metric_str())
+            if verbose:
+                print(f"epoch {epoch} done: loss={history['loss'][-1]:.4f}"
+                      f" {self._metric_str()}")
+            if eval_data is not None:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=verbose)
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, verbose=1):
+        loader = _as_loader(eval_data, batch_size, shuffle=False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = _split_batch(batch)
+            loss, pred = self.eval_batch(inputs, labels)
+            if loss is not None:
+                losses.append(loss)
+            self._update_metrics(pred, labels)
+        result = {"loss": float(np.mean(losses)) if losses else None}
+        for m in self._metrics:
+            result[m.name()] = m.accumulate()
+        if verbose:
+            print("eval:", result)
+        return result
+
+    def predict(self, test_data, batch_size=1):
+        loader = _as_loader(test_data, batch_size, shuffle=False)
+        outs = []
+        for batch in loader:
+            batch = list(batch) if isinstance(batch, (tuple, list)) \
+                else [batch]
+            outs.append(np.asarray(self.predict_batch(batch).numpy()))
+        return outs
+
+    def _update_metrics(self, pred, labels):
+        p = np.asarray(pred.numpy())
+        y = np.asarray(labels)
+        for m in self._metrics:
+            out = m.compute(p, y)
+            m.update(*out) if isinstance(out, tuple) else m.update(out)
+
+    def _metric_str(self):
+        return " ".join(f"{m.name()}={m.accumulate():.4f}"
+                        if np.isscalar(m.accumulate())
+                        else f"{m.name()}={m.accumulate()}"
+                        for m in self._metrics)
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        state = {k: (v.numpy() if hasattr(v, "numpy") else np.asarray(v))
+                 for k, v in self.network.state_dict().items()}
+        with open(path + ".pdparams", "wb") as f:
+            pickle.dump(state, f)
+
+    def load(self, path: str):
+        with open(path + ".pdparams", "rb") as f:
+            state = pickle.load(f)
+        self.network.set_state_dict(state)
+        return self

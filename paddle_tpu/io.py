@@ -29,6 +29,12 @@ _OPT_SUFFIX = ".pdopt"
 _MODEL_SUFFIX = ".pdmodel"
 
 
+# 2.0 paddle.io surface lives alongside the fluid save/load API
+from .reader import (BatchSampler, DataLoader, Dataset,  # noqa
+                     IterableDataset, RandomSampler, SequenceSampler,
+                     TensorDataset)
+
+
 def get_program_persistable_vars(program: Program) -> List[Variable]:
     return [v for v in program.list_vars() if v.persistable]
 

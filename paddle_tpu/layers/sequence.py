@@ -91,7 +91,6 @@ def create_array(dtype, item_shape, capacity: int = 128, name=None):
     static bound for the LoDTensorArray's dynamic growth)."""
     from . import tensor as T
 
-    helper = LayerHelper("tensor_array", name=name)
     arr = T.fill_constant([capacity] + list(item_shape), dtype, 0.0)
     arr._ta_len = T.fill_constant([1], "int64", 0)
     arr._ta_capacity = capacity
@@ -120,8 +119,14 @@ def array_write(x, i, array=None, capacity: int = 128):
 
     helper = LayerHelper("array_write")
     if array is None:
-        array = create_array(x.dtype, list(x.shape or ()),
-                             capacity=capacity)
+        shape = list(x.shape or ())
+        if any(d < 0 for d in shape):
+            raise ValueError(
+                f"array_write: cannot infer a TensorArray buffer from "
+                f"x shape {tuple(shape)} (unknown dims); pass "
+                "array=create_array(dtype, item_shape, capacity) with "
+                "concrete item dimensions")
+        array = create_array(x.dtype, shape, capacity=capacity)
     cap = getattr(array, "_ta_capacity", capacity)
     lit = _static_index_value(i)
     if lit is not None and int(lit) >= cap:
@@ -163,8 +168,6 @@ def array_length(array):
 # ---------------------------------------------------------------------------
 def _rnn(kind, input, hidden_size, lengths, n_gates, param_attr=None,
          bias_attr=None, name=None):
-    from ..framework.core import default_main_program
-
     helper = LayerHelper(kind, name=name)
     d = int(input.shape[-1])
     w = helper.create_parameter(param_attr,

@@ -1,0 +1,98 @@
+"""Paddle-2.0-style metric namespace (reference python/paddle/metric/
+metrics.py): Metric protocol = compute -> update -> accumulate, used by
+hapi Model.fit/evaluate.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+from . import metrics as _fluid_metrics
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+
+
+class Metric:
+    def name(self):
+        return getattr(self, "_name", self.__class__.__name__)
+
+    def compute(self, pred, label, *args):
+        """Optional pre-processing of network outputs; default
+        passthrough (run on host numpy here)."""
+        return pred, label
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (reference paddle/metric/metrics.py Accuracy)."""
+
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred = np.asarray(pred)
+        label = np.asarray(label).reshape(len(pred), -1)[:, :1]
+        k = max(self.topk)
+        top = np.argsort(-pred, axis=-1)[:, :k]
+        return (top == label).astype("float32")
+
+    def update(self, correct):
+        correct = np.asarray(correct)
+        accs = []
+        for k in self.topk:
+            c = correct[:, :k].max(-1)
+            self.total[self.topk.index(k)] += float(c.sum())
+            self.count[self.topk.index(k)] += len(c)
+            accs.append(float(c.mean()))
+        return accs[0] if len(accs) == 1 else accs
+
+    def accumulate(self):
+        res = [t / c if c else 0.0
+               for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+
+class _FluidWrap(Metric):
+    """Adapter: expose a fluid MetricBase under the 2.0 protocol."""
+
+    _cls = None
+
+    def __init__(self, name=None, **kw):
+        self._m = self._cls(name=name, **kw)
+        self._name = name or self._cls.__name__.lower()
+
+    def update(self, pred, label):
+        self._m.update(pred, label)
+
+    def accumulate(self):
+        return self._m.eval()
+
+    def reset(self):
+        self._m.reset()
+
+
+class Precision(_FluidWrap):
+    _cls = _fluid_metrics.Precision
+
+
+class Recall(_FluidWrap):
+    _cls = _fluid_metrics.Recall
+
+
+class Auc(_FluidWrap):
+    _cls = _fluid_metrics.Auc

@@ -1,0 +1,157 @@
+"""Metrics module (reference python/paddle/fluid/metrics.py): stateful
+host-side metric accumulators fed with numpy batches from fetch results.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["MetricBase", "CompositeMetric", "Accuracy", "Precision",
+           "Recall", "Auc"]
+
+
+class MetricBase:
+    """reference metrics.py MetricBase: reset/update/eval protocol."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._name = name or self.__class__.__name__
+
+    def get_config(self):
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")}
+
+    def reset(self):
+        for k in list(self.__dict__):
+            if not k.startswith("_"):
+                v = self.__dict__[k]
+                if isinstance(v, (int, float)):
+                    self.__dict__[k] = type(v)(0)
+                elif isinstance(v, np.ndarray):
+                    self.__dict__[k] = np.zeros_like(v)
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    """Bundle several metrics updated together (reference :182)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics: List[MetricBase] = []
+
+    def add_metric(self, metric: MetricBase):
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, *args, **kwargs):
+        for m in self._metrics:
+            m.update(*args, **kwargs)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Accuracy(MetricBase):
+    """Weighted streaming accuracy (reference metrics.py Accuracy:231:
+    update(value, weight) accumulates batch accuracies)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1.0):
+        value = float(np.asarray(value).reshape(-1)[0])
+        weight = float(weight)
+        if weight < 0:
+            raise ValueError("weight must be non-negative")
+        self.value += value * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("Accuracy: no batches accumulated")
+        return self.value / self.weight
+
+
+class Precision(MetricBase):
+    """Binary precision over streamed (pred_label, label) batches
+    (reference metrics.py Precision:297)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0.0
+        self.fp = 0.0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype("int64").reshape(-1)
+        labels = np.asarray(labels).astype("int64").reshape(-1)
+        self.tp += float(((preds == 1) & (labels == 1)).sum())
+        self.fp += float(((preds == 1) & (labels == 0)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    """Binary recall (reference metrics.py Recall:357)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0.0
+        self.fn = 0.0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype("int64").reshape(-1)
+        labels = np.asarray(labels).astype("int64").reshape(-1)
+        self.tp += float(((preds == 1) & (labels == 1)).sum())
+        self.fn += float(((preds == 0) & (labels == 1)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+class Auc(MetricBase):
+    """Streaming ROC AUC via threshold buckets (reference metrics.py
+    Auc:417 — same bucketed trapezoid estimate)."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._num_thresholds = num_thresholds
+        self._stat_pos = np.zeros(num_thresholds + 1, "int64")
+        self._stat_neg = np.zeros(num_thresholds + 1, "int64")
+
+    def reset(self):
+        self._stat_pos[:] = 0
+        self._stat_neg[:] = 0
+
+    def update(self, preds, labels):
+        """preds: [N, 2] class probabilities (or [N] positive prob)."""
+        preds = np.asarray(preds)
+        pos_prob = preds[:, 1] if preds.ndim == 2 else preds.reshape(-1)
+        labels = np.asarray(labels).astype("int64").reshape(-1)
+        idx = np.minimum((pos_prob * self._num_thresholds).astype("int64"),
+                         self._num_thresholds)
+        np.add.at(self._stat_pos, idx[labels == 1], 1)
+        np.add.at(self._stat_neg, idx[labels == 0], 1)
+
+    def eval(self):
+        tot_pos = tot_neg = 0.0
+        auc = 0.0
+        for i in range(self._num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_pos + tot_pos) / 2.0 * (new_neg - tot_neg)
+            tot_pos, tot_neg = new_pos, new_neg
+        return float(auc / (tot_pos * tot_neg)) if tot_pos and tot_neg \
+            else 0.0

@@ -1,0 +1,116 @@
+"""paddle.nn-style namespace (reference python/paddle/nn/): Layer
+classes for the imperative API, re-exporting the dygraph layer zoo and
+adding activation / loss Layers + the functional namespace.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..dygraph.layers import Layer, LayerList, ParameterList, Sequential  # noqa
+from ..dygraph.nn import (BatchNorm, Conv2D, Dropout, Embedding,  # noqa
+                          GroupNorm, LayerNorm, Linear, Pool2D)
+from . import functional  # noqa
+
+__all__ = ["Layer", "Sequential", "LayerList", "ParameterList", "Linear",
+           "Conv2D", "BatchNorm", "LayerNorm", "GroupNorm", "Embedding",
+           "Dropout", "Pool2D", "ReLU", "Sigmoid", "Tanh", "GELU",
+           "Softmax", "CrossEntropyLoss", "MSELoss", "L1Loss",
+           "BCELoss", "functional"]
+
+
+def _activation(name, fn_name):
+    class _Act(Layer):
+        def forward(self, x):
+            from .. import layers
+            return getattr(layers, fn_name)(x)
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _activation("ReLU", "relu")
+Sigmoid = _activation("Sigmoid", "sigmoid")
+Tanh = _activation("Tanh", "tanh")
+GELU = _activation("GELU", "gelu")
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        from .. import layers
+        return layers.softmax(x, axis=self._axis)
+
+
+class CrossEntropyLoss(Layer):
+    """reference paddle.nn.CrossEntropyLoss: softmax+xent from logits."""
+
+    def __init__(self, reduction="mean", soft_label=False):
+        super().__init__()
+        self._reduction = reduction
+        self._soft_label = soft_label
+
+    def forward(self, input, label):
+        from .. import layers
+        loss = layers.softmax_with_cross_entropy(
+            input, label, soft_label=self._soft_label)
+        if self._reduction == "mean":
+            return layers.reduce_mean(loss)
+        if self._reduction == "sum":
+            return layers.reduce_sum(loss)
+        return loss
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        from .. import layers
+        loss = layers.square_error_cost(input, label)
+        if self._reduction == "mean":
+            return layers.reduce_mean(loss)
+        if self._reduction == "sum":
+            return layers.reduce_sum(loss)
+        return loss
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        from .. import layers
+        diff = layers.abs(input - label)
+        if self._reduction == "mean":
+            return layers.reduce_mean(diff)
+        if self._reduction == "sum":
+            return layers.reduce_sum(diff)
+        return diff
+
+
+class BCELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        from .. import layers
+        loss = layers.loss.bce_loss(input, label) if hasattr(
+            layers.loss, "bce_loss") else _bce(input, label)
+        if self._reduction == "mean":
+            return layers.reduce_mean(loss)
+        if self._reduction == "sum":
+            return layers.reduce_sum(loss)
+        return loss
+
+
+def _bce(x, label):
+    from .. import layers
+    one = layers.fill_constant([1], "float32", 1.0)
+    return 0 - (label * layers.log(x) +
+                (one - label) * layers.log(one - x))

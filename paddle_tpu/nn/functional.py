@@ -1,0 +1,31 @@
+"""paddle.nn.functional-style namespace: stateless layer functions
+(reference python/paddle/nn/functional/) — thin aliases over the layers
+module, valid in both static-graph and dygraph modes.
+"""
+from __future__ import annotations
+
+from ..layers import (dropout, embedding, flash_attention, gelu,  # noqa
+                      hard_sigmoid, hard_swish, label_smooth, leaky_relu,
+                      log_softmax, matmul, mish, one_hot, pad, relu,
+                      relu6, sigmoid, silu, softmax, swish, tanh)
+from ..layers.loss import (cross_entropy, kldiv_loss, mse_loss,  # noqa
+                           sigmoid_cross_entropy_with_logits,
+                           softmax_with_cross_entropy, square_error_cost)
+from ..layers.nn import conv2d, layer_norm, pool2d  # noqa
+
+
+def linear(x, weight, bias=None):
+    from .. import layers
+    out = layers.matmul(x, weight)
+    if bias is not None:
+        out = layers.elementwise_add(out, bias)
+    return out
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    from .. import layers
+    return layers.l2_normalize(x, axis=axis, epsilon=epsilon)
+
+
+def binary_cross_entropy_with_logits(logit, label):
+    return sigmoid_cross_entropy_with_logits(logit, label)

@@ -237,7 +237,6 @@ def _gru_rnn(ctx, op):
     B = x.shape[0]
     h0 = jnp.zeros((B, H), x.dtype)
     xs = jnp.swapaxes(x, 0, 1)
-    D = x.shape[-1]
     w_rz, w_h = w[:, :2 * H], w[:, 2 * H:]
     b_rz, b_h = b[:2 * H], b[2 * H:]
 

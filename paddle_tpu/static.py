@@ -1,0 +1,26 @@
+"""paddle.static-style namespace (reference python/paddle/static/):
+the static-graph API surface under its 2.0 name.
+"""
+from .framework.core import (Program, default_main_program,  # noqa
+                             default_startup_program, program_guard,
+                             device_guard)
+from .framework.executor import Executor, Scope, global_scope, scope_guard  # noqa
+from .framework.compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa
+from .framework.backward import append_backward, gradients  # noqa
+from .layers.tensor import create_parameter, data  # noqa
+from .io import (load_inference_model, save_inference_model,  # noqa
+                 load_persistables as load, save_persistables as save)
+from . import nn as _nn  # noqa
+
+
+class InputSpec:
+    """reference paddle.static.InputSpec — shape/dtype/name descriptor."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype!r}, "
+                f"name={self.name!r})")

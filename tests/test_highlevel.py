@@ -1,0 +1,162 @@
+"""High-level API tests: metrics module, 2.0 namespaces, hapi Model.
+
+Reference analogs: tests/unittests/test_metrics.py, test_model.py
+(hapi), and the paddle 2.0 namespace surface.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import metric, metrics, nn, optimizer
+from paddle_tpu.reader import TensorDataset
+
+
+# ---------------------------------------------------------------------------
+# fluid metrics
+# ---------------------------------------------------------------------------
+def test_accuracy_metric_weighted_stream():
+    m = metrics.Accuracy()
+    m.update(0.8, weight=10)
+    m.update(0.6, weight=30)
+    np.testing.assert_allclose(m.eval(), (8 + 18) / 40)
+    m.reset()
+    with pytest.raises(ValueError):
+        m.eval()
+
+
+def test_precision_recall():
+    preds = np.array([1, 1, 0, 1, 0])
+    labels = np.array([1, 0, 0, 1, 1])
+    p = metrics.Precision()
+    r = metrics.Recall()
+    p.update(preds, labels)
+    r.update(preds, labels)
+    np.testing.assert_allclose(p.eval(), 2 / 3)   # tp=2, fp=1
+    np.testing.assert_allclose(r.eval(), 2 / 3)   # tp=2, fn=1
+
+
+def test_auc_matches_exact():
+    rng = np.random.RandomState(0)
+    pos = rng.uniform(0.4, 1.0, 200)
+    neg = rng.uniform(0.0, 0.6, 200)
+    preds = np.concatenate([pos, neg])
+    labels = np.concatenate([np.ones(200), np.zeros(200)]).astype("int64")
+    m = metrics.Auc()
+    m.update(preds, labels)
+    # exact AUC by rank statistic
+    order = np.argsort(preds)
+    ranks = np.empty(len(preds))
+    ranks[order] = np.arange(1, len(preds) + 1)
+    exact = (ranks[labels == 1].sum() - 200 * 201 / 2) / (200 * 200)
+    np.testing.assert_allclose(m.eval(), exact, atol=5e-3)
+
+
+def test_composite_metric():
+    c = metrics.CompositeMetric()
+    c.add_metric(metrics.Precision())
+    c.add_metric(metrics.Recall())
+    c.update(np.array([1, 0]), np.array([1, 1]))
+    assert c.eval() == [1.0, 0.5]
+
+
+# ---------------------------------------------------------------------------
+# 2.0 metric namespace
+# ---------------------------------------------------------------------------
+def test_metric20_topk_accuracy():
+    m = metric.Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.7, 0.2], [0.6, 0.3, 0.1]])
+    label = np.array([[1], [2]])
+    correct = m.compute(pred, label)
+    m.update(correct)
+    acc1, acc2 = m.accumulate()
+    np.testing.assert_allclose(acc1, 0.5)   # sample0 top1 correct
+    np.testing.assert_allclose(acc2, 0.5)   # label 2 not in top2 of s1
+
+
+# ---------------------------------------------------------------------------
+# nn namespace + hapi Model
+# ---------------------------------------------------------------------------
+def _toy_data(n=64, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, d).astype("float32")
+    y = (x.sum(1) > d / 2).astype("int64")[:, None]
+    return x, y
+
+
+def test_nn_namespace_builds_and_runs():
+    from paddle_tpu import dygraph
+    with dygraph.guard():
+        net = nn.Sequential(
+            nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 2))
+        x = dygraph.to_variable(np.ones((3, 6), "float32"))
+        out = net(x)
+        assert tuple(out.shape) == (3, 2)
+        loss = nn.CrossEntropyLoss()(out, dygraph.to_variable(
+            np.zeros((3, 1), "int64")))
+        assert np.isfinite(float(np.asarray(loss.numpy()).reshape(-1)[0]))
+        mse = nn.MSELoss()(out, dygraph.to_variable(
+            np.zeros((3, 2), "float32")))
+        l1 = nn.L1Loss()(out, dygraph.to_variable(
+            np.zeros((3, 2), "float32")))
+        assert float(mse.numpy().reshape(-1)[0]) >= 0
+        assert float(l1.numpy().reshape(-1)[0]) >= 0
+        y = nn.functional.relu(x)
+        assert tuple(y.shape) == (3, 6)
+
+
+def test_hapi_model_fit_evaluate_predict(tmp_path):
+    x, y = _toy_data()
+    from paddle_tpu import dygraph
+    with dygraph.guard():
+        net = nn.Sequential(nn.Linear(6, 16), nn.Tanh(),
+                            nn.Linear(16, 2))
+    model = pt.Model(net)
+    model.prepare(optimizer=optimizer.AdamOptimizer(5e-2),
+                  loss=nn.CrossEntropyLoss(),
+                  metrics=metric.Accuracy())
+    ds = TensorDataset(x, y)
+    hist = model.fit(ds, batch_size=16, epochs=25, verbose=0)
+    assert hist["loss"][-1] < 0.5 * hist["loss"][0]
+
+    res = model.evaluate(ds, batch_size=16, verbose=0)
+    assert res["loss"] is not None and res["acc"] > 0.7, res
+
+    preds = model.predict(TensorDataset(x), batch_size=16)
+    assert len(preds) == 4 and preds[0].shape == (16, 2)
+
+    # save / load roundtrip preserves the metric
+    path = str(tmp_path / "hapi_model")
+    model.save(path)
+    with dygraph.guard():
+        net2 = nn.Sequential(nn.Linear(6, 16), nn.Tanh(),
+                             nn.Linear(16, 2))
+    model2 = pt.Model(net2)
+    model2.prepare(loss=nn.CrossEntropyLoss(),
+                   metrics=metric.Accuracy())
+    model2.load(path)
+    res2 = model2.evaluate(ds, batch_size=16, verbose=0)
+    np.testing.assert_allclose(res2["acc"], res["acc"])
+
+
+def test_static_namespace():
+    from paddle_tpu import static
+    main, startup = static.Program(), static.Program()
+    startup._is_startup = True
+    with static.program_guard(main, startup):
+        x = static.data("sx", [4], dtype="float32")
+        w = static.create_parameter([4, 2], "float32")
+        out = pt.layers.matmul(x, w)
+    exe = static.Executor()
+    exe.run(startup)
+    got = exe.run(main, feed={"sx": np.ones((3, 4), "float32")},
+                  fetch_list=[out])
+    assert np.asarray(got[0]).shape == (3, 2)
+    spec = static.InputSpec([None, 4], "float32", "x")
+    assert "InputSpec" in repr(spec)
+
+
+def test_io20_namespace():
+    from paddle_tpu import io
+    assert io.DataLoader is pt.DataLoader
+    ds = io.TensorDataset(np.arange(6).reshape(3, 2))
+    assert len(ds) == 3
