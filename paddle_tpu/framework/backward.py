@@ -245,9 +245,31 @@ def _written_names(block: Block) -> Set[str]:
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     """reference fluid.backward.gradients (calc_gradient, backward.py:1729):
-    grads of sum(targets) w.r.t. inputs."""
+    grads of sum(targets) w.r.t. inputs.  Differentiates through
+    Backward-role ops too, so calling it on the result of a previous
+    gradients() yields higher-order derivatives (the reference's
+    double-grad path, imperative/partial_grad_engine.cc)."""
+    from .core import grad_suffix_guard
+
     targets = targets if isinstance(targets, (list, tuple)) else [targets]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    block = targets[0].block
+
+    # a suffix disjoint from ANY earlier pass's grad vars: two passes
+    # sharing intermediates would otherwise accumulate into each other's
+    # grads (__accumulate__) and corrupt both
+    suffix = "@GRAD"
+    k = 1
+    existing = set(block.vars)
+    while any(n.endswith(suffix) for n in existing):
+        k += 1
+        suffix = f"@GRAD{k}"
+    with grad_suffix_guard(suffix):
+        return _calc_gradient(targets, inputs, target_gradients,
+                              no_grad_set)
+
+
+def _calc_gradient(targets, inputs, target_gradients, no_grad_set):
     block = targets[0].block
     no_grad = _collect_no_grad(block, no_grad_set)
 
@@ -266,9 +288,12 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
                             attrs={"op_role": OpRole.Backward})
 
     target_names = {t.name for t in targets}
+    # Forward AND Backward roles: higher-order grads differentiate
+    # through earlier passes' grad ops (skip only optimizer machinery)
     fwd_ops = [op for op in block.ops
                if op.attr("op_role") in (OpRole.Forward,
-                                         OpRole.Forward | OpRole.Loss)]
+                                         OpRole.Forward | OpRole.Loss,
+                                         OpRole.Backward)]
     grads_available = set(target_names)
     helper = GradHelper(block, no_grad)
     emitted = []

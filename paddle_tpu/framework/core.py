@@ -156,6 +156,18 @@ class Variable:
         self.is_distributed = False
 
     # -- mirrors of the reference Variable API ------------------------------
+    def __bool__(self):
+        # Data-dependent Python control flow over a graph variable would
+        # silently bake one branch into the trace (reference fixes this
+        # with AST rewriting, dygraph_to_static/program_translator.py:711;
+        # we detect-and-error). `is None` / `is False` checks never reach
+        # here, so library code is unaffected.
+        raise TypeError(
+            f"cannot convert graph Variable {self.name!r} to bool: Python "
+            "`if`/`while` on tensor values is data-dependent control flow "
+            "and would be silently specialized at trace time. Use "
+            "layers.cond / layers.While / layers.Switch instead.")
+
     @property
     def ndim(self) -> int:
         return len(self.shape) if self.shape is not None else 0
@@ -621,6 +633,27 @@ GRAD_SUFFIX = "@GRAD"
 
 def grad_var_name(name: str) -> str:
     return name + GRAD_SUFFIX
+
+
+class grad_suffix_guard:
+    """Temporarily change the grad-var suffix.  Higher-order gradients
+    (reference calc_gradient's @RENAME@ machinery, fluid/backward.py)
+    re-run the backward builder over a block that already holds @GRAD
+    vars; a distinct suffix per pass keeps the passes' vars disjoint."""
+
+    def __init__(self, suffix: str):
+        self.suffix = suffix
+
+    def __enter__(self):
+        global GRAD_SUFFIX
+        self._old = GRAD_SUFFIX
+        GRAD_SUFFIX = self.suffix
+        return self
+
+    def __exit__(self, *exc):
+        global GRAD_SUFFIX
+        GRAD_SUFFIX = self._old
+        return False
 
 
 # ---------------------------------------------------------------------------

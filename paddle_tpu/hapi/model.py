@@ -105,6 +105,8 @@ class Model:
                 if verbose and step % log_freq == 0:
                     print(f"epoch {epoch} step {step}: loss={loss:.4f} "
                           + self._metric_str())
+            if not history["loss"]:
+                raise ValueError("fit: training data yielded no batches")
             if verbose:
                 print(f"epoch {epoch} done: loss={history['loss'][-1]:.4f}"
                       f" {self._metric_str()}")

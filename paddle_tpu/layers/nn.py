@@ -24,6 +24,7 @@ __all__ = [
     "silu", "mish",
     "exp", "log", "sqrt", "square", "reciprocal", "softplus",
     "softsign", "sin", "cos", "erf", "ceil", "floor", "round", "abs",
+    "resize_bilinear", "resize_nearest", "pixel_shuffle",
 ]
 
 
@@ -568,4 +569,46 @@ def rope(x, base=10000.0, position_offset=0, name=None):
     helper.append_op("rope", inputs={"X": [x]}, outputs={"Out": [out]},
                      attrs={"base": base,
                             "position_offset": position_offset})
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    align_corners=True):
+    if out_shape is None and scale is None:
+        raise ValueError("one of out_shape / scale is required")
+    """reference layers/nn.py resize_bilinear -> bilinear_interp op."""
+    helper = LayerHelper("resize_bilinear", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {"align_corners": align_corners}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op("bilinear_interp", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   align_corners=True):
+    if out_shape is None and scale is None:
+        raise ValueError("one of out_shape / scale is required")
+    helper = LayerHelper("resize_nearest", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {"align_corners": align_corners}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op("nearest_interp", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def pixel_shuffle(x, upscale_factor, name=None):
+    helper = LayerHelper("pixel_shuffle", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pixel_shuffle", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"upscale_factor": int(upscale_factor)})
     return out

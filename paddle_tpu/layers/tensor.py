@@ -23,6 +23,7 @@ __all__ = [
     "greater_equal", "logical_and", "logical_or", "logical_not", "clip",
     "uniform_random", "gaussian_random", "create_tensor",
     "create_global_var", "create_parameter",
+    "tril", "triu", "meshgrid", "cumprod",
 ]
 
 
@@ -500,4 +501,39 @@ def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
     helper.append_op("gaussian_random", outputs={"Out": [out]},
                      attrs={"shape": list(shape), "dtype": dtype,
                             "mean": mean, "std": std, "seed": seed})
+    return out
+
+
+def tril(x, diagonal=0, name=None):
+    helper = LayerHelper("tril", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("tril_triu", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"diagonal": int(diagonal), "lower": True})
+    return out
+
+
+def triu(x, diagonal=0, name=None):
+    helper = LayerHelper("tril_triu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("tril_triu", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"diagonal": int(diagonal), "lower": False})
+    return out
+
+
+def meshgrid(inputs, name=None):
+    helper = LayerHelper("meshgrid", name=name)
+    outs = [helper.create_variable_for_type_inference(inputs[0].dtype)
+            for _ in inputs]
+    helper.append_op("meshgrid", inputs={"X": [v for v in inputs]},
+                     outputs={"Out": outs})
+    return outs
+
+
+def cumprod(x, dim=-1, name=None):
+    helper = LayerHelper("cumprod", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("cumprod", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"dim": int(dim)})
     return out

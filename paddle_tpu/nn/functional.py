@@ -23,6 +23,8 @@ def linear(x, weight, bias=None):
 
 
 def normalize(x, p=2, axis=1, epsilon=1e-12):
+    if p != 2:
+        raise NotImplementedError("normalize: only p=2 is implemented")
     from .. import layers
     return layers.l2_normalize(x, axis=axis, epsilon=epsilon)
 

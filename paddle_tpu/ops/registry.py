@@ -302,6 +302,15 @@ def build_auto_grad_specs(fwd_op: Operator, block: Block,
     attrs["__fwd_type__"] = fwd_op.type
     attrs["__fwd_inputs__"] = {k: list(v) for k, v in fwd_op.inputs.items()}
     attrs["__fwd_outputs__"] = {k: list(v) for k, v in fwd_op.outputs.items()}
+    # full-fidelity nested desc, ONLY when differentiating a grad op
+    # (double backward): its own __fwd_* attrs would be clobbered by the
+    # flat keys above.  Plain first-order grads skip the duplication.
+    if fwd_op.type.endswith("_grad"):
+        attrs["__fwd_desc__"] = dict(
+            type=fwd_op.type,
+            inputs={k: list(v) for k, v in fwd_op.inputs.items()},
+            outputs={k: list(v) for k, v in fwd_op.outputs.items()},
+            attrs=dict(fwd_op.attrs))
     return [dict(type=fwd_op.type + "_grad", inputs=inputs, outputs=outputs,
                  attrs=attrs)]
 
@@ -344,9 +353,15 @@ def _lower_auto_grad(ctx: LowerContext, gop: Operator):
                  if n not in seen}
 
     # Reconstruct a forward op object for re-lowering (pure; attrs carry the
-    # original __op_seed__ so stochastic ops replay identically).
-    fwd_attrs = {k: v for k, v in gop.attrs.items()
-                 if not k.startswith("__fwd_")}
+    # original __op_seed__ so stochastic ops replay identically).  The
+    # nested desc preserves a grad op's own __fwd_* attrs, which double
+    # backward needs (grad-of-grad re-lowers the inner grad op).
+    desc = gop.attr("__fwd_desc__")
+    if desc is not None:
+        fwd_attrs = dict(desc["attrs"])
+    else:
+        fwd_attrs = {k: v for k, v in gop.attrs.items()
+                     if not k.startswith("__fwd_")}
     fwd_op = Operator(ctx.block, fwd_type, fwd_inputs, fwd_outputs, fwd_attrs)
 
     def fwd_fn(*diff_vals):
@@ -362,9 +377,18 @@ def _lower_auto_grad(ctx: LowerContext, gop: Operator):
     primals = tuple(ctx.get(n) for n in diff_names)
     out_vals, vjp_fn = jax.vjp(fwd_fn, *primals)
 
+    # cotangent names were recorded in the op's <slot>@GRAD inputs at
+    # build time — use them, not grad_var_name(), which reads the
+    # *current* grad suffix (higher-order passes build under @GRAD2, ...)
+    cot_name = {}
+    for slot, names in fwd_outputs.items():
+        for i, n in enumerate(names):
+            gnames = gop.inputs.get(slot + "@GRAD", [])
+            if i < len(gnames) and gnames[i]:
+                cot_name[n] = gnames[i]
     cotangents = []
     for n, ov in zip(out_order, out_vals):
-        g = ctx.env.get(grad_var_name(n))
+        g = ctx.env.get(cot_name.get(n, grad_var_name(n)))
         if g is None:
             g = jnp.zeros_like(ov)
         else:
@@ -411,6 +435,9 @@ class _AutoGradDef(OpDef):
 def ensure_grad_op_registered(fwd_type: str):
     gtype = fwd_type + "_grad"
     if gtype not in _REGISTRY:
+        # grad='auto': a grad op is itself differentiable (vjp of its
+        # vjp), which is what double backward walks through
         _REGISTRY[gtype] = _AutoGradDef(
-            gtype, infer=infer_auto_grad, lower=_lower_auto_grad, grad=None)
+            gtype, infer=infer_auto_grad, lower=_lower_auto_grad,
+            grad="auto")
     return gtype

@@ -843,3 +843,122 @@ def _pad3d_infer(op, block):
         out[2] += p[2] + p[3]
         out[1] += p[4] + p[5]
     set_out(op, block, "Out", out, x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# image / structural ops (reference operators/interpolate_op.*,
+# tril_triu_op.*, meshgrid_op.*, cumprod_op.*, pixel_shuffle_op.*)
+# ---------------------------------------------------------------------------
+def _interp_infer(op, block):
+    x = in_var(op, block, "X")  # NCHW
+    oh = op.attrs.get("out_h", -1)
+    ow = op.attrs.get("out_w", -1)
+    scale = op.attrs.get("scale", 0.0)
+    if (oh <= 0 or ow <= 0) and scale > 0 and x.shape[2] > 0:
+        oh, ow = int(x.shape[2] * scale), int(x.shape[3] * scale)
+    set_out(op, block, "Out", (x.shape[0], x.shape[1], oh, ow), x.dtype)
+
+
+def _axis_coords(jnp, size, out_size, align_corners):
+    """Source sampling coordinates for one spatial axis (reference
+    interpolate_op.h: align_corners picks corner-aligned vs half-pixel
+    sampling)."""
+    if align_corners and out_size > 1:
+        return jnp.linspace(0.0, size - 1.0, out_size)
+    c = (jnp.arange(out_size) + 0.5) * (size / out_size) - 0.5
+    return jnp.clip(c, 0.0, size - 1.0)
+
+
+def _interp_lower(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    n, c, h, w = x.shape
+    oh = op.attr("out_h", -1)
+    ow = op.attr("out_w", -1)
+    scale = op.attr("scale", 0.0)
+    align = bool(op.attr("align_corners", True))
+    if (oh is None or oh <= 0) and scale:
+        oh, ow = int(h * scale), int(w * scale)
+    xf = x.astype("float32")
+    if op.type.startswith("nearest"):
+        if align:
+            ys = jnp.round(jnp.arange(oh) * ((h - 1) / max(oh - 1, 1)))
+            xs = jnp.round(jnp.arange(ow) * ((w - 1) / max(ow - 1, 1)))
+        else:
+            ys = jnp.floor(jnp.arange(oh) * (h / oh))
+            xs = jnp.floor(jnp.arange(ow) * (w / ow))
+        out = xf[:, :, ys.astype("int32"), :][:, :, :, xs.astype("int32")]
+    else:  # bilinear: gather the 4 corners and lerp
+        ys = _axis_coords(jnp, h, oh, align)
+        xs = _axis_coords(jnp, w, ow, align)
+        y0 = jnp.floor(ys).astype("int32")
+        x0 = jnp.floor(xs).astype("int32")
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = (ys - y0)[None, None, :, None]
+        wx = (xs - x0)[None, None, None, :]
+        g = lambda yi, xi: xf[:, :, yi, :][:, :, :, xi]
+        out = (g(y0, x0) * (1 - wy) * (1 - wx) +
+               g(y1, x0) * wy * (1 - wx) +
+               g(y0, x1) * (1 - wy) * wx +
+               g(y1, x1) * wy * wx)
+    ctx.set_output(op, "Out", out.astype(x.dtype))
+
+
+register_op("bilinear_interp", infer=_interp_infer, lower=_interp_lower)
+register_op("bilinear_interp_v2", infer=_interp_infer, lower=_interp_lower)
+register_op("nearest_interp", infer=_interp_infer, lower=_interp_lower)
+register_op("nearest_interp_v2", infer=_interp_infer, lower=_interp_lower)
+
+
+@register_op("tril_triu", infer=same_as_input())
+def _tril_triu(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    diag = op.attr("diagonal", 0)
+    if op.attr("lower", True):
+        ctx.set_output(op, "Out", jnp.tril(x, k=diag))
+    else:
+        ctx.set_output(op, "Out", jnp.triu(x, k=diag))
+
+
+def _meshgrid_infer(op, block):
+    xs = [block.var(n) for n in op.input("X")]
+    shape = tuple(v.shape[0] for v in xs)
+    for n in op.output("Out"):
+        v = block._find_var_recursive(n) or block.create_var(name=n)
+        v.shape, v.dtype = shape, xs[0].dtype
+
+
+@register_op("meshgrid", infer=_meshgrid_infer)
+def _meshgrid(ctx, op):
+    jnp = _jnp()
+    xs = ctx.get_inputs(op, "X")
+    outs = jnp.meshgrid(*xs, indexing="ij")
+    ctx.set_outputs(op, "Out", outs)
+
+
+@register_op("cumprod", infer=same_as_input())
+def _cumprod(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", jnp.cumprod(x, axis=op.attr("dim", -1)))
+
+
+def _pixel_shuffle_infer(op, block):
+    x = in_var(op, block, "X")  # NCHW
+    r = op.attrs.get("upscale_factor", 1)
+    n, c, h, w = x.shape
+    set_out(op, block, "Out", (n, c // (r * r), h * r, w * r), x.dtype)
+
+
+@register_op("pixel_shuffle", infer=_pixel_shuffle_infer)
+def _pixel_shuffle(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    r = op.attr("upscale_factor", 1)
+    n, c, h, w = x.shape
+    co = c // (r * r)
+    out = x.reshape(n, co, r, r, h, w)
+    out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+    ctx.set_output(op, "Out", out.reshape(n, co, h * r, w * r))

@@ -1,0 +1,82 @@
+"""Higher-order gradients + to_static control-flow detection.
+
+Reference analogs: test_calc_gradient.py / test_double_grad_*.py
+(imperative/partial_grad_engine.cc) and the dygraph_to_static error
+tests (program_translator.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def test_second_and_third_order_gradients():
+    x = layers.data("x", [4], append_batch_size=False)
+    x.stop_gradient = False
+    y = layers.reduce_sum(x * x * x)
+    g1 = pt.gradients(y, x)[0]
+    g2 = pt.gradients(layers.reduce_sum(g1), x)[0]
+    g3 = pt.gradients(layers.reduce_sum(g2), x)[0]
+    assert g1.name != g2.name != g3.name  # per-pass grad suffixes
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.array([1., 2., 3., 4.], "float32")
+    o1, o2, o3 = exe.run(feed={"x": xv}, fetch_list=[g1, g2, g3])
+    np.testing.assert_allclose(np.asarray(o1), 3 * xv ** 2, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(o2), 6 * xv, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(o3), np.full(4, 6.0), rtol=1e-5)
+
+
+def test_double_grad_through_nonlinearity():
+    """d2/dx2 of sum(tanh(x)): -2*tanh(x)*(1-tanh(x)^2)."""
+    x = layers.data("x", [3], append_batch_size=False)
+    x.stop_gradient = False
+    y = layers.reduce_sum(layers.tanh(x))
+    g1 = pt.gradients(y, x)[0]
+    g2 = pt.gradients(layers.reduce_sum(g1), x)[0]
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.array([-1.0, 0.3, 0.9], "float32")
+    o2, = exe.run(feed={"x": xv}, fetch_list=[g2])
+    t = np.tanh(xv)
+    np.testing.assert_allclose(np.asarray(o2), -2 * t * (1 - t ** 2),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_gradients_with_target_gradients():
+    x = layers.data("x", [3], append_batch_size=False)
+    x.stop_gradient = False
+    y = x * x
+    tg = layers.fill_constant([3], "float32", 2.0)
+    g = pt.gradients(y, x, target_gradients=[tg])[0]
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.array([1., 2., 3.], "float32")
+    o, = exe.run(feed={"x": xv}, fetch_list=[g])
+    np.testing.assert_allclose(np.asarray(o), 2 * 2 * xv, rtol=1e-5)
+
+
+def test_static_bool_of_variable_raises():
+    """Data-dependent Python control flow must fail loudly at trace time
+    (the trace-only to_static would otherwise silently specialize)."""
+    x = layers.data("x", [3], append_batch_size=False)
+    cond = x > 0
+    with pytest.raises(TypeError, match="data-dependent control flow"):
+        if cond:
+            pass
+    with pytest.raises(TypeError, match="layers.cond"):
+        bool(layers.reduce_sum(x))
+
+
+def test_to_static_rejects_tensor_if():
+    from paddle_tpu.dygraph.jit import declarative
+
+    @declarative
+    def f(a):
+        if a.sum() if hasattr(a, "sum") else a:  # tensor truthiness
+            return a
+        return a * 2
+
+    with pytest.raises(TypeError, match="control flow"):
+        f(np.ones((2,), "float32"))

@@ -432,6 +432,64 @@ def _scatter_nd_ref(x, index, upd):
     return out
 
 
+def test_structural_ops():
+    x = R(31).rand(2, 4, 4).astype("float32")
+    run_case(OpCase("tril_triu", {"X": x},
+                    attrs={"diagonal": 0, "lower": True},
+                    ref=lambda X, diagonal, lower: np.tril(X),
+                    grad=["X"]))
+    run_case(OpCase("tril_triu", {"X": x},
+                    attrs={"diagonal": 1, "lower": False},
+                    ref=lambda X, diagonal, lower: np.triu(X, 1),
+                    name="triu"))
+    a = np.arange(3, dtype="float32")
+    b = np.arange(4, dtype="float32")
+    run_case(OpCase("meshgrid", {"X": [a, b]}, outputs={"Out": 2},
+                    ref=lambda X: {"Out": list(np.meshgrid(
+                        X[0], X[1], indexing="ij"))}))
+    run_case(OpCase("cumprod", {"X": _POS}, attrs={"dim": 1},
+                    ref=lambda X, dim: np.cumprod(X, 1), grad=["X"],
+                    rtol=1e-4, atol=1e-5))
+    img = R(32).rand(1, 2, 4, 4).astype("float32")
+    run_case(OpCase("nearest_interp", {"X": img},
+                    attrs={"out_h": 8, "out_w": 8,
+                           "align_corners": False},
+                    ref=lambda X, out_h, out_w, align_corners: np.repeat(
+                        np.repeat(X, 2, 2), 2, 3)))
+    def bilinear_ref(X, out_h, out_w, align_corners):
+        n, c, h, w = X.shape
+        ys = np.linspace(0, h - 1, out_h) if align_corners else \
+            np.clip((np.arange(out_h) + 0.5) * h / out_h - 0.5, 0, h - 1)
+        xs = np.linspace(0, w - 1, out_w) if align_corners else \
+            np.clip((np.arange(out_w) + 0.5) * w / out_w - 0.5, 0, w - 1)
+        y0 = np.floor(ys).astype(int); x0 = np.floor(xs).astype(int)
+        y1 = np.minimum(y0 + 1, h - 1); x1 = np.minimum(x0 + 1, w - 1)
+        wy = (ys - y0)[None, None, :, None]
+        wx = (xs - x0)[None, None, None, :]
+        g = lambda yi, xi: X[:, :, yi, :][:, :, :, xi]
+        return (g(y0, x0) * (1 - wy) * (1 - wx) + g(y1, x0) * wy * (1 - wx)
+                + g(y0, x1) * (1 - wy) * wx + g(y1, x1) * wy * wx
+                ).astype("float32")
+
+    for align in (True, False):
+        run_case(OpCase("bilinear_interp", {"X": img},
+                        attrs={"out_h": 8, "out_w": 8,
+                               "align_corners": align},
+                        ref=bilinear_ref, grad=["X"], rtol=1e-4,
+                        atol=1e-5, name=f"bilinear_align{align}"))
+    ps = R(33).rand(1, 8, 2, 2).astype("float32")
+
+    def ps_ref(X, upscale_factor):
+        n, c, h, w = X.shape
+        r = upscale_factor
+        o = X.reshape(n, c // (r * r), r, r, h, w)
+        return o.transpose(0, 1, 4, 2, 5, 3).reshape(
+            n, c // (r * r), h * r, w * r)
+
+    run_case(OpCase("pixel_shuffle", {"X": ps},
+                    attrs={"upscale_factor": 2}, ref=ps_ref, grad=["X"]))
+
+
 def test_argsort_topk_onehot():
     x = R(14).rand(3, 5).astype("float32")
     run_case(OpCase("arg_max", {"X": x}, attrs={"axis": 1},
@@ -878,6 +936,8 @@ COVERED = (set(UNARY) | set(BINARY) | set(COMPARE) | set(LOGICAL) | {
     "sum", "squared_l2_norm", "cumsum", "norm", "p_norm", "clip_by_norm",
     "reshape", "reshape2", "transpose", "transpose2", "concat", "split",
     "stack", "unstack", "squeeze", "squeeze2", "unsqueeze", "unsqueeze2",
+    "tril_triu", "meshgrid", "cumprod", "nearest_interp",
+    "bilinear_interp", "pixel_shuffle",
     "flatten", "flatten2", "flatten_contiguous_range", "slice",
     "strided_slice", "pad", "tile", "expand", "expand_v2", "flip",
     "roll", "shape", "gather", "gather_nd", "index_select", "scatter",
@@ -937,6 +997,8 @@ SKIP = {
     # dynamic output shapes: cannot run under a static-shape jit; the
     # lowering pads/masks — exercised via layers tests
     "print": "tests/test_observability.py (passthrough, grad, output)",
+    "bilinear_interp_v2": "same lowering as bilinear_interp (tested)",
+    "nearest_interp_v2": "same lowering as nearest_interp (tested)",
     **{op: "tests/test_sequence.py (masked refs vs numpy, training)"
        for op in ["sequence_mask", "sequence_pool", "sequence_softmax",
                   "sequence_reverse", "sequence_expand_as",
