@@ -36,6 +36,10 @@ from . import inference  # noqa
 from . import profiler  # noqa
 from .flags import get_flags, set_flags  # noqa
 from . import metrics  # noqa
+from . import dataset  # noqa
+from .dataset import DatasetFactory  # noqa
+from . import transpiler  # noqa
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa
 from . import metric  # noqa
 from . import nn  # noqa
 from . import static  # noqa

@@ -1,2 +1,3 @@
 """contrib namespace (reference python/paddle/fluid/contrib/)."""
 from . import mixed_precision  # noqa
+from . import slim  # noqa

@@ -1,0 +1,3 @@
+"""Model compression (reference python/paddle/fluid/contrib/slim/)."""
+from .quanter import (QuantizationTransformPass, post_training_quantize,  # noqa
+                      quant_aware)

@@ -1,0 +1,176 @@
+"""Quantization program transforms: QAT insert pass + post-training.
+
+Reference: fluid/contrib/slim/quantization/quantization_pass.py
+(QuantizationTransformPass — walks the graph inserting
+fake_quantize/dequantize before every quantizable op's inputs, weights
+channel-wise, activations with a moving-average scale) and
+post_training_quantization.py (calibration-run scale collection).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...framework.core import OpRole, Program, default_startup_program
+
+QUANTIZABLE = ("mul", "matmul", "matmul_v2", "conv2d",
+               "depthwise_conv2d")
+_WEIGHT_SLOTS = {"Y", "Filter"}   # weight-carrying input slots
+
+
+class QuantizationTransformPass:
+    """In-place QAT rewrite of a program (reference
+    quantization_pass.py:214 apply)."""
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 moving_rate: float = 0.9,
+                 quantizable_op_type: Sequence[str] = QUANTIZABLE,
+                 skip_pattern: Sequence[str] = ()):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+        self.quantizable = set(quantizable_op_type)
+        self.skip_pattern = tuple(skip_pattern)
+
+    def apply(self, program: Program,
+              startup_program: Optional[Program] = None,
+              act_scales: Optional[Dict[str, float]] = None,
+              scope=None):
+        """Insert fake quant-dequant on every quantizable op input.
+
+        act_scales: optional {var_name: scale} from calibration — when
+        given, activations use static abs_max scales (the PTQ flavor)
+        instead of moving-average state.
+        """
+        startup = startup_program or default_startup_program()
+        block = program.global_block()
+        quantized: Dict[str, str] = {}
+        n_inserted = 0
+        for op in list(block.ops):
+            if op.type not in self.quantizable:
+                continue
+            if op.attr("op_role", OpRole.Forward) != OpRole.Forward:
+                continue  # quantize the forward graph only
+            op_names = " ".join(op.output_arg_names()
+                                + op.input_arg_names())
+            if any(p in op_names for p in self.skip_pattern):
+                continue
+            for slot, names in list(op.inputs.items()):
+                new_names = []
+                for n in names:
+                    v = block._find_var_recursive(n)
+                    if v is None or not str(v.dtype).startswith("float"):
+                        new_names.append(n)
+                        continue
+                    key = (n + "@W") if slot in _WEIGHT_SLOTS else n
+                    if key not in quantized:
+                        quantized[key] = self._insert(
+                            block, startup, op, n,
+                            is_weight=slot in _WEIGHT_SLOTS,
+                            is_conv="conv" in op.type,
+                            act_scales=act_scales, scope=scope)
+                        n_inserted += 1
+                    new_names.append(quantized[key])
+                op.inputs[slot] = new_names
+        program.bump()
+        return n_inserted
+
+    def _insert(self, block, startup, op, name, is_weight, is_conv,
+                act_scales, scope=None):
+        qname = name + (".quantized.w" if is_weight else ".quantized")
+        block.create_var(name=qname,
+                         shape=block.var(name).shape,
+                         dtype=block.var(name).dtype)
+        scale_name = name + ".quant_scale"
+        if is_weight:
+            block.create_var(name=scale_name, shape=None, dtype="float32")
+            new_op = block._insert_op(
+                op.idx, "fake_channel_wise_quantize_dequantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [qname], "OutScale": [scale_name]},
+                # conv filters are OIHW (output channel first); matmul
+                # weights are [in, out] (output channel last)
+                attrs={"bit_length": self.weight_bits,
+                       "quant_axis": 0 if is_conv else
+                       len(block.var(name).shape or ()) - 1})
+        elif act_scales is not None:
+            # PTQ: static calibrated scale baked in as an attr-free
+            # abs-max around the recorded value via a constant input
+            block.create_var(name=scale_name, shape=None, dtype="float32")
+            const = name + ".calib_scale"
+            block.create_var(name=const, shape=(1,), dtype="float32",
+                             persistable=True)
+            # write the calibrated scale straight into the scope: the
+            # startup program has already run (PTQ calibrates a TRAINED
+            # model), and re-running it would wipe the weights
+            import numpy as _np
+            from ...framework.executor import global_scope
+            (scope or global_scope()).set_var(
+                const, _np.array([act_scales.get(name, 1.0)], "float32"))
+            new_op = block._insert_op(
+                op.idx,
+                "fake_quantize_dequantize_moving_average_abs_max",
+                inputs={"X": [name], "InScale": [const]},
+                outputs={"Out": [qname], "OutScale": [scale_name]},
+                attrs={"bit_length": self.activation_bits,
+                       "is_test": True})
+        else:
+            state = name + ".quant_scale_state"
+            block.create_var(name=state, shape=(1,), dtype="float32",
+                             persistable=True)
+            startup.global_block().create_var(
+                name=state, shape=(1,), dtype="float32", persistable=True)
+            startup.global_block().append_op(
+                "fill_constant", outputs={"Out": [state]},
+                attrs={"shape": [1], "dtype": "float32", "value": 0.0})
+            new_op = block._insert_op(
+                op.idx,
+                "fake_quantize_dequantize_moving_average_abs_max",
+                inputs={"X": [name], "InScale": [state]},
+                outputs={"Out": [qname], "OutScale": [state]},
+                attrs={"bit_length": self.activation_bits,
+                       "moving_rate": self.moving_rate})
+        return qname
+
+
+def quant_aware(program: Program, startup_program=None, weight_bits=8,
+                activation_bits=8, **kw) -> int:
+    """Convenience: apply the QAT transform in place; returns the number
+    of quant points inserted (reference paddleslim quant_aware)."""
+    return QuantizationTransformPass(
+        weight_bits, activation_bits, **kw).apply(program,
+                                                  startup_program)
+
+
+def post_training_quantize(program: Program, executor, feed_batches,
+                           fetch_targets=None, startup_program=None,
+                           weight_bits=8, activation_bits=8,
+                           quantizable_op_type=QUANTIZABLE, scope=None):
+    """PTQ (reference post_training_quantization.py): run calibration
+    batches on the float program to record per-activation abs-max, then
+    rewrite with static scales.  Returns the number of quant points."""
+    block = program.global_block()
+    # activation vars feeding quantizable ops
+    act_vars: List[str] = []
+    for op in block.ops:
+        if op.type in quantizable_op_type and \
+                op.attr("op_role", OpRole.Forward) == OpRole.Forward:
+            for slot, names in op.inputs.items():
+                if slot in _WEIGHT_SLOTS:
+                    continue
+                for n in names:
+                    v = block._find_var_recursive(n)
+                    if v is not None and str(v.dtype).startswith(
+                            "float") and n not in act_vars:
+                        act_vars.append(n)
+    scales = {n: 0.0 for n in act_vars}
+    for feed in feed_batches:
+        vals = executor.run(program, feed=feed, fetch_list=act_vars)
+        for n, v in zip(act_vars, vals):
+            scales[n] = max(scales[n], float(np.abs(np.asarray(v)).max()))
+    tp = QuantizationTransformPass(
+        weight_bits, activation_bits,
+        quantizable_op_type=quantizable_op_type)
+    return tp.apply(program, startup_program, act_scales=scales,
+                    scope=scope)

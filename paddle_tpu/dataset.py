@@ -1,0 +1,190 @@
+"""File-backed Dataset + the train_from_dataset device-worker loop.
+
+Reference: python/paddle/fluid/dataset.py (DatasetBase:set_pipe_command:
+78, set_batch_size:158, set_filelist:208, set_use_var:228,
+InMemoryDataset:329 load_into_memory:661/local_shuffle:727,
+QueueDataset:923) and framework/data_set.h:43 + framework/trainer.h:51
+(MultiTrainer + DeviceWorker pulling batches off the in-memory channel).
+
+TPU-native inversions:
+  * the C++ channel/DataFeed machinery collapses into the DataLoader
+    thread-prefetch pipeline (reader.py); one XLA-compiled step IS the
+    device worker, so `train_from_dataset` is: stream batches ->
+    Executor.run (jit-cached) -> optional fetch printing.
+  * pipe_command is executed per file through a real pipe (the
+    reference contract) but defaults to cat; record format is text —
+    one sample per line, one space-separated group of comma-separated
+    numbers per use_var, in set_use_var order.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["DatasetFactory", "DatasetBase", "InMemoryDataset",
+           "QueueDataset"]
+
+
+class DatasetFactory:
+    """reference dataset.py DatasetFactory.create_dataset."""
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread = 1
+        self._filelist: List[str] = []
+        self._use_vars = []
+        self._pipe_command = "cat"
+        self._drop_last = False
+        self._seed: Optional[int] = None
+
+    # -- reference config surface -------------------------------------------
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num: int):
+        self._thread = max(1, int(thread_num))
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self._use_vars = list(var_list)
+
+    def set_pipe_command(self, pipe_command: str):
+        self._pipe_command = pipe_command
+
+    def set_drop_last(self, drop_last: bool):
+        self._drop_last = bool(drop_last)
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        pass  # no hdfs in this environment; advisory
+
+    def desc(self):
+        return {"batch_size": self._batch_size,
+                "thread": self._thread,
+                "filelist": self._filelist,
+                "use_vars": [getattr(v, "name", v)
+                             for v in self._use_vars],
+                "pipe_command": self._pipe_command}
+
+    # -- record parsing ------------------------------------------------------
+    def _var_specs(self):
+        specs = []
+        for v in self._use_vars:
+            shape = [d for d in (v.shape or ()) if d != -1]
+            specs.append((getattr(v, "name", str(v)), shape,
+                          getattr(v, "dtype", "float32")))
+        return specs
+
+    def _parse_file(self, path: str) -> List[tuple]:
+        """Run pipe_command over the file, parse each output line into
+        one sample tuple aligned with use_vars."""
+        specs = self._var_specs()
+        samples = []
+        with open(path, "rb") as f:
+            proc = subprocess.run(self._pipe_command, shell=True,
+                                  stdin=f, capture_output=True,
+                                  check=True)
+        for line in proc.stdout.decode().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            groups = line.split()
+            if len(groups) != len(specs):
+                raise ValueError(
+                    f"{path}: line has {len(groups)} groups, dataset "
+                    f"uses {len(specs)} vars")
+            sample = []
+            for (name, shape, dtype), g in zip(specs, groups):
+                arr = np.array([float(t) for t in g.split(",")])
+                want = int(np.prod(shape)) if shape else 1
+                if arr.size != want:
+                    raise ValueError(
+                        f"{path}: var {name} expects {want} values, "
+                        f"got {arr.size}")
+                np_dtype = "int64" if str(dtype).startswith("int") \
+                    else str(dtype)
+                sample.append(arr.reshape(shape or (1,)).astype(np_dtype))
+            samples.append(tuple(sample))
+        return samples
+
+    def _batch_stream(self, sample_groups) -> Iterator[dict]:
+        """Batch a stream of sample groups, carrying remainders across
+        file boundaries so no tail data is silently dropped; the final
+        partial batch is yielded unless set_drop_last(True)."""
+        names = [s[0] for s in self._var_specs()]
+        bs = self._batch_size
+        buf: List[tuple] = []
+        for group in sample_groups:
+            for s in group:
+                buf.append(s)
+                if len(buf) == bs:
+                    yield {n: np.stack([t[i] for t in buf])
+                           for i, n in enumerate(names)}
+                    buf = []
+        if buf and not self._drop_last:
+            yield {n: np.stack([t[i] for t in buf])
+                   for i, n in enumerate(names)}
+
+    def batch_iter(self) -> Iterator[dict]:
+        raise NotImplementedError
+
+
+class InMemoryDataset(DatasetBase):
+    """Load every file into host memory; supports local/global shuffle
+    (reference InMemoryDataset:329)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples: List[tuple] = []
+        self._loaded = False
+
+    def load_into_memory(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(self._thread) as pool:
+            for part in pool.map(self._parse_file, self._filelist):
+                self._samples.extend(part)
+        self._loaded = True
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        rng = np.random.RandomState(seed)
+        rng.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        # single-host: same as local (multi-host exchange rides the
+        # trainers' disjoint filelists, the reference's default split)
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._samples = []
+        self._loaded = False
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._samples)
+
+    def batch_iter(self):
+        if not self._loaded:
+            raise RuntimeError("call load_into_memory() first")
+        yield from self._batch_stream([self._samples])
+
+
+class QueueDataset(DatasetBase):
+    """Stream files one at a time — nothing resident beyond one file
+    (reference QueueDataset:923)."""
+
+    def batch_iter(self):
+        yield from self._batch_stream(
+            self._parse_file(p) for p in self._filelist)

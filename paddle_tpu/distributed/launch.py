@@ -27,47 +27,72 @@ def _parse():
     p.add_argument("--node_rank", type=int, default=0)
     p.add_argument("--coordinator_port", type=int, default=12355)
     p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic: restart a failed worker up to N times "
+                        "(reference fleet launch watch loop)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
 
 
+def _spawn(args, hosts, nnodes, local_rank):
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    world = nnodes * args.nproc_per_node
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(
+            f"{h}:{args.coordinator_port + i}"
+            for h in hosts for i in range(args.nproc_per_node)),
+        "PADDLE_CURRENT_ENDPOINT":
+            f"{hosts[min(args.node_rank, nnodes - 1)]}:"
+            f"{args.coordinator_port + local_rank}",
+    })
+    if world > 1:
+        env["PADDLE_COORDINATOR"] = f"{hosts[0]}:{args.coordinator_port}"
+    cmd = [sys.executable, "-u", args.training_script,
+           *args.training_script_args]
+    stdout = None
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        stdout = open(os.path.join(args.log_dir,
+                                   f"worker.{rank}.log"), "a")
+    return subprocess.Popen(cmd, env=env, stdout=stdout,
+                            stderr=subprocess.STDOUT if stdout else None)
+
+
 def main():
+    import time
+
     args = _parse()
     hosts = [h for h in args.ips.split(",") if h]
     nnodes = max(1, len(hosts))
-    procs = []
-    for local_rank in range(args.nproc_per_node):
-        rank = args.node_rank * args.nproc_per_node + local_rank
-        world = nnodes * args.nproc_per_node
-        env = dict(os.environ)
-        env.update({
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(world),
-            "PADDLE_TRAINER_ENDPOINTS": ",".join(
-                f"{h}:{args.coordinator_port + i}"
-                for h in hosts for i in range(args.nproc_per_node)),
-            "PADDLE_CURRENT_ENDPOINT":
-                f"{hosts[min(args.node_rank, nnodes - 1)]}:"
-                f"{args.coordinator_port + local_rank}",
-        })
-        if world > 1:
-            env["PADDLE_COORDINATOR"] = \
-                f"{hosts[0]}:{args.coordinator_port}"
-        cmd = [sys.executable, "-u", args.training_script,
-               *args.training_script_args]
-        stdout = None
-        if args.log_dir:
-            os.makedirs(args.log_dir, exist_ok=True)
-            stdout = open(os.path.join(args.log_dir,
-                                       f"worker.{rank}.log"), "w")
-        procs.append(subprocess.Popen(cmd, env=env, stdout=stdout,
-                                      stderr=subprocess.STDOUT
-                                      if stdout else None))
+    procs = {lr: _spawn(args, hosts, nnodes, lr)
+             for lr in range(args.nproc_per_node)}
+    restarts = {lr: 0 for lr in procs}
+
+    # watch loop (reference fleet/launch.py watch_local_trainers): poll
+    # workers; restart crashed ones up to --max_restarts (they resume
+    # from their auto-checkpoint), give up past the budget.
     rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
+    while procs:
+        time.sleep(0.2)
+        for lr, p in list(procs.items()):
+            ret = p.poll()
+            if ret is None:
+                continue
+            if ret == 0:
+                del procs[lr]
+            elif restarts[lr] < args.max_restarts:
+                restarts[lr] += 1
+                print(f"[launch] worker {lr} exited rc={ret}; restart "
+                      f"{restarts[lr]}/{args.max_restarts}",
+                      file=sys.stderr)
+                procs[lr] = _spawn(args, hosts, nnodes, lr)
+            else:
+                rc = rc or ret
+                del procs[lr]
     sys.exit(rc)
 
 

@@ -251,9 +251,101 @@ class Executor:
                   f"{(time.perf_counter() - t0) * 1e3:.3f} ms")
         for name, val in zip(state_out, new_state):
             scope.set_var(name, val)
+        self._maybe_auto_checkpoint(program, scope)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    # -- auto checkpoint ----------------------------------------------------
+    def enable_auto_checkpoint(self, directory: str,
+                               interval_steps: int = 100,
+                               program=None, max_keep: int = 3):
+        """Periodic checkpoint + resume (reference incubate
+        fluid.incubate.checkpoint.auto_checkpoint + the trainer's
+        failure-recovery contract): every `interval_steps` successful
+        runs the persistable state is checkpointed; on enable, the
+        latest checkpoint (if any) is restored so a restarted process
+        continues where it died."""
+        from .. import checkpoint as ckpt
+
+        program = program or default_main_program()
+        self._auto_ckpt = {"dir": directory,
+                           "interval": max(1, int(interval_steps)),
+                           "program": program, "max_keep": max_keep}
+        step = ckpt.latest_step(directory)
+        if step is not None:
+            ckpt.load_checkpoint(directory, step, program=program)
+            self._step = int(step)
+        return step
+
+    def disable_auto_checkpoint(self):
+        self._auto_ckpt = None
+
+    def _maybe_auto_checkpoint(self, program, scope):
+        ac = getattr(self, "_auto_ckpt", None)
+        if not ac or self._step % ac["interval"]:
+            return
+        # only checkpoint runs of the bound training program: an
+        # interleaved eval-program run must not snapshot a state set
+        # without optimizer moments
+        if program is not ac["program"]:
+            return
+        from .. import checkpoint as ckpt
+
+        ckpt.save_checkpoint(ac["dir"], self._step,
+                             program=ac["program"], scope=scope)
+        self._prune_checkpoints(ac)
+
+    @staticmethod
+    def _prune_checkpoints(ac):
+        import os
+        import shutil
+
+        d = ac["dir"]
+        steps = []
+        for name in os.listdir(d):
+            base = name[:-4] if name.endswith(".pkl") else name
+            if base.isdigit():
+                steps.append((int(base), name))
+        for _step, name in sorted(steps)[:-ac["max_keep"]]:
+            path = os.path.join(d, name)
+            shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Dataset-driven training pass (reference executor.py:1642 —
+        MultiTrainer + DeviceWorker over the in-memory channel).  The
+        XLA-compiled step is the device worker; the dataset pipeline
+        streams host batches into it."""
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        from ..reader import device_prefetch
+
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        fetch_names = _fetch_names(fetch_list)
+        info = list(fetch_info or fetch_names)
+        step = 0
+        for batch in device_prefetch(dataset.batch_iter(), depth=2):
+            out = self.run(program, feed=batch,
+                           fetch_list=fetch_names or None, scope=scope)
+            step += 1
+            if debug and fetch_names and step % print_period == 0:
+                vals = " ".join(
+                    f"{n}={float(np.asarray(v).reshape(-1)[0]):.6f}"
+                    for n, v in zip(info, out))
+                print(f"step {step}: {vals}")
+        return step
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Same loop over a test-mode program (reference
+        executor.py:1554)."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
 
     def _run_debug(self, program, feed, fetch_names, scope, return_numpy):
         """check_nan_inf mode: lower op-by-op on concrete (eager) arrays

@@ -97,16 +97,20 @@ class Model:
         for epoch in range(epochs):
             for m in self._metrics:
                 m.reset()
+            n_batches = 0
             for step, batch in enumerate(loader):
                 inputs, labels = _split_batch(batch)
                 loss, pred = self.train_batch(inputs, labels)
                 history["loss"].append(loss)
+                n_batches += 1
                 self._update_metrics(pred, labels)
                 if verbose and step % log_freq == 0:
                     print(f"epoch {epoch} step {step}: loss={loss:.4f} "
                           + self._metric_str())
-            if not history["loss"]:
-                raise ValueError("fit: training data yielded no batches")
+            if not n_batches:
+                raise ValueError(
+                    f"fit: training data yielded no batches in epoch "
+                    f"{epoch} (exhausted generator?)")
             if verbose:
                 print(f"epoch {epoch} done: loss={history['loss'][-1]:.4f}"
                       f" {self._metric_str()}")

@@ -574,9 +574,9 @@ def rope(x, base=10000.0, position_offset=0, name=None):
 
 def resize_bilinear(input, out_shape=None, scale=None, name=None,
                     align_corners=True):
+    """reference layers/nn.py resize_bilinear -> bilinear_interp op."""
     if out_shape is None and scale is None:
         raise ValueError("one of out_shape / scale is required")
-    """reference layers/nn.py resize_bilinear -> bilinear_interp op."""
     helper = LayerHelper("resize_bilinear", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
     attrs = {"align_corners": align_corners}
@@ -591,6 +591,7 @@ def resize_bilinear(input, out_shape=None, scale=None, name=None,
 
 def resize_nearest(input, out_shape=None, scale=None, name=None,
                    align_corners=True):
+    """reference layers/nn.py resize_nearest -> nearest_interp op."""
     if out_shape is None and scale is None:
         raise ValueError("one of out_shape / scale is required")
     helper = LayerHelper("resize_nearest", name=name)

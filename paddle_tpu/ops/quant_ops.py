@@ -1,0 +1,102 @@
+"""Fake-quantization ops (quantization-aware training + PTQ).
+
+Reference: operators/fake_quantize_op.* — simulate int-k inference
+inside the float graph: out = round(clip(x) / scale * qmax) * scale /
+qmax, with the scale tracked per tensor (abs_max / moving average) or
+per output channel (weights).  Gradients are straight-through
+(identity), the standard QAT estimator the reference uses.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import grad_var_name
+from .registry import in_var, register_op, same_as_input, set_out
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _ste_grad_maker(fwd_op, block, helper):
+    """Straight-through estimator: d(out)/d(x) = 1."""
+    return [dict(type="assign",
+                 inputs={"X": [grad_var_name(fwd_op.single_output("Out"))]},
+                 outputs={"Out": [grad_var_name(
+                     fwd_op.single_input("X"))]},
+                 attrs={})]
+
+
+def _qdq(jnp, x, scale, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax)
+    return q * scale / qmax
+
+
+def _quant_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+    if op.output("OutScale"):
+        set_out(op, block, "OutScale", (1,), "float32",
+                persistable=bool(op.input("InScale")))
+
+
+@register_op("fake_quantize_dequantize_abs_max", infer=_quant_infer,
+             grad=_ste_grad_maker)
+def _fq_abs_max(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    bits = op.attr("bit_length", 8)
+    scale = jnp.max(jnp.abs(x))
+    ctx.set_output(op, "Out", _qdq(jnp, x, scale, bits).astype(x.dtype))
+    ctx.set_output(op, "OutScale", jnp.reshape(scale, (1,)))
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             infer=_quant_infer, grad=_ste_grad_maker,
+             stateful_outputs=("OutScale",))
+def _fq_moving(ctx, op):
+    """Activations: scale = EMA of batch abs-max (reference
+    fake_quantize_op.cc moving_average_abs_max).  In test mode the
+    stored scale is used unchanged."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    in_scale = ctx.get_input(op, "InScale")
+    bits = op.attr("bit_length", 8)
+    rate = op.attr("moving_rate", 0.9)
+    if ctx.is_test or op.attr("is_test", False):
+        scale = jnp.reshape(in_scale, ())
+        new_scale = in_scale
+    else:
+        cur = jnp.max(jnp.abs(x))
+        prev = jnp.reshape(in_scale, ())
+        # first batch adopts the observed scale (stored init 0)
+        scale = jnp.where(prev > 0, rate * prev + (1 - rate) * cur, cur)
+        new_scale = jnp.reshape(scale, (1,))
+    ctx.set_output(op, "Out", _qdq(jnp, x, scale, bits).astype(x.dtype))
+    ctx.set_output(op, "OutScale", new_scale)
+
+
+def _cw_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+    axis = op.attrs.get("quant_axis", 0)
+    set_out(op, block, "OutScale", (x.shape[axis],), "float32")
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max",
+             infer=_cw_infer, grad=_ste_grad_maker)
+def _fq_channel(ctx, op):
+    """Weights: one scale per output channel (reference
+    fake_channel_wise_quantize_*)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    bits = op.attr("bit_length", 8)
+    axis = op.attr("quant_axis", 0)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    out = _qdq(jnp, x, scale, bits)
+    ctx.set_output(op, "Out", out.astype(x.dtype))
+    ctx.set_output(op, "OutScale", jnp.reshape(scale, (-1,)))

@@ -863,7 +863,9 @@ def _axis_coords(jnp, size, out_size, align_corners):
     """Source sampling coordinates for one spatial axis (reference
     interpolate_op.h: align_corners picks corner-aligned vs half-pixel
     sampling)."""
-    if align_corners and out_size > 1:
+    if align_corners:
+        if out_size <= 1:
+            return jnp.zeros((out_size,))  # corner mapping: pixel 0
         return jnp.linspace(0.0, size - 1.0, out_size)
     c = (jnp.arange(out_size) + 0.5) * (size / out_size) - 0.5
     return jnp.clip(c, 0.0, size - 1.0)

@@ -1,0 +1,95 @@
+"""fluid 1.x transpiler compatibility surface.
+
+Reference: python/paddle/fluid/transpiler/distribute_transpiler.py:256
+(DistributeTranspiler: transpile -> get_trainer_program /
+get_pserver_program / get_startup_program).  The heavy program surgery
+maps onto the PS runtime (distributed/ps): sparse lookups become
+pulled-row feeds, dense updates move to the server, and the pserver
+"program" is the PSService the returned config describes.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..framework.core import Program, default_main_program
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig"]
+
+
+class DistributeTranspilerConfig:
+    """reference transpiler config (slice_var_up etc. — advisory here;
+    id routing is hash-based, transpiler.py:88)."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = "RoundRobin"
+        self.min_block_size = 8192
+        self.sync_mode = True
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._ctx = None
+        self._program = None
+        self._trainer_id = 0
+        self._eplist: List[str] = []
+        self._trainers = 1
+
+    def transpile(self, trainer_id: int, program: Optional[Program] = None,
+                  pservers: str = "", trainers: int = 1,
+                  sync_mode: Optional[bool] = None,
+                  startup_program: Optional[Program] = None):
+        """Rewrite `program` for PS-mode training.
+
+        Unlike the reference (which must be called AFTER minimize and
+        then performs send/recv surgery), the rewrite happens through
+        distributed/ps.transpile_to_ps; the optimizer ops already in the
+        program are partitioned by the PSContext at init_worker time.
+        """
+        from ..distributed.ps.worker import PSContext, transpile_to_ps
+        from ..framework.core import grad_var_name
+
+        program = program or default_main_program()
+        self._program = program
+        self._trainer_id = int(trainer_id)
+        self._eplist = [e for e in pservers.split(",") if e]
+        self._trainers = int(trainers)
+        sync = self.config.sync_mode if sync_mode is None else sync_mode
+
+        sections = transpile_to_ps(program)
+        block = program.global_block()
+        dense = []
+        for p in block.all_parameters():
+            g = grad_var_name(p.name)
+            if block.has_var(g):
+                dense.append((p.name, g, tuple(p.shape)))
+        self._ctx = PSContext(sections=sections, dense_params=dense,
+                              mode="sync" if sync else "async")
+        program._ps_ctx = self._ctx
+        return self
+
+    # -- reference accessors -------------------------------------------------
+    def get_trainer_program(self, wait_port=True) -> Program:
+        if self._ctx is None:
+            raise RuntimeError("call transpile() first")
+        return self._program
+
+    def get_pserver_program(self, endpoint: str):
+        """The pserver's 'program' is a service spec: the table configs
+        this endpoint serves (id-hash routing handles placement)."""
+        if self._ctx is None:
+            raise RuntimeError("call transpile() first")
+        return {"endpoint": endpoint,
+                "tables": [c.to_dict() for c in
+                           self._ctx.table_configs()],
+                "dense": [d[0] for d in self._ctx.dense_params],
+                "n_workers": self._trainers}
+
+    def get_pserver_programs(self, endpoint: str):
+        return self.get_pserver_program(endpoint), None
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        from ..framework.core import default_startup_program
+        return startup_program or default_startup_program()

@@ -1,0 +1,111 @@
+"""Dataset + train_from_dataset tests.
+
+Reference analogs: tests/unittests/test_dataset.py (InMemoryDataset /
+QueueDataset config + run) and test_executor_and_use_program_cache
+train_from_dataset paths.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+
+
+def _write_files(tmp_path, n_files=2, lines=12, seed=0):
+    """Records: '<x1,x2,x3> <label>' per line; label = 0.5*sum(x)."""
+    rng = np.random.RandomState(seed)
+    paths = []
+    for f in range(n_files):
+        path = str(tmp_path / f"part-{f}.txt")
+        with open(path, "w") as fh:
+            for _ in range(lines):
+                x = rng.rand(3)
+                y = 0.5 * x.sum()
+                fh.write(",".join(f"{v:.6f}" for v in x) +
+                         f" {y:.6f}\n")
+        paths.append(path)
+    return paths
+
+
+def _net():
+    x = layers.data("dx", [3])
+    y = layers.data("dy", [1])
+    pred = layers.fc(x, 1, name="dfc")
+    loss = layers.mean(pt.layers.square_error_cost(pred, y))
+    return x, y, loss
+
+
+def test_inmemory_dataset_load_shuffle_and_train(tmp_path):
+    files = _write_files(tmp_path)
+    x, y, loss = _net()
+    optimizer.SGDOptimizer(0.3).minimize(loss)
+
+    ds = pt.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(4)
+    ds.set_thread(2)
+    ds.set_filelist(files)
+    ds.set_use_var([x, y])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 24
+    before = [s[0].copy() for s in ds._samples[:3]]
+    ds.local_shuffle(seed=1)
+    after = [s[0] for s in ds._samples[:3]]
+    assert not all(np.array_equal(a, b) for a, b in zip(before, after))
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    l0 = float(exe.run(feed={"dx": np.stack([s[0] for s in
+                                             ds._samples[:4]]),
+                             "dy": np.stack([s[1] for s in
+                                             ds._samples[:4]])},
+                       fetch_list=[loss])[0])
+    for _epoch in range(12):
+        steps = exe.train_from_dataset(dataset=ds, fetch_list=[loss])
+    assert steps == 6  # 24 samples / batch 4
+    l1 = float(exe.run(feed={"dx": np.stack([s[0] for s in
+                                             ds._samples[:4]]),
+                             "dy": np.stack([s[1] for s in
+                                             ds._samples[:4]])},
+                       fetch_list=[loss])[0])
+    assert l1 < 0.1 * l0, (l0, l1)
+    ds.release_memory()
+    assert ds.get_memory_data_size() == 0
+
+
+def test_queue_dataset_streams(tmp_path):
+    files = _write_files(tmp_path, n_files=3, lines=8)
+    x, y, loss = _net()
+    ds = pt.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(8)
+    ds.set_filelist(files)
+    ds.set_use_var([x, y])
+    batches = list(ds.batch_iter())
+    assert len(batches) == 3  # one full batch per file
+    assert set(batches[0]) == {"dx", "dy"}
+    assert batches[0]["dx"].shape == (8, 3)
+
+
+def test_pipe_command_filters_lines(tmp_path):
+    files = _write_files(tmp_path, n_files=1, lines=10)
+    x, y, _ = _net()
+    ds = pt.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(2)
+    ds.set_filelist(files)
+    ds.set_use_var([x, y])
+    ds.set_pipe_command("head -4")  # the reference's per-file pipe
+    batches = list(ds.batch_iter())
+    assert len(batches) == 2  # 4 surviving lines / batch 2
+
+
+def test_dataset_record_arity_error(tmp_path):
+    bad = str(tmp_path / "bad.txt")
+    with open(bad, "w") as f:
+        f.write("1.0,2.0,3.0\n")  # one group, dataset uses two vars
+    x, y, _ = _net()
+    ds = pt.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_filelist([bad])
+    ds.set_use_var([x, y])
+    with pytest.raises(ValueError, match="groups"):
+        list(ds.batch_iter())

@@ -1,0 +1,137 @@
+"""Elastic training tests: auto-checkpoint resume, launcher restart of a
+crashed worker, DistributeTranspiler shim.
+
+Reference analogs: fleet elastic tests + incubate auto_checkpoint tests
++ test_dist_transpiler.py.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _net(lr=0.1):
+    x = layers.data("x", [4])
+    y = layers.data("y", [1])
+    pred = layers.fc(x, 1, name="efc")
+    loss = layers.mean(pt.layers.square_error_cost(pred, y))
+    optimizer.SGDOptimizer(lr).minimize(loss)
+    return loss
+
+
+def _feed(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(8, 4).astype("float32")
+    return {"x": x, "y": (x.sum(1, keepdims=True) * 0.5).astype("float32")}
+
+
+def test_auto_checkpoint_saves_and_resumes(tmp_path):
+    d = str(tmp_path / "ckpt")
+    loss = _net()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    assert exe.enable_auto_checkpoint(d, interval_steps=3) is None
+    feed = _feed()
+    # note: exe._step counts every run incl. the startup run
+    for _ in range(7):
+        exe.run(feed=feed, fetch_list=[loss])
+    from paddle_tpu import checkpoint as ckpt
+    assert ckpt.latest_step(d) == 6  # counter steps 3 and 6 checkpointed
+    n_train_at_ckpt = 6 - 1  # startup consumed counter step 1
+
+    # "crashed" process: fresh scope + executor resume from step 6
+    scope = pt.Scope()
+    exe2 = pt.Executor()
+    exe2.run(pt.default_startup_program(), scope=scope)
+    with pt.scope_guard(scope):
+        resumed = exe2.enable_auto_checkpoint(d, interval_steps=3)
+    assert resumed == 6
+    assert exe2._step == 6
+    w_resumed = np.asarray(scope.find_var("efc.w_0"))
+    # compare against a clean replay of the same number of train steps
+    scope3 = pt.Scope()
+    exe3 = pt.Executor()
+    exe3.run(pt.default_startup_program(), scope=scope3)
+    for _ in range(n_train_at_ckpt):
+        exe3.run(feed=feed, fetch_list=[loss], scope=scope3)
+    np.testing.assert_allclose(w_resumed,
+                               np.asarray(scope3.find_var("efc.w_0")),
+                               rtol=1e-6)
+
+
+def test_launcher_restarts_crashed_worker(tmp_path):
+    """Worker crashes on its first life, resumes from auto-checkpoint on
+    the second; the launcher's watch loop provides the restart."""
+    marker = str(tmp_path / "crashed_once")
+    ckpt_dir = str(tmp_path / "ck")
+    script = str(tmp_path / "worker.py")
+    with open(script, "w") as f:
+        f.write(textwrap.dedent(f"""
+            import os
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax; jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import paddle_tpu as pt
+            from paddle_tpu import layers, optimizer
+            x = layers.data("x", [4]); y = layers.data("y", [1])
+            loss = layers.mean(pt.layers.square_error_cost(
+                layers.fc(x, 1, name="wfc"), y))
+            optimizer.SGDOptimizer(0.1).minimize(loss)
+            exe = pt.Executor(); exe.run(pt.default_startup_program())
+            resumed = exe.enable_auto_checkpoint({ckpt_dir!r},
+                                                 interval_steps=2)
+            rng = np.random.RandomState(0)
+            feed = {{"x": rng.rand(4, 4).astype("float32"),
+                     "y": rng.rand(4, 1).astype("float32")}}
+            while exe._step < 9:
+                exe.run(feed=feed, fetch_list=[loss])
+                if exe._step == 5 and not os.path.exists({marker!r}):
+                    open({marker!r}, "w").write("x")
+                    os._exit(3)  # simulated crash mid-training
+            assert resumed is None or resumed >= 4
+            print("FINISHED at", exe._step, "resumed from", resumed)
+        """))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    log_dir = str(tmp_path / "logs")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--max_restarts", "2",
+         "--log_dir", log_dir, script],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    log = open(os.path.join(log_dir, "worker.0.log")).read()
+    assert "FINISHED at 9 resumed from 4" in log, log[-800:]
+    assert "restart 1/2" in r.stderr
+
+
+def test_distribute_transpiler_shim():
+    x = layers.data("ids", [2], dtype="int64")
+    label = layers.data("tl", [1])
+    emb = layers.embedding(x, [40, 6], is_sparse=True, param_attr="dt_w")
+    logit = layers.fc(layers.flatten(emb, axis=1), 1)
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logit, label))
+    from paddle_tpu.framework.backward import append_backward
+    append_backward(loss)
+
+    t = pt.DistributeTranspiler()
+    t.transpile(trainer_id=0, pservers="127.0.0.1:6174,127.0.0.1:6175",
+                trainers=2)
+    trainer_prog = t.get_trainer_program()
+    assert getattr(trainer_prog, "_ps_ctx", None) is not None
+    assert [s.table_name for s in trainer_prog._ps_ctx.sections] == \
+        ["dt_w"]
+    spec = t.get_pserver_program("127.0.0.1:6174")
+    assert spec["tables"][0]["name"] == "dt_w"
+    assert spec["n_workers"] == 2
+    assert t.get_startup_program() is pt.default_startup_program()
