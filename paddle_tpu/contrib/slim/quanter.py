@@ -63,11 +63,16 @@ class QuantizationTransformPass:
                     if v is None or not str(v.dtype).startswith("float"):
                         new_names.append(n)
                         continue
-                    key = (n + "@W") if slot in _WEIGHT_SLOTS else n
+                    # weight = persistable (the reference pass's check) —
+                    # slot name alone misclassifies activation-activation
+                    # matmuls (attention q@k) as weights
+                    is_weight = (slot in _WEIGHT_SLOTS
+                                 and getattr(v, "persistable", False))
+                    key = (n + "@W") if is_weight else n
                     if key not in quantized:
                         quantized[key] = self._insert(
                             block, startup, op, n,
-                            is_weight=slot in _WEIGHT_SLOTS,
+                            is_weight=is_weight,
                             is_conv="conv" in op.type,
                             act_scales=act_scales, scope=scope)
                         n_inserted += 1

@@ -525,17 +525,24 @@ def pad(x, paddings, pad_value=0.0, name=None):
 
 
 def flash_attention(q, k, v, bias=None, causal=False, scale=None,
-                    seq_parallel_mode="ring", name=None):
-    """Fused multi-head attention; q/k/v: [B, H, S, D].
+                    seq_parallel_mode="ring", impl="auto", layout="bhsd",
+                    dropout_prob=0.0, is_test=False, name=None):
+    """Fused multi-head attention; q/k/v: [B, H, S, D] (layout "bhsd")
+    or [B, S, H, D] (layout "bshd", impl="xla" only).
 
-    Lowers to the pallas TPU kernel, or ring/Ulysses attention when the
+    impl="auto": pallas TPU kernel, or ring/Ulysses attention when the
     sequence is sharded over the `sp` mesh axis (ops/attention_ops.py).
+    impl="xla": einsum formulation (XLA-fused softmax chain; supports
+    in-op probability dropout and the transpose-free bshd layout —
+    fastest at short/moderate S on v5e).
     bias: optional additive score bias [B, S] (or [B,1,1,S]) — the padding
     mask, 0 = attend / -1e4 = pad.
     """
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
-    attrs = {"causal": causal, "seq_parallel_mode": seq_parallel_mode}
+    attrs = {"causal": causal, "seq_parallel_mode": seq_parallel_mode,
+             "impl": impl, "layout": layout,
+             "dropout_prob": float(dropout_prob), "is_test": is_test}
     if scale is not None:
         attrs["scale"] = float(scale)
     inputs = {"Q": [q], "K": [k], "V": [v]}

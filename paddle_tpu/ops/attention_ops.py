@@ -38,6 +38,43 @@ def _flash_attention(ctx, op):
     sm_scale = op.attr("scale", None)
     mode = op.attr("seq_parallel_mode", "ring")
 
+    if op.attr("impl", "auto") == "xla":
+        if SP_AXIS in (getattr(ctx, "axis_names", ()) or ()):
+            raise NotImplementedError(
+                "flash_attention impl='xla' under sequence parallelism "
+                "would attend over the local shard only; use impl='auto' "
+                "(ring/Ulysses)")
+        # einsum formulation: one op for the whole scores/softmax/PV
+        # chain; layout "bshd" avoids materializing [B,h,S,d] transposes;
+        # supports additive row bias, causal, and in-op probability
+        # dropout (stateless key from the op's seed).  On v5e at S=128 it
+        # measures within ~4% of the explicit-matmul build (763 vs 792
+        # samples/s on the BERT bench) and well above the pallas kernel.
+        import jax.numpy as jnp
+
+        layout = op.attr("layout", "bhsd")
+        d = q.shape[-1]
+        scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+        eq = ("bqhd,bkhd->bhqk" if layout == "bshd"
+              else "bhqd,bhkd->bhqk")
+        s = jnp.einsum(eq, q, k) * scale
+        if bias is not None:
+            s = s + bias[:, None, None, :].astype(s.dtype)
+        if causal:
+            S = s.shape[-1]
+            s = jnp.where(jnp.tril(jnp.ones((S, S), bool))[None, None],
+                          s, jnp.asarray(-1e30, s.dtype))
+        p = jax.nn.softmax(s, axis=-1)
+        prob = op.attr("dropout_prob", 0.0)
+        if prob and not (ctx.is_test or op.attr("is_test", False)):
+            keep = jax.random.bernoulli(ctx.rng(op), 1.0 - prob, p.shape)
+            p = jnp.where(keep, p / (1.0 - prob), 0.0).astype(p.dtype)
+        eo = ("bhqk,bkhd->bqhd" if layout == "bshd"
+              else "bhqk,bhkd->bhqd")
+        out = jnp.einsum(eo, p, v)
+        ctx.set_output(op, "Out", out)
+        return
+
     axes = getattr(ctx, "axis_names", ()) or ()
     mesh = ctx.mesh
     multi_device = mesh is not None and mesh.devices.size > 1

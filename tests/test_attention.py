@@ -196,3 +196,78 @@ def test_bert_flash_matches_unfused():
                         scope=scope)
         losses.append(float(np.asarray(loss)))
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-4)
+
+
+def test_einsum_impl_matches_unfused_both_layouts():
+    """impl='xla' einsum attention == the reference matmul chain, in both
+    bhsd and the transpose-free bshd layout, incl. bias and causal."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    B, H, S, D = 2, 3, 16, 8
+    q = rng.randn(B, H, S, D).astype("float32")
+    k = rng.randn(B, H, S, D).astype("float32")
+    v = rng.randn(B, H, S, D).astype("float32")
+    bias = np.where(rng.rand(B, S) < 0.2, -1e4, 0.0).astype("float32")
+
+    def ref(q, k, v, bias, causal):
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        s = s + bias[:, None, None, :]
+        if causal:
+            s = np.where(np.tril(np.ones((S, S), bool))[None, None],
+                         s, -1e30)
+        e = np.exp(s - s.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    for causal in (False, True):
+        for layout in ("bhsd", "bshd"):
+            main, startup = pt.Program(), pt.Program()
+            startup._is_startup = True
+            with pt.program_guard(main, startup):
+                shp = [B, H, S, D] if layout == "bhsd" else [B, S, H, D]
+                qv = layers.data("q", shp, append_batch_size=False)
+                kv = layers.data("k", shp, append_batch_size=False)
+                vv = layers.data("v", shp, append_batch_size=False)
+                bv = layers.data("bias", [B, S], append_batch_size=False)
+                out = layers.flash_attention(qv, kv, vv, bias=bv,
+                                             causal=causal, impl="xla",
+                                             layout=layout, is_test=True)
+            exe = pt.Executor()
+            exe.run(startup)
+            feed_q = q if layout == "bhsd" else q.transpose(0, 2, 1, 3)
+            feed_k = k if layout == "bhsd" else k.transpose(0, 2, 1, 3)
+            feed_v = v if layout == "bhsd" else v.transpose(0, 2, 1, 3)
+            got, = exe.run(main, feed={"q": feed_q, "k": feed_k,
+                                       "v": feed_v, "bias": bias},
+                           fetch_list=[out])
+            got = np.asarray(got)
+            if layout == "bshd":
+                got = got.transpose(0, 2, 1, 3)
+            np.testing.assert_allclose(got, ref(q, k, v, bias, causal),
+                                       rtol=2e-4, atol=2e-5,
+                                       err_msg=f"{layout} causal={causal}")
+
+
+def test_einsum_impl_dropout_statistics():
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    B, H, S, D = 2, 2, 32, 8
+    qv = layers.data("q", [B, H, S, D], append_batch_size=False)
+    out = layers.flash_attention(qv, qv, qv, impl="xla",
+                                 dropout_prob=0.5)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    x = np.random.RandomState(1).rand(B, H, S, D).astype("float32")
+    o1, = exe.run(feed={"q": x}, fetch_list=[out])
+    o2, = exe.run(feed={"q": x}, fetch_list=[out])
+    # dropout active: stochastic across steps, but finite and same shape
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+    assert np.isfinite(np.asarray(o1)).all()
