@@ -7,7 +7,7 @@ Prints ONE JSON line:
 Recipe (the credible BERT pretraining setup): bf16 AMP (white-list
 autocast incl. bf16 activation stream, fp32 master weights), XLA fused
 attention (measured faster than the pallas kernel at every length on
-v5e — see BENCH_FLASH), masked-position MLM head (vocab projection on
+v5e — see BENCH_ATTN), masked-position MLM head (vocab projection on
 the P masked tokens only — the standard create_pretraining_data format),
 Adam with linear warmup + global-norm gradient clipping, input stream
 staged through the DataLoader's device-prefetch path (no cached-batch
@@ -79,6 +79,24 @@ def bert_train_flops_per_sample(seq, vocab, hidden, layers_n, inter,
     head = 2 * hidden ** 2 + 2 * hidden * vocab
     fwd = layers_n * per_layer * seq + head * n_pred
     return 3.0 * fwd
+
+
+def _attn_choice():
+    """BENCH_ATTN in {unfused, xla, pallas}; legacy BENCH_FLASH honored
+    with a deprecation note."""
+    import sys
+
+    if "BENCH_ATTN" not in os.environ and "BENCH_FLASH" in os.environ:
+        print("bench: BENCH_FLASH is deprecated; use "
+              "BENCH_ATTN={unfused,xla,pallas}", file=sys.stderr)
+        return os.environ["BENCH_FLASH"] == "1"
+    choice = os.environ.get("BENCH_ATTN", "unfused")
+    table = {"1": True, "pallas": True, "0": False, "unfused": False,
+             "xla": "xla"}
+    if choice not in table:
+        raise SystemExit(f"bench: unknown BENCH_ATTN={choice!r}; valid: "
+                         "unfused | xla | pallas")
+    return table[choice]
 
 
 def _peak_tflops(device) -> float:
@@ -179,9 +197,7 @@ def main():
                # in-op prob dropout (fastest measured); "0"/"unfused" =
                # explicit matmul chain; "1" = pallas kernel (remains for
                # ring/sequence-parallel composition)
-               use_flash={"1": True, "pallas": True, "0": False,
-                           "unfused": False, "xla": "xla"}[
-                   os.environ.get("BENCH_ATTN", "unfused")],
+               use_flash=_attn_choice(),
                dropout=float(os.environ.get("BENCH_DROPOUT", "0.1")))
     cfg["intermediate"] = 4 * cfg["hidden"]
     main_p, startup = pt.Program(), pt.Program()

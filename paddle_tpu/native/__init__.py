@@ -1,0 +1,54 @@
+"""Native (C++) runtime components, built on demand with the system
+toolchain and loaded via ctypes.
+
+Reference analog: the C++ runtime around the compute path — here the
+DataFeed record parser (framework/data_feed.cc).  Build products are
+cached next to the sources keyed by source mtime; any build failure
+falls back to the pure-Python implementations silently (the framework
+stays functional on toolchain-less machines).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB = None
+_TRIED = False
+
+
+def _build(src: str, out: str) -> bool:
+    try:
+        subprocess.run(["g++", "-O2", "-shared", "-fPIC", "-o", out, src],
+                       check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def datafeed_lib() -> Optional[ctypes.CDLL]:
+    """The datafeed parser library, building it on first use."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    src = os.path.join(_DIR, "datafeed.cc")
+    out = os.path.join(_DIR, "libdatafeed.so")
+    if (not os.path.exists(out)
+            or os.path.getmtime(out) < os.path.getmtime(src)):
+        if not _build(src, out):
+            return None
+    try:
+        lib = ctypes.CDLL(out)
+        lib.parse_records.restype = ctypes.c_long
+        lib.parse_records.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long), ctypes.c_long,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.c_long]
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
