@@ -18,6 +18,7 @@ from .framework.executor import (Executor, Scope, global_scope,  # noqa
 from .framework.backward import append_backward, gradients  # noqa
 from .framework.layer_helper import ParamAttr, WeightNormParamAttr  # noqa
 from .framework import initializer  # noqa
+from .framework import ir  # noqa
 from . import layers  # noqa
 from . import optimizer  # noqa
 from . import regularizer  # noqa

@@ -1,0 +1,88 @@
+"""IR pass framework tests (reference framework/ir/pass_test.cc,
+graph_test.cc, pattern detector tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.framework.ir import (Graph, Pass, PassRegistry,
+                                     apply_passes, get_pass,
+                                     register_pass)
+
+
+def _net():
+    x = layers.data("px", [4])
+    y = layers.data("py", [1])
+    h = layers.fc(x, 8, act="relu", name="pfc1")
+    pred = layers.fc(h, 1, name="pfc2")
+    loss = layers.mean(pt.layers.square_error_cost(pred, y))
+    return x, y, pred, loss
+
+
+def test_graph_def_use_and_chain_matching():
+    _x, _y, pred, _loss = _net()
+    g = Graph(pt.default_main_program())
+    # producer/consumer wiring
+    p = g.producer(pred.name)
+    assert p is not None and p.type == "elementwise_add"
+    mults = list(g.ops("mul"))
+    assert len(mults) == 2
+    # the fc pattern: mul -> elementwise_add -> relu
+    chains = list(g.match_chain("mul", "elementwise_add", "relu"))
+    assert len(chains) == 1  # only fc1 has the relu
+    assert [op.type for op in chains[0]] == ["mul", "elementwise_add",
+                                             "relu"]
+    # empty fetch set must be rejected, not wipe the program
+    with pytest.raises(ValueError, match="fetches"):
+        get_pass("prune_by_fetch").apply(pt.default_main_program())
+
+
+def test_custom_pass_and_registry():
+    class CountOps(Pass):
+        def apply_impl(self, program, **attrs):
+            program._op_count = len(program.global_block().ops)
+            return program
+
+    if "count_ops_test" not in PassRegistry.registered():
+        register_pass("count_ops_test")(CountOps)
+    # duplicate registration is rejected (reference REGISTER_PASS)
+    with pytest.raises(ValueError, match="already registered"):
+        register_pass("count_ops_test")(CountOps)
+
+    assert "count_ops_test" in PassRegistry.registered()
+    _net()
+    main = pt.default_main_program()
+    out = get_pass("count_ops_test").apply(main)
+    assert out is main and main._op_count > 0
+    with pytest.raises(KeyError, match="unknown pass"):
+        get_pass("nope")
+
+
+def test_builtin_pass_pipeline_prune_and_testmode():
+    x, y, pred, loss = _net()
+    optimizer.SGDOptimizer(0.1).minimize(loss)
+    main = pt.default_main_program()
+    n_before = len(main.global_block().ops)
+    # test_mode returns a clone; prune cuts to the feed->fetch subgraph
+    inference = apply_passes(main, ["test_mode", "prune_by_fetch"],
+                             feeds=["px"], fetches=[pred.name])
+    assert inference is not main
+    assert len(main.global_block().ops) == n_before  # original untouched
+    types = [op.type for op in inference.global_block().ops]
+    assert "sgd" not in types and "square_error_cost" not in str(types)
+    # pruned program serves without the label feed
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    out = exe.run(inference, feed={"px": np.ones((2, 4), "float32")},
+                  fetch_list=[pred.name])
+    assert np.asarray(out[0]).shape == (2, 1)
+
+
+def test_quant_pass_via_registry():
+    _x, _y, _pred, loss = _net()
+    optimizer.SGDOptimizer(0.1).minimize(loss)
+    main = pt.default_main_program()
+    get_pass("quantization_transform",
+             startup_program=pt.default_startup_program()).apply(main)
+    types = [op.type for op in main.global_block().ops]
+    assert any(t.startswith("fake_") for t in types)
