@@ -25,6 +25,9 @@ __all__ = [
     "exp", "log", "sqrt", "square", "reciprocal", "softplus",
     "softsign", "sin", "cos", "erf", "ceil", "floor", "round", "abs",
     "resize_bilinear", "resize_nearest", "pixel_shuffle",
+    "cos_sim", "pad2d", "expand_as", "crop_tensor", "crop",
+    "pad_constant_like", "image_resize", "space_to_depth", "norm",
+    "dist",
 ]
 
 
@@ -620,3 +623,120 @@ def pixel_shuffle(x, upscale_factor, name=None):
                      outputs={"Out": [out]},
                      attrs={"upscale_factor": int(upscale_factor)})
     return out
+
+
+def cos_sim(X, Y, name=None):
+    """Cosine similarity along the last dim (reference layers/nn.py
+    cos_sim -> cos_sim_op): composition over existing ops."""
+    from .math_op_patch import binary
+    from .tensor import _reduce_sum_dim
+
+    def _dotl(a, b):
+        return _reduce_sum_dim(binary(a, b, "elementwise_mul"),
+                               len(a.shape) - 1)
+
+    num = _dotl(X, Y)
+    den = sqrt(binary(_dotl(X, X), _dotl(Y, Y), "elementwise_mul"))
+    return binary(num, den, "elementwise_div")
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    """reference layers/nn.py pad2d: [top, bottom, left, right] on the
+    spatial dims of NCHW."""
+    t_, b_, l_, r_ = paddings
+    if data_format == "NCHW":
+        full_pads = [0, 0, 0, 0, t_, b_, l_, r_]
+    else:
+        full_pads = [0, 0, t_, b_, l_, r_, 0, 0]
+    helper = LayerHelper("pad2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("pad", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"paddings": full_pads,
+                            "pad_value": float(pad_value),
+                            "mode": mode})
+    return out
+
+
+def expand_as(x, target_tensor, name=None):
+    helper = LayerHelper("expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("expand_v2", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"shape": [int(d) for d in
+                                      target_tensor.shape]})
+    return out
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """Static crop (reference crop_tensor with list args); a shape entry
+    of -1 crops to the end of that dim."""
+    from .tensor import slice as _slice
+    if shape is None:
+        raise ValueError("crop_tensor: shape is required")
+    offsets = offsets or [0] * len(shape)
+    axes = list(range(len(shape)))
+    starts = [int(o) for o in offsets]
+    ends = []
+    for d, (o, s) in enumerate(zip(offsets, shape)):
+        if int(s) == -1:
+            ends.append(int(x.shape[d]))
+        else:
+            ends.append(int(o) + int(s))
+    return _slice(x, axes=axes, starts=starts, ends=ends)
+
+
+crop = crop_tensor
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y up to x's shape (reference pad_constant_like_op)."""
+    pads = []
+    for dx, dy in zip(x.shape, y.shape):
+        pads += [0, int(dx) - int(dy)]
+    return pad(y, pads, pad_value=pad_value, name=name)
+
+
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
+                 align_corners=True, name=None):
+    """reference layers/nn.py image_resize dispatcher."""
+    if resample.upper() == "BILINEAR":
+        return resize_bilinear(input, out_shape, scale, name,
+                               align_corners)
+    if resample.upper() == "NEAREST":
+        return resize_nearest(input, out_shape, scale, name,
+                              align_corners)
+    raise ValueError(f"unsupported resample {resample!r}")
+
+
+def space_to_depth(x, blocksize, name=None):
+    """reference space_to_depth_op: NCHW [B,C,H,W] ->
+    [B, C*b*b, H/b, W/b], composed from reshape + transpose."""
+    from .tensor import reshape as _reshape, transpose as _transpose
+    b = int(blocksize)
+    n, c, h, w = (int(d) for d in x.shape)
+    t1 = _reshape(x, [n if n > 0 else -1, c, h // b, b, w // b, b])
+    t2 = _transpose(t1, [0, 3, 5, 1, 2, 4])
+    return _reshape(t2, [n if n > 0 else -1, c * b * b, h // b, w // b])
+
+
+def norm(x, p=2, axis=-1, keepdim=False, name=None):
+    helper = LayerHelper("p_norm", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("p_norm", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"porder": float(p), "axis": int(axis),
+                            "keepdim": bool(keepdim), "epsilon": 1e-12})
+    return out
+
+
+def dist(x, y, p=2, name=None):
+    """p-norm of (x - y) over all elements (reference paddle.dist)."""
+    from .math_op_patch import binary
+    from .tensor import reshape as _reshape
+    d = binary(x, y, "elementwise_sub")
+    n = 1
+    for s in d.shape:
+        n *= int(s) if s > 0 else 1
+    flat = _reshape(d, [-1])
+    return norm(flat, p=p, axis=0)

@@ -24,6 +24,10 @@ __all__ = [
     "uniform_random", "gaussian_random", "create_tensor",
     "create_global_var", "create_parameter",
     "tril", "triu", "meshgrid", "cumprod",
+    "full", "full_like", "arange", "clamp", "strided_slice",
+    "index_select", "roll", "flip", "scatter_nd_add", "sort",
+    "logical_xor", "mm", "t", "dot", "addmm", "diag", "isfinite",
+    "has_nan", "has_inf", "shard_index",
 ]
 
 
@@ -411,7 +415,6 @@ def range(start, end, step, dtype, name=None):
     return out
 
 
-arange = range
 
 
 def linspace(start, stop, num, dtype="float32", name=None):
@@ -537,3 +540,196 @@ def cumprod(x, dim=-1, name=None):
     helper.append_op("cumprod", inputs={"X": [x]}, outputs={"Out": [out]},
                      attrs={"dim": int(dim)})
     return out
+
+
+# -- 2.0-style conveniences over existing ops (reference layers/tensor.py
+# + paddle/tensor/*): compositions only, no new lowerings ---------------
+def full(shape, fill_value, dtype="float32", name=None):
+    return fill_constant(shape, dtype, fill_value)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    helper = LayerHelper("full_like", name=name)
+    out = helper.create_variable_for_type_inference(dtype or x.dtype)
+    helper.append_op("fill_any_like", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"value": float(fill_value),
+                            "dtype": dtype or x.dtype})
+    return out
+
+
+def arange(start, end=None, step=1, dtype="int64", name=None):
+    if end is None:
+        start, end = 0, start
+    from . import tensor as T
+    return T.range(start, end, step, dtype)
+
+
+def clamp(x, min=None, max=None, name=None):
+    from .nn import clip as _clip
+    lo = float("-inf") if min is None else float(min)
+    hi = float("inf") if max is None else float(max)
+    return _clip(x, lo, hi, name=name)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    helper = LayerHelper("strided_slice", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("strided_slice", inputs={"Input": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends), "strides": list(strides)})
+    return out
+
+
+def _simple(op_type, x, out_dtype=None, name=None, **attrs):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(out_dtype or x.dtype)
+    helper.append_op(op_type, inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def index_select(x, index, axis=0, name=None):
+    helper = LayerHelper("index_select", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("index_select", inputs={"X": [x], "Index": [index]},
+                     outputs={"Out": [out]},
+                     attrs={"dim": int(axis), "axis": int(axis)})
+    return out
+
+
+def roll(x, shifts, axis=None, name=None):
+    shifts = [shifts] if isinstance(shifts, int) else list(shifts)
+    if axis is None:
+        # reference paddle.roll: flatten, roll, restore
+        flat = reshape(x, [-1])
+        rolled = _simple("roll", flat, name=name, shifts=shifts,
+                         axis=[0])
+        return reshape(rolled, [int(d) for d in x.shape])
+    axis = [axis] if isinstance(axis, int) else list(axis)
+    return _simple("roll", x, name=name, shifts=shifts, axis=axis)
+
+
+def flip(x, axis, name=None):
+    axis = [axis] if isinstance(axis, int) else list(axis)
+    return _simple("flip", x, name=name, axis=axis)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    helper = LayerHelper("scatter_nd_add", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("scatter_nd_add",
+                     inputs={"X": [x], "Index": [index],
+                             "Updates": [updates]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    """(values, indices) — reference paddle.sort/argsort pair."""
+    return argsort(x, axis=axis, descending=descending, name=name)
+
+
+def logical_xor(x, y, name=None):
+    from .math_op_patch import binary
+    return binary(x, y, "logical_xor")
+
+
+def mm(x, y, name=None):
+    from .nn import matmul
+    return matmul(x, y, name=name)
+
+
+def t(x, name=None):
+    if len(x.shape or ()) > 2:
+        raise ValueError(
+            f"t() expects a 0/1/2-D tensor, got rank {len(x.shape)} "
+            "(reference paddle.t rejects higher ranks)")
+    return transpose(x, [1, 0]) if len(x.shape) == 2 else x
+
+
+def dot(x, y, name=None):
+    helper = LayerHelper("dot", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("dot", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    from .nn import matmul
+    from .math_op_patch import binary
+    prod = matmul(x, y)
+    if alpha != 1.0:
+        prod = _scale(prod, alpha)
+    if beta != 1.0:
+        input = _scale(input, beta)
+    return binary(input, prod, "elementwise_add")
+
+
+def _scale(x, s):
+    return scale(x, scale=float(s))
+
+
+def diag(x, name=None):
+    """vector -> diagonal matrix, or matrix -> diagonal vector
+    (reference paddle.diag) — composed from eye/elementwise/reduce."""
+    from .math_op_patch import binary
+    if len(x.shape) == 1:
+        n = int(x.shape[0])
+        e = eye(n, n, dtype=x.dtype)
+        return binary(e, unsqueeze(x, [0]), "elementwise_mul")
+    e = eye(int(x.shape[0]), int(x.shape[1]), dtype=x.dtype)
+    return _reduce_sum_dim(binary(e, x, "elementwise_mul"), 1)
+
+
+def _reduce_sum_dim(x, dim):
+    helper = LayerHelper("reduce_sum")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("reduce_sum", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"dim": [dim], "keep_dim": False})
+    return out
+
+
+def _all_reduce_pred(pred_var, kind, name):
+    helper = LayerHelper(name or kind)
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(kind, inputs={"X": [pred_var]},
+                     outputs={"Out": [out]},
+                     attrs={"dim": [], "reduce_all": True})
+    return out
+
+
+def isfinite(x, name=None):
+    """True iff EVERY element is finite (reference layers.isfinite)."""
+    return _all_reduce_pred(_simple("isfinite_v2", x, out_dtype="bool"),
+                            "reduce_all", name)
+
+
+def has_nan(x, name=None):
+    return _all_reduce_pred(_simple("isnan_v2", x, out_dtype="bool"),
+                            "reduce_any", name)
+
+
+def has_inf(x, name=None):
+    return _all_reduce_pred(_simple("isinf_v2", x, out_dtype="bool"),
+                            "reduce_any", name)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Relabel global ids to shard-local ids (reference
+    layers/nn.py shard_index, used by sharded softmax classifiers):
+    ids owned by shard_id map to id - shard_id*shard_size, others to
+    ignore_value."""
+    from .math_op_patch import binary
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = fill_constant([1], input.dtype, shard_id * shard_size)
+    hi = fill_constant([1], input.dtype, (shard_id + 1) * shard_size)
+    in_shard = binary(binary(input, lo, "greater_equal"),
+                      binary(input, hi, "less_than"), "logical_and")
+    local = binary(input, lo, "elementwise_sub")
+    ignore = full_like(input, ignore_value)
+    return where(in_shard, local, ignore, name=name)

@@ -817,8 +817,15 @@ def _pad(ctx, op):
     x = ctx.get_input(op, "X")
     pads = op.attr("paddings", [])
     pairs = [(pads[2 * i], pads[2 * i + 1]) for i in range(jnp.ndim(x))]
-    ctx.set_output(op, "Out", jnp.pad(x, pairs,
-                                      constant_values=op.attr("pad_value", 0.0)))
+    mode = op.attr("mode", "constant")
+    if mode == "constant":
+        out = jnp.pad(x, pairs,
+                      constant_values=op.attr("pad_value", 0.0))
+    elif mode in ("reflect", "edge"):
+        out = jnp.pad(x, pairs, mode=mode)
+    else:
+        raise ValueError(f"pad: unsupported mode {mode!r}")
+    ctx.set_output(op, "Out", out)
 
 
 def _pad_infer(op, block):

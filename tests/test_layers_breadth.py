@@ -1,0 +1,139 @@
+"""Smoke + numeric tests for the 2.0-convenience layer batch
+(reference fluid.layers / paddle.tensor surface)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(fetches, feed=None):
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    return [np.asarray(v) for v in
+            exe.run(feed=feed or {}, fetch_list=fetches)]
+
+
+def test_creation_and_clamp():
+    a = layers.full([2, 3], 2.5)
+    b = layers.arange(1, 7, 2, dtype="float32")
+    x = layers.data("x", [3], append_batch_size=False)
+    c = layers.full_like(x, 7.0)
+    d = layers.clamp(x, min=-0.5, max=0.5)
+    fa, fb, fc, fd = _run([a, b, c, d],
+                          {"x": np.array([-1.0, 0.2, 3.0], "float32")})
+    np.testing.assert_allclose(fa, np.full((2, 3), 2.5))
+    np.testing.assert_allclose(fb, [1, 3, 5])
+    np.testing.assert_allclose(fc, [7, 7, 7])
+    np.testing.assert_allclose(fd, [-0.5, 0.2, 0.5])
+
+
+def test_indexing_and_sorting():
+    xv = np.array([[3.0, 1.0, 2.0], [6.0, 5.0, 4.0]], "float32")
+    x = layers.data("x", [2, 3], append_batch_size=False)
+    idx = layers.data("i", [2], dtype="int64", append_batch_size=False)
+    sel = layers.index_select(x, idx, axis=1)
+    rolled = layers.roll(x, 1, axis=1)
+    flipped = layers.flip(x, axis=1)
+    vals, order = layers.sort(x, axis=1)
+    ss = layers.strided_slice(x, axes=[1], starts=[0], ends=[3],
+                              strides=[2])
+    outs = _run([sel, rolled, flipped, vals, order, ss],
+                {"x": xv, "i": np.array([2, 0], "int64")})
+    np.testing.assert_allclose(outs[0], xv[:, [2, 0]])
+    np.testing.assert_allclose(outs[1], np.roll(xv, 1, 1))
+    np.testing.assert_allclose(outs[2], xv[:, ::-1])
+    np.testing.assert_allclose(outs[3], np.sort(xv, 1))
+    np.testing.assert_allclose(outs[4], np.argsort(xv, 1))
+    np.testing.assert_allclose(outs[5], xv[:, ::2])
+
+
+def test_linalg_and_diag():
+    a = np.random.RandomState(0).rand(3, 4).astype("float32")
+    b = np.random.RandomState(1).rand(4, 2).astype("float32")
+    x = layers.data("a", [3, 4], append_batch_size=False)
+    y = layers.data("b", [4, 2], append_batch_size=False)
+    base = layers.data("c", [3, 2], append_batch_size=False)
+    mm = layers.mm(x, y)
+    am = layers.addmm(base, x, y, beta=0.5, alpha=2.0)
+    tt = layers.t(y)
+    v = layers.data("v", [4], append_batch_size=False)
+    dg = layers.diag(v)
+    dgv = layers.diag(x)
+    cv = np.random.RandomState(2).rand(3, 2).astype("float32")
+    vv = np.array([1., 2., 3., 4.], "float32")
+    outs = _run([mm, am, tt, dg, dgv],
+                {"a": a, "b": b, "c": cv, "v": vv})
+    np.testing.assert_allclose(outs[0], a @ b, rtol=1e-5)
+    np.testing.assert_allclose(outs[1], 0.5 * cv + 2.0 * (a @ b),
+                               rtol=1e-5)
+    np.testing.assert_allclose(outs[2], b.T)
+    np.testing.assert_allclose(outs[3], np.diag(vv))
+    np.testing.assert_allclose(outs[4], np.diag(a), rtol=1e-6)
+
+
+def test_finite_predicates_and_shard_index():
+    x = layers.data("x", [3], append_batch_size=False)
+    fin = layers.isfinite(x)
+    hn = layers.has_nan(x)
+    hi = layers.has_inf(x)
+    ids = layers.data("ids", [4], dtype="int64", append_batch_size=False)
+    si = layers.shard_index(ids, index_num=20, nshards=2, shard_id=1)
+    outs = _run([fin, hn, hi, si],
+                {"x": np.array([1.0, np.nan, 2.0], "float32"),
+                 "ids": np.array([3, 10, 15, 19], "int64")})
+    assert bool(outs[0].reshape(-1)[0]) is False
+    assert bool(outs[1].reshape(-1)[0]) is True
+    assert bool(outs[2].reshape(-1)[0]) is False
+    np.testing.assert_array_equal(outs[3], [-1, 0, 5, 9])
+
+
+def test_nn_conveniences():
+    a = np.random.RandomState(3).rand(2, 5).astype("float32") + 0.1
+    b = np.random.RandomState(4).rand(2, 5).astype("float32") + 0.1
+    x = layers.data("x", [2, 5], append_batch_size=False)
+    y = layers.data("y", [2, 5], append_batch_size=False)
+    cs = layers.cos_sim(x, y)
+    nm = layers.norm(x, p=2, axis=1)
+    ds = layers.dist(x, y, p=2)
+    outs = _run([cs, nm, ds], {"x": a, "y": b})
+    ref_cs = (a * b).sum(1) / np.sqrt((a * a).sum(1) * (b * b).sum(1))
+    np.testing.assert_allclose(outs[0], ref_cs, rtol=1e-5)
+    np.testing.assert_allclose(outs[1], np.linalg.norm(a, axis=1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(outs[2].reshape(-1)[0],
+                               np.linalg.norm((a - b).ravel()),
+                               rtol=1e-5)
+
+
+def test_image_conveniences():
+    img = np.random.RandomState(5).rand(1, 4, 4, 4).astype("float32")
+    x = layers.data("img", [1, 4, 4, 4], append_batch_size=False)
+    p2 = layers.pad2d(x, [1, 1, 2, 2], pad_value=0.5)
+    rs = layers.image_resize(x, out_shape=[8, 8], resample="NEAREST",
+                             align_corners=False)
+    sd = layers.space_to_depth(x, 2)
+    small = layers.data("small", [1, 4, 2, 2], append_batch_size=False)
+    pcl = layers.pad_constant_like(x, small, pad_value=0.0)
+    cr = layers.crop_tensor(x, shape=[1, 4, 2, 2], offsets=[0, 0, 1, 1])
+    sv = np.ones((1, 4, 2, 2), "float32")
+    outs = _run([p2, rs, sd, pcl, cr], {"img": img, "small": sv})
+    assert outs[0].shape == (1, 4, 6, 8)
+    np.testing.assert_allclose(outs[0][:, :, 0, :], 0.5)
+    np.testing.assert_allclose(outs[1], np.repeat(np.repeat(img, 2, 2),
+                                                  2, 3))
+    assert outs[2].shape == (1, 16, 2, 2)
+    assert outs[3].shape == (1, 4, 4, 4) and outs[3][0, 0, 3, 3] == 0
+    np.testing.assert_allclose(outs[4], img[:, :, 1:3, 1:3])
+
+
+def test_expand_as_and_grads_flow():
+    from paddle_tpu import optimizer
+    x = layers.data("x", [1, 4], append_batch_size=False)
+    tgt = layers.data("t", [3, 4], append_batch_size=False)
+    e = layers.expand_as(x, tgt)
+    loss = layers.mean(layers.square_error_cost(e, tgt))
+    optimizer.SGDOptimizer(0.1).minimize(loss)  # grads flow through
+    out, = _run([e], {"x": np.ones((1, 4), "float32"),
+                      "t": np.zeros((3, 4), "float32")})
+    np.testing.assert_allclose(out, np.ones((3, 4)))
