@@ -315,6 +315,11 @@ class PServer:
         except OSError:
             pass
 
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until stop() (e.g. a client's stop_server) — the
+        pserver main loop (reference listen_and_serv RunSyncLoop)."""
+        return self._stop.wait(timeout)
+
 
 class RPCClient:
     """Client for one PServer endpoint (one persistent connection,

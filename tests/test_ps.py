@@ -407,3 +407,70 @@ def test_wide_deep_ps_trains():
     # bound, out of the 2^40 declared
     svc = fleet.fleet_instance()._ps_service
     assert 0 < svc.sparse["deep_embedding_w"].size() <= 15 * 16 * 6
+
+
+def test_ps_server_in_separate_process(tmp_path):
+    """A real multi-process PS deployment: the PServer runs in its own
+    OS process (reference: pserver nodes run listen_and_serv in separate
+    processes); the trainer connects over TCP and trains with parity to
+    the in-process path."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+    import time
+
+    port_file = str(tmp_path / "endpoint.txt")
+    server_src = textwrap.dedent(f"""
+        import numpy as np
+        from paddle_tpu.distributed.ps import (PServer, PSService,
+                                               TableConfig)
+        svc = PSService()
+        svc.create_sparse_table(TableConfig("emb_w", dim={DIM}, seed=5,
+                                            optimizer="sgd", lr=0.1))
+        svc.create_dense_table("w", np.zeros((4, 1), "float32"), lr=0.1)
+        server = PServer(svc, endpoint="127.0.0.1:0", n_workers=1)
+        server.start()
+        tmp = {port_file!r} + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(server.endpoint)
+        import os
+        os.replace(tmp, {port_file!r})  # atomic: never seen empty
+        server.wait()
+    """)
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen([sys.executable, "-c", server_src], env=env)
+    try:
+        endpoint = ""
+        for _ in range(200):
+            if os.path.exists(port_file):
+                endpoint = open(port_file).read().strip()
+                if endpoint:
+                    break
+            time.sleep(0.1)
+        assert endpoint, (f"server never published its endpoint "
+                          f"(child rc={proc.poll()})")
+        client = RPCClient(endpoint)
+        # cross-process sparse pull/push roundtrip
+        ids = np.array([7, 2**35, 7], dtype=np.int64)
+        rows = client.pull_sparse("emb_w", ids)
+        assert rows.shape == (3, DIM)
+        np.testing.assert_array_equal(rows[0], rows[2])
+        client.push_sparse("emb_w", np.array([7], np.int64),
+                           np.ones((1, DIM), "float32"))
+        rows2 = client.pull_sparse("emb_w", np.array([7], np.int64))
+        np.testing.assert_allclose(rows2[0], rows[0] - 0.1, rtol=1e-6)
+        # dense roundtrip
+        client.push_dense("w", np.ones((4, 1)))
+        np.testing.assert_allclose(client.pull_dense("w"),
+                                   -0.1 * np.ones((4, 1)))
+        client.stop_server()
+        client.close()
+        proc.wait(timeout=30)
+        assert proc.returncode == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
