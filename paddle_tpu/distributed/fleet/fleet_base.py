@@ -154,8 +154,11 @@ class Fleet:
         self._ps_service = build_service(self._ps_ctx(),
                                          scope=global_scope())
 
-    def run_server(self):
-        """Serve forever on this role's endpoint (RPC deployments)."""
+    def run_server(self, block: bool = True):
+        """Serve on this role's endpoint (RPC deployments).  Blocks until
+        a worker sends stop (reference fleet.run_server / the pserver
+        listen_and_serv loop); ``block=False`` returns the running server
+        for in-process deployments/tests."""
         from ..ps import PServer
         eps = self._role_maker.get_pserver_endpoints()
         me = eps[self.server_index()]
@@ -163,6 +166,8 @@ class Fleet:
                          n_workers=self.worker_num())
         server.start()
         self._ps_server = server
+        if block:
+            server.wait()
         return server
 
     def init_worker(self):
