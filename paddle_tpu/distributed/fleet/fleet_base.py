@@ -157,10 +157,17 @@ class Fleet:
     def run_server(self, block: bool = True):
         """Serve on this role's endpoint (RPC deployments).  Blocks until
         a worker sends stop (reference fleet.run_server / the pserver
-        listen_and_serv loop); ``block=False`` returns the running server
-        for in-process deployments/tests."""
+        listen_and_serv loop); ``block=False`` returns the running
+        server.  Needs server endpoints: in-process mode (no endpoints)
+        has no server process — init_worker builds the embedded service
+        there."""
         from ..ps import PServer
         eps = self._role_maker.get_pserver_endpoints()
+        if not eps:
+            raise RuntimeError(
+                "run_server: no pserver endpoints configured — in the "
+                "in-process deployment there is no server process; "
+                "workers use the embedded service via init_worker()")
         me = eps[self.server_index()]
         server = PServer(self._ps_service, endpoint=me,
                          n_workers=self.worker_num())
