@@ -36,6 +36,9 @@ from .reader import DataLoader, DataFeeder, batch  # noqa
 from . import inference  # noqa
 from . import profiler  # noqa
 from .flags import get_flags, set_flags  # noqa
+from . import memory  # noqa
+from . import errors  # noqa
+from .errors import EnforceNotMet, enforce  # noqa
 from . import metrics  # noqa
 from . import dataset  # noqa
 from .dataset import DatasetFactory  # noqa

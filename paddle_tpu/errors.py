@@ -1,0 +1,145 @@
+"""Structured framework errors — the PADDLE_ENFORCE layer.
+
+Reference: platform/enforce.h (PADDLE_ENFORCE_* macros raising
+EnforceNotMet with an error-code taxonomy + call-site context and a
+"summary/details" two-level message). The taxonomy below mirrors the
+reference's ErrorSummary codes (platform/error_codes list used by
+PADDLE_THROW); the context-attachment job (reference: C++ stack traces)
+is done here by `op_error_context`, which wraps an exception raised
+inside shape inference / lowering with the op's type, input/output
+shapes, and attrs — the information a user actually needs to find the
+bad op in a 10k-op program.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+__all__ = [
+    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "PermissionDeniedError",
+    "ResourceExhaustedError", "PreconditionNotMetError",
+    "UnimplementedError", "UnavailableError", "FatalError",
+    "ExecutionTimeoutError",
+    "enforce", "enforce_eq", "enforce_gt", "enforce_shape_match",
+    "op_error_context",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base of all framework errors (reference platform/enforce.h:
+    EnforceNotMet). `str(e)` carries the full context chain."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    def __str__(self):  # KeyError quotes its arg; keep plain message
+        return RuntimeError.__str__(self)
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet):
+    pass
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class FatalError(EnforceNotMet):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+def enforce(cond, msg: str, err=InvalidArgumentError):
+    """PADDLE_ENFORCE(cond, ...)."""
+    if not cond:
+        raise err(msg)
+
+
+def enforce_eq(a, b, msg: str = "", err=InvalidArgumentError):
+    if a != b:
+        raise err(f"expected {a!r} == {b!r}" + (f": {msg}" if msg else ""))
+
+
+def enforce_gt(a, b, msg: str = "", err=InvalidArgumentError):
+    if not a > b:
+        raise err(f"expected {a!r} > {b!r}" + (f": {msg}" if msg else ""))
+
+
+def enforce_shape_match(shape_a, shape_b, msg: str = ""):
+    """-1 (dynamic) dims match anything, like the reference's
+    CompatibleWith check on DDim."""
+    ok = len(shape_a) == len(shape_b) and all(
+        int(x) == int(y) or int(x) == -1 or int(y) == -1
+        for x, y in zip(shape_a, shape_b))
+    if not ok:
+        raise InvalidArgumentError(
+            f"shape mismatch {tuple(shape_a)} vs {tuple(shape_b)}"
+            + (f": {msg}" if msg else ""))
+
+
+def _op_summary(op, block=None) -> str:
+    def var_sig(name):
+        if block is None:
+            return name
+        v = block._find_var_recursive(name)
+        if v is None:
+            return f"{name}:<undefined>"
+        return f"{name}:{getattr(v, 'dtype', '?')}{list(v.shape or ())}"
+
+    ins = {slot: [var_sig(n) for n in names]
+           for slot, names in op.inputs.items()}
+    outs = {slot: list(names) for slot, names in op.outputs.items()}
+    attrs = {k: v for k, v in op.attrs.items()
+             if not k.startswith("__") and not hasattr(v, "shape")}
+    return (f"op {op.type!r} (inputs={ins}, outputs={outs}, "
+            f"attrs={attrs})")
+
+
+@contextmanager
+def op_error_context(op, block=None, phase: str = "lowering"):
+    """Wrap failures from one op's infer/lower with its signature.
+
+    EnforceNotMet subclasses pass through with the context appended;
+    foreign exceptions (jax/numpy/TypeError...) are chained into an
+    EnforceNotMet so `except EnforceNotMet` catches every framework
+    failure, like the reference catches everything into EnforceNotMet
+    at the op boundary (framework/operator.cc RunImpl try/catch).
+    """
+    try:
+        yield
+    except EnforceNotMet as e:
+        e.args = ((f"{e.args[0] if e.args else ''}\n  [operator context] "
+                   f"{phase} of {_op_summary(op, block)}"),)
+        raise
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as e:
+        raise EnforceNotMet(
+            f"{type(e).__name__}: {e}\n  [operator context] {phase} of "
+            f"{_op_summary(op, block)}") from e

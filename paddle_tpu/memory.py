@@ -1,0 +1,171 @@
+"""Device-memory introspection and allocator configuration.
+
+Reference analog: paddle/fluid/memory/allocation/allocator_facade.h:32
+(AllocatorFacade + strategy selection), memory/allocation/
+allocator_strategy.h:21 ({kNaiveBestFit, kAutoGrowth, kThreadLocal}),
+and the STAT_ADD GPU-memory counters (platform/monitor.h:77,130).
+
+On TPU the allocator itself belongs to PJRT/XLA: the runtime owns a BFC
+arena per device and XLA's buffer assignment does the within-program
+reuse the reference implements as ir memory_optimize passes.  What the
+framework owes on top — and what this module provides — is
+
+  * the *stats surface* the reference exposes through its monitor
+    counters: live/peak bytes per device, pool reservation, and a
+    framework-level peak tracker that can be reset between phases
+    (`memory_stats`, `max_memory_allocated`, `reset_peak`);
+  * the *strategy configuration* knob: PJRT's preallocation behaviour
+    (arena vs on-demand) mirrors {kNaiveBestFit chunked growth vs
+    kAutoGrowth}; it is env-driven and must be set before backend init,
+    exactly like FLAGS_allocator_strategy must precede device init in
+    the reference (`set_allocator_strategy`);
+  * an allocation probe for tests and capacity planning
+    (`device_memory_capacity`).
+
+Stats come from PJRT's per-device allocator via
+``jax.Device.memory_stats()`` when the backend provides it (TPU does;
+CPU returns None — callers get zeros there, mirroring how the reference
+reports 0 for platforms without the CUDA allocator compiled in).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+__all__ = [
+    "memory_stats",
+    "memory_allocated",
+    "max_memory_allocated",
+    "memory_reserved",
+    "device_memory_capacity",
+    "reset_peak",
+    "set_allocator_strategy",
+    "get_allocator_strategy",
+]
+
+# reference memory/allocation/allocator_strategy.h:21; the backing
+# flags (FLAGS_allocator_strategy, FLAGS_fraction_of_gpu_memory_to_use)
+# are registered once in flags.py.
+_STRATEGIES = ("naive_best_fit", "auto_growth", "thread_local")
+
+
+def set_allocator_strategy(strategy: str,
+                           memory_fraction: Optional[float] = None):
+    """Configure the device allocator. Must run before first device use.
+
+    naive_best_fit -> PJRT preallocates an arena of
+    ``memory_fraction`` of HBM (XLA_PYTHON_CLIENT_PREALLOCATE=true);
+    auto_growth / thread_local -> on-demand growth.  Mirrors
+    FLAGS_allocator_strategy + FLAGS_fraction_of_gpu_memory_to_use
+    (reference memory/allocation/allocator_facade.cc).
+    """
+    import jax
+
+    if strategy not in _STRATEGIES:
+        raise ValueError(
+            f"unknown allocator strategy {strategy!r}; expected one of "
+            f"{_STRATEGIES}")
+    from . import flags as _flags
+
+    _flags.set_flags({"FLAGS_allocator_strategy": strategy})
+    if memory_fraction is not None:
+        _flags.set_flags(
+            {"FLAGS_fraction_of_gpu_memory_to_use": float(memory_fraction)})
+    prealloc = strategy == "naive_best_fit"
+    os.environ["XLA_PYTHON_CLIENT_PREALLOCATE"] = (
+        "true" if prealloc else "false")
+    if prealloc and memory_fraction is not None:
+        os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = str(memory_fraction)
+    # if the backend is already initialized the env can no longer take
+    # effect — surface that instead of silently configuring nothing
+    # (reference enforces the same ordering via gflags-at-init).
+    backends = getattr(getattr(jax._src, "xla_bridge", None),
+                       "_backends", None)
+    if backends:  # backend already up
+        import warnings
+
+        warnings.warn(
+            "set_allocator_strategy called after device initialization; "
+            "the strategy applies to the next process, not this one")
+
+
+def get_allocator_strategy() -> str:
+    from .flags import get_flags
+
+    return get_flags(["FLAGS_allocator_strategy"])[
+        "FLAGS_allocator_strategy"]
+
+
+# framework-level peak tracking: PJRT's peak_bytes_in_use is
+# process-lifetime; phase-scoped peaks (reference resets its STAT
+# counters between epochs) need a local high-water mark.
+_peak_baseline: Dict[int, int] = {}
+
+
+def _raw_stats(device=None) -> Dict[str, int]:
+    import jax
+
+    dev = device if device is not None else jax.devices()[0]
+    stats = None
+    if hasattr(dev, "memory_stats"):
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # backend without allocator stats (CPU)
+            stats = None
+    return dict(stats or {})
+
+
+def memory_stats(device=None) -> Dict[str, int]:
+    """Full allocator stats for one device (bytes_in_use,
+    peak_bytes_in_use, bytes_limit, num_allocs, ... as provided by
+    PJRT). Empty dict on backends without stats (CPU)."""
+    return _raw_stats(device)
+
+
+def memory_allocated(device=None) -> int:
+    """Live framework-visible bytes on the device (reference
+    STAT gpu_mem counter, platform/monitor.h:130)."""
+    return int(_raw_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak bytes since process start or the last ``reset_peak``.
+
+    PJRT's peak counter is process-monotonic; after a reset the window
+    peak is the raw peak if it has grown past the reset snapshot, else
+    the current live bytes (torch's reset_peak_memory_stats sets
+    peak := current for the same reason)."""
+    import jax
+
+    dev = device if device is not None else jax.devices()[0]
+    stats = _raw_stats(dev)
+    peak = int(stats.get("peak_bytes_in_use", 0))
+    live = int(stats.get("bytes_in_use", 0))
+    baseline = _peak_baseline.get(dev.id)
+    if baseline is None:
+        return peak
+    return peak if peak > baseline else live
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes the allocator arena has reserved from the device
+    (>= allocated under naive_best_fit preallocation)."""
+    s = _raw_stats(device)
+    return int(s.get("pool_bytes", s.get("bytes_reserved",
+                                         s.get("bytes_in_use", 0))))
+
+
+def device_memory_capacity(device=None) -> int:
+    """Total HBM the allocator may use (bytes_limit)."""
+    return int(_raw_stats(device).get("bytes_limit", 0))
+
+
+def reset_peak(device=None):
+    """Start a new peak-tracking window (reference resets its monitor
+    STAT between profiling phases). PJRT's own peak counter is
+    monotonic, so the framework keeps a baseline per device."""
+    import jax
+
+    dev = device if device is not None else jax.devices()[0]
+    _peak_baseline[dev.id] = int(
+        _raw_stats(dev).get("peak_bytes_in_use", 0))

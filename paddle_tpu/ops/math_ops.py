@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..errors import InvalidArgumentError
 from ..framework.core import Block, Operator, convert_dtype, dtype_to_np
 from .registry import (LowerContext, broadcast_shapes, in_var, register_op,
                        same_as_input, set_out)
@@ -250,10 +251,17 @@ def _matmul_shape(xs, ys, tx, ty):
         xs = [1, xs[0]]
     if y1:
         ys = [ys[0], 1]
-    if tx:
+    # transpose flags are ignored for 1-D operands, matching the
+    # lowering's `ndim >= 2` condition
+    if tx and not x1:
         xs = xs[:-2] + [xs[-1], xs[-2]]
-    if ty:
+    if ty and not y1:
         ys = ys[:-2] + [ys[-1], ys[-2]]
+    if not (int(xs[-1]) == int(ys[-2]) or -1 in (int(xs[-1]),
+                                                 int(ys[-2]))):
+        raise InvalidArgumentError(
+            f"matmul contraction mismatch: X{tuple(xs)} @ Y{tuple(ys)} "
+            f"(K={xs[-1]} vs {ys[-2]})")
     batch = xs[:-2] if len(xs) >= len(ys) else ys[:-2]
     out = list(batch) + [xs[-2], ys[-1]]
     if x1:

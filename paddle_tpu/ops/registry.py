@@ -27,6 +27,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..errors import (NotFoundError, UnimplementedError,
+                      op_error_context)
 from ..framework.core import (Block, Operator, Variable, convert_dtype,
                               dtype_to_np, grad_var_name)
 
@@ -169,9 +171,10 @@ def infer_op_shape(op: Operator, block: Block):
     op.attrs.setdefault("__op_seed__", _OP_SEED[0])
     opdef = _REGISTRY.get(op.type)
     if opdef is None:
-        raise KeyError(f"cannot append unregistered op {op.type!r}")
+        raise NotFoundError(f"cannot append unregistered op {op.type!r}")
     if opdef.infer is not None:
-        opdef.infer(op, block)
+        with op_error_context(op, block, phase="shape inference"):
+            opdef.infer(op, block)
 
 
 _AMP_CASTABLE = ("float16", "bfloat16", "float32")
@@ -211,8 +214,10 @@ def _lower_with_amp(ctx: LowerContext, opdef: "OpDef", op: Operator):
 def lower_op(ctx: LowerContext, op: Operator):
     opdef = _REGISTRY.get(op.type)
     if opdef is None or opdef.lower is None:
-        raise NotImplementedError(f"no lowering for op {op.type!r}")
-    _lower_with_amp(ctx, opdef, op)
+        raise UnimplementedError(f"no lowering for op {op.type!r}")
+    with op_error_context(op, getattr(ctx, "block", None),
+                          phase="lowering"):
+        _lower_with_amp(ctx, opdef, op)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,75 @@
+"""Enforce/error layer (reference platform/enforce.h): taxonomy,
+enforce helpers, and op-context attachment at the infer/lower
+boundaries."""
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import errors
+
+
+def test_taxonomy_is_catchable_at_base():
+    for err in (errors.InvalidArgumentError, errors.NotFoundError,
+                errors.OutOfRangeError, errors.UnimplementedError,
+                errors.ResourceExhaustedError,
+                errors.PreconditionNotMetError):
+        with pytest.raises(errors.EnforceNotMet):
+            raise err("boom")
+
+
+def test_dual_inheritance_matches_python_idiom():
+    # framework code catches EnforceNotMet; user code catching the
+    # stdlib family still works (reference keeps errno-style codes)
+    with pytest.raises(ValueError):
+        raise errors.InvalidArgumentError("x")
+    with pytest.raises(NotImplementedError):
+        raise errors.UnimplementedError("x")
+    with pytest.raises(KeyError):
+        raise errors.NotFoundError("x")
+    assert str(errors.NotFoundError("no quotes")) == "no quotes"
+
+
+def test_enforce_helpers():
+    errors.enforce(True, "fine")
+    with pytest.raises(errors.InvalidArgumentError, match="bad"):
+        errors.enforce(False, "bad")
+    with pytest.raises(errors.EnforceNotMet, match="=="):
+        errors.enforce_eq(3, 4)
+    errors.enforce_shape_match((2, -1), (2, 7))
+    with pytest.raises(errors.InvalidArgumentError):
+        errors.enforce_shape_match((2, 3), (2, 4))
+
+
+def test_infer_failure_names_the_op():
+    prog = paddle_tpu.Program()
+    with paddle_tpu.program_guard(prog):
+        x = paddle_tpu.layers.data("x", shape=[4, 8], dtype="float32")
+        y = paddle_tpu.layers.data("y", shape=[5, 9], dtype="float32")
+        with pytest.raises(errors.EnforceNotMet) as ei:
+            paddle_tpu.layers.matmul(x, y)  # inner dims mismatch
+    msg = str(ei.value)
+    assert "matmul" in msg and "operator context" in msg
+
+
+def test_unregistered_op_is_not_found():
+    prog = paddle_tpu.Program()
+    with paddle_tpu.program_guard(prog):
+        block = prog.global_block()
+        with pytest.raises(errors.NotFoundError):
+            block.append_op(type="definitely_not_an_op", inputs={},
+                            outputs={}, attrs={})
+
+
+def test_lowering_failure_carries_op_context():
+    # gather with an out-of-graph dtype error at lowering time: feed a
+    # program whose lowering raises inside jax and check the wrap
+    prog = paddle_tpu.Program()
+    startup = paddle_tpu.Program()
+    with paddle_tpu.program_guard(prog, startup):
+        x = paddle_tpu.layers.data("x", shape=[2, 3], dtype="float32")
+        out = paddle_tpu.layers.reshape(x, shape=[7])  # 6 elems -> 7
+    exe = paddle_tpu.Executor()
+    with pytest.raises(errors.EnforceNotMet) as ei:
+        exe.run(prog, feed={"x": np.zeros((2, 3), np.float32)},
+                fetch_list=[out])
+    assert "reshape" in str(ei.value)

@@ -1,0 +1,50 @@
+"""Memory subsystem surface (reference memory/allocation/ + monitor STAT
+counters). On the CPU test backend PJRT reports no allocator stats, so
+the contract here is: the API exists, returns well-typed values, never
+raises, and the strategy knob round-trips + validates."""
+import pytest
+
+import paddle_tpu
+from paddle_tpu import memory
+
+
+def test_stats_api_shape():
+    stats = memory.memory_stats()
+    assert isinstance(stats, dict)
+    assert isinstance(memory.memory_allocated(), int)
+    assert isinstance(memory.max_memory_allocated(), int)
+    assert isinstance(memory.memory_reserved(), int)
+    assert isinstance(memory.device_memory_capacity(), int)
+    assert memory.memory_allocated() >= 0
+    assert memory.max_memory_allocated() >= 0
+
+
+def test_reset_peak_monotone():
+    memory.reset_peak()
+    # after a reset the windowed peak can only be >= 0 and <= the raw peak
+    raw = memory.memory_stats().get("peak_bytes_in_use", 0)
+    assert 0 <= memory.max_memory_allocated() <= max(raw, 0)
+
+
+def test_strategy_roundtrip_and_validation():
+    old = memory.get_allocator_strategy()
+    try:
+        with pytest.warns(UserWarning):
+            # backend is already up in tests -> must warn, not silently no-op
+            memory.set_allocator_strategy("naive_best_fit",
+                                          memory_fraction=0.5)
+        assert memory.get_allocator_strategy() == "naive_best_fit"
+        import os
+        assert os.environ["XLA_PYTHON_CLIENT_PREALLOCATE"] == "true"
+        with pytest.raises(ValueError):
+            memory.set_allocator_strategy("best_fit_with_coalescing")
+    finally:
+        with pytest.warns(UserWarning):
+            memory.set_allocator_strategy(old)
+
+
+def test_flags_registered():
+    got = paddle_tpu.get_flags(["FLAGS_allocator_strategy",
+                                "FLAGS_fraction_of_gpu_memory_to_use"])
+    assert set(got) == {"FLAGS_allocator_strategy",
+                       "FLAGS_fraction_of_gpu_memory_to_use"}
