@@ -18,3 +18,4 @@ from . import nn  # noqa
 from . import tensor  # noqa
 from . import loss  # noqa
 from . import metric_op  # noqa
+from . import detection  # noqa

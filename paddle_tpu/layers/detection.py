@@ -1,0 +1,202 @@
+"""Detection layers (reference python/paddle/fluid/layers/detection.py).
+
+Graph-building wrappers over the detection op family
+(ops/detection_ops.py). Output conventions differ from the reference
+only where LoD variable-length results are replaced by padded tensors +
+explicit counts (multiclass_nms returns (Out, Index, NmsRoisNum) — the
+reference multiclass_nms3 contract — instead of a LoD [No, 6] tensor).
+"""
+from __future__ import annotations
+
+from ..framework.layer_helper import LayerHelper
+
+__all__ = [
+    "iou_similarity", "box_coder", "prior_box", "anchor_generator",
+    "yolo_box", "box_clip", "bipartite_match", "roi_align", "roi_pool",
+    "multiclass_nms",
+]
+
+
+def iou_similarity(x, y, box_normalized=True):
+    """[N,4] x [M,4] -> IoU matrix [N,M] (ref fluid/layers/detection.py
+    iou_similarity; op detection/iou_similarity_op.cc)."""
+    helper = LayerHelper("iou_similarity")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"box_normalized": box_normalized})
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0):
+    """Encode targets against priors / decode deltas (ref
+    detection/box_coder_op.cc). prior_box_var: Variable, python list of
+    4 floats, or None."""
+    helper = LayerHelper("box_coder")
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if prior_box_var is None:
+        pass
+    elif isinstance(prior_box_var, (list, tuple)):
+        attrs["variance"] = [float(v) for v in prior_box_var]
+    else:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op("box_coder", inputs=inputs,
+                     outputs={"OutputBox": [out]}, attrs=attrs)
+    return out
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False):
+    """SSD prior boxes per feature-map cell (ref detection.py prior_box)."""
+    helper = LayerHelper("prior_box")
+    boxes = helper.create_variable_for_type_inference(input.dtype)
+    variances = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "prior_box", inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [variances]},
+        attrs={"min_sizes": [float(s) for s in min_sizes],
+               "max_sizes": [float(s) for s in (max_sizes or [])],
+               "aspect_ratios": [float(a) for a in aspect_ratios],
+               "variances": [float(v) for v in variance],
+               "flip": flip, "clip": clip,
+               "step_w": float(steps[0]), "step_h": float(steps[1]),
+               "offset": float(offset),
+               "min_max_aspect_ratios_order": min_max_aspect_ratios_order})
+    return boxes, variances
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5):
+    """RCNN-style anchors (ref detection.py anchor_generator)."""
+    helper = LayerHelper("anchor_generator")
+    anchors = helper.create_variable_for_type_inference(input.dtype)
+    variances = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "anchor_generator", inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [variances]},
+        attrs={"anchor_sizes": [float(s) for s in anchor_sizes],
+               "aspect_ratios": [float(a) for a in aspect_ratios],
+               "variances": [float(v) for v in variance],
+               "stride": [float(s) for s in stride],
+               "offset": float(offset)})
+    return anchors, variances
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0):
+    """Decode one YOLOv3 head (ref detection.py yolo_box)."""
+    helper = LayerHelper("yolo_box")
+    boxes = helper.create_variable_for_type_inference(x.dtype)
+    scores = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "yolo_box", inputs={"X": [x], "ImgSize": [img_size]},
+        outputs={"Boxes": [boxes], "Scores": [scores]},
+        attrs={"anchors": [int(a) for a in anchors],
+               "class_num": int(class_num),
+               "conf_thresh": float(conf_thresh),
+               "downsample_ratio": int(downsample_ratio),
+               "clip_bbox": clip_bbox, "scale_x_y": float(scale_x_y)})
+    return boxes, scores
+
+
+def box_clip(input, im_info):
+    """Clip boxes to (rounded-back) image bounds (ref box_clip_op.cc)."""
+    helper = LayerHelper("box_clip")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("box_clip",
+                     inputs={"Input": [input], "ImInfo": [im_info]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5):
+    """Greedy bipartite matching (ref bipartite_match_op.cc). Returns
+    (match_indices [1,C] int32, match_dist [1,C])."""
+    helper = LayerHelper("bipartite_match")
+    midx = helper.create_variable_for_type_inference("int32")
+    mdist = helper.create_variable_for_type_inference(dist_matrix.dtype)
+    helper.append_op(
+        "bipartite_match", inputs={"DistMat": [dist_matrix]},
+        outputs={"ColToRowMatchIndices": [midx],
+                 "ColToRowMatchDist": [mdist]},
+        attrs={"match_type": match_type,
+               "dist_threshold": float(dist_threshold)})
+    return midx, mdist
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=2, rois_num=None):
+    """RoIAlign bilinear pooling (ref roi_align_op.cc). TPU constraint:
+    sampling_ratio must be a static >= 1 (see ops/detection_ops.py)."""
+    helper = LayerHelper("roi_align")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        inputs["RoisNum"] = [rois_num]
+    helper.append_op(
+        "roi_align", inputs=inputs, outputs={"Out": [out]},
+        attrs={"pooled_height": int(pooled_height),
+               "pooled_width": int(pooled_width),
+               "spatial_scale": float(spatial_scale),
+               "sampling_ratio": int(sampling_ratio)})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None):
+    """Quantized-bin max RoI pooling (ref roi_pool_op.cc)."""
+    helper = LayerHelper("roi_pool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        inputs["RoisNum"] = [rois_num]
+    helper.append_op(
+        "roi_pool", inputs=inputs, outputs={"Out": [out]},
+        attrs={"pooled_height": int(pooled_height),
+               "pooled_width": int(pooled_width),
+               "spatial_scale": float(spatial_scale)})
+    return out
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
+                   keep_top_k, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0,
+                   return_index=True, return_rois_num=True):
+    """Per-class NMS + cross-class keep-top-k (ref multiclass_nms_op.cc).
+
+    bboxes [B,M,4], scores [B,C,M]. Returns (out [B,K,6], index [B,K],
+    rois_num [B]) — padded fixed-shape multiclass_nms3 contract; unused
+    slots have label -1."""
+    helper = LayerHelper("multiclass_nms")
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    outputs = {"Out": [out]}
+    index = rois_num = None
+    if return_index:
+        index = helper.create_variable_for_type_inference("int32")
+        outputs["Index"] = [index]
+    if return_rois_num:
+        rois_num = helper.create_variable_for_type_inference("int32")
+        outputs["NmsRoisNum"] = [rois_num]
+    helper.append_op(
+        "multiclass_nms", inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs=outputs,
+        attrs={"score_threshold": float(score_threshold),
+               "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
+               "nms_threshold": float(nms_threshold),
+               "normalized": normalized, "nms_eta": float(nms_eta),
+               "background_label": int(background_label)})
+    result = (out,)
+    if return_index:
+        result += (index,)
+    if return_rois_num:
+        result += (rois_num,)
+    return result if len(result) > 1 else out

@@ -1,0 +1,731 @@
+"""Detection op family — TPU-native rebuild of operators/detection/.
+
+Reference: paddle/fluid/operators/detection/{iou_similarity_op.h:20,
+box_coder_op.h:41,118, prior_box_op.h:95-170, anchor_generator_op.h:43,
+yolo_box_op.h:29-151, bipartite_match_op.cc:71, multiclass_nms_op.cc:139,
+box_clip_op.h + bbox_util.h:157} and operators/roi_{align,pool}_op.h.
+
+Design inversion for TPU: the reference kernels are scalar loops with
+data-dependent control flow (skip-if-below-threshold, variable-length
+LoD outputs). Here every op is a fixed-shape dense computation:
+
+  * threshold "skips" become masks (yolo_box zeroes suppressed entries —
+    exactly what the reference's memset-0-then-skip produces);
+  * variable-length NMS outputs become padded [K, ...] tensors plus an
+    explicit count (the multiclass_nms3-style Index/NmsRoisNum outputs),
+    the same masked-replacement convention as sequence_ops;
+  * greedy NMS / bipartite match run a fixed number of argmax-suppress
+    iterations under lax.fori_loop (K iterations of an O(M) vector step
+    instead of data-dependent list surgery);
+  * roi_align requires a static sampling_ratio >= 1 (the reference's
+    adaptive ceil(roi_h/ph) grid is data-dependent and cannot be a
+    static XLA shape).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..errors import InvalidArgumentError, UnimplementedError
+from .registry import in_var, register_op, set_out
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# iou_similarity
+# ---------------------------------------------------------------------------
+
+def _iou_matrix(jnp, x, y, normalized, eps=1e-10):
+    """x [N,4], y [M,4] -> [N,M] (reference iou_similarity_op.h:20)."""
+    off = 0.0 if normalized else 1.0
+    ax = (x[:, 2] - x[:, 0] + off) * (x[:, 3] - x[:, 1] + off)
+    ay = (y[:, 2] - y[:, 0] + off) * (y[:, 3] - y[:, 1] + off)
+    ix0 = jnp.maximum(x[:, None, 0], y[None, :, 0])
+    iy0 = jnp.maximum(x[:, None, 1], y[None, :, 1])
+    ix1 = jnp.minimum(x[:, None, 2], y[None, :, 2])
+    iy1 = jnp.minimum(x[:, None, 3], y[None, :, 3])
+    iw = jnp.maximum(ix1 - ix0 + off, 0.0)
+    ih = jnp.maximum(iy1 - iy0 + off, 0.0)
+    inter = iw * ih
+    return inter / (ax[:, None] + ay[None, :] - inter + eps)
+
+
+def _iou_sim_infer(op, block):
+    x = in_var(op, block, "X")
+    y = in_var(op, block, "Y")
+    set_out(op, block, "Out", (x.shape[0], y.shape[0]), x.dtype)
+
+
+@register_op("iou_similarity", infer=_iou_sim_infer, grad="auto")
+def _iou_similarity(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    normalized = op.attr("box_normalized", True)
+    ctx.set_output(op, "Out", _iou_matrix(jnp, x, y, normalized))
+
+
+# ---------------------------------------------------------------------------
+# box_coder
+# ---------------------------------------------------------------------------
+
+def _box_coder_infer(op, block):
+    t = in_var(op, block, "TargetBox")
+    p = in_var(op, block, "PriorBox")
+    code_type = op.attr("code_type", "encode_center_size")
+    if code_type == "encode_center_size":
+        out = (t.shape[0], p.shape[0], 4)
+    else:
+        out = tuple(t.shape)
+    set_out(op, block, "OutputBox", out, t.dtype)
+
+
+@register_op("box_coder", infer=_box_coder_infer, grad="auto")
+def _box_coder(ctx, op):
+    """reference box_coder_op.h:41 (EncodeCenterSize) / :118 (Decode)."""
+    jnp = _jnp()
+    t = ctx.get_input(op, "TargetBox")
+    p = ctx.get_input(op, "PriorBox")
+    pvar = (ctx.get_input(op, "PriorBoxVar")
+            if op.single_input("PriorBoxVar") else None)
+    code_type = op.attr("code_type", "encode_center_size")
+    normalized = op.attr("box_normalized", True)
+    variance = op.attr("variance", []) or []
+    axis = op.attr("axis", 0)
+    off = 0.0 if normalized else 1.0
+
+    pw = p[:, 2] - p[:, 0] + off
+    ph = p[:, 3] - p[:, 1] + off
+    pcx = p[:, 0] + pw / 2
+    pcy = p[:, 1] + ph / 2
+
+    if code_type == "encode_center_size":
+        tw = t[:, 2] - t[:, 0] + off           # [N]
+        th = t[:, 3] - t[:, 1] + off
+        tcx = (t[:, 0] + t[:, 2]) / 2
+        tcy = (t[:, 1] + t[:, 3]) / 2
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)  # [N,M,4]
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+        elif variance:
+            out = out / jnp.asarray(variance, out.dtype)
+    elif code_type == "decode_center_size":
+        # t: [N,M,4] deltas; prior per column (axis=0) or per row (axis=1)
+        expand = (lambda a: a[None, :]) if axis == 0 else \
+            (lambda a: a[:, None])
+        if pvar is not None:
+            var = pvar[None, :, :] if axis == 0 else pvar[:, None, :]
+        elif variance:
+            var = jnp.asarray(variance, t.dtype)[None, None, :]
+        else:
+            var = jnp.ones((1, 1, 4), t.dtype)
+        tcx = var[..., 0] * t[..., 0] * expand(pw) + expand(pcx)
+        tcy = var[..., 1] * t[..., 1] * expand(ph) + expand(pcy)
+        tw = jnp.exp(var[..., 2] * t[..., 2]) * expand(pw)
+        th = jnp.exp(var[..., 3] * t[..., 3]) * expand(ph)
+        out = jnp.stack([tcx - tw / 2, tcy - th / 2,
+                         tcx + tw / 2 - off, tcy + th / 2 - off], axis=-1)
+    else:
+        raise InvalidArgumentError(
+            f"box_coder: unknown code_type {code_type!r}")
+    ctx.set_output(op, "OutputBox", out)
+
+
+# ---------------------------------------------------------------------------
+# prior_box / anchor_generator  (static generators: attrs + static shapes
+# fully determine the output — XLA constant-folds the whole computation)
+# ---------------------------------------------------------------------------
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    """reference prior_box_op.h:28 ExpandAspectRatios."""
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(ar)
+        if flip:
+            out.append(1.0 / ar)
+    return out
+
+
+def _prior_box_count(op):
+    ars = _expand_aspect_ratios(op.attr("aspect_ratios", [1.0]),
+                                op.attr("flip", False))
+    n = len(ars) * len(op.attr("min_sizes", []))
+    n += len(op.attr("max_sizes", []) or [])
+    return n
+
+
+def _prior_box_infer(op, block):
+    x = in_var(op, block, "Input")
+    h, w = x.shape[2], x.shape[3]
+    n = _prior_box_count(op)
+    set_out(op, block, "Boxes", (h, w, n, 4), x.dtype)
+    set_out(op, block, "Variances", (h, w, n, 4), x.dtype)
+
+
+@register_op("prior_box", infer=_prior_box_infer)
+def _prior_box(ctx, op):
+    """reference prior_box_op.h:95-170 — SSD prior boxes, computed in
+    numpy at trace time (pure function of static shapes + attrs)."""
+    feat = ctx.get_input(op, "Input")
+    image = ctx.get_input(op, "Image")
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    min_sizes = [float(s) for s in op.attr("min_sizes", [])]
+    max_sizes = [float(s) for s in (op.attr("max_sizes", []) or [])]
+    ars = _expand_aspect_ratios(op.attr("aspect_ratios", [1.0]),
+                                op.attr("flip", False))
+    variances = op.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    clip = op.attr("clip", False)
+    step_w = op.attr("step_w", 0.0) or iw / fw
+    step_h = op.attr("step_h", 0.0) or ih / fh
+    offset = op.attr("offset", 0.5)
+    mm_order = op.attr("min_max_aspect_ratios_order", False)
+    if max_sizes and len(max_sizes) != len(min_sizes):
+        raise InvalidArgumentError(
+            f"prior_box: len(max_sizes)={len(max_sizes)} must equal "
+            f"len(min_sizes)={len(min_sizes)}")
+
+    boxes = np.zeros((fh, fw, _prior_box_count(op), 4), np.float32)
+    cx = (np.arange(fw) + offset) * step_w          # [fw]
+    cy = (np.arange(fh) + offset) * step_h          # [fh]
+    cxg, cyg = np.meshgrid(cx, cy)                  # [fh,fw]
+
+    def put(idx, bw, bh):
+        boxes[:, :, idx, 0] = (cxg - bw) / iw
+        boxes[:, :, idx, 1] = (cyg - bh) / ih
+        boxes[:, :, idx, 2] = (cxg + bw) / iw
+        boxes[:, :, idx, 3] = (cyg + bh) / ih
+
+    idx = 0
+    for s, ms in enumerate(min_sizes):
+        if mm_order:
+            put(idx, ms / 2.0, ms / 2.0)
+            idx += 1
+            if max_sizes:
+                sq = math.sqrt(ms * max_sizes[s]) / 2.0
+                put(idx, sq, sq)
+                idx += 1
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                put(idx, ms * math.sqrt(ar) / 2.0,
+                    ms / math.sqrt(ar) / 2.0)
+                idx += 1
+        else:
+            for ar in ars:
+                put(idx, ms * math.sqrt(ar) / 2.0,
+                    ms / math.sqrt(ar) / 2.0)
+                idx += 1
+            if max_sizes:
+                sq = math.sqrt(ms * max_sizes[s]) / 2.0
+                put(idx, sq, sq)
+                idx += 1
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          boxes.shape).copy()
+    jnp = _jnp()
+    ctx.set_output(op, "Boxes", jnp.asarray(boxes, feat.dtype))
+    ctx.set_output(op, "Variances", jnp.asarray(var, feat.dtype))
+
+
+def _anchor_gen_infer(op, block):
+    x = in_var(op, block, "Input")
+    h, w = x.shape[2], x.shape[3]
+    n = len(op.attr("aspect_ratios", [])) * len(op.attr("anchor_sizes", []))
+    set_out(op, block, "Anchors", (h, w, n, 4), x.dtype)
+    set_out(op, block, "Variances", (h, w, n, 4), x.dtype)
+
+
+@register_op("anchor_generator", infer=_anchor_gen_infer)
+def _anchor_generator(ctx, op):
+    """reference anchor_generator_op.h:43-85 (RCNN-style anchors)."""
+    feat = ctx.get_input(op, "Input")
+    fh, fw = feat.shape[2], feat.shape[3]
+    sizes = [float(s) for s in op.attr("anchor_sizes", [])]
+    ars = [float(a) for a in op.attr("aspect_ratios", [])]
+    variances = op.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    stride = op.attr("stride", [16.0, 16.0])
+    offset = op.attr("offset", 0.5)
+    sw, sh = float(stride[0]), float(stride[1])
+
+    n = len(ars) * len(sizes)
+    anchors = np.zeros((fh, fw, n, 4), np.float32)
+    xc = np.arange(fw) * sw + offset * (sw - 1)
+    yc = np.arange(fh) * sh + offset * (sh - 1)
+    xg, yg = np.meshgrid(xc, yc)
+    idx = 0
+    for ar in ars:
+        for size in sizes:
+            area = sw * sh
+            base_w = round(math.sqrt(area / ar))
+            base_h = round(base_w * ar)
+            aw = (size / sw) * base_w
+            ah = (size / sh) * base_h
+            anchors[:, :, idx, 0] = xg - 0.5 * (aw - 1)
+            anchors[:, :, idx, 1] = yg - 0.5 * (ah - 1)
+            anchors[:, :, idx, 2] = xg + 0.5 * (aw - 1)
+            anchors[:, :, idx, 3] = yg + 0.5 * (ah - 1)
+            idx += 1
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          anchors.shape).copy()
+    jnp = _jnp()
+    ctx.set_output(op, "Anchors", jnp.asarray(anchors, feat.dtype))
+    ctx.set_output(op, "Variances", jnp.asarray(var, feat.dtype))
+
+
+# ---------------------------------------------------------------------------
+# yolo_box
+# ---------------------------------------------------------------------------
+
+def _yolo_box_infer(op, block):
+    x = in_var(op, block, "X")
+    an_num = len(op.attr("anchors", [])) // 2
+    class_num = op.attr("class_num", 1)
+    h, w = x.shape[2], x.shape[3]
+    box_num = an_num * h * w
+    set_out(op, block, "Boxes", (x.shape[0], box_num, 4), x.dtype)
+    set_out(op, block, "Scores", (x.shape[0], box_num, class_num), x.dtype)
+
+
+@register_op("yolo_box", infer=_yolo_box_infer)
+def _yolo_box(ctx, op):
+    """reference yolo_box_op.h:82-151. The reference's skip-if-below-
+    conf_thresh writes zeros (output memset); here the same zeros come
+    from a mask."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                     # [N, an*(5+C), H, W]
+    imgsize = ctx.get_input(op, "ImgSize")         # [N, 2] (h, w)
+    anchors = np.asarray(op.attr("anchors", []), np.float32)
+    an_num = anchors.size // 2
+    C = op.attr("class_num", 1)
+    conf_thresh = op.attr("conf_thresh", 0.01)
+    downsample = op.attr("downsample_ratio", 32)
+    clip_bbox = op.attr("clip_bbox", True)
+    scale = op.attr("scale_x_y", 1.0)
+    bias = -0.5 * (scale - 1.0)
+
+    N, _, H, W = x.shape
+    in_h, in_w = downsample * H, downsample * W
+    x = x.reshape(N, an_num, 5 + C, H, W)
+    img_h = imgsize[:, 0].astype(x.dtype)[:, None, None, None]
+    img_w = imgsize[:, 1].astype(x.dtype)[:, None, None, None]
+
+    grid_x = jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    grid_y = jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    aw = jnp.asarray(anchors[0::2], x.dtype)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], x.dtype)[None, :, None, None]
+
+    sig = jax.nn.sigmoid
+    bx = (grid_x + sig(x[:, :, 0]) * scale + bias) * img_w / W
+    by = (grid_y + sig(x[:, :, 1]) * scale + bias) * img_h / H
+    bw = jnp.exp(x[:, :, 2]) * aw * img_w / in_w
+    bh = jnp.exp(x[:, :, 3]) * ah * img_h / in_h
+    conf = sig(x[:, :, 4])                        # [N,an,H,W]
+    keep = conf >= conf_thresh
+
+    x0, y0 = bx - bw / 2, by - bh / 2
+    x1, y1 = bx + bw / 2, by + bh / 2
+    if clip_bbox:
+        x0 = jnp.maximum(x0, 0.0)
+        y0 = jnp.maximum(y0, 0.0)
+        x1 = jnp.minimum(x1, img_w - 1)
+        y1 = jnp.minimum(y1, img_h - 1)
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1)   # [N,an,H,W,4]
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
+    scores = conf[..., None] * sig(
+        jnp.moveaxis(x[:, :, 5:], 2, -1))          # [N,an,H,W,C]
+    scores = jnp.where(keep[..., None], scores, 0.0)
+    ctx.set_output(op, "Boxes", boxes.reshape(N, an_num * H * W, 4))
+    ctx.set_output(op, "Scores", scores.reshape(N, an_num * H * W, C))
+
+
+# ---------------------------------------------------------------------------
+# box_clip
+# ---------------------------------------------------------------------------
+
+def _box_clip_infer(op, block):
+    x = in_var(op, block, "Input")
+    set_out(op, block, "Output", x.shape, x.dtype)
+
+
+@register_op("box_clip", infer=_box_clip_infer, grad="auto")
+def _box_clip(ctx, op):
+    """reference bbox_util.h:157 ClipTiledBoxes (is_scale=true)."""
+    jnp = _jnp()
+    boxes = ctx.get_input(op, "Input")             # [B, N, 4] or [N, 4]
+    im_info = ctx.get_input(op, "ImInfo")          # [B, 3] (h, w, scale)
+    squeeze = boxes.ndim == 2
+    if squeeze:
+        boxes = boxes[None]
+    im_h = jnp.round(im_info[:, 0] / im_info[:, 2])[:, None]
+    im_w = jnp.round(im_info[:, 1] / im_info[:, 2])[:, None]
+    out = jnp.stack([
+        jnp.clip(boxes[..., 0], 0.0, im_w - 1),
+        jnp.clip(boxes[..., 1], 0.0, im_h - 1),
+        jnp.clip(boxes[..., 2], 0.0, im_w - 1),
+        jnp.clip(boxes[..., 3], 0.0, im_h - 1),
+    ], axis=-1)
+    if squeeze:
+        out = out[0]
+    ctx.set_output(op, "Output", out)
+
+
+# ---------------------------------------------------------------------------
+# bipartite_match
+# ---------------------------------------------------------------------------
+
+def _bipartite_infer(op, block):
+    d = in_var(op, block, "DistMat")
+    set_out(op, block, "ColToRowMatchIndices", (1, d.shape[1]), "int32")
+    set_out(op, block, "ColToRowMatchDist", (1, d.shape[1]), d.dtype)
+
+
+@register_op("bipartite_match", infer=_bipartite_infer)
+def _bipartite_match(ctx, op):
+    """reference bipartite_match_op.cc:71 — greedy global-argmax
+    matching as min(R,C) fixed argmax-and-mask iterations."""
+    from jax import lax
+
+    jnp = _jnp()
+    dist = ctx.get_input(op, "DistMat")            # [R, C]
+    R, C = dist.shape
+    match_type = op.attr("match_type", "bipartite")
+    overlap_thresh = op.attr("dist_threshold", 0.5)
+
+    NEG = jnp.asarray(-1.0, dist.dtype)
+
+    def body(_, state):
+        midx, mdist, row_used, col_used = state
+        masked = jnp.where(row_used[:, None] | col_used[None, :],
+                           NEG, dist)
+        flat = jnp.argmax(masked)
+        r, c = flat // C, flat % C
+        v = masked[r, c]
+        ok = v > 0
+        midx = midx.at[c].set(jnp.where(ok, r.astype(jnp.int32),
+                                        midx[c]))
+        mdist = mdist.at[c].set(jnp.where(ok, v, mdist[c]))
+        row_used = row_used.at[r].set(row_used[r] | ok)
+        col_used = col_used.at[c].set(col_used[c] | ok)
+        return midx, mdist, row_used, col_used
+
+    init = (jnp.full((C,), -1, jnp.int32),
+            jnp.zeros((C,), dist.dtype),
+            jnp.zeros((R,), bool), jnp.zeros((C,), bool))
+    midx, mdist, _, _ = lax.fori_loop(0, min(R, C), body, init)
+
+    if match_type == "per_prediction":
+        # reference ArgMaxMatch: unmatched cols with max-dist >= thresh
+        # match their argmax row
+        col_max = dist.max(axis=0)
+        col_arg = dist.argmax(axis=0).astype(jnp.int32)
+        fill = (midx < 0) & (col_max >= overlap_thresh)
+        midx = jnp.where(fill, col_arg, midx)
+        mdist = jnp.where(fill, col_max, mdist)
+    ctx.set_output(op, "ColToRowMatchIndices", midx[None, :])
+    ctx.set_output(op, "ColToRowMatchDist", mdist[None, :])
+
+
+# ---------------------------------------------------------------------------
+# roi_align / roi_pool
+# ---------------------------------------------------------------------------
+
+def _rois_batch_ids(jnp, rois_num, R):
+    """RoisNum [B] -> batch id per roi [R] (replaces the reference's LoD
+    offsets, roi_align_op.h:210-215)."""
+    ends = jnp.cumsum(rois_num)
+    return (jnp.arange(R)[:, None] >= ends[None, :]).sum(axis=1)
+
+
+def _roi_align_infer(op, block):
+    x = in_var(op, block, "X")
+    rois = in_var(op, block, "ROIs")
+    ph = op.attr("pooled_height", 1)
+    pw = op.attr("pooled_width", 1)
+    set_out(op, block, "Out", (rois.shape[0], x.shape[1], ph, pw), x.dtype)
+
+
+@register_op("roi_align", infer=_roi_align_infer, grad="auto")
+def _roi_align(ctx, op):
+    """reference roi_align_op.h:218-275. Static sampling grid
+    (sampling_ratio >= 1) — the adaptive ceil(roi_h/ph) grid is
+    data-dependent and has no static-shape equivalent."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                     # [B, C, H, W]
+    rois = ctx.get_input(op, "ROIs")               # [R, 4]
+    ph = op.attr("pooled_height", 1)
+    pw = op.attr("pooled_width", 1)
+    scale = op.attr("spatial_scale", 1.0)
+    ratio = op.attr("sampling_ratio", -1)
+    if ratio < 1:
+        raise UnimplementedError(
+            "roi_align on TPU requires a static sampling_ratio >= 1; "
+            "the reference's adaptive grid (sampling_ratio=-1, "
+            "roi_align_op.h:231) is data-dependent shape")
+    B, Cc, H, W = x.shape
+    R = rois.shape[0]
+    if op.single_input("RoisNum"):
+        batch_ids = _rois_batch_ids(jnp, ctx.get_input(op, "RoisNum"), R)
+    elif B == 1:
+        batch_ids = jnp.zeros((R,), jnp.int32)
+    else:
+        raise InvalidArgumentError(
+            f"{op.type}: feature batch is {B} but no RoisNum input "
+            "maps rois to images (the reference carries this via the "
+            "ROIs LoD; the dense port needs RoisNum)")
+
+    xmin = rois[:, 0] * scale
+    ymin = rois[:, 1] * scale
+    roi_w = jnp.maximum(rois[:, 2] * scale - xmin, 1.0)
+    roi_h = jnp.maximum(rois[:, 3] * scale - ymin, 1.0)
+    bin_w = roi_w / pw
+    bin_h = roi_h / ph
+
+    # sample coords: [R, ph*ratio] x [R, pw*ratio]
+    iy = jnp.arange(ph * ratio)
+    ix = jnp.arange(pw * ratio)
+    ys = ymin[:, None] + bin_h[:, None] / ratio * (
+        iy[None, :] % ratio + 0.5) + (iy[None, :] // ratio) * bin_h[:, None]
+    xs = xmin[:, None] + bin_w[:, None] / ratio * (
+        ix[None, :] % ratio + 0.5) + (ix[None, :] // ratio) * bin_w[:, None]
+
+    def bilinear(img, ys, xs):
+        """img [C,H,W]; ys [Sy], xs [Sx] -> [C,Sy,Sx] (reference
+        bilinear_interpolate: out-of-range samples contribute 0)."""
+        valid_y = (ys >= -1.0) & (ys <= H * 1.0)
+        valid_x = (xs >= -1.0) & (xs <= W * 1.0)
+        y = jnp.clip(ys, 0.0, None)
+        xx = jnp.clip(xs, 0.0, None)
+        y0 = jnp.clip(jnp.floor(y).astype(jnp.int32), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+        y1 = jnp.clip(y0 + 1, 0, H - 1)
+        x1 = jnp.clip(x0 + 1, 0, W - 1)
+        ly = jnp.clip(y - y0, 0.0, 1.0)
+        lx = jnp.clip(xx - x0, 0.0, 1.0)
+        hy, hx = 1.0 - ly, 1.0 - lx
+        g = lambda yi, xi: img[:, yi][:, :, xi]    # [C,Sy,Sx]
+        val = (g(y0, x0) * (hy[:, None] * hx[None, :])
+               + g(y0, x1) * (hy[:, None] * lx[None, :])
+               + g(y1, x0) * (ly[:, None] * hx[None, :])
+               + g(y1, x1) * (ly[:, None] * lx[None, :]))
+        return val * (valid_y[:, None] & valid_x[None, :])
+
+    def per_roi(bid, ys_r, xs_r):
+        img = x[bid]                               # [C,H,W]
+        samples = bilinear(img, ys_r, xs_r)        # [C, ph*r, pw*r]
+        s = samples.reshape(Cc, ph, ratio, pw, ratio)
+        return s.mean(axis=(2, 4))                 # [C, ph, pw]
+
+    out = jax.vmap(per_roi)(batch_ids, ys, xs)
+    ctx.set_output(op, "Out", out.astype(x.dtype))
+
+
+def _roi_pool_infer(op, block):
+    x = in_var(op, block, "X")
+    rois = in_var(op, block, "ROIs")
+    ph = op.attr("pooled_height", 1)
+    pw = op.attr("pooled_width", 1)
+    set_out(op, block, "Out", (rois.shape[0], x.shape[1], ph, pw), x.dtype)
+
+
+@register_op("roi_pool", infer=_roi_pool_infer, grad="auto")
+def _roi_pool(ctx, op):
+    """reference roi_pool_op.h:95-160 — quantized-bin max pooling.
+    Dynamic [hstart,hend) ranges become masks over the (static) H x W
+    grid; empty bins produce 0 like the reference."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    rois = ctx.get_input(op, "ROIs")
+    ph = op.attr("pooled_height", 1)
+    pw = op.attr("pooled_width", 1)
+    scale = op.attr("spatial_scale", 1.0)
+    B, Cc, H, W = x.shape
+    R = rois.shape[0]
+    if op.single_input("RoisNum"):
+        batch_ids = _rois_batch_ids(jnp, ctx.get_input(op, "RoisNum"), R)
+    elif B == 1:
+        batch_ids = jnp.zeros((R,), jnp.int32)
+    else:
+        raise InvalidArgumentError(
+            f"{op.type}: feature batch is {B} but no RoisNum input "
+            "maps rois to images (the reference carries this via the "
+            "ROIs LoD; the dense port needs RoisNum)")
+
+    x0 = jnp.round(rois[:, 0] * scale).astype(jnp.int32)
+    y0 = jnp.round(rois[:, 1] * scale).astype(jnp.int32)
+    x1 = jnp.round(rois[:, 2] * scale).astype(jnp.int32)
+    y1 = jnp.round(rois[:, 3] * scale).astype(jnp.int32)
+    roi_h = jnp.maximum(y1 - y0 + 1, 1)
+    roi_w = jnp.maximum(x1 - x0 + 1, 1)
+
+    def per_roi(bid, x0r, y0r, hr, wr):
+        img = x[bid]                               # [C,H,W]
+        bh = hr.astype(jnp.float32) / ph
+        bw = wr.astype(jnp.float32) / pw
+        pidx_h = jnp.arange(ph)
+        pidx_w = jnp.arange(pw)
+        hs = jnp.clip(jnp.floor(pidx_h * bh).astype(jnp.int32) + y0r, 0, H)
+        he = jnp.clip(jnp.ceil((pidx_h + 1) * bh).astype(jnp.int32) + y0r,
+                      0, H)
+        ws = jnp.clip(jnp.floor(pidx_w * bw).astype(jnp.int32) + x0r, 0, W)
+        we = jnp.clip(jnp.ceil((pidx_w + 1) * bw).astype(jnp.int32) + x0r,
+                      0, W)
+        hh = jnp.arange(H)
+        ww = jnp.arange(W)
+        hmask = (hh[None, :] >= hs[:, None]) & (hh[None, :] < he[:, None])
+        wmask = (ww[None, :] >= ws[:, None]) & (ww[None, :] < we[:, None])
+        m = hmask[:, None, :, None] & wmask[None, :, None, :]  # [ph,pw,H,W]
+        empty = ~m.any(axis=(2, 3))                            # [ph,pw]
+        vals = jnp.where(m[None], img[:, None, None, :, :],
+                         -jnp.inf)                 # [C,ph,pw,H,W]
+        pooled = vals.max(axis=(3, 4))
+        return jnp.where(empty[None], 0.0, pooled)  # [C,ph,pw]
+
+    out = jax.vmap(per_roi)(batch_ids, x0, y0, roi_h, roi_w)
+    ctx.set_output(op, "Out", out.astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# multiclass_nms (padded multiclass_nms3-style outputs)
+# ---------------------------------------------------------------------------
+
+def _mc_nms_keep(op):
+    keep_top_k = op.attr("keep_top_k", -1)
+    nms_top_k = op.attr("nms_top_k", -1)
+    return keep_top_k, nms_top_k
+
+
+def _mc_nms_out_k(keep_top_k, nms_top_k, M, C):
+    per_class = min(nms_top_k, M) if nms_top_k > 0 else M
+    # the per-class stage can emit at most C*per_class rows — a larger
+    # keep_top_k cannot be filled, so the static K caps there
+    K = min(keep_top_k, C * per_class) if keep_top_k > 0 \
+        else C * per_class
+    return K, per_class
+
+
+def _multiclass_nms_infer(op, block):
+    b = in_var(op, block, "BBoxes")                # [B, M, 4]
+    s = in_var(op, block, "Scores")                # [B, C, M]
+    B, M = b.shape[0], b.shape[1]
+    C = s.shape[1]
+    keep_top_k, nms_top_k = _mc_nms_keep(op)
+    K, _ = _mc_nms_out_k(keep_top_k, nms_top_k, M, C)
+    set_out(op, block, "Out", (B, K, 6), b.dtype)
+    if op.output("Index"):
+        set_out(op, block, "Index", (B, K), "int32")
+    if op.output("NmsRoisNum"):
+        set_out(op, block, "NmsRoisNum", (B,), "int32")
+
+
+@register_op("multiclass_nms", infer=_multiclass_nms_infer)
+def _multiclass_nms(ctx, op):
+    """reference multiclass_nms_op.cc:139 (NMSFast) + :194. LoD output
+    [No, 6] becomes padded [B, K, 6] with label -1 in unused slots, an
+    Index into the per-image box rows, and NmsRoisNum counts (the
+    multiclass_nms3 output contract)."""
+    import jax
+    from jax import lax
+
+    jnp = _jnp()
+    bboxes = ctx.get_input(op, "BBoxes")           # [B, M, 4]
+    scores = ctx.get_input(op, "Scores")           # [B, C, M]
+    B, M = bboxes.shape[0], bboxes.shape[1]
+    C = scores.shape[1]
+    background = op.attr("background_label", 0)
+    score_thresh = op.attr("score_threshold", 0.0)
+    nms_thresh = op.attr("nms_threshold", 0.3)
+    nms_eta = op.attr("nms_eta", 1.0)
+    normalized = op.attr("normalized", True)
+    keep_top_k, nms_top_k = _mc_nms_keep(op)
+    K, per_class = _mc_nms_out_k(keep_top_k, nms_top_k, M, C)
+
+    NEG = jnp.asarray(-jnp.inf, scores.dtype)
+
+    def nms_one_class(boxes_m, scores_m):
+        """greedy NMS -> (idx [per_class], valid [per_class])."""
+        s = jnp.where(scores_m > score_thresh, scores_m, NEG)
+        if per_class < M:
+            # reference GetMaxScoreIndex keeps only the top nms_top_k
+            # candidates before NMS; index-based mask (top_k breaks ties
+            # by lower index, like the reference's stable_sort)
+            _, topi = lax.top_k(s, per_class)
+            cand = jnp.zeros((M,), bool).at[topi].set(True)
+            s = jnp.where(cand, s, NEG)
+        iou = _iou_matrix(jnp, boxes_m, boxes_m, normalized)
+
+        # Suppression is evaluated lazily each iteration against the
+        # kept set under the CURRENT adaptive threshold — the reference
+        # visits candidates in score order and tests each against the
+        # threshold at that candidate's turn (thr only shrinks on a
+        # keep), which this reproduces: every iteration picks the
+        # highest-scoring candidate whose max-IoU vs kept <= thr.
+        def body(i, state):
+            sel, val, kept, thr = state
+            supp = ((iou > thr) & kept[:, None]).any(axis=0)
+            s_ok = jnp.where(supp | kept, NEG, s)
+            j = jnp.argmax(s_ok)
+            ok = s_ok[j] > NEG
+            sel = sel.at[i].set(jnp.where(ok, j.astype(jnp.int32), -1))
+            val = val.at[i].set(ok)
+            kept = kept.at[j].set(kept[j] | ok)
+            thr = jnp.where(ok & (nms_eta < 1.0) & (thr > 0.5),
+                            thr * nms_eta, thr)
+            return sel, val, kept, thr
+
+        init = (jnp.full((per_class,), -1, jnp.int32),
+                jnp.zeros((per_class,), bool), jnp.zeros((M,), bool),
+                jnp.asarray(nms_thresh, scores.dtype))
+        sel, val, _, _ = lax.fori_loop(0, per_class, body, init)
+        return sel, val
+
+    def per_image(boxes_m, scores_cm):
+        sel, val = jax.vmap(
+            lambda s_m: nms_one_class(boxes_m, s_m))(scores_cm)
+        # mask out the background class entirely
+        if 0 <= background < C:
+            val = val.at[background].set(
+                jnp.zeros((per_class,), bool))
+        flat_idx = sel.reshape(-1)                 # [C*per_class]
+        flat_val = val.reshape(-1)
+        cls = jnp.repeat(jnp.arange(C), per_class)
+        flat_score = jnp.where(
+            flat_val,
+            scores_cm[cls, jnp.clip(flat_idx, 0, M - 1)], NEG)
+        # keep_top_k across classes
+        order = jnp.argsort(-flat_score)[:K]
+        kept_score = flat_score[order]
+        kept_valid = kept_score > NEG
+        kept_idx = jnp.where(kept_valid, flat_idx[order], -1)
+        kept_cls = jnp.where(kept_valid, cls[order], -1)
+        kept_boxes = boxes_m[jnp.clip(kept_idx, 0, M - 1)]
+        out = jnp.concatenate([
+            kept_cls.astype(boxes_m.dtype)[:, None],
+            jnp.where(kept_valid, kept_score, 0.0)[:, None],
+            jnp.where(kept_valid[:, None], kept_boxes, 0.0)], axis=1)
+        return out, kept_idx, kept_valid.sum().astype(jnp.int32)
+
+    out, index, nums = jax.vmap(per_image)(bboxes, scores)
+    ctx.set_output(op, "Out", out)
+    if op.output("Index"):
+        ctx.set_output(op, "Index", index)
+    if op.output("NmsRoisNum"):
+        ctx.set_output(op, "NmsRoisNum", nums)

@@ -1,0 +1,669 @@
+"""Detection op family vs independent numpy references.
+
+Reference test analogs: tests/unittests/test_iou_similarity_op.py,
+test_box_coder_op.py, test_prior_box_op.py, test_anchor_generator_op.py,
+test_yolo_box_op.py, test_bipartite_match_op.py, test_roi_align_op.py,
+test_roi_pool_op.py, test_multiclass_nms_op.py, test_box_clip_op.py.
+
+The numpy references below re-derive each op's semantics from the
+reference kernels (file:line cited per test) independently of the jax
+lowerings under test.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from op_test import OpCase, run_case
+
+R = np.random.RandomState
+
+
+def _run(op_type, inputs, outputs, attrs, n_out=None):
+    """Build a one-op program and run it; returns list of output arrays."""
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    feed = {}
+    with pt.program_guard(main, startup):
+        block = main.global_block()
+        in_slots = {}
+        for slot, arr in inputs.items():
+            name = f"in_{slot}"
+            block.create_var(name=name, shape=arr.shape,
+                             dtype=str(arr.dtype), is_data=True,
+                             stop_gradient=True)
+            feed[name] = arr
+            in_slots[slot] = [name]
+        out_slots = {slot: [f"out_{slot}"] for slot in outputs}
+        block.append_op(op_type, inputs=in_slots, outputs=out_slots,
+                        attrs=attrs)
+        fetch = [f"out_{slot}" for slot in outputs]
+    exe = pt.Executor()
+    res = exe.run(main, feed=feed, fetch_list=fetch)
+    return [np.asarray(r) for r in res]
+
+
+# ---------------------------------------------------------------------------
+# iou_similarity (ref iou_similarity_op.h:20)
+# ---------------------------------------------------------------------------
+
+def _np_iou(x, y, normalized, eps=1e-10):
+    off = 0.0 if normalized else 1.0
+    out = np.zeros((x.shape[0], y.shape[0]), np.float32)
+    for i, a in enumerate(x):
+        for j, b in enumerate(y):
+            a1 = (a[2] - a[0] + off) * (a[3] - a[1] + off)
+            a2 = (b[2] - b[0] + off) * (b[3] - b[1] + off)
+            iw = min(a[2], b[2]) - max(a[0], b[0]) + off
+            ih = min(a[3], b[3]) - max(a[1], b[1]) + off
+            inter = max(iw, 0.0) * max(ih, 0.0)
+            out[i, j] = inter / (a1 + a2 - inter + eps)
+    return out
+
+
+def _rand_boxes(rng, n, scale=10.0):
+    xy = rng.uniform(0, scale, (n, 2))
+    wh = rng.uniform(0.5, scale / 2, (n, 2))
+    return np.concatenate([xy, xy + wh], axis=1).astype("float32")
+
+
+@pytest.mark.parametrize("normalized", [True, False])
+def test_iou_similarity(normalized):
+    rng = R(7)
+    x, y = _rand_boxes(rng, 5), _rand_boxes(rng, 8)
+    run_case(OpCase("iou_similarity", {"X": x, "Y": y},
+                    attrs={"box_normalized": normalized},
+                    ref=lambda X, Y, box_normalized:
+                        _np_iou(X, Y, box_normalized),
+                    grad=["X"] if normalized else []))
+
+
+# ---------------------------------------------------------------------------
+# box_coder (ref box_coder_op.h:41,118)
+# ---------------------------------------------------------------------------
+
+def _np_encode(t, p, var, normalized):
+    off = 0.0 if normalized else 1.0
+    n, m = t.shape[0], p.shape[0]
+    out = np.zeros((n, m, 4), np.float32)
+    for j in range(m):
+        pw = p[j, 2] - p[j, 0] + off
+        ph = p[j, 3] - p[j, 1] + off
+        pcx, pcy = p[j, 0] + pw / 2, p[j, 1] + ph / 2
+        for i in range(n):
+            tw = t[i, 2] - t[i, 0] + off
+            th = t[i, 3] - t[i, 1] + off
+            tcx, tcy = (t[i, 0] + t[i, 2]) / 2, (t[i, 1] + t[i, 3]) / 2
+            out[i, j] = [(tcx - pcx) / pw, (tcy - pcy) / ph,
+                         np.log(abs(tw / pw)), np.log(abs(th / ph))]
+    if var is not None:
+        out = out / var[None, :, :]
+    return out
+
+
+def _np_decode(t, p, var, normalized, axis):
+    off = 0.0 if normalized else 1.0
+    out = np.zeros_like(t)
+    n, m = t.shape[0], t.shape[1]
+    for i in range(n):
+        for j in range(m):
+            k = j if axis == 0 else i
+            pw = p[k, 2] - p[k, 0] + off
+            ph = p[k, 3] - p[k, 1] + off
+            pcx, pcy = p[k, 0] + pw / 2, p[k, 1] + ph / 2
+            v = var[k] if var is not None else np.ones(4)
+            cx = v[0] * t[i, j, 0] * pw + pcx
+            cy = v[1] * t[i, j, 1] * ph + pcy
+            w = math.exp(v[2] * t[i, j, 2]) * pw
+            h = math.exp(v[3] * t[i, j, 3]) * ph
+            out[i, j] = [cx - w / 2, cy - h / 2,
+                         cx + w / 2 - off, cy + h / 2 - off]
+    return out
+
+
+@pytest.mark.parametrize("normalized", [True, False])
+def test_box_coder_encode(normalized):
+    rng = R(3)
+    t, p = _rand_boxes(rng, 6), _rand_boxes(rng, 4)
+    pvar = rng.uniform(0.1, 0.3, (4, 4)).astype("float32")
+    out, = _run("box_coder", {"TargetBox": t, "PriorBox": p,
+                              "PriorBoxVar": pvar},
+                ["OutputBox"],
+                {"code_type": "encode_center_size",
+                 "box_normalized": normalized})
+    np.testing.assert_allclose(out, _np_encode(t, p, pvar, normalized),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+def test_box_coder_decode(axis):
+    rng = R(4)
+    m = 5
+    p = _rand_boxes(rng, m)
+    n = 7 if axis == 0 else m
+    t = rng.uniform(-0.5, 0.5,
+                    (n, m if axis == 0 else m, 4)).astype("float32")
+    if axis == 1:
+        t = rng.uniform(-0.5, 0.5, (m, 9, 4)).astype("float32")
+        p = _rand_boxes(rng, m)
+    pvar = rng.uniform(0.1, 0.3, (m, 4)).astype("float32")
+    out, = _run("box_coder", {"TargetBox": t, "PriorBox": p,
+                              "PriorBoxVar": pvar},
+                ["OutputBox"],
+                {"code_type": "decode_center_size",
+                 "box_normalized": True, "axis": axis})
+    np.testing.assert_allclose(out, _np_decode(t, p, pvar, True, axis),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_box_coder_variance_attr():
+    rng = R(5)
+    t, p = _rand_boxes(rng, 3), _rand_boxes(rng, 2)
+    var = [0.1, 0.1, 0.2, 0.2]
+    out, = _run("box_coder", {"TargetBox": t, "PriorBox": p},
+                ["OutputBox"],
+                {"code_type": "encode_center_size",
+                 "box_normalized": True, "variance": var})
+    ref = _np_encode(t, p, None, True) / np.asarray(var, np.float32)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# prior_box / anchor_generator (ref prior_box_op.h:95, anchor_generator_op.h:43)
+# ---------------------------------------------------------------------------
+
+def _np_prior_box(fh, fw, ih, iw, min_sizes, max_sizes, ars_in, flip,
+                  clip, step_w, step_h, offset, mm_order):
+    ars = [1.0]
+    for ar in ars_in:
+        if any(abs(ar - a) < 1e-6 for a in ars):
+            continue
+        ars.append(ar)
+        if flip:
+            ars.append(1.0 / ar)
+    num = len(ars) * len(min_sizes) + len(max_sizes)
+    out = np.zeros((fh, fw, num, 4), np.float32)
+    sw = step_w or iw / fw
+    sh = step_h or ih / fh
+    for h in range(fh):
+        for w in range(fw):
+            cx, cy = (w + offset) * sw, (h + offset) * sh
+            prs = []
+            for s, ms in enumerate(min_sizes):
+                if mm_order:
+                    prs.append((ms / 2, ms / 2))
+                    if max_sizes:
+                        q = math.sqrt(ms * max_sizes[s]) / 2
+                        prs.append((q, q))
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        prs.append((ms * math.sqrt(ar) / 2,
+                                    ms / math.sqrt(ar) / 2))
+                else:
+                    for ar in ars:
+                        prs.append((ms * math.sqrt(ar) / 2,
+                                    ms / math.sqrt(ar) / 2))
+                    if max_sizes:
+                        q = math.sqrt(ms * max_sizes[s]) / 2
+                        prs.append((q, q))
+            for k, (bw, bh) in enumerate(prs):
+                out[h, w, k] = [(cx - bw) / iw, (cy - bh) / ih,
+                                (cx + bw) / iw, (cy + bh) / ih]
+    return np.clip(out, 0, 1) if clip else out
+
+
+@pytest.mark.parametrize("mm_order", [False, True])
+def test_prior_box(mm_order):
+    feat = np.zeros((1, 8, 4, 6), np.float32)
+    img = np.zeros((1, 3, 64, 96), np.float32)
+    attrs = {"min_sizes": [16.0, 32.0], "max_sizes": [24.0, 48.0],
+             "aspect_ratios": [2.0], "flip": True, "clip": True,
+             "variances": [0.1, 0.1, 0.2, 0.2], "step_w": 0.0,
+             "step_h": 0.0, "offset": 0.5,
+             "min_max_aspect_ratios_order": mm_order}
+    boxes, variances = _run("prior_box", {"Input": feat, "Image": img},
+                            ["Boxes", "Variances"], attrs)
+    ref = _np_prior_box(4, 6, 64, 96, [16.0, 32.0], [24.0, 48.0], [2.0],
+                        True, True, 0.0, 0.0, 0.5, mm_order)
+    np.testing.assert_allclose(boxes, ref, rtol=1e-5, atol=1e-5)
+    assert variances.shape == boxes.shape
+    np.testing.assert_allclose(variances[2, 3, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_anchor_generator():
+    feat = np.zeros((1, 8, 3, 5), np.float32)
+    sizes, ars, stride, offset = [32.0, 64.0], [0.5, 1.0], [16.0, 16.0], 0.5
+    anchors, variances = _run(
+        "anchor_generator", {"Input": feat}, ["Anchors", "Variances"],
+        {"anchor_sizes": sizes, "aspect_ratios": ars,
+         "variances": [0.1, 0.1, 0.2, 0.2], "stride": stride,
+         "offset": offset})
+    # ref anchor_generator_op.h:43-85
+    ref = np.zeros((3, 5, 4, 4), np.float32)
+    for h in range(3):
+        for w in range(5):
+            xc = w * 16.0 + offset * 15.0
+            yc = h * 16.0 + offset * 15.0
+            idx = 0
+            for ar in ars:
+                for size in sizes:
+                    base_w = round(math.sqrt(16.0 * 16.0 / ar))
+                    base_h = round(base_w * ar)
+                    aw = size / 16.0 * base_w
+                    ah = size / 16.0 * base_h
+                    ref[h, w, idx] = [xc - 0.5 * (aw - 1),
+                                      yc - 0.5 * (ah - 1),
+                                      xc + 0.5 * (aw - 1),
+                                      yc + 0.5 * (ah - 1)]
+                    idx += 1
+    np.testing.assert_allclose(anchors, ref, rtol=1e-5, atol=1e-4)
+    assert variances.shape == anchors.shape
+
+
+# ---------------------------------------------------------------------------
+# yolo_box (ref yolo_box_op.h:82-151)
+# ---------------------------------------------------------------------------
+
+def _np_yolo_box(x, imgsize, anchors, class_num, conf_thresh,
+                 downsample, clip_bbox, scale):
+    bias = -0.5 * (scale - 1.0)
+    n, _, h, w = x.shape
+    an_num = len(anchors) // 2
+    in_h, in_w = downsample * h, downsample * w
+    x = x.reshape(n, an_num, 5 + class_num, h, w)
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    boxes = np.zeros((n, an_num, h, w, 4), np.float32)
+    scores = np.zeros((n, an_num, h, w, class_num), np.float32)
+    for i in range(n):
+        img_h, img_w = imgsize[i]
+        for j in range(an_num):
+            for k in range(h):
+                for l in range(w):
+                    conf = sig(x[i, j, 4, k, l])
+                    if conf < conf_thresh:
+                        continue
+                    bx = (l + sig(x[i, j, 0, k, l]) * scale + bias) \
+                        * img_w / w
+                    by = (k + sig(x[i, j, 1, k, l]) * scale + bias) \
+                        * img_h / h
+                    bw = math.exp(x[i, j, 2, k, l]) * anchors[2 * j] \
+                        * img_w / in_w
+                    bh = math.exp(x[i, j, 3, k, l]) \
+                        * anchors[2 * j + 1] * img_h / in_h
+                    b = [bx - bw / 2, by - bh / 2,
+                         bx + bw / 2, by + bh / 2]
+                    if clip_bbox:
+                        b = [max(b[0], 0), max(b[1], 0),
+                             min(b[2], img_w - 1), min(b[3], img_h - 1)]
+                    boxes[i, j, k, l] = b
+                    scores[i, j, k, l] = conf * sig(x[i, j, 5:, k, l])
+    return (boxes.reshape(n, -1, 4), scores.reshape(n, -1, class_num))
+
+
+def test_yolo_box():
+    rng = R(11)
+    anchors = [10, 13, 16, 30]
+    class_num, h, w = 3, 4, 5
+    x = rng.uniform(-2, 2, (2, 2 * (5 + class_num), h, w)) \
+        .astype("float32")
+    imgsize = np.array([[64, 96], [60, 80]], np.int32)
+    boxes, scores = _run(
+        "yolo_box", {"X": x, "ImgSize": imgsize}, ["Boxes", "Scores"],
+        {"anchors": anchors, "class_num": class_num, "conf_thresh": 0.5,
+         "downsample_ratio": 16, "clip_bbox": True, "scale_x_y": 1.2})
+    rb, rs = _np_yolo_box(x, imgsize, anchors, class_num, 0.5, 16, True,
+                          1.2)
+    np.testing.assert_allclose(boxes, rb, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(scores, rs, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# box_clip (ref bbox_util.h:157)
+# ---------------------------------------------------------------------------
+
+def test_box_clip():
+    rng = R(13)
+    boxes = rng.uniform(-5, 120, (2, 6, 4)).astype("float32")
+    im_info = np.array([[60.0, 80.0, 1.0], [30.0, 40.0, 0.5]],
+                       np.float32)
+    out, = _run("box_clip", {"Input": boxes, "ImInfo": im_info},
+                ["Output"], {})
+    for b in range(2):
+        im_h = round(im_info[b, 0] / im_info[b, 2])
+        im_w = round(im_info[b, 1] / im_info[b, 2])
+        exp = boxes[b].copy()
+        exp[:, 0] = np.clip(exp[:, 0], 0, im_w - 1)
+        exp[:, 1] = np.clip(exp[:, 1], 0, im_h - 1)
+        exp[:, 2] = np.clip(exp[:, 2], 0, im_w - 1)
+        exp[:, 3] = np.clip(exp[:, 3], 0, im_h - 1)
+        np.testing.assert_allclose(out[b], exp, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# bipartite_match (ref bipartite_match_op.cc:71)
+# ---------------------------------------------------------------------------
+
+def _np_bipartite(dist, match_type, thresh):
+    r, c = dist.shape
+    midx = np.full(c, -1, np.int32)
+    mdist = np.zeros(c, np.float32)
+    row_used = np.zeros(r, bool)
+    d = dist.copy()
+    for _ in range(min(r, c)):
+        m = d.copy()
+        m[row_used, :] = -1
+        m[:, midx >= 0] = -1
+        i, j = np.unravel_index(np.argmax(m), m.shape)
+        if m[i, j] <= 0:
+            break
+        midx[j] = i
+        mdist[j] = dist[i, j]
+        row_used[i] = True
+    if match_type == "per_prediction":
+        for j in range(c):
+            if midx[j] < 0 and dist[:, j].max() >= thresh:
+                midx[j] = dist[:, j].argmax()
+                mdist[j] = dist[:, j].max()
+    return midx, mdist
+
+
+@pytest.mark.parametrize("match_type", ["bipartite", "per_prediction"])
+def test_bipartite_match(match_type):
+    rng = R(17)
+    # distinct values avoid argmax tie ambiguity between impls
+    dist = rng.permutation(20 * 12).reshape(20, 12) / (20.0 * 12.0)
+    dist = dist.astype("float32")
+    midx, mdist = _run("bipartite_match", {"DistMat": dist},
+                       ["ColToRowMatchIndices", "ColToRowMatchDist"],
+                       {"match_type": match_type, "dist_threshold": 0.5})
+    ri, rd = _np_bipartite(dist, match_type, 0.5)
+    np.testing.assert_array_equal(midx[0], ri)
+    np.testing.assert_allclose(mdist[0], rd, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# roi_align / roi_pool (ref roi_align_op.h:218, roi_pool_op.h:95)
+# ---------------------------------------------------------------------------
+
+def _np_roi_align(x, rois, batch_ids, ph, pw, scale, ratio):
+    B, C, H, W = x.shape
+    out = np.zeros((rois.shape[0], C, ph, pw), np.float32)
+
+    def bil(img, y, xx):
+        if y < -1.0 or y > H or xx < -1.0 or xx > W:
+            return np.zeros(C, np.float32)
+        y, xx = max(y, 0.0), max(xx, 0.0)
+        y0, x0 = min(int(y), H - 1), min(int(xx), W - 1)
+        y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+        ly, lx = min(y - y0, 1.0), min(xx - x0, 1.0)
+        return (img[:, y0, x0] * (1 - ly) * (1 - lx)
+                + img[:, y0, x1] * (1 - ly) * lx
+                + img[:, y1, x0] * ly * (1 - lx)
+                + img[:, y1, x1] * ly * lx)
+
+    for n, roi in enumerate(rois):
+        img = x[batch_ids[n]]
+        xmin, ymin = roi[0] * scale, roi[1] * scale
+        rw = max(roi[2] * scale - xmin, 1.0)
+        rh = max(roi[3] * scale - ymin, 1.0)
+        bw, bh = rw / pw, rh / ph
+        for i in range(ph):
+            for j in range(pw):
+                acc = np.zeros(C, np.float32)
+                for iy in range(ratio):
+                    for ix in range(ratio):
+                        yy = ymin + i * bh + bh / ratio * (iy + 0.5)
+                        xx = xmin + j * bw + bw / ratio * (ix + 0.5)
+                        acc += bil(img, yy, xx)
+                out[n, :, i, j] = acc / (ratio * ratio)
+    return out
+
+
+def test_roi_align():
+    rng = R(19)
+    x = rng.uniform(-1, 1, (2, 3, 8, 8)).astype("float32")
+    rois = np.array([[1.1, 1.3, 6.2, 5.7], [0.4, 2.1, 7.3, 7.8],
+                     [2.2, 0.3, 5.1, 6.6]], np.float32)
+    rois_num = np.array([2, 1], np.int32)
+    out, = _run("roi_align",
+                {"X": x, "ROIs": rois, "RoisNum": rois_num}, ["Out"],
+                {"pooled_height": 3, "pooled_width": 3,
+                 "spatial_scale": 0.5, "sampling_ratio": 2})
+    ref = _np_roi_align(x, rois, [0, 0, 1], 3, 3, 0.5, 2)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_align_grad_linear_in_x():
+    """out is linear in X (fixed bilinear weights given rois): the auto
+    vjp grad wrt X must match finite differences tightly."""
+    rng = R(23)
+    x = rng.uniform(-1, 1, (1, 2, 6, 6)).astype("float32")
+    rois = np.array([[0.7, 0.9, 4.3, 4.1]], np.float32)
+    run_case(OpCase(
+        "roi_align", {"X": x, "ROIs": rois},
+        attrs={"pooled_height": 2, "pooled_width": 2,
+               "spatial_scale": 1.0, "sampling_ratio": 2},
+        ref=lambda X, ROIs, **a: _np_roi_align(
+            X, ROIs, [0], 2, 2, 1.0, 2),
+        grad=["X"]))
+
+
+def test_roi_align_adaptive_ratio_rejected():
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    rois = np.zeros((1, 4), np.float32)
+    with pytest.raises(pt.errors.EnforceNotMet, match="sampling_ratio"):
+        _run("roi_align", {"X": x, "ROIs": rois}, ["Out"],
+             {"pooled_height": 2, "pooled_width": 2,
+              "spatial_scale": 1.0, "sampling_ratio": -1})
+
+
+def _np_roi_pool(x, rois, batch_ids, ph, pw, scale):
+    B, C, H, W = x.shape
+    out = np.zeros((rois.shape[0], C, ph, pw), np.float32)
+    for n, roi in enumerate(rois):
+        img = x[batch_ids[n]]
+        x0 = int(round(roi[0] * scale))
+        y0 = int(round(roi[1] * scale))
+        x1 = int(round(roi[2] * scale))
+        y1 = int(round(roi[3] * scale))
+        rh, rw = max(y1 - y0 + 1, 1), max(x1 - x0 + 1, 1)
+        bh, bw = rh / ph, rw / pw
+        for i in range(ph):
+            for j in range(pw):
+                hs = min(max(int(np.floor(i * bh)) + y0, 0), H)
+                he = min(max(int(np.ceil((i + 1) * bh)) + y0, 0), H)
+                ws = min(max(int(np.floor(j * bw)) + x0, 0), W)
+                we = min(max(int(np.ceil((j + 1) * bw)) + x0, 0), W)
+                if he <= hs or we <= ws:
+                    out[n, :, i, j] = 0.0
+                else:
+                    out[n, :, i, j] = img[:, hs:he, ws:we].max(
+                        axis=(1, 2))
+    return out
+
+
+def test_roi_pool():
+    rng = R(29)
+    x = rng.uniform(-1, 1, (2, 3, 8, 8)).astype("float32")
+    rois = np.array([[1.0, 1.0, 6.0, 5.0], [0.0, 2.0, 7.0, 7.0],
+                     [2.0, 0.0, 5.0, 6.0]], np.float32)
+    rois_num = np.array([1, 2], np.int32)
+    out, = _run("roi_pool", {"X": x, "ROIs": rois, "RoisNum": rois_num},
+                ["Out"],
+                {"pooled_height": 2, "pooled_width": 2,
+                 "spatial_scale": 1.0})
+    ref = _np_roi_pool(x, rois, [0, 1, 1], 2, 2, 1.0)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# multiclass_nms (ref multiclass_nms_op.cc:139,194)
+# ---------------------------------------------------------------------------
+
+def _np_nms_one(boxes, scores, score_thresh, nms_thresh, top_k, eta,
+                normalized):
+    cand = [i for i in np.argsort(-scores, kind="stable")
+            if scores[i] > score_thresh][:top_k]
+    kept = []
+    thr = nms_thresh
+    for i in cand:
+        keep = all(_np_iou(boxes[i:i + 1], boxes[k:k + 1],
+                           normalized)[0, 0] <= thr for k in kept)
+        if keep:
+            kept.append(i)
+            if eta < 1.0 and thr > 0.5:
+                thr *= eta
+    return kept
+
+
+def _np_multiclass_nms(bboxes, scores, bg, score_thresh, nms_thresh,
+                       nms_top_k, keep_top_k, eta, normalized):
+    B, M = bboxes.shape[0], bboxes.shape[1]
+    C = scores.shape[1]
+    per_class = min(nms_top_k, M) if nms_top_k > 0 else M
+    outs, counts = [], []
+    for b in range(B):
+        dets = []
+        for c in range(C):
+            if c == bg:
+                continue
+            for i in _np_nms_one(bboxes[b], scores[b, c], score_thresh,
+                                 nms_thresh, per_class, eta, normalized):
+                dets.append((c, scores[b, c, i], i))
+        dets.sort(key=lambda d: -d[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        outs.append([(c, s, *bboxes[b, i]) for c, s, i in dets])
+        counts.append(len(dets))
+    return outs, counts
+
+
+def test_multiclass_nms():
+    rng = R(31)
+    B, M, C = 2, 12, 3
+    bboxes = np.stack([_rand_boxes(rng, M) for _ in range(B)])
+    # distinct scores (stable ordering across impls)
+    scores = rng.permutation(B * C * M).reshape(B, C, M) \
+        .astype("float32") / (B * C * M)
+    out, index, nums = _run(
+        "multiclass_nms", {"BBoxes": bboxes, "Scores": scores},
+        ["Out", "Index", "NmsRoisNum"],
+        {"background_label": 0, "score_threshold": 0.1,
+         "nms_threshold": 0.4, "nms_top_k": 6, "keep_top_k": 5,
+         "nms_eta": 1.0, "normalized": True})
+    ref_out, ref_counts = _np_multiclass_nms(
+        bboxes, scores, 0, 0.1, 0.4, 6, 5, 1.0, True)
+    assert out.shape == (B, 5, 6) and index.shape == (B, 5)
+    np.testing.assert_array_equal(nums, ref_counts)
+    for b in range(B):
+        n = ref_counts[b]
+        got = out[b][:n]
+        exp = np.asarray(ref_out[b], np.float32)
+        np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-5)
+        assert (out[b][n:, 0] == -1).all()  # padding slots
+        assert (index[b][n:] == -1).all()
+
+
+def test_multiclass_nms_eta():
+    """adaptive threshold path (nms_eta < 1)."""
+    rng = R(37)
+    B, M, C = 1, 10, 2
+    bboxes = np.stack([_rand_boxes(rng, M, scale=4.0)])
+    scores = rng.permutation(B * C * M).reshape(B, C, M) \
+        .astype("float32") / (B * C * M)
+    out, index, nums = _run(
+        "multiclass_nms", {"BBoxes": bboxes, "Scores": scores},
+        ["Out", "Index", "NmsRoisNum"],
+        {"background_label": -1, "score_threshold": 0.05,
+         "nms_threshold": 0.7, "nms_top_k": -1, "keep_top_k": 8,
+         "nms_eta": 0.9, "normalized": True})
+    ref_out, ref_counts = _np_multiclass_nms(
+        bboxes, scores, -1, 0.05, 0.7, -1, 8, 0.9, True)
+    np.testing.assert_array_equal(nums, ref_counts)
+    n = ref_counts[0]
+    np.testing.assert_allclose(out[0][:n],
+                               np.asarray(ref_out[0], np.float32),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_multiclass_nms_eta_turn_semantics():
+    """Adaptive eta must apply at each CANDIDATE's turn (reference
+    NMSFast): B (IoU 0.6 vs kept A) is rejected because by B's turn the
+    threshold has decayed 0.7 -> 0.56 < 0.6."""
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 6]]], np.float32)
+    scores = np.array([[[0.9, 0.8]]], np.float32)  # C=1
+    out, index, nums = _run(
+        "multiclass_nms", {"BBoxes": boxes, "Scores": scores},
+        ["Out", "Index", "NmsRoisNum"],
+        {"background_label": -1, "score_threshold": 0.1,
+         "nms_threshold": 0.7, "nms_top_k": -1, "keep_top_k": 2,
+         "nms_eta": 0.8, "normalized": True})
+    assert nums[0] == 1
+    np.testing.assert_allclose(out[0, 0, :2], [0.0, 0.9])
+
+
+def test_multiclass_nms_keep_top_k_exceeds_capacity():
+    """keep_top_k > C*nms_top_k: static output K caps at capacity and
+    infer matches the lowering."""
+    rng = R(41)
+    boxes = np.stack([_rand_boxes(rng, 4)])
+    scores = rng.uniform(0.2, 0.9, (1, 2, 4)).astype("float32")
+    out, nums = _run(
+        "multiclass_nms", {"BBoxes": boxes, "Scores": scores},
+        ["Out", "NmsRoisNum"],
+        {"background_label": -1, "score_threshold": 0.1,
+         "nms_threshold": 0.4, "nms_top_k": 2, "keep_top_k": 50,
+         "nms_eta": 1.0, "normalized": True})
+    assert out.shape == (1, 4, 6)  # C*per_class = 2*2, not 50
+
+
+def test_roi_missing_rois_num_multibatch_rejected():
+    x = np.zeros((2, 1, 4, 4), np.float32)
+    rois = np.zeros((3, 4), np.float32)
+    for op_type in ("roi_align", "roi_pool"):
+        with pytest.raises(pt.errors.EnforceNotMet, match="RoisNum"):
+            _run(op_type, {"X": x, "ROIs": rois}, ["Out"],
+                 {"pooled_height": 2, "pooled_width": 2,
+                  "spatial_scale": 1.0, "sampling_ratio": 2})
+
+
+# ---------------------------------------------------------------------------
+# layer API smoke (graph building + shapes)
+# ---------------------------------------------------------------------------
+
+def test_detection_layer_api():
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        det = pt.layers.detection
+        feat = pt.layers.data("feat", shape=[1, 8, 4, 4],
+                              dtype="float32", append_batch_size=False)
+        img = pt.layers.data("img", shape=[1, 3, 32, 32],
+                             dtype="float32", append_batch_size=False)
+        boxes, variances = det.prior_box(feat, img, min_sizes=[8.0],
+                                         aspect_ratios=[2.0], flip=True)
+        assert tuple(boxes.shape) == (4, 4, 3, 4)
+        anchors, _ = det.anchor_generator(feat, anchor_sizes=[16.0],
+                                          aspect_ratios=[1.0],
+                                          stride=[8.0, 8.0])
+        assert tuple(anchors.shape) == (4, 4, 1, 4)
+        x = pt.layers.data("x", shape=[5, 4], dtype="float32",
+                           append_batch_size=False)
+        y = pt.layers.data("y", shape=[7, 4], dtype="float32",
+                           append_batch_size=False)
+        iou = det.iou_similarity(x, y)
+        assert tuple(iou.shape) == (5, 7)
+        enc = det.box_coder(y, [0.1, 0.1, 0.2, 0.2], x)
+        assert tuple(enc.shape) == (5, 7, 4)
+        m, d = det.bipartite_match(iou)
+        assert tuple(m.shape) == (1, 7)
+        bb = pt.layers.data("bb", shape=[2, 10, 4], dtype="float32",
+                            append_batch_size=False)
+        sc = pt.layers.data("sc", shape=[2, 4, 10], dtype="float32",
+                            append_batch_size=False)
+        out, idx, cnt = det.multiclass_nms(bb, sc, score_threshold=0.1,
+                                           nms_top_k=5, keep_top_k=3)
+        assert tuple(out.shape) == (2, 3, 6)
+        assert tuple(cnt.shape) == (2,)
