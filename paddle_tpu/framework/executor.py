@@ -377,12 +377,16 @@ class Executor:
             np.int32(self._step))
         ctx = LowerContext(block, env, base_key=base_key,
                            amp=getattr(program, "_amp_lowering", None))
+        from .selected_rows import densify, is_selected_rows
+
         for op in block.ops:
             if op.type in ("feed", "fetch"):
                 continue
             lower_op(ctx, op)
             for name in op.output_arg_names():
                 val = env.get(name)
+                if is_selected_rows(val):
+                    val = val.values
                 if val is None or not jnp.issubdtype(
                         jnp.asarray(val).dtype, jnp.floating):
                     continue
@@ -392,8 +396,8 @@ class Executor:
                         f"output {name!r} of op {op.type!r} "
                         f"(op index {op.idx})")
         for name in state_out:
-            scope.set_var(name, env[name])
-        fetches = [env[n] for n in fetch_names]
+            scope.set_var(name, densify(env[name]))
+        fetches = [densify(env[n]) for n in fetch_names]
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
@@ -421,8 +425,13 @@ class Executor:
             env.update(zip(mut_in, mut_vals))
             env.update(zip(const_in, const_vals))
             lower_block(block, env, base_key)
-            fetches = tuple(env[n] for n in fetch_names)
-            new_state = tuple(env[n] for n in state_out)
+            from .selected_rows import densify
+
+            # SELECTED_ROWS fetches/state leave the step as dense
+            # tensors (user-facing contract; reference fetch densifies
+            # SelectedRows the same way)
+            fetches = tuple(densify(env[n]) for n in fetch_names)
+            new_state = tuple(densify(env[n]) for n in state_out)
             return fetches, new_state
 
         # Donate only rebound state: params update in place in HBM.

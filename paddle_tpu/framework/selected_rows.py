@@ -1,0 +1,130 @@
+"""SelectedRows: the sparse row-slab gradient value.
+
+Reference: framework/selected_rows.h:41 — a {rows, value, height}
+triple used for embedding gradients (lookup_table_grad with
+is_sparse=True) and consumed by the sparse paths of the optimizer
+kernels (operators/optimizers/sgd_op.h:73, momentum_op.h:287,
+adam_op.h:195, adagrad_op).
+
+TPU-native form: a jax pytree of (rows int32 [K], values [K, cols...])
+with a static `height` — K is the static touched-row count (batch x
+seq ids), so the whole structure jits with fixed shapes.  Duplicate ids
+are allowed and merged (reference math::scatter::MergeAdd) with a
+sort + segment-sum, keeping K static: merged slots beyond the number of
+unique rows carry the out-of-range sentinel `height` and zero values,
+which every consumer drops via scatter mode='drop'.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRowsValue:
+    """Runtime value of a VarType.SELECTED_ROWS variable."""
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows          # int32 [K]; sentinel `height` = empty
+        self.values = values      # [K, cols...]
+        self.height = int(height)
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, values = children
+        return cls(rows, values, height)
+
+    # -- reference SelectedRows API ------------------------------------
+    def to_dense(self):
+        """GetValue into a dense [height, cols...] tensor (reference
+        SelectedRows::Get semantics: duplicate rows accumulate)."""
+        import jax.numpy as jnp
+
+        dense = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                          self.values.dtype)
+        return dense.at[self.rows].add(self.values, mode="drop")
+
+    def merge(self) -> "SelectedRowsValue":
+        """MergeAdd (reference math/selected_rows_functor.cc): sum
+        values of duplicate rows. Keeps K static: unique rows pack to
+        the front in sorted order; unused slots get the `height`
+        sentinel and zero values."""
+        import jax.numpy as jnp
+
+        K = self.rows.shape[0]
+        order = jnp.argsort(self.rows)
+        r = self.rows[order]
+        v = self.values[order]
+        is_first = jnp.concatenate(
+            [jnp.ones((1,), bool), r[1:] != r[:-1]])
+        seg = jnp.cumsum(is_first) - 1            # [K] segment index
+        merged_rows = jnp.full((K,), self.height, jnp.int32) \
+            .at[seg].set(r.astype(jnp.int32), mode="drop")
+        merged_vals = jnp.zeros_like(v).at[seg].add(v, mode="drop")
+        # sentinel rows may alias real ids after the unused tail; they
+        # hold zeros so mode='drop' consumers are unaffected either way
+        return SelectedRowsValue(merged_rows, merged_vals, self.height)
+
+    def scale(self, factor) -> "SelectedRowsValue":
+        return SelectedRowsValue(self.rows, self.values * factor,
+                                 self.height)
+
+    def __add__(self, other):
+        """Gradient accumulation (registry __accumulate__ uses `+`):
+        SR+SR concatenates (merge deferred to the consumer); SR+dense
+        densifies."""
+        if is_selected_rows(other):
+            return concat_selected_rows([self, other])
+        return self.to_dense() + other
+
+    def __radd__(self, other):
+        if other == 0:  # sum() builtin support
+            return self
+        return self.to_dense() + other
+
+    def __repr__(self):
+        return (f"SelectedRowsValue(K={self.rows.shape[0]}, "
+                f"height={self.height}, "
+                f"cols={tuple(self.values.shape[1:])})")
+
+
+def is_selected_rows(v: Any) -> bool:
+    return isinstance(v, SelectedRowsValue)
+
+
+def densify(v: Any):
+    """Dense view for fetch/debug consumers (numpy-facing)."""
+    if is_selected_rows(v):
+        return v.to_dense()
+    return v
+
+
+def concat_selected_rows(values) -> SelectedRowsValue:
+    """sum of N SelectedRows (gradient accumulation): concatenation —
+    consumers merge (reference sum_op SelectedRows branch)."""
+    import jax.numpy as jnp
+
+    heights = {v.height for v in values}
+    if len(heights) != 1:
+        from ..errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"sum over SelectedRows with differing heights {heights}")
+    return SelectedRowsValue(
+        jnp.concatenate([v.rows for v in values]),
+        jnp.concatenate([v.values for v in values]),
+        values[0].height)
+
+
+def np_reference_dense(rows, values, height):
+    """Test helper: numpy dense accumulation."""
+    out = np.zeros((height,) + values.shape[1:], values.dtype)
+    for r, v in zip(rows, values):
+        if 0 <= r < height:
+            out[r] += v
+    return out

@@ -444,11 +444,19 @@ def _bipartite_match(ctx, op):
 # roi_align / roi_pool
 # ---------------------------------------------------------------------------
 
-def _rois_batch_ids(jnp, rois_num, R):
-    """RoisNum [B] -> batch id per roi [R] (replaces the reference's LoD
-    offsets, roi_align_op.h:210-215)."""
-    ends = jnp.cumsum(rois_num)
-    return (jnp.arange(R)[:, None] >= ends[None, :]).sum(axis=1)
+def _rois_batch_ids(jnp, ctx, op, B, R):
+    """Batch id per roi [R] from the RoisNum input (replaces the
+    reference's LoD offsets, roi_align_op.h:210-215). Without RoisNum,
+    only a single-image batch is unambiguous."""
+    if op.single_input("RoisNum"):
+        ends = jnp.cumsum(ctx.get_input(op, "RoisNum"))
+        return (jnp.arange(R)[:, None] >= ends[None, :]).sum(axis=1)
+    if B == 1:
+        return jnp.zeros((R,), jnp.int32)
+    raise InvalidArgumentError(
+        f"{op.type}: feature batch is {B} but no RoisNum input maps "
+        "rois to images (the reference carries this via the ROIs LoD; "
+        "the dense port needs RoisNum)")
 
 
 def _roi_align_infer(op, block):
@@ -480,15 +488,7 @@ def _roi_align(ctx, op):
             "roi_align_op.h:231) is data-dependent shape")
     B, Cc, H, W = x.shape
     R = rois.shape[0]
-    if op.single_input("RoisNum"):
-        batch_ids = _rois_batch_ids(jnp, ctx.get_input(op, "RoisNum"), R)
-    elif B == 1:
-        batch_ids = jnp.zeros((R,), jnp.int32)
-    else:
-        raise InvalidArgumentError(
-            f"{op.type}: feature batch is {B} but no RoisNum input "
-            "maps rois to images (the reference carries this via the "
-            "ROIs LoD; the dense port needs RoisNum)")
+    batch_ids = _rois_batch_ids(jnp, ctx, op, B, R)
 
     xmin = rois[:, 0] * scale
     ymin = rois[:, 1] * scale
@@ -559,15 +559,7 @@ def _roi_pool(ctx, op):
     scale = op.attr("spatial_scale", 1.0)
     B, Cc, H, W = x.shape
     R = rois.shape[0]
-    if op.single_input("RoisNum"):
-        batch_ids = _rois_batch_ids(jnp, ctx.get_input(op, "RoisNum"), R)
-    elif B == 1:
-        batch_ids = jnp.zeros((R,), jnp.int32)
-    else:
-        raise InvalidArgumentError(
-            f"{op.type}: feature batch is {B} but no RoisNum input "
-            "maps rois to images (the reference carries this via the "
-            "ROIs LoD; the dense port needs RoisNum)")
+    batch_ids = _rois_batch_ids(jnp, ctx, op, B, R)
 
     x0 = jnp.round(rois[:, 0] * scale).astype(jnp.int32)
     y0 = jnp.round(rois[:, 1] * scale).astype(jnp.int32)

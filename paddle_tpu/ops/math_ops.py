@@ -52,8 +52,13 @@ def _align_y(x, y, axis):
 
 def _make_ew(op_type, fn):
     def lower(ctx: LowerContext, op: Operator):
-        x = ctx.get_input(op, "X")
-        y = ctx.get_input(op, "Y")
+        from ..framework.selected_rows import densify
+
+        # SELECTED_ROWS operands densify here (grad-clip pipelines
+        # square/scale grads elementwise); sparsity-preserving consumers
+        # are sum/scale/optimizer ops
+        x = densify(ctx.get_input(op, "X"))
+        y = densify(ctx.get_input(op, "Y"))
         x, y = _align_y(x, y, op.attr("axis", -1))
         ctx.set_output(op, "Out", fn(x, y))
     register_op(op_type, infer=_ew_infer, lower=lower)
@@ -188,19 +193,43 @@ _make_unary("leaky_relu", lambda x, op: _jnn().leaky_relu(
 _make_unary("elu", lambda x, op: _jnn().elu(x, op.attr("alpha", 1.0)))
 _make_unary("logsigmoid", lambda x, op: _jnn().log_sigmoid(x))
 _make_unary("sign", lambda x, op: _jnp().sign(x), grad=None)
-_make_unary("clip", lambda x, op: _jnp().clip(
-    x, op.attr("min", float("-inf")), op.attr("max", float("inf"))))
+
+
+def _clip_value(x, op):
+    """reference clip_op.h — the SelectedRows branch merges, then clips
+    the values slab (untouched rows are implicitly 0, kept as-is)."""
+    from ..framework.selected_rows import is_selected_rows
+
+    lo = op.attr("min", float("-inf"))
+    hi = op.attr("max", float("inf"))
+    if is_selected_rows(x):
+        m = x.merge()
+        return type(m)(m.rows, _jnp().clip(m.values, lo, hi), m.height)
+    return _jnp().clip(x, lo, hi)
+
+
+_make_unary("clip", _clip_value)
 _make_unary("assign", lambda x, op: x)
 _make_unary("share_data", lambda x, op: x)
 
 
 @register_op("scale", infer=same_as_input())
 def _scale(ctx: LowerContext, op: Operator):
+    from ..framework.selected_rows import is_selected_rows
+
     x = ctx.get_input(op, "X")
     scale = op.attr("scale", 1.0)
     if op.single_input("ScaleTensor"):
         scale = ctx.get_input(op, "ScaleTensor")
     bias = op.attr("bias", 0.0)
+    if is_selected_rows(x):
+        # sparsity-preserving (bias on a sparse grad would densify;
+        # the framework only emits bias=0 scales on grads)
+        if bias != 0.0:
+            x = x.to_dense()
+        else:
+            ctx.set_output(op, "Out", x.scale(scale))
+            return
     if op.attr("bias_after_scale", True):
         out = x * scale + bias
     else:
@@ -435,8 +464,18 @@ def _sum_infer(op, block):
 
 @register_op("sum", infer=_sum_infer)
 def _sum(ctx, op):
-    """Add N tensors (reference sum_op, used for gradient accumulation)."""
+    """Add N tensors (reference sum_op, used for gradient accumulation).
+    All-SelectedRows inputs concatenate (reference sum_op SelectedRows
+    branch); mixed inputs densify."""
+    from ..framework.selected_rows import (concat_selected_rows,
+                                           is_selected_rows)
+
     xs = ctx.get_inputs(op, "X")
+    if xs and all(is_selected_rows(x) for x in xs):
+        out = xs[0] if len(xs) == 1 else concat_selected_rows(xs)
+        ctx.set_output(op, "Out", out)
+        return
+    xs = [x.to_dense() if is_selected_rows(x) else x for x in xs]
     out = xs[0]
     for x in xs[1:]:
         out = out + x
@@ -489,9 +528,20 @@ def _cumsum(ctx, op):
 
 @register_op("clip_by_norm", infer=same_as_input())
 def _clip_by_norm(ctx, op):
+    from ..framework.selected_rows import is_selected_rows
+
     jnp = _jnp()
     x = ctx.get_input(op, "X")
     max_norm = op.attr("max_norm", 1.0)
+    if is_selected_rows(x):
+        # reference clip_by_norm_op.h SelectedRows branch: MergeAdd,
+        # then norm/scale the values slab (stays sparse)
+        m = x.merge()
+        norm = jnp.sqrt(jnp.sum(m.values * m.values))
+        vals = jnp.where(norm > max_norm,
+                         m.values * (max_norm / norm), m.values)
+        ctx.set_output(op, "Out", type(m)(m.rows, vals, m.height))
+        return
     norm = jnp.sqrt(jnp.sum(x * x))
     ctx.set_output(op, "Out",
                    jnp.where(norm > max_norm, x * (max_norm / norm), x))

@@ -44,9 +44,19 @@ def _reg_opt(op_type, alias_pairs, lower):
 # ---------------------------------------------------------------------------
 
 def _sgd(ctx: LowerContext, op: Operator):
+    from ..framework.selected_rows import is_selected_rows
+
     p = ctx.get_input(op, "Param")
     g = ctx.get_input(op, "Grad")
     lr = ctx.get_input(op, "LearningRate")
+    if is_selected_rows(g):
+        # reference sgd_op.h:73 SelectedRows branch: scatter-update the
+        # touched rows only — O(K*cols), no [height, cols] grad exists
+        m = g.merge()
+        upd = (lr * m.values.astype(p.dtype))
+        ctx.set_output(op, "ParamOut",
+                       p.at[m.rows].add(-upd, mode="drop"))
+        return
     ctx.set_output(op, "ParamOut", p - lr * g.astype(p.dtype))
 
 
@@ -54,9 +64,17 @@ _reg_opt("sgd", [("ParamOut", "Param")], _sgd)
 
 
 def _momentum(ctx, op):
+    from ..framework.selected_rows import is_selected_rows
+
     jnp = _jnp()
     p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad").astype(p.dtype)
+    g = ctx.get_input(op, "Grad")
+    if is_selected_rows(g):
+        # reference momentum_op.h:287 SparseMomentumFunctor is
+        # dense-equivalent: every row updates with g=0 for untouched
+        # rows (velocity decays everywhere) — densify is exact
+        g = g.to_dense()
+    g = g.astype(p.dtype)
     v = ctx.get_input(op, "Velocity")
     lr = ctx.get_input(op, "LearningRate")
     mu = op.attr("mu", 0.9)
@@ -83,9 +101,18 @@ def _adam_infer(op, block):
 
 
 def _adam(ctx: LowerContext, op: Operator):
+    from ..framework.selected_rows import is_selected_rows
+
     jnp = _jnp()
     p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad").astype("float32")
+    g = ctx.get_input(op, "Grad")
+    if is_selected_rows(g):
+        if op.attr("lazy_mode", False):
+            return _adam_sparse_lazy(ctx, op, g)
+        # reference adam_op.h:269 lazy_mode=false: dense-equivalent
+        # (every row updates, g=0 for untouched rows)
+        g = g.to_dense()
+    g = g.astype("float32")
     m1 = ctx.get_input(op, "Moment1")
     m2 = ctx.get_input(op, "Moment2")
     b1p = ctx.get_input(op, "Beta1Pow")
@@ -119,6 +146,53 @@ def _adam(ctx: LowerContext, op: Operator):
     ctx.set_output(op, "Beta2PowOut", b2p * b2)
 
 
+def _adam_sparse_lazy(ctx: LowerContext, op: Operator, sr):
+    """reference adam_op.h:269 lazy_mode=true: only touched rows update
+    param AND moments — O(K*cols) gather/update/scatter."""
+    jnp = _jnp()
+    p = ctx.get_input(op, "Param")
+    m1 = ctx.get_input(op, "Moment1")
+    m2 = ctx.get_input(op, "Moment2")
+    b1p = ctx.get_input(op, "Beta1Pow")
+    b2p = ctx.get_input(op, "Beta2Pow")
+    lr = ctx.get_input(op, "LearningRate")
+    b1 = op.attr("beta1", 0.9)
+    b2 = op.attr("beta2", 0.999)
+    if op.single_input("Beta1Tensor"):
+        b1 = ctx.get_input(op, "Beta1Tensor")
+    if op.single_input("Beta2Tensor"):
+        b2 = ctx.get_input(op, "Beta2Tensor")
+    eps = op.attr("epsilon", 1e-8)
+    if op.type == "adamw":
+        coeff = op.attr("coeff", 0.01)
+        if not op.attr("with_decay", True):
+            coeff = 0.0
+        # decoupled decay is a dense param scale — sparse rows only
+        # would silently skip decay on untouched rows
+        p = p * (1.0 - lr * coeff)
+
+    m = sr.merge()
+    rows = m.rows
+    g = m.values.astype("float32")
+    # duplicate-merged sentinel rows carry zero values; their gathered
+    # row updates are no-ops numerically and 'drop' discards them
+    m1r = m1.at[rows].get(mode="fill", fill_value=0.0)
+    m2r = m2.at[rows].get(mode="fill", fill_value=0.0)
+    pr = p.at[rows].get(mode="fill", fill_value=0.0).astype("float32")
+    m1n = b1 * m1r + (1 - b1) * g
+    m2n = b2 * m2r + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    prn = pr - lr_t * m1n / (jnp.sqrt(m2n) + eps * jnp.sqrt(1 - b2p))
+    # post-merge rows are unique; sentinel (out-of-range) slots are
+    # dropped by the scatter, so the writes below touch K real rows
+    ctx.set_output(op, "ParamOut",
+                   p.at[rows].set(prn.astype(p.dtype), mode="drop"))
+    ctx.set_output(op, "Moment1Out", m1.at[rows].set(m1n, mode="drop"))
+    ctx.set_output(op, "Moment2Out", m2.at[rows].set(m2n, mode="drop"))
+    ctx.set_output(op, "Beta1PowOut", b1p * b1)
+    ctx.set_output(op, "Beta2PowOut", b2p * b2)
+
+
 for _t in ("adam", "adamw"):
     register_op(_t, infer=_adam_infer, lower=_adam, grad=None,
                 stateful_outputs=("ParamOut", "Moment1Out", "Moment2Out",
@@ -148,12 +222,29 @@ _reg_opt("adamax", [("ParamOut", "Param"), ("MomentOut", "Moment"),
 
 
 def _adagrad(ctx, op):
+    from ..framework.selected_rows import is_selected_rows
+
     jnp = _jnp()
     p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad").astype("float32")
+    g = ctx.get_input(op, "Grad")
     m = ctx.get_input(op, "Moment")
     lr = ctx.get_input(op, "LearningRate")
     eps = op.attr("epsilon", 1e-6)
+    if is_selected_rows(g):
+        # reference adagrad_op.h SelectedRows branch: merge, then update
+        # moment+param on the touched rows only
+        mg = g.merge()
+        rows, gv = mg.rows, mg.values.astype("float32")
+        mr = m.at[rows].get(mode="fill", fill_value=0.0)
+        pr = p.at[rows].get(mode="fill", fill_value=0.0).astype("float32")
+        mn = mr + gv * gv
+        prn = pr - lr * gv / (jnp.sqrt(mn) + eps)
+        ctx.set_output(op, "ParamOut",
+                       p.at[rows].set(prn.astype(p.dtype), mode="drop"))
+        ctx.set_output(op, "MomentOut",
+                       m.at[rows].set(mn, mode="drop"))
+        return
+    g = g.astype("float32")
     mn = m + g * g
     p_new = p.astype("float32") - lr * g / (jnp.sqrt(mn) + eps)
     ctx.set_output(op, "ParamOut", p_new.astype(p.dtype))

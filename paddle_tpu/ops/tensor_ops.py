@@ -637,9 +637,67 @@ def _lookup_lower(ctx: LowerContext, op: Operator):
     ctx.set_output(op, "Out", out)
 
 
-register_op("lookup_table", infer=_lookup_infer, lower=_lookup_lower)
-register_op("lookup_table_v2", infer=_lookup_infer, lower=_lookup_lower)
-register_op("embedding", infer=_lookup_infer, lower=_lookup_lower)
+def _lookup_grad_maker(fwd_op, block, helper):
+    """is_sparse=True routes to the SelectedRows grad (reference
+    lookup_table_op.cc LookupTableGradOp: grad var type switches to
+    SELECTED_ROWS when is_sparse); dense keeps the auto vjp."""
+    from ..framework.core import grad_var_name
+    from .registry import build_auto_grad_specs
+
+    if not fwd_op.attr("is_sparse", False):
+        return build_auto_grad_specs(fwd_op, block, helper.no_grad_set)
+    w_name = fwd_op.single_input("W")
+    v = block._find_var_recursive(w_name)
+    if v is None or v.stop_gradient or w_name in helper.no_grad_set:
+        return []
+    return [dict(
+        type="lookup_table_sparse_grad",
+        inputs={"W": [w_name], "Ids": list(fwd_op.input("Ids")),
+                "Out@GRAD": [grad_var_name(fwd_op.single_output("Out"))]},
+        outputs={"W@GRAD": [grad_var_name(w_name)]},
+        attrs={"padding_idx": fwd_op.attr("padding_idx", -1),
+               "__lookup_type__": fwd_op.type})]
+
+
+def _lookup_sparse_grad_infer(op, block):
+    from ..framework.core import VarType
+
+    w = in_var(op, block, "W")
+    set_out(op, block, "W@GRAD", w.shape, w.dtype,
+            type=VarType.SELECTED_ROWS)
+
+
+@register_op("lookup_table_sparse_grad", infer=_lookup_sparse_grad_infer,
+             grad=None)
+def _lookup_sparse_grad(ctx, op):
+    """W@GRAD as SelectedRows{rows=flat ids, values=flat out-grad rows}
+    — no [V,H] dense scatter materializes (reference
+    lookup_table_op.h is_sparse branch)."""
+    from ..framework.selected_rows import SelectedRowsValue
+
+    jnp = _jnp()
+    w = ctx.get_input(op, "W")
+    ids = ctx.get_input(op, "Ids")
+    og = ctx.get_input(op, "Out@GRAD")
+    if op.attr("__lookup_type__") == "lookup_table" \
+            and jnp.shape(ids)[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    height, cols = w.shape[0], w.shape[-1]
+    rows = ids.reshape(-1).astype(jnp.int32)
+    vals = og.reshape(-1, cols).astype(w.dtype)
+    pad = op.attr("padding_idx", -1)
+    if pad is not None and pad >= 0:
+        # padding rows contribute no gradient (forward masked them)
+        vals = vals * (rows != pad)[:, None].astype(vals.dtype)
+    ctx.set_output(op, "W@GRAD", SelectedRowsValue(rows, vals, height))
+
+
+register_op("lookup_table", infer=_lookup_infer, lower=_lookup_lower,
+            grad=_lookup_grad_maker)
+register_op("lookup_table_v2", infer=_lookup_infer, lower=_lookup_lower,
+            grad=_lookup_grad_maker)
+register_op("embedding", infer=_lookup_infer, lower=_lookup_lower,
+            grad=_lookup_grad_maker)
 
 
 def _one_hot_infer(op, block):

@@ -1,0 +1,253 @@
+"""SelectedRows sparse gradient path (reference framework/
+selected_rows.h:41, lookup_table_op is_sparse branch, sparse optimizer
+kernels operators/optimizers/{sgd,momentum,adam,adagrad}_op.h).
+
+Parity principle: for every optimizer, training with is_sparse=True must
+produce the SAME trajectory as is_sparse=False (dense scatter grads) —
+the reference sparse kernels are mathematically dense-equivalent except
+sgd (touched-rows by construction: untouched rows have zero grad) and
+adam lazy_mode (reference-intended deviation, tested separately).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _train(is_sparse, opt_factory, steps=4, lazy=False, vocab=13, dim=4):
+    from paddle_tpu.ops.registry import reset_op_seed
+
+    pt.framework.core.reset_unique_name()
+    reset_op_seed()
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    main.random_seed = startup.random_seed = 7
+    with pt.program_guard(main, startup):
+        ids = pt.layers.data("ids", shape=[5], dtype="int64")
+        label = pt.layers.data("label", shape=[dim], dtype="float32")
+        emb = pt.layers.embedding(
+            ids, size=[vocab, dim], is_sparse=is_sparse,
+            param_attr=pt.ParamAttr(
+                name="emb_w",
+                initializer=pt.initializer.UniformInitializer(
+                    low=-0.5, high=0.5, seed=3)))
+        pooled = pt.layers.reduce_mean(emb, dim=1)
+        loss = pt.layers.reduce_mean(
+            pt.layers.square(pt.layers.elementwise_sub(pooled, label)))
+        opt = opt_factory()
+        if lazy:
+            opt._lazy_mode = True
+        opt.minimize(loss)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(steps):
+        ids_v = rng.randint(0, vocab, (8, 5)).astype("int64")
+        lab_v = rng.uniform(-1, 1, (8, dim)).astype("float32")
+        l, = exe.run(main, feed={"ids": ids_v, "label": lab_v},
+                     fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    w = np.asarray(scope.find_var("emb_w"))
+    return losses, w
+
+
+@pytest.mark.parametrize("opt", [
+    lambda: pt.optimizer.SGDOptimizer(0.1),
+    lambda: pt.optimizer.MomentumOptimizer(0.1, momentum=0.9),
+    lambda: pt.optimizer.AdamOptimizer(0.05),
+    lambda: pt.optimizer.AdagradOptimizer(0.1),
+], ids=["sgd", "momentum", "adam", "adagrad"])
+def test_sparse_dense_trajectory_parity(opt):
+    dense_losses, dense_w = _train(False, opt)
+    sparse_losses, sparse_w = _train(True, opt)
+    np.testing.assert_allclose(sparse_losses, dense_losses, rtol=2e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(sparse_w, dense_w, rtol=2e-5, atol=1e-6)
+    assert dense_losses[-1] < dense_losses[0]  # it actually trains
+
+
+def test_grad_var_is_selected_rows_type():
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        ids = pt.layers.data("ids", shape=[5], dtype="int64")
+        emb = pt.layers.embedding(ids, size=[11, 3], is_sparse=True,
+                                  param_attr=pt.ParamAttr(name="w_sr"))
+        loss = pt.layers.reduce_mean(emb)
+        pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+    gvar = main.global_block()._find_var_recursive("w_sr@GRAD")
+    assert gvar is not None
+    assert gvar.type == pt.framework.core.VarType.SELECTED_ROWS
+    # and the graph uses the sparse grad op, not a dense scatter vjp
+    types = [op.type for op in main.global_block().ops]
+    assert "lookup_table_sparse_grad" in types
+
+
+def test_selected_rows_merge_and_dense():
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.selected_rows import (SelectedRowsValue,
+                                                    np_reference_dense)
+
+    rows = jnp.asarray([3, 1, 3, 0, 1, 6], jnp.int32)
+    vals = jnp.asarray(np.arange(12, dtype="float32").reshape(6, 2))
+    sr = SelectedRowsValue(rows, vals, height=8)
+    ref = np_reference_dense(np.asarray(rows), np.asarray(vals), 8)
+    np.testing.assert_allclose(np.asarray(sr.to_dense()), ref)
+    m = sr.merge()
+    np.testing.assert_allclose(np.asarray(m.to_dense()), ref)
+    # merged: unique real rows + height-sentinel padding
+    mr = np.asarray(m.rows)
+    real = mr[mr < 8]
+    assert sorted(real) == [0, 1, 3, 6] and len(real) == 4
+    assert (mr[4:] == 8).all()
+
+
+def test_adam_lazy_mode_touched_rows_only():
+    """lazy_mode: moments/params of untouched rows must NOT move
+    (reference adam_op.h:269); non-lazy updates every row."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.selected_rows import SelectedRowsValue
+    from paddle_tpu.framework.core import Program
+    from paddle_tpu.ops.registry import LowerContext, lower_op
+
+    vocab, dim = 6, 3
+    prog = Program()
+    block = prog.global_block()
+    for n, shape in [("P", (vocab, dim)), ("M1", (vocab, dim)),
+                     ("M2", (vocab, dim)), ("B1", (1,)), ("B2", (1,)),
+                     ("LR", (1,))]:
+        block.create_var(name=n, shape=shape, dtype="float32")
+    block.create_var(name="G", shape=(vocab, dim), dtype="float32",
+                     type=pt.framework.core.VarType.SELECTED_ROWS)
+    op = block.append_op(
+        "adam",
+        inputs={"Param": ["P"], "Grad": ["G"], "Moment1": ["M1"],
+                "Moment2": ["M2"], "Beta1Pow": ["B1"],
+                "Beta2Pow": ["B2"], "LearningRate": ["LR"]},
+        outputs={"ParamOut": ["P"], "Moment1Out": ["M1"],
+                 "Moment2Out": ["M2"], "Beta1PowOut": ["B1"],
+                 "Beta2PowOut": ["B2"]},
+        attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+               "lazy_mode": True})
+    p0 = np.ones((vocab, dim), np.float32)
+    env = {"P": jnp.asarray(p0),
+           "M1": jnp.full((vocab, dim), 0.5),
+           "M2": jnp.full((vocab, dim), 0.25),
+           "B1": jnp.asarray([0.9], jnp.float32),
+           "B2": jnp.asarray([0.999], jnp.float32),
+           "LR": jnp.asarray([0.1], jnp.float32),
+           "G": SelectedRowsValue(jnp.asarray([1, 4, 1], jnp.int32),
+                                  jnp.ones((3, dim), jnp.float32),
+                                  vocab)}
+    ctx = LowerContext(block, env)
+    lower_op(ctx, op)
+    p_new = np.asarray(env["P"])
+    m1_new = np.asarray(env["M1"])
+    touched = [1, 4]
+    untouched = [0, 2, 3, 5]
+    assert (p_new[untouched] == p0[untouched]).all()
+    assert (m1_new[untouched] == 0.5).all()
+    assert (p_new[touched] != 1.0).all()
+    # duplicated row 1 merged: grad 2.0; row 4 grad 1.0
+    m1_expect_r1 = 0.9 * 0.5 + 0.1 * 2.0
+    m1_expect_r4 = 0.9 * 0.5 + 0.1 * 1.0
+    np.testing.assert_allclose(m1_new[1], m1_expect_r1, rtol=1e-6)
+    np.testing.assert_allclose(m1_new[4], m1_expect_r4, rtol=1e-6)
+
+
+def test_sparse_with_global_norm_clip_densifies_correctly():
+    """grad-clip pipelines square grads elementwise: SR operands
+    densify there, trajectory still matches dense exactly."""
+    mk = lambda: pt.optimizer.SGDOptimizer(
+        0.1, grad_clip=pt.clip.GradientClipByGlobalNorm(0.5))
+    dense_losses, dense_w = _train(False, mk)
+    sparse_losses, sparse_w = _train(True, mk)
+    np.testing.assert_allclose(sparse_losses, dense_losses, rtol=2e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(sparse_w, dense_w, rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("clip", [
+    lambda: pt.clip.GradientClipByNorm(0.05),
+    lambda: pt.clip.GradientClipByValue(0.01, -0.01),  # (max, min)
+], ids=["by_norm", "by_value"])
+def test_sparse_with_norm_and_value_clip(clip):
+    """clip_by_norm / clip on SelectedRows grads (reference
+    clip_op.h / clip_by_norm_op.h SelectedRows branches): trajectory
+    parity with dense, clips actually engaged (tight bounds)."""
+    mk = lambda: pt.optimizer.SGDOptimizer(0.1, grad_clip=clip())
+    dense_losses, dense_w = _train(False, mk)
+    sparse_losses, sparse_w = _train(True, mk)
+    np.testing.assert_allclose(sparse_losses, dense_losses, rtol=2e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(sparse_w, dense_w, rtol=2e-5, atol=1e-6)
+
+
+def test_adamw_lazy_applies_decoupled_decay():
+    """AdamW lazy_mode must still decay untouched rows (decoupled decay
+    is dense by definition)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.selected_rows import SelectedRowsValue
+    from paddle_tpu.framework.core import Program, VarType
+    from paddle_tpu.ops.registry import LowerContext, lower_op
+
+    vocab, dim = 4, 2
+    prog = Program()
+    block = prog.global_block()
+    for n, shape in [("P", (vocab, dim)), ("M1", (vocab, dim)),
+                     ("M2", (vocab, dim)), ("B1", (1,)), ("B2", (1,)),
+                     ("LR", (1,))]:
+        block.create_var(name=n, shape=shape, dtype="float32")
+    block.create_var(name="G", shape=(vocab, dim), dtype="float32",
+                     type=VarType.SELECTED_ROWS)
+    op = block.append_op(
+        "adamw",
+        inputs={"Param": ["P"], "Grad": ["G"], "Moment1": ["M1"],
+                "Moment2": ["M2"], "Beta1Pow": ["B1"],
+                "Beta2Pow": ["B2"], "LearningRate": ["LR"]},
+        outputs={"ParamOut": ["P"], "Moment1Out": ["M1"],
+                 "Moment2Out": ["M2"], "Beta1PowOut": ["B1"],
+                 "Beta2PowOut": ["B2"]},
+        attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+               "lazy_mode": True, "coeff": 0.1})
+    env = {"P": jnp.ones((vocab, dim), jnp.float32),
+           "M1": jnp.zeros((vocab, dim)), "M2": jnp.zeros((vocab, dim)),
+           "B1": jnp.asarray([0.9], jnp.float32),
+           "B2": jnp.asarray([0.999], jnp.float32),
+           "LR": jnp.asarray([0.1], jnp.float32),
+           "G": SelectedRowsValue(jnp.asarray([1], jnp.int32),
+                                  jnp.ones((1, dim), jnp.float32),
+                                  vocab)}
+    lower_op(LowerContext(block, env), op)
+    p_new = np.asarray(env["P"])
+    # untouched row 0: only decoupled decay applied
+    np.testing.assert_allclose(p_new[0], 1.0 - 0.1 * 0.1, rtol=1e-6)
+    assert (p_new[1] < 1.0 - 0.1 * 0.1).all()  # touched: decay + update
+
+
+def test_fetch_selected_rows_densifies():
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        ids = pt.layers.data("ids", shape=[4], dtype="int64")
+        emb = pt.layers.embedding(ids, size=[9, 2], is_sparse=True,
+                                  param_attr=pt.ParamAttr(name="w_f"))
+        loss = pt.layers.reduce_mean(emb)
+        pt.optimizer.SGDOptimizer(0.0).minimize(loss)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    g, = exe.run(main,
+                 feed={"ids": np.array([[1, 2, 2, 5]], "int64")},
+                 fetch_list=["w_f@GRAD"], scope=scope)
+    g = np.asarray(g)
+    assert g.shape == (9, 2)  # densified on fetch
+    assert g[1].sum() != 0 and g[2].sum() != 0
+    assert g[0].sum() == 0 and g[8].sum() == 0
+    # duplicate id 2 accumulated double the grad of id 1
+    np.testing.assert_allclose(g[2], 2 * g[1], rtol=1e-5)
