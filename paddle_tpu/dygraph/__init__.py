@@ -12,6 +12,8 @@ from .nn import (Linear, Conv2D, Pool2D, BatchNorm, LayerNorm,  # noqa
 from .parallel import (DataParallel, ParallelEnv, prepare_context,  # noqa
                        ParallelStrategy)
 from .jit import declarative, dygraph_to_static_func, TracedLayer  # noqa
+from . import dygraph_to_static  # noqa
+from .dygraph_to_static import ProgramTranslator  # noqa
 from .checkpoint import save_dygraph, load_dygraph  # noqa
 from . import amp  # noqa
 from .amp import amp_guard, auto_cast, GradScaler  # noqa

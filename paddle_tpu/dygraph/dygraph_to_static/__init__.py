@@ -1,0 +1,30 @@
+"""Dygraph-to-static AST transpiler.
+
+Reference: fluid/dygraph/dygraph_to_static/program_translator.py:711 and
+its transformer stack (ifelse_transformer.py, loop_transformer.py,
+logical_transformer.py). Same architecture, jax-era scope: Python
+control flow whose predicate is a graph Variable is rewritten into
+calls to runtime *conversion dispatchers* that build `cond` / `while`
+sub-block ops, while Python-valued predicates keep exact Python
+semantics (including short-circuiting) — the dispatch happens at trace
+time on the predicate's runtime type, exactly like the reference's
+convert_ifelse/convert_while_loop (dygraph_to_static/convert_operators.py).
+
+Supported rewrites:
+  * ``if``/``elif``/``else``  -> convert_ifelse(pred, true_fn, false_fn,
+    pre-branch values of every name either branch assigns)
+  * ``while``                 -> convert_while(cond_fn, body_fn, carries)
+  * ``a and b`` / ``a or b`` / ``not a`` -> convert_logical_*
+
+Deliberate restrictions (transform is skipped for that statement and
+the existing Variable.__bool__ TypeError fires if the predicate turns
+out to be a tensor): ``return``/``break``/``continue`` inside a
+converted block, non-name assignment targets (attributes/subscripts)
+carrying across branches, ``for`` over a tensor. Python ``for`` over
+ranges/lists is left untouched (static unroll at trace time).
+"""
+from .program_translator import (ProgramTranslator, convert_to_static,
+                                 unwrap_decorators)
+from . import convert_operators  # noqa: F401
+
+__all__ = ["ProgramTranslator", "convert_to_static", "unwrap_decorators"]

@@ -1,0 +1,244 @@
+"""Runtime conversion dispatchers (reference
+dygraph_to_static/convert_operators.py: convert_ifelse:?,
+convert_while_loop, convert_logical_and/or/not).
+
+Each dispatcher receives the predicate/closures produced by the AST
+rewrite and decides AT TRACE TIME whether to build graph control-flow
+ops (predicate is a static-graph Variable) or to execute plain Python
+(predicate is a bool/ndarray/eager VarBase — exact Python semantics,
+including short-circuit).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+from ...framework.core import Variable
+
+
+class _Undefined:
+    """Placeholder for names not yet bound before the control-flow
+    statement (reference UndefinedVar). Using one raises the NameError
+    the original (untransformed) code would have raised."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self):
+        return f"<undefined local {self.name!r}>"
+
+    def _raise(self):
+        raise NameError(
+            f"local variable {self.name!r} referenced before "
+            "assignment (it was only assigned on the other branch of a "
+            "converted if/while)")
+
+    def __bool__(self):
+        self._raise()
+
+    def __getattr__(self, item):
+        self._raise()
+
+    def __getitem__(self, item):
+        self._raise()
+
+    def __call__(self, *a, **k):
+        self._raise()
+
+    def __iter__(self):
+        self._raise()
+
+    def __len__(self):
+        self._raise()
+
+    def __float__(self):
+        self._raise()
+
+    def __int__(self):
+        self._raise()
+
+    def __array__(self, *a, **k):
+        self._raise()
+
+
+UNDEF = _Undefined
+
+
+def _is_tensor_pred(pred) -> bool:
+    return isinstance(pred, Variable)
+
+
+def _same_value(a, b) -> bool:
+    """Identity-or-equality that never raises (ndarray-safe)."""
+    if a is b:
+        return True
+    if isinstance(a, _Undefined) or isinstance(b, _Undefined):
+        return False
+    try:
+        import numpy as np
+
+        if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+            return bool(np.array_equal(a, b))
+        return bool(a == b)
+    except Exception:
+        return False
+
+
+def _check_defined(vals, names, where):
+    for v, n in zip(vals, names):
+        if isinstance(v, _Undefined):
+            raise NameError(
+                f"variable {n!r} is read by the converted {where} but "
+                "was never assigned on the executed path")
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
+                   names: Sequence[str], init: Tuple) -> Tuple:
+    """Returns the post-if values of `names` (every name either branch
+    assigns). Branch functions are pure: they take the pre-branch
+    values and return the tuple of post-branch values."""
+    if not _is_tensor_pred(pred):
+        taken = true_fn if pred else false_fn
+        return tuple(taken(*init))
+
+    from ...layers import control_flow
+
+    box = {}
+
+    def wrap(fn, key):
+        def run():
+            outs = tuple(fn(*init))
+            box[key] = outs
+            tensors = [o for o in outs if isinstance(o, Variable)]
+            return tensors or None
+        return run
+
+    try:
+        merged = control_flow.cond(pred, wrap(true_fn, "t"),
+                                   wrap(false_fn, "f"))
+    except ValueError as e:
+        # cond's arity check fires when one branch made a name a tensor
+        # and the other left it python/undefined — diagnose by name
+        if "arity" in str(e) and "t" in box and "f" in box:
+            for name, tv, fv in zip(names, box["t"], box["f"]):
+                if isinstance(tv, Variable) != isinstance(fv, Variable):
+                    raise TypeError(
+                        f"converted if: {name!r} is a tensor in one "
+                        "branch but not the other; assign it a "
+                        "matching tensor in both branches") from e
+        raise
+    if merged is None:
+        merged_list = []
+    elif isinstance(merged, Variable):
+        merged_list = [merged]
+    else:
+        merged_list = list(merged)
+
+    # rebuild the full name tuple: tensor slots take the cond-merged
+    # outputs positionally; python-value slots must agree between
+    # branches (a tensor pred cannot select between python values)
+    t_outs, f_outs = box["t"], box["f"]
+    out, mi = [], 0
+    for name, tv, fv in zip(names, t_outs, f_outs):
+        t_is, f_is = isinstance(tv, Variable), isinstance(fv, Variable)
+        if t_is != f_is:
+            raise TypeError(
+                f"converted if: {name!r} is a tensor in one branch but "
+                f"{'undefined' if isinstance(tv if not t_is else fv, _Undefined) else 'a python value'} "
+                "in the other; assign it a matching tensor in both "
+                "branches")
+        if t_is:
+            out.append(merged_list[mi])
+            mi += 1
+        else:
+            same_undef = (isinstance(tv, _Undefined)
+                          and isinstance(fv, _Undefined))
+            if not same_undef and not _same_value(tv, fv):
+                raise TypeError(
+                    f"converted if: python value {name!r} differs "
+                    f"between branches ({tv!r} vs {fv!r}) under a "
+                    "tensor predicate; make it a tensor")
+            out.append(tv)
+    return tuple(out)
+
+
+def convert_while(cond_fn: Callable, body_fn: Callable,
+                  names: Sequence[str], init: Tuple) -> Tuple:
+    """Dispatch a while loop: graph while when the predicate of the
+    INITIAL state is a Variable, else plain Python."""
+    pred0 = cond_fn(*init)
+    if not _is_tensor_pred(pred0):
+        vals = tuple(init)
+        while cond_fn(*vals):
+            vals = tuple(body_fn(*vals))
+        return vals
+
+    from ...layers import control_flow
+
+    _check_defined(init, names, "while")
+    # python-valued carries cannot change across a graph loop: they are
+    # closed over, and loop_body checks they are never rebound
+    tensor_idx = [i for i, v in enumerate(init)
+                  if isinstance(v, Variable)]
+    carries = [init[i] for i in tensor_idx]
+
+    def loop_cond(*c):
+        vals = list(init)
+        for j, i in enumerate(tensor_idx):
+            vals[i] = c[j]
+        return cond_fn(*vals)
+
+    def loop_body(*c):
+        vals = list(init)
+        for j, i in enumerate(tensor_idx):
+            vals[i] = c[j]
+        outs = body_fn(*vals)
+        for i, (a, b) in enumerate(zip(init, outs)):
+            if i not in tensor_idx and not _same_value(a, b):
+                raise TypeError(
+                    f"converted while rebinds python value {names[i]!r}"
+                    " inside a tensor loop; make it a tensor (e.g. "
+                    "fill_constant) to carry it through the loop")
+        return [outs[i] for i in tensor_idx]
+
+    final = control_flow.while_loop(loop_cond, loop_body, carries,
+                                    _initial_pred=pred0)
+    if isinstance(final, Variable):
+        final = [final]
+    vals = list(init)
+    for j, i in enumerate(tensor_idx):
+        vals[i] = final[j]
+    return tuple(vals)
+
+
+def convert_logical_and(lhs, rhs_fn: Callable):
+    if isinstance(lhs, Variable):
+        from ...layers import tensor as T
+
+        return T.logical_and(lhs, _to_bool_tensor(rhs_fn()))
+    return lhs and rhs_fn()
+
+
+def convert_logical_or(lhs, rhs_fn: Callable):
+    if isinstance(lhs, Variable):
+        from ...layers import tensor as T
+
+        return T.logical_or(lhs, _to_bool_tensor(rhs_fn()))
+    return lhs or rhs_fn()
+
+
+def convert_logical_not(x):
+    if isinstance(x, Variable):
+        from ...layers import tensor as T
+
+        return T.logical_not(x)
+    return not x
+
+
+def _to_bool_tensor(v):
+    if isinstance(v, Variable):
+        return v
+    raise TypeError(
+        "mixed tensor/python operands in a converted boolean "
+        "expression; wrap the python value in a tensor")

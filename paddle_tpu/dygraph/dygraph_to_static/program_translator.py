@@ -1,0 +1,318 @@
+"""AST rewriting for dygraph-to-static.
+
+Reference: dygraph_to_static/program_translator.py:711 (ProgramTranslator
++ StaticLayer), ifelse_transformer.py, loop_transformer.py,
+logical_transformer.py — collapsed into one conservative transformer.
+
+The rewrite turns
+
+    if cond:            ->  def __pt_true_1(x, y): ...; return (x, y)
+        ...                 def __pt_false_1(x, y): ...; return (x, y)
+        ...                 (x, y) = __pt_d2s.convert_ifelse(
+    else:                       cond, __pt_true_1, __pt_false_1,
+        ...                     ("x", "y"),
+                                tuple of current values (UNDEF if unbound))
+
+and analogously ``while`` -> convert_while, ``a and b`` ->
+convert_logical_and(a, lambda: b). Statements containing
+return/break/continue (or other constructs outside the supported
+subset) are left untouched — if their predicate turns out to be a
+tensor at trace time, Variable.__bool__ raises the standard loud error.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import warnings
+from typing import List, Set
+
+from . import convert_operators as _ops
+
+_HELPER_NAME = "__pt_d2s"
+
+
+# ---------------------------------------------------------------------------
+# analysis helpers
+# ---------------------------------------------------------------------------
+
+def _assigned_names(stmts: List[ast.stmt]) -> Set[str]:
+    """Simple names bound by a statement list (incl. nested blocks)."""
+    names: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                names.add(node.id)
+
+        def visit_FunctionDef(self, node):  # don't descend
+            names.add(node.name)
+
+        def visit_AsyncFunctionDef(self, node):
+            names.add(node.name)
+
+        def visit_Lambda(self, node):
+            pass
+
+    for s in stmts:
+        V().visit(s)
+    return names
+
+
+def _contains_flow_escape(stmts: List[ast.stmt]) -> bool:
+    """return/break/continue/yield directly inside (not nested defs)."""
+    found = False
+
+    class V(ast.NodeVisitor):
+        def visit_Return(self, node):
+            nonlocal found
+            found = True
+
+        def visit_Break(self, node):
+            nonlocal found
+            found = True
+
+        def visit_Continue(self, node):
+            nonlocal found
+            found = True
+
+        def visit_Yield(self, node):
+            nonlocal found
+            found = True
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        def visit_AsyncFunctionDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+    for s in stmts:
+        V().visit(s)
+    return found
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _helper_attr(fn_name):
+    return ast.Attribute(value=_name(_HELPER_NAME), attr=fn_name,
+                         ctx=ast.Load())
+
+
+def _locals_get(varnames):
+    """tuple(locals().get('v', UNDEF('v')) for each v) as an AST expr."""
+    elts = []
+    for v in varnames:
+        elts.append(ast.Call(
+            func=ast.Attribute(
+                value=ast.Call(func=_name("locals"), args=[],
+                               keywords=[]),
+                attr="get", ctx=ast.Load()),
+            args=[ast.Constant(v),
+                  ast.Call(func=_helper_attr("UNDEF"),
+                           args=[ast.Constant(v)], keywords=[])],
+            keywords=[]))
+    return ast.Tuple(elts=elts, ctx=ast.Load())
+
+
+def _make_branch_fn(name, params, body, ret_names):
+    """def name(p1, ..., pn): <body>; return (r1, ..., rn)"""
+    body = list(body) + [ast.Return(value=ast.Tuple(
+        elts=[_name(r) for r in ret_names], ctx=ast.Load()))]
+    args = ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+        vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+        defaults=[])
+    return ast.FunctionDef(name=name, args=args, body=body,
+                           decorator_list=[], returns=None,
+                           type_params=[])
+
+
+class _D2STransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # -- if/else ---------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        if _contains_flow_escape(node.body) \
+                or _contains_flow_escape(node.orelse):
+            return node
+        names = sorted(n for n in (_assigned_names(node.body)
+                                   | _assigned_names(node.orelse))
+                       if not n.startswith("__pt_"))
+        uid = self._uid()
+        tname, fname = f"__pt_true_{uid}", f"__pt_false_{uid}"
+        true_fn = _make_branch_fn(tname, names, node.body, names)
+        false_fn = _make_branch_fn(fname, names, node.orelse or [ast.Pass()],
+                                   names)
+        call = ast.Call(
+            func=_helper_attr("convert_ifelse"),
+            args=[node.test, _name(tname), _name(fname),
+                  ast.Tuple(elts=[ast.Constant(n) for n in names],
+                            ctx=ast.Load()),
+                  _locals_get(names)],
+            keywords=[])
+        if names:
+            assign = ast.Assign(
+                targets=[ast.Tuple(elts=[_name(n, ast.Store())
+                                         for n in names],
+                                   ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [true_fn, false_fn, assign]
+
+    # -- while -----------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse or _contains_flow_escape(node.body):
+            return node
+        names = sorted(n for n in _assigned_names(node.body)
+                       if not n.startswith("__pt_"))
+        uid = self._uid()
+        cname, bname = f"__pt_cond_{uid}", f"__pt_body_{uid}"
+        cond_fn = _make_branch_fn(cname, names, [ast.Pass()], [])
+        cond_fn.body = [ast.Return(value=node.test)]
+        body_fn = _make_branch_fn(bname, names, node.body, names)
+        call = ast.Call(
+            func=_helper_attr("convert_while"),
+            args=[_name(cname), _name(bname),
+                  ast.Tuple(elts=[ast.Constant(n) for n in names],
+                            ctx=ast.Load()),
+                  _locals_get(names)],
+            keywords=[])
+        if names:
+            assign = ast.Assign(
+                targets=[ast.Tuple(elts=[_name(n, ast.Store())
+                                         for n in names],
+                                   ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [cond_fn, body_fn, assign]
+
+    # -- boolean operators ----------------------------------------------
+    def visit_BoolOp(self, node: ast.BoolOp):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        expr = node.values[0]
+        for rhs in node.values[1:]:
+            lam = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                                   kwonlyargs=[], kw_defaults=[],
+                                   kwarg=None, defaults=[]),
+                body=rhs)
+            expr = ast.Call(func=_helper_attr(fn), args=[expr, lam],
+                            keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node: ast.UnaryOp):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=_helper_attr("convert_logical_not"),
+                            args=[node.operand], keywords=[])
+        return node
+
+
+# ---------------------------------------------------------------------------
+# translator entry
+# ---------------------------------------------------------------------------
+
+class _LiveGlobals(dict):
+    """Globals mapping for converted functions: local overlay (helper
+    module + closure snapshots) with live delegation to the original
+    module globals for everything else. CPython honors __missing__ for
+    LOAD_GLOBAL on dict subclasses."""
+
+    def __init__(self, base, overlay):
+        super().__init__(overlay)
+        self._base = base
+
+    def __missing__(self, key):
+        return self._base[key]
+
+def unwrap_decorators(tree: ast.Module) -> ast.FunctionDef:
+    fd = tree.body[0]
+    assert isinstance(fd, (ast.FunctionDef, ast.AsyncFunctionDef))
+    fd.decorator_list = []
+    return fd
+
+
+def convert_to_static(fn):
+    """AST-rewrite `fn` into its static-graph-compatible form.
+
+    Falls back to `fn` unchanged (with a warning) when the source is
+    unavailable (builtins, lambdas, REPL) or the rewrite fails —
+    trace-only conversion still works for tensor-free control flow.
+    """
+    if getattr(fn, "__pt_converted__", False):
+        return fn
+    if getattr(fn, "__name__", "") == "<lambda>":
+        # lambdas cannot contain statements — nothing to convert (and
+        # their extracted source is the enclosing assignment, unparsable
+        # as a function)
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        unwrap_decorators(tree)
+        tree = _D2STransformer().visit(tree)
+        ast.fix_missing_locations(tree)
+        code = compile(tree, filename=f"<d2s {fn.__qualname__}>",
+                       mode="exec")
+        # the converted function must see the module's globals LIVE
+        # (late-bound helpers, monkeypatching, mutual recursion), so
+        # lookups delegate to fn.__globals__ at call time; only the
+        # collision-proof helper name and snapshot-by-nature closure
+        # cells live in the overlay
+        glb = _LiveGlobals(fn.__globals__, {_HELPER_NAME: _ops})
+        if fn.__closure__:
+            for nm, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+                try:
+                    glb[nm] = cell.cell_contents
+                except ValueError:
+                    pass
+        ns: dict = {}
+        exec(code, glb, ns)
+        new_fn = ns[fn.__name__]
+        new_fn = functools.wraps(fn)(new_fn)
+        new_fn.__pt_converted__ = True
+        return new_fn
+    except (OSError, TypeError, SyntaxError) as e:
+        warnings.warn(
+            f"dygraph_to_static: could not AST-convert "
+            f"{getattr(fn, '__qualname__', fn)!r} ({e}); falling back "
+            "to trace-only conversion")
+        return fn
+
+
+class ProgramTranslator:
+    """reference ProgramTranslator singleton
+    (dygraph_to_static/program_translator.py:711): global enable switch
+    consulted by @declarative."""
+
+    _instance = None
+    enabled = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, flag: bool):
+        type(self).enabled = bool(flag)
+
+    def get_func(self, fn):
+        return convert_to_static(fn) if self.enabled else fn

@@ -29,14 +29,34 @@ def _to_numpy(v):
 
 class _StaticFunction:
     def __init__(self, fn):
-        self._fn = fn
+        self._orig_fn = fn
+        self._converted = None
         self._cache: Dict[tuple, tuple] = {}
         self._exe = Executor()
         functools.update_wrapper(self, fn)
 
+    @property
+    def _fn(self):
+        """AST pass (reference @declarative runs ProgramTranslator
+        before tracing): tensor-dependent if/while/and/or become
+        cond/while graph ops instead of silently baking one branch in.
+        The ProgramTranslator enable flag is consulted per call, like
+        the reference singleton."""
+        from .dygraph_to_static import ProgramTranslator
+
+        if not ProgramTranslator.enabled:
+            return self._orig_fn
+        if self._converted is None:
+            self._converted = ProgramTranslator.get_instance().get_func(
+                self._orig_fn)
+        return self._converted
+
     def __call__(self, *args):
+        from .dygraph_to_static import ProgramTranslator
+
         arrs = [_to_numpy(a) for a in args]
-        sig = tuple((a.shape, str(a.dtype)) for a in arrs)
+        sig = (ProgramTranslator.enabled,) \
+            + tuple((a.shape, str(a.dtype)) for a in arrs)
         entry = self._cache.get(sig)
         if entry is None:
             entry = self._trace(arrs)
@@ -87,9 +107,15 @@ class _StaticFunction:
 
 
 def _flatten(outs):
+    from .dygraph_to_static.convert_operators import _Undefined
+
+    vals = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+    for v in vals:
+        if isinstance(v, _Undefined):
+            v._raise()  # NameError naming the unbound local
     if isinstance(outs, (list, tuple)):
-        return ("seq", type(outs), len(outs)), list(outs)
-    return ("one", None, 1), [outs]
+        return ("seq", type(outs), len(outs)), vals
+    return ("one", None, 1), vals
 
 
 def _unflatten(structure, vals):

@@ -5,7 +5,7 @@ from .tensor import *  # noqa
 from .loss import *  # noqa
 from .metric_op import accuracy, auc  # noqa
 from . import collective  # noqa
-from .control_flow import cond, While, Switch, Print  # noqa
+from .control_flow import cond, While, Switch, while_loop, Print  # noqa
 from .learning_rate_scheduler import (noam_decay, exponential_decay,  # noqa
                                       natural_exp_decay, inverse_time_decay,
                                       polynomial_decay, piecewise_decay,

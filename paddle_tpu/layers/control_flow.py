@@ -13,7 +13,7 @@ import contextlib
 from ..framework.core import Variable, default_main_program
 from ..framework.layer_helper import LayerHelper
 
-__all__ = ["cond", "While", "Switch", "increment", "array_write",
+__all__ = ["cond", "While", "Switch", "while_loop", "increment", "array_write",
            "array_read", "array_length"]
 
 
@@ -31,12 +31,16 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
     main = helper.main_program
 
     true_blk = main._create_block()
-    true_outs = _as_list(true_fn() if true_fn else None)
-    main._rollback()
+    try:
+        true_outs = _as_list(true_fn() if true_fn else None)
+    finally:
+        main._rollback()
 
     false_blk = main._create_block()
-    false_outs = _as_list(false_fn() if false_fn else None)
-    main._rollback()
+    try:
+        false_outs = _as_list(false_fn() if false_fn else None)
+    finally:
+        main._rollback()
 
     if len(true_outs) != len(false_outs):
         raise ValueError(
@@ -86,8 +90,12 @@ class While:
         main = self._helper.main_program
         parent = main.current_block()
         sub = main._create_block()
-        yield
-        main._rollback()
+        try:
+            yield
+        finally:
+            # an exception in the body must not leave the program's
+            # block stack pointing at the orphaned sub-block
+            main._rollback()
         written = []
         for op in sub.ops:
             for n in op.output_arg_names():
@@ -101,6 +109,34 @@ class While:
             inputs={"Condition": [self._cond], "X": carries},
             outputs={"Out": carries},
             attrs={"sub_block": sub.idx}, infer_shape=False)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None,
+               _initial_pred=None):
+    """Functional while (reference fluid.layers.while_loop,
+    control_flow.py): carries thread through the loop; `cond` maps
+    carries -> bool Variable, `body` maps carries -> new carries.
+    `_initial_pred`: an already-built `cond(*loop_vars)` Variable to
+    reuse (avoids duplicating the entry-condition ops)."""
+    loop_vars = _as_list(loop_vars)
+    if not loop_vars:
+        raise ValueError("while_loop: loop_vars must be non-empty")
+    from .tensor import assign
+
+    pred = _initial_pred if _initial_pred is not None \
+        else cond(*loop_vars)
+    w = While(pred, is_test=is_test, name=name)
+    with w.block():
+        new_vars = _as_list(body(*loop_vars))
+        if len(new_vars) != len(loop_vars):
+            raise ValueError(
+                f"while_loop: body returned {len(new_vars)} vars, "
+                f"expected {len(loop_vars)}")
+        for old, new in zip(loop_vars, new_vars):
+            if new is not old:
+                assign(new, old)
+        assign(cond(*loop_vars), pred)
+    return loop_vars[0] if len(loop_vars) == 1 else list(loop_vars)
 
 
 class Switch:

@@ -1,0 +1,277 @@
+"""AST dygraph-to-static transpiler (reference
+dygraph_to_static/program_translator.py:711 + ifelse/loop/logical
+transformers): tensor-dependent Python control flow under @declarative
+becomes cond/while graph ops; Python-valued control flow keeps exact
+Python semantics."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.dygraph import declarative
+from paddle_tpu.dygraph.dygraph_to_static import (ProgramTranslator,
+                                                  convert_to_static)
+
+
+def _vb(a):
+    from paddle_tpu.dygraph.varbase import VarBase
+
+    with pt.dygraph.guard():
+        return VarBase(np.asarray(a))
+
+
+def run_decl(fn, *arrays):
+    with pt.dygraph.guard():
+        args = [_vb(a) for a in arrays]
+        out = declarative(fn)(*args)
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(o._value) for o in out]
+        return np.asarray(out._value)
+
+
+# ---------------------------------------------------------------------------
+# tensor-dependent if
+# ---------------------------------------------------------------------------
+
+def test_tensor_if_both_branches_traced():
+    def f(x):
+        s = pt.layers.reduce_sum(x)
+        if s > 0:
+            y = x * 2.0
+        else:
+            y = x - 10.0
+        return y
+
+    pos = np.ones((2, 3), np.float32)
+    neg = -np.ones((2, 3), np.float32)
+    np.testing.assert_allclose(run_decl(f, pos), pos * 2.0)
+    # same compiled function must take the OTHER branch on new data —
+    # trace-only conversion would have baked the first branch in
+    np.testing.assert_allclose(run_decl(f, neg), neg - 10.0)
+
+
+def test_tensor_if_same_function_both_paths():
+    def f(x):
+        if pt.layers.reduce_max(x) > 5.0:
+            out = x / 2.0
+        else:
+            out = x + 1.0
+        return out
+
+    g = declarative(f)
+    with pt.dygraph.guard():
+        a = _vb(np.full((2, 2), 10.0, np.float32))
+        b = _vb(np.zeros((2, 2), np.float32))
+        np.testing.assert_allclose(np.asarray(g(a)._value), 5.0)
+        np.testing.assert_allclose(np.asarray(g(b)._value), 1.0)
+
+
+def test_python_if_untouched():
+    def f(x, flag=True):
+        if flag:          # python bool: normal semantics
+            return x + 1.0
+        return x - 1.0
+
+    x = np.zeros((2,), np.float32)
+    np.testing.assert_allclose(run_decl(f, x), x + 1.0)
+
+
+def test_tensor_elif_chain():
+    def f(x):
+        s = pt.layers.reduce_sum(x)
+        if s > 10.0:
+            y = x * 0.0
+        elif s > 0.0:
+            y = x * 2.0
+        else:
+            y = x * -1.0
+        return y
+
+    one = np.ones((4,), np.float32)
+    np.testing.assert_allclose(run_decl(f, 100 * one), 0 * one)
+    np.testing.assert_allclose(run_decl(f, one), 2 * one)
+    np.testing.assert_allclose(run_decl(f, -one), one)
+
+
+# ---------------------------------------------------------------------------
+# tensor while
+# ---------------------------------------------------------------------------
+
+def test_tensor_while_loop():
+    def f(x):
+        # double until the sum exceeds 100
+        while pt.layers.reduce_sum(x) < 100.0:
+            x = x * 2.0
+        return x
+
+    start = np.ones((4,), np.float32)      # sum 4 -> 8 -> ... -> 128
+    np.testing.assert_allclose(run_decl(f, start), 32 * start)
+
+
+def test_python_while_untouched():
+    def f(x):
+        n = 0
+        while n < 3:
+            x = x + 1.0
+            n += 1
+        return x
+
+    np.testing.assert_allclose(run_decl(f, np.zeros((2,), np.float32)),
+                               3.0 * np.ones((2,), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# logical operators
+# ---------------------------------------------------------------------------
+
+def test_tensor_bool_ops():
+    def f(x):
+        a = pt.layers.reduce_sum(x) > 0.0
+        b = pt.layers.reduce_max(x) < 10.0
+        if a and b:
+            y = x + 100.0
+        else:
+            y = x - 100.0
+        return y
+
+    ones = np.ones((3,), np.float32)
+    np.testing.assert_allclose(run_decl(f, ones), ones + 100.0)
+    np.testing.assert_allclose(run_decl(f, 20 * ones), 20 * ones - 100.0)
+
+
+def test_python_shortcircuit_preserved():
+    calls = []
+
+    def f(x, flag=False):
+        def side():
+            calls.append(1)
+            return True
+        if flag and side():
+            return x + 1.0
+        return x
+
+    run_decl(f, np.zeros((2,), np.float32))
+    assert calls == []  # rhs never evaluated: short-circuit intact
+
+
+# ---------------------------------------------------------------------------
+# restrictions / fallbacks
+# ---------------------------------------------------------------------------
+
+def test_return_in_tensor_if_still_loud():
+    def f(x):
+        if pt.layers.reduce_sum(x) > 0:   # return inside: not converted
+            return x * 2.0
+        return x
+
+    with pytest.raises(TypeError, match="control flow"):
+        run_decl(f, np.ones((2,), np.float32))
+
+
+def test_mixed_branch_types_clear_error():
+    def f(x):
+        if pt.layers.reduce_sum(x) > 0:
+            y = x * 2.0
+        else:
+            y = 3          # python int in one branch
+        return y
+
+    with pytest.raises(TypeError, match="tensor in one branch"):
+        run_decl(f, np.ones((2,), np.float32))
+
+
+def test_translator_disable_restores_trace_only():
+    tr = ProgramTranslator.get_instance()
+
+    def f(x):
+        if pt.layers.reduce_sum(x) > 0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y
+
+    tr.enable(False)
+    try:
+        with pytest.raises(TypeError, match="control flow"):
+            run_decl(f, np.ones((2,), np.float32))
+    finally:
+        tr.enable(True)
+    np.testing.assert_allclose(run_decl(f, np.ones((2,), np.float32)),
+                               2 * np.ones((2,), np.float32))
+
+
+def test_enable_toggles_on_already_decorated_function():
+    """Reference semantics: ProgramTranslator.enable(False) affects
+    functions decorated BEFORE the toggle."""
+    def f(x):
+        if pt.layers.reduce_sum(x) > 0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y
+
+    g = declarative(f)      # decorate once, toggle afterwards
+    tr = ProgramTranslator.get_instance()
+    with pt.dygraph.guard():
+        ones = _vb(np.ones((2,), np.float32))
+        np.testing.assert_allclose(np.asarray(g(ones)._value), 2.0)
+        tr.enable(False)
+        try:
+            with pytest.raises(TypeError, match="control flow"):
+                g(ones)
+        finally:
+            tr.enable(True)
+        np.testing.assert_allclose(np.asarray(g(ones)._value), 2.0)
+
+
+_LATE = None
+
+
+def test_late_bound_global_resolves():
+    """Converted functions see module globals live, not a snapshot."""
+    def f(x):
+        if pt.layers.reduce_sum(x) > 0:
+            y = _LATE(x)
+        else:
+            y = _LATE(x) * 2.0
+        return y
+
+    g = declarative(f)
+    global _LATE
+    _LATE = lambda t: t + 5.0   # bound AFTER decoration
+    try:
+        with pt.dygraph.guard():
+            ones = _vb(np.ones((2,), np.float32))
+            np.testing.assert_allclose(np.asarray(g(ones)._value), 6.0)
+    finally:
+        _LATE = None
+
+
+def test_undefined_read_raises_nameerror():
+    def f(x):
+        if False:
+            z = x * 2.0
+        else:
+            w = x  # noqa: F841
+        return z   # z never assigned on the executed path
+
+    with pytest.raises(NameError, match="'z'"):
+        run_decl(f, np.ones((2,), np.float32))
+
+
+def test_convert_to_static_fallback_warns():
+    with pytest.warns(UserWarning, match="could not AST-convert"):
+        out = convert_to_static(abs)  # builtin: no source
+    assert out is abs
+
+
+def test_undefined_var_in_branch_error():
+    def f(x):
+        if pt.layers.reduce_sum(x) > 0:
+            z = x * 2.0       # z undefined in else branch
+        else:
+            w = x - 1.0       # noqa: F841
+        return x
+
+    # z tensor in true branch, undefined in false -> clear error
+    with pytest.raises(TypeError, match="tensor in one branch"):
+        run_decl(f, np.ones((2,), np.float32))
