@@ -39,6 +39,8 @@ from .flags import get_flags, set_flags  # noqa
 from . import memory  # noqa
 from . import errors  # noqa
 from .errors import EnforceNotMet, enforce  # noqa
+from . import vision  # noqa
+from . import text  # noqa
 from . import metrics  # noqa
 from . import dataset  # noqa
 from .dataset import DatasetFactory  # noqa

@@ -1,0 +1,5 @@
+"""paddle.text namespace (reference python/paddle/text/)."""
+from . import datasets  # noqa: F401
+from .datasets import Imdb, Imikolov, Movielens, UCIHousing  # noqa: F401
+
+__all__ = ["datasets", "Imdb", "Imikolov", "Movielens", "UCIHousing"]

@@ -1,0 +1,259 @@
+"""Text datasets (reference python/paddle/text/datasets/{uci_housing,
+imdb,imikolov,movielens}.py; parsers from python/paddle/dataset/).
+
+Zero-egress: every dataset takes a local ``data_file`` (the reference's
+download=False mode) and parses the published file formats unchanged —
+whitespace floats for UCI housing, the aclImdb tar for IMDB, the PTB
+tar for imikolov, the ml-1m zip/directory for movielens.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import re
+import tarfile
+import zipfile
+
+import numpy as np
+
+from ..reader import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "MovieInfo",
+           "UserInfo"]
+
+
+from ..vision.datasets import _need  # shared local-path validator
+
+
+class UCIHousing(Dataset):
+    """506x14 whitespace floats; features min-max/avg normalized like
+    the reference (dataset/uci_housing.py:69-83); train = first 80%."""
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        data_file = _need(data_file, "UCIHousing")
+        data = np.fromfile(data_file, sep=" ", dtype=np.float32)
+        if data.size % 14:
+            raise ValueError(
+                f"UCIHousing: {data.size} values is not a multiple of "
+                "14 features")
+        data = data.reshape(-1, 14)
+        mx, mn, avg = data.max(0), data.min(0), data.mean(0)
+        for i in range(13):
+            data[:, i] = (data[:, i] - avg[i]) / (mx[i] - mn[i])
+        offset = int(data.shape[0] * 0.8)
+        self.data = data[:offset] if mode == "train" else data[offset:]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:13], row[13:]
+
+
+class Imdb(Dataset):
+    """aclImdb tar: tokenize pos/neg reviews, frequency-cutoff word
+    dict (reference text/datasets/imdb.py:77-109)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=False):
+        if mode not in ("train", "test"):
+            raise ValueError(f"Imdb: bad mode {mode!r}")
+        self.data_file = _need(data_file, "Imdb")
+        # one pass over the tar: collect raw docs for every split and
+        # the train+test word frequencies together (the reference
+        # re-scans per polarity; the real tar is ~80 MB gzip)
+        self._raw = self._collect()
+        self.word_idx = self._build_word_dict(cutoff)
+        self.docs, self.labels = self._load(mode)
+
+    _PATTERN = re.compile(
+        r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+
+    def _collect(self):
+        raw = {("train", "pos"): [], ("train", "neg"): [],
+               ("test", "pos"): [], ("test", "neg"): []}
+        with tarfile.open(self.data_file) as tf:
+            for member in tf.getmembers():
+                m = self._PATTERN.match(member.name)
+                if not m:
+                    continue
+                data = tf.extractfile(member).read().decode(
+                    "latin-1").lower()
+                raw[(m.group(1), m.group(2))].append(
+                    data.replace("<br />", " ").split())
+        return raw
+
+    def _build_word_dict(self, cutoff):
+        freq = collections.defaultdict(int)
+        for docs in self._raw.values():
+            for doc in docs:
+                for w in doc:
+                    freq[w] += 1
+        kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self, mode):
+        unk = self.word_idx["<unk>"]
+        docs, labels = [], []
+        # reference imdb.py _load_anno order and polarity: pos=0, neg=1
+        for label, polarity in ((0, "pos"), (1, "neg")):
+            for doc in self._raw[(mode, polarity)]:
+                docs.append(np.asarray(
+                    [self.word_idx.get(w, unk) for w in doc],
+                    np.int64))
+                labels.append(label)
+        return docs, np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+
+class Imikolov(Dataset):
+    """PTB n-grams from the simple-examples tar (reference
+    text/datasets/imikolov.py / dataset/imikolov.py): data_type 'NGRAM'
+    yields N-grams, 'SEQ' yields (src, trg) shifted sequences."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=False):
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError(f"Imikolov: bad data_type {data_type!r}")
+        self.data_file = _need(data_file, "Imikolov")
+        self.data_type = data_type
+        self.window_size = window_size
+        self.word_idx = self._build_dict(min_word_freq)
+        self.data = self._load(mode)
+
+    def _lines(self, which):
+        path = f"./simple-examples/data/ptb.{which}.txt"
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(path)
+            for line in f.read().decode().splitlines():
+                yield line.strip().split()
+
+    def _build_dict(self, min_word_freq):
+        freq = collections.defaultdict(int)
+        for words in self._lines("train"):
+            for w in words:
+                freq[w] += 1
+        freq.pop("<unk>", None)
+        kept = sorted(((w, c) for w, c in freq.items()
+                       if c > min_word_freq), key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self, mode):
+        which = {"train": "train", "test": "test"}[mode]
+        unk = self.word_idx["<unk>"]
+        out = []
+        for words in self._lines(which):
+            if self.data_type == "NGRAM":
+                l = ["<s>"] + words + ["<e>"]
+                if len(l) < self.window_size:
+                    continue
+                ids = [self.word_idx.get(w, unk) for w in l]
+                for i in range(self.window_size, len(ids) + 1):
+                    out.append(np.asarray(
+                        ids[i - self.window_size:i], np.int64))
+            else:
+                l = ["<s>"] + words + ["<e>"]
+                ids = [self.word_idx.get(w, unk) for w in l]
+                if len(ids) < 2:
+                    continue
+                out.append((np.asarray(ids[:-1], np.int64),
+                            np.asarray(ids[1:], np.int64)))
+        return out
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = int(age)
+        self.job_id = int(job_id)
+
+
+class Movielens(Dataset):
+    """ml-1m ratings (reference text/datasets/movielens.py): yields
+    [user_id, gender, age, job, movie_id, categories..., title...,
+    rating]-style tuples; here (user feature vec, movie id, rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        data_file = _need(data_file, "Movielens")
+        read = self._read_zip if zipfile.is_zipfile(data_file) \
+            else self._read_dir
+        users, movies, ratings = read(data_file)
+        self.movie_info = movies
+        self.user_info = users
+        rng = np.random.RandomState(rand_seed)
+        mask = rng.uniform(size=len(ratings)) < test_ratio
+        keep = mask if mode == "test" else ~mask
+        self.samples = [r for r, k in zip(ratings, keep) if k]
+
+    @staticmethod
+    def _parse(users_txt, movies_txt, ratings_txt):
+        users = {}
+        for line in users_txt.splitlines():
+            if not line.strip():
+                continue
+            uid, gender, age, job, _zip = line.split("::")
+            users[int(uid)] = UserInfo(uid, gender, age, job)
+        movies = {}
+        for line in movies_txt.splitlines():
+            if not line.strip():
+                continue
+            mid, title, cats = line.split("::")
+            movies[int(mid)] = MovieInfo(mid, cats.split("|"), title)
+        ratings = []
+        for line in ratings_txt.splitlines():
+            if not line.strip():
+                continue
+            uid, mid, rating, _ts = line.split("::")
+            ratings.append((int(uid), int(mid), float(rating)))
+        return users, movies, ratings
+
+    def _read_zip(self, path):
+        with zipfile.ZipFile(path) as z:
+            root = next(n for n in z.namelist()
+                        if n.endswith("users.dat")).rsplit("/", 1)[0]
+            dec = lambda n: z.read(f"{root}/{n}").decode("latin-1")
+            return self._parse(dec("users.dat"), dec("movies.dat"),
+                               dec("ratings.dat"))
+
+    def _read_dir(self, path):
+        def rd(n):
+            with open(os.path.join(path, n), encoding="latin-1") as f:
+                return f.read()
+        return self._parse(rd("users.dat"), rd("movies.dat"),
+                           rd("ratings.dat"))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        uid, mid, rating = self.samples[idx]
+        u = self.user_info[uid]
+        feat = np.asarray([uid, int(u.is_male), u.age, u.job_id, mid],
+                          np.int64)
+        return feat, np.float32(rating)

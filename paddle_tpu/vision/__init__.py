@@ -1,0 +1,7 @@
+"""paddle.vision namespace (reference python/paddle/vision/)."""
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from .models import LeNet, ResNet, VGG  # noqa: F401
+
+__all__ = ["transforms", "datasets", "models", "LeNet", "ResNet", "VGG"]

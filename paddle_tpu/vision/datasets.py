@@ -1,0 +1,223 @@
+"""Vision datasets (reference python/paddle/vision/datasets/{mnist,
+cifar,folder}.py + python/paddle/dataset/{mnist,cifar}.py parsers).
+
+Zero-egress environment: ``download=True`` is unavailable — every
+dataset takes explicit local paths (the reference's
+image_path/label_path/data_file arguments with download=False) and
+raises a clear error otherwise. File formats match the published
+datasets exactly (idx-ubyte for MNIST, python-pickle tar for CIFAR,
+class-per-directory for ImageFolder), so real downloaded copies load
+unchanged.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..reader import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100",
+           "DatasetFolder", "ImageFolder"]
+
+
+def _need(path, what):
+    if not path:
+        raise ValueError(
+            f"{what}: downloads are unavailable in this environment; "
+            "pass the local file path (reference download=False mode)")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"{what}: no such file {path!r}")
+    return path
+
+
+def _open_maybe_gz(path):
+    return gzip.open(path, "rb") if path.endswith(".gz") \
+        else open(path, "rb")
+
+
+class MNIST(Dataset):
+    """idx-ubyte MNIST (reference vision/datasets/mnist.py:30; parser
+    semantics from dataset/mnist.py:53-70). Yields (image HW1 float32,
+    label int64); `transform` applies to the image."""
+
+    NAME = "MNIST"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        image_path = _need(image_path, f"{self.NAME} images")
+        label_path = _need(label_path, f"{self.NAME} labels")
+        self.transform = transform
+        with _open_maybe_gz(image_path) as f:
+            buf = f.read()
+        magic, n, rows, cols = struct.unpack_from(">IIII", buf, 0)
+        if magic != 2051:
+            raise ValueError(
+                f"{self.NAME}: bad image-file magic {magic} (expected "
+                "2051 — idx3-ubyte)")
+        self.images = np.frombuffer(
+            buf, np.uint8, count=n * rows * cols,
+            offset=struct.calcsize(">IIII")).reshape(n, rows, cols, 1)
+        with _open_maybe_gz(label_path) as f:
+            buf = f.read()
+        magic, n2 = struct.unpack_from(">II", buf, 0)
+        if magic != 2049:
+            raise ValueError(
+                f"{self.NAME}: bad label-file magic {magic} (expected "
+                "2049 — idx1-ubyte)")
+        self.labels = np.frombuffer(buf, np.uint8, count=n2,
+                                    offset=struct.calcsize(">II"))
+        if n != n2:
+            raise ValueError(
+                f"{self.NAME}: {n} images but {n2} labels")
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        # raw uint8 HWC to the transform (the reference hands ToTensor
+        # a PIL image; dtype-keyed scaling needs the original dtype)
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)
+        return img, np.int64(self.labels[idx])
+
+
+class FashionMNIST(MNIST):
+    NAME = "FashionMNIST"
+
+
+class _CifarBase(Dataset):
+    """python-pickle tar (reference vision/datasets/cifar.py +
+    dataset/cifar.py): members data_batch_*/test_batch (cifar-10) or
+    train/test (cifar-100)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, members=None, label_key=b"labels"):
+        data_file = _need(data_file, type(self).__name__)
+        self.transform = transform
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tar:
+            names = [m.name for m in tar.getmembers()]
+            want = [n for n in names
+                    if any(n.endswith(m) for m in members)]
+            if not want:
+                raise ValueError(
+                    f"{type(self).__name__}: no {members} members in "
+                    f"{data_file!r} (found {names[:5]}...)")
+            for name in sorted(want):
+                d = pickle.load(tar.extractfile(name),
+                                encoding="bytes")
+                images.append(np.asarray(d[b"data"], np.uint8))
+                labels.extend(d[label_key])
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32) \
+            .transpose(0, 2, 3, 1)  # HWC
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar10(_CifarBase):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False):
+        members = ["test_batch"] if mode == "test" else \
+            [f"data_batch_{i}" for i in range(1, 6)]
+        super().__init__(data_file, mode, transform, download,
+                         members=members, label_key=b"labels")
+
+
+class Cifar100(_CifarBase):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False):
+        members = ["test"] if mode == "test" else ["train"]
+        super().__init__(data_file, mode, transform, download,
+                         members=members, label_key=b"fine_labels")
+
+
+_IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+def _load_image(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"))
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory layout (reference
+    vision/datasets/folder.py DatasetFolder)."""
+
+    def __init__(self, root, transform=None, extensions=None,
+                 loader=None):
+        root = _need(root, "DatasetFolder root")
+        self.transform = transform
+        self.loader = loader or _load_image
+        exts = tuple(extensions or _IMG_EXTENSIONS)
+        self.classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        if not self.classes:
+            raise ValueError(
+                f"DatasetFolder: no class subdirectories in {root!r}")
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        self.samples = []
+        for c in self.classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    if fname.lower().endswith(exts):
+                        self.samples.append(
+                            (os.path.join(dirpath, fname),
+                             self.class_to_idx[c]))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+class ImageFolder(Dataset):
+    """flat/recursive image listing without labels (reference
+    folder.py ImageFolder)."""
+
+    def __init__(self, root, transform=None, extensions=None,
+                 loader=None):
+        root = _need(root, "ImageFolder root")
+        self.transform = transform
+        self.loader = loader or _load_image
+        exts = tuple(extensions or _IMG_EXTENSIONS)
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                if fname.lower().endswith(exts):
+                    self.samples.append(os.path.join(dirpath, fname))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]

@@ -1,0 +1,247 @@
+"""Vision model zoo as dygraph Layers (reference
+python/paddle/vision/models/{lenet,vgg,resnet}.py).
+
+Pretrained weights are not downloadable here (zero egress); the
+constructors accept ``pretrained=False`` only and load weights via the
+normal ``set_state_dict`` path instead.
+"""
+from __future__ import annotations
+
+from ..dygraph import (BatchNorm, Conv2D, Layer, Linear, Pool2D,
+                       Sequential)
+from ..dygraph.nn import Flatten
+
+__all__ = ["LeNet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+           "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+           "resnet152"]
+
+
+def _no_pretrained(pretrained, name):
+    if pretrained:
+        raise ValueError(
+            f"{name}: pretrained weights are not downloadable in this "
+            "environment; construct with pretrained=False and load a "
+            "local state_dict")
+
+
+class LeNet(Layer):
+    """reference vision/models/lenet.py (28x28 single-channel)."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(1, 6, 3, stride=1, padding=1, act="relu"),
+            Pool2D(2, "max", 2),
+            Conv2D(6, 16, 5, stride=1, padding=0, act="relu"),
+            Pool2D(2, "max", 2),
+        )
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Sequential(
+                Flatten(),
+                Linear(400, 120, act="relu"),
+                Linear(120, 84, act="relu"),
+                Linear(84, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.fc(x)
+        return x
+
+
+_VGG_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512,
+          "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512,
+          512, "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512,
+          512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(Layer):
+    """reference vision/models/vgg.py (batch-norm variant)."""
+
+    def __init__(self, cfg, num_classes=1000, with_pool=True):
+        super().__init__()
+        layers, c_in = [], 3
+        for v in cfg:
+            if v == "M":
+                layers.append(Pool2D(2, "max", 2))
+            else:
+                layers.append(Conv2D(c_in, v, 3, padding=1))
+                layers.append(BatchNorm(v, act="relu"))
+                c_in = v
+        self.features = Sequential(*layers)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Flatten(),
+                Linear(512 * 7 * 7, 4096, act="relu"),
+                Linear(4096, 4096, act="relu"),
+                Linear(4096, num_classes),
+            )
+
+    def forward(self, x):
+        from .. import layers as L
+
+        x = self.features(x)
+        if self.with_pool:
+            # reference vgg.py AdaptiveAvgPool2D((7,7)); static-shape
+            # XLA needs the feature map divisible by 7 (224-class
+            # inputs; see ops/nn_ops.py adaptive pool)
+            x = L.adaptive_pool2d(x, [7, 7], pool_type="avg")
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        return x
+
+
+def _vgg(cfg_key, pretrained, name, **kw):
+    _no_pretrained(pretrained, name)
+    return VGG(_VGG_CFGS[cfg_key], **kw)
+
+
+def vgg11(pretrained=False, **kw):
+    return _vgg("A", pretrained, "vgg11", **kw)
+
+
+def vgg13(pretrained=False, **kw):
+    return _vgg("B", pretrained, "vgg13", **kw)
+
+
+def vgg16(pretrained=False, **kw):
+    return _vgg("D", pretrained, "vgg16", **kw)
+
+
+def vgg19(pretrained=False, **kw):
+    return _vgg("E", pretrained, "vgg19", **kw)
+
+
+class _BasicBlock(Layer):
+    expansion = 1
+
+    def __init__(self, c_in, c_out, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = Conv2D(c_in, c_out, 3, stride=stride, padding=1,
+                            bias_attr=False)
+        self.bn1 = BatchNorm(c_out, act="relu")
+        self.conv2 = Conv2D(c_out, c_out, 3, stride=1, padding=1,
+                            bias_attr=False)
+        self.bn2 = BatchNorm(c_out)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        out = self.bn2(self.conv2(self.bn1(self.conv1(x))))
+        from .. import layers as L
+
+        return L.relu(out + identity)
+
+
+class _BottleneckBlock(Layer):
+    expansion = 4
+
+    def __init__(self, c_in, c_out, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = Conv2D(c_in, c_out, 1, bias_attr=False)
+        self.bn1 = BatchNorm(c_out, act="relu")
+        self.conv2 = Conv2D(c_out, c_out, 3, stride=stride, padding=1,
+                            bias_attr=False)
+        self.bn2 = BatchNorm(c_out, act="relu")
+        self.conv3 = Conv2D(c_out, c_out * 4, 1, bias_attr=False)
+        self.bn3 = BatchNorm(c_out * 4)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        out = self.bn1(self.conv1(x))
+        out = self.bn2(self.conv2(out))
+        out = self.bn3(self.conv3(out))
+        from .. import layers as L
+
+        return L.relu(out + identity)
+
+
+class ResNet(Layer):
+    """reference vision/models/resnet.py."""
+
+    def __init__(self, block, depth_cfg, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.conv = Conv2D(3, 64, 7, stride=2, padding=3,
+                           bias_attr=False)
+        self.bn = BatchNorm(64, act="relu")
+        self.maxpool = Pool2D(3, "max", 2, pool_padding=1)
+        self.c_in = 64
+        self.layer1 = self._make_layer(block, 64, depth_cfg[0], 1)
+        self.layer2 = self._make_layer(block, 128, depth_cfg[1], 2)
+        self.layer3 = self._make_layer(block, 256, depth_cfg[2], 2)
+        self.layer4 = self._make_layer(block, 512, depth_cfg[3], 2)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, c_out, blocks, stride):
+        downsample = None
+        if stride != 1 or self.c_in != c_out * block.expansion:
+            downsample = Sequential(
+                Conv2D(self.c_in, c_out * block.expansion, 1,
+                       stride=stride, bias_attr=False),
+                BatchNorm(c_out * block.expansion))
+        layers = [block(self.c_in, c_out, stride, downsample)]
+        self.c_in = c_out * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.c_in, c_out))
+        return Sequential(*layers)
+
+    def forward(self, x):
+        from .. import layers as L
+
+        x = self.maxpool(self.bn(self.conv(x)))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        if self.with_pool:
+            x = L.reduce_mean(x, dim=[2, 3])  # global average pool
+        if self.num_classes > 0:
+            x = self.fc(x)
+        return x
+
+
+_RESNET_CFGS = {
+    18: (_BasicBlock, [2, 2, 2, 2]),
+    34: (_BasicBlock, [3, 4, 6, 3]),
+    50: (_BottleneckBlock, [3, 4, 6, 3]),
+    101: (_BottleneckBlock, [3, 4, 23, 3]),
+    152: (_BottleneckBlock, [3, 8, 36, 3]),
+}
+
+
+def _resnet(depth, pretrained, **kw):
+    _no_pretrained(pretrained, f"resnet{depth}")
+    block, cfg = _RESNET_CFGS[depth]
+    return ResNet(block, cfg, **kw)
+
+
+def resnet18(pretrained=False, **kw):
+    return _resnet(18, pretrained, **kw)
+
+
+def resnet34(pretrained=False, **kw):
+    return _resnet(34, pretrained, **kw)
+
+
+def resnet50(pretrained=False, **kw):
+    return _resnet(50, pretrained, **kw)
+
+
+def resnet101(pretrained=False, **kw):
+    return _resnet(101, pretrained, **kw)
+
+
+def resnet152(pretrained=False, **kw):
+    return _resnet(152, pretrained, **kw)

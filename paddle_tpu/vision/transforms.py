@@ -1,0 +1,316 @@
+"""Vision transforms (reference python/paddle/vision/transforms/
+transforms.py:83-1170 + functional.py).
+
+numpy-first: every transform consumes/produces HWC numpy arrays (PIL
+images are accepted and converted on entry — the reference's 'pil'
+backend); interpolation is implemented directly on arrays so the
+pipeline has no hard cv2/PIL dependency.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+__all__ = [
+    "Compose", "BaseTransform", "ToTensor", "Resize", "CenterCrop",
+    "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+    "Normalize", "Transpose", "Pad", "Grayscale", "BrightnessTransform",
+    "ContrastTransform", "RandomResizedCrop",
+    "resize", "center_crop", "hflip", "vflip", "normalize", "to_tensor",
+]
+
+
+def _to_hwc(img) -> np.ndarray:
+    if isinstance(img, np.ndarray):
+        arr = img
+    else:  # PIL image
+        arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def _pair(size):
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+# ---------------------------------------------------------------------------
+# functional ops (reference transforms/functional.py)
+# ---------------------------------------------------------------------------
+
+def resize(img, size, interpolation="bilinear") -> np.ndarray:
+    """size: int (short side) or (h, w). Bilinear/nearest on numpy."""
+    arr = _to_hwc(img)
+    h, w = arr.shape[:2]
+    if isinstance(size, numbers.Number):
+        # reference semantics: resize the SHORT side to `size`, keep AR
+        if h <= w:
+            oh, ow = int(size), max(1, int(round(w * size / h)))
+        else:
+            oh, ow = max(1, int(round(h * size / w))), int(size)
+    else:
+        oh, ow = _pair(size)
+    if (oh, ow) == (h, w):
+        return arr
+    if interpolation == "nearest":
+        ys = np.clip(np.round(np.arange(oh) * h / oh).astype(int), 0,
+                     h - 1)
+        xs = np.clip(np.round(np.arange(ow) * w / ow).astype(int), 0,
+                     w - 1)
+        return arr[ys][:, xs]
+    # bilinear, half-pixel centers
+    dt = arr.dtype
+    y = (np.arange(oh) + 0.5) * h / oh - 0.5
+    x = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.clip(np.floor(y).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(x).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    ly = np.clip(y - y0, 0, 1)[:, None, None]
+    lx = np.clip(x - x0, 0, 1)[None, :, None]
+    a = arr.astype(np.float64)
+    out = (a[y0][:, x0] * (1 - ly) * (1 - lx)
+           + a[y0][:, x1] * (1 - ly) * lx
+           + a[y1][:, x0] * ly * (1 - lx)
+           + a[y1][:, x1] * ly * lx)
+    if np.issubdtype(dt, np.integer):
+        out = np.round(out).clip(np.iinfo(dt).min, np.iinfo(dt).max)
+    return out.astype(dt)
+
+
+def center_crop(img, output_size) -> np.ndarray:
+    arr = _to_hwc(img)
+    th, tw = _pair(output_size)
+    h, w = arr.shape[:2]
+    i = max(0, (h - th) // 2)
+    j = max(0, (w - tw) // 2)
+    return arr[i:i + th, j:j + tw]
+
+
+def hflip(img) -> np.ndarray:
+    return _to_hwc(img)[:, ::-1]
+
+
+def vflip(img) -> np.ndarray:
+    return _to_hwc(img)[::-1]
+
+
+def normalize(img, mean, std, data_format="CHW") -> np.ndarray:
+    arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (arr - mean[:, None, None]) / std[:, None, None]
+    return (arr - mean) / std
+
+
+def to_tensor(img, data_format="CHW") -> np.ndarray:
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference ToTensor).
+    Scaling keys off the INPUT dtype (integer images scale by their
+    type range; float images pass through), like the reference."""
+    raw = _to_hwc(img)
+    arr = raw.astype(np.float32)
+    if np.issubdtype(raw.dtype, np.integer):
+        arr = arr / float(np.iinfo(raw.dtype).max)
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# transform classes
+# ---------------------------------------------------------------------------
+
+class BaseTransform:
+    """reference transforms.py:134 — callable on an image (and
+    optionally more inputs, applied to the first)."""
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, pad_if_needed=True):
+        self.size = _pair(size)
+        self.pad_if_needed = pad_if_needed
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        th, tw = self.size
+        h, w = arr.shape[:2]
+        if self.pad_if_needed and (h < th or w < tw):
+            ph, pw = max(0, th - h), max(0, tw - w)
+            arr = np.pad(arr, ((0, ph), (0, pw), (0, 0)))
+            h, w = arr.shape[:2]
+        i = random.randint(0, h - th)
+        j = random.randint(0, w - tw)
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomResizedCrop(BaseTransform):
+    """reference transforms.py:396 — random area/ratio crop + resize."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 interpolation="bilinear"):
+        self.size = _pair(size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = random.randint(0, h - ch)
+                j = random.randint(0, w - cw)
+                return resize(arr[i:i + ch, j:j + cw], self.size,
+                              self.interpolation)
+        return resize(center_crop(arr, (min(h, w), min(h, w))),
+                      self.size, self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else _to_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else _to_hwc(img)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW"):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = tuple(order)
+
+    def _apply_image(self, img):
+        return _to_hwc(img).transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, numbers.Number):
+            padding = (padding,) * 4  # left, top, right, bottom
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        l, t, r, b = self.padding
+        if self.padding_mode == "constant":
+            return np.pad(arr, ((t, b), (l, r), (0, 0)),
+                          constant_values=self.fill)
+        return np.pad(arr, ((t, b), (l, r), (0, 0)),
+                      mode=self.padding_mode)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img).astype(np.float32)
+        if arr.shape[2] == 1:
+            g = arr
+        else:
+            g = (0.299 * arr[:, :, :1] + 0.587 * arr[:, :, 1:2]
+                 + 0.114 * arr[:, :, 2:3])
+        g = np.round(g).astype(_to_hwc(img).dtype) \
+            if np.issubdtype(_to_hwc(img).dtype, np.integer) else g
+        return np.repeat(g, self.num_output_channels, axis=2)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        out = arr.astype(np.float32) * f
+        if np.issubdtype(arr.dtype, np.integer):
+            out = out.clip(0, 255)
+        return out.astype(arr.dtype)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = arr.astype(np.float32).mean()
+        out = (arr.astype(np.float32) - mean) * f + mean
+        if np.issubdtype(arr.dtype, np.integer):
+            out = out.clip(0, 255)
+        return out.astype(arr.dtype)

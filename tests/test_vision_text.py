@@ -1,0 +1,290 @@
+"""paddle.vision / paddle.text namespaces (reference
+python/paddle/vision/, python/paddle/text/): transforms math vs numpy,
+dataset parsers against synthetic files in the published formats, model
+zoo forward shapes."""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import transforms as T
+
+
+# ---------------------------------------------------------------------------
+# transforms
+# ---------------------------------------------------------------------------
+
+def test_resize_shapes_and_short_side():
+    img = np.arange(24 * 12 * 3, dtype=np.uint8).reshape(24, 12, 3)
+    assert T.resize(img, (6, 8)).shape == (6, 8, 3)
+    # int size: short side -> 6, AR kept (24x12 -> 12x6)
+    assert T.resize(img, 6).shape == (12, 6, 3)
+    assert T.resize(img, 6, "nearest").shape == (12, 6, 3)
+
+
+def test_resize_bilinear_matches_constant_image():
+    img = np.full((10, 10, 3), 7.0, np.float32)
+    out = T.resize(img, (4, 4))
+    np.testing.assert_allclose(out, 7.0, rtol=1e-6)
+
+
+def test_center_crop_and_flips():
+    img = np.arange(5 * 5, dtype=np.float32).reshape(5, 5, 1)
+    c = T.center_crop(img, 3)
+    np.testing.assert_allclose(c[..., 0], img[1:4, 1:4, 0])
+    np.testing.assert_allclose(T.hflip(img), img[:, ::-1])
+    np.testing.assert_allclose(T.vflip(img), img[::-1])
+
+
+def test_to_tensor_and_normalize():
+    img = np.full((4, 4, 3), 255, np.uint8)
+    t = T.ToTensor()(img)
+    assert t.shape == (3, 4, 4) and t.dtype == np.float32
+    np.testing.assert_allclose(t, 1.0)
+    # dark uint8 images scale by dtype range, not by value
+    dark = np.full((2, 2, 3), 1, np.uint8)
+    np.testing.assert_allclose(T.ToTensor()(dark), 1.0 / 255.0)
+    # float inputs pass through unscaled
+    f = np.full((2, 2, 3), 2.5, np.float32)
+    np.testing.assert_allclose(T.ToTensor()(f), 2.5)
+    n = T.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5])(t)
+    np.testing.assert_allclose(n, 1.0)
+
+
+def test_compose_pipeline():
+    tr = T.Compose([T.Resize(8), T.CenterCrop(6), T.ToTensor(),
+                    T.Normalize([0.0] * 3, [1.0] * 3)])
+    out = tr(np.zeros((16, 16, 3), np.uint8))
+    assert out.shape == (3, 6, 6)
+
+
+def test_random_transforms_shapes():
+    img = np.zeros((9, 9, 3), np.uint8)
+    assert T.RandomCrop(4)(img).shape == (4, 4, 3)
+    assert T.RandomResizedCrop(5)(img).shape == (5, 5, 3)
+    assert T.RandomHorizontalFlip(1.0)(img).shape == (9, 9, 3)
+    assert T.Pad(2)(img).shape == (13, 13, 3)
+    assert T.Grayscale(3)(img).shape == (9, 9, 3)
+    assert T.BrightnessTransform(0.4)(img).shape == (9, 9, 3)
+    assert T.ContrastTransform(0.4)(img).shape == (9, 9, 3)
+
+
+# ---------------------------------------------------------------------------
+# vision datasets (synthetic files in published formats)
+# ---------------------------------------------------------------------------
+
+def _write_mnist(tmpdir, n=10, gz=True):
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, (n, 28, 28), dtype=np.uint8)
+    labels = rng.randint(0, 10, n).astype(np.uint8)
+    ipath = os.path.join(tmpdir, "images-idx3-ubyte.gz")
+    lpath = os.path.join(tmpdir, "labels-idx1-ubyte.gz")
+    op = gzip.open if gz else open
+    with op(ipath, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(images.tobytes())
+    with op(lpath, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return ipath, lpath, images, labels
+
+
+def test_mnist_dataset(tmp_path):
+    ipath, lpath, images, labels = _write_mnist(str(tmp_path))
+    ds = pt.vision.datasets.MNIST(image_path=ipath, label_path=lpath)
+    assert len(ds) == 10
+    img, lab = ds[3]
+    assert img.shape == (28, 28, 1)
+    np.testing.assert_allclose(img[..., 0], images[3])
+    assert lab == labels[3]
+    # with transform
+    ds2 = pt.vision.datasets.MNIST(image_path=ipath, label_path=lpath,
+                                   transform=T.ToTensor())
+    assert ds2[0][0].shape == (1, 28, 28)
+
+
+def test_mnist_bad_magic(tmp_path):
+    p = tmp_path / "bad"
+    p.write_bytes(struct.pack(">IIII", 1234, 1, 28, 28))
+    with pytest.raises(ValueError, match="magic"):
+        pt.vision.datasets.MNIST(image_path=str(p), label_path=str(p))
+
+
+def test_mnist_download_unavailable():
+    with pytest.raises(ValueError, match="download"):
+        pt.vision.datasets.MNIST()
+
+
+def _write_cifar10(path, n_per_batch=4):
+    rng = np.random.RandomState(1)
+    with tarfile.open(path, "w:gz") as tar:
+        import io
+
+        for name in [f"cifar-10-batches-py/data_batch_{i}"
+                     for i in range(1, 6)] + \
+                ["cifar-10-batches-py/test_batch"]:
+            d = {b"data": rng.randint(
+                    0, 256, (n_per_batch, 3072), dtype=np.uint8),
+                 b"labels": rng.randint(0, 10, n_per_batch).tolist()}
+            raw = pickle.dumps(d)
+            info = tarfile.TarInfo(name)
+            info.size = len(raw)
+            tar.addfile(info, io.BytesIO(raw))
+
+
+def test_cifar10_dataset(tmp_path):
+    p = str(tmp_path / "cifar-10-python.tar.gz")
+    _write_cifar10(p)
+    train = pt.vision.datasets.Cifar10(data_file=p, mode="train")
+    test = pt.vision.datasets.Cifar10(data_file=p, mode="test")
+    assert len(train) == 20 and len(test) == 4
+    img, lab = train[0]
+    assert img.shape == (32, 32, 3) and img.dtype == np.uint8
+    assert 0 <= lab < 10
+
+
+def test_dataset_folder(tmp_path):
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            np.save(d / f"{i}.npy",
+                    np.zeros((8, 8, 3), np.uint8))
+    ds = pt.vision.datasets.DatasetFolder(str(tmp_path))
+    assert len(ds) == 6
+    assert ds.classes == ["cat", "dog"]
+    img, lab = ds[5]
+    assert img.shape == (8, 8, 3) and lab == 1
+    flat = pt.vision.datasets.ImageFolder(str(tmp_path))
+    assert len(flat) == 6
+
+
+# ---------------------------------------------------------------------------
+# vision models
+# ---------------------------------------------------------------------------
+
+def test_lenet_forward():
+    with pt.dygraph.guard():
+        m = pt.vision.models.LeNet()
+        x = pt.dygraph.VarBase(
+            np.zeros((2, 1, 28, 28), np.float32))
+        out = m(x)
+        assert tuple(np.asarray(out._value).shape) == (2, 10)
+
+
+def test_resnet18_forward_tiny():
+    with pt.dygraph.guard():
+        m = pt.vision.models.resnet18(num_classes=7)
+        x = pt.dygraph.VarBase(
+            np.zeros((1, 3, 32, 32), np.float32))
+        out = m(x)
+        assert tuple(np.asarray(out._value).shape) == (1, 7)
+
+
+def test_pretrained_rejected():
+    with pytest.raises(ValueError, match="pretrained"):
+        pt.vision.models.resnet50(pretrained=True)
+
+
+# ---------------------------------------------------------------------------
+# text datasets
+# ---------------------------------------------------------------------------
+
+def test_uci_housing(tmp_path):
+    rng = np.random.RandomState(2)
+    data = rng.uniform(1, 10, (50, 14)).astype(np.float32)
+    p = tmp_path / "housing.data"
+    with open(p, "w") as f:
+        for row in data:
+            f.write(" ".join(f"{v:.4f}" for v in row) + "\n")
+    train = pt.text.UCIHousing(data_file=str(p), mode="train")
+    test = pt.text.UCIHousing(data_file=str(p), mode="test")
+    assert len(train) == 40 and len(test) == 10
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # features normalized: |x| bounded by ~1
+    assert np.abs(x).max() <= 1.0 + 1e-5
+
+
+def _write_imdb(path):
+    import io
+
+    docs = {
+        "train/pos/0.txt": b"good good movie " * 60,
+        "train/neg/0.txt": b"bad bad movie " * 60,
+        "test/pos/0.txt": b"good film",
+        "test/neg/0.txt": b"bad film",
+    }
+    with tarfile.open(path, "w:gz") as tar:
+        for name, content in docs.items():
+            info = tarfile.TarInfo(f"aclImdb/{name}")
+            info.size = len(content)
+            tar.addfile(info, io.BytesIO(content))
+
+
+def test_imdb(tmp_path):
+    p = str(tmp_path / "aclImdb_v1.tar.gz")
+    _write_imdb(p)
+    ds = pt.text.Imdb(data_file=p, mode="train", cutoff=50)
+    assert len(ds) == 2
+    assert "good" in ds.word_idx and "movie" in ds.word_idx
+    doc, label = ds[0]
+    assert doc.dtype == np.int64
+    # reference polarity: pos docs first with label 0, then neg with 1
+    assert ds[0][1] == 0 and ds[1][1] == 1
+    good = ds.word_idx["good"]
+    assert good in ds[0][0]  # first doc is the positive review
+
+
+def _write_ptb(path):
+    import io
+
+    lines = {"train": "the cat sat on the mat\nthe dog sat\n" * 30,
+             "test": "the cat ran\n"}
+    with tarfile.open(path, "w:gz") as tar:
+        for which, text in lines.items():
+            content = text.encode()
+            info = tarfile.TarInfo(
+                f"./simple-examples/data/ptb.{which}.txt")
+            info.size = len(content)
+            tar.addfile(info, io.BytesIO(content))
+
+
+def test_imikolov(tmp_path):
+    p = str(tmp_path / "simple-examples.tgz")
+    _write_ptb(p)
+    ds = pt.text.Imikolov(data_file=p, data_type="NGRAM", window_size=3,
+                          min_word_freq=5)
+    assert len(ds) > 0
+    gram = ds[0]
+    assert gram.shape == (3,)
+    seq = pt.text.Imikolov(data_file=p, data_type="SEQ",
+                           min_word_freq=5, mode="test")
+    src, trg = seq[0]
+    assert len(src) == len(trg)
+    np.testing.assert_array_equal(src[1:], trg[:-1])
+
+
+def test_movielens(tmp_path):
+    d = tmp_path / "ml-1m"
+    d.mkdir()
+    (d / "users.dat").write_text(
+        "1::M::25::4::12345\n2::F::35::7::67890\n")
+    (d / "movies.dat").write_text(
+        "10::Movie A (1990)::Comedy|Drama\n20::Movie B (1995)::Action\n")
+    (d / "ratings.dat").write_text(
+        "1::10::5::978300760\n2::20::3::978302109\n"
+        "1::20::4::978301968\n")
+    ds = pt.text.Movielens(data_file=str(d), mode="train",
+                           test_ratio=0.0)
+    assert len(ds) == 3
+    feat, rating = ds[0]
+    assert feat.shape == (5,) and feat.dtype == np.int64
+    assert rating in (5.0, 3.0, 4.0)
+    assert ds.movie_info[10].categories == ["Comedy", "Drama"]
+    assert ds.user_info[2].is_male is False
