@@ -75,8 +75,15 @@ def program_from_dict(d: dict) -> Program:
                                      trainable=vd.get("trainable", True))
             else:
                 blk.create_var(name=vd["name"], **kwargs)
+        from ..ops.registry import ensure_grad_op_registered
+
         for od in bd["ops"]:
             attrs = {k: _restore_attr(v) for k, v in od["attrs"].items()}
+            if od["type"].endswith("_grad"):
+                # auto-derived grad lowerings register lazily when
+                # append_backward runs; a deserialized program carries
+                # the grad ops without that step having run here
+                ensure_grad_op_registered(od["type"][:-len("_grad")])
             blk.append_op(od["type"], inputs=od["inputs"],
                           outputs=od["outputs"], attrs=attrs,
                           infer_shape=False)

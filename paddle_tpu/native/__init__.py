@@ -28,6 +28,52 @@ def _build(src: str, out: str) -> bool:
         return False
 
 
+def _build_embedded(src_name: str, out_name: str, extra_flags):
+    """mtime-cached g++ build of an embedded-python artifact; returns
+    the output path or None (no toolchain / libpython). Staleness keys
+    on the source AND the C API header it may include."""
+    src = os.path.join(_DIR, src_name)
+    out = os.path.join(_DIR, out_name)
+    header = os.path.join(_DIR, "paddle_tpu_c_api.h")
+    newest_dep = max(os.path.getmtime(src),
+                     os.path.getmtime(header)
+                     if os.path.exists(header) else 0)
+    if os.path.exists(out) and os.path.getmtime(out) >= newest_dep:
+        return out
+    cflags, ldflags = _python_flags()
+    try:
+        subprocess.run(["g++", "-O2"] + extra_flags + ["-o", out, src]
+                       + cflags + ldflags,
+                       check=True, capture_output=True, timeout=180)
+        return out
+    except Exception:
+        return None
+
+
+def _python_flags():
+    """Compile/link flags for embedding this interpreter."""
+    import sysconfig
+
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    return ([f"-I{inc}"],
+            [f"-L{libdir}", f"-Wl,-rpath,{libdir}", f"-lpython{ver}"])
+
+
+def build_train_demo() -> Optional[str]:
+    """Compile the C++ train entry (train_demo.cc); returns the binary
+    path or None when the toolchain/libpython is unavailable."""
+    return _build_embedded("train_demo.cc", "train_demo", [])
+
+
+def build_c_api() -> Optional[str]:
+    """Compile the C inference ABI (capi.cc) into a shared library."""
+    return _build_embedded("capi.cc", "libpaddle_tpu_c.so",
+                           ["-shared", "-fPIC"])
+
+
 def datafeed_lib() -> Optional[ctypes.CDLL]:
     """The datafeed parser library, building it on first use."""
     global _LIB, _TRIED

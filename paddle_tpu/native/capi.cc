@@ -1,0 +1,170 @@
+// C API implementation (see paddle_tpu_c_api.h).
+//
+// Reference analog: inference/capi/pd_predictor.cc. Hosts a CPython
+// interpreter (booted once, shared by all predictors) and maps the C
+// calls onto paddle_tpu.native.embed.CPredictor — buffers cross the
+// boundary as bytes objects (no per-element boxing).
+#include "paddle_tpu_c_api.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+struct PT_Predictor {
+    PyObject* obj;                       // embed.CPredictor
+    std::vector<std::string> in_names;
+    std::vector<std::string> out_names;
+};
+
+namespace {
+
+// Every C entry point runs under the GIL: the host may have embedded
+// Python itself and released it (PyEval_SaveThread), so acquisition
+// must go through PyGILState_Ensure rather than assuming ownership.
+class GilGuard {
+ public:
+    GilGuard() {
+        if (!Py_IsInitialized()) Py_Initialize();
+        state_ = PyGILState_Ensure();
+    }
+    ~GilGuard() { PyGILState_Release(state_); }
+
+ private:
+    PyGILState_STATE state_;
+};
+
+PyObject* embed_module() {
+    static PyObject* mod = nullptr;
+    if (mod == nullptr) {
+        mod = PyImport_ImportModule("paddle_tpu.native.embed");
+        if (mod == nullptr) PyErr_Print();
+    }
+    return mod;
+}
+
+void fill_names(PyObject* obj, const char* attr,
+                std::vector<std::string>* out) {
+    PyObject* names = PyObject_GetAttrString(obj, attr);
+    if (names == nullptr) {
+        PyErr_Print();
+        return;
+    }
+    const Py_ssize_t n = PySequence_Size(names);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject* item = PySequence_GetItem(names, i);
+        const char* utf8 = item ? PyUnicode_AsUTF8(item) : nullptr;
+        if (utf8 == nullptr) {
+            PyErr_Print();
+            Py_XDECREF(item);
+            continue;
+        }
+        out->emplace_back(utf8);
+        Py_DECREF(item);
+    }
+    Py_DECREF(names);
+}
+
+}  // namespace
+
+extern "C" {
+
+PT_Predictor* PT_CreatePredictor(const char* model_dir) {
+    GilGuard gil;
+    PyObject* mod = embed_module();
+    if (mod == nullptr) return nullptr;
+    PyObject* obj = PyObject_CallMethod(mod, "CPredictor", "s", model_dir);
+    if (obj == nullptr) {
+        PyErr_Print();
+        return nullptr;
+    }
+    PT_Predictor* pred = new PT_Predictor{obj, {}, {}};
+    fill_names(obj, "input_names", &pred->in_names);
+    fill_names(obj, "output_names", &pred->out_names);
+    return pred;
+}
+
+void PT_DeletePredictor(PT_Predictor* pred) {
+    if (pred == nullptr) return;
+    GilGuard gil;
+    Py_XDECREF(pred->obj);
+    delete pred;
+}
+
+long PT_GetInputNum(PT_Predictor* pred) {
+    return static_cast<long>(pred->in_names.size());
+}
+
+const char* PT_GetInputName(PT_Predictor* pred, long i) {
+    return pred->in_names[i].c_str();
+}
+
+long PT_GetOutputNum(PT_Predictor* pred) {
+    return static_cast<long>(pred->out_names.size());
+}
+
+const char* PT_GetOutputName(PT_Predictor* pred, long i) {
+    return pred->out_names[i].c_str();
+}
+
+int PT_PredictorRun(PT_Predictor* pred, const float* const* inputs,
+                    const long* const* shapes, const long* ndims,
+                    long n_inputs) {
+    GilGuard gil;
+    PyObject* feed = PyList_New(n_inputs);
+    for (long i = 0; i < n_inputs; ++i) {
+        long numel = 1;
+        PyObject* shape = PyList_New(ndims[i]);
+        for (long d = 0; d < ndims[i]; ++d) {
+            numel *= shapes[i][d];
+            PyList_SET_ITEM(shape, d, PyLong_FromLong(shapes[i][d]));
+        }
+        PyObject* buf = PyBytes_FromStringAndSize(
+            reinterpret_cast<const char*>(inputs[i]),
+            numel * static_cast<long>(sizeof(float)));
+        PyObject* pair = PyTuple_Pack(2, buf, shape);
+        Py_DECREF(buf);
+        Py_DECREF(shape);
+        PyList_SET_ITEM(feed, i, pair);
+    }
+    PyObject* r = PyObject_CallMethod(pred->obj, "run_packed", "O", feed);
+    Py_DECREF(feed);
+    if (r == nullptr) {
+        PyErr_Print();
+        return -1;
+    }
+    Py_DECREF(r);
+    return 0;
+}
+
+long PT_GetOutput(PT_Predictor* pred, long i, float* buf, long capacity,
+                  long* out_shape, long max_ndim, long* out_ndim) {
+    GilGuard gil;
+    // (bytes, shape tuple) of the i-th output of the last run
+    PyObject* r = PyObject_CallMethod(pred->obj, "get_output_packed",
+                                      "l", i);
+    if (r == nullptr) {
+        PyErr_Print();
+        return -1;
+    }
+    PyObject* bytes = PyTuple_GetItem(r, 0);
+    PyObject* shape = PyTuple_GetItem(r, 1);
+    const long ndim = static_cast<long>(PyTuple_Size(shape));
+    long numel = 1;
+    for (long d = 0; d < ndim; ++d) {
+        const long s = PyLong_AsLong(PyTuple_GetItem(shape, d));
+        if (d < max_ndim) out_shape[d] = s;
+        numel *= s;
+    }
+    if (out_ndim != nullptr) *out_ndim = ndim;
+    if (buf != nullptr && capacity > 0) {
+        const long n = capacity < numel ? capacity : numel;
+        std::memcpy(buf, PyBytes_AsString(bytes), n * sizeof(float));
+    }
+    Py_DECREF(r);
+    return numel;
+}
+
+}  // extern "C"

@@ -1,0 +1,55 @@
+/* C client of the inference ABI (reference inference/capi demo usage).
+ *
+ * Usage: capi_demo <model_dir> <n_feature>
+ * Feeds one batch of ones through every input, prints output 0.
+ */
+#include "paddle_tpu_c_api.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+
+int main(int argc, char** argv) {
+    if (argc < 3) {
+        fprintf(stderr, "usage: %s <model_dir> <n_feature>\n", argv[0]);
+        return 1;
+    }
+    const long nf = atol(argv[2]);
+    PT_Predictor* pred = PT_CreatePredictor(argv[1]);
+    if (pred == NULL) {
+        fprintf(stderr, "create predictor failed\n");
+        return 2;
+    }
+    const long n_in = PT_GetInputNum(pred);
+    printf("inputs: %ld (first: %s), outputs: %ld (first: %s)\n", n_in,
+           PT_GetInputName(pred, 0), PT_GetOutputNum(pred),
+           PT_GetOutputName(pred, 0));
+
+    float* data = (float*)malloc(sizeof(float) * 2 * nf);
+    for (long i = 0; i < 2 * nf; ++i) data[i] = 1.0f;
+    long shape[2];
+    shape[0] = 2;
+    shape[1] = nf;
+    const float* inputs[1];
+    const long* shapes[1];
+    long ndims[1];
+    inputs[0] = data;
+    shapes[0] = shape;
+    ndims[0] = 2;
+    if (PT_PredictorRun(pred, inputs, shapes, ndims, 1) != 0) {
+        fprintf(stderr, "run failed\n");
+        return 3;
+    }
+    long out_shape[8];
+    long out_ndim = 0;
+    const long numel = PT_GetOutput(pred, 0, NULL, 0, out_shape, 8,
+                                    &out_ndim);
+    float* out = (float*)malloc(sizeof(float) * numel);
+    PT_GetOutput(pred, 0, out, numel, out_shape, 8, &out_ndim);
+    printf("output0 numel %ld ndim %ld first %.6f\n", numel, out_ndim,
+           out[0]);
+    free(out);
+    free(data);
+    PT_DeletePredictor(pred);
+    printf("capi_demo: OK\n");
+    return 0;
+}

@@ -1,0 +1,109 @@
+"""Embedded-runtime driver for the native entries.
+
+Consumed object-by-object from C++ (train_demo.cc via the CPython API,
+capi.cc for the C inference ABI). Keeps the boundary narrow: scalars,
+bytes buffers, and name lists only — no numpy objects cross into C++.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# training session (train_demo.cc)
+# ---------------------------------------------------------------------------
+
+def save_train_artifacts(dirname, main_program, startup_program,
+                         feeds, fetch_name):
+    """Serialize a trainable program pair + feed metadata for the C++
+    train entry (reference train/demo: ProgramDesc files on disk).
+
+    feeds: {name: ([dims...], dtype, kind)} where kind is 'uniform'
+    (float data) or 'randint:N' (int labels in [0, N))."""
+    from ..framework import serde
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "main.json"), "w") as f:
+        f.write(serde.program_to_json(main_program))
+    with open(os.path.join(dirname, "startup.json"), "w") as f:
+        f.write(serde.program_to_json(startup_program))
+    with open(os.path.join(dirname, "meta.json"), "w") as f:
+        json.dump({"feeds": feeds, "fetch": fetch_name}, f)
+
+
+class TrainSession:
+    def __init__(self, model_dir: str):
+        from ..framework import serde
+        from ..framework.executor import Executor, Scope
+
+        with open(os.path.join(model_dir, "main.json")) as f:
+            self.main = serde.program_from_json(f.read())
+        with open(os.path.join(model_dir, "startup.json")) as f:
+            startup = serde.program_from_json(f.read())
+        with open(os.path.join(model_dir, "meta.json")) as f:
+            meta = json.load(f)
+        self.feeds = meta["feeds"]
+        self.fetch = meta["fetch"]
+        self.scope = Scope()
+        self.exe = Executor()
+        self.exe.run(startup, scope=self.scope)
+        self.losses: List[float] = []
+
+    def _batch(self, step: int):
+        rng = np.random.RandomState(1234 + step)
+        feed = {}
+        for name, (dims, dtype, kind) in self.feeds.items():
+            if kind.startswith("randint:"):
+                hi = int(kind.split(":")[1])
+                feed[name] = rng.randint(0, hi, dims).astype(dtype)
+            else:
+                feed[name] = rng.uniform(-1, 1, dims).astype(dtype)
+        return feed
+
+    def step(self, step: int) -> float:
+        out, = self.exe.run(self.main, feed=self._batch(step),
+                            fetch_list=[self.fetch], scope=self.scope)
+        loss = float(np.asarray(out).reshape(-1)[0])
+        self.losses.append(loss)
+        return loss
+
+    def improved(self) -> bool:
+        return len(self.losses) >= 2 and self.losses[-1] < self.losses[0]
+
+
+def load_train_session(model_dir: str) -> TrainSession:
+    return TrainSession(model_dir)
+
+
+# ---------------------------------------------------------------------------
+# C inference predictor (capi.cc)
+# ---------------------------------------------------------------------------
+
+class CPredictor:
+    """float32 bytes-buffer facade over inference.Predictor."""
+
+    def __init__(self, model_dir: str):
+        from ..inference import Predictor
+
+        self._pred = Predictor(model_dir)
+        self.input_names = self._pred.get_input_names()
+        self.output_names = self._pred.get_output_names()
+        self._outputs = []
+
+    def run_packed(self, packed):
+        """packed: [(bytes, [dims...]), ...] in input_names order."""
+        feed = {}
+        for name, (buf, shape) in zip(self.input_names, packed):
+            feed[name] = np.frombuffer(
+                buf, np.float32).reshape([int(s) for s in shape])
+        outs = self._pred.run(feed)
+        self._outputs = [np.asarray(o, np.float32) for o in outs]
+        return len(self._outputs)
+
+    def get_output_packed(self, i: int):
+        arr = np.ascontiguousarray(self._outputs[int(i)], np.float32)
+        return arr.tobytes(), tuple(int(s) for s in arr.shape)

@@ -1,0 +1,50 @@
+/* C inference API.
+ *
+ * Role parity: paddle/fluid/inference/capi/paddle_c_api.h — a stable C
+ * ABI over the predictor for non-Python deployments. The predictor
+ * behind it is the AOT-compiled paddle_tpu.inference.Predictor.
+ *
+ * Threading: calls must come from one thread (the embedded interpreter
+ * owns the GIL across calls). All buffers are float32.
+ */
+#ifndef PADDLE_TPU_C_API_H_
+#define PADDLE_TPU_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PT_Predictor PT_Predictor;
+
+/* Load a save_inference_model directory. NULL on failure (details on
+ * stderr). The first call boots the embedded runtime. */
+PT_Predictor* PT_CreatePredictor(const char* model_dir);
+
+void PT_DeletePredictor(PT_Predictor* pred);
+
+/* Model interface discovery. Names are owned by the predictor and
+ * valid until PT_DeletePredictor. */
+long PT_GetInputNum(PT_Predictor* pred);
+const char* PT_GetInputName(PT_Predictor* pred, long i);
+long PT_GetOutputNum(PT_Predictor* pred);
+const char* PT_GetOutputName(PT_Predictor* pred, long i);
+
+/* Run one batch. inputs[i] is a dense float32 buffer of shape
+ * shapes[i][0..ndims[i]-1], matched to input i (order of
+ * PT_GetInputName). Returns 0 on success. */
+int PT_PredictorRun(PT_Predictor* pred, const float* const* inputs,
+                    const long* const* shapes, const long* ndims,
+                    long n_inputs);
+
+/* Fetch output i of the last PT_PredictorRun. Writes up to `capacity`
+ * floats into buf and the shape into out_shape (up to max_ndim dims);
+ * returns the total element count (call with capacity 0 to size), or
+ * -1 on error. */
+long PT_GetOutput(PT_Predictor* pred, long i, float* buf, long capacity,
+                  long* out_shape, long max_ndim, long* out_ndim);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_C_API_H_ */
