@@ -28,6 +28,9 @@ __all__ = [
     "index_select", "roll", "flip", "scatter_nd_add", "sort",
     "logical_xor", "mm", "t", "dot", "addmm", "diag", "isfinite",
     "has_nan", "has_inf", "shard_index",
+    "cholesky", "inverse", "kron", "trace", "cross", "dist",
+    "diag_embed", "index_sample", "histogram", "multinomial",
+    "affine_grid", "grid_sampler", "unfold", "affine_channel",
 ]
 
 
@@ -733,3 +736,139 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
     local = binary(input, lo, "elementwise_sub")
     ignore = full_like(input, ignore_value)
     return where(in_shard, local, ignore, name=name)
+
+
+# ---------------------------------------------------------------------------
+# linalg + misc (ops/linalg_ops.py; reference fluid.layers / paddle.tensor)
+# ---------------------------------------------------------------------------
+
+def cholesky(x, upper=False, name=None):
+    return _simple("cholesky", x, name=name, upper=upper)
+
+
+def inverse(x, name=None):
+    helper = LayerHelper("inverse", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("inverse", inputs={"Input": [x]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def kron(x, y, name=None):
+    helper = LayerHelper("kron", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("kron", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    helper = LayerHelper("trace", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("trace", inputs={"Input": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"offset": offset, "axis1": axis1,
+                            "axis2": axis2})
+    return out
+
+
+def cross(x, y, dim=None, name=None):
+    helper = LayerHelper("cross", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    attrs = {} if dim is None else {"dim": int(dim)}
+    helper.append_op("cross", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def dist(x, y, p=2.0, name=None):
+    helper = LayerHelper("dist", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("dist", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"p": float(p)})
+    return out
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    helper = LayerHelper("diag_embed", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("diag_embed", inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"offset": offset, "dim1": dim1,
+                            "dim2": dim2})
+    return out
+
+
+def index_sample(x, index, name=None):
+    helper = LayerHelper("index_sample", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("index_sample",
+                     inputs={"X": [x], "Index": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    return _simple("histogram", input, out_dtype="int64", name=name,
+                   bins=bins, min=min, max=max)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    return _simple("multinomial", x, out_dtype="int64", name=name,
+                   num_samples=num_samples, replacement=replacement)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    helper = LayerHelper("affine_grid", name=name)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    helper.append_op("affine_grid", inputs={"Theta": [theta]},
+                     outputs={"Output": [out]},
+                     attrs={"output_shape": [int(s) for s in out_shape],
+                            "align_corners": align_corners})
+    return out
+
+
+def grid_sampler(x, grid, mode="bilinear", padding_mode="zeros",
+                 align_corners=True, name=None):
+    helper = LayerHelper("grid_sampler", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("grid_sampler",
+                     inputs={"X": [x], "Grid": [grid]},
+                     outputs={"Output": [out]},
+                     attrs={"mode": mode, "padding_mode": padding_mode,
+                            "align_corners": align_corners})
+    return out
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1,
+           name=None):
+    def _quad(v):
+        # reference unfold API: int -> same on all sides, [ph, pw] ->
+        # [ph, pw, ph, pw], 4-list passes through
+        if isinstance(v, int):
+            return [v, v, v, v]
+        v = list(v)
+        return v + v if len(v) == 2 else v
+
+    def _pair2(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    helper = LayerHelper("unfold", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("unfold", inputs={"X": [x]}, outputs={"Y": [out]},
+                     attrs={"kernel_sizes": _pair2(kernel_sizes),
+                            "strides": _pair2(strides),
+                            "paddings": _quad(paddings),
+                            "dilations": _pair2(dilations)})
+    return out
+
+
+def affine_channel(x, scale, bias, data_layout="NCHW", name=None):
+    helper = LayerHelper("affine_channel", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("affine_channel",
+                     inputs={"X": [x], "Scale": [scale],
+                             "Bias": [bias]},
+                     outputs={"Out": [out]},
+                     attrs={"data_layout": data_layout})
+    return out

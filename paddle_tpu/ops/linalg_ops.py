@@ -1,0 +1,465 @@
+"""Linalg + misc tensor ops.
+
+Reference: operators/{cholesky,inverse,kron,trace,diag,diag_embed,
+diag_v2,cross,dist,affine_channel,affine_grid,grid_sampler,histogram,
+index_sample,multinomial,unfold}_op.* — each a hand-written CPU/CUDA
+kernel (cuSOLVER for the factorizations); here jnp/lax lowerings on the
+MXU/XLA with 'auto' vjp grads where the reference registers a grad op.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..errors import InvalidArgumentError
+from .registry import in_var, register_op, same_as_input, set_out
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# factorizations / inverses
+# ---------------------------------------------------------------------------
+
+@register_op("cholesky", infer=same_as_input(), grad="auto")
+def _cholesky(ctx, op):
+    """reference cholesky_op.h (cuSOLVER potrf); upper=True returns the
+    upper-triangular factor (transpose of the lower one)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    low = jnp.linalg.cholesky(x)
+    if op.attr("upper", False):
+        low = jnp.swapaxes(low, -1, -2)
+    ctx.set_output(op, "Out", low)
+
+
+def _inverse_infer(op, block):
+    x = in_var(op, block, "Input")
+    set_out(op, block, "Output", x.shape, x.dtype)
+
+
+@register_op("inverse", infer=_inverse_infer, grad="auto")
+def _inverse(ctx, op):
+    ctx.set_output(op, "Output",
+                   _jnp().linalg.inv(ctx.get_input(op, "Input")))
+
+
+# ---------------------------------------------------------------------------
+# products / reductions
+# ---------------------------------------------------------------------------
+
+def _kron_infer(op, block):
+    x, y = in_var(op, block, "X"), in_var(op, block, "Y")
+    xs, ys = list(x.shape), list(y.shape)
+    while len(xs) < len(ys):
+        xs.insert(0, 1)
+    while len(ys) < len(xs):
+        ys.insert(0, 1)
+    set_out(op, block, "Out", [a * b for a, b in zip(xs, ys)], x.dtype)
+
+
+@register_op("kron", infer=_kron_infer, grad="auto")
+def _kron(ctx, op):
+    """reference kron_op.h: out[i] = prod of dims (np.kron semantics
+    with rank padding)."""
+    jnp = _jnp()
+    ctx.set_output(op, "Out", jnp.kron(ctx.get_input(op, "X"),
+                                       ctx.get_input(op, "Y")))
+
+
+def _trace_infer(op, block):
+    x = in_var(op, block, "Input")
+    ax1 = op.attr("axis1", 0) % len(x.shape)
+    ax2 = op.attr("axis2", 1) % len(x.shape)
+    shape = [s for i, s in enumerate(x.shape) if i not in (ax1, ax2)]
+    set_out(op, block, "Out", shape or [1], x.dtype)
+
+
+@register_op("trace", infer=_trace_infer, grad="auto")
+def _trace(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    out = jnp.trace(x, offset=op.attr("offset", 0),
+                    axis1=op.attr("axis1", 0), axis2=op.attr("axis2", 1))
+    if out.ndim == 0:
+        out = out.reshape(1)
+    ctx.set_output(op, "Out", out)
+
+
+def _cross_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+
+
+@register_op("cross", infer=_cross_infer, grad="auto")
+def _cross(ctx, op):
+    """reference cross_op.h: axis defaults to the first dim of size 3."""
+    jnp = _jnp()
+    x, y = ctx.get_input(op, "X"), ctx.get_input(op, "Y")
+    dim = op.attr("dim", None)
+    if dim is None or dim == -100:  # DefaultDim sentinel
+        dim = next((i for i, s in enumerate(x.shape) if s == 3), None)
+        if dim is None:
+            raise InvalidArgumentError("cross: no dimension of size 3")
+    ctx.set_output(op, "Out", jnp.cross(x, y, axis=int(dim)))
+
+
+def _dist_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", [1], x.dtype)
+
+
+@register_op("dist", infer=_dist_infer, grad="auto")
+def _dist(ctx, op):
+    """reference dist_op.h: p-norm of the broadcast difference."""
+    jnp = _jnp()
+    d = jnp.abs(ctx.get_input(op, "X") - ctx.get_input(op, "Y"))
+    p = op.attr("p", 2.0)
+    if p == float("inf"):
+        out = d.max()
+    elif p == float("-inf"):
+        out = d.min()
+    elif p == 0:
+        out = (d != 0).sum().astype(d.dtype)
+    else:
+        out = (d ** p).sum() ** (1.0 / p)
+    ctx.set_output(op, "Out", out.reshape(1))
+
+
+# ---------------------------------------------------------------------------
+# diag family
+# ---------------------------------------------------------------------------
+
+def _diag_infer(op, block):
+    x = in_var(op, block, "Diagonal")
+    n = x.shape[0]
+    set_out(op, block, "Out", (n, n), x.dtype)
+
+
+@register_op("diag", infer=_diag_infer, grad="auto")
+def _diag(ctx, op):
+    """reference diag_op.cc (v1): 1-D diagonal -> square matrix."""
+    ctx.set_output(op, "Out", _jnp().diag(ctx.get_input(op, "Diagonal")))
+
+
+def _diag_v2_infer(op, block):
+    x = in_var(op, block, "X")
+    off = abs(op.attr("offset", 0))
+    if len(x.shape) == 1:
+        n = x.shape[0] + off
+        set_out(op, block, "Out", (n, n), x.dtype)
+    else:
+        n = max(0, min(x.shape[0], x.shape[1] - op.attr("offset", 0),
+                       x.shape[1], x.shape[0] + op.attr("offset", 0)))
+        set_out(op, block, "Out", (n,), x.dtype)
+
+
+@register_op("diag_v2", infer=_diag_v2_infer, grad="auto")
+def _diag_v2(ctx, op):
+    """reference diag_v2_op.cc: np.diag with offset + padding_value."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    offset = op.attr("offset", 0)
+    pad = op.attr("padding_value", 0.0)
+    out = jnp.diag(x, k=offset)
+    if x.ndim == 1 and pad:
+        n = out.shape[0]
+        mask = jnp.eye(n, k=offset, dtype=bool)
+        out = jnp.where(mask, out, jnp.asarray(pad, out.dtype))
+    ctx.set_output(op, "Out", out)
+
+
+def _diag_embed_infer(op, block):
+    x = in_var(op, block, "Input")
+    n = x.shape[-1] + abs(op.attr("offset", 0))
+    shape = list(x.shape[:-1]) + [n, n]
+    nd = len(shape)
+    d1 = op.attr("dim1", -2) % nd
+    d2 = op.attr("dim2", -1) % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        # mirror the lowering's moveaxis of the two diagonal plane axes
+        rest = [s for i, s in enumerate(shape) if i < nd - 2]
+        out = [None] * nd
+        out[d1], out[d2] = n, n
+        it = iter(rest)
+        for i in range(nd):
+            if out[i] is None:
+                out[i] = next(it)
+        shape = out
+    set_out(op, block, "Out", shape, x.dtype)
+
+
+@register_op("diag_embed", infer=_diag_embed_infer, grad="auto")
+def _diag_embed(ctx, op):
+    """reference diag_embed_op.h: batched last-dim -> diagonal planes
+    (dim1/dim2 default -2/-1)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    offset = op.attr("offset", 0)
+    dim1 = op.attr("dim1", -2)
+    dim2 = op.attr("dim2", -1)
+    n = x.shape[-1] + abs(offset)
+    planes = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(0, -offset)
+    c = idx + max(0, offset)
+    out = planes.at[..., r, c].set(x)
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+    ctx.set_output(op, "Out", out)
+
+
+# ---------------------------------------------------------------------------
+# sampling / selection
+# ---------------------------------------------------------------------------
+
+def _index_sample_infer(op, block):
+    x = in_var(op, block, "X")
+    idx = in_var(op, block, "Index")
+    set_out(op, block, "Out", idx.shape, x.dtype)
+
+
+@register_op("index_sample", infer=_index_sample_infer, grad="auto")
+def _index_sample(ctx, op):
+    """reference index_sample_op.h: per-row gather x[i, index[i, j]]."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    idx = ctx.get_input(op, "Index")
+    ctx.set_output(op, "Out",
+                   jnp.take_along_axis(x, idx.astype(jnp.int32), axis=1))
+
+
+def _multinomial_infer(op, block):
+    x = in_var(op, block, "X")
+    n = op.attr("num_samples", 1)
+    shape = (x.shape[0], n) if len(x.shape) == 2 else (n,)
+    set_out(op, block, "Out", shape, "int64")
+
+
+@register_op("multinomial", infer=_multinomial_infer, grad=None)
+def _multinomial(ctx, op):
+    """reference multinomial_op.h: sample category ids from unnormalized
+    probabilities; without replacement via Gumbel top-k."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    n = op.attr("num_samples", 1)
+    repl = op.attr("replacement", False)
+    squeeze = x.ndim == 1
+    probs = x[None] if squeeze else x
+    logp = jnp.log(jnp.clip(probs, 1e-30, None))
+    key = ctx.rng(op)
+    if repl:
+        out = jax.random.categorical(key, logp, axis=-1,
+                                     shape=(n, probs.shape[0])).T
+    else:
+        if n > probs.shape[-1]:
+            raise InvalidArgumentError(
+                "multinomial without replacement: num_samples exceeds "
+                "category count")
+        # zero-probability categories must never be drawn (reference
+        # multinomial_op errors when nonzero categories < num_samples;
+        # the count is data-dependent here, so the invalid case is
+        # marked in the output instead of raised)
+        g = jax.random.gumbel(key, logp.shape)
+        masked = jnp.where(probs > 0, logp + g, -jnp.inf)
+        score, out = jax.lax.top_k(masked, n)
+        # a -inf selection means fewer than n nonzero categories: make
+        # the result recognizably invalid (-1) rather than silently
+        # sampling a zero-probability id
+        out = jnp.where(jnp.isneginf(score), -1, out)
+    ctx.set_output(op, "Out", out[0] if squeeze else out)
+
+
+def _histogram_infer(op, block):
+    lo, hi = op.attr("min", 0), op.attr("max", 0)
+    if lo > hi:
+        raise InvalidArgumentError(
+            f"histogram: min ({lo}) must be <= max ({hi})")
+    set_out(op, block, "Out", (op.attr("bins", 100),), "int64")
+
+
+@register_op("histogram", infer=_histogram_infer, grad=None)
+def _histogram(ctx, op):
+    """reference histogram_op.h: fixed-bin counts; min==max==0 takes
+    the data range."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X").reshape(-1)
+    bins = op.attr("bins", 100)
+    lo = op.attr("min", 0)
+    hi = op.attr("max", 0)
+    if lo == hi and lo != 0:
+        # reference histogram_op widens an equal explicit range
+        lo, hi = lo - 1, hi + 1
+    if lo == 0 and hi == 0:
+        lo_v, hi_v = x.min(), x.max()
+        same = lo_v == hi_v
+        lo_v = jnp.where(same, lo_v - 1, lo_v)
+        hi_v = jnp.where(same, hi_v + 1, hi_v)
+    else:
+        lo_v = jnp.asarray(lo, x.dtype)
+        hi_v = jnp.asarray(hi, x.dtype)
+    xf = x.astype(jnp.float32)
+    width = (hi_v - lo_v).astype(jnp.float32)
+    b = jnp.floor((xf - lo_v) * bins / width).astype(jnp.int32)
+    b = jnp.where(xf == hi_v, bins - 1, b)  # right edge inclusive
+    valid = (xf >= lo_v) & (xf <= hi_v)
+    # int32 accumulators; x64 is disabled jax-wide in this runtime and
+    # the declared int64 output narrows like every other integer op
+    counts = jnp.zeros((bins,), jnp.int32).at[
+        jnp.where(valid, b, bins)].add(1, mode="drop")
+    ctx.set_output(op, "Out", counts)
+
+
+# ---------------------------------------------------------------------------
+# geometry: affine_channel / affine_grid / grid_sampler / unfold
+# ---------------------------------------------------------------------------
+
+@register_op("affine_channel", infer=same_as_input(), grad="auto")
+def _affine_channel(ctx, op):
+    """reference affine_channel_op.cc: per-channel scale+bias."""
+    x = ctx.get_input(op, "X")
+    scale = ctx.get_input(op, "Scale")
+    bias = ctx.get_input(op, "Bias")
+    if op.attr("data_layout", "NCHW") == "NCHW":
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    ctx.set_output(op, "Out",
+                   x * scale.reshape(shape) + bias.reshape(shape))
+
+
+def _affine_grid_infer(op, block):
+    theta = in_var(op, block, "Theta")
+    h, w = op.attr("output_shape", [0, 0, 0, 0])[2:4]
+    set_out(op, block, "Output", (theta.shape[0], h, w, 2), theta.dtype)
+
+
+@register_op("affine_grid", infer=_affine_grid_infer, grad="auto")
+def _affine_grid(ctx, op):
+    """reference affine_grid_op.h: grid = [x_norm, y_norm, 1] @ theta^T
+    over normalized [-1, 1] coords (align_corners=True semantics of the
+    vintage)."""
+    jnp = _jnp()
+    theta = ctx.get_input(op, "Theta")              # [N, 2, 3]
+    _, _, h, w = op.attr("output_shape")
+    align = op.attr("align_corners", True)
+    if align:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+        xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+    xg, yg = jnp.meshgrid(xs, ys)                   # [h, w]
+    base = jnp.stack([xg, yg, jnp.ones_like(xg)], axis=-1)  # [h,w,3]
+    out = jnp.einsum("hwk,njk->nhwj", base.astype(theta.dtype), theta)
+    ctx.set_output(op, "Output", out)
+
+
+def _grid_sampler_infer(op, block):
+    x = in_var(op, block, "X")
+    grid = in_var(op, block, "Grid")
+    set_out(op, block, "Output",
+            (x.shape[0], x.shape[1], grid.shape[1], grid.shape[2]),
+            x.dtype)
+
+
+@register_op("grid_sampler", infer=_grid_sampler_infer, grad="auto")
+def _grid_sampler(ctx, op):
+    """reference grid_sampler_op.h: sample x at normalized grid coords;
+    bilinear/nearest x zeros/border/reflection."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                      # [N, C, H, W]
+    grid = ctx.get_input(op, "Grid")                # [N, Hg, Wg, 2]
+    mode = op.attr("mode", "bilinear")
+    padding = op.attr("padding_mode", "zeros")
+    align = op.attr("align_corners", True)
+    N, C, H, W = x.shape
+
+    def unnorm(c, size):
+        if align:
+            return (c + 1.0) / 2.0 * (size - 1)
+        return ((c + 1.0) * size - 1.0) / 2.0
+
+    gx = unnorm(grid[..., 0], W)                    # [N, Hg, Wg]
+    gy = unnorm(grid[..., 1], H)
+
+    def reflect(v, lo, hi):
+        rng = hi - lo
+        v = jnp.abs((v - lo) % (2 * rng) - rng) + lo
+        return v
+
+    if padding == "reflection":
+        if align:
+            gx = reflect(gx, 0.0, W - 1.0)
+            gy = reflect(gy, 0.0, H - 1.0)
+        else:
+            gx = jnp.clip(reflect(gx, -0.5, W - 0.5), 0, W - 1)
+            gy = jnp.clip(reflect(gy, -0.5, H - 0.5), 0, H - 1)
+    elif padding == "border":
+        gx = jnp.clip(gx, 0.0, W - 1.0)
+        gy = jnp.clip(gy, 0.0, H - 1.0)
+
+    def gather(img, yi, xi):
+        """img [C,H,W]; yi/xi int [Hg,Wg] -> [C,Hg,Wg]; OOB -> 0."""
+        inb = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1)
+        xc = jnp.clip(xi, 0, W - 1)
+        v = img[:, yc, xc]
+        return v * inb[None]
+
+    def sample_one(img, gx1, gy1):
+        if mode == "nearest":
+            return gather(img, jnp.round(gy1).astype(jnp.int32),
+                          jnp.round(gx1).astype(jnp.int32))
+        x0 = jnp.floor(gx1).astype(jnp.int32)
+        y0 = jnp.floor(gy1).astype(jnp.int32)
+        lx = (gx1 - x0).astype(x.dtype)
+        ly = (gy1 - y0).astype(x.dtype)
+        return (gather(img, y0, x0) * (1 - ly) * (1 - lx)
+                + gather(img, y0, x0 + 1) * (1 - ly) * lx
+                + gather(img, y0 + 1, x0) * ly * (1 - lx)
+                + gather(img, y0 + 1, x0 + 1) * ly * lx)
+
+    out = jax.vmap(sample_one)(x, gx, gy)
+    ctx.set_output(op, "Output", out)
+
+
+def _unfold_infer(op, block):
+    x = in_var(op, block, "X")
+    k = op.attr("kernel_sizes")
+    s = op.attr("strides", [1, 1])
+    p = op.attr("paddings", [0, 0, 0, 0])
+    d = op.attr("dilations", [1, 1])
+    N, C, H, W = x.shape
+    oh = (H + p[0] + p[2] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+    ow = (W + p[1] + p[3] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+    set_out(op, block, "Y", (N, C * k[0] * k[1], oh * ow), x.dtype)
+
+
+@register_op("unfold", infer=_unfold_infer, grad="auto")
+def _unfold(ctx, op):
+    """reference unfold_op.h (im2col): patches flattened to
+    [N, C*kh*kw, L] via lax patch extraction."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    k = op.attr("kernel_sizes")
+    s = op.attr("strides", [1, 1])
+    p = op.attr("paddings", [0, 0, 0, 0])
+    d = op.attr("dilations", [1, 1])
+    N, C = x.shape[0], x.shape[1]
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=tuple(k), window_strides=tuple(s),
+        padding=((p[0], p[2]), (p[1], p[3])),
+        rhs_dilation=tuple(d))                      # [N, C*kh*kw, oh, ow]
+    ctx.set_output(op, "Y",
+                   patches.reshape(N, C * k[0] * k[1], -1))

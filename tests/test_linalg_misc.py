@@ -1,0 +1,316 @@
+"""Linalg + misc op family vs numpy references (reference
+tests/unittests/test_{cholesky,inverse,kron,trace,diag,diag_embed,
+cross,dist,index_sample,multinomial,histogram,affine_grid,
+grid_sampler,unfold,affine_channel}_op.py)."""
+import numpy as np
+import pytest
+
+from op_test import OpCase, run_case
+
+R = np.random.RandomState
+
+
+def test_cholesky():
+    rng = R(0)
+    a = rng.randn(4, 4).astype("float32")
+    spd = (a @ a.T + 4 * np.eye(4)).astype("float32")
+    run_case(OpCase("cholesky", {"X": spd},
+                    ref=lambda X, **k: np.linalg.cholesky(X),
+                    grad=["X"], grad_rtol=1e-1, grad_atol=1e-2))
+    run_case(OpCase("cholesky", {"X": spd}, attrs={"upper": True},
+                    ref=lambda X, upper: np.linalg.cholesky(X).T))
+
+
+def test_inverse():
+    rng = R(1)
+    a = rng.randn(5, 5).astype("float32") + 5 * np.eye(5, dtype="float32")
+    run_case(OpCase("inverse", {"Input": a},
+                    outputs={"Output": 1},
+                    ref=lambda Input: {"Output": np.linalg.inv(Input)},
+                    grad=["Input"], grad_rtol=1e-1, grad_atol=1e-2,
+                    rtol=1e-4, atol=1e-5))
+
+
+def test_kron():
+    rng = R(2)
+    x = rng.randn(2, 3).astype("float32")
+    y = rng.randn(4, 2).astype("float32")
+    run_case(OpCase("kron", {"X": x, "Y": y},
+                    ref=lambda X, Y: np.kron(X, Y), grad=["X", "Y"]))
+    # rank-padded case
+    v = rng.randn(3).astype("float32")
+    run_case(OpCase("kron", {"X": v, "Y": y},
+                    ref=lambda X, Y: np.kron(X, Y)))
+
+
+def test_trace():
+    rng = R(3)
+    x = rng.randn(2, 4, 4).astype("float32")
+    run_case(OpCase("trace", {"Input": x},
+                    attrs={"offset": 1, "axis1": 1, "axis2": 2},
+                    ref=lambda Input, **a: np.trace(Input, offset=1,
+                                                    axis1=1, axis2=2),
+                    grad=["Input"]))
+    m = rng.randn(3, 3).astype("float32")
+    run_case(OpCase("trace", {"Input": m},
+                    ref=lambda Input, **a: np.trace(Input).reshape(1)))
+
+
+def test_diag_family():
+    rng = R(4)
+    v = rng.randn(4).astype("float32")
+    run_case(OpCase("diag", {"Diagonal": v},
+                    ref=lambda Diagonal: np.diag(Diagonal), grad=[]))
+    run_case(OpCase("diag_v2", {"X": v},
+                    attrs={"offset": 1, "padding_value": 7.0},
+                    ref=lambda X, offset, padding_value: np.where(
+                        np.eye(5, k=1, dtype=bool), np.diag(X, k=1),
+                        np.float32(7.0))))
+    m = rng.randn(4, 6).astype("float32")
+    run_case(OpCase("diag_v2", {"X": m}, attrs={"offset": -1},
+                    ref=lambda X, offset: np.diag(X, k=-1)))
+    b = rng.randn(2, 3).astype("float32")
+    run_case(OpCase("diag_embed", {"Input": b},
+                    attrs={"offset": 1},
+                    ref=lambda Input, offset: np.stack(
+                        [np.diag(r, k=1) for r in Input]),
+                    grad=["Input"]))
+
+
+def test_cross():
+    rng = R(5)
+    x = rng.randn(4, 3).astype("float32")
+    y = rng.randn(4, 3).astype("float32")
+    run_case(OpCase("cross", {"X": x, "Y": y}, attrs={"dim": 1},
+                    ref=lambda X, Y, dim: np.cross(X, Y, axis=1),
+                    grad=["X", "Y"]))
+    # default dim: first axis of size 3
+    run_case(OpCase("cross", {"X": x.T.copy(), "Y": y.T.copy()},
+                    ref=lambda X, Y: np.cross(X, Y, axis=0)))
+
+
+@pytest.mark.parametrize("p,ref", [
+    (2.0, lambda d: np.sqrt((d ** 2).sum())),
+    (1.0, lambda d: d.sum()),
+    (float("inf"), lambda d: d.max()),
+    (0.0, lambda d: np.float32((d != 0).sum())),
+])
+def test_dist(p, ref):
+    rng = R(6)
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(4).astype("float32")  # broadcast
+    run_case(OpCase("dist", {"X": x, "Y": y}, attrs={"p": p},
+                    ref=lambda X, Y, p=p: np.asarray(
+                        [ref(np.abs(X - Y))], "float32"),
+                    rtol=1e-4, atol=1e-5))
+
+
+def test_index_sample():
+    rng = R(7)
+    x = rng.randn(3, 8).astype("float32")
+    idx = rng.randint(0, 8, (3, 5)).astype("int64")
+    run_case(OpCase("index_sample", {"X": x, "Index": idx},
+                    ref=lambda X, Index: np.take_along_axis(
+                        X, Index, axis=1),
+                    grad=["X"]))
+
+
+def test_affine_channel():
+    rng = R(8)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    s = rng.randn(3).astype("float32")
+    b = rng.randn(3).astype("float32")
+    run_case(OpCase("affine_channel",
+                    {"X": x, "Scale": s, "Bias": b},
+                    ref=lambda X, Scale, Bias, **a:
+                        X * Scale[None, :, None, None]
+                        + Bias[None, :, None, None],
+                    grad=["X", "Scale", "Bias"]))
+
+
+def test_affine_grid():
+    theta = np.array([[[1.0, 0.0, 0.2], [0.0, 1.0, -0.3]]], "float32")
+
+    def ref(Theta, output_shape, align_corners):
+        h, w = output_shape[2:]
+        ys = np.linspace(-1, 1, h)
+        xs = np.linspace(-1, 1, w)
+        xg, yg = np.meshgrid(xs, ys)
+        base = np.stack([xg, yg, np.ones_like(xg)], -1).astype("float32")
+        return {"Output": np.einsum("hwk,njk->nhwj", base, Theta)}
+
+    run_case(OpCase("affine_grid", {"Theta": theta},
+                    outputs={"Output": 1},
+                    attrs={"output_shape": [1, 1, 4, 5],
+                           "align_corners": True},
+                    ref=ref, grad=["Theta"]))
+
+
+def _np_grid_sample_bilinear_zeros(x, grid, align=True):
+    N, C, H, W = x.shape
+    out = np.zeros((N, C) + grid.shape[1:3], np.float32)
+    for n in range(N):
+        for i in range(grid.shape[1]):
+            for j in range(grid.shape[2]):
+                gx, gy = grid[n, i, j]
+                fx = (gx + 1) / 2 * (W - 1) if align else \
+                    ((gx + 1) * W - 1) / 2
+                fy = (gy + 1) / 2 * (H - 1) if align else \
+                    ((gy + 1) * H - 1) / 2
+                x0, y0 = int(np.floor(fx)), int(np.floor(fy))
+                lx, ly = fx - x0, fy - y0
+                for dy, dx, wgt in ((0, 0, (1 - ly) * (1 - lx)),
+                                    (0, 1, (1 - ly) * lx),
+                                    (1, 0, ly * (1 - lx)),
+                                    (1, 1, ly * lx)):
+                    yy, xx = y0 + dy, x0 + dx
+                    if 0 <= yy < H and 0 <= xx < W:
+                        out[n, :, i, j] += wgt * x[n, :, yy, xx]
+    return out
+
+
+def test_grid_sampler():
+    rng = R(9)
+    x = rng.randn(2, 3, 5, 5).astype("float32")
+    grid = rng.uniform(-1.2, 1.2, (2, 4, 4, 2)).astype("float32")
+    run_case(OpCase("grid_sampler", {"X": x, "Grid": grid},
+                    outputs={"Output": 1},
+                    attrs={"mode": "bilinear", "padding_mode": "zeros",
+                           "align_corners": True},
+                    ref=lambda X, Grid, **a: {
+                        "Output": _np_grid_sample_bilinear_zeros(
+                            X, Grid)},
+                    rtol=1e-4, atol=1e-5))
+    # border padding keeps every sample in-range
+    out_border = OpCase("grid_sampler", {"X": x, "Grid": grid},
+                        outputs={"Output": 1},
+                        attrs={"mode": "nearest",
+                               "padding_mode": "border",
+                               "align_corners": True})
+    run_case(out_border)  # shape/dtype-only check via infer
+
+
+def test_unfold():
+    rng = R(10)
+    x = rng.randn(2, 3, 6, 6).astype("float32")
+
+    def ref(X, kernel_sizes, strides, paddings, dilations):
+        import torch
+
+        t = torch.from_numpy(X)
+        out = torch.nn.functional.unfold(
+            t, kernel_size=kernel_sizes, stride=strides,
+            padding=paddings[:2], dilation=dilations)
+        return out.numpy()
+
+    run_case(OpCase("unfold", {"X": x},
+                    outputs={"Y": 1},
+                    attrs={"kernel_sizes": [2, 2], "strides": [2, 2],
+                           "paddings": [0, 0, 0, 0],
+                           "dilations": [1, 1]},
+                    ref=lambda X, **a: {"Y": ref(X, [2, 2], [2, 2],
+                                                 [0, 0, 0, 0], [1, 1])},
+                    grad=["X"]))
+
+
+def test_histogram():
+    x = np.array([0.1, 0.5, 0.9, 1.5, 2.4, -1.0], "float32")
+    run_case(OpCase("histogram", {"X": x},
+                    attrs={"bins": 4, "min": 0.0, "max": 2.0},
+                    ref=lambda X, bins, min, max: np.histogram(
+                        X[(X >= 0) & (X <= 2)], bins=4,
+                        range=(0, 2))[0].astype("int64"),
+                    check_dtype=False))
+
+
+def test_multinomial_distribution():
+    import paddle_tpu as pt
+
+    probs = np.array([[0.1, 0.0, 0.6, 0.3]], "float32")
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        block = main.global_block()
+        block.create_var(name="p", shape=probs.shape, dtype="float32",
+                         is_data=True, stop_gradient=True)
+        block.append_op("multinomial", inputs={"X": ["p"]},
+                        outputs={"Out": ["samples"]},
+                        attrs={"num_samples": 2000, "replacement": True})
+    exe = pt.Executor()
+    s, = exe.run(main, feed={"p": probs}, fetch_list=["samples"])
+    s = np.asarray(s)
+    assert s.shape == (1, 2000)
+    counts = np.bincount(s[0], minlength=4) / 2000.0
+    assert counts[1] == 0.0
+    np.testing.assert_allclose(counts, [0.1, 0.0, 0.6, 0.3], atol=0.05)
+    # without replacement: each draw distinct
+    main2, startup2 = pt.Program(), pt.Program()
+    startup2._is_startup = True
+    with pt.program_guard(main2, startup2):
+        b = main2.global_block()
+        b.create_var(name="p", shape=(1, 4), dtype="float32",
+                     is_data=True, stop_gradient=True)
+        b.append_op("multinomial", inputs={"X": ["p"]},
+                    outputs={"Out": ["s2"]},
+                    attrs={"num_samples": 3, "replacement": False})
+    s2, = exe.run(main2, feed={"p": np.abs(probs) + 0.01},
+                  fetch_list=["s2"])
+    assert len(set(np.asarray(s2)[0].tolist())) == 3
+
+
+def test_diag_embed_nondefault_dims():
+    rng = R(11)
+    b = rng.randn(2, 3).astype("float32")
+    run_case(OpCase("diag_embed", {"Input": b},
+                    attrs={"dim1": 0, "dim2": 1},
+                    ref=lambda Input, dim1, dim2: np.moveaxis(
+                        np.stack([np.diag(r) for r in Input]),
+                        (1, 2), (0, 1))))
+
+
+def test_unfold_two_element_paddings():
+    import paddle_tpu as pt
+
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", shape=[1, 3, 6, 6], dtype="float32",
+                           append_batch_size=False)
+        y = pt.layers.unfold(x, [2, 2], paddings=[1, 1])
+    assert tuple(y.shape) == (1, 12, 7 * 7)
+
+
+def test_multinomial_never_draws_zero_prob():
+    import paddle_tpu as pt
+
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        b = main.global_block()
+        b.create_var(name="p", shape=(1, 4), dtype="float32",
+                     is_data=True, stop_gradient=True)
+        b.append_op("multinomial", inputs={"X": ["p"]},
+                    outputs={"Out": ["s"]},
+                    attrs={"num_samples": 4, "replacement": False})
+    s, = pt.Executor().run(
+        main, feed={"p": np.array([[0.5, 0.5, 0.0, 0.0]], "float32")},
+        fetch_list=["s"])
+    s = np.asarray(s)[0]
+    # zero-prob ids never sampled; shortfall marked -1
+    assert set(s[s >= 0].tolist()) <= {0, 1}
+    assert (s == -1).sum() == 2
+
+
+def test_histogram_range_validation():
+    import paddle_tpu as pt
+
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        b = main.global_block()
+        b.create_var(name="x", shape=(4,), dtype="float32",
+                     is_data=True, stop_gradient=True)
+        with pytest.raises(pt.errors.EnforceNotMet, match="min"):
+            b.append_op("histogram", inputs={"X": ["x"]},
+                        outputs={"Out": ["h"]},
+                        attrs={"bins": 4, "min": 3.0, "max": 1.0})

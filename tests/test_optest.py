@@ -1011,6 +1011,12 @@ SKIP = {
     "masked_select": "dynamic shape; covered via layers.masked_select "
                      "usage in tests/test_models.py",
     "unique": "dynamic shape; lowering returns padded/size pair",
+    **{op: "tests/test_linalg_misc.py (forward vs numpy refs + "
+       "finite-difference grads)" for op in [
+           "cholesky", "inverse", "kron", "trace", "cross", "dist",
+           "diag", "diag_v2", "diag_embed", "index_sample",
+           "affine_channel", "affine_grid", "grid_sampler", "unfold",
+           "histogram", "multinomial"]},
     **{op: "tests/test_detection.py (forward vs numpy refs; "
        "iou_similarity/roi_align grad-checked there)" for op in [
            "iou_similarity", "box_coder", "prior_box",
