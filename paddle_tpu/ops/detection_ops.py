@@ -145,6 +145,22 @@ def _box_coder(ctx, op):
 # fully determine the output — XLA constant-folds the whole computation)
 # ---------------------------------------------------------------------------
 
+def _emit_boxes_vars(ctx, op, boxes, dtype, clip, flatten=False):
+    """Shared tail of the prior generators: clip, broadcast the
+    variance attr, optionally flatten to [H*W*n, 4], emit outputs."""
+    jnp = _jnp()
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    variances = op.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          boxes.shape).copy()
+    if flatten:
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    ctx.set_output(op, "Boxes", jnp.asarray(boxes, dtype))
+    ctx.set_output(op, "Variances", jnp.asarray(var, dtype))
+
+
 def _expand_aspect_ratios(aspect_ratios, flip):
     """reference prior_box_op.h:28 ExpandAspectRatios."""
     out = [1.0]
@@ -231,13 +247,7 @@ def _prior_box(ctx, op):
                 sq = math.sqrt(ms * max_sizes[s]) / 2.0
                 put(idx, sq, sq)
                 idx += 1
-    if clip:
-        boxes = np.clip(boxes, 0.0, 1.0)
-    var = np.broadcast_to(np.asarray(variances, np.float32),
-                          boxes.shape).copy()
-    jnp = _jnp()
-    ctx.set_output(op, "Boxes", jnp.asarray(boxes, feat.dtype))
-    ctx.set_output(op, "Variances", jnp.asarray(var, feat.dtype))
+    _emit_boxes_vars(ctx, op, boxes, feat.dtype, clip)
 
 
 def _anchor_gen_infer(op, block):
@@ -281,6 +291,8 @@ def _anchor_generator(ctx, op):
     var = np.broadcast_to(np.asarray(variances, np.float32),
                           anchors.shape).copy()
     jnp = _jnp()
+    # (anchor_generator's output slot is "Anchors", not "Boxes" — the
+    # shared tail does not apply)
     ctx.set_output(op, "Anchors", jnp.asarray(anchors, feat.dtype))
     ctx.set_output(op, "Variances", jnp.asarray(var, feat.dtype))
 
@@ -721,3 +733,170 @@ def _multiclass_nms(ctx, op):
         ctx.set_output(op, "Index", index)
     if op.output("NmsRoisNum"):
         ctx.set_output(op, "NmsRoisNum", nums)
+
+
+# ---------------------------------------------------------------------------
+# SSD training ops: density_prior_box / target_assign / mine_hard_examples
+# ---------------------------------------------------------------------------
+
+def _density_prior_count(op):
+    dens = op.attr("densities", [])
+    return len(op.attr("fixed_ratios", [])) * sum(d * d for d in dens)
+
+
+def _density_prior_infer(op, block):
+    x = in_var(op, block, "Input")
+    h, w = x.shape[2], x.shape[3]
+    n = _density_prior_count(op)
+    if op.attr("flatten_to_2d", False):
+        set_out(op, block, "Boxes", (h * w * n, 4), x.dtype)
+        set_out(op, block, "Variances", (h * w * n, 4), x.dtype)
+    else:
+        set_out(op, block, "Boxes", (h, w, n, 4), x.dtype)
+        set_out(op, block, "Variances", (h, w, n, 4), x.dtype)
+
+
+@register_op("density_prior_box", infer=_density_prior_infer)
+def _density_prior_box(ctx, op):
+    """reference density_prior_box_op.h:59-130 — density-sampled SSD
+    priors; static numpy generation like prior_box."""
+    feat = ctx.get_input(op, "Input")
+    image = ctx.get_input(op, "Image")
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    fixed_sizes = [float(s) for s in op.attr("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in op.attr("fixed_ratios", [])]
+    densities = [int(d) for d in op.attr("densities", [])]
+    variances = op.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    clip = op.attr("clip", False)
+    step_w = op.attr("step_w", 0.0) or iw / fw
+    step_h = op.attr("step_h", 0.0) or ih / fh
+    offset = op.attr("offset", 0.5)
+    if len(fixed_sizes) != len(densities):
+        raise InvalidArgumentError(
+            "density_prior_box: len(fixed_sizes) must equal "
+            "len(densities)")
+
+    n = _density_prior_count(op)
+    boxes = np.zeros((fh, fw, n, 4), np.float32)
+    step_avg = int((step_w + step_h) * 0.5)
+    cx = (np.arange(fw) + offset) * step_w
+    cy = (np.arange(fh) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)
+    idx = 0
+    for size, density in zip(fixed_sizes, densities):
+        shift = step_avg // density
+        for r in fixed_ratios:
+            bw = size * math.sqrt(r)
+            bh = size / math.sqrt(r)
+            dcx = cxg - step_avg / 2.0 + shift / 2.0
+            dcy = cyg - step_avg / 2.0 + shift / 2.0
+            for di in range(density):
+                for dj in range(density):
+                    ctx_x = dcx + dj * shift
+                    ctx_y = dcy + di * shift
+                    boxes[:, :, idx, 0] = np.maximum(
+                        (ctx_x - bw / 2.0) / iw, 0.0)
+                    boxes[:, :, idx, 1] = np.maximum(
+                        (ctx_y - bh / 2.0) / ih, 0.0)
+                    boxes[:, :, idx, 2] = np.minimum(
+                        (ctx_x + bw / 2.0) / iw, 1.0)
+                    boxes[:, :, idx, 3] = np.minimum(
+                        (ctx_y + bh / 2.0) / ih, 1.0)
+                    idx += 1
+    _emit_boxes_vars(ctx, op, boxes, feat.dtype, clip,
+                     flatten=op.attr("flatten_to_2d", False))
+
+
+def _target_assign_infer(op, block):
+    x = in_var(op, block, "X")
+    mi = in_var(op, block, "MatchIndices")
+    B, P = mi.shape[0], mi.shape[1]
+    K = x.shape[-1]
+    set_out(op, block, "Out", (B, P, K), x.dtype)
+    if op.output("OutWeight"):
+        set_out(op, block, "OutWeight", (B, P, 1), "float32")
+
+
+@register_op("target_assign", infer=_target_assign_infer, grad="auto")
+def _target_assign(ctx, op):
+    """reference target_assign_op.h:50-73, dense form: X carries the
+    per-image candidate targets ([B, G, K] per-gt values, or
+    [B, G, P, K] per-(gt, prior) values such as box_coder encodings);
+    matched priors gather row match[b, p], unmatched get
+    mismatch_value with weight 0."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    mi = ctx.get_input(op, "MatchIndices")          # [B, P] int
+    mismatch = op.attr("mismatch_value", 0)
+    B, P = mi.shape
+    ids = jnp.clip(mi, 0, x.shape[1] - 1)
+    if x.ndim == 3:                                 # [B, G, K]
+        picked = jnp.take_along_axis(
+            x, ids[:, :, None], axis=1)             # [B, P, K]
+    elif x.ndim == 4:                               # [B, G, P, K]
+        # combined gather: out[b, p] = x[b, ids[b, p], p] — O(P)
+        # output without the [B, P, P, K] intermediate (P can be 8732)
+        picked = x[jnp.arange(B)[:, None], ids,
+                   jnp.arange(P)[None, :]]          # [B, P, K]
+    else:
+        raise InvalidArgumentError(
+            f"target_assign: X must be rank 3 or 4, got {x.ndim}")
+    matched = (mi > -1)[:, :, None]
+    out = jnp.where(matched, picked,
+                    jnp.asarray(mismatch, picked.dtype))
+    weight = matched.astype(jnp.float32)
+    if op.single_input("NegMask"):
+        # reference target_assign NegIndices (LoD) -> dense NegMask
+        # [B, P]: mined negatives keep mismatch_value targets but
+        # re-enter the loss with weight 1
+        neg = ctx.get_input(op, "NegMask")[:, :, None] > 0
+        out = jnp.where(neg & ~matched,
+                        jnp.asarray(mismatch, out.dtype), out)
+        weight = jnp.maximum(weight, neg.astype(jnp.float32))
+    ctx.set_output(op, "Out", out)
+    if op.output("OutWeight"):
+        ctx.set_output(op, "OutWeight", weight)
+
+
+def _mine_hard_infer(op, block):
+    mi = in_var(op, block, "MatchIndices")
+    set_out(op, block, "NegMask", mi.shape, "float32")
+    set_out(op, block, "UpdatedMatchIndices", mi.shape, "int32")
+
+
+@register_op("mine_hard_examples", infer=_mine_hard_infer, grad=None)
+def _mine_hard_examples(ctx, op):
+    """reference mine_hard_examples_op.cc:40-100 (max_negative mining).
+    The LoD NegIndices output becomes a fixed-shape NegMask [B, P]:
+    eligible negatives (unmatched, dist below threshold) ranked by
+    classification loss, the top num_pos * neg_pos_ratio per image
+    selected."""
+    jnp = _jnp()
+    cls_loss = ctx.get_input(op, "ClsLoss")         # [B, P]
+    mi = ctx.get_input(op, "MatchIndices")          # [B, P]
+    dist = ctx.get_input(op, "MatchDist")
+    mining = op.attr("mining_type", "max_negative")
+    if mining != "max_negative":
+        raise UnimplementedError(
+            "mine_hard_examples: only max_negative mining (the SSD "
+            "default) has a fixed-shape equivalent; hard_example "
+            "rewrites match indices data-dependently")
+    ratio = op.attr("neg_pos_ratio", 3.0)
+    thresh = op.attr("neg_dist_threshold", 0.5)
+    # max_negative ranks by classification loss ALONE; the reference
+    # only adds LocLoss under hard_example mining
+    # (mine_hard_examples_op.cc:46-49)
+    loss = cls_loss
+    eligible = (mi == -1) & (dist < thresh)
+    num_pos = (mi != -1).sum(axis=1)                # [B]
+    neg_sel = jnp.minimum(
+        (num_pos * ratio).astype(jnp.int32),
+        eligible.sum(axis=1).astype(jnp.int32))     # [B]
+    NEG = jnp.asarray(-jnp.inf, loss.dtype)
+    ranked = jnp.where(eligible, loss, NEG)
+    order = jnp.argsort(-ranked, axis=1)
+    rank = jnp.argsort(order, axis=1)               # rank of each prior
+    mask = (rank < neg_sel[:, None]) & eligible
+    ctx.set_output(op, "NegMask", mask.astype(jnp.float32))
+    ctx.set_output(op, "UpdatedMatchIndices", mi.astype(jnp.int32))

@@ -667,3 +667,129 @@ def test_detection_layer_api():
                                            nms_top_k=5, keep_top_k=3)
         assert tuple(out.shape) == (2, 3, 6)
         assert tuple(cnt.shape) == (2,)
+
+
+# ---------------------------------------------------------------------------
+# SSD training ops (ref density_prior_box_op.h, target_assign_op.h,
+# mine_hard_examples_op.cc)
+# ---------------------------------------------------------------------------
+
+def test_density_prior_box():
+    feat = np.zeros((1, 4, 2, 2), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+    boxes, variances = _run(
+        "density_prior_box", {"Input": feat, "Image": img},
+        ["Boxes", "Variances"],
+        {"fixed_sizes": [8.0], "fixed_ratios": [1.0, 2.0],
+         "densities": [2], "variances": [0.1, 0.1, 0.2, 0.2],
+         "step_w": 0.0, "step_h": 0.0, "offset": 0.5, "clip": True})
+    # numpy reference mirroring the reference kernel loops
+    n = 2 * 4
+    ref = np.zeros((2, 2, n, 4), np.float32)
+    step = 16.0
+    step_avg = int((step + step) * 0.5)
+    for h in range(2):
+        for w in range(2):
+            cx, cy = (w + 0.5) * step, (h + 0.5) * step
+            idx = 0
+            for size, density in [(8.0, 2)]:
+                shift = step_avg // density
+                for r in [1.0, 2.0]:
+                    bw = size * math.sqrt(r)
+                    bh = size / math.sqrt(r)
+                    dcx = cx - step_avg / 2.0 + shift / 2.0
+                    dcy = cy - step_avg / 2.0 + shift / 2.0
+                    for di in range(density):
+                        for dj in range(density):
+                            tx, ty = dcx + dj * shift, dcy + di * shift
+                            ref[h, w, idx] = [
+                                max((tx - bw / 2) / 32.0, 0),
+                                max((ty - bh / 2) / 32.0, 0),
+                                min((tx + bw / 2) / 32.0, 1),
+                                min((ty + bh / 2) / 32.0, 1)]
+                            idx += 1
+    np.testing.assert_allclose(boxes, np.clip(ref, 0, 1), rtol=1e-5,
+                               atol=1e-6)
+    assert variances.shape == boxes.shape
+
+
+def test_target_assign():
+    # 2 images, 3 gt rows, 4 priors; labels K=1
+    gt = np.arange(2 * 3 * 1, dtype=np.float32).reshape(2, 3, 1) + 1
+    mi = np.array([[0, -1, 2, 1], [-1, -1, 0, 2]], np.int32)
+    out, wt = _run("target_assign",
+                   {"X": gt, "MatchIndices": mi},
+                   ["Out", "OutWeight"], {"mismatch_value": -7})
+    exp = np.array([[[1], [-7], [3], [2]], [[-7], [-7], [4], [6]]],
+                   np.float32)
+    np.testing.assert_allclose(out, exp)
+    np.testing.assert_allclose(
+        wt[..., 0], (mi > -1).astype(np.float32))
+
+
+def test_target_assign_per_prior_targets():
+    # encoded loc targets [B, G, P, 4]: out[b, p] = X[b, match, p]
+    rng = R(43)
+    x = rng.randn(1, 2, 3, 4).astype("float32")
+    mi = np.array([[1, -1, 0]], np.int32)
+    out, wt = _run("target_assign", {"X": x, "MatchIndices": mi},
+                   ["Out", "OutWeight"], {"mismatch_value": 0})
+    np.testing.assert_allclose(out[0, 0], x[0, 1, 0])
+    np.testing.assert_allclose(out[0, 2], x[0, 0, 2])
+    np.testing.assert_allclose(out[0, 1], 0.0)
+
+
+def test_mine_hard_examples_max_negative():
+    # 1 image, 6 priors, 2 positives -> neg_sel = min(2*1.5, eligible)
+    mi = np.array([[0, -1, -1, 1, -1, -1]], np.int32)
+    dist = np.array([[0.9, 0.1, 0.2, 0.8, 0.3, 0.9]], np.float32)
+    cls_loss = np.array([[0.5, 3.0, 1.0, 0.2, 2.0, 9.9]], np.float32)
+    mask, upd = _run(
+        "mine_hard_examples",
+        {"ClsLoss": cls_loss, "MatchIndices": mi, "MatchDist": dist},
+        ["NegMask", "UpdatedMatchIndices"],
+        {"mining_type": "max_negative", "neg_pos_ratio": 1.5,
+         "neg_dist_threshold": 0.5})
+    # eligible: priors 1, 2, 4 (unmatched, dist < 0.5); prior 5 has
+    # dist 0.9 -> ineligible despite the largest loss. top-3 by loss
+    # capped at num_pos*1.5 = 3 -> priors 1, 4, 2 selected
+    np.testing.assert_allclose(mask[0], [0, 1, 1, 0, 1, 0])
+    np.testing.assert_array_equal(upd, mi)
+
+
+def test_mine_hard_examples_ratio_caps_selection():
+    mi = np.array([[0, -1, -1, -1, -1, -1]], np.int32)  # 1 positive
+    dist = np.zeros((1, 6), np.float32)
+    cls_loss = np.array([[0.0, 5.0, 4.0, 3.0, 2.0, 1.0]], np.float32)
+    mask, _ = _run(
+        "mine_hard_examples",
+        {"ClsLoss": cls_loss, "MatchIndices": mi, "MatchDist": dist},
+        ["NegMask", "UpdatedMatchIndices"],
+        {"mining_type": "max_negative", "neg_pos_ratio": 2.0,
+         "neg_dist_threshold": 0.5})
+    np.testing.assert_allclose(mask[0], [0, 1, 1, 0, 0, 0])  # top-2
+
+
+def test_target_assign_neg_mask_weights():
+    gt = np.ones((1, 2, 1), np.float32)
+    mi = np.array([[0, -1, -1, 1]], np.int32)
+    neg = np.array([[0, 1, 0, 0]], np.float32)
+    out, wt = _run("target_assign",
+                   {"X": gt, "MatchIndices": mi, "NegMask": neg},
+                   ["Out", "OutWeight"], {"mismatch_value": 0})
+    # mined negative (prior 1) re-enters the loss with weight 1 and
+    # background target; unmined unmatched prior 2 stays weight 0
+    np.testing.assert_allclose(wt[0, :, 0], [1, 1, 0, 1])
+    np.testing.assert_allclose(out[0, 1, 0], 0.0)
+
+
+def test_density_prior_box_flatten_to_2d():
+    feat = np.zeros((1, 4, 2, 2), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+    boxes, variances = _run(
+        "density_prior_box", {"Input": feat, "Image": img},
+        ["Boxes", "Variances"],
+        {"fixed_sizes": [8.0], "fixed_ratios": [1.0], "densities": [2],
+         "variances": [0.1, 0.1, 0.2, 0.2], "flatten_to_2d": True})
+    assert boxes.shape == (2 * 2 * 4, 4)
+    assert variances.shape == boxes.shape

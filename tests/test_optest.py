@@ -1022,7 +1022,8 @@ SKIP = {
            "iou_similarity", "box_coder", "prior_box",
            "anchor_generator", "yolo_box", "box_clip",
            "bipartite_match", "roi_align", "roi_pool",
-           "multiclass_nms"]},
+           "multiclass_nms", "density_prior_box", "target_assign",
+           "mine_hard_examples"]},
 }
 
 
