@@ -611,6 +611,46 @@ def _roi_pool(ctx, op):
 # multiclass_nms (padded multiclass_nms3-style outputs)
 # ---------------------------------------------------------------------------
 
+def _greedy_nms(jnp, lax, iou, scores, n_out, nms_thresh, eta):
+    """Fixed-iteration greedy NMS shared by multiclass_nms and
+    generate_proposals.
+
+    Suppression is evaluated lazily each iteration against the kept set
+    under the CURRENT adaptive threshold — the reference visits
+    candidates in score order and tests each against the threshold at
+    that candidate's turn (thr only shrinks on a keep), which this
+    reproduces: every iteration picks the highest-scoring candidate
+    whose max-IoU vs kept <= thr.
+
+    scores: [M] with ineligible candidates already at -inf. Returns
+    (sel [n_out] int32 with -1 padding, valid [n_out] bool,
+    sel_scores [n_out])."""
+    NEG = jnp.asarray(-jnp.inf, scores.dtype)
+
+    def body(i, state):
+        sel, val, scr, kept, thr = state
+        supp = ((iou > thr) & kept[:, None]).any(axis=0)
+        s_ok = jnp.where(supp | kept, NEG, scores)
+        j = jnp.argmax(s_ok)
+        ok = s_ok[j] > NEG
+        sel = sel.at[i].set(jnp.where(ok, j.astype(jnp.int32), -1))
+        val = val.at[i].set(ok)
+        scr = scr.at[i].set(jnp.where(ok, s_ok[j], 0.0))
+        kept = kept.at[j].set(kept[j] | ok)
+        thr = jnp.where(ok & (eta < 1.0) & (thr > 0.5), thr * eta, thr)
+        return sel, val, scr, kept, thr
+
+    init = (jnp.full((n_out,), -1, jnp.int32),
+            jnp.zeros((n_out,), bool),
+            jnp.zeros((n_out,), scores.dtype),
+            jnp.zeros(scores.shape, bool),
+            jnp.asarray(nms_thresh, scores.dtype))
+    sel, val, scr, _, _ = lax.fori_loop(0, n_out, body, init)
+    return sel, val, scr
+
+
+
+
 def _mc_nms_keep(op):
     keep_top_k = op.attr("keep_top_k", -1)
     nms_top_k = op.attr("nms_top_k", -1)
@@ -675,30 +715,8 @@ def _multiclass_nms(ctx, op):
             cand = jnp.zeros((M,), bool).at[topi].set(True)
             s = jnp.where(cand, s, NEG)
         iou = _iou_matrix(jnp, boxes_m, boxes_m, normalized)
-
-        # Suppression is evaluated lazily each iteration against the
-        # kept set under the CURRENT adaptive threshold — the reference
-        # visits candidates in score order and tests each against the
-        # threshold at that candidate's turn (thr only shrinks on a
-        # keep), which this reproduces: every iteration picks the
-        # highest-scoring candidate whose max-IoU vs kept <= thr.
-        def body(i, state):
-            sel, val, kept, thr = state
-            supp = ((iou > thr) & kept[:, None]).any(axis=0)
-            s_ok = jnp.where(supp | kept, NEG, s)
-            j = jnp.argmax(s_ok)
-            ok = s_ok[j] > NEG
-            sel = sel.at[i].set(jnp.where(ok, j.astype(jnp.int32), -1))
-            val = val.at[i].set(ok)
-            kept = kept.at[j].set(kept[j] | ok)
-            thr = jnp.where(ok & (nms_eta < 1.0) & (thr > 0.5),
-                            thr * nms_eta, thr)
-            return sel, val, kept, thr
-
-        init = (jnp.full((per_class,), -1, jnp.int32),
-                jnp.zeros((per_class,), bool), jnp.zeros((M,), bool),
-                jnp.asarray(nms_thresh, scores.dtype))
-        sel, val, _, _ = lax.fori_loop(0, per_class, body, init)
+        sel, val, _ = _greedy_nms(jnp, lax, iou, s, per_class,
+                                  nms_thresh, nms_eta)
         return sel, val
 
     def per_image(boxes_m, scores_cm):
@@ -900,3 +918,95 @@ def _mine_hard_examples(ctx, op):
     mask = (rank < neg_sel[:, None]) & eligible
     ctx.set_output(op, "NegMask", mask.astype(jnp.float32))
     ctx.set_output(op, "UpdatedMatchIndices", mi.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# generate_proposals (RPN)
+# ---------------------------------------------------------------------------
+
+def _gen_proposals_infer(op, block):
+    scores = in_var(op, block, "Scores")            # [N, A, H, W]
+    N = scores.shape[0]
+    post = op.attr("post_nms_topN", 1000)
+    set_out(op, block, "RpnRois", (N, post, 4), scores.dtype)
+    set_out(op, block, "RpnRoiProbs", (N, post, 1), scores.dtype)
+    if op.output("RpnRoisNum"):
+        set_out(op, block, "RpnRoisNum", (N,), "int32")
+
+
+@register_op("generate_proposals", infer=_gen_proposals_infer, grad=None)
+def _generate_proposals(ctx, op):
+    """reference generate_proposals_op.cc:85-240 — RPN proposal
+    generation: top-pre_nms scores -> delta decode (+1 box widths, exp
+    clipped at log(1000/16)) -> clip to image -> min-size filter ->
+    greedy NMS -> top post_nms. The LoD RpnRois output becomes padded
+    [N, post_nms_topN, 4] + RpnRoisNum counts."""
+    import jax
+    from jax import lax
+
+    jnp = _jnp()
+    scores = ctx.get_input(op, "Scores")            # [N, A, H, W]
+    deltas = ctx.get_input(op, "BboxDeltas")        # [N, 4A, H, W]
+    im_info = ctx.get_input(op, "ImInfo")           # [N, 3]
+    anchors = ctx.get_input(op, "Anchors").reshape(-1, 4)
+    variances = ctx.get_input(op, "Variances").reshape(-1, 4)
+    pre = op.attr("pre_nms_topN", 6000)
+    post = op.attr("post_nms_topN", 1000)
+    nms_thresh = op.attr("nms_thresh", 0.5)
+    min_size = max(op.attr("min_size", 0.1), 1.0)
+    eta = op.attr("eta", 1.0)
+
+    N, A, H, W = scores.shape
+    K = A * H * W
+    # layout: [A, H, W] -> [H, W, A] flat, matching the reference's
+    # NHWA transpose so flat index i pairs with anchors[h, w, a]
+    sc = jnp.transpose(scores, (0, 2, 3, 1)).reshape(N, K)
+    dl = jnp.transpose(deltas.reshape(N, A, 4, H, W),
+                       (0, 3, 4, 1, 2)).reshape(N, K, 4)
+    T1 = min(pre, K) if pre > 0 else K
+    clip_d = float(np.log(1000.0 / 16.0))
+    NEG = jnp.asarray(-jnp.inf, sc.dtype)
+
+    def one_image(s, d, info):
+        topv, topi = lax.top_k(s, T1)
+        an = anchors[topi]
+        var = variances[topi]
+        dd = d[topi]
+        # decode (reference bbox_util.h:216 BoxCoder, +1 widths)
+        aw = an[:, 2] - an[:, 0] + 1.0
+        ah = an[:, 3] - an[:, 1] + 1.0
+        acx = an[:, 0] + 0.5 * aw
+        acy = an[:, 1] + 0.5 * ah
+        cx = var[:, 0] * dd[:, 0] * aw + acx
+        cy = var[:, 1] * dd[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(var[:, 2] * dd[:, 2], clip_d)) * aw
+        h = jnp.exp(jnp.minimum(var[:, 3] * dd[:, 3], clip_d)) * ah
+        x0, y0 = cx - 0.5 * w, cy - 0.5 * h
+        x1, y1 = cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0
+        # clip to raw image bounds (ClipTiledBoxes is_scale=false)
+        im_h, im_w, im_s = info[0], info[1], info[2]
+        x0 = jnp.clip(x0, 0.0, im_w - 1.0)
+        y0 = jnp.clip(y0, 0.0, im_h - 1.0)
+        x1 = jnp.clip(x1, 0.0, im_w - 1.0)
+        y1 = jnp.clip(y1, 0.0, im_h - 1.0)
+        boxes = jnp.stack([x0, y0, x1, y1], axis=1)
+        # min-size filter in ORIGINAL image scale (FilterBoxes
+        # is_scale=true) + center inside image
+        ws = (x1 - x0) / im_s + 1.0
+        hs = (y1 - y0) / im_s + 1.0
+        keep = ((ws >= min_size) & (hs >= min_size)
+                & (x0 + 0.5 * (x1 - x0 + 1.0) <= im_w)
+                & (y0 + 0.5 * (y1 - y0 + 1.0) <= im_h))
+        s_f = jnp.where(keep, topv, NEG)
+        iou = _iou_matrix(jnp, boxes, boxes, False)  # +1 areas, like NMS<T>
+        sel, val, scr = _greedy_nms(jnp, lax, iou, s_f, post,
+                                    nms_thresh, eta)
+        rois = jnp.where(val[:, None],
+                         boxes[jnp.clip(sel, 0, T1 - 1)], 0.0)
+        return rois, scr[:, None], val.sum().astype(jnp.int32)
+
+    rois, probs, nums = jax.vmap(one_image)(sc, dl, im_info)
+    ctx.set_output(op, "RpnRois", rois)
+    ctx.set_output(op, "RpnRoiProbs", probs)
+    if op.output("RpnRoisNum"):
+        ctx.set_output(op, "RpnRoisNum", nums)

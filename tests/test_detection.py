@@ -793,3 +793,99 @@ def test_density_prior_box_flatten_to_2d():
          "variances": [0.1, 0.1, 0.2, 0.2], "flatten_to_2d": True})
     assert boxes.shape == (2 * 2 * 4, 4)
     assert variances.shape == boxes.shape
+
+
+def _np_generate_proposals(scores, deltas, im_info, anchors, variances,
+                           pre, post, nms_thresh, min_size, eta):
+    """numpy re-derivation of generate_proposals_op.cc per image."""
+    N, A, H, W = scores.shape
+    K = A * H * W
+    an = anchors.reshape(-1, 4)
+    var = variances.reshape(-1, 4)
+    clip_d = math.log(1000.0 / 16.0)
+    min_size = max(min_size, 1.0)
+    all_rois, all_scores, counts = [], [], []
+    for n in range(N):
+        s = scores[n].transpose(1, 2, 0).reshape(-1)
+        d = deltas[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4)
+        order = np.argsort(-s, kind="stable")
+        t1 = min(pre, K) if pre > 0 else K
+        idx = order[:t1]
+        boxes = []
+        for i in idx:
+            aw = an[i, 2] - an[i, 0] + 1.0
+            ah = an[i, 3] - an[i, 1] + 1.0
+            acx, acy = an[i, 0] + 0.5 * aw, an[i, 1] + 0.5 * ah
+            cx = var[i, 0] * d[i, 0] * aw + acx
+            cy = var[i, 1] * d[i, 1] * ah + acy
+            w = math.exp(min(var[i, 2] * d[i, 2], clip_d)) * aw
+            h = math.exp(min(var[i, 3] * d[i, 3], clip_d)) * ah
+            im_h, im_w, im_s = im_info[n]
+            x0 = np.clip(cx - 0.5 * w, 0, im_w - 1)
+            y0 = np.clip(cy - 0.5 * h, 0, im_h - 1)
+            x1 = np.clip(cx + 0.5 * w - 1, 0, im_w - 1)
+            y1 = np.clip(cy + 0.5 * h - 1, 0, im_h - 1)
+            boxes.append((x0, y0, x1, y1, s[i]))
+        # filter + greedy NMS (+1 IoU areas)
+        cands = []
+        for (x0, y0, x1, y1, sc) in boxes:
+            im_h, im_w, im_s = im_info[n]
+            ws, hs = (x1 - x0) / im_s + 1, (y1 - y0) / im_s + 1
+            if ws >= min_size and hs >= min_size and \
+                    x0 + 0.5 * (x1 - x0 + 1) <= im_w and \
+                    y0 + 0.5 * (y1 - y0 + 1) <= im_h:
+                cands.append((sc, (x0, y0, x1, y1)))
+        cands.sort(key=lambda c: -c[0])
+        kept, thr = [], nms_thresh
+        for sc, b in cands:
+            if len(kept) >= post:
+                break
+            ok = all(_np_iou(np.asarray([b], "float32"),
+                             np.asarray([kb], "float32"),
+                             normalized=False)[0, 0] <= thr
+                     for _, kb in kept)
+            if ok:
+                kept.append((sc, b))
+                if eta < 1.0 and thr > 0.5:
+                    thr *= eta
+        all_rois.append([b for _, b in kept])
+        all_scores.append([sc for sc, _ in kept])
+        counts.append(len(kept))
+    return all_rois, all_scores, counts
+
+
+def test_generate_proposals():
+    rng = R(47)
+    N, A, H, W = 1, 3, 4, 4
+    scores = rng.uniform(0, 1, (N, A, H, W)).astype("float32")
+    deltas = (rng.randn(N, 4 * A, H, W) * 0.2).astype("float32")
+    im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for h in range(H):
+        for w in range(W):
+            for a, size in enumerate([6.0, 10.0, 14.0]):
+                cx, cy = w * 8 + 4, h * 8 + 4
+                anchors[h, w, a] = [cx - size / 2, cy - size / 2,
+                                    cx + size / 2, cy + size / 2]
+    variances = np.full((H, W, A, 4), 0.1, np.float32)
+    rois, probs, nums = _run(
+        "generate_proposals",
+        {"Scores": scores, "BboxDeltas": deltas, "ImInfo": im_info,
+         "Anchors": anchors, "Variances": variances},
+        ["RpnRois", "RpnRoiProbs", "RpnRoisNum"],
+        {"pre_nms_topN": 20, "post_nms_topN": 8, "nms_thresh": 0.5,
+         "min_size": 2.0, "eta": 1.0})
+    ref_rois, ref_scores, ref_counts = _np_generate_proposals(
+        scores, deltas, im_info, anchors, variances, 20, 8, 0.5, 2.0,
+        1.0)
+    assert rois.shape == (1, 8, 4) and probs.shape == (1, 8, 1)
+    np.testing.assert_array_equal(nums, ref_counts)
+    nkeep = ref_counts[0]
+    np.testing.assert_allclose(rois[0, :nkeep],
+                               np.asarray(ref_rois[0], "float32"),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(probs[0, :nkeep, 0],
+                               np.asarray(ref_scores[0], "float32"),
+                               rtol=1e-4, atol=1e-5)
+    assert (rois[0, nkeep:] == 0).all()

@@ -1023,7 +1023,7 @@ SKIP = {
            "anchor_generator", "yolo_box", "box_clip",
            "bipartite_match", "roi_align", "roi_pool",
            "multiclass_nms", "density_prior_box", "target_assign",
-           "mine_hard_examples"]},
+           "mine_hard_examples", "generate_proposals"]},
 }
 
 
