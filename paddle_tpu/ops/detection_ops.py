@@ -1010,3 +1010,203 @@ def _generate_proposals(ctx, op):
     ctx.set_output(op, "RpnRoiProbs", probs)
     if op.output("RpnRoisNum"):
         ctx.set_output(op, "RpnRoisNum", nums)
+
+
+# ---------------------------------------------------------------------------
+# matrix_nms / FPN proposal plumbing
+# ---------------------------------------------------------------------------
+
+def _matrix_nms_infer(op, block):
+    b = in_var(op, block, "BBoxes")                 # [B, M, 4]
+    s = in_var(op, block, "Scores")                 # [B, C, M]
+    B, M, C = b.shape[0], b.shape[1], s.shape[1]
+    keep_top_k = op.attr("keep_top_k", -1)
+    nms_top_k = op.attr("nms_top_k", -1)
+    K, _ = _mc_nms_out_k(keep_top_k, nms_top_k, M, C)
+    set_out(op, block, "Out", (B, K, 6), b.dtype)
+    if op.output("Index"):
+        set_out(op, block, "Index", (B, K), "int32")
+    if op.output("RoisNum"):
+        set_out(op, block, "RoisNum", (B,), "int32")
+
+
+@register_op("matrix_nms", infer=_matrix_nms_infer, grad=None)
+def _matrix_nms(ctx, op):
+    """reference matrix_nms_op.cc:81-167 — soft-NMS by decay matrix
+    (PP-YOLO/SOLOv2): no sequential suppression loop at all, so the
+    whole op is dense linear algebra — the one NMS variant that is
+    natively TPU-shaped. decay[i] = min_j<i fn(iou_ij, iou_max[j]);
+    candidates keep score*decay and survive post_threshold."""
+    import jax
+
+    jnp = _jnp()
+    bboxes = ctx.get_input(op, "BBoxes")            # [B, M, 4]
+    scores = ctx.get_input(op, "Scores")            # [B, C, M]
+    B, M = bboxes.shape[0], bboxes.shape[1]
+    C = scores.shape[1]
+    background = op.attr("background_label", 0)
+    score_thresh = op.attr("score_threshold", 0.0)
+    post_thresh = op.attr("post_threshold", 0.0)
+    use_gaussian = op.attr("use_gaussian", False)
+    sigma = op.attr("gaussian_sigma", 2.0)
+    normalized = op.attr("normalized", True)
+    keep_top_k = op.attr("keep_top_k", -1)
+    nms_top_k = op.attr("nms_top_k", -1)
+    K, per_class = _mc_nms_out_k(keep_top_k, nms_top_k, M, C)
+    NEG = jnp.asarray(-jnp.inf, scores.dtype)
+
+    def nms_one_class(boxes_m, scores_m):
+        s = jnp.where(scores_m > score_thresh, scores_m, NEG)
+        order = jnp.argsort(-s)[:per_class]         # score-desc cands
+        sv = s[order]
+        bv = boxes_m[order]
+        iou = _iou_matrix(jnp, bv, bv, normalized)  # [T, T]
+        tri = jnp.tril(jnp.ones((per_class, per_class), bool), k=-1)
+        iou_lower = jnp.where(tri, iou, 0.0)
+        # iou_max[j] = max_{k<j} iou[j, k]
+        iou_max = iou_lower.max(axis=1)
+        if use_gaussian:
+            decay_m = jnp.exp((iou_max[None, :] ** 2 - iou ** 2)
+                              * sigma)
+        else:
+            decay_m = (1.0 - iou) / (1.0 - iou_max[None, :])
+        decay = jnp.where(tri, decay_m, 1.0).min(axis=1)
+        ds = jnp.where(jnp.isfinite(sv), decay * sv, NEG)
+        valid = ds > post_thresh
+        return order.astype(jnp.int32), ds, valid
+
+    def per_image(boxes_m, scores_cm):
+        sel, ds, val = jax.vmap(
+            lambda s_m: nms_one_class(boxes_m, s_m))(scores_cm)
+        if 0 <= background < C:
+            val = val.at[background].set(
+                jnp.zeros((per_class,), bool))
+        flat_idx = sel.reshape(-1)
+        flat_val = val.reshape(-1)
+        flat_ds = jnp.where(flat_val, ds.reshape(-1), NEG)
+        cls = jnp.repeat(jnp.arange(C), per_class)
+        order = jnp.argsort(-flat_ds)[:K]
+        kept_score = flat_ds[order]
+        kept_valid = kept_score > NEG
+        kept_idx = jnp.where(kept_valid, flat_idx[order], -1)
+        kept_cls = jnp.where(kept_valid, cls[order], -1)
+        kept_boxes = boxes_m[jnp.clip(kept_idx, 0, M - 1)]
+        out = jnp.concatenate([
+            kept_cls.astype(boxes_m.dtype)[:, None],
+            jnp.where(kept_valid, kept_score, 0.0)[:, None],
+            jnp.where(kept_valid[:, None], kept_boxes, 0.0)], axis=1)
+        return out, kept_idx, kept_valid.sum().astype(jnp.int32)
+
+    out, index, nums = jax.vmap(per_image)(bboxes, scores)
+    ctx.set_output(op, "Out", out)
+    if op.output("Index"):
+        ctx.set_output(op, "Index", index)
+    if op.output("RoisNum"):
+        ctx.set_output(op, "RoisNum", nums)
+
+
+def _distribute_fpn_infer(op, block):
+    rois = in_var(op, block, "FpnRois")             # [R, 4]
+    R = rois.shape[0]
+    # set_out applies the shape to every var in a multi-var slot
+    set_out(op, block, "MultiFpnRois", (R, 4), rois.dtype)
+    set_out(op, block, "RestoreIndex", (R, 1), "int32")
+    if op.output("MultiLevelRoIsNum"):
+        set_out(op, block, "MultiLevelRoIsNum", (1,), "int32")
+
+
+@register_op("distribute_fpn_proposals", infer=_distribute_fpn_infer,
+             grad=None)
+def _distribute_fpn_proposals(ctx, op):
+    """reference distribute_fpn_proposals_op.h:100-150: assign each roi
+    to level floor(log2(sqrt(area)/refer_scale) + refer_level). The
+    variable-length per-level splits become full-size padded tensors
+    (invalid rows zeroed) + per-level counts; rois pack to the front of
+    their level in original order, matching the reference's stable
+    per-level scatter. RestoreIndex maps level-concatenated order back
+    to the input order."""
+    jnp = _jnp()
+    if op.input("RoisNum"):
+        raise UnimplementedError(
+            "distribute_fpn_proposals: batched RoisNum input is not "
+            "supported yet — split per image and distribute each "
+            "image's rois separately")
+    rois = ctx.get_input(op, "FpnRois")             # [R, 4]
+    lo = op.attr("min_level", 2)
+    hi = op.attr("max_level", 5)
+    refer_level = op.attr("refer_level", 4)
+    refer_scale = op.attr("refer_scale", 224)
+    n_level = hi - lo + 1
+    R = rois.shape[0]
+
+    ws = rois[:, 2] - rois[:, 0] + 1.0
+    hs = rois[:, 3] - rois[:, 1] + 1.0
+    scale = jnp.sqrt(ws * hs)
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6) + refer_level)
+    lvl = jnp.clip(lvl, lo, hi).astype(jnp.int32)   # [R]
+
+    outs, counts, restore_src = [], [], []
+    offset = jnp.zeros((), jnp.int32)
+    positions = jnp.zeros((R,), jnp.int32)
+    for li in range(n_level):
+        mask = lvl == (lo + li)
+        cnt = mask.sum().astype(jnp.int32)
+        # stable pack-to-front: rank within the level by original index
+        rank = jnp.cumsum(mask) - 1                 # [R]
+        padded = jnp.zeros((R, 4), rois.dtype)
+        padded = padded.at[jnp.where(mask, rank, R)].set(
+            rois, mode="drop")
+        outs.append(padded)
+        counts.append(cnt.reshape(1))
+        positions = jnp.where(mask, offset + rank, positions)
+        offset = offset + cnt
+    # reference restore_index[original_idx] = position in the
+    # level-concatenated order (distribute_fpn_proposals_op.h:160-162)
+    ctx.set_outputs(op, "MultiFpnRois", outs)
+    ctx.set_output(op, "RestoreIndex", positions[:, None])
+    if op.output("MultiLevelRoIsNum"):
+        ctx.set_outputs(op, "MultiLevelRoIsNum", counts)
+
+
+def _collect_fpn_infer(op, block):
+    rois0 = in_var(op, block, "MultiLevelRois")
+    post = op.attr("post_nms_topN", 100)
+    set_out(op, block, "FpnRois", (post, 4), rois0.dtype)
+    if op.output("RoisNum"):
+        set_out(op, block, "RoisNum", (1,), "int32")
+
+
+@register_op("collect_fpn_proposals", infer=_collect_fpn_infer,
+             grad=None)
+def _collect_fpn_proposals(ctx, op):
+    """reference collect_fpn_proposals_op.h: concat per-level rois +
+    scores, keep the global top post_nms_topN by score. Padded-input
+    convention: each level i supplies rois [Ri, 4], scores [Ri, 1] and
+    (optionally) MultiLevelRoIsNum counts masking the padding."""
+    jnp = _jnp()
+    rois_list = ctx.get_inputs(op, "MultiLevelRois")
+    score_list = ctx.get_inputs(op, "MultiLevelScores")
+    post = op.attr("post_nms_topN", 100)
+    NEG = jnp.asarray(-jnp.inf, score_list[0].dtype)
+    if op.input("MultiLevelRoIsNum"):
+        nums = ctx.get_inputs(op, "MultiLevelRoIsNum")
+        masked = []
+        for s, n in zip(score_list, nums):
+            idx = jnp.arange(s.shape[0])
+            masked.append(jnp.where(idx < n[0], s[:, 0], NEG))
+        scores = jnp.concatenate(masked)
+    else:
+        scores = jnp.concatenate([s[:, 0] for s in score_list])
+    from jax import lax
+
+    rois = jnp.concatenate(rois_list, axis=0)
+    k = min(post, scores.shape[0])
+    topv, topi = lax.top_k(scores, k)
+    valid = topv > NEG
+    out = jnp.zeros((post, 4), rois.dtype)
+    out = out.at[jnp.arange(k)].set(
+        jnp.where(valid[:, None], rois[topi], 0.0))
+    ctx.set_output(op, "FpnRois", out)
+    if op.output("RoisNum"):
+        ctx.set_output(op, "RoisNum",
+                       valid.sum().astype(jnp.int32).reshape(1))

@@ -889,3 +889,142 @@ def test_generate_proposals():
                                np.asarray(ref_scores[0], "float32"),
                                rtol=1e-4, atol=1e-5)
     assert (rois[0, nkeep:] == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# matrix_nms / FPN plumbing (ref matrix_nms_op.cc,
+# distribute_fpn_proposals_op.h, collect_fpn_proposals_op.h)
+# ---------------------------------------------------------------------------
+
+def _np_matrix_nms_class(boxes, scores, score_thresh, post_thresh,
+                         top_k, use_gaussian, sigma, normalized):
+    perm = [i for i in np.argsort(-scores, kind="stable")
+            if scores[i] > score_thresh]
+    if top_k > -1:
+        perm = perm[:top_k]
+    out = []
+    if not perm:
+        return out
+    iou = _np_iou(boxes[perm], boxes[perm], normalized)
+    iou_max = [0.0]
+    for i in range(1, len(perm)):
+        iou_max.append(max(iou[i, j] for j in range(i)))
+    if scores[perm[0]] > post_thresh:
+        out.append((perm[0], scores[perm[0]]))
+    for i in range(1, len(perm)):
+        min_decay = 1.0
+        for j in range(i):
+            if use_gaussian:
+                d = math.exp((iou_max[j] ** 2 - iou[i, j] ** 2) * sigma)
+            else:
+                d = (1.0 - iou[i, j]) / (1.0 - iou_max[j])
+            min_decay = min(min_decay, d)
+        ds = min_decay * scores[perm[i]]
+        if ds > post_thresh:
+            out.append((perm[i], ds))
+    return out
+
+
+@pytest.mark.parametrize("use_gaussian", [False, True])
+def test_matrix_nms(use_gaussian):
+    rng = R(51)
+    B, M, C = 1, 10, 3
+    bboxes = np.stack([_rand_boxes(rng, M)])
+    scores = rng.permutation(B * C * M).reshape(B, C, M) \
+        .astype("float32") / (B * C * M)
+    out, index, nums = _run(
+        "matrix_nms", {"BBoxes": bboxes, "Scores": scores},
+        ["Out", "Index", "RoisNum"],
+        {"background_label": 0, "score_threshold": 0.1,
+         "post_threshold": 0.2, "nms_top_k": 6, "keep_top_k": 8,
+         "use_gaussian": use_gaussian, "gaussian_sigma": 2.0,
+         "normalized": True})
+    dets = []
+    for c in range(1, C):
+        for i, ds in _np_matrix_nms_class(
+                bboxes[0], scores[0, c], 0.1, 0.2, 6, use_gaussian,
+                2.0, True):
+            dets.append((c, ds, i))
+    dets.sort(key=lambda d: -d[1])
+    dets = dets[:8]
+    assert nums[0] == len(dets)
+    for k, (c, ds, i) in enumerate(dets):
+        assert out[0, k, 0] == c
+        np.testing.assert_allclose(out[0, k, 1], ds, rtol=1e-5)
+        np.testing.assert_allclose(out[0, k, 2:], bboxes[0, i],
+                                   rtol=1e-5)
+        assert index[0, k] == i
+
+
+def test_distribute_and_collect_fpn():
+    # rois with known scales -> known levels
+    rois = np.array([
+        [0, 0, 15, 15],      # scale 16 -> log2(16/224)+4 ~ 0.2 -> lvl 2
+        [0, 0, 223, 223],    # scale 224 -> lvl 4
+        [0, 0, 447, 447],    # scale 448 -> lvl 5
+        [0, 0, 111, 111],    # scale 112 -> lvl 3
+        [0, 0, 15, 31],      # small -> lvl 2
+    ], np.float32)
+    outs = _run_multi(
+        "distribute_fpn_proposals", {"FpnRois": rois},
+        {"MultiFpnRois": 4, "RestoreIndex": 1, "MultiLevelRoIsNum": 4},
+        {"min_level": 2, "max_level": 5, "refer_level": 4,
+         "refer_scale": 224})
+    lvl_rois = outs[:4]
+    restore = outs[4]
+    counts = [int(c[0]) for c in outs[5:]]
+    assert counts == [2, 1, 1, 1]
+    np.testing.assert_allclose(lvl_rois[0][:2], rois[[0, 4]])
+    np.testing.assert_allclose(lvl_rois[1][0], rois[3])
+    np.testing.assert_allclose(lvl_rois[2][0], rois[1])
+    np.testing.assert_allclose(lvl_rois[3][0], rois[2])
+    # restore maps concat(levels) order back to input order
+    concat = np.concatenate([lvl_rois[i][:counts[i]]
+                             for i in range(4)])
+    np.testing.assert_allclose(concat[restore[:, 0]], rois)
+
+    # collect: top-3 by score across two levels with padding masked
+    l0 = np.array([[0, 0, 1, 1], [0, 0, 2, 2], [9, 9, 9, 9]],
+                  np.float32)
+    l1 = np.array([[0, 0, 3, 3], [8, 8, 8, 8]], np.float32)
+    s0 = np.array([[0.9], [0.2], [0.99]], np.float32)  # row 2 is pad
+    s1 = np.array([[0.8], [0.99]], np.float32)         # row 1 is pad
+    n0 = np.array([2], np.int32)
+    n1 = np.array([1], np.int32)
+    fpn, cnt = _run_multi(
+        "collect_fpn_proposals",
+        {"MultiLevelRois": [l0, l1], "MultiLevelScores": [s0, s1],
+         "MultiLevelRoIsNum": [n0, n1]},
+        {"FpnRois": 1, "RoisNum": 1}, {"post_nms_topN": 3})
+    assert cnt[0] == 3
+    np.testing.assert_allclose(fpn[0], [0, 0, 1, 1])   # 0.9
+    np.testing.assert_allclose(fpn[1], [0, 0, 3, 3])   # 0.8
+    np.testing.assert_allclose(fpn[2], [0, 0, 2, 2])   # 0.2
+
+
+def _run_multi(op_type, inputs, outputs, attrs):
+    """Like _run but supports multi-var slots on both sides."""
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    feed = {}
+    with pt.program_guard(main, startup):
+        block = main.global_block()
+        in_slots = {}
+        for slot, arrs in inputs.items():
+            arrs = arrs if isinstance(arrs, list) else [arrs]
+            names = []
+            for j, arr in enumerate(arrs):
+                name = f"in_{slot}_{j}"
+                block.create_var(name=name, shape=arr.shape,
+                                 dtype=str(arr.dtype), is_data=True,
+                                 stop_gradient=True)
+                feed[name] = arr
+                names.append(name)
+            in_slots[slot] = names
+        out_slots = {slot: [f"out_{slot}_{j}" for j in range(cnt)]
+                     for slot, cnt in outputs.items()}
+        block.append_op(op_type, inputs=in_slots, outputs=out_slots,
+                        attrs=attrs)
+        fetch = [n for ns in out_slots.values() for n in ns]
+    res = pt.Executor().run(main, feed=feed, fetch_list=fetch)
+    return [np.asarray(r) for r in res]

@@ -1023,7 +1023,8 @@ SKIP = {
            "anchor_generator", "yolo_box", "box_clip",
            "bipartite_match", "roi_align", "roi_pool",
            "multiclass_nms", "density_prior_box", "target_assign",
-           "mine_hard_examples", "generate_proposals"]},
+           "mine_hard_examples", "generate_proposals", "matrix_nms",
+           "distribute_fpn_proposals", "collect_fpn_proposals"]},
 }
 
 
