@@ -1,5 +1,7 @@
 """paddle.text namespace (reference python/paddle/text/)."""
 from . import datasets  # noqa: F401
-from .datasets import Imdb, Imikolov, Movielens, UCIHousing  # noqa: F401
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: F401
+                       UCIHousing, WMT14, WMT16)
 
-__all__ = ["datasets", "Imdb", "Imikolov", "Movielens", "UCIHousing"]
+__all__ = ["datasets", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16", "Conll05st"]

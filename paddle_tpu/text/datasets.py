@@ -19,7 +19,7 @@ import numpy as np
 from ..reader import Dataset
 
 __all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "MovieInfo",
-           "UserInfo"]
+           "UserInfo", "WMT14", "WMT16", "Conll05st"]
 
 
 from ..vision.datasets import _need  # shared local-path validator
@@ -257,3 +257,263 @@ class Movielens(Dataset):
         feat = np.asarray([uid, int(u.is_male), u.age, u.job_id, mid],
                           np.int64)
         return feat, np.float32(rating)
+
+
+_WMT_UNK, _WMT_START, _WMT_END = "<unk>", "<s>", "<e>"
+
+
+class WMT14(Dataset):
+    """shrunk WMT14 fr-en tar (reference dataset/wmt14.py:56-105):
+    src.dict/trg.dict members + train/test files of 'src\\ttrg' lines.
+    Samples are (src_ids with <s>/<e>, trg_ids with <s>, trg_next with
+    <e>); train pairs longer than 80 tokens are dropped."""
+
+    UNK_IDX = 2
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=False):
+        self.data_file = _need(data_file, "WMT14")
+        if dict_size <= 0:
+            dict_size = 10 ** 9
+        self.src_dict, self.trg_dict = self._dicts(dict_size)
+        self.data = self._load(mode)
+
+    def _dicts(self, size):
+        out = []
+        with tarfile.open(self.data_file) as tf:
+            for suffix in ("src.dict", "trg.dict"):
+                names = [m.name for m in tf.getmembers()
+                         if m.name.endswith(suffix)]
+                if len(names) != 1:
+                    raise ValueError(
+                        f"WMT14: expected exactly one *{suffix} member,"
+                        f" found {names}")
+                d = {}
+                for i, line in enumerate(
+                        tf.extractfile(names[0]).read().decode()
+                        .splitlines()):
+                    if i >= size:
+                        break
+                    d[line.strip()] = i
+                out.append(d)
+        return out
+
+    def _load(self, mode):
+        which = {"train": "train/train", "test": "test/test",
+                 "gen": "gen/gen"}[mode]
+        data = []
+        with tarfile.open(self.data_file) as tf:
+            names = [m.name for m in tf.getmembers()
+                     if m.name.endswith(which)]
+            for name in names:
+                for line in tf.extractfile(name).read().decode() \
+                        .splitlines():
+                    parts = line.strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, self.UNK_IDX)
+                           for w in [_WMT_START] + parts[0].split()
+                           + [_WMT_END]]
+                    trg = [self.trg_dict.get(w, self.UNK_IDX)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    trg_next = trg + [self.trg_dict[_WMT_END]]
+                    trg = [self.trg_dict[_WMT_START]] + trg
+                    data.append((np.asarray(src, np.int64),
+                                 np.asarray(trg, np.int64),
+                                 np.asarray(trg_next, np.int64)))
+        return data
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+
+class WMT16(Dataset):
+    """WMT16 en-de tar (reference dataset/wmt16.py:60-140): 'wmt16/
+    {train,val,test}' members of 'en\\tde' lines; dictionaries built
+    from the train corpus by frequency with <s>/<e>/<unk> reserved."""
+
+    def __init__(self, data_file=None, mode="train",
+                 src_dict_size=10000, trg_dict_size=10000,
+                 lang="en", download=False):
+        if lang not in ("en", "de"):
+            raise ValueError(f"WMT16: lang must be 'en' or 'de', got "
+                             f"{lang!r}")
+        self.data_file = _need(data_file, "WMT16")
+        self.lang = lang
+        # one pass over the (large, gzipped) train member builds both
+        # frequency tables
+        freq_en, freq_de = self._count_train()
+        src_freq = freq_en if lang == "en" else freq_de
+        trg_freq = freq_de if lang == "en" else freq_en
+        self.src_dict = self._vocab(src_freq, src_dict_size)
+        self.trg_dict = self._vocab(trg_freq, trg_dict_size)
+        self.data = self._load(mode)
+
+    def _count_train(self):
+        freqs = (collections.defaultdict(int),
+                 collections.defaultdict(int))
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile("wmt16/train").read().decode() \
+                    .splitlines():
+                parts = line.strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for col in (0, 1):
+                    for w in parts[col].split():
+                        freqs[col][w] += 1
+        return freqs
+
+    @staticmethod
+    def _vocab(freq, size):
+        words = [w for w, _ in sorted(freq.items(),
+                                      key=lambda x: (-x[1], x[0]))]
+        vocab = [_WMT_START, _WMT_END, _WMT_UNK] + words[:size - 3]
+        return {w: i for i, w in enumerate(vocab)}
+
+    def _load(self, mode):
+        start = self.src_dict[_WMT_START]
+        end = self.src_dict[_WMT_END]
+        unk = self.src_dict[_WMT_UNK]
+        src_col = 0 if self.lang == "en" else 1
+        data = []
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile(f"wmt16/{mode}").read().decode() \
+                    .splitlines():
+                parts = line.strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [start] + [self.src_dict.get(w, unk)
+                                 for w in parts[src_col].split()] + [end]
+                trg = [self.trg_dict.get(w, unk)
+                       for w in parts[1 - src_col].split()]
+                data.append((np.asarray(src, np.int64),
+                             np.asarray([start] + trg, np.int64),
+                             np.asarray(trg + [end], np.int64)))
+        return data
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (reference dataset/conll05.py:corpus_reader +
+    reader_creator): words/props gz members inside the tar; props
+    bracket notation expands to B-/I-/O tags; each (sentence,
+    predicate) pair is one sample of (word_ids, predicate_id, mark,
+    label_ids), where mark flags the +/-2 context window around the
+    predicate (reference reader_creator:160-184)."""
+
+    def __init__(self, data_file=None, word_dict=None, label_list=None,
+                 words_name="conll05st-release/test.wsj/words/"
+                            "test.wsj.words.gz",
+                 props_name="conll05st-release/test.wsj/props/"
+                            "test.wsj.props.gz",
+                 download=False):
+        import gzip
+        import io
+
+        self.data_file = _need(data_file, "Conll05st")
+        samples = []
+        with tarfile.open(self.data_file) as tf:
+            words_raw = tf.extractfile(words_name).read()
+            props_raw = tf.extractfile(props_name).read()
+        if words_name.endswith(".gz"):
+            words_raw = gzip.decompress(words_raw)
+            props_raw = gzip.decompress(props_raw)
+        sentences, one_seg = [], []
+        for word, prop in zip(io.StringIO(words_raw.decode()),
+                              io.StringIO(props_raw.decode())):
+            word = word.strip()
+            label = prop.strip().split()
+            if not label:  # sentence boundary
+                labels = list(map(list, zip(*one_seg))) if one_seg \
+                    else []
+                if labels:
+                    verbs = [x for x in labels[0] if x != "-"]
+                    for i, lbl in enumerate(labels[1:]):
+                        samples.append(
+                            (list(sentences), verbs[i],
+                             self._expand(lbl)))
+                sentences, one_seg = [], []
+            else:
+                sentences.append(word)
+                one_seg.append(label)
+        self.word_dict = word_dict or self._auto_dict(samples)
+        self.label_dict = self._label_dict(samples, label_list)
+        self.predicate_dict = {v: i for i, v in enumerate(
+            sorted({verb for _, verb, _ in samples}))}
+        self.samples = [self._to_ids(s) for s in samples]
+
+    @staticmethod
+    def _expand(lbl):
+        """bracket props -> B-/I-/O (reference conll05.py:186-210)."""
+        out, cur, inside = [], "O", False
+        for l in lbl:
+            if l == "*" and not inside:
+                out.append("O")
+            elif l == "*" and inside:
+                out.append("I-" + cur)
+            elif l == "*)":
+                out.append("I-" + cur)
+                inside = False
+            elif "(" in l and ")" in l:
+                cur = l[1:l.find("*")]
+                out.append("B-" + cur)
+                inside = False
+            elif "(" in l:
+                cur = l[1:l.find("*")]
+                out.append("B-" + cur)
+                inside = True
+            else:
+                raise ValueError(f"Conll05st: unexpected label {l!r}")
+        return out
+
+    @staticmethod
+    def _auto_dict(samples):
+        words = sorted({w for s, _, _ in samples for w in s})
+        d = {w: i for i, w in enumerate(words)}
+        d.setdefault("<unk>", len(d))
+        return d
+
+    @staticmethod
+    def _label_dict(samples, label_list):
+        tags = label_list or sorted(
+            {t[2:] for _, _, lbl in samples for t in lbl
+             if t.startswith(("B-", "I-"))})
+        d = {}
+        for t in tags:
+            d["B-" + t] = len(d)
+            d["I-" + t] = len(d)
+        d["O"] = len(d)
+        return d
+
+    def _to_ids(self, sample):
+        sent, verb, lbl = sample
+        unk = self.word_dict.get("<unk>", 0)
+        word_ids = np.asarray([self.word_dict.get(w, unk)
+                               for w in sent], np.int64)
+        verb_idx = lbl.index("B-V")
+        # reference reader_creator:160-184 — the predicate and its
+        # +/-2 neighbors are flagged
+        mark = np.zeros(len(lbl), np.int64)
+        for d in (-2, -1, 0, 1, 2):
+            if 0 <= verb_idx + d < len(lbl):
+                mark[verb_idx + d] = 1
+        label_ids = np.asarray([self.label_dict[t] for t in lbl],
+                               np.int64)
+        return (word_ids, np.int64(self.predicate_dict[verb]), mark,
+                label_ids)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        return self.samples[idx]

@@ -288,3 +288,84 @@ def test_movielens(tmp_path):
     assert rating in (5.0, 3.0, 4.0)
     assert ds.movie_info[10].categories == ["Comedy", "Drama"]
     assert ds.user_info[2].is_male is False
+
+
+def _tar_add(tar, name, content):
+    import io
+
+    data = content.encode() if isinstance(content, str) else content
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tar.addfile(info, io.BytesIO(data))
+
+
+def test_wmt14(tmp_path):
+    p = str(tmp_path / "wmt14.tgz")
+    with tarfile.open(p, "w:gz") as tar:
+        _tar_add(tar, "wmt14/src.dict",
+                 "<s>\n<e>\n<unk>\nhello\nworld\n")
+        _tar_add(tar, "wmt14/trg.dict",
+                 "<s>\n<e>\n<unk>\nbonjour\nmonde\n")
+        _tar_add(tar, "wmt14/train/train",
+                 "hello world\tbonjour monde\n"
+                 "hello novel\tbonjour inconnu\n")
+        _tar_add(tar, "wmt14/test/test", "world\tmonde\n")
+    ds = pt.text.WMT14(data_file=p, mode="train")
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    # <s> hello world <e>
+    np.testing.assert_array_equal(src, [0, 3, 4, 1])
+    np.testing.assert_array_equal(trg, [0, 3, 4])
+    np.testing.assert_array_equal(trg_next, [3, 4, 1])
+    # unknown words map to UNK_IDX=2
+    assert ds[1][0][2] == 2 and ds[1][1][2] == 2
+    assert len(pt.text.WMT14(data_file=p, mode="test")) == 1
+
+
+def test_wmt16(tmp_path):
+    p = str(tmp_path / "wmt16.tar.gz")
+    train = "the cat\tdie katze\nthe dog\tder hund\n" * 3
+    with tarfile.open(p, "w:gz") as tar:
+        _tar_add(tar, "wmt16/train", train)
+        _tar_add(tar, "wmt16/val", "the cat\tdie katze\n")
+        _tar_add(tar, "wmt16/test", "a bird\tein vogel\n")
+    ds = pt.text.WMT16(data_file=p, mode="val", src_dict_size=100,
+                       trg_dict_size=100)
+    assert len(ds) == 1
+    src, trg, trg_next = ds[0]
+    sd, td = ds.src_dict, ds.trg_dict
+    np.testing.assert_array_equal(
+        src, [sd["<s>"], sd["the"], sd["cat"], sd["<e>"]])
+    np.testing.assert_array_equal(
+        trg_next, [td["die"], td["katze"], sd["<e>"]])
+    # unknown words in test -> <unk>
+    t = pt.text.WMT16(data_file=p, mode="test")
+    assert (np.asarray(t[0][0][1:-1]) == sd["<unk>"]).all()
+
+
+def test_conll05st(tmp_path):
+    import gzip as _gz
+
+    words = "The\ncat\nsat\n\nDogs\nbark\n\n"
+    # one predicate column per sentence: verb 'sat' spans (V*) at row 2
+    props = ("-  (A0*\n-  *)\nsat  (V*)\n\n"
+             "-  (A0*)\nbark  (V*)\n\n")
+    p = str(tmp_path / "conll05st-tests.tar.gz")
+    with tarfile.open(p, "w:gz") as tar:
+        _tar_add(tar, "conll05st-release/test.wsj/words/"
+                      "test.wsj.words.gz", _gz.compress(words.encode()))
+        _tar_add(tar, "conll05st-release/test.wsj/props/"
+                      "test.wsj.props.gz", _gz.compress(props.encode()))
+    ds = pt.text.Conll05st(data_file=p)
+    assert len(ds) == 2
+    word_ids, verb_id, mark, labels = ds[0]
+    assert verb_id == ds.predicate_dict["sat"]
+    assert len(word_ids) == 3 and len(labels) == 3
+    inv = {v: k for k, v in ds.label_dict.items()}
+    assert [inv[l] for l in labels] == ["B-A0", "I-A0", "B-V"]
+    # +/-2 window around verb index 2 (reference conll05.py:160-184)
+    np.testing.assert_array_equal(mark, [1, 1, 1])
+    word_ids2, verb_id2, mark2, labels2 = ds[1]
+    assert verb_id2 == ds.predicate_dict["bark"]
+    assert [inv[l] for l in labels2] == ["B-A0", "B-V"]
+    np.testing.assert_array_equal(mark2, [1, 1])
