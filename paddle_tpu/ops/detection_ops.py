@@ -1210,3 +1210,168 @@ def _collect_fpn_proposals(ctx, op):
     if op.output("RoisNum"):
         ctx.set_output(op, "RoisNum",
                        valid.sum().astype(jnp.int32).reshape(1))
+
+
+# ---------------------------------------------------------------------------
+# yolov3_loss
+# ---------------------------------------------------------------------------
+
+def _yolov3_loss_infer(op, block):
+    x = in_var(op, block, "X")
+    gt = in_var(op, block, "GTBox")
+    N, H, W = x.shape[0], x.shape[2], x.shape[3]
+    M = len(op.attr("anchor_mask", []))
+    set_out(op, block, "Loss", (N,), x.dtype)
+    if op.output("ObjectnessMask"):
+        set_out(op, block, "ObjectnessMask", (N, M, H, W), x.dtype)
+    if op.output("GTMatchMask"):
+        set_out(op, block, "GTMatchMask", (N, gt.shape[1]), "int32")
+
+
+@register_op("yolov3_loss", infer=_yolov3_loss_infer, grad="auto")
+def _yolov3_loss(ctx, op):
+    """reference yolov3_loss_op.h:28-250 — YOLOv3 training loss.
+
+    Per image: every predicted box whose best IoU against a valid gt
+    exceeds ignore_thresh drops out of the objectness loss (mask -1);
+    every gt matches its best anchor by origin-centered IoU, and if
+    that anchor belongs to this head's anchor_mask, the gt's cell pays
+    sigmoid-CE x/y + L1 w/h location loss scaled by (2 - w*h)*score,
+    per-class sigmoid-CE label loss, and positive objectness. The
+    match/ignore decisions are stop_gradient (the reference grad kernel
+    treats ObjectnessMask/GTMatchMask as constants)."""
+    import jax
+    from jax import lax
+
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                      # [N, M*(5+C), H, W]
+    gt_box = ctx.get_input(op, "GTBox")             # [N, B, 4] xywh
+    gt_label = ctx.get_input(op, "GTLabel")         # [N, B] int
+    gt_score = (ctx.get_input(op, "GTScore")
+                if op.single_input("GTScore") else None)
+    anchors = np.asarray(op.attr("anchors", []), np.float32)
+    anchor_mask = [int(a) for a in op.attr("anchor_mask", [])]
+    C = op.attr("class_num", 1)
+    ignore_thresh = op.attr("ignore_thresh", 0.7)
+    downsample = op.attr("downsample_ratio", 32)
+    use_smooth = op.attr("use_label_smooth", True)
+    scale_xy = op.attr("scale_x_y", 1.0)
+    bias_xy = -0.5 * (scale_xy - 1.0)
+
+    N, _, H, W = x.shape
+    M = len(anchor_mask)
+    B = gt_box.shape[1]
+    an_num = anchors.size // 2
+    input_size = downsample * H
+    label_pos, label_neg = 1.0, 0.0
+    if use_smooth:
+        sw = min(1.0 / C, 1.0 / 40)
+        label_pos, label_neg = 1.0 - sw, sw
+
+    xr = x.reshape(N, M, 5 + C, H, W)
+    if gt_score is None:
+        gt_score = jnp.ones((N, B), x.dtype)
+
+    def sce(logit, label):
+        # reference SigmoidCrossEntropy: max(x,0) - x*z + log1p(e^-|x|)
+        return (jnp.maximum(logit, 0.0) - logit * label
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    def center_iou(b1, b2):
+        """center-form IoU; b* = (..., 4) broadcastable."""
+        ov = lambda c1, w1, c2, w2: (
+            jnp.minimum(c1 + w1 / 2, c2 + w2 / 2)
+            - jnp.maximum(c1 - w1 / 2, c2 - w2 / 2))
+        w = ov(b1[..., 0], b1[..., 2], b2[..., 0], b2[..., 2])
+        h = ov(b1[..., 1], b1[..., 3], b2[..., 1], b2[..., 3])
+        inter = jnp.where((w < 0) | (h < 0), 0.0, w * h)
+        union = (b1[..., 2] * b1[..., 3] + b2[..., 2] * b2[..., 3]
+                 - inter)
+        return inter / jnp.maximum(union, 1e-10)
+
+    aw = jnp.asarray(anchors[0::2], x.dtype)
+    ah = jnp.asarray(anchors[1::2], x.dtype)
+    mask_arr = jnp.asarray(anchor_mask, jnp.int32)
+
+    def one_image(xi, gts, glabels, gscores):
+        valid = (gts[:, 2] * gts[:, 3]) > 1e-6     # [B]
+        # --- ignore mask: best pred-gt IoU > thresh -> -1 ------------
+        gx = jnp.arange(W, dtype=x.dtype)[None, None, :]
+        gy = jnp.arange(H, dtype=x.dtype)[None, :, None]
+        sig = jax.nn.sigmoid
+        px = (gx + sig(xi[:, 0]) * scale_xy + bias_xy) / W
+        py = (gy + sig(xi[:, 1]) * scale_xy + bias_xy) / H
+        pw = jnp.exp(xi[:, 2]) * aw[mask_arr][:, None, None] / input_size
+        ph = jnp.exp(xi[:, 3]) * ah[mask_arr][:, None, None] / input_size
+        pred = jnp.stack([px, py, pw, ph], axis=-1)  # [M,H,W,4]
+        iou = center_iou(pred[:, :, :, None, :],
+                         gts[None, None, None, :, :])  # [M,H,W,B]
+        iou = jnp.where(valid[None, None, None, :], iou, 0.0)
+        best = iou.max(axis=-1)
+        obj_mask0 = jnp.where(best > ignore_thresh,
+                              jnp.asarray(-1.0, x.dtype), 0.0)
+
+        # --- gt -> anchor matching -----------------------------------
+        an_boxes = jnp.stack([jnp.zeros_like(aw), jnp.zeros_like(ah),
+                              aw / input_size, ah / input_size], axis=1)
+        gt_shift = gts.at[:, 0:2].set(0.0)
+        a_iou = center_iou(an_boxes[None, :, :], gt_shift[:, None, :])
+        best_n = jnp.argmax(a_iou, axis=1).astype(jnp.int32)   # [B]
+        in_mask = (mask_arr[None, :] == best_n[:, None])
+        mask_idx = jnp.where(in_mask.any(axis=1),
+                             jnp.argmax(in_mask, axis=1), -1)
+        mask_idx = jnp.where(valid, mask_idx, -1)              # [B]
+        gi = jnp.clip((gts[:, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gts[:, 1] * H).astype(jnp.int32), 0, H - 1)
+        matched = mask_idx >= 0
+
+        # positive objectness overrides ignore, in gt order (reference
+        # writes sequentially; later gts win the cell)
+        def write(t, m):
+            return lax.cond(
+                matched[t],
+                lambda mm: mm.at[mask_idx[t], gj[t], gi[t]].set(
+                    gscores[t]),
+                lambda mm: mm, m)
+        obj_mask = lax.fori_loop(0, B, write, obj_mask0)
+        obj_mask = lax.stop_gradient(obj_mask)
+
+        # --- location + label loss (sum over matched gts) ------------
+        midx = jnp.clip(mask_idx, 0, M - 1)
+        cell = (midx, gj, gi)
+        tx = gts[:, 0] * W - gi
+        ty = gts[:, 1] * H - gj
+        tw = jnp.log(jnp.maximum(
+            gts[:, 2] * input_size / aw[jnp.clip(best_n, 0, an_num - 1)],
+            1e-9))
+        th = jnp.log(jnp.maximum(
+            gts[:, 3] * input_size / ah[jnp.clip(best_n, 0, an_num - 1)],
+            1e-9))
+        wscale = (2.0 - gts[:, 2] * gts[:, 3]) * gscores
+        loc = (sce(xi[cell[0], 0, cell[1], cell[2]], tx)
+               + sce(xi[cell[0], 1, cell[1], cell[2]], ty)
+               + jnp.abs(xi[cell[0], 2, cell[1], cell[2]] - tw)
+               + jnp.abs(xi[cell[0], 3, cell[1], cell[2]] - th)) * wscale
+        cls_logits = xi[cell[0], 5:, cell[1], cell[2]]         # [B, C]
+        onehot = (jnp.arange(C)[None, :]
+                  == jnp.clip(glabels, 0, C - 1)[:, None])
+        cls_tgt = jnp.where(onehot, label_pos, label_neg)
+        lbl = sce(cls_logits, cls_tgt).sum(axis=1) * gscores
+        loss_pos = jnp.where(matched, loc + lbl, 0.0).sum()
+
+        # --- objectness loss -----------------------------------------
+        obj_logit = xi[:, 4]                                   # [M,H,W]
+        pos = obj_mask > 1e-5
+        neg = (obj_mask <= 1e-5) & (obj_mask > -0.5)
+        obj_loss = (jnp.where(pos, sce(obj_logit, 1.0) * obj_mask, 0.0)
+                    + jnp.where(neg, sce(obj_logit, 0.0), 0.0)).sum()
+        # mask_idx is already -1 for invalid gts
+        return loss_pos + obj_loss, obj_mask, mask_idx.astype(jnp.int32)
+
+    loss, obj_mask, match = jax.vmap(one_image)(
+        xr, gt_box.astype(x.dtype), gt_label, gt_score.astype(x.dtype))
+    ctx.set_output(op, "Loss", loss)
+    if op.output("ObjectnessMask"):
+        ctx.set_output(op, "ObjectnessMask", obj_mask)
+    if op.output("GTMatchMask"):
+        ctx.set_output(op, "GTMatchMask", match)

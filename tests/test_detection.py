@@ -1028,3 +1028,185 @@ def _run_multi(op_type, inputs, outputs, attrs):
         fetch = [n for ns in out_slots.values() for n in ns]
     res = pt.Executor().run(main, feed=feed, fetch_list=fetch)
     return [np.asarray(r) for r in res]
+
+
+# ---------------------------------------------------------------------------
+# yolov3_loss (ref yolov3_loss_op.h)
+# ---------------------------------------------------------------------------
+
+def _np_yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, C,
+                    ignore_thresh, downsample, use_smooth, gt_score=None,
+                    scale_xy=1.0):
+    def sce(v, z):
+        return max(v, 0) - v * z + math.log1p(math.exp(-abs(v)))
+
+    def sig(v):
+        return 1.0 / (1.0 + math.exp(-v))
+
+    def iou_c(b1, b2):
+        ov = lambda c1, w1, c2, w2: min(c1 + w1/2, c2 + w2/2) - \
+            max(c1 - w1/2, c2 - w2/2)
+        w = ov(b1[0], b1[2], b2[0], b2[2])
+        h = ov(b1[1], b1[3], b2[1], b2[3])
+        inter = 0.0 if (w < 0 or h < 0) else w * h
+        return inter / (b1[2]*b1[3] + b2[2]*b2[3] - inter)
+
+    N, _, H, W = x.shape
+    M = len(anchor_mask)
+    B = gt_box.shape[1]
+    an_num = len(anchors) // 2
+    input_size = downsample * H
+    lp, ln = 1.0, 0.0
+    if use_smooth:
+        sw = min(1.0 / C, 1.0 / 40)
+        lp, ln = 1.0 - sw, sw
+    if gt_score is None:
+        gt_score = np.ones((N, B), np.float32)
+    xr = x.reshape(N, M, 5 + C, H, W)
+    losses, obj_masks, matches = [], [], []
+    for n in range(N):
+        obj = np.zeros((M, H, W), np.float32)
+        valid = [gt_box[n, t, 2] * gt_box[n, t, 3] > 1e-6
+                 for t in range(B)]
+        bias_xy = -0.5 * (scale_xy - 1.0)
+        for j in range(M):
+            for k in range(H):
+                for l in range(W):
+                    px = (l + sig(xr[n, j, 0, k, l]) * scale_xy
+                          + bias_xy) / W
+                    py = (k + sig(xr[n, j, 1, k, l]) * scale_xy
+                          + bias_xy) / H
+                    pw = math.exp(xr[n, j, 2, k, l]) \
+                        * anchors[2*anchor_mask[j]] / input_size
+                    ph = math.exp(xr[n, j, 3, k, l]) \
+                        * anchors[2*anchor_mask[j]+1] / input_size
+                    best = 0.0
+                    for t in range(B):
+                        if valid[t]:
+                            best = max(best, iou_c(
+                                (px, py, pw, ph), gt_box[n, t]))
+                    if best > ignore_thresh:
+                        obj[j, k, l] = -1
+        loss = 0.0
+        match = []
+        for t in range(B):
+            if not valid[t]:
+                match.append(-1)
+                continue
+            g = gt_box[n, t]
+            gi, gj = int(g[0] * W), int(g[1] * H)
+            best_iou, best_n = 0.0, 0
+            for a in range(an_num):
+                ab = (0.0, 0.0, anchors[2*a]/input_size,
+                      anchors[2*a+1]/input_size)
+                i = iou_c(ab, (0.0, 0.0, g[2], g[3]))
+                if i > best_iou:
+                    best_iou, best_n = i, a
+            mi = anchor_mask.index(best_n) if best_n in anchor_mask \
+                else -1
+            match.append(mi)
+            if mi < 0:
+                continue
+            score = gt_score[n, t]
+            tx, ty = g[0]*W - gi, g[1]*H - gj
+            tw = math.log(g[2]*input_size/anchors[2*best_n])
+            th = math.log(g[3]*input_size/anchors[2*best_n+1])
+            sc = (2.0 - g[2]*g[3]) * score
+            loss += sce(xr[n, mi, 0, gj, gi], tx) * sc
+            loss += sce(xr[n, mi, 1, gj, gi], ty) * sc
+            loss += abs(xr[n, mi, 2, gj, gi] - tw) * sc
+            loss += abs(xr[n, mi, 3, gj, gi] - th) * sc
+            obj[mi, gj, gi] = score
+            for c in range(C):
+                z = lp if c == gt_label[n, t] else ln
+                loss += sce(xr[n, mi, 5+c, gj, gi], z) * score
+        for j in range(M):
+            for k in range(H):
+                for l in range(W):
+                    o = obj[j, k, l]
+                    if o > 1e-5:
+                        loss += sce(xr[n, j, 4, k, l], 1.0) * o
+                    elif o > -0.5:
+                        loss += sce(xr[n, j, 4, k, l], 0.0)
+        losses.append(loss)
+        obj_masks.append(obj)
+        matches.append(match)
+    return (np.asarray(losses, np.float32), np.stack(obj_masks),
+            np.asarray(matches, np.int32))
+
+
+def test_yolov3_loss():
+    rng = R(53)
+    anchors = [10, 13, 16, 30, 33, 23]
+    anchor_mask = [0, 1]
+    C, H, W, B = 4, 4, 4, 3
+    x = (0.5 * rng.randn(2, 2 * (5 + C), H, W)).astype("float32")
+    gt = np.zeros((2, B, 4), np.float32)
+    gt[0, 0] = [0.3, 0.3, 0.1, 0.2]
+    gt[0, 1] = [0.7, 0.6, 0.3, 0.2]
+    gt[1, 0] = [0.5, 0.5, 0.12, 0.1]
+    gt_label = rng.randint(0, C, (2, B)).astype("int32")
+    loss, obj, match = _run(
+        "yolov3_loss", {"X": x, "GTBox": gt, "GTLabel": gt_label},
+        ["Loss", "ObjectnessMask", "GTMatchMask"],
+        {"anchors": anchors, "anchor_mask": anchor_mask,
+         "class_num": C, "ignore_thresh": 0.5, "downsample_ratio": 8,
+         "use_label_smooth": True})
+    rl, ro, rm = _np_yolov3_loss(x, gt, gt_label, anchors, anchor_mask,
+                                 C, 0.5, 8, True)
+    np.testing.assert_array_equal(match, rm)
+    np.testing.assert_allclose(obj, ro, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(loss, rl, rtol=1e-4, atol=1e-4)
+    # scale_x_y != 1 (bias term active in the ignore-mask pred boxes)
+    loss2, obj2, _ = _run(
+        "yolov3_loss", {"X": x, "GTBox": gt, "GTLabel": gt_label},
+        ["Loss", "ObjectnessMask", "GTMatchMask"],
+        {"anchors": anchors, "anchor_mask": anchor_mask,
+         "class_num": C, "ignore_thresh": 0.5, "downsample_ratio": 8,
+         "use_label_smooth": True, "scale_x_y": 1.2})
+    rl2, ro2, _ = _np_yolov3_loss(x, gt, gt_label, anchors, anchor_mask,
+                                  C, 0.5, 8, True, scale_xy=1.2)
+    np.testing.assert_allclose(obj2, ro2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(loss2, rl2, rtol=1e-4, atol=1e-4)
+
+
+def test_yolov3_loss_trains():
+    """Loss must decrease when optimizing X toward a fixed gt."""
+    import paddle_tpu as pt
+
+    anchors = [10, 13, 16, 30]
+    pt.framework.core.reset_unique_name()
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        xv = pt.layers.create_parameter([1, 2 * 7, 4, 4], "float32",
+                                        name="yolo_x")
+        b = main.global_block()
+        for nm, shape, dt in [("gtb", (1, 2, 4), "float32"),
+                              ("gtl", (1, 2), "int32")]:
+            b.create_var(name=nm, shape=shape, dtype=dt, is_data=True,
+                         stop_gradient=True)
+        b.append_op("yolov3_loss",
+                    inputs={"X": ["yolo_x"], "GTBox": ["gtb"],
+                            "GTLabel": ["gtl"]},
+                    outputs={"Loss": ["yl"],
+                             "ObjectnessMask": ["om"],
+                             "GTMatchMask": ["mm"]},
+                    attrs={"anchors": anchors, "anchor_mask": [0, 1],
+                           "class_num": 2, "ignore_thresh": 0.7,
+                           "downsample_ratio": 8,
+                           "use_label_smooth": False})
+        loss = pt.layers.reduce_mean(b.var("yl"))
+        pt.optimizer.SGDOptimizer(0.05).minimize(loss)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    feed = {"gtb": np.array([[[0.4, 0.4, 0.2, 0.3],
+                              [0.8, 0.7, 0.1, 0.1]]], np.float32),
+            "gtl": np.array([[0, 1]], np.int32)}
+    losses = []
+    for _ in range(60):
+        l, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert losses[-1] < 0.5 * losses[0]
+    assert losses[-1] < losses[len(losses) // 2] < losses[0]

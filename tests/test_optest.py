@@ -1024,7 +1024,8 @@ SKIP = {
            "bipartite_match", "roi_align", "roi_pool",
            "multiclass_nms", "density_prior_box", "target_assign",
            "mine_hard_examples", "generate_proposals", "matrix_nms",
-           "distribute_fpn_proposals", "collect_fpn_proposals"]},
+           "distribute_fpn_proposals", "collect_fpn_proposals",
+           "yolov3_loss"]},
 }
 
 
