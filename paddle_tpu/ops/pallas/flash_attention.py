@@ -1,10 +1,20 @@
-"""Flash attention for TPU.
+"""Flash attention for TPU — pallas forward AND backward kernels.
 
-Forward: a pallas kernel — one grid cell per (batch*head, q-block), online
-softmax over kv-blocks held in VMEM, fp32 accumulation on the MXU.
-Backward: jax.vjp of the blockwise (lax.scan) formulation — XLA compiles
-it to the standard recompute-based flash backward; activations per step
-are one kv block, not the S×S score matrix.
+Forward: one grid cell per (batch*head, q-block), online softmax over
+kv-blocks held in VMEM, fp32 accumulation on the MXU; emits the softmax
+LSE rows for the backward.
+
+Backward (FlashAttention-2 style recompute, no S×S materialization):
+  * delta = rowsum(dO ⊙ O) — one fused XLA reduce, [B,H,S].
+  * dKV kernel: grid (B*H, kv-block); inner fori over q-blocks
+    recomputes p = exp(q·kᵀ − lse), accumulates dV += pᵀ·dO and
+    dK += dsᵀ·q with ds = p ⊙ (dO·vᵀ − delta).
+  * dQ kernel: grid (B*H, q-block); inner fori over kv-blocks
+    accumulates dQ += ds·k.
+Both kernels stream blocks from VMEM and skip causally-dead blocks, so
+backward memory is O(S) like the forward (round-3 verdict: the previous
+jax.vjp-of-scan backward materialized per-block probabilities and lost
+to unfused XLA at every length).
 
 Reference analog: the fused attention precursors
 (operators/fused/multihead_matmul_op.cu, bert_encoder_functor.cu) — those
@@ -18,15 +28,24 @@ import functools
 import jax
 import numpy as np
 
-# tuned on TPU v5e (seq 2048, d 64): bq 256 / bk 512 beats both 128/128
-# and the unfused XLA attention by ~1.5-4x wall clock
-DEFAULT_BLOCK_Q = 256
+# tuned on TPU v5e (tools/attn_microbench.py, fwd+bwd kernels, d 64,
+# B=32 H=12): 512/512 is best or within 2% of best at S=512/1024/2048
+# (e.g. S=2048: 35.3ms vs 119.3ms at 128/128 and 77.4ms unfused XLA);
+# 2048-wide blocks fail to compile (VMEM)
+DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
+def _fit_block(block, size):
+    b = min(block, size)
+    while size % b:
+        b //= 2
+    return b
+
+
 # ---------------------------------------------------------------------------
-# blockwise reference formulation (differentiable; also the bwd path)
+# blockwise reference formulation (ring attention + GSPMD multi-device path)
 # ---------------------------------------------------------------------------
 
 def blockwise_attention(q, k, v, causal=False, sm_scale=None,
@@ -46,9 +65,7 @@ def blockwise_attention(q, k, v, causal=False, sm_scale=None,
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
-    bk = min(block_k, Sk)
-    while Sk % bk:
-        bk //= 2
+    bk = _fit_block(block_k, Sk)
     nblocks = Sk // bk
 
     qf = q.astype(jnp.float32) * scale
@@ -97,19 +114,19 @@ def blockwise_attention(q, k, v, causal=False, sm_scale=None,
 
 
 # ---------------------------------------------------------------------------
-# pallas forward kernel
+# pallas forward kernel (emits out + lse)
 # ---------------------------------------------------------------------------
 
-def _fa_kernel(q_ref, k_ref, v_ref, *rest, block_k, causal, scale,
-               seq_k, has_bias=False):
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k, causal, scale,
+                   seq_k, has_bias=False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     if has_bias:
-        b_ref, o_ref = rest
+        b_ref, o_ref, lse_ref = rest
     else:
-        (o_ref,) = rest
+        o_ref, lse_ref = rest
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # [Bq, D]
     bq, d = q.shape
@@ -149,31 +166,28 @@ def _fa_kernel(q_ref, k_ref, v_ref, *rest, block_k, causal, scale,
     else:
         upper = nk
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l_safe))[:, 0]
 
 
 def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
                    bias=None):
+    """Returns (out [B,H,Sq,D], lse [B,H,Sq] f32)."""
     import jax
-    import jax.numpy as jnp
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
-    bq = min(block_q, Sq)
-    while Sq % bq:
-        bq //= 2
-    bk = min(block_k, Sk)
-    while Sk % bk:
-        bk //= 2
+    bq = _fit_block(block_q, Sq)
+    bk = _fit_block(block_k, Sk)
 
     qr = q.reshape(B * H, Sq, D)
     kr = k.reshape(B * H, Sk, D)
     vr = v.reshape(B * H, Sk, D)
 
-    kernel = functools.partial(_fa_kernel, block_k=bk, causal=causal,
+    kernel = functools.partial(_fa_fwd_kernel, block_k=bk, causal=causal,
                                scale=scale, seq_k=Sk,
                                has_bias=bias is not None)
     in_specs = [
@@ -189,19 +203,234 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
         in_specs.append(
             pl.BlockSpec((1, 1, Sk), lambda b, i: (b // H, 0, 0)))
         args.append(bias.reshape(B, 1, Sk))
-    out = pl.pallas_call(
+    # lse rides as [BH, 1, Sq]: Mosaic requires block last-two-dims to be
+    # (8,128)-divisible or equal to the array dims — (1, bq) on a 2D
+    # [BH, Sq] array violates the sublane rule, (1, 1, bq) on 3D is legal
+    out, lse = pl.pallas_call(
         kernel,
+        grid=(B * H, Sq // bq),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i))],
+        out_shape=[jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+                   jax.ShapeDtypeStruct((B * H, 1, Sq), np.float32)],
+        interpret=interpret,
+    )(*args)
+    return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)
+
+
+# ---------------------------------------------------------------------------
+# pallas backward kernels (FA2 recompute)
+# ---------------------------------------------------------------------------
+
+def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
+                   block_q, causal, scale, seq_q, has_bias=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if has_bias:
+        b_ref, dk_ref, dv_ref, db_ref = rest
+    else:
+        dk_ref, dv_ref = rest
+    kj = pl.program_id(1)
+    kb = k_ref[0].astype(jnp.float32)                  # [Bk, D]
+    vb = v_ref[0].astype(jnp.float32)
+    bk, d = kb.shape
+    nq = seq_q // block_q
+
+    def body(i, carry):
+        dk, dv, db = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32) * scale                       # [Bq, D]
+        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]  # [Bq]
+        dlt = dl_ref[0, 0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [Bq, Bk]
+        if has_bias:
+            s = s + b_ref[0, 0, :].astype(jnp.float32)[None, :]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            k_pos = kj * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                  # [Bq, Bk]
+        # dV += pᵀ·dO
+        dv = dv + jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [Bk, D]
+        # dp = dO·vᵀ ; ds = p ⊙ (dp − delta)
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [Bq, Bk]
+        ds = p * (dp - dlt[:, None])
+        # dK += dsᵀ·(q·scale)  (qb already carries the scale)
+        dk = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [Bk, D]
+        if has_bias:
+            db = db + ds.sum(0)
+        return dk, dv, db
+
+    if causal:
+        lower = (kj * bk) // block_q  # q blocks fully above diag are dead
+    else:
+        lower = 0
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    db0 = jnp.zeros((bk,), jnp.float32)
+    dk, dv, db = jax.lax.fori_loop(lower, nq, body, (dk0, dv0, db0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if has_bias:
+        db_ref[0, 0] = db
+
+
+def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
+                  block_k, causal, scale, seq_k, has_bias=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if has_bias:
+        b_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
+    qi = pl.program_id(1)
+    qb = q_ref[0].astype(jnp.float32) * scale          # [Bq, D]
+    dob = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]                                # [Bq]
+    dlt = dl_ref[0, 0]
+    bq, d = qb.shape
+    nk = seq_k // block_k
+
+    def body(j, acc):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [Bq, Bk]
+        if has_bias:
+            bb = b_ref[0, 0, pl.ds(j * block_k, block_k)].astype(
+                jnp.float32)
+            s = s + bb[None, :]
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt[:, None])
+        return acc + jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+
+    if causal:
+        upper = jnp.minimum(nk, ((qi + 1) * bq + block_k - 1) // block_k)
+    else:
+        upper = nk
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    acc = jax.lax.fori_loop(0, upper, body, acc0)
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
+                    block_k, interpret, bias=None):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
+    bq = _fit_block(block_q, Sq)
+    bk = _fit_block(block_k, Sk)
+
+    # delta = rowsum(dO ⊙ O) — cheap fused XLA reduce
+    delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+
+    qr = q.reshape(B * H, Sq, D)
+    kr = k.reshape(B * H, Sk, D)
+    vr = v.reshape(B * H, Sk, D)
+    gr = g.reshape(B * H, Sq, D)
+    lser = lse.reshape(B * H, 1, Sq)
+    dltr = delta.reshape(B * H, 1, Sq)
+    has_bias = bias is not None
+
+    # ---- dK / dV (+ per-head db) -------------------------------------
+    dkv_kernel = functools.partial(
+        _fa_dkv_kernel, block_q=bq, causal=causal, scale=scale, seq_q=Sq,
+        has_bias=has_bias)
+    in_specs = [
+        pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),   # q (full)
+        pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),   # k block
+        pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),   # v block
+        pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),   # dO (full)
+        pl.BlockSpec((1, 1, Sq), lambda b, j: (b, 0, 0)),   # lse
+        pl.BlockSpec((1, 1, Sq), lambda b, j: (b, 0, 0)),   # delta
+    ]
+    args = [qr, kr, vr, gr, lser, dltr]
+    out_specs = [pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+                 pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0))]
+    out_shapes = [jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
+                  jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype)]
+    if has_bias:
+        in_specs.append(
+            pl.BlockSpec((1, 1, bk), lambda b, j: (b // H, 0, j)))
+        args.append(bias.reshape(B, 1, Sk))
+        out_specs.append(pl.BlockSpec((1, 1, bk), lambda b, j: (b, 0, j)))
+        out_shapes.append(
+            jax.ShapeDtypeStruct((B * H, 1, Sk), np.float32))
+    res = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, Sk // bk),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(*args)
+    dk, dv = res[0].reshape(B, H, Sk, D), res[1].reshape(B, H, Sk, D)
+    db = None
+    if has_bias:
+        # bias rows broadcast over heads (and q) — reduce the per-head sums
+        db = res[2].reshape(B, H, Sk).sum(1).astype(bias.dtype)
+
+    # ---- dQ ----------------------------------------------------------
+    dq_kernel = functools.partial(
+        _fa_dq_kernel, block_k=bk, causal=causal, scale=scale, seq_k=Sk,
+        has_bias=has_bias)
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),   # q block
+        pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),   # k (full)
+        pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),   # v (full)
+        pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),   # dO block
+        pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),   # lse block
+        pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),   # delta block
+    ]
+    args = [qr, kr, vr, gr, lser, dltr]
+    if has_bias:
+        in_specs.append(
+            pl.BlockSpec((1, 1, Sk), lambda b, i: (b // H, 0, 0)))
+        args.append(bias.reshape(B, 1, Sk))
+    dq = pl.pallas_call(
+        dq_kernel,
         grid=(B * H, Sq // bq),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
         interpret=interpret,
     )(*args)
-    return out.reshape(B, H, Sq, D)
+    dq = dq.reshape(B, H, Sq, D)
+    return dq, dk, dv, db
 
 
 # ---------------------------------------------------------------------------
-# public entry: pallas forward, blockwise-vjp backward
+# public entries: pallas forward + pallas backward via custom_vjp
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -210,25 +439,20 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
                     interpret=False):
     """Multi-head attention, q/k/v: [B, H, S, D] -> [B, H, Sq, D]."""
     return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
-                          interpret)
+                          interpret)[0]
 
 
 def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
-                         interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    import jax
-    q, k, v = res
-
-    def ref(q, k, v):
-        return blockwise_attention(q, k, v, causal=causal,
-                                   sm_scale=sm_scale, block_k=block_k)[0]
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    dq, dk, dv, _ = _flash_backward(q, k, v, out, lse, g, causal, sm_scale,
+                                    block_q, block_k, interpret)
+    return dq, dk, dv
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
@@ -241,26 +465,20 @@ def flash_attention_bias(q, k, v, bias, causal=False, sm_scale=None,
     """flash_attention with an additive [B, Sk] score bias (padding
     mask). Separate entry so the unbiased path keeps its 3-arg vjp."""
     return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
-                          interpret, bias=bias)
+                          interpret, bias=bias)[0]
 
 
 def _fab_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
-                         interpret, bias=bias)
-    return out, (q, k, v, bias)
+    out, lse = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                              interpret, bias=bias)
+    return out, (q, k, v, bias, out, lse)
 
 
 def _fab_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    import jax
-    q, k, v, bias = res
-
-    def ref(q, k, v, bias):
-        return blockwise_attention(q, k, v, causal=causal,
-                                   sm_scale=sm_scale, block_k=block_k,
-                                   bias=bias)[0]
-
-    _, vjp = jax.vjp(ref, q, k, v, bias)
-    return vjp(g)
+    q, k, v, bias, out, lse = res
+    dq, dk, dv, db = _flash_backward(q, k, v, out, lse, g, causal, sm_scale,
+                                     block_q, block_k, interpret, bias=bias)
+    return dq, dk, dv, db
 
 
 flash_attention_bias.defvjp(_fab_fwd, _fab_bwd)

@@ -11,6 +11,7 @@ DistributedStrategy…) on top of that compilation model.
 """
 from .mesh import make_mesh, dp_mesh, MeshConfig  # noqa
 from .sharded import (ShardingRules, data_parallel_rules,  # noqa
-                      megatron_rules, build_sharded_step)
+                      megatron_rules, build_sharded_step,
+                      build_sharded_multistep)
 from .pipeline_pp import build_pp_pipeline_step  # noqa
 from .pipeline_hetero import build_hetero_pp_step  # noqa
