@@ -14,19 +14,28 @@ DataLoader's device-prefetch path (no cached-batch feeding).
 
 Attention per leg (tools/attn_microbench.py scoreboard, fwd+bwd,
 real v5e):
-  * seq-128: unfused batched-matmul chain (fastest at short seq).
-  * seq>=512: the pallas flash kernel — fwd AND bwd kernels
-    (FA2-style recompute, O(S) memory). Attention-only fwd+bwd at
-    B=32,H=12,D=64: S=512 8.0ms vs 7.4 unfused; S=1024 14.6 vs 23.7;
-    S=2048 35.8 vs 77.4. In-model at S=512 the flash path wins
-    (no O(S²) HBM traffic): 182 vs 158 samples/s.
+  * seq-128: unfused batched-matmul chain (fastest at short seq —
+    1212 samples/s vs 889 xla-einsum vs 855 packed-pallas at b160/192).
+  * seq>=512: the packed pallas flash kernels (flash_attention_qkv) —
+    fwd AND bwd kernels (FA2-style recompute, O(S) memory) consuming
+    the fused [B,S,3H] projection directly, zero layout copies.
+    Attention-only fwd+bwd at B=32,H=12,D=64: S=1024 14.6ms vs 23.7
+    unfused; S=2048 35.8 vs 77.4. In-model at S=512: 289 vs 159
+    samples/s (the unfused path O(S²)-materializes and can't hold
+    batch 64).
 
-Dispatch: one device dispatch per WINDOW (lax.scan over
-STEPS_PER_WINDOW steps — parallel/sharded.py build_sharded_multistep),
-not per step. A per-step host dispatch costs ~24ms fixed latency
-through the remote-device tunnel (measured: device step 152.2ms vs
-176ms wall at seq-512) — the device-side loop is the TPU-native
-executor shape. BENCH_DISPATCH=step restores per-step dispatch.
+The round-4 perf walk at seq-512 (each same-session A/B):
+  145.6 (r3 scan-vjp bwd) -> 174 (kernel bwd) -> 182 (block tuning) ->
+  186 (AMP white-list for the attention op) -> 196 (packed QKV kernels)
+  -> 215 (batch 64) -> 289 (mul op lowered as direct dot_general —
+  the reshape-to-2D formulation cost ~3 GB/step of layout copies).
+Same fixes at seq-128: 853 -> 873 (u8 dropout bits) -> 934 (remat
+dropout, key-only residual) -> 1212 (dot_general mul + batch 192).
+
+Dispatch: per-step (BENCH_DISPATCH=window runs a lax.scan device loop —
+parallel/sharded.py build_sharded_multistep — measured ~3% slower on
+this tunnel because per-step dispatch pipelines fine and the scan's
+while-loop boundary inhibits cross-step fusion).
 
 Measurement discipline (round-2 postmortem: a driver capture once
 published 28.5 samples/s for a run that reproduces at 606 — chip
@@ -54,17 +63,11 @@ Config via env: BENCH_SEQ (128|512), BENCH_BATCH (per-chip),
 BENCH_ATTN (unfused|xla|pallas), BENCH_LEGS=0 to skip the seq-512 leg,
 PEAK_TFLOPS (per-chip peak override), BENCH_DROPOUT, BENCH_DISPATCH.
 
-Where the time goes (xprof on v5e, seq-512 leg, batch 32, pallas
-attention, ~152ms device step):
-  ~50% matmul fusions (24 FFN weight-grad convert_reduce fusions alone
-       are 27.6ms — 1.15ms each at ~34% of peak),
-  ~28% copies + transposes (attention [B,S,H]<->[B,h,S,d] layout moves
-       around the pallas custom-calls),
-  rest: loop fusions (dropout/gelu/layernorm/adam), rng, async.
-Measured dead ends (same-session A/B): batch 64/128 at seq-512 (171/160
-vs 174 at b32), pallas fused-dropout kernel with in-kernel PRNG at
-seq-128 (775 vs 847 — pallas_call boundaries cost more fusion than the
-in-kernel bits save).
+Measured dead ends (same-session A/B): pallas fused-dropout kernel
+with in-kernel PRNG at seq-128 (775 vs 847 — pallas_call boundaries
+cost more fusion than the in-kernel bits save); windowed-scan dispatch
+(-3%); packed kernel at seq-128 (855 vs 1212 unfused — grid overhead
+dominates at tiny per-cell work).
 
 Known deviation from the reference recipe: the flash-attention path folds
 out attention-probability dropout (output dropout kept) — reported in the
@@ -240,7 +243,7 @@ def run_config(seq, batch_per_chip, *, attn=None, dropout=0.1):
                            "elementwise_add", "elementwise_mul", "dropout",
                            "gelu", "relu", "scale", "transpose2",
                            "reshape2", "gather_nd", "squeeze2", "unsqueeze2",
-                           "flash_attention"]
+                           "flash_attention", "flash_attention_qkv"]
             if os.environ.get("BENCH_BF16_SOFTMAX", "1") == "1":
                 extra_white.append("softmax")
         opt = mixed_precision.decorate(
@@ -359,10 +362,10 @@ def main():
         jax.config.update("jax_default_prng_impl", "rbg")
 
     seq = int(os.environ.get("BENCH_SEQ", "128"))
-    # 128 measured fastest on v5e at seq-128 (64 -> 793, 128 -> 847,
-    # 192 -> 819, 256 -> 803); 32 fastest at seq-512 (64 -> 171,
-    # 128 -> 160, 32 -> 174, same-session A/B)
-    default_batch = 128 if seq < 512 else 32
+    # batch sweep on v5e AFTER the dot_general-mul + remat-dropout fixes:
+    # seq-128: 160 -> 934, 192 -> 1212, 224 -> 1128, 256 -> 1167;
+    # seq-512 (packed flash): 32 -> 196, 64 -> 289, 96 -> 284, 128 -> 201
+    default_batch = 192 if seq < 512 else 64
     batch = int(os.environ.get("BENCH_BATCH", str(default_batch)))
     dropout = float(os.environ.get("BENCH_DROPOUT", "0.1"))
 
@@ -374,7 +377,11 @@ def main():
     # the marquee long-context capability must carry a published number)
     want_legs = os.environ.get("BENCH_LEGS", "1") == "1"
     if want_legs and seq == 128 and "BENCH_HIDDEN" not in os.environ:
-        leg = run_config(512, 32, dropout=dropout)
+        # attention pinned to the packed flash kernels: the leg exists to
+        # publish the long-sequence number, and a BENCH_ATTN override
+        # meant for the seq-128 A/B would otherwise leak in (unfused
+        # can't hold batch 64 at seq-512)
+        leg = run_config(512, 64, attn=True, dropout=dropout)
         out["legs"] = {"seq512": leg}
 
     print(json.dumps(out))

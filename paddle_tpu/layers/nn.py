@@ -20,7 +20,8 @@ __all__ = [
     "swish", "hard_sigmoid", "hard_swish", "prelu", "matmul", "bmm", "mul",
     "one_hot", "topk", "flatten", "l2_normalize", "label_smooth", "maxout",
     "soft_relu", "log_loss", "clip", "clip_by_norm", "mean", "pad",
-    "adaptive_pool2d", "flash_attention", "rms_norm", "rope",
+    "adaptive_pool2d", "flash_attention", "flash_attention_qkv",
+    "rms_norm", "rope",
     "silu", "mish",
     "exp", "log", "sqrt", "square", "reciprocal", "softplus",
     "softsign", "sin", "cos", "erf", "ceil", "floor", "round", "abs",
@@ -554,6 +555,29 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
     if bias is not None:
         inputs["Bias"] = [bias]
     helper.append_op("flash_attention", inputs=inputs,
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def flash_attention_qkv(qkv, num_heads, bias=None, causal=False,
+                        scale=None, name=None):
+    """Transpose-free fused attention on a packed QKV projection.
+
+    qkv: [B, S, 3H] (the fused projection output, heads contiguous per
+    tensor), returns [B, S, H].  Lowers to the packed pallas kernels on
+    TPU (ops/attention_ops.py flash_attention_qkv) — no
+    [B,S,3H] <-> [B,h,S,d] layout traffic.  bias: optional [B, S]
+    additive score rows (padding mask).
+    """
+    helper = LayerHelper("flash_attention_qkv", name=name)
+    out = helper.create_variable_for_type_inference(qkv.dtype)
+    attrs = {"num_heads": int(num_heads), "causal": causal}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    inputs = {"QKV": [qkv]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    helper.append_op("flash_attention_qkv", inputs=inputs,
                      outputs={"Out": [out]}, attrs=attrs)
     return out
 

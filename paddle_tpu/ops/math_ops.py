@@ -364,11 +364,25 @@ def _mul_infer(op: Operator, block: Block):
 
 @register_op("mul", infer=_mul_infer)
 def _mul_lower(ctx: LowerContext, op: Operator):
+    import jax
     jnp = _jnp()
     x, y = ctx.get_input(op, "X"), ctx.get_input(op, "Y")
     xd = op.attr("x_num_col_dims", 1)
     yd = op.attr("y_num_col_dims", 1)
     xs, ys = jnp.shape(x), jnp.shape(y)
+    if list(xs[xd:]) == list(ys[:yd]):
+        # contraction factorizations line up: contract directly with
+        # dot_general, leading dims stay free. The reshape-to-2D-and-back
+        # formulation costs real HBM copies when XLA's tiled layouts
+        # differ across the reshape (profiled 3 GB/step of bf16
+        # [B,S,I] copies on the seq-128 BERT flagship at batch 160 —
+        # ~15% of device time as 'copy' ops)
+        dn = ((tuple(range(xd, len(xs))), tuple(range(yd))), ((), ()))
+        out = jax.lax.dot_general(
+            x, y, dn, preferred_element_type=_acc_dtype(x.dtype),
+            precision=_mm_precision(x.dtype))
+        ctx.set_output(op, "Out", out.astype(x.dtype))
+        return
     x2 = jnp.reshape(x, (int(np.prod(xs[:xd])), -1))
     y2 = jnp.reshape(y, (int(np.prod(ys[:yd])), -1))
     out = jnp.matmul(x2, y2, preferred_element_type=_acc_dtype(x2.dtype),

@@ -482,3 +482,403 @@ def _fab_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
 
 
 flash_attention_bias.defvjp(_fab_fwd, _fab_bwd)
+
+
+# ---------------------------------------------------------------------------
+# packed-QKV kernels: transpose-free attention on [B, S, 3H]
+# ---------------------------------------------------------------------------
+#
+# The standard path costs ~2.4 GB/step of pure layout movement on the
+# seq-512 BERT bench (xprof: the [B,S,3H] -> [3,B,h,S,d] transpose, the
+# q/k/v slices, the ctx transpose back, and all their grads).  These
+# kernels consume the fused QKV projection output directly: the grid is
+# (batch, 128-lane column chunk, row block) and each cell reads its
+# head-pair's columns via BlockSpec index maps (768 = 6 x 128, so chunk
+# boundaries are lane-aligned and Mosaic-legal).  head_dim 64 packs two
+# heads per chunk (static halves inside the kernel); head_dim 128 maps
+# one-to-one.  No transpose, slice, or concat ever materializes in HBM
+# on the forward; the backward assembles d(qkv) with one cheap concat.
+
+def _packed_dims(qkv_shape, num_heads):
+    B, S, threeH = qkv_shape
+    H = threeH // 3
+    D = H // num_heads
+    if threeH != 3 * H or H % 128 or D not in (64, 128):
+        raise ValueError(
+            f"flash_attention_packed needs hidden % 128 == 0 and head_dim "
+            f"in (64, 128); got qkv {qkv_shape}, num_heads {num_heads}")
+    return B, S, H, D, H // 128, 128 // D
+
+
+def _fp_fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k, causal, scale,
+                   seq_k, head_dim, hpc, has_bias=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if has_bias:
+        b_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
+    qi = pl.program_id(2)
+    nk = seq_k // block_k
+    outs = []
+    for h in range(hpc):
+        q = q_ref[0][:, h * head_dim:(h + 1) * head_dim].astype(
+            jnp.float32) * scale                       # [Bq, D]
+        bq = q.shape[0]
+
+        def body(j, carry, q=q, h=h, bq=bq):
+            m, l, acc = carry
+            kb = k_ref[0, pl.ds(j * block_k, block_k), :][
+                :, h * head_dim:(h + 1) * head_dim].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(j * block_k, block_k), :][
+                :, h * head_dim:(h + 1) * head_dim].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)    # [Bq, Bk]
+            if has_bias:
+                s = s + b_ref[0, 0, pl.ds(j * block_k, block_k)].astype(
+                    jnp.float32)[None, :]
+            if causal:
+                q_pos = qi * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 0)
+                k_pos = j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 1)
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + p.sum(-1, keepdims=True)
+            acc_new = acc * corr + jnp.dot(
+                p, vb, preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((q.shape[0], 1), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((q.shape[0], 1), jnp.float32)
+        acc0 = jnp.zeros((q.shape[0], head_dim), jnp.float32)
+        if causal:
+            upper = jnp.minimum(
+                nk, ((qi + 1) * q.shape[0] + block_k - 1) // block_k)
+        else:
+            upper = nk
+        m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+        l_safe = jnp.maximum(l, 1e-30)
+        outs.append(acc / l_safe)
+        lse_ref[0, 0, h] = (m + jnp.log(l_safe))[:, 0]
+    o_ref[0] = jnp.concatenate(outs, axis=1).astype(o_ref.dtype)
+
+
+def _packed_forward(qkv, num_heads, causal, sm_scale, block_q, block_k,
+                    interpret, bias=None):
+    """qkv [B, S, 3H] -> (out [B, S, H], lse [B, HP, hpc, S] f32)."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    B, S, H, D, HP, hpc = _packed_dims(qkv.shape, num_heads)
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
+    bq = _fit_block(block_q, S)
+    bk = _fit_block(block_k, S)
+
+    kernel = functools.partial(
+        _fp_fwd_kernel, block_k=bk, causal=causal, scale=scale, seq_k=S,
+        head_dim=D, hpc=hpc, has_bias=bias is not None)
+    in_specs = [
+        pl.BlockSpec((1, bq, 128), lambda b, hp, i: (b, i, hp)),
+        pl.BlockSpec((1, S, 128), lambda b, hp, i: (b, 0, HP + hp)),
+        pl.BlockSpec((1, S, 128), lambda b, hp, i: (b, 0, 2 * HP + hp)),
+    ]
+    args = [qkv, qkv, qkv]
+    if bias is not None:
+        in_specs.append(
+            pl.BlockSpec((1, 1, S), lambda b, hp, i: (b, 0, 0)))
+        args.append(bias.reshape(B, 1, S))
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, HP, S // bq),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, 128), lambda b, hp, i: (b, i, hp)),
+            pl.BlockSpec((1, 1, hpc, bq), lambda b, hp, i: (b, hp, 0, i)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((B, S, H), qkv.dtype),
+                   jax.ShapeDtypeStruct((B, HP, hpc, S), np.float32)],
+        interpret=interpret,
+    )(*args)
+    return out, lse
+
+
+def _fp_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
+                   block_q, causal, scale, seq_q, head_dim, hpc,
+                   has_bias=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if has_bias:
+        b_ref, dk_ref, dv_ref, db_ref = rest
+    else:
+        dk_ref, dv_ref = rest
+    kj = pl.program_id(2)
+    nq = seq_q // block_q
+    db_acc = None
+    dk_parts, dv_parts = [], []
+    for h in range(hpc):
+        kb = k_ref[0][:, h * head_dim:(h + 1) * head_dim].astype(
+            jnp.float32)                               # [Bk, D]
+        vb = v_ref[0][:, h * head_dim:(h + 1) * head_dim].astype(
+            jnp.float32)
+        bk = kb.shape[0]
+
+        def body(i, carry, kb=kb, vb=vb, h=h, bk=bk):
+            dk, dv, db = carry
+            qb = q_ref[0, pl.ds(i * block_q, block_q), :][
+                :, h * head_dim:(h + 1) * head_dim].astype(
+                jnp.float32) * scale                   # [Bq, D]
+            dob = do_ref[0, pl.ds(i * block_q, block_q), :][
+                :, h * head_dim:(h + 1) * head_dim].astype(jnp.float32)
+            lse = lse_ref[0, 0, h, pl.ds(i * block_q, block_q)]
+            dlt = dl_ref[0, 0, h, pl.ds(i * block_q, block_q)]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)    # [Bq, Bk]
+            if has_bias:
+                s = s + b_ref[0, 0, pl.ds(kj * bk, bk)].astype(
+                    jnp.float32)[None, :]
+            if causal:
+                q_pos = i * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, bk), 0)
+                k_pos = kj * bk + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, bk), 1)
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])
+            dv = dv + jax.lax.dot_general(
+                p, dob, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                dob, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - dlt[:, None])
+            dk = dk + jax.lax.dot_general(
+                ds, qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if has_bias:
+                db = db + ds.sum(0)
+            return dk, dv, db
+
+        lower = (kj * bk) // block_q if causal else 0
+        dk0 = jnp.zeros((bk, head_dim), jnp.float32)
+        dv0 = jnp.zeros((bk, head_dim), jnp.float32)
+        db0 = jnp.zeros((bk,), jnp.float32)
+        dk, dv, db = jax.lax.fori_loop(lower, nq, body, (dk0, dv0, db0))
+        dk_parts.append(dk)
+        dv_parts.append(dv)
+        db_acc = db if db_acc is None else db_acc + db
+    dk_ref[0] = jnp.concatenate(dk_parts, axis=1).astype(dk_ref.dtype)
+    dv_ref[0] = jnp.concatenate(dv_parts, axis=1).astype(dv_ref.dtype)
+    if has_bias:
+        # the db row block spans full S and is revisited across the kv
+        # grid; each cell writes its own bk-wide chunk
+        bk = dk_ref.shape[1]
+        db_ref[0, 0, pl.ds(kj * bk, bk)] = db_acc
+
+
+def _fp_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
+                  block_k, causal, scale, seq_k, head_dim, hpc,
+                  has_bias=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if has_bias:
+        b_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
+    qi = pl.program_id(2)
+    nk = seq_k // block_k
+    dq_parts = []
+    for h in range(hpc):
+        qb = q_ref[0][:, h * head_dim:(h + 1) * head_dim].astype(
+            jnp.float32) * scale
+        dob = do_ref[0][:, h * head_dim:(h + 1) * head_dim].astype(
+            jnp.float32)
+        lse = lse_ref[0, 0, h]
+        dlt = dl_ref[0, 0, h]
+        bq = qb.shape[0]
+
+        def body(j, acc, qb=qb, dob=dob, lse=lse, dlt=dlt, h=h, bq=bq):
+            kb = k_ref[0, pl.ds(j * block_k, block_k), :][
+                :, h * head_dim:(h + 1) * head_dim].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(j * block_k, block_k), :][
+                :, h * head_dim:(h + 1) * head_dim].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if has_bias:
+                s = s + b_ref[0, 0, pl.ds(j * block_k, block_k)].astype(
+                    jnp.float32)[None, :]
+            if causal:
+                q_pos = qi * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 0)
+                k_pos = j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 1)
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])
+            dp = jax.lax.dot_general(
+                dob, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - dlt[:, None])
+            return acc + jnp.dot(ds, kb,
+                                 preferred_element_type=jnp.float32)
+
+        if causal:
+            upper = jnp.minimum(nk, ((qi + 1) * bq + block_k - 1)
+                                // block_k)
+        else:
+            upper = nk
+        acc0 = jnp.zeros((bq, head_dim), jnp.float32)
+        acc = jax.lax.fori_loop(0, upper, body, acc0)
+        dq_parts.append(acc * scale)
+    dq_ref[0] = jnp.concatenate(dq_parts, axis=1).astype(dq_ref.dtype)
+
+
+def _packed_backward(qkv, num_heads, out, lse, g, causal, sm_scale,
+                     block_q, block_k, interpret, bias=None):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    B, S, H, D, HP, hpc = _packed_dims(qkv.shape, num_heads)
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
+    bq = _fit_block(block_q, S)
+    bk = _fit_block(block_k, S)
+    has_bias = bias is not None
+
+    # delta = rowsum(dO ⊙ O) per head, laid out to match lse
+    prod = (g.astype(jnp.float32) * out.astype(jnp.float32))
+    delta = prod.reshape(B, S, HP, hpc, D).sum(-1)       # [B,S,HP,hpc]
+    delta = jnp.moveaxis(delta, 1, 3)                    # [B,HP,hpc,S]
+
+    common_specs = [
+        pl.BlockSpec((1, S, 128), lambda b, hp, j: (b, 0, hp)),        # q
+        pl.BlockSpec((1, S, 128), lambda b, hp, j: (b, 0, HP + hp)),   # k
+        pl.BlockSpec((1, S, 128), lambda b, hp, j: (b, 0, 2 * HP + hp)),
+        pl.BlockSpec((1, S, 128), lambda b, hp, j: (b, 0, hp)),        # dO
+        pl.BlockSpec((1, 1, hpc, S), lambda b, hp, j: (b, hp, 0, 0)),  # lse
+        pl.BlockSpec((1, 1, hpc, S), lambda b, hp, j: (b, hp, 0, 0)),  # dlt
+    ]
+
+    # ---- dK / dV ------------------------------------------------------
+    dkv_kernel = functools.partial(
+        _fp_dkv_kernel, block_q=bq, causal=causal, scale=scale, seq_q=S,
+        head_dim=D, hpc=hpc, has_bias=has_bias)
+    in_specs = list(common_specs)
+    in_specs[1] = pl.BlockSpec((1, bk, 128),
+                               lambda b, hp, j: (b, j, HP + hp))
+    in_specs[2] = pl.BlockSpec((1, bk, 128),
+                               lambda b, hp, j: (b, j, 2 * HP + hp))
+    args = [qkv, qkv, qkv, g, lse, delta]
+    out_specs = [pl.BlockSpec((1, bk, 128), lambda b, hp, j: (b, j, hp)),
+                 pl.BlockSpec((1, bk, 128), lambda b, hp, j: (b, j, hp))]
+    out_shapes = [jax.ShapeDtypeStruct((B, S, H), qkv.dtype),
+                  jax.ShapeDtypeStruct((B, S, H), qkv.dtype)]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, 1, S), lambda b, hp, j: (b, 0, 0)))
+        args.append(bias.reshape(B, 1, S))
+        out_specs.append(pl.BlockSpec(
+            (1, 1, S), lambda b, hp, j: (b * HP + hp, 0, 0)))
+        out_shapes.append(jax.ShapeDtypeStruct((B * HP, 1, S), np.float32))
+    res = pl.pallas_call(
+        dkv_kernel,
+        grid=(B, HP, S // bk),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(*args)
+    dk, dv = res[0], res[1]
+    db = None
+    if has_bias:
+        db = res[2].reshape(B, HP, S).sum(1).astype(bias.dtype)
+
+    # ---- dQ -----------------------------------------------------------
+    dq_kernel = functools.partial(
+        _fp_dq_kernel, block_k=bk, causal=causal, scale=scale, seq_k=S,
+        head_dim=D, hpc=hpc, has_bias=has_bias)
+    in_specs = list(common_specs)
+    in_specs[0] = pl.BlockSpec((1, bq, 128), lambda b, hp, i: (b, i, hp))
+    in_specs[3] = pl.BlockSpec((1, bq, 128), lambda b, hp, i: (b, i, hp))
+    in_specs[4] = pl.BlockSpec((1, 1, hpc, bq),
+                               lambda b, hp, i: (b, hp, 0, i))
+    in_specs[5] = pl.BlockSpec((1, 1, hpc, bq),
+                               lambda b, hp, i: (b, hp, 0, i))
+    args = [qkv, qkv, qkv, g, lse, delta]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, 1, S), lambda b, hp, i: (b, 0, 0)))
+        args.append(bias.reshape(B, 1, S))
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B, HP, S // bq),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, 128), lambda b, hp, i: (b, i, hp)),
+        out_shape=jax.ShapeDtypeStruct((B, S, H), qkv.dtype),
+        interpret=interpret,
+    )(*args)
+
+    dqkv = jnp.concatenate([dq, dk, dv], axis=-1)
+    return dqkv, db
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
+def flash_attention_packed(qkv, num_heads, causal=False, sm_scale=None,
+                           block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                           interpret=False):
+    """Transpose-free attention on the fused projection: qkv [B, S, 3H]
+    -> [B, S, H]. Requires H % 128 == 0 and head_dim in (64, 128)."""
+    return _packed_forward(qkv, num_heads, causal, sm_scale, block_q,
+                           block_k, interpret)[0]
+
+
+def _fpk_fwd(qkv, num_heads, causal, sm_scale, block_q, block_k, interpret):
+    out, lse = _packed_forward(qkv, num_heads, causal, sm_scale, block_q,
+                               block_k, interpret)
+    return out, (qkv, out, lse)
+
+
+def _fpk_bwd(num_heads, causal, sm_scale, block_q, block_k, interpret,
+             res, g):
+    qkv, out, lse = res
+    dqkv, _ = _packed_backward(qkv, num_heads, out, lse, g, causal,
+                               sm_scale, block_q, block_k, interpret)
+    return (dqkv,)
+
+
+flash_attention_packed.defvjp(_fpk_fwd, _fpk_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def flash_attention_packed_bias(qkv, bias, num_heads, causal=False,
+                                sm_scale=None, block_q=DEFAULT_BLOCK_Q,
+                                block_k=DEFAULT_BLOCK_K, interpret=False):
+    """flash_attention_packed with an additive [B, S] score bias."""
+    return _packed_forward(qkv, num_heads, causal, sm_scale, block_q,
+                           block_k, interpret, bias=bias)[0]
+
+
+def _fpkb_fwd(qkv, bias, num_heads, causal, sm_scale, block_q, block_k,
+              interpret):
+    out, lse = _packed_forward(qkv, num_heads, causal, sm_scale, block_q,
+                               block_k, interpret, bias=bias)
+    return out, (qkv, bias, out, lse)
+
+
+def _fpkb_bwd(num_heads, causal, sm_scale, block_q, block_k, interpret,
+              res, g):
+    qkv, bias, out, lse = res
+    dqkv, db = _packed_backward(qkv, num_heads, out, lse, g, causal,
+                                sm_scale, block_q, block_k, interpret,
+                                bias=bias)
+    return dqkv, db
+
+
+flash_attention_packed_bias.defvjp(_fpkb_fwd, _fpkb_bwd)

@@ -271,3 +271,111 @@ def test_einsum_impl_dropout_statistics():
     # dropout active: stochastic across steps, but finite and same shape
     assert not np.allclose(np.asarray(o1), np.asarray(o2))
     assert np.isfinite(np.asarray(o1)).all()
+
+
+# ---------------------------------------------------------------------------
+# packed-QKV kernels (transpose-free [B, S, 3H] path)
+# ---------------------------------------------------------------------------
+
+PB, PS, PH, PNH = 2, 128, 256, 4  # head_dim 64, two heads per lane chunk
+
+
+def _packed_ref(qkv, bias=None, causal=False, nh=PNH):
+    b, s, three_h = qkv.shape
+    h = three_h // 3
+    d = h // nh
+    x = qkv.reshape(b, s, 3, nh, d)
+    q, k, v = x[:, :, 0], x[:, :, 1], x[:, :, 2]
+    sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if bias is not None:
+        sc = sc + bias[:, None, None, :]
+    if causal:
+        sc = jnp.where(jnp.tril(jnp.ones((s, s), bool))[None, None],
+                       sc, -1e30)
+    p = jax.nn.softmax(sc, -1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(b, s, h)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_packed_flash_matches_naive(causal):
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_packed
+
+    rng = np.random.RandomState(0)
+    qkv = jnp.asarray(rng.randn(PB, PS, 3 * PH).astype("float32"))
+    out = flash_attention_packed(qkv, PNH, causal, None, 64, 32, True)
+    ref = _packed_ref(qkv, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_packed_flash_grads_match_naive(causal):
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_packed
+
+    rng = np.random.RandomState(1)
+    qkv = jnp.asarray(rng.randn(PB, PS, 3 * PH).astype("float32"))
+    g1 = jax.grad(lambda x: (flash_attention_packed(
+        x, PNH, causal, None, 64, 32, True) ** 2).sum())(qkv)
+    g2 = jax.grad(lambda x: (_packed_ref(x, causal=causal) ** 2).sum())(qkv)
+    scale = float(jnp.abs(g2).max())
+    np.testing.assert_allclose(np.asarray(g1) / scale,
+                               np.asarray(g2) / scale, atol=2e-2)
+
+
+def test_packed_flash_bias_and_grads():
+    from paddle_tpu.ops.pallas.flash_attention import (
+        flash_attention_packed_bias)
+
+    rng = np.random.RandomState(2)
+    qkv = jnp.asarray(rng.randn(PB, PS, 3 * PH).astype("float32"))
+    bias = jnp.asarray(
+        np.where(rng.rand(PB, PS) > 0.2, 0.0, -1e4).astype("float32"))
+    out = flash_attention_packed_bias(qkv, bias, PNH, False, None, 64, 32,
+                                      True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_packed_ref(qkv, bias)),
+                               atol=2e-2, rtol=2e-2)
+    g1 = jax.grad(lambda x, b: (flash_attention_packed_bias(
+        x, b, PNH, False, None, 64, 32, True) ** 2).sum(), (0, 1))(qkv, bias)
+    g2 = jax.grad(lambda x, b: (_packed_ref(x, b) ** 2).sum(), (0, 1))(
+        qkv, bias)
+    for a, b_ in zip(g1, g2):
+        scale = float(jnp.abs(b_).max())
+        np.testing.assert_allclose(np.asarray(a) / scale,
+                                   np.asarray(b_) / scale, atol=2e-2)
+
+
+def test_packed_flash_head_dim_128():
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_packed
+
+    rng = np.random.RandomState(3)
+    qkv = jnp.asarray(rng.randn(PB, PS, 3 * 256).astype("float32"))
+    out = flash_attention_packed(qkv, 2, False, None, 64, 32, True)
+    ref = _packed_ref(qkv, nh=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_flash_attention_qkv_op_and_layer():
+    """Static-graph flash_attention_qkv op: forward + grads flow, and the
+    fallback (CPU/mesh) path matches the packed-kernel math."""
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main_p, startup):
+        x = layers.data("x", [PB, PS, 3 * PH], append_batch_size=False)
+        x.stop_gradient = False
+        bias = layers.data("bias", [PB, PS], append_batch_size=False)
+        out = layers.flash_attention_qkv(x, PNH, bias=bias)
+        loss = layers.reduce_mean(out)
+        pt.append_backward(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(4)
+    xv = rng.randn(PB, PS, 3 * PH).astype("float32")
+    bv = np.where(rng.rand(PB, PS) > 0.2, 0.0, -1e4).astype("float32")
+    outs = exe.run(main_p, feed={"x": xv, "bias": bv},
+                   fetch_list=[out.name, "x@GRAD"])
+    ref = _packed_ref(jnp.asarray(xv), jnp.asarray(bv))
+    np.testing.assert_allclose(outs[0], np.asarray(ref), atol=2e-2,
+                               rtol=2e-2)
+    assert np.abs(outs[1]).max() > 0
