@@ -12,6 +12,9 @@ from .learning_rate_scheduler import (noam_decay, exponential_decay,  # noqa
                                       cosine_decay, linear_lr_warmup)
 from . import learning_rate_scheduler  # noqa
 from . import control_flow  # noqa
+from .rnn import (RNNCell, GRUCell, LSTMCell, rnn, birnn,  # noqa
+                  BeamSearchDecoder, dynamic_decode, beam_search,
+                  beam_search_decode, gather_tree)
 from .sequence import *  # noqa
 from . import sequence  # noqa
 from . import nn  # noqa

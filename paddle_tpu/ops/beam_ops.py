@@ -1,0 +1,169 @@
+"""Beam-search decoding ops: beam_search, beam_search_decode, gather_tree.
+
+Reference analogs: operators/beam_search_op.cc, beam_search_decode_op.cc,
+gather_tree_op.cc. The reference implements hypothesis pruning with LoD
+shrinking (finished hypotheses leave the batch); that is scalar-loop,
+dynamic-shape machinery XLA cannot compile. Here the TPU-native
+formulation: FIXED [batch, beam] shapes end-to-end, finished hypotheses
+stay in the beam as end-token self-continuations with frozen scores
+(the standard fixed-shape beam search of flax/t5x), and the whole decode
+step is dense topk over [batch, beam*width] — one MXU/VPU-friendly
+reduction instead of per-sentence queues.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import in_var, register_op, set_out
+
+NEG_INF = -1e9
+
+
+def _beam_search_infer(op, block):
+    scores = in_var(op, block, "Scores")       # [B, K, W] accumulated
+    B, K = scores.shape[0], op.attr("beam_size")
+    set_out(op, block, "SelectedIds", (B, K), "int64")
+    set_out(op, block, "SelectedScores", (B, K), scores.dtype)
+    set_out(op, block, "ParentIdx", (B, K), "int64")
+
+
+@register_op("beam_search", infer=_beam_search_infer, grad=None)
+def _beam_search(ctx, op):
+    """One fixed-shape beam step.
+
+    Inputs:
+      PreIds    [B, K] int64   — last selected token per hypothesis
+      PreScores [B, K] float   — accumulated log-prob per hypothesis
+      Ids       [B, K, W] int64 — candidate token ids per hypothesis
+                 (typically a topk over the vocab; W = candidate width)
+      Scores    [B, K, W] float — ACCUMULATED log-probs of candidates
+                 (pre_score + step log-prob, reference accu_scores)
+    Attrs: beam_size K, end_id.
+    Outputs: SelectedIds/SelectedScores [B, K], ParentIdx [B, K] (which
+    source hypothesis each selected candidate extends).
+
+    Finished semantics (replaces reference LoD pruning,
+    beam_search_op.cc:42 `PruneEndBeams`): a hypothesis whose PreIds is
+    end_id contributes exactly one candidate — end_id again, at its
+    frozen PreScores — so it persists in the beam without spawning
+    continuations.
+    """
+    import jax.numpy as jnp
+    import jax
+
+    pre_ids = ctx.get_input(op, "PreIds")
+    pre_scores = ctx.get_input(op, "PreScores")
+    # Ids optional: absent means candidate slot w IS token id w (the
+    # full-vocab case — avoids materializing a [B,K,V] int64 id tensor)
+    ids = ctx.get_input(op, "Ids") if op.single_input("Ids") else None
+    scores = ctx.get_input(op, "Scores")
+    K = op.attr("beam_size")
+    end_id = op.attr("end_id")
+    B, K_in, W = scores.shape
+
+    finished = (pre_ids == end_id)                       # [B, K]
+    # finished rows: candidate 0 -> (end_id, frozen score), rest masked
+    slot = jnp.arange(W)[None, None, :] == 0             # [1,1,W]
+    cand_scores = jnp.where(
+        finished[:, :, None],
+        jnp.where(slot, pre_scores[:, :, None],
+                  jnp.asarray(NEG_INF, scores.dtype)),
+        scores)
+    flat_scores = cand_scores.reshape(B, K_in * W)
+    top_scores, top_idx = jax.lax.top_k(flat_scores, K)  # [B, K]
+    parent = (top_idx // W).astype("int64")
+    if ids is None:
+        tok = (top_idx % W).astype("int64")
+        # a selected candidate extending a finished parent is its end_id
+        # self-continuation (slot 0), not token 0
+        sel_ids = jnp.where(jnp.take_along_axis(finished, parent, axis=1),
+                            jnp.asarray(end_id, "int64"), tok)
+    else:
+        cand_ids = jnp.where(finished[:, :, None],
+                             jnp.asarray(end_id, ids.dtype), ids)
+        sel_ids = jnp.take_along_axis(
+            cand_ids.reshape(B, K_in * W), top_idx, axis=1).astype("int64")
+    ctx.set_output(op, "SelectedIds", sel_ids)
+    ctx.set_output(op, "SelectedScores", top_scores)
+    ctx.set_output(op, "ParentIdx", parent)
+
+
+def _gather_tree_infer(op, block):
+    ids = in_var(op, block, "Ids")
+    set_out(op, block, "Out", ids.shape, ids.dtype)
+
+
+def _backtrack(ids, parents):
+    """Reverse-scan beam backtrack (reference gather_tree_op.h:27).
+
+    Ids/Parents: [T, B, K] -> [T, B, K]. Out[t, b, k] follows the parent
+    chain from (T-1, b, k) down to step t. The reference walks each
+    (b, k) chain with a scalar loop; here one reverse lax.scan carries
+    the live parent row [B, K] and gathers whole [B, K] slices per step.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    T = ids.shape[0]
+    if T == 1:
+        return ids
+
+    def body(parent, xs):
+        ids_t, par_t = xs
+        out_t = jnp.take_along_axis(ids_t, parent, axis=1)
+        parent = jnp.take_along_axis(par_t, parent, axis=1)
+        return parent, out_t
+
+    _, rows = jax.lax.scan(body, parents[T - 1],
+                           (ids[:T - 1], parents[:T - 1]), reverse=True)
+    return jnp.concatenate([rows, ids[T - 1:]], axis=0)
+
+
+@register_op("gather_tree", infer=_gather_tree_infer, grad=None)
+def _gather_tree(ctx, op):
+    ctx.set_output(op, "Out",
+                   _backtrack(ctx.get_input(op, "Ids"),
+                              ctx.get_input(op, "Parents")))
+
+
+def _bsd_infer(op, block):
+    ids = in_var(op, block, "Ids")             # [T, B, K]
+    T, B, K = ids.shape
+    set_out(op, block, "SentenceIds", (B, K, T), "int64")
+    set_out(op, block, "SentenceScores", (B, K),
+            in_var(op, block, "Scores").dtype)
+    set_out(op, block, "SentenceLengths", (B, K), "int64")
+
+
+@register_op("beam_search_decode", infer=_bsd_infer, grad=None)
+def _beam_search_decode(ctx, op):
+    """Assemble final hypotheses from per-step beam outputs.
+
+    Inputs: Ids/Parents [T, B, K] (per-step selected tokens + parent
+    indices), Scores [B, K] (final accumulated log-probs). Outputs:
+    SentenceIds [B, K, T] (end_id-padded past each hypothesis' end),
+    SentenceScores [B, K], SentenceLengths [B, K] (tokens up to and
+    including the first end_id, or T if never finished).
+
+    Reference beam_search_decode_op.cc assembles LoD sentences on the
+    host; this stays on device with dense padded output.
+    """
+    import jax.numpy as jnp
+
+    ids = ctx.get_input(op, "Ids")
+    parents = ctx.get_input(op, "Parents")
+    scores = ctx.get_input(op, "Scores")
+    end_id = op.attr("end_id")
+    T, B, K = ids.shape
+
+    full = _backtrack(ids, parents)                          # [T, B, K]
+    sent = jnp.moveaxis(full, 0, 2).astype("int64")          # [B, K, T]
+    is_end = sent == end_id
+    # length = index of first end_id + 1, or T
+    first_end = jnp.where(is_end.any(-1), is_end.argmax(-1) + 1, T)
+    # pad everything past the first end with end_id
+    t_idx = jnp.arange(T)[None, None, :]
+    sent = jnp.where(t_idx < first_end[..., None], sent, end_id)
+    ctx.set_output(op, "SentenceIds", sent)
+    ctx.set_output(op, "SentenceScores", scores)
+    ctx.set_output(op, "SentenceLengths", first_end.astype("int64"))

@@ -985,6 +985,9 @@ SKIP = {
     # fused attention: parity + grad vs unfused in test_attention
     "flash_attention": "tests/test_attention.py (fwd+grad vs unfused)",
     "flash_attention_qkv": "tests/test_attention.py (packed vs unfused)",
+    "beam_search": "tests/test_beam_search.py (finished semantics)",
+    "beam_search_decode": "tests/test_beam_search.py (padding/lengths)",
+    "gather_tree": "tests/test_beam_search.py (vs reference loop)",
     # amp machinery: inf-recovery trajectories
     "check_finite_and_unscale": "tests/test_round2_fixes.py (amp)",
     "update_loss_scaling": "tests/test_round2_fixes.py (amp)",
