@@ -21,7 +21,8 @@ __all__ = [
     "one_hot", "topk", "flatten", "l2_normalize", "label_smooth", "maxout",
     "soft_relu", "log_loss", "clip", "clip_by_norm", "mean", "pad",
     "adaptive_pool2d", "flash_attention", "flash_attention_qkv",
-    "rms_norm", "rope",
+    "rms_norm", "rope", "linear_chain_crf", "crf_decoding", "warpctc",
+    "nce", "hsigmoid",
     "silu", "mish",
     "exp", "log", "sqrt", "square", "reciprocal", "softplus",
     "softsign", "sin", "cos", "erf", "ceil", "floor", "round", "abs",
@@ -766,3 +767,102 @@ def dist(x, y, p=2, name=None):
         n *= int(s) if s > 0 else 1
     flat = _reshape(d, [-1])
     return norm(flat, p=p, axis=0)
+
+
+def linear_chain_crf(input, label, length, param_attr=None, name=None):
+    """Linear-chain CRF NLL (reference layers.linear_chain_crf /
+    operators/linear_chain_crf_op.h). input: emissions [B, T, N]; label
+    [B, T] int64; length [B] int64. Creates the [N+2, N] transition
+    parameter (row 0 start, row 1 stop, rows 2.. pairwise). Returns the
+    per-sequence negative log-likelihood [B, 1]."""
+    helper = LayerHelper("linear_chain_crf", name=name)
+    n = int(input.shape[-1])
+    transition = helper.create_parameter(param_attr, [n + 2, n],
+                                         input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("linear_chain_crf",
+                     inputs={"Emission": [input], "Transition": [transition],
+                             "Label": [label], "Length": [length]},
+                     outputs={"LogLikelihood": [out]})
+    return out
+
+
+def crf_decoding(input, length, param_attr=None, transition=None,
+                 name=None):
+    """Viterbi decode (reference layers.crf_decoding). Pass the training
+    CRF's transition parameter (or a param_attr naming it) to share
+    weights. Returns the best path [B, T] int64 (0 past length)."""
+    helper = LayerHelper("crf_decoding", name=name)
+    if transition is None:
+        n = int(input.shape[-1])
+        transition = helper.create_parameter(param_attr, [n + 2, n],
+                                             input.dtype)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("crf_decoding",
+                     inputs={"Emission": [input],
+                             "Transition": [transition],
+                             "Length": [length]},
+                     outputs={"ViterbiPath": [out]})
+    return out
+
+
+def warpctc(input, label, input_length, label_length, blank=0, name=None):
+    """CTC loss (reference layers.warpctc, padded mode). input: logits
+    [B, T, C]; label [B, L] (no blanks); lengths [B]. Returns [B, 1]."""
+    helper = LayerHelper("warpctc", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("warpctc",
+                     inputs={"Logits": [input], "Label": [label],
+                             "LogitsLength": [input_length],
+                             "LabelLength": [label_length]},
+                     outputs={"Loss": [out]},
+                     attrs={"blank": int(blank)})
+    return out
+
+
+def nce(input, label, num_total_classes, num_neg_samples=10, sampler=0,
+        param_attr=None, bias_attr=None, name=None):
+    """NCE loss (reference layers.nce / operators/nce_op.h). input
+    [B, D]; label [B, num_true] int64. sampler: 0 uniform, 1
+    log-uniform. Creates Weight [num_total_classes, D] and Bias.
+    Returns per-sample cost [B, 1]."""
+    helper = LayerHelper("nce", name=name)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, [num_total_classes, d],
+                                input.dtype)
+    inputs = {"Input": [input], "Weight": [w], "Label": [label]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_total_classes],
+                                    input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("nce", inputs=inputs, outputs={"Cost": [out]},
+                     attrs={"num_neg_samples": int(num_neg_samples),
+                            "num_total_classes": int(num_total_classes),
+                            "sampler": int(sampler)})
+    return out
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             path_table=None, path_code=None, name=None):
+    """Hierarchical sigmoid loss (reference layers.hsigmoid /
+    operators/hierarchical_sigmoid_op.cc). input [B, D]; label [B] or
+    [B,1]. Default complete binary tree; custom Huffman trees via
+    path_table/path_code [B, P]. Returns [B, 1]."""
+    helper = LayerHelper("hierarchical_sigmoid", name=name)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, [num_classes - 1, d],
+                                input.dtype)
+    inputs = {"X": [input], "W": [w], "Label": [label]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_classes - 1],
+                                    input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    if path_table is not None:
+        inputs["PathTable"] = [path_table]
+        inputs["PathCode"] = [path_code]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("hierarchical_sigmoid", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"num_classes": int(num_classes)})
+    return out

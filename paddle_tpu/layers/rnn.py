@@ -146,8 +146,10 @@ def _cell_params(cell, input_size, gate_width):
 
 class RNNCell:
     """Base cell: __call__(inputs, states) -> (outputs, new_states).
-    Parameters are created on first call and cached on the instance, so
-    reuse across time steps / programs-in-scope shares weights."""
+    Parameters are created on first call and cached on the current
+    Program (_cell_params), so reuse across time steps shares weights
+    and the same instance rebuilds identically-named params in a
+    separate inference program."""
 
     def get_initial_states(self, batch_size, dtype="float32"):
         raise NotImplementedError
@@ -171,7 +173,6 @@ class GRUCell(RNNCell):
         self._param_attr = param_attr
         self._bias_attr = bias_attr
         self._name = name or unique_name("gru_cell")
-        self._params = {}
 
     def _build(self, input_size):
         H = self.hidden_size
@@ -214,7 +215,6 @@ class LSTMCell(RNNCell):
         self._bias_attr = bias_attr
         self._forget_bias = forget_bias
         self._name = name or unique_name("lstm_cell")
-        self._params = {}
 
     def _build(self, input_size):
         H = self.hidden_size
@@ -291,6 +291,8 @@ def rnn(cell, inputs, initial_states=None, sequence_length=None,
     if is_reverse:
         outs = outs[::-1]
     outputs = nn.stack(outs, axis=1)
+    if time_major:
+        outputs = nn.transpose(outputs, [1, 0, 2])
     return outputs, states
 
 
@@ -428,6 +430,11 @@ class BeamSearchDecoder:
 
 def dynamic_decode(decoder, inits=None, max_step_num=None, name=None,
                    **kwargs):
+    if kwargs:
+        raise TypeError(
+            f"dynamic_decode: unsupported options {sorted(kwargs)} — "
+            "the TPU decoder returns batch-major [B, beam, T] sentences "
+            "(no output_time_major/is_test/return_length switches)")
     """Run `decoder` for max_step_num steps (reference rnn.py:1568).
 
     TPU contract: `max_step_num` is REQUIRED and static — the loop

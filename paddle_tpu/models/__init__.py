@@ -14,3 +14,4 @@ from .lenet import lenet, build_mnist_train  # noqa
 from .resnet import resnet, build_resnet_train  # noqa
 from .bert import bert_encoder, build_bert_pretrain  # noqa
 from .llama import llama, llama_block, build_llama_train  # noqa
+from .seq2seq import build_seq2seq_train, build_seq2seq_infer  # noqa

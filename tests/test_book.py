@@ -1,0 +1,292 @@
+"""The reference's canonical `tests/book/` suite rebuilt end-to-end
+(VERDICT r3 #3): each model trains through the PUBLIC API to a loss-drop
+assertion. Machine translation lives in tests/test_beam_search.py.
+
+Data is synthetic but dataset-shaped (zero-egress environment): the
+point of the book suite is that the components COMPOSE — graph builder,
+layers, optimizers, executor — exactly as the reference's book models
+do. Reference: python/paddle/fluid/tests/book/*.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+
+
+def _train(main_p, startup, feed_fn, loss, steps, scope=None, lr_opt=None):
+    exe = pt.Executor()
+    scope = scope or pt.Scope()
+    exe.run(startup, scope=scope)
+    losses = []
+    for i in range(steps):
+        l, = exe.run(main_p, feed=feed_fn(i), fetch_list=[loss],
+                     scope=scope)
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    return losses, exe, scope
+
+
+# ---------------------------------------------------------------------------
+# 1. fit_a_line (UCIHousing linear regression, book/test_fit_a_line.py)
+# ---------------------------------------------------------------------------
+
+def test_book_fit_a_line():
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(13, 1).astype("float32")
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main_p, startup):
+        x = layers.data("x", [13])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+
+    def feed(i):
+        xv = rng.randn(32, 13).astype("float32")
+        return {"x": xv, "y": xv @ true_w + 0.01 *
+                rng.randn(32, 1).astype("float32")}
+
+    losses, _, _ = _train(main_p, startup, feed, loss, 80)
+    assert losses[-1] < 0.1 * losses[0], (losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------------------
+# 2. recognize_digits (MNIST conv net, book/test_recognize_digits.py)
+# ---------------------------------------------------------------------------
+
+def test_book_recognize_digits():
+    rng = np.random.RandomState(0)
+    B = 32
+    yv = rng.randint(0, 10, (B, 1)).astype("int64")
+    # separable synthetic digits: class-dependent intensity pattern
+    xv = (yv.reshape(B, 1, 1, 1) / 10.0
+          + 0.1 * rng.randn(B, 1, 28, 28)).astype("float32")
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main_p, startup):
+        img = layers.data("img", [1, 28, 28])
+        label = layers.data("label", [1], dtype="int64")
+        # the reference's conv_pool x2 + fc topology
+        c1 = layers.pool2d(layers.conv2d(img, 20, 5, act="relu"),
+                           pool_size=2, pool_stride=2)
+        c2 = layers.pool2d(layers.conv2d(c1, 50, 5, act="relu"),
+                           pool_size=2, pool_stride=2)
+        logits = layers.fc(c2, size=10)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        optimizer.AdamOptimizer(1e-3).minimize(loss)
+    losses, exe, scope = _train(main_p, startup,
+                                lambda i: {"img": xv, "label": yv},
+                                loss, 40)
+    assert losses[-1] < 0.35 * losses[0], (losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------------------
+# 3. image_classification (CIFAR ResNet, book/test_image_classification.py)
+# ---------------------------------------------------------------------------
+
+def test_book_image_classification_resnet():
+    from paddle_tpu.models import resnet
+
+    rng = np.random.RandomState(0)
+    B = 16
+    yv = rng.randint(0, 10, (B, 1)).astype("int64")
+    xv = (yv.reshape(B, 1, 1, 1) / 10.0
+          + 0.1 * rng.randn(B, 3, 32, 32)).astype("float32")
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main_p, startup):
+        img = layers.data("img", [3, 32, 32])
+        label = layers.data("label", [1], dtype="int64")
+        out = resnet(img, label=label, depth=18, class_num=10)
+        loss = out["loss"]
+        optimizer.AdamOptimizer(1e-3).minimize(loss)
+    losses, _, _ = _train(main_p, startup,
+                          lambda i: {"img": xv, "label": yv}, loss, 30)
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------------------
+# 4. understand_sentiment (Imdb stacked LSTM,
+#    book/notest_understand_sentiment.py)
+# ---------------------------------------------------------------------------
+
+def test_book_understand_sentiment_lstm():
+    rng = np.random.RandomState(0)
+    V, B, T = 50, 16, 12
+    GOOD, BAD = 7, 13
+    xv = rng.randint(0, V, (B, T)).astype("int64")
+    half = B // 2
+    xv[:half, rng.randint(0, T)] = GOOD
+    xv[half:, rng.randint(0, T)] = BAD
+    xv[:half][xv[:half] == BAD] = 0
+    xv[half:][xv[half:] == GOOD] = 0
+    yv = np.array([[1]] * half + [[0]] * half, "int64")
+    lens = np.full((B,), T, "int64")
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main_p, startup):
+        words = layers.data("words", [B, T], dtype="int64",
+                            append_batch_size=False)
+        ln = layers.data("ln", [B], dtype="int64", append_batch_size=False)
+        label = layers.data("label", [B, 1], dtype="int64",
+                            append_batch_size=False)
+        emb = layers.embedding(words, size=[V, 32])
+        out1, h1, _ = layers.lstm(emb, 32, lengths=ln)
+        out2, h2, _ = layers.lstm(out1, 32, lengths=ln)
+        feat = layers.concat([h1, h2], axis=1)
+        logits = layers.fc(feat, size=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        optimizer.AdamOptimizer(5e-3).minimize(loss)
+    losses, _, _ = _train(
+        main_p, startup,
+        lambda i: {"words": xv, "ln": lens, "label": yv}, loss, 50)
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------------------
+# 5. word2vec (Imikolov N-gram, book/test_word2vec.py) — reference CE
+#    head plus the hsigmoid/NCE variants (VERDICT r3 #4 models)
+# ---------------------------------------------------------------------------
+
+def _word2vec_case(head):
+    rng = np.random.RandomState(0)
+    V, E, B = 40, 16, 64
+    ctx = rng.randint(0, V, (B, 4)).astype("int64")
+    nxt = ((ctx.sum(1) * 3 + 1) % V).astype("int64")[:, None]
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main_p, startup):
+        words = [layers.data(n, [B], dtype="int64",
+                             append_batch_size=False)
+                 for n in ("firstw", "secondw", "thirdw", "forthw")]
+        nextw = layers.data("nextw", [B, 1], dtype="int64",
+                            append_batch_size=False)
+        embs = [layers.embedding(
+            w, size=[V, E], param_attr=pt.ParamAttr(name="shared_emb"))
+            for w in words]
+        concat = layers.concat(embs, axis=1)
+        hidden = layers.fc(concat, size=64, act="sigmoid",
+                           num_flatten_dims=1)
+        if head == "softmax":
+            logits = layers.fc(hidden, size=V)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, nextw))
+        elif head == "hsigmoid":
+            loss = layers.mean(
+                layers.hsigmoid(hidden, nextw, num_classes=V))
+        else:
+            loss = layers.mean(
+                layers.nce(hidden, nextw, num_total_classes=V,
+                           num_neg_samples=8, sampler=1))
+        optimizer.AdamOptimizer(1e-2).minimize(loss)
+
+    def feed(i):
+        return {"firstw": ctx[:, 0], "secondw": ctx[:, 1],
+                "thirdw": ctx[:, 2], "forthw": ctx[:, 3], "nextw": nxt}
+
+    return _train(main_p, startup, feed, loss, 80)[0]
+
+
+@pytest.mark.parametrize("head", ["softmax", "hsigmoid", "nce"])
+def test_book_word2vec(head):
+    losses = _word2vec_case(head)
+    assert losses[-1] < 0.5 * losses[0], (head, losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------------------
+# 6. recommender_system (Movielens two towers + cos_sim,
+#    book/test_recommender_system.py)
+# ---------------------------------------------------------------------------
+
+def test_book_recommender_system():
+    rng = np.random.RandomState(0)
+    B, NU, NM = 32, 20, 15
+    uid = rng.randint(0, NU, (B,)).astype("int64")
+    mid = rng.randint(0, NM, (B,)).astype("int64")
+    affinity = np.sin(uid * 0.7) * np.cos(mid * 1.3)
+    score = (2.5 + 2.5 * affinity).astype("float32")[:, None]
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main_p, startup):
+        u = layers.data("uid", [B], dtype="int64", append_batch_size=False)
+        m = layers.data("mid", [B], dtype="int64", append_batch_size=False)
+        y = layers.data("score", [B, 1], append_batch_size=False)
+        usr = layers.fc(layers.fc(layers.embedding(u, size=[NU, 32]),
+                                  size=32), size=32, act="tanh",
+                        num_flatten_dims=1)
+        mov = layers.fc(layers.fc(layers.embedding(m, size=[NM, 32]),
+                                  size=32), size=32, act="tanh",
+                        num_flatten_dims=1)
+        sim = layers.cos_sim(usr, mov)
+        pred = layers.scale(sim, scale=5.0)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        optimizer.AdamOptimizer(5e-3).minimize(loss)
+    losses, _, _ = _train(
+        main_p, startup,
+        lambda i: {"uid": uid, "mid": mid, "score": score}, loss, 80)
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------------------
+# 7. label_semantic_roles (Conll05st BiLSTM-CRF,
+#    book/test_label_semantic_roles.py)
+# ---------------------------------------------------------------------------
+
+def test_book_label_semantic_roles_crf():
+    rng = np.random.RandomState(0)
+    V, B, T, NTAG = 30, 8, 10, 5
+    xv = rng.randint(0, V, (B, T)).astype("int64")
+    # learnable tagging rule: tag = word mod NTAG
+    yv = (xv % NTAG).astype("int64")
+    lens = np.array([T, T, T - 2, T - 3, T, T - 1, T, 4], "int64")
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main_p, startup):
+        words = layers.data("words", [B, T], dtype="int64",
+                            append_batch_size=False)
+        ln = layers.data("ln", [B], dtype="int64", append_batch_size=False)
+        tags = layers.data("tags", [B, T], dtype="int64",
+                           append_batch_size=False)
+        emb = layers.embedding(words, size=[V, 32])
+        hidden, _, _ = layers.lstm(emb, 32, lengths=ln)
+        emission = layers.fc(hidden, size=NTAG, num_flatten_dims=2)
+        nll = layers.linear_chain_crf(
+            emission, tags, ln, param_attr=pt.ParamAttr(name="srl_crf"))
+        loss = layers.mean(nll)
+        optimizer.AdamOptimizer(1e-2).minimize(loss)
+
+    test_p = main_p.clone(for_test=True)
+
+    losses, exe, scope = _train(
+        main_p, startup,
+        lambda i: {"words": xv, "ln": lens, "tags": yv}, loss, 120)
+    assert losses[-1] < 0.25 * losses[0], (losses[0], losses[-1])
+
+    # viterbi decode: fetch emissions from the test clone, then run a
+    # decoding-only program whose crf_decoding shares the trained
+    # transition by name (already in scope — its startup is never run)
+    em_vals, = exe.run(test_p, feed={"words": xv, "ln": lens, "tags": yv},
+                       fetch_list=[emission.name], scope=scope)
+    dec_p, dec_start = pt.Program(), pt.Program()
+    dec_start._is_startup = True
+    with pt.program_guard(dec_p, dec_start):
+        e = layers.data("e", [B, T, NTAG], append_batch_size=False)
+        ln2 = layers.data("ln", [B], dtype="int64",
+                          append_batch_size=False)
+        path = layers.crf_decoding(
+            e, ln2, param_attr=pt.ParamAttr(name="srl_crf"))
+    got, = exe.run(dec_p, feed={"e": np.asarray(em_vals), "ln": lens},
+                   fetch_list=[path], scope=scope)
+    got = np.asarray(got)
+    # tag accuracy over valid positions must beat chance by a wide margin
+    correct = total = 0
+    for b in range(B):
+        L = int(lens[b])
+        correct += (got[b, :L] == yv[b, :L]).sum()
+        total += L
+    acc = correct / total
+    assert acc > 0.8, f"viterbi tag accuracy {acc:.2f}"

@@ -988,6 +988,11 @@ SKIP = {
     "beam_search": "tests/test_beam_search.py (finished semantics)",
     "beam_search_decode": "tests/test_beam_search.py (padding/lengths)",
     "gather_tree": "tests/test_beam_search.py (vs reference loop)",
+    "linear_chain_crf": "tests/test_crf_ctc.py (brute-force + finite diff)",
+    "crf_decoding": "tests/test_crf_ctc.py (viterbi vs brute force)",
+    "warpctc": "tests/test_crf_ctc.py (alignment enum + finite diff)",
+    "nce": "tests/test_crf_ctc.py (word2vec training smoke)",
+    "hierarchical_sigmoid": "tests/test_crf_ctc.py (manual tree ref)",
     # amp machinery: inf-recovery trajectories
     "check_finite_and_unscale": "tests/test_round2_fixes.py (amp)",
     "update_loss_scaling": "tests/test_round2_fixes.py (amp)",
