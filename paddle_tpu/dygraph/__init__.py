@@ -8,7 +8,8 @@ from .base import (guard, enabled, enable_dygraph, disable_dygraph,  # noqa
 from .layers import Layer, Sequential, LayerList, ParameterList  # noqa
 from .varbase import VarBase, ParamBase  # noqa
 from .nn import (Linear, Conv2D, Pool2D, BatchNorm, LayerNorm,  # noqa
-                 Embedding, Dropout, GroupNorm, Flatten)
+                 Embedding, Dropout, GroupNorm, Flatten,
+                 SpectralNorm)
 from .parallel import (DataParallel, ParallelEnv, prepare_context,  # noqa
                        ParallelStrategy)
 from .jit import declarative, dygraph_to_static_func, TracedLayer  # noqa

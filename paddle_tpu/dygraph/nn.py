@@ -258,9 +258,44 @@ class GroupNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, *a, **kw):
+    """Spectral normalization of a weight tensor (reference
+    dygraph.SpectralNorm / operators/spectral_norm_op.cc): divides the
+    weight by its largest singular value, estimated by power iteration
+    from persistable u/v vectors."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
         super().__init__()
-        raise NotImplementedError("SpectralNorm: planned")
+        from ..framework.layer_helper import LayerHelper
+        from ..framework.initializer import NormalInitializer
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        helper = LayerHelper("spectral_norm")
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        self.weight_u = helper.create_parameter(
+            None, [h], dtype, default_initializer=NormalInitializer(0, 1))
+        self.weight_u.trainable = False
+        self.weight_v = helper.create_parameter(
+            None, [w], dtype, default_initializer=NormalInitializer(0, 1))
+        self.weight_v.trainable = False
+
+    def forward(self, weight):
+        from ..framework.layer_helper import LayerHelper
+        helper = LayerHelper("spectral_norm")
+        out = helper.create_variable_for_type_inference(weight.dtype)
+        helper.append_op(
+            "spectral_norm",
+            inputs={"Weight": [weight], "U": [self.weight_u],
+                    "V": [self.weight_v]},
+            outputs={"Out": [out]},
+            attrs={"dim": self._dim, "power_iters": self._power_iters,
+                   "eps": self._eps})
+        return out
 
 
 class Flatten(Layer):

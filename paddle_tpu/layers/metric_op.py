@@ -26,5 +26,33 @@ def accuracy(input, label, k=1, correct=None, total=None):
 
 def auc(input, label, curve="ROC", num_thresholds=200, topk=1,
         slide_steps=1):
-    raise NotImplementedError(
-        "auc metric: use paddle_tpu.metric.Auc (host-side) instead")
+    """Streaming AUC graph op (reference layers.auc /
+    operators/metrics/auc_op.cc).
+
+    input: [B, 2] probabilities (column 1 = positive class); label
+    [B, 1] int64. Creates persistable StatPos/StatNeg bucket tensors
+    [num_thresholds+1] that accumulate across runs (the graph-op
+    counterpart of the host-side paddle_tpu.metric.Auc).
+    Returns (auc_out, stat_pos, stat_neg).
+    """
+    from ..framework.initializer import ConstantInitializer
+    from ..framework.layer_helper import ParamAttr
+
+    helper = LayerHelper("auc")
+    stat_pos = helper.create_parameter(
+        ParamAttr(name=f"{helper.name}.stat_pos", trainable=False),
+        [num_thresholds + 1], "int64",
+        default_initializer=ConstantInitializer(0))
+    stat_neg = helper.create_parameter(
+        ParamAttr(name=f"{helper.name}.stat_neg", trainable=False),
+        [num_thresholds + 1], "int64",
+        default_initializer=ConstantInitializer(0))
+    auc_out = helper.create_variable_for_type_inference("float64")
+    helper.append_op(
+        "auc",
+        inputs={"Predict": [input], "Label": [label],
+                "StatPos": [stat_pos], "StatNeg": [stat_neg]},
+        outputs={"AUC": [auc_out], "StatPosOut": [stat_pos],
+                 "StatNegOut": [stat_neg]},
+        attrs={"curve": curve, "num_thresholds": num_thresholds})
+    return auc_out, stat_pos, stat_neg

@@ -22,7 +22,8 @@ __all__ = [
     "soft_relu", "log_loss", "clip", "clip_by_norm", "mean", "pad",
     "adaptive_pool2d", "flash_attention", "flash_attention_qkv",
     "rms_norm", "rope", "linear_chain_crf", "crf_decoding", "warpctc",
-    "nce", "hsigmoid",
+    "nce", "hsigmoid", "conv3d", "pool3d", "lrn", "row_conv",
+    "shuffle_channel", "temporal_shift", "multiplex",
     "silu", "mish",
     "exp", "log", "sqrt", "square", "reciprocal", "softplus",
     "softsign", "sin", "cos", "erf", "ceil", "floor", "round", "abs",
@@ -671,18 +672,14 @@ def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
           data_format="NCHW", name=None):
     """reference layers/nn.py pad2d: [top, bottom, left, right] on the
     spatial dims of NCHW."""
-    t_, b_, l_, r_ = paddings
-    if data_format == "NCHW":
-        full_pads = [0, 0, 0, 0, t_, b_, l_, r_]
-    else:
-        full_pads = [0, 0, t_, b_, l_, r_, 0, 0]
+    if data_format != "NCHW":
+        raise ValueError("pad2d: NHWC not supported; transpose first")
     helper = LayerHelper("pad2d", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
-    helper.append_op("pad", inputs={"X": [input]},
+    helper.append_op("pad2d", inputs={"X": [input]},
                      outputs={"Out": [out]},
-                     attrs={"paddings": full_pads,
-                            "pad_value": float(pad_value),
-                            "mode": mode})
+                     attrs={"paddings": list(paddings), "mode": mode,
+                            "pad_value": float(pad_value)})
     return out
 
 
@@ -865,4 +862,104 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
     helper.append_op("hierarchical_sigmoid", inputs=inputs,
                      outputs={"Out": [out]},
                      attrs={"num_classes": int(num_classes)})
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None):
+    """reference layers.conv3d (NCDHW, OIDHW filters)."""
+    helper = LayerHelper("conv3d", name=name)
+    trip = (lambda v: list(v) if isinstance(v, (list, tuple))
+            else [v] * 3)
+    fs = trip(filter_size)
+    c_in = input.shape[1]
+    fan_in = (c_in // groups) * fs[0] * fs[1] * fs[2]
+    w = helper.create_parameter(
+        param_attr, [num_filters, c_in // groups] + fs, input.dtype,
+        default_initializer=NormalInitializer(0.0, (2.0 / fan_in) ** 0.5))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("conv3d",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": trip(stride),
+                            "paddings": trip(padding),
+                            "dilations": trip(dilation),
+                            "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        pre = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", inputs={"X": [out], "Y": [b]},
+                         outputs={"Out": [pre]}, attrs={"axis": 1})
+    else:
+        pre = out
+    return helper.append_activation(pre, act)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, name=None):
+    """reference layers.pool3d (NCDHW)."""
+    helper = LayerHelper("pool3d", name=name)
+    trip = (lambda v: list(v) if isinstance(v, (list, tuple))
+            else [v] * 3)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("pool3d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type,
+                            "ksize": trip(pool_size),
+                            "strides": trip(pool_stride),
+                            "paddings": trip(pool_padding),
+                            "global_pooling": global_pooling})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    """reference layers.lrn."""
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("lrn", inputs={"X": [input]},
+                     outputs={"Out": [out], "MidOut": [mid]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, name=None):
+    """reference layers.row_conv (padded [B, T, D] convention)."""
+    helper = LayerHelper("row_conv", name=name)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(param_attr,
+                                [future_context_size + 1, d], input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("row_conv",
+                     inputs={"X": [input], "Filter": [w]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def shuffle_channel(x, group, name=None):
+    helper = LayerHelper("shuffle_channel", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("shuffle_channel", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"group": group})
+    return out
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    helper = LayerHelper("temporal_shift", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("temporal_shift", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"seg_num": seg_num,
+                            "shift_ratio": shift_ratio})
+    return out
+
+
+def multiplex(inputs, index, name=None):
+    helper = LayerHelper("multiplex", name=name)
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op("multiplex",
+                     inputs={"X": list(inputs), "Ids": [index]},
+                     outputs={"Out": [out]})
     return out

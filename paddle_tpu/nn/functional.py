@@ -23,10 +23,20 @@ def linear(x, weight, bias=None):
 
 
 def normalize(x, p=2, axis=1, epsilon=1e-12):
-    if p != 2:
-        raise NotImplementedError("normalize: only p=2 is implemented")
     from .. import layers
-    return layers.l2_normalize(x, axis=axis, epsilon=epsilon)
+    if p == 2:
+        return layers.l2_normalize(x, axis=axis, epsilon=epsilon)
+    # general Lp: x / max(sum(|x|^p)^(1/p), eps)
+    absx = layers.abs(x)
+    powed = layers.elementwise_pow(
+        absx, layers.fill_constant([1], x.dtype or "float32", float(p)))
+    norm = layers.reduce_sum(powed, dim=axis, keep_dim=True)
+    norm = layers.elementwise_pow(
+        norm, layers.fill_constant([1], x.dtype or "float32", 1.0 / p))
+    norm = layers.elementwise_max(
+        norm, layers.fill_constant([1], x.dtype or "float32",
+                                   float(epsilon)))
+    return layers.elementwise_div(x, norm)
 
 
 def binary_cross_entropy_with_logits(logit, label):

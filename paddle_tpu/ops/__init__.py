@@ -21,6 +21,8 @@ from . import quant_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import beam_ops  # noqa: F401
 from . import crf_ctc_ops  # noqa: F401
+from . import misc_ops  # noqa: F401
+from . import nn_extra_ops  # noqa: F401
 from . import linalg_ops  # noqa: F401
 from .registry import (LowerContext, all_registered_ops, get_op_def,  # noqa
                        has_op, register_op)

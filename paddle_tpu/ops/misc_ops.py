@@ -1,0 +1,591 @@
+"""Long-tail math / loss / tensor ops.
+
+Reference analogs: one .cc each under paddle/fluid/operators/ (addmm_op,
+allclose_op, mv_op, minus_op, l1_norm_op, squared_l2_distance_op,
+hinge_loss_op, modified_huber_loss_op, margin_rank_loss_op, rank_loss_op,
+bpr_loss_op, teacher_student_sigmoid_loss_op, nll_loss_op, selu_op,
+size_op, shard_index_op, multiplex_op, unbind_op, reverse_op, cos_sim_op,
+log_loss_op, sampling_id_op, fill_constant_batch_size_like_op,
+uniform/gaussian_random_batch_size_like_op, mean_iou_op, edit_distance_op,
+add_position_encoding_op, center_loss_op, empty_op, is_empty_op, fill_op,
+unique_with_counts_op, conv_shift_op, cvm_op, where_index analog).
+Each is a direct jnp/lax lowering — the reference's per-op CUDA kernels
+and Eigen functors collapse to XLA-fused expressions.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import in_var, register_op, same_as_input, set_out
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _first_out(shape, dtype="float32"):
+    def infer(op, block):
+        set_out(op, block, "Out", shape, dtype)
+    return infer
+
+
+# ---------------------------------------------------------------------------
+# math
+# ---------------------------------------------------------------------------
+
+@register_op("addmm", infer=lambda op, block: set_out(
+    op, block, "Out",
+    (in_var(op, block, "X").shape[0], in_var(op, block, "Y").shape[1]),
+    in_var(op, block, "X").dtype))
+def _addmm(ctx, op):
+    jnp = _jnp()
+    inp = ctx.get_input(op, "Input")
+    x, y = ctx.get_input(op, "X"), ctx.get_input(op, "Y")
+    ctx.set_output(op, "Out", op.attr("Beta", 1.0) * inp
+                   + op.attr("Alpha", 1.0) * (x @ y))
+
+
+@register_op("mv", infer=lambda op, block: set_out(
+    op, block, "Out", (in_var(op, block, "X").shape[0],),
+    in_var(op, block, "X").dtype))
+def _mv(ctx, op):
+    ctx.set_output(op, "Out",
+                   ctx.get_input(op, "X") @ ctx.get_input(op, "Vec"))
+
+
+@register_op("minus", infer=same_as_input())
+def _minus(ctx, op):
+    ctx.set_output(op, "Out",
+                   ctx.get_input(op, "X") - ctx.get_input(op, "Y"))
+
+
+@register_op("allclose", infer=lambda op, block: set_out(
+    op, block, "Out", (), "bool"), grad=None)
+def _allclose(ctx, op):
+    jnp = _jnp()
+    ctx.set_output(op, "Out", jnp.allclose(
+        ctx.get_input(op, "Input"), ctx.get_input(op, "Other"),
+        rtol=float(op.attr("rtol", 1e-5)),
+        atol=float(op.attr("atol", 1e-8)),
+        equal_nan=op.attr("equal_nan", False)))
+
+
+@register_op("l1_norm", infer=lambda op, block: set_out(
+    op, block, "Out", (), in_var(op, block, "X").dtype))
+def _l1_norm(ctx, op):
+    jnp = _jnp()
+    ctx.set_output(op, "Out", jnp.abs(ctx.get_input(op, "X")).sum())
+
+
+@register_op("squared_l2_distance", infer=lambda op, block: (
+    set_out(op, block, "sub_result", in_var(op, block, "X").shape,
+            in_var(op, block, "X").dtype),
+    set_out(op, block, "Out", (in_var(op, block, "X").shape[0], 1),
+            in_var(op, block, "X").dtype)))
+def _squared_l2_distance(ctx, op):
+    jnp = _jnp()
+    d = ctx.get_input(op, "X") - ctx.get_input(op, "Y")
+    ctx.set_output(op, "sub_result", d)
+    ctx.set_output(op, "Out",
+                   (d * d).reshape(d.shape[0], -1).sum(1, keepdims=True))
+
+
+@register_op("size", infer=lambda op, block: set_out(
+    op, block, "Out", (), "int64"), grad=None)
+def _size(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    ctx.set_output(op, "Out", jnp.asarray(int(np.prod(x.shape)) if x.ndim
+                                          else 1, "int64"))
+
+
+@register_op("shard_index", infer=same_as_input(), grad=None)
+def _shard_index(ctx, op):
+    """id -> id % shard_size if it lands in this shard else ignore_value
+    (reference shard_index_op.cc, PS sharded embedding lookup)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    index_num = op.attr("index_num")
+    nshards = op.attr("nshards")
+    shard_id = op.attr("shard_id")
+    ignore = op.attr("ignore_value", -1)
+    size = (index_num + nshards - 1) // nshards
+    ctx.set_output(op, "Out", jnp.where(x // size == shard_id, x % size,
+                                        ignore))
+
+
+@register_op("multiplex", infer=lambda op, block: set_out(
+    op, block, "Out", in_var(op, block, "X").shape,
+    in_var(op, block, "X").dtype))
+def _multiplex(ctx, op):
+    """Row-wise select among candidate tensors by index
+    (reference multiplex_op.cc)."""
+    jnp = _jnp()
+    xs = ctx.get_inputs(op, "X")
+    ids = ctx.get_input(op, "Ids").reshape(-1).astype("int32")
+    stacked = jnp.stack(xs, axis=0)            # [C, B, ...]
+    ctx.set_output(op, "Out", stacked[ids, jnp.arange(stacked.shape[1])])
+
+
+def _unbind_infer(op, block):
+    x = in_var(op, block, "X")
+    axis = op.attr("axis", 0)
+    shape = list(x.shape)
+    del shape[axis]
+    set_out(op, block, "Out", shape, x.dtype)
+
+
+@register_op("unbind", infer=_unbind_infer)
+def _unbind(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    axis = op.attr("axis", 0)
+    outs = [jnp.squeeze(s, axis) for s in
+            jnp.split(x, x.shape[axis], axis=axis)]
+    ctx.set_outputs(op, "Out", outs)
+
+
+@register_op("reverse", infer=same_as_input())
+def _reverse(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", jnp.flip(x, axis=tuple(op.attr("axis"))))
+
+
+@register_op("cos_sim", infer=lambda op, block: set_out(
+    op, block, "Out", (in_var(op, block, "X").shape[0], 1),
+    in_var(op, block, "X").dtype))
+def _cos_sim(ctx, op):
+    jnp = _jnp()
+    x, y = ctx.get_input(op, "X"), ctx.get_input(op, "Y")
+    xn = jnp.sqrt((x * x).sum(-1, keepdims=True) + 1e-12)
+    yn = jnp.sqrt((y * y).sum(-1, keepdims=True) + 1e-12)
+    ctx.set_output(op, "Out", (x * y).sum(-1, keepdims=True) / (xn * yn))
+
+
+@register_op("log_loss", infer=same_as_input("Predicted", "Loss"))
+def _log_loss(ctx, op):
+    jnp = _jnp()
+    p = ctx.get_input(op, "Predicted")
+    y = ctx.get_input(op, "Labels")
+    eps = op.attr("epsilon", 1e-4)
+    ctx.set_output(op, "Loss", -y * jnp.log(p + eps)
+                   - (1 - y) * jnp.log(1 - p + eps))
+
+
+@register_op("selu", infer=same_as_input())
+def _selu(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    scale = op.attr("scale", 1.0507009873554805)
+    alpha = op.attr("alpha", 1.6732632423543772)
+    ctx.set_output(op, "Out", scale * jnp.where(
+        x > 0, x, alpha * (jnp.exp(x) - 1)))
+
+
+@register_op("conv_shift", infer=same_as_input())
+def _conv_shift(ctx, op):
+    """Circular convolution (reference conv_shift_op.cc): x [B, M],
+    y [B, N] (N odd, N<=M); out[b,i] = sum_j x[b,(i+j-N//2) % M]*y[b,j]."""
+    jnp = _jnp()
+    x, y = ctx.get_input(op, "X"), ctx.get_input(op, "Y")
+    B, M = x.shape
+    N = y.shape[1]
+    half = N // 2
+    idx = (jnp.arange(M)[:, None] + jnp.arange(N)[None, :] - half) % M
+    ctx.set_output(op, "Out",
+                   jnp.einsum("bmn,bn->bm", x[:, idx], y))
+
+
+@register_op("add_position_encoding", infer=same_as_input())
+def _add_position_encoding(ctx, op):
+    """Sinusoidal position encoding added in-place
+    (reference add_position_encoding_op.cc)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                 # [B, T, D]
+    alpha = op.attr("alpha", 1.0)
+    beta = op.attr("beta", 1.0)
+    B, T, D = x.shape
+    half = D // 2
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    enc = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    ctx.set_output(op, "Out", alpha * x + beta * enc[None].astype(x.dtype))
+
+
+def _cvm_infer(op, block):
+    x = in_var(op, block, "X")
+    cols = x.shape[1] if op.attr("use_cvm", True) else x.shape[1] - 2
+    set_out(op, block, "Y", (x.shape[0], cols), x.dtype)
+
+
+@register_op("cvm", infer=_cvm_infer)
+def _cvm(ctx, op):
+    """Continuous-value model feature transform (reference cvm_op.cc):
+    the leading two columns (show, click) become [log(show+1),
+    log(click+1) - log(show+1)]; use_cvm=False drops them."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    use_cvm = op.attr("use_cvm", True)
+    show = jnp.log(x[:, :1] + 1)
+    click = jnp.log(x[:, 1:2] + 1) - show
+    if use_cvm:
+        out = jnp.concatenate([show, click, x[:, 2:]], axis=1)
+    else:
+        out = x[:, 2:]
+    ctx.set_output(op, "Y", out)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+@register_op("hinge_loss", infer=same_as_input("Logits", "Loss"))
+def _hinge_loss(ctx, op):
+    jnp = _jnp()
+    logits = ctx.get_input(op, "Logits")
+    labels = ctx.get_input(op, "Labels")
+    ctx.set_output(op, "Loss", jnp.maximum(
+        0.0, 1.0 - (2.0 * labels - 1.0) * logits))
+
+
+@register_op("modified_huber_loss", infer=lambda op, block: (
+    set_out(op, block, "IntermediateVal", in_var(op, block, "X").shape,
+            in_var(op, block, "X").dtype),
+    set_out(op, block, "Out", in_var(op, block, "X").shape,
+            in_var(op, block, "X").dtype)))
+def _modified_huber_loss(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    z = (2.0 * y - 1.0) * x
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, (1.0 - z) ** 2, 0.0))
+    ctx.set_output(op, "IntermediateVal", z)
+    ctx.set_output(op, "Out", loss)
+
+
+@register_op("margin_rank_loss", infer=lambda op, block: (
+    set_out(op, block, "Activated", in_var(op, block, "X1").shape,
+            in_var(op, block, "X1").dtype),
+    set_out(op, block, "Out", in_var(op, block, "X1").shape,
+            in_var(op, block, "X1").dtype)))
+def _margin_rank_loss(ctx, op):
+    jnp = _jnp()
+    x1, x2 = ctx.get_input(op, "X1"), ctx.get_input(op, "X2")
+    label = ctx.get_input(op, "Label")
+    margin = op.attr("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    ctx.set_output(op, "Activated", (out > 0).astype(x1.dtype))
+    ctx.set_output(op, "Out", out)
+
+
+@register_op("rank_loss", infer=lambda op, block: set_out(
+    op, block, "Out", in_var(op, block, "Left").shape,
+    in_var(op, block, "Left").dtype))
+def _rank_loss(ctx, op):
+    import jax
+    left = ctx.get_input(op, "Left")
+    right = ctx.get_input(op, "Right")
+    label = ctx.get_input(op, "Label")
+    d = left - right
+    ctx.set_output(op, "Out",
+                   jax.nn.softplus(d) - label * d)
+
+
+@register_op("bpr_loss", infer=lambda op, block: set_out(
+    op, block, "Y", (in_var(op, block, "X").shape[0], 1),
+    in_var(op, block, "X").dtype))
+def _bpr_loss(ctx, op):
+    """Bayesian personalized ranking (reference bpr_loss_op.cc):
+    -mean_j log sigmoid(x_label - x_j), j != label."""
+    import jax
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                 # [B, C]
+    label = ctx.get_input(op, "Label").reshape(-1).astype("int32")
+    B, C = x.shape
+    pos = jnp.take_along_axis(x, label[:, None], axis=1)    # [B, 1]
+    mask = jnp.arange(C)[None, :] != label[:, None]
+    losses = jax.nn.softplus(-(pos - x)) * mask
+    ctx.set_output(op, "Y", losses.sum(1, keepdims=True) / (C - 1))
+
+
+@register_op("teacher_student_sigmoid_loss", infer=lambda op, block:
+             set_out(op, block, "Y",
+                     (in_var(op, block, "X").shape[0], 1),
+                     in_var(op, block, "X").dtype))
+def _ts_sigmoid_loss(ctx, op):
+    """reference teacher_student_sigmoid_loss_op.cc: CTR distillation —
+    label < -1 pure teacher, -1<=label<0 binary, else mixed."""
+    import jax
+    jnp = _jnp()
+    x = ctx.get_input(op, "X").reshape(-1)
+    label = ctx.get_input(op, "Label").reshape(-1)
+    sp = jax.nn.softplus
+    teacher = label + 2.0
+    binary = jnp.where(label < -0.5, 0.0, 1.0)
+    out = jnp.where(
+        label < -1.0, sp(x) - x * teacher,
+        jnp.where(label < 0.0, sp(x) - x * binary,
+                  sp(x) - x * jnp.clip(label, 0.0, 1.0)
+                  + sp(x) - x * jnp.where(label > 0, 1.0, 0.0)))
+    ctx.set_output(op, "Y", out[:, None])
+
+
+@register_op("nll_loss", infer=lambda op, block: (
+    set_out(op, block, "Out",
+            () if op.attr("reduction", "mean") != "none"
+            else (in_var(op, block, "X").shape[0],),
+            in_var(op, block, "X").dtype),
+    set_out(op, block, "Total_weight", (),
+            in_var(op, block, "X").dtype)))
+def _nll_loss(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                 # [B, C] log-probs
+    label = ctx.get_input(op, "Label").reshape(-1).astype("int32")
+    w = ctx.get_input(op, "Weight") if op.single_input("Weight") else None
+    ignore = op.attr("ignore_index", -100)
+    reduction = op.attr("reduction", "mean")
+    picked = -jnp.take_along_axis(x, label[:, None], axis=1)[:, 0]
+    wt = w[label] if w is not None else jnp.ones_like(picked)
+    keep = (label != ignore)
+    picked = jnp.where(keep, picked * wt, 0.0)
+    total_w = jnp.where(keep, wt, 0.0).sum()
+    if reduction == "none":
+        out = picked
+    elif reduction == "sum":
+        out = picked.sum()
+    else:
+        out = picked.sum() / jnp.maximum(total_w, 1e-12)
+    ctx.set_output(op, "Out", out)
+    ctx.set_output(op, "Total_weight", total_w)
+
+
+@register_op("center_loss", infer=lambda op, block: (
+    set_out(op, block, "Loss", (in_var(op, block, "X").shape[0], 1),
+            in_var(op, block, "X").dtype),
+    set_out(op, block, "SampleCenterDiff", in_var(op, block, "X").shape,
+            in_var(op, block, "X").dtype),
+    set_out(op, block, "CentersOut",
+            in_var(op, block, "Centers").shape,
+            in_var(op, block, "Centers").dtype)),
+    stateful_outputs=("CentersOut",))
+def _center_loss(ctx, op):
+    """reference center_loss_op.cc: pull features toward class centers;
+    centers update by averaged per-class diffs (update=True)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                 # [B, D]
+    label = ctx.get_input(op, "Label").reshape(-1).astype("int32")
+    centers = ctx.get_input(op, "Centers")     # [C, D]
+    lr = ctx.get_input(op, "CenterUpdateRate").reshape(())
+    diff = x - centers[label]
+    ctx.set_output(op, "SampleCenterDiff", diff)
+    ctx.set_output(op, "Loss", 0.5 * (diff * diff).sum(1, keepdims=True))
+    if op.attr("need_update", True):
+        import jax
+        counts = jnp.zeros((centers.shape[0],)).at[label].add(1.0)
+        sums = jnp.zeros_like(centers).at[label].add(diff)
+        upd = sums / (1.0 + counts)[:, None]
+        ctx.set_output(op, "CentersOut", centers + lr * upd)
+    else:
+        ctx.set_output(op, "CentersOut", centers)
+
+
+# ---------------------------------------------------------------------------
+# tensor creation / shape-like
+# ---------------------------------------------------------------------------
+
+def _batch_size_like_infer(out_slot):
+    def infer(op, block):
+        x = in_var(op, block, "Input")
+        shape = list(op.attr("shape"))
+        in_idx = op.attr("input_dim_idx", 0)
+        out_idx = op.attr("output_dim_idx", 0)
+        shape[out_idx] = x.shape[in_idx]
+        set_out(op, block, out_slot, shape, _creation_dtype(op))
+    return infer
+
+
+def _creation_dtype(op):
+    """Creation-op dtype attr: the repo convention is "dtype"
+    (fill_constant/range/linspace); "dtype_str" accepted as an alias."""
+    return op.attr("dtype", None) or op.attr("dtype_str", None) \
+        or "float32"
+
+
+@register_op("fill_constant_batch_size_like",
+             infer=_batch_size_like_infer("Out"), grad=None)
+def _fill_constant_bsl(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    shape = list(op.attr("shape"))
+    shape[op.attr("output_dim_idx", 0)] = x.shape[op.attr("input_dim_idx",
+                                                          0)]
+    ctx.set_output(op, "Out",
+                   jnp.full(shape, op.attr("value", 0.0),
+                            _creation_dtype(op)))
+
+
+@register_op("uniform_random_batch_size_like",
+             infer=_batch_size_like_infer("Out"), grad=None)
+def _uniform_random_bsl(ctx, op):
+    import jax
+    x = ctx.get_input(op, "Input")
+    shape = list(op.attr("shape"))
+    shape[op.attr("output_dim_idx", 0)] = x.shape[op.attr("input_dim_idx",
+                                                          0)]
+    ctx.set_output(op, "Out", jax.random.uniform(
+        ctx.rng(op), shape, minval=op.attr("min", -1.0),
+        maxval=op.attr("max", 1.0)))
+
+
+@register_op("gaussian_random_batch_size_like",
+             infer=_batch_size_like_infer("Out"), grad=None)
+def _gaussian_random_bsl(ctx, op):
+    import jax
+    x = ctx.get_input(op, "Input")
+    shape = list(op.attr("shape"))
+    shape[op.attr("output_dim_idx", 0)] = x.shape[op.attr("input_dim_idx",
+                                                          0)]
+    ctx.set_output(op, "Out", op.attr("mean", 0.0)
+                   + op.attr("std", 1.0)
+                   * jax.random.normal(ctx.rng(op), shape))
+
+
+@register_op("empty", infer=lambda op, block: set_out(
+    op, block, "Out", op.attr("shape"), _creation_dtype(op)),
+    grad=None)
+def _empty(ctx, op):
+    jnp = _jnp()
+    ctx.set_output(op, "Out", jnp.zeros(
+        op.attr("shape"), _creation_dtype(op)))
+
+
+@register_op("fill", infer=lambda op, block: set_out(
+    op, block, "Out", op.attr("shape"), _creation_dtype(op)),
+    grad=None)
+def _fill(ctx, op):
+    jnp = _jnp()
+    ctx.set_output(op, "Out", jnp.asarray(
+        np.array(op.attr("value"), dtype="float64").reshape(
+            op.attr("shape")), _creation_dtype(op)))
+
+
+@register_op("is_empty", infer=lambda op, block: set_out(
+    op, block, "Out", (), "bool"), grad=None)
+def _is_empty(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", jnp.asarray(int(np.prod(x.shape)) == 0))
+
+
+@register_op("sampling_id", infer=lambda op, block: set_out(
+    op, block, "Out", (in_var(op, block, "X").shape[0],), "int64"),
+    grad=None)
+def _sampling_id(ctx, op):
+    """Sample one class index per row from a probability matrix
+    (reference sampling_id_op.cc)."""
+    import jax
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    ids = jax.random.categorical(ctx.rng(op), jnp.log(x + 1e-20), axis=1)
+    ctx.set_output(op, "Out", ids.astype("int64"))
+
+
+# ---------------------------------------------------------------------------
+# metrics-adjacent
+# ---------------------------------------------------------------------------
+
+@register_op("mean_iou", infer=lambda op, block: (
+    set_out(op, block, "OutMeanIou", (), "float32"),
+    set_out(op, block, "OutWrong", (op.attr("num_classes"),), "int32"),
+    set_out(op, block, "OutCorrect", (op.attr("num_classes"),), "int32")),
+    grad=None)
+def _mean_iou(ctx, op):
+    jnp = _jnp()
+    pred = ctx.get_input(op, "Predictions").reshape(-1).astype("int32")
+    label = ctx.get_input(op, "Labels").reshape(-1).astype("int32")
+    C = op.attr("num_classes")
+    correct = jnp.zeros((C,), "int32").at[jnp.where(
+        pred == label, pred, C - 1)].add(
+        (pred == label).astype("int32"))
+    # wrong counts: union minus intersection per class
+    pred_c = jnp.zeros((C,), "int32").at[pred].add(1)
+    label_c = jnp.zeros((C,), "int32").at[label].add(1)
+    inter = correct
+    union = pred_c + label_c - inter
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1), 0.0)
+    present = (union > 0).sum()
+    ctx.set_output(op, "OutMeanIou",
+                   (iou.sum() / jnp.maximum(present, 1)).astype("float32"))
+    ctx.set_output(op, "OutWrong", (union - inter).astype("int32"))
+    ctx.set_output(op, "OutCorrect", inter.astype("int32"))
+
+
+@register_op("edit_distance", infer=lambda op, block: (
+    set_out(op, block, "Out",
+            (in_var(op, block, "Hyps").shape[0], 1), "float32"),
+    set_out(op, block, "SequenceNum", (), "int64")), grad=None)
+def _edit_distance(ctx, op):
+    """Levenshtein distance per row (reference edit_distance_op.cc),
+    padded [B, L] + lengths; DP over a lax.scan on the shorter axis."""
+    import jax
+    jnp = _jnp()
+    hyp = ctx.get_input(op, "Hyps").astype("int32")
+    ref = ctx.get_input(op, "Refs").astype("int32")
+    hyp_len = ctx.get_input(op, "HypsLength").reshape(-1)
+    ref_len = ctx.get_input(op, "RefsLength").reshape(-1)
+    B, H = hyp.shape
+    Rl = ref.shape[1]
+
+    # row[j] = distance(hyp[:i], ref[:j]); scan over hyp positions
+    init = jnp.broadcast_to(jnp.arange(Rl + 1, dtype=jnp.float32),
+                            (B, Rl + 1))
+
+    def body(row, i):
+        h_i = hyp[:, i]                                     # [B]
+        sub_cost = (ref != h_i[:, None]).astype(jnp.float32)  # [B, Rl]
+
+        def inner(carry, j):
+            prev_row_jm1 = row[:, j]
+            prev_row_j = row[:, j + 1]
+            left = carry
+            val = jnp.minimum(jnp.minimum(prev_row_j + 1, left + 1),
+                              prev_row_jm1 + sub_cost[:, j])
+            return val, val
+
+        first = row[:, 0] + 1
+        _, rest = jax.lax.scan(inner, first, jnp.arange(Rl))
+        new_row = jnp.concatenate([first[None], rest], axis=0).T
+        # positions past hyp_len keep the old row
+        alive = (i < hyp_len)
+        return jnp.where(alive[:, None], new_row, row), None
+
+    row, _ = jax.lax.scan(body, init, jnp.arange(H))
+    d = jnp.take_along_axis(row, ref_len[:, None].astype("int32"),
+                            axis=1)[:, 0]
+    if op.attr("normalized", False):
+        d = d / jnp.maximum(ref_len.astype(jnp.float32), 1.0)
+    ctx.set_output(op, "Out", d[:, None].astype("float32"))
+    ctx.set_output(op, "SequenceNum", jnp.asarray(B, "int64"))
+
+
+@register_op("unique_with_counts", infer=lambda op, block: (
+    set_out(op, block, "Out", in_var(op, block, "X").shape,
+            in_var(op, block, "X").dtype),
+    set_out(op, block, "Index", in_var(op, block, "X").shape, "int64"),
+    set_out(op, block, "Count", in_var(op, block, "X").shape, "int64")),
+    grad=None)
+def _unique_with_counts(ctx, op):
+    """Fixed-shape unique (XLA static-shape contract, like the repo's
+    `unique`): Out is padded with the first unique value; Index maps
+    each input element to its slot in Out; Count is per-slot."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X").reshape(-1)
+    uniq, idx, counts = (
+        jnp.unique(x, return_inverse=True, return_counts=True,
+                   size=x.shape[0]))
+    ctx.set_output(op, "Out", uniq)
+    ctx.set_output(op, "Index", idx.reshape(-1).astype("int64"))
+    ctx.set_output(op, "Count", counts.astype("int64"))

@@ -1,0 +1,703 @@
+"""NN long-tail ops: 3D conv/pool, pads, lrn, data_norm, spectral_norm,
+deformable conv, psroi_pool, and friends.
+
+Reference analogs under paddle/fluid/operators/: conv_op.cc (3D),
+conv_transpose_op.cc, pool_op.cc (3D), pad2d_op.cc, pad3d_op.cc,
+lrn_op.cc, data_norm_op.cc, spectral_norm_op.cc, deformable_conv_op.cu,
+deformable_psroi_pooling_op.cu, psroi_pool_op.cc, unpool_op.cc,
+spp_op.cc, temporal_shift_op.cc, shuffle_channel_op.cc, row_conv_op.cc,
+im2sequence_op.cc, bilinear_tensor_product_op.cc, fsp_op.cc,
+partial_concat_op.cc, partial_sum_op.cc, gru_unit_op.cc,
+lstm_unit_op.cc, segment_pool (incubate), metrics/auc_op.cc.
+TPU-first: everything is a lax conv/reduce_window/gather formulation —
+the reference's cuDNN descriptors and hand-rolled CUDA kernels
+(deformable sampling loops, psroi bin loops) become batched bilinear
+gathers the MXU/VPU consume directly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import in_var, register_op, same_as_input, set_out
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _lax():
+    import jax.lax as lax
+    return lax
+
+
+def _triple(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+
+def _conv_out(i, k, p0, p1, s, d):
+    return (i + p0 + p1 - (d * (k - 1) + 1)) // s + 1
+
+
+# ---------------------------------------------------------------------------
+# conv3d / conv3d_transpose / pool3d
+# ---------------------------------------------------------------------------
+
+def _conv3d_infer(op, block):
+    x = in_var(op, block, "Input")             # [B, C, D, H, W]
+    w = in_var(op, block, "Filter")            # [O, C/g, kd, kh, kw]
+    s = _triple(op.attr("strides", [1, 1, 1]))
+    p = _triple(op.attr("paddings", [0, 0, 0]))
+    d = _triple(op.attr("dilations", [1, 1, 1]))
+    out = [x.shape[0], w.shape[0]] + [
+        _conv_out(x.shape[2 + i], w.shape[2 + i], p[i], p[i], s[i], d[i])
+        for i in range(3)]
+    set_out(op, block, "Output", out, x.dtype)
+
+
+@register_op("conv3d", infer=_conv3d_infer)
+def _conv3d(ctx, op):
+    lax = _lax()
+    x = ctx.get_input(op, "Input")
+    w = ctx.get_input(op, "Filter")
+    s = _triple(op.attr("strides", [1, 1, 1]))
+    p = _triple(op.attr("paddings", [0, 0, 0]))
+    d = _triple(op.attr("dilations", [1, 1, 1]))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=s, padding=[(pi, pi) for pi in p],
+        rhs_dilation=d, feature_group_count=op.attr("groups", 1),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    ctx.set_output(op, "Output", out)
+
+
+def _conv3d_t_infer(op, block):
+    x = in_var(op, block, "Input")
+    w = in_var(op, block, "Filter")            # [C, O/g, kd, kh, kw]
+    s = _triple(op.attr("strides", [1, 1, 1]))
+    p = _triple(op.attr("paddings", [0, 0, 0]))
+    g = op.attr("groups", 1)
+    out = [x.shape[0], w.shape[1] * g] + [
+        (x.shape[2 + i] - 1) * s[i] - 2 * p[i] + w.shape[2 + i]
+        for i in range(3)]
+    set_out(op, block, "Output", out, x.dtype)
+
+
+@register_op("conv3d_transpose", infer=_conv3d_t_infer)
+def _conv3d_transpose(ctx, op):
+    lax = _lax()
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    w = ctx.get_input(op, "Filter")
+    s = _triple(op.attr("strides", [1, 1, 1]))
+    p = _triple(op.attr("paddings", [0, 0, 0]))
+    k = w.shape[2:]
+    pads = [(k[i] - 1 - p[i], k[i] - 1 - p[i]) for i in range(3)]
+    if op.attr("groups", 1) != 1:
+        raise NotImplementedError(
+            "conv3d_transpose: groups > 1 not supported (the flipped "
+            "[O, C, ...] kernel layout is incompatible with "
+            "feature_group_count)")
+    wt = jnp.flip(w, axis=(2, 3, 4)).swapaxes(0, 1)   # [O, C, ...]
+    out = lax.conv_general_dilated(
+        x, wt, window_strides=(1, 1, 1), padding=pads, lhs_dilation=s,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    ctx.set_output(op, "Output", out)
+
+
+def _pool3d_infer(op, block):
+    x = in_var(op, block, "X")
+    if op.attr("global_pooling", False):
+        set_out(op, block, "Out", list(x.shape[:2]) + [1, 1, 1], x.dtype)
+        return
+    k = _triple(op.attr("ksize"))
+    s = _triple(op.attr("strides", [1, 1, 1]))
+    p = _triple(op.attr("paddings", [0, 0, 0]))
+    out = [x.shape[0], x.shape[1]] + [
+        _conv_out(x.shape[2 + i], k[i], p[i], p[i], s[i], 1)
+        for i in range(3)]
+    set_out(op, block, "Out", out, x.dtype)
+
+
+@register_op("pool3d", infer=_pool3d_infer)
+def _pool3d(ctx, op):
+    lax = _lax()
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    ptype = op.attr("pooling_type", "max")
+    if op.attr("global_pooling", False):
+        red = (jnp.max if ptype == "max" else jnp.mean)
+        ctx.set_output(op, "Out", red(x, axis=(2, 3, 4), keepdims=True))
+        return
+    k = _triple(op.attr("ksize"))
+    s = _triple(op.attr("strides", [1, 1, 1]))
+    p = _triple(op.attr("paddings", [0, 0, 0]))
+    dims = (1, 1) + tuple(k)
+    strides = (1, 1) + tuple(s)
+    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    if ptype == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
+    else:
+        out = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+        cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims,
+                                strides, pads)
+        out = out / (cnt if op.attr("exclusive", True)
+                     else float(np.prod(k)))
+    ctx.set_output(op, "Out", out)
+
+
+# ---------------------------------------------------------------------------
+# pad2d / pad3d
+# ---------------------------------------------------------------------------
+
+def _padnd_infer(op, block):
+    x = in_var(op, block, "X")
+    p = op.attr("paddings")
+    shape = list(x.shape)
+    nsp = len(p) // 2
+    for i in range(nsp):
+        # paddings are [d0_lo, d0_hi, d1_lo, d1_hi, ...] over spatial dims
+        shape[2 + i] += p[2 * i] + p[2 * i + 1]
+    set_out(op, block, "Out", shape, x.dtype)
+
+
+def _pad_mode(x, pads, mode, value):
+    jnp = _jnp()
+    if mode == "constant":
+        return jnp.pad(x, pads, constant_values=value)
+    if mode == "reflect":
+        return jnp.pad(x, pads, mode="reflect")
+    if mode == "edge" or mode == "replicate":
+        return jnp.pad(x, pads, mode="edge")
+    if mode == "circular":
+        return jnp.pad(x, pads, mode="wrap")
+    raise ValueError(f"pad mode {mode!r}")
+
+
+@register_op("pad2d", infer=_padnd_infer)
+def _pad2d(ctx, op):
+    x = ctx.get_input(op, "X")                 # NCHW
+    p = op.attr("paddings")                    # [top, bottom, left, right]
+    pads = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    ctx.set_output(op, "Out", _pad_mode(
+        x, pads, op.attr("mode", "constant"),
+        op.attr("pad_value", 0.0)))
+
+
+@register_op("pad3d", infer=_padnd_infer)
+def _pad3d(ctx, op):
+    x = ctx.get_input(op, "X")                 # NCDHW
+    p = op.attr("paddings")    # [front, back, top, bottom, left, right]
+    pads = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3]), (p[4], p[5])]
+    ctx.set_output(op, "Out", _pad_mode(
+        x, pads, op.attr("mode", "constant"), op.attr("value", 0.0)))
+
+
+# ---------------------------------------------------------------------------
+# lrn / data_norm / spectral_norm
+# ---------------------------------------------------------------------------
+
+@register_op("lrn", infer=lambda op, block: (
+    set_out(op, block, "Out", in_var(op, block, "X").shape,
+            in_var(op, block, "X").dtype),
+    set_out(op, block, "MidOut", in_var(op, block, "X").shape,
+            in_var(op, block, "X").dtype)))
+def _lrn(ctx, op):
+    """Local response norm across channels (reference lrn_op.cc):
+    mid = k + alpha * sum_{c-n/2..c+n/2} x^2; out = x / mid^beta."""
+    lax = _lax()
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                 # NCHW
+    n = op.attr("n", 5)
+    k = op.attr("k", 2.0)
+    alpha = op.attr("alpha", 1e-4)
+    beta = op.attr("beta", 0.75)
+    half = n // 2
+    sq = x * x
+    mid = k + alpha * lax.reduce_window(
+        sq, 0.0, lax.add, (1, n, 1, 1), (1, 1, 1, 1),
+        ((0, 0), (half, n - 1 - half), (0, 0), (0, 0)))
+    ctx.set_output(op, "MidOut", mid)
+    ctx.set_output(op, "Out", x / mid ** beta)
+
+
+def _data_norm_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Y", x.shape, x.dtype)
+    set_out(op, block, "Means", (x.shape[-1],), x.dtype)
+    set_out(op, block, "Scales", (x.shape[-1],), x.dtype)
+
+
+@register_op("data_norm", infer=_data_norm_infer)
+def _data_norm(ctx, op):
+    """reference data_norm_op.cc (CTR models): normalize by accumulated
+    batch statistics carried as persistable BatchSize/BatchSum/
+    BatchSquareSum tensors (the optimizer updates them via summary
+    ops in the reference; here the stats are read-only inputs)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    bsz = ctx.get_input(op, "BatchSize")
+    bsum = ctx.get_input(op, "BatchSum")
+    bsq = ctx.get_input(op, "BatchSquareSum")
+    mean = bsum / jnp.maximum(bsz, 1e-4)
+    scale = jnp.sqrt(jnp.maximum(bsz, 1e-4)
+                     / jnp.maximum(bsq - bsum * mean, 1e-4))
+    ctx.set_output(op, "Means", mean)
+    ctx.set_output(op, "Scales", scale)
+    ctx.set_output(op, "Y", (x - mean) * scale)
+
+
+@register_op("spectral_norm", infer=same_as_input("Weight", "Out"))
+def _spectral_norm(ctx, op):
+    """reference spectral_norm_op.cc: weight / sigma_max via power
+    iteration on the [dim-first flattened] weight; U/V are persistable
+    state fed in (updated by the layer's assign in the reference; we
+    run power_iters fresh iterations from them, stop_gradient'd)."""
+    import jax
+    jnp = _jnp()
+    w = ctx.get_input(op, "Weight")
+    u = ctx.get_input(op, "U").reshape(-1)
+    v = ctx.get_input(op, "V").reshape(-1)
+    dim = op.attr("dim", 0)
+    power_iters = op.attr("power_iters", 1)
+    eps = op.attr("eps", 1e-12)
+    perm = [dim] + [i for i in range(w.ndim) if i != dim]
+    wm = jnp.transpose(w, perm).reshape(w.shape[dim], -1)   # [H, W]
+
+    def norm(a):
+        return a / (jnp.linalg.norm(a) + eps)
+
+    for _ in range(max(1, power_iters)):
+        v = norm(wm.T @ u)
+        u = norm(wm @ v)
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    sigma = u @ wm @ v
+    ctx.set_output(op, "Out", w / sigma)
+
+
+# ---------------------------------------------------------------------------
+# shufflers / shifts / misc vision
+# ---------------------------------------------------------------------------
+
+@register_op("shuffle_channel", infer=same_as_input())
+def _shuffle_channel(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                 # NCHW
+    g = op.attr("group")
+    B, C, H, W = x.shape
+    out = x.reshape(B, g, C // g, H, W).swapaxes(1, 2).reshape(
+        B, C, H, W)
+    ctx.set_output(op, "Out", out)
+
+
+@register_op("temporal_shift", infer=same_as_input())
+def _temporal_shift(ctx, op):
+    """reference temporal_shift_op.cc (TSM video models): shift 1/fold
+    of channels one step back in time, 1/fold forward, rest stay."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                 # [N*T, C, H, W]
+    seg_num = op.attr("seg_num")
+    ratio = op.attr("shift_ratio", 0.25)
+    NT, C, H, W = x.shape
+    N = NT // seg_num
+    xr = x.reshape(N, seg_num, C, H, W)
+    c1 = int(C * ratio)
+    c2 = int(C * 2 * ratio)
+    pad_fwd = jnp.concatenate(
+        [xr[:, 1:, :c1], jnp.zeros_like(xr[:, :1, :c1])], axis=1)
+    pad_bwd = jnp.concatenate(
+        [jnp.zeros_like(xr[:, :1, c1:c2]), xr[:, :-1, c1:c2]], axis=1)
+    out = jnp.concatenate([pad_fwd, pad_bwd, xr[:, :, c2:]], axis=2)
+    ctx.set_output(op, "Out", out.reshape(NT, C, H, W))
+
+
+@register_op("row_conv", infer=same_as_input())
+def _row_conv(ctx, op):
+    """Lookahead row convolution (reference row_conv_op.cc, padded
+    [B, T, D] convention): out[t] = sum_{j} x[t+j] * w[j]."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                 # [B, T, D]
+    w = ctx.get_input(op, "Filter")            # [future_len, D]
+    k = w.shape[0]
+    B, T, D = x.shape
+    xp = jnp.pad(x, [(0, 0), (0, k - 1), (0, 0)])
+    out = sum(xp[:, j:j + T] * w[j] for j in range(k))
+    ctx.set_output(op, "Out", out)
+
+
+def _im2seq_infer(op, block):
+    x = in_var(op, block, "X")                 # NCHW
+    k = op.attr("kernels")
+    s = op.attr("strides", [1, 1])
+    p = op.attr("paddings", [0, 0, 0, 0])
+    oh = (x.shape[2] + p[0] + p[2] - k[0]) // s[0] + 1
+    ow = (x.shape[3] + p[1] + p[3] - k[1]) // s[1] + 1
+    set_out(op, block, "Out",
+            (x.shape[0], oh * ow, x.shape[1] * k[0] * k[1]), x.dtype)
+
+
+@register_op("im2sequence", infer=_im2seq_infer)
+def _im2sequence(ctx, op):
+    """Patches -> sequence (reference im2sequence_op.cc), padded [B,
+    oh*ow, C*kh*kw] instead of LoD rows."""
+    lax = _lax()
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    k = op.attr("kernels")
+    s = op.attr("strides", [1, 1])
+    p = op.attr("paddings", [0, 0, 0, 0])
+    B, C = x.shape[:2]
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=tuple(k), window_strides=tuple(s),
+        padding=[(p[0], p[2]), (p[1], p[3])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))  # [B, C*kh*kw, oh, ow]
+    Bp, CK, oh, ow = patches.shape
+    out = patches.reshape(B, CK, oh * ow).swapaxes(1, 2)
+    ctx.set_output(op, "Out", out)
+
+
+@register_op("bilinear_tensor_product", infer=lambda op, block: set_out(
+    op, block, "Out",
+    (in_var(op, block, "X").shape[0],
+     in_var(op, block, "Weight").shape[0]),
+    in_var(op, block, "X").dtype))
+def _bilinear_tensor_product(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                 # [B, M]
+    y = ctx.get_input(op, "Y")                 # [B, N]
+    w = ctx.get_input(op, "Weight")            # [S, M, N]
+    out = jnp.einsum("bm,smn,bn->bs", x, w, y)
+    if op.single_input("Bias"):
+        out = out + ctx.get_input(op, "Bias")
+    ctx.set_output(op, "Out", out)
+
+
+@register_op("fsp", infer=lambda op, block: set_out(
+    op, block, "Out",
+    (in_var(op, block, "X").shape[0], in_var(op, block, "X").shape[1],
+     in_var(op, block, "Y").shape[1]),
+    in_var(op, block, "X").dtype))
+def _fsp(ctx, op):
+    """Flow-of-solution-procedure matrix (reference fsp_op.cc,
+    distillation): Gram matrix between two feature maps."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                 # [B, C1, H, W]
+    y = ctx.get_input(op, "Y")                 # [B, C2, H, W]
+    h = x.shape[2] * x.shape[3]
+    ctx.set_output(op, "Out",
+                   jnp.einsum("bchw,bdhw->bcd", x, y) / h)
+
+
+@register_op("partial_concat", infer=lambda op, block: set_out(
+    op, block, "Out",
+    (in_var(op, block, "X").shape[0],
+     (op.attr("length", -1) if op.attr("length", -1) > 0
+      else in_var(op, block, "X").shape[1] - op.attr("start_index", 0))
+     * len(op.input("X"))),
+    in_var(op, block, "X").dtype))
+def _partial_concat(ctx, op):
+    jnp = _jnp()
+    xs = ctx.get_inputs(op, "X")
+    start = op.attr("start_index", 0)
+    length = op.attr("length", -1)
+    end = None if length < 0 else start + length
+    ctx.set_output(op, "Out",
+                   jnp.concatenate([x[:, start:end] for x in xs], axis=1))
+
+
+@register_op("partial_sum", infer=lambda op, block: set_out(
+    op, block, "Out",
+    (in_var(op, block, "X").shape[0],
+     op.attr("length", -1) if op.attr("length", -1) > 0
+     else in_var(op, block, "X").shape[1] - op.attr("start_index", 0)),
+    in_var(op, block, "X").dtype))
+def _partial_sum(ctx, op):
+    xs = ctx.get_inputs(op, "X")
+    start = op.attr("start_index", 0)
+    length = op.attr("length", -1)
+    end = None if length < 0 else start + length
+    ctx.set_output(op, "Out", sum(x[:, start:end] for x in xs))
+
+
+# ---------------------------------------------------------------------------
+# roi family additions
+# ---------------------------------------------------------------------------
+
+def _psroi_infer(op, block):
+    rois = in_var(op, block, "ROIs")
+    oc = op.attr("output_channels")
+    ph = op.attr("pooled_height")
+    pw = op.attr("pooled_width")
+    set_out(op, block, "Out", (rois.shape[0], oc, ph, pw),
+            in_var(op, block, "X").dtype)
+
+
+@register_op("psroi_pool", infer=_psroi_infer)
+def _psroi_pool(ctx, op):
+    """Position-sensitive ROI average pooling (reference
+    psroi_pool_op.cc): output bin (c, i, j) averages input channel
+    c*ph*pw + i*pw + j over the bin's region. The reference loops bins
+    per ROI on GPU threads; here each bin gathers a fixed sample grid
+    (bilinear-free integer coverage via rounded bin bounds is replaced
+    by a dense sample average — fixed shapes, fully batched)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                 # [B, C, H, W]
+    rois = ctx.get_input(op, "ROIs")           # [R, 4] (x1,y1,x2,y2)
+    batch_idx = (ctx.get_input(op, "RoisBatchIdx").reshape(-1).astype(
+        "int32") if op.single_input("RoisBatchIdx")
+        else jnp.zeros((rois.shape[0],), "int32"))
+    scale = op.attr("spatial_scale", 1.0)
+    oc = op.attr("output_channels")
+    ph = op.attr("pooled_height")
+    pw = op.attr("pooled_width")
+    B, C, H, W = x.shape
+    R = rois.shape[0]
+    S = 4  # samples per bin side
+
+    x1 = rois[:, 0] * scale
+    y1 = rois[:, 1] * scale
+    x2 = rois[:, 2] * scale
+    y2 = rois[:, 3] * scale
+    bin_h = (y2 - y1) / ph
+    bin_w = (x2 - x1) / pw
+    # sample grid per bin: [ph, S] fractional offsets
+    off = (jnp.arange(S) + 0.5) / S
+    ys = (y1[:, None, None]
+          + (jnp.arange(ph)[None, :, None] + off[None, None, :])
+          * bin_h[:, None, None])              # [R, ph, S]
+    xs = (x1[:, None, None]
+          + (jnp.arange(pw)[None, :, None] + off[None, None, :])
+          * bin_w[:, None, None])              # [R, pw, S]
+    yi = jnp.clip(ys, 0, H - 1).astype("int32")
+    xi = jnp.clip(xs, 0, W - 1).astype("int32")
+    # channel map for each output (c, i, j)
+    cmap = (jnp.arange(oc)[:, None, None] * ph * pw
+            + jnp.arange(ph)[None, :, None] * pw
+            + jnp.arange(pw)[None, None, :])   # [oc, ph, pw]
+    feat = x[batch_idx]                        # [R, C, H, W]
+    # gather samples: out[r, c, i, j] = mean_{a,b} feat[r, cmap, yi, xi]
+    samp = feat[jnp.arange(R)[:, None, None, None, None, None],
+                cmap[None, :, :, :, None, None],
+                yi[:, None, :, None, :, None],
+                xi[:, None, None, :, None, :]]  # [R, oc, ph, pw, S, S]
+    ctx.set_output(op, "Out", samp.mean(axis=(4, 5)))
+
+
+def _deform_infer(op, block):
+    x = in_var(op, block, "Input")
+    w = in_var(op, block, "Filter")
+    s = op.attr("strides", [1, 1])
+    p = op.attr("paddings", [0, 0])
+    d = op.attr("dilations", [1, 1])
+    out = [x.shape[0], w.shape[0],
+           _conv_out(x.shape[2], w.shape[2], p[0], p[0], s[0], d[0]),
+           _conv_out(x.shape[3], w.shape[3], p[1], p[1], s[1], d[1])]
+    set_out(op, block, "Output", out, x.dtype)
+
+
+@register_op("deformable_conv", infer=_deform_infer)
+def _deformable_conv(ctx, op):
+    """Modulated deformable conv v2 (reference deformable_conv_op.cu —
+    per-thread bilinear sampling loops). Here: build the full sampling
+    grid [B, kh*kw, oh, ow] from offsets, bilinear-gather every tap,
+    modulate by the mask, and contract taps x channels with one einsum
+    on the MXU. v1 (deformable_conv_v1) is the same without the mask."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")             # [B, C, H, W]
+    offset = ctx.get_input(op, "Offset")       # [B, 2*kh*kw, oh, ow]
+    mask = ctx.get_input(op, "Mask") if op.single_input("Mask") else None
+    w = ctx.get_input(op, "Filter")            # [O, C, kh, kw]
+    s = op.attr("strides", [1, 1])
+    p = op.attr("paddings", [0, 0])
+    d = op.attr("dilations", [1, 1])
+    B, C, H, W = x.shape
+    O, _, kh, kw = w.shape
+    oh = _conv_out(H, kh, p[0], p[0], s[0], d[0])
+    ow = _conv_out(W, kw, p[1], p[1], s[1], d[1])
+    K = kh * kw
+
+    base_y = (jnp.arange(oh) * s[0] - p[0])[None, :, None]   # [1, oh, 1]
+    base_x = (jnp.arange(ow) * s[1] - p[1])[None, None, :]
+    ky = (jnp.arange(kh) * d[0]).repeat(kw).reshape(K, 1, 1)
+    kx = jnp.tile(jnp.arange(kw) * d[1], kh).reshape(K, 1, 1)
+    off = offset.reshape(B, K, 2, oh, ow)
+    py = base_y + ky + off[:, :, 0]            # [B, K, oh, ow]
+    px = base_x + kx + off[:, :, 1]
+
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy = py - y0
+    wx = px - x0
+
+    def gather(yy, xx):
+        yi = jnp.clip(yy, 0, H - 1).astype("int32")
+        xi = jnp.clip(xx, 0, W - 1).astype("int32")
+        valid = ((yy >= 0) & (yy <= H - 1) & (xx >= 0)
+                 & (xx <= W - 1)).astype(x.dtype)
+        g = x[jnp.arange(B)[:, None, None, None, None],
+              jnp.arange(C)[None, :, None, None, None],
+              yi[:, None], xi[:, None]]        # [B, C, K, oh, ow]
+        return g * valid[:, None]
+
+    v = (gather(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+         + gather(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
+         + gather(y0 + 1, x0) * (wy * (1 - wx))[:, None]
+         + gather(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+    if mask is not None:
+        v = v * mask.reshape(B, 1, K, oh, ow)
+    out = jnp.einsum("bckhw,ock->bohw",
+                     v, w.reshape(O, C, K))
+    ctx.set_output(op, "Output", out)
+
+
+register_op("deformable_conv_v1", infer=_deform_infer,
+            lower=_deformable_conv)
+
+
+# ---------------------------------------------------------------------------
+# segment pool / units
+# ---------------------------------------------------------------------------
+
+@register_op("segment_pool", infer=lambda op, block: (
+    set_out(op, block, "Out",
+            (op.attr("num_segments"),) + tuple(
+                in_var(op, block, "X").shape[1:]),
+            in_var(op, block, "X").dtype),
+    set_out(op, block, "SummedIds", (op.attr("num_segments"), 1),
+            in_var(op, block, "X").dtype)))
+def _segment_pool(ctx, op):
+    import jax
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    ids = ctx.get_input(op, "SegmentIds").reshape(-1).astype("int32")
+    n = op.attr("num_segments")
+    ptype = op.attr("pooltype", "SUM")
+    counts = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), ids, n)
+    ctx.set_output(op, "SummedIds", counts[:, None])
+    if ptype in ("SUM", "MEAN"):
+        out = jax.ops.segment_sum(x, ids, n)
+        if ptype == "MEAN":
+            out = out / jnp.maximum(counts, 1.0).reshape(
+                (-1,) + (1,) * (x.ndim - 1))
+    elif ptype == "MAX":
+        out = jax.ops.segment_max(x, ids, n)
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+    else:
+        out = jax.ops.segment_min(x, ids, n)
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+    ctx.set_output(op, "Out", out)
+
+
+def _gru_unit_infer(op, block):
+    h = in_var(op, block, "HiddenPrev")
+    set_out(op, block, "Gate", (h.shape[0], h.shape[1] * 3), h.dtype)
+    set_out(op, block, "ResetHiddenPrev", h.shape, h.dtype)
+    set_out(op, block, "Hidden", h.shape, h.dtype)
+
+
+@register_op("gru_unit", infer=_gru_unit_infer)
+def _gru_unit(ctx, op):
+    """Single GRU step (reference gru_unit_op.cc). Input [B, 3H] is the
+    precomputed x-projection; Weight [H, 3H] packs (update, reset) gates
+    then the candidate projection."""
+    import jax
+    jnp = _jnp()
+    xp = ctx.get_input(op, "Input")            # [B, 3H]
+    h_prev = ctx.get_input(op, "HiddenPrev")   # [B, H]
+    w = ctx.get_input(op, "Weight")            # [H, 3H]
+    bias = ctx.get_input(op, "Bias") if op.single_input("Bias") else None
+    H = h_prev.shape[1]
+    if bias is not None:
+        xp = xp + bias
+    g_uh = h_prev @ w[:, :2 * H]
+    u = jax.nn.sigmoid(xp[:, :H] + g_uh[:, :H])
+    r = jax.nn.sigmoid(xp[:, H:2 * H] + g_uh[:, H:])
+    rh = r * h_prev
+    c = jnp.tanh(xp[:, 2 * H:] + rh @ w[:, 2 * H:])
+    h = u * h_prev + (1 - u) * c
+    ctx.set_output(op, "Gate",
+                   jnp.concatenate([u, r, c], axis=1))
+    ctx.set_output(op, "ResetHiddenPrev", rh)
+    ctx.set_output(op, "Hidden", h)
+
+
+def _lstm_unit_infer(op, block):
+    c = in_var(op, block, "C_prev")
+    set_out(op, block, "C", c.shape, c.dtype)
+    set_out(op, block, "H", c.shape, c.dtype)
+
+
+@register_op("lstm_unit", infer=_lstm_unit_infer)
+def _lstm_unit(ctx, op):
+    """Single LSTM step from the packed gate pre-activation
+    (reference lstm_unit_op.cc): X [B, 4H] = (i, g, f, o)."""
+    import jax
+    jnp = _jnp()
+    xg = ctx.get_input(op, "X")
+    c_prev = ctx.get_input(op, "C_prev")
+    H = c_prev.shape[1]
+    fb = op.attr("forget_bias", 0.0)
+    i = jax.nn.sigmoid(xg[:, :H])
+    g = jnp.tanh(xg[:, H:2 * H])
+    f = jax.nn.sigmoid(xg[:, 2 * H:3 * H] + fb)
+    o = jax.nn.sigmoid(xg[:, 3 * H:])
+    c = f * c_prev + i * g
+    ctx.set_output(op, "C", c)
+    ctx.set_output(op, "H", o * jnp.tanh(c))
+
+
+# ---------------------------------------------------------------------------
+# auc (stateful graph metric — reference metrics/auc_op.cc)
+# ---------------------------------------------------------------------------
+
+def _auc_infer(op, block):
+    sp = in_var(op, block, "StatPos")
+    set_out(op, block, "AUC", (), "float64")
+    set_out(op, block, "StatPosOut", sp.shape, sp.dtype)
+    set_out(op, block, "StatNegOut", sp.shape, sp.dtype)
+
+
+@register_op("auc", infer=_auc_infer, grad=None,
+             stateful_outputs=("StatPosOut", "StatNegOut"))
+def _auc(ctx, op):
+    """Streaming AUC (reference metrics/auc_op.cc): bucketed positive/
+    negative counts accumulate across steps in persistable StatPos/
+    StatNeg [num_thresholds+1] tensors; AUC is the trapezoid area over
+    the bucket sweep."""
+    jnp = _jnp()
+    pred = ctx.get_input(op, "Predict")        # [B, 2] (prob of class 1)
+    label = ctx.get_input(op, "Label").reshape(-1).astype("int32")
+    stat_pos = ctx.get_input(op, "StatPos").astype("int64")
+    stat_neg = ctx.get_input(op, "StatNeg").astype("int64")
+    n_thresh = stat_pos.shape[0] - 1
+    p1 = pred[:, -1]
+    bucket = jnp.clip((p1 * n_thresh).astype("int32"), 0, n_thresh)
+    pos_add = jnp.zeros_like(stat_pos).at[bucket].add(
+        (label > 0).astype("int64"))
+    neg_add = jnp.zeros_like(stat_neg).at[bucket].add(
+        (label <= 0).astype("int64"))
+    stat_pos = stat_pos + pos_add
+    stat_neg = stat_neg + neg_add
+    # sweep buckets high->low accumulating TP/FP; trapezoid area
+    pos_flip = jnp.flip(stat_pos).astype("float64")
+    neg_flip = jnp.flip(stat_neg).astype("float64")
+    tp = jnp.cumsum(pos_flip)
+    fp = jnp.cumsum(neg_flip)
+    tp_prev = tp - pos_flip
+    fp_prev = fp - neg_flip
+    if op.attr("curve", "ROC") == "PR":
+        # precision-recall area: x = recall = TP/P, y = precision
+        p_total = jnp.maximum(tp[-1], 1.0)
+        recall = tp / p_total
+        recall_prev = tp_prev / p_total
+        prec = tp / jnp.maximum(tp + fp, 1.0)
+        prec_prev = tp_prev / jnp.maximum(tp_prev + fp_prev, 1.0)
+        area = ((recall - recall_prev) * (prec + prec_prev) / 2.0).sum()
+        auc = jnp.where(tp[-1] > 0, area, 0.0)
+    else:
+        area = ((fp - fp_prev) * (tp + tp_prev) / 2.0).sum()
+        total = tp[-1] * fp[-1]
+        auc = jnp.where(total > 0, area / jnp.maximum(total, 1.0), 0.0)
+    ctx.set_output(op, "AUC", auc)
+    ctx.set_output(op, "StatPosOut", stat_pos)
+    ctx.set_output(op, "StatNegOut", stat_neg)

@@ -253,3 +253,204 @@ def _gru_rnn(ctx, op):
     (h_last, _), hs = jax.lax.scan(step, (h0, 0), xs)
     ctx.set_output(op, "Out", jnp.swapaxes(hs, 0, 1))
     ctx.set_output(op, "LastH", h_last)
+
+
+# ---------------------------------------------------------------------------
+# sequence_ops long tail (reference operators/sequence_ops/*) — padded
+# [B, T, ...] + Lengths convention throughout
+# ---------------------------------------------------------------------------
+
+def _seq_conv_infer(op, block):
+    x = in_var(op, block, "X")                 # [B, T, D]
+    w = in_var(op, block, "Filter")            # [ctx_len*D, M]
+    set_out(op, block, "Out", (x.shape[0], x.shape[1], w.shape[1]),
+            x.dtype)
+
+
+@register_op("sequence_conv", infer=_seq_conv_infer)
+def _sequence_conv(ctx, op):
+    """Context-window conv over time (reference sequence_conv_op.cc):
+    each step's feature is the flattened [context_length, D] window
+    starting at t + context_start, matmul'd against Filter. Steps past
+    Lengths are zeroed; the window never crosses a row's end (the
+    reference's per-sequence im2col becomes a padded gather)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    w = ctx.get_input(op, "Filter")
+    lengths = ctx.get_input(op, "Lengths")
+    start = op.attr("context_start", 0)
+    clen = op.attr("context_length")
+    B, T, D = x.shape
+    t_idx = jnp.arange(T)
+    cols = []
+    for j in range(clen):
+        pos = t_idx + start + j                # source position per step
+        valid = (pos >= 0) & (pos[None, :] < lengths[:, None])
+        g = x[:, jnp.clip(pos, 0, T - 1)]      # [B, T, D]
+        cols.append(jnp.where(valid[..., None], g, 0.0))
+    win = jnp.concatenate(cols, axis=2)        # [B, T, clen*D]
+    out = win @ w                              # [B, T, M]
+    mask = (t_idx[None, :] < lengths[:, None])[..., None]
+    ctx.set_output(op, "Out", out * mask.astype(out.dtype))
+
+
+def _seq_expand_infer(op2, block):
+    x = in_var(op2, block, "X")
+    y = in_var(op2, block, "Y")
+    set_out(op2, block, "Out", (x.shape[0], y.shape[1]) + tuple(
+        x.shape[2:]), x.dtype)
+
+
+@register_op("sequence_expand", infer=_seq_expand_infer)
+def _sequence_expand(ctx, op):
+    """reference sequence_expand_op.cc with ref_level=0, padded form:
+    each row's single step (or [T=1] slice) is broadcast across the
+    companion Y's valid steps."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                 # [B, Tx, ...]
+    lengths = ctx.get_input(op, "YLengths")    # [B]
+    maxlen = ctx.get_input(op, "Y").shape[1]
+    first = x[:, 0]                            # [B, ...]
+    out = jnp.broadcast_to(first[:, None],
+                           (x.shape[0], maxlen) + first.shape[1:])
+    mask = (jnp.arange(maxlen)[None, :] < lengths[:, None])
+    m = mask.reshape(mask.shape + (1,) * (first.ndim - 1))
+    ctx.set_output(op, "Out", out * m.astype(x.dtype))
+
+
+def _seq_pad_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+    set_out(op, block, "Length", (x.shape[0],), "int64")
+
+
+@register_op("sequence_pad", infer=_seq_pad_infer)
+def _sequence_pad(ctx, op):
+    """reference sequence_pad_op.cc: under the repo's padded convention
+    the data is already dense — the op re-pads the tail with PadValue
+    and reports lengths (identity + mask, kept for API parity)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    lengths = ctx.get_input(op, "Lengths")
+    pad_value = ctx.get_input(op, "PadValue").reshape(())
+    T = x.shape[1]
+    mask = (jnp.arange(T)[None, :] < lengths[:, None])
+    m = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    ctx.set_output(op, "Out", jnp.where(m, x, pad_value.astype(x.dtype)))
+    ctx.set_output(op, "Length", lengths.astype("int64"))
+
+
+@register_op("sequence_unpad", infer=same_as_input())
+def _sequence_unpad(ctx, op):
+    """reference sequence_unpad_op.cc: inverse of sequence_pad. Fixed
+    shapes mean the padding slots stay (zeroed) — downstream masked ops
+    ignore them."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    lengths = ctx.get_input(op, "Lengths")
+    T = x.shape[1]
+    mask = (jnp.arange(T)[None, :] < lengths[:, None])
+    m = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    ctx.set_output(op, "Out", x * m.astype(x.dtype))
+
+
+def _seq_concat_infer(op, block):
+    xs = [block.var(n) for n in op.input("X")]
+    T = sum(x.shape[1] for x in xs)
+    set_out(op, block, "Out", (xs[0].shape[0], T) + tuple(
+        xs[0].shape[2:]), xs[0].dtype)
+
+
+@register_op("sequence_concat", infer=_seq_concat_infer)
+def _sequence_concat(ctx, op):
+    """reference sequence_concat_op.cc: per-row concatenation of the
+    VALID prefixes of each input, left-compacted into the output.
+    One argsort-based stable compaction replaces the reference's
+    per-sequence memcpy loop."""
+    jnp = _jnp()
+    xs = ctx.get_inputs(op, "X")
+    lens = ctx.get_inputs(op, "Lengths")
+    B = xs[0].shape[0]
+    cat = jnp.concatenate(xs, axis=1)          # [B, sumT, ...]
+    valid = jnp.concatenate(
+        [jnp.arange(x.shape[1])[None, :] < l[:, None]
+         for x, l in zip(xs, lens)], axis=1)   # [B, sumT]
+    # stable sort: valid slots (0) before padding (1) preserves order
+    order = jnp.argsort(jnp.where(valid, 0, 1), axis=1, stable=True)
+    idx = order.reshape(order.shape + (1,) * (cat.ndim - 2))
+    out = jnp.take_along_axis(cat, idx, axis=1)
+    total = sum(l for l in lens)
+    mask = (jnp.arange(cat.shape[1])[None, :] < total[:, None])
+    m = mask.reshape(mask.shape + (1,) * (cat.ndim - 2))
+    ctx.set_output(op, "Out", out * m.astype(out.dtype))
+
+
+@register_op("sequence_slice", infer=same_as_input())
+def _sequence_slice(ctx, op):
+    """reference sequence_slice_op.cc: per-row [offset, offset+length)
+    slice, left-aligned into the output with zero padding."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                 # [B, T, ...]
+    offset = ctx.get_input(op, "Offset").reshape(-1)
+    length = ctx.get_input(op, "Length").reshape(-1)
+    T = x.shape[1]
+    t_idx = jnp.arange(T)[None, :]
+    src = jnp.clip(offset[:, None] + t_idx, 0, T - 1)
+    g = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    mask = t_idx < length[:, None]
+    m = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    ctx.set_output(op, "Out", g * m.astype(x.dtype))
+
+
+def _seq_erase_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+    if op.output("OutLengths"):
+        set_out(op, block, "OutLengths", (x.shape[0],), "int64")
+
+
+@register_op("sequence_erase", infer=_seq_erase_infer, grad=None)
+def _sequence_erase(ctx, op):
+    """reference sequence_erase_op.cc: drop listed tokens, compact left,
+    pad with zeros; emits updated lengths."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                 # [B, T] int
+    lengths = ctx.get_input(op, "Lengths")
+    tokens = op.attr("tokens", [])
+    T = x.shape[1]
+    t_idx = jnp.arange(T)[None, :]
+    valid = t_idx < lengths[:, None]
+    keep = valid
+    for t in tokens:
+        keep = keep & (x != t)
+    order = jnp.argsort(jnp.where(keep, 0, 1), axis=1, stable=True)
+    out = jnp.take_along_axis(x, order, axis=1)
+    new_len = keep.sum(1)
+    mask = t_idx < new_len[:, None]
+    ctx.set_output(op, "Out", jnp.where(mask, out, 0))
+    if op.output("OutLengths"):
+        ctx.set_output(op, "OutLengths", new_len.astype("int64"))
+
+
+def _seq_enum_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out",
+            (x.shape[0], x.shape[1], op.attr("win_size")), x.dtype)
+
+
+@register_op("sequence_enumerate", infer=_seq_enum_infer, grad=None)
+def _sequence_enumerate(ctx, op):
+    """reference sequence_enumerate_op.cc: sliding win_size windows per
+    step, pad_value past each row's end."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")                 # [B, T] int
+    lengths = ctx.get_input(op, "Lengths")
+    win = op.attr("win_size")
+    pad = op.attr("pad_value", 0)
+    T = x.shape[1]
+    t_idx = jnp.arange(T)
+    pos = t_idx[:, None] + jnp.arange(win)[None, :]      # [T, win]
+    g = x[:, jnp.clip(pos, 0, T - 1)]                    # [B, T, win]
+    valid = pos[None] < lengths[:, None, None]
+    ctx.set_output(op, "Out", jnp.where(valid, g, pad))

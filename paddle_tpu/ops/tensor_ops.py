@@ -380,7 +380,9 @@ def _split_infer(op, block):
         n = num or len(outs)
         sizes = [x.shape[axis] // n] * n
     for name, size in zip(outs, sizes):
-        v = block._find_var_recursive(name) or block.create_var(name=name)
+        v = block._find_var_recursive(name)
+        if v is None:  # `or` would trip Variable.__bool__'s trace guard
+            v = block.create_var(name=name)
         shape = list(x.shape)
         shape[axis] = size
         v.shape, v.dtype = tuple(shape), x.dtype
@@ -430,7 +432,9 @@ def _unstack_infer(op, block):
     axis = op.attr("axis", 0) % len(x.shape)
     shape = [s for i, s in enumerate(x.shape) if i != axis]
     for name in op.output("Y"):
-        v = block._find_var_recursive(name) or block.create_var(name=name)
+        v = block._find_var_recursive(name)
+        if v is None:
+            v = block.create_var(name=name)
         v.shape, v.dtype = tuple(shape), x.dtype
 
 
@@ -993,7 +997,9 @@ def _meshgrid_infer(op, block):
     xs = [block.var(n) for n in op.input("X")]
     shape = tuple(v.shape[0] for v in xs)
     for n in op.output("Out"):
-        v = block._find_var_recursive(n) or block.create_var(name=n)
+        v = block._find_var_recursive(n)
+        if v is None:
+            v = block.create_var(name=n)
         v.shape, v.dtype = shape, xs[0].dtype
 
 

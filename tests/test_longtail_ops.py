@@ -1,0 +1,632 @@
+"""Op long-tail tests (VERDICT r3 #5): forward-vs-numpy + grads via the
+OpTest harness for misc_ops.py, nn_extra_ops.py, and the sequence_ops
+additions. Reference: the corresponding tests/unittests/test_*_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from op_test import OpCase, run_case
+
+R = np.random.RandomState
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+X34 = R(0).randn(3, 4).astype("float32")
+Y34 = R(1).randn(3, 4).astype("float32")
+POS34 = R(2).uniform(0.1, 0.9, (3, 4)).astype("float32")
+
+
+CASES = [
+    OpCase("addmm",
+           {"Input": R(3).randn(3, 5).astype("float32"), "X": X34,
+            "Y": R(4).randn(4, 5).astype("float32")},
+           attrs={"Alpha": 2.0, "Beta": 0.5},
+           ref=lambda Input, X, Y, Alpha, Beta: Beta * Input
+           + Alpha * (X @ Y),
+           grad=["X", "Y", "Input"]),
+    OpCase("mv", {"X": X34, "Vec": R(5).randn(4).astype("float32")},
+           ref=lambda X, Vec: X @ Vec, grad=["X", "Vec"]),
+    OpCase("minus", {"X": X34, "Y": Y34}, ref=lambda X, Y: X - Y,
+           grad=["X"]),
+    OpCase("allclose", {"Input": X34, "Other": X34 + 1e-9},
+           ref=lambda Input, Other: np.asarray(True),
+           check_dtype=False),
+    OpCase("l1_norm", {"X": X34}, ref=lambda X: np.abs(X).sum(),
+           grad=["X"]),
+    OpCase("squared_l2_distance", {"X": X34, "Y": Y34},
+           outputs={"sub_result": 1, "Out": 1},
+           ref=lambda X, Y: {"sub_result": X - Y,
+                             "Out": ((X - Y) ** 2).sum(
+                                 1, keepdims=True)},
+           grad=["X"]),
+    OpCase("size", {"Input": X34}, ref=lambda Input: np.asarray(12),
+           check_dtype=False),
+    OpCase("shard_index",
+           {"X": np.array([[1], [7], [15]], "int64")},
+           attrs={"index_num": 20, "nshards": 2, "shard_id": 0,
+                  "ignore_value": -1},
+           ref=lambda X, **a: np.where(X // 10 == 0, X % 10, -1)),
+    OpCase("multiplex",
+           {"X": [X34, Y34],
+            "Ids": np.array([[0], [1], [0]], "int32")},
+           ref=lambda X, Ids: np.stack(
+               [X[int(Ids.reshape(-1)[i])][i] for i in range(3)])),
+    OpCase("unbind", {"X": X34}, outputs={"Out": 3}, attrs={"axis": 0},
+           ref=lambda X, axis: {"Out": [X[0], X[1], X[2]]}),
+    OpCase("reverse", {"X": X34}, attrs={"axis": [1]},
+           ref=lambda X, axis: X[:, ::-1], grad=["X"]),
+    OpCase("cos_sim", {"X": X34, "Y": Y34},
+           ref=lambda X, Y: ((X * Y).sum(-1, keepdims=True)
+                             / np.sqrt((X * X).sum(-1, keepdims=True)
+                                       + 1e-12)
+                             / np.sqrt((Y * Y).sum(-1, keepdims=True)
+                                       + 1e-12)),
+           grad=["X"], rtol=1e-4, atol=1e-5),
+    OpCase("log_loss", {"Predicted": POS34, "Labels":
+                        (POS34 > 0.5).astype("float32")},
+           outputs={"Loss": 1}, attrs={"epsilon": 1e-4},
+           ref=lambda Predicted, Labels, epsilon:
+           -Labels * np.log(Predicted + epsilon)
+           - (1 - Labels) * np.log(1 - Predicted + epsilon),
+           grad=["Predicted"]),
+    OpCase("selu", {"X": X34},
+           ref=lambda X: 1.0507009873554805 * np.where(
+               X > 0, X, 1.6732632423543772 * (np.exp(X) - 1)),
+           grad=["X"]),
+    OpCase("conv_shift",
+           {"X": R(6).randn(2, 6).astype("float32"),
+            "Y": R(7).randn(2, 3).astype("float32")},
+           ref=None, grad=["X", "Y"]),
+    OpCase("add_position_encoding",
+           {"X": R(8).randn(2, 5, 8).astype("float32")},
+           attrs={"alpha": 1.0, "beta": 1.0}, ref=None, grad=["X"]),
+    OpCase("cvm", {"X": np.abs(R(9).randn(3, 6)).astype("float32")},
+           outputs={"Y": 1}, attrs={"use_cvm": True},
+           ref=lambda X, use_cvm: np.concatenate(
+               [np.log(X[:, :1] + 1),
+                np.log(X[:, 1:2] + 1) - np.log(X[:, :1] + 1),
+                X[:, 2:]], axis=1)),
+    # losses
+    OpCase("hinge_loss",
+           {"Logits": X34, "Labels": (Y34 > 0).astype("float32")},
+           outputs={"Loss": 1},
+           ref=lambda Logits, Labels: np.maximum(
+               0.0, 1.0 - (2 * Labels - 1) * Logits)),
+    OpCase("modified_huber_loss",
+           {"X": X34, "Y": (Y34 > 0).astype("float32")},
+           outputs={"IntermediateVal": 1, "Out": 1},
+           ref=lambda X, Y: {
+               "IntermediateVal": (2 * Y - 1) * X,
+               "Out": np.where(
+                   (2 * Y - 1) * X < -1, -4 * (2 * Y - 1) * X,
+                   np.where((2 * Y - 1) * X < 1,
+                            (1 - (2 * Y - 1) * X) ** 2, 0.0))}),
+    OpCase("margin_rank_loss",
+           {"X1": X34, "X2": Y34,
+            "Label": np.sign(R(10).randn(3, 4)).astype("float32")},
+           outputs={"Activated": 1, "Out": 1}, attrs={"margin": 0.1},
+           ref=None, grad=["X1"]),
+    OpCase("rank_loss",
+           {"Left": X34, "Right": Y34,
+            "Label": (R(11).rand(3, 4) > 0.5).astype("float32")},
+           ref=lambda Left, Right, Label: np.log1p(
+               np.exp(Left - Right)) - Label * (Left - Right),
+           grad=["Left"], rtol=1e-4, atol=1e-5),
+    OpCase("bpr_loss",
+           {"X": R(12).randn(4, 6).astype("float32"),
+            "Label": np.array([[0], [2], [5], [1]], "int64")},
+           outputs={"Y": 1}, ref=None, grad=["X"]),
+    OpCase("nll_loss",
+           {"X": np.log(_sigmoid(R(13).randn(4, 5)) + 1e-3).astype(
+               "float32"),
+            "Label": np.array([0, 2, 4, 1], "int64")},
+           outputs={"Out": 1, "Total_weight": 1},
+           attrs={"reduction": "mean"}, ref=None, grad=["X"]),
+    OpCase("teacher_student_sigmoid_loss",
+           {"X": R(14).randn(4, 1).astype("float32"),
+            "Label": np.array([[0.3], [-0.2], [-1.5], [0.9]],
+                              "float32")},
+           outputs={"Y": 1}, ref=None),
+    # tensor creation
+    OpCase("fill_constant_batch_size_like",
+           {"Input": X34},
+           attrs={"shape": [1, 7], "value": 3.5, "input_dim_idx": 0,
+                  "output_dim_idx": 0},
+           ref=lambda Input, **a: np.full((3, 7), 3.5, "float32")),
+    OpCase("empty", {}, attrs={"shape": [2, 3]},
+           ref=lambda **a: np.zeros((2, 3), "float32")),
+    OpCase("fill", {},
+           attrs={"shape": [2, 2], "value": [1.0, 2.0, 3.0, 4.0]},
+           ref=lambda **a: np.array([[1, 2], [3, 4]], "float32")),
+    OpCase("is_empty", {"X": X34},
+           ref=lambda X: np.asarray(False), check_dtype=False),
+    # metric-ish
+    OpCase("mean_iou",
+           {"Predictions": np.array([[0, 1], [1, 1]], "int32"),
+            "Labels": np.array([[0, 1], [0, 1]], "int32")},
+           outputs={"OutMeanIou": 1, "OutWrong": 1, "OutCorrect": 1},
+           attrs={"num_classes": 2}, ref=None),
+    OpCase("unique_with_counts",
+           {"X": np.array([2, 2, 5, 5, 5, 9], "int64")},
+           outputs={"Out": 1, "Index": 1, "Count": 1}, ref=None),
+]
+
+
+NN_CASES = [
+    OpCase("pad2d", {"X": R(20).randn(2, 3, 4, 5).astype("float32")},
+           attrs={"paddings": [1, 2, 0, 1], "mode": "constant",
+                  "pad_value": 0.0},
+           ref=lambda X, **a: np.pad(
+               X, [(0, 0), (0, 0), (1, 2), (0, 1)]),
+           grad=["X"]),
+    OpCase("pad3d", {"X": R(21).randn(2, 2, 3, 4, 5).astype("float32")},
+           attrs={"paddings": [1, 0, 0, 1, 2, 0], "mode": "reflect"},
+           ref=lambda X, **a: np.pad(
+               X, [(0, 0), (0, 0), (1, 0), (0, 1), (2, 0)],
+               mode="reflect"),
+           grad=["X"]),
+    OpCase("shuffle_channel",
+           {"X": R(22).randn(2, 6, 3, 3).astype("float32")},
+           attrs={"group": 2},
+           ref=lambda X, group: X.reshape(2, 2, 3, 3, 3).swapaxes(
+               1, 2).reshape(2, 6, 3, 3),
+           grad=["X"]),
+    OpCase("temporal_shift",
+           {"X": R(23).randn(4, 8, 2, 2).astype("float32")},
+           attrs={"seg_num": 2, "shift_ratio": 0.25}, ref=None,
+           grad=["X"]),
+    OpCase("row_conv",
+           {"X": R(24).randn(2, 6, 3).astype("float32"),
+            "Filter": R(25).randn(2, 3).astype("float32")},
+           ref=None, grad=["X", "Filter"]),
+    OpCase("bilinear_tensor_product",
+           {"X": R(26).randn(3, 4).astype("float32"),
+            "Y": R(27).randn(3, 5).astype("float32"),
+            "Weight": R(28).randn(2, 4, 5).astype("float32")},
+           ref=lambda X, Y, Weight: np.einsum(
+               "bm,smn,bn->bs", X, Weight, Y),
+           grad=["X", "Y", "Weight"], grad_atol=1e-2),
+    OpCase("fsp",
+           {"X": R(29).randn(2, 3, 4, 4).astype("float32"),
+            "Y": R(30).randn(2, 5, 4, 4).astype("float32")},
+           ref=lambda X, Y: np.einsum("bchw,bdhw->bcd", X, Y) / 16,
+           grad=["X", "Y"]),
+    OpCase("partial_concat", {"X": [X34, Y34]},
+           attrs={"start_index": 1, "length": 2},
+           ref=lambda X, **a: np.concatenate(
+               [X[0][:, 1:3], X[1][:, 1:3]], axis=1),
+           grad=[]),
+    OpCase("partial_sum", {"X": [X34, Y34]},
+           attrs={"start_index": 0, "length": 3},
+           ref=lambda X, **a: X[0][:, :3] + X[1][:, :3], grad=[]),
+    OpCase("lrn", {"X": np.abs(R(31).randn(2, 6, 3, 3)).astype(
+        "float32")},
+        outputs={"Out": 1, "MidOut": 1},
+        attrs={"n": 3, "k": 2.0, "alpha": 1e-2, "beta": 0.75},
+        ref=None, grad=["X"]),
+    OpCase("im2sequence",
+           {"X": R(32).randn(2, 3, 6, 6).astype("float32")},
+           attrs={"kernels": [2, 2], "strides": [2, 2],
+                  "paddings": [0, 0, 0, 0]},
+           ref=None, grad=["X"]),
+    OpCase("segment_pool",
+           {"X": R(33).randn(6, 4).astype("float32"),
+            "SegmentIds": np.array([0, 0, 1, 1, 1, 2], "int32")},
+           outputs={"Out": 1, "SummedIds": 1},
+           attrs={"num_segments": 3, "pooltype": "MEAN"},
+           ref=None, grad=["X"]),
+]
+
+
+SEQ_CASES = [
+    OpCase("sequence_conv",
+           {"X": R(40).randn(2, 5, 3).astype("float32"),
+            "Filter": R(41).randn(6, 4).astype("float32"),
+            "Lengths": np.array([5, 3], "int64")},
+           attrs={"context_start": 0, "context_length": 2},
+           ref=None, grad=["X", "Filter"]),
+    OpCase("sequence_pad",
+           {"X": R(42).randn(2, 4, 3).astype("float32"),
+            "Lengths": np.array([4, 2], "int64"),
+            "PadValue": np.array([0.0], "float32")},
+           outputs={"Out": 1, "Length": 1}, ref=None, grad=["X"]),
+    OpCase("sequence_unpad",
+           {"X": R(43).randn(2, 4, 3).astype("float32"),
+            "Lengths": np.array([3, 4], "int64")},
+           ref=None, grad=["X"]),
+    OpCase("sequence_slice",
+           {"X": R(44).randn(2, 5, 3).astype("float32"),
+            "Offset": np.array([[1], [0]], "int64"),
+            "Length": np.array([[2], [4]], "int64")},
+           ref=None, grad=["X"]),
+    OpCase("sequence_erase",
+           {"X": np.array([[3, 1, 3, 2, 0], [1, 1, 2, 0, 0]], "int64"),
+            "Lengths": np.array([4, 3], "int64")},
+           attrs={"tokens": [1]},
+           ref=lambda X, Lengths, tokens: np.array(
+               [[3, 3, 2, 0, 0], [2, 0, 0, 0, 0]], "int64")),
+    OpCase("sequence_enumerate",
+           {"X": np.array([[1, 2, 3, 4]], "int64"),
+            "Lengths": np.array([3], "int64")},
+           attrs={"win_size": 2, "pad_value": 0},
+           ref=lambda X, Lengths, win_size, pad_value: np.array(
+               [[[1, 2], [2, 3], [3, 0], [0, 0]]], "int64")),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=lambda c: c.op_type)
+def test_misc_ops(case):
+    run_case(case)
+
+
+@pytest.mark.parametrize("case", NN_CASES, ids=lambda c: c.op_type)
+def test_nn_extra_ops(case):
+    run_case(case)
+
+
+@pytest.mark.parametrize("case", SEQ_CASES, ids=lambda c: c.op_type)
+def test_sequence_longtail_ops(case):
+    run_case(case)
+
+
+# ---------------------------------------------------------------------------
+# cases that need bespoke checks
+# ---------------------------------------------------------------------------
+
+def _run_op(op_type, np_inputs, out_slots, attrs=None):
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    from paddle_tpu.framework.layer_helper import LayerHelper
+    with pt.program_guard(main_p, startup):
+        feeds = {}
+        in_map = {}
+        for slot, arr in np_inputs.items():
+            v = layers.data(slot.lower(), list(arr.shape),
+                            dtype=str(arr.dtype),
+                            append_batch_size=False)
+            feeds[slot.lower()] = arr
+            in_map[slot] = [v]
+        h = LayerHelper(op_type)
+        outs = {s: [h.create_variable_for_type_inference("float32")]
+                for s in out_slots}
+        h.append_op(op_type, inputs=in_map, outputs=outs,
+                    attrs=attrs or {})
+    exe = pt.Executor()
+    exe.run(startup)
+    vals = exe.run(main_p, feed=feeds,
+                   fetch_list=[outs[s][0] for s in out_slots])
+    return [np.asarray(v) for v in vals]
+
+
+def test_conv3d_matches_direct():
+    rng = R(50)
+    x = rng.randn(1, 2, 5, 6, 6).astype("float32")
+    w = rng.randn(3, 2, 2, 2, 2).astype("float32")
+    out, = _run_op("conv3d", {"Input": x, "Filter": w}, ["Output"],
+                   {"strides": [1, 1, 1], "paddings": [0, 0, 0]})
+    # direct correlation
+    ref = np.zeros((1, 3, 4, 5, 5), "float32")
+    for o in range(3):
+        for d in range(4):
+            for i in range(5):
+                for j in range(5):
+                    ref[0, o, d, i, j] = (
+                        x[0, :, d:d + 2, i:i + 2, j:j + 2]
+                        * w[o]).sum()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pool3d_and_conv3d_transpose_shapes():
+    rng = R(51)
+    x = rng.randn(2, 3, 4, 6, 6).astype("float32")
+    out, = _run_op("pool3d", {"X": x}, ["Out"],
+                   {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                    "paddings": [0, 0, 0], "pooling_type": "max"})
+    assert out.shape == (2, 3, 2, 3, 3)
+    ref = x.reshape(2, 3, 2, 2, 3, 2, 3, 2).max(axis=(3, 5, 7))
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    w = rng.randn(3, 4, 2, 2, 2).astype("float32")
+    out2, = _run_op("conv3d_transpose", {"Input": x, "Filter": w},
+                    ["Output"], {"strides": [2, 2, 2],
+                                 "paddings": [0, 0, 0]})
+    assert out2.shape == (2, 4, 8, 12, 12)
+
+
+def test_spectral_norm_normalizes():
+    rng = R(52)
+    w = rng.randn(6, 8).astype("float32")
+    u = rng.randn(6).astype("float32")
+    v = rng.randn(8).astype("float32")
+    out, = _run_op("spectral_norm", {"Weight": w, "U": u, "V": v},
+                   ["Out"], {"dim": 0, "power_iters": 30})
+    # largest singular value of the output ~ 1
+    s = np.linalg.svd(out, compute_uv=False)[0]
+    np.testing.assert_allclose(s, 1.0, atol=1e-3)
+
+
+def test_data_norm():
+    rng = R(53)
+    x = rng.randn(5, 3).astype("float32")
+    hist = rng.randn(10, 3).astype("float32")   # the accumulated batch
+    bsz = np.full((3,), 10.0, "float32")
+    bsum = hist.sum(0)
+    bsq = (hist ** 2).sum(0)
+    y, = _run_op("data_norm",
+                 {"X": x, "BatchSize": bsz, "BatchSum": bsum,
+                  "BatchSquareSum": bsq}, ["Y"])
+    mean = bsum / bsz
+    scale = np.sqrt(bsz / (bsq - bsum * mean))
+    np.testing.assert_allclose(y, (x - mean) * scale, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deformable_conv_zero_offset_matches_conv2d():
+    """With zero offsets and unit mask, deformable conv == plain conv."""
+    rng = R(54)
+    x = rng.randn(1, 2, 6, 6).astype("float32")
+    w = rng.randn(3, 2, 3, 3).astype("float32")
+    oh = ow = 4
+    offset = np.zeros((1, 18, oh, ow), "float32")
+    mask = np.ones((1, 9, oh, ow), "float32")
+    out, = _run_op("deformable_conv",
+                   {"Input": x, "Offset": offset, "Mask": mask,
+                    "Filter": w}, ["Output"],
+                   {"strides": [1, 1], "paddings": [0, 0],
+                    "dilations": [1, 1]})
+    ref = np.zeros((1, 3, 4, 4), "float32")
+    for o in range(3):
+        for i in range(4):
+            for j in range(4):
+                ref[0, o, i, j] = (x[0, :, i:i + 3, j:j + 3] * w[o]).sum()
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_psroi_pool_constant_map():
+    """On a channelwise-constant map every bin returns its mapped
+    channel's constant."""
+    oc, ph, pw = 2, 2, 2
+    C = oc * ph * pw
+    x = np.arange(C, dtype="float32").reshape(1, C, 1, 1) * np.ones(
+        (1, C, 8, 8), "float32")
+    rois = np.array([[0.0, 0.0, 8.0, 8.0]], "float32")
+    out, = _run_op("psroi_pool", {"X": x, "ROIs": rois}, ["Out"],
+                   {"spatial_scale": 1.0, "output_channels": oc,
+                    "pooled_height": ph, "pooled_width": pw})
+    ref = np.arange(C, dtype="float32").reshape(oc, ph, pw)[None]
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_gru_lstm_unit_step():
+    rng = R(55)
+    B, H = 3, 4
+    xp = rng.randn(B, 3 * H).astype("float32")
+    h_prev = rng.randn(B, H).astype("float32")
+    w = rng.randn(H, 3 * H).astype("float32")
+    hid, = _run_op("gru_unit",
+                   {"Input": xp, "HiddenPrev": h_prev, "Weight": w},
+                   ["Hidden"])
+    g_uh = h_prev @ w[:, :2 * H]
+    u = _sigmoid(xp[:, :H] + g_uh[:, :H])
+    r = _sigmoid(xp[:, H:2 * H] + g_uh[:, H:])
+    c = np.tanh(xp[:, 2 * H:] + (r * h_prev) @ w[:, 2 * H:])
+    np.testing.assert_allclose(hid, u * h_prev + (1 - u) * c, rtol=1e-4,
+                               atol=1e-4)
+
+    xg = rng.randn(B, 4 * H).astype("float32")
+    c_prev = rng.randn(B, H).astype("float32")
+    c_out, h_out = _run_op("lstm_unit", {"X": xg, "C_prev": c_prev},
+                           ["C", "H"])
+    i = _sigmoid(xg[:, :H])
+    g = np.tanh(xg[:, H:2 * H])
+    f = _sigmoid(xg[:, 2 * H:3 * H])
+    o = _sigmoid(xg[:, 3 * H:])
+    cref = f * c_prev + i * g
+    np.testing.assert_allclose(c_out, cref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(h_out, o * np.tanh(cref), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_auc_op_streaming():
+    """Graph-op AUC accumulates across runs and matches the exact AUC
+    (r3 weak #5: layers.auc used to raise)."""
+    rng = R(56)
+    n_thresh = 200
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    from paddle_tpu.framework.layer_helper import LayerHelper
+    with pt.program_guard(main_p, startup):
+        pred = layers.data("pred", [8, 2], append_batch_size=False)
+        label = layers.data("label", [8, 1], dtype="int64",
+                            append_batch_size=False)
+        auc_out, stat_pos, stat_neg = layers.auc(
+            pred, label, num_thresholds=n_thresh)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    all_p, all_y = [], []
+    for step in range(4):
+        p1 = rng.rand(8).astype("float32")
+        y = (p1 + 0.3 * rng.randn(8) > 0.5).astype("int64")
+        all_p.append(p1)
+        all_y.append(y)
+        pv = np.stack([1 - p1, p1], axis=1)
+        a, = exe.run(main_p, feed={"pred": pv, "label": y[:, None]},
+                     fetch_list=[auc_out], scope=scope)
+    p = np.concatenate(all_p)
+    y = np.concatenate(all_y)
+    # exact AUC by rank statistic
+    order = np.argsort(p)
+    ranks = np.empty_like(order, dtype="float64")
+    ranks[order] = np.arange(1, len(p) + 1)
+    npos = y.sum()
+    nneg = len(y) - npos
+    exact = (ranks[y == 1].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+    np.testing.assert_allclose(float(np.asarray(a)), exact, atol=0.02)
+
+
+def test_sequence_concat_compacts():
+    x1 = np.array([[[1.], [2.], [0.]], [[5.], [0.], [0.]]], "float32")
+    x2 = np.array([[[3.], [0.]], [[6.], [7.]]], "float32")
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    from paddle_tpu.framework.layer_helper import LayerHelper
+    with pt.program_guard(main_p, startup):
+        a = layers.data("a", [2, 3, 1], append_batch_size=False)
+        b = layers.data("b", [2, 2, 1], append_batch_size=False)
+        la = layers.data("la", [2], dtype="int64", append_batch_size=False)
+        lb = layers.data("lb", [2], dtype="int64", append_batch_size=False)
+        h = LayerHelper("sequence_concat")
+        out = h.create_variable_for_type_inference("float32")
+        h.append_op("sequence_concat",
+                    inputs={"X": [a, b], "Lengths": [la, lb]},
+                    outputs={"Out": [out]})
+    exe = pt.Executor()
+    exe.run(startup)
+    got, = exe.run(main_p,
+                   feed={"a": x1, "b": x2,
+                         "la": np.array([2, 1], "int64"),
+                         "lb": np.array([1, 2], "int64")},
+                   fetch_list=[out])
+    ref = np.array([[[1.], [2.], [3.], [0.], [0.]],
+                    [[5.], [6.], [7.], [0.], [0.]]], "float32")
+    np.testing.assert_allclose(np.asarray(got), ref)
+
+
+def test_sequence_expand_broadcasts():
+    x = np.array([[[1., 2.]], [[3., 4.]]], "float32")   # [2,1,2]
+    y = np.zeros((2, 3, 2), "float32")
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    from paddle_tpu.framework.layer_helper import LayerHelper
+    with pt.program_guard(main_p, startup):
+        a = layers.data("a", [2, 1, 2], append_batch_size=False)
+        yv = layers.data("y", [2, 3, 2], append_batch_size=False)
+        ly = layers.data("ly", [2], dtype="int64", append_batch_size=False)
+        h = LayerHelper("sequence_expand")
+        out = h.create_variable_for_type_inference("float32")
+        h.append_op("sequence_expand",
+                    inputs={"X": [a], "Y": [yv], "YLengths": [ly]},
+                    outputs={"Out": [out]})
+    exe = pt.Executor()
+    exe.run(startup)
+    got, = exe.run(main_p, feed={"a": x, "y": y,
+                                 "ly": np.array([3, 2], "int64")},
+                   fetch_list=[out])
+    ref = np.array([[[1., 2.]] * 3, [[3., 4.], [3., 4.], [0., 0.]]],
+                   "float32")
+    np.testing.assert_allclose(np.asarray(got), ref)
+
+
+def test_edit_distance():
+    hyp = np.array([[1, 2, 3, 0], [4, 5, 0, 0]], "int64")
+    ref = np.array([[1, 3, 3], [4, 4, 5]], "int64")
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    from paddle_tpu.framework.layer_helper import LayerHelper
+    with pt.program_guard(main_p, startup):
+        hv = layers.data("h", [2, 4], dtype="int64",
+                         append_batch_size=False)
+        rv = layers.data("r", [2, 3], dtype="int64",
+                         append_batch_size=False)
+        hl = layers.data("hl", [2], dtype="int64", append_batch_size=False)
+        rl = layers.data("rl", [2], dtype="int64", append_batch_size=False)
+        h = LayerHelper("edit_distance")
+        out = h.create_variable_for_type_inference("float32")
+        num = h.create_variable_for_type_inference("int64")
+        h.append_op("edit_distance",
+                    inputs={"Hyps": [hv], "Refs": [rv],
+                            "HypsLength": [hl], "RefsLength": [rl]},
+                    outputs={"Out": [out], "SequenceNum": [num]})
+    exe = pt.Executor()
+    exe.run(startup)
+    got, = exe.run(main_p,
+                   feed={"h": hyp, "r": ref,
+                         "hl": np.array([3, 2], "int64"),
+                         "rl": np.array([3, 3], "int64")},
+                   fetch_list=[out])
+    # [1,2,3] vs [1,3,3] = 1 sub; [4,5] vs [4,4,5] = 1 insert
+    np.testing.assert_allclose(np.asarray(got)[:, 0], [1.0, 1.0])
+
+
+def test_sampling_id_and_random_batch_size_like():
+    p = np.array([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]], "float32")
+    ids, = _run_op("sampling_id", {"X": p}, ["Out"])
+    assert ids.tolist() == [1, 0]
+    u, = _run_op("uniform_random_batch_size_like", {"Input": X34},
+                 ["Out"], {"shape": [1, 5], "min": 0.0, "max": 1.0})
+    assert u.shape == (3, 5) and (u >= 0).all() and (u <= 1).all()
+    g, = _run_op("gaussian_random_batch_size_like", {"Input": X34},
+                 ["Out"], {"shape": [1, 50], "mean": 0.0, "std": 1.0})
+    assert g.shape == (3, 50) and abs(g.mean()) < 0.5
+
+
+def test_center_loss():
+    rng = R(57)
+    x = rng.randn(4, 3).astype("float32")
+    centers = rng.randn(5, 3).astype("float32")
+    label = np.array([0, 2, 2, 4], "int64")
+    lr = np.array([0.1], "float32")
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    from paddle_tpu.framework.layer_helper import LayerHelper
+    with pt.program_guard(main_p, startup):
+        xv = layers.data("x", [4, 3], append_batch_size=False)
+        lv = layers.data("l", [4], dtype="int64", append_batch_size=False)
+        cv = layers.data("c", [5, 3], append_batch_size=False)
+        rv = layers.data("r", [1], append_batch_size=False)
+        h = LayerHelper("center_loss")
+        loss = h.create_variable_for_type_inference("float32")
+        diff = h.create_variable_for_type_inference("float32")
+        cout = h.create_variable_for_type_inference("float32")
+        h.append_op("center_loss",
+                    inputs={"X": [xv], "Label": [lv], "Centers": [cv],
+                            "CenterUpdateRate": [rv]},
+                    outputs={"Loss": [loss], "SampleCenterDiff": [diff],
+                             "CentersOut": [cout]},
+                    attrs={"need_update": True})
+    exe = pt.Executor()
+    exe.run(startup)
+    lv_, = exe.run(main_p, feed={"x": x, "l": label, "c": centers,
+                                 "r": lr}, fetch_list=[loss])
+    d = x - centers[label]
+    np.testing.assert_allclose(np.asarray(lv_)[:, 0],
+                               0.5 * (d * d).sum(1), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_op_bench_gate_logic(tmp_path):
+    """The per-op perf regression gate (tools/check_op_bench.py) passes
+    on equal numbers, fails on a >threshold regression, and skips on a
+    device mismatch — VERDICT r3 #7; chip-free logic check."""
+    import json
+    import subprocess
+    import sys
+    base = {"device_kind": "TPU v5 lite",
+            "ops": {"matmul": 100.0, "softmax": 50.0}}
+    bp = tmp_path / "base.json"
+    bp.write_text(json.dumps(base))
+
+    def run(res):
+        rp = tmp_path / "res.json"
+        rp.write_text(json.dumps(res))
+        return subprocess.run(
+            [sys.executable, "tools/check_op_bench.py", str(rp),
+             "--baseline", str(bp)], capture_output=True,
+            text=True).returncode
+
+    ok = {"device_kind": "TPU v5 lite",
+          "ops": {"matmul": 110.0, "softmax": 45.0}}
+    assert run(ok) == 0
+    bad = {"device_kind": "TPU v5 lite",
+           "ops": {"matmul": 300.0, "softmax": 45.0}}
+    assert run(bad) == 1
+    newly_failing = {"device_kind": "TPU v5 lite",
+                     "ops": {"matmul": 100.0, "softmax": None}}
+    assert run(newly_failing) == 1
+    other_dev = {"device_kind": "TPU v6 lite", "ops": {"matmul": 9e9}}
+    assert run(other_dev) == 0  # baseline only binds its own hardware
