@@ -37,6 +37,8 @@ from . import inference  # noqa
 from . import profiler  # noqa
 from .flags import get_flags, set_flags  # noqa
 from . import memory  # noqa
+from . import tensor  # noqa  (paddle.tensor 2.0 namespace)
+from . import amp  # noqa  (paddle.amp 2.0 namespace)
 from . import errors  # noqa
 from .errors import EnforceNotMet, enforce  # noqa
 from . import vision  # noqa

@@ -1,0 +1,12 @@
+"""paddle.amp 2.0 namespace (reference python/paddle/amp/__init__.py).
+
+auto_cast is the dygraph autocast guard (dygraph/amp.py amp_guard);
+GradScaler is the dynamic loss scaler; the static-graph decorator lives
+in contrib.mixed_precision (also re-exported here as `decorate` when
+used on an optimizer).
+"""
+from ..dygraph.amp import amp_guard as auto_cast  # noqa: F401
+from ..dygraph.amp import GradScaler  # noqa: F401
+from ..contrib.mixed_precision import decorate  # noqa: F401
+
+__all__ = ["auto_cast", "GradScaler", "decorate"]

@@ -379,6 +379,9 @@ class PServer:
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)
             t.start()
+            # prune finished threads so connection churn (reconnecting
+            # retry clients, heartbeats) doesn't grow the list unboundedly
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
     def _serve_conn(self, conn: socket.socket):
@@ -520,7 +523,10 @@ class RPCClient:
         if resp is None:
             raise ConnectionError("pserver closed connection")
         if resp[0] == 1:
-            raise PSError(bytes(resp[1:]).decode(errors="replace"))
+            msg = bytes(resp[1:]).decode(errors="replace")
+            if msg.startswith("BarrierError:"):
+                raise BarrierError(msg)   # catchable type across RPC
+            raise PSError(msg)
         return memoryview(resp)[1:]
 
     def _call(self, payload: bytes, timeout: Optional[float] = None,

@@ -93,8 +93,34 @@ def _check_defined(vals, names, where):
                 "was never assigned on the executed path")
 
 
+def _undef_only_slots(names, t_outs, f_outs):
+    """Slots where exactly one branch left the name UNDEF and the other
+    made it a tensor — a branch-local scratch variable. Merging it as
+    UNDEF is sound: a later read raises the may-be-unbound NameError
+    the original python would risk, while dead scratch (the common
+    continuation-rewrite case) costs nothing."""
+    out = []
+    for i, (tv, fv) in enumerate(zip(t_outs, f_outs)):
+        one_undef = (isinstance(tv, _Undefined)
+                     != isinstance(fv, _Undefined))
+        other_tensor = isinstance(tv, Variable) or isinstance(fv, Variable)
+        if one_undef and other_tensor:
+            out.append(i)
+    return out
+
+
+def _drop_slots(fn, names, slots):
+    def g(*a):
+        outs = list(fn(*a))
+        for i in slots:
+            outs[i] = _Undefined(names[i])
+        return tuple(outs)
+    return g
+
+
 def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
-                   names: Sequence[str], init: Tuple) -> Tuple:
+                   names: Sequence[str], init: Tuple,
+                   _retried: bool = False) -> Tuple:
     """Returns the post-if values of `names` (every name either branch
     assigns). Branch functions are pure: they take the pre-branch
     values and return the tuple of post-branch values."""
@@ -105,6 +131,8 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
     from ...layers import control_flow
 
     box = {}
+    parent = pred.block
+    n_ops0 = len(parent.ops)
 
     def wrap(fn, key):
         def run():
@@ -114,13 +142,32 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
             return tensors or None
         return run
 
+    def retry_with_undef():
+        if _retried or "t" not in box or "f" not in box:
+            return None
+        slots = _undef_only_slots(names, box["t"], box["f"])
+        if not slots:
+            return None
+        # drop the first attempt's cond2 (and anything after it) from
+        # the parent block — leaving it would trace AND execute both
+        # branch bodies twice per step (orphaned sub-blocks are dead)
+        del parent.ops[n_ops0:]
+        return convert_ifelse(
+            pred, _drop_slots(true_fn, names, slots),
+            _drop_slots(false_fn, names, slots), names, init,
+            _retried=True)
+
     try:
         merged = control_flow.cond(pred, wrap(true_fn, "t"),
                                    wrap(false_fn, "f"))
     except ValueError as e:
-        # cond's arity check fires when one branch made a name a tensor
-        # and the other left it python/undefined — diagnose by name
-        if "arity" in str(e) and "t" in box and "f" in box:
+        # arity / branch-output mismatch: one branch made a name a
+        # tensor (or a different-shaped tensor) the other left alone
+        if ("arity" in str(e) or "branch output mismatch" in str(e)) \
+                and "t" in box and "f" in box:
+            r = retry_with_undef()
+            if r is not None:
+                return r
             for name, tv, fv in zip(names, box["t"], box["f"]):
                 if isinstance(tv, Variable) != isinstance(fv, Variable):
                     raise TypeError(
@@ -139,6 +186,10 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
     # outputs positionally; python-value slots must agree between
     # branches (a tensor pred cannot select between python values)
     t_outs, f_outs = box["t"], box["f"]
+    if not _retried and _undef_only_slots(names, t_outs, f_outs):
+        r = retry_with_undef()
+        if r is not None:
+            return r
     out, mi = [], 0
     for name, tv, fv in zip(names, t_outs, f_outs):
         t_is, f_is = isinstance(tv, Variable), isinstance(fv, Variable)

@@ -133,6 +133,110 @@ def _make_branch_fn(name, params, body, ret_names):
                            type_params=[])
 
 
+_RET_NAME = "__d2s_ret__"
+
+
+def _has_direct_return(stmts) -> bool:
+    found = False
+
+    class V(ast.NodeVisitor):
+        def visit_Return(self, node):
+            nonlocal found
+            found = True
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        def visit_AsyncFunctionDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+    for s in stmts:
+        V().visit(s)
+    return found
+
+
+def _terminates(stmts) -> bool:
+    """Every path through `stmts` ends in a return."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return _terminates(last.body) and _terminates(last.orelse)
+    return False
+
+
+class _UnsupportedReturn(Exception):
+    pass
+
+
+def _rewrite_returns(stmts):
+    """Continuation-style early-return rewrite (reference
+    return_transformer.py, restricted to the always-returns-branch
+    shape): an `if` whose taken branch ALWAYS returns absorbs the rest
+    of the function into its other branch, and every `return X` becomes
+    `__d2s_ret__ = X` — so both branches of the (later-converted) if
+    bind the same name and the tensor merge works. One `return
+    __d2s_ret__` is appended by the caller.
+
+    Unsupported shapes (a return that does not terminate its branch, a
+    return inside a loop) raise _UnsupportedReturn — the function is
+    then left untouched, preserving the old loud-error behavior."""
+    out = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.Return):
+            out.append(ast.Assign(
+                targets=[_name(_RET_NAME, ast.Store())],
+                value=s.value or ast.Constant(None)))
+            return out                      # anything after is dead
+        if isinstance(s, (ast.While, ast.For)) \
+                and _has_direct_return([s]):
+            raise _UnsupportedReturn("return inside a loop")
+        if isinstance(s, ast.If) and _has_direct_return([s]):
+            rest = stmts[i + 1:]
+            if _terminates(s.body):
+                s.body = _rewrite_returns(s.body)
+                s.orelse = _rewrite_returns(list(s.orelse) + rest)
+                out.append(s)
+                return out
+            if s.orelse and _terminates(s.orelse):
+                s.orelse = _rewrite_returns(s.orelse)
+                s.body = _rewrite_returns(list(s.body) + rest)
+                out.append(s)
+                return out
+            raise _UnsupportedReturn(
+                "return does not terminate its branch")
+        out.append(s)
+    return out
+
+
+def _transform_returns(fd: ast.FunctionDef) -> ast.FunctionDef:
+    """Apply the early-return rewrite to a function body when it has
+    returns anywhere but the tail; no-op (with the legacy loud-error
+    path preserved) when the shape is unsupported."""
+    non_tail = _has_direct_return(fd.body[:-1]) or (
+        fd.body and isinstance(fd.body[-1], ast.If)
+        and _has_direct_return([fd.body[-1]]))
+    if not non_tail:
+        return fd
+    import copy
+    try:
+        # rewrite a COPY: _rewrite_returns mutates If nodes in place, so
+        # bailing out mid-rewrite must not leave a half-transformed tree
+        new_body = _rewrite_returns(copy.deepcopy(fd.body))
+    except _UnsupportedReturn:
+        return fd
+    init = ast.Assign(targets=[_name(_RET_NAME, ast.Store())],
+                      value=ast.Constant(None))
+    fd.body = [init] + new_body + [
+        ast.Return(value=_name(_RET_NAME))]
+    return fd
+
+
 class _D2STransformer(ast.NodeTransformer):
     def __init__(self):
         self._n = 0
@@ -266,7 +370,8 @@ def convert_to_static(fn):
     try:
         src = textwrap.dedent(inspect.getsource(fn))
         tree = ast.parse(src)
-        unwrap_decorators(tree)
+        fd = unwrap_decorators(tree)
+        _transform_returns(fd)
         tree = _D2STransformer().visit(tree)
         ast.fix_missing_locations(tree)
         code = compile(tree, filename=f"<d2s {fn.__qualname__}>",

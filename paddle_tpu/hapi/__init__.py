@@ -1,2 +1,5 @@
 """High-level API (reference python/paddle/hapi/)."""
 from .model import Model  # noqa
+from . import callbacks  # noqa
+from .callbacks import (Callback, CallbackList, ProgBarLogger,  # noqa
+                        ModelCheckpoint, LRScheduler, EarlyStopping)

@@ -1,0 +1,220 @@
+"""hapi callbacks (reference python/paddle/hapi/callbacks.py).
+
+The same hook protocol as the reference CallbackList (set_model/
+set_params; on_{train,eval,predict}_{begin,end}; on_epoch_{begin,end};
+on_{train,eval,predict}_batch_{begin,end}), with the standard zoo:
+ProgBarLogger, ModelCheckpoint, LRScheduler, EarlyStopping. Custom
+callbacks subclass Callback and override any hook.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+           "LRScheduler", "EarlyStopping", "config_callbacks"]
+
+
+class Callback:
+    """Base class (reference callbacks.py:129). Hooks default to no-ops;
+    `self.model` and `self.params` are set by the CallbackList."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = dict(params or {})
+
+    # train / eval / predict lifecycle
+    def on_train_begin(self, logs=None): pass
+    def on_train_end(self, logs=None): pass
+    def on_eval_begin(self, logs=None): pass
+    def on_eval_end(self, logs=None): pass
+    def on_predict_begin(self, logs=None): pass
+    def on_predict_end(self, logs=None): pass
+    def on_epoch_begin(self, epoch, logs=None): pass
+    def on_epoch_end(self, epoch, logs=None): pass
+    def on_train_batch_begin(self, step, logs=None): pass
+    def on_train_batch_end(self, step, logs=None): pass
+    def on_eval_batch_begin(self, step, logs=None): pass
+    def on_eval_batch_end(self, step, logs=None): pass
+    def on_predict_batch_begin(self, step, logs=None): pass
+    def on_predict_batch_end(self, step, logs=None): pass
+
+
+class CallbackList:
+    """Fans one hook call out to every callback
+    (reference callbacks.py:72)."""
+
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb: Callback):
+        self.callbacks.append(cb)
+
+    def set_model(self, model):
+        for cb in self.callbacks:
+            cb.set_model(model)
+
+    def set_params(self, params):
+        for cb in self.callbacks:
+            cb.set_params(params)
+
+    def __getattr__(self, name):
+        if not name.startswith("on_"):
+            raise AttributeError(name)
+
+        def call(*args, **kwargs):
+            for cb in self.callbacks:
+                getattr(cb, name)(*args, **kwargs)
+
+        return call
+
+
+class ProgBarLogger(Callback):
+    """Per-step / per-epoch console logging
+    (reference callbacks.py:298)."""
+
+    def __init__(self, log_freq: int = 1, verbose: int = 2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+        if self.verbose:
+            steps = self.params.get("steps")
+            print(f"Epoch {epoch + 1}/{self.params.get('epochs', '?')}"
+                  + (f" ({steps} steps)" if steps else ""))
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose >= 1 and step % self.log_freq == 0:
+            items = " - ".join(f"{k}: {v:.4f}" if np.isscalar(v) else
+                               f"{k}: {v}" for k, v in (logs or {}).items())
+            print(f"step {step}: {items}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            items = " - ".join(f"{k}: {v:.4f}" if np.isscalar(v) else
+                               f"{k}: {v}" for k, v in (logs or {}).items())
+            print(f"Epoch {epoch + 1} done in {dt:.1f}s - {items}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            print("Eval:", logs)
+
+
+class ModelCheckpoint(Callback):
+    """Save the model every `save_freq` epochs and at train end
+    (reference callbacks.py:442): <save_dir>/<epoch>.pdparams +
+    <save_dir>/final.pdparams."""
+
+    def __init__(self, save_freq: int = 1, save_dir: str = "checkpoint"):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and epoch % self.save_freq == 0:
+            os.makedirs(self.save_dir, exist_ok=True)
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+    def on_train_end(self, logs=None):
+        if self.model is not None:
+            os.makedirs(self.save_dir, exist_ok=True)
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRScheduler(Callback):
+    """Step the optimizer's LR schedule per epoch (or per batch when
+    by_step=True) — reference callbacks.py:505 drives
+    optimizer._learning_rate.step()."""
+
+    def __init__(self, by_step: bool = False, by_epoch: bool = True):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch and not by_step
+
+    def _step(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        if hasattr(lr, "step"):
+            lr.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            self._step()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            self._step()
+
+
+class EarlyStopping(Callback):
+    """Stop training when `monitor` stops improving
+    (reference callbacks.py:595). Monitors eval logs when eval_data is
+    given, else train epoch logs."""
+
+    def __init__(self, monitor: str = "loss", mode: str = "min",
+                 patience: int = 0, min_delta: float = 0.0,
+                 baseline: Optional[float] = None, verbose: int = 1):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.verbose = verbose
+        if mode not in ("min", "max"):
+            mode = "min"
+        self._better = ((lambda a, b: a < b - self.min_delta)
+                        if mode == "min"
+                        else (lambda a, b: a > b + self.min_delta))
+        self.best = baseline if baseline is not None else (
+            np.inf if mode == "min" else -np.inf)
+        self.wait = 0
+        self.stopped_epoch = None
+
+    def _check(self, logs):
+        v = (logs or {}).get(self.monitor)
+        if v is None:
+            return
+        v = float(np.asarray(v).reshape(-1)[0])
+        if self._better(v, self.best):
+            self.best = v
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience and self.model is not None:
+                self.model.stop_training = True
+                if self.verbose:
+                    print(f"EarlyStopping: no {self.monitor} improvement "
+                          f"for {self.wait} checks (best {self.best:.4f})")
+
+    def on_eval_end(self, logs=None):
+        self._check(logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.stopped_epoch is None and not self.params.get("has_eval"):
+            self._check(logs)
+
+
+def config_callbacks(callbacks, model, epochs=None, steps=None,
+                     verbose=2, log_freq=1, has_eval=False):
+    """Assemble the CallbackList the way reference fit() does: user
+    callbacks + a default ProgBarLogger when verbose."""
+    cbs = list(callbacks or [])
+    if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
+        cbs = [ProgBarLogger(log_freq, verbose=verbose)] + cbs
+    clist = CallbackList(cbs)
+    clist.set_model(model)
+    clist.set_params({"epochs": epochs, "steps": steps,
+                      "verbose": verbose, "has_eval": has_eval})
+    return clist

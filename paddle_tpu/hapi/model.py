@@ -16,7 +16,7 @@ import numpy as np
 from .. import dygraph
 from ..reader import DataLoader, Dataset
 
-__all__ = ["Model"]
+__all__ = ["Model"]  # callbacks in .callbacks
 
 
 def _as_loader(data, batch_size, shuffle):
@@ -45,6 +45,7 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics: List = []
+        self.stop_training = False   # set by EarlyStopping
 
     # -- configuration ------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None):
@@ -91,48 +92,77 @@ class Model:
 
     # -- loops --------------------------------------------------------------
     def fit(self, train_data, eval_data=None, batch_size=1, epochs=1,
-            shuffle=True, verbose=1, log_freq=50):
+            shuffle=True, verbose=1, log_freq=50, callbacks=None):
+        """Reference hapi fit (model.py:1637) incl. the callback
+        protocol (callbacks.py): user callbacks + a default
+        ProgBarLogger get the full on_train/on_epoch/on_batch hook
+        sequence; EarlyStopping may set model.stop_training."""
+        from .callbacks import config_callbacks
         loader = _as_loader(train_data, batch_size, shuffle)
+        cbks = config_callbacks(callbacks, self, epochs=epochs,
+                                verbose=verbose, log_freq=log_freq,
+                                has_eval=eval_data is not None)
         history = {"loss": []}
+        self.stop_training = False
+        cbks.on_train_begin()
         for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
             n_batches = 0
             for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
                 inputs, labels = _split_batch(batch)
                 loss, pred = self.train_batch(inputs, labels)
                 history["loss"].append(loss)
                 n_batches += 1
                 self._update_metrics(pred, labels)
-                if verbose and step % log_freq == 0:
-                    print(f"epoch {epoch} step {step}: loss={loss:.4f} "
-                          + self._metric_str())
+                logs = {"loss": loss}
+                for m in self._metrics:
+                    logs[m.name()] = m.accumulate()
+                cbks.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
             if not n_batches:
                 raise ValueError(
                     f"fit: training data yielded no batches in epoch "
                     f"{epoch} (exhausted generator?)")
-            if verbose:
-                print(f"epoch {epoch} done: loss={history['loss'][-1]:.4f}"
-                      f" {self._metric_str()}")
-            if eval_data is not None:
+            epoch_logs = {"loss": history["loss"][-1]}
+            for m in self._metrics:
+                epoch_logs[m.name()] = m.accumulate()
+            cbks.on_epoch_end(epoch, epoch_logs)
+            if eval_data is not None and not self.stop_training:
                 self.evaluate(eval_data, batch_size=batch_size,
-                              verbose=verbose)
+                              verbose=0, _cbks=cbks)
+            if self.stop_training:
+                break
+        cbks.on_train_end({"loss": history["loss"][-1]
+                           if history["loss"] else None})
         return history
 
-    def evaluate(self, eval_data, batch_size=1, verbose=1):
+    def evaluate(self, eval_data, batch_size=1, verbose=1, callbacks=None,
+                 _cbks=None):
+        from .callbacks import config_callbacks
+        cbks = _cbks if _cbks is not None else config_callbacks(
+            callbacks, self, verbose=0)
         loader = _as_loader(eval_data, batch_size, shuffle=False)
         for m in self._metrics:
             m.reset()
         losses = []
-        for batch in loader:
+        cbks.on_eval_begin()
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
             inputs, labels = _split_batch(batch)
             loss, pred = self.eval_batch(inputs, labels)
             if loss is not None:
                 losses.append(loss)
             self._update_metrics(pred, labels)
+            cbks.on_eval_batch_end(
+                step, {"loss": loss} if loss is not None else {})
         result = {"loss": float(np.mean(losses)) if losses else None}
         for m in self._metrics:
             result[m.name()] = m.accumulate()
+        cbks.on_eval_end(result)
         if verbose:
             print("eval:", result)
         return result

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 from typing import Dict, List
 
+import numpy as np
+
 from .registry import LowerContext, lower_op, register_op
 
 
@@ -106,7 +108,13 @@ def _cond2(ctx, op):
     reads = [n for n in dict.fromkeys(_external_reads(tblk, None) +
                                       _external_reads(fblk, None))
              if n in ctx.env]
-    pred = jnp.reshape(ctx.get_input(op, "Cond"), ()).astype(bool)
+    cond_in = ctx.get_input(op, "Cond")
+    if int(np.prod(jnp.shape(cond_in))) != 1:
+        raise TypeError(
+            f"cond: the condition must be a scalar (1-element) tensor, "
+            f"got shape {tuple(jnp.shape(cond_in))} — reduce it first "
+            "(e.g. reduce_any/reduce_all) or compare to a scalar")
+    pred = jnp.reshape(cond_in, ()).astype(bool)
 
     def _branch(blk, outs):
         def fn(carry):

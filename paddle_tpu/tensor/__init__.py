@@ -1,0 +1,232 @@
+"""paddle.tensor 2.0 namespace (reference python/paddle/tensor/__init__.py
+— an 8.7K-LoC re-export surface over creation/math/manipulation/linalg/
+logic/random/search/stat kernels).
+
+Re-exports the framework's layer builders under the 2.0 names; ops with
+no fluid-layer front get thin builders here. Every symbol appends graph
+ops in static mode and traces eagerly in dygraph, exactly like the
+`paddle.*` flat namespace the reference aliases these into.
+"""
+from __future__ import annotations
+
+from ..framework.layer_helper import LayerHelper
+from ..layers import (  # noqa: F401
+    # creation
+    fill_constant, zeros, ones, zeros_like, ones_like, full, full_like,
+    arange, linspace, eye, assign, diag, meshgrid,
+    # random
+    uniform_random as uniform, gaussian_random as normal, multinomial,
+    # math
+    abs, ceil, floor, round, exp, log, sqrt, square, reciprocal, sin,
+    cos, erf, cumsum, cumprod, clip, pow,
+    elementwise_add as add, elementwise_sub as subtract,
+    elementwise_mul as multiply, elementwise_div as divide,
+    elementwise_mod as mod,
+    elementwise_max as maximum, elementwise_min as minimum,
+    elementwise_pow,
+    reduce_sum as sum, reduce_mean as mean, reduce_max as amax,
+    reduce_min as amin, reduce_prod as prod,
+    matmul, bmm, dot, kron, cross, dist, trace,
+    # manipulation
+    concat, stack, unstack, split, squeeze, unsqueeze, reshape,
+    transpose, flatten, tile, expand, expand_as, flip, roll, gather,
+    gather_nd, scatter, scatter_nd_add, slice, strided_slice,
+    index_select, index_sample, one_hot,
+    multiplex,
+    # search / sort
+    argsort, where, sort,
+    # logic
+    equal, not_equal, greater_than, greater_equal, less_than,
+    less_equal, logical_and, logical_or, logical_not, logical_xor,
+    isfinite,
+    # linalg-ish
+    cholesky, inverse, norm, histogram, t,
+    # misc
+    cast, shape, increment, cos_sim,
+)
+
+
+def _simple(op_type):
+    """Thin 2.0 front for a unary op with no fluid-layer builder."""
+
+    def fn(x, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(op_type, inputs={"X": [x]},
+                         outputs={"Out": [out]}, attrs=attrs)
+        return out
+
+    fn.__name__ = op_type
+    return fn
+
+
+log2 = _simple("log2")
+log10 = _simple("log10")
+log1p = _simple("log1p")
+rsqrt = _simple("rsqrt")
+sign = _simple("sign")
+tan = _simple("tan")
+sinh = _simple("sinh")
+cosh = _simple("cosh")
+asin = _simple("asin")
+acos = _simple("acos")
+atan = _simple("atan")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    helper = LayerHelper("logsumexp", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    # reduce-op attr convention: dim / keep_dim / reduce_all
+    attrs = {"keep_dim": keepdim}
+    if axis is None:
+        attrs["reduce_all"] = True
+    else:
+        attrs["dim"] = list(axis) if isinstance(axis, (list, tuple)) \
+            else [axis]
+    helper.append_op("logsumexp", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def floor_divide(x, y, name=None):
+    helper = LayerHelper("elementwise_floordiv", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("elementwise_floordiv", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    helper = LayerHelper("take_along_axis", name=name)
+    out = helper.create_variable_for_type_inference(arr.dtype)
+    helper.append_op("take_along_axis",
+                     inputs={"Input": [arr], "Index": [indices]},
+                     outputs={"Result": [out]}, attrs={"Axis": axis})
+    return out
+
+
+def masked_select(x, mask, name=None):
+    helper = LayerHelper("masked_select", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("masked_select",
+                     inputs={"X": [x], "Mask": [mask]},
+                     outputs={"Y": [out]})
+    return out
+
+
+def unique(x, name=None):
+    helper = LayerHelper("unique", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    idx = helper.create_variable_for_type_inference("int64")
+    helper.append_op("unique", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [idx]})
+    return out
+
+
+def tril(x, diagonal=0, name=None):
+    helper = LayerHelper("tril_triu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("tril_triu", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"diagonal": diagonal, "lower": True})
+    return out
+
+
+def triu(x, diagonal=0, name=None):
+    helper = LayerHelper("tril_triu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("tril_triu", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"diagonal": diagonal, "lower": False})
+    return out
+
+
+def unbind(x, axis=0, name=None):
+    helper = LayerHelper("unbind", name=name)
+    n = int(x.shape[axis])
+    outs = [helper.create_variable_for_type_inference(x.dtype)
+            for _ in range(n)]
+    helper.append_op("unbind", inputs={"X": [x]},
+                     outputs={"Out": outs}, attrs={"axis": axis})
+    return outs
+
+
+def argmax(x, axis=-1, keepdim=False, name=None):
+    helper = LayerHelper("arg_max", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("arg_max", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"axis": axis, "keepdims": keepdim})
+    return out
+
+
+def argmin(x, axis=-1, keepdim=False, name=None):
+    helper = LayerHelper("arg_min", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("arg_min", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"axis": axis, "keepdims": keepdim})
+    return out
+
+
+def topk(x, k=1, axis=-1, name=None):
+    helper = LayerHelper("top_k_v2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    idx = helper.create_variable_for_type_inference("int64")
+    helper.append_op("top_k_v2", inputs={"X": [x]},
+                     outputs={"Out": [out], "Indices": [idx]},
+                     attrs={"k": k, "axis": axis})
+    return out, idx
+
+
+def isinf(x, name=None):
+    helper = LayerHelper("isinf_v2", name=name)
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op("isinf_v2", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def isnan(x, name=None):
+    helper = LayerHelper("isnan_v2", name=name)
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op("isnan_v2", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    helper = LayerHelper("allclose", name=name)
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op("allclose", inputs={"Input": [x], "Other": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"rtol": float(rtol), "atol": float(atol),
+                            "equal_nan": equal_nan})
+    return out
+
+
+def randint(low, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    helper = LayerHelper("randint", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("randint", inputs={}, outputs={"Out": [out]},
+                     attrs={"low": int(low), "high": int(high),
+                            "shape": list(shape)})
+    return out
+
+
+def randperm(n, dtype="int64", name=None):
+    helper = LayerHelper("randperm", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("randperm", inputs={}, outputs={"Out": [out]},
+                     attrs={"n": int(n)})
+    return out
+
+
+def bernoulli(x, name=None):
+    helper = LayerHelper("bernoulli", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("bernoulli", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out

@@ -69,14 +69,27 @@ def test_static_bool_of_variable_raises():
         bool(layers.reduce_sum(x))
 
 
-def test_to_static_rejects_tensor_if():
+def test_to_static_tensor_if_semantics():
     from paddle_tpu.dygraph.jit import declarative
 
+    # scalar-tensor condition + early return now CONVERTS (r4: the
+    # return transformer) and takes the truthy branch
     @declarative
     def f(a):
-        if a.sum() if hasattr(a, "sum") else a:  # tensor truthiness
+        import paddle_tpu as _pt
+        if _pt.layers.reduce_sum(a):
             return a
         return a * 2
 
-    with pytest.raises(TypeError, match="control flow"):
-        f(np.ones((2,), "float32"))
+    out = f(np.ones((2,), "float32"))
+    np.testing.assert_allclose(np.asarray(out._value), np.ones(2))
+
+    # a NON-scalar tensor condition stays rejected, with a clear error
+    @declarative
+    def g(a):
+        if a:                      # [2]-shaped truthiness: ambiguous
+            return a
+        return a * 2
+
+    with pytest.raises(Exception, match="scalar"):
+        g(np.ones((2,), "float32"))

@@ -160,3 +160,136 @@ def test_io20_namespace():
     assert io.DataLoader is pt.DataLoader
     ds = io.TensorDataset(np.arange(6).reshape(3, 2))
     assert len(ds) == 3
+
+
+# ---------------------------------------------------------------------------
+# callbacks (VERDICT r3 #9)
+# ---------------------------------------------------------------------------
+
+def _cb_model():
+    import paddle_tpu as pt
+    from paddle_tpu import nn, hapi
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.nn import CrossEntropyLoss
+    with pt.dygraph.guard():
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m = hapi.Model(net)
+    m.prepare(optimizer=opt.AdamOptimizer(1e-2),
+              loss=CrossEntropyLoss())
+    return m
+
+
+def _cb_data(n=32):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 4).astype("float32")
+    y = (x.sum(1) > 0).astype("int64")[:, None]
+    return [(x[i], y[i]) for i in range(n)]
+
+
+def test_callbacks_hooks_fire_in_order():
+    from paddle_tpu.hapi import Callback
+
+    events = []
+
+    class Recorder(Callback):
+        def on_train_begin(self, logs=None):
+            events.append("train_begin")
+
+        def on_epoch_begin(self, epoch, logs=None):
+            events.append(f"epoch_begin_{epoch}")
+
+        def on_train_batch_end(self, step, logs=None):
+            if step == 0:
+                events.append(f"batch_end_{step}")
+                assert "loss" in (logs or {})
+
+        def on_epoch_end(self, epoch, logs=None):
+            events.append(f"epoch_end_{epoch}")
+
+        def on_train_end(self, logs=None):
+            events.append("train_end")
+
+    m = _cb_model()
+    m.fit(_cb_data(), batch_size=8, epochs=2, verbose=0,
+          callbacks=[Recorder()])
+    assert events == ["train_begin", "epoch_begin_0", "batch_end_0",
+                      "epoch_end_0", "epoch_begin_1", "batch_end_0",
+                      "epoch_end_1", "train_end"]
+
+
+def test_model_checkpoint_callback(tmp_path):
+    from paddle_tpu.hapi import ModelCheckpoint
+
+    m = _cb_model()
+    save_dir = str(tmp_path / "ckpt")
+    m.fit(_cb_data(), batch_size=8, epochs=2, verbose=0,
+          callbacks=[ModelCheckpoint(save_freq=1, save_dir=save_dir)])
+    import os
+    assert os.path.exists(os.path.join(save_dir, "0.pdparams"))
+    assert os.path.exists(os.path.join(save_dir, "1.pdparams"))
+    assert os.path.exists(os.path.join(save_dir, "final.pdparams"))
+    # weights reload into a fresh model
+    m2 = _cb_model()
+    m2.load(os.path.join(save_dir, "final"))
+
+
+def test_early_stopping_callback():
+    from paddle_tpu.hapi import EarlyStopping
+
+    m = _cb_model()
+    # patience 0 + impossible baseline: stops after the first epoch
+    es = EarlyStopping(monitor="loss", mode="min", patience=0,
+                       baseline=-1e9, verbose=0)
+    m.fit(_cb_data(), batch_size=8, epochs=50, verbose=0,
+          callbacks=[es])
+    assert m.stop_training
+
+
+# ---------------------------------------------------------------------------
+# paddle.tensor / paddle.amp namespaces (VERDICT r3 #9)
+# ---------------------------------------------------------------------------
+
+def test_tensor_namespace_smoke():
+    import paddle_tpu as pt
+    import paddle_tpu.tensor as T
+
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main_p, startup):
+        x = pt.layers.data("x", [3, 4], append_batch_size=False)
+        y = T.add(T.multiply(x, x), T.ones_like(x))
+        s = T.sum(y, dim=1)
+        mx = T.argmax(y, axis=1)
+        lse = T.logsumexp(x, axis=1)
+        tri = T.tril(x)
+        top_v, top_i = T.topk(x, k=2)
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.arange(12, dtype="float32").reshape(3, 4)
+    sv, mv, lv, tv, tvv = exe.run(
+        main_p, feed={"x": xv}, fetch_list=[s, mx, lse, tri, top_v])
+    np.testing.assert_allclose(np.asarray(sv), (xv * xv + 1).sum(1))
+    np.testing.assert_allclose(np.asarray(mv), np.argmax(xv * xv + 1, 1))
+    np.testing.assert_allclose(
+        np.asarray(lv),
+        np.log(np.exp(xv - xv.max(1, keepdims=True)).sum(1))
+        + xv.max(1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(tv), np.tril(xv))
+    np.testing.assert_allclose(np.asarray(tvv), np.sort(xv, 1)[:, -2:][:, ::-1])
+
+
+def test_amp_namespace_smoke():
+    import paddle_tpu as pt
+    from paddle_tpu import amp
+
+    with pt.dygraph.guard():
+        import paddle_tpu.dygraph as dg
+        lin = pt.nn.Linear(4, 4)
+        x = dg.to_variable(np.ones((2, 4), "float32"))
+        with amp.auto_cast():
+            y = lin(x)
+        scaler = amp.GradScaler(init_loss_scaling=128.0)
+        loss = pt.layers.reduce_mean(y)
+        scaled = scaler.scale(loss)
+        assert scaled is not None
+    assert callable(amp.decorate)
