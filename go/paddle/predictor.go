@@ -1,0 +1,148 @@
+// Package paddle: Go inference client over the paddle_tpu C ABI
+// (reference go/paddle/predictor.go over the C API of
+// paddle/fluid/inference/capi).
+//
+// Build: requires cgo and libpaddle_tpu_c.so (built by
+// paddle_tpu/native/embed.py) on the linker path:
+//
+//	CGO_LDFLAGS="-L${REPO}/paddle_tpu/native -lpaddle_tpu_c" go build
+//
+// NOTE: the build environment of this repo has no Go toolchain — this
+// client mirrors the reference API surface 1:1 over the TESTED C ABI
+// (paddle_tpu/native/capi.cc, exercised by tests/test_native_entries.py);
+// compile it wherever Go is available.
+package paddle
+
+/*
+#cgo LDFLAGS: -lpaddle_tpu_c
+#include <stdlib.h>
+#include "paddle_tpu_c_api.h"
+
+// cgo cannot index C pointer arrays from Go slices of pointers directly;
+// small helpers keep the hot path in C.
+static int pt_run(PT_Predictor* p, const float** ins, const long** shapes,
+                  const long* ndims, long n) {
+    return PT_PredictorRun(p, ins, shapes, ndims, n);
+}
+*/
+import "C"
+
+import (
+	"errors"
+	"unsafe"
+)
+
+// Predictor wraps a native paddle_tpu inference session.
+type Predictor struct {
+	ptr *C.PT_Predictor
+}
+
+// NewPredictor loads a saved inference model directory
+// (io.save_inference_model output).
+func NewPredictor(modelDir string) (*Predictor, error) {
+	cdir := C.CString(modelDir)
+	defer C.free(unsafe.Pointer(cdir))
+	p := C.PT_CreatePredictor(cdir)
+	if p == nil {
+		return nil, errors.New("paddle: PT_CreatePredictor failed for " + modelDir)
+	}
+	return &Predictor{ptr: p}, nil
+}
+
+// Delete releases the native predictor.
+func (p *Predictor) Delete() {
+	if p.ptr != nil {
+		C.PT_DeletePredictor(p.ptr)
+		p.ptr = nil
+	}
+}
+
+// InputNames returns the feed names in declaration order.
+func (p *Predictor) InputNames() []string {
+	n := int(C.PT_GetInputNum(p.ptr))
+	names := make([]string, n)
+	for i := 0; i < n; i++ {
+		names[i] = C.GoString(C.PT_GetInputName(p.ptr, C.long(i)))
+	}
+	return names
+}
+
+// OutputNames returns the fetch names in declaration order.
+func (p *Predictor) OutputNames() []string {
+	n := int(C.PT_GetOutputNum(p.ptr))
+	names := make([]string, n)
+	for i := 0; i < n; i++ {
+		names[i] = C.GoString(C.PT_GetOutputName(p.ptr, C.long(i)))
+	}
+	return names
+}
+
+// Tensor is one dense float32 input/output.
+type Tensor struct {
+	Shape []int64
+	Data  []float32
+}
+
+// Run feeds `inputs` (aligned with InputNames) and executes the model.
+func (p *Predictor) Run(inputs []Tensor) error {
+	n := len(inputs)
+	ins := make([]*C.float, n)
+	shapes := make([]*C.long, n)
+	ndims := make([]C.long, n)
+	// keep Go slices alive across the call
+	pinShapes := make([][]C.long, n)
+	for i, t := range inputs {
+		if len(t.Data) > 0 {
+			ins[i] = (*C.float)(unsafe.Pointer(&t.Data[0]))
+		}
+		cs := make([]C.long, len(t.Shape))
+		for j, d := range t.Shape {
+			cs[j] = C.long(d)
+		}
+		pinShapes[i] = cs
+		if len(cs) > 0 {
+			shapes[i] = &cs[0]
+		}
+		ndims[i] = C.long(len(t.Shape))
+	}
+	var insP **C.float
+	var shapesP **C.long
+	var ndimsP *C.long
+	if n > 0 {
+		insP = &ins[0]
+		shapesP = &shapes[0]
+		ndimsP = &ndims[0]
+	}
+	rc := C.pt_run(p.ptr, (**C.float)(unsafe.Pointer(insP)),
+		(**C.long)(unsafe.Pointer(shapesP)), ndimsP, C.long(n))
+	_ = pinShapes
+	if rc != 0 {
+		return errors.New("paddle: PT_PredictorRun failed")
+	}
+	return nil
+}
+
+// GetOutput copies output i of the last Run.
+func (p *Predictor) GetOutput(i int) (Tensor, error) {
+	var shape [16]C.long
+	var ndim C.long
+	// size query pass (capacity 0 reports the element count)
+	n := C.PT_GetOutput(p.ptr, C.long(i), nil, 0, &shape[0], 16, &ndim)
+	if n < 0 {
+		return Tensor{}, errors.New("paddle: PT_GetOutput failed")
+	}
+	buf := make([]float32, int(n))
+	var bufP *C.float
+	if n > 0 {
+		bufP = (*C.float)(unsafe.Pointer(&buf[0]))
+	}
+	if C.PT_GetOutput(p.ptr, C.long(i), bufP, n, &shape[0], 16,
+		&ndim) < 0 {
+		return Tensor{}, errors.New("paddle: PT_GetOutput failed")
+	}
+	out := Tensor{Data: buf, Shape: make([]int64, int(ndim))}
+	for j := 0; j < int(ndim); j++ {
+		out.Shape[j] = int64(shape[j])
+	}
+	return out, nil
+}

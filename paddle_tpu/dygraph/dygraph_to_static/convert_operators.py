@@ -131,7 +131,10 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
     from ...layers import control_flow
 
     box = {}
-    parent = pred.block
+    # cond2 is appended to the program's CURRENT block (which may be a
+    # sub-block when this if is nested inside another converted branch)
+    # — pred.block can be an outer block and rollback would miss the op
+    parent = pred.block.program.current_block()
     n_ops0 = len(parent.ops)
 
     def wrap(fn, key):

@@ -182,7 +182,9 @@ class EarlyStopping(Callback):
         self.wait = 0
         self.stopped_epoch = None
 
-    def _check(self, logs):
+    def _check(self, logs, epoch=None):
+        if self.stopped_epoch is not None:
+            return
         v = (logs or {}).get(self.monitor)
         if v is None:
             return
@@ -194,16 +196,20 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait > self.patience and self.model is not None:
                 self.model.stop_training = True
+                self.stopped_epoch = epoch
                 if self.verbose:
                     print(f"EarlyStopping: no {self.monitor} improvement "
                           f"for {self.wait} checks (best {self.best:.4f})")
 
     def on_eval_end(self, logs=None):
-        self._check(logs)
+        self._check(logs, getattr(self, "_epoch", None))
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
 
     def on_epoch_end(self, epoch, logs=None):
-        if self.stopped_epoch is None and not self.params.get("has_eval"):
-            self._check(logs)
+        if not self.params.get("has_eval"):
+            self._check(logs, epoch)
 
 
 def config_callbacks(callbacks, model, epochs=None, steps=None,

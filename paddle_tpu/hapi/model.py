@@ -118,8 +118,12 @@ class Model:
                 n_batches += 1
                 self._update_metrics(pred, labels)
                 logs = {"loss": loss}
-                for m in self._metrics:
-                    logs[m.name()] = m.accumulate()
+                # metric accumulate() per batch is hot-loop overhead;
+                # only pay it when something will read it (a user
+                # callback, or the default logger's log_freq tick)
+                if callbacks or step % max(1, log_freq) == 0:
+                    for m in self._metrics:
+                        logs[m.name()] = m.accumulate()
                 cbks.on_train_batch_end(step, logs)
                 if self.stop_training:
                     break

@@ -389,3 +389,34 @@ def test_undef_retry_leaves_single_cond():
     n_conds = sum(1 for op in main_p.global_block().ops
                   if op.type == "cond2")
     assert n_conds == 1, f"expected 1 cond2, found {n_conds}"
+
+
+def test_undef_retry_nested_block_rollback():
+    """The retry rollback must target the CURRENT (possibly nested)
+    block, not the predicate's home block — an outer-block predicate
+    used inside another converted branch must not leave a duplicate
+    cond2 in the sub-block."""
+    from paddle_tpu.dygraph.dygraph_to_static.program_translator import (
+        convert_to_static)
+
+    def f(x):
+        c = pt.layers.reduce_sum(x) > 0        # predicate in root block
+        if pt.layers.reduce_sum(x) < 100.0:
+            if c:                              # nested converted if
+                z = x * 2.0                    # noqa: F841 scratch
+            else:
+                w = x - 1.0                    # noqa: F841
+            y = x + 1.0
+        else:
+            y = x
+        return y
+
+    fs = convert_to_static(f)
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main_p, startup):
+        xv = pt.layers.data("x", [2], append_batch_size=False)
+        fs(xv)
+    n_conds = sum(1 for blk in main_p.blocks for op in blk.ops
+                  if op.type == "cond2")
+    assert n_conds == 2, f"expected 2 cond2 ops, found {n_conds}"
