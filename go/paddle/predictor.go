@@ -84,38 +84,50 @@ type Tensor struct {
 }
 
 // Run feeds `inputs` (aligned with InputNames) and executes the model.
+//
+// All pointer arrays AND the data/shape buffers are copied into
+// C-allocated memory: passing a Go pointer to memory that itself holds
+// Go pointers violates the cgo pointer-passing rules (panics under the
+// default cgocheck), and C-side copies also pin nothing against the GC.
 func (p *Predictor) Run(inputs []Tensor) error {
 	n := len(inputs)
-	ins := make([]*C.float, n)
-	shapes := make([]*C.long, n)
-	ndims := make([]C.long, n)
-	// keep Go slices alive across the call
-	pinShapes := make([][]C.long, n)
+	if n == 0 {
+		return errors.New("paddle: Run needs at least one input")
+	}
+	ptrSz := C.size_t(unsafe.Sizeof(uintptr(0)))
+	longSz := C.size_t(unsafe.Sizeof(C.long(0)))
+	ins := (*[1 << 20]*C.float)(C.malloc(C.size_t(n) * ptrSz))
+	shapes := (*[1 << 20]*C.long)(C.malloc(C.size_t(n) * ptrSz))
+	ndims := (*[1 << 20]C.long)(C.malloc(C.size_t(n) * longSz))
+	var owned []unsafe.Pointer
+	defer func() {
+		for _, q := range owned {
+			C.free(q)
+		}
+		C.free(unsafe.Pointer(ins))
+		C.free(unsafe.Pointer(shapes))
+		C.free(unsafe.Pointer(ndims))
+	}()
 	for i, t := range inputs {
-		if len(t.Data) > 0 {
-			ins[i] = (*C.float)(unsafe.Pointer(&t.Data[0]))
+		nd := len(t.Shape)
+		dbuf := C.malloc(C.size_t(len(t.Data)+1) * 4)
+		owned = append(owned, dbuf)
+		dslice := (*[1 << 28]C.float)(dbuf)
+		for j, v := range t.Data {
+			dslice[j] = C.float(v)
 		}
-		cs := make([]C.long, len(t.Shape))
+		sbuf := C.malloc(C.size_t(nd+1) * longSz)
+		owned = append(owned, sbuf)
+		sslice := (*[64]C.long)(sbuf)
 		for j, d := range t.Shape {
-			cs[j] = C.long(d)
+			sslice[j] = C.long(d)
 		}
-		pinShapes[i] = cs
-		if len(cs) > 0 {
-			shapes[i] = &cs[0]
-		}
-		ndims[i] = C.long(len(t.Shape))
+		ins[i] = &dslice[0]
+		shapes[i] = &sslice[0]
+		ndims[i] = C.long(nd)
 	}
-	var insP **C.float
-	var shapesP **C.long
-	var ndimsP *C.long
-	if n > 0 {
-		insP = &ins[0]
-		shapesP = &shapes[0]
-		ndimsP = &ndims[0]
-	}
-	rc := C.pt_run(p.ptr, (**C.float)(unsafe.Pointer(insP)),
-		(**C.long)(unsafe.Pointer(shapesP)), ndimsP, C.long(n))
-	_ = pinShapes
+	rc := C.pt_run(p.ptr, (**C.float)(unsafe.Pointer(ins)),
+		(**C.long)(unsafe.Pointer(shapes)), &ndims[0], C.long(n))
 	if rc != 0 {
 		return errors.New("paddle: PT_PredictorRun failed")
 	}
@@ -140,8 +152,12 @@ func (p *Predictor) GetOutput(i int) (Tensor, error) {
 		&ndim) < 0 {
 		return Tensor{}, errors.New("paddle: PT_GetOutput failed")
 	}
-	out := Tensor{Data: buf, Shape: make([]int64, int(ndim))}
-	for j := 0; j < int(ndim); j++ {
+	nd := int(ndim)
+	if nd > len(shape) { // C truncates writes at max_ndim; clamp reads too
+		nd = len(shape)
+	}
+	out := Tensor{Data: buf, Shape: make([]int64, nd)}
+	for j := 0; j < nd; j++ {
 		out.Shape[j] = int64(shape[j])
 	}
 	return out, nil

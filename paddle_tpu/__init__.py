@@ -38,6 +38,7 @@ from . import profiler  # noqa
 from .flags import get_flags, set_flags  # noqa
 from . import memory  # noqa
 from . import tensor  # noqa  (paddle.tensor 2.0 namespace)
+from . import monitor  # noqa  (StatRegistry + graphviz dumps)
 from . import amp  # noqa  (paddle.amp 2.0 namespace)
 from . import errors  # noqa
 from .errors import EnforceNotMet, enforce  # noqa

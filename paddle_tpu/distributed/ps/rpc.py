@@ -34,6 +34,9 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from .table import DenseTable, SparseTable, TableConfig
+from ...monitor import monitor as _monitor
+
+_RPC_STAT = _monitor.get("ps_rpc_requests")
 
 __all__ = ["PSService", "LocalClient", "PServer", "RPCClient",
            "ShardedClient", "PSError", "BarrierError",
@@ -336,6 +339,8 @@ class PServer:
         self.endpoint = "%s:%d" % self._sock.getsockname()[:2]
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         self._accept_thread: Optional[threading.Thread] = None
         # bounded connection pool (r3 weak #3: one unbounded thread per
         # connection). Each trainer holds a data connection (which a
@@ -385,6 +390,8 @@ class PServer:
             self._threads.append(t)
 
     def _serve_conn(self, conn: socket.socket):
+        with self._conns_lock:
+            self._conns.add(conn)
         try:
             while not self._stop.is_set():
                 msg = _recv_msg(conn)
@@ -407,9 +414,12 @@ class PServer:
                 self._conn_slots.release()
             except ValueError:
                 pass
+            with self._conns_lock:
+                self._conns.discard(conn)
             conn.close()
 
     def _dispatch(self, conn: socket.socket, msg: memoryview) -> bytes:
+        _RPC_STAT.increase()
         svc = self.service
         method = msg[0]
         off = 1
@@ -473,6 +483,19 @@ class PServer:
             self._sock.close()
         except OSError:
             pass
+        # close live connections too: a serve thread parked in recv would
+        # otherwise answer one more request after stop
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until stop() (e.g. a client's stop_server) — the

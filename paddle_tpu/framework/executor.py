@@ -30,6 +30,12 @@ from .core import (Block, Operator, Program, Variable, convert_dtype,
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
 
+# hot-path stat handles resolved once (a per-step registry lookup would
+# pay an import + two lock acquisitions per run)
+from ..monitor import monitor as _monitor  # noqa: E402
+_STEP_STAT = _monitor.get("executor_run_steps")
+_JIT_STAT = _monitor.get("executor_jit_builds")
+
 
 # ---------------------------------------------------------------------------
 # Scope: name -> device array holder (reference framework/scope.h:52)
@@ -224,6 +230,7 @@ class Executor:
 
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
+            _JIT_STAT.increase()
             entry = self._build(program, block, list(feed_arrays),
                                 fetch_names)
             if use_program_cache:
@@ -242,6 +249,7 @@ class Executor:
         const_vals = tuple(_val(n) for n in const_in)
 
         self._step += 1
+        _STEP_STAT.increase()
         step = np.int32(self._step)
         bench = flag_value("FLAGS_benchmark")
         if bench:

@@ -176,6 +176,17 @@ def apply_passes(program: Program, names: Sequence[str],
 # ---------------------------------------------------------------------------
 # built-in passes over the existing transforms
 # ---------------------------------------------------------------------------
+@register_pass("graph_viz")
+def _graph_viz_pass(program, graph_viz_path="program.dot", block_idx=0,
+                    **_):
+    """reference ir/graph_viz_pass.cc: dump the block's op/var dataflow
+    as graphviz DOT to `graph_viz_path`; the program passes through
+    unchanged."""
+    from ..monitor import save_program_dot
+    save_program_dot(program, graph_viz_path, block_idx=block_idx)
+    return program
+
+
 @register_pass("prune_by_fetch")
 def _prune_pass(program, feeds=(), fetches=(), **_):
     from ..io import _prune_by_fetch

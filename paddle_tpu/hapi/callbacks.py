@@ -182,6 +182,12 @@ class EarlyStopping(Callback):
         self.wait = 0
         self.stopped_epoch = None
 
+    def on_train_begin(self, logs=None):
+        # a reused instance must re-arm (reference EarlyStopping resets
+        # its wait/best state per fit)
+        self.wait = 0
+        self.stopped_epoch = None
+
     def _check(self, logs, epoch=None):
         if self.stopped_epoch is not None:
             return

@@ -1,0 +1,157 @@
+"""Runtime stat monitor (reference platform/monitor.h StatRegistry /
+STAT_ADD macros + the graph_viz_pass program dumps of ir/graph_viz_pass.cc).
+
+StatRegistry: named thread-safe counters any subsystem bumps
+(executor steps, PS RPC calls, checkpoint writes, ...); `publish()`
+snapshots (optionally resetting) for logging/metrics export.
+
+program_to_dot / save_program_dot: render a Program's op/var dataflow as
+graphviz DOT — the reference attaches graph_viz_pass to pass pipelines;
+here it is a plain function usable on any Program (and registered as an
+IR pass in framework/ir.py for pipeline parity).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+__all__ = ["StatValue", "StatRegistry", "monitor", "stat_add", "stat_get",
+           "program_to_dot", "save_program_dot"]
+
+
+class StatValue:
+    """One named int64 stat (reference platform/monitor.h StatValue)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def increase(self, n: int = 1) -> int:
+        with self._lock:
+            self._v += n
+            return self._v
+
+    def decrease(self, n: int = 1) -> int:
+        return self.increase(-n)
+
+    def reset(self) -> int:
+        with self._lock:
+            old, self._v = self._v, 0
+            return old
+
+    def get(self) -> int:
+        with self._lock:
+            return self._v
+
+
+class StatRegistry:
+    """Thread-safe name -> StatValue registry
+    (reference StatRegistry::Instance)."""
+
+    _instance = None
+
+    def __init__(self):
+        self._stats: Dict[str, StatValue] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "StatRegistry":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def get(self, name: str) -> StatValue:
+        with self._lock:
+            s = self._stats.get(name)
+            if s is None:
+                s = self._stats[name] = StatValue(name)
+            return s
+
+    def publish(self, reset: bool = False) -> List[Tuple[str, int]]:
+        with self._lock:
+            stats = list(self._stats.items())
+        out = []
+        for name, s in sorted(stats):
+            out.append((name, s.reset() if reset else s.get()))
+        return out
+
+
+monitor = StatRegistry.instance()
+
+
+def stat_add(name: str, n: int = 1) -> int:
+    """reference STAT_ADD(name, n) macro."""
+    return monitor.get(name).increase(n)
+
+
+def stat_get(name: str) -> int:
+    return monitor.get(name).get()
+
+
+# ---------------------------------------------------------------------------
+# graphviz program dump (reference ir/graph_viz_pass.cc)
+# ---------------------------------------------------------------------------
+
+def _esc(s: str) -> str:
+    return s.replace('"', '\\"')
+
+
+def program_to_dot(program, block_idx: int = 0,
+                   max_var_len: int = 40) -> str:
+    """Render one block's op/var dataflow as graphviz DOT.
+
+    Ops are boxes, variables ellipses (parameters shaded); edges follow
+    def-use. Sub-block-owning ops (while/cond2) are annotated with the
+    sub-block index rather than inlined (the reference's
+    graph_viz_pass dumps one graph per block too)."""
+    block = program.block(block_idx)
+    lines = ["digraph G {", '  rankdir="TB";',
+             '  node [fontsize=10];']
+    var_nodes = set()
+
+    def var_node(name):
+        if name in var_nodes:
+            return
+        var_nodes.add(name)
+        v = block._find_var_recursive(name)
+        shape_s = ""
+        if v is not None and v.shape is not None:
+            shape_s = "\\n" + str(tuple(v.shape))
+        style = ""
+        if v is not None and getattr(v, "persistable", False):
+            style = ', style=filled, fillcolor="lightgrey"'
+        label = name if len(name) <= max_var_len \
+            else name[:max_var_len - 3] + "..."
+        lines.append(f'  "v_{_esc(name)}" [label="{_esc(label)}{shape_s}"'
+                     f', shape=ellipse{style}];')
+
+    for i, op in enumerate(block.ops):
+        extra = ""
+        sub = op.attrs.get("sub_block")
+        if sub is None:
+            sub = op.attrs.get("true_block")
+        if sub is not None:
+            extra = f"\\n[sub_block {sub}]"
+        lines.append(f'  "op_{i}" [label="{_esc(op.type)}{extra}", '
+                     'shape=box, style=filled, fillcolor="lightblue"];')
+        for name in op.input_arg_names():
+            if not name:
+                continue
+            var_node(name)
+            lines.append(f'  "v_{_esc(name)}" -> "op_{i}";')
+        for name in op.output_arg_names():
+            if not name:
+                continue
+            var_node(name)
+            lines.append(f'  "op_{i}" -> "v_{_esc(name)}";')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def save_program_dot(program, path: str, block_idx: int = 0):
+    """Write the DOT dump (reference graph_viz_pass's
+    graph_viz_path attribute)."""
+    with open(path, "w") as f:
+        f.write(program_to_dot(program, block_idx))
+    return path

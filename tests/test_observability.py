@@ -106,3 +106,50 @@ def test_print_op_passthrough_and_grad(capfd):
     jax.effects_barrier()
     out = capfd.readouterr()
     assert "h_values" in out.out or "h_values" in out.err
+
+
+# ---------------------------------------------------------------------------
+# monitor / StatRegistry + graphviz dumps (r3 §5 observability partial)
+# ---------------------------------------------------------------------------
+
+def test_stat_registry_counts_executor_steps():
+    from paddle_tpu.monitor import monitor, stat_add, stat_get
+
+    base = stat_get("executor_run_steps")
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main_p, startup):
+        x = layers.data("x", [2, 2], append_batch_size=False)
+        y = layers.scale(x, scale=2.0)
+    exe = pt.Executor()
+    exe.run(startup)
+    for _ in range(3):
+        exe.run(main_p, feed={"x": np.ones((2, 2), "float32")},
+                fetch_list=[y])
+    assert stat_get("executor_run_steps") >= base + 3
+    stat_add("custom_stat", 5)
+    snap = dict(monitor.publish())
+    assert snap["custom_stat"] == 5
+    assert dict(monitor.publish(reset=True))["custom_stat"] == 5
+    assert stat_get("custom_stat") == 0
+
+
+def test_program_dot_dump(tmp_path):
+    from paddle_tpu.monitor import program_to_dot
+    from paddle_tpu.framework.ir import PassRegistry
+
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main_p, startup):
+        x = layers.data("x", [4, 4], append_batch_size=False)
+        h = layers.fc(x, size=3, act="relu")
+        layers.reduce_mean(h)
+    dot = program_to_dot(main_p)
+    assert dot.startswith("digraph G {") and dot.endswith("}")
+    assert '"op_0"' in dot and "mul" in dot and "relu" in dot
+    assert "lightgrey" in dot     # parameter shading
+    # via the registered pass (reference graph_viz_pass attachment)
+    p = str(tmp_path / "prog.dot")
+    PassRegistry.get("graph_viz", graph_viz_path=p).apply(main_p)
+    content = open(p).read()
+    assert "digraph G {" in content and "reduce_mean" in content
