@@ -43,10 +43,19 @@ contention that the bench could neither detect nor explain):
   * W windows of K steps, fenced by a host readback of the final loss of
     each window (one fence per window, not per step).
   * reports median/p10/p90/min/max over windows + device_kind.
-  * anomaly detection: window spread (max/min) > 2x, or per-chip
-    throughput below a device-kind sanity floor -> the whole measurement
-    re-runs once; if still anomalous the JSON carries "anomaly": <reason>
-    so a garbage number can never be published silently.
+  * anomaly detection: windows whose duration drags the window spread
+    (max/min) above 1.25x are re-run (bounded budget) before any number
+    is published; if the spread still exceeds 2x, or per-chip throughput
+    sits below a device-kind sanity floor, the whole measurement re-runs
+    once; if still anomalous the JSON carries "anomaly": <reason> so a
+    garbage number can never be published silently.
+  * fault tolerance (round-4 postmortem: BENCH_r04 died rc=1 when one
+    transient axon remote-compile disconnect — "response body closed
+    before all bytes were read" — aborted the run): host readback faults
+    retry in place (device state is intact); dispatch faults retry once,
+    then rebuild the whole measurement from scratch (donated buffers may
+    be invalidated), bounded at 2 rebuilds. The bench exits non-zero only
+    when the failure reproduces across every rebuild, i.e. deterministic.
   * cross-RUN drift: the shared v5e chip was observed wandering +-10%
     between runs with BYTE-IDENTICAL compiled programs (cost_analysis
     equal, 694..792 samples/s across one session) — comparisons between
@@ -90,6 +99,133 @@ STEPS_PER_WINDOW = 5
 # sanity floors (samples/s/chip) by device kind — far below any healthy
 # run, far above a contended/broken one
 FLOORS = {"tpu": 20.0, "cpu": 0.0}
+
+# fault-tolerance budget (VERDICT r4 #1)
+MAX_REBUILDS = 2          # full rebuild-from-scratch attempts on faults
+RERUN_SPREAD = 1.25       # window spread that triggers per-window re-runs
+RERUN_BUDGET = 4          # max per-window re-runs per measurement
+ANOMALY_SPREAD = 2.0      # spread that still flags after re-runs
+
+
+class RebuildNeeded(Exception):
+    """A transient fault invalidated device state (donated buffers);
+    the measurement must be rebuilt from scratch."""
+
+
+def _transient(e) -> bool:
+    """Could this exception be a transient tunnel/runtime fault?
+
+    Known-deterministic signatures (OOM, invalid program) fail fast —
+    rebuilding an identical program to die identically would triple the
+    time-to-failure on exactly the runs where feedback matters. Beyond
+    those, any XLA/JAX runtime error counts as possibly-transient (a
+    deterministic one still reproduces across the bounded rebuilds and
+    exits non-zero), plus the known axon tunnel fault signatures on
+    other exception types.
+    """
+    s = str(e)
+    if any(m in s for m in ("RESOURCE_EXHAUSTED", "out of memory",
+                            "Out of memory", "INVALID_ARGUMENT")):
+        return False
+    if type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError"):
+        return True
+    return any(m in s for m in (
+        "response body closed", "Socket closed", "UNAVAILABLE",
+        "DEADLINE_EXCEEDED", "Connection reset", "Broken pipe"))
+
+
+def measure_windows(run_window, fence, state, *, n_windows,
+                    rerun_spread=RERUN_SPREAD, rerun_budget=RERUN_BUDGET,
+                    faults=None):
+    """Time n_windows calls of run_window, each fenced by fence(fetches).
+
+    run_window(state) -> (state, fetches); fence(fetches) -> float loss.
+    Returns (dts, state, loss, n_reruns).
+
+    Retry policy (VERDICT r4 #1 — BENCH_r04 died rc=1 on one transient
+    axon disconnect): any transient fault voids that window's timing and
+    the window is re-attempted once from the current state (fence faults
+    leave device state valid; dispatch faults may have invalidated
+    donated inputs, in which case the retry escalates to RebuildNeeded).
+    A second consecutive fault escalates to RebuildNeeded; non-transient
+    exceptions propagate unchanged.
+    Outlier policy (VERDICT r4 weak #3 — a 1.54x spread sailed through
+    the old 2x-only gate): after the initial pass, the slowest window is
+    re-timed while max/min spread exceeds rerun_spread, bounded by
+    rerun_budget.
+    """
+    faults = faults if faults is not None else {}
+    faults.setdefault("dispatch_retries", 0)
+    faults.setdefault("fence_retries", 0)
+
+    def one_window(state):
+        """One timed dispatch+fence. A transient fault anywhere voids
+        that timing entirely (a 30s tunnel hang must not be booked as
+        chip time) and the whole window is re-attempted once from the
+        current state: a fence fault leaves device state valid (the
+        dispatch completed), while a dispatch fault may have invalidated
+        donated inputs — in which case the retry's 'deleted' error
+        escalates to RebuildNeeded."""
+        for retry in (False, True):
+            t0 = time.perf_counter()
+            try:
+                new_state, fetches = run_window(state)
+            except Exception as e:
+                if not _transient(e) and "delete" not in str(e).lower():
+                    raise
+                if retry:
+                    raise RebuildNeeded(str(e)) from e
+                faults["dispatch_retries"] += 1
+                continue
+            try:
+                loss = fence(fetches)
+            except Exception as e:
+                if not _transient(e):
+                    raise
+                if retry:
+                    raise RebuildNeeded(str(e)) from e
+                faults["fence_retries"] += 1
+                state = new_state  # dispatch landed; advance and re-time
+                continue
+            return time.perf_counter() - t0, new_state, loss
+
+    dts, loss = [], None
+    for _ in range(n_windows):
+        dt, state, loss = one_window(state)
+        dts.append(dt)
+
+    n_reruns = 0
+    while (max(dts) / max(min(dts), 1e-9) > rerun_spread
+           and n_reruns < rerun_budget):
+        worst = dts.index(max(dts))  # slowest window = largest duration
+        dt, state, loss = one_window(state)
+        # keep the better timing: both time the same compiled program, so
+        # a contention blip during the re-run must not replace a valid
+        # measurement with a worse one
+        dts[worst] = min(dts[worst], dt)
+        n_reruns += 1
+    return dts, state, loss, n_reruns
+
+
+def with_rebuilds(build_and_measure, *, max_rebuilds=MAX_REBUILDS,
+                  faults=None, settle=time.sleep):
+    """Run build_and_measure(), rebuilding from scratch on transient
+    faults (bounded). Exits with the original exception only when the
+    failure reproduces across every rebuild — i.e. is deterministic."""
+    faults = faults if faults is not None else {}
+    faults.setdefault("rebuilds", 0)
+    for attempt in range(max_rebuilds + 1):
+        try:
+            return build_and_measure()
+        except RebuildNeeded:
+            if attempt == max_rebuilds:
+                raise
+            faults["rebuilds"] += 1
+        except Exception as e:
+            if attempt == max_rebuilds or not _transient(e):
+                raise
+            faults["rebuilds"] += 1
+        settle(2.0 * (attempt + 1))  # let the tunnel settle
 
 
 def bert_train_flops_per_sample(seq, vocab, hidden, layers_n, inter,
@@ -195,7 +331,21 @@ def _attn_for(seq):
 
 
 def run_config(seq, batch_per_chip, *, attn=None, dropout=0.1):
-    """Build + measure one config. Returns the result dict."""
+    """Build + measure one config with bounded fault tolerance
+    (VERDICT r4 #1). Returns the result dict; the "faults" entry records
+    how many transient retries/rebuilds the measurement survived."""
+    faults = {"dispatch_retries": 0, "fence_retries": 0, "rebuilds": 0}
+    result = with_rebuilds(
+        lambda: _run_config_once(seq, batch_per_chip, attn=attn,
+                                 dropout=dropout, faults=faults),
+        faults=faults)
+    result["faults"] = dict(faults)
+    return result
+
+
+def _run_config_once(seq, batch_per_chip, *, attn=None, dropout=0.1,
+                     faults=None):
+    """One build + measurement pass (may raise RebuildNeeded)."""
     import jax
 
     import paddle_tpu as pt
@@ -209,7 +359,10 @@ def run_config(seq, batch_per_chip, *, attn=None, dropout=0.1):
     device = jax.devices()[0]
     device_kind = getattr(device, "device_kind", str(device))
     mesh = dp_mesh(n_chips)
-    per_step_dispatch = os.environ.get("BENCH_DISPATCH", "window") == "step"
+    # per-step is the measured default (windowed lax.scan dispatch is ~3%
+    # slower on this tunnel — the While boundary inhibits cross-step
+    # fusion; VERDICT r4 weak #8)
+    per_step_dispatch = os.environ.get("BENCH_DISPATCH", "step") == "step"
 
     B = batch_per_chip * n_chips
     max_pred = max(1, int(round(0.15 * seq)))
@@ -287,24 +440,33 @@ def run_config(seq, batch_per_chip, *, attn=None, dropout=0.1):
         step, mut_vals, fetches = run_window(step, mut_vals)
     float(np.asarray(fetches[0]).reshape(-1)[0])
 
+    def rw(state):
+        step, mut_vals = state
+        step, mut_vals, fetches = run_window(step, mut_vals)
+        return (step, mut_vals), fetches
+
+    def fence(fetches):
+        loss = float(np.asarray(fetches[0]).reshape(-1)[0])
+        if not np.isfinite(loss):
+            raise RuntimeError(f"non-finite loss {loss}")  # deterministic
+        return loss
+
     floor = FLOORS["tpu" if "tpu" in device.platform.lower() else "cpu"]
     anomaly = None
+    state = (step, mut_vals)
+    total_reruns = 0
     for attempt in range(2):
-        rates = []
-        for _ in range(WINDOWS):
-            t0 = time.perf_counter()
-            step, mut_vals, fetches = run_window(step, mut_vals)
-            loss = float(np.asarray(fetches[0]).reshape(-1)[0])  # fence
-            dt = time.perf_counter() - t0
-            if not np.isfinite(loss):
-                raise RuntimeError(f"non-finite loss {loss}")
-            rates.append(B * STEPS_PER_WINDOW / dt)
+        dts, state, loss, n_reruns = measure_windows(
+            rw, fence, state, n_windows=WINDOWS, faults=faults)
+        total_reruns += n_reruns
+        rates = [B * STEPS_PER_WINDOW / dt for dt in dts]
         med = float(np.median(rates))
         spread = max(rates) / max(min(rates), 1e-9)
         per_chip = med / n_chips
         anomaly = None
-        if spread > 2.0:
-            anomaly = (f"window spread {spread:.2f}x > 2x "
+        if spread > ANOMALY_SPREAD:
+            anomaly = (f"window spread {spread:.2f}x > {ANOMALY_SPREAD}x "
+                       f"after {total_reruns} window re-runs "
                        f"(chip contention?): {sorted(rates)}")
         elif per_chip < floor:
             anomaly = (f"throughput {per_chip:.1f} below sanity floor "
@@ -333,6 +495,7 @@ def run_config(seq, batch_per_chip, *, attn=None, dropout=0.1):
             "min": round(min(rates) / n_chips, 2),
             "max": round(max(rates) / n_chips, 2),
             "spread": round(spread, 3),
+            "window_reruns": total_reruns,
         },
         "config": {"seq": seq, "batch_per_chip": batch_per_chip,
                    "max_predictions": max_pred, "n_chips": n_chips,

@@ -1,0 +1,192 @@
+"""Forced-fault tests for bench.py's fault-tolerance core (VERDICT r4 #1).
+
+BENCH_r04 exited rc=1 when one transient axon remote-compile disconnect
+aborted the run mid-measurement. These tests inject the exact fault
+signatures and prove the measurement survives: fence (readback) faults
+retry in place, dispatch faults escalate to a bounded rebuild, outlier
+windows are re-timed, and only deterministic failures propagate.
+"""
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import bench  # noqa: E402
+
+
+class XlaRuntimeError(Exception):
+    """Same type name the tunnel raises — _transient matches on it."""
+
+
+def _ok_window(state):
+    return state + 1, ("fetch",)
+
+
+def test_transient_predicate():
+    assert bench._transient(XlaRuntimeError("INTERNAL: boom"))
+    assert bench._transient(RuntimeError(
+        "response body closed before all bytes were read"))
+    assert bench._transient(OSError("Connection reset by peer"))
+    assert not bench._transient(ValueError("shape mismatch"))
+    assert not bench._transient(RuntimeError("non-finite loss nan"))
+    # known-deterministic device faults fail fast, no rebuild cycles
+    assert not bench._transient(XlaRuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 12345 bytes"))
+    assert not bench._transient(XlaRuntimeError(
+        "INVALID_ARGUMENT: mismatched operand shapes"))
+
+
+def test_fence_fault_retries_in_place():
+    calls = {"n": 0}
+
+    def fence(fetches):
+        calls["n"] += 1
+        if calls["n"] == 2:  # one window's readback dies once
+            raise XlaRuntimeError("INTERNAL: response body closed "
+                                  "before all bytes were read")
+        return 1.0
+
+    faults = {}
+    dts, state, loss, n_reruns = bench.measure_windows(
+        _ok_window, fence, 0, n_windows=4, faults=faults)
+    assert len(dts) == 4 and state >= 4 and loss == 1.0
+    assert faults["fence_retries"] == 1
+    assert faults["dispatch_retries"] == 0
+
+
+def test_dispatch_fault_retries_then_succeeds():
+    calls = {"n": 0}
+
+    def run_window(state):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise XlaRuntimeError("UNAVAILABLE: socket closed")
+        return state + 1, ("fetch",)
+
+    faults = {}
+    dts, state, loss, _ = bench.measure_windows(
+        run_window, lambda f: 0.5, 0, n_windows=3, faults=faults)
+    assert len(dts) == 3
+    assert faults["dispatch_retries"] == 1
+
+
+def test_dispatch_double_fault_escalates_to_rebuild():
+    def run_window(state):
+        raise XlaRuntimeError("INTERNAL: stream broken")
+
+    with pytest.raises(bench.RebuildNeeded):
+        bench.measure_windows(run_window, lambda f: 0.5, 0, n_windows=3)
+
+
+def test_deleted_buffer_after_fault_escalates():
+    calls = {"n": 0}
+
+    def run_window(state):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise XlaRuntimeError("INTERNAL: boom")
+        raise RuntimeError("Array has been deleted")  # donated input gone
+
+    with pytest.raises(bench.RebuildNeeded):
+        bench.measure_windows(run_window, lambda f: 0.5, 0, n_windows=3)
+
+
+def test_deterministic_error_propagates_unchanged():
+    def run_window(state):
+        raise ValueError("shape mismatch (deterministic)")
+
+    with pytest.raises(ValueError):
+        bench.measure_windows(run_window, lambda f: 0.5, 0, n_windows=3)
+
+
+def test_nonfinite_loss_propagates():
+    def fence(fetches):
+        raise RuntimeError("non-finite loss nan")
+
+    with pytest.raises(RuntimeError, match="non-finite"):
+        bench.measure_windows(_ok_window, fence, 0, n_windows=2)
+
+
+def test_outlier_window_rerun(monkeypatch):
+    """A window 1.5x slower than the rest is re-timed (VERDICT weak #3:
+    a 1.54x spread must not pass silently)."""
+    ticks = iter([0.0, 1.0,    # window 0: 1.0s
+                  1.0, 2.0,    # window 1: 1.0s
+                  2.0, 3.6,    # window 2: 1.6s  -> outlier
+                  3.6, 4.6])   # re-run:   1.0s
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: next(ticks))
+    dts, state, loss, n_reruns = bench.measure_windows(
+        _ok_window, lambda f: 1.0, 0, n_windows=3)
+    assert n_reruns == 1
+    assert max(dts) / min(dts) <= bench.RERUN_SPREAD + 1e-9
+
+
+def test_rerun_budget_bounds(monkeypatch):
+    """A persistently slow chip exhausts the budget and stops."""
+    t = {"now": 0.0}
+
+    def clock():
+        return t["now"]
+
+    monkeypatch.setattr(bench.time, "perf_counter", clock)
+    slow = iter([1.0, 2.0] + [2.0] * 100)  # every re-run is slow too
+
+    def run_window(state):
+        t["now"] += next(slow)
+        return state + 1, ("f",)
+
+    dts, state, loss, n_reruns = bench.measure_windows(
+        run_window, lambda f: 1.0, 0, n_windows=2)
+    assert n_reruns == bench.RERUN_BUDGET
+
+
+def test_with_rebuilds_recovers():
+    attempts = {"n": 0}
+
+    def build():
+        attempts["n"] += 1
+        if attempts["n"] < 2:
+            raise bench.RebuildNeeded("tunnel died")
+        return {"value": 42}
+
+    faults = {}
+    out = bench.with_rebuilds(build, faults=faults, settle=lambda s: None)
+    assert out["value"] == 42
+    assert faults["rebuilds"] == 1
+
+
+def test_with_rebuilds_transient_generic_exception():
+    attempts = {"n": 0}
+
+    def build():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise XlaRuntimeError("INTERNAL: compile rpc lost")
+        return "ok"
+
+    assert bench.with_rebuilds(build, settle=lambda s: None) == "ok"
+
+
+def test_with_rebuilds_deterministic_fails_fast():
+    attempts = {"n": 0}
+
+    def build():
+        attempts["n"] += 1
+        raise ValueError("bad config")
+
+    with pytest.raises(ValueError):
+        bench.with_rebuilds(build)
+    assert attempts["n"] == 1  # no pointless rebuilds
+
+
+def test_with_rebuilds_bounded():
+    attempts = {"n": 0}
+
+    def build():
+        attempts["n"] += 1
+        raise bench.RebuildNeeded("always")
+
+    with pytest.raises(bench.RebuildNeeded):
+        bench.with_rebuilds(build, settle=lambda s: None)
+    assert attempts["n"] == bench.MAX_REBUILDS + 1
