@@ -24,5 +24,9 @@ from . import crf_ctc_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import nn_extra_ops  # noqa: F401
 from . import linalg_ops  # noqa: F401
+from . import interp_extra_ops  # noqa: F401
+from . import pool_extra_ops  # noqa: F401
+from . import misc2_ops  # noqa: F401
+from . import rnn_fused_ops  # noqa: F401
 from .registry import (LowerContext, all_registered_ops, get_op_def,  # noqa
                        has_op, register_op)

@@ -1,0 +1,131 @@
+"""Interpolation variants: linear / bicubic / trilinear (+_v2 aliases).
+
+Reference: paddle/fluid/operators/interpolate_op.h — LinearInterpolation
+(:118), TrilinearInterpolation (:312), BicubicInterpolation (:487) with
+get_cubic_upsample_coefficients (:460, A=-0.75) — and interpolate_op.cc
+(:558-612) for the op surfaces. nearest/bilinear live in tensor_ops.py.
+
+TPU-first design: every mode is a *separable* weighted gather — per
+spatial axis we precompute (taps [out,k] int32, weights [out,k] f32) on
+the host (shapes are static under jit) and contract one axis at a time
+with jnp.take + a broadcasted weighted sum. XLA fuses the k-tap
+contraction into a single gather-multiply-reduce per axis; grads fall
+out of the auto-vjp (a scatter-add, also fused). No data-dependent
+control flow, no dynamic shapes.
+
+Semantics mirrored from the reference kernels:
+  * ratio = (in-1)/(out-1) if align_corners else in/out
+  * linear family: x_w = trunc(align_flag ? ratio*(l+.5)-.5 : ratio*l),
+    clamped at 0; right tap min(x_w+1, in-1); fractional part from the
+    clamped source coordinate (align_flag = align_mode==0 and not
+    align_corners).
+  * bicubic: src = align_corners ? ratio*l : ratio*(l+.5)-.5, 4 taps at
+    clip(floor(src)-1+o), Keys kernel A=-0.75.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import in_var, register_op, set_out
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _ratio(in_size: int, out_size: int, align_corners: bool) -> float:
+    if align_corners:
+        return (in_size - 1.0) / (out_size - 1.0) if out_size > 1 else 0.0
+    return in_size / float(out_size)
+
+
+def _linear_taps(in_size, out_size, align_corners, align_mode):
+    """(idx [out,2] int32, w [out,2] f32) for one linear-family axis."""
+    r = _ratio(in_size, out_size, align_corners)
+    l = np.arange(out_size, dtype=np.float64)
+    align_flag = (align_mode == 0) and not align_corners
+    src = r * (l + 0.5) - 0.5 if align_flag else r * l
+    x_w = np.maximum(np.trunc(src), 0.0).astype(np.int64)
+    x_e = np.minimum(x_w + 1, in_size - 1)
+    d = (np.maximum(src, 0.0) - x_w) if align_flag else (r * l - x_w)
+    idx = np.stack([x_w, x_e], 1).astype(np.int32)
+    w = np.stack([1.0 - d, d], 1).astype(np.float32)
+    return idx, w
+
+
+def _cubic_taps(in_size, out_size, align_corners):
+    """(idx [out,4] int32, w [out,4] f32): Keys cubic kernel, A=-0.75."""
+    A = -0.75
+    r = _ratio(in_size, out_size, align_corners)
+    l = np.arange(out_size, dtype=np.float64)
+    src = r * l if align_corners else r * (l + 0.5) - 0.5
+    base = np.floor(src)
+    t = src - base
+
+    def conv1(x):  # |x| <= 1
+        return ((A + 2) * x - (A + 3)) * x * x + 1
+
+    def conv2(x):  # 1 < |x| < 2
+        return ((A * x - 5 * A) * x + 8 * A) * x - 4 * A
+
+    w = np.stack([conv2(t + 1.0), conv1(t), conv1(1.0 - t),
+                  conv2(2.0 - t)], 1).astype(np.float32)
+    idx = np.clip(base[:, None] + np.arange(-1, 3)[None, :],
+                  0, in_size - 1).astype(np.int32)
+    return idx, w
+
+
+def _contract_axis(jnp, x, axis, idx, w):
+    """Weighted k-tap gather along one axis: x[..., idx, ...] @ w."""
+    out, k = idx.shape
+    g = jnp.take(x, jnp.asarray(idx.reshape(-1)), axis=axis)
+    g = g.reshape(x.shape[:axis] + (out, k) + x.shape[axis + 1:])
+    wshape = (1,) * axis + (out, k) + (1,) * (x.ndim - axis - 1)
+    return (g * jnp.asarray(w).reshape(wshape)).sum(axis=axis + 1)
+
+
+def _out_sizes(op, in_spatial, names):
+    """Resolve output spatial sizes from out_* attrs or scale."""
+    sizes = [op.attr(n, -1) or -1 for n in names]
+    scale = op.attr("scale", 0.0)
+    if any(s is None or s <= 0 for s in sizes):
+        if isinstance(scale, (list, tuple)) and scale:
+            sizes = [int(d * s) for d, s in zip(in_spatial, scale)]
+        elif scale and scale > 0:
+            sizes = [int(d * scale) for d in in_spatial]
+    return sizes
+
+
+def _interp_nd_infer(names):
+    def infer(op, block):
+        x = in_var(op, block, "X")
+        sizes = _out_sizes(op, x.shape[2:], names)
+        set_out(op, block, "Out", tuple(x.shape[:2]) + tuple(sizes),
+                x.dtype)
+    return infer
+
+
+def _interp_nd_lower(names, cubic):
+    def lower(ctx, op):
+        jnp = _jnp()
+        x = ctx.get_input(op, "X")
+        sizes = _out_sizes(op, x.shape[2:], names)
+        align = bool(op.attr("align_corners", True))
+        mode = int(op.attr("align_mode", 1))
+        out = x.astype("float32")
+        for i, (in_sz, out_sz) in enumerate(zip(x.shape[2:], sizes)):
+            idx, w = (_cubic_taps(in_sz, out_sz, align) if cubic
+                      else _linear_taps(in_sz, out_sz, align, mode))
+            out = _contract_axis(jnp, out, 2 + i, idx, w)
+        ctx.set_output(op, "Out", out.astype(x.dtype))
+    return lower
+
+
+for _name, _axes, _cubic in [
+        ("linear_interp", ("out_w",), False),
+        ("trilinear_interp", ("out_d", "out_h", "out_w"), False),
+        ("bicubic_interp", ("out_h", "out_w"), True)]:
+    for _suffix in ("", "_v2"):
+        register_op(_name + _suffix, infer=_interp_nd_infer(_axes),
+                    lower=_interp_nd_lower(_axes, _cubic))

@@ -1,0 +1,466 @@
+"""Round-5 op-catalog batch: shape/structural/loss/sampling long tail.
+
+Reference analogs (paddle/fluid/operators/): space_to_depth_op.h:25 (the
+darknet-reorg index mapping), crop_op.cc, crop_tensor_op.cc,
+pad_constant_like_op.cc, expand_as_op.cc, expand_as_v2_op.cc,
+frobenius_norm_op.cc, cross_entropy_op.h:227 (CrossEntropyOpKernel2),
+where_index_op.cc, coalesce_tensor_op.cc, inplace_abn_op.cc,
+detection/sigmoid_focal_loss_op.cu:33, shuffle_batch_op.cc,
+sample_logits_op.cc, positive_negative_pair_op.cc, hash_op.cc.
+
+TPU-first notes:
+  * space_to_depth's reorg permutation collapses to reshape+transpose+
+    reshape — pure layout ops XLA folds into neighbouring fusions.
+  * where_index (nonzero) has a data-dependent output size; under jit we
+    keep the static shape (numel, rank) with valid rows sorted first and
+    -1 padding (same documented convention as masked_select's zero-fill).
+  * sample_logits uses the log-uniform inverse-CDF sampler drawn with
+    replacement; Probabilities are the marginal log-uniform probs
+    (deviation: the reference's unique-sampling num_tries adjustment is
+    not applied — documented here, not hidden).
+  * hash uses a multiply-xor integer mix (splitmix64) instead of XXH64:
+    same contract (deterministic int -> bucket), different constants.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import in_var, register_op, same_as_input, set_out
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# space_to_depth (darknet reorg)
+# ---------------------------------------------------------------------------
+def _s2d_infer(op, block):
+    x = in_var(op, block, "X")
+    bs = int(op.attr("blocksize"))
+    set_out(op, block, "Out", (x.shape[0], x.shape[1] * bs * bs,
+                               x.shape[2] // bs, x.shape[3] // bs),
+            x.dtype)
+
+
+@register_op("space_to_depth", infer=_s2d_infer)
+def _space_to_depth(ctx, op):
+    x = ctx.get_input(op, "X")
+    bs = int(op.attr("blocksize"))
+    b, c, h, w = x.shape
+    c2 = c // (bs * bs)
+    # reference functor: k = (od*bs+ow)*c2 + cc writes to (b, cc,
+    # j*bs+od, i*bs+ow) of a (b, c2, h*bs, w*bs) buffer, reinterpreted
+    # as (b, c*bs*bs, h/bs, w/bs)
+    y = x.reshape(b, bs, bs, c2, h, w).transpose(0, 3, 4, 1, 5, 2)
+    ctx.set_output(op, "Out",
+                   y.reshape(b, c * bs * bs, h // bs, w // bs))
+
+
+# ---------------------------------------------------------------------------
+# crop family
+# ---------------------------------------------------------------------------
+def _crop_infer(op, block):
+    x = in_var(op, block, "X")
+    shape = op.attr("shape", None) or list(in_var(op, block, "Y").shape)
+    set_out(op, block, "Out", shape, x.dtype)
+
+
+def _crop_lower(ctx, op):
+    x = ctx.get_input(op, "X")
+    shape = op.attr("shape", None)
+    if not shape:
+        shape = list(ctx.get_input(op, "Y").shape)
+    offsets = op.attr("offsets", None) or [0] * len(shape)
+    sl = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    ctx.set_output(op, "Out", x[sl])
+
+
+register_op("crop", infer=_crop_infer, lower=_crop_lower)
+register_op("crop_tensor", infer=_crop_infer, lower=_crop_lower)
+
+
+def _pad_like_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, in_var(op, block, "Y").dtype)
+
+
+@register_op("pad_constant_like", infer=_pad_like_infer)
+def _pad_constant_like(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")  # the larger, shape-giving tensor
+    y = ctx.get_input(op, "Y")
+    val = op.attr("pad_value", 0.0)
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    ctx.set_output(op, "Out", jnp.pad(y, pads, constant_values=val))
+
+
+# ---------------------------------------------------------------------------
+# expand_as family
+# ---------------------------------------------------------------------------
+def _expand_as_infer(op, block):
+    slot = "target_tensor" if op.input("target_tensor") else "Y"
+    set_out(op, block, "Out", in_var(op, block, slot).shape,
+            in_var(op, block, "X").dtype)
+
+
+@register_op("expand_as", infer=_expand_as_infer)
+def _expand_as(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    slot = "target_tensor" if op.input("target_tensor") else "Y"
+    t = ctx.get_input(op, slot)
+    reps = [ts // xs for ts, xs in zip(t.shape, x.shape)]
+    ctx.set_output(op, "Out", jnp.tile(x, reps))
+
+
+def _expand_as_v2_infer(op, block):
+    shape = op.attr("target_shape", None)
+    if not shape:
+        shape = in_var(op, block, "Y").shape
+    set_out(op, block, "Out", shape, in_var(op, block, "X").dtype)
+
+
+@register_op("expand_as_v2", infer=_expand_as_v2_infer)
+def _expand_as_v2(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    shape = op.attr("target_shape", None)
+    if not shape:
+        shape = ctx.get_input(op, "Y").shape
+    ctx.set_output(op, "Out", jnp.broadcast_to(x, tuple(shape)))
+
+
+# ---------------------------------------------------------------------------
+# frobenius_norm
+# ---------------------------------------------------------------------------
+def _frob_infer(op, block):
+    x = in_var(op, block, "X")
+    dims = op.attr("dim", None)
+    keep = op.attr("keep_dim", False)
+    if op.attr("reduce_all", False) or not dims:
+        dims = list(range(len(x.shape)))
+    dims = [d % len(x.shape) for d in dims]
+    if keep:
+        shape = [1 if i in dims else s for i, s in enumerate(x.shape)]
+    else:
+        shape = [s for i, s in enumerate(x.shape) if i not in dims]
+    set_out(op, block, "Out", shape, x.dtype)
+
+
+@register_op("frobenius_norm", infer=_frob_infer)
+def _frobenius_norm(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    dims = op.attr("dim", None)
+    if op.attr("reduce_all", False) or not dims:
+        dims = list(range(x.ndim))
+    ctx.set_output(op, "Out", jnp.sqrt(
+        (x.astype("float32") ** 2).sum(
+            axis=tuple(d % x.ndim for d in dims),
+            keepdims=bool(op.attr("keep_dim", False)))).astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# cross_entropy2 (hard label, keeps MatchX for the grad)
+# ---------------------------------------------------------------------------
+def _ce2_infer(op, block):
+    x = in_var(op, block, "X")
+    shape = list(x.shape[:-1]) + [1]
+    set_out(op, block, "Y", shape, x.dtype)
+    if op.output("MatchX"):
+        set_out(op, block, "MatchX", shape, x.dtype)
+    if op.output("XShape"):
+        set_out(op, block, "XShape", [0] + list(x.shape), x.dtype)
+
+
+@register_op("cross_entropy2", infer=_ce2_infer)
+def _cross_entropy2(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    label = ctx.get_input(op, "Label")
+    ignore = int(op.attr("ignore_index", -100))
+    lab = label.reshape(label.shape[:x.ndim - 1]).astype("int32")
+    safe = jnp.where(lab == ignore, 0, lab)
+    match = jnp.take_along_axis(x, safe[..., None], axis=-1)
+    tiny = jnp.asarray(np.finfo(np.float32).tiny, x.dtype)
+    y = -jnp.log(jnp.maximum(match, tiny))
+    valid = (lab != ignore)[..., None]
+    ctx.set_output(op, "Y", jnp.where(valid, y, 0))
+    ctx.set_output(op, "MatchX", match)
+    if op.output("XShape"):
+        ctx.set_output(op, "XShape", jnp.zeros((0,), x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# where_index (nonzero) — static-shape convention
+# ---------------------------------------------------------------------------
+def _where_index_infer(op, block):
+    x = in_var(op, block, "Condition")
+    n = int(np.prod(x.shape)) if x.shape else 1
+    set_out(op, block, "Out", (n, max(len(x.shape), 1)), "int64")
+
+
+@register_op("where_index", infer=_where_index_infer, grad=None)
+def _where_index(ctx, op):
+    jnp = _jnp()
+    cond = ctx.get_input(op, "Condition")
+    flat = (cond != 0).reshape(-1)
+    n = flat.shape[0]
+    # stable order: true positions first, each in original order
+    order = jnp.argsort(jnp.where(flat, 0, 1) * n + jnp.arange(n))
+    count = flat.sum()
+    coords = jnp.stack(
+        jnp.unravel_index(order, cond.shape if cond.ndim else (1,)), 1)
+    valid = (jnp.arange(n) < count)[:, None]
+    ctx.set_output(op, "Out",
+                   jnp.where(valid, coords, -1).astype("int64"))
+
+
+# ---------------------------------------------------------------------------
+# coalesce_tensor
+# ---------------------------------------------------------------------------
+def _coalesce_infer(op, block):
+    def out_var(name):
+        return (block._find_var_recursive(name)
+                or block.create_var(name=name))
+
+    total = 0
+    for name, src in zip(op.output("Output"), op.input("Input")):
+        v = block.var(src)
+        total += int(np.prod(v.shape)) if v.shape else 1
+        out = out_var(name)
+        out.shape, out.dtype = tuple(v.shape), v.dtype
+    fused = out_var(op.output("FusedOutput")[0])
+    fused.shape = (total,)
+    fused.dtype = block.var(op.input("Input")[0]).dtype
+
+
+@register_op("coalesce_tensor", infer=_coalesce_infer, grad=None)
+def _coalesce_tensor(ctx, op):
+    jnp = _jnp()
+    ins = ctx.get_inputs(op, "Input")
+    const = op.attr("set_constant", False)
+    val = op.attr("constant", 0.0)
+    outs = []
+    for x in ins:
+        outs.append(jnp.full_like(x, val) if const else x)
+    ctx.set_outputs(op, "Output", outs)
+    ctx.set_output(op, "FusedOutput",
+                   jnp.concatenate([o.reshape(-1) for o in outs]))
+
+
+# ---------------------------------------------------------------------------
+# inplace_abn — activated batch norm (in-place is a no-op concept in XLA)
+# ---------------------------------------------------------------------------
+def _abn_infer(op, block):
+    from .nn_ops import _bn_infer
+    _bn_infer(op, block)
+
+
+@register_op("inplace_abn", infer=_abn_infer)
+def _inplace_abn(ctx, op):
+    from .nn_ops import _bn_lower
+    _bn_lower(ctx, op)
+    act = op.attr("activation", "")
+    if act:
+        jnp = _jnp()
+        y = ctx.get(op.output("Y")[0])
+        if act == "relu":
+            y = jnp.maximum(y, 0)
+        elif act in ("leaky_relu", "leakyrelu"):
+            alpha = op.attr("alpha", 0.01)
+            y = jnp.where(y >= 0, y, alpha * y)
+        elif act == "elu":
+            alpha = op.attr("alpha", 1.0)
+            y = jnp.where(y >= 0, y, alpha * (jnp.exp(y) - 1))
+        else:
+            raise NotImplementedError(f"inplace_abn activation {act!r}")
+        ctx.set_output(op, "Y", y)
+
+
+# ---------------------------------------------------------------------------
+# sigmoid_focal_loss (reference sigmoid_focal_loss_op.cu:33)
+# ---------------------------------------------------------------------------
+def _sfl_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+
+
+@register_op("sigmoid_focal_loss", infer=_sfl_infer)
+def _sigmoid_focal_loss(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X").astype("float32")
+    label = ctx.get_input(op, "Label").reshape(-1).astype("int32")
+    fg = ctx.get_input(op, "FgNum").reshape(-1)[0]
+    gamma = float(op.attr("gamma", 2.0))
+    alpha = float(op.attr("alpha", 0.25))
+    n, num_classes = x.shape
+    d = jnp.arange(num_classes)[None, :]
+    g = label[:, None]
+    c_pos = (g == d + 1).astype("float32")
+    c_neg = ((g != -1) & (g != d + 1)).astype("float32")
+    fg_num = jnp.maximum(fg, 1).astype("float32")
+    s_pos, s_neg = alpha / fg_num, (1.0 - alpha) / fg_num
+    p = 1.0 / (1.0 + jnp.exp(-x))
+    tiny = np.finfo(np.float32).tiny
+    term_pos = (1.0 - p) ** gamma * jnp.log(jnp.maximum(p, tiny))
+    # numerically-stable log(1-p) = -x*(x>=0) - log(1+exp(x-2x*(x>=0)))
+    pos_mask = (x >= 0).astype("float32")
+    log1mp = -x * pos_mask - jnp.log(1.0 + jnp.exp(x - 2.0 * x * pos_mask))
+    term_neg = p ** gamma * log1mp
+    out = -c_pos * term_pos * s_pos - c_neg * term_neg * s_neg
+    ctx.set_output(op, "Out", out)
+
+
+# ---------------------------------------------------------------------------
+# shuffle_batch
+# ---------------------------------------------------------------------------
+def _shuffle_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+    set_out(op, block, "ShuffleIdx", (x.shape[0],), "int64")
+    if op.output("SeedOut"):
+        set_out(op, block, "SeedOut", (1,), "int64")
+
+
+@register_op("shuffle_batch", infer=_shuffle_infer)
+def _shuffle_batch(ctx, op):
+    import jax
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    perm = jax.random.permutation(ctx.rng(op), x.shape[0])
+    ctx.set_output(op, "Out", x[perm])
+    ctx.set_output(op, "ShuffleIdx", perm.astype("int64"))
+    if op.output("SeedOut"):
+        ctx.set_output(op, "SeedOut",
+                       jnp.zeros((1,), "int64"))
+
+
+# ---------------------------------------------------------------------------
+# sample_logits (sampled softmax; log-uniform with replacement)
+# ---------------------------------------------------------------------------
+def _sample_logits_infer(op, block):
+    logits = in_var(op, block, "Logits")
+    labels = in_var(op, block, "Labels")
+    n, nt = labels.shape[0], labels.shape[1]
+    s = int(op.attr("num_samples"))
+    set_out(op, block, "Samples", (n, nt + s), "int64")
+    set_out(op, block, "Probabilities", (n, nt + s), logits.dtype)
+    set_out(op, block, "SampledLogits", (n, nt + s), logits.dtype)
+    set_out(op, block, "SampledLabels", (n, nt), "int64")
+
+
+@register_op("sample_logits", infer=_sample_logits_infer)
+def _sample_logits(ctx, op):
+    import jax
+    jnp = _jnp()
+    logits = ctx.get_input(op, "Logits")
+    labels = ctx.get_input(op, "Labels").astype("int64")
+    n, vocab = logits.shape
+    nt = labels.shape[1]
+    s = int(op.attr("num_samples"))
+
+    if op.attr("use_customized_samples", False):
+        samples_neg = ctx.get_input(op, "CustomizedSamples")
+        probs_full = ctx.get_input(op, "CustomizedProbabilities")
+        samples = samples_neg.astype("int64")
+    else:
+        # log-uniform inverse CDF: id = floor(exp(u*log(V+1))) - 1
+        u = jax.random.uniform(ctx.rng(op), (n, s))
+        neg = jnp.clip(
+            jnp.exp(u * np.log(vocab + 1.0)) - 1.0, 0,
+            vocab - 1).astype("int64")
+        samples = jnp.concatenate([labels, neg], 1)
+        # marginal log-uniform probability of each id
+        ids = samples.astype("float32")
+        probs_full = (jnp.log((ids + 2.0) / (ids + 1.0))
+                      / np.log(vocab + 1.0))
+
+    gathered = jnp.take_along_axis(logits, samples.astype("int32"), 1)
+    sampled_logits = gathered - jnp.log(
+        jnp.maximum(probs_full, np.finfo(np.float32).tiny))
+    if op.attr("remove_accidental_hits", True):
+        # a negative column that collides with any true label is masked
+        neg_mask = jnp.concatenate(
+            [jnp.zeros((n, nt), bool),
+             (samples[:, nt:, None] == labels[:, None, :]).any(-1)], 1)
+        sampled_logits = jnp.where(neg_mask,
+                                   sampled_logits - 1e20, sampled_logits)
+    ctx.set_output(op, "Samples", samples)
+    ctx.set_output(op, "Probabilities",
+                   probs_full.astype(logits.dtype))
+    ctx.set_output(op, "SampledLogits",
+                   sampled_logits.astype(logits.dtype))
+    ctx.set_output(op, "SampledLabels",
+                   jnp.tile(jnp.arange(nt, dtype="int64")[None, :],
+                            (n, 1)))
+
+
+# ---------------------------------------------------------------------------
+# positive_negative_pair (query-grouped ranking pair counts)
+# ---------------------------------------------------------------------------
+def _pnp_infer(op, block):
+    for slot in ("PositivePair", "NegativePair", "NeutralPair"):
+        set_out(op, block, slot, (1,), "float32")
+
+
+@register_op("positive_negative_pair", infer=_pnp_infer, grad=None)
+def _positive_negative_pair(ctx, op):
+    jnp = _jnp()
+    score = ctx.get_input(op, "Score")
+    label = ctx.get_input(op, "Label").reshape(-1)
+    qid = ctx.get_input(op, "QueryID").reshape(-1)
+    col = int(op.attr("column", -1))
+    s = score[:, col].astype("float32")
+    same_q = qid[:, None] == qid[None, :]
+    # count each unordered pair once: i < j
+    n = s.shape[0]
+    upper = jnp.triu(jnp.ones((n, n), bool), 1)
+    considered = same_q & upper & (label[:, None] != label[None, :])
+    hi_first = jnp.where(label[:, None] > label[None, :],
+                         s[:, None] - s[None, :],
+                         s[None, :] - s[:, None])
+    pos = (considered & (hi_first > 0)).sum()
+    neg = (considered & (hi_first < 0)).sum()
+    neu = (considered & (hi_first == 0)).sum()
+    acc = [ctx.get_input(op, f"Accumulate{k}Pair")
+           if op.input(f"Accumulate{k}Pair") else 0.0
+           for k in ("Positive", "Negative", "Neutral")]
+    ctx.set_output(op, "PositivePair",
+                   (pos.astype("float32") + jnp.asarray(acc[0])).reshape(1))
+    ctx.set_output(op, "NegativePair",
+                   (neg.astype("float32") + jnp.asarray(acc[1])).reshape(1))
+    ctx.set_output(op, "NeutralPair",
+                   (neu.astype("float32") + jnp.asarray(acc[2])).reshape(1))
+
+
+# ---------------------------------------------------------------------------
+# hash (splitmix64 mix instead of XXH64 — same bucketing contract)
+# ---------------------------------------------------------------------------
+def _hash_infer(op, block):
+    x = in_var(op, block, "X")
+    n_hash = int(op.attr("num_hash", 1))
+    set_out(op, block, "Out", (x.shape[0], n_hash, 1), "int64")
+
+
+@register_op("hash", infer=_hash_infer, grad=None)
+def _hash(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X").astype("uint32")
+    n_hash = int(op.attr("num_hash", 1))
+    mod_by = int(op.attr("mod_by", 100000000))
+    # fold the feature dim into one key per row, then n_hash seeded mixes
+    key = jnp.zeros((x.shape[0],), "uint32")
+    for j in range(x.shape[1] if x.ndim > 1 else 1):
+        col = x[:, j] if x.ndim > 1 else x
+        key = key * jnp.uint32(1000003) + col
+    outs = []
+    for h in range(n_hash):
+        z = key + jnp.uint32(0x9E3779B9) * jnp.uint32(h + 1)
+        z = (z ^ (z >> 16)) * jnp.uint32(0x85EBCA6B)
+        z = (z ^ (z >> 13)) * jnp.uint32(0xC2B2AE35)
+        z = z ^ (z >> 16)
+        outs.append(z.astype("int64") % mod_by)
+    ctx.set_output(op, "Out", jnp.stack(outs, 1)[:, :, None])

@@ -1,0 +1,190 @@
+"""max_pool2d/3d_with_index and unpool.
+
+Reference: paddle/fluid/operators/pool_with_index_op.cc (+ the
+MaxPool2d/3dWithIndexFunctor in operators/math/pooling.cc — Mask holds
+the flat h*W+w index of the max within the *input* feature map) and
+operators/unpool_op.cc (max-unpooling: scatter by saved indices).
+
+TPU-first design: pooling windows become a static k-tap gather per
+spatial axis (same trick as interp_extra_ops) — taps and validity masks
+are precomputed host-side, the patch tensor (N,C,out...,k...) is one
+fused gather, and max/argmax reduce over the tap axes on the VPU. Both
+uniform (stride/pad) and adaptive windows fit the same formulation
+(adaptive start/end = floor/ceil divisions, padded to the max window
+with invalid taps masked to -inf). No data-dependent shapes; grads via
+auto-vjp (argmax is int-valued and naturally stop-gradient; Out grads
+flow through the masked max). unpool is a batched scatter-add into the
+zeroed output, exact inverse of the recorded argmax.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import in_var, register_op, set_out
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+NEG = -3.0e38  # -inf stand-in that survives f32 casts
+
+
+def _axis_taps(in_size, out_size, k, stride, pad, adaptive):
+    """(idx [out,kmax] int32, valid [out,kmax] bool, kmax) for one axis."""
+    o = np.arange(out_size)
+    if adaptive:
+        start = (o * in_size) // out_size
+        end = -((-(o + 1) * in_size) // out_size)  # ceil div
+        kmax = int((end - start).max())
+        j = np.arange(kmax)
+        idx = start[:, None] + j[None, :]
+        valid = j[None, :] < (end - start)[:, None]
+    else:
+        start = o * stride - pad
+        kmax = k
+        idx = start[:, None] + np.arange(k)[None, :]
+        valid = (idx >= 0) & (idx < in_size)
+    return (np.clip(idx, 0, in_size - 1).astype(np.int32),
+            valid, kmax)
+
+
+def _pool_out_size(in_size, k, stride, pad, adaptive, out_attr):
+    if adaptive:
+        return out_attr
+    return (in_size - k + 2 * pad) // stride + 1
+
+
+def _with_index_infer(nd):
+    def infer(op, block):
+        x = in_var(op, block, "X")
+        ks = op.attr("ksize")
+        st = op.attr("strides", [1] * nd)
+        pd = op.attr("paddings", [0] * nd)
+        adaptive = bool(op.attr("adaptive", False))
+        if op.attr("global_pooling", False):
+            out_sp = [1] * nd
+        else:
+            out_sp = [
+                _pool_out_size(x.shape[2 + i], ks[i], st[i], pd[i],
+                               adaptive, ks[i]) for i in range(nd)]
+        shape = tuple(x.shape[:2]) + tuple(out_sp)
+        set_out(op, block, "Out", shape, x.dtype)
+        set_out(op, block, "Mask", shape, "int32")
+    return infer
+
+
+def _with_index_lower(nd):
+    def lower(ctx, op):
+        jnp = _jnp()
+        x = ctx.get_input(op, "X")
+        spatial = x.shape[2:]
+        ks = list(op.attr("ksize"))
+        st = list(op.attr("strides", [1] * nd))
+        pd = list(op.attr("paddings", [0] * nd))
+        adaptive = bool(op.attr("adaptive", False))
+        if op.attr("global_pooling", False):
+            ks, st, pd, adaptive = list(spatial), [1] * nd, [0] * nd, False
+
+        # per-axis taps (adaptive: ksize attr is the target output size)
+        taps = []
+        for i in range(nd):
+            out_sz = (ks[i] if adaptive else
+                      _pool_out_size(spatial[i], ks[i], st[i], pd[i],
+                                     False, None))
+            taps.append(_axis_taps(spatial[i], out_sz, ks[i], st[i],
+                                   pd[i], adaptive))
+        idx_axes = [t[0] for t in taps]
+        kmaxes = [t[2] for t in taps]
+        out_spatial = tuple(t[0].shape[0] for t in taps)
+
+        # gather axis-by-axis: after axis i the tap axis sits right after
+        # its spatial axis, giving (N, C, o0, k0, o1, k1, ...)
+        patch = x.astype("float32")
+        for i in range(nd):
+            axis = 2 + 2 * i
+            idx, _, kmax = taps[i]
+            g = jnp.take(patch, jnp.asarray(idx.reshape(-1)), axis=axis)
+            patch = g.reshape(patch.shape[:axis]
+                              + (out_spatial[i], kmax)
+                              + patch.shape[axis + 1:])
+        # move tap axes last: (N, C, o0..o{nd-1}, k0..k{nd-1})
+        perm = ([0, 1] + [2 + 2 * i for i in range(nd)]
+                + [3 + 2 * i for i in range(nd)])
+        patch = patch.transpose(perm)
+
+        # full validity mask, built host-side in the final layout
+        valid_np = np.ones((1, 1) + out_spatial + tuple(kmaxes), bool)
+        for i, (_, valid, _) in enumerate(taps):
+            shape = [1] * (2 + 2 * nd)
+            shape[2 + i] = out_spatial[i]
+            shape[2 + nd + i] = kmaxes[i]
+            valid_np = valid_np & valid.reshape(shape)
+
+        flat = patch.reshape(patch.shape[:2 + nd] + (-1,))
+        vflat = jnp.asarray(
+            valid_np.reshape(valid_np.shape[:2 + nd] + (-1,)))
+        masked = jnp.where(vflat, flat, NEG)
+        out = masked.max(-1)
+        am = masked.argmax(-1)  # flat tap index over (k0*k1*...)
+
+        # decode tap -> global flat input index (row-major over spatial)
+        out_spatial = patch.shape[2:2 + nd]
+        rem, coords = am, []
+        for i in reversed(range(nd)):
+            tap = rem % kmaxes[i]
+            rem = rem // kmaxes[i]
+            # idx_axes[i][o_i, tap] with o_i broadcast over out position
+            oshape = [1] * (2 + nd)
+            oshape[2 + i] = out_spatial[i]
+            o_i = jnp.arange(out_spatial[i]).reshape(oshape)
+            coords.append(jnp.asarray(idx_axes[i])[o_i, tap])
+        coords = coords[::-1]
+        mask = coords[0]
+        for i in range(1, nd):
+            mask = mask * spatial[i] + coords[i]
+        ctx.set_output(op, "Out", out.astype(x.dtype))
+        ctx.set_output(op, "Mask", mask.astype("int32"))
+    return lower
+
+
+register_op("max_pool2d_with_index", infer=_with_index_infer(2),
+            lower=_with_index_lower(2))
+register_op("max_pool3d_with_index", infer=_with_index_infer(3),
+            lower=_with_index_lower(3))
+
+
+def _unpool_infer(op, block):
+    x = in_var(op, block, "X")
+    ks = op.attr("ksize")
+    st = op.attr("strides", [2, 2])
+    pd = op.attr("paddings", [0, 0])
+    out_sp = op.attr("output_size", None)
+    if not out_sp:
+        out_sp = [(x.shape[2 + i] - 1) * st[i] - 2 * pd[i] + ks[i]
+                  for i in range(2)]
+    set_out(op, block, "Out",
+            (x.shape[0], x.shape[1], out_sp[0], out_sp[1]), x.dtype)
+
+
+@register_op("unpool", infer=_unpool_infer)
+def _unpool(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    ind = ctx.get_input(op, "Indices")
+    n, c, h, w = x.shape
+    ks = op.attr("ksize")
+    st = op.attr("strides", [2, 2])
+    pd = op.attr("paddings", [0, 0])
+    out_sp = op.attr("output_size", None)
+    if not out_sp:
+        out_sp = [(h - 1) * st[0] - 2 * pd[0] + ks[0],
+                  (w - 1) * st[1] - 2 * pd[1] + ks[1]]
+    oh, ow = out_sp
+    flat_x = x.reshape(n * c, h * w)
+    flat_i = ind.reshape(n * c, h * w).astype("int32")
+    rows = jnp.arange(n * c)[:, None]
+    out = jnp.zeros((n * c, oh * ow), flat_x.dtype)
+    out = out.at[rows, flat_i].add(flat_x)
+    ctx.set_output(op, "Out", out.reshape(n, c, oh, ow))

@@ -1,0 +1,349 @@
+"""Fused single-op RNN surfaces: lstm / lstmp / gru / rnn (+cudnn_lstm).
+
+Reference: paddle/fluid/operators/lstm_op.cc, lstmp_op.cc, gru_op.cc
+(gate math in operators/math/detail/lstm_kernel.h — gate layout
+[candidate, input, forget, output] — and gru_kernel.h:76 for
+origin_mode), and cudnn_lstm_op.cc / the 2.0 `rnn` op (multi-layer,
+bidirectional, mode attr).
+
+TPU-first design: the reference's LoD batch-reorder machinery
+(sequence2batch.h) and cuDNN descriptors collapse to one lax.scan per
+layer/direction whose per-step math is a fused [H,kH] matmul on the MXU;
+variable lengths use the repo-wide padded [B,T,...] + Lengths masking
+convention (state freezes past each row's end). The x-projection
+(Input @ Wx) is kept OUTSIDE lstm/lstmp/gru, exactly like the reference
+(callers feed the projected [B,T,4H] stream) — so XLA fuses it into one
+big [B*T, D]x[D, 4H] matmul instead of T small ones. The `rnn` op takes
+raw input + a WeightList of (w_ih, w_hh, b_ih, b_hh) per layer*dir.
+"""
+from __future__ import annotations
+
+from .registry import in_var, register_op, set_out
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _mask_step(x, lengths, t, new, old):
+    jnp = _jnp()
+    alive = (t < lengths)[:, None].astype(new.dtype)
+    return alive * new + (1 - alive) * old
+
+
+def _lstm_scan(xs, lengths, w, h0, c0, *, peep=None, reverse=False):
+    """xs [T,B,4H] projected gates; w [H,4H]; returns (hs, h_T, c_T).
+
+    Reference gate layout (math/detail/lstm_kernel.h):
+    [candidate, input, forget, output]; peepholes (wi, wf) read c_prev,
+    wo reads c_new.
+    """
+    import jax
+    jnp = _jnp()
+    H = w.shape[0]
+    if reverse:
+        xs = xs[::-1]
+    T = xs.shape[0]
+    # original time index per scan position (reverse runs T-1..0) —
+    # a step is alive iff its original index < length
+    idxs = jnp.arange(T - 1, -1, -1) if reverse else jnp.arange(T)
+
+    def step(carry, inp):
+        xt, t = inp
+        h, c = carry
+        z = xt + h @ w
+        g, i, f, o = (z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H],
+                      z[:, 3 * H:])
+        if peep is not None:
+            wi, wf, wo = peep
+            i = i + wi * c
+            f = f + wf * c
+        i, f = jax.nn.sigmoid(i), jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        if peep is not None:
+            o = o + wo * c_new
+        o = jax.nn.sigmoid(o)
+        h_new = o * jnp.tanh(c_new)
+        alive = (t < lengths)[:, None].astype(h_new.dtype)
+        h_c = alive * h_new + (1 - alive) * h
+        c_c = alive * c_new + (1 - alive) * c
+        # per-step outputs zero past each row's end (repo-wide padded
+        # convention, matches sequence_pad); carry freezes instead
+        return (h_c, c_c), (alive * h_new, alive * c_new)
+
+    (h_l, c_l), (hs, cs) = jax.lax.scan(step, (h0, c0), (xs, idxs))
+    if reverse:
+        hs, cs = hs[::-1], cs[::-1]
+    return hs, cs, h_l, c_l
+
+
+def _lstm_io(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")          # [B, T, 4H] projected
+    w = ctx.get_input(op, "Weight")         # [H, 4H]
+    bias = ctx.get_input(op, "Bias")        # [4H] or [7H] w/ peepholes
+    lengths = ctx.get_input(op, "Lengths")
+    H = w.shape[0]
+    peep = None
+    if op.attr("use_peepholes", False):
+        b, pw = bias[..., :4 * H], bias[..., 4 * H:]
+        pw = pw.reshape(-1)
+        peep = (pw[:H], pw[H:2 * H], pw[2 * H:])
+    else:
+        b = bias
+    xs = jnp.swapaxes(x + b.reshape(1, 1, -1), 0, 1)
+    B = x.shape[0]
+    h0 = (ctx.get_input(op, "H0") if op.input("H0")
+          else jnp.zeros((B, H), x.dtype))
+    c0 = (ctx.get_input(op, "C0") if op.input("C0")
+          else jnp.zeros((B, H), x.dtype))
+    return xs, lengths, w, h0, c0, peep
+
+
+def _lstm_infer(op, block):
+    x = in_var(op, block, "Input")
+    H = in_var(op, block, "Weight").shape[0]
+    set_out(op, block, "Hidden", (x.shape[0], x.shape[1], H), x.dtype)
+    set_out(op, block, "Cell", (x.shape[0], x.shape[1], H), x.dtype)
+
+
+@register_op("lstm", infer=_lstm_infer)
+def _lstm(ctx, op):
+    jnp = _jnp()
+    xs, lengths, w, h0, c0, peep = _lstm_io(ctx, op)
+    hs, cs, _, _ = _lstm_scan(xs, lengths, w, h0, c0, peep=peep,
+                              reverse=bool(op.attr("is_reverse", False)))
+    ctx.set_output(op, "Hidden", jnp.swapaxes(hs, 0, 1))
+    ctx.set_output(op, "Cell", jnp.swapaxes(cs, 0, 1))
+
+
+def _lstmp_infer(op, block):
+    x = in_var(op, block, "Input")
+    # lstmp Weight is [P,4H]; ProjWeight [H,P] carries both dims
+    H, P = in_var(op, block, "ProjWeight").shape
+    set_out(op, block, "Projection", (x.shape[0], x.shape[1], P),
+            x.dtype)
+    set_out(op, block, "Cell", (x.shape[0], x.shape[1], H), x.dtype)
+
+
+@register_op("lstmp", infer=_lstmp_infer)
+def _lstmp(ctx, op):
+    """LSTM with recurrent projection (reference lstmp_op.cc): the
+    recurrent state is r = act(h @ ProjWeight) [B,P]; Weight is [P,4H]."""
+    import jax
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    w = ctx.get_input(op, "Weight")          # [P, 4H]
+    wp = ctx.get_input(op, "ProjWeight")     # [H, P]
+    bias = ctx.get_input(op, "Bias")
+    lengths = ctx.get_input(op, "Lengths")
+    H, P = wp.shape
+    peep = None
+    if op.attr("use_peepholes", False):
+        b, pw = bias[..., :4 * H], bias[..., 4 * H:].reshape(-1)
+        peep = (pw[:H], pw[H:2 * H], pw[2 * H:])
+    else:
+        b = bias
+    xs = jnp.swapaxes(x + b.reshape(1, 1, -1), 0, 1)
+    B = x.shape[0]
+    r0 = jnp.zeros((B, P), x.dtype)
+    c0 = jnp.zeros((B, H), x.dtype)
+    reverse = bool(op.attr("is_reverse", False))
+    if reverse:
+        xs = xs[::-1]
+    T = xs.shape[0]
+    idxs = (jnp.arange(T - 1, -1, -1) if reverse else jnp.arange(T))
+
+    def step(carry, inp):
+        xt, t = inp
+        r, c = carry
+        z = xt + r @ w
+        g, i, f, o = (z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H],
+                      z[:, 3 * H:])
+        if peep is not None:
+            i = i + peep[0] * c
+            f = f + peep[1] * c
+        i, f = jax.nn.sigmoid(i), jax.nn.sigmoid(f)
+        c_new = f * c + i * jnp.tanh(g)
+        if peep is not None:
+            o = o + peep[2] * c_new
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        r_new = h_new @ wp
+        act = op.attr("proj_activation", "tanh")
+        if act == "tanh":
+            r_new = jnp.tanh(r_new)
+        alive = (t < lengths)[:, None].astype(r_new.dtype)
+        r_c = alive * r_new + (1 - alive) * r
+        c_c = alive * c_new + (1 - alive) * c
+        return (r_c, c_c), (alive * r_new, alive * c_new)
+
+    _, (rs, cs) = jax.lax.scan(step, (r0, c0), (xs, idxs))
+    if reverse:
+        rs, cs = rs[::-1], cs[::-1]
+    ctx.set_output(op, "Projection", jnp.swapaxes(rs, 0, 1))
+    ctx.set_output(op, "Cell", jnp.swapaxes(cs, 0, 1))
+
+
+def _gru_infer(op, block):
+    x = in_var(op, block, "Input")
+    H = in_var(op, block, "Weight").shape[0]
+    set_out(op, block, "Hidden", (x.shape[0], x.shape[1], H), x.dtype)
+
+
+@register_op("gru", infer=_gru_infer)
+def _gru(ctx, op):
+    """Fused GRU (reference gru_op.cc): Input [B,T,3H] projected;
+    Weight [H,3H] packs (update, reset) then candidate; origin_mode per
+    gru_kernel.h:76."""
+    import jax
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    w = ctx.get_input(op, "Weight")
+    lengths = ctx.get_input(op, "Lengths")
+    H = w.shape[0]
+    B = x.shape[0]
+    if op.input("Bias"):
+        x = x + ctx.get_input(op, "Bias").reshape(1, 1, -1)
+    h0 = (ctx.get_input(op, "H0") if op.input("H0")
+          else jnp.zeros((B, H), x.dtype))
+    origin = bool(op.attr("origin_mode", False))
+    reverse = bool(op.attr("is_reverse", False))
+    xs = jnp.swapaxes(x, 0, 1)
+    if reverse:
+        xs = xs[::-1]
+    T = xs.shape[0]
+    idxs = (jnp.arange(T - 1, -1, -1) if reverse else jnp.arange(T))
+    w_ur, w_c = w[:, :2 * H], w[:, 2 * H:]
+
+    def step(h, inp):
+        xt, t = inp
+        g = xt[:, :2 * H] + h @ w_ur
+        u = jax.nn.sigmoid(g[:, :H])
+        r = jax.nn.sigmoid(g[:, H:])
+        c = jnp.tanh(xt[:, 2 * H:] + (r * h) @ w_c)
+        if origin:
+            h_new = u * h + (1 - u) * c
+        else:
+            h_new = (1 - u) * h + u * c
+        alive = (t < lengths)[:, None].astype(h_new.dtype)
+        h_c = alive * h_new + (1 - alive) * h
+        return h_c, alive * h_new
+
+    _, hs = jax.lax.scan(step, h0, (xs, idxs))
+    if reverse:
+        hs = hs[::-1]
+    ctx.set_output(op, "Hidden", jnp.swapaxes(hs, 0, 1))
+
+
+# ---------------------------------------------------------------------------
+# unified multi-layer rnn (reference 2.0 rnn op / cudnn_lstm_op.cc)
+# ---------------------------------------------------------------------------
+def _rnn_op_infer(op, block):
+    x = in_var(op, block, "Input")
+    H = int(op.attr("hidden_size"))
+    nd = 2 if op.attr("is_bidirec", False) else 1
+    L = int(op.attr("num_layers", 1))
+    set_out(op, block, "Out", (x.shape[0], x.shape[1], H * nd), x.dtype)
+    set_out(op, block, "LastH", (L * nd, x.shape[0], H), x.dtype)
+    if op.output("LastC"):
+        set_out(op, block, "LastC", (L * nd, x.shape[0], H), x.dtype)
+
+
+def _rnn_op_lower(ctx, op):
+    """Multi-layer (optionally bidirectional) LSTM/GRU/RNN. WeightList
+    holds (w_ih [Din,kH], w_hh [H,kH], b_ih [kH], b_hh [kH]) per
+    layer*direction, forward direction first."""
+    import jax
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    lengths = ctx.get_input(op, "Lengths")
+    weights = ctx.get_inputs(op, "WeightList")
+    mode = op.attr("mode", "LSTM")
+    H = int(op.attr("hidden_size"))
+    L = int(op.attr("num_layers", 1))
+    ndir = 2 if op.attr("is_bidirec", False) else 1
+    B = x.shape[0]
+    lasth, lastc = [], []
+    out = x
+    wi = 0
+    for layer in range(L):
+        dirs = []
+        for d in range(ndir):
+            w_ih, w_hh, b_ih, b_hh = weights[wi:wi + 4]
+            wi += 4
+            proj = out @ w_ih + (b_ih + b_hh)
+            xs = jnp.swapaxes(proj, 0, 1)
+            rev = d == 1
+            if mode == "LSTM":
+                hs, cs, h_l, c_l = _lstm_scan(
+                    xs, lengths, w_hh,
+                    jnp.zeros((B, H), x.dtype),
+                    jnp.zeros((B, H), x.dtype), reverse=rev)
+                lastc.append(c_l)
+                lasth.append(h_l)
+                dirs.append(jnp.swapaxes(hs, 0, 1))
+            elif mode == "GRU":
+                if rev:
+                    xs = xs[::-1]
+                T = xs.shape[0]
+                idxs = (jnp.arange(T - 1, -1, -1) if rev
+                        else jnp.arange(T))
+                w_ur, w_c = w_hh[:, :2 * H], w_hh[:, 2 * H:]
+
+                def gstep(h, inp):
+                    xt, t = inp
+                    g = xt[:, :2 * H] + h @ w_ur
+                    u = jax.nn.sigmoid(g[:, :H])
+                    r = jax.nn.sigmoid(g[:, H:])
+                    c = jnp.tanh(xt[:, 2 * H:] + (r * h) @ w_c)
+                    h_new = (1 - u) * h + u * c
+                    alive = (t < lengths)[:, None].astype(h_new.dtype)
+                    h_c = alive * h_new + (1 - alive) * h
+                    return h_c, alive * h_new
+
+                h_l, hs = jax.lax.scan(
+                    gstep, jnp.zeros((B, H), x.dtype), (xs, idxs))
+                if rev:
+                    hs = hs[::-1]
+                lasth.append(h_l)
+                dirs.append(jnp.swapaxes(hs, 0, 1))
+            else:  # RNN_TANH / RNN_RELU
+                act = (jnp.tanh if mode == "RNN_TANH"
+                       else lambda v: jnp.maximum(v, 0))
+                if rev:
+                    xs = xs[::-1]
+                T = xs.shape[0]
+                idxs = (jnp.arange(T - 1, -1, -1) if rev
+                        else jnp.arange(T))
+
+                def rstep(h, inp):
+                    xt, t = inp
+                    h_new = act(xt + h @ w_hh)
+                    alive = (t < lengths)[:, None].astype(h_new.dtype)
+                    h_c = alive * h_new + (1 - alive) * h
+                    return h_c, alive * h_new
+
+                h_l, hs = jax.lax.scan(
+                    rstep, jnp.zeros((B, H), x.dtype), (xs, idxs))
+                if rev:
+                    hs = hs[::-1]
+                lasth.append(h_l)
+                dirs.append(jnp.swapaxes(hs, 0, 1))
+        out = jnp.concatenate(dirs, -1) if ndir > 1 else dirs[0]
+        drop = op.attr("dropout_prob", 0.0)
+        if drop and layer < L - 1:
+            import jax as _jax
+            keep = _jax.random.bernoulli(ctx.rng(op), 1.0 - drop,
+                                         out.shape)
+            out = jnp.where(keep, out / (1.0 - drop), 0)
+    ctx.set_output(op, "Out", out)
+    ctx.set_output(op, "LastH", jnp.stack(lasth))
+    if op.output("LastC") and lastc:
+        ctx.set_output(op, "LastC", jnp.stack(lastc))
+
+
+register_op("rnn", infer=_rnn_op_infer, lower=_rnn_op_lower)
+# cudnn_lstm is the pre-2.0 surface of the same kernel
+register_op("cudnn_lstm", infer=_rnn_op_infer, lower=_rnn_op_lower)

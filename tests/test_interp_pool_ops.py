@@ -1,0 +1,205 @@
+"""OpTests for the round-5 interp variants and indexed pooling.
+
+Reference unittests: test_linear_interp_op.py, test_bicubic_interp_op.py,
+test_trilinear_interp_op.py, test_max_pool2d_with_index (test_pool_max_op
+.py), test_unpool_op.py. Numpy refs below are written independently from
+the reference kernel pseudocode (loops), not from the jax lowerings.
+"""
+import numpy as np
+import pytest
+
+from op_test import OpCase, run_case
+
+R = np.random.RandomState
+
+
+# ---------------------------------------------------------------------------
+# numpy references (loop form, mirrors interpolate_op.h)
+# ---------------------------------------------------------------------------
+def _np_ratio(in_s, out_s, align_corners):
+    if align_corners:
+        return (in_s - 1.0) / (out_s - 1.0) if out_s > 1 else 0.0
+    return in_s / out_s
+
+
+def _np_linear_axis(vals, out_s, align_corners, align_mode):
+    """1-D linear interp along the last axis, loop reference."""
+    in_s = vals.shape[-1]
+    r = _np_ratio(in_s, out_s, align_corners)
+    out = np.zeros(vals.shape[:-1] + (out_s,), vals.dtype)
+    align_flag = align_mode == 0 and not align_corners
+    for l in range(out_s):
+        if align_flag:
+            xw = int(r * (l + 0.5) - 0.5)
+        else:
+            xw = int(r * l)
+        xw = max(xw, 0)
+        xe = min(xw + 1, in_s - 1)
+        src = r * (l + 0.5) - 0.5
+        src = max(src, 0.0)
+        d = (src - xw) if align_flag else (r * l - xw)
+        out[..., l] = vals[..., xw] * (1 - d) + vals[..., xe] * d
+    return out
+
+
+def _np_cubic_axis(vals, out_s, align_corners):
+    A = -0.75
+    in_s = vals.shape[-1]
+    r = _np_ratio(in_s, out_s, align_corners)
+    out = np.zeros(vals.shape[:-1] + (out_s,), "float64")
+    for l in range(out_s):
+        src = r * l if align_corners else r * (l + 0.5) - 0.5
+        base = int(np.floor(src))
+        t = src - base
+        w = [((A * (x + 1) - 5 * A) * (x + 1) + 8 * A) * (x + 1) - 4 * A
+             if i in (0, 3) else ((A + 2) * x - (A + 3)) * x * x + 1
+             for i, x in enumerate([t, t, 1 - t, 1 - t])]
+        for i in range(4):
+            idx = min(max(base - 1 + i, 0), in_s - 1)
+            out[..., l] += vals[..., idx] * w[i]
+    return out.astype(vals.dtype)
+
+
+def _np_maxpool_with_index(x, ks, st, pd, adaptive=False):
+    n, c, h, w = x.shape
+    if adaptive:
+        oh, ow = ks
+    else:
+        oh = (h - ks[0] + 2 * pd[0]) // st[0] + 1
+        ow = (w - ks[1] + 2 * pd[1]) // st[1] + 1
+    out = np.zeros((n, c, oh, ow), x.dtype)
+    mask = np.zeros((n, c, oh, ow), "int32")
+    for i in range(oh):
+        for j in range(ow):
+            if adaptive:
+                h0, h1 = i * h // oh, -((-(i + 1) * h) // oh)
+                w0, w1 = j * w // ow, -((-(j + 1) * w) // ow)
+            else:
+                h0 = max(i * st[0] - pd[0], 0)
+                h1 = min(i * st[0] - pd[0] + ks[0], h)
+                w0 = max(j * st[1] - pd[1], 0)
+                w1 = min(j * st[1] - pd[1] + ks[1], w)
+            win = x[:, :, h0:h1, w0:w1].reshape(n, c, -1)
+            am = win.argmax(-1)
+            out[:, :, i, j] = win.max(-1)
+            ww = w1 - w0
+            mask[:, :, i, j] = (h0 + am // ww) * w + (w0 + am % ww)
+    return out, mask
+
+
+X_NCW = R(0).randn(2, 3, 9).astype("float32")
+X_NCHW = R(1).randn(2, 2, 6, 7).astype("float32")
+X_NCDHW = R(2).randn(2, 2, 4, 5, 6).astype("float32")
+
+
+@pytest.mark.parametrize("align,mode", [(True, 1), (False, 0), (False, 1)])
+def test_linear_interp(align, mode):
+    ref = _np_linear_axis(X_NCW, 14, align, mode)
+    run_case(OpCase(
+        "linear_interp", {"X": X_NCW},
+        attrs={"out_w": 14, "align_corners": align, "align_mode": mode},
+        ref=lambda X, **a: ref, grad=["X"], rtol=1e-4, atol=1e-5))
+
+
+@pytest.mark.parametrize("align,mode", [(True, 1), (False, 0)])
+def test_trilinear_interp(align, mode):
+    r = _np_linear_axis(
+        np.moveaxis(X_NCDHW, 2, -1), 7, align, mode)
+    r = _np_linear_axis(np.moveaxis(np.moveaxis(r, -1, 2), 3, -1),
+                        9, align, mode)
+    r = np.moveaxis(r, -1, 3)
+    ref = _np_linear_axis(r, 11, align, mode)
+    run_case(OpCase(
+        "trilinear_interp_v2", {"X": X_NCDHW},
+        attrs={"out_d": 7, "out_h": 9, "out_w": 11,
+               "align_corners": align, "align_mode": mode},
+        ref=lambda X, **a: ref, grad=["X"], rtol=1e-4, atol=1e-5))
+
+
+@pytest.mark.parametrize("align", [True, False])
+def test_bicubic_interp(align):
+    r = _np_cubic_axis(np.moveaxis(X_NCHW, 2, -1), 9, align)
+    ref = _np_cubic_axis(np.moveaxis(r, -1, 2), 13, align)
+    run_case(OpCase(
+        "bicubic_interp", {"X": X_NCHW},
+        attrs={"out_h": 9, "out_w": 13, "align_corners": align},
+        ref=lambda X, **a: ref, grad=["X"], rtol=1e-4, atol=1e-5))
+
+
+def test_interp_scale_attr():
+    ref = _np_linear_axis(X_NCW, 18, False, 1)
+    run_case(OpCase(
+        "linear_interp_v2", {"X": X_NCW},
+        attrs={"scale": 2.0, "align_corners": False, "align_mode": 1},
+        ref=lambda X, **a: ref, grad=["X"], rtol=1e-4, atol=1e-5))
+
+
+def test_max_pool2d_with_index():
+    x = R(3).randn(2, 3, 7, 7).astype("float32")
+    out, mask = _np_maxpool_with_index(x, [3, 3], [2, 2], [1, 1])
+    run_case(OpCase(
+        "max_pool2d_with_index", {"X": x},
+        outputs={"Out": 1, "Mask": 1},
+        attrs={"ksize": [3, 3], "strides": [2, 2], "paddings": [1, 1]},
+        ref=lambda X, **a: {"Out": out, "Mask": mask},
+        grad=["X"]))
+
+
+def test_max_pool2d_with_index_adaptive():
+    x = R(4).randn(2, 2, 7, 5).astype("float32")
+    out, mask = _np_maxpool_with_index(x, [3, 2], None, None,
+                                       adaptive=True)
+    run_case(OpCase(
+        "max_pool2d_with_index", {"X": x},
+        outputs={"Out": 1, "Mask": 1},
+        attrs={"ksize": [3, 2], "adaptive": True},
+        ref=lambda X, **a: {"Out": out, "Mask": mask},
+        grad=["X"]))
+
+
+def test_max_pool3d_with_index():
+    x = R(5).randn(1, 2, 5, 5, 5).astype("float32")
+    # loop ref for 3d
+    ks, st, pd = [2, 2, 2], [2, 2, 2], [0, 0, 0]
+    od = oh = ow = 3 if False else (5 - 2) // 2 + 1
+    out = np.zeros((1, 2, od, oh, ow), "float32")
+    mask = np.zeros((1, 2, od, oh, ow), "int32")
+    for a in range(od):
+        for b in range(oh):
+            for c in range(ow):
+                win = x[:, :, a*2:a*2+2, b*2:b*2+2, c*2:c*2+2]
+                f = win.reshape(1, 2, -1)
+                am = f.argmax(-1)
+                out[:, :, a, b, c] = f.max(-1)
+                d_, h_, w_ = np.unravel_index(am, (2, 2, 2))
+                mask[:, :, a, b, c] = ((a*2 + d_) * 5 + (b*2 + h_)) * 5 \
+                    + (c*2 + w_)
+    run_case(OpCase(
+        "max_pool3d_with_index", {"X": x},
+        outputs={"Out": 1, "Mask": 1},
+        attrs={"ksize": ks, "strides": st, "paddings": pd},
+        ref=lambda X, **a: {"Out": out, "Mask": mask},
+        grad=["X"]))
+
+
+def test_unpool():
+    x = R(6).rand(2, 2, 3, 3).astype("float32") + 0.5
+    # indices as produced by max_pool2d_with_index on a 6x6 input, k2s2
+    ind = np.zeros((2, 2, 3, 3), "int32")
+    rr = R(7)
+    for i in range(3):
+        for j in range(3):
+            ind[:, :, i, j] = (2 * i + rr.randint(0, 2)) * 6 \
+                + 2 * j + rr.randint(0, 2)
+    ref = np.zeros((2, 2, 6, 6), "float32")
+    for n in range(2):
+        for c in range(2):
+            for i in range(3):
+                for j in range(3):
+                    f = ind[n, c, i, j]
+                    ref[n, c, f // 6, f % 6] += x[n, c, i, j]
+    run_case(OpCase(
+        "unpool", {"X": x, "Indices": ind},
+        attrs={"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+               "unpooling_type": "max"},
+        ref=lambda X, Indices, **a: ref, grad=["X"]))

@@ -1,0 +1,322 @@
+"""OpTests for the round-5 misc batch (ops/misc2_ops.py).
+
+Reference unittests: test_space_to_depth_op.py, test_crop_op.py,
+test_pad_constant_like.py, test_expand_as_op.py, test_frobenius_norm_op
+.py, test_cross_entropy2_op.py, test_where_index.py, test_sigmoid_focal
+_loss_op.py, test_shuffle_batch_op.py, test_sample_logits.py,
+test_positive_negative_pair_op.py, test_hash_op.py,
+test_coalesce_tensor_op.py, test_inplace_abn_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from op_test import OpCase, run_case
+
+R = np.random.RandomState
+
+
+def test_space_to_depth():
+    x = R(0).randn(2, 8, 4, 6).astype("float32")
+    bs = 2
+    b, c, h, w = x.shape
+    c2 = c // (bs * bs)
+    # literal reference functor loop (space_to_depth_op.h:39)
+    out = np.zeros(b * c * h * w, "float32")
+    xf = x.reshape(-1)
+    for idx in range(b * c * h * w):
+        bb = idx // (c * h * w)
+        k = (idx % (c * h * w)) // (h * w)
+        j = ((idx % (c * h * w)) % (h * w)) // w
+        i = ((idx % (c * h * w)) % (h * w)) % w
+        cc = k % c2
+        off = k // c2
+        w2 = i * bs + off % bs
+        h2 = j * bs + off // bs
+        out[w2 + w * bs * (h2 + h * bs * (cc + c2 * bb))] = xf[idx]
+    ref = out.reshape(b, c * bs * bs, h // bs, w // bs)
+    run_case(OpCase("space_to_depth", {"X": x}, attrs={"blocksize": 2},
+                    ref=lambda X, **a: ref, grad=["X"]))
+
+
+def test_crop_and_crop_tensor():
+    x = R(1).randn(4, 6, 5).astype("float32")
+    for op in ("crop", "crop_tensor"):
+        run_case(OpCase(
+            op, {"X": x},
+            attrs={"offsets": [1, 2, 0], "shape": [2, 3, 4]},
+            ref=lambda X, **a: X[1:3, 2:5, 0:4], grad=["X"]))
+
+
+def test_pad_constant_like():
+    x = np.zeros((4, 5), "float32")
+    y = R(2).randn(2, 3).astype("float32")
+    ref = np.full((4, 5), 1.5, "float32")
+    ref[:2, :3] = y
+    run_case(OpCase(
+        "pad_constant_like", {"X": x, "Y": y},
+        attrs={"pad_value": 1.5},
+        ref=lambda X, Y, **a: ref, grad=["Y"]))
+
+
+def test_expand_as():
+    x = R(3).randn(2, 1, 3).astype("float32")
+    run_case(OpCase(
+        "expand_as", {"X": x, "Y": np.zeros((4, 1, 3), "float32")},
+        ref=lambda X, Y: np.tile(X, (2, 1, 1)), grad=["X"]))
+    # v2 = numpy broadcasting rules (1-dims expand, others must match)
+    run_case(OpCase(
+        "expand_as_v2", {"X": x, "Y": np.zeros((2, 5, 3), "float32")},
+        ref=lambda X, Y: np.broadcast_to(X, (2, 5, 3)), grad=["X"]))
+
+
+def test_frobenius_norm():
+    x = R(4).randn(3, 4, 5).astype("float32")
+    run_case(OpCase(
+        "frobenius_norm", {"X": x}, attrs={"dim": [1, 2],
+                                           "keep_dim": False},
+        ref=lambda X, **a: np.sqrt((X ** 2).sum((1, 2))),
+        grad=["X"], rtol=1e-4, atol=1e-5))
+    run_case(OpCase(
+        "frobenius_norm", {"X": x}, attrs={"reduce_all": True},
+        ref=lambda X, **a: np.sqrt((X ** 2).sum()),
+        grad=["X"], rtol=1e-4, atol=1e-5))
+
+
+def test_cross_entropy2():
+    x = R(5).uniform(0.05, 1.0, (4, 7)).astype("float32")
+    x /= x.sum(-1, keepdims=True)
+    lab = np.array([[1], [3], [0], [6]], "int64")
+    match = np.take_along_axis(x, lab, 1)
+    run_case(OpCase(
+        "cross_entropy2", {"X": x, "Label": lab},
+        outputs={"Y": 1, "MatchX": 1, "XShape": 1},
+        ref=lambda X, Label: {"Y": -np.log(match), "MatchX": match},
+        grad=["X"], rtol=1e-4, atol=1e-5))
+
+
+def test_cross_entropy2_ignore_index():
+    x = R(6).uniform(0.05, 1.0, (3, 4)).astype("float32")
+    lab = np.array([[2], [-100], [1]], "int64")
+    safe = np.where(lab == -100, 0, lab)
+    match = np.take_along_axis(x, safe, 1)
+    y = -np.log(match)
+    y[1] = 0.0
+    run_case(OpCase(
+        "cross_entropy2", {"X": x, "Label": lab},
+        outputs={"Y": 1, "MatchX": 1, "XShape": 1},
+        attrs={"ignore_index": -100},
+        ref=lambda X, Label, **a: {"Y": y, "MatchX": match},
+        rtol=1e-4, atol=1e-5))
+
+
+def test_where_index():
+    cond = np.array([[True, False, True], [False, False, True]])
+    ref = np.array([[0, 0], [0, 2], [1, 2],
+                    [-1, -1], [-1, -1], [-1, -1]], "int64")
+    run_case(OpCase("where_index", {"Condition": cond},
+                    ref=lambda Condition: ref, check_dtype=True))
+
+
+def test_sigmoid_focal_loss():
+    n, c = 6, 5
+    x = R(7).randn(n, c).astype("float32")
+    label = np.array([[1], [0], [3], [-1], [5], [2]], "int64")
+    fg = np.array([3], "int32")
+    gamma, alpha = 2.0, 0.25
+    # loop reference (sigmoid_focal_loss_op.cu:41)
+    ref = np.zeros((n, c), "float32")
+    for i in range(n):
+        for d in range(c):
+            xx = x[i, d]
+            g = label[i, 0]
+            c_pos = float(g == d + 1)
+            c_neg = float((g != -1) and (g != d + 1))
+            fgn = max(fg[0], 1)
+            s_pos, s_neg = alpha / fgn, (1 - alpha) / fgn
+            p = 1 / (1 + np.exp(-xx))
+            term_pos = (1 - p) ** gamma * np.log(max(p, 1e-38))
+            term_neg = p ** gamma * (
+                -xx * (xx >= 0) - np.log(1 + np.exp(xx - 2 * xx * (xx >= 0))))
+            ref[i, d] = -c_pos * term_pos * s_pos - c_neg * term_neg * s_neg
+    run_case(OpCase(
+        "sigmoid_focal_loss", {"X": x, "Label": label, "FgNum": fg},
+        attrs={"gamma": gamma, "alpha": alpha},
+        ref=lambda X, Label, FgNum, **a: ref,
+        grad=["X"], rtol=1e-4, atol=1e-5))
+
+
+def test_shuffle_batch():
+    """Out must be a permutation of rows and ShuffleIdx must describe it."""
+    x = np.arange(20, dtype="float32").reshape(5, 4)
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        xv = pt.layers.data(name="x", shape=[4], dtype="float32")
+        block = main.global_block()
+        out = block.create_var(name="sb_out", shape=[5, 4],
+                               dtype="float32")
+        idx = block.create_var(name="sb_idx", shape=[5], dtype="int64")
+        block.append_op("shuffle_batch", inputs={"X": [xv.name]},
+                        outputs={"Out": [out.name],
+                                 "ShuffleIdx": [idx.name]}, attrs={})
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    o, i = exe.run(main, feed={"x": x}, fetch_list=["sb_out", "sb_idx"],
+                   scope=scope)
+    o, i = np.asarray(o), np.asarray(i)
+    assert sorted(i.tolist()) == list(range(5))
+    np.testing.assert_allclose(o, x[i])
+
+
+def test_sample_logits():
+    n, vocab, nt, s = 3, 50, 1, 8
+    logits = R(8).randn(n, vocab).astype("float32")
+    labels = np.array([[5], [0], [49]], "int64")
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        block = main.global_block()
+        lg = block.create_var(name="lg", shape=[n, vocab],
+                              dtype="float32", is_data=True)
+        lb = block.create_var(name="lb", shape=[n, nt], dtype="int64",
+                              is_data=True)
+        outs = {}
+        for slot, shp, dt in [("Samples", [n, nt + s], "int64"),
+                              ("Probabilities", [n, nt + s], "float32"),
+                              ("SampledLogits", [n, nt + s], "float32"),
+                              ("SampledLabels", [n, nt], "int64")]:
+            outs[slot] = [block.create_var(name=f"sl_{slot}", shape=shp,
+                                           dtype=dt).name]
+        block.append_op("sample_logits",
+                        inputs={"Logits": ["lg"], "Labels": ["lb"]},
+                        outputs=outs,
+                        attrs={"num_samples": s,
+                               "remove_accidental_hits": False})
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    sm, pr, sl, slab = (np.asarray(v) for v in exe.run(
+        main, feed={"lg": logits, "lb": labels},
+        fetch_list=["sl_Samples", "sl_Probabilities",
+                    "sl_SampledLogits", "sl_SampledLabels"],
+        scope=scope))
+    # first nt columns are the true labels
+    np.testing.assert_array_equal(sm[:, :nt], labels)
+    assert (sm >= 0).all() and (sm < vocab).all()
+    # probabilities follow the log-uniform marginal
+    expect_p = np.log((sm + 2.0) / (sm + 1.0)) / np.log(vocab + 1.0)
+    np.testing.assert_allclose(pr, expect_p, rtol=1e-5)
+    # sampled logits = gathered logit - log(q)
+    gathered = np.take_along_axis(logits, sm, 1)
+    np.testing.assert_allclose(sl, gathered - np.log(expect_p),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(slab, np.zeros((n, nt), "int64"))
+
+
+def test_positive_negative_pair():
+    score = np.array([[0.9], [0.4], [0.6], [0.2], [0.8]], "float32")
+    label = np.array([[1], [0], [1], [0], [1]], "float32")
+    qid = np.array([[0], [0], [0], [1], [1]], "int64")
+    # q0: pairs (0,1): lab 1>0, s .9>.4 pos; (1,2): lab 0<1, s... hi=2:
+    #     .6>.4 pos; (0,2) same label skip. q1: (3,4): hi=4 .8>.2 pos
+    run_case(OpCase(
+        "positive_negative_pair",
+        {"Score": score, "Label": label, "QueryID": qid},
+        outputs={"PositivePair": 1, "NegativePair": 1, "NeutralPair": 1},
+        attrs={"column": -1},
+        ref=lambda Score, Label, QueryID, **a: {
+            "PositivePair": np.array([3.0], "float32"),
+            "NegativePair": np.array([0.0], "float32"),
+            "NeutralPair": np.array([0.0], "float32")},
+        check_dtype=False))
+
+
+def test_hash_op():
+    x = np.array([[1, 2], [3, 4], [1, 2]], "int64")
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        block = main.global_block()
+        xv = block.create_var(name="hx", shape=[3, 2], dtype="int64",
+                              is_data=True)
+        out = block.create_var(name="hout", shape=[3, 4, 1],
+                               dtype="int64")
+        block.append_op("hash", inputs={"X": ["hx"]},
+                        outputs={"Out": ["hout"]},
+                        attrs={"num_hash": 4, "mod_by": 10000})
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    o, = exe.run(main, feed={"hx": x}, fetch_list=["hout"], scope=scope)
+    o = np.asarray(o)
+    assert o.shape == (3, 4, 1)
+    assert (o >= 0).all() and (o < 10000).all()
+    np.testing.assert_array_equal(o[0], o[2])  # deterministic
+    assert len({tuple(o[0, :, 0]), tuple(o[1, :, 0])}) == 2
+
+
+def test_coalesce_tensor():
+    a = R(9).randn(2, 3).astype("float32")
+    b = R(10).randn(4).astype("float32")
+    run_case(OpCase(
+        "coalesce_tensor", {"Input": [a, b]},
+        outputs={"Output": 2, "FusedOutput": 1},
+        attrs={"copy_data": True},
+        ref=lambda Input, **at: {
+            "Output": [a, b],
+            "FusedOutput": np.concatenate([a.reshape(-1), b])},
+    ))
+
+
+def test_inplace_abn_matches_bn_relu():
+    x = R(11).randn(4, 3, 5, 5).astype("float32")
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main, startup):
+        xv = pt.layers.data(name="ax", shape=[3, 5, 5], dtype="float32")
+        bn = pt.layers.batch_norm(xv, act="relu")
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    want, = exe.run(main, feed={"ax": x}, fetch_list=[bn.name],
+                    scope=scope)
+
+    main2, startup2 = pt.Program(), pt.Program()
+    startup2._is_startup = True
+    with pt.program_guard(main2, startup2):
+        xv = pt.layers.data(name="ax", shape=[3, 5, 5], dtype="float32")
+        block = main2.global_block()
+        c = 3
+        params = {}
+        for nm, init in [("scale", 1.0), ("bias", 0.0), ("mean", 0.0),
+                         ("var", 1.0)]:
+            v = block.create_var(name=f"abn_{nm}", shape=[c],
+                                 dtype="float32", persistable=True)
+            startup2.global_block().create_var(
+                name=f"abn_{nm}", shape=[c], dtype="float32",
+                persistable=True)
+            startup2.global_block().append_op(
+                "fill_constant", inputs={},
+                outputs={"Out": [f"abn_{nm}"]},
+                attrs={"shape": [c], "value": init, "dtype": "float32"})
+            params[nm] = v
+        outs = {s: [block.create_var(name=f"abn_{s}", shape=[c],
+                                     dtype="float32").name]
+                for s in ("MeanOut", "VarianceOut", "SavedMean",
+                          "SavedVariance")}
+        y = block.create_var(name="abn_y", shape=[4, 3, 5, 5],
+                             dtype="float32")
+        outs["Y"] = [y.name]
+        block.append_op(
+            "inplace_abn",
+            inputs={"X": [xv.name], "Scale": ["abn_scale"],
+                    "Bias": ["abn_bias"], "Mean": ["abn_mean"],
+                    "Variance": ["abn_var"]},
+            outputs=outs, attrs={"activation": "relu"})
+    exe.run(startup2, scope=scope)
+    got, = exe.run(main2, feed={"ax": x}, fetch_list=["abn_y"],
+                   scope=scope)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)

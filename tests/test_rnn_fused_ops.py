@@ -1,0 +1,217 @@
+"""OpTests for the fused RNN surfaces (ops/rnn_fused_ops.py).
+
+Reference unittests: test_lstm_op.py, test_lstmp_op.py, test_gru_op.py,
+test_rnn_op.py. Numpy refs are step-loop implementations written from
+the reference kernel math (math/detail/lstm_kernel.h gate layout
+[candidate, input, forget, output]; gru_kernel.h origin_mode).
+"""
+import numpy as np
+import pytest
+
+from op_test import OpCase, run_case
+
+R = np.random.RandomState
+
+
+def _sig(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def _np_lstm(x, w, b, lengths, peep=None, reverse=False, h0=None,
+             c0=None):
+    """x [B,T,4H] projected; returns hidden, cell [B,T,H]."""
+    B, T, H4 = x.shape
+    H = H4 // 4
+    h = np.zeros((B, H)) if h0 is None else h0.copy()
+    c = np.zeros((B, H)) if c0 is None else c0.copy()
+    hs = np.zeros((B, T, H))
+    cs = np.zeros((B, T, H))
+    order = range(T - 1, -1, -1) if reverse else range(T)
+    for t in order:
+        z = x[:, t] + b.reshape(1, -1)[:, :4 * H] + h @ w
+        g, i, f, o = np.split(z, 4, 1)
+        if peep is not None:
+            i = i + peep[0] * c
+            f = f + peep[1] * c
+        i, f = _sig(i), _sig(f)
+        c_new = f * c + i * np.tanh(g)
+        if peep is not None:
+            o = o + peep[2] * c_new
+        h_new = _sig(o) * np.tanh(c_new)
+        alive = (t < lengths)[:, None]
+        h = np.where(alive, h_new, h)
+        c = np.where(alive, c_new, c)
+        hs[:, t] = np.where(alive, h_new, 0)
+        cs[:, t] = np.where(alive, c_new, 0)
+    return hs.astype("float32"), cs.astype("float32")
+
+
+B, T, H = 3, 5, 4
+X = R(0).randn(B, T, 4 * H).astype("float32") * 0.5
+W = R(1).randn(H, 4 * H).astype("float32") * 0.3
+BI = R(2).randn(4 * H).astype("float32") * 0.1
+LEN = np.array([5, 3, 4], "int64")
+
+
+def test_lstm_forward_backward():
+    hs, cs = _np_lstm(X.astype("float64"), W.astype("float64"),
+                      BI.astype("float64"), LEN)
+    run_case(OpCase(
+        "lstm", {"Input": X, "Weight": W, "Bias": BI, "Lengths": LEN},
+        outputs={"Hidden": 1, "Cell": 1},
+        ref=lambda **kw: {"Hidden": hs.astype("float32"),
+                          "Cell": cs.astype("float32")},
+        grad=["Input", "Weight", "Bias"], rtol=1e-4, atol=1e-5))
+
+
+def test_lstm_reverse_and_peepholes():
+    b7 = np.concatenate([BI, R(3).randn(3 * H).astype("float32") * 0.1])
+    peep = np.split(b7[4 * H:], 3)
+    hs, cs = _np_lstm(X.astype("float64"), W.astype("float64"), b7,
+                      LEN, peep=peep, reverse=True)
+    run_case(OpCase(
+        "lstm", {"Input": X, "Weight": W, "Bias": b7, "Lengths": LEN},
+        outputs={"Hidden": 1, "Cell": 1},
+        attrs={"use_peepholes": True, "is_reverse": True},
+        ref=lambda **kw: {"Hidden": hs.astype("float32"),
+                          "Cell": cs.astype("float32")},
+        grad=["Input"], rtol=1e-4, atol=1e-5))
+
+
+def test_lstmp():
+    P = 3
+    wp = R(4).randn(H, P).astype("float32") * 0.4
+    w = R(5).randn(P, 4 * H).astype("float32") * 0.3
+    x64, w64, wp64 = (a.astype("float64") for a in (X, w, wp))
+    r = np.zeros((B, P))
+    c = np.zeros((B, H))
+    rs = np.zeros((B, T, P))
+    cs = np.zeros((B, T, H))
+    for t in range(T):
+        z = x64[:, t] + BI.reshape(1, -1) + r @ w64
+        g, i, f, o = np.split(z, 4, 1)
+        i, f = _sig(i), _sig(f)
+        c_new = f * c + i * np.tanh(g)
+        h_new = _sig(o) * np.tanh(c_new)
+        r_new = np.tanh(h_new @ wp64)
+        alive = (t < LEN)[:, None]
+        r = np.where(alive, r_new, r)
+        c = np.where(alive, c_new, c)
+        rs[:, t] = np.where(alive, r_new, 0)
+        cs[:, t] = np.where(alive, c_new, 0)
+    run_case(OpCase(
+        "lstmp", {"Input": X, "Weight": w, "ProjWeight": wp,
+                  "Bias": BI, "Lengths": LEN},
+        outputs={"Projection": 1, "Cell": 1},
+        ref=lambda **kw: {"Projection": rs.astype("float32"),
+                          "Cell": cs.astype("float32")},
+        grad=["Input", "ProjWeight"], rtol=1e-4, atol=1e-5))
+
+
+@pytest.mark.parametrize("origin", [False, True])
+def test_gru(origin):
+    x = R(6).randn(B, T, 3 * H).astype("float32") * 0.5
+    w = R(7).randn(H, 3 * H).astype("float32") * 0.3
+    x64, w64 = x.astype("float64"), w.astype("float64")
+    h = np.zeros((B, H))
+    hs = np.zeros((B, T, H))
+    for t in range(T):
+        g = x64[:, t, :2 * H] + h @ w64[:, :2 * H]
+        u, r = _sig(g[:, :H]), _sig(g[:, H:])
+        c = np.tanh(x64[:, t, 2 * H:] + (r * h) @ w64[:, 2 * H:])
+        h_new = u * h + (1 - u) * c if origin else (1 - u) * h + u * c
+        alive = (t < LEN)[:, None]
+        h = np.where(alive, h_new, h)
+        hs[:, t] = np.where(alive, h_new, 0)
+    run_case(OpCase(
+        "gru", {"Input": x, "Weight": w, "Lengths": LEN},
+        outputs={"Hidden": 1},
+        attrs={"origin_mode": origin},
+        ref=lambda **kw: hs.astype("float32"),
+        grad=["Input", "Weight"], rtol=1e-4, atol=1e-5))
+
+
+def test_rnn_bidirectional_lstm():
+    D = 3
+    x = R(8).randn(B, T, D).astype("float32") * 0.5
+    ws = []
+    for _ in range(2):  # fwd, bwd
+        ws += [R(9).randn(D, 4 * H).astype("float32") * 0.3,
+               R(10).randn(H, 4 * H).astype("float32") * 0.3,
+               R(11).randn(4 * H).astype("float32") * 0.1,
+               R(12).randn(4 * H).astype("float32") * 0.1]
+    # numpy ref via _np_lstm on the projected stream
+    outs = []
+    for d in range(2):
+        w_ih, w_hh, b_ih, b_hh = ws[4 * d:4 * d + 4]
+        proj = (x.astype("float64") @ w_ih.astype("float64")
+                + b_ih + b_hh)
+        hs, _ = _np_lstm(proj, w_hh.astype("float64"),
+                         np.zeros(4 * H), LEN, reverse=(d == 1))
+        outs.append(hs)
+    ref = np.concatenate(outs, -1).astype("float32")
+    run_case(OpCase(
+        "rnn", {"Input": x, "WeightList": ws, "Lengths": LEN},
+        outputs={"Out": 1, "LastH": 1, "LastC": 1},
+        attrs={"mode": "LSTM", "hidden_size": H, "num_layers": 1,
+               "is_bidirec": True},
+        ref=None, grad=["Input"], rtol=1e-4, atol=1e-5))
+    # forward value check (ref=None above skips; do it via direct case)
+    run_case(OpCase(
+        "rnn", {"Input": x, "WeightList": ws, "Lengths": LEN},
+        outputs={"Out": 1},
+        attrs={"mode": "LSTM", "hidden_size": H, "num_layers": 1,
+               "is_bidirec": True},
+        ref=lambda **kw: ref, rtol=1e-4, atol=1e-5))
+
+
+def test_rnn_two_layer_gru():
+    D = 3
+    x = R(13).randn(B, T, D).astype("float32") * 0.5
+    ws, dims = [], [D, H]
+    rr = R(14)
+    for layer in range(2):
+        ws += [rr.randn(dims[layer], 3 * H).astype("float32") * 0.3,
+               rr.randn(H, 3 * H).astype("float32") * 0.3,
+               rr.randn(3 * H).astype("float32") * 0.1,
+               rr.randn(3 * H).astype("float32") * 0.1]
+    out = x.astype("float64")
+    for layer in range(2):
+        w_ih, w_hh, b_ih, b_hh = (a.astype("float64")
+                                  for a in ws[4 * layer:4 * layer + 4])
+        proj = out @ w_ih + b_ih + b_hh
+        h = np.zeros((B, H))
+        hs = np.zeros((B, T, H))
+        for t in range(T):
+            g = proj[:, t, :2 * H] + h @ w_hh[:, :2 * H]
+            u, r = _sig(g[:, :H]), _sig(g[:, H:])
+            c = np.tanh(proj[:, t, 2 * H:] + (r * h) @ w_hh[:, 2 * H:])
+            h_new = (1 - u) * h + u * c
+            alive = (t < LEN)[:, None]
+            h = np.where(alive, h_new, h)
+            hs[:, t] = np.where(alive, h_new, 0)
+        out = hs
+    run_case(OpCase(
+        "rnn", {"Input": x, "WeightList": ws, "Lengths": LEN},
+        outputs={"Out": 1},
+        attrs={"mode": "GRU", "hidden_size": H, "num_layers": 2},
+        ref=lambda **kw: out.astype("float32"),
+        grad=["Input"], rtol=1e-4, atol=1e-5, name="rnn_gru2"))
+
+
+def test_cudnn_lstm_alias():
+    D = 3
+    x = R(15).randn(B, T, D).astype("float32") * 0.5
+    ws = [R(16).randn(D, 4 * H).astype("float32") * 0.3,
+          R(17).randn(H, 4 * H).astype("float32") * 0.3,
+          R(18).randn(4 * H).astype("float32") * 0.1,
+          R(19).randn(4 * H).astype("float32") * 0.1]
+    proj = (x.astype("float64") @ ws[0].astype("float64")
+            + ws[2] + ws[3])
+    hs, _ = _np_lstm(proj, ws[1].astype("float64"), np.zeros(4 * H),
+                     LEN)
+    run_case(OpCase(
+        "cudnn_lstm", {"Input": x, "WeightList": ws, "Lengths": LEN},
+        outputs={"Out": 1},
+        attrs={"mode": "LSTM", "hidden_size": H, "num_layers": 1},
+        ref=lambda **kw: hs.astype("float32"), rtol=1e-4, atol=1e-5))
