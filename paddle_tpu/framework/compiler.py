@@ -137,9 +137,19 @@ class CompiledProgram:
         mesh = dp_mesh(n)
         batch_axes = ("dp",)
 
+        if self._build_strategy.sync_batch_norm:
+            # the reference's sync-BN build pass rewrites batch_norm ->
+            # sync_batch_norm (details/build_strategy.cc); same here —
+            # the op's pmean binds the dp axis in the spmd lowering
+            for blk in self._program.blocks:
+                for op in blk.ops:
+                    if op.type == "batch_norm":
+                        op.type = "sync_batch_norm"
+
         def _has_collective(blk):
             return any(
                 op.type.startswith(("c_", "send_v2", "recv_v2", "barrier"))
+                or op.type == "sync_batch_norm"
                 or any(op.attr(k) is not None and _has_collective(
                        self._program.block(op.attr(k)))
                        for k in ("sub_block", "true_block", "false_block"))

@@ -28,5 +28,7 @@ from . import interp_extra_ops  # noqa: F401
 from . import pool_extra_ops  # noqa: F401
 from . import misc2_ops  # noqa: F401
 from . import rnn_fused_ops  # noqa: F401
+from . import catalog_seq_ops  # noqa: F401
+from . import catalog_ctr_ops  # noqa: F401
 from .registry import (LowerContext, all_registered_ops, get_op_def,  # noqa
                        has_op, register_op)

@@ -165,3 +165,89 @@ def _flash_attention_qkv(ctx, op):
         out = jnp.moveaxis(o, 1, 2).reshape(B, S, H).astype(qkv.dtype)
     ctx.set_output(op, "Out", out)
 
+
+
+# ---------------------------------------------------------------------------
+# fused inference surfaces (reference operators/fused/) — on TPU these
+# are plain compositions XLA fuses; the ops exist for API parity with
+# the reference's pass-inserted fused kernels.
+# ---------------------------------------------------------------------------
+def _mm_infer(op, block):
+    x = in_var(op, block, "Input")
+    set_out(op, block, "Out", x.shape, x.dtype)
+
+
+@register_op("multihead_matmul", infer=_mm_infer)
+def _multihead_matmul(ctx, op):
+    """Reference fused/multihead_matmul_op.cu: Input [B,S,D] projects to
+    packed QKV via W [D,3,N,H] (+ Bias [3,N,H]), scaled dot-product
+    attention with optional BiasQK added to the logits, heads merged
+    back to [B,S,D]."""
+    import jax
+    import jax.numpy as jnp
+    x = ctx.get_input(op, "Input")
+    w = ctx.get_input(op, "W")
+    bias = ctx.get_input(op, "Bias")
+    n_head = int(op.attr("head_number"))
+    alpha = float(op.attr("alpha", 1.0))
+    B, S, D = x.shape
+    H = D // n_head
+    qkv = jnp.einsum("bsd,dknh->kbnsh", x.astype("float32"),
+                     w.reshape(D, 3, n_head, H).astype("float32"))
+    qkv = qkv + bias.reshape(3, 1, n_head, 1, H)
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    logits = jnp.einsum("bnsh,bnth->bnst", q, k) * alpha
+    if op.input("BiasQK"):
+        logits = logits + ctx.get_input(op, "BiasQK").astype("float32")
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bnst,bnth->bsnh", probs, v).reshape(B, S, D)
+    ctx.set_output(op, "Out", out.astype(x.dtype))
+
+
+def _skip_ln_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+
+
+@register_op("skip_layernorm", infer=_skip_ln_infer)
+def _skip_layernorm(ctx, op):
+    """out = LayerNorm(X + Y) (reference fused/skip_layernorm_op.cc)."""
+    import jax.numpy as jnp
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    scale = ctx.get_input(op, "Scale")
+    bias = ctx.get_input(op, "Bias")
+    eps = float(op.attr("epsilon", 1e-5))
+    s = (x + y).astype("float32")
+    mu = s.mean(-1, keepdims=True)
+    var = ((s - mu) ** 2).mean(-1, keepdims=True)
+    out = (s - mu) / jnp.sqrt(var + eps) * scale + bias
+    ctx.set_output(op, "Out", out.astype(x.dtype))
+
+
+def _feel_infer(op, block):
+    ids0 = block.var(op.input("Ids")[0])
+    emb0 = block.var(op.input("Embs")[0])
+    set_out(op, block, "Out",
+            (ids0.shape[0], ids0.shape[1], emb0.shape[1]), emb0.dtype)
+
+
+@register_op("fused_embedding_eltwise_layernorm", infer=_feel_infer)
+def _fused_embedding_eltwise_layernorm(ctx, op):
+    """out = LayerNorm(sum_i Embs_i[Ids_i]) (reference
+    fused/fused_embedding_eltwise_layernorm_op.cc)."""
+    import jax.numpy as jnp
+    ids = ctx.get_inputs(op, "Ids")
+    embs = ctx.get_inputs(op, "Embs")
+    scale = ctx.get_input(op, "Scale")
+    bias = ctx.get_input(op, "Bias")
+    eps = float(op.attr("epsilon", 1e-5))
+    s = None
+    for i, e in zip(ids, embs):
+        idx = i.reshape(i.shape[:2]).astype("int32")
+        g = e[idx].astype("float32")
+        s = g if s is None else s + g
+    mu = s.mean(-1, keepdims=True)
+    var = ((s - mu) ** 2).mean(-1, keepdims=True)
+    out = (s - mu) / jnp.sqrt(var + eps) * scale + bias
+    ctx.set_output(op, "Out", out.astype(embs[0].dtype))

@@ -1,0 +1,499 @@
+"""CTR / text-matching / detection long-tail ops.
+
+Reference analogs (paddle/fluid/operators/): batch_fc_op.cu,
+rank_attention.cu.h:28 (expand kernels), tree_conv_op.cc +
+math/tree2col.h:35 (eta formulas) / tree2col.cc:23 (patch DFS),
+var_conv_2d_op.cc, pyramid_hash_op.cc, filter_by_instag_op.h,
+detection/prroi_pool_op.h (integral of bilinear basis),
+correlation_op.cu (FlowNet cost volume), metrics/chunk_eval_op.h.
+
+TPU-first notes:
+  * rank_attention's two CUDA expand kernels + batched GEMM collapse to
+    gathers + one einsum.
+  * tree_conv's per-node DFS patch construction becomes an all-pairs
+    bounded-depth reachability built with B boolean matmuls (trees are
+    runtime data, so the structure tensors are computed on device with
+    static [N,N] shapes); eta_{t,l,r} follow tree2col.h exactly.
+  * prroi_pool is computed in closed form: the integral of the bilinear
+    interpolant over a bin is separable into per-axis integrals of the
+    hat basis, giving an [outW,W]x[outH,H] pair of weight matrices per
+    ROI — one einsum per ROI under vmap, no sampling-grid approximation.
+  * correlation's displacement loop is a static python loop over the
+    (2d+1)^2 shifts — each iteration is a fused multiply-reduce.
+  * chunk_eval's chunk walk is vectorized: per-position begin/end masks
+    from the scheme rules, first-end-at-or-after-start via a reverse
+    cummin, segment equality per start position.
+  * filter_by_instag / pyramid_hash keep static shapes (zeroed rows /
+    per-position n-gram embeddings); pyramid_hash uses the splitmix-
+    style mix from misc2_ops.hash instead of XXH64 (documented).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import in_var, register_op, set_out
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# batch_fc
+# ---------------------------------------------------------------------------
+def _batch_fc_infer(op, block):
+    x = in_var(op, block, "Input")      # [slot, ins, in_dim]
+    w = in_var(op, block, "W")          # [slot, in_dim, out_dim]
+    set_out(op, block, "Out", (x.shape[0], x.shape[1], w.shape[2]),
+            x.dtype)
+
+
+@register_op("batch_fc", infer=_batch_fc_infer)
+def _batch_fc(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    w = ctx.get_input(op, "W")
+    b = ctx.get_input(op, "Bias")       # [slot, 1, out_dim]
+    ctx.set_output(op, "Out", jnp.einsum("sid,sdo->sio", x, w) + b)
+
+
+# ---------------------------------------------------------------------------
+# rank_attention
+# ---------------------------------------------------------------------------
+def _rank_attn_infer(op, block):
+    x = in_var(op, block, "X")
+    p = in_var(op, block, "RankParam")
+    set_out(op, block, "Out", (x.shape[0], p.shape[1]), x.dtype)
+    if op.output("InputHelp"):
+        mr = int(op.attr("MaxRank", 3))
+        set_out(op, block, "InputHelp", (x.shape[0], mr * x.shape[1]),
+                x.dtype)
+    if op.output("InsRank"):
+        set_out(op, block, "InsRank", (x.shape[0], 1), x.dtype)
+
+
+@register_op("rank_attention", infer=_rank_attn_infer)
+def _rank_attention(ctx, op):
+    """RankOffset row: [own_rank, (faster_rank_k, index_k) x MaxRank]
+    (1-based ranks, 0 = invalid). Expanded input block k = X[index_k];
+    expanded param block (k, :) = RankParam[(own-1)*R + faster_k - 1]
+    viewed [R*R, in_dim, out_dim]; Out = per-instance GEMM of the two
+    (rank_attention.cu.h:28,66)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    ro = ctx.get_input(op, "RankOffset").astype("int32")
+    param = ctx.get_input(op, "RankParam")
+    R = int(op.attr("MaxRank", 3))
+    n, d = x.shape
+    pcol = param.shape[1]
+    lower = ro[:, 0] - 1                      # [N]
+    faster = ro[:, 1::2][:, :R] - 1           # [N, R]
+    index = ro[:, 2::2][:, :R]                # [N, R]
+    valid = (lower[:, None] >= 0) & (faster >= 0)
+    xin = jnp.where(valid[..., None], x[jnp.clip(index, 0, n - 1)], 0)
+    start = jnp.clip(lower[:, None] * R + faster, 0, R * R - 1)
+    pr = param.reshape(R * R, d, pcol)
+    pw = jnp.where(valid[..., None, None], pr[start], 0)
+    ctx.set_output(op, "Out", jnp.einsum("nrd,nrdp->np", xin, pw))
+    if op.output("InputHelp"):
+        ctx.set_output(op, "InputHelp", xin.reshape(n, R * d))
+    if op.output("InsRank"):
+        ctx.set_output(op, "InsRank",
+                       ro[:, :1].astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# tree_conv (TBCNN)
+# ---------------------------------------------------------------------------
+def _tree_conv_infer(op, block):
+    x = in_var(op, block, "NodesVector")    # [B, N, F]
+    w = in_var(op, block, "Filter")         # [F, 3, G, M]
+    set_out(op, block, "Out",
+            (x.shape[0], x.shape[1], w.shape[2], w.shape[3]), x.dtype)
+
+
+@register_op("tree_conv", infer=_tree_conv_infer)
+def _tree_conv(ctx, op):
+    """Continuous binary tree conv. EdgeSet [B, E, 2] (parent, child)
+    1-based, 0-padded. Per patch node at depth dep (root 0), sibling
+    index i (1-based) of pclen children (tree2col.h:35):
+      eta_t = (D - dep)/D
+      eta_l = (1-eta_t) * (0.5 if pclen==1 else (i-1)/(pclen-1))
+      eta_r = (1-eta_t) * (1 - eta_l)
+    Patch = nodes within depth D-1; reachability via D-1 boolean
+    matmuls of the child adjacency."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "NodesVector")
+    edges = ctx.get_input(op, "EdgeSet").astype("int32")
+    w = ctx.get_input(op, "Filter")
+    D = int(op.attr("max_depth", 2))
+    B, N, F = x.shape
+
+    def one(feat, edge):
+        p = edge[:, 0] - 1
+        c = edge[:, 1] - 1
+        ev = (edge[:, 0] > 0) & (edge[:, 1] > 0)
+        pc = jnp.clip(p, 0, N - 1)
+        cc = jnp.clip(c, 0, N - 1)
+        adj = jnp.zeros((N, N), "float32").at[pc, cc].add(
+            ev.astype("float32"))
+        adj = (adj > 0).astype("float32")
+        # sibling order: position of the edge among same-parent edges
+        E = edge.shape[0]
+        same_p = (p[None, :] == p[:, None]) & ev[None, :] & ev[:, None]
+        earlier = jnp.tril(jnp.ones((E, E), bool), -1)
+        sib_idx = (same_p & earlier.T).sum(0) + 1       # 1-based
+        pclen_e = same_p.sum(1)
+        node_idx = jnp.ones((N,), "float32").at[cc].max(
+            jnp.where(ev, sib_idx.astype("float32"), 1.0))
+        node_pclen = jnp.ones((N,), "float32").at[cc].max(
+            jnp.where(ev, pclen_e.astype("float32"), 1.0))
+        # dist[u,v] = tree distance if reachable within D-1 else INF
+        INF = np.float32(1e9)
+        dist = jnp.where(jnp.eye(N, dtype=bool), 0.0, INF)
+        frontier = jnp.eye(N, dtype="float32")
+        for k in range(1, D):
+            frontier = (frontier @ adj > 0).astype("float32")
+            dist = jnp.where((frontier > 0) & (dist >= INF),
+                             float(k), dist)
+        member = dist < INF
+        eta_t = jnp.where(member, (D - dist) / D, 0.0)
+        temp = jnp.where(node_pclen > 1,
+                         (node_idx - 1.0)
+                         / jnp.maximum(node_pclen - 1.0, 1.0),
+                         0.5)[None, :]
+        # patch ROOT uses index=1, pclen=1 -> temp 0.5 regardless of the
+        # node's own sibling position (tree2col.cc:29)
+        temp = jnp.where(jnp.eye(N, dtype=bool), 0.5, temp)
+        eta_l = jnp.where(member, (1 - eta_t) * temp, 0.0)
+        eta_r = jnp.where(member, (1 - eta_t) * (1 - eta_l), 0.0)
+        coeff = jnp.stack([eta_l, eta_r, eta_t], -1)    # [U, V, 3]
+        return jnp.einsum("uvr,vf,frgm->ugm", coeff, feat, w)
+
+    import jax
+    ctx.set_output(op, "Out", jax.vmap(one)(x, edges))
+
+
+# ---------------------------------------------------------------------------
+# var_conv_2d — masked variable-size conv (text matching)
+# ---------------------------------------------------------------------------
+def _var_conv_infer(op, block):
+    x = in_var(op, block, "X")      # [B, Cin, H, W] padded
+    w = in_var(op, block, "W")      # [Cout, Cin*kh*kw]
+    out_ch = int(op.attr("OutputChannel"))
+    sh, sw = int(op.attr("StrideH", 1)), int(op.attr("StrideW", 1))
+    set_out(op, block, "Out",
+            (x.shape[0], out_ch, x.shape[2] // sh, x.shape[3] // sw),
+            x.dtype)
+
+
+@register_op("var_conv_2d", infer=_var_conv_infer)
+def _var_conv_2d(ctx, op):
+    """Per-row variable-extent conv (reference var_conv_2d_op.cc walks
+    LoD extents; padded form: same-padding conv + per-row output mask
+    from RowLengths/ColLengths)."""
+    import jax
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    w = ctx.get_input(op, "W")
+    rows = ctx.get_input(op, "RowLengths")
+    cols = ctx.get_input(op, "ColLengths")
+    kh, kw = int(op.attr("KernelH")), int(op.attr("KernelW"))
+    sh, sw = int(op.attr("StrideH", 1)), int(op.attr("StrideW", 1))
+    out_ch = int(op.attr("OutputChannel"))
+    b, cin, H, W = x.shape
+    wk = w.reshape(out_ch, cin, kh, kw)
+    out = jax.lax.conv_general_dilated(
+        x.astype("float32"), wk.astype("float32"),
+        window_strides=(sh, sw),
+        padding=[((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)])
+    oh, ow = out.shape[2], out.shape[3]
+    # valid extent per row: ceil(len/stride)
+    rvalid = (jnp.arange(oh)[None, :]
+              < jnp.ceil(rows[:, None] / sh)).astype(out.dtype)
+    cvalid = (jnp.arange(ow)[None, :]
+              < jnp.ceil(cols[:, None] / sw)).astype(out.dtype)
+    mask = rvalid[:, None, :, None] * cvalid[:, None, None, :]
+    ctx.set_output(op, "Out", (out * mask).astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# pyramid_hash
+# ---------------------------------------------------------------------------
+def _pyramid_hash_infer(op, block):
+    x = in_var(op, block, "X")          # [B, T] ids
+    num_emb = int(op.attr("num_emb"))
+    set_out(op, block, "Out", (x.shape[0], x.shape[1], num_emb),
+            x.dtype if x.dtype.startswith("float") else "float32")
+
+
+@register_op("pyramid_hash", infer=_pyramid_hash_infer)
+def _pyramid_hash(ctx, op):
+    """PyramidDNN n-gram hash embedding (reference pyramid_hash_op.cc):
+    out[b,t] = sum over n-gram lengths 2..pyramid_layer+1 of the hashed
+    embedding of ids[b, t:t+n] (alive n-grams only). Each n-gram hashes
+    to num_emb/rand_len buckets of W [space_len, rand_len]
+    (splitmix-style mix instead of the reference's XXH64)."""
+    jnp = _jnp()
+    ids = ctx.get_input(op, "X").astype("uint32")
+    W = ctx.get_input(op, "W")          # [space_len, rand_len]
+    lengths = ctx.get_input(op, "Lengths")
+    num_emb = int(op.attr("num_emb"))
+    rand_len = int(op.attr("rand_len", 16))
+    space = W.shape[0]
+    levels = int(op.attr("pyramid_layer", 2))
+    b, t = ids.shape
+    n_seed = num_emb // rand_len
+    out = jnp.zeros((b, t, num_emb), "float32")
+    alive = jnp.arange(t)[None, :] < lengths[:, None]
+    for n in range(2, levels + 2):
+        if n > t:
+            break
+        key = jnp.zeros((b, t - n + 1), "uint32")
+        for j in range(n):
+            key = key * jnp.uint32(1000003) + ids[:, j:t - n + 1 + j]
+        ok = alive[:, n - 1:]           # whole n-gram in range
+        chunks = []
+        for s in range(n_seed):
+            z = key + jnp.uint32(0x9E3779B9) * jnp.uint32(s + 1)
+            z = (z ^ (z >> 16)) * jnp.uint32(0x85EBCA6B)
+            z = (z ^ (z >> 13)) * jnp.uint32(0xC2B2AE35)
+            bucket = ((z ^ (z >> 16)) % jnp.uint32(space)).astype("int32")
+            chunks.append(W[bucket])    # [b, t-n+1, rand_len]
+        emb = jnp.concatenate(chunks, -1) * ok[..., None]
+        out = out.at[:, :t - n + 1].add(emb.astype("float32"))
+    ctx.set_output(op, "Out", out)
+
+
+# ---------------------------------------------------------------------------
+# filter_by_instag
+# ---------------------------------------------------------------------------
+def _instag_infer(op, block):
+    x = in_var(op, block, "Ins")
+    set_out(op, block, "Out", x.shape, x.dtype)
+    set_out(op, block, "LossWeight", (x.shape[0], 1), "float32")
+    if op.output("IndexMap"):
+        set_out(op, block, "IndexMap", (x.shape[0], 2), "int64")
+
+
+@register_op("filter_by_instag", infer=_instag_infer)
+def _filter_by_instag(ctx, op):
+    """Keep rows whose tag list intersects Filter_tag (reference
+    filter_by_instag_op.h). Static shapes: dropped rows are zeroed and
+    get LossWeight 0 (reference out_val_if_empty analog)."""
+    jnp = _jnp()
+    ins = ctx.get_input(op, "Ins")
+    tags = ctx.get_input(op, "Ins_tag")        # [N, Ttag], -1 padded
+    want = ctx.get_input(op, "Filter_tag")     # [K]
+    hit = ((tags[:, :, None] == want[None, None, :])
+           & (tags[:, :, None] >= 0)).any((1, 2))
+    m = hit.reshape((-1,) + (1,) * (ins.ndim - 1))
+    ctx.set_output(op, "Out", jnp.where(m, ins, 0))
+    ctx.set_output(op, "LossWeight",
+                   hit.astype("float32")[:, None])
+    if op.output("IndexMap"):
+        n = ins.shape[0]
+        idx = jnp.arange(n, dtype="int64")
+        ctx.set_output(op, "IndexMap", jnp.stack([idx, idx], 1))
+
+
+# ---------------------------------------------------------------------------
+# prroi_pool — closed-form integral of the bilinear interpolant
+# ---------------------------------------------------------------------------
+def _prroi_infer(op, block):
+    rois = in_var(op, block, "ROIs")
+    x = in_var(op, block, "X")
+    ph = int(op.attr("pooled_height"))
+    pw = int(op.attr("pooled_width"))
+    set_out(op, block, "Out", (rois.shape[0], x.shape[1], ph, pw),
+            x.dtype)
+
+
+def _hat_integral(jnp, a, b, centers):
+    """∫_a^b hat(t - c) dt for each center c; hat(d)=max(0,1-|d|).
+    Antiderivative H(d) = d - d|d|/2 on [-1,1], clamped outside."""
+    def H(d):
+        d = jnp.clip(d, -1.0, 1.0)
+        return d - d * jnp.abs(d) / 2.0
+    return H(b[..., None] - centers) - H(a[..., None] - centers)
+
+
+@register_op("prroi_pool", infer=_prroi_infer)
+def _prroi_pool(ctx, op):
+    """Precise ROI pooling (reference detection/prroi_pool_op.h): the
+    average of the continuous bilinear interpolant over each bin,
+    computed exactly — the 2-D integral separates into per-axis
+    integrals of the hat basis, so each ROI is two small weight
+    matrices and one einsum. Fully differentiable in both X and ROIs
+    (the reference ships a hand-written coordinate backward; here the
+    closed form autodiffs)."""
+    import jax
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    rois = ctx.get_input(op, "ROIs")    # [R, 4] (x1,y1,x2,y2)
+    batch_idx = (ctx.get_input(op, "BatchRoINums")
+                 if op.input("BatchRoINums") else None)
+    scale = float(op.attr("spatial_scale", 1.0))
+    ph = int(op.attr("pooled_height"))
+    pw = int(op.attr("pooled_width"))
+    N, C, H, W = x.shape
+    if batch_idx is None:
+        bidx = jnp.zeros((rois.shape[0],), "int32")
+    else:
+        # BatchRoINums [N]: rois per image, in order
+        counts = batch_idx.astype("int32")
+        bidx = jnp.searchsorted(jnp.cumsum(counts),
+                                jnp.arange(rois.shape[0]),
+                                side="right").astype("int32")
+
+    cy = jnp.arange(H, dtype="float32")
+    cx = jnp.arange(W, dtype="float32")
+
+    def one(roi, bi):
+        x1, y1, x2, y2 = roi * scale
+        bw = jnp.maximum((x2 - x1) / pw, 1e-9)
+        bh = jnp.maximum((y2 - y1) / ph, 1e-9)
+        ax = x1 + jnp.arange(pw) * bw
+        ay = y1 + jnp.arange(ph) * bh
+        wx = _hat_integral(jnp, ax, ax + bw, cx)      # [pw, W]
+        wy = _hat_integral(jnp, ay, ay + bh, cy)      # [ph, H]
+        feat = x[bi].astype("float32")
+        s = jnp.einsum("ph,qw,chw->cpq", wy, wx, feat)
+        return s / (bw * bh)
+
+    ctx.set_output(op, "Out",
+                   jax.vmap(one)(rois.astype("float32"), bidx)
+                   .astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# correlation (FlowNet cost volume)
+# ---------------------------------------------------------------------------
+def _corr_infer(op, block):
+    x = in_var(op, block, "Input1")
+    d = int(op.attr("max_displacement"))
+    s2 = int(op.attr("stride2", 1))
+    rad = d // s2
+    k = 2 * rad + 1
+    set_out(op, block, "Out",
+            (x.shape[0], k * k, x.shape[2], x.shape[3]), x.dtype)
+
+
+@register_op("correlation", infer=_corr_infer)
+def _correlation(ctx, op):
+    """out[:, d, :, :] = mean_c x1[c, h, w] * x2[c, h+dy, w+dx] for the
+    (2r+1)^2 displacement grid (reference correlation_op.cu); stride1/
+    kernel_size=1 form, zero padding at borders."""
+    jnp = _jnp()
+    x1 = ctx.get_input(op, "Input1").astype("float32")
+    x2 = ctx.get_input(op, "Input2").astype("float32")
+    d = int(op.attr("max_displacement"))
+    s2 = int(op.attr("stride2", 1))
+    rad = d // s2
+    n, c, h, w = x1.shape
+    pad = rad * s2
+    x2p = jnp.pad(x2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    outs = []
+    for dy in range(-rad, rad + 1):
+        for dx in range(-rad, rad + 1):
+            oy, ox = pad + dy * s2, pad + dx * s2
+            shifted = x2p[:, :, oy:oy + h, ox:ox + w]
+            outs.append((x1 * shifted).mean(1))
+    ctx.set_output(op, "Out",
+                   jnp.stack(outs, 1).astype(x1.dtype))
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval
+# ---------------------------------------------------------------------------
+def _chunk_eval_infer(op, block):
+    for slot in ("Precision", "Recall", "F1-Score"):
+        set_out(op, block, slot, (1,), "float32")
+    for slot in ("NumInferChunks", "NumLabelChunks",
+                 "NumCorrectChunks"):
+        if op.output(slot):
+            set_out(op, block, slot, (1,), "int64")
+
+
+def _chunk_masks(jnp, tags, lengths, scheme, n_types):
+    """(begin, end, type) masks per position for one [B,T] tag batch.
+
+    Tag encoding (reference chunk_eval_op.h): tag = type * n_pos + pos;
+    anything >= n_types * n_pos (or < 0) is Outside.
+    """
+    n_pos = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+    B, T = tags.shape
+    alive = jnp.arange(T)[None, :] < lengths[:, None]
+    inside = alive & (tags >= 0) & (tags < n_types * n_pos)
+    typ = jnp.where(inside, tags // n_pos, -1)
+    pos = jnp.where(inside, tags % n_pos, -1)
+    # neighbours (Outside beyond the sequence)
+    prev_t = jnp.pad(typ, ((0, 0), (1, 0)), constant_values=-1)[:, :-1]
+    prev_p = jnp.pad(pos, ((0, 0), (1, 0)), constant_values=-1)[:, :-1]
+    next_t = jnp.pad(typ, ((0, 0), (0, 1)), constant_values=-1)[:, 1:]
+    next_p = jnp.pad(pos, ((0, 0), (0, 1)), constant_values=-1)[:, 1:]
+    last = alive & ~jnp.pad(alive, ((0, 0), (0, 1)))[:, 1:]
+    next_t = jnp.where(last, -1, next_t)
+    next_p = jnp.where(last, -1, next_p)
+
+    if scheme == "plain":
+        begin = inside & (prev_t != typ)
+        end = inside & (next_t != typ)
+    elif scheme == "IOB":        # pos: B=0, I=1
+        begin = inside & ((pos == 0)
+                          | ((pos == 1) & (prev_t != typ)))
+        end = inside & ((next_t != typ) | (next_p == 0))
+    elif scheme == "IOE":        # pos: I=0, E=1
+        begin = inside & ((prev_t != typ) | (prev_p == 1))
+        end = inside & ((pos == 1) | (next_t != typ))
+    else:                        # IOBES: B=0, I=1, E=2, S=3
+        begin = inside & ((pos == 0) | (pos == 3))
+        end = inside & ((pos == 2) | (pos == 3))
+    return begin, end, typ
+
+
+@register_op("chunk_eval", infer=_chunk_eval_infer, grad=None)
+def _chunk_eval(ctx, op):
+    """Chunk-level precision/recall/F1 (reference metrics/chunk_eval
+    _op.h). A predicted chunk is correct iff a label chunk starts at
+    the same position with the same type and ends at the same place;
+    ends are matched with a reverse cummin (first end >= start)."""
+    jnp = _jnp()
+    inf = ctx.get_input(op, "Inference").reshape(
+        ctx.get_input(op, "Inference").shape[:2])
+    lab = ctx.get_input(op, "Label").reshape(inf.shape)
+    lengths = ctx.get_input(op, "Lengths")
+    scheme = op.attr("chunk_scheme", "IOB")
+    n_types = int(op.attr("num_chunk_types"))
+    ib, ie, it = _chunk_masks(jnp, inf.astype("int32"), lengths,
+                              scheme, n_types)
+    lb, le, lt = _chunk_masks(jnp, lab.astype("int32"), lengths,
+                              scheme, n_types)
+    B, T = inf.shape
+    pos = jnp.arange(T)[None, :]
+    BIG = T + 1
+
+    def first_end_at_or_after(endmask):
+        import jax.lax as lax
+        v = jnp.where(endmask, pos, BIG)
+        # reverse cummin: for each t, min over t' >= t
+        return lax.cummin(v, axis=1, reverse=True)
+
+    i_end = first_end_at_or_after(ie)
+    l_end = first_end_at_or_after(le)
+    both = ib & lb & (it == lt) & (i_end == l_end) & (i_end < BIG)
+    tp = both.sum()
+    n_inf = ib.sum()
+    n_lab = lb.sum()
+    p = tp / jnp.maximum(n_inf, 1)
+    r = tp / jnp.maximum(n_lab, 1)
+    f1 = 2 * p * r / jnp.maximum(p + r, 1e-9)
+    ctx.set_output(op, "Precision", p.astype("float32").reshape(1))
+    ctx.set_output(op, "Recall", r.astype("float32").reshape(1))
+    ctx.set_output(op, "F1-Score", f1.astype("float32").reshape(1))
+    for slot, v in (("NumInferChunks", n_inf),
+                    ("NumLabelChunks", n_lab),
+                    ("NumCorrectChunks", tp)):
+        if op.output(slot):
+            ctx.set_output(op, slot, v.astype("int64").reshape(1))

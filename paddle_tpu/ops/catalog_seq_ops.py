@@ -1,0 +1,339 @@
+"""Sequence/LoD machinery + SelectedRows/ids routing + select_input/output.
+
+Reference analogs (paddle/fluid/operators/):
+  sequence_ops/sequence_reshape_op.cc, sequence_ops/sequence_scatter_op
+  .cc, lod_reset_op.cc, lod_tensor_to_array_op.cc,
+  array_to_lod_tensor_op.cc, split_lod_tensor_op.cc,
+  merge_lod_tensor_op.cc, shrink_rnn_memory_op.cc,
+  merge_selected_rows_op.cc, split_selected_rows_op.cc,
+  get_tensor_from_selected_rows_op.cc, distributed_ops/merge_ids_op.cc,
+  distributed_ops/split_ids_op.cc, controlflow/select_input_output_op.cc.
+
+TPU-first conventions (repo-wide, documented in README):
+  * LoD tensors are padded [B, T, ...] + Lengths; ops that would change
+    LoD emit the transformed padded tensor (and new lengths where the
+    surface has a slot for them).
+  * Ops whose reference output is data-dependently sized keep static
+    shapes: routing ops (split_lod_tensor, split_ids, filter-style)
+    zero/sentinel the non-selected slots instead of shrinking — the
+    same convention as masked_select.
+  * The tensor-array ops view a [B,T,...] batch time-major ([T,B,...]
+    array items), replacing the reference's rank-table machinery.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import in_var, register_op, same_as_input, set_out
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# sequence_reshape / sequence_scatter
+# ---------------------------------------------------------------------------
+def _seq_reshape_infer(op, block):
+    x = in_var(op, block, "X")          # [B, T, D]
+    new_dim = int(op.attr("new_dim"))
+    b, t, d = x.shape
+    set_out(op, block, "Out", (b, t * d // new_dim, new_dim), x.dtype)
+    if op.output("LengthsOut"):
+        set_out(op, block, "LengthsOut", (b,), "int64")
+
+
+@register_op("sequence_reshape", infer=_seq_reshape_infer)
+def _sequence_reshape(ctx, op):
+    """Each row's T_i*D payload re-chunked to new_dim columns
+    (reference sequence_reshape_op.cc: out offset = offset*D/new_dim).
+    Padded form: plain reshape + rescaled lengths (rows are
+    left-justified so padding stays trailing)."""
+    x = ctx.get_input(op, "X")
+    new_dim = int(op.attr("new_dim"))
+    b, t, d = x.shape
+    ctx.set_output(op, "Out", x.reshape(b, t * d // new_dim, new_dim))
+    if op.output("LengthsOut"):
+        lengths = ctx.get_input(op, "Lengths")
+        ctx.set_output(op, "LengthsOut",
+                       (lengths * d) // new_dim)
+
+
+def _seq_scatter_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+
+
+@register_op("sequence_scatter", infer=_seq_scatter_infer)
+def _sequence_scatter(ctx, op):
+    """Out = X with Updates[b,t] added at (b, Ids[b,t]) for alive steps
+    (reference sequence_scatter_op.cc over LoD rows)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    ids = ctx.get_input(op, "Ids").astype("int32")
+    upd = ctx.get_input(op, "Updates")
+    lengths = ctx.get_input(op, "Lengths")
+    b, t = ids.shape[:2]
+    alive = (jnp.arange(t)[None, :] < lengths[:, None])
+    upd = jnp.where(alive, upd, 0)
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
+    ctx.set_output(op, "Out",
+                   x.at[rows, ids].add(upd.astype(x.dtype)))
+
+
+# ---------------------------------------------------------------------------
+# lod_reset — data identity; lengths swap
+# ---------------------------------------------------------------------------
+def _lod_reset_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+    if op.output("LengthsOut"):
+        set_out(op, block, "LengthsOut", (x.shape[0],), "int64")
+
+
+@register_op("lod_reset", infer=_lod_reset_infer)
+def _lod_reset(ctx, op):
+    """Reassign sequence structure (reference lod_reset_op.cc). Data
+    passes through; the new lengths come from Y (if wired) or the
+    target_lod attr converted to lengths."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", x)
+    if op.output("LengthsOut"):
+        if op.input("Y"):
+            ctx.set_output(op, "LengthsOut",
+                           ctx.get_input(op, "Y").astype("int64"))
+        else:
+            lod = list(op.attr("target_lod", []))
+            lens = np.diff(np.asarray(lod, "int64"))
+            ctx.set_output(op, "LengthsOut", jnp.asarray(lens))
+
+
+# ---------------------------------------------------------------------------
+# tensor-array bridges (time-major view of the padded batch)
+# ---------------------------------------------------------------------------
+def _l2a_infer(op, block):
+    x = in_var(op, block, "X")          # [B, T, ...]
+    set_out(op, block, "Out",
+            (x.shape[1], x.shape[0]) + tuple(x.shape[2:]), x.dtype)
+
+
+@register_op("lod_tensor_to_array", infer=_l2a_infer)
+def _lod_tensor_to_array(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", jnp.swapaxes(x, 0, 1))
+
+
+def _a2l_infer(op, block):
+    arr = in_var(op, block, "X")        # [T, B, ...]
+    set_out(op, block, "Out",
+            (arr.shape[1], arr.shape[0]) + tuple(arr.shape[2:]),
+            arr.dtype)
+
+
+@register_op("array_to_lod_tensor", infer=_a2l_infer)
+def _array_to_lod_tensor(ctx, op):
+    jnp = _jnp()
+    arr = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", jnp.swapaxes(arr, 0, 1))
+
+
+def _split_lod_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "OutTrue", x.shape, x.dtype)
+    set_out(op, block, "OutFalse", x.shape, x.dtype)
+
+
+@register_op("split_lod_tensor", infer=_split_lod_infer)
+def _split_lod_tensor(ctx, op):
+    """Row routing by Mask (reference split_lod_tensor_op.cc). Static
+    shapes: non-selected rows are zeroed, not removed."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    mask = ctx.get_input(op, "Mask").reshape(-1).astype(bool)
+    shape = (-1,) + (1,) * (x.ndim - 1)
+    m = mask.reshape(shape)
+    ctx.set_output(op, "OutTrue", jnp.where(m, x, 0))
+    ctx.set_output(op, "OutFalse", jnp.where(m, 0, x))
+
+
+def _merge_lod_infer(op, block):
+    x = in_var(op, block, "InTrue")
+    set_out(op, block, "Out", x.shape, x.dtype)
+
+
+@register_op("merge_lod_tensor", infer=_merge_lod_infer)
+def _merge_lod_tensor(ctx, op):
+    jnp = _jnp()
+    t = ctx.get_input(op, "InTrue")
+    f = ctx.get_input(op, "InFalse")
+    mask = ctx.get_input(op, "Mask").reshape(-1).astype(bool)
+    m = mask.reshape((-1,) + (1,) * (t.ndim - 1))
+    ctx.set_output(op, "Out", jnp.where(m, t, f))
+
+
+@register_op("shrink_rnn_memory", infer=same_as_input())
+def _shrink_rnn_memory(ctx, op):
+    """Keep state rows whose sequence is still alive at step I
+    (reference shrink_rnn_memory_op.cc shrinks to the first K rows; the
+    static-shape form zeroes dead rows instead)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    i = jnp.reshape(ctx.get_input(op, "I"), ()).astype("int32")
+    lengths = ctx.get_input(op, "Lengths")
+    alive = (i < lengths).reshape((-1,) + (1,) * (x.ndim - 1))
+    ctx.set_output(op, "Out", jnp.where(alive, x, 0))
+
+
+# ---------------------------------------------------------------------------
+# SelectedRows utilities
+# ---------------------------------------------------------------------------
+def _sr_passthrough_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+
+
+@register_op("merge_selected_rows", infer=_sr_passthrough_infer,
+             grad=None)
+def _merge_selected_rows(ctx, op):
+    """Deduplicate rows, summing values (reference math::scatter::
+    MergeAdd via merge_selected_rows_op.cc)."""
+    from ..framework.selected_rows import SelectedRowsValue, is_selected_rows
+    x = ctx.get_input(op, "X")
+    if is_selected_rows(x):
+        ctx.set_output(op, "Out", x.merge())
+    else:
+        ctx.set_output(op, "Out", x)
+
+
+@register_op("get_tensor_from_selected_rows",
+             infer=_sr_passthrough_infer, grad=None)
+def _get_tensor_from_selected_rows(ctx, op):
+    from ..framework.selected_rows import is_selected_rows
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", x.values if is_selected_rows(x) else x)
+
+
+def _split_sr_infer(op, block):
+    x = in_var(op, block, "X")
+    for name in op.output("Out"):
+        v = (block._find_var_recursive(name)
+             or block.create_var(name=name))
+        v.shape, v.dtype = x.shape, x.dtype
+
+
+@register_op("split_selected_rows", infer=_split_sr_infer, grad=None)
+def _split_selected_rows(ctx, op):
+    """Split by height sections (reference split_selected_rows_op.cc).
+    Static form: every shard keeps K slots; rows outside its section
+    carry the empty sentinel (= height) with zeroed values."""
+    jnp = _jnp()
+    from ..framework.selected_rows import SelectedRowsValue, is_selected_rows
+    x = ctx.get_input(op, "X")
+    outs = op.output("Out")
+    sections = op.attr("height_sections", None)
+    if not sections:
+        n = len(outs)
+        base = x.height // n
+        sections = [base + (1 if i < x.height % n else 0)
+                    for i in range(n)]
+    bounds = np.cumsum([0] + list(sections))
+    vals = []
+    for i in range(len(outs)):
+        lo, hi = int(bounds[i]), int(bounds[i + 1])
+        own = (x.rows >= lo) & (x.rows < hi)
+        rows = jnp.where(own, x.rows - lo, sections[i])
+        vshape = (-1,) + (1,) * (x.values.ndim - 1)
+        v = jnp.where(own.reshape(vshape), x.values, 0)
+        vals.append(SelectedRowsValue(rows.astype("int32"), v,
+                                      int(sections[i])))
+    ctx.set_outputs(op, "Out", vals)
+
+
+# ---------------------------------------------------------------------------
+# ids routing (PS sharding ops)
+# ---------------------------------------------------------------------------
+def _split_ids_infer(op, block):
+    x = in_var(op, block, "Ids")
+    for name in op.output("Out"):
+        v = (block._find_var_recursive(name)
+             or block.create_var(name=name))
+        v.shape, v.dtype = x.shape, x.dtype
+
+
+@register_op("split_ids", infer=_split_ids_infer, grad=None)
+def _split_ids(ctx, op):
+    """Shard ids by id %% nshards (reference split_ids_op.cc). Static
+    form: non-owned slots carry -1."""
+    jnp = _jnp()
+    ids = ctx.get_input(op, "Ids")
+    outs = op.output("Out")
+    n = len(outs)
+    vals = [jnp.where(ids % n == k, ids, -1) for k in range(n)]
+    ctx.set_outputs(op, "Out", vals)
+
+
+def _merge_ids_infer(op, block):
+    ids = in_var(op, block, "Ids")
+    x0 = in_var(op, block, "X")
+    set_out(op, block, "Out", (ids.shape[0], x0.shape[-1]), x0.dtype)
+
+
+@register_op("merge_ids", infer=_merge_ids_infer, grad=None)
+def _merge_ids(ctx, op):
+    """Reassemble shard lookup results in original id order (reference
+    distributed_ops/merge_ids_op.cc): for each queried id, take the
+    value row whose shard id list matches (-1 slots never match)."""
+    jnp = _jnp()
+    ids = ctx.get_input(op, "Ids").reshape(-1)
+    rows = [r.reshape(-1) for r in ctx.get_inputs(op, "Rows")]
+    xs = ctx.get_inputs(op, "X")
+    all_rows = jnp.concatenate(rows)
+    all_vals = jnp.concatenate([x.reshape(x.shape[0], -1) for x in xs])
+    # one-hot match (N_ids x N_rows) @ values — static-shape gather
+    match = (ids[:, None] == all_rows[None, :]) & (all_rows[None, :] >= 0)
+    first = (jnp.cumsum(match, 1) == 1) & match  # dedupe repeated rows
+    out = first.astype(all_vals.dtype) @ all_vals
+    ctx.set_output(op, "Out", out)
+
+
+# ---------------------------------------------------------------------------
+# select_input / select_output (controlflow/select_op family)
+# ---------------------------------------------------------------------------
+def _select_input_infer(op, block):
+    x = block.var(op.input("X")[0])
+    set_out(op, block, "Out", x.shape, x.dtype)
+
+
+@register_op("select_input", infer=_select_input_infer)
+def _select_input(ctx, op):
+    import jax
+    jnp = _jnp()
+    xs = ctx.get_inputs(op, "X")
+    mask = jnp.reshape(ctx.get_input(op, "Mask"), ()).astype("int32")
+    out = xs[0]
+    for i, x in enumerate(xs[1:], start=1):
+        out = jnp.where(mask == i, x, out)
+    ctx.set_output(op, "Out", out)
+
+
+def _select_output_infer(op, block):
+    x = in_var(op, block, "X")
+    for name in op.output("Out"):
+        v = (block._find_var_recursive(name)
+             or block.create_var(name=name))
+        v.shape, v.dtype = x.shape, x.dtype
+
+
+@register_op("select_output", infer=_select_output_infer)
+def _select_output(ctx, op):
+    """Route X to the Mask-selected output; the others carry zeros
+    (static-shape form of controlflow/select_output)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    mask = jnp.reshape(ctx.get_input(op, "Mask"), ()).astype("int32")
+    outs = [jnp.where(mask == i, x, jnp.zeros_like(x))
+            for i in range(len(op.output("Out")))]
+    ctx.set_outputs(op, "Out", outs)

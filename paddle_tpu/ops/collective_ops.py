@@ -287,3 +287,69 @@ for _t in ("c_gen_nccl_id", "c_comm_init", "c_comm_init_all",
            "c_sync_calc_stream", "c_sync_comm_stream", "barrier",
            "c_wait_comm", "c_wait_compute"):
     _register_noop(_t)
+
+
+# ---------------------------------------------------------------------------
+# legacy dense collective surfaces (reference operators/collective/
+# allreduce_op.cc, broadcast_op.cc, c_scatter_op.cc, c_allreduce_prod)
+# ---------------------------------------------------------------------------
+register_op("allreduce", infer=same_as_input("X", "Out"),
+            lower=(lambda ctx, op: ctx.set_output(
+                op, "Out",
+                ctx.get_input(op, "X") if _axis_name(ctx, op) is None
+                else _psum(ctx.get_input(op, "X"), _axis_name(ctx, op)))),
+            grad="auto")
+
+register_op("c_reduce_prod", infer=same_as_input("X", "Out"),
+            lower=(lambda ctx, op: ctx.set_output(
+                op, "Out",
+                ctx.get_input(op, "X") if _axis_name(ctx, op) is None
+                else _pprod(ctx.get_input(op, "X"),
+                            _axis_name(ctx, op)))),
+            grad="auto")
+
+
+@register_op("broadcast", infer=same_as_input("X", "Out"), grad="auto")
+def _broadcast_legacy(ctx, op):
+    """Dense broadcast from root (reference collective/broadcast_op.cc)
+    — same select(root)+psum single-collective trick as c_broadcast."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+    x = ctx.get_input(op, "X")
+    axis = _axis_name(ctx, op)
+    if axis is None:
+        ctx.set_output(op, "Out", x)
+        return
+    root = int(op.attr("root", op.attr("root_id", 0)))
+    me = lax.axis_index(axis)
+    ctx.set_output(op, "Out",
+                   lax.psum(jnp.where(me == root, x, 0), axis))
+
+
+def _c_scatter_infer(op, block):
+    x = in_var(op, block, "X")
+    n = int(op.attrs.get("nranks", 1))
+    shape = list(x.shape)
+    shape[0] //= max(n, 1)
+    set_out(op, block, "Out", shape, x.dtype)
+
+
+@register_op("c_scatter", infer=_c_scatter_infer, grad="auto")
+def _c_scatter(ctx, op):
+    """Root's [nranks*chunk, ...] scattered along dim 0: each rank takes
+    its chunk of the broadcast value (reference c_scatter_op.cc)."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+    x = ctx.get_input(op, "X")
+    axis = _axis_name(ctx, op)
+    if axis is None:
+        ctx.set_output(op, "Out", x)
+        return
+    root = int(op.attr("root", 0))
+    n = int(op.attr("nranks", 1))
+    me = lax.axis_index(axis)
+    x_root = lax.psum(jnp.where(me == root, x, 0), axis)
+    chunk = x.shape[0] // max(n, 1)
+    ctx.set_output(op, "Out",
+                   lax.dynamic_slice_in_dim(x_root, me * chunk, chunk,
+                                            axis=0))

@@ -15,7 +15,7 @@ from __future__ import annotations
 import numpy as np
 
 from .collective_ops import _axis_name
-from .registry import register_op
+from .registry import in_var, register_op, set_out
 
 
 def _dgc_infer(op, block):
@@ -70,3 +70,87 @@ def _dgc_momentum(ctx, op):
     ctx.set_output(op, "ParamOut", pf.astype(p.dtype))
     ctx.set_output(op, "UOut", u_new)
     ctx.set_output(op, "VOut", v_out)
+
+
+def _dgc_op_infer(op, block):
+    for slot_in, slot_out in (("U", "U_out"), ("V", "V_out"),
+                              ("Grad", "Grad_out"),
+                              ("Grad", "EncodeGrad")):
+        xn = op.single_input(slot_in)
+        for on in op.output(slot_out):
+            xv = block.var(xn)
+            ov = (block._find_var_recursive(on)
+                  or block.create_var(name=on))
+            ov.shape, ov.dtype = xv.shape, xv.dtype
+
+
+@register_op("dgc", infer=_dgc_op_infer, grad=None,
+             stateful_outputs=("U_out", "V_out"))
+def _dgc(ctx, op):
+    """Standalone DGC sparsify (reference dgc_op.h): momentum
+    correction u/v accumulation, top-k threshold mask with error
+    feedback; EncodeGrad carries the sparsified gradient (dense tensor
+    with zeros — ICI psum replaces the reference's encoded allgather),
+    Grad_out the residual."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    g = ctx.get_input(op, "Grad").astype("float32")
+    u = ctx.get_input(op, "U")
+    v = ctx.get_input(op, "V")
+    step = ctx.get_input(op, "current_step")
+    m = op.attr("m", 0.9)
+    use_nesterov = op.attr("use_nesterov", False)
+    ratios = op.attr("sparsity", [0.999])
+    rampup_begin = op.attr("rampup_begin_step", 0.0)
+    rampup = max(1.0, op.attr("rampup_step", 1.0))
+    # rampup sparsity schedule: pick the period's ratio
+    s = jnp.reshape(step, ()) - rampup_begin
+    seg = jnp.clip((s * len(ratios) / rampup).astype("int32"),
+                   0, len(ratios) - 1)
+    ratio = jnp.asarray(np.asarray(ratios, "float32"))[seg]
+
+    u_new = m * u + g
+    if use_nesterov:
+        acc = m * (u_new + v) + g + v
+    else:
+        acc = u_new + v
+    flat = acc.reshape(-1)
+    numel = flat.shape[0]
+    # static top-k bound at the max ratio; runtime threshold from the
+    # scheduled ratio via the sorted prefix
+    k_max = max(1, int(np.ceil(numel * (1.0 - min(ratios)))))
+    top_vals = lax.top_k(jnp.abs(flat), k_max)[0]
+    k_run = jnp.clip((numel * (1.0 - ratio)).astype("int32"),
+                     1, k_max)
+    thresh = top_vals[k_run - 1]
+    mask = (jnp.abs(acc) >= thresh).astype("float32")
+    in_rampup = jnp.reshape(step, ()) < rampup_begin
+    mask = jnp.where(in_rampup, jnp.ones_like(mask), mask)
+    encoded = acc * mask
+    ctx.set_output(op, "U_out", u_new)
+    ctx.set_output(op, "V_out", acc * (1.0 - mask))
+    ctx.set_output(op, "EncodeGrad", encoded)
+    ctx.set_output(op, "Grad_out", encoded)
+    if op.output("k"):
+        ctx.set_output(op, "k", k_run.astype("float32").reshape(1))
+
+
+def _dgc_clip_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+
+
+@register_op("dgc_clip_by_norm", infer=_dgc_clip_infer, grad=None)
+def _dgc_clip_by_norm(ctx, op):
+    """clip_by_norm gated on the DGC rampup step (reference
+    dgc_clip_by_norm_op.cc: no clipping before rampup_begin_step)."""
+    import jax.numpy as jnp
+    x = ctx.get_input(op, "X").astype("float32")
+    step = ctx.get_input(op, "current_step")
+    max_norm = op.attr("max_norm", 1.0)
+    rampup_begin = op.attr("rampup_begin_step", -1.0)
+    norm = jnp.sqrt((x * x).sum())
+    clipped = x * jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    out = jnp.where(jnp.reshape(step, ()) < rampup_begin, x, clipped)
+    ctx.set_output(op, "Out", out)

@@ -636,6 +636,69 @@ register_op("batch_norm", infer=_bn_infer, lower=_bn_lower,
             stateful_outputs=("MeanOut", "VarianceOut"))
 
 
+def _sync_bn_lower(ctx: LowerContext, op: Operator):
+    """Cross-replica batch norm (reference sync_batch_norm_op.cu:31:
+    NCCL allreduce of per-device sum/sum-of-squares). On TPU the stats
+    ride one lax.pmean pair over the dp axis inside shard_map — cheap
+    on ICI — and the grad falls out of the auto-vjp (pmean has a
+    defined transpose). Without a bound axis it degrades to plain BN
+    (single participant), matching the reference's 1-GPU behavior."""
+    import jax.lax as lax
+    jnp = _jnp()
+    from .collective_ops import _axis_name
+    axis = _axis_name(ctx, op)
+    x = ctx.get_input(op, "X")
+    scale = ctx.get_input(op, "Scale")
+    bias = ctx.get_input(op, "Bias")
+    mean = ctx.get_input(op, "Mean")
+    var = ctx.get_input(op, "Variance")
+    eps = op.attr("epsilon", 1e-5)
+    momentum = op.attr("momentum", 0.9)
+    layout = op.attr("data_layout", "NCHW")
+    is_test = op.attr("is_test", False) or ctx.is_test
+    use_global = op.attr("use_global_stats", False) or is_test
+
+    nd = jnp.ndim(x)
+    c_axis = 1 if layout == "NCHW" else nd - 1
+    red_axes = tuple(i for i in range(nd) if i != c_axis)
+    bshape = [1] * nd
+    bshape[c_axis] = jnp.shape(x)[c_axis]
+
+    xf = x.astype("float32")
+    if use_global:
+        use_mean, use_var = mean, var
+        new_mean, new_var = mean, var
+        saved_mean = mean
+        saved_var = 1.0 / jnp.sqrt(var + eps)
+    else:
+        m1 = jnp.mean(xf, axis=red_axes)
+        m2 = jnp.mean(xf * xf, axis=red_axes)
+        if axis is not None:
+            m1 = lax.pmean(m1, axis)
+            m2 = lax.pmean(m2, axis)
+        bmean = m1
+        bvar = jnp.maximum(m2 - m1 * m1, 0.0)
+        use_mean, use_var = bmean, bvar
+        new_mean = momentum * mean + (1 - momentum) * bmean
+        new_var = momentum * var + (1 - momentum) * bvar
+        saved_mean = bmean
+        saved_var = 1.0 / jnp.sqrt(bvar + eps)
+
+    inv = 1.0 / jnp.sqrt(use_var + eps)
+    y = (xf - use_mean.reshape(bshape)) * inv.reshape(bshape)
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    ctx.set_output(op, "Y", y.astype(x.dtype))
+    ctx.set_output(op, "MeanOut", new_mean)
+    ctx.set_output(op, "VarianceOut", new_var)
+    ctx.set_output(op, "SavedMean", saved_mean)
+    ctx.set_output(op, "SavedVariance", saved_var)
+
+
+register_op("sync_batch_norm", infer=_bn_infer, lower=_sync_bn_lower,
+            grad=_bn_grad_maker,
+            stateful_outputs=("MeanOut", "VarianceOut"))
+
+
 def _ln_infer(op, block):
     x = in_var(op, block, "X")
     axis = op.attr("begin_norm_axis", 1)

@@ -510,3 +510,27 @@ _reg_opt("average_accumulates",
           ("OutOldNumAccumulates", "InOldNumAccumulates"),
           ("OutNumUpdates", "InNumUpdates")],
          _average_accumulates)
+
+
+def _proximal_adagrad(ctx, op):
+    """Reference operators/optimizers/proximal_adagrad_op.h: adagrad
+    moment accumulation then the proximal l1/l2 shrink step."""
+    jnp = _jnp()
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad").astype("float32")
+    m = ctx.get_input(op, "Moment")
+    lr = ctx.get_input(op, "LearningRate")
+    l1, l2 = op.attr("l1", 0.0), op.attr("l2", 0.0)
+    m_new = m + g * g
+    lr_eff = lr / jnp.sqrt(m_new)
+    prox = p.astype("float32") - lr_eff * g
+    p_new = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_eff * l1,
+                                          0.0)
+             / (1.0 + lr_eff * l2))
+    ctx.set_output(op, "ParamOut", p_new.astype(p.dtype))
+    ctx.set_output(op, "MomentOut", m_new)
+
+
+_reg_opt("proximal_adagrad", [("ParamOut", "Param"),
+                              ("MomentOut", "Moment")],
+         _proximal_adagrad)

@@ -100,3 +100,47 @@ def _fq_channel(ctx, op):
     out = _qdq(jnp, x, scale, bits)
     ctx.set_output(op, "Out", out.astype(x.dtype))
     ctx.set_output(op, "OutScale", jnp.reshape(scale, (-1,)))
+
+
+# ---------------------------------------------------------------------------
+# real (non-fake) quant ops — the mkldnn INT8 surface (reference
+# operators/quantize_op.cc, dequantize_op.cc, requantize_op.cc); on TPU
+# the integer tensors are ordinary int8 arrays XLA computes with.
+# ---------------------------------------------------------------------------
+def _q_same_shape(dtype):
+    def infer(op, block):
+        x = in_var(op, block, "Input")
+        set_out(op, block, "Output", x.shape, dtype)
+    return infer
+
+
+@register_op("quantize", infer=_q_same_shape("int8"), grad=None)
+def _quantize(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    scale = op.attr("Scale", 1.0)
+    shift = op.attr("Shift", 0.0)
+    q = jnp.round(x.astype("float32") * scale + shift)
+    ctx.set_output(op, "Output",
+                   jnp.clip(q, -128, 127).astype("int8"))
+
+
+@register_op("dequantize", infer=_q_same_shape("float32"), grad=None)
+def _dequantize(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    scale = op.attr("Scale", 1.0)
+    shift = op.attr("Shift", 0.0)
+    ctx.set_output(op, "Output",
+                   (x.astype("float32") - shift) / scale)
+
+
+@register_op("requantize", infer=_q_same_shape("int8"), grad=None)
+def _requantize(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    s_in = op.attr("Scale_in", 1.0)
+    s_out = op.attr("Scale_out", 1.0)
+    q = jnp.round(x.astype("float32") * (s_out / s_in))
+    ctx.set_output(op, "Output",
+                   jnp.clip(q, -128, 127).astype("int8"))

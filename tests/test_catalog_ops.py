@@ -1,0 +1,548 @@
+"""OpTests for the round-5 catalog batches (catalog_seq_ops,
+catalog_ctr_ops, quant/optimizer/dgc/attention additions).
+
+Reference unittests: test_sequence_reshape.py, test_sequence_scatter_op
+.py, test_lod_reset_op.py, test_split_merge_lod_tensor_op.py,
+test_shrink_rnn_memory.py, test_merge_selected_rows_op.py,
+test_split_ids_op.py / test_merge_ids_op.py, test_select_input_output
+_op.py, test_batch_fc_op.py, test_rank_attention_op.py,
+test_tree_conv_op.py, test_var_conv_2d.py, test_pyramid_hash_op.py,
+test_filter_by_instag_op.py, test_prroi_pool_op.py, test_correlation
+.py, test_chunk_eval_op.py, test_quantize_op.py, test_proximal_adagrad
+_op.py, test_dgc_op.py, test_fused_multihead_matmul_op.py,
+test_skip_layernorm_fuse_pass.py, test_fused_emb_seq_pool_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from op_test import OpCase, run_case
+
+R = np.random.RandomState
+
+
+def _run_program(op_type, inputs, outputs, attrs, feed_extra=None):
+    """Build a one-op program, run it, return fetched outputs (dict)."""
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    feed = {}
+    with pt.program_guard(main, startup):
+        block = main.global_block()
+        in_slots = {}
+        for slot, arrs in inputs.items():
+            names = []
+            arrs_l = arrs if isinstance(arrs, list) else [arrs]
+            for j, a in enumerate(arrs_l):
+                n = f"i_{slot}_{j}"
+                block.create_var(name=n, shape=a.shape,
+                                 dtype=str(a.dtype), is_data=True)
+                feed[n] = a
+                names.append(n)
+            in_slots[slot] = names
+        out_slots = {s: [f"o_{s}_{j}" for j in range(c)]
+                     for s, c in outputs.items()}
+        block.append_op(op_type, inputs=in_slots, outputs=out_slots,
+                        attrs=attrs)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    names = [n for ns in out_slots.values() for n in ns]
+    vals = exe.run(main, feed=feed, fetch_list=names, scope=scope)
+    return dict(zip(names, [np.asarray(v) for v in vals]))
+
+
+# ---------------------------------------------------------------------------
+# sequence / LoD
+# ---------------------------------------------------------------------------
+def test_sequence_reshape():
+    x = R(0).randn(2, 4, 6).astype("float32")
+    lens = np.array([4, 2], "int64")
+    out = _run_program(
+        "sequence_reshape", {"X": x, "Lengths": lens},
+        {"Out": 1, "LengthsOut": 1}, {"new_dim": 3})
+    np.testing.assert_allclose(out["o_Out_0"], x.reshape(2, 8, 3))
+    np.testing.assert_array_equal(out["o_LengthsOut_0"], [8, 4])
+
+
+def test_sequence_scatter():
+    x = R(1).randn(2, 6).astype("float32")
+    ids = np.array([[0, 2, 3], [1, 1, 4]], "int64")
+    upd = R(2).randn(2, 3).astype("float32")
+    lens = np.array([3, 2], "int64")
+    ref = x.copy()
+    for b in range(2):
+        for t in range(int(lens[b])):
+            ref[b, ids[b, t]] += upd[b, t]
+    run_case(OpCase(
+        "sequence_scatter",
+        {"X": x, "Ids": ids, "Updates": upd, "Lengths": lens},
+        ref=lambda **kw: ref, grad=["X", "Updates"]))
+
+
+def test_lod_reset():
+    x = R(3).randn(3, 4).astype("float32")
+    y = np.array([2, 1, 4], "int64")
+    out = _run_program("lod_reset", {"X": x, "Y": y},
+                       {"Out": 1, "LengthsOut": 1}, {})
+    np.testing.assert_allclose(out["o_Out_0"], x)
+    np.testing.assert_array_equal(out["o_LengthsOut_0"], y)
+
+
+def test_tensor_array_bridges():
+    x = R(4).randn(2, 3, 5).astype("float32")
+    out = _run_program("lod_tensor_to_array", {"X": x}, {"Out": 1}, {})
+    np.testing.assert_allclose(out["o_Out_0"], x.swapaxes(0, 1))
+    back = _run_program("array_to_lod_tensor",
+                        {"X": x.swapaxes(0, 1)}, {"Out": 1}, {})
+    np.testing.assert_allclose(back["o_Out_0"], x)
+
+
+def test_split_merge_lod_tensor():
+    x = R(5).randn(4, 3).astype("float32")
+    mask = np.array([[1], [0], [1], [0]], "int32")
+    out = _run_program("split_lod_tensor", {"X": x, "Mask": mask},
+                       {"OutTrue": 1, "OutFalse": 1}, {})
+    np.testing.assert_allclose(out["o_OutTrue_0"],
+                               np.where(mask.astype(bool), x, 0))
+    np.testing.assert_allclose(out["o_OutFalse_0"],
+                               np.where(mask.astype(bool), 0, x))
+    merged = _run_program(
+        "merge_lod_tensor",
+        {"InTrue": out["o_OutTrue_0"], "InFalse": out["o_OutFalse_0"],
+         "Mask": mask}, {"Out": 1}, {})
+    np.testing.assert_allclose(merged["o_Out_0"], x)
+
+
+def test_shrink_rnn_memory():
+    x = R(6).randn(3, 4).astype("float32")
+    lens = np.array([5, 2, 3], "int64")
+    i = np.array([2], "int64")
+    out = _run_program("shrink_rnn_memory",
+                       {"X": x, "I": i, "Lengths": lens}, {"Out": 1}, {})
+    ref = x.copy()
+    ref[1] = 0  # length 2 <= step 2 -> dead
+    np.testing.assert_allclose(out["o_Out_0"], ref)
+
+
+def test_select_input_output():
+    a, b = (R(7).randn(2, 3).astype("float32") for _ in range(2))
+    mask = np.array([1], "int32")
+    out = _run_program("select_input", {"X": [a, b], "Mask": mask},
+                       {"Out": 1}, {})
+    np.testing.assert_allclose(out["o_Out_0"], b)
+    out = _run_program("select_output", {"X": a, "Mask": mask},
+                       {"Out": 2}, {})
+    np.testing.assert_allclose(out["o_Out_0"], np.zeros_like(a))
+    np.testing.assert_allclose(out["o_Out_1"], a)
+
+
+def test_split_merge_ids():
+    ids = np.array([[3], [4], [7], [10]], "int64")
+    out = _run_program("split_ids", {"Ids": ids}, {"Out": 2}, {})
+    np.testing.assert_array_equal(out["o_Out_0"].reshape(-1),
+                                  [-1, 4, -1, 10])
+    np.testing.assert_array_equal(out["o_Out_1"].reshape(-1),
+                                  [3, -1, 7, -1])
+    # merge: two shards' lookup results back in query order
+    rows0 = np.array([4, 10], "int64")
+    rows1 = np.array([3, 7], "int64")
+    emb0 = R(8).randn(2, 5).astype("float32")
+    emb1 = R(9).randn(2, 5).astype("float32")
+    merged = _run_program(
+        "merge_ids",
+        {"Ids": ids, "Rows": [rows0, rows1], "X": [emb0, emb1]},
+        {"Out": 1}, {})
+    want = np.stack([emb1[0], emb0[0], emb1[1], emb0[1]])
+    np.testing.assert_allclose(merged["o_Out_0"], want)
+
+
+# ---------------------------------------------------------------------------
+# CTR / text / detection
+# ---------------------------------------------------------------------------
+def test_batch_fc():
+    x = R(10).randn(3, 4, 5).astype("float32")
+    w = R(11).randn(3, 5, 6).astype("float32")
+    b = R(12).randn(3, 1, 6).astype("float32")
+    run_case(OpCase(
+        "batch_fc", {"Input": x, "W": w, "Bias": b},
+        ref=lambda Input, W, Bias: np.einsum("sid,sdo->sio", Input, W)
+        + Bias,
+        grad=["Input", "W"], rtol=1e-4, atol=1e-5))
+
+
+def test_rank_attention():
+    n, d, R_, pcol = 4, 3, 2, 5
+    x = R(13).randn(n, d).astype("float32")
+    param = R(14).randn(R_ * R_ * d, pcol).astype("float32")
+    # rows: [own_rank, faster_1, index_1, faster_2, index_2]
+    ro = np.array([
+        [1, 1, 0, 2, 1],
+        [2, 1, 0, 2, 1],
+        [1, 2, 3, 0, 0],    # second slot invalid (faster=0)
+        [0, 1, 0, 1, 1],    # own rank invalid -> all zero
+    ], "int32")
+    ref = np.zeros((n, pcol), "float32")
+    pr = param.reshape(R_ * R_, d, pcol)
+    for i in range(n):
+        lower = ro[i, 0] - 1
+        if lower < 0:
+            continue
+        for k in range(R_):
+            faster = ro[i, 2 * k + 1] - 1
+            if faster < 0:
+                continue
+            idx = ro[i, 2 * k + 2]
+            ref[i] += x[idx] @ pr[lower * R_ + faster]
+    run_case(OpCase(
+        "rank_attention",
+        {"X": x, "RankOffset": ro, "RankParam": param},
+        attrs={"MaxRank": R_},
+        ref=lambda **kw: ref, grad=["X", "RankParam"],
+        rtol=1e-4, atol=1e-5))
+
+
+def test_tree_conv():
+    # tree: 1 -> (2, 3); 2 -> (4,)   (1-based, one batch)
+    edges = np.array([[[1, 2], [1, 3], [2, 4], [0, 0]]], "int32")
+    N, F, G, M, D = 5, 3, 2, 2, 2
+    x = R(15).randn(1, N, F).astype("float32")
+    w = R(16).randn(F, 3, G, M).astype("float32")
+    # loop reference per tree2col.cc construct_patch + tree2col.h etas
+    children = {1: [2, 3], 2: [4], 3: [], 4: [], 5: []}
+    parent_meta = {2: (1, 2), 3: (2, 2), 4: (1, 1)}  # node->(idx,pclen)
+
+    def patch(root):
+        # DFS limited to depth < D
+        items = [(root, 1, 1, 0)]
+        stack = [(root, 0)]
+        while stack:
+            u, dep = stack.pop()
+            if dep + 1 < D:
+                for i, v in enumerate(children[u]):
+                    idx, pclen = i + 1, len(children[u])
+                    items.append((v, idx, pclen, dep + 1))
+                    stack.append((v, dep + 1))
+        return items
+
+    ref = np.zeros((1, N, G, M), "float32")
+    for u in range(1, N + 1):
+        acc = np.zeros((F, 3), "float32")
+        for (v, idx, pclen, dep) in patch(u):
+            eta_t = (D - dep) / D
+            temp = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+            eta_l = (1 - eta_t) * temp
+            eta_r = (1 - eta_t) * (1 - eta_l)
+            acc[:, 0] += eta_l * x[0, v - 1]
+            acc[:, 1] += eta_r * x[0, v - 1]
+            acc[:, 2] += eta_t * x[0, v - 1]
+        ref[0, u - 1] = np.einsum("fr,frgm->gm", acc, w)
+    run_case(OpCase(
+        "tree_conv", {"NodesVector": x, "EdgeSet": edges, "Filter": w},
+        attrs={"max_depth": D},
+        ref=lambda **kw: ref, grad=["NodesVector", "Filter"],
+        rtol=1e-4, atol=1e-5))
+
+
+def test_var_conv_2d():
+    x = R(17).randn(2, 1, 6, 6).astype("float32")
+    out_ch, kh, kw = 2, 3, 3
+    w = R(18).randn(out_ch, 1 * kh * kw).astype("float32")
+    rows = np.array([6, 4], "int64")
+    cols = np.array([6, 3], "int64")
+    out = _run_program(
+        "var_conv_2d",
+        {"X": x, "W": w, "RowLengths": rows, "ColLengths": cols},
+        {"Out": 1},
+        {"OutputChannel": out_ch, "KernelH": kh, "KernelW": kw,
+         "StrideH": 1, "StrideW": 1})["o_Out_0"]
+    assert out.shape == (2, 2, 6, 6)
+    # masked region zero
+    assert np.all(out[1, :, 4:, :] == 0) and np.all(out[1, :, :, 3:] == 0)
+    # interior of full-extent row matches a manual correlation loop
+    ref = np.zeros((6, 6), "float32")
+    for i in range(6):
+        for j in range(6):
+            acc = 0.0
+            for di in range(3):
+                for dj in range(3):
+                    ii, jj = i + di - 1, j + dj - 1
+                    if 0 <= ii < 6 and 0 <= jj < 6:
+                        acc += x[0, 0, ii, jj] * w[0, di * 3 + dj]
+            ref[i, j] = acc
+    np.testing.assert_allclose(out[0, 0], ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pyramid_hash():
+    ids = np.array([[3, 7, 9, 0], [5, 2, 0, 0]], "int64")
+    lens = np.array([3, 2], "int64")
+    W = R(19).randn(64, 4).astype("float32")
+    out = _run_program(
+        "pyramid_hash",
+        {"X": ids, "W": W, "Lengths": lens}, {"Out": 1},
+        {"num_emb": 8, "rand_len": 4, "pyramid_layer": 2,
+         "space_len": 64})["o_Out_0"]
+    assert out.shape == (2, 4, 8)
+    # n-grams beyond the row's length contribute nothing
+    assert np.all(out[1, 2:] == 0)
+    assert np.any(out[0, 0] != 0)
+    # determinism: same ids -> same embedding
+    out2 = _run_program(
+        "pyramid_hash",
+        {"X": ids, "W": W, "Lengths": lens}, {"Out": 1},
+        {"num_emb": 8, "rand_len": 4, "pyramid_layer": 2,
+         "space_len": 64})["o_Out_0"]
+    np.testing.assert_allclose(out, out2)
+
+
+def test_filter_by_instag():
+    ins = R(20).randn(4, 3).astype("float32")
+    tags = np.array([[1, -1], [2, 3], [4, -1], [3, 1]], "int64")
+    want = np.array([1, 3], "int64")
+    out = _run_program(
+        "filter_by_instag",
+        {"Ins": ins, "Ins_tag": tags, "Filter_tag": want},
+        {"Out": 1, "LossWeight": 1, "IndexMap": 1}, {})
+    keep = np.array([True, True, False, True])
+    np.testing.assert_allclose(out["o_Out_0"],
+                               np.where(keep[:, None], ins, 0))
+    np.testing.assert_allclose(out["o_LossWeight_0"].reshape(-1),
+                               keep.astype("float32"))
+
+
+def test_prroi_pool_exact_average():
+    """A ROI aligned to pixel centers spanning whole pixels: the
+    integral average equals the plain mean of those pixels."""
+    x = R(21).randn(1, 2, 8, 8).astype("float32")
+    # roi [x1,y1,x2,y2] covering pixel centers 2..5 in both axes
+    rois = np.array([[2.0, 2.0, 4.0, 4.0]], "float32")
+    out = _run_program(
+        "prroi_pool", {"X": x, "ROIs": rois}, {"Out": 1},
+        {"pooled_height": 1, "pooled_width": 1,
+         "spatial_scale": 1.0})["o_Out_0"]
+    # bilinear interpolant integrated over [2,4]^2: trapezoid weights
+    w = np.zeros(8)
+    w[2], w[3], w[4] = 0.5, 1.0, 0.5
+    ref = np.einsum("h,w,chw->c", w, w, x[0]) / 4.0
+    np.testing.assert_allclose(out[0, :, 0, 0], ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_prroi_pool_grad():
+    x = R(22).randn(1, 1, 6, 6).astype("float32")
+    rois = np.array([[0.5, 0.5, 4.5, 4.5]], "float32")
+    run_case(OpCase(
+        "prroi_pool", {"X": x, "ROIs": rois},
+        attrs={"pooled_height": 2, "pooled_width": 2,
+               "spatial_scale": 1.0},
+        ref=None, grad=["X", "ROIs"], grad_rtol=8e-2, grad_atol=8e-3))
+
+
+def test_correlation():
+    x1 = R(23).randn(1, 3, 5, 5).astype("float32")
+    x2 = R(24).randn(1, 3, 5, 5).astype("float32")
+    d = 1
+    ref = np.zeros((1, 9, 5, 5), "float32")
+    x2p = np.pad(x2, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    i = 0
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            ref[:, i] = (x1 * x2p[:, :, 1 + dy:6 + dy,
+                                  1 + dx:6 + dx]).mean(1)
+            i += 1
+    run_case(OpCase(
+        "correlation", {"Input1": x1, "Input2": x2},
+        attrs={"max_displacement": d, "stride2": 1},
+        ref=lambda **kw: ref, grad=["Input1", "Input2"],
+        rtol=1e-4, atol=1e-5))
+
+
+def test_chunk_eval_iob():
+    # types: PER, LOC; IOB tags: B-PER=0 I-PER=1 B-LOC=2 I-LOC=3 O=4
+    inference = np.array([[0, 1, 4, 2, 4],
+                          [2, 3, 3, 4, 0]], "int64")
+    label = np.array([[0, 1, 4, 2, 4],
+                      [2, 3, 4, 4, 0]], "int64")
+    lens = np.array([5, 5], "int64")
+    out = _run_program(
+        "chunk_eval",
+        {"Inference": inference[..., None], "Label": label[..., None],
+         "Lengths": lens},
+        {"Precision": 1, "Recall": 1, "F1-Score": 1,
+         "NumInferChunks": 1, "NumLabelChunks": 1,
+         "NumCorrectChunks": 1},
+        {"num_chunk_types": 2, "chunk_scheme": "IOB"})
+    # row0: chunks inf {(0,PER,0-1),(3,LOC)} lab same -> 2 correct
+    # row1: inf {(0-2,LOC),(4,PER)}, lab {(0-1,LOC),(4,PER)} -> 1
+    assert out["o_NumInferChunks_0"][0] == 4
+    assert out["o_NumLabelChunks_0"][0] == 4
+    assert out["o_NumCorrectChunks_0"][0] == 3
+    np.testing.assert_allclose(out["o_Precision_0"][0], 0.75)
+    np.testing.assert_allclose(out["o_Recall_0"][0], 0.75)
+
+
+def test_chunk_eval_iobes_plain():
+    # IOBES, 1 type: B=0 I=1 E=2 S=3, O=4
+    inf = np.array([[0, 1, 2, 3, 4]], "int64")
+    lab = np.array([[0, 1, 2, 4, 3]], "int64")
+    lens = np.array([5], "int64")
+    out = _run_program(
+        "chunk_eval",
+        {"Inference": inf[..., None], "Label": lab[..., None],
+         "Lengths": lens},
+        {"Precision": 1, "Recall": 1, "F1-Score": 1,
+         "NumInferChunks": 1, "NumLabelChunks": 1,
+         "NumCorrectChunks": 1},
+        {"num_chunk_types": 1, "chunk_scheme": "IOBES"})
+    assert out["o_NumInferChunks_0"][0] == 2
+    assert out["o_NumLabelChunks_0"][0] == 2
+    assert out["o_NumCorrectChunks_0"][0] == 1  # the B-I-E chunk
+    # plain scheme: each maximal same-type run is a chunk
+    inf_p = np.array([[0, 0, 1, 2, 2]], "int64")
+    out = _run_program(
+        "chunk_eval",
+        {"Inference": inf_p[..., None], "Label": inf_p[..., None],
+         "Lengths": lens},
+        {"Precision": 1, "Recall": 1, "F1-Score": 1,
+         "NumInferChunks": 1, "NumLabelChunks": 1,
+         "NumCorrectChunks": 1},
+        {"num_chunk_types": 3, "chunk_scheme": "plain"})
+    assert out["o_NumInferChunks_0"][0] == 3
+    assert out["o_NumCorrectChunks_0"][0] == 3
+
+
+# ---------------------------------------------------------------------------
+# quant / optimizer / dgc / fused
+# ---------------------------------------------------------------------------
+def test_quantize_dequantize_requantize():
+    x = R(25).randn(3, 4).astype("float32")
+    q = _run_program("quantize", {"Input": x}, {"Output": 1},
+                     {"Scale": 32.0})["o_Output_0"]
+    assert q.dtype == np.int8
+    np.testing.assert_array_equal(
+        q, np.clip(np.round(x * 32.0), -128, 127).astype("int8"))
+    dq = _run_program("dequantize", {"Input": q}, {"Output": 1},
+                      {"Scale": 32.0})["o_Output_0"]
+    np.testing.assert_allclose(dq, x, atol=1.0 / 32.0 + 1e-6)
+    rq = _run_program("requantize", {"Input": q}, {"Output": 1},
+                      {"Scale_in": 32.0, "Scale_out": 16.0}
+                      )["o_Output_0"]
+    np.testing.assert_array_equal(
+        rq, np.clip(np.round(q.astype("float32") / 2.0), -128,
+                    127).astype("int8"))
+
+
+def test_proximal_adagrad():
+    p = R(26).randn(4).astype("float32")
+    g = R(27).randn(4).astype("float32")
+    m = np.abs(R(28).randn(4)).astype("float32")
+    lr = np.array([0.1], "float32")
+    l1, l2 = 0.05, 0.02
+    m_new = m + g * g
+    lr_eff = lr / np.sqrt(m_new)
+    prox = p - lr_eff * g
+    want = (np.sign(prox) * np.maximum(np.abs(prox) - lr_eff * l1, 0)
+            / (1 + lr_eff * l2))
+    out = _run_program(
+        "proximal_adagrad",
+        {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr},
+        {"ParamOut": 1, "MomentOut": 1}, {"l1": l1, "l2": l2})
+    np.testing.assert_allclose(out["o_ParamOut_0"], want, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(out["o_MomentOut_0"], m_new, rtol=1e-5)
+
+
+def test_dgc_op():
+    g = R(29).randn(32).astype("float32")
+    u = np.zeros(32, "float32")
+    v = np.zeros(32, "float32")
+    step = np.array([10.0], "float32")
+    out = _run_program(
+        "dgc", {"Grad": g, "U": u, "V": v, "current_step": step},
+        {"U_out": 1, "V_out": 1, "EncodeGrad": 1, "Grad_out": 1},
+        {"m": 0.9, "sparsity": [0.75], "rampup_begin_step": 0.0,
+         "rampup_step": 1.0})
+    enc = out["o_EncodeGrad_0"]
+    # top-25% kept: 8 of 32 entries
+    assert (enc != 0).sum() == 8
+    kept = np.abs(g)[enc != 0].min()
+    dropped = np.abs(g)[enc == 0].max()
+    assert kept >= dropped
+    # error feedback: residual + encoded == accumulated grad
+    np.testing.assert_allclose(enc + out["o_V_out_0"], g, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_dgc_clip_by_norm():
+    x = (R(30).randn(16) * 10).astype("float32")
+    norm = np.linalg.norm(x)
+    step = np.array([5.0], "float32")
+    out = _run_program(
+        "dgc_clip_by_norm", {"X": x, "current_step": step}, {"Out": 1},
+        {"max_norm": 1.0, "rampup_begin_step": 10.0})["o_Out_0"]
+    np.testing.assert_allclose(out, x)  # before rampup: no clipping
+    out = _run_program(
+        "dgc_clip_by_norm", {"X": x, "current_step": step}, {"Out": 1},
+        {"max_norm": 1.0, "rampup_begin_step": 0.0})["o_Out_0"]
+    np.testing.assert_allclose(out, x / norm, rtol=1e-4)
+
+
+def test_multihead_matmul():
+    B, S, N, H = 2, 4, 2, 3
+    D = N * H
+    x = R(31).randn(B, S, D).astype("float32")
+    w = R(32).randn(D, 3, N, H).astype("float32")
+    b = R(33).randn(3, N, H).astype("float32")
+    qkv = np.einsum("bsd,dknh->kbnsh", x, w) + b.reshape(3, 1, N, 1, H)
+    q, k, v = qkv
+    logits = np.einsum("bnsh,bnth->bnst", q, k) / np.sqrt(H)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    ref = np.einsum("bnst,bnth->bsnh", probs, v).reshape(B, S, D)
+    run_case(OpCase(
+        "multihead_matmul",
+        {"Input": x, "W": w.reshape(D, -1), "Bias": b},
+        attrs={"head_number": N, "alpha": 1.0 / np.sqrt(H)},
+        ref=lambda **kw: ref, grad=["Input"], rtol=1e-4, atol=1e-5))
+
+
+def test_skip_layernorm():
+    x = R(34).randn(2, 3, 6).astype("float32")
+    y = R(35).randn(2, 3, 6).astype("float32")
+    scale = R(36).randn(6).astype("float32")
+    bias = R(37).randn(6).astype("float32")
+    s = x + y
+    mu = s.mean(-1, keepdims=True)
+    var = s.var(-1, keepdims=True)
+    ref = (s - mu) / np.sqrt(var + 1e-5) * scale + bias
+    run_case(OpCase(
+        "skip_layernorm", {"X": x, "Y": y, "Scale": scale,
+                           "Bias": bias},
+        ref=lambda **kw: ref, grad=["X", "Y"], rtol=1e-4, atol=1e-5))
+
+
+def test_fused_embedding_eltwise_layernorm():
+    V, Dm = 11, 6
+    ids1 = np.array([[1, 2], [3, 4]], "int64")[..., None]
+    ids2 = np.array([[5, 6], [7, 8]], "int64")[..., None]
+    e1 = R(38).randn(V, Dm).astype("float32")
+    e2 = R(39).randn(V, Dm).astype("float32")
+    scale = R(40).randn(Dm).astype("float32")
+    bias = R(41).randn(Dm).astype("float32")
+    s = e1[ids1[..., 0]] + e2[ids2[..., 0]]
+    mu = s.mean(-1, keepdims=True)
+    var = s.var(-1, keepdims=True)
+    ref = (s - mu) / np.sqrt(var + 1e-5) * scale + bias
+    out = _run_program(
+        "fused_embedding_eltwise_layernorm",
+        {"Ids": [ids1, ids2], "Embs": [e1, e2], "Scale": scale,
+         "Bias": bias}, {"Out": 1}, {})["o_Out_0"]
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_merge_selected_rows_dense_passthrough():
+    x = R(42).randn(3, 4).astype("float32")
+    out = _run_program("merge_selected_rows", {"X": x}, {"Out": 1}, {})
+    np.testing.assert_allclose(out["o_Out_0"], x)
+    out = _run_program("get_tensor_from_selected_rows", {"X": x},
+                       {"Out": 1}, {})
+    np.testing.assert_allclose(out["o_Out_0"], x)

@@ -185,3 +185,34 @@ def test_compiled_program_gspmd_path():
     l1 = exe.run(compiled, feed={"x": xv}, fetch_list=[loss])[0]
     assert "gspmd" in compiled._compiled
     assert float(np.mean(l1)) < float(np.mean(l0))
+
+
+# round-5 legacy dense surfaces (reference collective/allreduce_op.cc,
+# broadcast_op.cc, c_scatter_op.cc + c_allreduce_prod reduce flavor)
+def test_allreduce_legacy():
+    xv = np.arange(8, dtype="float32").reshape(8, 1)
+    got = _run_collective("allreduce", xv, {"ring_id": 0})
+    np.testing.assert_allclose(got, np.full((8, 1), xv.sum()))
+
+
+def test_broadcast_legacy():
+    xv = np.arange(8, dtype="float32").reshape(8, 1)
+    got = _run_collective("broadcast", xv, {"ring_id": 0, "root": 5})
+    np.testing.assert_allclose(got, np.full((8, 1), 5.0))
+
+
+def test_c_reduce_prod():
+    xv = (np.arange(8, dtype="float32") % 2 + 1).reshape(8, 1)
+    got = _run_collective("c_reduce_prod", xv, {"ring_id": 0})
+    np.testing.assert_allclose(got, np.full((8, 1), 16.0))
+
+
+def test_c_scatter():
+    # root holds [8,1]; each rank gets its 1-row chunk
+    xv = np.arange(64, dtype="float32").reshape(64, 1)
+    got = _run_collective("c_scatter", xv,
+                          {"ring_id": 0, "root": 0, "nranks": 8})
+    # shard b of the dp axis feeds rows 8b..8b+8; root=0's value is
+    # rows 0..8, rank r takes chunk r -> r
+    assert got.shape == (8, 1)
+    np.testing.assert_allclose(got.reshape(-1), np.arange(8.0))

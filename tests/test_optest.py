@@ -1022,6 +1022,24 @@ SKIP = {
         "positive_negative_pair", "hash"]},
     **{op: "tests/test_rnn_fused_ops.py (step-loop refs + FD grads)"
        for op in ["lstm", "lstmp", "gru", "rnn", "cudnn_lstm"]},
+    **{op: "tests/test_catalog_ops.py" for op in [
+        "sequence_reshape", "sequence_scatter", "lod_reset",
+        "lod_tensor_to_array", "array_to_lod_tensor",
+        "split_lod_tensor", "merge_lod_tensor", "shrink_rnn_memory",
+        "merge_selected_rows", "get_tensor_from_selected_rows",
+        "split_ids", "merge_ids", "select_input", "select_output",
+        "batch_fc", "rank_attention", "tree_conv", "var_conv_2d",
+        "pyramid_hash", "filter_by_instag", "prroi_pool",
+        "correlation", "chunk_eval", "quantize", "dequantize",
+        "requantize", "proximal_adagrad", "dgc", "dgc_clip_by_norm",
+        "multihead_matmul", "skip_layernorm",
+        "fused_embedding_eltwise_layernorm"]},
+    "split_selected_rows": "tests/test_selected_rows.py "
+                           "(lowering-level shard test)",
+    "sync_batch_norm": "tests/test_sync_batch_norm.py (8-mesh parity "
+                       "vs full-batch BN + training)",
+    **{op: "tests/test_fleet_collective.py (8-mesh numeric)" for op in [
+        "allreduce", "broadcast", "c_reduce_prod", "c_scatter"]},
     "add_position_encoding": "tests/test_longtail_ops.py",
     "cvm": "tests/test_longtail_ops.py",
     "hinge_loss": "tests/test_longtail_ops.py",

@@ -251,3 +251,35 @@ def test_fetch_selected_rows_densifies():
     assert g[0].sum() == 0 and g[8].sum() == 0
     # duplicate id 2 accumulated double the grad of id 1
     np.testing.assert_allclose(g[2], 2 * g[1], rtol=1e-5)
+
+
+def test_split_selected_rows_lowering():
+    """split_selected_rows inside a lowering: shards carry owned rows
+    (offset to shard-local) and sentinel elsewhere (round-5 catalog)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from paddle_tpu.framework.core import Program, Operator
+    from paddle_tpu.framework.selected_rows import SelectedRowsValue
+    from paddle_tpu.ops.registry import LowerContext, get_op_def
+
+    prog = Program()
+    block = prog.global_block()
+    block.create_var(name="srx", shape=[10, 2], dtype="float32")
+    op = block.append_op(
+        "split_selected_rows", inputs={"X": ["srx"]},
+        outputs={"Out": ["s0", "s1"]},
+        attrs={"height_sections": [6, 4]})
+    sr = SelectedRowsValue(jnp.asarray([1, 7, 3], "int32"),
+                           jnp.asarray(np.arange(6.0, dtype="float32")
+                                       .reshape(3, 2)), 10)
+    ctx = LowerContext(block, {"srx": sr})
+    get_op_def("split_selected_rows").lower(ctx, op)
+    s0, s1 = ctx.get("s0"), ctx.get("s1")
+    assert s0.height == 6 and s1.height == 4
+    np.testing.assert_array_equal(np.asarray(s0.rows), [1, 6, 3])
+    np.testing.assert_array_equal(np.asarray(s1.rows), [4, 1, 4])
+    np.testing.assert_allclose(np.asarray(s0.to_dense())[1],
+                               [0.0, 1.0])
+    np.testing.assert_allclose(np.asarray(s1.to_dense())[1],
+                               [2.0, 3.0])
