@@ -10,7 +10,12 @@
 // NOTE: the build environment of this repo has no Go toolchain — this
 // client mirrors the reference API surface 1:1 over the TESTED C ABI
 // (paddle_tpu/native/capi.cc, exercised by tests/test_native_entries.py);
-// compile it wherever Go is available.
+// compile it wherever Go is available. The exact ABI call sequence this
+// file makes (allocation pattern, pt_run wrapper, two-pass PT_GetOutput
+// with a long[16] shape buffer) is replayed from C in
+// native/go_mirror_harness.c and CI-tested by
+// tests/test_native_entries.py::test_go_client_abi_sequence, so the
+// contract is exercised even without cgo.
 package paddle
 
 /*

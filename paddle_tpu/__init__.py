@@ -25,6 +25,7 @@ from . import regularizer  # noqa
 from . import clip  # noqa
 from .layers.tensor import data  # noqa
 from . import dygraph  # noqa
+from .dygraph import jit  # noqa  (paddle.jit 2.0 namespace)
 from .framework.compiler import (CompiledProgram, BuildStrategy,  # noqa
                                  ExecutionStrategy, ParallelExecutor)
 from . import distributed  # noqa

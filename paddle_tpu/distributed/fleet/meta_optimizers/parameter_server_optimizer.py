@@ -84,6 +84,10 @@ class ParameterServerOptimizer(MetaOptimizerBase):
         k_steps = int(strategy.a_sync_configs.get("k_steps", -1))
         if not getattr(strategy, "a_sync", False):
             mode = "sync"
+        elif strategy.a_sync_configs.get("half_async", False):
+            # barrier'd k-step batch (reference HalfAsyncCommunicator,
+            # communicator.h:340)
+            mode = "half_async"
         elif k_steps > 0:
             mode = "geo"
         else:

@@ -30,8 +30,8 @@ import numpy as np
 
 from .table import SparseTable, TableConfig, merge_sparse_grad
 
-__all__ = ["Communicator", "AsyncCommunicator", "GeoCommunicator",
-           "make_communicator"]
+__all__ = ["Communicator", "AsyncCommunicator", "HalfAsyncCommunicator",
+           "GeoCommunicator", "make_communicator"]
 
 
 class Communicator:
@@ -170,6 +170,73 @@ class AsyncCommunicator(Communicator):
                 self._q.task_done()
 
 
+class HalfAsyncCommunicator(Communicator):
+    """Barrier'd k-step batch (reference communicator.h:340
+    HalfAsyncCommunicator): pushes buffer locally; every ``k_steps``
+    step_done() merges duplicate ids, sends the whole batch, and fences
+    all trainers on the server barrier. Staleness is bounded by the
+    window (unlike async) without sync's per-step server round trip.
+    Pulls read the server state directly — within a window they see
+    values at most k steps old, the defining half-async contract."""
+
+    mode = "half_async"
+
+    def __init__(self, client, k_steps: int = 10):
+        super().__init__(client)
+        self.k_steps = max(1, k_steps)
+        self._pending: List[Tuple] = []
+        self._step_count = 0
+        self._lock = threading.Lock()
+
+    def push_sparse(self, table, ids, grads, lr_scale=1.0):
+        with self._lock:
+            self._pending.append(
+                ("sparse", table, np.asarray(ids, np.int64).ravel(),
+                 np.asarray(grads, np.float32), lr_scale))
+
+    def push_dense(self, name, grad, lr_scale=1.0):
+        with self._lock:
+            self._pending.append(
+                ("dense", name, None, np.asarray(grad, np.float32),
+                 lr_scale))
+
+    def step_done(self):
+        with self._lock:
+            self._step_count += 1
+            fence = self._step_count % self.k_steps == 0
+            if fence:
+                self._send_locked()
+        if fence:
+            # barrier OUTSIDE the lock: it blocks on other trainers
+            self.client.barrier()
+
+    def flush(self):
+        with self._lock:
+            self._send_locked()
+
+    def _send_locked(self):
+        sparse: Dict[str, List[Tuple[np.ndarray, np.ndarray]]] = {}
+        dense: Dict[str, List[np.ndarray]] = {}
+        scales: Dict[str, float] = {}
+        for kind, name, ids, g, lr_scale in self._pending:
+            scales[name] = lr_scale
+            if kind == "sparse":
+                sparse.setdefault(name, []).append((ids, g))
+            else:
+                dense.setdefault(name, []).append(g)
+        self._pending = []
+        for name, parts in sparse.items():
+            ids = np.concatenate([p[0] for p in parts])
+            grads = np.concatenate(
+                [p[1].reshape(len(p[0]), -1) for p in parts])
+            uids, merged = merge_sparse_grad(ids, grads)
+            self.client.push_sparse(name, uids, merged,
+                                    lr_scale=scales[name])
+        for name, gs in dense.items():
+            g = gs[0] if len(gs) == 1 else np.sum(gs, axis=0)
+            self.client.push_dense(name, g, lr_scale=scales[name])
+
+
 class GeoCommunicator(Communicator):
     """Geo-SGD: local training + k-step delta exchange.
 
@@ -271,6 +338,9 @@ def make_communicator(mode: str, client, sparse_configs=(),
         return Communicator(client)
     if mode == "async":
         return AsyncCommunicator(client, **kw)
+    if mode == "half_async":
+        return HalfAsyncCommunicator(client, k_steps=max(1, k_steps),
+                                     **kw)
     if mode == "geo":
         return GeoCommunicator(client, sparse_configs, k_steps=k_steps)
     raise ValueError(f"unknown communicator mode {mode!r}")

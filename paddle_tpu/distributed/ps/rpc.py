@@ -134,6 +134,41 @@ class PSService:
     def set_dense(self, name: str, value: np.ndarray):
         self.dense[name].set(value)
 
+    # -- checkpoint (reference checkpoint_notify_op.cc: the trainer
+    # notifies, the SERVER writes/reads its own disk) -----------------------
+    def save_checkpoint(self, dirname: str):
+        """Write every table under dirname. Sparse tables persist
+        (ids, values) — parameter state, like the reference's
+        save_persistables over PS tables; dense tables persist value +
+        optimizer slots + step so a restored server resumes exactly."""
+        import os
+        os.makedirs(dirname, exist_ok=True)
+        for name, t in self.sparse.items():
+            t.save(os.path.join(dirname, f"sparse_{name}"))
+        for name, d in self.dense.items():
+            with d._lock:
+                np.savez(os.path.join(dirname, f"dense_{name}"),
+                         value=d.value, t=np.int64(d._t),
+                         **{f"slot_{i}": s
+                            for i, s in enumerate(d.slots)})
+
+    def restore_checkpoint(self, dirname: str):
+        """Load tables saved by save_checkpoint into the EXISTING table
+        objects (configs/optimizers come from the program, exactly like
+        the reference's init-then-load flow)."""
+        import os
+        for name, t in self.sparse.items():
+            path = os.path.join(dirname, f"sparse_{name}.npz")
+            z = np.load(path)
+            t.load(z["ids"], z["values"])
+        for name, d in self.dense.items():
+            z = np.load(os.path.join(dirname, f"dense_{name}.npz"))
+            with d._lock:
+                d.value[...] = z["value"]
+                d._t = int(z["t"])
+                for i in range(len(d.slots)):
+                    d.slots[i][...] = z[f"slot_{i}"]
+
     # -- coordination -------------------------------------------------------
     def barrier(self, n_workers: int, monitor: "HeartBeatMonitor" = None,
                 timeout: float = 120.0):
@@ -214,6 +249,12 @@ class LocalClient:
     def heartbeat(self, trainer_id: int):
         pass  # in-process: liveness is trivial
 
+    def save_checkpoint(self, dirname: str):
+        self.service.save_checkpoint(dirname)
+
+    def restore_checkpoint(self, dirname: str):
+        self.service.restore_checkpoint(dirname)
+
     def close(self):
         pass
 
@@ -226,6 +267,7 @@ _PULL_SPARSE, _PUSH_SPARSE, _PUSH_SPARSE_DELTA = 1, 2, 3
 _PULL_DENSE, _PUSH_DENSE, _SET_DENSE = 4, 5, 6
 _BARRIER, _STOP, _PUSH_DENSE_DELTA = 7, 8, 9
 _HEARTBEAT = 10
+_SAVE_CKPT, _RESTORE_CKPT = 11, 12
 
 # response status framing (first byte): 0 = OK, 1 = server error string
 _OK, _ERR = b"\x00", b"\x01"
@@ -467,6 +509,14 @@ class PServer:
             (tid,) = struct.unpack_from("!i", msg, off)
             self.monitor.beat(tid)
             return _OK
+        if method == _SAVE_CKPT:
+            dirname, off = _unpack_str(msg, off)
+            svc.save_checkpoint(dirname)
+            return _OK
+        if method == _RESTORE_CKPT:
+            dirname, off = _unpack_str(msg, off)
+            svc.restore_checkpoint(dirname)
+            return _OK
         if method == _BARRIER:
             svc.barrier(self.n_workers, monitor=self.monitor,
                         timeout=self.barrier_timeout)
@@ -629,6 +679,13 @@ class RPCClient:
     def heartbeat(self, trainer_id: int):
         self._call(bytes([_HEARTBEAT]) + struct.pack("!i", trainer_id))
 
+    def save_checkpoint(self, dirname: str):
+        """checkpoint_notify: the server saves to ITS disk at dirname."""
+        self._call(bytes([_SAVE_CKPT]) + _pack_str(dirname))
+
+    def restore_checkpoint(self, dirname: str):
+        self._call(bytes([_RESTORE_CKPT]) + _pack_str(dirname))
+
     def stop_server(self):
         try:
             self._call(bytes([_STOP]))
@@ -707,6 +764,18 @@ class ShardedClient:
 
     def barrier(self):
         self.clients[0].barrier()
+
+    def save_checkpoint(self, dirname: str):
+        # per-shard subdir: shard servers sharing a filesystem must not
+        # clobber each other's identically-named tables
+        import os
+        for i, c in enumerate(self.clients):
+            c.save_checkpoint(os.path.join(dirname, f"shard_{i}"))
+
+    def restore_checkpoint(self, dirname: str):
+        import os
+        for i, c in enumerate(self.clients):
+            c.restore_checkpoint(os.path.join(dirname, f"shard_{i}"))
 
     # NOTE deliberately no heartbeat() here: pinging over the
     # data-plane connections would queue behind a blocked sync barrier

@@ -64,7 +64,7 @@ class PSContext:
     optimizer: str = "sgd"
     lr: float = 0.01
     opt_kwargs: dict = field(default_factory=dict)
-    mode: str = "sync"       # sync | async | geo
+    mode: str = "sync"       # sync | half_async | async | geo
     k_steps: int = 100       # geo sync interval
 
     def table_configs(self) -> List[TableConfig]:

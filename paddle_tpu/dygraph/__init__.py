@@ -12,7 +12,9 @@ from .nn import (Linear, Conv2D, Pool2D, BatchNorm, LayerNorm,  # noqa
                  SpectralNorm)
 from .parallel import (DataParallel, ParallelEnv, prepare_context,  # noqa
                        ParallelStrategy)
-from .jit import declarative, dygraph_to_static_func, TracedLayer  # noqa
+from .jit import (declarative, dygraph_to_static_func, TracedLayer,  # noqa
+                  TranslatedLayer)
+from . import jit  # noqa
 from . import dygraph_to_static  # noqa
 from .dygraph_to_static import ProgramTranslator  # noqa
 from .checkpoint import save_dygraph, load_dygraph  # noqa

@@ -118,6 +118,103 @@ def _flatten(outs):
     return ("one", None, 1), vals
 
 
+def _static_save_inference_model(entry, dirname, feed=None, fetch=None):
+    """Save one traced entry via the static io path."""
+    from ..framework.executor import scope_guard
+    from .. import io as pt_io
+
+    main, feed_names, out_vars, _structure, scope, _captures = entry
+    feed_idx = list(feed) if feed is not None else range(len(feed_names))
+    fetch_idx = list(fetch) if fetch is not None else range(len(out_vars))
+    with scope_guard(scope):
+        pt_io.save_inference_model(
+            dirname, [feed_names[i] for i in feed_idx],
+            [out_vars[j] for j in fetch_idx], Executor(),
+            main_program=main)
+
+
+def _sf_latest_entry(self):
+    if not self._cache:
+        raise RuntimeError(
+            "py trace cache empty: call the traced function once (or "
+            "pass input_spec / example inputs) before saving")
+    return next(reversed(self._cache.values()))
+
+
+def _sf_save_inference_model(self, dirname, feed=None, fetch=None):
+    _static_save_inference_model(self._latest_entry(), dirname,
+                                 feed=feed, fetch=fetch)
+
+
+_StaticFunction._latest_entry = _sf_latest_entry
+_StaticFunction.save_inference_model = _sf_save_inference_model
+
+
+def save(layer, path, input_spec=None):
+    """paddle.jit.save (reference python/paddle/fluid/dygraph/jit.py:159
+    `save`): trace a Layer / @declarative function and serialize the
+    inference program + params under `path` (a directory here — the
+    serde is the repo's JSON program format, not protobuf). Reloadable
+    by jit.load, io.load_inference_model, and inference.Predictor."""
+    from ..static import InputSpec
+
+    if isinstance(layer, _StaticFunction):
+        sf = layer
+    elif hasattr(layer, "forward") and isinstance(layer.forward,
+                                                  _StaticFunction):
+        sf = layer.forward
+    else:
+        sf = _StaticFunction(lambda *a: layer(*a))
+    if input_spec is not None:
+        arrs = []
+        for spec in input_spec:
+            if isinstance(spec, InputSpec):
+                shape = [1 if (d is None or d < 0) else int(d)
+                         for d in spec.shape]
+                arrs.append(np.zeros(shape, spec.dtype))
+            else:
+                arrs.append(_to_numpy(spec))
+        sf(*arrs)  # ensure a trace exists for this signature
+    sf.save_inference_model(path)
+
+
+class TranslatedLayer:
+    """Result of jit.load: a callable serving the saved program
+    (reference dygraph/io.py TranslatedLayer)."""
+
+    def __init__(self, dirname):
+        from ..framework.executor import scope_guard
+        from .. import io as pt_io
+
+        self._exe = Executor()
+        self._scope = Scope()
+        with scope_guard(self._scope):
+            prog, feeds, fetches = pt_io.load_inference_model(
+                dirname, self._exe)
+        self._program, self._feeds, self._fetches = prog, feeds, fetches
+
+    def __call__(self, *inputs):
+        feed = {n: _to_numpy(v) for n, v in zip(self._feeds, inputs)}
+        res = self._exe.run(self._program, feed=feed,
+                            fetch_list=self._fetches, scope=self._scope,
+                            return_numpy=False)
+        outs = [VarBase(r, stop_gradient=True) for r in res]
+        return outs[0] if len(outs) == 1 else outs
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer serves inference only "
+                           "(reference parity: retraining a loaded "
+                           "model goes through the static API)")
+
+
+def load(path):
+    """paddle.jit.load — see `save`."""
+    return TranslatedLayer(path)
+
+
 def _unflatten(structure, vals):
     kind, typ, n = structure
     if kind == "one":
@@ -148,10 +245,20 @@ class TracedLayer:
         out = layer(*inputs)
         static_fn = _StaticFunction(lambda *a: layer(*a))
         traced = TracedLayer(layer, static_fn)
+        # trace the static program right away (reference trace() builds
+        # the ProgramDesc here, not lazily) so save_inference_model can
+        # run without another forward
+        traced(*inputs)
         return out, traced
 
     def __call__(self, *inputs):
         return self._static_fn(*inputs)
 
     def save_inference_model(self, path, feed=None, fetch=None):
-        raise NotImplementedError("wired up with io.save_inference_model")
+        """Serialize the traced program + params so the static
+        inference stack (io.load_inference_model / inference.Predictor)
+        can serve it (reference dygraph/jit.py TracedLayer.save_
+        inference_model; feed/fetch are INDEX lists like the
+        reference's)."""
+        self._static_fn.save_inference_model(path, feed=feed,
+                                             fetch=fetch)

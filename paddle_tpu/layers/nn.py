@@ -30,7 +30,7 @@ __all__ = [
     "resize_bilinear", "resize_nearest", "pixel_shuffle",
     "cos_sim", "pad2d", "expand_as", "crop_tensor", "crop",
     "pad_constant_like", "image_resize", "space_to_depth", "norm",
-    "dist",
+    "dist", "py_func",
 ]
 
 
@@ -686,10 +686,11 @@ def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
 def expand_as(x, target_tensor, name=None):
     helper = LayerHelper("expand_as", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)
-    helper.append_op("expand_v2", inputs={"X": [x]},
+    helper.append_op("expand_as_v2",
+                     inputs={"X": [x], "Y": [target_tensor]},
                      outputs={"Out": [out]},
-                     attrs={"shape": [int(d) for d in
-                                      target_tensor.shape]})
+                     attrs={"target_shape": [int(d) for d in
+                                             target_tensor.shape]})
     return out
 
 
@@ -736,13 +737,14 @@ def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
 
 def space_to_depth(x, blocksize, name=None):
     """reference space_to_depth_op: NCHW [B,C,H,W] ->
-    [B, C*b*b, H/b, W/b], composed from reshape + transpose."""
-    from .tensor import reshape as _reshape, transpose as _transpose
-    b = int(blocksize)
-    n, c, h, w = (int(d) for d in x.shape)
-    t1 = _reshape(x, [n if n > 0 else -1, c, h // b, b, w // b, b])
-    t2 = _transpose(t1, [0, 3, 5, 1, 2, 4])
-    return _reshape(t2, [n if n > 0 else -1, c * b * b, h // b, w // b])
+    [B, C*b*b, H/b, W/b] with the darknet-reorg element order
+    (space_to_depth_op.h:39 index mapping — NOT the TF ordering)."""
+    helper = LayerHelper("space_to_depth", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("space_to_depth", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"blocksize": int(blocksize)})
+    return out
 
 
 def norm(x, p=2, axis=-1, keepdim=False, name=None):
@@ -962,4 +964,26 @@ def multiplex(inputs, index, name=None):
     helper.append_op("multiplex",
                      inputs={"X": list(inputs), "Ids": [index]},
                      outputs={"Out": [out]})
+    return out
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Run a Python callable as a graph op (reference layers/nn.py
+    py_func over py_func_op.cc:44). `out` must be pre-created Variables
+    with shapes/dtypes (create_variable / create_parameter), exactly
+    like the reference. backward_func(x..., out..., dout...) -> dx...
+    enables gradients."""
+    from ..ops.io_ops import register_py_func
+    helper = LayerHelper("py_func")
+    xs = [x] if isinstance(x, Variable) else list(x)
+    outs = [out] if isinstance(out, Variable) else list(out)
+    fid = register_py_func(func)
+    bid = register_py_func(backward_func) if backward_func else -1
+    helper.append_op(
+        type="py_func",
+        inputs={"X": [v.name for v in xs]},
+        outputs={"Out": [v.name for v in outs]},
+        attrs={"forward_callable_id": fid,
+               "backward_callable_id": bid})
     return out

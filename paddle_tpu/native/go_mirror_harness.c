@@ -1,0 +1,114 @@
+/* Byte-identical replay of the Go client's ABI call sequence.
+ *
+ * The build image has no Go toolchain, so go/paddle/predictor.go cannot
+ * be compile-tested here (it says so in its header). This harness makes
+ * the EXACT sequence of C ABI calls, with the exact allocation pattern,
+ * that the cgo code makes — so the contract the Go client depends on is
+ * exercised in CI even without Go:
+ *
+ *   NewPredictor:  PT_CreatePredictor(dir)
+ *   InputNames:    PT_GetInputNum + PT_GetInputName for each i
+ *   OutputNames:   PT_GetOutputNum + PT_GetOutputName for each i
+ *   Run:           malloc'd pointer arrays (ins/shapes/ndims) and
+ *                  malloc'd PER-TENSOR copies of data (+1 slack elem)
+ *                  and shape (+1 slack), exactly like predictor.go's
+ *                  cgo-safety copies; dispatch through a pt_run wrapper
+ *                  with the same signature as the cgo helper
+ *   GetOutput:     two-pass PT_GetOutput — capacity-0 size query with a
+ *                  long[16] shape buffer, then the sized read
+ *   Delete:        PT_DeletePredictor
+ *
+ * Usage: go_mirror_harness <model_dir> <n_feature>
+ */
+#include "paddle_tpu_c_api.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* identical to the static helper in go/paddle/predictor.go */
+static int pt_run(PT_Predictor* p, const float** ins, const long** shapes,
+                  const long* ndims, long n) {
+    return PT_PredictorRun(p, ins, shapes, ndims, n);
+}
+
+int main(int argc, char** argv) {
+    if (argc < 3) {
+        fprintf(stderr, "usage: %s <model_dir> <n_feature>\n", argv[0]);
+        return 1;
+    }
+    const long nf = atol(argv[2]);
+
+    /* NewPredictor */
+    PT_Predictor* pred = PT_CreatePredictor(argv[1]);
+    if (pred == NULL) return 2;
+
+    /* InputNames / OutputNames */
+    long n_in = PT_GetInputNum(pred);
+    for (long i = 0; i < n_in; ++i) {
+        if (PT_GetInputName(pred, i) == NULL) return 3;
+    }
+    long n_out = PT_GetOutputNum(pred);
+    for (long i = 0; i < n_out; ++i) {
+        if (PT_GetOutputName(pred, i) == NULL) return 3;
+    }
+
+    /* Run: one [2, nf] ones tensor, allocation pattern as in Go */
+    long n = 1;
+    const float** ins = (const float**)malloc(n * sizeof(void*));
+    const long** shapes = (const long**)malloc(n * sizeof(void*));
+    long* ndims = (long*)malloc(n * sizeof(long));
+
+    long numel = 2 * nf, nd = 2;
+    float* dbuf = (float*)malloc((numel + 1) * 4);      /* +1 as in Go */
+    for (long j = 0; j < numel; ++j) dbuf[j] = 1.0f;
+    long* sbuf = (long*)malloc((nd + 1) * sizeof(long));
+    sbuf[0] = 2;
+    sbuf[1] = nf;
+    ins[0] = &dbuf[0];
+    shapes[0] = &sbuf[0];
+    ndims[0] = nd;
+
+    int rc = pt_run(pred, ins, shapes, &ndims[0], n);
+    free(dbuf);
+    free(sbuf);
+    free(ins);
+    free(shapes);
+    free(ndims);
+    if (rc != 0) return 4;
+
+    /* GetOutput(0): two-pass with long[16] shape buffer */
+    long shape[16];
+    long ndim = 0;
+    long count = PT_GetOutput(pred, 0, NULL, 0, &shape[0], 16, &ndim);
+    if (count < 0) return 5;
+    float* buf = (float*)malloc(count * 4);
+    if (PT_GetOutput(pred, 0, count > 0 ? &buf[0] : NULL, count,
+                     &shape[0], 16, &ndim) < 0)
+        return 5;
+    printf("go_mirror: numel %ld first %.6f ndim %ld\n", count,
+           count > 0 ? buf[0] : 0.0f, ndim);
+    free(buf);
+
+    /* second Run on the SAME predictor: the Go client reuses sessions */
+    const float** ins2 = (const float**)malloc(sizeof(void*));
+    const long** shapes2 = (const long**)malloc(sizeof(void*));
+    long* ndims2 = (long*)malloc(sizeof(long));
+    float* dbuf2 = (float*)malloc((numel + 1) * 4);
+    for (long j = 0; j < numel; ++j) dbuf2[j] = 2.0f;
+    long* sbuf2 = (long*)malloc((nd + 1) * sizeof(long));
+    sbuf2[0] = 2;
+    sbuf2[1] = nf;
+    ins2[0] = dbuf2;
+    shapes2[0] = sbuf2;
+    ndims2[0] = nd;
+    rc = pt_run(pred, ins2, shapes2, ndims2, 1);
+    free(dbuf2); free(sbuf2); free(ins2); free(shapes2); free(ndims2);
+    if (rc != 0) return 6;
+    long count2 = PT_GetOutput(pred, 0, NULL, 0, &shape[0], 16, &ndim);
+    if (count2 != count) return 7;
+
+    PT_DeletePredictor(pred);
+    printf("go_mirror: OK\n");
+    return 0;
+}

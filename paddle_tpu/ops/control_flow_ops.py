@@ -166,3 +166,27 @@ def _increment(ctx, op):
     x = ctx.get_input(op, "X")
     step = op.attr("step", 1.0)
     ctx.set_output(op, "Out", x + jnp.asarray(step, x.dtype))
+
+
+def _run_program_infer(op, block):
+    # out vars were shaped when the captured block's ops ran their infer
+    pass
+
+
+@register_op("run_program", infer=_run_program_infer, grad="auto")
+def _run_program(ctx, op):
+    """Execute a captured sub-program inline (reference run_program_op
+    .cc — the dygraph-side container for to_static traces; there it
+    spins a nested executor, here the sub-block lowers into the same
+    traced computation and XLA fuses across the boundary)."""
+    sub = _sub_block(ctx, op)
+    env = dict()
+    for n in op.input("X") + op.input("Params"):
+        if n and n in ctx.env:
+            env[n] = ctx.env[n]
+    for n in _external_reads(sub, None):
+        if n in ctx.env and n not in env:
+            env[n] = ctx.env[n]
+    _lower_sub(ctx, sub, env)
+    for n in op.output("Out"):
+        ctx.env[n] = env[n]

@@ -107,3 +107,124 @@ def _load_combine(ctx, op):
         _read, tuple(jax.ShapeDtypeStruct(sh, dt) for sh, dt in metas),
         ordered=True)
     ctx.set_outputs(op, "Out", list(outs))
+
+
+# ---------------------------------------------------------------------------
+# py_func — user Python inside the graph (reference py_func_op.cc:44)
+# ---------------------------------------------------------------------------
+_PY_FUNCS = []
+
+
+def register_py_func(fn) -> int:
+    """Register a Python callable; returns its id (the reference keeps
+    the same global registry on the Python side of py_func_op)."""
+    _PY_FUNCS.append(fn)
+    return len(_PY_FUNCS) - 1
+
+
+def get_py_func(fid):
+    return _PY_FUNCS[int(fid)]
+
+
+def _py_func_infer(op, block):
+    mirror = op.attr("__mirror_inputs__", None)
+    if mirror is not None:
+        dtypes = op.attr("__out_dtypes__", None)
+        xs = op.input("X")
+        for name, i, dt in zip(op.output("Out"), mirror, dtypes):
+            src = block._find_var_recursive(xs[i])
+            v = (block._find_var_recursive(name)
+                 or block.create_var(name=name))
+            v.shape, v.dtype = tuple(src.shape), dt
+    # else: the layer front-end pre-declared the out vars with shapes
+
+
+def _py_func_grad_maker(fwd_op, block, helper):
+    """Backward = another py_func running the user's backward_func on
+    (x..., out..., dout...) -> dx... (reference py_func_op.cc grad
+    maker)."""
+    from ..framework.core import grad_var_name
+    bid = fwd_op.attr("backward_callable_id", -1)
+    if bid is None or bid < 0:
+        return []
+    xs = list(fwd_op.input("X"))
+    outs = list(fwd_op.output("Out"))
+    douts = [grad_var_name(n) for n in outs]
+    gxs, mirror, dtypes = [], [], []
+    for i, n in enumerate(xs):
+        v = block._find_var_recursive(n)
+        if (v is not None and not v.stop_gradient
+                and n not in helper.no_grad_set
+                and str(v.dtype).startswith(("float", "bfloat"))):
+            gxs.append(grad_var_name(n))
+            mirror.append(i)  # dx_i has x_i's (runtime) shape
+            dtypes.append(v.dtype)
+    if not gxs:
+        return []
+    return [dict(type="py_func",
+                 inputs={"X": xs + outs + douts},
+                 outputs={"Out": gxs},
+                 attrs={"forward_callable_id": bid,
+                        "backward_callable_id": -1,
+                        "__mirror_inputs__": mirror,
+                        "__out_dtypes__": dtypes})]
+
+
+@register_op("py_func", infer=_py_func_infer, grad=_py_func_grad_maker)
+def _py_func(ctx, op):
+    """Host callback via io_callback: the callable sees real numpy
+    arrays, its results are shipped back to the device. Inside jit this
+    is an ordered host round-trip — the documented cost of py_func on
+    an accelerator (the reference pays a GPU sync the same way)."""
+    import jax
+
+    fn = get_py_func(op.attr("forward_callable_id"))
+    xs = ctx.get_inputs(op, "X")
+    out_names = op.output("Out")
+    mirror = op.attr("__mirror_inputs__", None)
+    if mirror is not None:
+        # grad form: dx_i mirrors x_i's runtime shape (static var shapes
+        # can carry -1 batch dims)
+        dtypes = op.attr("__out_dtypes__")
+        specs = [jax.ShapeDtypeStruct(tuple(xs[i].shape), dt)
+                 for i, dt in zip(mirror, dtypes)]
+    else:
+        specs = [jax.ShapeDtypeStruct(tuple(ctx.var_shape(n)),
+                                      ctx.var_dtype(n))
+                 for n in out_names]
+
+    def host(*arrays):
+        res = fn(*[np.asarray(a) for a in arrays])
+        res = list(res) if isinstance(res, (list, tuple)) else [res]
+        return [np.asarray(r, s.dtype).reshape(s.shape)
+                for r, s in zip(res, specs)]
+
+    outs = jax.experimental.io_callback(host, specs, *xs)
+    ctx.set_outputs(op, "Out", outs)
+
+
+# ---------------------------------------------------------------------------
+# distributed_lookup_table (reference distributed_ops/
+# distributed_lookup_table_op.cc): sparse-table lookup. The PS-backed
+# path lives in distributed/ps (communicator pulls); inside a compiled
+# graph the op gathers from the locally-materialized table slice — the
+# transpiled PS program feeds W from the pulled parameter.
+# ---------------------------------------------------------------------------
+def _dlt_infer(op, block):
+    w = block.var(op.input("W")[0])
+    for name, src in zip(op.output("Outputs"), op.input("Ids")):
+        ids = block.var(src)
+        v = (block._find_var_recursive(name)
+             or block.create_var(name=name))
+        v.shape = tuple(ids.shape[:-1]) + (w.shape[-1],)
+        v.dtype = w.dtype
+
+
+@register_op("distributed_lookup_table", infer=_dlt_infer)
+def _distributed_lookup_table(ctx, op):
+    w = ctx.get_input(op, "W")
+    outs = []
+    for ids in ctx.get_inputs(op, "Ids"):
+        idx = ids.reshape(ids.shape[:-1]).astype("int32")
+        outs.append(w[idx])
+    ctx.set_outputs(op, "Outputs", outs)

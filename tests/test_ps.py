@@ -614,3 +614,165 @@ def test_connection_pool_bounded():
     for c in held:
         c.close()
     server.stop()
+
+
+# ---------------------------------------------------------------------------
+# round-5: half-async communicator + server-side checkpoint (VERDICT #7)
+# ---------------------------------------------------------------------------
+def test_ps_half_async_mode_selected_and_converges():
+    """half_async: a_sync + half_async config; bounded staleness — the
+    loss must still converge, and pushes must only reach the server at
+    window boundaries (reference communicator.h:340)."""
+    feeds = _batches(150)
+    strategy = DistributedStrategy()
+    strategy.a_sync = True
+    strategy.a_sync_configs = {"k_steps": 4, "half_async": True}
+    main, startup, loss = _build_ps_program(strategy=strategy)
+    ctx = main._ps_ctx
+    assert ctx.mode == "half_async"
+
+    exe = pt.Executor()
+    exe.run(startup)
+    trainer = fleet.init_worker()
+    comm = trainer.comm
+    from paddle_tpu.distributed.ps.communicator import \
+        HalfAsyncCommunicator
+    assert isinstance(comm, HalfAsyncCommunicator)
+
+    losses = []
+    for i, f in enumerate(feeds):
+        losses.append(float(trainer.run(f, fetch_list=[loss])[0]))
+        if i == 1:
+            # inside the first window: nothing pushed to the server yet
+            assert len(comm._pending) > 0
+    fleet.stop_worker()
+    assert not comm._pending  # stop flushes the tail
+    first = np.mean(losses[:10])
+    last = np.mean(losses[-10:])
+    assert last < first * 0.85, (first, last)
+
+
+def test_ps_checkpoint_save_restore_inprocess():
+    """Server-side checkpoint: save, keep training, restore -> exact
+    rewind of sparse rows AND dense value/optimizer slots."""
+    import paddle_tpu.distributed.ps as ps
+
+    svc = ps.PSService()
+    svc.create_sparse_table(ps.TableConfig("emb", dim=4, seed=3,
+                                           optimizer="adam", lr=0.1))
+    svc.create_dense_table("w", np.zeros((3, 2), "float32"),
+                           optimizer="adam", lr=0.1)
+    client = ps.LocalClient(svc)
+    ids = np.array([1, 5, 9], np.int64)
+    client.push_sparse("emb", ids, np.ones((3, 4), "float32"))
+    client.push_dense("w", np.ones((3, 2), "float32"))
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        client.save_checkpoint(d)
+        snap_rows = client.pull_sparse("emb", ids).copy()
+        snap_w = client.pull_dense("w").copy()
+        # diverge
+        client.push_sparse("emb", ids, np.ones((3, 4), "float32"))
+        client.push_dense("w", np.ones((3, 2), "float32"))
+        assert not np.allclose(client.pull_dense("w"), snap_w)
+        # restore rewinds values AND adam state
+        client.restore_checkpoint(d)
+        np.testing.assert_allclose(client.pull_sparse("emb", ids),
+                                   snap_rows)
+        np.testing.assert_allclose(client.pull_dense("w"), snap_w)
+        dt = svc.dense["w"]
+        assert dt._t == 1  # adam step counter rewound too
+        # post-restore updates behave identically to the original path
+        client.push_dense("w", np.ones((3, 2), "float32"))
+        w_after = client.pull_dense("w")
+        client.restore_checkpoint(d)
+        client.push_dense("w", np.ones((3, 2), "float32"))
+        np.testing.assert_allclose(client.pull_dense("w"), w_after)
+
+
+def test_ps_checkpoint_across_process_restart(tmp_path):
+    """Full resume drill: server process trains, checkpoints to disk,
+    dies; a FRESH server process restores and serves the exact state
+    (reference checkpoint_notify + load flow across pserver restart)."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+    import time
+
+    ckpt = str(tmp_path / "ckpt")
+
+    def start_server(port_file):
+        src = textwrap.dedent(f"""
+            import numpy as np
+            from paddle_tpu.distributed.ps import (PServer, PSService,
+                                                   TableConfig)
+            svc = PSService()
+            svc.create_sparse_table(TableConfig("emb_w", dim={DIM},
+                                                seed=5, optimizer="sgd",
+                                                lr=0.1))
+            svc.create_dense_table("w", np.zeros((4, 1), "float32"),
+                                   lr=0.1)
+            server = PServer(svc, endpoint="127.0.0.1:0", n_workers=1)
+            server.start()
+            tmp = {port_file!r} + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(server.endpoint)
+            import os
+            os.replace(tmp, {port_file!r})
+            server.wait()
+        """)
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        return subprocess.Popen([sys.executable, "-c", src], env=env)
+
+    def wait_endpoint(port_file, proc):
+        for _ in range(200):
+            if os.path.exists(port_file):
+                ep = open(port_file).read().strip()
+                if ep:
+                    return ep
+            time.sleep(0.1)
+        raise AssertionError(f"no endpoint (rc={proc.poll()})")
+
+    pf1 = str(tmp_path / "ep1.txt")
+    p1 = start_server(pf1)
+    try:
+        c1 = RPCClient(wait_endpoint(pf1, p1))
+        ids = np.array([3, 11, 42], np.int64)
+        base = c1.pull_sparse("emb_w", ids).copy()
+        c1.push_sparse("emb_w", ids, np.ones((3, DIM), "float32"))
+        c1.push_dense("w", np.ones((4, 1), "float32"))
+        trained_rows = c1.pull_sparse("emb_w", ids).copy()
+        trained_w = c1.pull_dense("w").copy()
+        np.testing.assert_allclose(trained_rows, base - 0.1, rtol=1e-6)
+        c1.save_checkpoint(ckpt)   # server writes its own disk
+        c1.stop_server()
+        c1.close()
+        p1.wait(timeout=30)
+    finally:
+        if p1.poll() is None:
+            p1.kill()
+
+    pf2 = str(tmp_path / "ep2.txt")
+    p2 = start_server(pf2)
+    try:
+        c2 = RPCClient(wait_endpoint(pf2, p2))
+        # fresh process: state differs until restore
+        c2.restore_checkpoint(ckpt)
+        np.testing.assert_allclose(c2.pull_sparse("emb_w", ids),
+                                   trained_rows, rtol=1e-6)
+        np.testing.assert_allclose(c2.pull_dense("w"), trained_w,
+                                   rtol=1e-6)
+        # training continues from the restored state
+        c2.push_sparse("emb_w", ids, np.ones((3, DIM), "float32"))
+        np.testing.assert_allclose(c2.pull_sparse("emb_w", ids),
+                                   trained_rows - 0.1, rtol=1e-6)
+        c2.stop_server()
+        c2.close()
+        p2.wait(timeout=30)
+    finally:
+        if p2.poll() is None:
+            p2.kill()
