@@ -30,7 +30,7 @@ __all__ = [
     "resize_bilinear", "resize_nearest", "pixel_shuffle",
     "cos_sim", "pad2d", "expand_as", "crop_tensor", "crop",
     "pad_constant_like", "image_resize", "space_to_depth", "norm",
-    "dist", "py_func",
+    "dist", "py_func", "moe_ffn",
 ]
 
 
@@ -987,3 +987,33 @@ def py_func(func, x, out, backward_func=None,
         attrs={"forward_callable_id": fid,
                "backward_callable_id": bid})
     return out
+
+
+def moe_ffn(x, num_experts, d_ff, capacity_factor=1.25,
+            activation="gelu", name=None, param_attr=None):
+    """Switch-style top-1 gated mixture-of-experts FFN (new capability —
+    SURVEY §2.6 EP row; ops/moe_ops.py). Returns (out, aux_loss); add
+    aux_loss (scaled ~1e-2) to the training loss for balanced routing.
+    Parameter names carry the 'moe' tag so parallel.moe.moe_rules shards
+    the expert dims over the `ep` mesh axis."""
+    helper = LayerHelper("moe_ffn", name=name)
+    h = int(x.shape[-1])
+    e, i = int(num_experts), int(d_ff)
+    # names inherit the "moe_ffn" helper prefix, which moe_rules keys on
+    gate_w = helper.create_parameter(param_attr, [h, e], x.dtype)
+    w1 = helper.create_parameter(param_attr, [e, h, i], x.dtype)
+    b1 = helper.create_parameter(param_attr, [e, i], x.dtype, is_bias=True)
+    w2 = helper.create_parameter(param_attr, [e, i, h], x.dtype)
+    b2 = helper.create_parameter(param_attr, [e, h], x.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    aux = helper.create_variable_for_type_inference("float32")
+    counts = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "moe_ffn",
+        inputs={"X": [x], "GateW": [gate_w], "W1": [w1], "B1": [b1],
+                "W2": [w2], "B2": [b2]},
+        outputs={"Out": [out], "AuxLoss": [aux],
+                 "ExpertCount": [counts]},
+        attrs={"capacity_factor": float(capacity_factor),
+               "activation": activation})
+    return out, aux

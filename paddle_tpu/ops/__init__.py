@@ -30,5 +30,6 @@ from . import misc2_ops  # noqa: F401
 from . import rnn_fused_ops  # noqa: F401
 from . import catalog_seq_ops  # noqa: F401
 from . import catalog_ctr_ops  # noqa: F401
+from . import moe_ops  # noqa: F401
 from .registry import (LowerContext, all_registered_ops, get_op_def,  # noqa
                        has_op, register_op)

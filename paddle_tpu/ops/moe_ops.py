@@ -1,0 +1,47 @@
+"""moe_ffn graph op: Switch-style expert-parallel FFN.
+
+New capability (SURVEY.md §2.6 — completes the TP/EP/CP/SP quartet; the
+reference vintage has no MoE op). Lowering picks the TPU execution per
+context, the same pattern as flash_attention:
+  * `ep` axis bound (shard_map / build_spmd_step) -> all_to_all token
+    dispatch over ICI (parallel/moe.py)
+  * otherwise (single device or GSPMD build_sharded_step) -> dense
+    einsum math; under GSPMD the expert weights are physically sharded
+    by parallel.moe.moe_rules and XLA inserts the collectives.
+"""
+from __future__ import annotations
+
+from .registry import in_var, register_op, set_out
+
+
+def _moe_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+    set_out(op, block, "AuxLoss", (), "float32")
+    if op.output("ExpertCount"):
+        e = in_var(op, block, "GateW").shape[1]
+        set_out(op, block, "ExpertCount", (e,), "float32")
+
+
+@register_op("moe_ffn", infer=_moe_infer, grad="auto")
+def _moe_ffn(ctx, op):
+    from ..parallel.mesh import EP_AXIS
+    from ..parallel.moe import moe_ffn_tokens
+
+    x = ctx.get_input(op, "X")
+    gate_w = ctx.get_input(op, "GateW")
+    w1, b1 = ctx.get_input(op, "W1"), ctx.get_input(op, "B1")
+    w2, b2 = ctx.get_input(op, "W2"), ctx.get_input(op, "B2")
+    axes = getattr(ctx, "axis_names", ()) or ()
+    axis = EP_AXIS if EP_AXIS in axes else None
+    shape = x.shape
+    flat = x.reshape(-1, shape[-1])
+    out, aux, counts = moe_ffn_tokens(
+        flat, gate_w, w1, b1, w2, b2,
+        capacity_factor=float(op.attr("capacity_factor", 1.25)),
+        axis_name=axis,
+        activation=op.attr("activation", "gelu"))
+    ctx.set_output(op, "Out", out.reshape(shape))
+    ctx.set_output(op, "AuxLoss", aux)
+    if op.output("ExpertCount"):
+        ctx.set_output(op, "ExpertCount", counts)

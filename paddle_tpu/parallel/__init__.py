@@ -15,3 +15,5 @@ from .sharded import (ShardingRules, data_parallel_rules,  # noqa
                       build_sharded_multistep)
 from .pipeline_pp import build_pp_pipeline_step  # noqa
 from .pipeline_hetero import build_hetero_pp_step  # noqa
+from .spmd import build_spmd_step  # noqa
+from .moe import moe_ffn_tokens, moe_rules  # noqa

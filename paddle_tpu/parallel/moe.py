@@ -1,0 +1,120 @@
+"""Expert parallelism: Switch-style gated MoE over an `ep` mesh axis.
+
+New capability (SURVEY.md §2.6 TP/EP/CP/SP row — absent in the reference
+vintage, required for the quartet). Design follows the TPU lineage
+(Switch Transformer / GShard): top-1 gating, per-expert capacity
+C = ceil(tokens/E * capacity_factor), dispatch/combine as one-hot
+einsums, and token exchange as a single `lax.all_to_all` pair over the
+`ep` axis inside shard_map — the collectives ride ICI. Under GSPMD
+(build_sharded_step) the same math runs dense with expert weights
+physically sharded over `ep` via `moe_rules`, and XLA inserts the
+equivalent collectives from the annotations.
+
+Overflowed tokens (beyond an expert's capacity) contribute zero from the
+expert path — callers keep the residual connection so dropped tokens
+pass through, exactly the Switch semantics.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .mesh import EP_AXIS
+
+
+def moe_ffn_tokens(x, gate_w, w1, b1, w2, b2, *,
+                   capacity_factor: float = 1.25,
+                   axis_name: Optional[str] = None,
+                   activation: str = "gelu"):
+    """Top-1 MoE FFN over flat tokens.
+
+    x [N, H]; gate_w [H, E]; w1 [E, H, I]; b1 [E, I]; w2 [E, I, H];
+    b2 [E, H]. Returns (out [N, H], aux_loss scalar, expert_counts [E]).
+
+    With `axis_name` bound (shard_map over `ep`): N is the per-device
+    token count; experts are partitioned E/ep per device (each device
+    computes with its own slice of the expert weights) and tokens move
+    via all_to_all. Without it: dense single-participant math.
+    """
+    import jax
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    N, H = x.shape
+    E = gate_w.shape[1]
+    xf = x.astype("float32")
+    logits = xf @ gate_w.astype("float32")
+    probs = jax.nn.softmax(logits, axis=-1)              # [N, E]
+    expert = jnp.argmax(probs, axis=-1)                  # top-1
+    gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
+    onehot = jax.nn.one_hot(expert, E, dtype="float32")  # [N, E]
+
+    # load-balancing auxiliary loss (Switch eq. 4): E * sum_e f_e * P_e
+    frac = onehot.mean(0)
+    mean_prob = probs.mean(0)
+    aux = E * jnp.sum(frac * mean_prob)
+
+    # capacity-factor padding: rank of each token within its expert
+    C = max(1, int(np.ceil(N / E * capacity_factor)))
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot    # [N, E]
+    keep = (pos < C) & (onehot > 0)
+    pos_oh = (jax.nn.one_hot(pos.astype("int32"), C, dtype="float32")
+              * keep[..., None].astype("float32"))       # [N, E, C]
+
+    dispatched = jnp.einsum("nec,nh->ech", pos_oh, xf)   # [E, C, H]
+
+    def ffn(tokens, w1_, b1_, w2_, b2_):
+        h = jnp.einsum("ech,ehi->eci", tokens, w1_.astype("float32"))
+        h = h + b1_.astype("float32")[:, None, :]
+        if activation == "gelu":
+            h = jax.nn.gelu(h)
+        elif activation == "relu":
+            h = jnp.maximum(h, 0)
+        out = jnp.einsum("eci,eih->ech", h, w2_.astype("float32"))
+        return out + b2_.astype("float32")[:, None, :]
+
+    if axis_name:
+        ep = lax.psum(1, axis_name)                      # axis size
+        el = E // ep                                     # local experts
+        me = lax.axis_index(axis_name)
+        # each device keeps its expert slice of the (replicated-in-
+        # shard_map) weights; GSPMD legs shard them physically instead
+        sl = lambda w: lax.dynamic_slice_in_dim(w, me * el, el, axis=0)
+        # exchange: split experts across devices, gather every peer's
+        # tokens for MY experts along the capacity axis
+        expert_in = lax.all_to_all(dispatched, axis_name,
+                                   split_axis=0, concat_axis=1,
+                                   tiled=True)           # [el, ep*C, H]
+        expert_out = ffn(expert_in, sl(w1), sl(b1), sl(w2), sl(b2))
+        combined = lax.all_to_all(expert_out, axis_name,
+                                  split_axis=1, concat_axis=0,
+                                  tiled=True)            # [E, C, H]
+    else:
+        combined = ffn(dispatched, w1, b1, w2, b2)
+
+    out = jnp.einsum("nec,ech->nh", pos_oh, combined)
+    out = out * gate[:, None]
+    counts = onehot.sum(0)
+    return out.astype(x.dtype), aux.astype("float32"), counts
+
+
+def moe_rules(mesh, axis: str = EP_AXIS, inner=None):
+    """GSPMD rule table for expert weights: 3-D+ params whose leading
+    dim divides the `ep` axis shard over it (expert dim first); other
+    params fall through to `inner` (e.g. megatron_rules). Compose:
+    ``moe_rules(mesh, inner=megatron_rules(mesh))``."""
+    from jax.sharding import PartitionSpec as P
+
+    from .sharded import ShardingRules
+
+    size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+    inner_fn = getattr(inner, "_fn", None) or (lambda name, shape: None)
+
+    def fn(name, shape):
+        if (size > 1 and shape and len(shape) >= 3
+                and "moe" in name and shape[0] % size == 0):
+            return P(*([axis] + [None] * (len(shape) - 1)))
+        return inner_fn(name, shape)
+
+    return ShardingRules(fn)

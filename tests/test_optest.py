@@ -1040,6 +1040,8 @@ SKIP = {
                        "vs full-batch BN + training)",
     **{op: "tests/test_jit_save.py" for op in [
         "py_func", "run_program", "distributed_lookup_table"]},
+    "moe_ffn": "tests/test_moe.py (numpy Switch ref, ep8 all_to_all "
+               "parity, capacity drop, training)",
     **{op: "tests/test_fleet_collective.py (8-mesh numeric)" for op in [
         "allreduce", "broadcast", "c_reduce_prod", "c_scatter"]},
     "add_position_encoding": "tests/test_longtail_ops.py",
