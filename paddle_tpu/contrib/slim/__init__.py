@@ -1,3 +1,4 @@
 """Model compression (reference python/paddle/fluid/contrib/slim/)."""
-from .quanter import (QuantizationTransformPass, post_training_quantize,  # noqa
-                      quant_aware)
+from .quanter import (QuantizationTransformPass, HistogramCalibrator,  # noqa
+                      convert_to_int8, export_quantized_inference_model,
+                      post_training_quantize, quant_aware)

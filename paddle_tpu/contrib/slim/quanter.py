@@ -148,15 +148,7 @@ def quant_aware(program: Program, startup_program=None, weight_bits=8,
                                                   startup_program)
 
 
-def post_training_quantize(program: Program, executor, feed_batches,
-                           fetch_targets=None, startup_program=None,
-                           weight_bits=8, activation_bits=8,
-                           quantizable_op_type=QUANTIZABLE, scope=None):
-    """PTQ (reference post_training_quantization.py): run calibration
-    batches on the float program to record per-activation abs-max, then
-    rewrite with static scales.  Returns the number of quant points."""
-    block = program.global_block()
-    # activation vars feeding quantizable ops
+def _collect_act_vars(block, quantizable_op_type) -> List[str]:
     act_vars: List[str] = []
     for op in block.ops:
         if op.type in quantizable_op_type and \
@@ -169,13 +161,216 @@ def post_training_quantize(program: Program, executor, feed_batches,
                     if v is not None and str(v.dtype).startswith(
                             "float") and n not in act_vars:
                         act_vars.append(n)
-    scales = {n: 0.0 for n in act_vars}
-    for feed in feed_batches:
-        vals = executor.run(program, feed=feed, fetch_list=act_vars)
-        for n, v in zip(act_vars, vals):
-            scales[n] = max(scales[n], float(np.abs(np.asarray(v)).max()))
+    return act_vars
+
+
+_HIST_BINS = 2048
+_QUANT_LEVELS = 128  # int8 positive range
+
+
+def _kl_divergence(p, q):
+    p = p / max(p.sum(), 1e-12)
+    q = q / max(q.sum(), 1e-12)
+    mask = p > 0
+    qm = np.where(q[mask] > 0, q[mask], 1e-12)
+    return float(np.sum(p[mask] * np.log(p[mask] / qm)))
+
+
+def _kl_threshold(hist: np.ndarray, bin_width: float) -> float:
+    """TensorRT-style entropy calibration (reference
+    post_training_quantization.py cal_kl_threshold / utils.py): scan
+    clip points i in [128, nbins], fold the tail into the last bin of
+    the reference distribution, quantize to 128 levels, expand back,
+    and pick the i minimizing KL(P||Q). Returns the abs-max scale."""
+    nbins = len(hist)
+    best_i, best_kl = nbins, np.inf
+    for i in range(_QUANT_LEVELS, nbins + 1):
+        p = hist[:i].astype("float64").copy()
+        p[i - 1] += hist[i:].sum()          # outliers clipped in
+        if p.sum() == 0:
+            continue
+        # quantize the i bins into 128 levels, then expand
+        chunks = np.array_split(np.arange(i), _QUANT_LEVELS)
+        q = np.zeros(i, "float64")
+        ref = hist[:i].astype("float64")
+        for ch in chunks:
+            total = ref[ch].sum()
+            nz = (ref[ch] > 0).sum()
+            if nz:
+                q[ch] = np.where(ref[ch] > 0, total / nz, 0.0)
+        kl = _kl_divergence(p, q)
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return (best_i + 0.5) * bin_width
+
+
+class HistogramCalibrator:
+    """Two-pass activation calibration (reference
+    post_training_quantization.py sample-collection): pass 1 records
+    per-var abs-max, pass 2 accumulates 2048-bin histograms; scales come
+    from the chosen algo ('KL' entropy threshold or 'hist' percentile)."""
+
+    def __init__(self, var_names: Sequence[str], algo: str = "KL",
+                 hist_percent: float = 0.99999):
+        self.var_names = list(var_names)
+        self.algo = algo
+        self.hist_percent = hist_percent
+        self.abs_max: Dict[str, float] = {n: 0.0 for n in var_names}
+        self.hist: Dict[str, np.ndarray] = {}
+
+    def observe_max(self, name, value):
+        self.abs_max[name] = max(self.abs_max[name],
+                                 float(np.abs(np.asarray(value)).max()))
+
+    def observe_hist(self, name, value):
+        top = max(self.abs_max[name], 1e-12)
+        h, _ = np.histogram(np.abs(np.asarray(value)).ravel(),
+                            bins=_HIST_BINS, range=(0.0, top))
+        if name in self.hist:
+            self.hist[name] += h
+        else:
+            self.hist[name] = h.astype("int64")
+
+    def scales(self) -> Dict[str, float]:
+        out = {}
+        for n in self.var_names:
+            top = max(self.abs_max[n], 1e-12)
+            h = self.hist.get(n)
+            if h is None or h.sum() == 0:
+                out[n] = top
+            elif self.algo == "KL":
+                out[n] = _kl_threshold(h, top / _HIST_BINS)
+            else:  # 'hist': percentile of the |x| distribution
+                c = np.cumsum(h) / h.sum()
+                idx = int(np.searchsorted(c, self.hist_percent))
+                out[n] = (min(idx, _HIST_BINS - 1) + 0.5) \
+                    * (top / _HIST_BINS)
+        return out
+
+
+def post_training_quantize(program: Program, executor, feed_batches,
+                           fetch_targets=None, startup_program=None,
+                           weight_bits=8, activation_bits=8,
+                           quantizable_op_type=QUANTIZABLE, scope=None,
+                           algo: str = "abs_max",
+                           hist_percent: float = 0.99999):
+    """PTQ (reference post_training_quantization.py): run calibration
+    batches on the float program to collect per-activation statistics,
+    then rewrite with static scales. algo: 'abs_max' (min-max), 'KL'
+    (entropy threshold), or 'hist' (percentile). Returns the number of
+    quant points.
+
+    NOTE (same caveat as the reference): KL needs a REPRESENTATIVE
+    multi-batch calibration set — on a spiky single-batch histogram the
+    entropy scan over-clips; prefer 'hist' when calibration data is
+    scarce."""
+    feed_batches = list(feed_batches)
+    block = program.global_block()
+    act_vars = _collect_act_vars(block, quantizable_op_type)
+    if algo == "abs_max":
+        scales = {n: 0.0 for n in act_vars}
+        for feed in feed_batches:
+            vals = executor.run(program, feed=feed, fetch_list=act_vars,
+                                scope=scope)
+            for n, v in zip(act_vars, vals):
+                scales[n] = max(scales[n],
+                                float(np.abs(np.asarray(v)).max()))
+    elif algo in ("KL", "hist"):
+        calib = HistogramCalibrator(act_vars, algo=algo,
+                                    hist_percent=hist_percent)
+        for feed in feed_batches:      # pass 1: abs-max
+            vals = executor.run(program, feed=feed, fetch_list=act_vars,
+                                scope=scope)
+            for n, v in zip(act_vars, vals):
+                calib.observe_max(n, v)
+        for feed in feed_batches:      # pass 2: histograms
+            vals = executor.run(program, feed=feed, fetch_list=act_vars,
+                                scope=scope)
+            for n, v in zip(act_vars, vals):
+                calib.observe_hist(n, v)
+        scales = calib.scales()
+    else:
+        raise ValueError(f"unknown PTQ algo {algo!r}; "
+                         "valid: abs_max | KL | hist")
     tp = QuantizationTransformPass(
         weight_bits, activation_bits,
         quantizable_op_type=quantizable_op_type)
     return tp.apply(program, startup_program, act_scales=scales,
                     scope=scope)
+
+
+# ---------------------------------------------------------------------------
+# freeze / int8 export (reference quantization_pass.py
+# QuantizationFreezePass + ConvertToInt8Pass)
+# ---------------------------------------------------------------------------
+def convert_to_int8(program: Program, scope=None) -> int:
+    """Freeze weight fake-quant points into real int8 storage.
+
+    Each fake_channel_wise_quantize_dequantize_abs_max op on a
+    persistable weight is replaced by (cast int8->float) *
+    (per-channel scale) ops reading a new `<w>.int8` persistable var —
+    so the SAVED model carries int8 weights (4x smaller) + float scale
+    vectors, and the Predictor serves it with a dequantize-on-entry
+    epilogue XLA folds into the consuming matmul. Activation points
+    (static-scale qdq) are kept: on TPU the fake-qdq clamp IS the int8
+    simulation, there is no separate int8 engine to hand off to.
+    Returns the number of weights converted."""
+    from ...framework.executor import global_scope
+    scope = scope or global_scope()
+    block = program.global_block()
+    n_converted = 0
+    for op in list(block.ops):
+        if op.type != "fake_channel_wise_quantize_dequantize_abs_max":
+            continue
+        wname = op.input("X")[0]
+        wv = block._find_var_recursive(wname)
+        if wv is None or not getattr(wv, "persistable", False):
+            continue
+        w = np.asarray(scope.find_var(wname))
+        axis = int(op.attr("quant_axis", 0))
+        qname = op.output("Out")[0]
+        red = tuple(i for i in range(w.ndim) if i != axis)
+        scale = np.abs(w).max(axis=red, keepdims=True)
+        scale = np.maximum(scale, 1e-12)
+        q = np.clip(np.round(w / scale * 127.0), -127, 127) \
+            .astype("int8")
+        int8_name = wname + ".int8"
+        scale_name = wname + ".int8_scale"
+        block.create_var(name=int8_name, shape=q.shape, dtype="int8",
+                         persistable=True)
+        block.create_var(name=scale_name, shape=scale.shape,
+                         dtype="float32", persistable=True)
+        scope.set_var(int8_name, q)
+        scope.set_var(scale_name, (scale / 127.0).astype("float32"))
+        castf = wname + ".int8_f32"
+        block.create_var(name=castf, shape=q.shape, dtype="float32")
+        idx = op.idx
+        # replace the fake op with cast + mul producing the same output
+        block._remove_op(idx)
+        block._insert_op(idx, "cast", inputs={"X": [int8_name]},
+                         outputs={"Out": [castf]},
+                         attrs={"in_dtype": "int8",
+                                "out_dtype": "float32"})
+        block._insert_op(idx + 1, "elementwise_mul",
+                         inputs={"X": [castf], "Y": [scale_name]},
+                         outputs={"Out": [qname]}, attrs={"axis": -1})
+        # the float weight is dead: stop persisting it so the exported
+        # params carry only the int8 copy
+        wv.persistable = False
+        n_converted += 1
+    program.bump()
+    return n_converted
+
+
+def export_quantized_inference_model(dirname, feed_names, targets,
+                                     executor, program: Program,
+                                     scope=None):
+    """convert_to_int8 + save_inference_model in one step (reference
+    PostTrainingQuantization.save_quantized_model)."""
+    from ... import io as pt_io
+    from ...framework.executor import scope_guard, global_scope
+    n = convert_to_int8(program, scope=scope)
+    with scope_guard(scope or global_scope()):
+        pt_io.save_inference_model(dirname, feed_names, targets,
+                                   executor, main_program=program)
+    return n

@@ -94,6 +94,16 @@ def apply_dygraph_update(opt, params_grads: List[Tuple]):
     cache = getattr(opt, "_eager_engine_cache", None)
     if cache is None or cache[0] != sig:
         st = _build(opt, params_grads)
+        # resume: set_state_dict stashed accumulators positionally
+        # (raw accumulator names are unique-suffixed per build and do
+        # NOT survive a rebuild; the structural order does)
+        restored = getattr(opt, "_dy_restored_state", None)
+        if restored is not None and len(restored) == len(st.state_names):
+            for n, v in zip(st.state_names, restored):
+                have = np.shape(st.state_vals[n])
+                if have == np.shape(v):
+                    st.state_vals[n] = np.asarray(v)
+            opt._dy_restored_state = None
         opt._eager_engine_cache = (sig, st)
     else:
         st = cache[1]
@@ -109,6 +119,9 @@ def apply_dygraph_update(opt, params_grads: List[Tuple]):
         p._value = v
     for n, v in zip(st.state_names, new_state):
         st.state_vals[n] = v
-    # mirror into _dy_accumulators for optimizer.state_dict()
-    for n, v in zip(st.state_names, new_state):
-        opt._dy_accumulators.setdefault("state", {})[n] = v
+    # mirror into _dy_accumulators for optimizer.state_dict(): keyed by
+    # POSITION (names are unique-suffixed per build and unstable across
+    # process/model rebuilds; the structural order is deterministic)
+    mirror = opt._dy_accumulators.setdefault("state", {})
+    for i, v in enumerate(new_state):
+        mirror[str(i)] = v

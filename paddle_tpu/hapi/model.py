@@ -38,7 +38,11 @@ def _split_batch(batch):
 
 
 class Model:
-    """2.0-style training facade around a dygraph Layer."""
+    """2.0-style training facade around a dygraph Layer.
+
+    inputs/labels take paddle.static.InputSpec lists (reference
+    model.py: the specs drive save(training=False) export); when
+    omitted they are inferred from the first batch seen."""
 
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -46,9 +50,17 @@ class Model:
         self._loss = None
         self._metrics: List = []
         self.stop_training = False   # set by EarlyStopping
+        self._inputs = list(inputs) if inputs else None
+        self._labels = list(labels) if labels else None
+        self._ddp = None             # DataParallel wrapper when multi-proc
 
     # -- configuration ------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None):
+        """Reference Model.prepare (model.py:1558). Launched under
+        distributed.launch with >1 trainers, fit() automatically runs
+        data-parallel: the network is wrapped in dygraph.DataParallel
+        and each step scales the loss and allreduces gradients across
+        processes (reference _init_distributed + prepare)."""
         self._optimizer = optimizer
         self._loss = loss
         if metrics is None:
@@ -56,19 +68,34 @@ class Model:
         else:
             self._metrics = list(metrics) if isinstance(
                 metrics, (list, tuple)) else [metrics]
+        env = dygraph.ParallelEnv()
+        if env.world_size > 1 and self._ddp is None:
+            with dygraph.guard():
+                self._ddp = dygraph.DataParallel(self.network)
         return self
 
     # -- single-batch engines ----------------------------------------------
     def train_batch(self, inputs, labels):
         assert self._optimizer is not None and self._loss is not None, \
             "call prepare(optimizer, loss) first"
+        if self._inputs is None:
+            from ..static import InputSpec
+            self._inputs = [
+                InputSpec(np.asarray(x).shape, str(np.asarray(x).dtype))
+                for x in inputs]
         with dygraph.guard():
             self.network.train()
             ins = [dygraph.to_variable(np.asarray(x)) for x in inputs]
             y = dygraph.to_variable(np.asarray(labels))
-            pred = self.network(*ins)
-            loss = self._loss(pred, y)
-            loss.backward()
+            if self._ddp is not None:
+                pred = self._ddp(*ins)
+                loss = self._loss(pred, y)       # reported unscaled
+                self._ddp.scale_loss(loss).backward()
+                self._ddp.apply_collective_grads()
+            else:
+                pred = self.network(*ins)
+                loss = self._loss(pred, y)
+                loss.backward()
             self._optimizer.minimize(
                 loss, parameter_list=self.network.parameters())
             self.network.clear_gradients()
@@ -194,15 +221,41 @@ class Model:
                         for m in self._metrics)
 
     # -- persistence --------------------------------------------------------
-    def save(self, path: str):
+    def save(self, path: str, training: bool = True):
+        """training=True: full train state — params (.pdparams) AND
+        optimizer accumulators (.pdopt), the reference Model.save
+        contract. training=False: export a deployable inference model
+        via jit.save using the InputSpecs (given to __init__ or
+        inferred from the first fit batch)."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if not training:
+            from .. import jit
+            if self._inputs is None:
+                raise ValueError(
+                    "save(training=False) needs input specs: pass "
+                    "inputs=[InputSpec(...)] to Model() or fit/"
+                    "train_batch once first")
+            with dygraph.guard():
+                jit.save(self.network, path, input_spec=self._inputs)
+            return
         state = {k: (v.numpy() if hasattr(v, "numpy") else np.asarray(v))
                  for k, v in self.network.state_dict().items()}
         with open(path + ".pdparams", "wb") as f:
             pickle.dump(state, f)
+        if self._optimizer is not None and hasattr(self._optimizer,
+                                                   "state_dict"):
+            with open(path + ".pdopt", "wb") as f:
+                pickle.dump(self._optimizer.state_dict(), f)
 
     def load(self, path: str):
+        """Restores params and, when present and an optimizer is
+        prepared, the optimizer accumulators — resuming mid-training
+        continues the exact trajectory."""
         with open(path + ".pdparams", "rb") as f:
             state = pickle.load(f)
         self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if self._optimizer is not None and os.path.exists(opt_path):
+            with open(opt_path, "rb") as f:
+                self._optimizer.set_state_dict(pickle.load(f))
         return self

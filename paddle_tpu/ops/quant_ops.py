@@ -39,8 +39,14 @@ def _quant_infer(op, block):
     x = in_var(op, block, "X")
     set_out(op, block, "Out", x.shape, x.dtype)
     if op.output("OutScale"):
+        # persistable only when OutScale IS the moving-average state
+        # (QAT aliases InScale==OutScale); the PTQ flavor writes a fresh
+        # per-run scale var that must not join the saved persistables
+        aliased = (bool(op.input("InScale"))
+                   and op.single_input("InScale")
+                   == op.single_output("OutScale"))
         set_out(op, block, "OutScale", (1,), "float32",
-                persistable=bool(op.input("InScale")))
+                persistable=aliased)
 
 
 @register_op("fake_quantize_dequantize_abs_max", infer=_quant_infer,

@@ -185,7 +185,9 @@ class Optimizer:
                     out[var.name] = np.asarray(val)
         for pname, accs in self._dy_accumulators.items():
             for aname, val in accs.items():
-                out[f"{pname}.{aname}"] = np.asarray(val)
+                # param names themselves contain dots — an explicit
+                # marker keeps dygraph keys unambiguous on restore
+                out[f"dyacc::{pname}::{aname}"] = np.asarray(val)
         return out
 
     def set_state_dict(self, state):
@@ -195,6 +197,19 @@ class Optimizer:
                 if var.name in state:
                     global_scope().set_var(var.name,
                                            np.asarray(state[var.name]))
+        dy = {}
+        for key, val in state.items():
+            if key.startswith("dyacc::"):
+                _, pname, aname = key.split("::", 2)
+                self._dy_accumulators.setdefault(pname, {})[aname] = \
+                    np.asarray(val)
+                if pname == "state":
+                    dy[int(aname)] = np.asarray(val)
+        if dy:
+            # positional stash consumed by the dygraph engine on its
+            # next (re)build — see optimizer_engine.apply_dygraph_update
+            self._dy_restored_state = [dy[i] for i in sorted(dy)]
+            self._eager_engine_cache = None
 
     set_dict = set_state_dict
 

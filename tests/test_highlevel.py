@@ -293,3 +293,102 @@ def test_amp_namespace_smoke():
         scaled = scaler.scale(loss)
         assert scaled is not None
     assert callable(amp.decorate)
+
+
+def test_hapi_model_full_train_state_resume(tmp_path):
+    """save/load now carries optimizer accumulators (.pdopt): resuming
+    from a checkpoint continues the EXACT Adam trajectory (reference
+    Model.save training=True contract)."""
+    x, y = _toy_data()
+    ds = TensorDataset(x, y)
+
+    def build(seed_net=None):
+        from paddle_tpu import dygraph
+        with dygraph.guard():
+            net = nn.Sequential(nn.Linear(6, 16), nn.Tanh(),
+                                nn.Linear(16, 2))
+        m = pt.Model(net)
+        m.prepare(optimizer.AdamOptimizer(
+            5e-2, parameter_list=net.parameters()),
+            loss=nn.CrossEntropyLoss())
+        return m
+
+    model = build()
+    model.fit(ds, batch_size=16, epochs=5, verbose=0)
+    path = str(tmp_path / "resume_ck")
+    model.save(path)
+    import os
+    assert os.path.exists(path + ".pdopt")  # optimizer state on disk
+    direct = model.fit(ds, batch_size=16, epochs=3, shuffle=False,
+                       verbose=0)["loss"]
+
+    resumed = build()
+    resumed.load(path)
+    replay = resumed.fit(ds, batch_size=16, epochs=3, shuffle=False,
+                         verbose=0)["loss"]
+    np.testing.assert_allclose(replay, direct, rtol=1e-5, atol=1e-6)
+
+
+def test_hapi_model_inference_export(tmp_path):
+    """save(training=False) exports via jit.save using specs inferred
+    from the first fit batch; Predictor + jit.load serve it."""
+    x, y = _toy_data()
+    from paddle_tpu import dygraph
+    with dygraph.guard():
+        net = nn.Sequential(nn.Linear(6, 16), nn.Tanh(),
+                            nn.Linear(16, 2))
+    model = pt.Model(net)
+    model.prepare(optimizer.AdamOptimizer(
+        5e-2, parameter_list=net.parameters()),
+        loss=nn.CrossEntropyLoss())
+    model.fit(TensorDataset(x, y), batch_size=16, epochs=2, verbose=0)
+    assert model._inputs is not None  # specs inferred from fit
+    with dygraph.guard():
+        want = np.asarray(net(dygraph.to_variable(x[:16])).numpy())
+    d = str(tmp_path / "hapi_infer")
+    model.save(d, training=False)
+    with dygraph.guard():
+        got = pt.jit.load(d)(x[:16])
+        np.testing.assert_allclose(np.asarray(got.numpy()), want,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_hapi_distributed_fit_with_resume(tmp_path):
+    """Book MLP under real 2-process DP (launch + DataParallel grad
+    allreduce) with a checkpoint resume mid-run (VERDICT r4 #10)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(os.path.dirname(__file__),
+                          "hapi_dist_worker.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", "2", "--coordinator_port", "23873",
+           script, str(tmp_path)]
+    r = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                       text=True, timeout=280)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    res = {}
+    for rank in (0, 1):
+        p = tmp_path / f"hapi_result.{rank}.json"
+        assert p.exists(), (r.stdout[-2000:], r.stderr[-2000:])
+        res[rank] = json.loads(p.read_text())
+    # training converged under DP
+    for rank in (0, 1):
+        assert res[rank]["last_loss"] < res[rank]["first_loss"] * 0.5
+    # grad allreduce kept both ranks' parameters identical
+    np.testing.assert_allclose(res[0]["param_sum"], res[1]["param_sum"],
+                               rtol=1e-5)
+    np.testing.assert_allclose(res[0]["param_absmax"],
+                               res[1]["param_absmax"], rtol=1e-5)
+    # checkpoint resume replays the direct trajectory on every rank
+    for rank in (0, 1):
+        np.testing.assert_allclose(res[rank]["resume_losses"],
+                                   res[rank]["direct_losses"],
+                                   rtol=1e-4, atol=1e-5)
