@@ -183,21 +183,25 @@ def _kl_threshold(hist: np.ndarray, bin_width: float) -> float:
     the reference distribution, quantize to 128 levels, expand back,
     and pick the i minimizing KL(P||Q). Returns the abs-max scale."""
     nbins = len(hist)
+    href = hist.astype("float64")
+    csum = np.concatenate([[0.0], np.cumsum(href)])      # bin prefix sums
+    cnz = np.concatenate([[0], np.cumsum(href > 0)])     # nonzero counts
     best_i, best_kl = nbins, np.inf
     for i in range(_QUANT_LEVELS, nbins + 1):
-        p = hist[:i].astype("float64").copy()
-        p[i - 1] += hist[i:].sum()          # outliers clipped in
+        p = href[:i].copy()
+        p[i - 1] += href[i:].sum()          # outliers clipped in
         if p.sum() == 0:
             continue
-        # quantize the i bins into 128 levels, then expand
-        chunks = np.array_split(np.arange(i), _QUANT_LEVELS)
-        q = np.zeros(i, "float64")
-        ref = hist[:i].astype("float64")
-        for ch in chunks:
-            total = ref[ch].sum()
-            nz = (ref[ch] > 0).sum()
-            if nz:
-                q[ch] = np.where(ref[ch] > 0, total / nz, 0.0)
+        # quantize the i bins into 128 levels, then expand — chunk sums
+        # and nonzero counts come from the prefix arrays (no per-chunk
+        # python loop: ~2k candidates x 128 chunks was seconds per var)
+        bounds = (np.arange(_QUANT_LEVELS + 1) * i) // _QUANT_LEVELS
+        totals = csum[bounds[1:]] - csum[bounds[:-1]]
+        nz = cnz[bounds[1:]] - cnz[bounds[:-1]]
+        fill = np.where(nz > 0, totals / np.maximum(nz, 1), 0.0)
+        level_of = np.searchsorted(bounds, np.arange(i),
+                                   side="right") - 1
+        q = np.where(href[:i] > 0, fill[level_of], 0.0)
         kl = _kl_divergence(p, q)
         if kl < best_kl:
             best_kl, best_i = kl, i

@@ -94,6 +94,10 @@ def apply_dygraph_update(opt, params_grads: List[Tuple]):
     cache = getattr(opt, "_eager_engine_cache", None)
     if cache is None or cache[0] != sig:
         st = _build(opt, params_grads)
+        # the positional state mirror must not carry entries from a
+        # previous build with a different param set — stale high-index
+        # keys would make a later restore silently skip everything
+        opt._dy_accumulators["state"] = {}
         # resume: set_state_dict stashed accumulators positionally
         # (raw accumulator names are unique-suffixed per build and do
         # NOT survive a rebuild; the structural order does)

@@ -236,7 +236,18 @@ class Model:
                     "inputs=[InputSpec(...)] to Model() or fit/"
                     "train_batch once first")
             with dygraph.guard():
-                jit.save(self.network, path, input_spec=self._inputs)
+                # trace in eval mode: dropout off, BN on running stats —
+                # an exported "inference" model must not bake training
+                # behavior in (the network is often left in train mode
+                # by fit())
+                was_training = getattr(self.network, "training", False)
+                self.network.eval()
+                try:
+                    jit.save(self.network, path,
+                             input_spec=self._inputs)
+                finally:
+                    if was_training:
+                        self.network.train()
             return
         state = {k: (v.numpy() if hasattr(v, "numpy") else np.asarray(v))
                  for k, v in self.network.state_dict().items()}

@@ -219,8 +219,9 @@ def _get_tensor_from_selected_rows(ctx, op):
 def _split_sr_infer(op, block):
     x = in_var(op, block, "X")
     for name in op.output("Out"):
-        v = (block._find_var_recursive(name)
-             or block.create_var(name=name))
+        v = block._find_var_recursive(name)
+        if v is None:
+            v = block.create_var(name=name)
         v.shape, v.dtype = x.shape, x.dtype
 
 
@@ -258,8 +259,9 @@ def _split_selected_rows(ctx, op):
 def _split_ids_infer(op, block):
     x = in_var(op, block, "Ids")
     for name in op.output("Out"):
-        v = (block._find_var_recursive(name)
-             or block.create_var(name=name))
+        v = block._find_var_recursive(name)
+        if v is None:
+            v = block.create_var(name=name)
         v.shape, v.dtype = x.shape, x.dtype
 
 
@@ -322,8 +324,9 @@ def _select_input(ctx, op):
 def _select_output_infer(op, block):
     x = in_var(op, block, "X")
     for name in op.output("Out"):
-        v = (block._find_var_recursive(name)
-             or block.create_var(name=name))
+        v = block._find_var_recursive(name)
+        if v is None:
+            v = block.create_var(name=name)
         v.shape, v.dtype = x.shape, x.dtype
 
 

@@ -79,8 +79,9 @@ def _dgc_op_infer(op, block):
         xn = op.single_input(slot_in)
         for on in op.output(slot_out):
             xv = block.var(xn)
-            ov = (block._find_var_recursive(on)
-                  or block.create_var(name=on))
+            ov = block._find_var_recursive(on)
+            if ov is None:
+                ov = block.create_var(name=on)
             ov.shape, ov.dtype = xv.shape, xv.dtype
 
 

@@ -133,8 +133,9 @@ def _py_func_infer(op, block):
         xs = op.input("X")
         for name, i, dt in zip(op.output("Out"), mirror, dtypes):
             src = block._find_var_recursive(xs[i])
-            v = (block._find_var_recursive(name)
-                 or block.create_var(name=name))
+            v = block._find_var_recursive(name)
+            if v is None:
+                v = block.create_var(name=name)
             v.shape, v.dtype = tuple(src.shape), dt
     # else: the layer front-end pre-declared the out vars with shapes
 
@@ -214,8 +215,9 @@ def _dlt_infer(op, block):
     w = block.var(op.input("W")[0])
     for name, src in zip(op.output("Outputs"), op.input("Ids")):
         ids = block.var(src)
-        v = (block._find_var_recursive(name)
-             or block.create_var(name=name))
+        v = block._find_var_recursive(name)
+        if v is None:
+            v = block.create_var(name=name)
         v.shape = tuple(ids.shape[:-1]) + (w.shape[-1],)
         v.dtype = w.dtype
 

@@ -569,3 +569,23 @@ def _max(ctx, op):
 @register_op("min", infer=_reduce_infer)
 def _min(ctx, op):
     _REGISTRY["reduce_min"].lower(ctx, op)
+
+
+def _global_norm_sq_infer(op, block):
+    set_out(op, block, "Out", (), "float32")
+
+
+@register_op("global_norm_sq", infer=_global_norm_sq_infer)
+def _global_norm_sq(ctx, op):
+    """sum_i ||x_i||^2 over ALL inputs in one concat+vdot fusion.
+
+    Opt-in alternative (clip.py PT_FUSED_GLOBAL_CLIP=1) to the per-grad
+    square+reduce chain — measured SLOWER on v5e BERT-base (the concat
+    materializes the full gradient set), kept for param-count-heavy
+    models where launch overhead dominates."""
+    jnp = _jnp()
+    from ..framework.selected_rows import densify
+    xs = [densify(x) for x in ctx.get_inputs(op, "X")]
+    flat = jnp.concatenate(
+        [x.astype("float32").reshape(-1) for x in xs])
+    ctx.set_output(op, "Out", jnp.vdot(flat, flat))

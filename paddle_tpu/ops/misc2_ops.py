@@ -223,8 +223,8 @@ def _where_index(ctx, op):
 # ---------------------------------------------------------------------------
 def _coalesce_infer(op, block):
     def out_var(name):
-        return (block._find_var_recursive(name)
-                or block.create_var(name=name))
+        v = block._find_var_recursive(name)
+        return v if v is not None else block.create_var(name=name)
 
     total = 0
     for name, src in zip(op.output("Output"), op.input("Input")):

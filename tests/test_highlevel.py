@@ -392,3 +392,31 @@ def test_hapi_distributed_fit_with_resume(tmp_path):
         np.testing.assert_allclose(res[rank]["resume_losses"],
                                    res[rank]["direct_losses"],
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_hapi_inference_export_is_deterministic_with_dropout(tmp_path):
+    """save(training=False) must trace in eval mode: a net with dropout
+    exported right after fit() (which leaves the net in train mode)
+    has to serve deterministic outputs."""
+    x, y = _toy_data()
+    from paddle_tpu import dygraph
+    with dygraph.guard():
+        net = nn.Sequential(nn.Linear(6, 16), nn.Dropout(0.5),
+                            nn.Linear(16, 2))
+    model = pt.Model(net)
+    model.prepare(optimizer.AdamOptimizer(
+        5e-2, parameter_list=net.parameters()),
+        loss=nn.CrossEntropyLoss())
+    model.fit(TensorDataset(x, y), batch_size=16, epochs=1, verbose=0)
+    d = str(tmp_path / "dropout_infer")
+    model.save(d, training=False)
+    assert getattr(net, "training", False)  # fit's train mode restored
+    with dygraph.guard():
+        loaded = pt.jit.load(d)
+        o1 = np.asarray(loaded(x[:8]).numpy())
+        o2 = np.asarray(loaded(x[:8]).numpy())
+    np.testing.assert_array_equal(o1, o2)  # no live dropout
+    with dygraph.guard():
+        net.eval()
+        want = np.asarray(net(dygraph.to_variable(x[:8])).numpy())
+    np.testing.assert_allclose(o1, want, rtol=1e-5, atol=1e-6)
