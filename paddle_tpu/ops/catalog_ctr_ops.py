@@ -497,3 +497,72 @@ def _chunk_eval(ctx, op):
                     ("NumCorrectChunks", tp)):
         if op.output(slot):
             ctx.set_output(op, slot, v.astype("int64").reshape(1))
+
+
+# ---------------------------------------------------------------------------
+# attention_lstm (reference attention_lstm_op.cc:350 compute loop)
+# ---------------------------------------------------------------------------
+def _attn_lstm_infer(op, block):
+    x = in_var(op, block, "X")              # [B, T, M]
+    D = in_var(op, block, "C0").shape[-1]
+    set_out(op, block, "Hidden", (x.shape[0], x.shape[1], D), x.dtype)
+    set_out(op, block, "Cell", (x.shape[0], x.shape[1], D), x.dtype)
+
+
+@register_op("attention_lstm", infer=_attn_lstm_infer)
+def _attention_lstm(ctx, op):
+    """Fused attention-LSTM. Per step: attention logits over the row's
+    positions = relu(X@aw[:M] + ab + dot(c_prev, aw[M:])), optional
+    scalar relu(s*logit + sb), masked softmax, context = probs @ X;
+    LSTM gates = [h_prev, ctx] @ lstm_w + lstm_b with layout
+    [forget, input, output, candidate] (attention_lstm_op.cc:405
+    "concat[forget, input, output, tilde]"; lstm_w rows = hidden part
+    then x part). Padded [B,T,M] + Lengths replaces the LoD walk."""
+    import jax
+    jnp = _jnp()
+    x = ctx.get_input(op, "X").astype("float32")
+    c0 = ctx.get_input(op, "C0").astype("float32")
+    lengths = ctx.get_input(op, "Lengths")
+    aw = ctx.get_input(op, "AttentionWeight").astype("float32")
+    ab = (ctx.get_input(op, "AttentionBias").astype("float32")
+          if op.input("AttentionBias") else 0.0)
+    a_s = (ctx.get_input(op, "AttentionScalar").astype("float32")
+           if op.input("AttentionScalar") else None)
+    a_sb = (ctx.get_input(op, "AttentionScalarBias").astype("float32")
+            if op.input("AttentionScalarBias") else 0.0)
+    lw = ctx.get_input(op, "LSTMWeight").astype("float32")
+    lb = ctx.get_input(op, "LSTMBias").astype("float32").reshape(-1)
+    B, T, M = x.shape
+    D = c0.shape[-1]
+    h0 = (ctx.get_input(op, "H0").astype("float32")
+          if op.input("H0") else jnp.zeros((B, D), "float32"))
+
+    atted = jnp.einsum("btm,m->bt", x, aw[:M, 0]) + jnp.reshape(ab, ())
+    alive = jnp.arange(T)[None, :] < lengths[:, None]
+    NEG = -3.0e38
+
+    def step(carry, t):
+        h, c = carry
+        logit = jnp.maximum(atted + (c @ aw[M:, 0])[:, None], 0.0)
+        if a_s is not None:
+            logit = jnp.maximum(
+                jnp.reshape(a_s, ()) * logit + jnp.reshape(a_sb, ()),
+                0.0)
+        probs = jax.nn.softmax(jnp.where(alive, logit, NEG), axis=1)
+        ctx_vec = jnp.einsum("bt,btm->bm", probs, x)
+        gates = h @ lw[:D] + ctx_vec @ lw[D:] + lb
+        f = jax.nn.sigmoid(gates[:, :D])
+        i = jax.nn.sigmoid(gates[:, D:2 * D])
+        o = jax.nn.sigmoid(gates[:, 2 * D:3 * D])
+        cand = jnp.tanh(gates[:, 3 * D:])
+        c_new = f * c + i * cand
+        h_new = jnp.tanh(c_new) * o
+        live = alive[:, t][:, None].astype("float32")
+        h_c = live * h_new + (1 - live) * h
+        c_c = live * c_new + (1 - live) * c
+        return (h_c, c_c), (live * h_new, live * c_new)
+
+    _, (hs, cs) = jax.lax.scan(step, (h0, c0), jnp.arange(T))
+    dt = ctx.get_input(op, "X").dtype
+    ctx.set_output(op, "Hidden", jnp.swapaxes(hs, 0, 1).astype(dt))
+    ctx.set_output(op, "Cell", jnp.swapaxes(cs, 0, 1).astype(dt))

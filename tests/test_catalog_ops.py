@@ -546,3 +546,48 @@ def test_merge_selected_rows_dense_passthrough():
     out = _run_program("get_tensor_from_selected_rows", {"X": x},
                        {"Out": 1}, {})
     np.testing.assert_allclose(out["o_Out_0"], x)
+
+
+def test_attention_lstm():
+    """Loop reference of attention_lstm_op.cc:350 (padded form)."""
+    B, T, M, D = 2, 4, 3, 2
+    r = R(50)
+    x = r.randn(B, T, M).astype("float32") * 0.5
+    c0 = r.randn(B, D).astype("float32") * 0.3
+    h0 = r.randn(B, D).astype("float32") * 0.3
+    aw = r.randn(M + D, 1).astype("float32") * 0.5
+    ab = r.randn(1).astype("float32") * 0.1
+    lw = r.randn(D + M, 4 * D).astype("float32") * 0.4
+    lb = r.randn(4 * D).astype("float32") * 0.1
+    lens = np.array([4, 2], "int64")
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    hs = np.zeros((B, T, D), "float32")
+    cs = np.zeros((B, T, D), "float32")
+    for b in range(B):
+        h, c = h0[b].copy(), c0[b].copy()
+        L = int(lens[b])
+        atted = x[b, :L] @ aw[:M, 0] + ab[0]
+        for t in range(L):
+            logit = np.maximum(atted + c @ aw[M:, 0], 0.0)
+            e = np.exp(logit - logit.max())
+            probs = e / e.sum()
+            ctx_vec = probs @ x[b, :L]
+            gates = h @ lw[:D] + ctx_vec @ lw[D:] + lb
+            f, i, o = (sig(gates[:D]), sig(gates[D:2*D]),
+                       sig(gates[2*D:3*D]))
+            cand = np.tanh(gates[3*D:])
+            c = f * c + i * cand
+            h = np.tanh(c) * o
+            hs[b, t], cs[b, t] = h, c
+    run_case(OpCase(
+        "attention_lstm",
+        {"X": x, "C0": c0, "H0": h0, "AttentionWeight": aw,
+         "AttentionBias": ab, "LSTMWeight": lw, "LSTMBias": lb,
+         "Lengths": lens},
+        outputs={"Hidden": 1, "Cell": 1},
+        ref=lambda **kw: {"Hidden": hs, "Cell": cs},
+        grad=["X", "LSTMWeight", "AttentionWeight"],
+        rtol=1e-4, atol=1e-5))
