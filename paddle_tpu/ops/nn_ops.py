@@ -458,23 +458,53 @@ def _conv2d_transpose_infer(op, block):
     set_out(op, block, "Output", out, x.dtype)
 
 
+# depthwise flavor shares the lowering: groups come from the attr
+# (reference conv_transpose_op.cc registers both names over one kernel)
+@register_op("depthwise_conv2d_transpose",
+             infer=_conv2d_transpose_infer)
 @register_op("conv2d_transpose", infer=_conv2d_transpose_infer)
 def _conv2d_transpose_lower(ctx, op):
+    """Gradient-of-conv formulation (same as conv3d_transpose): dilate
+    the input by the stride, flip the kernel, pad with k_eff-1-p per
+    side. Round-5 fix: the previous lax.conv_transpose call passed the
+    FORWARD pads as literal pads on the dilated input, which silently
+    shrank outputs for stride>1 or p != (k-1)/2 (stride-1 SAME-style
+    configs happened to coincide, which is why it survived). Groups
+    (incl. depthwise_conv2d_transpose) via feature_group_count."""
     lax = _lax()
     jnp = _jnp()
     x = ctx.get_input(op, "Input")
-    w = ctx.get_input(op, "Filter")  # IOHW
+    w = ctx.get_input(op, "Filter")  # IOHW [Cin, Cout/g, kh, kw]
     strides = tuple(op.attr("strides", [1, 1]))
     dils = tuple(op.attr("dilations", [1, 1]))
     fmt = op.attr("data_format", "NCHW")
-    io = ("NCHW", "IOHW", "NCHW") if fmt == "NCHW" else ("NHWC", "IOHW", "NHWC")
-    spatial = jnp.shape(x)[2:] if fmt == "NCHW" else jnp.shape(x)[1:3]
-    pads = _resolve_padding(op, list(spatial),
-                            [jnp.shape(w)[2], jnp.shape(w)[3]], strides, dils)
-    dn = lax.conv_dimension_numbers(jnp.shape(x), jnp.shape(w), io)
-    out = lax.conv_transpose(x, w, strides=strides, padding=pads,
-                             rhs_dilation=dils, dimension_numbers=dn,
-                             transpose_kernel=True)
+    g = int(op.attr("groups", 1))
+    ch_axis = 1 if fmt == "NCHW" else 3
+    spatial = (jnp.shape(x)[2:] if fmt == "NCHW"
+               else jnp.shape(x)[1:3])
+    kh, kw = jnp.shape(w)[2], jnp.shape(w)[3]
+    pads_f = _resolve_padding(op, list(spatial), [kh, kw], strides,
+                              dils)
+    ke = [(k - 1) * d + 1 for k, d in zip((kh, kw), dils)]
+    default_out = [
+        (spatial[i] - 1) * strides[i] - pads_f[i][0] - pads_f[i][1]
+        + ke[i] for i in range(2)]
+    out_size = op.attr("output_size", []) or default_out
+    pads = [(ke[i] - 1 - pads_f[i][0],
+             ke[i] - 1 - pads_f[i][1]
+             + int(out_size[i]) - default_out[i]) for i in range(2)]
+    cin = jnp.shape(x)[ch_axis]
+    wt = jnp.flip(w, axis=(2, 3))
+    # IOHW -> OIHW with group-major output channels (paddle layout)
+    wt = wt.reshape(g, cin // g, -1, kh, kw)
+    wt = wt.transpose(0, 2, 1, 3, 4).reshape(-1, cin // g, kh, kw)
+    dn = (("NCHW", "OIHW", "NCHW") if fmt == "NCHW"
+          else ("NHWC", "OIHW", "NHWC"))
+    out = lax.conv_general_dilated(
+        x, wt.astype(x.dtype), window_strides=(1, 1), padding=pads,
+        lhs_dilation=strides, rhs_dilation=dils,
+        dimension_numbers=dn, feature_group_count=g,
+        precision=_conv_precision(x.dtype))
     ctx.set_output(op, "Output", out.astype(x.dtype))
 
 

@@ -591,3 +591,61 @@ def test_attention_lstm():
         ref=lambda **kw: {"Hidden": hs, "Cell": cs},
         grad=["X", "LSTMWeight", "AttentionWeight"],
         rtol=1e-4, atol=1e-5))
+
+
+def test_depthwise_conv2d_transpose():
+    """Grouped transpose conv (groups == channels) vs a per-channel
+    numpy scatter reference."""
+    C, H, W, K, S = 3, 4, 4, 3, 2
+    x = R(60).randn(1, C, H, W).astype("float32")
+    w = R(61).randn(C, 1, K, K).astype("float32")  # IOHW, out/groups=1
+    OH = (H - 1) * S + K
+    OW = (W - 1) * S + K
+    ref = np.zeros((1, C, OH, OW), "float32")
+    for c in range(C):
+        for i in range(H):
+            for j in range(W):
+                ref[0, c, i*S:i*S+K, j*S:j*S+K] += x[0, c, i, j] * w[c, 0]
+    run_case(OpCase(
+        "depthwise_conv2d_transpose", {"Input": x, "Filter": w},
+        outputs={"Output": 1},
+        attrs={"strides": [S, S], "paddings": [0, 0], "groups": C},
+        ref=lambda **kw: ref, grad=["Input", "Filter"],
+        rtol=1e-4, atol=1e-5))
+
+
+def test_conv2d_transpose_stride2_shape_and_values():
+    """Round-5 regression: stride-2 transpose conv with explicit pad 0
+    must produce the (H-1)*s+k output the infer promises (the old
+    lowering passed forward pads literally and shrank it)."""
+    H, K, S = 4, 3, 2
+    x = R(62).randn(1, 2, H, H).astype("float32")
+    w = R(63).randn(2, 3, K, K).astype("float32")
+    OH = (H - 1) * S + K
+    ref = np.zeros((1, 3, OH, OH), "float32")
+    for ci in range(2):
+        for co in range(3):
+            for i in range(H):
+                for j in range(H):
+                    ref[0, co, i*S:i*S+K, j*S:j*S+K] += \
+                        x[0, ci, i, j] * w[ci, co]
+    run_case(OpCase(
+        "conv2d_transpose", {"Input": x, "Filter": w},
+        outputs={"Output": 1},
+        attrs={"strides": [S, S], "paddings": [0, 0], "groups": 1},
+        ref=lambda **kw: ref, grad=["Input", "Filter"],
+        rtol=1e-4, atol=1e-4))
+
+
+def test_conv2d_transpose_output_size_attr():
+    """output_size extends the default with stride slack padding."""
+    H, K, S = 3, 3, 2
+    x = np.ones((1, 1, H, H), "float32")
+    w = np.ones((1, 1, K, K), "float32")
+    out = _run_program(
+        "conv2d_transpose", {"Input": x, "Filter": w}, {"Output": 1},
+        {"strides": [S, S], "paddings": [0, 0], "groups": 1,
+         "output_size": [8, 8]})["o_Output_0"]
+    assert out.shape == (1, 1, 8, 8)
+    # the extra row/col is pure zero padding at the high end
+    assert np.all(out[0, 0, 7, :] == 0) and np.all(out[0, 0, :, 7] == 0)

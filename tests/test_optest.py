@@ -1030,7 +1030,8 @@ SKIP = {
         "split_ids", "merge_ids", "select_input", "select_output",
         "batch_fc", "rank_attention", "tree_conv", "var_conv_2d",
         "pyramid_hash", "filter_by_instag", "prroi_pool",
-        "correlation", "chunk_eval", "attention_lstm", "quantize",
+        "correlation", "chunk_eval", "attention_lstm",
+        "depthwise_conv2d_transpose", "quantize",
         "dequantize",
         "requantize", "proximal_adagrad", "dgc", "dgc_clip_by_norm",
         "multihead_matmul", "skip_layernorm",
