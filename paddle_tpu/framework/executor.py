@@ -400,8 +400,25 @@ class Executor:
                 val = env.get(name)
                 if is_selected_rows(val):
                     val = val.values
-                if val is None or not jnp.issubdtype(
-                        jnp.asarray(val).dtype, jnp.floating):
+                if val is None:
+                    continue
+                # infer-vs-runtime shape drift check (round-5: a
+                # conv2d_transpose stride bug shipped because infer
+                # promised one shape and the lowering produced another
+                # — the jit path only sees the lowered value)
+                v = block._find_var_recursive(name)
+                decl = getattr(v, "shape", None) if v is not None \
+                    else None
+                run_shape = tuple(jnp.shape(val))
+                if (decl is not None and len(decl) == len(run_shape)
+                        and all(int(d) >= 0 for d in decl)
+                        and tuple(int(d) for d in decl) != run_shape):
+                    raise RuntimeError(
+                        f"shape-inference drift: op {op.type!r} output "
+                        f"{name!r} declared {tuple(decl)} but lowered "
+                        f"to {run_shape} (op index {op.idx})")
+                if not jnp.issubdtype(jnp.asarray(val).dtype,
+                                      jnp.floating):
                     continue
                 if not bool(jnp.isfinite(val).all()):
                     raise FloatingPointError(

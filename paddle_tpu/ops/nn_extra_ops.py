@@ -91,16 +91,18 @@ def _conv3d_transpose(ctx, op):
     s = _triple(op.attr("strides", [1, 1, 1]))
     p = _triple(op.attr("paddings", [0, 0, 0]))
     k = w.shape[2:]
+    g = int(op.attr("groups", 1))
     pads = [(k[i] - 1 - p[i], k[i] - 1 - p[i]) for i in range(3)]
-    if op.attr("groups", 1) != 1:
-        raise NotImplementedError(
-            "conv3d_transpose: groups > 1 not supported (the flipped "
-            "[O, C, ...] kernel layout is incompatible with "
-            "feature_group_count)")
-    wt = jnp.flip(w, axis=(2, 3, 4)).swapaxes(0, 1)   # [O, C, ...]
+    cin = w.shape[0]
+    # IODHW -> OIDHW with group-major output channels, flipped spatial
+    # (same formulation as the round-5 conv2d_transpose fix)
+    wt = jnp.flip(w, axis=(2, 3, 4))
+    wt = wt.reshape(g, cin // g, -1, *k)
+    wt = wt.transpose(0, 2, 1, 3, 4, 5).reshape(-1, cin // g, *k)
     out = lax.conv_general_dilated(
         x, wt, window_strides=(1, 1, 1), padding=pads, lhs_dilation=s,
-        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=g)
     ctx.set_output(op, "Output", out)
 
 

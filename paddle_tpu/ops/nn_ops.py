@@ -850,11 +850,18 @@ def _instance_norm(ctx, op):
                    (1.0 / jnp.sqrt(var + eps)).reshape(-1))
 
 
-@register_op("norm", infer=lambda op, block: (
-    set_out(op, block, "Out", in_var(op, block, "X").shape,
-            in_var(op, block, "X").dtype),
-    set_out(op, block, "Norm", in_var(op, block, "X").shape,
-            in_var(op, block, "X").dtype)))
+def _norm_infer(op, block):
+    x = in_var(op, block, "X")
+    set_out(op, block, "Out", x.shape, x.dtype)
+    # Norm keeps a size-1 reduced axis (reference norm_op.cc InferShape:
+    # xdim[axis] = 1) — caught by the round-5 infer-vs-runtime gate
+    axis = op.attrs.get("axis", 1) % len(x.shape)
+    nshape = list(x.shape)
+    nshape[axis] = 1
+    set_out(op, block, "Norm", nshape, x.dtype)
+
+
+@register_op("norm", infer=_norm_infer)
 def _l2norm(ctx, op):
     jnp = _jnp()
     x = ctx.get_input(op, "X")

@@ -120,6 +120,20 @@ def check_forward(case: OpCase):
     scope = pt.Scope()
     exe.run(startup, scope=scope)
     got = exe.run(main, feed=feed, fetch_list=out_names, scope=scope)
+    # infer-vs-runtime drift gate (round-5: a conv2d_transpose stride
+    # bug hid because only value equality was checked and the test
+    # configs happened to coincide): every fully-static declared shape
+    # must match what the lowering actually produced.
+    block = main.global_block()
+    for name, val in zip(out_names, got):
+        v = block._find_var_recursive(name)
+        decl = getattr(v, "shape", None) if v is not None else None
+        run_shape = tuple(np.shape(np.asarray(val)))
+        if (decl is not None and len(decl) == len(run_shape)
+                and all(int(d) >= 0 for d in decl)):
+            assert tuple(int(d) for d in decl) == run_shape, (
+                f"{case.op_type}: output {name!r} infer declared "
+                f"{tuple(decl)} but the lowering produced {run_shape}")
     if case.ref is None:
         return got
     kwargs = {}

@@ -649,3 +649,25 @@ def test_conv2d_transpose_output_size_attr():
     assert out.shape == (1, 1, 8, 8)
     # the extra row/col is pure zero padding at the high end
     assert np.all(out[0, 0, 7, :] == 0) and np.all(out[0, 0, :, 7] == 0)
+
+
+def test_conv3d_transpose_grouped():
+    """Grouped 3-D transpose conv (previously NotImplementedError) vs a
+    scatter-loop reference."""
+    C, Dp, K, S = 2, 3, 2, 2
+    x = R(66).randn(1, C, Dp, Dp, Dp).astype("float32")
+    w = R(67).randn(C, 1, K, K, K).astype("float32")  # groups=C
+    OD = (Dp - 1) * S + K
+    ref = np.zeros((1, C, OD, OD, OD), "float32")
+    for c in range(C):
+        for a in range(Dp):
+            for b in range(Dp):
+                for d in range(Dp):
+                    ref[0, c, a*S:a*S+K, b*S:b*S+K, d*S:d*S+K] += \
+                        x[0, c, a, b, d] * w[c, 0]
+    run_case(OpCase(
+        "conv3d_transpose", {"Input": x, "Filter": w},
+        outputs={"Output": 1},
+        attrs={"strides": [S]*3, "paddings": [0]*3, "groups": C},
+        ref=lambda **kw: ref, grad=["Input", "Filter"],
+        rtol=1e-4, atol=1e-4))

@@ -91,3 +91,42 @@ def test_random_ops_deterministic_per_program_seed():
     # different steps fold different counters -> different draws
     assert not np.allclose(r1, r2)
     assert r1.min() >= 0.0 and r1.max() <= 1.0
+
+
+def test_debug_mode_catches_shape_inference_drift():
+    """FLAGS_check_nan_inf debug path also validates infer-vs-runtime
+    shapes (round-5 hardening after the conv2d_transpose stride bug)."""
+    import pytest
+    from paddle_tpu.ops.registry import register_op, set_out, _REGISTRY
+
+    @register_op("__drifty_op__", infer=lambda op, block: set_out(
+        op, block, "Out", (3, 3), "float32"))
+    def _drifty(ctx, op):
+        import jax.numpy as jnp
+        ctx.set_output(op, "Out",
+                       jnp.zeros((2, 2), "float32")
+                       + ctx.get_input(op, "X").sum())
+
+    try:
+        main, startup = pt.Program(), pt.Program()
+        startup._is_startup = True
+        with pt.program_guard(main, startup):
+            block = main.global_block()
+            block.create_var(name="dx", shape=[2, 2], dtype="float32",
+                             is_data=True)
+            block.create_var(name="dout", shape=[3, 3],
+                             dtype="float32")
+            block.append_op("__drifty_op__", inputs={"X": ["dx"]},
+                            outputs={"Out": ["dout"]}, attrs={})
+        exe = pt.Executor()
+        scope = pt.Scope()
+        exe.run(startup, scope=scope)
+        pt.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            with pytest.raises(Exception, match="shape-inference drift"):
+                exe.run(main, feed={"dx": np.ones((2, 2), "float32")},
+                        fetch_list=["dout"], scope=scope)
+        finally:
+            pt.set_flags({"FLAGS_check_nan_inf": False})
+    finally:
+        _REGISTRY.pop("__drifty_op__", None)
