@@ -566,3 +566,68 @@ def _attention_lstm(ctx, op):
     dt = ctx.get_input(op, "X").dtype
     ctx.set_output(op, "Hidden", jnp.swapaxes(hs, 0, 1).astype(dt))
     ctx.set_output(op, "Cell", jnp.swapaxes(cs, 0, 1).astype(dt))
+
+
+# ---------------------------------------------------------------------------
+# bilateral_slice (HDRNet; reference bilateral_slice_op.cu:60)
+# ---------------------------------------------------------------------------
+def _bilateral_infer(op, block):
+    x = in_var(op, block, "X")              # [B, Cin, H, W]
+    grid = in_var(op, block, "Grid")        # [B, Cg, D, Hg, Wg]
+    cs = x.shape[1] + (1 if op.attr("has_offset", False) else 0)
+    set_out(op, block, "Out",
+            (x.shape[0], grid.shape[1] // cs, x.shape[2], x.shape[3]),
+            x.dtype)
+
+
+@register_op("bilateral_slice", infer=_bilateral_infer)
+def _bilateral_slice(ctx, op):
+    """Slice the bilateral grid at (x, y, guide) with tent weights and
+    apply the sampled per-pixel affine coeffs (reference
+    bilateral_slice_op.cu:60-121; z weight uses the smoothed |.| with
+    eps=1e-8 exactly as WeightZ). The 2x2x2 corner walk is a static
+    8-term python loop of fused gathers."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X").astype("float32")
+    grid = ctx.get_input(op, "Grid").astype("float32")
+    guide = ctx.get_input(op, "Guide").astype("float32")
+    has_offset = bool(op.attr("has_offset", False))
+    B, Cin, H, W = x.shape
+    _, Cg, D, Hg, Wg = grid.shape
+    cs = Cin + (1 if has_offset else 0)
+    Cout = Cg // cs
+
+    gx = (jnp.arange(W) + 0.5) * Wg / W                  # [W]
+    gy = (jnp.arange(H) + 0.5) * Hg / H                  # [H]
+    gz = guide.reshape(B, H, W) * D                      # [B, H, W]
+    fx = jnp.floor(gx - 0.5).astype("int32")
+    fy = jnp.floor(gy - 0.5).astype("int32")
+    fz = jnp.floor(gz - 0.5).astype("int32")
+
+    coeff = jnp.zeros((B, Cg, H, W), "float32")
+    for dx in range(2):
+        xx = fx + dx
+        x_ = jnp.clip(xx, 0, Wg - 1)
+        wx = jnp.maximum(1.0 - jnp.abs(xx + 0.5 - gx), 0.0)   # [W]
+        for dy in range(2):
+            yy = fy + dy
+            y_ = jnp.clip(yy, 0, Hg - 1)
+            wy = jnp.maximum(1.0 - jnp.abs(yy + 0.5 - gy), 0.0)
+            for dz in range(2):
+                zz = fz + dz
+                z_ = jnp.clip(zz, 0, D - 1)                   # [B,H,W]
+                diff = zz + 0.5 - gz
+                wz = jnp.maximum(
+                    1.0 - jnp.sqrt(diff * diff + 1e-8), 0.0)
+                # gather grid[b, :, z_, y_, x_] -> [B, Cg, H, W]
+                g = grid[jnp.arange(B)[:, None, None], :,
+                         z_, y_[None, :, None], x_[None, None, :]]
+                g = jnp.moveaxis(g, -1, 1)
+                w8 = (wz * wy[None, :, None]
+                      * wx[None, None, :])[:, None]
+                coeff = coeff + g * w8
+    coeff = coeff.reshape(B, Cout, cs, H, W)
+    out = jnp.einsum("bochw,bchw->bohw", coeff[:, :, :Cin], x)
+    if has_offset:
+        out = out + coeff[:, :, Cin]
+    ctx.set_output(op, "Out", out.astype(ctx.get_input(op, "X").dtype))

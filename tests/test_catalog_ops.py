@@ -671,3 +671,50 @@ def test_conv3d_transpose_grouped():
         attrs={"strides": [S]*3, "paddings": [0]*3, "groups": C},
         ref=lambda **kw: ref, grad=["Input", "Filter"],
         rtol=1e-4, atol=1e-4))
+
+
+def test_bilateral_slice():
+    """Loop reference of bilateral_slice_op.cu:60 (with offset)."""
+    B, Cin, H, W = 1, 2, 4, 4
+    D, Hg, Wg, Cout = 3, 2, 2, 2
+    cs = Cin + 1
+    x = R(70).rand(B, Cin, H, W).astype("float32")
+    grid = R(71).randn(B, Cout * cs, D, Hg, Wg).astype("float32")
+    guide = R(72).rand(B, H, W).astype("float32")
+    ref = np.zeros((B, Cout, H, W), "float32")
+    for b in range(B):
+        for oc in range(Cout):
+            for yp in range(H):
+                for xp in range(W):
+                    gx = (xp + 0.5) * Wg / W
+                    gy = (yp + 0.5) * Hg / H
+                    gz = guide[b, yp, xp] * D
+                    fx = int(np.floor(gx - 0.5))
+                    fy = int(np.floor(gy - 0.5))
+                    fz = int(np.floor(gz - 0.5))
+                    val = 0.0
+                    for ic in range(cs):
+                        cf = 0.0
+                        for xx in range(fx, fx + 2):
+                            x_ = min(max(xx, 0), Wg - 1)
+                            wx = max(1 - abs(xx + 0.5 - gx), 0)
+                            for yy in range(fy, fy + 2):
+                                y_ = min(max(yy, 0), Hg - 1)
+                                wy = max(1 - abs(yy + 0.5 - gy), 0)
+                                for zz in range(fz, fz + 2):
+                                    z_ = min(max(zz, 0), D - 1)
+                                    dfz = zz + 0.5 - gz
+                                    wz = max(1 - np.sqrt(
+                                        dfz*dfz + 1e-8), 0)
+                                    cf += grid[b, cs*oc+ic, z_, y_,
+                                               x_] * wx * wy * wz
+                        if ic < Cin:
+                            val += cf * x[b, ic, yp, xp]
+                        else:
+                            val += cf
+                    ref[b, oc, yp, xp] = val
+    run_case(OpCase(
+        "bilateral_slice", {"X": x, "Grid": grid, "Guide": guide},
+        attrs={"has_offset": True},
+        ref=lambda **kw: ref, grad=["X", "Grid"],
+        rtol=1e-4, atol=1e-5))

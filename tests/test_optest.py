@@ -1030,7 +1030,7 @@ SKIP = {
         "split_ids", "merge_ids", "select_input", "select_output",
         "batch_fc", "rank_attention", "tree_conv", "var_conv_2d",
         "pyramid_hash", "filter_by_instag", "prroi_pool",
-        "correlation", "chunk_eval", "attention_lstm",
+        "correlation", "chunk_eval", "attention_lstm", "bilateral_slice",
         "depthwise_conv2d_transpose", "quantize",
         "dequantize",
         "requantize", "proximal_adagrad", "dgc", "dgc_clip_by_norm",
