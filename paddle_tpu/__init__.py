@@ -37,6 +37,8 @@ from .reader import DataLoader, DataFeeder, batch  # noqa
 from . import inference  # noqa
 from . import profiler  # noqa
 from .flags import get_flags, set_flags  # noqa
+from . import fault  # noqa  (deterministic fault injection)
+from .train_guard import TrainGuard, TrainingInterrupted  # noqa
 from . import memory  # noqa
 from . import tensor  # noqa  (paddle.tensor 2.0 namespace)
 from . import monitor  # noqa  (StatRegistry + graphviz dumps)

@@ -1,17 +1,54 @@
-"""Sharded / async checkpointing (orbax-backed).
+"""Crash-safe sharded / async checkpointing (orbax-backed).
 
 Reference analog: auto-checkpoint + save_persistables (SURVEY.md §5
 checkpoint/resume). On TPU the state is a pytree of (possibly sharded)
 jax.Arrays; orbax writes each shard from its owning host and restores
 with the target sharding — the reference's per-var save ops can't express
 that.
+
+Write protocol (crash-safe)::
+
+    <dir>/.tmp-<step>-<pid>-<attempt>/   serialize payload here
+        orbax/... | state.pkl
+        MANIFEST.json                    per-file size + sha256, written last
+    os.replace(tmp, <dir>/<step>)        atomic publish
+
+A crash at any point leaves either an orphaned ``.tmp-*`` (reaped by
+:func:`gc_checkpoints`) or a fully published checkpoint.  Readers verify
+the manifest (existence + size + checksum) and fall back to the newest
+*valid* checkpoint instead of dying on — or half-restoring from — a torn
+one.  Transient write errors retry with exponential backoff
+(``FLAGS_checkpoint_retries`` / ``FLAGS_checkpoint_retry_backoff_s``).
+
+Observability (monitor stats): ``checkpoint_writes``,
+``checkpoint_retries``, ``checkpoint_fallback`` (orbax → pickle),
+``checkpoint_corrupt_skipped``, ``checkpoint_resumes``,
+``checkpoints_gc``, ``checkpoint_tmp_cleaned``.
 """
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
 import os
-from typing import Dict, Optional
+import shutil
+import time
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from . import fault
+from .flags import flag_value
+from .monitor import stat_add
+
+logger = logging.getLogger("paddle_tpu.checkpoint")
+
+MANIFEST = "MANIFEST.json"
+_TMP_PREFIX = ".tmp-"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed manifest/checksum validation or deserialization."""
 
 
 def _persistable_state(program, scope) -> Dict[str, object]:
@@ -24,10 +61,87 @@ def _persistable_state(program, scope) -> Dict[str, object]:
     return state
 
 
+# ---------------------------------------------------------------------------
+# manifest
+# ---------------------------------------------------------------------------
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_manifest(ckpt_dir: str, step: int, fmt: str) -> dict:
+    files = {}
+    for root, _dirs, names in os.walk(ckpt_dir):
+        for name in names:
+            if name == MANIFEST:
+                continue
+            p = os.path.join(root, name)
+            files[os.path.relpath(p, ckpt_dir)] = {
+                "bytes": os.path.getsize(p), "sha256": _sha256(p)}
+    manifest = {"step": int(step), "format": fmt, "files": files,
+                "time": time.time()}
+    mpath = os.path.join(ckpt_dir, MANIFEST)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    return manifest
+
+
+def verify_checkpoint(directory: str, step: int, deep: bool = True) -> dict:
+    """Validate one checkpoint; returns its manifest ({} for legacy
+    single-file pickles, which are only verifiable by unpickling).
+    deep=False skips the sha256 re-hash (manifest + existence + sizes
+    only) — enough for retention/discovery without re-reading gigabytes.
+    Raises CheckpointCorrupt / FileNotFoundError."""
+    path, kind = _checkpoint_path(directory, step)
+    if kind in ("pkl-legacy", "orbax-legacy"):
+        return {}
+    mpath = os.path.join(path, MANIFEST)
+    if not os.path.isfile(mpath):
+        raise CheckpointCorrupt(f"{path}: missing {MANIFEST}")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorrupt(f"{mpath}: unreadable manifest: {e}") from e
+    for rel, meta in manifest.get("files", {}).items():
+        fp = os.path.join(path, rel)
+        if not os.path.isfile(fp):
+            raise CheckpointCorrupt(f"{path}: missing payload file {rel}")
+        if os.path.getsize(fp) != meta["bytes"]:
+            raise CheckpointCorrupt(
+                f"{path}: torn write in {rel} "
+                f"({os.path.getsize(fp)} != {meta['bytes']} bytes)")
+        if deep and _sha256(fp) != meta["sha256"]:
+            raise CheckpointCorrupt(f"{path}: checksum mismatch in {rel}")
+    return manifest
+
+
+def validate_checkpoint(directory: str, step: int,
+                        deep: bool = True) -> bool:
+    try:
+        verify_checkpoint(directory, step, deep=deep)
+        return True
+    except (CheckpointCorrupt, FileNotFoundError):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# save
+# ---------------------------------------------------------------------------
+
 def save_checkpoint(directory: str, step: int, program=None, scope=None,
                     extra_state: Optional[dict] = None,
-                    use_orbax: bool = True):
-    """Save all persistable vars (+ extra_state) under directory/step."""
+                    use_orbax: bool = True,
+                    keep_last_n: Optional[int] = None) -> str:
+    """Save all persistable vars (+ extra_state) under directory/step,
+    atomically, with retry-with-backoff on I/O errors; optionally GC down
+    to the newest `keep_last_n` valid checkpoints afterwards."""
     from .framework.core import default_main_program
     from .framework.executor import global_scope
 
@@ -37,58 +151,170 @@ def save_checkpoint(directory: str, step: int, program=None, scope=None,
     if extra_state:
         state = dict(state, **{f"__extra__{k}": v
                                for k, v in extra_state.items()})
-    path = os.path.join(directory, str(step))
+    arrays = {k: np.asarray(v) for k, v in state.items()}
+
+    os.makedirs(directory, exist_ok=True)
+    final = os.path.join(directory, str(step))
+    retries = int(flag_value("FLAGS_checkpoint_retries") or 0)
+    backoff = float(flag_value("FLAGS_checkpoint_retry_backoff_s") or 0)
+    last_err: Optional[OSError] = None
+    for attempt in range(retries + 1):
+        if attempt:
+            stat_add("checkpoint_retries")
+            time.sleep(backoff * (2 ** (attempt - 1)))
+        tmp = os.path.join(
+            directory, f"{_TMP_PREFIX}{step}-{os.getpid()}-{attempt}")
+        try:
+            _write_once(tmp, final, arrays, step, use_orbax)
+            stat_add("checkpoint_writes")
+            break
+        except OSError as e:
+            last_err = e
+            logger.warning("checkpoint write for step %s failed "
+                           "(attempt %d/%d): %s",
+                           step, attempt + 1, retries + 1, e)
+            shutil.rmtree(tmp, ignore_errors=True)
+    else:
+        raise last_err
+    if keep_last_n:
+        gc_checkpoints(directory, keep_last_n)
+    return final
+
+
+def _write_once(tmp: str, final: str, arrays: Dict[str, np.ndarray],
+                step: int, use_orbax: bool):
+    kind = fault.fire("ckpt_write")
+    if kind == "raise":
+        raise fault.InjectedFault(
+            f"injected checkpoint write failure (step {step})")
+    os.makedirs(tmp, exist_ok=True)
+    fmt = "pkl"
     if use_orbax:
         try:
             import orbax.checkpoint as ocp
             ckptr = ocp.PyTreeCheckpointer()
-            ckptr.save(os.path.abspath(path),
-                       {k: np.asarray(v) for k, v in state.items()},
-                       force=True)
-            return path
-        except Exception:
-            pass  # fall through to pickle
-    import pickle
-    os.makedirs(directory, exist_ok=True)
-    with open(path + ".pkl", "wb") as f:
-        pickle.dump({k: np.asarray(v) for k, v in state.items()}, f,
-                    protocol=2)
-    return path + ".pkl"
+            ckptr.save(os.path.abspath(os.path.join(tmp, "orbax")),
+                       arrays, force=True)
+            fmt = "orbax"
+        except Exception as e:
+            stat_add("checkpoint_fallback")
+            logger.warning("orbax save failed (%s: %s); falling back to "
+                           "pickle", type(e).__name__, e)
+    if fmt == "pkl":
+        import pickle
+        with open(os.path.join(tmp, "state.pkl"), "wb") as f:
+            pickle.dump(arrays, f, protocol=2)
+            f.flush()
+            os.fsync(f.fileno())
+    _write_manifest(tmp, step, fmt)
+    if os.path.isdir(final):
+        shutil.rmtree(final)
+    elif os.path.exists(final):
+        os.remove(final)
+    os.replace(tmp, final)
+    if kind in ("torn", "partial"):
+        # simulate storage failure after publish: data never hit the disk
+        _inject_corruption(final, kind)
 
 
-def latest_step(directory: str) -> Optional[int]:
-    if not os.path.isdir(directory):
-        return None
-    steps = []
+def _inject_corruption(path: str, kind: str):
+    if kind == "partial":
+        os.remove(os.path.join(path, MANIFEST))
+        return
+    target, size = None, -1  # torn: truncate the largest payload file
+    for root, _dirs, names in os.walk(path):
+        for n in names:
+            if n == MANIFEST:
+                continue
+            p = os.path.join(root, n)
+            s = os.path.getsize(p)
+            if s > size:
+                target, size = p, s
+    if target is not None:
+        with open(target, "r+b") as f:
+            f.truncate(max(1, size // 2))
+
+
+# ---------------------------------------------------------------------------
+# discovery / load
+# ---------------------------------------------------------------------------
+
+def _entries(directory: str) -> Dict[int, List[str]]:
+    """step -> directory-entry names (a step can have both a legacy .pkl
+    and a checkpoint dir; the dir wins at load)."""
+    out: Dict[int, List[str]] = {}
     for name in os.listdir(directory):
+        if name.startswith(_TMP_PREFIX):
+            continue
         base = name[:-4] if name.endswith(".pkl") else name
         if base.isdigit():
-            steps.append(int(base))
-    return max(steps) if steps else None
+            out.setdefault(int(base), []).append(name)
+    return out
 
 
-def load_checkpoint(directory: str, step: Optional[int] = None,
-                    program=None, scope=None) -> dict:
-    """Restore persistable vars into the scope; returns extra_state."""
-    from .framework.core import default_main_program
-    from .framework.executor import global_scope
+def _checkpoint_path(directory: str, step: int) -> Tuple[str, str]:
+    d = os.path.join(directory, str(step))
+    if os.path.isdir(d):
+        if os.path.isfile(os.path.join(d, MANIFEST)) or \
+                os.path.isdir(os.path.join(d, "orbax")) or \
+                os.path.isfile(os.path.join(d, "state.pkl")):
+            # new layout (a new-layout dir WITHOUT its manifest is torn:
+            # the atomic publish always includes it)
+            return d, "dir"
+        # pre-manifest layout: orbax payload directly under <dir>/<step>
+        return d, "orbax-legacy"
+    if os.path.isfile(d + ".pkl"):
+        return d + ".pkl", "pkl-legacy"
+    raise FileNotFoundError(f"no checkpoint for step {step} in {directory}")
 
-    program = program or default_main_program()
-    scope = scope or global_scope()
-    if step is None:
-        step = latest_step(directory)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints in {directory}")
-    path = os.path.join(directory, str(step))
-    state = None
-    if os.path.exists(path + ".pkl"):
+
+def valid_steps(directory: str) -> List[int]:
+    """Ascending steps whose checkpoints pass structural (shallow)
+    manifest validation; the deep sha256 check runs at load time, before
+    any scope mutation, where a same-size bit-flip actually matters."""
+    if not os.path.isdir(directory):
+        return []
+    return [s for s in sorted(_entries(directory))
+            if validate_checkpoint(directory, s, deep=False)]
+
+
+def latest_step(directory: str, validate: bool = True) -> Optional[int]:
+    """Newest step — by default the newest that passes validation, so a
+    torn/manifest-less write can never be offered for resume."""
+    if not os.path.isdir(directory):
+        return None
+    steps = valid_steps(directory) if validate \
+        else sorted(_entries(directory))
+    return steps[-1] if steps else None
+
+
+def _load_state(directory: str, step: int) -> dict:
+    """Verify + fully deserialize one checkpoint (no scope mutation)."""
+    manifest = verify_checkpoint(directory, step)
+    path, kind = _checkpoint_path(directory, step)
+    try:
         import pickle
-        with open(path + ".pkl", "rb") as f:
-            state = pickle.load(f)
-    else:
-        import orbax.checkpoint as ocp
-        ckptr = ocp.PyTreeCheckpointer()
-        state = ckptr.restore(os.path.abspath(path))
+        if kind == "pkl-legacy":
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        if kind == "orbax-legacy":
+            import orbax.checkpoint as ocp
+            return ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
+        if manifest.get("format") == "orbax":
+            import orbax.checkpoint as ocp
+            ckptr = ocp.PyTreeCheckpointer()
+            return ckptr.restore(
+                os.path.abspath(os.path.join(path, "orbax")))
+        with open(os.path.join(path, "state.pkl"), "rb") as f:
+            return pickle.load(f)
+    except CheckpointCorrupt:
+        raise
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"checkpoint {path}: failed to deserialize: {e}") from e
+
+
+def _apply_state(state: dict, program, scope) -> dict:
     extra = {}
     persistable = {v.name for v in program.list_vars() if v.persistable}
     for k, v in state.items():
@@ -97,3 +323,85 @@ def load_checkpoint(directory: str, step: Optional[int] = None,
         elif k in persistable:
             scope.set_var(k, np.asarray(v))
     return extra
+
+
+def restore_latest(directory: str, program=None,
+                   scope=None) -> Tuple[Optional[int], dict]:
+    """Restore the newest checkpoint that fully validates AND loads;
+    corrupt/incomplete ones are skipped (logged + counted), newest-first.
+    Returns (step, extra_state) or (None, {})."""
+    from .framework.core import default_main_program
+    from .framework.executor import global_scope
+
+    program = program or default_main_program()
+    scope = scope or global_scope()
+    if not os.path.isdir(directory):
+        return None, {}
+    for step in sorted(_entries(directory), reverse=True):
+        try:
+            state = _load_state(directory, step)
+        except (CheckpointCorrupt, FileNotFoundError) as e:
+            stat_add("checkpoint_corrupt_skipped")
+            logger.warning("skipping corrupt checkpoint step %s: %s",
+                           step, e)
+            continue
+        # only mutate the scope once a checkpoint fully deserialized: a
+        # torn read must not leave a half-restored state behind
+        extra = _apply_state(state, program, scope)
+        stat_add("checkpoint_resumes")
+        return step, extra
+    return None, {}
+
+
+def load_checkpoint(directory: str, step: Optional[int] = None,
+                    program=None, scope=None) -> dict:
+    """Restore persistable vars into the scope; returns extra_state.
+
+    With step=None the newest *valid* checkpoint is used, falling back
+    past corrupt ones; an explicit step is validated up front and raises
+    CheckpointCorrupt before touching the scope."""
+    from .framework.core import default_main_program
+    from .framework.executor import global_scope
+
+    program = program or default_main_program()
+    scope = scope or global_scope()
+    if step is None:
+        found, extra = restore_latest(directory, program=program,
+                                      scope=scope)
+        if found is None:
+            raise FileNotFoundError(f"no valid checkpoints in {directory}")
+        return extra
+    return _apply_state(_load_state(directory, step), program, scope)
+
+
+# ---------------------------------------------------------------------------
+# retention
+# ---------------------------------------------------------------------------
+
+def gc_checkpoints(directory: str, keep_last_n: int) -> int:
+    """Keep the newest `keep_last_n` *valid* checkpoints (corrupt entries
+    newer than the boundary are left for forensics — loads skip them);
+    delete everything older, plus orphaned .tmp-* write dirs."""
+    if not os.path.isdir(directory):
+        return 0
+    removed = 0
+    for name in os.listdir(directory):
+        if name.startswith(_TMP_PREFIX):
+            shutil.rmtree(os.path.join(directory, name),
+                          ignore_errors=True)
+            stat_add("checkpoint_tmp_cleaned")
+    entries = _entries(directory)
+    kept_valid = 0
+    for step in sorted(entries, reverse=True):
+        if kept_valid < keep_last_n:
+            # shallow check: retention ordering must not re-hash every
+            # retained checkpoint on every save (load still deep-checks)
+            if validate_checkpoint(directory, step, deep=False):
+                kept_valid += 1
+            continue
+        for name in entries[step]:
+            path = os.path.join(directory, name)
+            shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)
+            removed += 1
+            stat_add("checkpoints_gc")
+    return removed

@@ -6,6 +6,8 @@ distributed_optimizer:554, minimize:946 with meta-optimizer ranking at
 """
 from __future__ import annotations
 
+import logging
+
 from typing import Optional
 
 from .distributed_strategy import DistributedStrategy
@@ -37,8 +39,11 @@ class Fleet:
         if t is not None:
             try:
                 t.stop_worker()
-            except Exception:
-                pass
+            except Exception as e:
+                from ...monitor import stat_add
+                stat_add("fleet_stale_worker_stop_errors")
+                logging.getLogger("paddle_tpu.fleet").warning(
+                    "stopping stale PS trainer failed: %s", e)
         s = getattr(self, "_ps_server", None)
         if s is not None:
             s.stop()

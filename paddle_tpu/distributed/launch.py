@@ -35,13 +35,17 @@ def _parse():
     return p.parse_args()
 
 
-def _spawn(args, hosts, nnodes, local_rank):
+def _spawn(args, hosts, nnodes, local_rank, restart_count=0):
     rank = args.node_rank * args.nproc_per_node + local_rank
     world = nnodes * args.nproc_per_node
     env = dict(os.environ)
     env.update({
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(world),
+        # which life this worker is on (0 = first); restarted workers can
+        # tell a fresh launch from an elastic restart (e.g. to log, or to
+        # insist on finding an auto-checkpoint to resume from)
+        "PADDLE_TPU_RESTART_COUNT": str(restart_count),
         "PADDLE_TRAINER_ENDPOINTS": ",".join(
             f"{h}:{args.coordinator_port + i}"
             for h in hosts for i in range(args.nproc_per_node)),
@@ -89,7 +93,7 @@ def main():
                 print(f"[launch] worker {lr} exited rc={ret}; restart "
                       f"{restarts[lr]}/{args.max_restarts}",
                       file=sys.stderr)
-                procs[lr] = _spawn(args, hosts, nnodes, lr)
+                procs[lr] = _spawn(args, hosts, nnodes, lr, restarts[lr])
             else:
                 rc = rc or ret
                 del procs[lr]

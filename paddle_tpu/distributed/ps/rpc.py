@@ -416,12 +416,12 @@ class PServer:
                     _send_msg(conn, _ERR + b"server connection pool "
                               b"exhausted")
                 except OSError:
-                    pass
+                    pass  # ok: best-effort refusal; peer already gone
                 finally:
                     try:
                         conn.close()
                     except OSError:
-                        pass
+                        pass  # ok: peer already closed the socket
                 continue
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)
@@ -455,7 +455,7 @@ class PServer:
             try:
                 self._conn_slots.release()
             except ValueError:
-                pass
+                pass  # ok: slot was already released on the refusal path
             with self._conns_lock:
                 self._conns.discard(conn)
             conn.close()
@@ -532,7 +532,7 @@ class PServer:
         try:
             self._sock.close()
         except OSError:
-            pass
+            pass  # ok: listener socket already dead during shutdown
         # close live connections too: a serve thread parked in recv would
         # otherwise answer one more request after stop
         with self._conns_lock:
@@ -541,11 +541,11 @@ class PServer:
             try:
                 c.shutdown(socket.SHUT_RDWR)
             except OSError:
-                pass
+                pass  # ok: connection already torn down by the peer
             try:
                 c.close()
             except OSError:
-                pass
+                pass  # ok: connection already closed
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until stop() (e.g. a client's stop_server) — the
@@ -627,7 +627,7 @@ class RPCClient:
                         if self._sock is not None:
                             self._sock.close()
                     except OSError:
-                        pass
+                        pass  # ok: closing a dead socket before retry
                     self._sock = None
                     if i + 1 < attempts:
                         time.sleep(self.retry_backoff * (2 ** i))
@@ -690,14 +690,14 @@ class RPCClient:
         try:
             self._call(bytes([_STOP]))
         except ConnectionError:
-            pass
+            pass  # ok: server exits before answering its own stop
 
     def close(self):
         try:
             if self._sock is not None:
                 self._sock.close()
         except OSError:
-            pass
+            pass  # ok: socket already closed
         self._sock = None
 
 
@@ -813,7 +813,8 @@ def start_heartbeat(client, trainer_id: int, interval: float = 2.0):
                 try:
                     c.heartbeat(trainer_id)
                 except Exception:
-                    pass
+                    from ...monitor import stat_add
+                    stat_add("ps_heartbeat_send_errors")
 
     t = threading.Thread(target=loop, daemon=True)
     t.start()

@@ -94,6 +94,13 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good = 0
 
+    def backoff_on_nonfinite(self):
+        """External non-finite signal (train_guard's in-graph skip-step
+        detected a NaN/Inf loss): apply the decrease path of dynamic loss
+        scaling as if minimize() had seen the inf gradient itself."""
+        if self._enable:
+            self._update(True)
+
     def is_enable(self):
         return self._enable
 

@@ -387,7 +387,7 @@ def convert_to_static(fn):
                 try:
                     glb[nm] = cell.cell_contents
                 except ValueError:
-                    pass
+                    pass  # ok: unbound cell; name resolves via __globals__
         ns: dict = {}
         exec(code, glb, ns)
         new_fn = ns[fn.__name__]

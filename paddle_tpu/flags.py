@@ -74,3 +74,14 @@ register_flag("FLAGS_cudnn_deterministic", False,
               "deterministic kernels (XLA is deterministic by default)")
 register_flag("FLAGS_paddle_num_threads", 1,
               "host threads per op (advisory)")
+register_flag("FLAGS_fault_inject", "",
+              "deterministic fault-injection spec: comma-separated "
+              "site:kind@N / site:kind@N+ / site:kind~p entries "
+              "(paddle_tpu/fault.py; e.g. 'ckpt_write:torn@2,loss:nan@5')")
+register_flag("FLAGS_fault_seed", 0,
+              "seed for probabilistic (~p) fault-injection triggers")
+register_flag("FLAGS_checkpoint_retries", 2,
+              "retry a failed checkpoint write up to N more times "
+              "(exponential backoff) before giving up")
+register_flag("FLAGS_checkpoint_retry_backoff_s", 0.05,
+              "base backoff (seconds) between checkpoint write retries")

@@ -35,6 +35,8 @@ __all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
 from ..monitor import monitor as _monitor  # noqa: E402
 _STEP_STAT = _monitor.get("executor_run_steps")
 _JIT_STAT = _monitor.get("executor_jit_builds")
+_SKIP_STAT = _monitor.get("skipped_nonfinite_steps")
+_CKPT_FAIL_STAT = _monitor.get("checkpoint_write_failures")
 
 
 # ---------------------------------------------------------------------------
@@ -226,16 +228,27 @@ class Executor:
             (n, tuple(np.shape(a)),
              str(a.dtype if hasattr(a, "dtype") else np.asarray(a).dtype))
             for n, a in feed_arrays.items())
-        key = (program._uid, program._mod_count, sig, tuple(fetch_names))
+        # guard every run of the bound training program that produces the
+        # loss (fetched or not — env holds it either way); other programs
+        # (startup, an interleaved eval clone) compile unguarded so an
+        # eval NaN can't back off the loss scale or count as a skip
+        guard_loss = getattr(self, "_guard_loss", None)
+        if guard_loss is not None:
+            gp = getattr(self, "_guard_program", None)
+            if (gp is not None and program is not gp) or \
+                    not block.has_var(guard_loss):
+                guard_loss = None
+        key = (program._uid, program._mod_count, sig, tuple(fetch_names),
+               guard_loss)
 
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             _JIT_STAT.increase()
             entry = self._build(program, block, list(feed_arrays),
-                                fetch_names)
+                                fetch_names, guard_loss)
             if use_program_cache:
                 self._cache[key] = entry
-        fn, mut_in, const_in, state_out = entry
+        fn, mut_in, const_in, state_out, guarded = entry
 
         def _val(name):
             val = scope.find_var(name)
@@ -256,14 +269,24 @@ class Executor:
             import time
             jax.block_until_ready(mut_vals)
             t0 = time.perf_counter()
-        fetches, new_state = fn(tuple(feed_arrays.values()),
-                                mut_vals, const_vals, step)
+        if guarded:
+            fetches, new_state, ok = fn(tuple(feed_arrays.values()),
+                                        mut_vals, const_vals, step)
+        else:
+            fetches, new_state = fn(tuple(feed_arrays.values()),
+                                    mut_vals, const_vals, step)
+            ok = True
         if bench:
             jax.block_until_ready((fetches, new_state))
             print(f"[FLAGS_benchmark] step {self._step}: "
                   f"{(time.perf_counter() - t0) * 1e3:.3f} ms")
         for name, val in zip(state_out, new_state):
             scope.set_var(name, val)
+        if guarded and not bool(ok):
+            _SKIP_STAT.increase()
+            cb = getattr(self, "_guard_cb", None)
+            if cb is not None:
+                cb(self._step)
         self._maybe_auto_checkpoint(program, scope)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
@@ -277,17 +300,17 @@ class Executor:
         fluid.incubate.checkpoint.auto_checkpoint + the trainer's
         failure-recovery contract): every `interval_steps` successful
         runs the persistable state is checkpointed; on enable, the
-        latest checkpoint (if any) is restored so a restarted process
-        continues where it died."""
+        newest *valid* checkpoint (if any) is restored — corrupt or
+        torn ones are skipped — so a restarted process continues where
+        it died."""
         from .. import checkpoint as ckpt
 
         program = program or default_main_program()
         self._auto_ckpt = {"dir": directory,
                            "interval": max(1, int(interval_steps)),
                            "program": program, "max_keep": max_keep}
-        step = ckpt.latest_step(directory)
+        step, _extra = ckpt.restore_latest(directory, program=program)
         if step is not None:
-            ckpt.load_checkpoint(directory, step, program=program)
             self._step = int(step)
         return step
 
@@ -305,24 +328,36 @@ class Executor:
             return
         from .. import checkpoint as ckpt
 
-        ckpt.save_checkpoint(ac["dir"], self._step,
-                             program=ac["program"], scope=scope)
-        self._prune_checkpoints(ac)
+        try:
+            ckpt.save_checkpoint(ac["dir"], self._step,
+                                 program=ac["program"], scope=scope,
+                                 keep_last_n=ac["max_keep"])
+        except OSError as e:
+            # best-effort: a flaky store must not kill the training job
+            # (the write already retried with backoff inside)
+            _CKPT_FAIL_STAT.increase()
+            import logging
+            logging.getLogger("paddle_tpu.checkpoint").error(
+                "auto-checkpoint at step %d failed: %s", self._step, e)
 
-    @staticmethod
-    def _prune_checkpoints(ac):
-        import os
-        import shutil
+    # -- non-finite guard ---------------------------------------------------
+    def set_nonfinite_guard(self, loss, callback=None, program=None):
+        """Always-on cheap skip-step: compile the step so that whenever
+        `loss` comes out non-finite, the state update is discarded
+        *in-graph* (the old state is re-selected) — one extra scalar
+        reduce per step, no host round-trip before the optimizer.
+        `callback(step)` fires after each skipped step (train_guard uses
+        it for the AMP loss-scale backoff).  With `program` given, only
+        runs of that exact program are guarded (an eval clone carrying
+        the same loss var stays unguarded)."""
+        self._guard_loss = loss if isinstance(loss, str) else loss.name
+        self._guard_cb = callback
+        self._guard_program = program
 
-        d = ac["dir"]
-        steps = []
-        for name in os.listdir(d):
-            base = name[:-4] if name.endswith(".pkl") else name
-            if base.isdigit():
-                steps.append((int(base), name))
-        for _step, name in sorted(steps)[:-ac["max_keep"]]:
-            path = os.path.join(d, name)
-            shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)
+    def clear_nonfinite_guard(self):
+        self._guard_loss = None
+        self._guard_cb = None
+        self._guard_program = None
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
@@ -434,8 +469,10 @@ class Executor:
 
     # -- compilation --------------------------------------------------------
     def _build(self, program: Program, block: Block,
-               feed_names: List[str], fetch_names: List[str]):
+               feed_names: List[str], fetch_names: List[str],
+               guard_loss: Optional[str] = None):
         import jax
+        import jax.numpy as jnp
 
         state_in, state_out = analyze_block(block, feed_names)
         # fetched temps must be emitted; ensure they exist in the block
@@ -462,11 +499,23 @@ class Executor:
             # SelectedRows the same way)
             fetches = tuple(densify(env[n]) for n in fetch_names)
             new_state = tuple(densify(env[n]) for n in state_out)
+            if guard_loss is not None:
+                # non-finite skip-step: select the OLD state when the
+                # loss went NaN/Inf (donated inputs stay readable here;
+                # a scalar-cond where is free next to the matmuls)
+                gval = env.get(guard_loss)
+                ok = jnp.isfinite(densify(gval)).all() \
+                    if gval is not None else jnp.asarray(True)
+                old = dict(zip(mut_in, mut_vals))
+                new_state = tuple(
+                    jnp.where(ok, v, old[n]) if n in old else v
+                    for n, v in zip(state_out, new_state))
+                return fetches, new_state, ok
             return fetches, new_state
 
         # Donate only rebound state: params update in place in HBM.
         fn = jax.jit(step_fn, donate_argnums=(1,))
-        return fn, mut_in, const_in, state_out
+        return fn, mut_in, const_in, state_out, guard_loss is not None
 
     def _run_pipeline(self, program, feed, fetch_list, scope, return_numpy):
         """Programs marked by PipelineOptimizer: microbatch-scan schedule

@@ -177,13 +177,13 @@ def device_prefetch(it: Iterable, depth: int = 2, device=None):
         for _ in range(depth):
             staged.append(put(next(it)))
     except StopIteration:
-        pass
+        pass  # ok: prefetch window larger than the dataset
     while staged:
         out = staged.pop(0)
         try:
             staged.append(put(next(it)))
         except StopIteration:
-            pass
+            pass  # ok: source exhausted; drain the staged batches
         yield out
 
 

@@ -81,6 +81,8 @@ def test_launcher_restarts_crashed_worker(tmp_path):
             import numpy as np
             import paddle_tpu as pt
             from paddle_tpu import layers, optimizer
+            print("RESTART_COUNT",
+                  os.environ.get("PADDLE_TPU_RESTART_COUNT"), flush=True)
             x = layers.data("x", [4]); y = layers.data("y", [1])
             loss = layers.mean(pt.layers.square_error_cost(
                 layers.fc(x, 1, name="wfc"), y))
@@ -112,6 +114,10 @@ def test_launcher_restarts_crashed_worker(tmp_path):
     log = open(os.path.join(log_dir, "worker.0.log")).read()
     assert "FINISHED at 9 resumed from 4" in log, log[-800:]
     assert "restart 1/2" in r.stderr
+    # restart -> auto-resume path: the launcher tells each life which
+    # incarnation it is (first life 0, restarted life 1)
+    assert "RESTART_COUNT 0" in log, log[-800:]
+    assert "RESTART_COUNT 1" in log, log[-800:]
 
 
 def test_distribute_transpiler_shim():

@@ -1,0 +1,376 @@
+"""Fault-injection matrix: crash-safe checkpointing, skip-step on
+non-finite loss, SIGTERM preemption, deterministic injection.
+
+The acceptance bar (ISSUE 1): with an injected torn write + process kill
+at an arbitrary step, a restart resumes from the last *valid* checkpoint
+and the final trained params match an uninterrupted run bit-exact; every
+injected fault and recovery action is visible via monitor counters.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import checkpoint as ckpt
+from paddle_tpu import fault, layers, optimizer
+from paddle_tpu.monitor import stat_get
+from paddle_tpu.train_guard import TrainGuard, TrainingInterrupted
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    fault.reset()
+    yield
+    fault.reset()
+    pt.set_flags({"FLAGS_fault_inject": ""})
+
+
+def _net(lr=0.1):
+    """-> (loss, weight_param_name); the name is unique-suffixed per
+    process, so tests must not hardcode it."""
+    x = layers.data("x", [4])
+    y = layers.data("y", [1])
+    pred = layers.fc(x, 1, name="gfc")
+    loss = layers.mean(pt.layers.square_error_cost(pred, y))
+    optimizer.SGDOptimizer(lr).minimize(loss)
+    w = pt.default_main_program().global_block().all_parameters()[0]
+    return loss, w.name
+
+
+def _feed(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(8, 4).astype("float32")
+    return {"x": x, "y": (x.sum(1, keepdims=True) * 0.5).astype("float32")}
+
+
+def _startup(scope=None):
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), scope=scope)
+    return exe
+
+
+def _clean_params(loss, feed, n_steps, name):
+    """Uninterrupted guarded run of n_steps; returns the trained weight."""
+    scope = pt.Scope()
+    exe = _startup(scope)
+    with pt.scope_guard(scope):
+        g = TrainGuard(exe, loss, handle_sigterm=False)
+        for _ in range(n_steps):
+            g.step(feed, scope=scope)
+        g.close()
+    w = scope.find_var(name)
+    assert w is not None, f"{name} missing from scope"
+    return np.asarray(w)
+
+
+# ---------------------------------------------------------------------------
+# injector unit behavior
+# ---------------------------------------------------------------------------
+
+def test_injector_occurrence_and_sticky_triggers():
+    inj = fault.FaultInjector("s:raise@2,t:torn@3+", seed=0)
+    assert [inj.fire("s") for _ in range(4)] == \
+        [None, "raise", None, None]
+    assert [inj.fire("t") for _ in range(5)] == \
+        [None, None, "torn", "torn", "torn"]
+
+
+def test_injector_probabilistic_is_seeded():
+    inj1 = fault.FaultInjector("s:raise~0.5", seed=7)
+    inj2 = fault.FaultInjector("s:raise~0.5", seed=7)
+    s1 = [inj1.fire("s") for _ in range(64)]
+    s2 = [inj2.fire("s") for _ in range(64)]
+    assert s1 == s2 and 0 < s1.count("raise") < 64
+
+
+def test_injector_bad_spec_rejected():
+    with pytest.raises(ValueError):
+        fault.FaultInjector("ckpt_write-raise")
+
+
+def test_injector_reads_flags():
+    pt.set_flags({"FLAGS_fault_inject": "ckpt_write:raise@1"})
+    inj = fault.configure()
+    assert inj.fire("ckpt_write") == "raise"
+    assert stat_get("fault_ckpt_write_raise") >= 1
+
+
+# ---------------------------------------------------------------------------
+# crash-safe checkpoint writes
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_manifest_and_validation(tmp_path):
+    d = str(tmp_path)
+    loss, _w = _net()
+    exe = _startup()
+    exe.run(feed=_feed(), fetch_list=[loss])
+    before = stat_get("checkpoint_writes")
+    path = ckpt.save_checkpoint(d, 5)
+    assert stat_get("checkpoint_writes") == before + 1
+    mpath = os.path.join(path, ckpt.MANIFEST)
+    assert os.path.isfile(mpath)
+    manifest = json.load(open(mpath))
+    assert manifest["step"] == 5 and manifest["files"]
+    for meta in manifest["files"].values():
+        assert set(meta) == {"bytes", "sha256"}
+    assert ckpt.validate_checkpoint(d, 5)
+    assert ckpt.latest_step(d) == 5
+    assert not any(n.startswith(".tmp-") for n in os.listdir(d))
+
+    # truncate a payload file: validation must reject, latest must hide it
+    files = sorted(manifest["files"])
+    victim = os.path.join(path, files[0])
+    with open(victim, "r+b") as f:
+        f.truncate(max(0, os.path.getsize(victim) // 2))
+    assert not ckpt.validate_checkpoint(d, 5)
+    assert ckpt.latest_step(d) is None
+    assert ckpt.latest_step(d, validate=False) == 5
+
+
+def test_write_retries_transient_error(tmp_path):
+    d = str(tmp_path)
+    loss, _w = _net()
+    exe = _startup()
+    exe.run(feed=_feed(), fetch_list=[loss])
+    fault.configure("ckpt_write:raise@1")
+    r0, f0 = stat_get("checkpoint_retries"), stat_get("faults_injected")
+    ckpt.save_checkpoint(d, 3)
+    assert stat_get("checkpoint_retries") == r0 + 1
+    assert stat_get("faults_injected") == f0 + 1
+    assert ckpt.validate_checkpoint(d, 3)
+
+
+def test_write_gives_up_past_retry_budget(tmp_path):
+    d = str(tmp_path)
+    loss, _w = _net()
+    exe = _startup()
+    exe.run(feed=_feed(), fetch_list=[loss])
+    fault.configure("ckpt_write:raise@1+")
+    with pytest.raises(OSError):
+        ckpt.save_checkpoint(d, 3)
+    assert os.listdir(d) == []  # failed attempts leave no debris
+    assert ckpt.latest_step(d) is None
+
+
+def test_retention_gc_keeps_newest_valid(tmp_path):
+    d = str(tmp_path)
+    loss, _w = _net()
+    exe = _startup()
+    exe.run(feed=_feed(), fetch_list=[loss])
+    for s in (1, 2, 3, 4):
+        ckpt.save_checkpoint(d, s)
+    g0 = stat_get("checkpoints_gc")
+    ckpt.save_checkpoint(d, 5, keep_last_n=2)
+    assert ckpt.valid_steps(d) == [4, 5]
+    assert stat_get("checkpoints_gc") == g0 + 3
+
+
+# ---------------------------------------------------------------------------
+# the fault matrix: resume is bit-exact from the last VALID checkpoint
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("spec,expected_resume", [
+    ("ckpt_write:torn@2", 3),       # 2nd write torn -> fall back to step 3
+    ("ckpt_write:partial@2", 3),    # manifest-less -> fall back to step 3
+    ("ckpt_write:raise@2+", 3),     # storage down from 2nd write on
+])
+def test_fault_matrix_resume_bitexact(tmp_path, spec, expected_resume):
+    d = str(tmp_path / "ck")
+    loss, w_name = _net()
+    feed = _feed()
+
+    # life 1: train 7 steps with periodic checkpoints at counter steps 3, 6
+    fault.configure(spec)
+    skipped0 = stat_get("checkpoint_corrupt_skipped")
+    exe = _startup()
+    g = TrainGuard(exe, loss, checkpoint_dir=d, interval_steps=3,
+                   keep_last_n=5, handle_sigterm=False)
+    assert g.resumed_step is None
+    for _ in range(7):
+        g.step(feed)
+    g.close()
+    fault.reset()
+    assert stat_get("faults_injected") > 0
+    assert ckpt.latest_step(d) == expected_resume
+
+    # life 2 ("after the crash"): fresh scope + executor, auto-resume
+    s2 = pt.Scope()
+    exe2 = _startup(s2)
+    with pt.scope_guard(s2):
+        g2 = TrainGuard(exe2, loss, checkpoint_dir=d, interval_steps=3,
+                        keep_last_n=5, handle_sigterm=False)
+        assert g2.resumed_step == expected_resume
+        assert exe2._step == expected_resume
+        while exe2._step < 8:
+            g2.step(feed, scope=s2)
+        g2.close()
+    if spec != "ckpt_write:raise@2+":
+        # the newer corrupt checkpoint was skipped on the way down
+        assert stat_get("checkpoint_corrupt_skipped") > skipped0
+    w_resumed = s2.find_var(w_name)
+    assert w_resumed is not None
+
+    # uninterrupted comparator: same 7 training steps, no faults
+    w_clean = _clean_params(loss, feed, 7, w_name)
+    np.testing.assert_array_equal(np.asarray(w_resumed), w_clean)
+
+
+def test_nan_loss_skips_step_and_backs_off_scaler():
+    loss, w_name = _net()
+    feed = _feed()
+    fault.configure("loss:nan@3")
+    scaler = pt.amp.GradScaler(enable=True, init_loss_scaling=8.0,
+                               decr_every_n_nan_or_inf=1)
+    seen = []
+    exe = _startup()
+    sk0 = stat_get("skipped_nonfinite_steps")
+    g = TrainGuard(exe, loss, scaler=scaler, on_nonfinite=seen.append,
+                   handle_sigterm=False)
+    outs = [g.step(feed, fetch_list=[loss])[0] for _ in range(5)]
+    g.close()
+    assert stat_get("skipped_nonfinite_steps") == sk0 + 1
+    assert stat_get("fault_loss_nan") >= 1
+    assert g.skipped_steps == 1 and seen == [4]  # counter: startup was 1
+    assert not np.isfinite(outs[2]).all()        # the poisoned fetch
+    assert all(np.isfinite(o).all() for i, o in enumerate(outs) if i != 2)
+    assert scaler.get_scale() == 4.0             # 8.0 * decr_ratio 0.5
+    # params match a clean run with the skipped update left out entirely
+    w_guarded = pt.global_scope().find_var(w_name)
+    assert w_guarded is not None
+    w_clean = _clean_params(loss, feed, 4, w_name)
+    np.testing.assert_array_equal(np.asarray(w_guarded), w_clean)
+
+
+def test_legacy_orbax_checkpoint_still_loads(tmp_path):
+    """Pre-manifest checkpoints (orbax payload directly under
+    <dir>/<step>, no MANIFEST.json) keep working across the upgrade."""
+    d = str(tmp_path)
+    loss, w_name = _net()
+    exe = _startup()
+    exe.run(feed=_feed(), fetch_list=[loss])
+    import orbax.checkpoint as ocp
+    w_before = np.asarray(pt.global_scope().find_var(w_name)).copy()
+    ocp.PyTreeCheckpointer().save(
+        os.path.abspath(os.path.join(d, "7")), {w_name: w_before},
+        force=True)
+    assert ckpt.latest_step(d) == 7
+    pt.global_scope().set_var(w_name, np.zeros_like(w_before))
+    ckpt.load_checkpoint(d, 7)
+    np.testing.assert_array_equal(
+        np.asarray(pt.global_scope().find_var(w_name)), w_before)
+
+
+def test_eval_program_nan_does_not_trigger_skip():
+    """An interleaved eval run (program clone carrying the same loss var)
+    must not count as a skipped step or back off the loss scale."""
+    loss, _w = _net()
+    feed = _feed()
+    test_prog = pt.default_main_program().clone(for_test=True)
+    scaler = pt.amp.GradScaler(enable=True, init_loss_scaling=8.0,
+                               decr_every_n_nan_or_inf=1)
+    exe = _startup()
+    g = TrainGuard(exe, loss, scaler=scaler, handle_sigterm=False)
+    g.step(feed, fetch_list=[loss])
+    sk0 = stat_get("skipped_nonfinite_steps")
+    bad = {k: np.full_like(v, np.nan)
+           if np.issubdtype(np.asarray(v).dtype, np.floating) else v
+           for k, v in feed.items()}
+    out = exe.run(test_prog, feed=bad, fetch_list=[loss.name])
+    assert not np.isfinite(out[0]).all()
+    assert stat_get("skipped_nonfinite_steps") == sk0
+    assert g.skipped_steps == 0 and scaler.get_scale() == 8.0
+    g.close()
+
+
+def test_close_uninstalls_auto_checkpoint(tmp_path):
+    d = str(tmp_path / "ck")
+    loss, _w = _net()
+    feed = _feed()
+    exe = _startup()
+    g = TrainGuard(exe, loss, checkpoint_dir=d, interval_steps=1,
+                   handle_sigterm=False)
+    g.step(feed)
+    assert ckpt.latest_step(d) is not None
+    g.close()
+    n = len(ckpt.valid_steps(d))
+    exe.run(feed=feed, fetch_list=[loss])  # post-close run: no more writes
+    assert len(ckpt.valid_steps(d)) == n
+    assert getattr(exe, "_auto_ckpt", None) is None
+
+
+def test_guard_active_without_fetching_loss():
+    """The skip-step guard keys on the program producing the loss, not on
+    the caller fetching it — a bare step(feed) is still protected."""
+    loss, w_name = _net()
+    feed = _feed()
+    fault.configure("loss:nan@2")
+    exe = _startup()
+    g = TrainGuard(exe, loss, handle_sigterm=False)
+    for _ in range(3):
+        out = g.step(feed)          # no fetch_list at all
+        assert out == []            # caller's (empty) fetch_list honored
+    g.close()
+    assert g.skipped_steps == 1
+    w_guarded = pt.global_scope().find_var(w_name)
+    assert w_guarded is not None
+    w_clean = _clean_params(loss, feed, 2, w_name)
+    np.testing.assert_array_equal(np.asarray(w_guarded), w_clean)
+
+
+def test_sigterm_writes_final_checkpoint_and_resumes_bitexact(tmp_path):
+    d = str(tmp_path / "ck")
+    loss, w_name = _net()
+    feed = _feed()
+    fault.configure("step:sigterm@4")
+    sig0 = stat_get("sigterm_received")
+    fin0 = stat_get("checkpoint_final")
+
+    exe = _startup()
+    g = TrainGuard(exe, loss, checkpoint_dir=d, interval_steps=100)
+    with pytest.raises(TrainingInterrupted) as ei:
+        for _ in range(7):
+            g.step(feed)
+    g.close()
+    fault.reset()
+    assert ei.value.code == 0                       # clean exit contract
+    assert stat_get("sigterm_received") == sig0 + 1
+    assert stat_get("checkpoint_final") == fin0 + 1
+    assert stat_get("fault_step_sigterm") >= 1
+    # 4 training runs happened (counter 2..5); final checkpoint at 5
+    assert ckpt.latest_step(d) == 5
+    assert ckpt.validate_checkpoint(d, 5)
+
+    # preempted worker restarts: resume and finish the remaining steps
+    s2 = pt.Scope()
+    exe2 = _startup(s2)
+    with pt.scope_guard(s2):
+        g2 = TrainGuard(exe2, loss, checkpoint_dir=d, interval_steps=100,
+                        handle_sigterm=False)
+        assert g2.resumed_step == 5
+        while exe2._step < 8:
+            g2.step(feed, scope=s2)
+        g2.close()
+    w_resumed = s2.find_var(w_name)
+    assert w_resumed is not None
+    w_clean = _clean_params(loss, feed, 7, w_name)
+    np.testing.assert_array_equal(np.asarray(w_resumed), w_clean)
+
+
+def test_explicit_corrupt_step_raises_before_scope_mutation(tmp_path):
+    d = str(tmp_path)
+    loss, w_name = _net()
+    exe = _startup()
+    exe.run(feed=_feed(), fetch_list=[loss])
+    ckpt.save_checkpoint(d, 2)
+    w_var = pt.global_scope().find_var(w_name)
+    assert w_var is not None
+    w_before = np.asarray(w_var).copy()
+    os.remove(os.path.join(d, "2", ckpt.MANIFEST))
+    pt.global_scope().set_var(w_name, w_before + 1.0)
+    with pytest.raises(ckpt.CheckpointCorrupt):
+        ckpt.load_checkpoint(d, 2)
+    # the half-restore guard: scope untouched by the failed load
+    np.testing.assert_array_equal(
+        np.asarray(pt.global_scope().find_var(w_name)), w_before + 1.0)

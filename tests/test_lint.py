@@ -1,0 +1,50 @@
+"""Tier-1 lint gates (tools/check_no_bare_pass.py).
+
+Robustness hygiene: no `except ...: pass` in paddle_tpu/ may silently
+swallow a failure — handlers must log, bump a monitor stat, or carry an
+explicit `# ok: <reason>` waiver.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "tools", "check_no_bare_pass.py")
+
+
+def test_paddle_tpu_has_no_silent_except_pass():
+    r = subprocess.run(
+        [sys.executable, LINT, os.path.join(REPO, "paddle_tpu")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_lint_catches_violation_and_honors_waiver(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""\
+        try:
+            x = 1
+        except Exception:
+            pass
+    """))
+    r = subprocess.run([sys.executable, LINT, str(bad)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    assert "bad.py:3" in r.stdout
+
+    good = tmp_path / "good.py"
+    good.write_text(textwrap.dedent("""\
+        try:
+            x = 1
+        except StopIteration:
+            pass  # ok: generator drained
+        try:
+            y = 2
+        except Exception:
+            log("boom")
+            pass
+    """))
+    r = subprocess.run([sys.executable, LINT, str(good)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout
