@@ -242,6 +242,50 @@ def with_rebuilds(build_and_measure, *, max_rebuilds=MAX_REBUILDS,
         settle(2.0 * (attempt + 1))  # let the tunnel settle
 
 
+def measure_leg(rw, fence, state, *, B, n_chips, device, device_kind,
+                faults):
+    """Shared windowed-measurement harness for every bench leg: runs
+    measure_windows (with its per-window outlier re-runs), classifies
+    spread/floor anomalies, and re-runs the whole measurement once before
+    letting an anomalous number out.  Returns
+    (per_chip, rates, spread, loss, anomaly, total_reruns)."""
+    floor = FLOORS["tpu" if "tpu" in device.platform.lower() else "cpu"]
+    total_reruns = 0
+    for _attempt in range(2):
+        dts, state, loss, n_reruns = measure_windows(
+            rw, fence, state, n_windows=WINDOWS, faults=faults)
+        total_reruns += n_reruns
+        rates = [B * STEPS_PER_WINDOW / dt for dt in dts]
+        med = float(np.median(rates))
+        spread = max(rates) / max(min(rates), 1e-9)
+        per_chip = med / n_chips
+        anomaly = None
+        if spread > ANOMALY_SPREAD:
+            anomaly = (f"window spread {spread:.2f}x > {ANOMALY_SPREAD}x "
+                       f"after {total_reruns} window re-runs "
+                       f"(chip contention?): {sorted(rates)}")
+        elif per_chip < floor:
+            anomaly = (f"throughput {per_chip:.1f} below sanity floor "
+                       f"{floor} for {device_kind}")
+        if anomaly is None:
+            break  # clean measurement; else re-run once before publishing
+    return per_chip, rates, spread, loss, anomaly, total_reruns
+
+
+def leg_stats(rates, n_chips, spread, reruns):
+    """The published per-leg stats block (same fields for every leg)."""
+    return {
+        "windows": WINDOWS, "steps_per_window": STEPS_PER_WINDOW,
+        "median": round(float(np.median(rates)) / n_chips, 2),
+        "p10": round(float(np.percentile(rates, 10)) / n_chips, 2),
+        "p90": round(float(np.percentile(rates, 90)) / n_chips, 2),
+        "min": round(min(rates) / n_chips, 2),
+        "max": round(max(rates) / n_chips, 2),
+        "spread": round(spread, 3),
+        "window_reruns": reruns,
+    }
+
+
 def bert_train_flops_per_sample(seq, vocab, hidden, layers_n, inter,
                                 n_pred):
     """Analytic matmul FLOPs for one BERT MLM training sample.
@@ -465,29 +509,10 @@ def _run_config_once(seq, batch_per_chip, *, attn=None, dropout=0.1,
             raise RuntimeError(f"non-finite loss {loss}")  # deterministic
         return loss
 
-    floor = FLOORS["tpu" if "tpu" in device.platform.lower() else "cpu"]
-    anomaly = None
     state = (step, mut_vals)
-    total_reruns = 0
-    for attempt in range(2):
-        dts, state, loss, n_reruns = measure_windows(
-            rw, fence, state, n_windows=WINDOWS, faults=faults)
-        total_reruns += n_reruns
-        rates = [B * STEPS_PER_WINDOW / dt for dt in dts]
-        med = float(np.median(rates))
-        spread = max(rates) / max(min(rates), 1e-9)
-        per_chip = med / n_chips
-        anomaly = None
-        if spread > ANOMALY_SPREAD:
-            anomaly = (f"window spread {spread:.2f}x > {ANOMALY_SPREAD}x "
-                       f"after {total_reruns} window re-runs "
-                       f"(chip contention?): {sorted(rates)}")
-        elif per_chip < floor:
-            anomaly = (f"throughput {per_chip:.1f} below sanity floor "
-                       f"{floor} for {device_kind}")
-        if anomaly is None:
-            break  # clean measurement
-        # re-run once before publishing an anomalous number
+    per_chip, rates, spread, loss, anomaly, total_reruns = measure_leg(
+        rw, fence, state, B=B, n_chips=n_chips, device=device,
+        device_kind=device_kind, faults=faults)
 
     flops = bert_train_flops_per_sample(
         seq, cfg["vocab_size"], cfg["hidden"], cfg["num_layers"],
@@ -501,16 +526,7 @@ def _run_config_once(seq, batch_per_chip, *, attn=None, dropout=0.1,
                              3),
         "mfu": round(mfu, 4),
         "model_tflops_per_sample": round(flops / 1e12, 4),
-        "stats": {
-            "windows": WINDOWS, "steps_per_window": STEPS_PER_WINDOW,
-            "median": round(med / n_chips, 2),
-            "p10": round(float(np.percentile(rates, 10)) / n_chips, 2),
-            "p90": round(float(np.percentile(rates, 90)) / n_chips, 2),
-            "min": round(min(rates) / n_chips, 2),
-            "max": round(max(rates) / n_chips, 2),
-            "spread": round(spread, 3),
-            "window_reruns": total_reruns,
-        },
+        "stats": leg_stats(rates, n_chips, spread, total_reruns),
         "config": {"seq": seq, "batch_per_chip": batch_per_chip,
                    "max_predictions": max_pred, "n_chips": n_chips,
                    "amp": "bfloat16",
@@ -525,6 +541,129 @@ def _run_config_once(seq, batch_per_chip, *, attn=None, dropout=0.1,
         "deviations": (["flash attention folds out attention-probability "
                         "dropout (output dropout kept)"]
                        if use_flash is True and dropout else []),
+    }
+
+
+# ---------------------------------------------------------------------------
+# ResNet-50 leg: the second tracked BASELINE config (ImageNet CNN training)
+# ---------------------------------------------------------------------------
+
+# analytic fwd matmul FLOPs for ResNet-50 at 224x224 (the standard ~4.1
+# GFLOPs/inference figure); train = 3x fwd.  Conv FLOPs scale with the
+# spatial area, so other image sizes scale by (size/224)^2.
+RESNET50_FWD_FLOPS_224 = 4.089e9
+
+
+def resnet50_train_flops_per_sample(image_size):
+    return 3.0 * RESNET50_FWD_FLOPS_224 * (image_size / 224.0) ** 2
+
+
+def run_resnet50(batch_per_chip=None, image_size=224):
+    faults = {"dispatch_retries": 0, "fence_retries": 0, "rebuilds": 0}
+    result = with_rebuilds(
+        lambda: _run_resnet50_once(batch_per_chip, image_size,
+                                   faults=faults),
+        faults=faults)
+    result["faults"] = dict(faults)
+    return result
+
+
+def _resnet_stream(B, image_size, mesh):
+    import itertools
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.reader import device_prefetch
+
+    rng = np.random.RandomState(0)
+    host = [(rng.rand(B, 3, image_size, image_size).astype("float32"),
+             rng.randint(0, 1000, (B, 1)).astype("int64"))
+            for _ in range(4)]
+    sh = NamedSharding(mesh, P("dp"))
+    return device_prefetch(itertools.cycle(host), depth=2, device=sh)
+
+
+def _run_resnet50_once(batch_per_chip, image_size, *, faults=None):
+    """ResNet-50 ImageNet training throughput: bf16 AMP (conv/matmul
+    white list), momentum + L2-style global clip off (the PaddleClas
+    recipe uses piecewise lr + momentum), measured with the same
+    windowed/anomaly harness as the BERT flagship."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.contrib import mixed_precision
+    from paddle_tpu.models import build_resnet_train
+    from paddle_tpu.parallel import dp_mesh, build_sharded_step
+
+    n_chips = jax.device_count()
+    device = jax.devices()[0]
+    device_kind = getattr(device, "device_kind", str(device))
+    mesh = dp_mesh(n_chips)
+    if batch_per_chip is None:
+        batch_per_chip = int(os.environ.get("BENCH_RESNET_BATCH", "64"))
+    B = batch_per_chip * n_chips
+
+    main_p, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    with pt.program_guard(main_p, startup):
+        feed_names, outs = build_resnet_train(
+            batch_size=B, depth=50, image_size=image_size, class_num=1000)
+        opt = optimizer.MomentumOptimizer(0.1, momentum=0.9)
+        opt = mixed_precision.decorate(opt, dtype="bfloat16")
+        opt.minimize(outs["loss"])
+
+    scope = pt.Scope()
+    pt.Executor().run(startup, scope=scope)
+    fn, mut_in, const_in, _ = build_sharded_step(
+        main_p, feed_names, [outs["loss"].name], mesh)
+    batches = _resnet_stream(B, image_size, mesh)
+    mut_vals = tuple(scope.find_var(n) for n in mut_in)
+    const_vals = tuple(scope.find_var(n) for n in const_in)
+
+    def run_window(step, mut_vals):
+        for _ in range(STEPS_PER_WINDOW):
+            step += 1
+            fetches, mut_vals, _ = fn(next(batches), mut_vals, const_vals,
+                                      np.int32(step))
+        return step, mut_vals, fetches
+
+    step = 0
+    for _ in range(WARMUP_WINDOWS):
+        step, mut_vals, fetches = run_window(step, mut_vals)
+    float(np.asarray(fetches[0]).reshape(-1)[0])
+
+    def rw(state):
+        step, mut_vals = state
+        step, mut_vals, fetches = run_window(step, mut_vals)
+        return (step, mut_vals), fetches
+
+    def fence(fetches):
+        loss = float(np.asarray(fetches[0]).reshape(-1)[0])
+        if not np.isfinite(loss):
+            raise RuntimeError(f"non-finite loss {loss}")  # deterministic
+        return loss
+
+    state = (step, mut_vals)
+    per_chip, rates, spread, loss, anomaly, total_reruns = measure_leg(
+        rw, fence, state, B=B, n_chips=n_chips, device=device,
+        device_kind=device_kind, faults=faults)
+
+    flops = resnet50_train_flops_per_sample(image_size)
+    peak = _peak_tflops(device) * 1e12
+    return {
+        "metric": "resnet50_imagenet_train_samples_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "samples/sec/chip",
+        "mfu": round(per_chip * flops / peak, 4),
+        "model_tflops_per_sample": round(flops / 1e12, 4),
+        "stats": leg_stats(rates, n_chips, spread, total_reruns),
+        "config": {"depth": 50, "image_size": image_size,
+                   "batch_per_chip": batch_per_chip, "n_chips": n_chips,
+                   "amp": "bfloat16", "optimizer": "momentum"},
+        "device_kind": device_kind,
+        "final_loss": round(loss, 4),
+        "anomaly": anomaly,
     }
 
 
@@ -564,6 +703,14 @@ def main():
         # can't hold batch 64 at seq-512)
         leg = run_config(512, 80, attn=True, dropout=dropout)
         out["legs"] = {"seq512": leg}
+        # second tracked BASELINE config: ResNet-50 ImageNet training
+        # (BENCH_RESNET=0 skips; BENCH_RESNET_BATCH sizes it)
+        if os.environ.get("BENCH_RESNET", "1") == "1":
+            try:
+                out["legs"]["resnet50"] = run_resnet50()
+            except Exception as e:  # a leg must not kill the flagship
+                out["legs"]["resnet50"] = {"error": f"{type(e).__name__}: "
+                                                    f"{e}"}
 
     print(json.dumps(out))
 

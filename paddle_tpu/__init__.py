@@ -13,7 +13,8 @@ from .framework.core import (Program, Variable, Parameter, OpRole,  # noqa
                              default_main_program, default_startup_program,
                              program_guard, unique_name, in_dygraph_mode,
                              convert_dtype, grad_var_name, device_guard)
-from .framework.executor import (Executor, Scope, global_scope,  # noqa
+from .framework.executor import (AsyncRunResult, Executor,  # noqa
+                                 FetchHandle, Scope, global_scope,
                                  scope_guard)
 from .framework.backward import append_backward, gradients  # noqa
 from .framework.layer_helper import ParamAttr, WeightNormParamAttr  # noqa

@@ -53,6 +53,8 @@ class GradScaler:
         self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
         self._dynamic = use_dynamic_loss_scaling
         self._good, self._bad = 0, 0
+        self._nonfinite_backoffs = 0
+        self._last_nonfinite_step: "int | None" = None
 
     def scale(self, loss):
         if not self._enable:
@@ -94,12 +96,23 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good = 0
 
-    def backoff_on_nonfinite(self):
+    def backoff_on_nonfinite(self, step=None):
         """External non-finite signal (train_guard's in-graph skip-step
         detected a NaN/Inf loss): apply the decrease path of dynamic loss
-        scaling as if minimize() had seen the inf gradient itself."""
+        scaling as if minimize() had seen the inf gradient itself.
+
+        With the deferred guard the verdict may resolve steps after the
+        fact; `step` carries the ORIGINAL step id the backoff belongs to
+        (recorded as ``last_nonfinite_step`` for logging/debugging)."""
         if self._enable:
+            self._nonfinite_backoffs += 1
+            if step is not None:
+                self._last_nonfinite_step = int(step)
             self._update(True)
+
+    @property
+    def last_nonfinite_step(self):
+        return self._last_nonfinite_step
 
     def is_enable(self):
         return self._enable

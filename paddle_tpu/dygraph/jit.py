@@ -71,7 +71,10 @@ class _StaticFunction:
             val = scope.find_var(name)
             if val is not None:
                 vb._value = val
-        out_vbs = [VarBase(r, stop_gradient=True) for r in results]
+        # unwrap lazy FetchHandles: downstream dygraph ops expect raw
+        # device arrays on VarBase._value
+        out_vbs = [VarBase(getattr(r, "value", r), stop_gradient=True)
+                   for r in results]
         return _unflatten(structure, out_vbs)
 
     def _trace(self, arrs):
@@ -198,7 +201,8 @@ class TranslatedLayer:
         res = self._exe.run(self._program, feed=feed,
                             fetch_list=self._fetches, scope=self._scope,
                             return_numpy=False)
-        outs = [VarBase(r, stop_gradient=True) for r in res]
+        outs = [VarBase(getattr(r, "value", r), stop_gradient=True)
+                for r in res]
         return outs[0] if len(outs) == 1 else outs
 
     def eval(self):

@@ -85,3 +85,18 @@ register_flag("FLAGS_checkpoint_retries", 2,
               "(exponential backoff) before giving up")
 register_flag("FLAGS_checkpoint_retry_backoff_s", 0.05,
               "base backoff (seconds) between checkpoint write retries")
+register_flag("FLAGS_guard_resolve_interval", 64,
+              "deferred non-finite guard: resolve the pending on-device "
+              "ok-verdict ring at most every N guarded steps when nothing "
+              "else (a fetch read, a checkpoint, close) forces it; "
+              "1 restores the synchronous per-step host check, 0 defers "
+              "indefinitely (fetch/checkpoint/close only)")
+register_flag("FLAGS_compile_cache_dir", "",
+              "persistent XLA compilation cache directory (jax "
+              "compilation cache; hits feed the compile_cache_hits "
+              "stat via jax's monitoring events); empty disables. Lets "
+              "TrainGuard auto-restarts skip recompilation")
+register_flag("FLAGS_feed_double_buffer", True,
+              "stage numpy Executor.run feeds onto the device through a "
+              "2-deep device_put ring so the H2D copy of step N+1 "
+              "overlaps the compute of step N")

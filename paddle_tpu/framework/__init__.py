@@ -2,7 +2,8 @@ from .core import (Block, OpRole, Operator, Parameter, Program, Variable,  # noq
                    convert_dtype, default_main_program,
                    default_startup_program, grad_var_name, in_dygraph_mode,
                    program_guard, unique_name)
-from .executor import Executor, Scope, global_scope, scope_guard  # noqa
+from .executor import (AsyncRunResult, Executor, FetchHandle, Scope,  # noqa
+                       global_scope, scope_guard)
 from .backward import append_backward, calc_gradient, gradients  # noqa
 from . import initializer  # noqa
 from .layer_helper import LayerHelper, ParamAttr  # noqa

@@ -123,9 +123,10 @@ class CompiledProgram:
                                       np.int32(exe._step))
         for n, v in zip(mut_in, new_mut):
             scope.set_var(n, v)
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+        exe._last_dispatch = new_mut
+        # same epilogue contract as Executor.run: blocking numpy, or lazy
+        # FetchHandles (run_async wraps these into its AsyncRunResult)
+        return exe._finish_fetches(list(fetches), return_numpy)
 
     def _build(self, feed_names, fetch_names):
         import jax

@@ -28,15 +28,157 @@ from ..ops.registry import LowerContext, get_op_def, lower_op
 from .core import (Block, Operator, Program, Variable, convert_dtype,
                    default_main_program, dtype_to_np)
 
-__all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
+__all__ = ["Executor", "FetchHandle", "AsyncRunResult", "Scope",
+           "global_scope", "scope_guard"]
 
 # hot-path stat handles resolved once (a per-step registry lookup would
 # pay an import + two lock acquisitions per run)
+from ..flags import flag_value  # noqa: E402
 from ..monitor import monitor as _monitor  # noqa: E402
 _STEP_STAT = _monitor.get("executor_run_steps")
 _JIT_STAT = _monitor.get("executor_jit_builds")
 _SKIP_STAT = _monitor.get("skipped_nonfinite_steps")
 _CKPT_FAIL_STAT = _monitor.get("checkpoint_write_failures")
+_HOST_SYNC_STAT = _monitor.get("host_syncs")
+_GUARD_RES_STAT = _monitor.get("guard_resolutions")
+_CACHE_HIT_STAT = _monitor.get("compile_cache_hits")
+
+# process-global latch for the jax persistent-cache dir currently applied
+# to jax.config (which is itself process-global), and a once-only flag for
+# the cache-hit monitoring listener
+_CC_ACTIVE_DIR: List[Optional[str]] = [None]
+_CC_LISTENER_ON: List[bool] = [False]
+
+
+# ---------------------------------------------------------------------------
+# Lazy fetches: the async-pipeline user handle
+# ---------------------------------------------------------------------------
+class FetchHandle:
+    """A fetch that stays on device until first host read.
+
+    ``Executor.run(..., return_numpy=False)`` / ``run_async`` return these
+    instead of blocking device arrays: the device value is held lazily and
+    the host fences (``host_syncs``) only on the first ``numpy()`` /
+    ``np.asarray`` / ``float()`` / ``block()``.  Reading a handle also
+    resolves every pending non-finite-guard verdict up to its step (the
+    step's completion proves the verdicts are ready), so guard callbacks
+    never fire later than the data they explain.
+
+    Device-side consumers never pay a sync: ``.value`` /
+    ``__jax_array__`` hand back the raw device array, and ``shape`` /
+    ``dtype`` / ``ndim`` read jax metadata without a transfer.
+    """
+
+    __slots__ = ("_value", "_exe", "_step", "_np")
+
+    def __init__(self, value, exe: Optional["Executor"] = None,
+                 step: int = 0):
+        self._value = value
+        self._exe = exe
+        self._step = step
+        self._np = None
+
+    # -- device-side (never syncs) ------------------------------------------
+    @property
+    def value(self):
+        """The underlying device array (no host fence)."""
+        return self._value
+
+    def __jax_array__(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return tuple(np.shape(self._value))
+
+    @property
+    def dtype(self):
+        return self._value.dtype if hasattr(self._value, "dtype") \
+            else np.asarray(self._value).dtype
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __getitem__(self, idx):
+        return self._value[idx]
+
+    def ravel(self):
+        return self._value.ravel()
+
+    def reshape(self, *shape):
+        return self._value.reshape(*shape)
+
+    def __repr__(self):
+        state = "read" if self._np is not None else "pending"
+        return (f"FetchHandle(step={self._step}, shape={self.shape}, "
+                f"dtype={self.dtype}, {state})")
+
+    # -- host-side (first call fences) --------------------------------------
+    def numpy(self) -> np.ndarray:
+        if self._np is None:
+            _HOST_SYNC_STAT.increase()
+            self._np = np.asarray(self._value)
+            if self._exe is not None:
+                self._exe._resolve_guard(upto=self._step)
+        return self._np
+
+    def block(self) -> "FetchHandle":
+        """Fence without copying to host (device value stays primary)."""
+        if self._np is None:
+            import jax
+            _HOST_SYNC_STAT.increase()
+            jax.block_until_ready(self._value)
+            if self._exe is not None:
+                self._exe._resolve_guard(upto=self._step)
+        return self
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a if dtype is None else a.astype(dtype, copy=False)
+
+    def __float__(self):
+        # numpy semantics: raises on a multi-element fetch instead of
+        # silently returning element 0 (masking a missing reduction)
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+
+class AsyncRunResult:
+    """What ``Executor.run_async`` hands back: the step's lazy fetches
+    plus a ``sync()`` fence.  Indexes/iterates like the list Executor.run
+    returns."""
+
+    __slots__ = ("fetches", "_exe", "_step")
+
+    def __init__(self, fetches: List[FetchHandle], exe: "Executor",
+                 step: int):
+        self.fetches = fetches
+        self._exe = exe
+        self._step = step
+
+    def __len__(self):
+        return len(self.fetches)
+
+    def __iter__(self):
+        return iter(self.fetches)
+
+    def __getitem__(self, i):
+        return self.fetches[i]
+
+    def sync(self) -> List[np.ndarray]:
+        """Block until this step (and its guard verdict) has landed;
+        returns the fetches as numpy."""
+        self._exe.sync(upto=self._step)
+        return [h.numpy() for h in self.fetches]
 
 
 # ---------------------------------------------------------------------------
@@ -191,6 +333,14 @@ class Executor:
         self.place = place
         self._cache: Dict[Tuple, Any] = {}
         self._step = 0
+        # deferred non-finite guard: ring of (step, on-device ok scalar)
+        # verdicts awaiting host resolution (see _resolve_guard)
+        self._pending_guard: List[Tuple[int, Any]] = []
+        # double-buffered feed staging: keep the last 2 steps' device_put
+        # results alive so the H2D copy of step N+1 overlaps step N's
+        # compute without recycling a buffer the in-flight step still reads
+        self._feed_ring: List[Any] = []
+        self._last_dispatch = None
 
     # -- public API ---------------------------------------------------------
     def run(self, program: Optional[Program] = None,
@@ -214,7 +364,6 @@ class Executor:
         fetch_names = _fetch_names(fetch_list)
         scope = scope or global_scope()
 
-        from ..flags import flag_value
         if flag_value("FLAGS_check_nan_inf"):
             return self._run_debug(program, feed, fetch_names, scope,
                                    return_numpy)
@@ -244,6 +393,7 @@ class Executor:
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             _JIT_STAT.increase()
+            self._ensure_compile_cache()
             entry = self._build(program, block, list(feed_arrays),
                                 fetch_names, guard_loss)
             if use_program_cache:
@@ -260,6 +410,7 @@ class Executor:
 
         mut_vals = tuple(_val(n) for n in mut_in)
         const_vals = tuple(_val(n) for n in const_in)
+        feed_vals = self._stage_feed(feed_arrays)
 
         self._step += 1
         _STEP_STAT.increase()
@@ -267,30 +418,194 @@ class Executor:
         bench = flag_value("FLAGS_benchmark")
         if bench:
             import time
+            _HOST_SYNC_STAT.increase()
             jax.block_until_ready(mut_vals)
             t0 = time.perf_counter()
         if guarded:
-            fetches, new_state, ok = fn(tuple(feed_arrays.values()),
-                                        mut_vals, const_vals, step)
+            fetches, new_state, ok = fn(feed_vals, mut_vals, const_vals,
+                                        step)
         else:
-            fetches, new_state = fn(tuple(feed_arrays.values()),
-                                    mut_vals, const_vals, step)
-            ok = True
+            fetches, new_state = fn(feed_vals, mut_vals, const_vals, step)
+            ok = None
         if bench:
+            import time
+            t_dispatch = time.perf_counter() - t0
+            _HOST_SYNC_STAT.increase()
             jax.block_until_ready((fetches, new_state))
             print(f"[FLAGS_benchmark] step {self._step}: "
-                  f"{(time.perf_counter() - t0) * 1e3:.3f} ms")
+                  f"{(time.perf_counter() - t0) * 1e3:.3f} ms "
+                  f"(host dispatch {t_dispatch * 1e3:.3f} ms)")
         for name, val in zip(state_out, new_state):
             scope.set_var(name, val)
-        if guarded and not bool(ok):
-            _SKIP_STAT.increase()
-            cb = getattr(self, "_guard_cb", None)
-            if cb is not None:
-                cb(self._step)
+        self._last_dispatch = new_state if new_state else fetches
+        if guarded:
+            # deferred verdict: keep the on-device scalar; the host learns
+            # about a skipped step lazily — on fetch read, at the resolve
+            # interval, at checkpoint time, or at close/sync
+            self._pending_guard.append((self._step, ok))
+            interval = int(flag_value("FLAGS_guard_resolve_interval") or 0)
+            if interval > 0 and len(self._pending_guard) >= interval:
+                self._resolve_guard()
         self._maybe_auto_checkpoint(program, scope)
+        return self._finish_fetches(fetches, return_numpy,
+                                    resolve_guard=True)
+
+    def _finish_fetches(self, fetches, return_numpy: bool,
+                        resolve_guard: bool = False):
+        """Common run epilogue: blocking numpy fetches (one logical fence
+        per run — the first asarray blocks on the step, the rest copy out
+        already-landed buffers) or lazy FetchHandles.  `resolve_guard`
+        marks the paths where a blocking fetch read doubles as a
+        guard-resolution point."""
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+            if not fetches:
+                return []
+            _HOST_SYNC_STAT.increase()
+            out = [np.asarray(f) for f in fetches]
+            if resolve_guard:
+                self._resolve_guard(upto=self._step)
+            return out
+        return [FetchHandle(f, self, self._step) for f in fetches]
+
+    def run_async(self, program: Optional[Program] = None,
+                  feed: Optional[Dict[str, Any]] = None,
+                  fetch_list: Optional[Sequence] = None,
+                  scope: Optional[Scope] = None,
+                  use_program_cache: bool = True) -> "AsyncRunResult":
+        """Fully asynchronous step: dispatches the compiled step and
+        returns immediately — no device→host fence anywhere on the path.
+        The result holds lazy :class:`FetchHandle`\\ s plus a ``sync()``
+        fence; a deferred non-finite guard verdict resolves on the first
+        read (or at ``FLAGS_guard_resolve_interval`` / checkpoint /
+        ``close``)."""
+        handles = self.run(program, feed, fetch_list, scope,
+                           return_numpy=False,
+                           use_program_cache=use_program_cache)
+        return AsyncRunResult(list(handles), self, self._step)
+
+    def sync(self, upto: Optional[int] = None):
+        """Host fence: block until dispatched work has completed and
+        resolve pending non-finite-guard verdicts (all of them, or those
+        up to step `upto`)."""
+        import jax
+
+        if self._last_dispatch is not None:
+            _HOST_SYNC_STAT.increase()
+            jax.block_until_ready(self._last_dispatch)
+            self._last_dispatch = None
+        self._resolve_guard(upto=upto)
+        return self
+
+    # -- deferred non-finite guard resolution -------------------------------
+    def _resolve_guard(self, upto: Optional[int] = None):
+        """Pull pending on-device ok-verdicts to the host (oldest first)
+        and fire the skip-step bookkeeping — ``skipped_nonfinite_steps`` +
+        guard callback with the ORIGINAL step id — exactly as if each had
+        been checked synchronously at its own step."""
+        pending = self._pending_guard
+        if not pending:
+            return
+        if upto is None:
+            take, rest = pending, []
+        else:
+            take = [p for p in pending if p[0] <= upto]
+            if not take:
+                return
+            rest = [p for p in pending if p[0] > upto]
+        self._pending_guard = rest
+        _GUARD_RES_STAT.increase()
+        _HOST_SYNC_STAT.increase()  # one fence resolves the whole batch
+        import jax
+        oks = jax.device_get([ok for _, ok in take])
+        cb = getattr(self, "_guard_cb", None)
+        for (step_id, _), okv in zip(take, oks):
+            if not bool(okv):
+                _SKIP_STAT.increase()
+                if cb is not None:
+                    cb(step_id)
+
+    def resolve_nonfinite_guard(self):
+        """Public fence for the deferred guard only (train_guard uses it
+        before final checkpoints and on close)."""
+        self._resolve_guard()
+
+    # -- feed staging (double buffer) ---------------------------------------
+    def _stage_feed(self, feed_arrays: Dict[str, Any]) -> Tuple:
+        """Route numpy feeds through a 2-deep ``device_put`` ring
+        (reader.stage_to_device): the H2D copy dispatches asynchronously
+        and overlaps the still-running previous step, and the executor's
+        jit call then binds already-device-resident arrays."""
+        if not feed_arrays:
+            return ()
+        if not flag_value("FLAGS_feed_double_buffer"):
+            return tuple(feed_arrays.values())
+        from ..reader import stage_to_device
+
+        staged = stage_to_device(feed_arrays)
+        self._feed_ring.append(staged)
+        if len(self._feed_ring) > 2:
+            self._feed_ring.pop(0)
+        return tuple(staged.values())
+
+    # -- persistent compilation cache ---------------------------------------
+    def _ensure_compile_cache(self):
+        """FLAGS_compile_cache_dir: point jax's persistent compilation
+        cache at the directory (so an identical XLA program — e.g. a
+        TrainGuard auto-restart — skips compilation).  Cache hits are
+        observable as the ``compile_cache_hits`` stat, fed by jax's own
+        ``/jax/compilation_cache/cache_hits`` monitoring event — ground
+        truth from the serving layer, immune to index/eviction skew (the
+        stat counts persistent-cache hits process-wide).  Clearing the
+        flag mid-process restores jax's default (no persistent cache)."""
+        cc_dir = flag_value("FLAGS_compile_cache_dir")
+        import jax
+
+        # the jax compilation-cache config is process-global, so the
+        # active-dir latch must be too: any executor instance observing a
+        # cleared/changed flag opts the whole process out/over
+        def _reset_cache_latch():
+            # jax latches cache initialization at the FIRST compile: a
+            # dir set (or cleared) later is ignored until reset_cache()
+            try:
+                from jax._src.compilation_cache import reset_cache
+                reset_cache()
+            except (ImportError, AttributeError):
+                pass  # ok: older jax initializes per-compile instead
+
+        if not cc_dir:
+            if _CC_ACTIVE_DIR[0] is not None:
+                jax.config.update("jax_compilation_cache_dir", None)
+                _CC_ACTIVE_DIR[0] = None
+                _reset_cache_latch()
+            return
+        if _CC_ACTIVE_DIR[0] != cc_dir:
+            import os
+            os.makedirs(cc_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cc_dir)
+            _reset_cache_latch()
+            # default thresholds skip tiny/fast programs — a restart
+            # wants EVERY step program cached, including the CPU-sized
+            # ones the tests compile
+            try:
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0)
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", -1)
+            except AttributeError:
+                pass  # ok: older jax without the threshold knobs
+            _CC_ACTIVE_DIR[0] = cc_dir
+        if not _CC_LISTENER_ON[0]:
+            _CC_LISTENER_ON[0] = True
+            try:
+                from jax._src import monitoring as _jm
+
+                def _on_event(event, **kw):
+                    if event == "/jax/compilation_cache/cache_hits":
+                        _CACHE_HIT_STAT.increase()
+
+                _jm.register_event_listener(_on_event)
+            except (ImportError, AttributeError):
+                pass  # ok: stat stays 0 on a jax without the event API
 
     # -- auto checkpoint ----------------------------------------------------
     def enable_auto_checkpoint(self, directory: str,
@@ -321,6 +636,9 @@ class Executor:
         ac = getattr(self, "_auto_ckpt", None)
         if not ac or self._step % ac["interval"]:
             return
+        # checkpoint is a guard-resolution point: the skip/backoff
+        # bookkeeping must be final before the state is snapshotted
+        self._resolve_guard()
         # only checkpoint runs of the bound training program: an
         # interleaved eval-program run must not snapshot a state set
         # without optimizer moments
@@ -355,6 +673,9 @@ class Executor:
         self._guard_program = program
 
     def clear_nonfinite_guard(self):
+        # resolve BEFORE dropping the callback: verdicts still in flight
+        # must fire their skip bookkeeping, not vanish
+        self._resolve_guard()
         self._guard_loss = None
         self._guard_cb = None
         self._guard_program = None
@@ -384,6 +705,7 @@ class Executor:
                     f"{n}={float(np.asarray(v).reshape(-1)[0]):.6f}"
                     for n, v in zip(info, out))
                 print(f"step {step}: {vals}")
+        self._resolve_guard()  # end of the pass: land deferred verdicts
         return step
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
@@ -463,9 +785,7 @@ class Executor:
         for name in state_out:
             scope.set_var(name, densify(env[name]))
         fetches = [densify(env[n]) for n in fetch_names]
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return fetches
+        return self._finish_fetches(fetches, return_numpy)
 
     # -- compilation --------------------------------------------------------
     def _build(self, program: Program, block: Block,
@@ -563,12 +883,14 @@ class Executor:
             scope.set_var(n, v)
         for n, v in zip(extra_out, extra):
             scope.set_var(n, v)
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+        self._last_dispatch = new_mut
+        return self._finish_fetches(fetches, return_numpy)
 
     def close(self):
+        self._resolve_guard()
         self._cache.clear()
+        self._feed_ring.clear()
+        self._last_dispatch = None
 
 
 def _fetch_names(fetch_list) -> List[str]:

@@ -41,7 +41,71 @@ class ParamAttr:
         raise TypeError(f"cannot convert {arg!r} to ParamAttr")
 
 
-WeightNormParamAttr = ParamAttr  # placeholder parity alias
+class WeightNormParamAttr(ParamAttr):
+    """Weight-normalization reparameterization (Salimans & Kingma 2016;
+    reference fluid.WeightNormParamAttr + layer_helper_base's
+    __weight_normalize): the layer's weight is computed as
+
+        w = g * v / ||v||_{except dim}
+
+    where ``v`` (direction, the weight's shape) and ``g`` (magnitude,
+    one scalar per slice along `dim`, or a single scalar for dim=None)
+    are the *trainable* parameters.  ``g`` is initialized in the startup
+    program to the norm of the freshly initialized ``v``, so the initial
+    effective weight equals the plain initialization.
+
+    Static-graph only (like the reference): in dygraph mode construction
+    warns and the attr degrades to a plain ParamAttr.
+    """
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, gradient_clip=None):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable,
+                         do_model_average=do_model_average,
+                         gradient_clip=gradient_clip)
+        self.dim = dim
+
+
+def _append_norm_except_dim(block, v_name, shape, dim, dtype,
+                            out_name=None):
+    """Append ops computing ||v|| reduced over every axis except `dim`
+    (all axes for dim=None; result reshaped to [1] then) to `block`;
+    returns the output var name.  Shared by the startup-time g seeding
+    and the per-step reparameterization."""
+    def tmp(suffix, tmp_shape):
+        var = block.create_var(name=unique_name(v_name + suffix),
+                               shape=tmp_shape, dtype=dtype)
+        return var.name
+
+    sq = tmp(".sq", list(shape))
+    block.append_op("square", inputs={"X": [v_name]},
+                    outputs={"Out": [sq]}, attrs={})
+    if dim is None:
+        red_shape, red_attrs = [], {"dim": [], "reduce_all": True,
+                                    "keep_dim": False}
+    else:
+        red_shape = [int(shape[dim])]
+        red_attrs = {"dim": [i for i in range(len(shape)) if i != dim],
+                     "keep_dim": False}
+    red = tmp(".ssq", red_shape)
+    block.append_op("reduce_sum", inputs={"X": [sq]},
+                    outputs={"Out": [red]}, attrs=red_attrs)
+    if dim is None:
+        # scalar norm -> [1] to match g's shape
+        sqrt_out = tmp(".norm", red_shape)
+        block.append_op("sqrt", inputs={"X": [red]},
+                        outputs={"Out": [sqrt_out]}, attrs={})
+        out = out_name or tmp(".norm1", [1])
+        block.append_op("reshape2", inputs={"X": [sqrt_out]},
+                        outputs={"Out": [out]}, attrs={"shape": [1]})
+        return out
+    out = out_name or tmp(".norm", red_shape)
+    block.append_op("sqrt", inputs={"X": [red]},
+                    outputs={"Out": [out]}, attrs={})
+    return out
 
 
 class LayerHelper:
@@ -69,8 +133,18 @@ class LayerHelper:
             init = (ConstantInitializer(0.0) if is_bias
                     else XavierInitializer())
         if in_dygraph_mode():
+            if isinstance(attr, WeightNormParamAttr):
+                import warnings
+                warnings.warn(
+                    "WeightNormParamAttr is static-graph only here (as in "
+                    "the reference); falling back to a plain parameter "
+                    "WITHOUT the w = g*v/||v|| reparameterization",
+                    UserWarning)
             from ..dygraph.base import create_dygraph_parameter
             return create_dygraph_parameter(name, shape, dtype, init, attr)
+        if isinstance(attr, WeightNormParamAttr):
+            return self._create_weight_norm_param(attr, name, shape, dtype,
+                                                  init)
         block = self.main_program.global_block()
         p = block.create_parameter(
             name, shape, dtype, trainable=attr.trainable,
@@ -78,6 +152,51 @@ class LayerHelper:
             optimize_attr={"learning_rate": attr.learning_rate})
         init(p, self.startup_program.global_block())
         return p
+
+    def _create_weight_norm_param(self, attr, name, shape, dtype, init):
+        """w = g * v / ||v||: create direction param `v` (the weight's
+        shape, user initializer) and magnitude param `g` (per-`dim`
+        slice), seed g with the startup-time norm of v, and append the
+        reparameterization ops to the main block so autodiff trains v and
+        g while consumers see the effective weight `w`."""
+        dim = attr.dim
+        if dim is not None:
+            dim = int(dim) % len(shape)
+            g_shape = [int(shape[dim])]
+        else:
+            g_shape = [1]
+        block = self.main_program.current_block()
+        gb = self.main_program.global_block()
+        v = gb.create_parameter(
+            name + ".w_v", shape, dtype, trainable=attr.trainable,
+            regularizer=attr.regularizer,
+            optimize_attr={"learning_rate": attr.learning_rate})
+        init(v, self.startup_program.global_block())
+        g = gb.create_parameter(
+            name + ".w_g", g_shape, dtype, trainable=attr.trainable,
+            regularizer=None,
+            optimize_attr={"learning_rate": attr.learning_rate})
+        # startup: g <- ||v_init|| so the initial effective weight equals
+        # the plain initialization (reference startup-program norm ops)
+        sb = self.startup_program.global_block()
+        sb.create_var(name=g.name, shape=g_shape, dtype=dtype,
+                      persistable=True)
+        _append_norm_except_dim(sb, v.name, shape, dim, dtype,
+                                out_name=g.name)
+        # main: recompute the norm of the LIVE v every step and rescale
+        norm = _append_norm_except_dim(block, v.name, shape, dim, dtype)
+        scale = block.create_var(name=unique_name(name + ".w_scale"),
+                                 dtype=dtype)
+        block.append_op("elementwise_div",
+                        inputs={"X": [g.name], "Y": [norm]},
+                        outputs={"Out": [scale.name]}, attrs={"axis": -1})
+        w = block.create_var(name=unique_name(name + ".w_eff"),
+                             shape=list(shape), dtype=dtype)
+        block.append_op("elementwise_mul",
+                        inputs={"X": [v.name], "Y": [scale.name]},
+                        outputs={"Out": [w.name]},
+                        attrs={"axis": 0 if dim is None else dim})
+        return w
 
     def create_variable_for_type_inference(self, dtype="float32",
                                            stop_gradient=False) -> Variable:

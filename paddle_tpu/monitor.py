@@ -5,6 +5,15 @@ StatRegistry: named thread-safe counters any subsystem bumps
 (executor steps, PS RPC calls, checkpoint writes, ...); `publish()`
 snapshots (optionally resetting) for logging/metrics export.
 
+Async-pipeline counters (framework/executor.py): ``host_syncs`` — every
+device→host fence the executor pays (block_until_ready / fetch asarray /
+guard resolution; an async 50-step run should book O(1), not O(steps));
+``guard_resolutions`` — batched resolutions of the deferred non-finite
+guard's pending verdict ring; ``compile_cache_hits`` — XLA binaries
+served from the FLAGS_compile_cache_dir persistent cache (jax's
+cache_hits monitoring event, i.e. a TrainGuard restart skipping a
+rebuild; counted process-wide).
+
 program_to_dot / save_program_dot: render a Program's op/var dataflow as
 graphviz DOT — the reference attaches graph_viz_pass to pass pipelines;
 here it is a plain function usable on any Program (and registered as an

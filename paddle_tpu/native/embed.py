@@ -23,7 +23,10 @@ def save_train_artifacts(dirname, main_program, startup_program,
     train entry (reference train/demo: ProgramDesc files on disk).
 
     feeds: {name: ([dims...], dtype, kind)} where kind is 'uniform'
-    (float data) or 'randint:N' (int labels in [0, N))."""
+    (float data), 'randint:N' (int labels in [0, N)), or
+    'linear_of:NAME' (targets computed from feed NAME through a fixed
+    random linear map — a learnable regression, so a trained loss
+    genuinely drops instead of chasing independent noise)."""
     from ..framework import serde
 
     os.makedirs(dirname, exist_ok=True)
@@ -56,12 +59,24 @@ class TrainSession:
     def _batch(self, step: int):
         rng = np.random.RandomState(1234 + step)
         feed = {}
+        derived = []
         for name, (dims, dtype, kind) in self.feeds.items():
             if kind.startswith("randint:"):
                 hi = int(kind.split(":")[1])
                 feed[name] = rng.randint(0, hi, dims).astype(dtype)
+            elif kind.startswith("linear_of:"):
+                derived.append((name, dims, dtype, kind.split(":")[1]))
             else:
                 feed[name] = rng.uniform(-1, 1, dims).astype(dtype)
+        for name, dims, dtype, src in derived:
+            x = feed[src].reshape(len(feed[src]), -1)
+            # fixed map (seed independent of step): the SAME ground truth
+            # every batch, so SGD can actually fit it
+            w = np.random.RandomState(97).uniform(
+                -1, 1, (x.shape[1], int(np.prod(dims[1:]))))
+            y = (x @ w) / x.shape[1] + 0.01 * rng.standard_normal(
+                (len(x), w.shape[1]))
+            feed[name] = y.reshape(dims).astype(dtype)
         return feed
 
     def step(self, step: int) -> float:
@@ -72,7 +87,13 @@ class TrainSession:
         return loss
 
     def improved(self) -> bool:
-        return len(self.losses) >= 2 and self.losses[-1] < self.losses[0]
+        """Window means, not single first/last batches: per-batch losses
+        are noisy even when the fit is clearly improving."""
+        if len(self.losses) < 2:
+            return False
+        k = max(1, len(self.losses) // 4)
+        return float(np.mean(self.losses[-k:])) < \
+            float(np.mean(self.losses[:k]))
 
 
 def load_train_session(model_dir: str) -> TrainSession:

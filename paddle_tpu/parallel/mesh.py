@@ -13,6 +13,27 @@ from typing import Dict, Optional, Sequence, Tuple
 import numpy as np
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs, check=False):
+    """`shard_map` across jax versions: new jax exposes `jax.shard_map`
+    with `check_vma=`, 0.4.x has `jax.experimental.shard_map.shard_map`
+    with `check_rep=`.  `check=False` disables the replication/VMA
+    checker either way (our bodies mix collectives the checker can't
+    type)."""
+    import inspect
+
+    try:
+        from jax import shard_map as sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+    params = inspect.signature(sm).parameters
+    kw = {}
+    if "check_vma" in params:
+        kw["check_vma"] = check
+    elif "check_rep" in params:
+        kw["check_rep"] = check
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
 # Canonical axis names used across the framework.
 DP_AXIS = "dp"      # data parallel (batch)
 MP_AXIS = "mp"      # tensor/model parallel

@@ -319,10 +319,6 @@ def build_hetero_pp_step(program: Program, feed_names: Sequence[str],
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P_
 
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
 
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pp schedule {schedule!r}")
@@ -519,10 +515,11 @@ def build_hetero_pp_step(program: Program, feed_names: Sequence[str],
         mut_spec = tuple([P_(PP_AXIS)] +
                          [P_() for _ in plan.shared_mut])
         const_spec = tuple(P_() for _ in const_in)
-        return shard_map(shard_body, mesh=mesh,
-                         in_specs=(feed_spec, mut_spec, const_spec, P_()),
-                         out_specs=((P_(),), mut_spec),
-                         check_vma=False)
+        from .mesh import shard_map_compat
+        return shard_map_compat(
+            shard_body, mesh,
+            in_specs=(feed_spec, mut_spec, const_spec, P_()),
+            out_specs=((P_(),), mut_spec))
 
     _cache: Dict[tuple, object] = {}
 

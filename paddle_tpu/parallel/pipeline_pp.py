@@ -299,10 +299,6 @@ def build_pp_pipeline_step(program: Program, feed_names: Sequence[str],
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
 
     loss_name = loss_name or (fetch_names[0] if fetch_names else None)
     if not loss_name:
@@ -468,11 +464,11 @@ def build_pp_pipeline_step(program: Program, feed_names: Sequence[str],
                      [P() for _ in shared_mut])
     const_spec = tuple(P() for _ in const_in)
 
-    mapped = shard_map(
-        shard_body, mesh=mesh,
+    from .mesh import shard_map_compat
+    mapped = shard_map_compat(
+        shard_body, mesh,
         in_specs=(feed_spec, mut_spec, const_spec, P()),
-        out_specs=((P(),), mut_spec),
-        check_vma=False)
+        out_specs=((P(),), mut_spec))
 
     def _step(feed_vals, mut_vals, const_vals, step):
         fetches, new_mut = mapped(feed_vals, mut_vals, const_vals, step)

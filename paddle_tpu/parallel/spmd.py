@@ -57,10 +57,6 @@ def build_spmd_step(program: Program, feed_names: Sequence[str],
     import jax
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax layout
-        from jax.experimental.shard_map import shard_map
 
     block = program.global_block()
     state_in, state_out = analyze_block(block, feed_names)
@@ -96,12 +92,12 @@ def build_spmd_step(program: Program, feed_names: Sequence[str],
                 tuple(env[n] for n in mut_in),
                 tuple(env[n] for n in extra_out))
 
-    mapped = shard_map(
-        shard_body, mesh=mesh,
+    from .mesh import shard_map_compat
+    mapped = shard_map_compat(
+        shard_body, mesh,
         in_specs=(feed_spec, mut_spec, const_spec, P()),
         out_specs=(tuple(P(batch_axis) for _ in fetch_names), mut_spec,
-                   tuple(P() for _ in extra_out)),
-        check_vma=False)
+                   tuple(P() for _ in extra_out)))
 
     fn = jax.jit(mapped, donate_argnums=(1,) if donate_state else ())
     return fn, mut_in, const_in, extra_out

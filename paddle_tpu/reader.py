@@ -33,7 +33,8 @@ import numpy as np
 
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "BatchSampler",
            "RandomSampler", "SequenceSampler", "DataLoader", "DataFeeder",
-           "batch", "shuffle", "chain", "device_prefetch"]
+           "batch", "shuffle", "chain", "device_prefetch",
+           "stage_to_device"]
 
 
 # ---------------------------------------------------------------------------
@@ -154,6 +155,27 @@ def default_collate(samples: Sequence) -> Any:
 # ---------------------------------------------------------------------------
 # device double buffering
 # ---------------------------------------------------------------------------
+def stage_to_device(batch, device=None):
+    """``device_put`` one batch (dict / tuple / array) — the building
+    block of both ``device_prefetch``'s ping-pong staging and the
+    Executor's double-buffered feed ring.  ``device_put`` dispatches
+    asynchronously, so the H2D DMA overlaps whatever step is already
+    running on the device; values that are already device arrays pass
+    through untouched (no host round-trip)."""
+    import jax
+
+    def put(v):
+        if hasattr(v, "devices") and device is None:
+            return v  # already device-resident; leave its placement alone
+        return jax.device_put(v, device)
+
+    if isinstance(batch, dict):
+        return {k: put(v) for k, v in batch.items()}
+    if isinstance(batch, (tuple, list)):
+        return type(batch)(put(v) for v in batch)
+    return put(batch)
+
+
 def device_prefetch(it: Iterable, depth: int = 2, device=None):
     """Stage batches onto the device ahead of consumption.
 
@@ -162,14 +184,9 @@ def device_prefetch(it: Iterable, depth: int = 2, device=None):
     buffered_reader.cc's ping-pong staging buffers.  ``depth`` bounds
     device memory spent on staged batches.
     """
-    import jax
 
     def put(b):
-        if isinstance(b, dict):
-            return {k: jax.device_put(v, device) for k, v in b.items()}
-        if isinstance(b, (tuple, list)):
-            return type(b)(jax.device_put(v, device) for v in b)
-        return jax.device_put(b, device)
+        return stage_to_device(b, device)
 
     it = iter(it)
     staged: List[Any] = []

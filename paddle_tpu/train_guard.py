@@ -85,6 +85,14 @@ class TrainGuard:
         self.scaler = scaler
         self.on_nonfinite = on_nonfinite
         self.skipped_steps = 0
+        # dispatch-time watermark of the last scaler backoff: a skipped
+        # step only compounds the backoff if it was DISPATCHED after the
+        # previous backoff landed (i.e. it overflowed at the reduced
+        # scale).  With the deferred guard, a whole batch of verdicts
+        # from one overflow episode resolves at once — steps in flight
+        # never saw the backoff, so they must not multiply it
+        # (decr_ratio^interval would collapse the scale to ~0).
+        self._backoff_watermark = -1
         self.resumed_step: Optional[int] = None
         self.stop_requested = False
         self._finalized = False
@@ -111,15 +119,28 @@ class TrainGuard:
 
     # -- run loop -----------------------------------------------------------
     def step(self, feed, fetch_list=None, scope=None):
+        return self._step(feed, fetch_list, scope, run_async=False)
+
+    def step_async(self, feed, fetch_list=None, scope=None):
+        """Asynchronous flavor of :meth:`step`: returns the executor's
+        :class:`AsyncRunResult` (lazy fetches + ``sync()`` fence) instead
+        of blocking numpy arrays.  Skip-step protection is identical —
+        the non-finite verdict stays on device and resolves lazily (fetch
+        read / ``FLAGS_guard_resolve_interval`` / checkpoint / close),
+        firing the scaler backoff with the original step id."""
+        return self._step(feed, fetch_list, scope, run_async=True)
+
+    def _step(self, feed, fetch_list, scope, run_async):
         if fault.fire("step") == "sigterm":
             os.kill(os.getpid(), signal.SIGTERM)
         if fault.fire("loss") == "nan":
             feed = _poison_nonfinite(feed)
         # the guard keys on the block producing the loss, not on it being
         # fetched — the caller's fetch_list passes through untouched
-        out = self.exe.run(self.program, feed=feed,
-                           fetch_list=list(fetch_list or []) or None,
-                           scope=scope)
+        runner = self.exe.run_async if run_async else self.exe.run
+        out = runner(self.program, feed=feed,
+                     fetch_list=list(fetch_list or []) or None,
+                     scope=scope)
         if self.stop_requested:
             self.finalize(scope=scope)
             raise TrainingInterrupted(self.exe._step)
@@ -131,14 +152,33 @@ class TrainGuard:
         stat_add("sigterm_received")
 
     def _skipped(self, step: int):
+        # `step` is the ORIGINAL step id the verdict belongs to — with the
+        # deferred guard, resolution may run many steps later
         self.skipped_steps += 1
         logger.warning("non-finite %r at step %d: update skipped",
                        self.loss_name, step)
         if self.scaler is not None and \
-                hasattr(self.scaler, "backoff_on_nonfinite"):
-            self.scaler.backoff_on_nonfinite()
+                hasattr(self.scaler, "backoff_on_nonfinite") and \
+                step > self._backoff_watermark:
+            # mark every step currently in flight as pre-backoff: their
+            # verdicts belong to this same overflow episode
+            self._backoff_watermark = self.exe._step
+            self._backoff(step)
         if self.on_nonfinite is not None:
             self.on_nonfinite(step)
+
+    def _backoff(self, step: int):
+        import inspect
+        try:
+            params = inspect.signature(
+                self.scaler.backoff_on_nonfinite).parameters
+            takes_step = "step" in params
+        except (TypeError, ValueError):
+            takes_step = False  # builtins/C callables: play safe
+        if takes_step:
+            self.scaler.backoff_on_nonfinite(step=step)
+        else:
+            self.scaler.backoff_on_nonfinite()
 
     # -- shutdown -----------------------------------------------------------
     def finalize(self, scope=None):
@@ -147,6 +187,10 @@ class TrainGuard:
         if self._finalized:
             return
         self._finalized = True
+        # end-of-run is a guard-resolution point: in-flight verdicts must
+        # land (skip counters, scaler backoff) before the final snapshot
+        if hasattr(self.exe, "resolve_nonfinite_guard"):
+            self.exe.resolve_nonfinite_guard()
         if not self._ckpt_dir:
             return
         from . import checkpoint as ckpt

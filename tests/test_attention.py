@@ -9,6 +9,7 @@ import paddle_tpu as pt
 from paddle_tpu import layers
 from paddle_tpu.ops.pallas import blockwise_attention, flash_attention
 from paddle_tpu.parallel import make_mesh
+from paddle_tpu.parallel.mesh import shard_map_compat
 from paddle_tpu.parallel.ring import ring_attention, ulysses_attention
 
 B, H, S, D = 2, 4, 128, 32
@@ -58,9 +59,9 @@ def test_ring_attention_matches_full(causal):
     q, k, v = _qkv()
     mesh = make_mesh({"sp": 8})
 
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(shard_map_compat(
         lambda q, k, v: ring_attention(q, k, v, "sp", causal=causal),
-        mesh=mesh, in_specs=(P(None, None, "sp"),) * 3,
+        mesh, in_specs=(P(None, None, "sp"),) * 3,
         out_specs=P(None, None, "sp")))
     out = ring(q, k, v)
     np.testing.assert_allclose(out, _naive(q, k, v, causal), atol=2e-5)
@@ -72,9 +73,9 @@ def test_ring_attention_gradients():
     mesh = make_mesh({"sp": 8})
 
     def ring_loss(q, k, v):
-        f = jax.shard_map(
+        f = shard_map_compat(
             lambda a, b, c: ring_attention(a, b, c, "sp", causal=True),
-            mesh=mesh, in_specs=(P(None, None, "sp"),) * 3,
+            mesh, in_specs=(P(None, None, "sp"),) * 3,
             out_specs=P(None, None, "sp"))
         return (f(q, k, v) ** 2).sum()
 
@@ -89,9 +90,9 @@ def test_ulysses_matches_full(causal):
     q, k, v = _qkv()
     mesh = make_mesh({"sp": 4})  # H=4 heads divisible by 4
 
-    uly = jax.jit(jax.shard_map(
+    uly = jax.jit(shard_map_compat(
         lambda q, k, v: ulysses_attention(q, k, v, "sp", causal=causal),
-        mesh=mesh, in_specs=(P(None, None, "sp"),) * 3,
+        mesh, in_specs=(P(None, None, "sp"),) * 3,
         out_specs=P(None, None, "sp")))
     out = uly(q, k, v)
     np.testing.assert_allclose(out, _naive(q, k, v, causal), atol=2e-5)

@@ -358,6 +358,77 @@ def test_sigterm_writes_final_checkpoint_and_resumes_bitexact(tmp_path):
     np.testing.assert_array_equal(np.asarray(w_resumed), w_clean)
 
 
+def test_deferred_guard_interval_bitexact_resume(tmp_path):
+    """Async-pipeline interaction: `loss:nan@N` with
+    FLAGS_guard_resolve_interval=8 and fetch-free async steps — the skip
+    verdict resolves in deferred batches (at checkpoints/close, never
+    per step), yet `skipped_nonfinite_steps` is exact, the callback gets
+    the ORIGINAL step id, and crash+resume stays bit-exact because the
+    skip re-selection never left the graph."""
+    d = str(tmp_path / "ck")
+    loss, w_name = _net()
+    feed = _feed()
+    pt.set_flags({"FLAGS_guard_resolve_interval": 8})
+    try:
+        fault.configure("loss:nan@3")
+        sk0 = stat_get("skipped_nonfinite_steps")
+        seen = []
+        exe = _startup()
+        g = TrainGuard(exe, loss, checkpoint_dir=d, interval_steps=3,
+                       keep_last_n=5, handle_sigterm=False,
+                       on_nonfinite=seen.append)
+        for _ in range(7):          # counter steps 2..8, nan at 4
+            g.step_async(feed)      # fetch-free: nothing resolves inline
+        g.close()
+        fault.reset()
+        assert stat_get("skipped_nonfinite_steps") == sk0 + 1
+        assert g.skipped_steps == 1 and seen == [4]
+        assert ckpt.latest_step(d) == 6
+
+        # life 2 (after the crash): resume at 6, finish steps 7..8
+        s2 = pt.Scope()
+        exe2 = _startup(s2)
+        with pt.scope_guard(s2):
+            g2 = TrainGuard(exe2, loss, checkpoint_dir=d,
+                            interval_steps=3, keep_last_n=5,
+                            handle_sigterm=False)
+            assert g2.resumed_step == 6
+            while exe2._step < 8:
+                g2.step_async(feed, scope=s2)
+            g2.close()
+        w_resumed = s2.find_var(w_name)
+        assert w_resumed is not None
+        # comparator: identical feed every step, so 7 guarded steps with
+        # one in-graph skip == 6 clean steps, bit-exact
+        w_clean = _clean_params(loss, feed, 6, w_name)
+        np.testing.assert_array_equal(np.asarray(w_resumed), w_clean)
+    finally:
+        pt.set_flags({"FLAGS_guard_resolve_interval": 64})
+
+
+def test_deferred_guard_scaler_backoff_on_resolution():
+    """GradScaler backoff fires at RESOLUTION time (not dispatch) and
+    records the original non-finite step id."""
+    loss, _w = _net()
+    feed = _feed()
+    fault.configure("loss:nan@2")
+    scaler = pt.amp.GradScaler(enable=True, init_loss_scaling=8.0,
+                               decr_every_n_nan_or_inf=1)
+    exe = _startup()
+    g = TrainGuard(exe, loss, scaler=scaler, handle_sigterm=False)
+    pt.set_flags({"FLAGS_guard_resolve_interval": 0})
+    try:
+        for _ in range(4):          # counter steps 2..5, nan at 3
+            g.step_async(feed)
+        assert scaler.get_scale() == 8.0       # verdict still on device
+        exe.resolve_nonfinite_guard()
+        assert scaler.get_scale() == 4.0       # backoff landed
+        assert scaler.last_nonfinite_step == 3
+        g.close()
+    finally:
+        pt.set_flags({"FLAGS_guard_resolve_interval": 64})
+
+
 def test_explicit_corrupt_step_raises_before_scope_mutation(tmp_path):
     d = str(tmp_path)
     loss, w_name = _net()

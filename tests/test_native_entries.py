@@ -39,7 +39,7 @@ def _build_regression_artifacts(dirname):
     save_train_artifacts(
         dirname, main, startup,
         feeds={"x": ([16, 8], "float32", "uniform"),
-               "y": ([16, 1], "float32", "uniform")},
+               "y": ([16, 1], "float32", "linear_of:x")},
         fetch_name=loss.name)
 
 

@@ -623,7 +623,7 @@ def test_ps_half_async_mode_selected_and_converges():
     """half_async: a_sync + half_async config; bounded staleness — the
     loss must still converge, and pushes must only reach the server at
     window boundaries (reference communicator.h:340)."""
-    feeds = _batches(150)
+    feeds = _batches(300)
     strategy = DistributedStrategy()
     strategy.a_sync = True
     strategy.a_sync_configs = {"k_steps": 4, "half_async": True}

@@ -14,7 +14,7 @@ import pytest
 import paddle_tpu as pt
 
 
-def _train(is_sparse, opt_factory, steps=4, lazy=False, vocab=13, dim=4):
+def _train(is_sparse, opt_factory, steps=24, lazy=False, vocab=13, dim=4):
     from paddle_tpu.ops.registry import reset_op_seed
 
     pt.framework.core.reset_unique_name()
@@ -42,10 +42,14 @@ def _train(is_sparse, opt_factory, steps=4, lazy=False, vocab=13, dim=4):
     exe = pt.Executor()
     exe.run(startup, scope=scope)
     rng = np.random.RandomState(0)
+    # labels follow a FIXED per-row target table so the objective is
+    # learnable and loss reliably decreases (independent random labels
+    # made the `it actually trains` check a per-seed coin flip)
+    target = np.random.RandomState(42).uniform(-1, 1, (vocab, dim))
     losses = []
     for _ in range(steps):
         ids_v = rng.randint(0, vocab, (8, 5)).astype("int64")
-        lab_v = rng.uniform(-1, 1, (8, dim)).astype("float32")
+        lab_v = target[ids_v].mean(axis=1).astype("float32")
         l, = exe.run(main, feed={"ids": ids_v, "label": lab_v},
                      fetch_list=[loss], scope=scope)
         losses.append(float(np.asarray(l).reshape(-1)[0]))
@@ -54,7 +58,7 @@ def _train(is_sparse, opt_factory, steps=4, lazy=False, vocab=13, dim=4):
 
 
 @pytest.mark.parametrize("opt", [
-    lambda: pt.optimizer.SGDOptimizer(0.1),
+    lambda: pt.optimizer.SGDOptimizer(1.0),
     lambda: pt.optimizer.MomentumOptimizer(0.1, momentum=0.9),
     lambda: pt.optimizer.AdamOptimizer(0.05),
     lambda: pt.optimizer.AdagradOptimizer(0.1),
@@ -65,7 +69,9 @@ def test_sparse_dense_trajectory_parity(opt):
     np.testing.assert_allclose(sparse_losses, dense_losses, rtol=2e-5,
                                atol=1e-6)
     np.testing.assert_allclose(sparse_w, dense_w, rtol=2e-5, atol=1e-6)
-    assert dense_losses[-1] < dense_losses[0]  # it actually trains
+    # window means: single-batch first-vs-last is a coin flip (each batch
+    # samples different rows of the target table)
+    assert np.mean(dense_losses[-3:]) < np.mean(dense_losses[:3])
 
 
 def test_grad_var_is_selected_rows_type():

@@ -1,0 +1,95 @@
+"""Per-step host-side dispatch overhead: synchronous vs async guard path.
+
+Quantifies the tentpole of the async-executor work: with the
+PR-1-era synchronous guard, every guarded ``Executor.run`` paid a
+device->host fence (``bool(ok)``) plus a blocking fetch, serializing
+dispatch; the deferred guard + ``run_async`` keep the whole step loop
+fence-free (host_syncs stays O(1) over the run).
+
+Measures HOST time spent inside the run call only — the time until the
+step is dispatched, not until the device finishes — which is exactly the
+overhead that caps dispatch pipelining.  Prints one JSON line:
+
+    {"steps": N,
+     "sync_ms_per_step":  <run(); fetch + per-step guard resolve>,
+     "async_ms_per_step": <run_async(); no fence>,
+     "sync_host_syncs": ..., "async_host_syncs": ...,
+     "speedup": sync/async}
+
+Run on the real chip for the numbers quoted in BENCH/PR descriptions;
+on CPU the ordering is the same, the magnitudes smaller.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def build_net(hidden=256):
+    import paddle_tpu as pt
+    from paddle_tpu import layers, optimizer
+
+    x = layers.data("x", [hidden])
+    y = layers.data("y", [1])
+    h = layers.fc(x, hidden, act="relu")
+    pred = layers.fc(h, 1)
+    loss = layers.mean(pt.layers.square_error_cost(pred, y))
+    optimizer.SGDOptimizer(0.01).minimize(loss)
+    return loss
+
+
+def measure(steps=50, hidden=256, batch=64):
+    import paddle_tpu as pt
+    from paddle_tpu.monitor import stat_get
+    from paddle_tpu.train_guard import TrainGuard
+
+    loss = build_net(hidden)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(batch, hidden).astype("float32"),
+            "y": rng.rand(batch, 1).astype("float32")}
+
+    guard = TrainGuard(exe, loss, handle_sigterm=False)
+
+    def timed(fn):
+        fn()  # warm the program cache (compile off the clock)
+        exe.sync()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            fn()
+        dt = time.perf_counter() - t0
+        exe.sync()
+        return dt / steps * 1e3
+
+    # synchronous path: per-step resolve (interval=1) + blocking fetch —
+    # the PR-1 behavior (bool(ok) + np.asarray every step)
+    pt.set_flags({"FLAGS_guard_resolve_interval": 1})
+    h0 = stat_get("host_syncs")
+    sync_ms = timed(lambda: guard.step(feed, fetch_list=[loss]))
+    sync_syncs = stat_get("host_syncs") - h0
+
+    # async path: deferred guard, lazy fetches, no fence until sync()
+    pt.set_flags({"FLAGS_guard_resolve_interval": 0})
+    h0 = stat_get("host_syncs")
+    async_ms = timed(lambda: guard.step_async(feed, fetch_list=[loss]))
+    async_syncs = stat_get("host_syncs") - h0
+    pt.set_flags({"FLAGS_guard_resolve_interval": 64})
+    guard.close()
+
+    return {"steps": steps, "hidden": hidden, "batch": batch,
+            "sync_ms_per_step": round(sync_ms, 4),
+            "async_ms_per_step": round(async_ms, 4),
+            "sync_host_syncs": int(sync_syncs),
+            "async_host_syncs": int(async_syncs),
+            "speedup": round(sync_ms / max(async_ms, 1e-9), 2)}
+
+
+if __name__ == "__main__":
+    print(json.dumps(measure()))
