@@ -248,7 +248,11 @@ def measure_leg(rw, fence, state, *, B, n_chips, device, device_kind,
     measure_windows (with its per-window outlier re-runs), classifies
     spread/floor anomalies, and re-runs the whole measurement once before
     letting an anomalous number out.  Returns
-    (per_chip, rates, spread, loss, anomaly, total_reruns)."""
+    (per_chip, rates, spread, loss, anomaly, total_reruns, telemetry) —
+    `telemetry` embeds a monitor.publish() counter snapshot plus a
+    per-step duration histogram (paddle_tpu/telemetry.py Histogram
+    p50/p95/p99) over this leg's measured windows, so every BENCH_*.json
+    carries the observability trail, not just wall-clock."""
     floor = FLOORS["tpu" if "tpu" in device.platform.lower() else "cpu"]
     total_reruns = 0
     for _attempt in range(2):
@@ -269,7 +273,23 @@ def measure_leg(rw, fence, state, *, B, n_chips, device, device_kind,
                        f"{floor} for {device_kind}")
         if anomaly is None:
             break  # clean measurement; else re-run once before publishing
-    return per_chip, rates, spread, loss, anomaly, total_reruns
+    telemetry = leg_telemetry(dts)
+    return per_chip, rates, spread, loss, anomaly, total_reruns, telemetry
+
+
+def leg_telemetry(dts):
+    """Per-leg telemetry block: cumulative monitor counters at leg end +
+    a fixed-bucket step-duration histogram over the leg's own windows
+    (fresh per leg — step times from one config must not pollute the
+    percentiles of the next)."""
+    from paddle_tpu.monitor import monitor as _monitor
+    from paddle_tpu.telemetry import Histogram
+
+    hist = Histogram("bench_step_ms")
+    for dt in dts:
+        hist.observe(dt * 1e3 / STEPS_PER_WINDOW)
+    return {"monitor": dict(_monitor.publish()),
+            "step_ms": hist.summary()}
 
 
 def leg_stats(rates, n_chips, spread, reruns):
@@ -510,7 +530,8 @@ def _run_config_once(seq, batch_per_chip, *, attn=None, dropout=0.1,
         return loss
 
     state = (step, mut_vals)
-    per_chip, rates, spread, loss, anomaly, total_reruns = measure_leg(
+    (per_chip, rates, spread, loss, anomaly, total_reruns,
+     telemetry) = measure_leg(
         rw, fence, state, B=B, n_chips=n_chips, device=device,
         device_kind=device_kind, faults=faults)
 
@@ -538,6 +559,7 @@ def _run_config_once(seq, batch_per_chip, *, attn=None, dropout=0.1,
         "device_kind": device_kind,
         "final_loss": round(loss, 4),
         "anomaly": anomaly,
+        "telemetry": telemetry,
         "deviations": (["flash attention folds out attention-probability "
                         "dropout (output dropout kept)"]
                        if use_flash is True and dropout else []),
@@ -645,7 +667,8 @@ def _run_resnet50_once(batch_per_chip, image_size, *, faults=None):
         return loss
 
     state = (step, mut_vals)
-    per_chip, rates, spread, loss, anomaly, total_reruns = measure_leg(
+    (per_chip, rates, spread, loss, anomaly, total_reruns,
+     telemetry) = measure_leg(
         rw, fence, state, B=B, n_chips=n_chips, device=device,
         device_kind=device_kind, faults=faults)
 
@@ -664,6 +687,7 @@ def _run_resnet50_once(batch_per_chip, image_size, *, faults=None):
         "device_kind": device_kind,
         "final_loss": round(loss, 4),
         "anomaly": anomaly,
+        "telemetry": telemetry,
     }
 
 

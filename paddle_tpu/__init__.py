@@ -43,6 +43,7 @@ from .train_guard import TrainGuard, TrainingInterrupted  # noqa
 from . import memory  # noqa
 from . import tensor  # noqa  (paddle.tensor 2.0 namespace)
 from . import monitor  # noqa  (StatRegistry + graphviz dumps)
+from . import telemetry  # noqa  (spans, typed metrics, exporters)
 from . import amp  # noqa  (paddle.amp 2.0 namespace)
 from . import errors  # noqa
 from .errors import EnforceNotMet, enforce  # noqa

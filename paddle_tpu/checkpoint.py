@@ -23,7 +23,12 @@ one.  Transient write errors retry with exponential backoff
 Observability (monitor stats): ``checkpoint_writes``,
 ``checkpoint_retries``, ``checkpoint_fallback`` (orbax → pickle),
 ``checkpoint_corrupt_skipped``, ``checkpoint_resumes``,
-``checkpoints_gc``, ``checkpoint_tmp_cleaned``.
+``checkpoints_gc``, ``checkpoint_tmp_cleaned``,
+``checkpoint_bytes_written`` (payload bytes per published checkpoint,
+cumulative).  Telemetry (paddle_tpu/telemetry.py): ``ckpt/write`` /
+``ckpt/publish`` / ``ckpt/gc`` / ``ckpt/restore`` spans, a
+``checkpoint_write_ms`` duration histogram, and ``ckpt_publish`` /
+``ckpt_resume`` JSONL events.
 """
 from __future__ import annotations
 
@@ -38,6 +43,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from . import fault
+from . import telemetry
 from .flags import flag_value
 from .monitor import stat_add
 
@@ -158,31 +164,37 @@ def save_checkpoint(directory: str, step: int, program=None, scope=None,
     retries = int(flag_value("FLAGS_checkpoint_retries") or 0)
     backoff = float(flag_value("FLAGS_checkpoint_retry_backoff_s") or 0)
     last_err: Optional[OSError] = None
-    for attempt in range(retries + 1):
-        if attempt:
-            stat_add("checkpoint_retries")
-            time.sleep(backoff * (2 ** (attempt - 1)))
-        tmp = os.path.join(
-            directory, f"{_TMP_PREFIX}{step}-{os.getpid()}-{attempt}")
-        try:
-            _write_once(tmp, final, arrays, step, use_orbax)
-            stat_add("checkpoint_writes")
-            break
-        except OSError as e:
-            last_err = e
-            logger.warning("checkpoint write for step %s failed "
-                           "(attempt %d/%d): %s",
-                           step, attempt + 1, retries + 1, e)
-            shutil.rmtree(tmp, ignore_errors=True)
-    else:
-        raise last_err
+    with telemetry.trace_span("ckpt/write", step=int(step)), \
+            telemetry.timer("checkpoint_write_ms"):
+        for attempt in range(retries + 1):
+            if attempt:
+                stat_add("checkpoint_retries")
+                time.sleep(backoff * (2 ** (attempt - 1)))
+            tmp = os.path.join(
+                directory, f"{_TMP_PREFIX}{step}-{os.getpid()}-{attempt}")
+            try:
+                manifest = _write_once(tmp, final, arrays, step, use_orbax)
+                stat_add("checkpoint_writes")
+                break
+            except OSError as e:
+                last_err = e
+                logger.warning("checkpoint write for step %s failed "
+                               "(attempt %d/%d): %s",
+                               step, attempt + 1, retries + 1, e)
+                shutil.rmtree(tmp, ignore_errors=True)
+        else:
+            raise last_err
+    nbytes = sum(f["bytes"] for f in manifest.get("files", {}).values())
+    stat_add("checkpoint_bytes_written", nbytes)
+    telemetry.log_event("ckpt_publish", step=int(step), bytes=nbytes,
+                        format=manifest.get("format"), attempts=attempt + 1)
     if keep_last_n:
         gc_checkpoints(directory, keep_last_n)
     return final
 
 
 def _write_once(tmp: str, final: str, arrays: Dict[str, np.ndarray],
-                step: int, use_orbax: bool):
+                step: int, use_orbax: bool) -> dict:
     kind = fault.fire("ckpt_write")
     if kind == "raise":
         raise fault.InjectedFault(
@@ -206,15 +218,17 @@ def _write_once(tmp: str, final: str, arrays: Dict[str, np.ndarray],
             pickle.dump(arrays, f, protocol=2)
             f.flush()
             os.fsync(f.fileno())
-    _write_manifest(tmp, step, fmt)
-    if os.path.isdir(final):
-        shutil.rmtree(final)
-    elif os.path.exists(final):
-        os.remove(final)
-    os.replace(tmp, final)
+    manifest = _write_manifest(tmp, step, fmt)
+    with telemetry.trace_span("ckpt/publish", step=int(step)):
+        if os.path.isdir(final):
+            shutil.rmtree(final)
+        elif os.path.exists(final):
+            os.remove(final)
+        os.replace(tmp, final)
     if kind in ("torn", "partial"):
         # simulate storage failure after publish: data never hit the disk
         _inject_corruption(final, kind)
+    return manifest
 
 
 def _inject_corruption(path: str, kind: str):
@@ -337,19 +351,22 @@ def restore_latest(directory: str, program=None,
     scope = scope or global_scope()
     if not os.path.isdir(directory):
         return None, {}
-    for step in sorted(_entries(directory), reverse=True):
-        try:
-            state = _load_state(directory, step)
-        except (CheckpointCorrupt, FileNotFoundError) as e:
-            stat_add("checkpoint_corrupt_skipped")
-            logger.warning("skipping corrupt checkpoint step %s: %s",
-                           step, e)
-            continue
-        # only mutate the scope once a checkpoint fully deserialized: a
-        # torn read must not leave a half-restored state behind
-        extra = _apply_state(state, program, scope)
-        stat_add("checkpoint_resumes")
-        return step, extra
+    with telemetry.trace_span("ckpt/restore", dir=directory):
+        for step in sorted(_entries(directory), reverse=True):
+            try:
+                state = _load_state(directory, step)
+            except (CheckpointCorrupt, FileNotFoundError) as e:
+                stat_add("checkpoint_corrupt_skipped")
+                logger.warning("skipping corrupt checkpoint step %s: %s",
+                               step, e)
+                continue
+            # only mutate the scope once a checkpoint fully deserialized:
+            # a torn read must not leave a half-restored state behind
+            extra = _apply_state(state, program, scope)
+            stat_add("checkpoint_resumes")
+            telemetry.log_event("ckpt_resume", step=int(step),
+                                dir=directory)
+            return step, extra
     return None, {}
 
 
@@ -385,23 +402,26 @@ def gc_checkpoints(directory: str, keep_last_n: int) -> int:
     if not os.path.isdir(directory):
         return 0
     removed = 0
-    for name in os.listdir(directory):
-        if name.startswith(_TMP_PREFIX):
-            shutil.rmtree(os.path.join(directory, name),
-                          ignore_errors=True)
-            stat_add("checkpoint_tmp_cleaned")
-    entries = _entries(directory)
-    kept_valid = 0
-    for step in sorted(entries, reverse=True):
-        if kept_valid < keep_last_n:
-            # shallow check: retention ordering must not re-hash every
-            # retained checkpoint on every save (load still deep-checks)
-            if validate_checkpoint(directory, step, deep=False):
-                kept_valid += 1
-            continue
-        for name in entries[step]:
-            path = os.path.join(directory, name)
-            shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)
-            removed += 1
-            stat_add("checkpoints_gc")
+    with telemetry.trace_span("ckpt/gc", keep=keep_last_n):
+        for name in os.listdir(directory):
+            if name.startswith(_TMP_PREFIX):
+                shutil.rmtree(os.path.join(directory, name),
+                              ignore_errors=True)
+                stat_add("checkpoint_tmp_cleaned")
+        entries = _entries(directory)
+        kept_valid = 0
+        for step in sorted(entries, reverse=True):
+            if kept_valid < keep_last_n:
+                # shallow check: retention ordering must not re-hash
+                # every retained checkpoint on every save (load still
+                # deep-checks)
+                if validate_checkpoint(directory, step, deep=False):
+                    kept_valid += 1
+                continue
+            for name in entries[step]:
+                path = os.path.join(directory, name)
+                shutil.rmtree(path) if os.path.isdir(path) \
+                    else os.remove(path)
+                removed += 1
+                stat_add("checkpoints_gc")
     return removed

@@ -18,13 +18,14 @@ Spec grammar (``FLAGS_fault_inject``)::
 Sites are names agreed between the injector and the instrumented code;
 the ones wired in-tree:
 
-    ==========  ============================  =====================
-    site        instrumented in               kinds understood
-    ==========  ============================  =====================
-    ckpt_write  checkpoint.save_checkpoint    raise | torn | partial
-    loss        train_guard.TrainGuard.step   nan
-    step        train_guard.TrainGuard.step   sigterm
-    ==========  ============================  =====================
+    =============  ============================  =====================
+    site           instrumented in               kinds understood
+    =============  ============================  =====================
+    ckpt_write     checkpoint.save_checkpoint    raise | torn | partial
+    loss           train_guard.TrainGuard.step   nan
+    step           train_guard.TrainGuard.step   sigterm
+    metrics_write  telemetry exporters           raise
+    =============  ============================  =====================
 
 Every fired fault bumps ``faults_injected`` plus a per-site/kind
 ``fault_<site>_<kind>`` counter.

@@ -100,3 +100,19 @@ register_flag("FLAGS_feed_double_buffer", True,
               "stage numpy Executor.run feeds onto the device through a "
               "2-deep device_put ring so the H2D copy of step N+1 "
               "overlaps the compute of step N")
+register_flag("FLAGS_telemetry", True,
+              "master switch for paddle_tpu/telemetry.py: 0 turns spans, "
+              "typed metrics, and every file exporter into constant-time "
+              "no-ops (one dict lookup on the hot path)")
+register_flag("FLAGS_metrics_dir", "",
+              "directory for the telemetry file exporters (metrics.prom "
+              "Prometheus textfile, events.jsonl event log, heartbeat.json "
+              "health file, trace.json Perfetto trace); empty disables "
+              "all file output")
+register_flag("FLAGS_metrics_interval", 10.0,
+              "seconds between periodic telemetry flushes (Prometheus "
+              "textfile + heartbeat + trace), checked on the hot path "
+              "with one monotonic read")
+register_flag("FLAGS_trace_buffer_size", 4096,
+              "capacity of the completed-span ring buffer "
+              "(paddle_tpu/telemetry.py); oldest spans drop first")

@@ -17,12 +17,25 @@ intermediate lifetimes are managed by XLA's buffer assignment.
 
 Randomness is stateless: a per-run step counter is folded into a base key
 derived from program.random_seed (replaces cuRAND generator state).
+
+Telemetry (paddle_tpu/telemetry.py; all opt-out via ``FLAGS_telemetry=0``):
+every compiled run opens an ``executor/step`` span with
+``executor/compile`` (jit build), ``executor/dispatch`` (the compiled
+call), and ``executor/fetch`` (blocking host reads) children; the host
+wall time per run feeds the ``executor_step_host_ms`` histogram and the
+``examples_per_sec`` gauge / heartbeat via ``telemetry.note_step``, the
+feed double-buffer depth feeds the ``feed_ring_occupancy`` gauge, and
+the run epilogue drives the periodic exporter flush
+(``telemetry.maybe_flush``).
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from .. import telemetry as _telemetry
 
 from ..ops.registry import LowerContext, get_op_def, lower_op
 from .core import (Block, Operator, Program, Variable, convert_dtype,
@@ -349,8 +362,6 @@ class Executor:
             scope: Optional[Scope] = None,
             return_numpy: bool = True,
             use_program_cache: bool = True):
-        import jax
-
         if program is None:
             program = default_main_program()
         # CompiledProgram (data-parallel wrapper) delegates here
@@ -367,6 +378,29 @@ class Executor:
         if flag_value("FLAGS_check_nan_inf"):
             return self._run_debug(program, feed, fetch_names, scope,
                                    return_numpy)
+
+        if not _telemetry.enabled():
+            return self._run_compiled(program, feed, fetch_names, scope,
+                                      return_numpy, use_program_cache)[0]
+        t0 = time.perf_counter()
+        span = _telemetry.span_begin("executor/step", step=self._step + 1)
+        try:
+            out, examples = self._run_compiled(
+                program, feed, fetch_names, scope, return_numpy,
+                use_program_cache)
+        finally:
+            _telemetry.span_end(span)
+        _telemetry.note_step(self._step,
+                             (time.perf_counter() - t0) * 1e3, examples)
+        _telemetry.maybe_flush()
+        return out
+
+    def _run_compiled(self, program, feed, fetch_names, scope,
+                      return_numpy, use_program_cache):
+        """The compiled-run body of :meth:`run`; returns (fetch result,
+        examples in this step's feed) so the telemetry wrapper can feed
+        the throughput gauge without re-inspecting the feed."""
+        import jax
 
         block = program.global_block()
         feed_arrays = _prepare_feed(block, feed)
@@ -394,8 +428,11 @@ class Executor:
         if entry is None:
             _JIT_STAT.increase()
             self._ensure_compile_cache()
-            entry = self._build(program, block, list(feed_arrays),
-                                fetch_names, guard_loss)
+            with _telemetry.trace_span("executor/compile",
+                                       program=program._uid,
+                                       fetches=len(fetch_names)):
+                entry = self._build(program, block, list(feed_arrays),
+                                    fetch_names, guard_loss)
             if use_program_cache:
                 self._cache[key] = entry
         fn, mut_in, const_in, state_out, guarded = entry
@@ -417,18 +454,19 @@ class Executor:
         step = np.int32(self._step)
         bench = flag_value("FLAGS_benchmark")
         if bench:
-            import time
             _HOST_SYNC_STAT.increase()
             jax.block_until_ready(mut_vals)
             t0 = time.perf_counter()
+        dspan = _telemetry.span_begin("executor/dispatch",
+                                      step=self._step, guarded=guarded)
         if guarded:
             fetches, new_state, ok = fn(feed_vals, mut_vals, const_vals,
                                         step)
         else:
             fetches, new_state = fn(feed_vals, mut_vals, const_vals, step)
             ok = None
+        _telemetry.span_end(dspan)
         if bench:
-            import time
             t_dispatch = time.perf_counter() - t0
             _HOST_SYNC_STAT.increase()
             jax.block_until_ready((fetches, new_state))
@@ -447,8 +485,12 @@ class Executor:
             if interval > 0 and len(self._pending_guard) >= interval:
                 self._resolve_guard()
         self._maybe_auto_checkpoint(program, scope)
+        examples = 0
+        if feed_arrays:
+            shape = np.shape(next(iter(feed_arrays.values())))
+            examples = int(shape[0]) if shape else 0
         return self._finish_fetches(fetches, return_numpy,
-                                    resolve_guard=True)
+                                    resolve_guard=True), examples
 
     def _finish_fetches(self, fetches, return_numpy: bool,
                         resolve_guard: bool = False):
@@ -461,7 +503,9 @@ class Executor:
             if not fetches:
                 return []
             _HOST_SYNC_STAT.increase()
-            out = [np.asarray(f) for f in fetches]
+            with _telemetry.trace_span("executor/fetch",
+                                       n=len(fetches), step=self._step):
+                out = [np.asarray(f) for f in fetches]
             if resolve_guard:
                 self._resolve_guard(upto=self._step)
             return out
@@ -545,6 +589,9 @@ class Executor:
         self._feed_ring.append(staged)
         if len(self._feed_ring) > 2:
             self._feed_ring.pop(0)
+        # occupancy 2 = the ring is actually overlapping H2D with compute;
+        # stuck at 1 means feeds are arriving slower than steps complete
+        _telemetry.gauge_set("feed_ring_occupancy", len(self._feed_ring))
         return tuple(staged.values())
 
     # -- persistent compilation cache ---------------------------------------
@@ -891,6 +938,7 @@ class Executor:
         self._cache.clear()
         self._feed_ring.clear()
         self._last_dispatch = None
+        _telemetry.flush()  # final exporter write (no-op without a dir)
 
 
 def _fetch_names(fetch_list) -> List[str]:

@@ -59,6 +59,7 @@ class StatRegistry:
     (reference StatRegistry::Instance)."""
 
     _instance = None
+    _instance_lock = threading.Lock()
 
     def __init__(self):
         self._stats: Dict[str, StatValue] = {}
@@ -66,8 +67,13 @@ class StatRegistry:
 
     @classmethod
     def instance(cls) -> "StatRegistry":
+        # double-checked under a class lock: the unlocked check-then-set
+        # could hand two racing importers two registries, silently
+        # splitting the counters between them
         if cls._instance is None:
-            cls._instance = cls()
+            with cls._instance_lock:
+                if cls._instance is None:
+                    cls._instance = cls()
         return cls._instance
 
     def get(self, name: str) -> StatValue:
@@ -78,11 +84,26 @@ class StatRegistry:
             return s
 
     def publish(self, reset: bool = False) -> List[Tuple[str, int]]:
+        """Point-in-time snapshot of every stat, optionally resetting.
+
+        Atomic: all per-stat locks are acquired (in name order) before
+        any value is read, so writers racing the publish land either
+        entirely before the snapshot or entirely after it — a
+        ``reset=True`` publish can no longer tear across stats or lose
+        increments from cached StatValue handles that bypass the
+        registry."""
         with self._lock:
-            stats = list(self._stats.items())
-        out = []
-        for name, s in sorted(stats):
-            out.append((name, s.reset() if reset else s.get()))
+            stats = sorted(self._stats.items())
+            for _, s in stats:
+                s._lock.acquire()
+            try:
+                out = [(name, s._v) for name, s in stats]
+                if reset:
+                    for _, s in stats:
+                        s._v = 0
+            finally:
+                for _, s in stats:
+                    s._lock.release()
         return out
 
 

@@ -13,6 +13,10 @@ equivalent collectives from the annotations.
 Overflowed tokens (beyond an expert's capacity) contribute zero from the
 expert path — callers keep the residual connection so dropped tokens
 pass through, exactly the Switch semantics.
+
+Monitor stats: ``collective_all_to_all_calls`` /
+``collective_psum_calls`` count collective ops emitted at trace time
+(per program build) on the explicit shard_map path.
 """
 from __future__ import annotations
 
@@ -20,6 +24,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..monitor import stat_add
 from .mesh import EP_AXIS
 
 
@@ -75,6 +80,8 @@ def moe_ffn_tokens(x, gate_w, w1, b1, w2, b2, *,
         return out + b2_.astype("float32")[:, None, :]
 
     if axis_name:
+        stat_add("collective_psum_calls")
+        stat_add("collective_all_to_all_calls", 2)  # dispatch + combine
         ep = lax.psum(1, axis_name)                      # axis size
         el = E // ep                                     # local experts
         me = lax.axis_index(axis_name)

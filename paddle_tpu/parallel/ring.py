@@ -15,11 +15,17 @@ XLA's latency-hiding scheduler.
 Ulysses (head-scatter): all_to_all converts the seq shard into a head
 shard, runs dense local attention on full sequences for H/n heads, and
 converts back. Cheaper comm for moderate S; requires H % n == 0.
+
+Monitor stats: ``collective_ppermute_calls`` /
+``collective_all_to_all_calls`` count the collective ops *emitted at
+trace time* (once per program build, not per device step) — a cheap
+audit of how much ICI traffic each compiled program carries.
 """
 from __future__ import annotations
 
 import numpy as np
 
+from ..monitor import stat_add
 from ..ops.pallas.flash_attention import (NEG_INF, blockwise_attention)
 
 
@@ -50,6 +56,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     qf = q.astype(jnp.float32)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
+    stat_add("collective_ppermute_calls", 2)  # k + v rotation per build
 
     def step(carry, t):
         m, l, acc, kc, vc = carry
@@ -99,10 +106,12 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
         raise ValueError(f"ulysses: heads {H} not divisible by group {n}")
 
     def scatter(x):  # [B,H,Sl,D] -> [B,H/n,S,D]
+        stat_add("collective_all_to_all_calls")
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
     def gather(x):   # [B,H/n,S,D] -> [B,H,Sl,D]
+        stat_add("collective_all_to_all_calls")
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
 

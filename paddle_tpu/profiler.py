@@ -50,8 +50,14 @@ def stop_profiler(sorted_key: Optional[str] = None,
     global _active_dir
     if _active_dir is None:
         return None
-    jax.profiler.stop_trace()
-    trace_dir, _active_dir = _active_dir, None
+    trace_dir = _active_dir
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        # even a failed stop tears down the session state: leaving
+        # _active_dir set would wedge start_profiler ("profiler already
+        # running") for the rest of the process
+        _active_dir = None
     if sorted_key:
         table = summarize_trace(trace_dir, sorted_key)
         print(table)

@@ -21,6 +21,12 @@ and the C++ double-buffered device prefetch
 
 Also provides the classic decorator readers (``paddle.batch``-style
 ``batch``/``shuffle``/``chain``) and ``DataFeeder`` for API parity.
+
+Telemetry (paddle_tpu/telemetry.py): ``reader_prefetch_depth`` gauge —
+staged-batch occupancy of the ``device_prefetch`` ring as each batch is
+yielded (pinned at 0/1 while the full ``depth`` was requested means the
+host pipeline, not the device, is the bottleneck).  The executor's own
+2-deep feed ring reports as ``feed_ring_occupancy``.
 """
 from __future__ import annotations
 
@@ -185,6 +191,8 @@ def device_prefetch(it: Iterable, depth: int = 2, device=None):
     device memory spent on staged batches.
     """
 
+    from . import telemetry
+
     def put(b):
         return stage_to_device(b, device)
 
@@ -201,6 +209,9 @@ def device_prefetch(it: Iterable, depth: int = 2, device=None):
             staged.append(put(next(it)))
         except StopIteration:
             pass  # ok: source exhausted; drain the staged batches
+        # occupancy at yield time: < depth means the consumer outruns
+        # the host pipeline (the feed, not the chip, is the bottleneck)
+        telemetry.gauge_set("reader_prefetch_depth", len(staged))
         yield out
 
 

@@ -1,0 +1,712 @@
+"""Training telemetry: span tracing, typed metrics, exporters, heartbeat.
+
+The monitor's int counters answer "how many"; this module answers "why
+was step N slow" and "is the job alive" without print statements:
+
+* **Span tracer** — :func:`trace_span` ``(name, **attrs)`` context
+  manager with a thread-local parent stack, monotonic-clock durations,
+  and a bounded ring of completed spans exportable as chrome://tracing /
+  Perfetto JSON (:func:`export_chrome_trace`, ``tools/trace_export.py``).
+* **Typed metrics** — :class:`Gauge`, :class:`Timer`, and fixed-bucket
+  :class:`Histogram` (p50/p95/p99 summaries) in a
+  :class:`MetricsRegistry` alongside the monitor's counters.
+* **Exporters** — Prometheus textfile (``metrics.prom``, atomic
+  tmp+rename on a ``FLAGS_metrics_interval`` cadence), structured JSONL
+  event log (``events.jsonl``: one machine-parseable line per event),
+  and a ``heartbeat.json`` health file (pid, step, last-step wall ms,
+  examples/sec, jax live-buffer device memory) an external watchdog can
+  poll.  All land under ``FLAGS_metrics_dir``; empty dir = no files.
+
+``FLAGS_telemetry=0`` reduces every entry point to a constant-time
+no-op: :func:`trace_span` returns a shared no-op context manager,
+metric writes return immediately, and no file is ever created — the
+hot-path cost of disabled telemetry is one dict lookup.
+
+Exporter writes go through the ``metrics_write`` fault-injection site
+(``paddle_tpu/fault.py``) and NEVER raise into the training loop: an
+I/O failure bumps ``telemetry_write_failures`` and is logged.
+
+Metrics emitted by this module itself: ``telemetry_write_failures``
+(counter), ``telemetry_events_dropped`` (counter: JSONL lines lost to
+I/O faults).  Instrumented metrics are documented in their home modules
+and in the README stat catalog ("Observability" section).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import fault
+from .flags import flag_value
+from .monitor import monitor as _monitor
+from .monitor import stat_add
+
+__all__ = ["trace_span", "span_begin", "span_end", "get_spans",
+           "clear_spans", "span_tree", "export_chrome_trace",
+           "spans_to_chrome_events", "Gauge", "Timer", "Histogram",
+           "MetricsRegistry", "metrics", "gauge_set", "histogram_observe",
+           "timer", "log_event", "note_step", "prometheus_text",
+           "write_prometheus", "write_heartbeat", "maybe_flush", "flush",
+           "enabled"]
+
+logger = logging.getLogger("paddle_tpu.telemetry")
+
+# maps time.monotonic() to the epoch so chrome-trace timestamps are
+# real wall-clock times while durations stay monotonic
+_EPOCH_OFFSET = time.time() - time.monotonic()
+
+
+def enabled() -> bool:
+    """Master switch (``FLAGS_telemetry``): one dict lookup."""
+    return bool(flag_value("FLAGS_telemetry"))
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+class Span:
+    """One completed (or in-flight) traced region.
+
+    Durations come from ``time.monotonic()``; ``ts``/``dur`` export as
+    chrome-trace microseconds.  ``parent_id`` is the span id of the
+    enclosing :func:`trace_span` on the same thread (None at root), so
+    the tree reconstructs from the flat ring.
+    """
+
+    __slots__ = ("name", "attrs", "span_id", "parent_id", "tid", "start",
+                 "end")
+    _next_id = [1]
+    _id_lock = threading.Lock()
+
+    def __init__(self, name: str, attrs: Dict[str, Any], parent_id, tid):
+        self.name = name
+        self.attrs = attrs
+        with Span._id_lock:
+            self.span_id = Span._next_id[0]
+            Span._next_id[0] += 1
+        self.parent_id = parent_id
+        self.tid = tid
+        self.start = time.monotonic()
+        self.end: Optional[float] = None
+
+    @property
+    def duration_ms(self) -> Optional[float]:
+        return None if self.end is None else (self.end - self.start) * 1e3
+
+    def to_event(self) -> dict:
+        """Chrome-trace complete ('X') event."""
+        return {"ph": "X", "name": self.name, "cat": "paddle_tpu",
+                "pid": os.getpid(), "tid": self.tid,
+                "ts": (self.start + _EPOCH_OFFSET) * 1e6,
+                "dur": ((self.end or time.monotonic()) - self.start) * 1e6,
+                "args": dict(self.attrs, span_id=self.span_id,
+                             parent_id=self.parent_id)}
+
+    def __repr__(self):
+        d = self.duration_ms
+        return (f"Span({self.name!r}, id={self.span_id}, "
+                f"parent={self.parent_id}, "
+                f"{'open' if d is None else f'{d:.3f}ms'})")
+
+
+_tls = threading.local()
+_ring_lock = threading.Lock()
+_ring: Optional[deque] = None
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def _get_ring() -> deque:
+    global _ring
+    if _ring is None:
+        with _ring_lock:
+            if _ring is None:
+                cap = int(flag_value("FLAGS_trace_buffer_size") or 4096)
+                _ring = deque(maxlen=max(1, cap))
+    return _ring
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager for FLAGS_telemetry=0."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _SpanCtx:
+    __slots__ = ("_span",)
+
+    def __init__(self, span: Span):
+        self._span = span
+
+    def __enter__(self):
+        return self._span
+
+    def __exit__(self, *exc):
+        span_end(self._span)
+        return False
+
+
+def span_begin(name: str, **attrs) -> Optional[Span]:
+    """Open a span without a ``with`` block (executor hot path); pair
+    with :func:`span_end`.  Returns None when telemetry is disabled."""
+    if not enabled():
+        return None
+    stack = _stack()
+    parent = stack[-1].span_id if stack else None
+    span = Span(name, attrs, parent, threading.get_ident())
+    stack.append(span)
+    return span
+
+
+def span_end(span: Optional[Span]):
+    """Close `span`, recording it in the ring.  Defensive against spans
+    left open by an exception: everything above `span` on this thread's
+    stack is closed (and recorded) too."""
+    if span is None:
+        return
+    stack = _stack()
+    if span not in stack:
+        return
+    now = time.monotonic()
+    ring = _get_ring()
+    while stack:
+        top = stack.pop()
+        top.end = now
+        with _ring_lock:
+            ring.append(top)
+        if top is span:
+            break
+
+
+def trace_span(name: str, **attrs):
+    """``with trace_span("ckpt/write", step=n): ...`` — times the block
+    on the monotonic clock and records a :class:`Span` with the current
+    thread's innermost open span as parent.  A no-op (shared singleton,
+    no allocation beyond the call) under ``FLAGS_telemetry=0``."""
+    if not enabled():
+        return _NOOP
+    return _SpanCtx(span_begin(name, **attrs))
+
+
+def get_spans() -> List[Span]:
+    """Completed spans, oldest first (bounded by
+    ``FLAGS_trace_buffer_size``)."""
+    with _ring_lock:
+        return list(_ring) if _ring is not None else []
+
+
+def clear_spans():
+    global _ring
+    with _ring_lock:
+        _ring = None
+    _tls.stack = []
+
+
+def span_tree(spans: Optional[List[Span]] = None) -> List[dict]:
+    """Reconstruct the forest from a flat span list: returns root nodes
+    as ``{"span": Span, "children": [...]}``, children in completion
+    order."""
+    spans = get_spans() if spans is None else spans
+    nodes = {s.span_id: {"span": s, "children": []} for s in spans}
+    roots = []
+    for s in spans:
+        node = nodes[s.span_id]
+        parent = nodes.get(s.parent_id)
+        (parent["children"] if parent else roots).append(node)
+    return roots
+
+
+def spans_to_chrome_events(spans: Optional[List[Span]] = None) -> List[dict]:
+    return [s.to_event() for s in (get_spans() if spans is None else spans)]
+
+
+def export_chrome_trace(path: str,
+                        spans: Optional[List[Span]] = None) -> str:
+    """Write the span ring as chrome://tracing / Perfetto JSON
+    (atomic tmp+rename; survives injected metrics_write faults).
+    Serialization itself honors the never-raise contract too: span
+    attrs that aren't JSON-native (np scalars, paths) stringify via
+    ``default=str``, and anything still unserializable drops the export
+    (``telemetry_write_failures``) instead of killing the step."""
+    doc = {"traceEvents": spans_to_chrome_events(spans),
+           "displayTimeUnit": "ms"}
+    try:
+        text = json.dumps(doc, default=str)
+    except (TypeError, ValueError) as e:
+        stat_add("telemetry_write_failures")
+        logger.warning("trace export %s failed to serialize: %s", path, e)
+        return path
+    _atomic_write(path, text)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# typed metrics
+# ---------------------------------------------------------------------------
+
+class Gauge:
+    """Last-value-wins float metric (feed-ring occupancy, examples/sec,
+    resume duration...)."""
+
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float):
+        with self._lock:
+            self._v = float(v)
+
+    def add(self, v: float):
+        with self._lock:
+            self._v += float(v)
+
+    def get(self) -> float:
+        with self._lock:
+            return self._v
+
+
+# default buckets: milliseconds, 0.1ms .. 60s (fixed so two processes'
+# histograms merge bucket-for-bucket)
+DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
+    0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+    1000, 2500, 5000, 10000, 30000, 60000)
+
+
+class Histogram:
+    """Fixed-bucket histogram with percentile summaries.
+
+    Buckets are upper bounds (a +inf overflow bucket is implicit).
+    Percentiles interpolate linearly inside the chosen bucket — exact
+    enough for p50/p95/p99 dashboards, O(len(buckets)) memory forever.
+    """
+
+    __slots__ = ("name", "buckets", "_counts", "_sum", "_count", "_min",
+                 "_max", "_lock")
+
+    def __init__(self, name: str, buckets: Tuple[float, ...] = None):
+        self.name = name
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS_MS))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        v = float(v)
+        i = 0
+        for i, ub in enumerate(self.buckets):
+            if v <= ub:
+                break
+        else:
+            i = len(self.buckets)  # overflow bucket
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    def percentile(self, p: float) -> float:
+        """p in [0, 100]; linear interpolation within the bucket."""
+        with self._lock:
+            counts, total = list(self._counts), self._count
+            lo, hi = self._min, self._max
+        if total == 0:
+            return 0.0
+        rank = p / 100.0 * total
+        seen = 0.0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                b_lo = self.buckets[i - 1] if i > 0 else min(lo, 0.0)
+                b_hi = self.buckets[i] if i < len(self.buckets) else hi
+                b_lo, b_hi = max(b_lo, min(lo, b_hi)), min(b_hi, hi)
+                frac = (rank - seen) / c
+                return b_lo + (b_hi - b_lo) * min(max(frac, 0.0), 1.0)
+            seen += c
+        return hi
+
+    def summary(self) -> dict:
+        with self._lock:
+            if self._count == 0:
+                return {"count": 0, "sum": 0.0}
+            base = {"count": self._count, "sum": round(self._sum, 4),
+                    "min": round(self._min, 4), "max": round(self._max, 4),
+                    "mean": round(self._sum / self._count, 4)}
+        base.update({"p50": round(self.percentile(50), 4),
+                     "p95": round(self.percentile(95), 4),
+                     "p99": round(self.percentile(99), 4)})
+        return base
+
+    def cumulative_buckets(self) -> List[Tuple[float, int]]:
+        """(upper_bound, cumulative_count) pairs, +inf last (Prometheus
+        histogram exposition)."""
+        with self._lock:
+            counts = list(self._counts)
+        out, cum = [], 0
+        for ub, c in zip(self.buckets, counts):
+            cum += c
+            out.append((ub, cum))
+        out.append((math.inf, cum + counts[-1]))
+        return out
+
+
+class Timer:
+    """Histogram-backed duration metric::
+
+        with metrics.timer("checkpoint_write_ms").time():
+            ...
+    """
+
+    __slots__ = ("hist",)
+
+    def __init__(self, hist: Histogram):
+        self.hist = hist
+
+    def time(self):
+        return _TimerCtx(self.hist)
+
+    def observe_ms(self, ms: float):
+        self.hist.observe(ms)
+
+
+class _TimerCtx:
+    __slots__ = ("_hist", "_t0")
+
+    def __init__(self, hist: Histogram):
+        self._hist = hist
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe((time.monotonic() - self._t0) * 1e3)
+        return False
+
+
+class MetricsRegistry:
+    """Typed-metric sibling of :class:`monitor.StatRegistry`: named
+    gauges, histograms, and timers, with a combined :meth:`snapshot`
+    that also embeds the monitor's counters.  Thread-safe (lock-guarded
+    construction, per-metric locks on mutation)."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._gauges: Dict[str, Gauge] = {}
+        self._hists: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "MetricsRegistry":
+        if cls._instance is None:
+            with cls._instance_lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def histogram(self, name: str,
+                  buckets: Tuple[float, ...] = None) -> Histogram:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram(name, buckets)
+            return h
+
+    def timer(self, name: str) -> Timer:
+        return Timer(self.histogram(name))
+
+    def snapshot(self, reset_counters: bool = False) -> dict:
+        """{"counters": {...}, "gauges": {...}, "histograms": {...}} —
+        counters via the monitor's atomic publish.  Each histogram entry
+        carries its summary plus ``buckets`` (cumulative (le, count)
+        pairs), so a snapshot fully renders to Prometheus later without
+        touching the live registry."""
+        with self._lock:
+            gauges = list(self._gauges.items())
+            hists = list(self._hists.items())
+        return {
+            "counters": dict(_monitor.publish(reset=reset_counters)),
+            "gauges": {n: g.get() for n, g in sorted(gauges)},
+            "histograms": {
+                n: dict(h.summary(), buckets=h.cumulative_buckets())
+                for n, h in sorted(hists)},
+        }
+
+
+metrics = MetricsRegistry.instance()
+
+
+def gauge_set(name: str, value: float):
+    """Module-level shorthand (no-op when telemetry is off)."""
+    if enabled():
+        metrics.gauge(name).set(value)
+
+
+def histogram_observe(name: str, value: float):
+    if enabled():
+        metrics.histogram(name).observe(value)
+
+
+def timer(name: str):
+    """``with timer("ckpt_write_ms"): ...`` — no-op context manager
+    when telemetry is off."""
+    if not enabled():
+        return _NOOP
+    return metrics.timer(name).time()
+
+
+# ---------------------------------------------------------------------------
+# step bookkeeping (heartbeat inputs)
+# ---------------------------------------------------------------------------
+
+_step_state = {"step": 0, "last_step_ms": None, "examples_per_sec": None,
+               "host_ms": None, "last_t": None, "started": time.time()}
+_step_lock = threading.Lock()
+
+
+def note_step(step: int, host_ms: float, examples: int):
+    """Executor per-step hook: feeds the step-duration histogram, the
+    throughput gauge, and the heartbeat.
+
+    ``host_ms`` is host wall time spent inside ``Executor.run`` (with
+    async dispatch this is dispatch cost, not device step time);
+    ``last_step_ms``/``examples_per_sec`` derive from the interval
+    between consecutive step completions, which IS the steady-state
+    step time even when dispatch runs ahead of the device."""
+    if not enabled():
+        return
+    now = time.monotonic()
+    metrics.histogram("executor_step_host_ms").observe(host_ms)
+    with _step_lock:
+        last_t = _step_state["last_t"]
+        _step_state["last_t"] = now
+        _step_state["step"] = int(step)
+        _step_state["host_ms"] = round(host_ms, 4)
+        if last_t is not None and now > last_t:
+            dt_ms = (now - last_t) * 1e3
+            _step_state["last_step_ms"] = round(dt_ms, 4)
+            if examples:
+                rate = examples * 1e3 / dt_ms
+                prev = _step_state["examples_per_sec"]
+                # EMA: smooth over dispatch jitter, converge in ~10 steps
+                rate = rate if prev is None else 0.8 * prev + 0.2 * rate
+                _step_state["examples_per_sec"] = round(rate, 3)
+    if _step_state["examples_per_sec"] is not None:
+        metrics.gauge("examples_per_sec").set(
+            _step_state["examples_per_sec"])
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def _metrics_dir() -> Optional[str]:
+    d = flag_value("FLAGS_metrics_dir")
+    return d or None
+
+
+def _atomic_write(path: str, text: str):
+    """tmp + os.replace publish; never raises into the caller (I/O
+    failures bump ``telemetry_write_failures``).  Routed through the
+    ``metrics_write`` fault site so CI can prove the never-raises
+    contract."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        if fault.fire("metrics_write") == "raise":
+            raise fault.InjectedFault(f"injected metrics write failure "
+                                      f"({os.path.basename(path)})")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except OSError as e:
+        stat_add("telemetry_write_failures")
+        logger.warning("telemetry write %s failed: %s", path, e)
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass  # ok: tmp may never have been created
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    return f"paddle_tpu_{out}"
+
+
+def prometheus_text(snapshot: Optional[dict] = None) -> str:
+    """Render a :meth:`MetricsRegistry.snapshot` in the Prometheus text
+    exposition format (counters, gauges, and cumulative-bucket
+    histograms with ``_sum``/``_count``).  A passed snapshot renders
+    exactly as captured — nothing is read from the live registry."""
+    snap = snapshot if snapshot is not None else metrics.snapshot()
+    lines = []
+    for name, v in sorted(snap.get("counters", {}).items()):
+        pn = _prom_name(name)
+        lines += [f"# TYPE {pn} counter", f"{pn} {v}"]
+    for name, v in sorted(snap.get("gauges", {}).items()):
+        pn = _prom_name(name)
+        lines += [f"# TYPE {pn} gauge", f"{pn} {v}"]
+    for name, h in sorted(snap.get("histograms", {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        for ub, cum in h.get("buckets", []):
+            le = "+Inf" if math.isinf(ub) else repr(float(ub))
+            lines.append(f'{pn}_bucket{{le="{le}"}} {cum}')
+        lines.append(f"{pn}_sum {h.get('sum', 0.0)}")
+        lines.append(f"{pn}_count {h.get('count', 0)}")
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus(path: Optional[str] = None) -> Optional[str]:
+    if not enabled():
+        return None
+    d = _metrics_dir()
+    if path is None:
+        if d is None:
+            return None
+        path = os.path.join(d, "metrics.prom")
+    _atomic_write(path, prometheus_text())
+    return path
+
+
+def _device_memory() -> Optional[dict]:
+    """jax live-buffer stats for the heartbeat (None when jax is not
+    imported yet — the heartbeat must not force a jax init)."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        live = jax.live_arrays()
+        return {"live_buffers": len(live),
+                "live_bytes": int(sum(int(getattr(a, "nbytes", 0) or 0)
+                                      for a in live))}
+    except Exception as e:
+        logger.debug("live-buffer stats unavailable: %s", e)
+        return None
+
+
+def write_heartbeat(path: Optional[str] = None) -> Optional[str]:
+    """``heartbeat.json``: liveness + progress for an external watchdog
+    (atomic write; a reader never sees a torn file)."""
+    if not enabled():
+        return None
+    d = _metrics_dir()
+    if path is None:
+        if d is None:
+            return None
+        path = os.path.join(d, "heartbeat.json")
+    with _step_lock:
+        state = dict(_step_state)
+    state.pop("last_t", None)
+    hb = {"pid": os.getpid(), "time": time.time(),
+          "uptime_s": round(time.time() - state.pop("started"), 3),
+          "device_memory": _device_memory()}
+    hb.update(state)
+    _atomic_write(path, json.dumps(hb, indent=1, sort_keys=True))
+    return path
+
+
+def log_event(kind: str, **fields):
+    """Append one machine-parseable line to ``events.jsonl``
+    (step timings, guard resolutions, checkpoint publishes, restarts).
+    No-op without telemetry or a metrics dir; an I/O fault drops the
+    line (``telemetry_events_dropped``) instead of raising."""
+    if not enabled():
+        return
+    d = _metrics_dir()
+    if d is None:
+        return
+    rec = {"ts": round(time.time(), 6), "event": kind, "pid": os.getpid()}
+    rec.update(fields)
+    try:
+        if fault.fire("metrics_write") == "raise":
+            raise fault.InjectedFault("injected event-log write failure")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "events.jsonl"), "a") as f:
+            f.write(json.dumps(rec, sort_keys=True, default=str) + "\n")
+    except OSError as e:
+        stat_add("telemetry_events_dropped")
+        logger.warning("event log write failed: %s", e)
+
+
+# ---------------------------------------------------------------------------
+# flush cadence
+# ---------------------------------------------------------------------------
+
+_flush_state = {"last": 0.0}
+_flush_lock = threading.Lock()
+
+
+def maybe_flush() -> bool:
+    """Hot-path cadence check: flush the file exporters if at least
+    ``FLAGS_metrics_interval`` seconds passed since the last flush.
+    Costs one monotonic read + a comparison when it's not yet time."""
+    if not enabled() or _metrics_dir() is None:
+        return False
+    now = time.monotonic()
+    # explicit 0.0 means flush every step — `or` would eat it
+    interval = flag_value("FLAGS_metrics_interval")
+    interval = 10.0 if interval is None else float(interval)
+    with _flush_lock:
+        if now - _flush_state["last"] < interval:
+            return False
+        _flush_state["last"] = now
+    flush(force=False)
+    return True
+
+
+def flush(force: bool = True):
+    """Write every exporter now: Prometheus textfile, heartbeat, and the
+    span ring as ``trace.json``.  ``force=True`` also resets the cadence
+    clock (used at run end: TrainGuard.close/finalize, Executor.close)."""
+    if not enabled():
+        return
+    d = _metrics_dir()
+    if d is None:
+        return
+    if force:
+        with _flush_lock:
+            _flush_state["last"] = time.monotonic()
+    write_prometheus()
+    write_heartbeat()
+    export_chrome_trace(os.path.join(d, "trace.json"))

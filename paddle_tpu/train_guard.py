@@ -19,17 +19,28 @@ Typical use::
             guard.step(batch, fetch_list=[loss])
     except TrainingInterrupted:
         pass   # SIGTERM: final checkpoint already written, exit 0
+
+Telemetry (paddle_tpu/telemetry.py): a ``train_guard/resume`` span plus
+``train_guard_resume_ms`` gauge time the construction-time restore,
+``train_guard_restart_count`` gauge republishes
+``PADDLE_TPU_RESTART_COUNT``, ``sigterm_to_exit_ms`` gauge records
+SIGTERM-to-TrainingInterrupted latency, every step drives the periodic
+exporter flush, and resume / guard-skip / SIGTERM / final-checkpoint
+transitions land in the JSONL event log (events ``resume``,
+``guard_skip``, ``sigterm``, ``final_checkpoint``).
 """
 from __future__ import annotations
 
 import logging
 import os
 import signal
+import time
 from typing import Callable, Optional
 
 import numpy as np
 
 from . import fault
+from . import telemetry
 from .monitor import stat_add
 
 __all__ = ["TrainGuard", "TrainingInterrupted"]
@@ -98,10 +109,21 @@ class TrainGuard:
         self._finalized = False
         self._ckpt_dir = checkpoint_dir
         self._keep_last_n = keep_last_n
+        self._sigterm_at: Optional[float] = None
+        restarts = int(os.environ.get("PADDLE_TPU_RESTART_COUNT", "0") or 0)
+        telemetry.gauge_set("train_guard_restart_count", restarts)
         if checkpoint_dir:
-            self.resumed_step = executor.enable_auto_checkpoint(
-                checkpoint_dir, interval_steps, program=self.program,
-                max_keep=keep_last_n)
+            t0 = time.monotonic()
+            with telemetry.trace_span("train_guard/resume",
+                                      dir=checkpoint_dir):
+                self.resumed_step = executor.enable_auto_checkpoint(
+                    checkpoint_dir, interval_steps, program=self.program,
+                    max_keep=keep_last_n)
+            resume_ms = (time.monotonic() - t0) * 1e3
+            telemetry.gauge_set("train_guard_resume_ms", resume_ms)
+            telemetry.log_event("resume", step=self.resumed_step,
+                                resume_ms=round(resume_ms, 3),
+                                restart_count=restarts)
         executor.set_nonfinite_guard(self.loss_name,
                                      callback=self._skipped,
                                      program=self.program)
@@ -141,14 +163,26 @@ class TrainGuard:
         out = runner(self.program, feed=feed,
                      fetch_list=list(fetch_list or []) or None,
                      scope=scope)
+        # periodic exporter flush rides the guarded loop even when the
+        # caller bypasses Executor.run's epilogue (e.g. future runners)
+        telemetry.maybe_flush()
         if self.stop_requested:
             self.finalize(scope=scope)
+            exit_ms = None
+            if self._sigterm_at is not None:
+                exit_ms = (time.monotonic() - self._sigterm_at) * 1e3
+                telemetry.gauge_set("sigterm_to_exit_ms", exit_ms)
+            telemetry.log_event(
+                "sigterm", step=self.exe._step,
+                to_exit_ms=None if exit_ms is None else round(exit_ms, 3))
+            telemetry.flush()
             raise TrainingInterrupted(self.exe._step)
         return out
 
     # -- callbacks ----------------------------------------------------------
     def _on_sigterm(self, signum, frame):
         self.stop_requested = True
+        self._sigterm_at = time.monotonic()
         stat_add("sigterm_received")
 
     def _skipped(self, step: int):
@@ -157,6 +191,9 @@ class TrainGuard:
         self.skipped_steps += 1
         logger.warning("non-finite %r at step %d: update skipped",
                        self.loss_name, step)
+        telemetry.log_event("guard_skip", step=step,
+                            loss=self.loss_name,
+                            resolved_at=self.exe._step)
         if self.scaler is not None and \
                 hasattr(self.scaler, "backoff_on_nonfinite") and \
                 step > self._backoff_watermark:
@@ -199,6 +236,8 @@ class TrainGuard:
                                  program=self.program, scope=scope,
                                  keep_last_n=self._keep_last_n)
             stat_add("checkpoint_final")
+            telemetry.log_event("final_checkpoint", step=self.exe._step,
+                                dir=self._ckpt_dir)
         except OSError as e:
             stat_add("checkpoint_write_failures")
             logger.error("final checkpoint at step %d failed: %s",
@@ -213,6 +252,7 @@ class TrainGuard:
         self.exe.clear_nonfinite_guard()
         if self._ckpt_dir:
             self.exe.disable_auto_checkpoint()
+        telemetry.flush()  # end-of-run exporter write (no-op without dir)
 
     def __enter__(self):
         return self

@@ -1,8 +1,14 @@
-"""Tier-1 lint gates (tools/check_no_bare_pass.py).
+"""Tier-1 lint gates (tools/check_no_bare_pass.py,
+tools/check_stat_catalog.py).
 
 Robustness hygiene: no `except ...: pass` in paddle_tpu/ may silently
 swallow a failure — handlers must log, bump a monitor stat, or carry an
 explicit `# ok: <reason>` waiver.
+
+Observability hygiene: every literal metric name used through the
+monitor / telemetry APIs in paddle_tpu/ must appear (backtick-quoted)
+in the README stat catalog, so metric names can't drift undocumented
+out from under the dashboards reading them.
 """
 import os
 import subprocess
@@ -11,6 +17,7 @@ import textwrap
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LINT = os.path.join(REPO, "tools", "check_no_bare_pass.py")
+CATALOG = os.path.join(REPO, "tools", "check_stat_catalog.py")
 
 
 def test_paddle_tpu_has_no_silent_except_pass():
@@ -47,4 +54,42 @@ def test_lint_catches_violation_and_honors_waiver(tmp_path):
     """))
     r = subprocess.run([sys.executable, LINT, str(good)],
                        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout
+
+
+def test_every_metric_name_is_in_readme_catalog():
+    r = subprocess.run(
+        [sys.executable, CATALOG, os.path.join(REPO, "paddle_tpu")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_stat_catalog_lint_catches_undocumented_name(tmp_path):
+    bad = tmp_path / "mod.py"
+    bad.write_text(textwrap.dedent("""\
+        from paddle_tpu.monitor import stat_add
+        from paddle_tpu import telemetry
+
+        def f():
+            stat_add("documented_stat")
+            stat_add("totally_undocumented_stat")
+            telemetry.gauge_set("undocumented_gauge", 1.0)
+            stat_add(f"dynamic_{f.__name__}")  # non-literal: out of scope
+    """))
+    readme = tmp_path / "README.md"
+    readme.write_text("catalog: `documented_stat` only\n")
+    r = subprocess.run(
+        [sys.executable, CATALOG, str(bad), "--readme", str(readme)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1, r.stdout
+    assert "totally_undocumented_stat" in r.stdout
+    assert "undocumented_gauge" in r.stdout
+    assert "'documented_stat'" not in r.stdout  # documented: no finding
+    assert "dynamic_" not in r.stdout
+
+    readme.write_text("`documented_stat` `totally_undocumented_stat` "
+                      "`undocumented_gauge`\n")
+    r = subprocess.run(
+        [sys.executable, CATALOG, str(bad), "--readme", str(readme)],
+        capture_output=True, text=True, timeout=60)
     assert r.returncode == 0, r.stdout

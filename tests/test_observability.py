@@ -55,6 +55,32 @@ def test_profiler_trace_saved_and_loadable(tmp_path):
     assert "bench_step" in table and "Total(ms)" in table
 
 
+def test_stop_profiler_failure_does_not_wedge(monkeypatch, tmp_path):
+    """A failed jax.profiler.stop_trace must still clear the session:
+    the old code left _active_dir set, permanently wedging
+    start_profiler with 'profiler already running'."""
+    import jax
+
+    from paddle_tpu import profiler as prof
+
+    started = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: started.append(d))
+
+    def boom():
+        raise RuntimeError("trace flush failed")
+
+    monkeypatch.setattr(jax.profiler, "stop_trace", boom)
+    prof.start_profiler(trace_dir=str(tmp_path / "a"))
+    with pytest.raises(RuntimeError, match="trace flush failed"):
+        prof.stop_profiler()
+    # not wedged: the next session starts and stops cleanly
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    prof.start_profiler(trace_dir=str(tmp_path / "b"))
+    assert prof.stop_profiler() == str(tmp_path / "b")
+    assert started == [str(tmp_path / "a"), str(tmp_path / "b")]
+
+
 def test_check_nan_inf_names_the_op():
     """Inject a NaN-producing op (log of a negative number) and assert
     the failure names it."""

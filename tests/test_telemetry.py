@@ -1,0 +1,416 @@
+"""Telemetry subsystem tests (paddle_tpu/telemetry.py).
+
+Covers: nested-span tree reconstruction, histogram percentiles on a
+known distribution, Prometheus/JSONL/heartbeat/trace file formats from
+a real 20-step TrainGuard run, the tools/trace_export.py merge,
+exporter survival under injected metrics_write I/O faults, the atomic
+monitor publish, and the FLAGS_telemetry=0 contract (no spans, no
+metrics, no files — the host_syncs-style O(1) pattern).
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import fault, layers, optimizer, telemetry
+from paddle_tpu.monitor import stat_get
+from paddle_tpu.train_guard import TrainGuard
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_defaults():
+    telemetry.clear_spans()  # earlier modules' executor runs leave spans
+    yield
+    pt.set_flags({"FLAGS_telemetry": True, "FLAGS_metrics_dir": "",
+                  "FLAGS_metrics_interval": 10.0,
+                  "FLAGS_trace_buffer_size": 4096,
+                  "FLAGS_fault_inject": ""})
+    fault.reset()
+    telemetry.clear_spans()
+
+
+def _net():
+    x = layers.data("x", [4])
+    y = layers.data("y", [1])
+    pred = layers.fc(x, 1)
+    loss = layers.mean(pt.layers.square_error_cost(pred, y))
+    optimizer.SGDOptimizer(0.1).minimize(loss)
+    return loss
+
+
+def _feed(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(8, 4).astype("float32")
+    return {"x": x, "y": (x.sum(1, keepdims=True) * 0.5).astype("float32")}
+
+
+def _startup():
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    return exe
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+def test_nested_spans_reconstruct_the_tree():
+    telemetry.clear_spans()
+    with telemetry.trace_span("root", step=7):
+        with telemetry.trace_span("child_a"):
+            with telemetry.trace_span("leaf"):
+                pass
+        with telemetry.trace_span("child_b"):
+            pass
+    spans = telemetry.get_spans()
+    # completion order: innermost first
+    assert [s.name for s in spans] == ["leaf", "child_a", "child_b",
+                                       "root"]
+    assert all(s.duration_ms is not None and s.duration_ms >= 0
+               for s in spans)
+    roots = telemetry.span_tree(spans)
+    assert len(roots) == 1 and roots[0]["span"].name == "root"
+    assert roots[0]["span"].attrs == {"step": 7}
+    kids = [n["span"].name for n in roots[0]["children"]]
+    assert kids == ["child_a", "child_b"]
+    grand = roots[0]["children"][0]["children"]
+    assert [n["span"].name for n in grand] == ["leaf"]
+    # the parent encloses the child on the monotonic clock
+    root, leaf = spans[3], spans[0]
+    assert root.start <= leaf.start and root.end >= leaf.end
+
+
+def test_spans_on_other_threads_root_separately():
+    telemetry.clear_spans()
+
+    def worker():
+        with telemetry.trace_span("thread_root"):
+            pass
+
+    with telemetry.trace_span("main_root"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    by_name = {s.name: s for s in telemetry.get_spans()}
+    assert by_name["thread_root"].parent_id is None  # not under main_root
+    assert by_name["main_root"].parent_id is None
+    assert by_name["thread_root"].tid != by_name["main_root"].tid
+
+
+def test_span_end_closes_abandoned_children():
+    telemetry.clear_spans()
+    outer = telemetry.span_begin("outer")
+    telemetry.span_begin("inner_abandoned")  # never explicitly ended
+    telemetry.span_end(outer)
+    spans = telemetry.get_spans()
+    assert {s.name for s in spans} == {"outer", "inner_abandoned"}
+    assert all(s.end is not None for s in spans)
+    # next root does not parent under a leaked span
+    with telemetry.trace_span("fresh"):
+        pass
+    assert telemetry.get_spans()[-1].parent_id is None
+
+
+def test_span_ring_is_bounded():
+    pt.set_flags({"FLAGS_trace_buffer_size": 8})
+    telemetry.clear_spans()  # re-reads the capacity flag
+    for i in range(20):
+        with telemetry.trace_span(f"s{i}"):
+            pass
+    spans = telemetry.get_spans()
+    assert len(spans) == 8
+    assert [s.name for s in spans] == [f"s{i}" for i in range(12, 20)]
+
+
+# ---------------------------------------------------------------------------
+# typed metrics
+# ---------------------------------------------------------------------------
+
+def test_histogram_percentiles_on_known_distribution():
+    # decade buckets make 1..100 land exactly on interpolated percentiles
+    h = telemetry.Histogram("t_ms", buckets=tuple(
+        float(b) for b in range(10, 101, 10)))
+    for v in range(1, 101):
+        h.observe(float(v))
+    s = h.summary()
+    assert s["count"] == 100 and s["sum"] == 5050.0
+    assert s["min"] == 1.0 and s["max"] == 100.0 and s["mean"] == 50.5
+    assert abs(s["p50"] - 50.0) < 1e-6
+    assert abs(s["p95"] - 95.0) < 1e-6
+    assert abs(s["p99"] - 99.0) < 1e-6
+    # overflow bucket: values beyond the last bound still count
+    h.observe(1e9)
+    assert h.summary()["count"] == 101 and h.summary()["max"] == 1e9
+    cum = h.cumulative_buckets()
+    assert cum[-1][1] == 101 and cum[-1][0] == float("inf")
+    assert [c for _, c in cum] == sorted(c for _, c in cum)  # monotonic
+
+
+def test_histogram_constant_distribution_is_exact():
+    h = telemetry.Histogram("c")
+    for _ in range(10):
+        h.observe(500.0)
+    s = h.summary()
+    assert s["p50"] == s["p95"] == s["p99"] == 500.0
+
+
+def test_gauge_and_timer():
+    g = telemetry.metrics.gauge("test_gauge")
+    g.set(3.5)
+    assert g.get() == 3.5
+    g.add(1.5)
+    assert g.get() == 5.0
+    with telemetry.metrics.timer("test_timer_ms").time():
+        pass
+    s = telemetry.metrics.histogram("test_timer_ms").summary()
+    assert s["count"] == 1 and s["min"] >= 0.0
+    snap = telemetry.metrics.snapshot()
+    assert snap["gauges"]["test_gauge"] == 5.0
+    assert snap["histograms"]["test_timer_ms"]["count"] == 1
+    assert "executor_run_steps" in snap["counters"]
+
+
+def test_prometheus_text_wellformed():
+    telemetry.metrics.gauge("prom_gauge").set(2.25)
+    telemetry.metrics.histogram("prom_hist_ms").observe(3.0)
+    text = telemetry.prometheus_text()
+    line_re = re.compile(
+        r'^[a-zA-Z_][a-zA-Z0-9_]*(\{le="[^"]+"\})? -?[0-9.eE+inf-]+$')
+    for line in text.strip().splitlines():
+        assert line.startswith("# ") or line_re.match(line), line
+    assert "# TYPE paddle_tpu_prom_gauge gauge" in text
+    assert "# TYPE paddle_tpu_prom_hist_ms histogram" in text
+    assert 'paddle_tpu_prom_hist_ms_bucket{le="+Inf"}' in text
+    assert "paddle_tpu_prom_hist_ms_count 1" in text
+    assert "# TYPE paddle_tpu_executor_run_steps counter" in text
+
+
+def test_monitor_publish_atomic_under_concurrent_writers():
+    """reset=True publishes must conserve every increment: sum of all
+    published snapshots + the residual equals the writes."""
+    from paddle_tpu.monitor import monitor, stat_add
+    N_THREADS, N_INC = 4, 2000
+    monitor.get("race_stat").reset()
+
+    def writer():
+        for _ in range(N_INC):
+            stat_add("race_stat")
+
+    threads = [threading.Thread(target=writer) for _ in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    harvested = 0
+    while any(t.is_alive() for t in threads):
+        harvested += dict(monitor.publish(reset=True)).get("race_stat", 0)
+    for t in threads:
+        t.join()
+    harvested += dict(monitor.publish(reset=True)).get("race_stat", 0)
+    assert harvested == N_THREADS * N_INC
+
+
+def test_stat_registry_singleton_identity():
+    from paddle_tpu.monitor import StatRegistry, monitor
+    assert StatRegistry.instance() is monitor
+    assert StatRegistry.instance() is StatRegistry.instance()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance run: 20-step TrainGuard with telemetry on
+# ---------------------------------------------------------------------------
+
+def _trainguard_run(tmp_path, steps=20):
+    mdir = str(tmp_path / "metrics")
+    pt.set_flags({"FLAGS_metrics_dir": mdir,
+                  "FLAGS_metrics_interval": 0.0})  # flush every step
+    telemetry.clear_spans()
+    loss = _net()
+    exe = _startup()
+    g = TrainGuard(exe, loss, checkpoint_dir=str(tmp_path / "ckpts"),
+                   interval_steps=10, handle_sigterm=False)
+    for i in range(steps):
+        g.step(_feed(i), fetch_list=[loss])
+    g.close()
+    return mdir
+
+
+def test_trainguard_run_produces_all_four_artifacts(tmp_path):
+    mdir = _trainguard_run(tmp_path)
+
+    # 1. Perfetto-loadable trace JSON
+    with open(os.path.join(mdir, "trace.json")) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    assert isinstance(events, list) and events
+    for e in events:
+        assert {"ph", "name", "ts", "pid", "tid"} <= set(e)
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    names = {e["name"] for e in events}
+    assert {"executor/step", "executor/dispatch", "executor/fetch",
+            "ckpt/write", "ckpt/publish"} <= names
+    # the step spans parent the dispatch spans
+    steps = {e["args"]["span_id"] for e in events
+             if e["name"] == "executor/step"}
+    dparents = {e["args"]["parent_id"] for e in events
+                if e["name"] == "executor/dispatch"}
+    assert dparents <= steps
+
+    # 2. Prometheus textfile
+    with open(os.path.join(mdir, "metrics.prom")) as f:
+        prom = f.read()
+    assert "# TYPE paddle_tpu_executor_run_steps counter" in prom
+    assert "paddle_tpu_executor_step_host_ms_count" in prom
+    assert "# TYPE paddle_tpu_examples_per_sec gauge" in prom
+    assert "paddle_tpu_checkpoint_bytes_written" in prom
+
+    # 3. JSONL event log
+    with open(os.path.join(mdir, "events.jsonl")) as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    kinds = {r["event"] for r in recs}
+    assert "ckpt_publish" in kinds
+    publishes = [r for r in recs if r["event"] == "ckpt_publish"]
+    assert all(r["bytes"] > 0 and "ts" in r and r["pid"] == os.getpid()
+               for r in publishes)
+    assert {r["step"] for r in publishes} == {10, 20}
+
+    # 4. heartbeat
+    with open(os.path.join(mdir, "heartbeat.json")) as f:
+        hb = json.load(f)
+    assert hb["pid"] == os.getpid()
+    assert hb["step"] >= 20
+    assert hb["last_step_ms"] is not None and hb["last_step_ms"] >= 0
+    assert hb["examples_per_sec"] is not None \
+        and hb["examples_per_sec"] > 0
+    assert hb["device_memory"]["live_buffers"] > 0
+    assert hb["uptime_s"] >= 0
+
+    # step-duration histogram saw every step
+    s = telemetry.metrics.histogram("executor_step_host_ms").summary()
+    assert s["count"] >= 20
+
+
+def test_trace_export_tool_merges_spans_and_events(tmp_path):
+    mdir = _trainguard_run(tmp_path)
+    out = str(tmp_path / "perfetto.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_export.py"),
+         mdir, out],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        doc = json.load(f)
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "executor/step" in names
+    assert "event/ckpt_publish" in names  # events.jsonl markers merged
+    ts = [e["ts"] for e in doc["traceEvents"]]
+    assert ts == sorted(ts)
+    # --filter narrows to one subsystem
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_export.py"),
+         mdir, out, "--filter", "ckpt/", "--no-events"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["traceEvents"]
+    assert all(e["name"].startswith("ckpt/") for e in doc["traceEvents"])
+
+
+def test_resume_telemetry(tmp_path):
+    mdir = _trainguard_run(tmp_path)
+    # second guard (fresh programs) over the same dir resumes + reports
+    main2, startup2 = pt.Program(), pt.Program()
+    startup2._is_startup = True
+    with pt.program_guard(main2, startup2):
+        loss = _net()
+    exe = pt.Executor()
+    exe.run(startup2)
+    g = TrainGuard(exe, loss, program=main2,
+                   checkpoint_dir=str(tmp_path / "ckpts"),
+                   interval_steps=10, handle_sigterm=False)
+    assert g.resumed_step == 20
+    g.close()
+    assert telemetry.metrics.gauge("train_guard_resume_ms").get() > 0
+    with open(os.path.join(mdir, "events.jsonl")) as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    resumes = [r for r in recs if r["event"] == "resume"]
+    assert resumes and resumes[-1]["step"] == 20
+    assert any(r["event"] == "ckpt_resume" for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# fault tolerance: exporters must never raise into the training loop
+# ---------------------------------------------------------------------------
+
+def test_exporters_survive_injected_io_fault(tmp_path):
+    mdir = str(tmp_path / "m")
+    pt.set_flags({"FLAGS_metrics_dir": mdir})
+    fault.configure("metrics_write:raise@1+")
+    w0 = stat_get("telemetry_write_failures")
+    d0 = stat_get("telemetry_events_dropped")
+    telemetry.flush()                      # prometheus + heartbeat + trace
+    telemetry.log_event("probe", x=1)
+    assert stat_get("telemetry_write_failures") >= w0 + 3
+    assert stat_get("telemetry_events_dropped") == d0 + 1
+    assert not os.path.exists(os.path.join(mdir, "metrics.prom"))
+    assert not os.path.exists(os.path.join(mdir, "events.jsonl"))
+
+    # the training loop itself is unaffected: a full run still completes
+    loss = _net()
+    exe = _startup()
+    out = exe.run(feed=_feed(), fetch_list=[loss])
+    assert np.isfinite(out[0]).all()
+    fault.configure("")
+    telemetry.flush()
+    assert os.path.isfile(os.path.join(mdir, "metrics.prom"))
+
+
+# ---------------------------------------------------------------------------
+# FLAGS_telemetry=0: no spans, no metrics, no files, no per-step work
+# ---------------------------------------------------------------------------
+
+def test_telemetry_off_emits_nothing(tmp_path):
+    mdir = str(tmp_path / "m")
+    telemetry.clear_spans()
+    pt.set_flags({"FLAGS_telemetry": 0, "FLAGS_metrics_dir": mdir,
+                  "FLAGS_metrics_interval": 0.0})
+    h0 = telemetry.metrics.histogram("executor_step_host_ms").summary()
+    loss = _net()
+    exe = _startup()
+    g = TrainGuard(exe, loss, checkpoint_dir=str(tmp_path / "ckpts"),
+                   interval_steps=10, handle_sigterm=False)
+    for i in range(20):
+        g.step(_feed(i), fetch_list=[loss])
+    g.close()
+    # the host_syncs-style O(1) assertion, for telemetry: zero spans
+    # recorded, zero histogram observations, zero files — disabled
+    # telemetry does no per-step bookkeeping at all
+    assert telemetry.get_spans() == []
+    h1 = telemetry.metrics.histogram("executor_step_host_ms").summary()
+    assert h1["count"] == h0["count"]
+    assert not os.path.exists(mdir)
+    assert telemetry.log_event("x") is None
+    assert telemetry.write_prometheus() is None \
+        and not os.path.exists(mdir)
+    # spans collapse to one shared no-op singleton: no allocation
+    assert telemetry.trace_span("a") is telemetry.trace_span("b")
+    assert telemetry.span_begin("a") is None
+
+
+def test_telemetry_off_then_on_round_trip(tmp_path):
+    pt.set_flags({"FLAGS_telemetry": 0})
+    with telemetry.trace_span("invisible"):
+        pass
+    assert telemetry.get_spans() == []
+    pt.set_flags({"FLAGS_telemetry": 1})
+    with telemetry.trace_span("visible"):
+        pass
+    assert [s.name for s in telemetry.get_spans()] == ["visible"]

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Lint: every metric name used in paddle_tpu/ must be documented.
+
+Counters, gauges, and histograms are only useful if an operator can
+find out what they mean — and names drift silently: a renamed stat
+breaks every dashboard reading the old one with no test failing.  This
+gate extracts every *literal* metric name passed to the monitor /
+telemetry APIs and requires each to appear (backtick-quoted) in the
+README's stat catalog ("Observability" section).
+
+Recognized call shapes (first argument must be a string literal;
+dynamic f-string names like ``fault_<site>_<kind>`` are out of scope):
+
+* bare calls:      ``stat_add(n)``, ``stat_get(n)``, ``gauge_set(n, v)``,
+                   ``histogram_observe(n, v)``
+* monitor handles: ``monitor.get(n)`` / ``_monitor.get(n)``
+* telemetry attrs: ``telemetry.gauge_set/histogram_observe/timer(n)``
+* registry attrs:  ``metrics.gauge/histogram/timer(n)``
+
+Usage: python tools/check_stat_catalog.py [--readme README.md] [--list]
+       [root ...]   (default root: paddle_tpu)
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+
+BARE_FUNCS = {"stat_add", "stat_get", "gauge_set", "histogram_observe"}
+TELEMETRY_ATTRS = {"gauge_set", "histogram_observe", "timer"}
+REGISTRY_ATTRS = {"gauge", "histogram", "timer"}
+
+
+def _first_str_arg(node: ast.Call):
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def _value_id(node) -> str:
+    """Best-effort identifier of an attribute's object ('telemetry',
+    '_monitor', 'self._metrics' -> '_metrics', ...)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def extract_names(path: str):
+    """(name, path, lineno) for every literal metric name in one file."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, path)
+    except SyntaxError as e:
+        raise SystemExit(f"{path}:{e.lineno}: syntax error: {e.msg}")
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        hit = False
+        if isinstance(func, ast.Name) and func.id in BARE_FUNCS:
+            hit = True
+        elif isinstance(func, ast.Attribute):
+            # exact-id match (modulo leading underscores for module
+            # aliases like `_monitor`): a substring match would drag in
+            # ordinary dict .get() calls on unrelated names
+            vid = _value_id(func.value).lstrip("_")
+            if func.attr == "get" and vid == "monitor":
+                hit = True
+            elif func.attr in TELEMETRY_ATTRS and vid == "telemetry":
+                hit = True
+            elif func.attr in REGISTRY_ATTRS and vid == "metrics":
+                hit = True
+        if not hit:
+            continue
+        name = _first_str_arg(node)
+        if name is not None:
+            out.append((name, path, node.lineno))
+    return out
+
+
+CATALOG_MARKER = "**Stat catalog**"
+
+
+def catalog_names(readme_path: str) -> set:
+    """Backtick-quoted identifiers in the README's stat-catalog section
+    (from the CATALOG_MARKER to the next `## ` heading).  Scoping to
+    the catalog matters: a metric name that happens to collide with any
+    backticked word elsewhere in the README (a flag, a heartbeat field)
+    must not pass as documented.  Falls back to the whole file when the
+    marker is absent (minimal/test READMEs)."""
+    with open(readme_path, encoding="utf-8") as f:
+        text = f.read()
+    start = text.find(CATALOG_MARKER)
+    if start >= 0:
+        end = text.find("\n## ", start)
+        text = text[start:end if end >= 0 else len(text)]
+    return set(re.findall(r"`([A-Za-z_][A-Za-z0-9_]*)`", text))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("roots", nargs="*", default=None)
+    ap.add_argument("--readme", default=None)
+    ap.add_argument("--list", action="store_true",
+                    help="print every extracted name and exit 0")
+    args = ap.parse_args(argv)
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    roots = args.roots or [os.path.join(here, "paddle_tpu")]
+    readme = args.readme or os.path.join(here, "README.md")
+
+    found = []
+    for root in roots:
+        if os.path.isfile(root):
+            found += extract_names(root)
+            continue
+        for dirpath, _dirs, files in os.walk(root):
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    found += extract_names(os.path.join(dirpath, name))
+    if args.list:
+        for n in sorted({n for n, _, _ in found}):
+            print(n)
+        return 0
+
+    documented = catalog_names(readme)
+    missing = sorted({(n, p, ln) for n, p, ln in found
+                      if n not in documented})
+    for n, p, ln in missing:
+        print(f"{p}:{ln}: metric {n!r} is not in the README stat "
+              f"catalog ({os.path.basename(readme)}) -- document it "
+              f"(backtick-quoted) or rename it to a documented one")
+    if missing:
+        print(f"{len(missing)} undocumented metric name use(s)")
+    return 1 if missing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Export paddle_tpu telemetry as one Perfetto-loadable trace.
+
+Merges a ``FLAGS_metrics_dir``'s artifacts into a single
+chrome://tracing / Perfetto JSON file:
+
+* ``trace.json`` — the span ring (``executor/step``, ``ckpt/write``, ...)
+  exported by paddle_tpu/telemetry.py, passed through after validation;
+* ``events.jsonl`` — the structured event log, converted to instant
+  ('i'-phase) events so checkpoint publishes, guard skips, resumes, and
+  SIGTERMs show as markers on the same timeline.
+
+Usage::
+
+    python tools/trace_export.py <metrics_dir | trace.json> [out.json]
+        [--filter SUBSTR]     keep only spans whose name contains SUBSTR
+        [--no-events]         skip the events.jsonl markers
+
+Load the output in https://ui.perfetto.dev (or chrome://tracing).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def load_span_events(trace_path: str) -> list:
+    with open(trace_path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        raise SystemExit(f"{trace_path}: not a chrome trace "
+                         f"(no traceEvents list)")
+    bad = [e for e in events
+           if not isinstance(e, dict) or "name" not in e or "ph" not in e]
+    if bad:
+        raise SystemExit(f"{trace_path}: {len(bad)} malformed trace "
+                         f"event(s), e.g. {bad[0]!r}")
+    return events
+
+
+def load_event_markers(jsonl_path: str) -> list:
+    """events.jsonl lines -> instant events on the merged timeline.
+
+    Malformed lines are skipped with a warning, not fatal: a crashed
+    run leaves a torn final append, and the post-mortem tool must keep
+    working exactly then."""
+    markers = []
+    with open(jsonl_path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                print(f"warning: {jsonl_path}:{lineno}: skipping bad "
+                      f"JSON line (torn write?): {e}", file=sys.stderr)
+                continue
+            markers.append({
+                "ph": "i", "s": "p",
+                "name": f"event/{rec.get('event', 'unknown')}",
+                "cat": "paddle_tpu.events",
+                "pid": rec.get("pid", 0), "tid": 0,
+                "ts": float(rec.get("ts", 0.0)) * 1e6,
+                "args": rec,
+            })
+    return markers
+
+
+def export(src: str, out: str, name_filter: str = "",
+           include_events: bool = True) -> dict:
+    if os.path.isdir(src):
+        trace_path = os.path.join(src, "trace.json")
+        events_path = os.path.join(src, "events.jsonl")
+    else:
+        trace_path = src
+        events_path = os.path.join(os.path.dirname(src) or ".",
+                                   "events.jsonl")
+    events = load_span_events(trace_path)
+    if name_filter:
+        events = [e for e in events if name_filter in e.get("name", "")]
+    n_spans = len(events)
+    n_markers = 0
+    if include_events and os.path.isfile(events_path):
+        markers = load_event_markers(events_path)
+        n_markers = len(markers)
+        events = events + markers
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    return {"out": out, "spans": n_spans, "markers": n_markers}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("src", help="FLAGS_metrics_dir or a trace.json")
+    ap.add_argument("out", nargs="?", default="perfetto_trace.json")
+    ap.add_argument("--filter", default="",
+                    help="keep only spans whose name contains this")
+    ap.add_argument("--no-events", action="store_true",
+                    help="skip events.jsonl markers")
+    args = ap.parse_args(argv)
+    info = export(args.src, args.out, args.filter,
+                  include_events=not args.no_events)
+    print(f"wrote {info['out']}: {info['spans']} span(s), "
+          f"{info['markers']} event marker(s) — load in "
+          f"https://ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
