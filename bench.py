@@ -691,6 +691,81 @@ def _run_resnet50_once(batch_per_chip, image_size, *, faults=None):
     }
 
 
+# ---------------------------------------------------------------------------
+# Serving leg: dynamic-batching engine throughput vs serial batch-1
+# ---------------------------------------------------------------------------
+
+def _load_serving_loadgen():
+    """tools/ is scripts, not a package — load the loadgen by path."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "serving_loadgen.py")
+    spec = importlib.util.spec_from_file_location("serving_loadgen", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_serving():
+    """Serving throughput leg (`legs.serving`): an in-process
+    dynamic-batching ServingEngine under the closed-loop loadgen
+    (tools/serving_loadgen.py) vs. the same predictor driven serially at
+    batch 1 — the speedup IS the batching+pool win.  An open-loop pass
+    at ~60% of the measured closed-loop rate reports latency at a
+    steady offered load.  Sized by BENCH_SERVING_{FEAT,HIDDEN,DEPTH,
+    REQUESTS,WORKERS,MAX_BATCH}."""
+    from paddle_tpu.serving import ServingEngine
+
+    lg = _load_serving_loadgen()
+    # weight-heavy MLP: batch-1 inference is memory-bound on streaming
+    # the weights, so micro-batching amortizes exactly what serial pays
+    # per request (measured CPU: ~7-9x closed-loop vs serial batch-1)
+    feat = int(os.environ.get("BENCH_SERVING_FEAT", "256"))
+    hidden = int(os.environ.get("BENCH_SERVING_HIDDEN", "2048"))
+    depth = int(os.environ.get("BENCH_SERVING_DEPTH", "4"))
+    n_req = int(os.environ.get("BENCH_SERVING_REQUESTS", "256"))
+    workers = int(os.environ.get("BENCH_SERVING_WORKERS", "2"))
+    max_batch = int(os.environ.get("BENCH_SERVING_MAX_BATCH", "8"))
+
+    predictor, shapes = lg.build_synthetic(feat, hidden, depth)
+    make_feed = lg.feed_maker(shapes, rows=1)
+
+    # serial batch-1 baseline on the same (warmed) predictor
+    predictor.warmup({n: (1,) + s for n, s in shapes.items()})
+    n_serial = max(n_req // 4, 32)
+    t0 = time.perf_counter()
+    for i in range(n_serial):
+        predictor.run(make_feed(i))
+    serial_s = time.perf_counter() - t0
+    serial_qps = n_serial / serial_s
+
+    engine = ServingEngine(predictor.clone(), workers=workers,
+                           max_batch=max_batch, max_delay_ms=2.0,
+                           queue_cap=4 * n_req, deadline_ms=60000.0,
+                           warmup_shapes=shapes)
+    try:
+        closed = lg.run_closed_loop(engine, make_feed, n_req,
+                                    concurrency=2 * max_batch)
+        open_rep = lg.run_open_loop(engine, make_feed,
+                                    qps=max(closed["qps"] * 0.6, 50.0),
+                                    duration_s=2.0)
+    finally:
+        engine.close()
+    return {
+        "metric": "serving_closed_loop_qps",
+        "value": closed["qps"],
+        "unit": "requests/sec",
+        "serial_batch1_qps": round(serial_qps, 2),
+        "speedup_vs_serial": round(closed["qps"] / serial_qps, 3),
+        "closed": closed,
+        "open": open_rep,
+        "config": {"feat": feat, "hidden": hidden, "depth": depth,
+                   "requests": n_req, "workers": workers,
+                   "max_batch": max_batch},
+    }
+
+
 def main():
     import jax
 
@@ -735,6 +810,14 @@ def main():
             except Exception as e:  # a leg must not kill the flagship
                 out["legs"]["resnet50"] = {"error": f"{type(e).__name__}: "
                                                     f"{e}"}
+        # serving leg: dynamic-batching engine qps vs serial batch-1
+        # (BENCH_SERVING=0 skips)
+        if os.environ.get("BENCH_SERVING", "1") == "1":
+            try:
+                out["legs"]["serving"] = run_serving()
+            except Exception as e:
+                out["legs"]["serving"] = {"error": f"{type(e).__name__}: "
+                                                   f"{e}"}
 
     print(json.dumps(out))
 

@@ -36,6 +36,7 @@ from . import checkpoint  # noqa
 from . import reader  # noqa
 from .reader import DataLoader, DataFeeder, batch  # noqa
 from . import inference  # noqa
+from . import serving  # noqa  (dynamic-batching inference engine + HTTP)
 from . import profiler  # noqa
 from .flags import get_flags, set_flags  # noqa
 from . import fault  # noqa  (deterministic fault injection)

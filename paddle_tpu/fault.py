@@ -25,6 +25,8 @@ the ones wired in-tree:
     loss           train_guard.TrainGuard.step   nan
     step           train_guard.TrainGuard.step   sigterm
     metrics_write  telemetry exporters           raise
+    serve_request  serving/engine.py submit      shed | fail
+    serve_batch    serving/engine.py _run_batch  fail
     =============  ============================  =====================
 
 Every fired fault bumps ``faults_injected`` plus a per-site/kind

@@ -116,3 +116,22 @@ register_flag("FLAGS_metrics_interval", 10.0,
 register_flag("FLAGS_trace_buffer_size", 4096,
               "capacity of the completed-span ring buffer "
               "(paddle_tpu/telemetry.py); oldest spans drop first")
+register_flag("FLAGS_serving_max_batch", 8,
+              "serving engine: largest micro-batch (= largest padding "
+              "bucket) the dynamic batcher forms; buckets are the powers "
+              "of two up to this value (paddle_tpu/serving)")
+register_flag("FLAGS_serving_max_delay_ms", 5.0,
+              "serving engine: longest a worker holds a partial batch "
+              "open waiting for more requests before dispatching it "
+              "padded (the latency half of the batching policy)")
+register_flag("FLAGS_serving_queue_cap", 256,
+              "serving engine: bounded admission queue; submit() on a "
+              "full queue sheds with an explicit OverloadedError instead "
+              "of queuing unbounded latency")
+register_flag("FLAGS_serving_deadline_ms", 1000.0,
+              "serving engine: requests that waited longer than this in "
+              "the queue are shed (OverloadedError) when a worker picks "
+              "them up — bounds admission-latency p99 under overload")
+register_flag("FLAGS_serving_workers", 2,
+              "serving engine: predictor-pool size (clone()d predictors "
+              "sharing device weights, one dispatch thread each)")

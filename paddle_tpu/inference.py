@@ -22,6 +22,7 @@ signature and cache the compiled executable.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -96,6 +97,13 @@ class Predictor:
         self._block = program.global_block()
         self._cache: Dict[tuple, object] = {}
         self._state_in = None
+        # run() is thread-safe: the per-shape compile cache (and the lazy
+        # _state_in analysis) are guarded by this lock, so N threads can
+        # share ONE predictor — first compile of a signature serializes,
+        # steady-state is one lock acquire around a dict hit.  clone()d
+        # predictors each get their own lock (and own cache); the shared
+        # scope arrays are read-only at serve time.
+        self._lock = threading.RLock()
 
     # -- reference-API accessors -------------------------------------------
     def get_input_names(self) -> List[str]:
@@ -109,9 +117,10 @@ class Predictor:
         """The pure (feeds, state) -> fetches function + state binding."""
         import jax
 
-        if self._state_in is None:
-            state_in, _ = analyze_block(self._block, self.feed_names)
-            self._state_in = state_in
+        with self._lock:
+            if self._state_in is None:
+                state_in, _ = analyze_block(self._block, self.feed_names)
+                self._state_in = state_in
 
         state_in = self._state_in
         block = self._block
@@ -139,16 +148,19 @@ class Predictor:
     def _compiled_for(self, sig, feed_arrays):
         import jax
 
-        entry = self._cache.get(sig)
-        if entry is None:
-            fn, state_vals = self._fn_and_state()
-            jitted = jax.jit(fn)
-            # AOT: compile now, at this signature
-            compiled = jitted.lower(tuple(feed_arrays), state_vals
-                                    ).compile()
-            entry = (compiled, state_vals)
-            self._cache[sig] = entry
-        return entry
+        with self._lock:
+            entry = self._cache.get(sig)
+            if entry is None:
+                fn, state_vals = self._fn_and_state()
+                jitted = jax.jit(fn)
+                # AOT: compile now, at this signature.  Compiling under
+                # the lock means two racing threads can't both miss and
+                # build duplicate executables for the same signature.
+                compiled = jitted.lower(tuple(feed_arrays), state_vals
+                                        ).compile()
+                entry = (compiled, state_vals)
+                self._cache[sig] = entry
+            return entry
 
     def _prepare(self, feed):
         arrays = []
@@ -173,6 +185,35 @@ class Predictor:
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return list(outs)
+
+    def warmup(self, feed_shapes) -> int:
+        """Pre-compile AND prime the given feed signatures (off the
+        request path): ``feed_shapes`` is one ``{feed_name: shape}``
+        dict or a list of them.  Dtypes come from the program's feed
+        vars.  Each newly compiled executable is also run once on zero
+        feeds (result discarded): the first execution pays one-time
+        costs beyond compilation (runtime autotuning, thread-pool /
+        allocator spin-up) that must not land on a real request.
+        Returns the number of signatures compiled now (already-cached
+        ones are free).  The serving engine uses this to warm every
+        batch bucket at startup; direct users call it to move the
+        first-request latency spike out of the serving path."""
+        if isinstance(feed_shapes, dict):
+            feed_shapes = [feed_shapes]
+        compiled = 0
+        for shapes in feed_shapes:
+            arrays = []
+            for n in self.feed_names:
+                want = dtype_to_np(self._block.var(n).dtype)
+                arrays.append(np.zeros(tuple(shapes[n]), dtype=want))
+            sig = tuple((a.shape, str(a.dtype)) for a in arrays)
+            with self._lock:
+                hit = sig in self._cache
+            if not hit:
+                executable, state_vals = self._compiled_for(sig, arrays)
+                executable(tuple(arrays), state_vals)
+                compiled += 1
+        return compiled
 
     def clone(self) -> "Predictor":
         """Shared-weight clone (zero-copy: same scope arrays), private

@@ -1,0 +1,23 @@
+"""Serving layer: dynamic-batching inference over the AOT Predictor.
+
+The deployment pillar of the reference stack (analysis predictor +
+Paddle-Serving), rebuilt TPU-native: one compiled executable per shape
+bucket, a clone()d predictor pool sharing device weights, bounded-queue
+admission control with explicit overload shedding, and a stdlib HTTP
+front end.  See the README "Serving" section for the policy knobs.
+
+    from paddle_tpu.serving import ServingEngine, serve
+
+    engine = ServingEngine("exported_model_dir",
+                           warmup_shapes={"x": (6,)})
+    outputs = engine.predict({"x": example})      # in-process
+    server = serve(engine, port=8080)             # HTTP /predict,/healthz
+"""
+from . import batcher  # noqa
+from .engine import (OverloadedError, RequestFailed, ServingEngine,  # noqa
+                     ServingError, ServingFuture)
+from .server import ServingServer, serve  # noqa
+
+__all__ = ["ServingEngine", "ServingError", "OverloadedError",
+           "RequestFailed", "ServingFuture", "ServingServer", "serve",
+           "batcher"]

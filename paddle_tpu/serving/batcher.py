@@ -1,0 +1,103 @@
+"""Dynamic micro-batch formation: shape buckets, padding, bit-exact split.
+
+The throughput lever for accelerator serving is amortizing dispatch over
+a batch (Clipper-style adaptive batching); the XLA-specific twist is
+that every distinct feed shape is a distinct compiled executable, so
+batches are padded **up to a small fixed set of bucket sizes** — the
+engine compiles once per bucket at startup instead of once per observed
+batch size at serve time.
+
+This module is the pure, lock-free half of the scheduler: the policy
+(`bucket_sizes`, `bucket_for`), batch assembly (`signature_of`,
+`pad_stack`) and the bit-exact inverse (`split_rows`).  The queueing /
+threading half lives in :mod:`paddle_tpu.serving.engine`.
+
+Padding contract: pad rows replicate row 0 of the real payload (never
+zeros — a zero row can be out-of-domain for the model and produce
+NaN/Inf that trips non-finite machinery; a replicated real row is by
+construction in-domain).  Because the served program is row-independent
+(inference has no cross-example ops — no batch norm in train mode), pad
+rows cannot perturb real rows, and `split_rows` slicing the first
+`rows` entries returns results `np.array_equal` to running each request
+alone (`tests/test_serving.py` asserts this at every bucket boundary).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["bucket_sizes", "bucket_for", "signature_of", "pad_stack",
+           "split_rows", "fill_pct"]
+
+
+def bucket_sizes(max_batch: int) -> Tuple[int, ...]:
+    """The padding buckets for a given max batch: powers of two up to
+    ``max_batch``, with ``max_batch`` itself always included (so a full
+    batch never pads).  max_batch=8 -> (1, 2, 4, 8); 6 -> (1, 2, 4, 6)."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    sizes = {max_batch}
+    b = 1
+    while b < max_batch:
+        sizes.add(b)
+        b *= 2
+    return tuple(sorted(sizes))
+
+
+def bucket_for(rows: int, buckets: Sequence[int]):
+    """Smallest bucket that fits ``rows``; None when rows exceed every
+    bucket (the engine then chunks the request across batches)."""
+    for b in buckets:
+        if rows <= b:
+            return b
+    return None
+
+
+def signature_of(arrays: Sequence[np.ndarray]) -> tuple:
+    """Per-ROW feed signature: batchable requests are exactly those whose
+    feeds agree on everything but the leading (batch) dim."""
+    return tuple((a.shape[1:], str(a.dtype)) for a in arrays)
+
+
+def pad_stack(feeds: List[Sequence[np.ndarray]],
+              bucket: int) -> Tuple[List[np.ndarray], int]:
+    """Concatenate each feed position across requests along axis 0 and
+    pad up to ``bucket`` rows by replicating row 0.
+
+    ``feeds`` is a list of per-request feed tuples (same order/signature,
+    each array with its request's leading batch dim).  Returns
+    ``(padded_arrays, real_rows)``."""
+    real_rows = sum(int(f[0].shape[0]) for f in feeds)
+    if real_rows > bucket:
+        raise ValueError(f"{real_rows} rows do not fit bucket {bucket}")
+    out = []
+    for pos in range(len(feeds[0])):
+        cat = feeds[0][pos] if len(feeds) == 1 else \
+            np.concatenate([f[pos] for f in feeds], axis=0)
+        pad = bucket - real_rows
+        if pad:
+            fill = np.broadcast_to(cat[:1], (pad,) + cat.shape[1:])
+            cat = np.concatenate([cat, fill], axis=0)
+        out.append(np.ascontiguousarray(cat))
+    return out, real_rows
+
+
+def split_rows(outputs: Sequence[np.ndarray],
+               row_counts: Sequence[int]) -> List[List[np.ndarray]]:
+    """Bit-exact inverse of :func:`pad_stack` on the model outputs:
+    slice each output back into per-request row ranges (pad rows beyond
+    ``sum(row_counts)`` are dropped).  Returns one output list per
+    request, aligned with the request order given to pad_stack."""
+    per_request: List[List[np.ndarray]] = [[] for _ in row_counts]
+    offsets = np.cumsum([0] + list(row_counts))
+    for out in outputs:
+        arr = np.asarray(out)
+        for i, (lo, hi) in enumerate(zip(offsets[:-1], offsets[1:])):
+            per_request[i].append(arr[lo:hi])
+    return per_request
+
+
+def fill_pct(rows: int, bucket: int) -> float:
+    """Batch fill ratio in percent (real rows / padded rows)."""
+    return 100.0 * rows / max(bucket, 1)

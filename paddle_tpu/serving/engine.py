@@ -1,0 +1,584 @@
+"""Serving engine: dynamic-batching scheduler over a pool of predictors.
+
+Turns one AOT :class:`~paddle_tpu.inference.Predictor` into a
+trafficable engine:
+
+* **Predictor pool** — ``workers`` ``clone()``d predictors share the
+  device weight arrays (zero-copy); each owns a dispatch thread and a
+  private compile cache, so batch executions overlap across workers
+  (compiled XLA calls release the GIL).
+* **Dynamic micro-batching** — requests queue centrally; a worker pops
+  the head, gathers same-signature requests until the batch reaches
+  ``FLAGS_serving_max_batch`` rows or ``FLAGS_serving_max_delay_ms``
+  elapses, pads up to the shape bucket
+  (:mod:`paddle_tpu.serving.batcher`) and dispatches one compiled call.
+  Results split bit-exactly back to the per-request futures.
+* **Warm-up** — every bucket of every declared signature is compiled on
+  every worker at startup (``Predictor.warmup``), so no caller ever
+  pays a compile.
+* **Admission control** — the queue is bounded
+  (``FLAGS_serving_queue_cap``); a full queue sheds at ``submit()``
+  with an explicit :class:`OverloadedError` (reason ``queue_full``),
+  and requests that sat queued past ``FLAGS_serving_deadline_ms`` are
+  shed when picked up (reason ``deadline``) — overload degrades into
+  explicit errors with bounded latency, never unbounded queueing.
+* **Graceful drain** — ``close(drain=True)`` (or SIGTERM via
+  :meth:`ServingEngine.install_sigterm`, mirroring ``TrainGuard``)
+  stops admissions, flushes every in-flight and queued request, joins
+  the workers, and leaves the process clean.
+
+Fault sites (``paddle_tpu/fault.py``): ``serve_request`` (kinds
+``shed`` — forced admission shed — and ``fail`` — admission error) and
+``serve_batch`` (kind ``fail`` — the batch execution raises; only that
+batch's requests error, the engine keeps serving).
+
+Stats (README catalog): counters ``serving_requests``,
+``serving_requests_shed``, ``serving_batches``,
+``serving_batch_exact_bucket``, ``serving_batch_failures``,
+``serving_pad_rows``, ``serving_no_sigterm``; gauges
+``serving_queue_depth``, ``serving_bucket_hit_rate``; histograms
+``serving_request_ms``, ``serving_queue_wait_ms``,
+``serving_batch_fill_pct``.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import signal
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from .. import fault
+from .. import telemetry
+from ..flags import flag_value
+from ..monitor import stat_add
+from . import batcher
+
+__all__ = ["ServingError", "OverloadedError", "RequestFailed",
+           "ServingFuture", "ServingEngine"]
+
+logger = logging.getLogger("paddle_tpu.serving")
+
+FILL_BUCKETS = tuple(float(x) for x in range(5, 101, 5))
+
+
+class ServingError(RuntimeError):
+    """Base class for request-level serving failures."""
+
+
+class OverloadedError(ServingError):
+    """Explicit shed: the engine refused (or dropped) the request rather
+    than queue unbounded latency.  ``reason`` is one of ``queue_full``,
+    ``deadline``, ``draining``, ``injected``."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"serving overloaded ({reason})"
+                         + (f": {detail}" if detail else ""))
+        self.reason = reason
+
+
+class RequestFailed(ServingError):
+    """The batch this request rode in raised during execution."""
+
+
+class ServingFuture:
+    """Completion handle returned by :meth:`ServingEngine.submit`."""
+
+    __slots__ = ("_event", "_outputs", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._outputs: Optional[List[np.ndarray]] = None
+        self._error: Optional[Exception] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[np.ndarray]:
+        """Block for the outputs (list aligned with the predictor's
+        fetch order); raises the request's error (OverloadedError /
+        RequestFailed) if it was shed or its batch failed."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving request still pending")
+        if self._error is not None:
+            raise self._error
+        return self._outputs
+
+    def exception(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving request still pending")
+        return self._error
+
+    def _resolve(self, outputs=None, error=None):
+        self._outputs, self._error = outputs, error
+        self._event.set()
+
+
+class _Request:
+    __slots__ = ("arrays", "rows", "sig", "future", "t_submit")
+
+    def __init__(self, arrays: List[np.ndarray]):
+        self.arrays = arrays
+        self.rows = int(arrays[0].shape[0])
+        self.sig = batcher.signature_of(arrays)
+        self.future = ServingFuture()
+        self.t_submit = time.monotonic()
+
+
+class ServingEngine:
+    """Batching scheduler + predictor pool + admission control.
+
+    ``predictor`` is a :class:`~paddle_tpu.inference.Predictor` (or a
+    ``save_inference_model`` directory).  ``warmup_shapes`` — one
+    ``{feed_name: per_row_shape}`` dict (or a list of them) naming the
+    per-example shapes to pre-compile at every bucket on every worker;
+    omit it to compile lazily on first use instead.
+
+    In-process API: :meth:`submit` (future) / :meth:`predict`
+    (blocking) — tests and the bench drive the engine without sockets;
+    the HTTP front end (:mod:`paddle_tpu.serving.server`) is a thin
+    JSON veneer over the same calls.
+    """
+
+    def __init__(self, predictor, workers: Optional[int] = None,
+                 max_batch: Optional[int] = None,
+                 max_delay_ms: Optional[float] = None,
+                 queue_cap: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 warmup_shapes=None, autostart: bool = True,
+                 share_executables: bool = True):
+        from ..inference import Predictor
+
+        if not isinstance(predictor, Predictor):
+            predictor = Predictor(predictor)
+        self._base = predictor
+        self.workers = int(workers if workers is not None
+                           else flag_value("FLAGS_serving_workers") or 1)
+        self.max_batch = int(max_batch if max_batch is not None
+                             else flag_value("FLAGS_serving_max_batch"))
+        self.buckets = batcher.bucket_sizes(self.max_batch)
+        delay = (max_delay_ms if max_delay_ms is not None
+                 else flag_value("FLAGS_serving_max_delay_ms"))
+        self._max_delay_s = float(delay) / 1e3
+        self.queue_cap = int(queue_cap if queue_cap is not None
+                             else flag_value("FLAGS_serving_queue_cap"))
+        dl = (deadline_ms if deadline_ms is not None
+              else flag_value("FLAGS_serving_deadline_ms"))
+        self._deadline_s = float(dl) / 1e3
+        if self.workers < 1:
+            raise ValueError("ServingEngine needs at least one worker")
+
+        self._queue: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._draining = False
+        self._closed = False
+        self._started = time.time()
+        self._threads: List[threading.Thread] = []
+        # share_executables=True: one zero-copy clone serves every
+        # worker thread (Predictor.run is thread-safe and compiled-call
+        # execution releases the GIL), so startup compiles each bucket
+        # ONCE instead of once per worker and holds one copy of every
+        # executable.  False restores fully private per-worker clones
+        # (isolated compile caches; the reference Clone() shape).
+        if share_executables:
+            self._pool = [predictor.clone()] * self.workers
+        else:
+            self._pool = [predictor.clone() for _ in range(self.workers)]
+
+        # engine-local tallies (isolated from the process-global monitor,
+        # which other subsystems and tests also bump) + mirrored global
+        # telemetry so the exporters see serving alongside training
+        # requests = every validated submit() (admitted OR shed);
+        # served = requests completed with real outputs; shed covers
+        # both admission sheds and deadline sheds, so at quiescence
+        # requests == served + shed + batch-failed (+ injected
+        # serve_request:fail admission errors)
+        self._n = {"requests": 0, "served": 0, "shed": 0, "batches": 0,
+                   "exact_bucket": 0, "batch_failures": 0, "pad_rows": 0}
+        self._n_lock = threading.Lock()
+        self._h_request = telemetry.Histogram("serving_request_ms")
+        self._h_wait = telemetry.Histogram("serving_queue_wait_ms")
+        self._h_fill = telemetry.Histogram("serving_batch_fill_pct",
+                                           buckets=FILL_BUCKETS)
+        # pre-register the global fill histogram with percent buckets —
+        # a lazy first histogram_observe would get millisecond buckets
+        telemetry.metrics.histogram("serving_batch_fill_pct",
+                                    buckets=FILL_BUCKETS)
+
+        self._sigterm_installed = False
+        self._prev_sigterm = None
+
+        if warmup_shapes is not None:
+            self.warmup(warmup_shapes)
+        if autostart:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+    def warmup(self, warmup_shapes) -> int:
+        """Compile every bucket of every given per-row signature on every
+        worker (so the first real request of any admissible batch size
+        hits a warm executable).  Returns executables compiled now."""
+        if isinstance(warmup_shapes, dict):
+            warmup_shapes = [warmup_shapes]
+        sigs = []
+        for shapes in warmup_shapes:
+            for b in self.buckets:
+                sigs.append({n: (b,) + tuple(s)
+                             for n, s in shapes.items()})
+        compiled = 0
+        with telemetry.trace_span("serving/warmup", buckets=len(sigs)):
+            for p in dict.fromkeys(self._pool):  # unique when shared
+                compiled += p.warmup(sigs)
+        return compiled
+
+    def start(self):
+        if self._threads:
+            return
+        for i, p in enumerate(self._pool):
+            t = threading.Thread(target=self._worker_loop, args=(p,),
+                                 name=f"serving-worker-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def install_sigterm(self):
+        """SIGTERM → graceful drain (mirrors TrainGuard): stop accepting,
+        flush in-flight batches, exit clean.  Main-thread only; elsewhere
+        the launcher's restart path applies (``serving_no_sigterm``)."""
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM,
+                                               self._on_sigterm)
+            self._sigterm_installed = True
+        except ValueError:
+            stat_add("serving_no_sigterm")
+
+    def _on_sigterm(self, signum, frame):
+        stat_add("sigterm_received")
+        telemetry.log_event("serving_sigterm", pid=os.getpid())
+        # a signal handler must not block on worker joins: flip the drain
+        # flag here (submit() rejects from this instant) and finish the
+        # flush+join off the handler
+        threading.Thread(target=self.close, kwargs={"drain": True},
+                         name="serving-drain", daemon=True).start()
+
+    def drain(self, timeout: Optional[float] = None):
+        """Stop accepting and wait until queued + in-flight work flushed
+        (workers exit once the queue is empty)."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout)
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None):
+        """Shut the engine down.  ``drain=True`` serves out everything
+        already admitted first; ``drain=False`` sheds the queue
+        immediately (in-flight batches still finish)."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._draining = True
+            shed = []
+            if not drain:
+                shed, self._queue = list(self._queue), collections.deque()
+            self._cv.notify_all()
+        for req in shed:
+            self._shed(req, "draining")
+        for t in self._threads:
+            t.join(timeout)
+        if self._sigterm_installed:
+            try:
+                signal.signal(signal.SIGTERM,
+                              self._prev_sigterm or signal.SIG_DFL)
+            except ValueError:
+                pass  # ok: restoring from a non-main thread (drain thread)
+            self._sigterm_installed = False
+        telemetry.log_event("serving_drained", served=self._n["served"],
+                            shed=self._n["shed"])
+        telemetry.flush()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    # -- request admission --------------------------------------------------
+    def _feed_dtypes(self) -> List:
+        dts = getattr(self, "_feed_dtypes_cache", None)
+        if dts is None:
+            from ..framework.core import dtype_to_np
+            dts = self._feed_dtypes_cache = [
+                dtype_to_np(self._base._block.var(n).dtype)
+                for n in self._base.feed_names]
+        return dts
+
+    def coerce_feed(self, feed) -> List[np.ndarray]:
+        """Validate + dtype-cast one request feed (dict name->array or
+        list in input order) into the predictor's feed order.  Every
+        array must carry a leading batch dim (>= 1 row), equal across
+        feeds."""
+        names = self._base.feed_names
+        if not isinstance(feed, dict):
+            feed = dict(zip(names, feed))
+        arrays = []
+        for n, want in zip(names, self._feed_dtypes()):
+            if n not in feed:
+                raise ValueError(f"missing feed {n!r}; expected {names}")
+            a = np.asarray(feed[n])
+            if a.ndim < 1 or a.shape[0] < 1:
+                raise ValueError(f"feed {n!r} needs a leading batch dim, "
+                                 f"got shape {a.shape}")
+            if a.dtype != want:
+                a = a.astype(want)
+            arrays.append(a)
+        rows = {a.shape[0] for a in arrays}
+        if len(rows) != 1:
+            shapes = {n: a.shape for n, a in zip(names, arrays)}
+            raise ValueError(f"feeds disagree on batch dim: {shapes}")
+        return arrays
+
+    def submit(self, feed) -> ServingFuture:
+        """Admit one request (any batch size >= 1).  Returns a
+        :class:`ServingFuture`; sheds with :class:`OverloadedError`
+        when the queue is full or the engine is draining."""
+        arrays = self.coerce_feed(feed)
+        self._count("requests")
+        stat_add("serving_requests")
+        kind = fault.fire("serve_request")
+        if kind == "fail":
+            # stay inside the serving error taxonomy: callers (HTTP
+            # handler, loadgen) handle ServingError, not raw OSError
+            raise RequestFailed("injected serve_request failure")
+        req = _Request(arrays)
+        with self._cv:
+            if self._draining:
+                self._count("shed")
+                stat_add("serving_requests_shed")
+                raise OverloadedError("draining")
+            if kind == "shed" or len(self._queue) >= self.queue_cap:
+                self._count("shed")
+                stat_add("serving_requests_shed")
+                raise OverloadedError(
+                    "injected" if kind == "shed" else "queue_full",
+                    f"{len(self._queue)}/{self.queue_cap} queued")
+            self._queue.append(req)
+            # notify_all: a single notify can land on a worker holding a
+            # partial batch open for a DIFFERENT signature, leaving an
+            # idle worker asleep in its poll for up to 50ms
+            self._cv.notify_all()
+        # queue-depth gauge is refreshed per batch pickup, not per
+        # submit — one fewer registry round-trip on the admission path
+        return req.future
+
+    def predict(self, feed, timeout: Optional[float] = None):
+        """Blocking one-shot: ``submit(feed).result(timeout)``."""
+        return self.submit(feed).result(timeout)
+
+    # -- scheduler ----------------------------------------------------------
+    def _count(self, key: str, n: int = 1):
+        with self._n_lock:
+            self._n[key] += n
+
+    def _shed(self, req: _Request, reason: str):
+        self._count("shed")
+        stat_add("serving_requests_shed")
+        waited_ms = (time.monotonic() - req.t_submit) * 1e3
+        req.future._resolve(error=OverloadedError(
+            reason, f"waited {waited_ms:.1f}ms"))
+
+    def _pop_live_locked(self) -> Optional[_Request]:
+        """Pop the queue head, shedding any that outlived the deadline
+        (bounds p99 admission latency: a request is served fresh or
+        refused, never served stale)."""
+        now = time.monotonic()
+        while self._queue:
+            req = self._queue.popleft()
+            if now - req.t_submit > self._deadline_s:
+                self._shed(req, "deadline")
+                continue
+            return req
+        return None
+
+    def _gather_locked(self, sig, max_rows: int) -> List[_Request]:
+        """Pop a FIFO run of head requests matching ``sig`` while they
+        fit in ``max_rows`` (deadline-shedding stale heads as they are
+        encountered).  Strict head-of-line order keeps this O(batch) —
+        a standing queue under load must not cost O(queue) per taken
+        request."""
+        taken: List[_Request] = []
+        rows = 0
+        now = time.monotonic()
+        while self._queue and rows < max_rows:
+            req = self._queue[0]
+            if now - req.t_submit > self._deadline_s:
+                self._queue.popleft()
+                self._shed(req, "deadline")
+                continue
+            if req.sig != sig or req.rows > max_rows - rows:
+                break
+            self._queue.popleft()
+            taken.append(req)
+            rows += req.rows
+        return taken
+
+    def _next_batch(self) -> Optional[List[_Request]]:
+        """Block for the next batch: pop a head request, then hold the
+        batch open up to max_delay for same-signature followers, up to
+        max_batch rows.  Returns None when draining and drained."""
+        with self._cv:
+            first = None
+            while first is None:
+                first = self._pop_live_locked()
+                if first is None:
+                    if self._draining:
+                        return None
+                    self._cv.wait(0.05)
+            batch, rows = [first], first.rows
+            deadline = time.monotonic() + self._max_delay_s
+            while rows < self.max_batch:
+                more = self._gather_locked(first.sig,
+                                           self.max_batch - rows)
+                if more:
+                    batch.extend(more)
+                    rows += sum(r.rows for r in more)
+                    continue
+                if self._draining:
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cv.wait(left)
+            depth = len(self._queue)
+        telemetry.gauge_set("serving_queue_depth", depth)
+        now = time.monotonic()
+        for req in batch:
+            wait_ms = (now - req.t_submit) * 1e3
+            self._h_wait.observe(wait_ms)
+            telemetry.histogram_observe("serving_queue_wait_ms", wait_ms)
+        return batch
+
+    def _worker_loop(self, predictor):
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            self._run_batch(predictor, batch)
+
+    def _run_batch(self, predictor, batch: List[_Request]):
+        rows = sum(r.rows for r in batch)
+        bucket = batcher.bucket_for(rows, self.buckets)
+        try:
+            if fault.fire("serve_batch") == "fail":
+                raise fault.InjectedFault("injected serve_batch failure")
+            with telemetry.trace_span("serving/batch", rows=rows,
+                                      bucket=bucket or rows,
+                                      requests=len(batch)):
+                if bucket is None:
+                    # one oversized request (> largest bucket): chunk it
+                    # across full batches and reassemble — still bit-exact
+                    per_req = [self._run_chunked(predictor, batch[0])]
+                else:
+                    padded, _real = batcher.pad_stack(
+                        [r.arrays for r in batch], bucket)
+                    outs = predictor.run(padded)
+                    per_req = batcher.split_rows(outs,
+                                                 [r.rows for r in batch])
+                    self._book_batch(rows, bucket)
+            now = time.monotonic()
+            self._count("served", len(batch))
+            for req, outputs in zip(batch, per_req):
+                ms = (now - req.t_submit) * 1e3
+                self._h_request.observe(ms)
+                telemetry.histogram_observe("serving_request_ms", ms)
+                req.future._resolve(outputs=outputs)
+        except Exception as e:  # noqa: BLE001 — a batch failure must not
+            # kill the worker: exactly this batch's requests error, the
+            # engine keeps serving (tested via serve_batch:fail@N)
+            self._count("batch_failures")
+            stat_add("serving_batch_failures")
+            logger.warning("serving batch of %d request(s) failed: %s",
+                           len(batch), e)
+            telemetry.log_event("serving_batch_failure", rows=rows,
+                               error=f"{type(e).__name__}: {e}")
+            err = RequestFailed(f"batch execution failed: "
+                                f"{type(e).__name__}: {e}")
+            for req in batch:
+                req.future._resolve(error=err)
+
+    def _run_chunked(self, predictor, req: _Request) -> List[np.ndarray]:
+        chunks = []
+        for lo in range(0, req.rows, self.max_batch):
+            part = [a[lo:lo + self.max_batch] for a in req.arrays]
+            bucket = batcher.bucket_for(part[0].shape[0], self.buckets)
+            padded, real = batcher.pad_stack([part], bucket)
+            outs = predictor.run(padded)
+            chunks.append([np.asarray(o)[:real] for o in outs])
+            self._book_batch(real, bucket)
+        return [np.concatenate([c[i] for c in chunks], axis=0)
+                for i in range(len(chunks[0]))]
+
+    def _book_batch(self, rows: int, bucket: Optional[int]):
+        self._count("batches")
+        stat_add("serving_batches")
+        b = bucket or rows
+        pad = b - rows
+        if pad:
+            self._count("pad_rows", pad)
+            stat_add("serving_pad_rows", pad)
+        else:
+            self._count("exact_bucket")
+            stat_add("serving_batch_exact_bucket")
+        fill = batcher.fill_pct(rows, b)
+        self._h_fill.observe(fill)
+        telemetry.histogram_observe("serving_batch_fill_pct", fill)
+        with self._n_lock:
+            hit = self._n["exact_bucket"] / max(self._n["batches"], 1)
+        telemetry.gauge_set("serving_bucket_hit_rate", hit)
+
+    # -- introspection ------------------------------------------------------
+    def stats(self) -> dict:
+        """Engine-local serving stats (isolated from the process-global
+        monitor): counters, latency/wait/fill histogram summaries,
+        queue depth."""
+        with self._n_lock:
+            n = dict(self._n)
+        with self._cv:
+            depth = len(self._queue)
+        return {
+            "queue_depth": depth,
+            "queue_cap": self.queue_cap,
+            "workers": self.workers,
+            "buckets": list(self.buckets),
+            "draining": self._draining,
+            "counters": n,
+            "bucket_hit_rate": round(
+                n["exact_bucket"] / max(n["batches"], 1), 4),
+            "shed_rate": round(n["shed"] / max(n["requests"], 1), 4),
+            "request_ms": self._h_request.summary(),
+            "queue_wait_ms": self._h_wait.summary(),
+            "batch_fill_pct": self._h_fill.summary(),
+        }
+
+    def health(self) -> dict:
+        """The ``/healthz`` payload: serving liveness + the same
+        process-level fields the telemetry heartbeat exports (pid,
+        uptime, jax live-buffer memory)."""
+        from ..telemetry import _device_memory
+
+        status = "draining" if self._draining else "ok"
+        if self._closed:
+            status = "closed"
+        return {
+            "status": status,
+            "pid": os.getpid(),
+            "time": time.time(),
+            "uptime_s": round(time.time() - self._started, 3),
+            "device_memory": _device_memory(),
+            "serving": self.stats(),
+        }

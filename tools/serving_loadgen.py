@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""Closed- and open-loop load generator for the serving engine.
+
+Drives a :class:`paddle_tpu.serving.ServingEngine` **in process** (no
+sockets — the engine's submit() API is the contract; the HTTP server is
+a veneer over the same calls) and emits one JSON report:
+
+    {"mode": "closed", "requests": N, "ok": N, "shed": N, "failed": N,
+     "wall_s": ..., "qps": ..., "latency_ms": {"p50":..,"p95":..,"p99":..},
+     "shed_rate": ..., "engine": {<ServingEngine.stats()>}}
+
+* **closed loop** (``--mode closed``): ``--concurrency`` callers, each
+  submit→wait→repeat until ``--requests`` total — measures saturated
+  throughput (the batcher sees a standing queue, batches run full).
+* **open loop** (``--mode open``): requests arrive on a fixed ``--qps``
+  clock regardless of completions — measures latency at a target rate
+  and shed behavior past capacity (arrival rate does not slow down when
+  the engine does, so overload actually overloads).
+* ``--mode both`` runs closed then open and nests the two reports.
+
+Model: ``--model-dir`` (a ``save_inference_model`` export; give per-row
+feed shapes as ``--shape name=d0,d1``) or ``--synthetic`` (an in-process
+MLP — no files needed; ``--hidden/--depth/--feat`` size it).
+
+Used by ``bench.py run_serving`` (the ``legs.serving`` entry) and
+``tests/test_serving.py``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue as queue_mod
+import sys
+import threading
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# model builders
+# ---------------------------------------------------------------------------
+
+def build_synthetic(feat: int = 64, hidden: int = 256, depth: int = 2,
+                    classes: int = 8, seed: int = 0):
+    """In-process MLP predictor (no model dir needed): returns
+    ``(predictor, per_row_shapes)``."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.inference import Predictor
+
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    startup.random_seed = main.random_seed = seed
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [feat])
+        h = x
+        for i in range(depth):
+            h = layers.fc(h, hidden, act="relu", name=f"lg_fc{i}")
+        out = layers.fc(h, classes, name="lg_head")
+    scope = pt.Scope()
+    pt.Executor().run(startup, scope=scope)
+    return Predictor(main, ["x"], [out], scope=scope), {"x": (feat,)}
+
+
+def feed_maker(shapes: Dict[str, tuple], rows: int = 1,
+               seed: int = 0) -> Callable[[int], dict]:
+    """Deterministic per-request feed factory (a pool of distinct
+    pre-generated feeds, cycled by request index — host RNG off the
+    timed path)."""
+    rng = np.random.RandomState(seed)
+    pool = []
+    for _ in range(16):
+        pool.append({n: rng.rand(rows, *s).astype("float32")
+                     for n, s in shapes.items()})
+    return lambda i: pool[i % len(pool)]
+
+
+# ---------------------------------------------------------------------------
+# loops
+# ---------------------------------------------------------------------------
+
+def _percentiles(lat_ms: List[float]) -> dict:
+    if not lat_ms:
+        return {"count": 0}
+    a = np.asarray(lat_ms)
+    return {"count": len(lat_ms),
+            "mean": round(float(a.mean()), 3),
+            "p50": round(float(np.percentile(a, 50)), 3),
+            "p95": round(float(np.percentile(a, 95)), 3),
+            "p99": round(float(np.percentile(a, 99)), 3),
+            "max": round(float(a.max()), 3)}
+
+
+def _report(mode: str, n: int, ok: int, shed: int, failed: int,
+            wall_s: float, lat_ms: List[float], engine) -> dict:
+    return {"mode": mode, "requests": n, "ok": ok, "shed": shed,
+            "failed": failed, "wall_s": round(wall_s, 4),
+            "qps": round(ok / wall_s, 2) if wall_s > 0 else 0.0,
+            "offered_qps": round(n / wall_s, 2) if wall_s > 0 else 0.0,
+            "shed_rate": round(shed / max(n, 1), 4),
+            "latency_ms": _percentiles(lat_ms),
+            "engine": engine.stats()}
+
+
+def run_closed_loop(engine, make_feed, n_requests: int,
+                    concurrency: int, timeout_s: float = 60.0) -> dict:
+    """``concurrency`` synchronous callers sharing a ticket counter."""
+    from paddle_tpu.serving import OverloadedError, ServingError
+
+    tickets = iter(range(n_requests))
+    ticket_lock = threading.Lock()
+    lat, lock = [], threading.Lock()
+    counts = {"ok": 0, "shed": 0, "failed": 0}
+
+    def caller():
+        while True:
+            with ticket_lock:
+                i = next(tickets, None)
+            if i is None:
+                return
+            feed = make_feed(i)
+            t0 = time.monotonic()
+            try:
+                engine.predict(feed, timeout=timeout_s)
+                ms = (time.monotonic() - t0) * 1e3
+                with lock:
+                    counts["ok"] += 1
+                    lat.append(ms)
+            except OverloadedError:
+                with lock:
+                    counts["shed"] += 1
+            except (ServingError, TimeoutError):
+                with lock:
+                    counts["failed"] += 1
+
+    threads = [threading.Thread(target=caller, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    rep = _report("closed", n_requests, counts["ok"], counts["shed"],
+                  counts["failed"], wall, lat, engine)
+    rep["concurrency"] = concurrency
+    return rep
+
+
+def run_open_loop(engine, make_feed, qps: float, duration_s: float,
+                  timeout_s: float = 60.0, collectors: int = 8) -> dict:
+    """Fixed-rate arrivals: one pacing thread submits on a ``1/qps``
+    clock; a collector pool stamps completions.  Sheds at submit() count
+    against the offered load (that IS the overload behavior under
+    test)."""
+    from paddle_tpu.serving import OverloadedError, ServingError
+
+    lat, lock = [], threading.Lock()
+    counts = {"ok": 0, "shed": 0, "failed": 0}
+    pending: queue_mod.Queue = queue_mod.Queue()
+
+    def collector():
+        while True:
+            item = pending.get()
+            if item is None:
+                return
+            fut, t0 = item
+            try:
+                fut.result(timeout_s)
+                ms = (time.monotonic() - t0) * 1e3
+                with lock:
+                    counts["ok"] += 1
+                    lat.append(ms)
+            except OverloadedError:
+                with lock:
+                    counts["shed"] += 1
+            except (ServingError, TimeoutError):
+                with lock:
+                    counts["failed"] += 1
+
+    pool = [threading.Thread(target=collector, daemon=True)
+            for _ in range(collectors)]
+    for t in pool:
+        t.start()
+
+    period = 1.0 / qps
+    n = 0
+    t0 = time.monotonic()
+    end = t0 + duration_s
+    next_at = t0
+    while True:
+        now = time.monotonic()
+        if now >= end:
+            break
+        if now < next_at:
+            time.sleep(min(next_at - now, 0.01))
+            continue
+        next_at += period
+        i = n
+        n += 1
+        try:
+            fut = engine.submit(make_feed(i))
+            pending.put((fut, now))
+        except OverloadedError:
+            with lock:
+                counts["shed"] += 1
+    for _ in pool:
+        pending.put(None)
+    for t in pool:
+        t.join()
+    wall = time.monotonic() - t0
+    rep = _report("open", n, counts["ok"], counts["shed"],
+                  counts["failed"], wall, lat, engine)
+    rep["target_qps"] = qps
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _parse_shapes(specs: List[str]) -> Dict[str, tuple]:
+    out = {}
+    for spec in specs or []:
+        name, _, dims = spec.partition("=")
+        out[name] = tuple(int(d) for d in dims.split(",") if d)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--model-dir", help="save_inference_model export")
+    src.add_argument("--synthetic", action="store_true",
+                     help="in-process MLP (default)")
+    ap.add_argument("--shape", action="append", metavar="name=d0,d1",
+                    help="per-row feed shape (required with --model-dir)")
+    ap.add_argument("--feat", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--rows", type=int, default=1,
+                    help="rows per request")
+    ap.add_argument("--mode", choices=["closed", "open", "both"],
+                    default="closed")
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--qps", type=float, default=200.0)
+    ap.add_argument("--duration", type=float, default=2.0)
+    ap.add_argument("--workers", type=int, default=None)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--max-delay-ms", type=float, default=None)
+    ap.add_argument("--queue-cap", type=int, default=None)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--out", help="also write the JSON report here")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.serving import ServingEngine
+
+    if args.model_dir:
+        from paddle_tpu.inference import Predictor
+        shapes = _parse_shapes(args.shape)
+        if not shapes:
+            ap.error("--model-dir needs at least one --shape name=dims")
+        predictor = Predictor(args.model_dir)
+    else:
+        predictor, shapes = build_synthetic(args.feat, args.hidden,
+                                            args.depth)
+    engine = ServingEngine(predictor, workers=args.workers,
+                           max_batch=args.max_batch,
+                           max_delay_ms=args.max_delay_ms,
+                           queue_cap=args.queue_cap,
+                           deadline_ms=args.deadline_ms,
+                           warmup_shapes=shapes)
+    make_feed = feed_maker(shapes, rows=args.rows)
+
+    try:
+        if args.mode == "both":
+            report = {"mode": "both",
+                      "closed": run_closed_loop(engine, make_feed,
+                                                args.requests,
+                                                args.concurrency),
+                      "open": run_open_loop(engine, make_feed, args.qps,
+                                            args.duration)}
+        elif args.mode == "closed":
+            report = run_closed_loop(engine, make_feed, args.requests,
+                                     args.concurrency)
+        else:
+            report = run_open_loop(engine, make_feed, args.qps,
+                                   args.duration)
+    finally:
+        engine.close()
+
+    text = json.dumps(report)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
