@@ -9,7 +9,7 @@ from __future__ import annotations
 import os
 from typing import Dict, Iterable, Union
 
-__all__ = ["set_flags", "get_flags", "register_flag"]
+__all__ = ["set_flags", "get_flags", "register_flag", "all_flags"]
 
 _FLAGS: Dict[str, object] = {}
 _DEFS: Dict[str, tuple] = {}  # name -> (type, default, help)
@@ -51,6 +51,13 @@ def get_flags(flags: Union[str, Iterable[str]]):
             raise ValueError(f"unknown flag {flags!r}")
         return {flags: _FLAGS[flags]}
     return {f: get_flags(f)[f] for f in flags}
+
+
+def all_flags() -> Dict[str, object]:
+    """Every registered flag's current value (the ``/statusz``
+    introspection payload: an operator diagnosing a live server needs
+    the flags it actually runs with, not the defaults)."""
+    return {name: _FLAGS.get(name, _DEFS[name][1]) for name in _DEFS}
 
 
 def flag_value(name: str):
@@ -135,3 +142,25 @@ register_flag("FLAGS_serving_deadline_ms", 1000.0,
 register_flag("FLAGS_serving_workers", 2,
               "serving engine: predictor-pool size (clone()d predictors "
               "sharing device weights, one dispatch thread each)")
+register_flag("FLAGS_trace_sample", 1.0,
+              "head-sampling rate for serving request traces: fraction "
+              "of requests (0..1, deterministic every-Nth spacing) that "
+              "record full serving/admit..respond span trees; unsampled "
+              "requests keep phase timings only.  Independent of the "
+              "always-keep-slowest-N tail capture (FLAGS_trace_tail_keep)")
+register_flag("FLAGS_trace_tail_keep", 8,
+              "tail capture: always keep the N slowest request traces "
+              "regardless of head sampling (the /tracez 'slowest' list "
+              "— the requests worth asking 'why was this slow' about)")
+register_flag("FLAGS_tracez_recent", 32,
+              "how many recent head-sampled request traces /tracez "
+              "retains (bounded ring; oldest drop first)")
+register_flag("FLAGS_histogram_buckets", "",
+              "comma-separated upper bounds (ms) overriding the default "
+              "telemetry histogram buckets for histograms created "
+              "without explicit buckets; empty keeps DEFAULT_BUCKETS_MS")
+register_flag("FLAGS_serving_access_log", "",
+              "path of the serving JSONL access log (one line per HTTP "
+              "request: trace_id, status, per-phase latency breakdown); "
+              "empty defaults to <FLAGS_metrics_dir>/access.jsonl when a "
+              "metrics dir is set, else disabled")

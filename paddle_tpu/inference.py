@@ -215,6 +215,21 @@ class Predictor:
                 compiled += 1
         return compiled
 
+    def cache_info(self) -> dict:
+        """Compiled-executable inventory for live introspection (the
+        serving ``/statusz`` endpoint).  Non-blocking by design: the
+        cache lock is held for the full duration of an XLA compile, and
+        a status probe must never stall behind one — on contention this
+        reports ``busy: True`` instead of waiting."""
+        if not self._lock.acquire(timeout=0.05):
+            return {"compiled": None, "busy": True}
+        try:
+            sigs = list(self._cache)
+        finally:
+            self._lock.release()
+        return {"compiled": len(sigs),
+                "signatures": sorted(str(s) for s in sigs)}
+
     def clone(self) -> "Predictor":
         """Shared-weight clone (zero-copy: same scope arrays), private
         compile cache — the reference Clone() contract."""

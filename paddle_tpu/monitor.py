@@ -22,10 +22,28 @@ IR pass in framework/ir.py for pipeline parity).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Tuple
 
 __all__ = ["StatValue", "StatRegistry", "monitor", "stat_add", "stat_get",
+           "process_start_time", "process_uptime_s",
            "program_to_dot", "save_program_dot"]
+
+# one process-wide epoch for every "uptime" the system reports —
+# telemetry heartbeat, serving /healthz, and /statusz must agree on it
+# (three modules each stamping their own import time drift apart and
+# make cross-surface uptime deltas meaningless)
+_PROCESS_START = time.time()
+
+
+def process_start_time() -> float:
+    """Wall-clock time this process's monitor was imported (the shared
+    epoch for uptime reporting across telemetry/serving surfaces)."""
+    return _PROCESS_START
+
+
+def process_uptime_s() -> float:
+    return round(time.time() - _PROCESS_START, 3)
 
 
 class StatValue:

@@ -27,8 +27,8 @@ from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["bucket_sizes", "bucket_for", "signature_of", "pad_stack",
-           "split_rows", "fill_pct"]
+__all__ = ["bucket_sizes", "bucket_for", "signature_of",
+           "describe_signature", "pad_stack", "split_rows", "fill_pct"]
 
 
 def bucket_sizes(max_batch: int) -> Tuple[int, ...]:
@@ -58,6 +58,19 @@ def signature_of(arrays: Sequence[np.ndarray]) -> tuple:
     """Per-ROW feed signature: batchable requests are exactly those whose
     feeds agree on everything but the leading (batch) dim."""
     return tuple((a.shape[1:], str(a.dtype)) for a in arrays)
+
+
+def describe_signature(sig: tuple) -> str:
+    """Human-readable form of a :func:`signature_of` tuple for span
+    attributes and the ``/statusz`` bucket state — ``"(6,)f32|(2,)i64"``
+    instead of a nested tuple repr."""
+    short = {"float32": "f32", "float64": "f64", "float16": "f16",
+             "bfloat16": "bf16", "int32": "i32", "int64": "i64",
+             "int8": "i8", "uint8": "u8", "bool": "b1"}
+    parts = []
+    for shape, dtype in sig:
+        parts.append(f"{tuple(shape)}{short.get(dtype, dtype)}")
+    return "|".join(parts)
 
 
 def pad_stack(feeds: List[Sequence[np.ndarray]],

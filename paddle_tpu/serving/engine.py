@@ -27,6 +27,19 @@ trafficable engine:
   stops admissions, flushes every in-flight and queued request, joins
   the workers, and leaves the process clean.
 
+* **Request-scoped tracing** — every request is ONE trace: a
+  ``serving/request`` root span opened at admission and closed at
+  respond, with ``serving/admit``, ``serving/queue_wait`` (ended on the
+  dispatch thread — the span crosses the queue hop under the same
+  trace_id), ``serving/predict`` and ``serving/respond`` children; the
+  shared ``serving/batch`` span carries fan-in ``links`` to the N
+  request traces it serves.  Head sampling (``FLAGS_trace_sample``,
+  deterministic every-Nth) bounds overhead; the slowest
+  ``FLAGS_trace_tail_keep`` requests are ALWAYS captured (phase-timing
+  records, full span trees when also head-sampled) — :meth:`tracez`
+  feeds the HTTP ``/tracez`` endpoint.  Latency histograms record the
+  request's trace_id as an exemplar, so a bad p99 points at a trace.
+
 Fault sites (``paddle_tpu/fault.py``): ``serve_request`` (kinds
 ``shed`` — forced admission shed — and ``fail`` — admission error) and
 ``serve_batch`` (kind ``fail`` — the batch execution raises; only that
@@ -36,7 +49,9 @@ Stats (README catalog): counters ``serving_requests``,
 ``serving_requests_shed``, ``serving_batches``,
 ``serving_batch_exact_bucket``, ``serving_batch_failures``,
 ``serving_pad_rows``, ``serving_no_sigterm``; gauges
-``serving_queue_depth``, ``serving_bucket_hit_rate``; histograms
+``serving_queue_depth`` (refreshed at every enqueue AND dequeue),
+``serving_queue_depth_peak`` (high watermark — bursty peaks that a
+publish-time sample misses), ``serving_bucket_hit_rate``; histograms
 ``serving_request_ms``, ``serving_queue_wait_ms``,
 ``serving_batch_fill_pct``.
 """
@@ -44,6 +59,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import math
 import os
 import signal
 import threading
@@ -55,7 +71,7 @@ import numpy as np
 from .. import fault
 from .. import telemetry
 from ..flags import flag_value
-from ..monitor import stat_add
+from ..monitor import process_start_time, stat_add
 from . import batcher
 
 __all__ = ["ServingError", "OverloadedError", "RequestFailed",
@@ -86,14 +102,20 @@ class RequestFailed(ServingError):
 
 
 class ServingFuture:
-    """Completion handle returned by :meth:`ServingEngine.submit`."""
+    """Completion handle returned by :meth:`ServingEngine.submit`.
 
-    __slots__ = ("_event", "_outputs", "_error")
+    After resolution, ``trace`` holds the request's trace record
+    (trace_id, status, per-phase latency breakdown, span tree when
+    head-sampled; None with telemetry off) — the HTTP front end reads
+    it into the access log."""
+
+    __slots__ = ("_event", "_outputs", "_error", "trace")
 
     def __init__(self):
         self._event = threading.Event()
         self._outputs: Optional[List[np.ndarray]] = None
         self._error: Optional[Exception] = None
+        self.trace: Optional[dict] = None
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -119,7 +141,8 @@ class ServingFuture:
 
 
 class _Request:
-    __slots__ = ("arrays", "rows", "sig", "future", "t_submit")
+    __slots__ = ("arrays", "rows", "sig", "future", "t_submit",
+                 "t_picked", "trace_id", "sampled", "root", "spans")
 
     def __init__(self, arrays: List[np.ndarray]):
         self.arrays = arrays
@@ -127,6 +150,14 @@ class _Request:
         self.sig = batcher.signature_of(arrays)
         self.future = ServingFuture()
         self.t_submit = time.monotonic()
+        self.t_picked: Optional[float] = None
+        # trace identity: stamped by ServingEngine._trace_begin (None
+        # with telemetry off); `root` is the serving/request span when
+        # head-sampled, `spans` every span opened for this request
+        self.trace_id: Optional[str] = None
+        self.sampled = False
+        self.root = None
+        self.spans: List = []
 
 
 class ServingEngine:
@@ -198,7 +229,8 @@ class ServingEngine:
         # requests == served + shed + batch-failed (+ injected
         # serve_request:fail admission errors)
         self._n = {"requests": 0, "served": 0, "shed": 0, "batches": 0,
-                   "exact_bucket": 0, "batch_failures": 0, "pad_rows": 0}
+                   "exact_bucket": 0, "batch_failures": 0, "pad_rows": 0,
+                   "sampled": 0}
         self._n_lock = threading.Lock()
         self._h_request = telemetry.Histogram("serving_request_ms")
         self._h_wait = telemetry.Histogram("serving_queue_wait_ms")
@@ -208,6 +240,22 @@ class ServingEngine:
         # a lazy first histogram_observe would get millisecond buckets
         telemetry.metrics.histogram("serving_batch_fill_pct",
                                     buckets=FILL_BUCKETS)
+        # cached gauge handles: the queue-depth gauges update on EVERY
+        # enqueue and dequeue, so the registry round-trip is paid once
+        # here, not per request
+        self._g_depth = telemetry.metrics.gauge("serving_queue_depth")
+        self._g_peak = telemetry.metrics.gauge("serving_queue_depth_peak")
+        self._peak_depth = 0  # engine-local high watermark (cv-guarded)
+
+        # request-trace store for /tracez: a ring of recent head-sampled
+        # traces + the slowest-N tail (kept regardless of sampling)
+        self._sample_seq = 0
+        self._trace_lock = threading.Lock()
+        self._tracez_recent: collections.deque = collections.deque(
+            maxlen=max(1, int(flag_value("FLAGS_tracez_recent") or 32)))
+        self._tail_keep = max(0, int(flag_value("FLAGS_trace_tail_keep")
+                                     or 0))
+        self._tracez_slow: List[dict] = []
 
         self._sigterm_installed = False
         self._prev_sigterm = None
@@ -346,7 +394,8 @@ class ServingEngine:
     def submit(self, feed) -> ServingFuture:
         """Admit one request (any batch size >= 1).  Returns a
         :class:`ServingFuture`; sheds with :class:`OverloadedError`
-        when the queue is full or the engine is draining."""
+        when the queue is full or the engine is draining (the raised
+        error carries the request's ``trace_id``)."""
         arrays = self.coerce_feed(feed)
         self._count("requests")
         stat_add("serving_requests")
@@ -356,25 +405,139 @@ class ServingEngine:
             # handler, loadgen) handle ServingError, not raw OSError
             raise RequestFailed("injected serve_request failure")
         req = _Request(arrays)
+        admit = self._trace_begin(req)
         with self._cv:
             if self._draining:
-                self._count("shed")
-                stat_add("serving_requests_shed")
-                raise OverloadedError("draining")
+                raise self._submit_shed(req, admit, "draining")
             if kind == "shed" or len(self._queue) >= self.queue_cap:
-                self._count("shed")
-                stat_add("serving_requests_shed")
-                raise OverloadedError(
+                raise self._submit_shed(
+                    req, admit,
                     "injected" if kind == "shed" else "queue_full",
                     f"{len(self._queue)}/{self.queue_cap} queued")
+            if req.sampled:
+                # the wait span MUST exist before the request becomes
+                # visible to workers (the append below): a worker can
+                # pick the request up the instant the lock releases,
+                # and its span_end must find the span to close
+                wait = telemetry.span_begin("serving/queue_wait",
+                                            parent=req.root.context(),
+                                            detached=True)
+                req.spans.append(wait)
             self._queue.append(req)
+            depth = len(self._queue)
+            if depth > self._peak_depth:
+                self._peak_depth = depth
             # notify_all: a single notify can land on a worker holding a
             # partial batch open for a DIFFERENT signature, leaving an
             # idle worker asleep in its poll for up to 50ms
             self._cv.notify_all()
-        # queue-depth gauge is refreshed per batch pickup, not per
-        # submit — one fewer registry round-trip on the admission path
+        if telemetry.enabled():
+            # enqueue-time depth + high watermark: the peak gauge sees
+            # every burst, not just the depth at batch-pickup instants
+            self._g_depth.set(depth)
+            self._g_peak.set_max(depth)
+        telemetry.span_end(admit)
         return req.future
+
+    # -- request-trace bookkeeping ------------------------------------------
+    def _head_sample(self) -> bool:
+        """Deterministic head sampling: every ~(1/rate)-th validated
+        request records a full span tree (evenly spaced, no RNG on the
+        admission path; rate>=1 keeps all, <=0 none)."""
+        rate = flag_value("FLAGS_trace_sample")
+        try:
+            rate = float(rate if rate is not None else 0.0)
+        except (TypeError, ValueError):
+            rate = 0.0
+        if rate <= 0.0:
+            return False
+        if rate >= 1.0:
+            return True
+        with self._n_lock:
+            self._sample_seq += 1
+            n = self._sample_seq
+        return math.floor(n * rate) > math.floor((n - 1) * rate)
+
+    def _trace_begin(self, req: _Request):
+        """Stamp the request's trace identity and (when head-sampled)
+        open the ``serving/request`` root + ``serving/admit`` child.
+        Returns the admit span (None unsampled/disabled).  Constant
+        time with telemetry off: one enabled() check, nothing else."""
+        if not telemetry.enabled():
+            return None
+        if self._head_sample():
+            req.sampled = True
+            self._count("sampled")
+            req.root = telemetry.span_begin("serving/request",
+                                            detached=True, rows=req.rows)
+            req.trace_id = req.root.trace_id
+            admit = telemetry.span_begin("serving/admit",
+                                         parent=req.root.context(),
+                                         detached=True)
+            req.spans += [req.root, admit]
+            return admit
+        # unsampled requests still get an identity: the access log and
+        # histogram exemplars must be able to name ANY request
+        req.trace_id = telemetry.new_trace_id()
+        return None
+
+    def _wait_span_of(self, req: _Request):
+        for s in req.spans:
+            if s.name == "serving/queue_wait":
+                return s
+        return None
+
+    def _trace_finish(self, req: _Request, status: str,
+                      predict_ms: Optional[float] = None
+                      ) -> Optional[dict]:
+        """Build the request's trace record, feed the /tracez store
+        (recent ring if sampled; slowest-N tail regardless), and return
+        it.  Called after the request's spans are closed."""
+        if req.trace_id is None:
+            return None
+        now = time.monotonic()
+        total_ms = (now - req.t_submit) * 1e3
+        wait_ms = ((req.t_picked or now) - req.t_submit) * 1e3
+        rec = {
+            "trace_id": req.trace_id,
+            "ts": round(time.time() - total_ms / 1e3, 6),
+            "status": status,
+            "rows": req.rows,
+            "sampled": req.sampled,
+            "duration_ms": round(total_ms, 3),
+            "phases": {
+                "queue_wait_ms": round(wait_ms, 3),
+                "predict_ms": None if predict_ms is None
+                else round(predict_ms, 3),
+            },
+        }
+        if req.sampled and req.root is not None:
+            rec["spans"] = [s.to_tracez(t0=req.root.start)
+                            for s in req.spans]
+        with self._trace_lock:
+            if req.sampled:
+                self._tracez_recent.append(rec)
+            if self._tail_keep:
+                slow = self._tracez_slow
+                slow.append(rec)
+                slow.sort(key=lambda r: -r["duration_ms"])
+                del slow[self._tail_keep:]
+        return rec
+
+    def _submit_shed(self, req: _Request, admit, reason: str,
+                     detail: str = "") -> OverloadedError:
+        """Book an admission-time shed and build the error to raise
+        (spans closed, trace recorded, trace_id attached)."""
+        self._count("shed")
+        stat_add("serving_requests_shed")
+        telemetry.span_end(admit)
+        if req.root is not None:
+            req.root.attrs["status"] = "shed:" + reason
+            telemetry.span_end(req.root)
+        self._trace_finish(req, "shed:" + reason)
+        err = OverloadedError(reason, detail)
+        err.trace_id = req.trace_id
+        return err
 
     def predict(self, feed, timeout: Optional[float] = None):
         """Blocking one-shot: ``submit(feed).result(timeout)``."""
@@ -389,8 +552,14 @@ class ServingEngine:
         self._count("shed")
         stat_add("serving_requests_shed")
         waited_ms = (time.monotonic() - req.t_submit) * 1e3
-        req.future._resolve(error=OverloadedError(
-            reason, f"waited {waited_ms:.1f}ms"))
+        telemetry.span_end(self._wait_span_of(req))
+        if req.root is not None:
+            req.root.attrs["status"] = "shed:" + reason
+            telemetry.span_end(req.root)
+        err = OverloadedError(reason, f"waited {waited_ms:.1f}ms")
+        err.trace_id = req.trace_id
+        req.future.trace = self._trace_finish(req, "shed:" + reason)
+        req.future._resolve(error=err)
 
     def _pop_live_locked(self) -> Optional[_Request]:
         """Pop the queue head, shedding any that outlived the deadline
@@ -455,12 +624,18 @@ class ServingEngine:
                     break
                 self._cv.wait(left)
             depth = len(self._queue)
-        telemetry.gauge_set("serving_queue_depth", depth)
+        if telemetry.enabled():
+            self._g_depth.set(depth)  # dequeue-time refresh
         now = time.monotonic()
         for req in batch:
+            req.t_picked = now
+            # the queue_wait span ends HERE, on the dispatch thread —
+            # the cross-thread half of the request's trace
+            telemetry.span_end(self._wait_span_of(req))
             wait_ms = (now - req.t_submit) * 1e3
-            self._h_wait.observe(wait_ms)
-            telemetry.histogram_observe("serving_queue_wait_ms", wait_ms)
+            self._h_wait.observe(wait_ms, trace_id=req.trace_id)
+            telemetry.histogram_observe("serving_queue_wait_ms", wait_ms,
+                                        trace_id=req.trace_id)
         return batch
 
     def _worker_loop(self, predictor):
@@ -473,12 +648,27 @@ class ServingEngine:
     def _run_batch(self, predictor, batch: List[_Request]):
         rows = sum(r.rows for r in batch)
         bucket = batcher.bucket_for(rows, self.buckets)
+        t_run0 = time.monotonic()
+        pspans = []
         try:
             if fault.fire("serve_batch") == "fail":
                 raise fault.InjectedFault("injected serve_batch failure")
-            with telemetry.trace_span("serving/batch", rows=rows,
-                                      bucket=bucket or rows,
-                                      requests=len(batch)):
+            # the batch span is its own trace (it belongs to no single
+            # request); `links` record the fan-in to every sampled
+            # request trace riding in it
+            links = [r.root.context() for r in batch if r.root is not None]
+            with telemetry.trace_span("serving/batch", links=links,
+                                      rows=rows, bucket=bucket or rows,
+                                      requests=len(batch),
+                                      sig=batcher.describe_signature(
+                                          batch[0].sig)):
+                for r in batch:
+                    if r.root is not None:
+                        ps = telemetry.span_begin(
+                            "serving/predict", parent=r.root.context(),
+                            detached=True, rows=r.rows)
+                        r.spans.append(ps)
+                        pspans.append(ps)
                 if bucket is None:
                     # one oversized request (> largest bucket): chunk it
                     # across full batches and reassemble — still bit-exact
@@ -490,16 +680,33 @@ class ServingEngine:
                     per_req = batcher.split_rows(outs,
                                                  [r.rows for r in batch])
                     self._book_batch(rows, bucket)
+                for ps in pspans:
+                    telemetry.span_end(ps)
+                pspans = []
             now = time.monotonic()
+            predict_ms = (now - t_run0) * 1e3
             self._count("served", len(batch))
             for req, outputs in zip(batch, per_req):
+                rs = None
+                if req.root is not None:
+                    rs = telemetry.span_begin("serving/respond",
+                                              parent=req.root.context(),
+                                              detached=True)
+                    req.spans.append(rs)
                 ms = (now - req.t_submit) * 1e3
-                self._h_request.observe(ms)
-                telemetry.histogram_observe("serving_request_ms", ms)
+                self._h_request.observe(ms, trace_id=req.trace_id)
+                telemetry.histogram_observe("serving_request_ms", ms,
+                                            trace_id=req.trace_id)
+                telemetry.span_end(rs)
+                telemetry.span_end(req.root)
+                req.future.trace = self._trace_finish(req, "ok",
+                                                      predict_ms)
                 req.future._resolve(outputs=outputs)
         except Exception as e:  # noqa: BLE001 — a batch failure must not
             # kill the worker: exactly this batch's requests error, the
             # engine keeps serving (tested via serve_batch:fail@N)
+            for ps in pspans:
+                telemetry.span_end(ps)
             self._count("batch_failures")
             stat_add("serving_batch_failures")
             logger.warning("serving batch of %d request(s) failed: %s",
@@ -508,7 +715,13 @@ class ServingEngine:
                                error=f"{type(e).__name__}: {e}")
             err = RequestFailed(f"batch execution failed: "
                                 f"{type(e).__name__}: {e}")
+            predict_ms = (time.monotonic() - t_run0) * 1e3
             for req in batch:
+                if req.root is not None:
+                    req.root.attrs["status"] = "failed"
+                    telemetry.span_end(req.root)
+                req.future.trace = self._trace_finish(req, "failed",
+                                                      predict_ms)
                 req.future._resolve(error=err)
 
     def _run_chunked(self, predictor, req: _Request) -> List[np.ndarray]:
@@ -545,13 +758,15 @@ class ServingEngine:
     def stats(self) -> dict:
         """Engine-local serving stats (isolated from the process-global
         monitor): counters, latency/wait/fill histogram summaries,
-        queue depth."""
+        queue depth + its high watermark."""
         with self._n_lock:
             n = dict(self._n)
         with self._cv:
             depth = len(self._queue)
+            peak = self._peak_depth
         return {
             "queue_depth": depth,
+            "queue_depth_peak": peak,
             "queue_cap": self.queue_cap,
             "workers": self.workers,
             "buckets": list(self.buckets),
@@ -563,6 +778,41 @@ class ServingEngine:
             "request_ms": self._h_request.summary(),
             "queue_wait_ms": self._h_wait.summary(),
             "batch_fill_pct": self._h_fill.summary(),
+        }
+
+    def tracez(self) -> dict:
+        """The ``/tracez`` payload: recent head-sampled request traces
+        (newest first, full span trees) + the slowest-N tail (kept
+        regardless of sampling — phase-timing records, span trees when
+        the slow request was also sampled)."""
+        with self._trace_lock:
+            recent = list(self._tracez_recent)
+            slow = list(self._tracez_slow)
+        rate = flag_value("FLAGS_trace_sample")
+        return {
+            "sample_rate": float(rate) if rate is not None else 0.0,
+            "tail_keep": self._tail_keep,
+            "recent_sampled": recent[::-1],
+            "slowest": slow,
+        }
+
+    def introspect(self) -> dict:
+        """The engine half of ``/statusz``: stats + per-predictor
+        compiled-executable inventory + trace-store occupancy."""
+        with self._trace_lock:
+            traces = {"recent_sampled": len(self._tracez_recent),
+                      "slowest_kept": len(self._tracez_slow)}
+        return {
+            "stats": self.stats(),
+            "max_batch": self.max_batch,
+            "max_delay_ms": self._max_delay_s * 1e3,
+            "deadline_ms": self._deadline_s * 1e3,
+            "engine_uptime_s": round(time.time() - self._started, 3),
+            "process_uptime_s": round(
+                time.time() - process_start_time(), 3),
+            "executables": [p.cache_info()
+                            for p in dict.fromkeys(self._pool)],
+            "traces": traces,
         }
 
     def health(self) -> dict:

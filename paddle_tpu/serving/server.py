@@ -11,14 +11,31 @@ Endpoints:
 
 * ``POST /predict`` — body ``{"inputs": {feed_name: nested_list}}``
   (each input carries its leading batch dim).  200 →
-  ``{"outputs": [nested_list, ...], "shapes": [...], "ms": float}``.
-  Overload/drain sheds → **503** ``{"error": "overloaded", "reason":
-  "queue_full" | "deadline" | "draining" | "injected"}`` (explicit
-  backpressure, never unbounded queueing); malformed body / wrong
-  feeds → 400; batch execution failure → 500.
+  ``{"outputs": [nested_list, ...], "shapes": [...], "ms": float,
+  "trace_id": hex}``.  Overload/drain sheds → **503** ``{"error":
+  "overloaded", "reason": "queue_full" | "deadline" | "draining" |
+  "injected"}`` (explicit backpressure, never unbounded queueing);
+  malformed body / wrong feeds → 400; batch execution failure → 500.
 * ``GET /healthz`` — 200 with :meth:`ServingEngine.health` (serving
   stats + the telemetry heartbeat's process fields); 503 once the
   engine is closed — a load balancer drains the instance on SIGTERM.
+* ``GET /metrics`` — the live in-process registry rendered in strict
+  Prometheus text exposition format (``text/plain; version=0.0.4``) —
+  a real scrape target, not the textfile exporter.  503 when
+  ``FLAGS_telemetry=0``.
+* ``GET /statusz`` — JSON operator snapshot: every flag's current
+  value, pid/uptime/restart count, engine state (queue depth + peak,
+  buckets, workers, compiled executables), trace-store occupancy.
+* ``GET /tracez`` — JSON of recent head-sampled request traces (full
+  span trees) + the always-kept slowest-N tail.  503 when telemetry
+  is off.
+
+Every ``/predict`` request also appends one line to the JSONL access
+log (``FLAGS_serving_access_log``, defaulting to
+``<FLAGS_metrics_dir>/access.jsonl``): ts, status, total ms, trace_id,
+and the per-phase latency breakdown (queue_wait/predict) from the
+request's trace record — grep a slow trace_id straight from the log
+into ``/tracez``.
 
 ``install_sigterm()`` wires graceful shutdown: SIGTERM stops admission,
 flushes in-flight batches, then stops the listener (mirrors
@@ -28,11 +45,15 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from .. import fault, telemetry
+from ..flags import all_flags, flag_value
+from ..monitor import process_uptime_s, stat_add
 from .engine import OverloadedError, RequestFailed, ServingEngine
 
 __all__ = ["ServingServer", "serve"]
@@ -40,10 +61,72 @@ __all__ = ["ServingServer", "serve"]
 logger = logging.getLogger("paddle_tpu.serving.http")
 
 
+class _AccessLog:
+    """Append-only JSONL request log (one line per ``/predict``).
+
+    Honors the telemetry never-raise contract: the path re-resolves
+    per write (flags can change at runtime), I/O failures bump
+    ``telemetry_write_failures`` and drop the line, and the
+    ``metrics_write`` fault site covers it in CI.  The append handle is
+    cached (reopened only when the resolved path changes, or after an
+    error): handler threads must not pay an open/close plus a makedirs
+    syscall per request on the response path."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._path: Optional[str] = None
+        self._fh = None
+
+    def path(self) -> Optional[str]:
+        if not telemetry.enabled():
+            return None
+        p = flag_value("FLAGS_serving_access_log")
+        if p:
+            return str(p)
+        d = flag_value("FLAGS_metrics_dir")
+        return os.path.join(str(d), "access.jsonl") if d else None
+
+    def write(self, rec: dict):
+        path = self.path()
+        if path is None:
+            return
+        line = json.dumps(rec, sort_keys=True, default=str) + "\n"
+        try:
+            if fault.fire("metrics_write") == "raise":
+                raise fault.InjectedFault("injected access-log failure")
+            with self._lock:
+                if path != self._path or self._fh is None:
+                    self._close_locked()
+                    os.makedirs(os.path.dirname(path) or ".",
+                                exist_ok=True)
+                    self._fh = open(path, "a")
+                    self._path = path
+                self._fh.write(line)
+                self._fh.flush()  # a tail -f / test reader sees it now
+        except OSError as e:
+            stat_add("telemetry_write_failures")
+            logger.warning("access log write %s failed: %s", path, e)
+            with self._lock:
+                self._close_locked()  # reopen fresh on the next write
+
+    def _close_locked(self):
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError as e:
+                logger.debug("access log close: %s", e)
+        self._fh, self._path = None, None
+
+    def close(self):
+        with self._lock:
+            self._close_locked()
+
+
 class _Handler(BaseHTTPRequestHandler):
     # set by ServingServer on the subclass
     engine: ServingEngine = None
     request_timeout_s: Optional[float] = None
+    access_log: _AccessLog = None
 
     protocol_version = "HTTP/1.1"
 
@@ -52,19 +135,72 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _reply(self, code: int, payload: dict):
         body = json.dumps(payload).encode()
+        self._reply_raw(code, body, "application/json")
+
+    def _reply_raw(self, code: int, body: bytes, content_type: str):
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
+    # -- GET introspection plane --------------------------------------------
     def do_GET(self):
-        if self.path.split("?", 1)[0] != "/healthz":
+        route = self.path.split("?", 1)[0]
+        handler = {"/healthz": self._get_healthz,
+                   "/metrics": self._get_metrics,
+                   "/statusz": self._get_statusz,
+                   "/tracez": self._get_tracez}.get(route)
+        if handler is None:
             self._reply(404, {"error": "not found", "path": self.path})
             return
+        handler()
+
+    def _get_healthz(self):
         health = self.engine.health()
         self._reply(503 if health["status"] == "closed" else 200, health)
 
+    def _get_metrics(self):
+        """Prometheus scrape target over the LIVE in-process registry
+        (the textfile exporter only refreshes on the flush cadence and
+        dies with the process; a scrape answers now)."""
+        if not telemetry.enabled():
+            self._reply(503, {"error": "telemetry disabled",
+                              "detail": "FLAGS_telemetry=0"})
+            return
+        text = telemetry.prometheus_text()
+        self._reply_raw(200, text.encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+
+    def _get_statusz(self):
+        """Operator snapshot — works with telemetry off too (flags and
+        engine state carry no telemetry dependency)."""
+        tele = {"enabled": telemetry.enabled(),
+                "access_log": self.access_log.path(),
+                "metrics_dir": flag_value("FLAGS_metrics_dir") or None,
+                "trace_sample": flag_value("FLAGS_trace_sample"),
+                "trace_tail_keep": flag_value("FLAGS_trace_tail_keep")}
+        self._reply(200, {
+            "pid": os.getpid(),
+            "time": time.time(),
+            "process_uptime_s": process_uptime_s(),
+            "restart_count": int(
+                os.environ.get("PADDLE_TPU_RESTART_COUNT", "0") or 0),
+            "server": {"host": self.server.server_address[0],
+                       "port": self.server.server_address[1]},
+            "telemetry": tele,
+            "flags": all_flags(),
+            "engine": self.engine.introspect(),
+        })
+
+    def _get_tracez(self):
+        if not telemetry.enabled():
+            self._reply(503, {"error": "telemetry disabled",
+                              "detail": "FLAGS_telemetry=0"})
+            return
+        self._reply(200, self.engine.tracez())
+
+    # -- POST /predict ------------------------------------------------------
     def do_POST(self):
         # drain the body FIRST, before any error reply: HTTP/1.1
         # keep-alive would otherwise parse leftover body bytes as the
@@ -77,35 +213,56 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path.split("?", 1)[0] != "/predict":
             self._reply(404, {"error": "not found", "path": self.path})
             return
+        stat_add("serving_http_requests")
+        t0 = time.monotonic()
+        code, payload, trace = self._predict(body)
+        self._reply(code, payload)
+        ms = (time.monotonic() - t0) * 1e3
+        rec = {"ts": round(time.time(), 6), "method": "POST",
+               "path": "/predict", "status": code, "ms": round(ms, 3),
+               "trace_id": (trace or {}).get("trace_id")
+               or payload.get("trace_id")}
+        if trace:
+            rec["rows"] = trace.get("rows")
+            rec["phases"] = trace.get("phases")
+            rec["request_status"] = trace.get("status")
+        self.access_log.write(rec)
+
+    def _predict(self, body: bytes):
+        """Run one /predict body; returns (http_code, payload,
+        trace_record_or_None) so do_POST can both reply and access-log
+        without re-deciding anything."""
         try:
             doc = json.loads(body or b"{}")
             inputs = doc["inputs"]
             if not isinstance(inputs, dict):
                 raise TypeError("'inputs' must be an object")
         except (KeyError, TypeError, ValueError) as e:
-            self._reply(400, {"error": "bad request",
-                              "detail": f"{type(e).__name__}: {e}"})
-            return
+            return 400, {"error": "bad request",
+                         "detail": f"{type(e).__name__}: {e}"}, None
         t0 = time.monotonic()
+        fut = None
         try:
-            outputs = self.engine.predict(inputs,
-                                          timeout=self.request_timeout_s)
+            fut = self.engine.submit(inputs)
+            outputs = fut.result(self.request_timeout_s)
         except OverloadedError as e:
-            self._reply(503, {"error": "overloaded", "reason": e.reason,
-                              "detail": str(e)})
-            return
+            return 503, {"error": "overloaded", "reason": e.reason,
+                         "detail": str(e),
+                         "trace_id": getattr(e, "trace_id", None)}, \
+                (fut.trace if fut is not None else None)
         except (ValueError, KeyError) as e:  # bad feed names/shapes
-            self._reply(400, {"error": "bad request", "detail": str(e)})
-            return
+            return 400, {"error": "bad request", "detail": str(e)}, None
         except (RequestFailed, TimeoutError) as e:
-            self._reply(500, {"error": "request failed", "detail": str(e)})
-            return
-        self._reply(200, {
+            return 500, {"error": "request failed", "detail": str(e)}, \
+                (fut.trace if fut is not None else None)
+        trace = fut.trace
+        return 200, {
             "outputs": [o.tolist() for o in outputs],
             "shapes": [list(o.shape) for o in outputs],
             "names": self.engine._base.get_output_names(),
             "ms": round((time.monotonic() - t0) * 1e3, 3),
-        })
+            "trace_id": (trace or {}).get("trace_id"),
+        }, trace
 
 
 class ServingServer:
@@ -119,9 +276,11 @@ class ServingServer:
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
                  port: int = 0, request_timeout_s: Optional[float] = 30.0):
         self.engine = engine
+        self.access_log = _AccessLog()
         handler = type("BoundHandler", (_Handler,),
                        {"engine": engine,
-                        "request_timeout_s": request_timeout_s})
+                        "request_timeout_s": request_timeout_s,
+                        "access_log": self.access_log})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
@@ -174,6 +333,7 @@ class ServingServer:
         self._stop_listener()
         if self._thread is not None:
             self._thread.join(timeout)
+        self.access_log.close()
 
     def __enter__(self):
         return self

@@ -7,6 +7,16 @@ was step N slow" and "is the job alive" without print statements:
   manager with a thread-local parent stack, monotonic-clock durations,
   and a bounded ring of completed spans exportable as chrome://tracing /
   Perfetto JSON (:func:`export_chrome_trace`, ``tools/trace_export.py``).
+* **Span context** — every span carries a ``trace_id`` (inherited from
+  its parent; minted fresh at a root), :func:`current_span` exposes the
+  innermost open span as a handoff-able :class:`SpanContext`, and
+  ``trace_span(..., parent=ctx)`` re-parents under that context on ANY
+  thread — a request keeps one trace_id across queue/thread hops
+  (Dapper-style propagation; the serving engine is the main user).
+  ``detached=True`` spans skip the thread-local stack entirely (begun
+  on one thread, ended on another); ``links=[ctx, ...]`` records
+  fan-in/fan-out references to other traces (a serving batch links the
+  N request traces it carries).
 * **Typed metrics** — :class:`Gauge`, :class:`Timer`, and fixed-bucket
   :class:`Histogram` (p50/p95/p99 summaries) in a
   :class:`MetricsRegistry` alongside the monitor's counters.
@@ -45,15 +55,16 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import fault
 from .flags import flag_value
 from .monitor import monitor as _monitor
-from .monitor import stat_add
+from .monitor import process_start_time, stat_add
 
-__all__ = ["trace_span", "span_begin", "span_end", "get_spans",
-           "clear_spans", "span_tree", "export_chrome_trace",
-           "spans_to_chrome_events", "Gauge", "Timer", "Histogram",
-           "MetricsRegistry", "metrics", "gauge_set", "histogram_observe",
-           "timer", "log_event", "note_step", "prometheus_text",
-           "write_prometheus", "write_heartbeat", "maybe_flush", "flush",
-           "enabled"]
+__all__ = ["SpanContext", "new_trace_id", "trace_span", "span_begin",
+           "span_end", "current_span", "get_spans", "clear_spans",
+           "span_tree",
+           "export_chrome_trace", "spans_to_chrome_events", "Gauge",
+           "Timer", "Histogram", "MetricsRegistry", "metrics",
+           "gauge_set", "histogram_observe", "timer", "log_event",
+           "note_step", "prometheus_text", "write_prometheus",
+           "write_heartbeat", "maybe_flush", "flush", "enabled"]
 
 logger = logging.getLogger("paddle_tpu.telemetry")
 
@@ -71,30 +82,85 @@ def enabled() -> bool:
 # span tracer
 # ---------------------------------------------------------------------------
 
+class SpanContext:
+    """The handoff-able identity of a span: ``(trace_id, span_id)``.
+
+    Capture it on one thread (:func:`current_span` or
+    ``span.context()``), pass it across a queue / thread-pool hop, and
+    re-parent with ``trace_span(..., parent=ctx)`` — the child lands in
+    the same trace regardless of which thread runs it."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: int):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __eq__(self, other):
+        return (isinstance(other, SpanContext)
+                and other.trace_id == self.trace_id
+                and other.span_id == self.span_id)
+
+    def __hash__(self):
+        return hash((self.trace_id, self.span_id))
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    def __repr__(self):
+        return f"SpanContext({self.trace_id!r}, {self.span_id})"
+
+
+_trace_seq = [0]
+_trace_seq_lock = threading.Lock()
+
+
+def new_trace_id() -> str:
+    """Process-unique 16-hex-char trace id (pid + sequence: two
+    processes writing one metrics dir cannot collide).  Spans mint one
+    automatically at trace roots; the serving engine also stamps
+    UNsampled requests with one so access-log lines and histogram
+    exemplars still name the request."""
+    with _trace_seq_lock:
+        _trace_seq[0] += 1
+        n = _trace_seq[0]
+    return f"{os.getpid() & 0xffffffff:08x}{n & 0xffffffff:08x}"
+
+
 class Span:
     """One completed (or in-flight) traced region.
 
     Durations come from ``time.monotonic()``; ``ts``/``dur`` export as
     chrome-trace microseconds.  ``parent_id`` is the span id of the
-    enclosing :func:`trace_span` on the same thread (None at root), so
-    the tree reconstructs from the flat ring.
-    """
+    enclosing :func:`trace_span` on the same thread — or of the
+    explicit ``parent=SpanContext`` handed across a thread hop — and
+    None at a root, so the tree reconstructs from the flat ring.
+    ``trace_id`` is inherited from the parent (fresh at a root): every
+    span of one request shares it.  ``links`` are SpanContexts of
+    OTHER traces this span fans in from (a serving batch links the
+    requests it serves)."""
 
-    __slots__ = ("name", "attrs", "span_id", "parent_id", "tid", "start",
-                 "end")
+    __slots__ = ("name", "attrs", "span_id", "parent_id", "trace_id",
+                 "links", "tid", "start", "end")
     _next_id = [1]
     _id_lock = threading.Lock()
 
-    def __init__(self, name: str, attrs: Dict[str, Any], parent_id, tid):
+    def __init__(self, name: str, attrs: Dict[str, Any], parent_id, tid,
+                 trace_id: Optional[str] = None, links=None):
         self.name = name
         self.attrs = attrs
         with Span._id_lock:
             self.span_id = Span._next_id[0]
             Span._next_id[0] += 1
         self.parent_id = parent_id
+        self.trace_id = trace_id or new_trace_id()
+        self.links: Tuple[SpanContext, ...] = tuple(links or ())
         self.tid = tid
         self.start = time.monotonic()
         self.end: Optional[float] = None
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
 
     @property
     def duration_ms(self) -> Optional[float]:
@@ -102,17 +168,31 @@ class Span:
 
     def to_event(self) -> dict:
         """Chrome-trace complete ('X') event."""
+        args = dict(self.attrs, span_id=self.span_id,
+                    parent_id=self.parent_id, trace_id=self.trace_id)
+        if self.links:
+            args["links"] = [c.to_dict() for c in self.links]
         return {"ph": "X", "name": self.name, "cat": "paddle_tpu",
                 "pid": os.getpid(), "tid": self.tid,
                 "ts": (self.start + _EPOCH_OFFSET) * 1e6,
                 "dur": ((self.end or time.monotonic()) - self.start) * 1e6,
-                "args": dict(self.attrs, span_id=self.span_id,
-                             parent_id=self.parent_id)}
+                "args": args}
+
+    def to_tracez(self, t0: Optional[float] = None) -> dict:
+        """Compact JSON shape for the live ``/tracez`` endpoint."""
+        return {"name": self.name, "trace_id": self.trace_id,
+                "span_id": self.span_id, "parent_id": self.parent_id,
+                "tid": self.tid,
+                "start_ms": round((self.start - (t0 or 0.0)) * 1e3, 3),
+                "duration_ms": None if self.end is None
+                else round(self.duration_ms, 3),
+                "attrs": dict(self.attrs),
+                "links": [c.to_dict() for c in self.links]}
 
     def __repr__(self):
         d = self.duration_ms
         return (f"Span({self.name!r}, id={self.span_id}, "
-                f"parent={self.parent_id}, "
+                f"parent={self.parent_id}, trace={self.trace_id}, "
                 f"{'open' if d is None else f'{d:.3f}ms'})")
 
 
@@ -167,46 +247,91 @@ class _SpanCtx:
         return False
 
 
-def span_begin(name: str, **attrs) -> Optional[Span]:
+def span_begin(name: str, parent: Optional[SpanContext] = None,
+               links=None, detached: bool = False,
+               **attrs) -> Optional[Span]:
     """Open a span without a ``with`` block (executor hot path); pair
-    with :func:`span_end`.  Returns None when telemetry is disabled."""
+    with :func:`span_end`.  Returns None when telemetry is disabled.
+
+    ``parent`` — an explicit :class:`SpanContext` overrides the
+    thread-local stack: the span joins that context's trace (same
+    trace_id, parented under its span_id) even on a different thread.
+    ``detached=True`` keeps the span OFF this thread's parent stack —
+    required when the span will be ended on another thread (ending a
+    stacked span from elsewhere would strand it), or when it outlives
+    the caller (a request root span spanning submit→respond must not
+    adopt later same-thread spans as children).
+    ``links`` — SpanContexts of other traces to reference."""
     if not enabled():
         return None
-    stack = _stack()
-    parent = stack[-1].span_id if stack else None
-    span = Span(name, attrs, parent, threading.get_ident())
-    stack.append(span)
+    if parent is not None:
+        parent_id, trace_id = parent.span_id, parent.trace_id
+    else:
+        stack = _stack()
+        top = stack[-1] if stack else None
+        parent_id = top.span_id if top is not None else None
+        trace_id = top.trace_id if top is not None else None
+    span = Span(name, attrs, parent_id, threading.get_ident(),
+                trace_id=trace_id, links=links)
+    if not detached:
+        _stack().append(span)
     return span
 
 
 def span_end(span: Optional[Span]):
-    """Close `span`, recording it in the ring.  Defensive against spans
-    left open by an exception: everything above `span` on this thread's
-    stack is closed (and recorded) too."""
+    """Close `span`, recording it in the ring.  Safe from any thread:
+    a span on the CURRENT thread's stack unwinds it (everything left
+    open above it by an exception is closed and recorded too); a
+    detached or cross-thread span is closed directly.  Double-ends are
+    no-ops (a span is recorded at most once)."""
     if span is None:
         return
     stack = _stack()
     if span not in stack:
+        # detached span, or a stack span being ended from another
+        # thread (the queue/thread-hop half of trace propagation)
+        if span.end is None:
+            span.end = time.monotonic()
+            ring = _get_ring()  # before the lock: _get_ring takes it
+            with _ring_lock:
+                ring.append(span)
         return
     now = time.monotonic()
     ring = _get_ring()
     while stack:
         top = stack.pop()
-        top.end = now
-        with _ring_lock:
-            ring.append(top)
+        # a span another thread already ended keeps its recorded
+        # duration and must not be appended to the ring twice
+        if top.end is None:
+            top.end = now
+            with _ring_lock:
+                ring.append(top)
         if top is span:
             break
 
 
-def trace_span(name: str, **attrs):
+def current_span() -> Optional[SpanContext]:
+    """The innermost open span on THIS thread as a handoff-able
+    :class:`SpanContext` (None when nothing is open or telemetry is
+    off).  Capture before a queue/thread hop, re-attach on the far
+    side with ``trace_span(..., parent=ctx)``."""
+    if not enabled():
+        return None
+    stack = getattr(_tls, "stack", None)
+    return stack[-1].context() if stack else None
+
+
+def trace_span(name: str, parent: Optional[SpanContext] = None,
+               links=None, **attrs):
     """``with trace_span("ckpt/write", step=n): ...`` — times the block
     on the monotonic clock and records a :class:`Span` with the current
-    thread's innermost open span as parent.  A no-op (shared singleton,
-    no allocation beyond the call) under ``FLAGS_telemetry=0``."""
+    thread's innermost open span as parent — or, with ``parent=ctx``,
+    under that explicit :class:`SpanContext`'s trace regardless of
+    thread.  A no-op (shared singleton, no allocation beyond the call)
+    under ``FLAGS_telemetry=0``."""
     if not enabled():
         return _NOOP
-    return _SpanCtx(span_begin(name, **attrs))
+    return _SpanCtx(span_begin(name, parent=parent, links=links, **attrs))
 
 
 def get_spans() -> List[Span]:
@@ -284,6 +409,16 @@ class Gauge:
         with self._lock:
             self._v += float(v)
 
+    def set_max(self, v: float):
+        """High-watermark update: keep the max of the current value and
+        ``v`` (queue-depth peaks under bursty load — a sampled gauge
+        only shows the depth at publish instants and misses the spikes
+        that actually shed requests)."""
+        v = float(v)
+        with self._lock:
+            if v > self._v:
+                self._v = v
+
     def get(self) -> float:
         with self._lock:
             return self._v
@@ -295,29 +430,59 @@ DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
     0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
     1000, 2500, 5000, 10000, 30000, 60000)
 
+# recent-observation window exemplars are drawn from; top EXEMPLARS by
+# value of this window = "the trace ids of recent slow samples"
+_EXEMPLAR_WINDOW = 64
+_EXEMPLAR_KEEP = 5
+
+
+def _flag_buckets() -> Optional[Tuple[float, ...]]:
+    """``FLAGS_histogram_buckets``: comma-separated upper bounds (ms)
+    overriding DEFAULT_BUCKETS_MS for histograms created without
+    explicit buckets.  Malformed specs fall back to the default (a bad
+    flag must not take down the job)."""
+    spec = flag_value("FLAGS_histogram_buckets")
+    if not spec:
+        return None
+    try:
+        vals = tuple(float(x) for x in str(spec).split(",") if x.strip())
+    except ValueError:
+        logger.warning("FLAGS_histogram_buckets %r is not a comma-"
+                       "separated float list; using defaults", spec)
+        return None
+    return vals or None
+
 
 class Histogram:
     """Fixed-bucket histogram with percentile summaries.
 
-    Buckets are upper bounds (a +inf overflow bucket is implicit).
-    Percentiles interpolate linearly inside the chosen bucket — exact
-    enough for p50/p95/p99 dashboards, O(len(buckets)) memory forever.
+    Buckets are upper bounds (a +inf overflow bucket is implicit;
+    its population is exposed as :meth:`overflow_count`).  Percentiles
+    interpolate linearly inside the chosen bucket; an estimate landing
+    in the overflow bucket is *censored* — reported as the top finite
+    bucket edge and flagged, never extrapolated (the true value is
+    only known to be ``> buckets[-1]``).  O(len(buckets)) memory
+    forever.  ``observe(v, trace_id=...)`` additionally retains
+    exemplars: the trace ids of recent slow samples, linking a latency
+    percentile back to a concrete request trace.
     """
 
     __slots__ = ("name", "buckets", "_counts", "_sum", "_count", "_min",
-                 "_max", "_lock")
+                 "_max", "_lock", "_recent_ex")
 
     def __init__(self, name: str, buckets: Tuple[float, ...] = None):
         self.name = name
-        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS_MS))
+        self.buckets = tuple(sorted(buckets or _flag_buckets()
+                                    or DEFAULT_BUCKETS_MS))
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._count = 0
         self._min = math.inf
         self._max = -math.inf
         self._lock = threading.Lock()
+        self._recent_ex: deque = deque(maxlen=_EXEMPLAR_WINDOW)
 
-    def observe(self, v: float):
+    def observe(self, v: float, trace_id: Optional[str] = None):
         v = float(v)
         i = 0
         for i, ub in enumerate(self.buckets):
@@ -333,27 +498,59 @@ class Histogram:
                 self._min = v
             if v > self._max:
                 self._max = v
+            if trace_id is not None:
+                self._recent_ex.append((v, trace_id, time.time()))
 
-    def percentile(self, p: float) -> float:
-        """p in [0, 100]; linear interpolation within the bucket."""
+    def overflow_count(self) -> int:
+        """Observations above the top finite bucket (the implicit +Inf
+        bucket's own population)."""
+        with self._lock:
+            return self._counts[-1]
+
+    def exemplars(self, k: int = _EXEMPLAR_KEEP) -> List[dict]:
+        """The slowest ``k`` of the recent exemplar window, value-desc:
+        ``{"value", "trace_id", "ts"}`` — the trace to pull up when the
+        p99 looks wrong."""
+        with self._lock:
+            recent = list(self._recent_ex)
+        recent.sort(key=lambda e: e[0], reverse=True)
+        return [{"value": round(v, 4), "trace_id": t, "ts": round(ts, 3)}
+                for v, t, ts in recent[:k]]
+
+    def percentile(self, p: float, with_censor: bool = False):
+        """p in [0, 100]; linear interpolation within the bucket.  An
+        estimate in the overflow bucket returns the top bucket edge;
+        ``with_censor=True`` returns ``(value, censored)`` so callers
+        can mark it +Inf-censored instead of trusting the clamp."""
         with self._lock:
             counts, total = list(self._counts), self._count
             lo, hi = self._min, self._max
+        censored = False
         if total == 0:
-            return 0.0
+            return (0.0, censored) if with_censor else 0.0
         rank = p / 100.0 * total
         seen = 0.0
+        value = hi
         for i, c in enumerate(counts):
             if c == 0:
                 continue
             if seen + c >= rank:
+                if i == len(self.buckets):
+                    # overflow bucket: the estimate is only a lower
+                    # bound — report the censoring edge, not a guess
+                    # interpolated toward one extreme max
+                    value, censored = float(self.buckets[-1]), True
+                    break
                 b_lo = self.buckets[i - 1] if i > 0 else min(lo, 0.0)
-                b_hi = self.buckets[i] if i < len(self.buckets) else hi
+                b_hi = self.buckets[i]
                 b_lo, b_hi = max(b_lo, min(lo, b_hi)), min(b_hi, hi)
                 frac = (rank - seen) / c
-                return b_lo + (b_hi - b_lo) * min(max(frac, 0.0), 1.0)
+                value = b_lo + (b_hi - b_lo) * min(max(frac, 0.0), 1.0)
+                break
             seen += c
-        return hi
+        else:
+            censored = counts[-1] > 0 and hi > self.buckets[-1]
+        return (value, censored) if with_censor else value
 
     def summary(self) -> dict:
         with self._lock:
@@ -361,10 +558,21 @@ class Histogram:
                 return {"count": 0, "sum": 0.0}
             base = {"count": self._count, "sum": round(self._sum, 4),
                     "min": round(self._min, 4), "max": round(self._max, 4),
-                    "mean": round(self._sum / self._count, 4)}
-        base.update({"p50": round(self.percentile(50), 4),
-                     "p95": round(self.percentile(95), 4),
-                     "p99": round(self.percentile(99), 4)})
+                    "mean": round(self._sum / self._count, 4),
+                    "overflow": self._counts[-1]}
+        censored = []
+        for p in (50, 95, 99):
+            v, cens = self.percentile(p, with_censor=True)
+            base[f"p{p}"] = round(v, 4)
+            if cens:
+                censored.append(f"p{p}")
+        if censored:
+            # these percentiles sit in the +Inf overflow bucket: the
+            # value is the top bucket edge (a floor, not an estimate)
+            base["censored"] = censored
+        ex = self.exemplars()
+        if ex:
+            base["exemplars"] = ex
         return base
 
     def cumulative_buckets(self) -> List[Tuple[float, int]]:
@@ -481,9 +689,12 @@ def gauge_set(name: str, value: float):
         metrics.gauge(name).set(value)
 
 
-def histogram_observe(name: str, value: float):
+def histogram_observe(name: str, value: float,
+                      trace_id: Optional[str] = None):
+    """Module-level shorthand; ``trace_id`` retains the observation as
+    an exemplar (the trace behind a slow sample)."""
     if enabled():
-        metrics.histogram(name).observe(value)
+        metrics.histogram(name).observe(value, trace_id=trace_id)
 
 
 def timer(name: str):
@@ -499,7 +710,8 @@ def timer(name: str):
 # ---------------------------------------------------------------------------
 
 _step_state = {"step": 0, "last_step_ms": None, "examples_per_sec": None,
-               "host_ms": None, "last_t": None, "started": time.time()}
+               "host_ms": None, "last_t": None,
+               "started": process_start_time()}
 _step_lock = threading.Lock()
 
 
@@ -573,21 +785,32 @@ def _prom_name(name: str) -> str:
 
 
 def prometheus_text(snapshot: Optional[dict] = None) -> str:
-    """Render a :meth:`MetricsRegistry.snapshot` in the Prometheus text
-    exposition format (counters, gauges, and cumulative-bucket
-    histograms with ``_sum``/``_count``).  A passed snapshot renders
-    exactly as captured — nothing is read from the live registry."""
+    """Render a :meth:`MetricsRegistry.snapshot` in the strict
+    Prometheus text exposition format: per family one ``# HELP`` and
+    one ``# TYPE`` line, then the samples (counters, gauges, and
+    cumulative-bucket histograms with ``_sum``/``_count``).  Validated
+    by ``tools/check_stat_catalog.py validate_exposition`` in tier-1.
+    A passed snapshot renders exactly as captured — nothing is read
+    from the live registry."""
     snap = snapshot if snapshot is not None else metrics.snapshot()
     lines = []
+
+    def head(pn: str, kind: str, src: str):
+        lines.append(f"# HELP {pn} paddle_tpu {kind} {src} "
+                     f"(see README stat catalog)")
+        lines.append(f"# TYPE {pn} {kind}")
+
     for name, v in sorted(snap.get("counters", {}).items()):
         pn = _prom_name(name)
-        lines += [f"# TYPE {pn} counter", f"{pn} {v}"]
+        head(pn, "counter", name)
+        lines.append(f"{pn} {v}")
     for name, v in sorted(snap.get("gauges", {}).items()):
         pn = _prom_name(name)
-        lines += [f"# TYPE {pn} gauge", f"{pn} {v}"]
+        head(pn, "gauge", name)
+        lines.append(f"{pn} {v}")
     for name, h in sorted(snap.get("histograms", {}).items()):
         pn = _prom_name(name)
-        lines.append(f"# TYPE {pn} histogram")
+        head(pn, "histogram", name)
         for ub, cum in h.get("buckets", []):
             le = "+Inf" if math.isinf(ub) else repr(float(ub))
             lines.append(f'{pn}_bucket{{le="{le}"}} {cum}')

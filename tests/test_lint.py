@@ -8,16 +8,29 @@ explicit `# ok: <reason>` waiver.
 Observability hygiene: every literal metric name used through the
 monitor / telemetry APIs in paddle_tpu/ must appear (backtick-quoted)
 in the README stat catalog, so metric names can't drift undocumented
-out from under the dashboards reading them.
+out from under the dashboards reading them — and the serving
+``/metrics`` endpoint's claim of strict Prometheus text exposition is
+checked against a LIVE scrape (HELP/TYPE per family, name charset, no
+duplicate series), not just against fixtures.
 """
+import importlib.util
 import os
 import subprocess
 import sys
 import textwrap
+import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LINT = os.path.join(REPO, "tools", "check_no_bare_pass.py")
 CATALOG = os.path.join(REPO, "tools", "check_stat_catalog.py")
+
+
+def _load_catalog_tool():
+    spec = importlib.util.spec_from_file_location("check_stat_catalog",
+                                                  CATALOG)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def test_paddle_tpu_has_no_silent_except_pass():
@@ -93,3 +106,88 @@ def test_stat_catalog_lint_catches_undocumented_name(tmp_path):
         [sys.executable, CATALOG, str(bad), "--readme", str(readme)],
         capture_output=True, text=True, timeout=60)
     assert r.returncode == 0, r.stdout
+
+
+# ---------------------------------------------------------------------------
+# strict Prometheus exposition: validator unit + live /metrics scrape
+# ---------------------------------------------------------------------------
+
+def test_exposition_validator_catches_violations(tmp_path):
+    csc = _load_catalog_tool()
+    good = ("# HELP m_total docs\n# TYPE m_total counter\nm_total 3\n"
+            "# HELP h_ms docs\n# TYPE h_ms histogram\n"
+            'h_ms_bucket{le="1.0"} 1\nh_ms_bucket{le="+Inf"} 2\n'
+            "h_ms_sum 4.5\nh_ms_count 2\n")
+    assert csc.validate_exposition(good) == []
+
+    cases = {
+        "m 1\n": "no preceding # TYPE",
+        "# TYPE m counter\nm 1\n": "no # HELP",
+        "# HELP m d\n# TYPE m counter\nm 1\nm 1\n": "duplicate series",
+        "# HELP m d\n# TYPE m counter\n# TYPE m counter\nm 1\n":
+            "duplicate # TYPE",
+        "# HELP m d\n# TYPE m sometype\nm 1\n": "not one of",
+        "# HELP 1bad d\n# TYPE 1bad counter\n": "bad metric name",
+        "# HELP m d\n# TYPE m counter\nm  1\n": "malformed sample",
+        "# HELP m d\n# TYPE m counter\nm{le=}\n": "malformed sample",
+        "# HELP h d\n# TYPE h histogram\n"
+        'h_bucket{le="1.0"} 1\nh_sum 1\nh_count 1\n': "+Inf",
+        "m 1\n# HELP m d\n# TYPE m counter\n": "after its samples",
+    }
+    for text, needle in cases.items():
+        errs = csc.validate_exposition(text)
+        assert errs and any(needle in e for e in errs), (text, errs)
+
+    # the CLI face of the same validator (what CI scripts call)
+    bad_file = tmp_path / "bad.prom"
+    bad_file.write_text("# TYPE m counter\nm 1\nm 1\n")
+    r = subprocess.run(
+        [sys.executable, CATALOG, "--validate-prom", str(bad_file)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1 and "duplicate series" in r.stdout
+    good_file = tmp_path / "good.prom"
+    good_file.write_text(good)
+    r = subprocess.run(
+        [sys.executable, CATALOG, "--validate-prom", str(good_file)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout
+
+
+def test_live_metrics_scrape_is_strict_prometheus():
+    """Scrape a LIVE serving /metrics endpoint and hold it to the
+    strict exposition format — the contract a real Prometheus scraper
+    relies on, validated against the running registry rather than a
+    snapshot fixture."""
+    import paddle_tpu as pt
+    from paddle_tpu.serving import ServingEngine, serve
+
+    spec = importlib.util.spec_from_file_location(
+        "serving_loadgen", os.path.join(REPO, "tools",
+                                        "serving_loadgen.py"))
+    lg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lg)
+
+    pt.set_flags({"FLAGS_telemetry": True})
+    predictor, shapes = lg.build_synthetic(feat=4, hidden=8, depth=1,
+                                           classes=2)
+    eng = ServingEngine(predictor, workers=1, max_batch=2,
+                        max_delay_ms=1.0, deadline_ms=60000)
+    srv = serve(eng)
+    try:
+        make_feed = lg.feed_maker(shapes, rows=1)
+        # traffic first, so the scrape covers the serving histograms
+        assert lg._http_predict(srv.url + "/predict",
+                                lg._encode_bodies(make_feed, 1)[0],
+                                60.0) == "ok"
+        with urllib.request.urlopen(srv.url + "/metrics",
+                                    timeout=30) as r:
+            assert r.status == 200
+            assert "version=0.0.4" in r.headers["Content-Type"]
+            text = r.read().decode()
+    finally:
+        srv.close()
+    csc = _load_catalog_tool()
+    errs = csc.validate_exposition(text)
+    assert errs == [], errs[:10]
+    assert "paddle_tpu_serving_http_requests" in text
+    assert "paddle_tpu_serving_request_ms_count" in text

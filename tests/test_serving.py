@@ -22,7 +22,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as pt
-from paddle_tpu import fault, layers
+from paddle_tpu import fault, layers, telemetry
 from paddle_tpu.inference import Predictor
 from paddle_tpu.monitor import stat_get
 from paddle_tpu.serving import (OverloadedError, RequestFailed,
@@ -36,7 +36,10 @@ def _reset_faults():
     fault.reset()
     yield
     fault.reset()
-    pt.set_flags({"FLAGS_fault_inject": ""})
+    pt.set_flags({"FLAGS_fault_inject": "", "FLAGS_telemetry": True,
+                  "FLAGS_metrics_dir": "", "FLAGS_trace_sample": 1.0,
+                  "FLAGS_trace_tail_keep": 8, "FLAGS_tracez_recent": 32,
+                  "FLAGS_serving_access_log": ""})
 
 
 def _build_mlp(feat=6, hidden=16, classes=3, depth=1, seed=0):
@@ -341,6 +344,164 @@ def test_sigterm_drains_in_flight_then_rejects(small_model):
 
 
 # ---------------------------------------------------------------------------
+# request-scoped tracing
+# ---------------------------------------------------------------------------
+
+def test_request_trace_is_one_trace_across_threads(small_model):
+    """The tentpole contract: one request = one trace_id, with
+    admit/queue_wait/predict/respond child spans under the
+    serving/request root, crossing the admission thread → dispatch
+    thread hop; the batch span links the request trace."""
+    p, xs = small_model
+    telemetry.clear_spans()
+    with ServingEngine(p, workers=1, max_batch=4, max_delay_ms=1.0,
+                       deadline_ms=60000) as eng:
+        fut = eng.submit({"x": xs[:2]})
+        fut.result(60)
+        tid = fut.trace["trace_id"]
+        assert fut.trace["status"] == "ok" and fut.trace["sampled"]
+        spans = [s for s in telemetry.get_spans() if s.trace_id == tid]
+        by = {s.name: s for s in spans}
+        assert {"serving/request", "serving/admit", "serving/queue_wait",
+                "serving/predict", "serving/respond"} <= set(by)
+        root = by["serving/request"]
+        for name in ("serving/admit", "serving/queue_wait",
+                     "serving/predict", "serving/respond"):
+            assert by[name].parent_id == root.span_id, name
+        # the trace crosses >= 2 threads: admit on the submitter,
+        # predict/respond on the dispatch worker; queue_wait BEGAN on
+        # the submitter and ENDED on the worker
+        assert by["serving/admit"].tid != by["serving/predict"].tid
+        assert len({s.tid for s in spans}) >= 2
+        # batch span: its own trace, fan-in link to this request
+        batches = [s for s in telemetry.get_spans()
+                   if s.name == "serving/batch"]
+        linked = [s for s in batches
+                  if any(l.trace_id == tid for l in s.links)]
+        assert linked and linked[0].trace_id != tid
+        # phases + exemplar plumbing
+        assert fut.trace["phases"]["queue_wait_ms"] >= 0
+        assert fut.trace["phases"]["predict_ms"] > 0
+        # the engine-local latency histogram holds the request's trace
+        # id as an exemplar (the global one shares its top-5 window
+        # with every other engine in the process)
+        ex = eng.stats()["request_ms"]["exemplars"]
+        assert any(e["trace_id"] == tid for e in ex)
+        # /tracez store has the full span tree
+        tz = eng.tracez()
+        rec = [t for t in tz["recent_sampled"]
+               if t["trace_id"] == tid][0]
+        assert len({s["tid"] for s in rec["spans"]}) >= 2
+
+
+def test_head_sampling_and_tail_capture(small_model):
+    """FLAGS_trace_sample=0.25 records every 4th request's span tree;
+    FLAGS_trace_sample=0 records none — but the slowest-N tail still
+    captures phase records with trace ids."""
+    p, xs = small_model
+    pt.set_flags({"FLAGS_trace_sample": 0.25})
+    with ServingEngine(p, workers=1, max_batch=4, max_delay_ms=1.0,
+                       deadline_ms=60000) as eng:
+        for i in range(8):
+            eng.predict({"x": xs[:1]}, timeout=60)
+        n = eng.stats()["counters"]
+        assert n["sampled"] == 2  # deterministic: every 4th of 8
+        tz = eng.tracez()
+        assert len(tz["recent_sampled"]) == 2
+        assert tz["sample_rate"] == 0.25
+
+    pt.set_flags({"FLAGS_trace_sample": 0.0, "FLAGS_trace_tail_keep": 3})
+    telemetry.clear_spans()
+    with ServingEngine(p, workers=1, max_batch=4, max_delay_ms=1.0,
+                       deadline_ms=60000) as eng:
+        futs = [eng.submit({"x": xs[:1]}) for i in range(6)]
+        for f in futs:
+            f.result(60)
+        assert eng.stats()["counters"]["sampled"] == 0
+        assert not [s for s in telemetry.get_spans()
+                    if s.name == "serving/request"]
+        tz = eng.tracez()
+        assert tz["recent_sampled"] == []
+        # tail capture is sampling-independent: slowest 3 kept, with
+        # trace ids and phase breakdowns, slowest first
+        assert len(tz["slowest"]) == 3
+        durs = [t["duration_ms"] for t in tz["slowest"]]
+        assert durs == sorted(durs, reverse=True)
+        for t in tz["slowest"]:
+            assert t["trace_id"] and not t["sampled"]
+            assert t["phases"]["queue_wait_ms"] is not None
+
+
+def test_queue_depth_recorded_at_enqueue_with_high_watermark(small_model):
+    """The satellite contract: serving_queue_depth updates at enqueue
+    time and serving_queue_depth_peak holds the burst high watermark
+    even after the queue drains."""
+    p, xs = small_model
+    eng = ServingEngine(p, workers=1, max_batch=4, max_delay_ms=1.0,
+                        queue_cap=64, deadline_ms=60000, autostart=False)
+    try:
+        for i in range(5):
+            eng.submit({"x": xs[i:i + 1]})
+        # workers never started: the only updates were enqueue-time
+        assert telemetry.metrics.gauge("serving_queue_depth").get() == 5
+        assert eng.stats()["queue_depth"] == 5
+        eng.start()
+        deadline = time.monotonic() + 60
+        while eng.stats()["queue_depth"] > 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        stats = eng.stats()
+        assert stats["queue_depth_peak"] >= 5  # survives the drain
+        assert telemetry.metrics.gauge(
+            "serving_queue_depth_peak").get() >= 5
+    finally:
+        eng.close()
+
+
+def test_serving_telemetry_off_constant_time(small_model):
+    """FLAGS_telemetry=0 serving-path contract (the serving analog of
+    test_telemetry_off_emits_nothing): requests serve fine, zero spans
+    are recorded, the global latency histograms see nothing, no trace
+    records or access log exist, and /metrics //tracez degrade to 503
+    while /statusz and /predict stay up."""
+    p, xs = small_model
+    pt.set_flags({"FLAGS_telemetry": 0})
+    telemetry.clear_spans()
+    h0 = telemetry.metrics.histogram("serving_request_ms").summary()
+    eng = ServingEngine(p, workers=1, max_batch=4, max_delay_ms=1.0,
+                        deadline_ms=60000)
+    srv = serve(eng)
+    try:
+        code, doc = _post(srv.url + "/predict",
+                          {"inputs": {"x": xs[:2].tolist()}})
+        assert code == 200 and doc["trace_id"] is None
+        fut = eng.submit({"x": xs[:1]})
+        fut.result(60)
+        assert fut.trace is None
+        assert telemetry.get_spans() == []
+        h1 = telemetry.metrics.histogram("serving_request_ms").summary()
+        assert h1["count"] == h0["count"]
+        assert eng.tracez()["recent_sampled"] == []
+        assert eng.tracez()["slowest"] == []
+        assert srv.access_log.path() is None
+
+        for path in ("/metrics", "/tracez"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + path, timeout=30)
+            assert ei.value.code == 503
+            assert json.loads(ei.value.read())["error"] \
+                == "telemetry disabled"
+        with urllib.request.urlopen(srv.url + "/statusz",
+                                    timeout=30) as r:
+            st = json.loads(r.read())
+        assert r.status == 200
+        assert st["telemetry"]["enabled"] is False
+        assert st["engine"]["stats"]["counters"]["requests"] >= 2
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
 # HTTP front end
 # ---------------------------------------------------------------------------
 
@@ -460,6 +621,111 @@ def test_predictor_warmup_precompiles(small_model):
 # ---------------------------------------------------------------------------
 # loadgen CLI
 # ---------------------------------------------------------------------------
+
+def test_loadgen_open_loop_against_live_http_server(tmp_path):
+    """E2E satellite: serving_loadgen open-loop mode over real sockets
+    against a live ThreadingHTTPServer — the JSON report carries
+    qps/p99/shed, and /metrics agrees with the access log on request
+    counts (every POST /predict = one counter bump = one log line)."""
+    lg = _load_loadgen()
+    mdir = str(tmp_path / "serve_metrics")
+    pt.set_flags({"FLAGS_metrics_dir": mdir,
+                  "FLAGS_metrics_interval": 0.0})
+    predictor, shapes = lg.build_synthetic(feat=8, hidden=16, depth=1)
+    eng = ServingEngine(predictor, workers=2, max_batch=4,
+                        max_delay_ms=2.0, deadline_ms=60000,
+                        warmup_shapes=shapes)
+    srv = serve(eng)
+    try:
+        def scrape_http_count():
+            with urllib.request.urlopen(srv.url + "/metrics",
+                                        timeout=30) as r:
+                text = r.read().decode()
+            line = [l for l in text.splitlines()
+                    if l.startswith("paddle_tpu_serving_http_requests ")]
+            return int(line[0].split()[1]) if line else 0, text
+
+        before, _ = scrape_http_count()
+        make_feed = lg.feed_maker(shapes, rows=1)
+        rep = lg.run_open_loop_http(srv.url, make_feed, qps=120,
+                                    duration_s=0.5)
+        assert rep["mode"] == "open" and rep["url"] == srv.url
+        assert rep["requests"] > 0 and rep["ok"] > 0
+        assert rep["failed"] == 0
+        assert rep["qps"] > 0 and rep["target_qps"] == 120
+        assert {"p50", "p95", "p99"} <= set(rep["latency_ms"])
+        assert rep["shed"] == 0 and rep["shed_rate"] == 0.0
+        # the report embeds a /statusz snapshot instead of engine stats
+        assert rep["engine"] is None
+        assert rep["statusz"]["engine"]["stats"]["counters"]["served"] \
+            >= rep["ok"]
+
+        after, text = scrape_http_count()
+        access = os.path.join(mdir, "access.jsonl")
+        lines = [json.loads(l) for l in open(access) if l.strip()]
+        # /metrics and the access log agree on request counts
+        assert after - before == rep["requests"] == len(lines)
+        assert all(l["status"] == 200 and l["trace_id"]
+                   and l["phases"]["queue_wait_ms"] is not None
+                   for l in lines)
+        # the live scrape includes the serving stats and is strictly
+        # valid Prometheus exposition
+        assert "paddle_tpu_serving_request_ms_count" in text
+        assert "paddle_tpu_serving_queue_depth_peak" in text
+        csc = _load_tool("check_stat_catalog")
+        assert csc.validate_exposition(text) == []
+
+        # acceptance: a complete request trace crossing >= 2 threads
+        # under one trace_id, visible in /tracez ...
+        with urllib.request.urlopen(srv.url + "/tracez",
+                                    timeout=30) as r:
+            tz = json.loads(r.read())
+        recs = [t for t in tz["recent_sampled"] if t.get("spans")]
+        assert recs
+        rec = recs[-1]
+        names = {s["name"] for s in rec["spans"]}
+        assert {"serving/request", "serving/admit", "serving/queue_wait",
+                "serving/predict", "serving/respond"} <= names
+        assert len({s["tid"] for s in rec["spans"]}) >= 2
+        srv.close()  # flush writes trace.json into mdir
+
+        # ... and in the merged Perfetto export (trainer dir + serving
+        # dir -> distinct track groups, trace_id preserved)
+        other = str(tmp_path / "trainer_metrics")
+        telemetry.export_chrome_trace(
+            os.path.join(other, "trace.json"),
+            spans=[s for s in telemetry.get_spans()
+                   if s.name.startswith("executor/")])
+        out = str(tmp_path / "merged.json")
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "trace_export.py"),
+             "--metrics-dir", other, "--metrics-dir", mdir, out],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        with open(out) as f:
+            evs = json.load(f)["traceEvents"]
+        tid = rec["trace_id"]
+        merged = [e for e in evs
+                  if e.get("args", {}).get("trace_id") == tid]
+        assert {e["name"] for e in merged} >= {"serving/request",
+                                               "serving/predict"}
+        assert len({e["tid"] for e in merged}) >= 2
+    finally:
+        srv.close()
+        pt.set_flags({"FLAGS_metrics_dir": "",
+                      "FLAGS_metrics_interval": 10.0})
+
+
+def _load_tool(name):
+    import importlib.util
+
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
 
 def test_serving_loadgen_cli(tmp_path):
     out = str(tmp_path / "report.json")

@@ -32,6 +32,7 @@ def _telemetry_defaults():
     pt.set_flags({"FLAGS_telemetry": True, "FLAGS_metrics_dir": "",
                   "FLAGS_metrics_interval": 10.0,
                   "FLAGS_trace_buffer_size": 4096,
+                  "FLAGS_histogram_buckets": "",
                   "FLAGS_fault_inject": ""})
     fault.reset()
     telemetry.clear_spans()
@@ -119,6 +120,84 @@ def test_span_end_closes_abandoned_children():
     assert telemetry.get_spans()[-1].parent_id is None
 
 
+def test_span_context_reparents_across_threads():
+    """The Dapper contract: a SpanContext handed across a thread hop
+    keeps the child in the SAME trace (trace_id + parent linkage),
+    unlike the thread-local stack which roots per thread."""
+    telemetry.clear_spans()
+    captured = {}
+
+    with telemetry.trace_span("request_root", rows=2):
+        ctx = telemetry.current_span()
+        assert isinstance(ctx, telemetry.SpanContext)
+
+        def worker():
+            with telemetry.trace_span("hop_child", parent=ctx):
+                captured["inner"] = telemetry.current_span()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    by = {s.name: s for s in telemetry.get_spans()}
+    root, child = by["request_root"], by["hop_child"]
+    assert child.trace_id == root.trace_id == ctx.trace_id
+    assert child.parent_id == root.span_id
+    assert child.tid != root.tid
+    # the hop child was the worker thread's current span (same trace)
+    assert captured["inner"].trace_id == root.trace_id
+    # a root mints a fresh id; another root gets a different one
+    with telemetry.trace_span("other_root"):
+        pass
+    assert telemetry.get_spans()[-1].trace_id != root.trace_id
+
+
+def test_detached_span_cross_thread_end_and_links():
+    """Detached spans stay off the thread-local stack (an unrelated
+    same-thread span must not parent under them) and may be ended from
+    another thread; links record fan-in to other traces."""
+    telemetry.clear_spans()
+    root = telemetry.span_begin("req", detached=True)
+    with telemetry.trace_span("unrelated"):
+        pass
+    assert telemetry.get_spans()[-1].parent_id is None  # not under req
+
+    t = threading.Thread(target=telemetry.span_end, args=(root,))
+    t.start()
+    t.join()
+    assert root.end is not None
+    assert telemetry.get_spans()[-1] is root
+    n = len(telemetry.get_spans())
+    telemetry.span_end(root)  # double-end: no duplicate record
+    assert len(telemetry.get_spans()) == n
+
+    batch = telemetry.span_begin("batch", links=[root.context()],
+                                 detached=True)
+    telemetry.span_end(batch)
+    assert batch.trace_id != root.trace_id  # its own trace...
+    assert batch.links[0] == root.context()  # ...linked to the request
+    ev = batch.to_event()
+    assert ev["args"]["links"][0]["trace_id"] == root.trace_id
+    assert ev["args"]["trace_id"] == batch.trace_id
+
+
+def test_cross_thread_end_of_stacked_span_records_once():
+    """A stacked span ended from ANOTHER thread keeps its recorded end
+    and is not re-recorded (with a different duration) when its own
+    thread later unwinds the stack past it."""
+    telemetry.clear_spans()
+    outer = telemetry.span_begin("outer")
+    inner = telemetry.span_begin("inner")
+    t = threading.Thread(target=telemetry.span_end, args=(inner,))
+    t.start()
+    t.join()
+    end0 = inner.end
+    assert end0 is not None
+    telemetry.span_end(outer)  # unwind pops inner off this stack too
+    names = [s.name for s in telemetry.get_spans()]
+    assert names.count("inner") == 1 and names.count("outer") == 1
+    assert inner.end == end0  # duration untouched by the unwind
+
+
 def test_span_ring_is_bounded():
     pt.set_flags({"FLAGS_trace_buffer_size": 8})
     telemetry.clear_spans()  # re-reads the capacity flag
@@ -162,6 +241,52 @@ def test_histogram_constant_distribution_is_exact():
     assert s["p50"] == s["p95"] == s["p99"] == 500.0
 
 
+def test_histogram_overflow_censoring_and_exemplars():
+    """Percentile estimates landing in the +Inf overflow bucket report
+    the top bucket edge marked censored (a floor, not an extrapolated
+    guess); the overflow population is exposed; trace_id'd observations
+    surface as slowest-first exemplars."""
+    h = telemetry.Histogram("cens_ms", buckets=(1.0, 2.0, 4.0))
+    for i, v in enumerate((0.5, 1.5, 1.7, 200.0, 300.0)):
+        h.observe(v, trace_id=f"t{i}")
+    assert h.overflow_count() == 2
+    s = h.summary()
+    assert s["overflow"] == 2
+    # p99 (and p95) fall in the overflow bucket: value = top edge, not
+    # something interpolated toward max=300
+    assert s["p99"] == 4.0 and s["p95"] == 4.0
+    assert "p99" in s["censored"] and "p95" in s["censored"]
+    assert "p50" not in s["censored"]  # the median IS finite here
+    v, cens = h.percentile(99, with_censor=True)
+    assert v == 4.0 and cens
+    v, cens = h.percentile(10, with_censor=True)
+    assert v <= 1.0 and not cens
+    # exemplars: slowest recent first, carrying their trace ids
+    ex = s["exemplars"]
+    assert [e["trace_id"] for e in ex[:3]] == ["t4", "t3", "t2"]
+    assert ex[0]["value"] == 300.0
+    # a histogram with no censored percentiles has no marker key
+    ok = telemetry.Histogram("fine_ms", buckets=(1.0, 1000.0))
+    ok.observe(3.0)
+    assert "censored" not in ok.summary()
+
+
+def test_histogram_custom_buckets_flag():
+    pt.set_flags({"FLAGS_histogram_buckets": "5, 10,20"})
+    h = telemetry.Histogram("flagged_ms")
+    assert h.buckets == (5.0, 10.0, 20.0)
+    # explicit buckets still win over the flag
+    h2 = telemetry.Histogram("explicit_ms", buckets=(1.0, 2.0))
+    assert h2.buckets == (1.0, 2.0)
+    # malformed spec falls back to the defaults instead of raising
+    pt.set_flags({"FLAGS_histogram_buckets": "not,numbers"})
+    h3 = telemetry.Histogram("fallback_ms")
+    assert h3.buckets == telemetry.DEFAULT_BUCKETS_MS
+    pt.set_flags({"FLAGS_histogram_buckets": ""})
+    assert telemetry.Histogram("default_ms").buckets == \
+        telemetry.DEFAULT_BUCKETS_MS
+
+
 def test_gauge_and_timer():
     g = telemetry.metrics.gauge("test_gauge")
     g.set(3.5)
@@ -186,11 +311,32 @@ def test_prometheus_text_wellformed():
         r'^[a-zA-Z_][a-zA-Z0-9_]*(\{le="[^"]+"\})? -?[0-9.eE+inf-]+$')
     for line in text.strip().splitlines():
         assert line.startswith("# ") or line_re.match(line), line
+    assert "# HELP paddle_tpu_prom_gauge " in text
     assert "# TYPE paddle_tpu_prom_gauge gauge" in text
     assert "# TYPE paddle_tpu_prom_hist_ms histogram" in text
     assert 'paddle_tpu_prom_hist_ms_bucket{le="+Inf"}' in text
     assert "paddle_tpu_prom_hist_ms_count 1" in text
     assert "# TYPE paddle_tpu_executor_run_steps counter" in text
+
+
+def _load_tool(name):
+    import importlib.util
+
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_prometheus_text_passes_strict_validator():
+    """The registry's own rendering must satisfy the strict exposition
+    validator that tier-1 also runs against a live /metrics scrape."""
+    csc = _load_tool("check_stat_catalog")
+    telemetry.metrics.gauge("strict_gauge").set(1.0)
+    telemetry.metrics.histogram("strict_hist_ms").observe(2.0)
+    errs = csc.validate_exposition(telemetry.prometheus_text())
+    assert errs == [], errs[:10]
 
 
 def test_monitor_publish_atomic_under_concurrent_writers():
@@ -322,6 +468,50 @@ def test_trace_export_tool_merges_spans_and_events(tmp_path):
         doc = json.load(f)
     assert doc["traceEvents"]
     assert all(e["name"].startswith("ckpt/") for e in doc["traceEvents"])
+
+
+def test_trace_export_merges_multiple_metrics_dirs(tmp_path):
+    """--metrics-dir twice (a 'trainer' dir and a 'serving' dir) →
+    one Perfetto file, one process track group per source (synthetic
+    pid + process_name metadata), spans keeping their trace_id args."""
+    train_dir = _trainguard_run(tmp_path)
+    serve_dir = str(tmp_path / "serving_metrics")
+    telemetry.clear_spans()
+    root = telemetry.span_begin("serving/request", detached=True)
+    with telemetry.trace_span("serving/queue_wait", parent=root.context()):
+        pass
+    telemetry.span_end(root)
+    os.makedirs(serve_dir, exist_ok=True)
+    telemetry.export_chrome_trace(os.path.join(serve_dir, "trace.json"))
+
+    out = str(tmp_path / "merged.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_export.py"),
+         "--metrics-dir", train_dir, "--metrics-dir", serve_dir, out],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "2 source(s)" in r.stdout
+    with open(out) as f:
+        evs = json.load(f)["traceEvents"]
+    meta = [e for e in evs if e.get("ph") == "M"
+            and e["name"] == "process_name"]
+    assert len(meta) == 2
+    labels = {e["pid"]: e["args"]["name"] for e in meta}
+    assert any("serving_metrics" in v for v in labels.values())
+    # distinct track groups: each source's events carry its synthetic pid
+    pids_by_name = {}
+    for e in evs:
+        if e.get("ph") != "M":
+            pids_by_name.setdefault(e["name"], set()).add(e["pid"])
+    assert pids_by_name["executor/step"] == {1}
+    assert pids_by_name["serving/request"] == {2}
+    # the serving spans kept one trace_id across the merge
+    sv = [e for e in evs
+          if e["name"] in ("serving/request", "serving/queue_wait")]
+    assert len({e["args"]["trace_id"] for e in sv}) == 1
+    # metadata events lead, the rest is time-ordered
+    ts = [e["ts"] for e in evs if e.get("ph") != "M"]
+    assert ts == sorted(ts)
 
 
 def test_resume_telemetry(tmp_path):

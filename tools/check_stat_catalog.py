@@ -17,8 +17,16 @@ dynamic f-string names like ``fault_<site>_<kind>`` are out of scope):
 * telemetry attrs: ``telemetry.gauge_set/histogram_observe/timer(n)``
 * registry attrs:  ``metrics.gauge/histogram/timer(n)``
 
+This tool also owns the strict Prometheus text-exposition validator
+(:func:`validate_exposition`): the serving ``/metrics`` endpoint and
+the ``metrics.prom`` textfile claim the format, so tier-1
+(``tests/test_lint.py``) scrapes a live ``/metrics`` response and
+fails the build on any violation — missing/duplicated ``# HELP`` /
+``# TYPE`` lines, bad metric-name charset, malformed samples, or
+duplicate series.
+
 Usage: python tools/check_stat_catalog.py [--readme README.md] [--list]
-       [root ...]   (default root: paddle_tpu)
+       [--validate-prom FILE]  [root ...]   (default root: paddle_tpu)
 """
 from __future__ import annotations
 
@@ -85,6 +93,127 @@ def extract_names(path: str):
     return out
 
 
+# ---------------------------------------------------------------------------
+# strict Prometheus text-exposition validation
+# ---------------------------------------------------------------------------
+
+PROM_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+PROM_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"           # metric name
+    r"(\{[^{}]*\})?"                          # optional {labels}
+    r" (-?(?:[0-9.eE+-]+|\+?Inf|-Inf|NaN))"   # value (one space before)
+    r"( [0-9]+)?$")                           # optional ms timestamp
+_LABELS_RE = re.compile(
+    r'^\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*,?)?\}$')
+
+
+def _family_of(name: str, typed: dict) -> str:
+    """Map a histogram/summary component sample back to its family
+    (``x_bucket``/``x_sum``/``x_count`` -> ``x`` when ``x`` is typed
+    histogram or summary)."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if typed.get(base) in ("histogram", "summary"):
+                return base
+    return name
+
+
+def validate_exposition(text: str):
+    """Strictly validate Prometheus text exposition format.  Returns a
+    list of ``"line N: problem"`` strings (empty = valid).
+
+    Enforced: every non-comment line is a well-formed sample
+    (``name{labels} value [timestamp]``); metric names match the
+    Prometheus charset; every sample's family carries ``# HELP`` and
+    ``# TYPE`` lines that PRECEDE its samples; at most one HELP/TYPE
+    per family; TYPE values are real Prometheus types; no duplicate
+    series (same name + label set); histogram families expose
+    ``_bucket``/``_sum``/``_count`` with a ``+Inf`` bucket."""
+    errors = []
+    helped: dict = {}
+    typed: dict = {}
+    sampled_families = set()
+    seen_series = {}
+    bucket_infs = {}
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        def err(msg):
+            errors.append(f"line {lineno}: {msg} -- {line[:80]!r}")
+
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            kind = parts[1] if len(parts) > 1 else ""
+            if kind not in ("HELP", "TYPE"):
+                continue  # free-form comment: allowed
+            if len(parts) < 3:
+                err(f"{kind} line without a metric name")
+                continue
+            name = parts[2]
+            if not PROM_NAME_RE.match(name):
+                err(f"bad metric name {name!r} in {kind} line")
+                continue
+            book = helped if kind == "HELP" else typed
+            if name in book:
+                err(f"duplicate # {kind} for {name}")
+            if kind == "HELP":
+                if len(parts) < 4 or not parts[3].strip():
+                    err(f"HELP for {name} has empty docstring")
+                helped.setdefault(name, lineno)
+            else:
+                t = parts[3].strip() if len(parts) > 3 else ""
+                if t not in PROM_TYPES:
+                    err(f"TYPE for {name} is {t!r}, not one of "
+                        f"{sorted(PROM_TYPES)}")
+                typed.setdefault(name, t)
+                if name in sampled_families:
+                    err(f"# TYPE for {name} appears after its samples")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            err("malformed sample line (want 'name{labels} value "
+                "[timestamp]', single spaces)")
+            continue
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        if labels and not _LABELS_RE.match(labels):
+            err(f"malformed label set {labels!r}")
+        try:
+            float(value.replace("Inf", "inf").replace("NaN", "nan"))
+        except ValueError:
+            err(f"unparseable sample value {value!r}")
+        series = (name, labels)
+        if series in seen_series:
+            err(f"duplicate series {name}{labels} (first at line "
+                f"{seen_series[series]})")
+        else:
+            seen_series[series] = lineno
+        fam = _family_of(name, typed)
+        sampled_families.add(fam)
+        if fam not in typed:
+            err(f"sample for {name} with no preceding # TYPE {fam}")
+        elif fam not in helped:
+            err(f"sample for {name} with no # HELP {fam}")
+        if typed.get(fam) == "histogram" and name == fam + "_bucket":
+            if 'le="+Inf"' in labels:
+                bucket_infs[fam] = True
+            bucket_infs.setdefault(fam, False)
+
+    for fam, has_inf in sorted(bucket_infs.items()):
+        if not has_inf:
+            errors.append(f"histogram {fam} has no le=\"+Inf\" bucket")
+    for fam in sorted(f for f, t in typed.items() if t == "histogram"):
+        if fam in sampled_families:
+            for part in ("_sum", "_count"):
+                if (fam + part, "") not in seen_series:
+                    errors.append(f"histogram {fam} is missing "
+                                  f"{fam}{part}")
+    return errors
+
+
 CATALOG_MARKER = "**Stat catalog**"
 
 
@@ -110,7 +239,24 @@ def main(argv=None) -> int:
     ap.add_argument("--readme", default=None)
     ap.add_argument("--list", action="store_true",
                     help="print every extracted name and exit 0")
+    ap.add_argument("--validate-prom", metavar="FILE",
+                    help="instead of the catalog lint, strictly "
+                         "validate a Prometheus text exposition file "
+                         "('-' = stdin; e.g. a /metrics scrape or "
+                         "metrics.prom) and exit 1 on violations")
     args = ap.parse_args(argv)
+    if args.validate_prom:
+        if args.validate_prom == "-":
+            text = sys.stdin.read()
+        else:
+            with open(args.validate_prom, encoding="utf-8") as f:
+                text = f.read()
+        errs = validate_exposition(text)
+        for e in errs:
+            print(e)
+        if errs:
+            print(f"{len(errs)} exposition-format violation(s)")
+        return 1 if errs else 0
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     roots = args.roots or [os.path.join(here, "paddle_tpu")]
     readme = args.readme or os.path.join(here, "README.md")

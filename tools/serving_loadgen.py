@@ -1,9 +1,11 @@
 #!/usr/bin/env python
 """Closed- and open-loop load generator for the serving engine.
 
-Drives a :class:`paddle_tpu.serving.ServingEngine` **in process** (no
-sockets — the engine's submit() API is the contract; the HTTP server is
-a veneer over the same calls) and emits one JSON report:
+Drives a :class:`paddle_tpu.serving.ServingEngine` **in process** (the
+engine's submit() API is the contract) — or, with ``--url``, a live
+serving HTTP endpoint over real sockets (``POST /predict``; overload
+503s count as sheds, and the report embeds a ``/statusz`` snapshot
+instead of in-process engine stats) — and emits one JSON report:
 
     {"mode": "closed", "requests": N, "ok": N, "shed": N, "failed": N,
      "wall_s": ..., "qps": ..., "latency_ms": {"p50":..,"p95":..,"p99":..},
@@ -34,7 +36,9 @@ import queue as queue_mod
 import sys
 import threading
 import time
-from typing import Callable, Dict, List
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -104,7 +108,7 @@ def _report(mode: str, n: int, ok: int, shed: int, failed: int,
             "offered_qps": round(n / wall_s, 2) if wall_s > 0 else 0.0,
             "shed_rate": round(shed / max(n, 1), 4),
             "latency_ms": _percentiles(lat_ms),
-            "engine": engine.stats()}
+            "engine": engine.stats() if engine is not None else None}
 
 
 def run_closed_loop(engine, make_feed, n_requests: int,
@@ -221,6 +225,151 @@ def run_open_loop(engine, make_feed, qps: float, duration_s: float,
 
 
 # ---------------------------------------------------------------------------
+# HTTP loops (--url: drive a live ServingServer over real sockets)
+# ---------------------------------------------------------------------------
+
+def _encode_bodies(make_feed, n: int = 16) -> List[bytes]:
+    """Pre-serialize the feed pool to JSON bodies (host JSON encoding
+    off the timed path, mirroring feed_maker's pre-generated arrays)."""
+    return [json.dumps({"inputs": {k: np.asarray(v).tolist()
+                                   for k, v in make_feed(i).items()}}
+                       ).encode() for i in range(n)]
+
+
+def _http_predict(url: str, body: bytes, timeout_s: float) -> str:
+    """One POST /predict -> 'ok' | 'shed' (503 backpressure) |
+    'failed'."""
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            r.read()
+            return "ok"
+    except urllib.error.HTTPError as e:
+        try:
+            e.read()  # drain: keep-alive must not desync
+        except OSError:
+            pass  # ok: error body already gone with the connection
+        return "shed" if e.code == 503 else "failed"
+    except (OSError, TimeoutError, ValueError):
+        return "failed"
+
+
+def _http_statusz(base_url: str, timeout_s: float = 10.0
+                  ) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen(base_url.rstrip("/") + "/statusz",
+                                    timeout=timeout_s) as r:
+            return json.loads(r.read())
+    except (OSError, TimeoutError, ValueError):
+        return None
+
+
+def run_closed_loop_http(base_url: str, make_feed, n_requests: int,
+                         concurrency: int,
+                         timeout_s: float = 60.0) -> dict:
+    """Closed loop over HTTP: ``concurrency`` synchronous posters
+    sharing a ticket counter against a live server."""
+    url = base_url.rstrip("/") + "/predict"
+    bodies = _encode_bodies(make_feed)
+    tickets = iter(range(n_requests))
+    ticket_lock = threading.Lock()
+    lat, lock = [], threading.Lock()
+    counts = {"ok": 0, "shed": 0, "failed": 0}
+
+    def caller():
+        while True:
+            with ticket_lock:
+                i = next(tickets, None)
+            if i is None:
+                return
+            body = bodies[i % len(bodies)]
+            t0 = time.monotonic()
+            outcome = _http_predict(url, body, timeout_s)
+            ms = (time.monotonic() - t0) * 1e3
+            with lock:
+                counts[outcome] += 1
+                if outcome == "ok":
+                    lat.append(ms)
+
+    threads = [threading.Thread(target=caller, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    rep = _report("closed", n_requests, counts["ok"], counts["shed"],
+                  counts["failed"], wall, lat, None)
+    rep["concurrency"] = concurrency
+    rep["url"] = base_url
+    rep["statusz"] = _http_statusz(base_url)
+    return rep
+
+
+def run_open_loop_http(base_url: str, make_feed, qps: float,
+                       duration_s: float, timeout_s: float = 60.0,
+                       collectors: int = 16) -> dict:
+    """Open loop over HTTP: one pacing thread enqueues request bodies
+    on a ``1/qps`` clock; a poster pool sends them.  Arrivals stay on
+    the clock regardless of completions (the client-side queue absorbs
+    a slow server, so offered load does not back off), though with
+    every poster busy the in-flight concurrency caps at the pool
+    size."""
+    url = base_url.rstrip("/") + "/predict"
+    bodies = _encode_bodies(make_feed)
+    lat, lock = [], threading.Lock()
+    counts = {"ok": 0, "shed": 0, "failed": 0}
+    pending: queue_mod.Queue = queue_mod.Queue()
+
+    def poster():
+        while True:
+            item = pending.get()
+            if item is None:
+                return
+            body, t0 = item
+            outcome = _http_predict(url, body, timeout_s)
+            ms = (time.monotonic() - t0) * 1e3
+            with lock:
+                counts[outcome] += 1
+                if outcome == "ok":
+                    lat.append(ms)
+
+    pool = [threading.Thread(target=poster, daemon=True)
+            for _ in range(collectors)]
+    for t in pool:
+        t.start()
+
+    period = 1.0 / qps
+    n = 0
+    t0 = time.monotonic()
+    end = t0 + duration_s
+    next_at = t0
+    while True:
+        now = time.monotonic()
+        if now >= end:
+            break
+        if now < next_at:
+            time.sleep(min(next_at - now, 0.01))
+            continue
+        next_at += period
+        pending.put((bodies[n % len(bodies)], now))
+        n += 1
+    for _ in pool:
+        pending.put(None)
+    for t in pool:
+        t.join()
+    wall = time.monotonic() - t0
+    rep = _report("open", n, counts["ok"], counts["shed"],
+                  counts["failed"], wall, lat, None)
+    rep["target_qps"] = qps
+    rep["url"] = base_url
+    rep["statusz"] = _http_statusz(base_url)
+    return rep
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 
@@ -238,6 +387,10 @@ def main(argv=None) -> int:
     src.add_argument("--model-dir", help="save_inference_model export")
     src.add_argument("--synthetic", action="store_true",
                      help="in-process MLP (default)")
+    src.add_argument("--url", help="drive a live serving HTTP endpoint "
+                                   "(http://host:port) instead of an "
+                                   "in-process engine; feed shapes come "
+                                   "from --shape (default: x=<feat>)")
     ap.add_argument("--shape", action="append", metavar="name=d0,d1",
                     help="per-row feed shape (required with --model-dir)")
     ap.add_argument("--feat", type=int, default=64)
@@ -258,6 +411,32 @@ def main(argv=None) -> int:
     ap.add_argument("--deadline-ms", type=float, default=None)
     ap.add_argument("--out", help="also write the JSON report here")
     args = ap.parse_args(argv)
+
+    if args.url:
+        # remote target: no model, no engine — just paced HTTP traffic
+        shapes = _parse_shapes(args.shape) or {"x": (args.feat,)}
+        make_feed = feed_maker(shapes, rows=args.rows)
+        if args.mode == "both":
+            report = {"mode": "both",
+                      "closed": run_closed_loop_http(
+                          args.url, make_feed, args.requests,
+                          args.concurrency),
+                      "open": run_open_loop_http(args.url, make_feed,
+                                                 args.qps,
+                                                 args.duration)}
+        elif args.mode == "closed":
+            report = run_closed_loop_http(args.url, make_feed,
+                                          args.requests,
+                                          args.concurrency)
+        else:
+            report = run_open_loop_http(args.url, make_feed, args.qps,
+                                        args.duration)
+        text = json.dumps(report)
+        print(text)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text + "\n")
+        return 0
 
     from paddle_tpu.serving import ServingEngine
 

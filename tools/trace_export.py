@@ -1,18 +1,30 @@
 #!/usr/bin/env python
 """Export paddle_tpu telemetry as one Perfetto-loadable trace.
 
-Merges a ``FLAGS_metrics_dir``'s artifacts into a single
+Merges one or more ``FLAGS_metrics_dir``s' artifacts into a single
 chrome://tracing / Perfetto JSON file:
 
-* ``trace.json`` — the span ring (``executor/step``, ``ckpt/write``, ...)
-  exported by paddle_tpu/telemetry.py, passed through after validation;
+* ``trace.json`` — the span ring (``executor/step``, ``ckpt/write``,
+  ``serving/request``, ...) exported by paddle_tpu/telemetry.py,
+  passed through after validation;
 * ``events.jsonl`` — the structured event log, converted to instant
   ('i'-phase) events so checkpoint publishes, guard skips, resumes, and
   SIGTERMs show as markers on the same timeline.
 
+With repeated ``--metrics-dir`` arguments (e.g. a trainer dir and a
+serving dir), each source gets its own Perfetto process track group: a
+synthetic pid per source plus a ``process_name`` metadata event naming
+it, so two runs' (or the same process's two subsystems') spans stay
+visually separate but share one wall-clock timeline.  Spans keep their
+``trace_id`` args — a serving request found in ``/tracez`` or the
+access log is findable by id in the merged view.
+
 Usage::
 
     python tools/trace_export.py <metrics_dir | trace.json> [out.json]
+    python tools/trace_export.py --metrics-dir A --metrics-dir B [out.json]
+        [--metrics-dir DIR]   source dir (repeatable; when given, a
+                              lone positional arg is the OUTPUT path)
         [--filter SUBSTR]     keep only spans whose name contains SUBSTR
         [--no-events]         skip the events.jsonl markers
 
@@ -70,8 +82,9 @@ def load_event_markers(jsonl_path: str) -> list:
     return markers
 
 
-def export(src: str, out: str, name_filter: str = "",
-           include_events: bool = True) -> dict:
+def _load_source(src: str, name_filter: str,
+                 include_events: bool) -> dict:
+    """One metrics dir (or trace.json) -> its span events + markers."""
     if os.path.isdir(src):
         trace_path = os.path.join(src, "trace.json")
         events_path = os.path.join(src, "events.jsonl")
@@ -82,33 +95,85 @@ def export(src: str, out: str, name_filter: str = "",
     events = load_span_events(trace_path)
     if name_filter:
         events = [e for e in events if name_filter in e.get("name", "")]
-    n_spans = len(events)
-    n_markers = 0
+    markers = []
     if include_events and os.path.isfile(events_path):
         markers = load_event_markers(events_path)
-        n_markers = len(markers)
-        events = events + markers
-    events.sort(key=lambda e: e.get("ts", 0.0))
+    return {"src": src, "spans": events, "markers": markers}
+
+
+def export(src, out: str, name_filter: str = "",
+           include_events: bool = True) -> dict:
+    """``src`` is one metrics dir / trace.json, or a list of them.
+    Multiple sources merge onto one wall-clock timeline with one
+    Perfetto process track group per source: events are re-pidded
+    (synthetic pid = 1-based source index) and a ``process_name``
+    metadata event labels the group — two dirs written by the same
+    real pid (one process's trainer dir and serving dir) must not
+    interleave into one track.  Spans keep their ``trace_id`` args, so
+    a request surfaced by ``/tracez`` or the access log is findable by
+    id in the merged view."""
+    srcs = [src] if isinstance(src, str) else list(src)
+    if not srcs:
+        raise SystemExit("no source dir given")
+    loaded = [_load_source(s, name_filter, include_events) for s in srcs]
+    events = []
+    n_spans = n_markers = 0
+    for i, part in enumerate(loaded):
+        n_spans += len(part["spans"])
+        n_markers += len(part["markers"])
+        if len(loaded) == 1:
+            events += part["spans"] + part["markers"]
+            continue
+        pid = i + 1
+        label = os.path.basename(os.path.normpath(part["src"])) \
+            or part["src"]
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "ts": 0.0,
+                       "args": {"name": f"{label} ({part['src']})"}})
+        events += [dict(e, pid=pid)
+                   for e in part["spans"] + part["markers"]]
+    # metadata first, then time order (Perfetto wants names early)
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
     doc = {"traceEvents": events, "displayTimeUnit": "ms"}
     with open(out, "w") as f:
         json.dump(doc, f)
-    return {"out": out, "spans": n_spans, "markers": n_markers}
+    return {"out": out, "spans": n_spans, "markers": n_markers,
+            "sources": len(loaded)}
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("src", help="FLAGS_metrics_dir or a trace.json")
-    ap.add_argument("out", nargs="?", default="perfetto_trace.json")
+    ap.add_argument("src", nargs="?",
+                    help="FLAGS_metrics_dir or a trace.json")
+    ap.add_argument("out", nargs="?", default=None)
+    ap.add_argument("--metrics-dir", action="append", default=[],
+                    metavar="DIR", dest="metrics_dirs",
+                    help="additional metrics dir to merge (repeatable; "
+                         "each source gets its own process track group)")
     ap.add_argument("--filter", default="",
                     help="keep only spans whose name contains this")
     ap.add_argument("--no-events", action="store_true",
                     help="skip events.jsonl markers")
     args = ap.parse_args(argv)
-    info = export(args.src, args.out, args.filter,
-                  include_events=not args.no_events)
+    srcs, out = list(args.metrics_dirs), args.out
+    if args.src:
+        if srcs and out is None:
+            # `trace_export.py --metrics-dir a --metrics-dir b out.json`:
+            # the lone positional fills `src`, but with --metrics-dir
+            # sources present it is the OUTPUT (deterministic — never
+            # keyed on whether the path happens to exist, so re-running
+            # the same command cannot re-ingest its own output)
+            out = args.src
+        else:
+            srcs.insert(0, args.src)
+    if not srcs:
+        ap.error("give a positional src and/or --metrics-dir DIR")
+    info = export(srcs if len(srcs) > 1 else srcs[0],
+                  out or "perfetto_trace.json",
+                  args.filter, include_events=not args.no_events)
     print(f"wrote {info['out']}: {info['spans']} span(s), "
-          f"{info['markers']} event marker(s) — load in "
-          f"https://ui.perfetto.dev")
+          f"{info['markers']} event marker(s) from {info['sources']} "
+          f"source(s) — load in https://ui.perfetto.dev")
     return 0
 
 
