@@ -159,6 +159,23 @@ register_flag("FLAGS_histogram_buckets", "",
               "comma-separated upper bounds (ms) overriding the default "
               "telemetry histogram buckets for histograms created "
               "without explicit buckets; empty keeps DEFAULT_BUCKETS_MS")
+register_flag("FLAGS_device_peak_flops", 0.0,
+              "per-chip peak TFLOP/s override for the costmodel peak "
+              "table (paddle_tpu/costmodel.py); 0 = auto from "
+              "device_kind.  The bench's PEAK_TFLOPS env var, when "
+              "set, wins over both (historical contract)")
+register_flag("FLAGS_device_peak_bw", 0.0,
+              "per-chip peak HBM GB/s override for the costmodel peak "
+              "table; 0 = auto from device_kind")
+register_flag("FLAGS_hbm_sample_interval", 0.25,
+              "seconds between HBM live-buffer samples taken by the "
+              "observatory sampling thread (hbm_live_bytes / "
+              "hbm_peak_bytes gauges + the Perfetto counter track); "
+              "0 disables the sampler")
+register_flag("FLAGS_profilez_sec", 2.0,
+              "default duration (seconds) of an on-demand profiler "
+              "capture (GET /profilez, TrainGuard SIGUSR2); capped at "
+              "60s per capture")
 register_flag("FLAGS_serving_access_log", "",
               "path of the serving JSONL access log (one line per HTTP "
               "request: trace_id, status, per-phase latency breakdown); "

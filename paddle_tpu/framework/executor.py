@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import costmodel as _costmodel
 from .. import telemetry as _telemetry
 
 from ..ops.registry import LowerContext, get_op_def, lower_op
@@ -335,6 +336,33 @@ def lower_block(block: Block, env: Dict[str, Any], base_key,
 # ---------------------------------------------------------------------------
 # Executor
 # ---------------------------------------------------------------------------
+class _CacheEntry:
+    """One compiled-program cache slot: the jitted step function plus
+    its AOT-compiled executable and cost/memory **manifest**
+    (paddle_tpu/costmodel.py).  The executable compiles exactly once —
+    either here via ``lower().compile()`` (manifest captured) or, if
+    the AOT path fails on this backend, lazily inside the jit call
+    (``aot_failed`` latches the fallback so it is attempted once)."""
+
+    __slots__ = ("fn", "mut_in", "const_in", "state_out", "guarded",
+                 "compiled", "manifest", "aot_failed", "sig", "prev_t")
+
+    def __init__(self, fn, mut_in, const_in, state_out, guarded):
+        self.fn = fn
+        self.mut_in = mut_in
+        self.const_in = const_in
+        self.state_out = state_out
+        self.guarded = guarded
+        self.compiled = None
+        self.manifest = None
+        self.aot_failed = False
+        self.sig = None
+        # per-ENTRY inter-dispatch clock: two programs interleaving
+        # through one executor (train step + eval clone) must each
+        # measure their own full cycle, not the gap since the other
+        self.prev_t = None
+
+
 class Executor:
     """`Executor(place)` — place is advisory; jax selects the backend.
 
@@ -435,7 +463,9 @@ class Executor:
                                     fetch_names, guard_loss)
             if use_program_cache:
                 self._cache[key] = entry
-        fn, mut_in, const_in, state_out, guarded = entry
+        fn, mut_in, const_in, state_out, guarded = \
+            entry.fn, entry.mut_in, entry.const_in, entry.state_out, \
+            entry.guarded
 
         def _val(name):
             val = scope.find_var(name)
@@ -452,20 +482,45 @@ class Executor:
         self._step += 1
         _STEP_STAT.increase()
         step = np.int32(self._step)
+        # AOT-compile the entry at its first dispatch: same single XLA
+        # compile the jit call would pay, but through lower().compile()
+        # so the executable's cost/memory manifest is readable
+        # (costmodel.executable_manifest -> cache_info / gauges)
+        call = entry.compiled
+        if call is None and not entry.aot_failed:
+            call = self._aot_compile(entry, sig, feed_vals, mut_vals,
+                                     const_vals, step)
+        if call is None:
+            call = fn
         bench = flag_value("FLAGS_benchmark")
         if bench:
             _HOST_SYNC_STAT.increase()
             jax.block_until_ready(mut_vals)
             t0 = time.perf_counter()
-        dspan = _telemetry.span_begin("executor/dispatch",
-                                      step=self._step, guarded=guarded)
+        # the dispatch span carries the executable's HBM footprint, so
+        # the Perfetto HBM counter track is attributable span-by-span
+        # to the signature that was executing under it
+        dattrs = {"step": self._step, "guarded": guarded}
+        if entry.manifest and "peak_hbm_bytes" in entry.manifest:
+            dattrs["peak_hbm_bytes"] = entry.manifest["peak_hbm_bytes"]
+        dspan = _telemetry.span_begin("executor/dispatch", **dattrs)
+        try:
+            out_vals = call(feed_vals, mut_vals, const_vals, step)
+        except (TypeError, ValueError):
+            if call is not entry.compiled:
+                raise
+            # aval drift vs the AOT executable (argument validation
+            # raises BEFORE execution, so donated inputs are intact):
+            # fall back to the jit path, which recompiles per aval set
+            entry.compiled, entry.aot_failed = None, True
+            out_vals = fn(feed_vals, mut_vals, const_vals, step)
         if guarded:
-            fetches, new_state, ok = fn(feed_vals, mut_vals, const_vals,
-                                        step)
+            fetches, new_state, ok = out_vals
         else:
-            fetches, new_state = fn(feed_vals, mut_vals, const_vals, step)
+            fetches, new_state = out_vals
             ok = None
         _telemetry.span_end(dspan)
+        self._publish_efficiency(entry, new_state or fetches)
         if bench:
             t_dispatch = time.perf_counter() - t0
             _HOST_SYNC_STAT.increase()
@@ -491,6 +546,73 @@ class Executor:
             examples = int(shape[0]) if shape else 0
         return self._finish_fetches(fetches, return_numpy,
                                     resolve_guard=True), examples
+
+    def _aot_compile(self, entry: "_CacheEntry", sig, feed_vals,
+                     mut_vals, const_vals, step):
+        """Lower + compile the entry's step function at the concrete
+        argument set and capture its executable manifest.  On any
+        failure the entry latches ``aot_failed`` and the caller uses
+        the plain jit path — observability must never break a step."""
+        try:
+            with _telemetry.trace_span("executor/compile",
+                                       step=int(step), aot=True):
+                entry.compiled, entry.manifest = _costmodel.aot_compile(
+                    entry.fn, feed_vals, mut_vals, const_vals, step,
+                    signature=sig)
+            entry.sig = sig
+        except Exception as e:
+            entry.compiled, entry.aot_failed = None, True
+            import logging
+            logging.getLogger("paddle_tpu.executor").debug(
+                "AOT compile unavailable (falling back to jit): %s", e)
+            return None
+        if entry.manifest is not None and _telemetry.enabled():
+            _telemetry.log_event(
+                "executable_manifest", step=int(step),
+                **{k: v for k, v in entry.manifest.items()
+                   if k != "signature"})
+        return entry.compiled
+
+    def _publish_efficiency(self, entry: "_CacheEntry", out_vals):
+        """Per-step achieved MFU / HBM-bandwidth gauges: the entry's
+        manifest (flops, bytes accessed per execution) over THIS
+        entry's steady-state inter-dispatch interval.  The manifest
+        covers the whole program, so the rate divides by the number of
+        devices the dispatched outputs actually span (per-chip peaks in
+        the denominator)."""
+        if not _telemetry.enabled() or entry.manifest is None:
+            return
+        now = time.monotonic()
+        prev, entry.prev_t = entry.prev_t, now
+        if prev is None or now <= prev:
+            return
+        n_dev = 1
+        try:
+            first = out_vals[0] if out_vals else None
+            ds = getattr(getattr(first, "sharding", None),
+                         "device_set", None)
+            if ds:
+                n_dev = len(ds)
+        except (TypeError, IndexError, AttributeError):
+            pass  # ok: unsharded/opaque outputs count as one device
+        _costmodel.publish_achieved(entry.manifest, 1.0 / (now - prev),
+                                    n_devices=n_dev)
+
+    def cache_info(self) -> dict:
+        """Compiled-program inventory with per-entry manifests (the
+        executor sibling of ``Predictor.cache_info``): one record per
+        cache entry with its feed signature and cost/memory manifest
+        summary (None when the backend exposes no analysis)."""
+        entries = []
+        for e in self._cache.values():
+            if not isinstance(e, _CacheEntry):
+                continue  # pipeline entries carry no manifest
+            entries.append({
+                "signature": None if e.sig is None else str(e.sig),
+                "aot": e.compiled is not None,
+                "manifest": _costmodel.manifest_summary(e.manifest),
+            })
+        return {"compiled": len(entries), "entries": entries}
 
     def _finish_fetches(self, fetches, return_numpy: bool,
                         resolve_guard: bool = False):
@@ -882,7 +1004,8 @@ class Executor:
 
         # Donate only rebound state: params update in place in HBM.
         fn = jax.jit(step_fn, donate_argnums=(1,))
-        return fn, mut_in, const_in, state_out, guard_loss is not None
+        return _CacheEntry(fn, mut_in, const_in, state_out,
+                           guard_loss is not None)
 
     def _run_pipeline(self, program, feed, fetch_list, scope, return_numpy):
         """Programs marked by PipelineOptimizer: microbatch-scan schedule

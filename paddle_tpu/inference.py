@@ -148,6 +148,8 @@ class Predictor:
     def _compiled_for(self, sig, feed_arrays):
         import jax
 
+        from .costmodel import executable_manifest
+
         with self._lock:
             entry = self._cache.get(sig)
             if entry is None:
@@ -158,9 +160,12 @@ class Predictor:
                 # build duplicate executables for the same signature.
                 compiled = jitted.lower(tuple(feed_arrays), state_vals
                                         ).compile()
-                entry = (compiled, state_vals)
+                # executable manifest (flops / bytes / peak HBM) rides
+                # the cache entry into cache_info() -> /statusz
+                entry = (compiled, state_vals,
+                         executable_manifest(compiled, signature=sig))
                 self._cache[sig] = entry
-            return entry
+            return entry[0], entry[1]
 
     def _prepare(self, feed):
         arrays = []
@@ -217,18 +222,27 @@ class Predictor:
 
     def cache_info(self) -> dict:
         """Compiled-executable inventory for live introspection (the
-        serving ``/statusz`` endpoint).  Non-blocking by design: the
-        cache lock is held for the full duration of an XLA compile, and
-        a status probe must never stall behind one — on contention this
-        reports ``busy: True`` instead of waiting."""
+        serving ``/statusz`` endpoint), each signature with its
+        executable manifest (flops / bytes accessed / peak HBM from
+        XLA cost+memory analysis; None where the backend exposes
+        none).  Non-blocking by design: the cache lock is held for the
+        full duration of an XLA compile, and a status probe must never
+        stall behind one — on contention this reports ``busy: True``
+        instead of waiting."""
+        from .costmodel import manifest_summary
+
         if not self._lock.acquire(timeout=0.05):
             return {"compiled": None, "busy": True}
         try:
-            sigs = list(self._cache)
+            entries = [(s, e[2] if len(e) > 2 else None)
+                       for s, e in self._cache.items()]
         finally:
             self._lock.release()
-        return {"compiled": len(sigs),
-                "signatures": sorted(str(s) for s in sigs)}
+        return {"compiled": len(entries),
+                "signatures": sorted(str(s) for s, _ in entries),
+                "manifests": {str(s): manifest_summary(m)
+                              for s, m in sorted(entries,
+                                                 key=lambda x: str(x[0]))}}
 
     def clone(self) -> "Predictor":
         """Shared-weight clone (zero-copy: same scope arrays), private

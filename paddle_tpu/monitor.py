@@ -26,7 +26,7 @@ import time
 from typing import Dict, List, Tuple
 
 __all__ = ["StatValue", "StatRegistry", "monitor", "stat_add", "stat_get",
-           "process_start_time", "process_uptime_s",
+           "stat_add_per_device", "process_start_time", "process_uptime_s",
            "program_to_dot", "save_program_dot"]
 
 # one process-wide epoch for every "uptime" the system reports —
@@ -135,6 +135,21 @@ def stat_add(name: str, n: int = 1) -> int:
 
 def stat_get(name: str) -> int:
     return monitor.get(name).get()
+
+
+def stat_add_per_device(name: str, n_devices: int, n: int = 1):
+    """Bump the device-attributed siblings of a collective/memory stat:
+    ``<name>_dev<i>`` for each participating device index, alongside
+    the caller's own aggregate ``stat_add(name, ...)``.
+
+    An SPMD program emits each collective once at trace time but every
+    device in the group executes it, so multichip attribution (e.g. the
+    MULTICHIP_r05 legs, per-shard ``/statusz`` health) needs the
+    per-device series.  Device-suffixed names are dynamic and therefore
+    exempt from the README stat-catalog lint; the ``_dev<i>``
+    convention itself is documented there."""
+    for i in range(max(int(n_devices), 0)):
+        monitor.get(f"{name}_dev{i}").increase(n)
 
 
 # ---------------------------------------------------------------------------

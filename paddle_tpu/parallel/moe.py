@@ -24,7 +24,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..monitor import stat_add
+from ..monitor import stat_add, stat_add_per_device
 from .mesh import EP_AXIS
 
 
@@ -80,9 +80,13 @@ def moe_ffn_tokens(x, gate_w, w1, b1, w2, b2, *,
         return out + b2_.astype("float32")[:, None, :]
 
     if axis_name:
+        ep = lax.psum(1, axis_name)                      # axis size
         stat_add("collective_psum_calls")
         stat_add("collective_all_to_all_calls", 2)  # dispatch + combine
-        ep = lax.psum(1, axis_name)                      # axis size
+        # per-shard attribution (ep is concrete at trace time — it
+        # sizes the expert slice below)
+        stat_add_per_device("collective_psum_calls", ep)
+        stat_add_per_device("collective_all_to_all_calls", ep, 2)
         el = E // ep                                     # local experts
         me = lax.axis_index(axis_name)
         # each device keeps its expert slice of the (replicated-in-

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..monitor import stat_add
+from ..monitor import stat_add, stat_add_per_device
 from ..ops.pallas.flash_attention import (NEG_INF, blockwise_attention)
 
 
@@ -57,6 +57,10 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
     perm = [(i, (i + 1) % n) for i in range(n)]
     stat_add("collective_ppermute_calls", 2)  # k + v rotation per build
+    # every device on the axis executes the emitted collective: the
+    # per-shard series attributes it chip-by-chip (n is concrete at
+    # trace time — it sizes the ring permutation)
+    stat_add_per_device("collective_ppermute_calls", n, 2)
 
     def step(carry, t):
         m, l, acc, kc, vc = carry
@@ -107,11 +111,13 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
 
     def scatter(x):  # [B,H,Sl,D] -> [B,H/n,S,D]
         stat_add("collective_all_to_all_calls")
+        stat_add_per_device("collective_all_to_all_calls", n)
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
     def gather(x):   # [B,H/n,S,D] -> [B,H,Sl,D]
         stat_add("collective_all_to_all_calls")
+        stat_add_per_device("collective_all_to_all_calls", n)
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
 

@@ -69,6 +69,7 @@ from typing import List, Optional
 import numpy as np
 
 from .. import fault
+from .. import observatory
 from .. import telemetry
 from ..flags import flag_value
 from ..monitor import process_start_time, stat_add
@@ -259,11 +260,17 @@ class ServingEngine:
 
         self._sigterm_installed = False
         self._prev_sigterm = None
+        self._hbm_sampling = False
 
         if warmup_shapes is not None:
             self.warmup(warmup_shapes)
         if autostart:
             self.start()
+        # HBM timeline: the engine holds the process-wide sampler open
+        # for its lifetime (refcounted; a co-resident TrainGuard shares
+        # the same thread).  Acquired LAST: a constructor that dies in
+        # warmup must not leak a refcount close() can never release.
+        self._hbm_sampling = observatory.start_hbm_sampler()
 
     # -- lifecycle ----------------------------------------------------------
     def warmup(self, warmup_shapes) -> int:
@@ -345,6 +352,9 @@ class ServingEngine:
             except ValueError:
                 pass  # ok: restoring from a non-main thread (drain thread)
             self._sigterm_installed = False
+        if self._hbm_sampling:
+            self._hbm_sampling = False
+            observatory.stop_hbm_sampler()
         telemetry.log_event("serving_drained", served=self._n["served"],
                             shed=self._n["shed"])
         telemetry.flush()

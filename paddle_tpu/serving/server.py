@@ -51,7 +51,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from .. import fault, telemetry
+from .. import costmodel, fault, observatory, telemetry
 from ..flags import all_flags, flag_value
 from ..monitor import process_uptime_s, stat_add
 from .engine import OverloadedError, RequestFailed, ServingEngine
@@ -150,7 +150,8 @@ class _Handler(BaseHTTPRequestHandler):
         handler = {"/healthz": self._get_healthz,
                    "/metrics": self._get_metrics,
                    "/statusz": self._get_statusz,
-                   "/tracez": self._get_tracez}.get(route)
+                   "/tracez": self._get_tracez,
+                   "/profilez": self._get_profilez}.get(route)
         if handler is None:
             self._reply(404, {"error": "not found", "path": self.path})
             return
@@ -190,6 +191,8 @@ class _Handler(BaseHTTPRequestHandler):
                        "port": self.server.server_address[1]},
             "telemetry": tele,
             "flags": all_flags(),
+            "device": {"peaks": costmodel.device_peaks(),
+                       "hbm": observatory.hbm_snapshot()},
             "engine": self.engine.introspect(),
         })
 
@@ -199,6 +202,45 @@ class _Handler(BaseHTTPRequestHandler):
                               "detail": "FLAGS_telemetry=0"})
             return
         self._reply(200, self.engine.tracez())
+
+    def _get_profilez(self):
+        """On-demand profiler capture: ``GET /profilez?sec=N`` blocks
+        this handler thread for N seconds (bounded) while the XLA
+        profiler traces whatever the engine is executing — serving
+        never pauses (ThreadingHTTPServer keeps answering; the engine
+        keeps batching).  200 with the artifact inventory, 503 with
+        telemetry off, 409 when a capture is already in flight."""
+        if not telemetry.enabled():
+            self._reply(503, {"error": "telemetry disabled",
+                              "detail": "FLAGS_telemetry=0"})
+            return
+        sec = None
+        query = self.path.partition("?")[2]
+        for part in query.split("&"):
+            k, _, v = part.partition("=")
+            if k == "sec" and v:
+                try:
+                    sec = float(v)
+                except ValueError:
+                    self._reply(400, {"error": "bad request",
+                                      "detail": f"sec={v!r} is not a "
+                                                "number"})
+                    return
+        try:
+            rep = observatory.capture_profile(sec)
+        except observatory.CaptureBusy as e:
+            self._reply(409, {"error": "capture busy", "detail": str(e)})
+            return
+        except observatory.CaptureDisabled as e:
+            self._reply(503, {"error": "telemetry disabled",
+                              "detail": str(e)})
+            return
+        except Exception as e:  # profiler backend failure
+            logger.warning("/profilez capture failed: %s", e)
+            self._reply(500, {"error": "capture failed",
+                              "detail": f"{type(e).__name__}: {e}"})
+            return
+        self._reply(200, rep)
 
     # -- POST /predict ------------------------------------------------------
     def do_POST(self):

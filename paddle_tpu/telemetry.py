@@ -59,7 +59,7 @@ from .monitor import process_start_time, stat_add
 
 __all__ = ["SpanContext", "new_trace_id", "trace_span", "span_begin",
            "span_end", "current_span", "get_spans", "clear_spans",
-           "span_tree",
+           "span_tree", "counter_sample", "get_counter_samples",
            "export_chrome_trace", "spans_to_chrome_events", "Gauge",
            "Timer", "Histogram", "MetricsRegistry", "metrics",
            "gauge_set", "histogram_observe", "timer", "log_event",
@@ -342,9 +342,10 @@ def get_spans() -> List[Span]:
 
 
 def clear_spans():
-    global _ring
+    global _ring, _counter_ring
     with _ring_lock:
         _ring = None
+        _counter_ring = None
     _tls.stack = []
 
 
@@ -366,6 +367,54 @@ def spans_to_chrome_events(spans: Optional[List[Span]] = None) -> List[dict]:
     return [s.to_event() for s in (get_spans() if spans is None else spans)]
 
 
+# ---------------------------------------------------------------------------
+# counter samples (Perfetto counter tracks, e.g. the HBM timeline)
+# ---------------------------------------------------------------------------
+
+_counter_ring: Optional[deque] = None
+
+
+def _get_counter_ring() -> deque:
+    global _counter_ring
+    if _counter_ring is None:
+        with _ring_lock:
+            if _counter_ring is None:
+                cap = int(flag_value("FLAGS_trace_buffer_size") or 4096)
+                _counter_ring = deque(maxlen=max(1, cap))
+    return _counter_ring
+
+
+def counter_sample(name: str, series):
+    """Record one point of a Perfetto **counter track** (chrome-trace
+    'C' phase): ``series`` is a value or a ``{series_name: value}``
+    dict (multiple series render stacked on one track — the HBM
+    sampler emits ``{"total": ..., "dev0": ..., ...}``).  Bounded ring
+    (``FLAGS_trace_buffer_size``), no-op with telemetry off."""
+    if not enabled():
+        return
+    if not isinstance(series, dict):
+        series = {"value": float(series)}
+    ring = _get_counter_ring()
+    sample = (name, time.monotonic(),
+              {k: float(v) for k, v in series.items()})
+    with _ring_lock:
+        ring.append(sample)
+
+
+def get_counter_samples() -> List[tuple]:
+    """``(name, monotonic_ts, {series: value})`` tuples, oldest
+    first."""
+    with _ring_lock:
+        return list(_counter_ring) if _counter_ring is not None else []
+
+
+def counters_to_chrome_events() -> List[dict]:
+    return [{"ph": "C", "name": name, "cat": "paddle_tpu",
+             "pid": os.getpid(), "tid": 0,
+             "ts": (t + _EPOCH_OFFSET) * 1e6, "args": dict(series)}
+            for name, t, series in get_counter_samples()]
+
+
 def export_chrome_trace(path: str,
                         spans: Optional[List[Span]] = None) -> str:
     """Write the span ring as chrome://tracing / Perfetto JSON
@@ -374,8 +423,11 @@ def export_chrome_trace(path: str,
     attrs that aren't JSON-native (np scalars, paths) stringify via
     ``default=str``, and anything still unserializable drops the export
     (``telemetry_write_failures``) instead of killing the step."""
-    doc = {"traceEvents": spans_to_chrome_events(spans),
-           "displayTimeUnit": "ms"}
+    events = spans_to_chrome_events(spans)
+    if spans is None:
+        # live export: include counter-track samples (HBM timeline)
+        events = events + counters_to_chrome_events()
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
     try:
         text = json.dumps(doc, default=str)
     except (TypeError, ValueError) as e:

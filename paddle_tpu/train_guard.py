@@ -40,6 +40,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from . import fault
+from . import observatory
 from . import telemetry
 from .monitor import stat_add
 
@@ -138,6 +139,24 @@ class TrainGuard:
                 # non-main thread can't install handlers; preemption then
                 # falls back to the launcher's restart + auto-resume path
                 stat_add("train_guard_no_sigterm")
+        # device observatory: HBM timeline sampler for the run's
+        # lifetime, and SIGUSR2 -> on-demand profiler capture
+        # (FLAGS_profilez_sec seconds into FLAGS_metrics_dir/profiles,
+        # without pausing the step loop)
+        self._hbm_sampling = observatory.start_hbm_sampler()
+        self._sigusr2_installed = False
+        self._prev_usr2 = None
+        if handle_sigterm and hasattr(signal, "SIGUSR2"):
+            try:
+                self._prev_usr2 = signal.signal(signal.SIGUSR2,
+                                                self._on_sigusr2)
+                self._sigusr2_installed = True
+            except ValueError:
+                # non-main thread: the SIGTERM try above already booked
+                # train_guard_no_sigterm for this condition — captures
+                # remain available via capture_profile()
+                logger.debug("SIGUSR2 handler not installed "
+                             "(non-main thread)")
 
     # -- run loop -----------------------------------------------------------
     def step(self, feed, fetch_list=None, scope=None):
@@ -184,6 +203,22 @@ class TrainGuard:
         self.stop_requested = True
         self._sigterm_at = time.monotonic()
         stat_add("sigterm_received")
+
+    def _on_sigusr2(self, signum, frame):
+        # a signal handler must not sleep for the capture window: the
+        # capture runs on its own daemon thread while training continues
+        self.capture_profile()
+
+    def capture_profile(self, sec: Optional[float] = None):
+        """Trigger an on-demand ``jax.profiler`` capture (default
+        ``FLAGS_profilez_sec`` seconds) of the running training loop —
+        the training analog of the serving ``GET /profilez``.  Returns
+        the capture thread; the artifact lands under
+        ``FLAGS_metrics_dir/profiles`` and is announced in the event
+        log (``profile_capture``)."""
+        telemetry.log_event("profile_capture_requested",
+                            step=self.exe._step)
+        return observatory.capture_profile_async(sec)
 
     def _skipped(self, step: int):
         # `step` is the ORIGINAL step id the verdict belongs to — with the
@@ -249,6 +284,13 @@ class TrainGuard:
             signal.signal(signal.SIGTERM,
                           self._prev_handler or signal.SIG_DFL)
             self._sigterm_installed = False
+        if self._sigusr2_installed:
+            signal.signal(signal.SIGUSR2,
+                          self._prev_usr2 or signal.SIG_DFL)
+            self._sigusr2_installed = False
+        if self._hbm_sampling:
+            self._hbm_sampling = False
+            observatory.stop_hbm_sampler()
         self.exe.clear_nonfinite_guard()
         if self._ckpt_dir:
             self.exe.disable_auto_checkpoint()

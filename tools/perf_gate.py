@@ -1,0 +1,352 @@
+#!/usr/bin/env python
+"""Perf regression gate: fresh bench/op-bench reports vs the committed
+trajectory, with noise-aware tolerances.
+
+Makes the numbers load-bearing (ROADMAP item 5): a perf PR runs the
+bench, then this gate compares the fresh report against the checked-in
+``BENCH_r*.json`` baselines (and optionally an ``op_bench.py`` report
+against ``tools/op_bench_baseline.json``) and **exits nonzero on
+regression** — a capacity or step-time regression fails loudly instead
+of shipping silently.
+
+Noise model: the shared chip drifts ±10% between runs with
+byte-identical programs (bench.py module docstring), and every bench
+leg records its own window spread as ``stats.p10``/``stats.p90``.  The
+per-leg tolerance is therefore::
+
+    tol = max(--floor-tol,                     # cross-run chip drift
+              (base.p90 - base.p10) / base.median,   # baseline's noise
+              (new.p90  - new.p10)  / new.median)    # fresh run's noise
+
+and a leg regresses when ``new.median < base.median * (1 - tol)``.
+Legs are only compared on matching ``device_kind`` (a CPU smoke run
+against a TPU baseline is a skip, not a pass or fail), and legs the
+baseline flagged ``anomaly`` are skipped (a garbage baseline must not
+gate anything).
+
+Usage::
+
+    python tools/perf_gate.py --report fresh.json --baseline BENCH_r05.json
+        [--baseline BENCH_r04.json ...]     # trajectory: last match wins
+        [--op-report ops.json [--op-baseline tools/op_bench_baseline.json]]
+        [--floor-tol 0.10] [--op-threshold 1.5]
+    python tools/perf_gate.py --smoke       # self-test on committed
+        fixtures (no benchmark run) — wired into tier-1 via
+        tests/test_lint.py
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FLOOR_TOL = 0.10        # cross-run chip drift floor (bench.py docstring)
+OP_THRESHOLD = 1.5      # per-op regression ratio (check_op_bench.py)
+
+
+def load_report(path: str) -> dict:
+    """Load a bench JSON; unwrap the driver's capture envelope
+    (``{"n", "cmd", "rc", "tail", "parsed": {...}}``) when present."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if "parsed" in doc and isinstance(doc["parsed"], dict) \
+            and "value" in doc["parsed"]:
+        return doc["parsed"]
+    return doc
+
+
+def extract_legs(doc: dict) -> Dict[str, dict]:
+    """Flatten a bench report into ``{leg_name: leg_dict}``: the
+    top-level flagship plus everything under ``legs``.  Legs that
+    errored (``{"error": ...}``) or carry no ``value`` are dropped."""
+    legs = {}
+    if isinstance(doc.get("value"), (int, float)):
+        legs["flagship"] = doc
+    for name, leg in (doc.get("legs") or {}).items():
+        if isinstance(leg, dict) and isinstance(leg.get("value"),
+                                                (int, float)):
+            legs[name] = leg
+    return legs
+
+
+def _noise(leg: dict) -> float:
+    """Relative window spread from the leg's own recorded p10/p90
+    (0 when the leg publishes no stats — e.g. the serving leg)."""
+    st = leg.get("stats") or {}
+    med = st.get("median") or 0.0
+    p10, p90 = st.get("p10"), st.get("p90")
+    if not med or p10 is None or p90 is None:
+        return 0.0
+    return max(float(p90) - float(p10), 0.0) / float(med)
+
+
+def _median_of(leg: dict) -> float:
+    st = leg.get("stats") or {}
+    return float(st.get("median") or leg["value"])
+
+
+def compare_leg(name: str, new: dict, base: dict,
+                floor_tol: float) -> dict:
+    """One leg's verdict: ``status`` in ``ok | regression | skipped``
+    (+ the numbers behind it)."""
+    res = {"leg": name}
+    nk, bk = new.get("device_kind"), base.get("device_kind")
+    if nk is not None and bk is not None and nk != bk:
+        res.update(status="skipped",
+                   reason=f"device_kind {nk!r} != baseline {bk!r}")
+        return res
+    if base.get("anomaly"):
+        res.update(status="skipped",
+                   reason=f"baseline flagged anomalous: "
+                          f"{base['anomaly']}")
+        return res
+    new_med, base_med = _median_of(new), _median_of(base)
+    tol = max(floor_tol, _noise(base), _noise(new))
+    threshold = base_med * (1.0 - tol)
+    res.update(base_median=round(base_med, 2),
+               new_median=round(new_med, 2),
+               ratio=round(new_med / base_med, 4) if base_med else None,
+               tolerance=round(tol, 4),
+               threshold=round(threshold, 2))
+    if new.get("anomaly"):
+        # an anomalous fresh number can't prove health — but it also
+        # must not fail the gate on chip contention; surface it loudly
+        res.update(status="skipped",
+                   reason=f"fresh run flagged anomalous: "
+                          f"{new['anomaly']}")
+        return res
+    res["status"] = "regression" if new_med < threshold else "ok"
+    return res
+
+
+def compare_bench(new_doc: dict, base_docs: List[dict],
+                  floor_tol: float = FLOOR_TOL) -> dict:
+    """Gate a fresh bench report against the baseline trajectory.
+
+    For each leg in the fresh report, the baseline is the LAST given
+    document carrying that leg (pass baselines oldest→newest); earlier
+    medians are reported as ``trajectory`` context.  A leg present in
+    a baseline but missing from the fresh report is a regression (a
+    silently-vanished leg must not pass)."""
+    new_legs = extract_legs(new_doc)
+    results = []
+    seen = set()
+    base_legsets = [extract_legs(d) for d in base_docs]
+    for name, new_leg in new_legs.items():
+        base_leg, trajectory = None, []
+        for legs in base_legsets:
+            if name in legs:
+                base_leg = legs[name]
+                trajectory.append(_median_of(legs[name]))
+        if base_leg is None:
+            results.append({"leg": name, "status": "new",
+                            "new_median": round(_median_of(new_leg), 2)})
+            continue
+        seen.add(name)
+        res = compare_leg(name, new_leg, base_leg, floor_tol)
+        if len(trajectory) > 1:
+            res["trajectory"] = [round(t, 2) for t in trajectory]
+        results.append(res)
+    for legs in base_legsets:
+        for name in legs:
+            if name not in new_legs and name not in seen:
+                seen.add(name)
+                results.append({"leg": name, "status": "regression",
+                                "reason": "leg missing from fresh "
+                                          "report"})
+    ok = all(r["status"] != "regression" for r in results)
+    return {"ok": ok, "floor_tol": floor_tol, "legs": results}
+
+
+def compare_ops(new: dict, base: dict,
+                threshold: float = OP_THRESHOLD) -> dict:
+    """Per-op gate (same policy as tools/check_op_bench.py): fail on
+    ratio > threshold or a newly-failing op; skip entirely on a
+    device_kind mismatch."""
+    if new.get("device_kind") != base.get("device_kind"):
+        return {"ok": True, "skipped": True,
+                "reason": f"device_kind {new.get('device_kind')!r} != "
+                          f"baseline {base.get('device_kind')!r}"}
+    regressions, missing = [], []
+    for name, b_us in (base.get("ops") or {}).items():
+        r_us = (new.get("ops") or {}).get(name)
+        if r_us is None:
+            missing.append(name)
+            continue
+        ratio = r_us / b_us if b_us else 0.0
+        if ratio > threshold:
+            regressions.append({"op": name, "base_us": b_us,
+                                "new_us": r_us,
+                                "ratio": round(ratio, 3)})
+    return {"ok": not regressions and not missing,
+            "threshold": threshold, "regressions": regressions,
+            "missing": missing}
+
+
+# ---------------------------------------------------------------------------
+# smoke mode: prove the gate logic on committed fixtures (no bench run)
+# ---------------------------------------------------------------------------
+
+def _degrade(doc: dict, factor: float) -> dict:
+    """A synthetically slower copy of a bench report: every leg's value
+    and window stats scaled by ``factor``."""
+    out = json.loads(json.dumps(doc))
+    for leg in extract_legs(out).values():
+        leg["value"] = leg["value"] * factor
+        for k in ("median", "p10", "p90", "min", "max"):
+            if k in (leg.get("stats") or {}):
+                leg["stats"][k] = leg["stats"][k] * factor
+    return out
+
+
+def run_smoke() -> int:
+    """Assert the gate's pass/fail behavior against the checked-in
+    BENCH_r0*.json + op_bench_baseline.json fixtures.  Returns 0 when
+    every assertion holds (tier-1 wires this via tests/test_lint.py)."""
+    fixtures = sorted(glob.glob(os.path.join(REPO, "BENCH_r0*.json")))
+    if not fixtures:
+        print("smoke: no BENCH_r0*.json fixtures found")
+        return 1
+    docs = [load_report(p) for p in fixtures]
+    latest = docs[-1]
+    checks = []
+
+    def check(name, cond, detail=""):
+        checks.append((name, bool(cond), detail))
+
+    # unchanged tree: the latest capture gated against the full
+    # trajectory (itself last) must pass
+    r = compare_bench(latest, docs)
+    check("unchanged-tree passes", r["ok"],
+          json.dumps([x for x in r["legs"]
+                      if x["status"] == "regression"]))
+    # a 30% slowdown must fail (far past the 10% drift floor + spread)
+    r = compare_bench(_degrade(latest, 0.70), docs)
+    check("30%-degraded fails", not r["ok"])
+    # a 3% wiggle is inside the noise floor: must NOT flap
+    r = compare_bench(_degrade(latest, 0.97), docs)
+    check("3%-wiggle passes", r["ok"],
+          json.dumps([x for x in r["legs"]
+                      if x["status"] == "regression"]))
+    # a vanished leg must fail
+    pruned = json.loads(json.dumps(latest))
+    if pruned.get("legs"):
+        pruned["legs"].pop(sorted(pruned["legs"])[0], None)
+        r = compare_bench(pruned, docs)
+        check("missing-leg fails", not r["ok"])
+    # device-kind mismatch must skip, not fail
+    other = json.loads(json.dumps(latest))
+    for leg in extract_legs(other).values():
+        leg["device_kind"] = "TPU v9000"
+    r = compare_bench(other, docs)
+    check("device-mismatch skips", r["ok"] and any(
+        x["status"] == "skipped" for x in r["legs"]))
+
+    # op gate on its own committed baseline
+    op_base_path = os.path.join(REPO, "tools", "op_bench_baseline.json")
+    with open(op_base_path, encoding="utf-8") as f:
+        op_base = json.load(f)
+    check("op self-compare passes", compare_ops(op_base, op_base)["ok"])
+    op_bad = json.loads(json.dumps(op_base))
+    first = sorted(op_bad["ops"])[0]
+    op_bad["ops"][first] *= 2.0
+    check("op 2x-regression fails",
+          not compare_ops(op_bad, op_base)["ok"])
+    op_missing = json.loads(json.dumps(op_base))
+    op_missing["ops"].pop(first)
+    check("op newly-failing fails",
+          not compare_ops(op_missing, op_base)["ok"])
+    op_other = json.loads(json.dumps(op_base))
+    op_other["device_kind"] = "TPU v9000"
+    check("op device-mismatch skips",
+          compare_ops(op_other, op_base).get("skipped") is True)
+
+    failed = [c for c in checks if not c[1]]
+    for name, okay, detail in checks:
+        print(f"  [{'ok' if okay else 'FAIL'}] {name}"
+              + (f" -- {detail}" if detail and not okay else ""))
+    print(f"smoke: {len(checks) - len(failed)}/{len(checks)} gate-logic "
+          f"checks passed")
+    return 1 if failed else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--report", help="fresh bench.py JSON report")
+    ap.add_argument("--baseline", action="append", default=[],
+                    help="baseline BENCH_r*.json (repeatable, "
+                         "oldest->newest; last match per leg wins)")
+    ap.add_argument("--op-report", help="fresh tools/op_bench.py JSON")
+    ap.add_argument("--op-baseline",
+                    default=os.path.join(REPO, "tools",
+                                         "op_bench_baseline.json"))
+    ap.add_argument("--floor-tol", type=float, default=FLOOR_TOL,
+                    help="minimum relative tolerance (cross-run chip "
+                         "drift floor; default 0.10)")
+    ap.add_argument("--op-threshold", type=float, default=OP_THRESHOLD)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full verdict as JSON on stdout")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-test the gate logic on committed "
+                         "fixtures and exit (no benchmark run)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return run_smoke()
+    if not args.report and not args.op_report:
+        ap.error("need --report and/or --op-report (or --smoke)")
+
+    verdict = {"ok": True}
+    if args.report:
+        if not args.baseline:
+            ap.error("--report needs at least one --baseline")
+        bench = compare_bench(load_report(args.report),
+                              [load_report(p) for p in args.baseline],
+                              args.floor_tol)
+        verdict["bench"] = bench
+        verdict["ok"] &= bench["ok"]
+    if args.op_report:
+        with open(args.op_report, encoding="utf-8") as f:
+            new_ops = json.load(f)
+        with open(args.op_baseline, encoding="utf-8") as f:
+            base_ops = json.load(f)
+        ops = compare_ops(new_ops, base_ops, args.op_threshold)
+        verdict["ops"] = ops
+        verdict["ok"] &= ops["ok"]
+
+    if args.json:
+        print(json.dumps(verdict, indent=1, sort_keys=True))
+    else:
+        for leg in (verdict.get("bench") or {}).get("legs", []):
+            line = f"  {leg['leg']:12s} {leg['status']:10s}"
+            if "new_median" in leg and "base_median" in leg:
+                line += (f" new {leg['new_median']:>10} vs base "
+                         f"{leg['base_median']:>10} "
+                         f"(tol {leg.get('tolerance')})")
+            if "reason" in leg:
+                line += f" -- {leg['reason']}"
+            print(line)
+        ops = verdict.get("ops")
+        if ops:
+            if ops.get("skipped"):
+                print(f"  ops: SKIP -- {ops['reason']}")
+            else:
+                for r in ops.get("regressions", []):
+                    print(f"  op {r['op']}: {r['ratio']}x "
+                          f"({r['base_us']} -> {r['new_us']} us) "
+                          f"<< REGRESSION")
+                if ops.get("missing"):
+                    print(f"  ops newly failing: {ops['missing']}")
+        print("GATE " + ("PASSED" if verdict["ok"] else "FAILED"))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,13 @@ instead of in-process engine stats) — and emits one JSON report:
   the engine does, so overload actually overloads).
 * ``--mode both`` runs closed then open and nests the two reports.
 
+**SLO assertions** (ROADMAP item 5 — capacity regressions fail
+loudly): ``--slo-p99-ms X`` and/or ``--slo-shed-pct Y`` make the run
+load-bearing — the report gains an ``"slo"`` block listing every
+violation (p99 latency above X ms, shed rate above Y percent, or zero
+completed requests) and the process **exits 1** when any sub-report
+violates.  In ``--mode both`` each sub-report is checked.
+
 Model: ``--model-dir`` (a ``save_inference_model`` export; give per-row
 feed shapes as ``--shape name=d0,d1``) or ``--synthetic`` (an in-process
 MLP — no files needed; ``--hidden/--depth/--feat`` size it).
@@ -370,6 +377,45 @@ def run_open_loop_http(base_url: str, make_feed, qps: float,
 
 
 # ---------------------------------------------------------------------------
+# SLO assertions
+# ---------------------------------------------------------------------------
+
+def check_slo(report: dict, p99_ms: Optional[float] = None,
+              shed_pct: Optional[float] = None) -> dict:
+    """Evaluate the SLO against one report (recursing into the nested
+    closed/open halves of ``--mode both``).  Returns
+    ``{"p99_ms_limit", "shed_pct_limit", "violations": [...], "ok"}``;
+    a sub-report with zero completed requests is itself a violation
+    (a fully-shed run must not pass on a vacuous p99)."""
+    violations = []
+
+    def _one(rep: dict, label: str):
+        lat = rep.get("latency_ms") or {}
+        if p99_ms is not None:
+            p99 = lat.get("p99")
+            if p99 is None:
+                violations.append(f"{label}: no completed requests — "
+                                  f"p99 unmeasurable")
+            elif p99 > p99_ms:
+                violations.append(f"{label}: p99 {p99}ms > SLO "
+                                  f"{p99_ms}ms")
+        if shed_pct is not None:
+            rate = rep.get("shed_rate")
+            if rate is not None and rate * 100.0 > shed_pct:
+                violations.append(
+                    f"{label}: shed rate {rate * 100.0:.2f}% > SLO "
+                    f"{shed_pct}%")
+
+    if report.get("mode") == "both":
+        _one(report["closed"], "closed")
+        _one(report["open"], "open")
+    else:
+        _one(report, report.get("mode", "report"))
+    return {"p99_ms_limit": p99_ms, "shed_pct_limit": shed_pct,
+            "violations": violations, "ok": not violations}
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 
@@ -410,7 +456,29 @@ def main(argv=None) -> int:
     ap.add_argument("--queue-cap", type=int, default=None)
     ap.add_argument("--deadline-ms", type=float, default=None)
     ap.add_argument("--out", help="also write the JSON report here")
+    ap.add_argument("--slo-p99-ms", type=float, default=None,
+                    help="assert p99 latency <= this (ms); violation "
+                         "exits 1 with an 'slo' block in the report")
+    ap.add_argument("--slo-shed-pct", type=float, default=None,
+                    help="assert shed rate <= this (percent); "
+                         "violation exits 1")
     args = ap.parse_args(argv)
+
+    def finish(report: dict) -> int:
+        rc = 0
+        if args.slo_p99_ms is not None or args.slo_shed_pct is not None:
+            slo = check_slo(report, args.slo_p99_ms, args.slo_shed_pct)
+            report["slo"] = slo
+            if not slo["ok"]:
+                for v in slo["violations"]:
+                    print(f"SLO VIOLATION: {v}", file=sys.stderr)
+                rc = 1
+        text = json.dumps(report)
+        print(text)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text + "\n")
+        return rc
 
     if args.url:
         # remote target: no model, no engine — just paced HTTP traffic
@@ -431,12 +499,7 @@ def main(argv=None) -> int:
         else:
             report = run_open_loop_http(args.url, make_feed, args.qps,
                                         args.duration)
-        text = json.dumps(report)
-        print(text)
-        if args.out:
-            with open(args.out, "w") as f:
-                f.write(text + "\n")
-        return 0
+        return finish(report)
 
     from paddle_tpu.serving import ServingEngine
 
@@ -474,12 +537,7 @@ def main(argv=None) -> int:
     finally:
         engine.close()
 
-    text = json.dumps(report)
-    print(text)
-    if args.out:
-        with open(args.out, "w") as f:
-            f.write(text + "\n")
-    return 0
+    return finish(report)
 
 
 if __name__ == "__main__":
