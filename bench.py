@@ -816,6 +816,148 @@ def run_serving():
     }
 
 
+# ---------------------------------------------------------------------------
+# Decode leg: KV-cached continuous batching tokens/sec vs static batch drain
+# ---------------------------------------------------------------------------
+
+def run_decode():
+    """Autoregressive decode leg (`legs.llama_decode`) — the tracked
+    Llama BASELINE config's first captured number (VERDICT.md gap).
+
+    A KV-cached :class:`~paddle_tpu.serving.GenerationEngine` under the
+    closed-loop generation loadgen (tools/serving_loadgen.py): requests
+    draw long-tail output lengths (chat-style 75/25 short/long
+    bimodal mix by default), the slot grid decodes
+    every sequence at O(1)/token against donated per-slot caches, and
+    finished sequences free their slot immediately.  The SAME engine
+    with ``continuous=False`` (FIFO head-run: claim only into a fully
+    drained grid) is the measured baseline — the speedup is the
+    continuous-batching win at equal-or-better p99 (both p99s
+    published; the headline ``value`` is continuous tokens/sec/chip).
+
+    Efficiency: decode-step MFU = the decode executable's XLA manifest
+    FLOPs x the measured grid step rate over the chip peak
+    (costmodel), plus cache HBM bytes and the manifest's peak HBM.
+    Sized by BENCH_DECODE_{VOCAB,HIDDEN,LAYERS,HEADS,KV_HEADS,INTER,
+    SLOTS,MAX_SEQ,REQUESTS,OUT_MEAN,OUT_MAX,OUT_DIST} — CPU smoke
+    defaults; a chip run sizes it to the Llama-2-7B proxy."""
+    from paddle_tpu.serving import GenerationEngine
+
+    lg = _load_serving_loadgen()
+    env = os.environ.get
+    vocab = int(env("BENCH_DECODE_VOCAB", "256"))
+    hidden = int(env("BENCH_DECODE_HIDDEN", "64"))
+    layers_n = int(env("BENCH_DECODE_LAYERS", "2"))
+    heads = int(env("BENCH_DECODE_HEADS", "4"))
+    kv_heads = int(env("BENCH_DECODE_KV_HEADS", str(heads)))
+    inter = int(env("BENCH_DECODE_INTER", str(2 * hidden)))
+    slots = int(env("BENCH_DECODE_SLOTS", "8"))
+    max_seq = int(env("BENCH_DECODE_MAX_SEQ", "160"))
+    n_req = int(env("BENCH_DECODE_REQUESTS", "48"))
+    # decode-dominated defaults: chat-style bimodal outputs (75% short
+    # / 25% long at mean 32 — the grid's longest sequence runs ~3.3x
+    # the mean, the static batch-drain penalty; pure geometric caps at
+    # ~2.7x and noise on a shared host eats the margin) over short
+    # prompts, so tokens/sec measures the scheduler, not prefill
+    # dispatch overhead
+    out_mean = float(env("BENCH_DECODE_OUT_MEAN", "32"))
+    out_max = int(env("BENCH_DECODE_OUT_MAX", "128"))
+    out_dist = env("BENCH_DECODE_OUT_DIST", "bimodal")
+    # clamp to what the engine can admit (largest default prefill
+    # bucket = max_seq with one decode position reserved): an over-long
+    # prompt is a submit-time ValueError, which the loadgen counts as
+    # failed — an undercounted tokens/sec, not an error
+    prompt_max = min(int(env("BENCH_DECODE_PROMPT_MAX", "8")),
+                     max_seq - 1)
+    model = dict(vocab_size=vocab, hidden=hidden, num_layers=layers_n,
+                 num_heads=heads, num_kv_heads=kv_heads,
+                 intermediate=inter)
+    make_prompt = lg.prompt_maker(vocab, 4, prompt_max, out_mean,
+                                  out_max, dist=out_dist)
+
+    rounds = int(env("BENCH_DECODE_ROUNDS", "3"))
+
+    def one_mode(continuous, n_rounds):
+        """One engine, ``n_rounds`` measurement passes (first pass
+        includes no compile — warmup() runs first).  Per-round
+        tokens/sec feed the stats block the perf gate's noise model
+        reads (serving throughput on a shared host wobbles well past
+        the 10% drift floor)."""
+        eng = GenerationEngine(model, num_slots=slots,
+                               max_seq_len=max_seq,
+                               max_new_tokens=out_max,
+                               continuous=continuous,
+                               queue_cap=4 * n_req,
+                               deadline_ms=600000.0)
+        eng.warmup()
+        try:
+            reps = [lg.run_closed_loop_generate(eng, make_prompt, n_req,
+                                                concurrency=4 * slots)
+                    for _ in range(n_rounds)]
+            extras = {"decode_mfu": eng.decode_mfu(),
+                      "manifest": eng.decode_manifest(),
+                      "kv_cache_bytes": eng.kv_cache_bytes,
+                      "slot_reclaims":
+                          eng.stats()["counters"]["slot_reclaims"]}
+        finally:
+            eng.close()
+        return reps, extras
+
+    import jax
+
+    device = jax.devices()[0]
+    # both modes run the SAME number of rounds and compare medians:
+    # serving throughput on a shared host wobbles enough that a
+    # single-round static baseline dominates the speedup's noise
+    static_reps, _static_extras = one_mode(False, rounds)
+    cont_reps, extras = one_mode(True, rounds)
+    rates = [r["tokens_per_sec"] for r in cont_reps]
+    static_rates = [r["tokens_per_sec"] for r in static_reps]
+    tps = float(np.median(rates))
+    tps_static = float(np.median(static_rates))
+    static_rep = static_reps[
+        static_rates.index(sorted(static_rates)[len(static_rates) // 2])]
+    cont_rep = cont_reps[rates.index(sorted(rates)[len(rates) // 2])]
+    manifest = extras["manifest"] or {}
+    return {
+        "metric": "llama_decode_tokens_per_sec_per_chip",
+        "value": round(tps, 2),
+        "unit": "tokens/sec/chip",
+        "device_kind": getattr(device, "device_kind", str(device)),
+        "stats": {
+            "rounds": rounds,
+            "median": round(tps, 2),
+            "p10": round(float(np.percentile(rates, 10)), 2),
+            "p90": round(float(np.percentile(rates, 90)), 2),
+            "min": round(min(rates), 2),
+            "max": round(max(rates), 2),
+        },
+        "p99_ms": cont_rep["latency_ms"].get("p99"),
+        "static_tokens_per_sec": round(tps_static, 2),
+        "static_stats": {
+            "rounds": rounds,
+            "median": round(tps_static, 2),
+            "p10": round(float(np.percentile(static_rates, 10)), 2),
+            "p90": round(float(np.percentile(static_rates, 90)), 2),
+        },
+        "static_p99_ms": static_rep["latency_ms"].get("p99"),
+        "speedup_vs_static": round(tps / max(tps_static, 1e-9), 3),
+        "decode_mfu": extras["decode_mfu"],
+        "hbm_peak_bytes": manifest.get("peak_hbm_bytes"),
+        "xla_flops_per_step": manifest.get("flops"),
+        "kv_cache_bytes": extras["kv_cache_bytes"],
+        "slot_reclaims": extras["slot_reclaims"],
+        "closed": cont_rep,
+        "static": static_rep,
+        "config": {"vocab": vocab, "hidden": hidden, "layers": layers_n,
+                   "heads": heads, "kv_heads": kv_heads, "inter": inter,
+                   "slots": slots, "max_seq": max_seq,
+                   "requests": n_req, "out_mean": out_mean,
+                   "out_max": out_max, "out_dist": out_dist,
+                   "prompt_max": prompt_max, "rounds": rounds},
+    }
+
+
 def main():
     import jax
 
@@ -868,6 +1010,14 @@ def main():
             except Exception as e:
                 out["legs"]["serving"] = {"error": f"{type(e).__name__}: "
                                                    f"{e}"}
+        # decode leg: KV-cached continuous batching tokens/sec/chip —
+        # the tracked Llama BASELINE config (BENCH_DECODE=0 skips)
+        if os.environ.get("BENCH_DECODE", "1") == "1":
+            try:
+                out["legs"]["llama_decode"] = run_decode()
+            except Exception as e:
+                out["legs"]["llama_decode"] = {
+                    "error": f"{type(e).__name__}: {e}"}
 
     print(json.dumps(out))
 

@@ -170,14 +170,35 @@ def save(layer, path, input_spec=None):
         sf = _StaticFunction(lambda *a: layer(*a))
     if input_spec is not None:
         arrs = []
+        poly_dims = []
         for spec in input_spec:
             if isinstance(spec, InputSpec):
                 shape = [1 if (d is None or d < 0) else int(d)
                          for d in spec.shape]
                 arrs.append(np.zeros(shape, spec.dtype))
+                poly_dims.append([i for i, d in enumerate(spec.shape)
+                                  if d is None or int(d) < 0])
             else:
                 arrs.append(_to_numpy(spec))
+                poly_dims.append([])
         sf(*arrs)  # ensure a trace exists for this signature
+        # Restore polymorphic dims on the traced feed vars: the trace
+        # itself must run at a concrete sample size (XLA compiles
+        # static shapes), but the EXPORTED contract keeps -1 where the
+        # spec said None/-1 — the Executor specializes -1 dims from the
+        # feed at compile time, so the loaded program serves any batch
+        # instead of being frozen to the sample size.
+        main, feed_names = sf._latest_entry()[:2]
+        block = main.global_block()
+        for name, dims in zip(feed_names, poly_dims):
+            if not dims or not block.has_var(name):
+                continue
+            v = block.var(name)
+            shape = list(v.shape)
+            for d in dims:
+                if d < len(shape):
+                    shape[d] = -1
+            v.shape = tuple(shape)
     sf.save_inference_model(path)
 
 

@@ -142,6 +142,26 @@ register_flag("FLAGS_serving_deadline_ms", 1000.0,
 register_flag("FLAGS_serving_workers", 2,
               "serving engine: predictor-pool size (clone()d predictors "
               "sharing device weights, one dispatch thread each)")
+register_flag("FLAGS_serving_decode_slots", 8,
+              "generation engine: decode-slot grid size — the whole "
+              "grid runs every decode iteration, finished sequences "
+              "free their slot to the next queued request immediately "
+              "(paddle_tpu/serving/generation.py)")
+register_flag("FLAGS_serving_max_seq_len", 256,
+              "generation engine: per-slot KV-cache sequence capacity "
+              "(prompt + generated tokens); the cache HBM footprint is "
+              "slots * layers * 2 * n_kv_heads * max_seq_len * head_dim "
+              "* 4 bytes")
+register_flag("FLAGS_serving_prefill_buckets", "",
+              "comma-separated prefill sequence-length buckets "
+              "(prompts pad up to the smallest fitting bucket, one "
+              "compiled executable per bucket); empty = powers of two "
+              "from 8 up to FLAGS_serving_max_seq_len")
+register_flag("FLAGS_serving_max_new_tokens", 64,
+              "generation engine: default per-request cap on generated "
+              "tokens (a request's own max_new_tokens wins; a budget "
+              "beyond the cache capacity left after the prompt decodes "
+              "until the slot cache fills and finishes 'cache_full')")
 register_flag("FLAGS_trace_sample", 1.0,
               "head-sampling rate for serving request traces: fraction "
               "of requests (0..1, deterministic every-Nth spacing) that "

@@ -21,7 +21,9 @@ __all__ = [
     "one_hot", "topk", "flatten", "l2_normalize", "label_smooth", "maxout",
     "soft_relu", "log_loss", "clip", "clip_by_norm", "mean", "pad",
     "adaptive_pool2d", "flash_attention", "flash_attention_qkv",
-    "rms_norm", "rope", "linear_chain_crf", "crf_decoding", "warpctc",
+    "rms_norm", "rope", "kv_cache_write", "kv_cache_insert",
+    "cached_attention",
+    "linear_chain_crf", "crf_decoding", "warpctc",
     "nce", "hsigmoid", "conv3d", "pool3d", "lrn", "row_conv",
     "shuffle_channel", "temporal_shift", "multiplex",
     "silu", "mish",
@@ -600,13 +602,69 @@ def rms_norm(x, epsilon=1e-6, param_attr=None, name=None):
     return out
 
 
-def rope(x, base=10000.0, position_offset=0, name=None):
-    """Rotary position embedding; x: [B, H, S, D]."""
+def rope(x, base=10000.0, position_offset=0, offset=None, name=None):
+    """Rotary position embedding; x: [B, H, S, D].
+
+    ``offset``: optional [B] int Variable of per-row dynamic position
+    offsets (cached decode: row b's S positions start at ``offset[b]``);
+    the static ``position_offset`` attr applies when it is absent."""
     helper = LayerHelper("rope", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)
-    helper.append_op("rope", inputs={"X": [x]}, outputs={"Out": [out]},
+    inputs = {"X": [x]}
+    if offset is not None:
+        inputs["Offset"] = [offset]
+    helper.append_op("rope", inputs=inputs, outputs={"Out": [out]},
                      attrs={"base": base,
                             "position_offset": position_offset})
+    return out
+
+
+def kv_cache_write(cache, new, positions, name=None):
+    """Write the step's fresh K/V rows into a persistent decode cache
+    **in place**: ``cache`` [B, Hkv, S_max, D] gets ``new`` [B, Hkv, T,
+    D] at per-row seq offset ``positions`` [B].  The op's output is the
+    cache variable itself, so the executor classifies the cache as
+    mutated persistable state → donated buffer (HBM reused, no copy).
+    Returns the cache Variable (now carrying the updated value in the
+    lowered graph)."""
+    helper = LayerHelper("kv_cache_write", name=name)
+    helper.append_op("kv_cache_write",
+                     inputs={"Cache": [cache], "New": [new],
+                             "Positions": [positions]},
+                     outputs={"Out": [cache]})
+    return cache
+
+
+def kv_cache_insert(cache, new, slot, name=None):
+    """Prefill-time cache population, in place: ``cache`` [slots, Hkv,
+    S_max, D] gets ``new`` [1, Hkv, S_b, D] at slot index ``slot``
+    ([1] int32 Variable), seq offset 0.  Like :func:`kv_cache_write`,
+    the output aliases the cache variable so the executor donates the
+    buffer.  Returns the cache Variable."""
+    helper = LayerHelper("kv_cache_insert", name=name)
+    helper.append_op("kv_cache_insert",
+                     inputs={"Cache": [cache], "New": [new],
+                             "Slot": [slot]},
+                     outputs={"Out": [cache]})
+    return cache
+
+
+def cached_attention(q, cache_k, cache_v, positions, scale=None,
+                     name=None):
+    """Decode-step attention over a KV cache: ``q`` [B, H, T, D]
+    attends ``cache_k``/``cache_v`` [B, Hkv, S_max, D] with per-row
+    validity ``j <= positions[b] + t`` (``positions`` [B] = pre-step
+    sequence length).  GQA caches expand repeat-interleave style inside
+    the op.  Returns [B, H, T, D]."""
+    helper = LayerHelper("cached_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    attrs = {}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op("cached_attention",
+                     inputs={"Q": [q], "K": [cache_k], "V": [cache_v],
+                             "Positions": [positions]},
+                     outputs={"Out": [out]}, attrs=attrs)
     return out
 
 

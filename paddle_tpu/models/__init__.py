@@ -13,5 +13,7 @@ in-tree book tests python/paddle/fluid/tests/book/).
 from .lenet import lenet, build_mnist_train  # noqa
 from .resnet import resnet, build_resnet_train  # noqa
 from .bert import bert_encoder, build_bert_pretrain  # noqa
-from .llama import llama, llama_block, build_llama_train  # noqa
+from .llama import (llama, llama_block, build_llama_train,  # noqa
+                    build_llama_forward, build_llama_prefill,
+                    build_llama_decode)
 from .seq2seq import build_seq2seq_train, build_seq2seq_infer  # noqa

@@ -10,24 +10,57 @@ TPU-first notes:
   * all projections are single large matmuls (fused QKV, fused gate+up)
     to keep the MXU busy;
   * weights stay fp32 in the scope; AMP lowers matmuls to bf16.
+
+Decode fast path (the generation serving workload): ``llama_block``
+also runs in two KV-cache modes —
+
+  * ``collect_kv=True`` (prefill): the post-RoPE, pre-GQA-expansion
+    K/V of the whole prompt come back as extra outputs, so one forward
+    populates a decode cache in one shot;
+  * ``kv_cache=(cache_k, cache_v)`` + ``positions`` (cached decode):
+    the block consumes persistent per-slot cache Variables, writes the
+    step's fresh K/V at per-row dynamic offsets (``kv_cache_write`` —
+    the op's output aliases the cache var, so the executor donates the
+    buffer and XLA updates it in place in HBM) and attends the single
+    new token over the cache (``cached_attention``) — O(1) work per
+    token instead of O(n²) over the prefix.
+
+With an explicit ``name`` prefix every parameter gets a deterministic
+name, so the train/full-forward, prefill, and decode programs built in
+one process bind the *same* scope weights (``tests/test_generation.py``
+asserts cached decode logits are bit-exact against the uncached full
+forward).
 """
 from __future__ import annotations
 
 from .. import layers
 
 
-def _linear(x, size, name=None):
+def _linear(x, size, pname=None, name=None):
     return layers.fc(x, size, num_flatten_dims=2, bias_attr=False,
-                     name=name)
+                     param_attr=pname, name=name)
 
 
 def llama_block(x, hidden, num_heads, num_kv_heads, seq_len, head_dim,
-                intermediate):
-    """One decoder layer. x: [B, S, H]."""
+                intermediate, name=None, attn_impl="auto",
+                kv_cache=None, positions=None, collect_kv=False):
+    """One decoder layer. x: [B, S, H].
+
+    ``name`` prefixes every parameter deterministically (required when
+    several programs must share one scope).  ``attn_impl`` feeds the
+    flash_attention op's impl switch ("auto" | "xla" | pallas bools).
+
+    Cache modes (mutually exclusive):
+      * ``kv_cache=(cache_k, cache_v)`` with ``positions`` [B] int32 —
+        cached decode: returns x with the caches updated in place.
+      * ``collect_kv=True`` — prefill: returns ``(x, k, v)`` where
+        k/v are the post-RoPE [B, n_kv, S, D] cache rows.
+    """
     q_size = num_heads * head_dim
     kv_size = num_kv_heads * head_dim
-    h = layers.rms_norm(x)
-    qkv = _linear(h, q_size + 2 * kv_size)
+    p = (lambda s: f"{name}.{s}") if name else (lambda s: None)
+    h = layers.rms_norm(x, param_attr=p("ln1"))
+    qkv = _linear(h, q_size + 2 * kv_size, pname=p("qkv.w"))
     q = layers.slice(qkv, axes=[2], starts=[0], ends=[q_size])
     k = layers.slice(qkv, axes=[2], starts=[q_size],
                      ends=[q_size + kv_size])
@@ -40,46 +73,72 @@ def llama_block(x, hidden, num_heads, num_kv_heads, seq_len, head_dim,
 
     q, k, v = heads(q, num_heads), heads(k, num_kv_heads), \
         heads(v, num_kv_heads)
-    q = layers.rope(q)
-    k = layers.rope(k)
-    if num_kv_heads != num_heads:
-        # repeat_interleave-style expansion [k1,k1,..,k2,k2,..]: query-head
-        # group g maps to kv head g//rep, matching canonical Llama GQA
-        # (block-order tile would pair queries with the wrong kv heads).
-        rep = num_heads // num_kv_heads
+    offset = positions if kv_cache is not None else None
+    q = layers.rope(q, offset=offset)
+    k = layers.rope(k, offset=offset)
 
-        def expand_kv(t):
-            t = layers.reshape(t, [0, num_kv_heads, 1, seq_len, head_dim])
-            t = layers.tile(t, [1, 1, rep, 1, 1])
-            return layers.reshape(t, [0, num_heads, seq_len, head_dim])
+    if kv_cache is not None:
+        # cached decode: write this step's K/V at each slot's position,
+        # then attend the new token(s) over the whole (updated) cache —
+        # GQA expansion happens inside cached_attention
+        cache_k, cache_v = kv_cache
+        cache_k = layers.kv_cache_write(cache_k, k, positions)
+        cache_v = layers.kv_cache_write(cache_v, v, positions)
+        attn = layers.cached_attention(q, cache_k, cache_v, positions)
+    else:
+        cache_k = cache_v = None
+        new_k, new_v = k, v  # pre-expansion rows are what a cache stores
+        if num_kv_heads != num_heads:
+            # repeat_interleave-style expansion [k1,k1,..,k2,k2,..]:
+            # query-head group g maps to kv head g//rep, matching
+            # canonical Llama GQA (block-order tile would pair queries
+            # with the wrong kv heads).
+            rep = num_heads // num_kv_heads
 
-        k, v = expand_kv(k), expand_kv(v)
-    attn = layers.flash_attention(q, k, v, causal=True)
+            def expand_kv(t):
+                t = layers.reshape(t, [0, num_kv_heads, 1, seq_len,
+                                       head_dim])
+                t = layers.tile(t, [1, 1, rep, 1, 1])
+                return layers.reshape(t, [0, num_heads, seq_len,
+                                          head_dim])
+
+            k, v = expand_kv(k), expand_kv(v)
+        attn = layers.flash_attention(q, k, v, causal=True,
+                                      impl=attn_impl)
     attn = layers.transpose(attn, [0, 2, 1, 3])
     attn = layers.reshape(attn, [0, seq_len, q_size])
-    x = layers.elementwise_add(x, _linear(attn, hidden))
+    x = layers.elementwise_add(x, _linear(attn, hidden,
+                                          pname=p("attn_out.w")))
 
-    h = layers.rms_norm(x)
-    gate_up = _linear(h, 2 * intermediate)
+    h = layers.rms_norm(x, param_attr=p("ln2"))
+    gate_up = _linear(h, 2 * intermediate, pname=p("gate_up.w"))
     gate = layers.slice(gate_up, axes=[2], starts=[0], ends=[intermediate])
     up = layers.slice(gate_up, axes=[2], starts=[intermediate],
                       ends=[2 * intermediate])
     ffn = layers.elementwise_mul(layers.silu(gate), up)
-    return layers.elementwise_add(x, _linear(ffn, hidden))
+    out = layers.elementwise_add(x, _linear(ffn, hidden,
+                                            pname=p("ffn_out.w")))
+    if collect_kv:
+        return out, new_k, new_v
+    return out
 
 
 def llama(input_ids, vocab_size=32000, hidden=4096, num_layers=32,
           num_heads=32, num_kv_heads=None, intermediate=11008,
-          seq_len=2048):
+          seq_len=2048, name=None, attn_impl="auto"):
     """Returns logits [B, S, V]. input_ids: [B, S] int64."""
     num_kv_heads = num_kv_heads or num_heads
     head_dim = hidden // num_heads
-    x = layers.embedding(input_ids, size=[vocab_size, hidden])
-    for _ in range(num_layers):
+    p = (lambda s: f"{name}.{s}") if name else (lambda s: None)
+    x = layers.embedding(input_ids, size=[vocab_size, hidden],
+                         param_attr=p("embed"))
+    for i in range(num_layers):
         x = llama_block(x, hidden, num_heads, num_kv_heads, seq_len,
-                        head_dim, intermediate)
-    x = layers.rms_norm(x)
-    return _linear(x, vocab_size)
+                        head_dim, intermediate,
+                        name=f"{name}.blk{i}" if name else None,
+                        attn_impl=attn_impl)
+    x = layers.rms_norm(x, param_attr=p("ln_f"))
+    return _linear(x, vocab_size, pname=p("head.w"))
 
 
 def build_llama_train(batch_size=None, seq_len=2048, vocab_size=32000,
@@ -97,3 +156,159 @@ def build_llama_train(batch_size=None, seq_len=2048, vocab_size=32000,
         logits, layers.unsqueeze(labels, [2]))
     mean_loss = layers.mean(layers.squeeze(loss, [2]))
     return ["input_ids", "labels"], {"loss": mean_loss, "logits": logits}
+
+
+# ---------------------------------------------------------------------------
+# Generation fast path: full-forward reference / prefill / cached decode
+# ---------------------------------------------------------------------------
+
+def build_llama_forward(batch_size, seq_len, vocab_size=32000,
+                        hidden=4096, num_layers=32, num_heads=32,
+                        num_kv_heads=None, intermediate=11008,
+                        name="llama", attn_impl="auto"):
+    """Uncached full forward: feeds input_ids [B, S], fetches logits
+    [B, S, V] (causal — row i depends only on tokens ≤ i, so one run
+    yields every decode step's reference logits)."""
+    input_ids = layers.data("input_ids", [batch_size, seq_len],
+                            dtype="int64", append_batch_size=False)
+    logits = llama(input_ids, vocab_size, hidden, num_layers, num_heads,
+                   num_kv_heads, intermediate, seq_len, name=name,
+                   attn_impl=attn_impl)
+    return ["input_ids"], {"logits": logits}
+
+
+def build_llama_prefill(batch_size, seq_len, vocab_size=32000,
+                        hidden=4096, num_layers=32, num_heads=32,
+                        num_kv_heads=None, intermediate=11008,
+                        name="llama", attn_impl="auto",
+                        cache_slots=None, max_seq_len=None):
+    """Prefill entry point: one causal forward over the (padded) prompt
+    that populates a decode cache in one shot.
+
+    Feeds: ``input_ids`` [B, S] int64 (right-padded to the bucket) and
+    ``last_pos`` [B] int64 (index of the last real token).  Fetches:
+    ``logits`` [B, V] (next-token logits at last_pos) and
+    ``next_token`` [B] int64 (greedy).
+
+    Cache handling, two modes:
+
+    * ``cache_slots``/``max_seq_len`` given (the serving engine's
+      path; requires ``batch_size == 1``): the per-layer post-RoPE K/V
+      are written **in-graph** into the shared decode cache Variables
+      ``<name>.cache_{k,v}_<i>`` at slot index feed ``slot`` [1] int32
+      — the caches are mutated persistable state, so the prefill step
+      donates them exactly like the decode step (no K/V fetch, no
+      host-side reinsert).
+    * omitted: per-layer ``k_i``/``v_i`` [B, n_kv, S, D] rows come
+      back as extra fetches for the caller to place.
+
+    Because attention is causal, pad-tail rows never influence rows
+    before the true length — the engine masks them out of the cache
+    via per-slot positions."""
+    from ..framework.core import default_main_program
+
+    num_kv_heads = num_kv_heads or num_heads
+    head_dim = hidden // num_heads
+    input_ids = layers.data("input_ids", [batch_size, seq_len],
+                            dtype="int64", append_batch_size=False)
+    last_pos = layers.data("last_pos", [batch_size], dtype="int64",
+                           append_batch_size=False)
+    feeds = ["input_ids", "last_pos"]
+    slot = None
+    if cache_slots is not None:
+        if batch_size != 1:
+            raise ValueError("in-graph cache insert prefills one "
+                             "request at a time (batch_size must be 1)")
+        if max_seq_len is None or seq_len > max_seq_len:
+            raise ValueError(f"prefill bucket {seq_len} exceeds cache "
+                             f"max_seq_len {max_seq_len}")
+        slot = layers.data("slot", [1], dtype="int32",
+                           append_batch_size=False)
+        feeds.append("slot")
+    x = layers.embedding(input_ids, size=[vocab_size, hidden],
+                         param_attr=f"{name}.embed")
+    kvs = []
+    block = default_main_program().global_block()
+    for i in range(num_layers):
+        x, k, v = llama_block(x, hidden, num_heads, num_kv_heads,
+                              seq_len, head_dim, intermediate,
+                              name=f"{name}.blk{i}", attn_impl=attn_impl,
+                              collect_kv=True)
+        if slot is not None:
+            for kind, t in (("k", k), ("v", v)):
+                cache = block.create_var(
+                    name=f"{name}.cache_{kind}_{i}", persistable=True,
+                    shape=[cache_slots, num_kv_heads, max_seq_len,
+                           head_dim],
+                    dtype="float32", stop_gradient=True)
+                layers.kv_cache_insert(cache, t, slot)
+        else:
+            kvs.append((k, v))
+    x = layers.rms_norm(x, param_attr=f"{name}.ln_f")
+    # LM head over ALL rows, then gather each row's last real position.
+    # Gathering the hidden state first and projecting only that row
+    # would save (S-1)·V head FLOPs, but XLA fuses the gather into the
+    # projection and the fused contraction's accumulation order drifts
+    # ~5e-8 from the full-forward GEMM — breaking the bit-exactness
+    # contract (cached decode ≡ uncached forward, tolerance 0).
+    all_logits = _linear(x, vocab_size, pname=f"{name}.head.w")
+    rows = layers.range(0, batch_size, 1, dtype="int64")     # [B]
+    coords = layers.stack([rows, last_pos], axis=1)          # [B, 2]
+    logits = layers.gather_nd(all_logits, coords)            # [B, V]
+    next_token = layers.argmax(logits, axis=-1)              # [B] int64
+    fetches = {"logits": logits, "next_token": next_token}
+    for i, (k, v) in enumerate(kvs):
+        fetches[f"k_{i}"] = k
+        fetches[f"v_{i}"] = v
+    return feeds, fetches
+
+
+def build_llama_decode(num_slots, max_seq_len, vocab_size=32000,
+                       hidden=4096, num_layers=32, num_heads=32,
+                       num_kv_heads=None, intermediate=11008,
+                       name="llama"):
+    """Cached decode step over a fixed slot grid.
+
+    Feeds: ``tokens`` [slots, 1] int64 (each slot's current token) and
+    ``positions`` [slots] int32 (each slot's pre-step sequence length =
+    the cache offset this step writes at).  Per-layer cache Variables
+    ``<name>.cache_k_<i>`` / ``.cache_v_<i>`` [slots, n_kv, S_max, D]
+    are persistable read+written state — the executor donates them, so
+    every step updates the caches in place in HBM.  Fetches: ``logits``
+    [slots, V] and greedy ``next_token`` [slots] int64.
+
+    Returns ``(feed_names, fetches, cache_names)``."""
+    from ..framework.core import default_main_program
+
+    num_kv_heads = num_kv_heads or num_heads
+    head_dim = hidden // num_heads
+    tokens = layers.data("tokens", [num_slots, 1], dtype="int64",
+                         append_batch_size=False)
+    positions = layers.data("positions", [num_slots], dtype="int32",
+                            append_batch_size=False)
+    block = default_main_program().global_block()
+    cache_names = []
+    caches = []
+    for i in range(num_layers):
+        ck = block.create_var(
+            name=f"{name}.cache_k_{i}", persistable=True,
+            shape=[num_slots, num_kv_heads, max_seq_len, head_dim],
+            dtype="float32", stop_gradient=True)
+        cv = block.create_var(
+            name=f"{name}.cache_v_{i}", persistable=True,
+            shape=[num_slots, num_kv_heads, max_seq_len, head_dim],
+            dtype="float32", stop_gradient=True)
+        caches.append((ck, cv))
+        cache_names += [ck.name, cv.name]
+    x = layers.embedding(tokens, size=[vocab_size, hidden],
+                         param_attr=f"{name}.embed")
+    for i, (ck, cv) in enumerate(caches):
+        x = llama_block(x, hidden, num_heads, num_kv_heads, 1, head_dim,
+                        intermediate, name=f"{name}.blk{i}",
+                        kv_cache=(ck, cv), positions=positions)
+    x = layers.rms_norm(x, param_attr=f"{name}.ln_f")
+    logits = _linear(x, vocab_size, pname=f"{name}.head.w")  # [slots,1,V]
+    logits = layers.squeeze(logits, [1])                     # [slots, V]
+    next_token = layers.argmax(logits, axis=-1)              # [slots]
+    return ["tokens", "positions"], \
+        {"logits": logits, "next_token": next_token}, cache_names

@@ -14,6 +14,7 @@ from . import control_flow_ops  # noqa: F401
 from . import dgc_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import rope_ops  # noqa: F401
+from . import decode_ops  # noqa: F401
 from . import io_ops  # noqa: F401
 from . import debug_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401

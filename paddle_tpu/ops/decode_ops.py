@@ -1,0 +1,126 @@
+"""Autoregressive-decode ops: per-slot KV-cache write + cached attention.
+
+New capability for the generation serving path (no reference analog —
+the reference vintage predates KV-cached LLM serving).  Two ops that
+make a decoder block's attention O(1) per step instead of O(n²) over
+the prefix:
+
+* ``kv_cache_write`` — scatter the step's fresh K/V rows into a
+  persistent per-slot cache at per-row dynamic offsets
+  (``jax.lax.dynamic_update_slice`` vmapped over the slot dim).  The
+  output aliases the cache *variable name*, so the executor classifies
+  the cache as mutated persistable state → donated buffer → XLA updates
+  it in place in HBM (no [slots, H, S_max, D] copy per token).
+* ``cached_attention`` — one query step attends over the full cache
+  with a per-row validity mask (``j <= position[b] + t``).  The
+  formulation mirrors ``flash_attention impl='xla'`` exactly (same
+  einsum contractions, same ``-1e30`` mask constant, same
+  ``jax.nn.softmax``), which is what makes cached decode logits
+  **bit-exact** against the uncached full forward on CPU — masked cache
+  columns contribute exact zeros, and reduction prefixes are preserved
+  across lengths (asserted in ``tests/test_generation.py``).
+
+Both are inference-only (``grad=None``): the decode path never trains.
+"""
+from __future__ import annotations
+
+from .registry import in_var, register_op, set_out
+
+
+def _kv_write_infer(op, block):
+    c = in_var(op, block, "Cache")
+    set_out(op, block, "Out", c.shape, c.dtype)
+
+
+@register_op("kv_cache_write", infer=_kv_write_infer, grad=None,
+             stateful_outputs=("Out",))
+def _kv_cache_write(ctx, op):
+    """Cache [B, Hkv, S_max, D], New [B, Hkv, T, D], Positions [B] int —
+    write row b's T fresh rows at seq offset ``positions[b]``."""
+    import jax
+    import jax.numpy as jnp
+
+    cache = ctx.get_input(op, "Cache")
+    new = ctx.get_input(op, "New")
+    pos = ctx.get_input(op, "Positions")
+
+    def write_row(c, n, p):
+        return jax.lax.dynamic_update_slice(
+            c, n.astype(c.dtype), (jnp.int32(0), p, jnp.int32(0)))
+
+    out = jax.vmap(write_row)(cache, new, pos.astype(jnp.int32))
+    ctx.set_output(op, "Out", out)
+
+
+@register_op("kv_cache_insert", infer=_kv_write_infer, grad=None,
+             stateful_outputs=("Out",))
+def _kv_cache_insert(ctx, op):
+    """Prefill insert: Cache [slots, Hkv, S_max, D] gets New
+    [1, Hkv, S_b, D] at slot ``Slot[0]`` (seq offset 0) — the one-shot
+    cache population after a prompt's causal forward, in-graph so the
+    prefill step donates the cache buffer like the decode step does
+    (no per-layer K/V fetch + host-side reinsert)."""
+    import jax
+    import jax.numpy as jnp
+
+    cache = ctx.get_input(op, "Cache")
+    new = ctx.get_input(op, "New")
+    slot = ctx.get_input(op, "Slot").astype(jnp.int32)
+    z = jnp.int32(0)
+    out = jax.lax.dynamic_update_slice(
+        cache, new.astype(cache.dtype), (slot.reshape(()), z, z, z))
+    ctx.set_output(op, "Out", out)
+
+
+def _cached_attn_infer(op, block):
+    q = in_var(op, block, "Q")
+    set_out(op, block, "Out", q.shape, q.dtype)
+
+
+@register_op("cached_attention", infer=_cached_attn_infer, grad=None)
+def _cached_attention(ctx, op):
+    """Q [B, H, T, D] over caches K/V [B, Hkv, S_max, D]; Positions [B]
+    is the pre-step sequence length (row b's query t sits at absolute
+    position ``positions[b] + t`` and attends columns ``j`` with
+    ``j <= positions[b] + t``).  GQA caches (Hkv < H) expand
+    repeat-interleave style, matching the uncached block's ``expand_kv``
+    values exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    q = ctx.get_input(op, "Q")
+    k = ctx.get_input(op, "K")
+    v = ctx.get_input(op, "V")
+    pos = ctx.get_input(op, "Positions").astype(jnp.int32)
+    B, H, T, D = q.shape
+    Hkv, S = k.shape[1], k.shape[2]
+    if Hkv != H:
+        rep = H // Hkv
+        # repeat_interleave [k1,k1,..,k2,k2,..]: query-head group g maps
+        # to kv head g//rep (same convention as llama_block's expand_kv)
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    scale = op.attr("scale", None)
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    if T == 1:
+        # a Q=1 scores dot lowers to a GEMV-style rewrite whose
+        # accumulation order over D differs from the generic GEMM the
+        # uncached forward uses (measured on CPU: ~1e-6 logit drift,
+        # breaking the bit-exactness contract).  Duplicating the query
+        # row keeps the generic row-consistent GEMM path; the clone's
+        # scores are sliced away before the softmax.
+        s = jnp.einsum("bhqd,bhkd->bhqk",
+                       jnp.concatenate([q, q], axis=2), k)[:, :, :1]
+        s = s * scale
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    # validity mask: same -1e30 constant as flash_attention impl="xla";
+    # exp underflows to exact 0 for masked columns, so softmax sums and
+    # the PV contraction are bit-identical to the shorter uncached row
+    j = jnp.arange(S, dtype=jnp.int32)[None, None, None, :]
+    t = jnp.arange(T, dtype=jnp.int32)[None, None, :, None]
+    limit = pos[:, None, None, None] + t
+    s = jnp.where(j <= limit, s, jnp.asarray(-1e30, s.dtype))
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    ctx.set_output(op, "Out", out.astype(q.dtype))

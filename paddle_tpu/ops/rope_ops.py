@@ -15,7 +15,14 @@ def _rope_infer(op, block):
 @register_op("rope", infer=_rope_infer, grad="auto")
 def _rope(ctx, op):
     """X: [B, H, S, D] (D even). Rotates pairs (x[..., :D/2], x[..., D/2:])
-    by position-dependent angles — the 'rotate_half' convention."""
+    by position-dependent angles — the 'rotate_half' convention.
+
+    Optional input ``Offset`` [B] (int): per-row dynamic position
+    offset for cached decode — row b's positions are
+    ``offset[b] .. offset[b]+S-1``.  The angle math is identical to the
+    static path (``pos * inv_freq``), so a token rotated at decode step
+    p is bit-equal to the same token rotated at position p of a full
+    forward."""
     import jax.numpy as jnp
 
     x = ctx.get_input(op, "X")
@@ -25,10 +32,20 @@ def _rope(ctx, op):
     half = D // 2
 
     inv_freq = 1.0 / (base ** (np.arange(0, half) / half))
-    pos = jnp.arange(pos_offset, pos_offset + S, dtype=jnp.float32)
-    freqs = jnp.outer(pos, inv_freq)              # [S, half]
-    cos = jnp.cos(freqs)[None, None]              # [1,1,S,half]
-    sin = jnp.sin(freqs)[None, None]
+    offset = ctx.get_input(op, "Offset") if op.single_input("Offset") \
+        else None
+    if offset is None:
+        pos = jnp.arange(pos_offset, pos_offset + S, dtype=jnp.float32)
+        freqs = jnp.outer(pos, inv_freq)          # [S, half]
+        cos = jnp.cos(freqs)[None, None]          # [1,1,S,half]
+        sin = jnp.sin(freqs)[None, None]
+    else:
+        pos = offset.astype(jnp.float32)[:, None] \
+            + jnp.arange(S, dtype=jnp.float32)[None, :]      # [B, S]
+        freqs = pos[..., None] * jnp.asarray(inv_freq,
+                                             jnp.float32)    # [B,S,half]
+        cos = jnp.cos(freqs)[:, None]             # [B,1,S,half]
+        sin = jnp.sin(freqs)[:, None]
 
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., :half], xf[..., half:]

@@ -12,12 +12,19 @@ front end.  See the README "Serving" section for the policy knobs.
                            warmup_shapes={"x": (6,)})
     outputs = engine.predict({"x": example})      # in-process
     server = serve(engine, port=8080)             # HTTP /predict,/healthz
+
+Autoregressive generation rides the same front end through the
+slot-based continuous-batching scheduler
+(:class:`~paddle_tpu.serving.generation.GenerationEngine`): attach one
+via ``engine.attach_generator(gen)`` and ``POST /generate`` routes to
+it (README "Generation serving").
 """
 from . import batcher  # noqa
 from .engine import (OverloadedError, RequestFailed, ServingEngine,  # noqa
                      ServingError, ServingFuture)
+from .generation import GenerationEngine  # noqa
 from .server import ServingServer, serve  # noqa
 
 __all__ = ["ServingEngine", "ServingError", "OverloadedError",
            "RequestFailed", "ServingFuture", "ServingServer", "serve",
-           "batcher"]
+           "GenerationEngine", "batcher"]

@@ -28,7 +28,8 @@ from typing import Dict, List, Sequence, Tuple
 import numpy as np
 
 __all__ = ["bucket_sizes", "bucket_for", "signature_of",
-           "describe_signature", "pad_stack", "split_rows", "fill_pct"]
+           "describe_signature", "pad_stack", "split_rows", "fill_pct",
+           "prompt_buckets", "prompt_bucket_for", "pad_prompt"]
 
 
 def bucket_sizes(max_batch: int) -> Tuple[int, ...]:
@@ -114,3 +115,57 @@ def split_rows(outputs: Sequence[np.ndarray],
 def fill_pct(rows: int, bucket: int) -> float:
     """Batch fill ratio in percent (real rows / padded rows)."""
     return 100.0 * rows / max(bucket, 1)
+
+
+# ---------------------------------------------------------------------------
+# prompt-length bucketing (the generation prefill analog of the batch
+# buckets above: every distinct padded prompt length is a distinct XLA
+# executable, so prompts pad up to a small fixed set of lengths)
+# ---------------------------------------------------------------------------
+
+def prompt_buckets(max_len: int, floor: int = 8,
+                   buckets=None) -> Tuple[int, ...]:
+    """Prefill sequence-length buckets: powers of two from ``floor`` up
+    to ``max_len`` (``max_len`` itself always included).  An explicit
+    ``buckets`` list overrides (validated ascending, capped at
+    max_len)."""
+    if max_len < 2:
+        raise ValueError(f"max_len must be >= 2, got {max_len}")
+    if buckets is not None:
+        out = sorted({int(b) for b in buckets})
+        if not out or out[0] < 1 or out[-1] > max_len:
+            raise ValueError(f"bad prefill buckets {buckets!r} for "
+                             f"max_len {max_len}")
+        return tuple(out)
+    sizes = {max_len}
+    b = max(1, floor)
+    while b < max_len:
+        sizes.add(b)
+        b *= 2
+    return tuple(sorted(sizes))
+
+
+def prompt_bucket_for(length: int, buckets: Sequence[int]) -> int:
+    """Smallest prefill bucket holding ``length`` prompt tokens;
+    raises when the prompt exceeds every bucket (the engine validates
+    at submit, so a scheduler-side miss is a bug, not an overload)."""
+    b = bucket_for(length, buckets)
+    if b is None:
+        raise ValueError(f"prompt of {length} tokens exceeds the "
+                         f"largest prefill bucket {buckets[-1]}")
+    return b
+
+
+def pad_prompt(ids: np.ndarray, bucket: int, pad_id: int = 0
+               ) -> np.ndarray:
+    """Right-pad a 1-D token-id prompt to ``bucket``.  Causal attention
+    means pad-tail tokens can never influence positions before them, so
+    the pad id's value is irrelevant to the real rows (the cached rows
+    beyond the true length are masked by per-slot positions)."""
+    ids = np.asarray(ids).reshape(-1).astype("int64")
+    if ids.size > bucket:
+        raise ValueError(f"prompt of {ids.size} tokens does not fit "
+                         f"bucket {bucket}")
+    out = np.full((bucket,), pad_id, "int64")
+    out[:ids.size] = ids
+    return out

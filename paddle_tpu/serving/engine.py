@@ -261,6 +261,9 @@ class ServingEngine:
         self._sigterm_installed = False
         self._prev_sigterm = None
         self._hbm_sampling = False
+        # optional slot-based generation scheduler (attach_generator):
+        # generation requests route to it, the one-shot path is untouched
+        self.generator = None
 
         if warmup_shapes is not None:
             self.warmup(warmup_shapes)
@@ -345,6 +348,8 @@ class ServingEngine:
             self._shed(req, "draining")
         for t in self._threads:
             t.join(timeout)
+        if self.generator is not None:
+            self.generator.close(drain=drain, timeout=timeout)
         if self._sigterm_installed:
             try:
                 signal.signal(signal.SIGTERM,
@@ -552,6 +557,26 @@ class ServingEngine:
     def predict(self, feed, timeout: Optional[float] = None):
         """Blocking one-shot: ``submit(feed).result(timeout)``."""
         return self.submit(feed).result(timeout)
+
+    # -- generation routing -------------------------------------------------
+    def attach_generator(self, generator) -> "ServingEngine":
+        """Attach a :class:`~paddle_tpu.serving.generation.
+        GenerationEngine`: generation requests (``submit_generate`` /
+        HTTP ``POST /generate``) route to its slot scheduler while the
+        one-shot ``/predict`` path stays untouched.  The generator
+        drains and closes with the engine."""
+        self.generator = generator
+        return self
+
+    def submit_generate(self, prompt, max_new_tokens=None):
+        """Admit one generation request to the attached slot scheduler
+        (future of the generation record); raises RuntimeError when no
+        generator is attached."""
+        if self.generator is None:
+            raise RuntimeError("no GenerationEngine attached; call "
+                               "attach_generator() first")
+        return self.generator.submit(prompt,
+                                     max_new_tokens=max_new_tokens)
 
     # -- scheduler ----------------------------------------------------------
     def _count(self, key: str, n: int = 1):
@@ -812,7 +837,7 @@ class ServingEngine:
         with self._trace_lock:
             traces = {"recent_sampled": len(self._tracez_recent),
                       "slowest_kept": len(self._tracez_slow)}
-        return {
+        out = {
             "stats": self.stats(),
             "max_batch": self.max_batch,
             "max_delay_ms": self._max_delay_s * 1e3,
@@ -824,6 +849,9 @@ class ServingEngine:
                             for p in dict.fromkeys(self._pool)],
             "traces": traces,
         }
+        if self.generator is not None:
+            out["generator"] = self.generator.introspect()
+        return out
 
     def health(self) -> dict:
         """The ``/healthz`` payload: serving liveness + the same
@@ -834,7 +862,7 @@ class ServingEngine:
         status = "draining" if self._draining else "ok"
         if self._closed:
             status = "closed"
-        return {
+        out = {
             "status": status,
             "pid": os.getpid(),
             "time": time.time(),
@@ -842,3 +870,6 @@ class ServingEngine:
             "device_memory": _device_memory(),
             "serving": self.stats(),
         }
+        if self.generator is not None:
+            out["generation"] = self.generator.stats()
+        return out

@@ -1,0 +1,654 @@
+"""Slot-based continuous batching for autoregressive decode.
+
+The serving engine's FIFO head-run batching (``engine.py``) cannot
+express generation: one request is not one forward but a *prefill*
+(one causal pass over the prompt, O(P²)) followed by N *decode* steps
+(one token each, O(1) with a KV cache).  Static batching strands a
+finished sequence's batch slot until the whole batch drains — the two
+dominant throughput losses Orca's iteration-level scheduling (Yu et
+al., OSDI '22) and vLLM's KV-cache management (Kwon et al., SOSP '23)
+identified.  This module is the repo's answer:
+
+* **Fixed slot grid** — ``num_slots`` decode slots share per-layer KV
+  caches ``[slots, n_kv, max_seq_len, D]`` held as persistable
+  executor state.  The decode program writes each slot's fresh K/V at
+  its own offset and the executor *donates* the cache buffers
+  (``jax.jit donate_argnums`` via mutated-persistable classification),
+  so every step updates the caches in place in HBM — no per-token
+  cache copy, one compiled executable for the whole grid.
+* **Prefill/decode split** — prompts compile against shape buckets
+  (powers of two, like the one-shot batcher); decode steps run the
+  whole slot grid every iteration.  Idle slots compute garbage rows
+  that are row-independent from live ones (asserted bit-exact in
+  ``tests/test_generation.py``).
+* **Continuous batching** — a finished sequence (EOS / max tokens /
+  max_seq_len) frees its slot *immediately*; the scheduler claims the
+  next queued request into it between decode steps while the other
+  slots keep generating.  ``continuous=False`` restores FIFO head-run
+  static batching (claim only when every slot is idle, i.e. batch
+  drain) — the measured baseline the bench leg compares against.
+* **Admission control** — bounded queue reusing the serving
+  :class:`~paddle_tpu.serving.engine.OverloadedError` semantics:
+  ``queue_full`` at submit, ``deadline`` when a request outlives
+  ``FLAGS_serving_deadline_ms`` before claiming a slot, ``draining``
+  during shutdown.
+
+Stats (README catalog): counters ``serving_generate_requests``,
+``serving_generate_shed``, ``serving_prefills``,
+``serving_decode_steps``, ``serving_generated_tokens``,
+``serving_prefill_tokens``, ``serving_slot_reclaims``; gauges
+``serving_slot_occupancy``, ``serving_prefill_decode_ratio``,
+``serving_kv_cache_bytes``, ``serving_decode_mfu``; histograms
+``serving_generate_ms``, ``serving_prefill_ms``,
+``serving_decode_step_ms``.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import costmodel, telemetry
+from ..flags import flag_value
+from ..monitor import stat_add
+from . import batcher
+from .engine import OverloadedError, RequestFailed, ServingFuture
+
+__all__ = ["GenerationEngine", "GenRequest"]
+
+logger = logging.getLogger("paddle_tpu.serving.generation")
+
+# decode-MFU gauge refresh cadence (steps) — cheap, but no need to pay
+# a costmodel lookup every token
+_MFU_EVERY = 16
+
+
+class GenRequest:
+    """One queued generation request."""
+
+    __slots__ = ("prompt", "max_new_tokens", "future", "t_submit",
+                 "t_claimed", "trace_id", "prefill_ms")
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.future = ServingFuture()
+        self.t_submit = time.monotonic()
+        self.t_claimed: Optional[float] = None
+        self.trace_id: Optional[str] = None
+        self.prefill_ms: float = 0.0
+
+
+class _Slot:
+    """Per-slot decode state: cache offset, step count, deadline."""
+
+    __slots__ = ("idx", "req", "position", "steps", "tokens", "t_start",
+                 "logits")
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.req: Optional[GenRequest] = None
+        self.position = 0     # pre-step sequence length = cache offset
+        self.steps = 0        # decode steps taken for this request
+        self.tokens: List[int] = []
+        self.t_start = 0.0
+        self.logits: List[np.ndarray] = []  # keep_logits only
+
+    @property
+    def active(self) -> bool:
+        return self.req is not None
+
+
+class GenerationEngine:
+    """KV-cached generation over a fixed decode-slot grid.
+
+    ``model``: dict of llama size kwargs (``vocab_size``, ``hidden``,
+    ``num_layers``, ``num_heads``, ``num_kv_heads``, ``intermediate``).
+    ``scope``: optional pre-initialized :class:`~paddle_tpu.framework.
+    executor.Scope` whose weights use the same ``name`` prefix (the
+    engine then shares them zero-copy); omitted, the engine seeds its
+    own random weights (bench / loadgen).
+
+    In-process API: :meth:`submit` (future) / :meth:`generate`
+    (blocking).  The HTTP front end exposes ``POST /generate`` over the
+    same calls (:mod:`paddle_tpu.serving.server`).
+    """
+
+    def __init__(self, model: Dict, scope=None, *, num_slots=None,
+                 max_seq_len=None, prefill_buckets=None, eos_id=-1,
+                 max_new_tokens=None, queue_cap=None, deadline_ms=None,
+                 continuous=True, autostart=True, name="llama",
+                 attn_impl="auto", seed=0, keep_logits=False):
+        import paddle_tpu as pt
+        from ..models.llama import build_llama_decode, build_llama_prefill
+
+        self.model = dict(model)
+        self.name = name
+        self.attn_impl = attn_impl
+        self.continuous = bool(continuous)
+        # keep_logits: fetch and retain every step's next-token logits
+        # on the result record — the bit-exactness tests compare them
+        # against the uncached full forward; costs one extra [slots, V]
+        # fetch per step, so serve-path default is off
+        self.keep_logits = bool(keep_logits)
+        self.eos_id = int(eos_id)
+        self.num_slots = int(num_slots if num_slots is not None
+                             else flag_value("FLAGS_serving_decode_slots"))
+        self.max_seq_len = int(
+            max_seq_len if max_seq_len is not None
+            else flag_value("FLAGS_serving_max_seq_len"))
+        self.max_new_tokens = int(
+            max_new_tokens if max_new_tokens is not None
+            else flag_value("FLAGS_serving_max_new_tokens"))
+        self.queue_cap = int(queue_cap if queue_cap is not None
+                             else flag_value("FLAGS_serving_queue_cap"))
+        dl = (deadline_ms if deadline_ms is not None
+              else flag_value("FLAGS_serving_deadline_ms"))
+        self._deadline_s = float(dl) / 1e3
+        if prefill_buckets is None:
+            spec = str(flag_value("FLAGS_serving_prefill_buckets") or "")
+            prefill_buckets = [int(b) for b in spec.split(",") if b] \
+                if spec else None
+        self.prefill_buckets = batcher.prompt_buckets(
+            self.max_seq_len, buckets=prefill_buckets)
+        self.max_prompt_len = min(self.prefill_buckets[-1],
+                                  self.max_seq_len - 1)
+        if self.num_slots < 1:
+            raise ValueError("GenerationEngine needs at least one slot")
+
+        heads = self.model["num_heads"]
+        self._n_kv = self.model.get("num_kv_heads") or heads
+        self._head_dim = self.model["hidden"] // heads
+        self._build_fn_prefill = build_llama_prefill
+        self._seed = seed
+
+        # programs + executors: decode gets its own executor so its
+        # compile-cache entry (and cost/memory manifest) is isolated —
+        # cache_info()["entries"][0] IS the decode step
+        self._prefill_exe = pt.Executor()
+        self._decode_exe = pt.Executor()
+        self._prefill_progs: Dict[int, tuple] = {}  # bucket -> (prog, fetches)
+        self.scope = scope if scope is not None else pt.Scope()
+        self._build_decode(scope_ready=scope is not None)
+        self._init_caches()
+
+        # scheduler state
+        self._queue: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._slots = [_Slot(i) for i in range(self.num_slots)]
+        self._draining = False
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+        self._n = {"requests": 0, "shed": 0, "served": 0, "prefills": 0,
+                   "decode_steps": 0, "generated_tokens": 0,
+                   "prefill_tokens": 0, "slot_reclaims": 0,
+                   "failed": 0}
+        self._n_lock = threading.Lock()
+        self._h_gen = telemetry.Histogram("serving_generate_ms")
+        self._h_prefill = telemetry.Histogram("serving_prefill_ms")
+        self._h_step = telemetry.Histogram("serving_decode_step_ms")
+        self._t_prefill_total = 0.0
+        self._t_decode_total = 0.0
+        self._decode_rate_ema: Optional[float] = None
+
+        if autostart:
+            self.start()
+
+    # -- build --------------------------------------------------------------
+    def _build_decode(self, scope_ready: bool):
+        import paddle_tpu as pt
+        from ..models.llama import build_llama_decode
+
+        main, startup = pt.Program(), pt.Program()
+        startup._is_startup = True
+        startup.random_seed = main.random_seed = self._seed
+        with pt.program_guard(main, startup):
+            feeds, fetches, cache_names = build_llama_decode(
+                self.num_slots, self.max_seq_len, name=self.name,
+                **self.model)
+        self._decode_prog = main
+        self._decode_feeds = feeds
+        self._decode_fetches = fetches
+        self.cache_names = cache_names
+        if not scope_ready:
+            # engine-owned weights: the decode program references every
+            # parameter, so one startup run initializes the full set
+            self._prefill_exe.run(startup, scope=self.scope)
+
+    def _init_caches(self):
+        import jax.numpy as jnp
+
+        shape = (self.num_slots, self._n_kv, self.max_seq_len,
+                 self._head_dim)
+        total = 0
+        for n in self.cache_names:
+            # one DISTINCT zero buffer per cache: the decode step and
+            # the prefill insert donate all caches in one call, and XLA
+            # rejects donating the same buffer twice
+            self.scope.set_var(n, jnp.zeros(shape, jnp.float32).copy())
+            total += int(np.prod(shape)) * 4
+        self.kv_cache_bytes = total
+        telemetry.gauge_set("serving_kv_cache_bytes", total)
+
+    def _prefill_prog_for(self, bucket: int):
+        import paddle_tpu as pt
+
+        entry = self._prefill_progs.get(bucket)
+        if entry is None:
+            main, startup = pt.Program(), pt.Program()
+            startup._is_startup = True
+            startup.random_seed = main.random_seed = self._seed
+            with pt.program_guard(main, startup):
+                _feeds, fetches = self._build_fn_prefill(
+                    1, bucket, name=self.name, attn_impl=self.attn_impl,
+                    cache_slots=self.num_slots,
+                    max_seq_len=self.max_seq_len, **self.model)
+            entry = self._prefill_progs[bucket] = (main, fetches)
+        return entry
+
+    def warmup(self) -> int:
+        """Compile every prefill bucket + the decode step now (off the
+        request path).  Returns the number of programs compiled."""
+        compiled = 0
+        for b in self.prefill_buckets:
+            if b not in self._prefill_progs:
+                self._run_prefill_program(
+                    np.zeros((b,), "int64"), b, slot=0)
+                compiled += 1
+        # one throwaway decode dispatch compiles the grid step
+        self._run_decode_program(np.zeros((self.num_slots, 1), "int64"),
+                                 np.zeros((self.num_slots,), "int32"))
+        return compiled + 1
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="generation-scheduler",
+                                            daemon=True)
+            self._thread.start()
+
+    def drain(self, timeout: Optional[float] = None):
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None):
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._draining = True
+            shed = []
+            if not drain:
+                shed, self._queue = list(self._queue), collections.deque()
+            self._cv.notify_all()
+        for req in shed:
+            self._shed(req, "draining")
+        if self._thread is not None:
+            self._thread.join(timeout)
+        telemetry.log_event("generation_drained",
+                            served=self._n["served"], shed=self._n["shed"])
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None) -> ServingFuture:
+        """Admit one generation request.  ``prompt``: 1-D int token ids
+        (1 ≤ len ≤ the largest prefill bucket).  Returns a future whose
+        ``result()`` is ``{"tokens", "prompt_len", "steps", "finish",
+        "trace_id", "queue_wait_ms", "prefill_ms", "total_ms"}``.
+        A budget larger than the cache capacity left after the prompt
+        is honored until the slot's cache fills, finishing
+        ``"cache_full"`` (vs ``"length"`` for a genuinely met budget).
+        Sheds with :class:`OverloadedError` (``queue_full`` /
+        ``draining``)."""
+        ids = np.asarray(prompt)
+        if ids.ndim != 1 or ids.size < 1:
+            raise ValueError(f"prompt must be a non-empty 1-D token id "
+                             f"sequence, got shape {ids.shape}")
+        if not np.issubdtype(ids.dtype, np.integer):
+            raise ValueError(f"prompt must be integer token ids, got "
+                             f"dtype {ids.dtype}")
+        if ids.size > self.max_prompt_len:
+            raise ValueError(
+                f"prompt of {ids.size} tokens exceeds max prompt length "
+                f"{self.max_prompt_len} (largest prefill bucket, with "
+                f"one decode slot of max_seq_len={self.max_seq_len} "
+                f"reserved)")
+        mnt = max(1, int(max_new_tokens if max_new_tokens is not None
+                         else self.max_new_tokens))
+        req = GenRequest(ids.astype("int64"), mnt)
+        if telemetry.enabled():
+            req.trace_id = telemetry.new_trace_id()
+        self._count("requests")
+        stat_add("serving_generate_requests")
+        with self._cv:
+            if self._draining:
+                raise self._shed_err(req, "draining")
+            if len(self._queue) >= self.queue_cap:
+                raise self._shed_err(
+                    req, "queue_full",
+                    f"{len(self._queue)}/{self.queue_cap} queued")
+            self._queue.append(req)
+            self._cv.notify_all()
+        return req.future
+
+    def generate(self, prompt, max_new_tokens=None,
+                 timeout: Optional[float] = None) -> dict:
+        """Blocking one-shot: ``submit(...).result(timeout)``."""
+        return self.submit(prompt, max_new_tokens).result(timeout)
+
+    def _shed_err(self, req: GenRequest, reason: str,
+                  detail: str = "") -> OverloadedError:
+        self._count("shed")
+        stat_add("serving_generate_shed")
+        err = OverloadedError(reason, detail)
+        err.trace_id = req.trace_id
+        return err
+
+    def _shed(self, req: GenRequest, reason: str):
+        req.future._resolve(error=self._shed_err(req, reason))
+
+    # -- scheduler ----------------------------------------------------------
+    def _count(self, key: str, n: int = 1):
+        with self._n_lock:
+            self._n[key] += n
+
+    def _active(self) -> List[_Slot]:
+        return [s for s in self._slots if s.active]
+
+    def _can_claim_locked(self) -> bool:
+        """Continuous batching claims a free slot the moment one
+        exists; static (FIFO head-run) batching only claims into a
+        fully drained grid — the Orca-motivated difference under
+        test."""
+        if self.continuous:
+            return any(not s.active for s in self._slots)
+        return all(not s.active for s in self._slots)
+
+    def _claim_locked(self) -> List[tuple]:
+        claimed = []
+        if not self._can_claim_locked():
+            return claimed
+        now = time.monotonic()
+        busy_before = sum(1 for s in self._slots if s.active)
+        for slot in self._slots:
+            if slot.active or not self._queue:
+                continue
+            req = None
+            while self._queue:
+                cand = self._queue.popleft()
+                if now - cand.t_submit > self._deadline_s:
+                    self._shed(cand, "deadline")
+                    continue
+                req = cand
+                break
+            if req is None:
+                break
+            req.t_claimed = now
+            slot.req = req
+            slot.position = 0
+            slot.steps = 0
+            slot.tokens = []
+            slot.t_start = now
+            claimed.append((slot, req))
+            if busy_before:
+                # the continuous-batching event: a new sequence enters
+                # a grid other sequences are still decoding in
+                self._count("slot_reclaims")
+                stat_add("serving_slot_reclaims")
+        return claimed
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while True:
+                    if self._queue and self._can_claim_locked():
+                        break
+                    if self._active():
+                        break
+                    if self._draining and not self._queue:
+                        return
+                    self._cv.wait(0.02)
+                claimed = self._claim_locked()
+            for slot, req in claimed:
+                try:
+                    self._prefill(slot, req)
+                except Exception as e:  # noqa: BLE001 — a prefill failure
+                    # must not kill the scheduler: exactly this request
+                    # errors, the grid keeps decoding
+                    self._count("failed")
+                    logger.warning("prefill failed: %s", e)
+                    req.future._resolve(error=RequestFailed(
+                        f"prefill failed: {type(e).__name__}: {e}"))
+                    slot.req = None
+            if self._active():
+                self._decode_step()
+            self._publish_gauges()
+
+    # -- prefill ------------------------------------------------------------
+    def _run_prefill_program(self, ids: np.ndarray, bucket: int,
+                             slot: int):
+        """One causal pass over the padded prompt; the per-layer K/V
+        land in the slot's caches in-graph (donated executor state —
+        the same HBM-in-place contract as the decode step)."""
+        prog, fetches = self._prefill_prog_for(bucket)
+        padded = batcher.pad_prompt(ids, bucket)
+        fetch = [fetches["next_token"]]
+        if self.keep_logits:
+            fetch.append(fetches["logits"])
+        outs = self._prefill_exe.run(
+            prog,
+            feed={"input_ids": padded[None],
+                  "last_pos": np.asarray([ids.size - 1], "int64"),
+                  "slot": np.asarray([slot], "int32")},
+            fetch_list=fetch,
+            scope=self.scope, return_numpy=False)
+        return outs
+
+    def _prefill(self, slot: _Slot, req: GenRequest):
+        t0 = time.monotonic()
+        bucket = batcher.prompt_bucket_for(req.prompt.size,
+                                           self.prefill_buckets)
+        with telemetry.trace_span("generation/prefill",
+                                  tokens=int(req.prompt.size),
+                                  bucket=bucket, slot=slot.idx):
+            outs = self._run_prefill_program(req.prompt, bucket,
+                                             slot.idx)
+            first = int(np.asarray(outs[0].numpy())[0])
+            slot.logits = [np.asarray(outs[1].numpy())[0]] \
+                if self.keep_logits else []
+        ms = (time.monotonic() - t0) * 1e3
+        req.prefill_ms = ms
+        self._t_prefill_total += ms
+        self._h_prefill.observe(ms, trace_id=req.trace_id)
+        telemetry.histogram_observe("serving_prefill_ms", ms,
+                                    trace_id=req.trace_id)
+        self._count("prefills")
+        self._count("prefill_tokens", int(req.prompt.size))
+        stat_add("serving_prefills")
+        stat_add("serving_prefill_tokens", int(req.prompt.size))
+        slot.position = int(req.prompt.size)
+        slot.tokens = [first]
+        self._book_token(slot, first)
+
+    # -- decode -------------------------------------------------------------
+    def _run_decode_program(self, tokens: np.ndarray,
+                            positions: np.ndarray):
+        fetch = [self._decode_fetches["next_token"]]
+        if self.keep_logits:
+            fetch.append(self._decode_fetches["logits"])
+        outs = self._decode_exe.run(
+            self._decode_prog,
+            feed={"tokens": tokens, "positions": positions},
+            fetch_list=fetch,
+            scope=self.scope, return_numpy=False)
+        next_tokens = np.asarray(outs[0].numpy())
+        logits = np.asarray(outs[1].numpy()) if self.keep_logits else None
+        return next_tokens, logits
+
+    def _decode_step(self):
+        t0 = time.monotonic()
+        tokens = np.zeros((self.num_slots, 1), "int64")
+        positions = np.zeros((self.num_slots,), "int32")
+        active = self._active()
+        for s in active:
+            tokens[s.idx, 0] = s.tokens[-1]
+            positions[s.idx] = s.position
+        with telemetry.trace_span("generation/decode_step",
+                                  active=len(active)):
+            next_tokens, logits = self._run_decode_program(tokens,
+                                                           positions)
+        ms = (time.monotonic() - t0) * 1e3
+        self._t_decode_total += ms
+        self._h_step.observe(ms)
+        telemetry.histogram_observe("serving_decode_step_ms", ms)
+        self._count("decode_steps")
+        stat_add("serving_decode_steps")
+        dt = ms / 1e3
+        self._decode_rate_ema = (1.0 / dt if self._decode_rate_ema is None
+                                 else 0.9 * self._decode_rate_ema
+                                 + 0.1 / dt)
+        for s in active:
+            tok = int(next_tokens[s.idx])
+            s.position += 1
+            s.steps += 1
+            s.tokens.append(tok)
+            if logits is not None:
+                s.logits.append(logits[s.idx])
+            self._book_token(s, tok)
+
+    def _book_token(self, slot: _Slot, tok: int):
+        """Account one generated token and finish the slot on EOS /
+        token budget / cache exhaustion — freeing it for the next
+        queued request at the very next scheduler iteration."""
+        self._count("generated_tokens")
+        stat_add("serving_generated_tokens")
+        req = slot.req
+        finish = None
+        if tok == self.eos_id:
+            finish = "eos"
+        elif len(slot.tokens) >= req.max_new_tokens:
+            finish = "length"
+        elif slot.position >= self.max_seq_len:
+            # the next decode step would write at index max_seq_len —
+            # past the cache bucket, where dynamic_update_slice would
+            # silently clamp onto the last row; finishing HERE is the
+            # out-of-bounds guard (reachable: submit does not clamp a
+            # request's budget to the capacity left after its prompt)
+            finish = "cache_full"
+        if finish is not None:
+            self._finish(slot, finish)
+
+    def _finish(self, slot: _Slot, finish: str):
+        req = slot.req
+        now = time.monotonic()
+        total_ms = (now - req.t_submit) * 1e3
+        self._count("served")
+        self._h_gen.observe(total_ms, trace_id=req.trace_id)
+        telemetry.histogram_observe("serving_generate_ms", total_ms,
+                                    trace_id=req.trace_id)
+        result = {
+            "tokens": [int(t) for t in slot.tokens],
+            "prompt_len": int(req.prompt.size),
+            "steps": slot.steps,
+            "finish": finish,
+            "trace_id": req.trace_id,
+            "queue_wait_ms": round(
+                ((req.t_claimed or now) - req.t_submit) * 1e3, 3),
+            "prefill_ms": round(req.prefill_ms, 3),
+            "total_ms": round(total_ms, 3),
+        }
+        if self.keep_logits:
+            result["logits"] = slot.logits
+            slot.logits = []
+        slot.req = None
+        req.future._resolve(outputs=result)
+
+    # -- introspection ------------------------------------------------------
+    def _publish_gauges(self):
+        if not telemetry.enabled():
+            return
+        active = len(self._active())
+        telemetry.gauge_set("serving_slot_occupancy",
+                            active / self.num_slots)
+        if self._t_decode_total > 0:
+            telemetry.gauge_set(
+                "serving_prefill_decode_ratio",
+                self._t_prefill_total / self._t_decode_total)
+        with self._n_lock:
+            steps = self._n["decode_steps"]
+        if steps and steps % _MFU_EVERY == 0:
+            mfu = self.decode_mfu()
+            if mfu is not None:
+                telemetry.gauge_set("serving_decode_mfu", mfu)
+
+    def decode_manifest(self) -> Optional[dict]:
+        """The decode-step executable's cost/memory manifest (flops,
+        bytes accessed, peak HBM — see costmodel.executable_manifest);
+        None before the first decode step or when the backend exposes
+        no analysis."""
+        for e in self._decode_exe.cache_info()["entries"]:
+            if e.get("manifest"):
+                return e["manifest"]
+        return None
+
+    def decode_mfu(self) -> Optional[float]:
+        """Achieved decode-step MFU: manifest FLOPs × measured grid
+        step rate over the chip peak."""
+        m = self.decode_manifest()
+        if not m or not m.get("flops") or not self._decode_rate_ema:
+            return None
+        return costmodel.mfu(m["flops"] * self._decode_rate_ema)
+
+    def stats(self) -> dict:
+        with self._n_lock:
+            n = dict(self._n)
+        with self._cv:
+            depth = len(self._queue)
+            active = len(self._active())
+        return {
+            "queue_depth": depth,
+            "queue_cap": self.queue_cap,
+            "slots": self.num_slots,
+            "slots_active": active,
+            "slot_occupancy": round(active / self.num_slots, 4),
+            "continuous": self.continuous,
+            "max_seq_len": self.max_seq_len,
+            "prefill_buckets": list(self.prefill_buckets),
+            "kv_cache_bytes": self.kv_cache_bytes,
+            "draining": self._draining,
+            "counters": n,
+            "tokens_per_request": round(
+                n["generated_tokens"] / max(n["served"], 1), 2),
+            "prefill_decode_ms_ratio": round(
+                self._t_prefill_total / max(self._t_decode_total, 1e-9),
+                4),
+            "generate_ms": self._h_gen.summary(),
+            "prefill_ms": self._h_prefill.summary(),
+            "decode_step_ms": self._h_step.summary(),
+        }
+
+    def introspect(self) -> dict:
+        """The generator half of ``/statusz``: stats + the decode
+        executable manifest + achieved decode MFU."""
+        return {
+            "stats": self.stats(),
+            "decode_manifest": self.decode_manifest(),
+            "decode_mfu": self.decode_mfu(),
+            "decode_executables": self._decode_exe.cache_info(),
+        }

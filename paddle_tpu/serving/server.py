@@ -16,6 +16,14 @@ Endpoints:
   "overloaded", "reason": "queue_full" | "deadline" | "draining" |
   "injected"}`` (explicit backpressure, never unbounded queueing);
   malformed body / wrong feeds → 400; batch execution failure → 500.
+* ``POST /generate`` — body ``{"prompt": [token ids],
+  "max_new_tokens": N?}`` against the attached
+  :class:`~paddle_tpu.serving.generation.GenerationEngine` (slot-based
+  continuous batching).  200 → ``{"tokens": [...], "prompt_len",
+  "steps", "finish": "eos" | "length" | "cache_full", "trace_id",
+  "queue_wait_ms", "prefill_ms", "total_ms", "ms"}``.  Sheds → **503**
+  like ``/predict``; malformed or over-long prompts → 400; no
+  generator attached → 404.
 * ``GET /healthz`` — 200 with :meth:`ServingEngine.health` (serving
   stats + the telemetry heartbeat's process fields); 503 once the
   engine is closed — a load balancer drains the instance on SIGTERM.
@@ -242,7 +250,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._reply(200, rep)
 
-    # -- POST /predict ------------------------------------------------------
+    # -- POST /predict, /generate -------------------------------------------
     def do_POST(self):
         # drain the body FIRST, before any error reply: HTTP/1.1
         # keep-alive would otherwise parse leftover body bytes as the
@@ -252,16 +260,20 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError:
             n = 0
         body = self.rfile.read(n) if n > 0 else b""
-        if self.path.split("?", 1)[0] != "/predict":
+        route = self.path.split("?", 1)[0]
+        if route not in ("/predict", "/generate"):
             self._reply(404, {"error": "not found", "path": self.path})
             return
         stat_add("serving_http_requests")
         t0 = time.monotonic()
-        code, payload, trace = self._predict(body)
+        if route == "/predict":
+            code, payload, trace = self._predict(body)
+        else:
+            code, payload, trace = self._generate(body)
         self._reply(code, payload)
         ms = (time.monotonic() - t0) * 1e3
         rec = {"ts": round(time.time(), 6), "method": "POST",
-               "path": "/predict", "status": code, "ms": round(ms, 3),
+               "path": route, "status": code, "ms": round(ms, 3),
                "trace_id": (trace or {}).get("trace_id")
                or payload.get("trace_id")}
         if trace:
@@ -269,6 +281,50 @@ class _Handler(BaseHTTPRequestHandler):
             rec["phases"] = trace.get("phases")
             rec["request_status"] = trace.get("status")
         self.access_log.write(rec)
+
+    def _generate(self, body: bytes):
+        """One POST /generate body — ``{"prompt": [token ids],
+        "max_new_tokens": N?}`` — against the attached GenerationEngine.
+        404 when no generator is attached, 503 on overload sheds
+        (queue_full / deadline / draining), 400 on malformed prompts,
+        500 on a generation failure."""
+        gen = getattr(self.engine, "generator", None)
+        if gen is None:
+            return 404, {"error": "not found",
+                         "detail": "no generation engine attached"}, None
+        try:
+            doc = json.loads(body or b"{}")
+            prompt = doc["prompt"]
+            if not isinstance(prompt, list):
+                raise TypeError("'prompt' must be a list of token ids")
+            mnt = doc.get("max_new_tokens")
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"error": "bad request",
+                         "detail": f"{type(e).__name__}: {e}"}, None
+        t0 = time.monotonic()
+        try:
+            fut = self.engine.submit_generate(prompt, max_new_tokens=mnt)
+            res = fut.result(self.request_timeout_s)
+        except OverloadedError as e:
+            return 503, {"error": "overloaded", "reason": e.reason,
+                         "detail": str(e),
+                         "trace_id": getattr(e, "trace_id", None)}, None
+        except ValueError as e:  # bad prompt shape/dtype/length
+            return 400, {"error": "bad request", "detail": str(e)}, None
+        except (RequestFailed, TimeoutError) as e:
+            return 500, {"error": "request failed",
+                         "detail": str(e)}, None
+        res = dict(res)
+        # keep_logits debug runs attach raw per-step logit arrays —
+        # not JSON, and not part of the HTTP contract
+        res.pop("logits", None)
+        res["ms"] = round((time.monotonic() - t0) * 1e3, 3)
+        return 200, res, {"trace_id": res.get("trace_id"),
+                          "rows": res.get("steps"),
+                          "status": "ok:" + res.get("finish", ""),
+                          "phases": {
+                              "queue_wait_ms": res.get("queue_wait_ms"),
+                              "predict_ms": res.get("prefill_ms")}}
 
     def _predict(self, body: bytes):
         """Run one /predict body; returns (http_code, payload,

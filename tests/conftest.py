@@ -45,3 +45,10 @@ def _fresh_programs():
     core.switch_startup_program(prev_s)
     ex._global_scope = old_scope
     ex._scope_stack[:] = [old_scope]
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow'); "
+        "subprocess-heavy or long-wall-clock tests")

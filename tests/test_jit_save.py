@@ -77,6 +77,36 @@ def test_jit_save_load_roundtrip(tmp_path):
                                    atol=1e-6)
 
 
+def test_jit_save_polymorphic_batch(tmp_path):
+    """InputSpec([None, D]) must export a batch-polymorphic program:
+    the saved feed var keeps -1 (not a frozen sample size of 1), so one
+    export serves any batch (ADVICE.md jit.py:172 finding — the
+    prerequisite for serving exported generative models)."""
+    layer, x, ref = _train_tiny_layer()
+    d = str(tmp_path / "poly")
+    with pt.dygraph.guard():
+        pt.jit.save(layer, d,
+                    input_spec=[pt.static.InputSpec([None, 4],
+                                                    "float32")])
+        loaded = pt.jit.load(d)
+        for b in (1, 3, 8):
+            got = loaded(to_variable(x[:b]))
+            np.testing.assert_allclose(got.numpy(), ref[:b],
+                                       rtol=1e-5, atol=1e-6)
+    # the static io path agrees on the exported contract
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with _scope_guard(scope):
+        prog, feeds, fetches = pt.io.load_inference_model(d, exe)
+        v = prog.global_block().var(feeds[0])
+        assert v.shape[0] == -1, \
+            f"batch dim frozen to {v.shape[0]} in the export"
+        got, = exe.run(prog, feed={feeds[0]: x[:5]},
+                       fetch_list=fetches, scope=scope)
+    np.testing.assert_allclose(np.asarray(got), ref[:5], rtol=1e-5,
+                               atol=1e-6)
+
+
 def test_jit_save_serves_in_fresh_process(tmp_path):
     """Train dygraph -> jit.save -> a clean process serves it through
     BOTH jit.load and inference.Predictor (the deployment promise)."""

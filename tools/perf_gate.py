@@ -121,6 +121,19 @@ def compare_leg(name: str, new: dict, base: dict,
                           f"{new['anomaly']}")
         return res
     res["status"] = "regression" if new_med < threshold else "ok"
+    # decode-leg extra: the leg's headline is continuous-batching
+    # tokens/sec, but the scheduler's reason to exist is beating its
+    # own FIFO static baseline — if the fresh speedup drops below 1.0
+    # while the baseline had the win, the fast path regressed even when
+    # raw tokens/sec kept up (e.g. the static path got faster because
+    # the continuous path stopped reclaiming slots)
+    sp_new = new.get("speedup_vs_static")
+    sp_base = base.get("speedup_vs_static")
+    if res["status"] == "ok" and sp_new is not None \
+            and sp_base is not None and sp_new < 1.0 <= sp_base:
+        res.update(status="regression",
+                   reason=f"speedup_vs_static collapsed to {sp_new} "
+                          f"(baseline {sp_base})")
     return res
 
 
@@ -246,6 +259,31 @@ def run_smoke() -> int:
     r = compare_bench(other, docs)
     check("device-mismatch skips", r["ok"] and any(
         x["status"] == "skipped" for x in r["legs"]))
+
+    # decode leg (synthetic until a BENCH_r* capture carries it): the
+    # generic noise-aware gate applies, plus the speedup-collapse rule
+    decode_leg = {
+        "metric": "llama_decode_tokens_per_sec_per_chip",
+        "value": 2500.0, "unit": "tokens/sec/chip",
+        "device_kind": "cpu",
+        "stats": {"rounds": 3, "median": 2500.0, "p10": 2300.0,
+                  "p90": 2700.0, "min": 2250.0, "max": 2750.0},
+        "speedup_vs_static": 2.4,
+    }
+    with_decode = json.loads(json.dumps(latest))
+    with_decode.setdefault("legs", {})["llama_decode"] = decode_leg
+    r = compare_bench(with_decode, docs + [with_decode])
+    check("decode self-compare passes", r["ok"],
+          json.dumps([x for x in r["legs"]
+                      if x["status"] == "regression"]))
+    r = compare_bench(_degrade(with_decode, 0.70), docs + [with_decode])
+    check("decode 30%-degraded fails", not r["ok"])
+    collapsed = json.loads(json.dumps(with_decode))
+    collapsed["legs"]["llama_decode"]["speedup_vs_static"] = 0.8
+    r = compare_bench(collapsed, docs + [with_decode])
+    check("decode speedup-collapse fails", not r["ok"] and any(
+        x["status"] == "regression" and "speedup" in x.get("reason", "")
+        for x in r["legs"]))
 
     # op gate on its own committed baseline
     op_base_path = os.path.join(REPO, "tools", "op_bench_baseline.json")

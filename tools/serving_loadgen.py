@@ -31,8 +31,23 @@ Model: ``--model-dir`` (a ``save_inference_model`` export; give per-row
 feed shapes as ``--shape name=d0,d1``) or ``--synthetic`` (an in-process
 MLP — no files needed; ``--hidden/--depth/--feat`` size it).
 
-Used by ``bench.py run_serving`` (the ``legs.serving`` entry) and
-``tests/test_serving.py``.
+**Generation mode** (``--generate``): drives a slot-based
+:class:`paddle_tpu.serving.GenerationEngine` instead of the one-shot
+engine.  Each request draws its prompt length uniformly from
+``[--gen-prompt-min, --gen-prompt-max]`` and its output length from
+``--gen-out-dist`` (**geometric**, or a chat-style 75/25 short/long
+**bimodal** mix; mean ``--gen-out-mean``, clamped to
+``[1, --gen-out-max]``) — the long-tail shape real generation traffic
+has, and exactly the workload where continuous batching beats static
+batch-drain scheduling.  Closed loop measures saturated
+``tokens_per_sec``; open loop (``--mode open``) paces request arrivals
+on the ``--qps`` clock for latency/shed behavior at a target rate.
+``--gen-static`` schedules FIFO head-run (batch drain) instead of
+continuous slot reclaim — the A/B the bench leg publishes.
+
+Used by ``bench.py run_serving``/``run_decode`` (the ``legs.serving``
+and ``legs.llama_decode`` entries), ``tests/test_serving.py``, and
+``tests/test_generation.py``.
 """
 from __future__ import annotations
 
@@ -227,6 +242,190 @@ def run_open_loop(engine, make_feed, qps: float, duration_s: float,
     wall = time.monotonic() - t0
     rep = _report("open", n, counts["ok"], counts["shed"],
                   counts["failed"], wall, lat, engine)
+    rep["target_qps"] = qps
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# generation loops (--generate: drive a GenerationEngine's slot scheduler)
+# ---------------------------------------------------------------------------
+
+def prompt_maker(vocab_size: int, prompt_min: int, prompt_max: int,
+                 out_mean: float, out_max: int, seed: int = 0,
+                 pool: int = 64,
+                 dist: str = "geometric") -> Callable[[int], tuple]:
+    """Deterministic per-request ``(prompt_ids, max_new_tokens)``
+    factory.  Prompt lengths are uniform in [prompt_min, prompt_max];
+    output lengths draw from ``dist`` with mean ``out_mean`` clamped to
+    [1, out_max] — most sequences finish fast, a tail runs long, which
+    is the shape that makes batch-drain scheduling strand slots (host
+    RNG off the timed path: a fixed pool cycled by request index).
+
+    ``dist="geometric"``: memoryless tail; a full slot grid's expected
+    longest draw is only ~2.7x the mean, so the batch-drain penalty it
+    exposes is bounded.  ``dist="bimodal"``: 75% short (mean/8) / 25%
+    long (~3.3x mean, same overall mean) — the chat-style mix where
+    most turns are brief and a quarter run long, driving the grid's
+    longest sequence to ~3.3x the mean (the harsher, more realistic
+    test of slot reclaim)."""
+    rng = np.random.RandomState(seed)
+    reqs = []
+    if dist == "bimodal":
+        p_long = 0.25
+        short = max(1.0, out_mean / 8.0)
+        long_ = (out_mean - (1.0 - p_long) * short) / p_long
+    elif dist != "geometric":
+        raise ValueError(f"unknown output-length dist {dist!r}")
+    for _ in range(pool):
+        plen = int(rng.randint(prompt_min, prompt_max + 1))
+        prompt = rng.randint(1, vocab_size, size=plen).astype("int64")
+        if dist == "bimodal":
+            mean = long_ if rng.random_sample() < p_long else short
+        else:
+            mean = out_mean
+        out_len = int(np.clip(rng.geometric(1.0 / max(mean, 1.0)),
+                              1, out_max))
+        reqs.append((prompt, out_len))
+    return lambda i: reqs[i % len(reqs)]
+
+
+def _gen_report(mode: str, n: int, ok: int, shed: int, failed: int,
+                wall_s: float, lat_ms: List[float], tokens: int,
+                engine) -> dict:
+    rep = _report(mode, n, ok, shed, failed, wall_s, lat_ms, engine)
+    rep["generated_tokens"] = tokens
+    rep["tokens_per_sec"] = round(tokens / wall_s, 2) if wall_s > 0 \
+        else 0.0
+    return rep
+
+
+def run_closed_loop_generate(engine, make_prompt, n_requests: int,
+                             concurrency: int,
+                             timeout_s: float = 120.0) -> dict:
+    """Closed loop against a GenerationEngine: ``concurrency``
+    synchronous callers submit→wait→repeat; the slot grid sees a
+    standing queue, so the measured ``tokens_per_sec`` is the
+    scheduler's saturated decode throughput."""
+    from paddle_tpu.serving import OverloadedError, ServingError
+
+    tickets = iter(range(n_requests))
+    ticket_lock = threading.Lock()
+    lat, lock = [], threading.Lock()
+    counts = {"ok": 0, "shed": 0, "failed": 0, "tokens": 0}
+
+    def caller():
+        while True:
+            with ticket_lock:
+                i = next(tickets, None)
+            if i is None:
+                return
+            prompt, out_len = make_prompt(i)
+            t0 = time.monotonic()
+            try:
+                res = engine.generate(prompt, out_len,
+                                      timeout=timeout_s)
+                ms = (time.monotonic() - t0) * 1e3
+                with lock:
+                    counts["ok"] += 1
+                    counts["tokens"] += len(res["tokens"])
+                    lat.append(ms)
+            except OverloadedError:
+                with lock:
+                    counts["shed"] += 1
+            except (ServingError, TimeoutError, ValueError):
+                # ValueError = a rejected prompt (over-long / bad
+                # dtype): counted as failed, NOT raised — a dead
+                # caller thread would silently undercount the report
+                with lock:
+                    counts["failed"] += 1
+
+    threads = [threading.Thread(target=caller, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    rep = _gen_report("closed", n_requests, counts["ok"],
+                      counts["shed"], counts["failed"], wall, lat,
+                      counts["tokens"], engine)
+    rep["concurrency"] = concurrency
+    return rep
+
+
+def run_open_loop_generate(engine, make_prompt, qps: float,
+                           duration_s: float, timeout_s: float = 120.0,
+                           collectors: int = 8) -> dict:
+    """Open loop against a GenerationEngine: request arrivals on a
+    fixed ``1/qps`` clock regardless of completions (offered load does
+    not back off when the grid saturates — submit-time sheds ARE the
+    overload signal under test); a collector pool stamps
+    completions."""
+    from paddle_tpu.serving import OverloadedError, ServingError
+
+    lat, lock = [], threading.Lock()
+    counts = {"ok": 0, "shed": 0, "failed": 0, "tokens": 0}
+    pending: queue_mod.Queue = queue_mod.Queue()
+
+    def collector():
+        while True:
+            item = pending.get()
+            if item is None:
+                return
+            fut, t0 = item
+            try:
+                res = fut.result(timeout_s)
+                ms = (time.monotonic() - t0) * 1e3
+                with lock:
+                    counts["ok"] += 1
+                    counts["tokens"] += len(res["tokens"])
+                    lat.append(ms)
+            except OverloadedError:
+                with lock:
+                    counts["shed"] += 1
+            except (ServingError, TimeoutError):
+                with lock:
+                    counts["failed"] += 1
+
+    pool = [threading.Thread(target=collector, daemon=True)
+            for _ in range(collectors)]
+    for t in pool:
+        t.start()
+
+    period = 1.0 / qps
+    n = 0
+    t0 = time.monotonic()
+    end = t0 + duration_s
+    next_at = t0
+    while True:
+        now = time.monotonic()
+        if now >= end:
+            break
+        if now < next_at:
+            time.sleep(min(next_at - now, 0.01))
+            continue
+        next_at += period
+        prompt, out_len = make_prompt(n)
+        n += 1
+        try:
+            fut = engine.submit(prompt, out_len)
+            pending.put((fut, now))
+        except OverloadedError:
+            with lock:
+                counts["shed"] += 1
+        except ValueError:
+            # rejected prompt: failed, not a crash of the arrival loop
+            with lock:
+                counts["failed"] += 1
+    for _ in pool:
+        pending.put(None)
+    for t in pool:
+        t.join()
+    wall = time.monotonic() - t0
+    rep = _gen_report("open", n, counts["ok"], counts["shed"],
+                      counts["failed"], wall, lat, counts["tokens"],
+                      engine)
     rep["target_qps"] = qps
     return rep
 
@@ -455,6 +654,34 @@ def main(argv=None) -> int:
     ap.add_argument("--max-delay-ms", type=float, default=None)
     ap.add_argument("--queue-cap", type=int, default=None)
     ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--generate", action="store_true",
+                    help="drive a slot-based GenerationEngine "
+                         "(autoregressive decode) instead of the "
+                         "one-shot engine; --gen-* flags size it")
+    ap.add_argument("--gen-vocab", type=int, default=128)
+    ap.add_argument("--gen-hidden", type=int, default=64)
+    ap.add_argument("--gen-layers", type=int, default=2)
+    ap.add_argument("--gen-heads", type=int, default=4)
+    ap.add_argument("--gen-kv-heads", type=int, default=None)
+    ap.add_argument("--gen-intermediate", type=int, default=128)
+    ap.add_argument("--gen-slots", type=int, default=4,
+                    help="decode-slot grid size")
+    ap.add_argument("--gen-max-seq", type=int, default=64,
+                    help="per-slot KV-cache capacity")
+    ap.add_argument("--gen-prompt-min", type=int, default=4)
+    ap.add_argument("--gen-prompt-max", type=int, default=16)
+    ap.add_argument("--gen-out-mean", type=float, default=8.0,
+                    help="mean of the output-length distribution")
+    ap.add_argument("--gen-out-max", type=int, default=32,
+                    help="per-request output-length clamp")
+    ap.add_argument("--gen-out-dist", choices=("geometric", "bimodal"),
+                    default="geometric",
+                    help="output-length distribution: memoryless "
+                         "geometric, or a 75/25 short/long chat-style "
+                         "mix at the same mean (heavier tail)")
+    ap.add_argument("--gen-static", action="store_true",
+                    help="FIFO head-run (batch drain) scheduling "
+                         "instead of continuous slot reclaim")
     ap.add_argument("--out", help="also write the JSON report here")
     ap.add_argument("--slo-p99-ms", type=float, default=None,
                     help="assert p99 latency <= this (ms); violation "
@@ -499,6 +726,45 @@ def main(argv=None) -> int:
         else:
             report = run_open_loop_http(args.url, make_feed, args.qps,
                                         args.duration)
+        return finish(report)
+
+    if args.generate:
+        from paddle_tpu.serving import GenerationEngine
+
+        model = dict(vocab_size=args.gen_vocab, hidden=args.gen_hidden,
+                     num_layers=args.gen_layers, num_heads=args.gen_heads,
+                     num_kv_heads=args.gen_kv_heads,
+                     intermediate=args.gen_intermediate)
+        gen = GenerationEngine(
+            model, num_slots=args.gen_slots, max_seq_len=args.gen_max_seq,
+            max_new_tokens=args.gen_out_max,
+            continuous=not args.gen_static,
+            queue_cap=args.queue_cap or 4 * args.requests,
+            deadline_ms=args.deadline_ms or 600000.0)
+        gen.warmup()
+        make_prompt = prompt_maker(args.gen_vocab, args.gen_prompt_min,
+                                   min(args.gen_prompt_max,
+                                       gen.max_prompt_len),
+                                   args.gen_out_mean, args.gen_out_max,
+                                   dist=args.gen_out_dist)
+        try:
+            if args.mode == "both":
+                report = {"mode": "both",
+                          "closed": run_closed_loop_generate(
+                              gen, make_prompt, args.requests,
+                              args.concurrency),
+                          "open": run_open_loop_generate(
+                              gen, make_prompt, args.qps,
+                              args.duration)}
+            elif args.mode == "closed":
+                report = run_closed_loop_generate(gen, make_prompt,
+                                                  args.requests,
+                                                  args.concurrency)
+            else:
+                report = run_open_loop_generate(gen, make_prompt,
+                                                args.qps, args.duration)
+        finally:
+            gen.close()
         return finish(report)
 
     from paddle_tpu.serving import ServingEngine
