@@ -196,6 +196,21 @@ register_flag("FLAGS_profilez_sec", 2.0,
               "default duration (seconds) of an on-demand profiler "
               "capture (GET /profilez, TrainGuard SIGUSR2); capped at "
               "60s per capture")
+register_flag("FLAGS_serving_mesh", "",
+              "sharded-serving topology spec for ReplicaGroupEngine "
+              "(paddle_tpu/serving/sharded.py): 'dp=4,mp=2' makes 4 "
+              "replica groups of 2-device weight-sharded sub-meshes; "
+              "dp multiplies throughput, mp divides a too-big model's "
+              "dense weights across a group (ep shards what mp "
+              "doesn't divide, e.g. expert tables).  Explicit "
+              "constructor kwargs win over the flag; empty = "
+              "unsharded")
+register_flag("FLAGS_serving_group_degraded_after", 3,
+              "sharded serving: a replica group (engine worker) whose "
+              "batches failed this many times CONSECUTIVELY reports "
+              "status 'degraded' in /healthz and /statusz (it keeps "
+              "pulling work — one success resets the streak); the "
+              "engine-level status degrades with it")
 register_flag("FLAGS_serving_access_log", "",
               "path of the serving JSONL access log (one line per HTTP "
               "request: trace_id, status, per-phase latency breakdown); "

@@ -202,7 +202,11 @@ class Predictor:
         Returns the number of signatures compiled now (already-cached
         ones are free).  The serving engine uses this to warm every
         batch bucket at startup; direct users call it to move the
-        first-request latency spike out of the serving path."""
+        first-request latency spike out of the serving path.  Priming
+        goes through :meth:`_compiled_for` and the compiled call, so a
+        mesh-partitioned subclass warms every bucket ON ITS MESH (the
+        zero feeds flow through the executable's input shardings), not
+        just device 0."""
         if isinstance(feed_shapes, dict):
             feed_shapes = [feed_shapes]
         compiled = 0
@@ -244,11 +248,21 @@ class Predictor:
                               for s, m in sorted(entries,
                                                  key=lambda x: str(x[0]))}}
 
+    def _clone_kwargs(self) -> dict:
+        """Extra constructor kwargs a clone must inherit.  Subclasses
+        with placement state (the mesh-partitioned ShardedPredictor)
+        override this so ``clone()`` reproduces their device placement
+        instead of silently degrading to single-device."""
+        return {}
+
     def clone(self) -> "Predictor":
         """Shared-weight clone (zero-copy: same scope arrays), private
-        compile cache — the reference Clone() contract."""
-        p = Predictor(self.program, self.feed_names, self.fetch_names,
-                      scope=self.scope)
+        compile cache — the reference Clone() contract.  Mesh-aware:
+        constructs ``type(self)`` with :meth:`_clone_kwargs`, so a
+        sharded predictor's clone shares its sharded executables and
+        mesh-placed device weights rather than re-assuming device 0."""
+        p = type(self)(self.program, self.feed_names, self.fetch_names,
+                       scope=self.scope, **self._clone_kwargs())
         return p
 
     # -- export -------------------------------------------------------------

@@ -9,7 +9,8 @@ parameters, and XLA inserting the collectives over ICI. This package keeps
 the reference's *API surface* (CompiledProgram, fleet.init,
 DistributedStrategy…) on top of that compilation model.
 """
-from .mesh import make_mesh, dp_mesh, MeshConfig  # noqa
+from .mesh import (make_mesh, dp_mesh, MeshConfig,  # noqa
+                   parse_mesh_spec, axis_size)
 from .sharded import (ShardingRules, data_parallel_rules,  # noqa
                       megatron_rules, build_sharded_step,
                       build_sharded_multistep)

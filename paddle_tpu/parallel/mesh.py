@@ -7,6 +7,7 @@ c_gen_nccl_id/c_comm_init ops): a ring_id becomes a *named mesh axis*, and
 """
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Sequence, Tuple
 
@@ -64,6 +65,47 @@ class MeshConfig:
         axes = {DP_AXIS: dp, MP_AXIS: self.mp, PP_AXIS: self.pp,
                 SP_AXIS: self.sp, EP_AXIS: self.ep}
         return {k: v for k, v in axes.items() if v > 1} or {DP_AXIS: dp}
+
+
+def parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """Parse a serving-mesh topology spec string into ``{axis: size}``.
+
+    Accepts ``"dp=4,mp=2"`` / ``"dp4,mp2"`` / ``"dp=4"`` (axes from the
+    canonical set above; size >= 1; sizes of 1 are kept — the caller
+    decides whether a trivial axis still materializes in the Mesh).
+    The empty string parses to ``{}`` (no mesh configured)."""
+    axes: Dict[str, int] = {}
+    known = (DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS, EP_AXIS)
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, size = part.partition("=")
+        if not size:  # "dp4" form
+            m = re.match(r"([a-z]+)(\d+)$", part)
+            if not m:
+                raise ValueError(f"bad mesh spec entry {part!r}; expected "
+                                 f"'axis=N' or 'axisN' (axes: {known})")
+            name, size = m.group(1), m.group(2)
+        name = name.strip()
+        if name not in known:
+            raise ValueError(f"unknown mesh axis {name!r} in spec "
+                             f"{spec!r}; known axes: {known}")
+        n = int(size)
+        if n < 1:
+            raise ValueError(f"mesh axis {name}={n} must be >= 1")
+        axes[name] = n
+    return axes
+
+
+def axis_size(mesh, *axes: str) -> int:
+    """Product of the sizes of the given axes present in ``mesh``
+    (absent axes count as 1) — e.g. the dp width of a serving mesh."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n = 1
+    for a in axes:
+        n *= int(sizes.get(a, 1))
+    return n
 
 
 def make_mesh(axis_sizes: Dict[str, int] = None, devices=None, **kw):

@@ -24,7 +24,10 @@ from .engine import (OverloadedError, RequestFailed, ServingEngine,  # noqa
                      ServingError, ServingFuture)
 from .generation import GenerationEngine  # noqa
 from .server import ServingServer, serve  # noqa
+from .sharded import (ReplicaGroupEngine, ShardedPredictor,  # noqa
+                      serving_shard_rules)
 
 __all__ = ["ServingEngine", "ServingError", "OverloadedError",
            "RequestFailed", "ServingFuture", "ServingServer", "serve",
-           "GenerationEngine", "batcher"]
+           "GenerationEngine", "batcher", "ReplicaGroupEngine",
+           "ShardedPredictor", "serving_shard_rules"]

@@ -48,7 +48,11 @@ batch's requests error, the engine keeps serving).
 Stats (README catalog): counters ``serving_requests``,
 ``serving_requests_shed``, ``serving_batches``,
 ``serving_batch_exact_bucket``, ``serving_batch_failures``,
-``serving_pad_rows``, ``serving_no_sigterm``; gauges
+``serving_pad_rows``, ``serving_no_sigterm``,
+``serving_sharded_batches`` / ``serving_sharded_batch_failures``
+(mesh-placed pools only, plus dynamic per-device ``_dev<i>``
+siblings); gauge ``serving_groups_degraded`` (workers past the
+``FLAGS_serving_group_degraded_after`` failure streak); gauges
 ``serving_queue_depth`` (refreshed at every enqueue AND dequeue),
 ``serving_queue_depth_peak`` (high watermark — bursty peaks that a
 publish-time sample misses), ``serving_bucket_hit_rate``; histograms
@@ -182,14 +186,22 @@ class ServingEngine:
                  queue_cap: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
                  warmup_shapes=None, autostart: bool = True,
-                 share_executables: bool = True):
+                 share_executables: bool = True,
+                 pool: Optional[List] = None):
         from ..inference import Predictor
 
         if not isinstance(predictor, Predictor):
             predictor = Predictor(predictor)
         self._base = predictor
-        self.workers = int(workers if workers is not None
-                           else flag_value("FLAGS_serving_workers") or 1)
+        if pool is not None:
+            # explicit worker pool (one dispatch thread per entry): the
+            # sharded ReplicaGroupEngine passes one mesh-placed
+            # ShardedPredictor per dp replica group
+            self.workers = len(pool)
+        else:
+            self.workers = int(workers if workers is not None
+                               else flag_value("FLAGS_serving_workers")
+                               or 1)
         self.max_batch = int(max_batch if max_batch is not None
                              else flag_value("FLAGS_serving_max_batch"))
         self.buckets = batcher.bucket_sizes(self.max_batch)
@@ -216,10 +228,30 @@ class ServingEngine:
         # ONCE instead of once per worker and holds one copy of every
         # executable.  False restores fully private per-worker clones
         # (isolated compile caches; the reference Clone() shape).
-        if share_executables:
+        if pool is not None:
+            self._pool = list(pool)
+        elif share_executables:
             self._pool = [predictor.clone()] * self.workers
         else:
             self._pool = [predictor.clone() for _ in range(self.workers)]
+
+        # per-worker health (per replica GROUP when the pool is one
+        # sharded predictor per group): last-batch status, consecutive
+        # failure streak, degraded flag.  Mutated under _n_lock; the
+        # degraded threshold makes one poisoned group VISIBLE
+        # (/healthz, /statusz) without stopping it or its siblings.
+        self.degraded_after = max(1, int(
+            flag_value("FLAGS_serving_group_degraded_after") or 1))
+        self._health = [{"worker": i, "batches": 0, "failures": 0,
+                         "consecutive_failures": 0, "degraded": False,
+                         "in_flight_rows": 0, "rows_total": 0,
+                         "last_batch": None}
+                        for i in range(self.workers)]
+        # per-worker batch-latency histograms (engine-local, like
+        # _h_request): per replica GROUP p50/p99 for worker_health —
+        # a slow shard set shows up HERE, not averaged away engine-wide
+        self._h_worker = [telemetry.Histogram("serving_group_predict_ms")
+                          for _ in range(self.workers)]
 
         # engine-local tallies (isolated from the process-global monitor,
         # which other subsystems and tests also bump) + mirrored global
@@ -297,7 +329,7 @@ class ServingEngine:
         if self._threads:
             return
         for i, p in enumerate(self._pool):
-            t = threading.Thread(target=self._worker_loop, args=(p,),
+            t = threading.Thread(target=self._worker_loop, args=(i, p),
                                  name=f"serving-worker-{i}", daemon=True)
             t.start()
             self._threads.append(t)
@@ -673,15 +705,52 @@ class ServingEngine:
                                         trace_id=req.trace_id)
         return batch
 
-    def _worker_loop(self, predictor):
+    def _worker_loop(self, widx, predictor):
         while True:
             batch = self._next_batch()
             if batch is None:
                 return
-            self._run_batch(predictor, batch)
+            self._run_batch(predictor, batch, widx)
 
-    def _run_batch(self, predictor, batch: List[_Request]):
+    def _book_worker(self, widx: int, predictor, ok: bool, rows: int,
+                     predict_ms: Optional[float] = None):
+        """Per-worker (= per replica group) health bookkeeping after a
+        batch: failure streaks flip the group to ``degraded`` at the
+        threshold, one success clears it.  Sharded predictors also get
+        per-device ``_dev<i>`` attribution (PR-6 convention)."""
+        if predict_ms is not None:
+            self._h_worker[widx].observe(predict_ms)
+        h = self._health[widx]
+        with self._n_lock:
+            h["batches"] += 1
+            h["rows_total"] += rows
+            if ok:
+                h["consecutive_failures"] = 0
+            else:
+                h["failures"] += 1
+                h["consecutive_failures"] += 1
+            h["degraded"] = \
+                h["consecutive_failures"] >= self.degraded_after
+            h["last_batch"] = {"status": "ok" if ok else "failed",
+                               "rows": rows,
+                               "ts": round(time.time(), 3)}
+            degraded = sum(1 for x in self._health if x["degraded"])
+        if telemetry.enabled():
+            telemetry.gauge_set("serving_groups_degraded", degraded)
+        device_ids = getattr(predictor, "device_ids", None)
+        if device_ids is not None:
+            name = ("serving_sharded_batches" if ok
+                    else "serving_sharded_batch_failures")
+            stat_add(name)
+            for d in device_ids():
+                # dynamic _dev<i> siblings: catalog-exempt by convention
+                stat_add(f"{name}_dev{d}")
+
+    def _run_batch(self, predictor, batch: List[_Request],
+                   widx: int = 0):
         rows = sum(r.rows for r in batch)
+        with self._n_lock:
+            self._health[widx]["in_flight_rows"] = rows
         bucket = batcher.bucket_for(rows, self.buckets)
         t_run0 = time.monotonic()
         pspans = []
@@ -721,6 +790,7 @@ class ServingEngine:
             now = time.monotonic()
             predict_ms = (now - t_run0) * 1e3
             self._count("served", len(batch))
+            self._book_worker(widx, predictor, True, rows, predict_ms)
             for req, outputs in zip(batch, per_req):
                 rs = None
                 if req.root is not None:
@@ -743,6 +813,8 @@ class ServingEngine:
             for ps in pspans:
                 telemetry.span_end(ps)
             self._count("batch_failures")
+            self._book_worker(widx, predictor, False, rows,
+                              (time.monotonic() - t_run0) * 1e3)
             stat_add("serving_batch_failures")
             logger.warning("serving batch of %d request(s) failed: %s",
                            len(batch), e)
@@ -758,6 +830,9 @@ class ServingEngine:
                 req.future.trace = self._trace_finish(req, "failed",
                                                       predict_ms)
                 req.future._resolve(error=err)
+        finally:
+            with self._n_lock:
+                self._health[widx]["in_flight_rows"] = 0
 
     def _run_chunked(self, predictor, req: _Request) -> List[np.ndarray]:
         chunks = []
@@ -790,6 +865,38 @@ class ServingEngine:
         telemetry.gauge_set("serving_bucket_hit_rate", hit)
 
     # -- introspection ------------------------------------------------------
+    def worker_health(self) -> List[dict]:
+        """Per-worker (= per replica group under sharded serving)
+        health: batch/failure tallies, the failure streak and its
+        ``degraded`` verdict, rows currently in flight, the last
+        batch's status, the group's own batch-latency summary
+        (``predict_ms`` — a slow shard set shows HERE, not averaged
+        away engine-wide) and mean batch fill (``avg_batch_rows``) —
+        plus, for mesh-placed predictors, the group's mesh axes,
+        device ids, and any shards missing from the live device set.
+        ``status`` is ``ok | degraded | missing_shards`` (missing
+        shards win: a group whose devices vanished cannot serve at
+        all, degraded or not)."""
+        with self._n_lock:
+            snap = [dict(h, last_batch=dict(h["last_batch"])
+                         if h["last_batch"] else None)
+                    for h in self._health]
+        for i, h in enumerate(snap):
+            h["predict_ms"] = self._h_worker[i].summary()
+            h["avg_batch_rows"] = round(
+                h["rows_total"] / max(h["batches"], 1), 2)
+        for h, p in zip(snap, self._pool):
+            placement = getattr(p, "placement", None)
+            if placement is not None:
+                h.update(placement())
+            h["status"] = ("missing_shards" if h.get("missing_shards")
+                           else "degraded" if h["degraded"] else "ok")
+        return snap
+
+    def groups_degraded(self) -> int:
+        with self._n_lock:
+            return sum(1 for h in self._health if h["degraded"])
+
     def stats(self) -> dict:
         """Engine-local serving stats (isolated from the process-global
         monitor): counters, latency/wait/fill histogram summaries,
@@ -807,6 +914,7 @@ class ServingEngine:
             "buckets": list(self.buckets),
             "draining": self._draining,
             "counters": n,
+            "groups_degraded": self.groups_degraded(),
             "bucket_hit_rate": round(
                 n["exact_bucket"] / max(n["batches"], 1), 4),
             "shed_rate": round(n["shed"] / max(n["requests"], 1), 4),
@@ -847,6 +955,7 @@ class ServingEngine:
                 time.time() - process_start_time(), 3),
             "executables": [p.cache_info()
                             for p in dict.fromkeys(self._pool)],
+            "groups": self.worker_health(),
             "traces": traces,
         }
         if self.generator is not None:
@@ -859,7 +968,15 @@ class ServingEngine:
         uptime, jax live-buffer memory)."""
         from ..telemetry import _device_memory
 
-        status = "draining" if self._draining else "ok"
+        groups = self.worker_health()
+        status = "ok"
+        if any(g["status"] != "ok" for g in groups):
+            # a degraded / shard-missing group: still serving (the
+            # other groups are healthy), but a balancer and an operator
+            # must see the damage
+            status = "degraded"
+        if self._draining:
+            status = "draining"
         if self._closed:
             status = "closed"
         out = {
@@ -869,6 +986,7 @@ class ServingEngine:
             "uptime_s": round(time.time() - self._started, 3),
             "device_memory": _device_memory(),
             "serving": self.stats(),
+            "groups": groups,
         }
         if self.generator is not None:
             out["generation"] = self.generator.stats()

@@ -57,6 +57,7 @@ from ..flags import flag_value
 from ..monitor import stat_add
 from . import batcher
 from .engine import OverloadedError, RequestFailed, ServingFuture
+from .sharded import describe_mesh as _describe_mesh
 
 __all__ = ["GenerationEngine", "GenRequest"]
 
@@ -122,7 +123,8 @@ class GenerationEngine:
                  max_seq_len=None, prefill_buckets=None, eos_id=-1,
                  max_new_tokens=None, queue_cap=None, deadline_ms=None,
                  continuous=True, autostart=True, name="llama",
-                 attn_impl="auto", seed=0, keep_logits=False):
+                 attn_impl="auto", seed=0, keep_logits=False,
+                 mesh=None, shard_rules=None):
         import paddle_tpu as pt
         from ..models.llama import build_llama_decode, build_llama_prefill
 
@@ -173,7 +175,16 @@ class GenerationEngine:
         self._decode_exe = pt.Executor()
         self._prefill_progs: Dict[int, tuple] = {}  # bucket -> (prog, fetches)
         self.scope = scope if scope is not None else pt.Scope()
+        # mesh-partitioned decode: weights shard per `shard_rules`
+        # (default serving_shard_rules — mp/ep last-dim splits) and the
+        # per-slot KV caches shard over mp on the kv-head dim.  The
+        # executor needs no mesh plumbing: committed NamedSharding
+        # placements on the scope arrays drive GSPMD at jit time, and
+        # the donated cache buffers stay sharded in place across steps.
+        self.mesh = mesh
         self._build_decode(scope_ready=scope is not None)
+        if mesh is not None:
+            self._place_on_mesh(shard_rules)
         self._init_caches()
 
         # scheduler state
@@ -220,17 +231,53 @@ class GenerationEngine:
             # parameter, so one startup run initializes the full set
             self._prefill_exe.run(startup, scope=self.scope)
 
+    def _place_on_mesh(self, shard_rules):
+        """Shard every decode-program weight onto the mesh — once,
+        before the caches exist (the caches get their own kv-head
+        placement in :meth:`_init_caches`).  The prefill programs read
+        the same scope, so one placement covers both paths
+        (:func:`~paddle_tpu.serving.sharded.place_block_state`)."""
+        from .sharded import place_block_state, serving_shard_rules
+
+        self._shard_rules = shard_rules or serving_shard_rules(self.mesh)
+        place_block_state(self._decode_prog.global_block(),
+                          self._decode_feeds, self.scope, self.mesh,
+                          self._shard_rules, skip=self.cache_names)
+
+    def _cache_sharding(self):
+        """KV caches [slots, n_kv, S_max, D] shard the kv-head dim over
+        ``mp`` when it divides (each device holds its heads' cache —
+        attention is per-head independent, so the contraction never
+        crosses devices); otherwise replicate."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import MP_AXIS, axis_size
+
+        mp = axis_size(self.mesh, MP_AXIS)
+        if mp > 1 and self._n_kv % mp == 0:
+            return NamedSharding(self.mesh, P(None, MP_AXIS)), MP_AXIS
+        return NamedSharding(self.mesh, P()), None
+
     def _init_caches(self):
+        import jax
         import jax.numpy as jnp
 
         shape = (self.num_slots, self._n_kv, self.max_seq_len,
                  self._head_dim)
+        cache_sh = None
+        self.kv_shard_axis = None
+        if self.mesh is not None:
+            cache_sh, self.kv_shard_axis = self._cache_sharding()
         total = 0
         for n in self.cache_names:
             # one DISTINCT zero buffer per cache: the decode step and
             # the prefill insert donate all caches in one call, and XLA
-            # rejects donating the same buffer twice
-            self.scope.set_var(n, jnp.zeros(shape, jnp.float32).copy())
+            # rejects donating the same buffer twice (device_put also
+            # allocates a fresh buffer per call)
+            zeros = jnp.zeros(shape, jnp.float32)
+            self.scope.set_var(
+                n, jax.device_put(zeros, cache_sh)
+                if cache_sh is not None else zeros.copy())
             total += int(np.prod(shape)) * 4
         self.kv_cache_bytes = total
         telemetry.gauge_set("serving_kv_cache_bytes", total)
@@ -631,6 +678,9 @@ class GenerationEngine:
             "max_seq_len": self.max_seq_len,
             "prefill_buckets": list(self.prefill_buckets),
             "kv_cache_bytes": self.kv_cache_bytes,
+            "mesh": None if self.mesh is None
+            else _describe_mesh(self.mesh),
+            "kv_shard_axis": getattr(self, "kv_shard_axis", None),
             "draining": self._draining,
             "counters": n,
             "tokens_per_request": round(

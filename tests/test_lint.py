@@ -122,6 +122,27 @@ def test_perf_gate_smoke_on_committed_fixtures():
     assert "gate-logic checks passed" in r.stdout
 
 
+def test_every_serving_flag_is_documented_in_readme():
+    """Every registered `FLAGS_serving_*` flag (the sharded-serving
+    mesh/degradation flags included) must appear backtick-quoted in
+    the README flag tables — a serving knob that isn't documented
+    can't be operated, and the sharded topology flags
+    (`FLAGS_serving_mesh`, `FLAGS_serving_group_degraded_after`)
+    change what /healthz reports, so they must never drift
+    undocumented."""
+    from paddle_tpu import flags
+
+    names = sorted(n for n in flags.all_flags()
+                   if n.startswith("FLAGS_serving"))
+    assert "FLAGS_serving_mesh" in names  # the lint must see the new
+    assert "FLAGS_serving_group_degraded_after" in names  # sharded set
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as f:
+        readme = f.read()
+    missing = [n for n in names if f"`{n}`" not in readme]
+    assert not missing, (f"serving flags missing from the README flag "
+                         f"tables: {missing}")
+
+
 # ---------------------------------------------------------------------------
 # strict Prometheus exposition: validator unit + live /metrics scrape
 # ---------------------------------------------------------------------------

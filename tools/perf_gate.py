@@ -95,6 +95,16 @@ def compare_leg(name: str, new: dict, base: dict,
     """One leg's verdict: ``status`` in ``ok | regression | skipped``
     (+ the numbers behind it)."""
     res = {"leg": name}
+    # sharded-serving correctness rule, checked before EVERY skip
+    # (device-kind mismatch, anomalous baseline, anomalous fresh run):
+    # mp=2 weight-sharded serving is bit-exact by construction, so a
+    # False is a regression on any host — core contention or a garbage
+    # baseline can hide throughput, never flip bytes
+    if new.get("mp2_bit_exact") is False:
+        res.update(status="regression",
+                   reason="mp2 weight-sharded serving no longer "
+                          "bit-exact vs the unsharded predictor")
+        return res
     nk, bk = new.get("device_kind"), base.get("device_kind")
     if nk is not None and bk is not None and nk != bk:
         res.update(status="skipped",
@@ -134,6 +144,26 @@ def compare_leg(name: str, new: dict, base: dict,
         res.update(status="regression",
                    reason=f"speedup_vs_static collapsed to {sp_new} "
                           f"(baseline {sp_base})")
+    # sharded-serving extras: the replica-group engine's contract is
+    # dp=4 at >= 2x the single-chip qps AT NO WORSE p99 — raw qps can
+    # keep up (e.g. the single-chip baseline got slower too) while the
+    # dp win quietly collapses, so both ratios gate explicitly when the
+    # baseline proved them on this device kind
+    sg_new = new.get("speedup_vs_single")
+    sg_base = base.get("speedup_vs_single")
+    if res["status"] == "ok" and sg_new is not None \
+            and sg_base is not None and sg_new < 2.0 <= sg_base:
+        res.update(status="regression",
+                   reason=f"speedup_vs_single fell to {sg_new} "
+                          f"(< 2x dp contract; baseline {sg_base})")
+    p99r_new = new.get("p99_vs_single")
+    p99r_base = base.get("p99_vs_single")
+    if res["status"] == "ok" and p99r_new is not None \
+            and p99r_base is not None \
+            and p99r_new > 1.0 + tol >= p99r_base:
+        res.update(status="regression",
+                   reason=f"dp p99 now {p99r_new}x the single-chip "
+                          f"p99 (was {p99r_base}x; tol {tol})")
     return res
 
 
@@ -283,6 +313,71 @@ def run_smoke() -> int:
     r = compare_bench(collapsed, docs + [with_decode])
     check("decode speedup-collapse fails", not r["ok"] and any(
         x["status"] == "regression" and "speedup" in x.get("reason", "")
+        for x in r["legs"]))
+
+    # sharded-serving leg (synthetic capable-host fixture: the 2-core
+    # CI sim flags its own captures anomalous, so the >=2x dp contract
+    # is proven here on fixture numbers): generic noise gate + the
+    # speedup-vs-single floor + the p99 rule + the bit-exactness rule
+    sharded_leg = {
+        "metric": "sharded_serving_dp4_closed_loop_qps",
+        "value": 4000.0, "unit": "requests/sec", "device_kind": "cpu",
+        "n_devices": 8,
+        "stats": {"rounds": 3, "median": 4000.0, "p10": 3800.0,
+                  "p90": 4200.0, "min": 3750.0, "max": 4250.0},
+        "p99_ms": 14.0, "single_qps": 1540.0, "single_p99_ms": 15.0,
+        "speedup_vs_single": 2.6, "p99_vs_single": 0.93,
+        "mp2_bit_exact": True,
+    }
+    with_sharded = json.loads(json.dumps(latest))
+    with_sharded.setdefault("legs", {})["sharded_serving"] = sharded_leg
+    r = compare_bench(with_sharded, docs + [with_sharded])
+    check("sharded self-compare passes", r["ok"],
+          json.dumps([x for x in r["legs"]
+                      if x["status"] == "regression"]))
+    r = compare_bench(_degrade(with_sharded, 0.70),
+                      docs + [with_sharded])
+    check("sharded 30%-degraded fails", not r["ok"])
+    collapsed = json.loads(json.dumps(with_sharded))
+    collapsed["legs"]["sharded_serving"]["speedup_vs_single"] = 1.4
+    r = compare_bench(collapsed, docs + [with_sharded])
+    check("sharded dp-speedup-collapse fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "speedup_vs_single" in x.get("reason", "")
+        for x in r["legs"]))
+    worse_p99 = json.loads(json.dumps(with_sharded))
+    worse_p99["legs"]["sharded_serving"]["p99_vs_single"] = 1.8
+    r = compare_bench(worse_p99, docs + [with_sharded])
+    check("sharded worse-p99 fails", not r["ok"] and any(
+        x["status"] == "regression" and "p99" in x.get("reason", "")
+        for x in r["legs"]))
+    inexact = json.loads(json.dumps(with_sharded))
+    inexact["legs"]["sharded_serving"]["mp2_bit_exact"] = False
+    # an anomaly flag must NOT shield a bit-exactness break
+    inexact["legs"]["sharded_serving"]["anomaly"] = "core-bound host"
+    r = compare_bench(inexact, docs + [with_sharded])
+    check("sharded bit-exactness-break fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "bit-exact" in x.get("reason", "") for x in r["legs"]))
+    # ...nor must an anomalous BASELINE (e.g. every capture from a
+    # core-bound CI host) or a device-kind mismatch shield it
+    anom_base = json.loads(json.dumps(with_sharded))
+    anom_base["legs"]["sharded_serving"]["anomaly"] = "core-bound host"
+    r = compare_bench(inexact, docs + [anom_base])
+    check("sharded bit-exactness-break fails past anomalous baseline",
+          not r["ok"])
+    other_kind = json.loads(json.dumps(inexact))
+    other_kind["legs"]["sharded_serving"]["device_kind"] = "TPU v9000"
+    r = compare_bench(other_kind, docs + [with_sharded])
+    check("sharded bit-exactness-break fails past device mismatch",
+          not r["ok"])
+    core_bound = json.loads(json.dumps(with_sharded))
+    core_bound["legs"]["sharded_serving"]["anomaly"] = \
+        "host has 2 cores for a 8-virtual-device CPU sim"
+    core_bound["legs"]["sharded_serving"]["speedup_vs_single"] = 1.2
+    r = compare_bench(core_bound, docs + [with_sharded])
+    check("sharded core-bound capture skips", r["ok"] and any(
+        x["leg"] == "sharded_serving" and x["status"] == "skipped"
         for x in r["legs"]))
 
     # op gate on its own committed baseline

@@ -31,6 +31,16 @@ Model: ``--model-dir`` (a ``save_inference_model`` export; give per-row
 feed shapes as ``--shape name=d0,d1``) or ``--synthetic`` (an in-process
 MLP — no files needed; ``--hidden/--depth/--feat`` size it).
 
+**Sharded mode** (``--sharded``): drives a mesh-partitioned
+:class:`paddle_tpu.serving.ReplicaGroupEngine` (``--groups``/``--mp``/
+``--ep`` or a ``--mesh "dp=4,mp=2"`` spec).  Every sub-report embeds a
+``groups`` block — per replica group batch/failure tallies, fill,
+predict-latency percentiles, mesh + device ids, and ``status`` (``ok |
+degraded | missing_shards``) — and the SLO check **fails** when any
+group reports non-``ok`` (with ``--url``, group health is read from
+the live ``/statusz`` instead): a load test that passes while a
+replica group is down has measured the wrong capacity.
+
 **Generation mode** (``--generate``): drives a slot-based
 :class:`paddle_tpu.serving.GenerationEngine` instead of the one-shot
 engine.  Each request draws its prompt length uniformly from
@@ -580,12 +590,19 @@ def run_open_loop_http(base_url: str, make_feed, qps: float,
 # ---------------------------------------------------------------------------
 
 def check_slo(report: dict, p99_ms: Optional[float] = None,
-              shed_pct: Optional[float] = None) -> dict:
+              shed_pct: Optional[float] = None,
+              fail_degraded: bool = False) -> dict:
     """Evaluate the SLO against one report (recursing into the nested
     closed/open halves of ``--mode both``).  Returns
     ``{"p99_ms_limit", "shed_pct_limit", "violations": [...], "ok"}``;
     a sub-report with zero completed requests is itself a violation
-    (a fully-shed run must not pass on a vacuous p99)."""
+    (a fully-shed run must not pass on a vacuous p99).  With
+    ``fail_degraded`` (the ``--sharded`` contract) any replica group
+    reporting non-``ok`` status — ``degraded`` failure streak or
+    ``missing_shards`` — in the report's ``groups`` block (or the
+    embedded ``statusz.groups`` when driving a live server) is a
+    violation: a load test that "passed" while a group was down
+    measured the wrong capacity."""
     violations = []
 
     def _one(rep: dict, label: str):
@@ -604,14 +621,30 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
                 violations.append(
                     f"{label}: shed rate {rate * 100.0:.2f}% > SLO "
                     f"{shed_pct}%")
+        if fail_degraded:
+            st = rep.get("statusz") or {}
+            # in-process reports carry `groups` flat; a live /statusz
+            # nests the engine block (statusz.engine.groups)
+            groups = (rep.get("groups") or st.get("groups")
+                      or (st.get("engine") or {}).get("groups") or [])
+            for g in groups:
+                status = g.get("status", "ok")
+                if status != "ok":
+                    violations.append(
+                        f"{label}: replica group {g.get('worker')} "
+                        f"(mesh {g.get('mesh')}, devices "
+                        f"{g.get('devices')}) reports {status}")
 
     if report.get("mode") == "both":
         _one(report["closed"], "closed")
         _one(report["open"], "open")
     else:
         _one(report, report.get("mode", "report"))
-    return {"p99_ms_limit": p99_ms, "shed_pct_limit": shed_pct,
-            "violations": violations, "ok": not violations}
+    out = {"p99_ms_limit": p99_ms, "shed_pct_limit": shed_pct,
+           "violations": violations, "ok": not violations}
+    if fail_degraded:
+        out["fail_degraded"] = True
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -654,6 +687,25 @@ def main(argv=None) -> int:
     ap.add_argument("--max-delay-ms", type=float, default=None)
     ap.add_argument("--queue-cap", type=int, default=None)
     ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--sharded", action="store_true",
+                    help="drive a mesh-partitioned ReplicaGroupEngine "
+                         "(paddle_tpu/serving/sharded.py) instead of "
+                         "the single-chip pool; --groups/--mp/--ep/"
+                         "--mesh size the topology (default: fill the "
+                         "device set with 1-device groups).  The "
+                         "report embeds per-group health and the SLO "
+                         "check FAILS when any replica group reports "
+                         "degraded or missing shards — with --url, the "
+                         "group health comes from the live /statusz")
+    ap.add_argument("--groups", type=int, default=None,
+                    help="dp replica-group count (sharded mode)")
+    ap.add_argument("--mp", type=int, default=None,
+                    help="model-parallel width per group (sharded)")
+    ap.add_argument("--ep", type=int, default=None,
+                    help="expert-parallel width per group (sharded)")
+    ap.add_argument("--mesh", default=None, metavar="dp=4,mp=2",
+                    help="serving-mesh spec (sharded mode; explicit "
+                         "--groups/--mp/--ep win)")
     ap.add_argument("--generate", action="store_true",
                     help="drive a slot-based GenerationEngine "
                          "(autoregressive decode) instead of the "
@@ -690,11 +742,19 @@ def main(argv=None) -> int:
                     help="assert shed rate <= this (percent); "
                          "violation exits 1")
     args = ap.parse_args(argv)
+    if args.sharded and args.generate:
+        # the generate branch would silently drive a plain single-mesh
+        # GenerationEngine while the report claimed a sharded health
+        # check ran — refuse instead (GenerationEngine(mesh=...) is the
+        # in-process API for mesh-partitioned generation)
+        ap.error("--sharded cannot combine with --generate")
 
     def finish(report: dict) -> int:
         rc = 0
-        if args.slo_p99_ms is not None or args.slo_shed_pct is not None:
-            slo = check_slo(report, args.slo_p99_ms, args.slo_shed_pct)
+        if args.slo_p99_ms is not None or args.slo_shed_pct is not None \
+                or args.sharded:
+            slo = check_slo(report, args.slo_p99_ms, args.slo_shed_pct,
+                            fail_degraded=args.sharded)
             report["slo"] = slo
             if not slo["ok"]:
                 for v in slo["violations"]:
@@ -778,28 +838,47 @@ def main(argv=None) -> int:
     else:
         predictor, shapes = build_synthetic(args.feat, args.hidden,
                                             args.depth)
-    engine = ServingEngine(predictor, workers=args.workers,
-                           max_batch=args.max_batch,
-                           max_delay_ms=args.max_delay_ms,
-                           queue_cap=args.queue_cap,
-                           deadline_ms=args.deadline_ms,
-                           warmup_shapes=shapes)
+    engine_kw = dict(max_batch=args.max_batch,
+                     max_delay_ms=args.max_delay_ms,
+                     queue_cap=args.queue_cap,
+                     deadline_ms=args.deadline_ms,
+                     warmup_shapes=shapes)
+    if args.sharded:
+        from paddle_tpu.serving import ReplicaGroupEngine
+        engine = ReplicaGroupEngine(predictor, groups=args.groups,
+                                    mp=args.mp, ep=args.ep,
+                                    mesh_spec=args.mesh, **engine_kw)
+    else:
+        engine = ServingEngine(predictor, workers=args.workers,
+                               **engine_kw)
     make_feed = feed_maker(shapes, rows=args.rows)
+
+    def _with_groups(rep: dict) -> dict:
+        # --sharded report block: per-group health captured while the
+        # engine is live (check_slo reads it for the degraded gate)
+        if args.sharded:
+            rep["groups"] = engine.worker_health()
+            rep["replica_groups"] = engine.introspect()["replica_groups"]
+        return rep
 
     try:
         if args.mode == "both":
             report = {"mode": "both",
-                      "closed": run_closed_loop(engine, make_feed,
-                                                args.requests,
-                                                args.concurrency),
-                      "open": run_open_loop(engine, make_feed, args.qps,
-                                            args.duration)}
+                      "closed": _with_groups(
+                          run_closed_loop(engine, make_feed,
+                                          args.requests,
+                                          args.concurrency)),
+                      "open": _with_groups(
+                          run_open_loop(engine, make_feed, args.qps,
+                                        args.duration))}
         elif args.mode == "closed":
-            report = run_closed_loop(engine, make_feed, args.requests,
-                                     args.concurrency)
+            report = _with_groups(
+                run_closed_loop(engine, make_feed, args.requests,
+                                args.concurrency))
         else:
-            report = run_open_loop(engine, make_feed, args.qps,
-                                   args.duration)
+            report = _with_groups(
+                run_open_loop(engine, make_feed, args.qps,
+                              args.duration))
     finally:
         engine.close()
 
