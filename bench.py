@@ -1018,6 +1018,213 @@ def _group_summaries(health):
 
 
 # ---------------------------------------------------------------------------
+# Router leg: fleet front-end scaling + rolling-restart availability
+# ---------------------------------------------------------------------------
+
+def run_router():
+    """Fleet-router leg (`legs.router`): closed-loop qps through the
+    router tier at N=1/2/4 replica server PROCESSES vs the busiest
+    replica driven direct (no router hop — the hop's overhead is the
+    N=1 delta), plus a **rolling-restart availability pass**: open-loop
+    traffic runs through the router while `FleetSupervisor.
+    rolling_restart()` drains and replaces every replica one at a
+    time — the pass publishes served/shed/failed counts and the
+    perf gate fails any capture with a non-shed failure in the
+    window.  Replica processes spawn via the fleet supervisor
+    (stable ports, warmup-gated readiness), so the measured scaling
+    includes real process/socket costs, not thread-pool costs.
+    On hosts with fewer cores than replicas the sweep is core-bound
+    and the leg flags `anomaly` (honestly measured, not gated).
+    Sized by BENCH_ROUTER_{FEAT,HIDDEN,DEPTH,REQUESTS,MAX_BATCH,
+    ROUNDS,REPLICAS}."""
+    import threading
+
+    import jax
+
+    from paddle_tpu.serving import FleetSupervisor, Router, RouterServer
+
+    lg = _load_serving_loadgen()
+    env = os.environ.get
+    feat = int(env("BENCH_ROUTER_FEAT", "64"))
+    hidden = int(env("BENCH_ROUTER_HIDDEN", "256"))
+    depth = int(env("BENCH_ROUTER_DEPTH", "2"))
+    n_req = int(env("BENCH_ROUTER_REQUESTS", "192"))
+    max_batch = int(env("BENCH_ROUTER_MAX_BATCH", "8"))
+    rounds = int(env("BENCH_ROUTER_ROUNDS", "3"))
+    n_list = tuple(int(x) for x in
+                   env("BENCH_ROUTER_REPLICAS", "1,2,4").split(","))
+    n_max = max(n_list)
+
+    make_feed = lg.feed_maker({"x": (feat,)}, rows=1)
+    fleet = FleetSupervisor(
+        replicas=n_max,
+        replica_argv=["--feat", str(feat), "--hidden", str(hidden),
+                      "--depth", str(depth),
+                      "--max-batch", str(max_batch),
+                      "--max-delay-ms", "2.0",
+                      "--queue-cap", str(4 * n_req),
+                      "--deadline-ms", "60000"])
+    try:
+        urls = fleet.wait_ready(timeout_s=300)
+
+        # direct single-replica baseline: the router hop removed
+        direct_reps = [lg.run_closed_loop_http(
+            urls[0], make_feed, n_req, concurrency=2 * max_batch)
+            for _ in range(rounds)]
+        direct_qps = float(np.median([r["qps"] for r in direct_reps]))
+        direct_p99 = float(np.median(
+            [r["latency_ms"].get("p99") or 0.0 for r in direct_reps]))
+
+        sweep = {}
+        for n in n_list:
+            router = Router(urls[:n], poll_interval_ms=100.0)
+            server = RouterServer(router).start()
+            try:
+                router.poll_once()
+                reps = [lg.run_closed_loop_http(
+                    server.url, make_feed, n_req,
+                    concurrency=2 * max_batch * n)
+                    for _ in range(rounds)]
+            finally:
+                server.close()
+            qps = [r["qps"] for r in reps]
+            sweep[str(n)] = {
+                "replicas": n,
+                "qps_median": round(float(np.median(qps)), 2),
+                "qps_rounds": [round(q, 2) for q in qps],
+                "p99_ms": float(np.median(
+                    [r["latency_ms"].get("p99") or 0.0 for r in reps])),
+                "failed": int(sum(r["failed"] for r in reps)),
+            }
+
+        # rolling-restart availability: open-loop traffic through the
+        # router across the WHOLE rollout window (back-to-back windows
+        # until rolling_restart returns — a fixed duration could end
+        # before a slow host finishes rolling and the tail of the
+        # rollout would see no offered load, passing the zero-failure
+        # contract vacuously); non-shed failures must be zero (gated
+        # by tools/perf_gate.py)
+        router = Router(urls, poll_interval_ms=100.0)
+        server = RouterServer(router).start()
+        rollout_rep = {}
+        try:
+            router.poll_once()
+            target_qps = max(sweep[str(n_max)]["qps_median"] * 0.4, 20.0)
+            window_s = float(env("BENCH_ROUTER_ROLLOUT_S", "10"))
+            box = {"reps": [], "error": None, "last_end": None}
+            stop = threading.Event()
+
+            def _traffic():
+                try:
+                    while not stop.is_set():
+                        box["reps"].append(lg.run_open_loop_http(
+                            server.url, make_feed, qps=target_qps,
+                            duration_s=window_s))
+                        box["last_end"] = time.perf_counter()
+                except Exception as e:  # noqa: BLE001 — recorded as
+                    # a coverage failure below, never swallowed
+                    box["error"] = f"{type(e).__name__}: {e}"
+
+            t = threading.Thread(target=_traffic, daemon=True)
+            t.start()
+            time.sleep(0.5)  # traffic flowing before the rollout
+            t_roll0 = time.perf_counter()
+            fleet.rolling_restart(ready_timeout_s=180)
+            t_roll1 = time.perf_counter()
+            roll_s = t_roll1 - t_roll0
+            stop.set()
+            t.join(timeout=window_s + 60.0)
+            reps = box["reps"]
+            # covered: the traffic loop was still producing windows
+            # when the rollout finished (its final window necessarily
+            # ends after stop is set, i.e. after t_roll1)
+            covered = (reps and box["error"] is None
+                       and not t.is_alive()
+                       and box["last_end"] is not None
+                       and box["last_end"] >= t_roll1)
+            if not covered:
+                # the window measured NOTHING (or not the whole
+                # rollout) — failed stays None, which the perf gate
+                # treats as a regression (a vacuous pass must not
+                # satisfy the zero-failure contract)
+                rollout_rep = {
+                    "requests": None, "ok": None, "shed": None,
+                    "failed": None,
+                    "error": box["error"]
+                    or "rollout traffic did not cover the window",
+                    "rollout_s": round(roll_s, 3),
+                    "windows": len(reps),
+                }
+            else:
+                def _tot(key):
+                    return int(sum(r.get(key) or 0 for r in reps))
+                rollout_rep = {
+                    "requests": _tot("requests"),
+                    "ok": _tot("ok"), "shed": _tot("shed"),
+                    "failed": _tot("failed"),
+                    "rollout_s": round(roll_s, 3),
+                    "target_qps": round(target_qps, 2),
+                    "windows": len(reps),
+                    "p99_ms": max(
+                        ((r.get("latency_ms") or {}).get("p99") or 0.0)
+                        for r in reps),
+                }
+        finally:
+            server.close()
+    finally:
+        fleet.close()
+
+    head = sweep[str(n_max)]
+    rates = head["qps_rounds"]
+    # n1 None (replica count 1 not swept) must propagate as None:
+    # a fabricated 0.0 speedup or 100% overhead would trip the
+    # perf-gate collapse rule on a number that was never measured
+    n1 = sweep.get("1", {}).get("qps_median")
+    out = {
+        "metric": f"router_fleet{n_max}_closed_loop_qps",
+        "value": head["qps_median"],
+        "unit": "requests/sec",
+        "device_kind": getattr(jax.devices()[0], "device_kind",
+                               str(jax.devices()[0])),
+        "stats": {
+            "rounds": rounds,
+            "median": head["qps_median"],
+            "p10": round(float(np.percentile(rates, 10)), 2),
+            "p90": round(float(np.percentile(rates, 90)), 2),
+            "min": round(min(rates), 2),
+            "max": round(max(rates), 2),
+        },
+        "p99_ms": head["p99_ms"],
+        "direct_qps": round(direct_qps, 2),
+        "direct_p99_ms": round(direct_p99, 3),
+        "router_overhead_pct": round(
+            (1.0 - n1 / direct_qps) * 100.0, 2)
+        if n1 and direct_qps else None,
+        "qps_by_replicas": {k: v["qps_median"]
+                            for k, v in sweep.items()},
+        "speedup_4v1": round(head["qps_median"] / n1, 3)
+        if n1 else None,
+        "p99_vs_direct": round(
+            (head["p99_ms"] or 0.0) / max(direct_p99, 1e-9), 3),
+        "rollout": rollout_rep,
+        "sweep": sweep,
+        "config": {"feat": feat, "hidden": hidden, "depth": depth,
+                   "requests": n_req, "max_batch": max_batch,
+                   "rounds": rounds, "replicas": list(n_list)},
+    }
+    cores = os.cpu_count() or 1
+    if cores < n_max + 1:
+        # N replica processes PLUS the router process multiplexed onto
+        # fewer host cores: the sweep contends for the same ALUs, so
+        # replica scaling cannot show — measured honestly, never gated
+        out["anomaly"] = (
+            f"host has {cores} cores for {n_max} replica processes + "
+            f"the router; fleet scaling is core-bound and speedup_4v1 "
+            f"is not meaningful")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Decode leg: KV-cached continuous batching tokens/sec vs static batch drain
 # ---------------------------------------------------------------------------
 
@@ -1218,6 +1425,14 @@ def main():
                 out["legs"]["sharded_serving"] = run_sharded_serving()
             except Exception as e:
                 out["legs"]["sharded_serving"] = {
+                    "error": f"{type(e).__name__}: {e}"}
+        # router leg: fleet front-end scaling + rolling-restart
+        # availability (BENCH_ROUTER=0 skips)
+        if os.environ.get("BENCH_ROUTER", "1") == "1":
+            try:
+                out["legs"]["router"] = run_router()
+            except Exception as e:
+                out["legs"]["router"] = {
                     "error": f"{type(e).__name__}: {e}"}
         # decode leg: KV-cached continuous batching tokens/sec/chip —
         # the tracked Llama BASELINE config (BENCH_DECODE=0 skips)

@@ -35,35 +35,54 @@ def _parse():
     return p.parse_args()
 
 
+def spawn_process(cmd, env_overrides=None, log_path=None,
+                  restart_count=0):
+    """Spawn one supervised worker process: current env + overrides,
+    ``PADDLE_TPU_RESTART_COUNT`` accounting (which life this worker is
+    on; 0 = first — a restarted worker can tell a fresh launch from an
+    elastic respawn, e.g. to insist on finding an auto-checkpoint),
+    stdout+stderr appended to ``log_path`` when given.
+
+    Shared machinery: the training watch loop below and the serving
+    fleet supervisor (:mod:`paddle_tpu.serving.fleet`) spawn through
+    this one helper so restart accounting and log capture cannot
+    drift apart."""
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in (env_overrides or {}).items()})
+    env["PADDLE_TPU_RESTART_COUNT"] = str(restart_count)
+    stdout = None
+    if log_path:
+        os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+        stdout = open(log_path, "a")
+    try:
+        return subprocess.Popen(cmd, env=env, stdout=stdout,
+                                stderr=subprocess.STDOUT
+                                if stdout else None)
+    finally:
+        if stdout is not None:
+            stdout.close()  # the child holds its own descriptor
+
+
 def _spawn(args, hosts, nnodes, local_rank, restart_count=0):
     rank = args.node_rank * args.nproc_per_node + local_rank
     world = nnodes * args.nproc_per_node
-    env = dict(os.environ)
-    env.update({
+    env = {
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(world),
-        # which life this worker is on (0 = first); restarted workers can
-        # tell a fresh launch from an elastic restart (e.g. to log, or to
-        # insist on finding an auto-checkpoint to resume from)
-        "PADDLE_TPU_RESTART_COUNT": str(restart_count),
         "PADDLE_TRAINER_ENDPOINTS": ",".join(
             f"{h}:{args.coordinator_port + i}"
             for h in hosts for i in range(args.nproc_per_node)),
         "PADDLE_CURRENT_ENDPOINT":
             f"{hosts[min(args.node_rank, nnodes - 1)]}:"
             f"{args.coordinator_port + local_rank}",
-    })
+    }
     if world > 1:
         env["PADDLE_COORDINATOR"] = f"{hosts[0]}:{args.coordinator_port}"
     cmd = [sys.executable, "-u", args.training_script,
            *args.training_script_args]
-    stdout = None
-    if args.log_dir:
-        os.makedirs(args.log_dir, exist_ok=True)
-        stdout = open(os.path.join(args.log_dir,
-                                   f"worker.{rank}.log"), "a")
-    return subprocess.Popen(cmd, env=env, stdout=stdout,
-                            stderr=subprocess.STDOUT if stdout else None)
+    log_path = (os.path.join(args.log_dir, f"worker.{rank}.log")
+                if args.log_dir else None)
+    return spawn_process(cmd, env, log_path, restart_count)
 
 
 def main():

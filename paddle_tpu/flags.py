@@ -216,3 +216,36 @@ register_flag("FLAGS_serving_access_log", "",
               "request: trace_id, status, per-phase latency breakdown); "
               "empty defaults to <FLAGS_metrics_dir>/access.jsonl when a "
               "metrics dir is set, else disabled")
+register_flag("FLAGS_router_health_interval_ms", 200.0,
+              "fleet router: cadence of the background /healthz poll "
+              "against every registered replica (queue depth, inflight "
+              "rows, ready flag feed the least-loaded routing score)")
+register_flag("FLAGS_router_health_stale_ms", 2000.0,
+              "fleet router: a replica whose last successful health "
+              "poll is older than this is DEPRIORITIZED (routed to only "
+              "when no fresh replica exists) — a silent replica must "
+              "not keep winning the least-loaded comparison on frozen "
+              "numbers")
+register_flag("FLAGS_router_eject_after", 2,
+              "fleet router: consecutive failed health polls before a "
+              "replica is EJECTED from the routing set entirely (it "
+              "rejoins on the first successful poll reporting ready)")
+register_flag("FLAGS_router_slo_p99_ms", 250.0,
+              "fleet router: the served-latency SLO the autoscaling "
+              "signal is derived from — fleet_wanted_replicas scales "
+              "live replicas by max(p99/SLO, queue-depth pressure) "
+              "(paddle_tpu/serving/router.py)")
+register_flag("FLAGS_fleet_replicas", 2,
+              "fleet supervisor: replica server processes to spawn "
+              "(paddle_tpu/serving/fleet.py; each gets its own port, "
+              "metrics dir, and PADDLE_TPU_REPLICA_ID env)")
+register_flag("FLAGS_fleet_max_restarts", 3,
+              "fleet supervisor: respawn a CRASHED replica up to N "
+              "times (exponential backoff, PADDLE_TPU_RESTART_COUNT "
+              "accounting); past the budget the replica stays down and "
+              "fleet_replicas_live drops.  Rolling-restart respawns "
+              "are planned exits and do not count")
+register_flag("FLAGS_fleet_restart_backoff_ms", 200.0,
+              "fleet supervisor: base crash-respawn backoff; doubles "
+              "per consecutive crash of the same replica (capped at "
+              "5s), resets after a healthy start")

@@ -22,7 +22,9 @@ it (README "Generation serving").
 from . import batcher  # noqa
 from .engine import (OverloadedError, RequestFailed, ServingEngine,  # noqa
                      ServingError, ServingFuture)
+from .fleet import FleetSupervisor  # noqa
 from .generation import GenerationEngine  # noqa
+from .router import Router, RouterServer, serve_router  # noqa
 from .server import ServingServer, serve  # noqa
 from .sharded import (ReplicaGroupEngine, ShardedPredictor,  # noqa
                       serving_shard_rules)
@@ -30,4 +32,5 @@ from .sharded import (ReplicaGroupEngine, ShardedPredictor,  # noqa
 __all__ = ["ServingEngine", "ServingError", "OverloadedError",
            "RequestFailed", "ServingFuture", "ServingServer", "serve",
            "GenerationEngine", "batcher", "ReplicaGroupEngine",
-           "ShardedPredictor", "serving_shard_rules"]
+           "ShardedPredictor", "serving_shard_rules", "Router",
+           "RouterServer", "serve_router", "FleetSupervisor"]

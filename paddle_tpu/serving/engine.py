@@ -187,7 +187,8 @@ class ServingEngine:
                  deadline_ms: Optional[float] = None,
                  warmup_shapes=None, autostart: bool = True,
                  share_executables: bool = True,
-                 pool: Optional[List] = None):
+                 pool: Optional[List] = None,
+                 ready_requires_warmup: bool = False):
         from ..inference import Predictor
 
         if not isinstance(predictor, Predictor):
@@ -297,6 +298,14 @@ class ServingEngine:
         # generation requests route to it, the one-shot path is untouched
         self.generator = None
 
+        # readiness gating (fleet scale-out): with ready_requires_warmup
+        # the /healthz `ready` field stays False until warmup() has
+        # primed the shape buckets, so a router never sends the
+        # first-request compile spike to a cold replica.  Default False:
+        # a standalone engine is routable the moment it is constructed.
+        self._ready_requires_warmup = bool(ready_requires_warmup)
+        self._warmed = False
+
         if warmup_shapes is not None:
             self.warmup(warmup_shapes)
         if autostart:
@@ -323,7 +332,17 @@ class ServingEngine:
         with telemetry.trace_span("serving/warmup", buckets=len(sigs)):
             for p in dict.fromkeys(self._pool):  # unique when shared
                 compiled += p.warmup(sigs)
+        self._warmed = True
         return compiled
+
+    def ready(self) -> bool:
+        """Routable: accepting requests AND (when readiness is gated on
+        warmup) the shape buckets are compiled + primed.  Surfaces as
+        the ``ready`` field in ``/healthz`` — the fleet router refuses
+        to place traffic on a replica until this flips true."""
+        if self._draining or self._closed:
+            return False
+        return self._warmed or not self._ready_requires_warmup
 
     def start(self):
         if self._threads:
@@ -438,11 +457,15 @@ class ServingEngine:
             raise ValueError(f"feeds disagree on batch dim: {shapes}")
         return arrays
 
-    def submit(self, feed) -> ServingFuture:
+    def submit(self, feed, trace_id: Optional[str] = None
+               ) -> ServingFuture:
         """Admit one request (any batch size >= 1).  Returns a
         :class:`ServingFuture`; sheds with :class:`OverloadedError`
         when the queue is full or the engine is draining (the raised
-        error carries the request's ``trace_id``)."""
+        error carries the request's ``trace_id``).  ``trace_id`` adopts
+        an externally-minted trace identity (the router hop forwards
+        its ``X-PaddleTPU-Trace`` header here), so one served request
+        is ONE trace across both tiers."""
         arrays = self.coerce_feed(feed)
         self._count("requests")
         stat_add("serving_requests")
@@ -452,7 +475,7 @@ class ServingEngine:
             # handler, loadgen) handle ServingError, not raw OSError
             raise RequestFailed("injected serve_request failure")
         req = _Request(arrays)
-        admit = self._trace_begin(req)
+        admit = self._trace_begin(req, trace_id=trace_id)
         with self._cv:
             if self._draining:
                 raise self._submit_shed(req, admit, "draining")
@@ -505,18 +528,22 @@ class ServingEngine:
             n = self._sample_seq
         return math.floor(n * rate) > math.floor((n - 1) * rate)
 
-    def _trace_begin(self, req: _Request):
+    def _trace_begin(self, req: _Request,
+                     trace_id: Optional[str] = None):
         """Stamp the request's trace identity and (when head-sampled)
         open the ``serving/request`` root + ``serving/admit`` child.
-        Returns the admit span (None unsampled/disabled).  Constant
-        time with telemetry off: one enabled() check, nothing else."""
+        ``trace_id`` (when the caller carried one in — the router hop)
+        is adopted instead of minting fresh, sampled or not.  Returns
+        the admit span (None unsampled/disabled).  Constant time with
+        telemetry off: one enabled() check, nothing else."""
         if not telemetry.enabled():
             return None
         if self._head_sample():
             req.sampled = True
             self._count("sampled")
             req.root = telemetry.span_begin("serving/request",
-                                            detached=True, rows=req.rows)
+                                            detached=True, rows=req.rows,
+                                            trace_id=trace_id)
             req.trace_id = req.root.trace_id
             admit = telemetry.span_begin("serving/admit",
                                          parent=req.root.context(),
@@ -525,7 +552,7 @@ class ServingEngine:
             return admit
         # unsampled requests still get an identity: the access log and
         # histogram exemplars must be able to name ANY request
-        req.trace_id = telemetry.new_trace_id()
+        req.trace_id = trace_id or telemetry.new_trace_id()
         return None
 
     def _wait_span_of(self, req: _Request):
@@ -600,7 +627,8 @@ class ServingEngine:
         self.generator = generator
         return self
 
-    def submit_generate(self, prompt, max_new_tokens=None):
+    def submit_generate(self, prompt, max_new_tokens=None,
+                        trace_id=None):
         """Admit one generation request to the attached slot scheduler
         (future of the generation record); raises RuntimeError when no
         generator is attached."""
@@ -608,7 +636,8 @@ class ServingEngine:
             raise RuntimeError("no GenerationEngine attached; call "
                                "attach_generator() first")
         return self.generator.submit(prompt,
-                                     max_new_tokens=max_new_tokens)
+                                     max_new_tokens=max_new_tokens,
+                                     trace_id=trace_id)
 
     # -- scheduler ----------------------------------------------------------
     def _count(self, key: str, n: int = 1):
@@ -903,11 +932,13 @@ class ServingEngine:
         queue depth + its high watermark."""
         with self._n_lock:
             n = dict(self._n)
+            inflight = sum(h["in_flight_rows"] for h in self._health)
         with self._cv:
             depth = len(self._queue)
             peak = self._peak_depth
         return {
             "queue_depth": depth,
+            "inflight_rows": inflight,
             "queue_depth_peak": peak,
             "queue_cap": self.queue_cap,
             "workers": self.workers,
@@ -981,6 +1012,7 @@ class ServingEngine:
             status = "closed"
         out = {
             "status": status,
+            "ready": self.ready(),
             "pid": os.getpid(),
             "time": time.time(),
             "uptime_s": round(time.time() - self._started, 3),

@@ -1,0 +1,372 @@
+"""Fleet supervisor: spawn, monitor, and roll N replica servers.
+
+The process-management half of the fleet front end (the routing half
+is :mod:`paddle_tpu.serving.router`): each replica is one
+``python -m paddle_tpu.serving.replica`` subprocess spawned through
+the launcher machinery (:func:`paddle_tpu.distributed.launch.
+spawn_process` — shared restart accounting + log capture), with its
+own port, metrics dir, and ``PADDLE_TPU_REPLICA_ID`` env.
+
+* **Stable URLs.** A replica binds ephemeral on first spawn and
+  publishes its port via an atomic endpoint file; the supervisor PINS
+  that port for every respawn, so the router registry never changes
+  across crashes or rollouts.
+
+* **Crash detection → bounded respawn.** A monitor thread polls the
+  processes; an unexpected exit respawns the replica with exponential
+  backoff (``FLAGS_fleet_restart_backoff_ms`` doubling per
+  consecutive crash, capped at 5s) up to ``FLAGS_fleet_max_restarts``
+  times — past the budget the replica stays down and
+  ``fleet_replicas_live`` drops.  Every life increments the
+  ``PADDLE_TPU_RESTART_COUNT`` the replica sees (launch.py's elastic
+  accounting), and a healthy start (ready reached) resets the crash
+  streak.
+
+* **Drain-aware rolling restart.** :meth:`rolling_restart` takes the
+  fleet through a rollout ONE replica at a time: SIGTERM (the
+  replica's existing drain path serves out everything admitted),
+  wait for the process to exit cleanly, respawn the successor at the
+  same port, and wait until its ``/healthz`` reports ``ready`` (shape
+  buckets primed) before touching the next replica — at every instant
+  N-1 replicas are routable, which is what lets the router pass
+  traffic through a rollout with zero non-shed failures (asserted by
+  ``bench.py run_router`` and ``tests/test_router.py``).
+
+Stats (README catalog): counters ``fleet_restarts``,
+``fleet_rolling_restarts``; gauge ``fleet_replicas_live``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+from .. import telemetry
+from ..distributed.launch import spawn_process
+from ..flags import flag_value
+from ..monitor import stat_add
+
+__all__ = ["FleetSupervisor"]
+
+logger = logging.getLogger("paddle_tpu.serving.fleet")
+
+_BACKOFF_CAP_S = 5.0
+_MONITOR_POLL_S = 0.1
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _healthz(url: str, timeout: float = 2.0) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + "/healthz",
+                                    timeout=timeout) as r:
+            return json.loads(r.read())
+    except (OSError, TimeoutError, ValueError):
+        return None
+
+
+class _Replica:
+    """Supervisor-side state for one replica slot."""
+
+    def __init__(self, idx: int, rdir: str):
+        self.idx = idx
+        self.dir = rdir
+        self.endpoint_file = os.path.join(rdir, "endpoint.json")
+        self.log_path = os.path.join(rdir, "replica.log")
+        self.metrics_dir = os.path.join(rdir, "metrics")
+        self.proc = None
+        self.port: Optional[int] = None     # pinned after first bind
+        self.url: Optional[str] = None
+        self.lives = 0            # spawns so far (-> RESTART_COUNT)
+        self.crash_streak = 0     # consecutive crashes (backoff input)
+        self.crash_restarts = 0   # crash respawns consumed of budget
+        self.failed = False       # past the restart budget: stays down
+        self.in_rollout = False   # monitor keeps hands off
+        self.respawn_at: Optional[float] = None  # backoff deadline
+
+
+class FleetSupervisor:
+    """Spawn and babysit ``replicas`` replica server processes.
+
+    ``replica_argv`` — extra CLI args for every
+    ``paddle_tpu.serving.replica`` process (model sizing /
+    ``--model-dir`` etc.); ``env`` — extra env vars for every replica
+    (e.g. serving ``FLAGS_*``).  ``workdir`` (default: a fresh temp
+    dir) holds per-replica ``replica-<i>/`` dirs: endpoint file, log,
+    metrics dir."""
+
+    def __init__(self, replicas: Optional[int] = None,
+                 replica_argv: Optional[List[str]] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 workdir: Optional[str] = None,
+                 max_restarts: Optional[int] = None,
+                 backoff_ms: Optional[float] = None,
+                 autostart: bool = True):
+        self.n = int(replicas if replicas is not None
+                     else flag_value("FLAGS_fleet_replicas"))
+        if self.n < 1:
+            raise ValueError("FleetSupervisor needs >= 1 replica")
+        self.replica_argv = list(replica_argv or [])
+        self.env = dict(env or {})
+        self.workdir = workdir or tempfile.mkdtemp(prefix="fleet-")
+        self.max_restarts = int(
+            max_restarts if max_restarts is not None
+            else flag_value("FLAGS_fleet_max_restarts"))
+        self._backoff_s = float(
+            backoff_ms if backoff_ms is not None
+            else flag_value("FLAGS_fleet_restart_backoff_ms")) / 1e3
+        self._lock = threading.Lock()
+        self._replicas = [
+            _Replica(i, os.path.join(self.workdir, f"replica-{i}"))
+            for i in range(self.n)]
+        self._closing = False
+        self._monitor: Optional[threading.Thread] = None
+        self._started = time.time()
+        if autostart:
+            self.start()
+
+    # -- spawning -----------------------------------------------------------
+    def _spawn(self, rep: _Replica):
+        os.makedirs(rep.dir, exist_ok=True)
+        # stale endpoint files must not satisfy the bind-wait below
+        try:
+            os.remove(rep.endpoint_file)
+        except FileNotFoundError:
+            pass  # ok: first spawn
+        cmd = [sys.executable, "-u", "-m", "paddle_tpu.serving.replica",
+               "--endpoint-file", rep.endpoint_file,
+               "--port", str(rep.port or 0), *self.replica_argv]
+        env = dict(self.env)
+        env.update({
+            "PADDLE_TPU_REPLICA_ID": str(rep.idx),
+            "FLAGS_metrics_dir": rep.metrics_dir,
+        })
+        rep.proc = spawn_process(cmd, env, rep.log_path,
+                                 restart_count=rep.lives)
+        rep.lives += 1
+        rep.respawn_at = None
+        logger.info("replica %d spawned (pid %d, life %d, port %s)",
+                    rep.idx, rep.proc.pid, rep.lives,
+                    rep.port or "ephemeral")
+        self._publish_live()
+
+    def start(self):
+        for rep in self._replicas:
+            if rep.proc is None:
+                self._spawn(rep)
+        if self._monitor is None:
+            self._monitor = threading.Thread(target=self._monitor_loop,
+                                             name="fleet-monitor",
+                                             daemon=True)
+            self._monitor.start()
+
+    def _publish_live(self):
+        live = sum(1 for r in self._replicas
+                   if r.proc is not None and r.proc.poll() is None)
+        telemetry.gauge_set("fleet_replicas_live", live)
+
+    # -- readiness ----------------------------------------------------------
+    def _wait_bound(self, rep: _Replica, deadline: float) -> bool:
+        """Wait for the endpoint file of rep's CURRENT life."""
+        while time.monotonic() < deadline:
+            doc = _read_json(rep.endpoint_file)
+            if doc and doc.get("pid") == rep.proc.pid:
+                rep.port = int(doc["port"])
+                rep.url = doc["url"]
+                return True
+            if rep.proc.poll() is not None:
+                return False
+            time.sleep(0.05)
+        return False
+
+    def _wait_replica_ready(self, rep: _Replica,
+                            deadline: float) -> bool:
+        if not self._wait_bound(rep, deadline):
+            return False
+        while time.monotonic() < deadline:
+            h = _healthz(rep.url)
+            if h is not None and h.get("ready"):
+                rep.crash_streak = 0  # healthy start resets backoff
+                return True
+            if rep.proc.poll() is not None:
+                return False
+            time.sleep(0.05)
+        return False
+
+    def wait_ready(self, timeout_s: float = 120.0) -> List[str]:
+        """Block until every replica is bound, warmed, and reporting
+        ``ready``; returns the (stable) base URLs.  Raises on timeout
+        or a replica that died before readiness."""
+        deadline = time.monotonic() + timeout_s
+        for rep in self._replicas:
+            if not self._wait_replica_ready(rep, deadline):
+                rc = rep.proc.poll() if rep.proc is not None else None
+                tail = ""
+                try:
+                    with open(rep.log_path, encoding="utf-8",
+                              errors="replace") as f:
+                        tail = f.read()[-2000:]
+                except OSError as e:
+                    tail = f"<log unreadable: {e}>"
+                raise RuntimeError(
+                    f"replica {rep.idx} not ready in {timeout_s}s "
+                    f"(rc={rc}); log tail:\n{tail}")
+        return self.endpoints()
+
+    def endpoints(self) -> List[str]:
+        return [r.url for r in self._replicas if r.url]
+
+    # -- crash monitor ------------------------------------------------------
+    def _monitor_loop(self):
+        while not self._closing:
+            time.sleep(_MONITOR_POLL_S)
+            with self._lock:
+                if self._closing:
+                    return
+                for rep in self._replicas:
+                    self._check_one(rep)
+
+    def _check_one(self, rep: _Replica):
+        if rep.in_rollout or rep.failed or rep.proc is None:
+            return
+        if rep.respawn_at is not None:
+            # in crash backoff: respawn once the deadline passes
+            if time.monotonic() >= rep.respawn_at:
+                self._spawn(rep)
+            return
+        rc = rep.proc.poll()
+        if rc is None:
+            return
+        # unexpected exit = crash (planned exits happen only inside
+        # rolling_restart / close, which hold the rollout flag or
+        # _closing)
+        self._publish_live()
+        if rep.crash_restarts >= self.max_restarts:
+            rep.failed = True
+            logger.error("replica %d exited rc=%s past the restart "
+                         "budget (%d); staying down", rep.idx, rc,
+                         self.max_restarts)
+            telemetry.log_event("fleet_replica_failed", replica=rep.idx,
+                                rc=rc)
+            return
+        rep.crash_restarts += 1
+        rep.crash_streak += 1
+        backoff = min(self._backoff_s * (2 ** (rep.crash_streak - 1)),
+                      _BACKOFF_CAP_S)
+        rep.respawn_at = time.monotonic() + backoff
+        stat_add("fleet_restarts")
+        logger.warning("replica %d crashed rc=%s; respawn %d/%d in "
+                       "%.2fs", rep.idx, rc, rep.crash_restarts,
+                       self.max_restarts, backoff)
+        telemetry.log_event("fleet_replica_crash", replica=rep.idx,
+                            rc=rc, restart=rep.crash_restarts,
+                            backoff_s=round(backoff, 3))
+
+    # -- rollout ------------------------------------------------------------
+    def rolling_restart(self, ready_timeout_s: float = 120.0,
+                        drain_timeout_s: float = 30.0) -> dict:
+        """Drain-aware rollout: one replica at a time, SIGTERM → wait
+        for its drain path to flush and the process to exit → respawn
+        at the same port → wait for the successor's ``ready`` — then
+        the next replica.  The fleet never has more than one replica
+        out at a time, so a router keeps serving throughout (the
+        zero-non-shed-failure window asserted by the bench leg and the
+        test matrix).  Returns per-replica timings."""
+        stat_add("fleet_rolling_restarts")
+        t0 = time.monotonic()
+        out = []
+        for rep in self._replicas:
+            if rep.failed or rep.proc is None:
+                out.append({"replica": rep.idx, "skipped": "down"})
+                continue
+            with self._lock:
+                rep.in_rollout = True
+            try:
+                t_rep = time.monotonic()
+                rep.proc.send_signal(signal.SIGTERM)
+                try:
+                    rc = rep.proc.wait(drain_timeout_s)
+                except Exception:  # subprocess.TimeoutExpired
+                    logger.warning("replica %d did not drain in %.1fs; "
+                                   "killing", rep.idx, drain_timeout_s)
+                    rep.proc.kill()
+                    rc = rep.proc.wait(5.0)
+                drain_s = time.monotonic() - t_rep
+                self._spawn(rep)
+                ok = self._wait_replica_ready(
+                    rep, time.monotonic() + ready_timeout_s)
+                out.append({"replica": rep.idx, "exit_rc": rc,
+                            "drain_s": round(drain_s, 3),
+                            "successor_ready": ok,
+                            "total_s": round(
+                                time.monotonic() - t_rep, 3)})
+                if not ok:
+                    raise RuntimeError(
+                        f"rolling restart: replica {rep.idx} successor "
+                        f"never became ready")
+            finally:
+                with self._lock:
+                    rep.in_rollout = False
+        telemetry.log_event("fleet_rolling_restart",
+                            replicas=len(out),
+                            duration_s=round(time.monotonic() - t0, 3))
+        return {"replicas": out,
+                "duration_s": round(time.monotonic() - t0, 3)}
+
+    # -- introspection / teardown -------------------------------------------
+    def statusz(self) -> dict:
+        with self._lock:
+            reps = [{
+                "replica": r.idx, "url": r.url, "port": r.port,
+                "pid": r.proc.pid if r.proc is not None else None,
+                "alive": r.proc is not None and r.proc.poll() is None,
+                "lives": r.lives, "crash_restarts": r.crash_restarts,
+                "failed": r.failed, "in_rollout": r.in_rollout,
+            } for r in self._replicas]
+        return {"replicas": reps, "max_restarts": self.max_restarts,
+                "workdir": self.workdir,
+                "uptime_s": round(time.time() - self._started, 3)}
+
+    def close(self, timeout_s: float = 30.0):
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+        for rep in self._replicas:
+            if rep.proc is not None and rep.proc.poll() is None:
+                rep.proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + timeout_s
+        for rep in self._replicas:
+            if rep.proc is None:
+                continue
+            left = max(0.1, deadline - time.monotonic())
+            try:
+                rep.proc.wait(left)
+            except Exception:  # subprocess.TimeoutExpired
+                logger.warning("replica %d ignored SIGTERM; killing",
+                               rep.idx)
+                rep.proc.kill()
+                rep.proc.wait(5.0)
+        self._publish_live()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False

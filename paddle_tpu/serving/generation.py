@@ -353,7 +353,8 @@ class GenerationEngine:
 
     # -- admission ----------------------------------------------------------
     def submit(self, prompt: Sequence[int],
-               max_new_tokens: Optional[int] = None) -> ServingFuture:
+               max_new_tokens: Optional[int] = None,
+               trace_id: Optional[str] = None) -> ServingFuture:
         """Admit one generation request.  ``prompt``: 1-D int token ids
         (1 ≤ len ≤ the largest prefill bucket).  Returns a future whose
         ``result()`` is ``{"tokens", "prompt_len", "steps", "finish",
@@ -380,7 +381,9 @@ class GenerationEngine:
                          else self.max_new_tokens))
         req = GenRequest(ids.astype("int64"), mnt)
         if telemetry.enabled():
-            req.trace_id = telemetry.new_trace_id()
+            # an externally-minted id (the router hop's trace header)
+            # wins: one generated sequence is one trace across tiers
+            req.trace_id = trace_id or telemetry.new_trace_id()
         self._count("requests")
         stat_add("serving_generate_requests")
         with self._cv:

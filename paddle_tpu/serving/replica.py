@@ -1,0 +1,197 @@
+"""Replica server process: ``python -m paddle_tpu.serving.replica``.
+
+One fleet replica = one of these processes (spawned and supervised by
+:mod:`paddle_tpu.serving.fleet`): build a predictor, start the HTTP
+front end FIRST (so the router can poll ``/healthz`` and see
+``ready: false`` while warmup runs), prime every shape bucket, then
+flip ready — the router never places traffic on a replica that would
+pay a first-request compile.
+
+Startup contract (what the supervisor relies on):
+
+1. bind the port (``--port``, 0 = ephemeral) and write
+   ``--endpoint-file`` atomically: ``{"url", "port", "pid",
+   "replica_id", "restart_count"}`` — the supervisor learns the bound
+   port from here and PINS it for respawns, so a replica's URL is
+   stable across its lifetimes and the router registry never changes;
+2. warm up (``Predictor.warmup`` over every bucket of the feed
+   signature) with the engine constructed ``ready_requires_warmup``,
+   so ``/healthz`` carries ``ready: false`` until buckets are primed;
+3. install SIGTERM drain (stop admissions, flush in-flight, stop the
+   listener) and block until the listener exits — exit code 0 is a
+   PLANNED exit (rollout), anything else a crash the supervisor
+   respawns with backoff.
+
+Model source: ``--model-dir`` + repeated ``--shape name=d0,d1``, or
+the synthetic MLP (``--feat/--hidden/--depth/--classes`` — the same
+builder the loadgen and bench use, so fleet tests need no files).
+Environment: ``PADDLE_TPU_REPLICA_ID`` (also via ``--replica-id``)
+tags logs and the endpoint file; ``FLAGS_metrics_dir`` etc. arrive as
+normal flag env vars.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import tempfile
+import time
+
+logger = logging.getLogger("paddle_tpu.serving.replica")
+
+
+def _parse_shapes(specs):
+    out = {}
+    for spec in specs or []:
+        name, _, dims = spec.partition("=")
+        out[name] = tuple(int(d) for d in dims.split(",") if d)
+    return out
+
+
+def _write_endpoint(path: str, payload: dict):
+    """Atomic publish (tmp + rename): the supervisor polling the file
+    must never read a torn JSON."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               prefix=".endpoint-")
+    with os.fdopen(fd, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def build_predictor(args):
+    """(predictor, per_row_shapes) from the CLI args."""
+    if args.model_dir:
+        from ..inference import Predictor
+        shapes = _parse_shapes(args.shape)
+        if not shapes:
+            raise SystemExit("--model-dir needs at least one "
+                             "--shape name=d0,d1")
+        return Predictor(args.model_dir), shapes
+    # synthetic MLP — same builder as the loadgen so the whole fleet
+    # path is testable with no exported model on disk
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from ..inference import Predictor
+
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    startup.random_seed = main.random_seed = args.seed
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [args.feat])
+        h = x
+        for i in range(args.depth):
+            h = layers.fc(h, args.hidden, act="relu", name=f"rep_fc{i}")
+        out = layers.fc(h, args.classes, name="rep_head")
+    scope = pt.Scope()
+    pt.Executor().run(startup, scope=scope)
+    return (Predictor(main, ["x"], [out], scope=scope),
+            {"x": (args.feat,)})
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--model-dir", help="save_inference_model export")
+    ap.add_argument("--shape", action="append", metavar="name=d0,d1",
+                    help="per-row feed shape (with --model-dir)")
+    ap.add_argument("--feat", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--classes", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 = ephemeral (published via --endpoint-file; "
+                         "the supervisor pins it for respawns)")
+    ap.add_argument("--endpoint-file",
+                    default=os.environ.get("PADDLE_TPU_ENDPOINT_FILE"),
+                    help="where to publish {url, port, pid, ...} once "
+                         "the listener is bound")
+    ap.add_argument("--replica-id", type=int,
+                    default=int(os.environ.get("PADDLE_TPU_REPLICA_ID",
+                                               "0") or 0))
+    ap.add_argument("--workers", type=int, default=None)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--max-delay-ms", type=float, default=None)
+    ap.add_argument("--queue-cap", type=int, default=None)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--no-warmup-gate", action="store_true",
+                    help="report ready immediately instead of gating "
+                         "on bucket warmup (debugging only)")
+    ap.add_argument("--generate", action="store_true",
+                    help="also attach a slot-based GenerationEngine so "
+                         "this replica serves POST /generate (the "
+                         "--gen-* flags size the decode model; without "
+                         "this the route answers 404)")
+    ap.add_argument("--gen-vocab", type=int, default=128)
+    ap.add_argument("--gen-hidden", type=int, default=64)
+    ap.add_argument("--gen-layers", type=int, default=2)
+    ap.add_argument("--gen-heads", type=int, default=4)
+    ap.add_argument("--gen-kv-heads", type=int, default=None)
+    ap.add_argument("--gen-intermediate", type=int, default=128)
+    ap.add_argument("--gen-slots", type=int, default=4)
+    ap.add_argument("--gen-max-seq", type=int, default=64)
+    ap.add_argument("--gen-max-new", type=int, default=32)
+    args = ap.parse_args(argv)
+
+    from .engine import ServingEngine
+    from .server import serve
+
+    predictor, shapes = build_predictor(args)
+    engine = ServingEngine(
+        predictor, workers=args.workers, max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms, queue_cap=args.queue_cap,
+        deadline_ms=args.deadline_ms,
+        ready_requires_warmup=not args.no_warmup_gate)
+    gen = None
+    if args.generate:
+        from .generation import GenerationEngine
+        gen = GenerationEngine(
+            dict(vocab_size=args.gen_vocab, hidden=args.gen_hidden,
+                 num_layers=args.gen_layers, num_heads=args.gen_heads,
+                 num_kv_heads=args.gen_kv_heads,
+                 intermediate=args.gen_intermediate),
+            num_slots=args.gen_slots, max_seq_len=args.gen_max_seq,
+            max_new_tokens=args.gen_max_new,
+            queue_cap=args.queue_cap,
+            deadline_ms=args.deadline_ms)
+        engine.attach_generator(gen)
+    server = serve(engine, host=args.host, port=args.port)
+    server.install_sigterm()
+
+    restart_count = int(os.environ.get("PADDLE_TPU_RESTART_COUNT",
+                                       "0") or 0)
+    if args.endpoint_file:
+        _write_endpoint(args.endpoint_file, {
+            "url": server.url, "port": server.port, "pid": os.getpid(),
+            "replica_id": args.replica_id,
+            "restart_count": restart_count})
+    logger.info("replica %d listening on %s (restart %d)",
+                args.replica_id, server.url, restart_count)
+
+    # warmup AFTER the listener is up: the router polls ready=false the
+    # whole time, so no traffic lands on cold buckets.  The generator
+    # (prefill buckets + the decode grid) warms first — the one-shot
+    # warmup flips `ready` and must stay the LAST gate
+    if gen is not None:
+        gen.warmup()
+    engine.warmup(shapes)
+    logger.info("replica %d ready (buckets primed)", args.replica_id)
+
+    # block until SIGTERM drains the engine and stops the listener
+    try:
+        while server._thread is not None and server._thread.is_alive():
+            server._thread.join(0.5)
+    except KeyboardInterrupt:
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    sys.exit(main())

@@ -1,0 +1,707 @@
+"""Fleet front end: least-loaded replica router (stdlib-only).
+
+The tier above :mod:`paddle_tpu.serving.server` — one
+``ThreadingHTTPServer`` that spreads ``POST /predict`` and
+``POST /generate`` across N replica server processes, making the
+PR-5/6 metrics plane load-bearing: routing decisions come from each
+replica's live ``/healthz`` (queue depth, inflight rows, ``ready``),
+not from a static round-robin.
+
+* **Health polling** — a background thread GETs every registered
+  replica's ``/healthz`` on a ``FLAGS_router_health_interval_ms``
+  cadence.  A replica is *routable* when its last successful poll is
+  fresh, it reports ``ready`` (warmup primed — no first-request
+  compile spike lands on live traffic), and it is not draining or
+  closed.  Snapshots older than ``FLAGS_router_health_stale_ms``
+  DEPRIORITIZE the replica (stale numbers must not keep winning the
+  least-loaded comparison); ``FLAGS_router_eject_after`` consecutive
+  failed polls EJECT it until a successful poll reports it
+  serviceable (ready, not draining/closed) again.
+
+* **Least-loaded placement** — among routable replicas the router
+  picks the lowest ``queue_depth + inflight_rows + router-side
+  in-flight`` (the last term counts requests this router already sent
+  that have not returned — burst sensitivity between polls).
+  Fresh+healthy replicas always beat stale-or-degraded ones; ejected
+  or not-ready replicas are never picked.
+
+* **Retry + explicit empty-fleet error** — a connect-level failure
+  (refused / reset / remote-disconnected: the replica died or is
+  mid-restart) books a health strike against that replica and retries
+  ONCE on a different replica; served inference is idempotent, so a
+  replayed request changes nothing.  Timeouts and in-flight HTTP
+  errors are NOT retried (the work may have executed).  With no
+  routable replica at all the router answers **503**
+  ``{"error": "overloaded", "reason": "no_ready_replicas"}``.
+
+* **Trace continuity** — the router forwards (or mints) an
+  ``X-PaddleTPU-Trace`` id; its own ``router/request`` →
+  ``router/forward`` spans and the replica's ``serving/request`` tree
+  adopt the same trace id, so one served request is one trace across
+  both tiers, findable in both access logs.
+
+* **SLO-derived autoscaling signal** — every poll sweep recomputes
+  ``pressure = max(p99_ms / FLAGS_router_slo_p99_ms,
+  avg_queue_depth / depth_target)`` over a sliding latency window and
+  publishes ``fleet_wanted_replicas`` (gauge + ``/statusz``
+  ``autoscale`` block): scale-up is proportional above pressure 1.0
+  (capped at 4x live), scale-down only below the 0.4 hysteresis
+  low-water mark — the hook a real autoscaler consumes.
+
+Endpoints: ``POST /predict`` / ``POST /generate`` (forwarded;
+replica responses — including overload 503s — pass through
+verbatim), ``GET /healthz`` (503 when the fleet has no routable
+replica), ``GET /metrics`` (strict Prometheus, live registry),
+``GET /statusz`` (fleet topology, per-replica health/ejection state,
+routing decision counters, autoscale signal).
+
+Stats (README catalog): counters ``router_http_requests``,
+``router_requests_routed``, ``router_retries``,
+``router_no_ready_replicas``, ``router_replica_errors``,
+``router_ejections``, ``router_recoveries``, ``router_health_polls``,
+``router_health_poll_failures``; gauges ``router_replicas_ready``,
+``fleet_wanted_replicas``; histogram ``router_request_ms``.
+"""
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import http.client
+import json
+import logging
+import math
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from .. import telemetry
+from ..flags import all_flags, flag_value
+from ..monitor import process_uptime_s, stat_add
+from .server import (TRACE_HEADER, _AccessLog, _JsonHandler,
+                     parse_trace_header)
+
+__all__ = ["Router", "RouterServer", "serve_router"]
+
+logger = logging.getLogger("paddle_tpu.serving.router")
+
+# connect-level failures: the request never reached a handler, so a
+# retry on another replica cannot double-execute anything
+_CONNECT_ERRORS = (ConnectionRefusedError, ConnectionResetError,
+                   BrokenPipeError, http.client.RemoteDisconnected)
+
+_LATENCY_WINDOW_S = 10.0    # sliding window feeding the SLO pressure
+_SCALE_UP_CAP = 4.0         # wanted <= 4x live per signal recompute
+_SCALE_DOWN_BAND = 0.4      # hysteresis: shrink only below this
+
+
+def _is_connect_error(exc) -> bool:
+    if isinstance(exc, _CONNECT_ERRORS):
+        return True
+    reason = getattr(exc, "reason", None)
+    return isinstance(reason, _CONNECT_ERRORS)
+
+
+class _Replica:
+    """Router-side state for one replica endpoint."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.health: Optional[dict] = None     # last good /healthz body
+        self.health_ts: float = 0.0            # monotonic, last success
+        self.poll_failures = 0                 # consecutive
+        self.ejected = False
+        self.last_error: Optional[str] = None
+        self.inflight = 0                      # router-side, this proc
+        self.routed = 0
+        self.retries_to = 0                    # retries that landed here
+        self.errors = 0
+
+    # -- routing view -------------------------------------------------------
+    def ready(self) -> bool:
+        if self.ejected or self.health is None:
+            return False
+        h = self.health
+        if h.get("status") in ("draining", "closed"):
+            return False
+        return bool(h.get("ready", True))  # pre-ready replicas: absent=ok
+
+    def stale(self, stale_s: float) -> bool:
+        return (time.monotonic() - self.health_ts) > stale_s
+
+    def degraded(self) -> bool:
+        return bool(self.health) and self.health.get("status") == "degraded"
+
+    def load(self) -> float:
+        """Least-loaded score: replica-reported queue depth + rows in
+        flight on its workers, plus requests THIS router already sent
+        it that have not come back (the between-polls burst term)."""
+        serving = (self.health or {}).get("serving") or {}
+        return (float(serving.get("queue_depth") or 0)
+                + float(serving.get("inflight_rows") or 0)
+                + float(self.inflight))
+
+    def queue_cap(self) -> int:
+        serving = (self.health or {}).get("serving") or {}
+        return int(serving.get("queue_cap") or 0)
+
+    def snapshot(self, stale_s: float) -> dict:
+        serving = (self.health or {}).get("serving") or {}
+        age_ms = (time.monotonic() - self.health_ts) * 1e3 \
+            if self.health_ts else None
+        return {
+            "url": self.url,
+            "ready": self.ready(),
+            "ejected": self.ejected,
+            "stale": self.stale(stale_s) if self.health else True,
+            "status": (self.health or {}).get("status"),
+            "poll_failures": self.poll_failures,
+            "queue_depth": serving.get("queue_depth"),
+            "inflight_rows": serving.get("inflight_rows"),
+            "router_inflight": self.inflight,
+            "load": self.load() if self.health else None,
+            "health_age_ms": round(age_ms, 1) if age_ms is not None
+            else None,
+            "routed": self.routed,
+            "retries_to": self.retries_to,
+            "errors": self.errors,
+            "last_error": self.last_error,
+        }
+
+
+class Router:
+    """Health-polled least-loaded router over N replica server URLs.
+
+    ``replicas`` — iterable of base URLs (``http://host:port``).  The
+    poll thread starts with ``autostart``; replicas can be added or
+    removed live (``add_replica`` / ``remove_replica`` — a rollout
+    that replaces a process at the same URL needs no registry change).
+    """
+
+    def __init__(self, replicas=(), slo_p99_ms: Optional[float] = None,
+                 poll_interval_ms: Optional[float] = None,
+                 stale_ms: Optional[float] = None,
+                 eject_after: Optional[int] = None,
+                 request_timeout_s: float = 30.0,
+                 autostart: bool = True):
+        self._slo_p99_ms = float(
+            slo_p99_ms if slo_p99_ms is not None
+            else flag_value("FLAGS_router_slo_p99_ms"))
+        self._poll_s = float(
+            poll_interval_ms if poll_interval_ms is not None
+            else flag_value("FLAGS_router_health_interval_ms")) / 1e3
+        self._stale_s = float(
+            stale_ms if stale_ms is not None
+            else flag_value("FLAGS_router_health_stale_ms")) / 1e3
+        self.eject_after = max(1, int(
+            eject_after if eject_after is not None
+            else flag_value("FLAGS_router_eject_after")))
+        self.request_timeout_s = float(request_timeout_s)
+
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, _Replica] = {}
+        for url in replicas:
+            self._replicas[url.rstrip("/")] = _Replica(url)
+        self._started = time.time()
+        self._n = {"requests": 0, "routed": 0, "retries": 0,
+                   "no_ready": 0, "replica_errors": 0, "ejections": 0,
+                   "recoveries": 0, "health_polls": 0,
+                   "health_poll_failures": 0}
+        self._h_request = telemetry.Histogram("router_request_ms")
+        # sliding (ts, ms) window of served latencies -> SLO pressure
+        self._recent: collections.deque = collections.deque(maxlen=2048)
+        self._autoscale = {"wanted_replicas": None, "pressure": None,
+                           "p99_ms": None, "slo_p99_ms": self._slo_p99_ms,
+                           "avg_queue_depth": None, "live": 0}
+        self._closed = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+        # persistent poll workers (idle threads are cheap; per-sweep
+        # thread churn is not).  16 bounds the damage of many replicas
+        # blackholing at once; each poll is timeout-bounded anyway.
+        self._poll_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="router-poll")
+        if autostart:
+            self.start()
+
+    # -- registry -----------------------------------------------------------
+    def add_replica(self, url: str):
+        with self._lock:
+            self._replicas.setdefault(url.rstrip("/"), _Replica(url))
+
+    def remove_replica(self, url: str):
+        with self._lock:
+            self._replicas.pop(url.rstrip("/"), None)
+
+    def replica_urls(self) -> List[str]:
+        with self._lock:
+            return list(self._replicas)
+
+    def _all(self) -> List[_Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    # -- health polling -----------------------------------------------------
+    def start(self):
+        if self._poll_thread is None:
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, name="router-health-poll",
+                daemon=True)
+            self._poll_thread.start()
+
+    def close(self):
+        self._closed.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5.0)
+        self._poll_pool.shutdown(wait=False)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    def _poll_loop(self):
+        while not self._closed.wait(self._poll_s):
+            self.poll_once()
+
+    def poll_once(self):
+        """One health sweep over every replica + an autoscale-signal
+        recompute.  Replicas poll CONCURRENTLY (on a persistent pool —
+        a fresh thread per replica per sweep would churn 5N threads/s
+        at the default cadence): a blackholed endpoint blocking its
+        full timeout must not stall the sweep past the staleness
+        budget and drag every healthy replica into the stale tier on
+        frozen numbers.  Public: tests and the fleet supervisor call
+        it to converge the routing view without waiting out the
+        cadence."""
+        reps = self._all()
+        if len(reps) == 1:
+            self._poll_replica(reps[0])
+        elif reps:
+            futs = [self._poll_pool.submit(self._poll_replica, r)
+                    for r in reps]
+            join_s = max(0.5, self._stale_s / 2.0) + 1.0
+            concurrent.futures.wait(futs, timeout=join_s)
+        self._recompute_autoscale()
+
+    def _poll_replica(self, rep: _Replica):
+        self._count("health_polls")
+        stat_add("router_health_polls")
+        timeout = max(0.5, self._stale_s / 2.0)
+        try:
+            with urllib.request.urlopen(rep.url + "/healthz",
+                                        timeout=timeout) as r:
+                body = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            # a 503 /healthz is still an ANSWER (closed engine): parse
+            # it so status/ready reflect what the replica said
+            try:
+                body = json.loads(e.read())
+            except (OSError, ValueError):
+                self._poll_failed(rep, f"HTTP {e.code}")
+                return
+        except (OSError, TimeoutError, ValueError) as e:
+            self._poll_failed(rep, f"{type(e).__name__}: {e}")
+            return
+        # an EJECTED replica rejoins only on a poll reporting it
+        # actually serviceable (ready, not draining/closed) — the
+        # documented FLAGS_router_eject_after contract.  A replica
+        # flapping between connect-refused and answering-but-closed
+        # must not churn the ejection/recovery counters (and operator
+        # alerts keyed on them) without ever serving.
+        serviceable = (bool(body.get("ready", True))
+                       and body.get("status") not in ("draining",
+                                                      "closed"))
+        with self._lock:
+            recovered = rep.ejected and serviceable
+            rep.health = body
+            rep.health_ts = time.monotonic()
+            rep.poll_failures = 0
+            rep.last_error = None
+            if recovered:
+                rep.ejected = False
+        if recovered:
+            self._count("recoveries")
+            stat_add("router_recoveries")
+            telemetry.log_event("router_replica_recovered", url=rep.url)
+
+    def _poll_failed(self, rep: _Replica, detail: str):
+        self._count("health_poll_failures")
+        stat_add("router_health_poll_failures")
+        with self._lock:
+            rep.poll_failures += 1
+            rep.last_error = detail
+            eject_now = (not rep.ejected
+                         and rep.poll_failures >= self.eject_after)
+            if eject_now:
+                rep.ejected = True
+        if eject_now:
+            self._count("ejections")
+            stat_add("router_ejections")
+            logger.warning("replica %s ejected after %d failed health "
+                           "polls (%s)", rep.url, rep.poll_failures,
+                           detail)
+            telemetry.log_event("router_replica_ejected", url=rep.url,
+                                detail=detail)
+
+    # -- autoscaling signal -------------------------------------------------
+    def _window_p99(self) -> Optional[float]:
+        cutoff = time.monotonic() - _LATENCY_WINDOW_S
+        with self._lock:
+            vals = [ms for ts, ms in self._recent if ts >= cutoff]
+        if not vals:
+            return None
+        vals.sort()
+        return vals[min(len(vals) - 1,
+                        int(math.ceil(0.99 * len(vals))) - 1)]
+
+    def _recompute_autoscale(self):
+        routable = [r for r in self._all() if r.ready()]
+        live = len(routable)
+        p99 = self._window_p99()
+        depths = [float((r.health.get("serving") or {})
+                        .get("queue_depth") or 0) for r in routable]
+        caps = [r.queue_cap() for r in routable if r.queue_cap() > 0]
+        avg_depth = sum(depths) / live if live else None
+        # depth_target: a quarter-full admission queue is standing
+        # backlog worth scaling for (well before shedding at cap)
+        depth_target = max(1.0, (sum(caps) / len(caps)) / 4.0) \
+            if caps else 1.0
+        p99_pressure = (p99 / self._slo_p99_ms) \
+            if p99 is not None and self._slo_p99_ms > 0 else 0.0
+        depth_pressure = (avg_depth / depth_target) \
+            if avg_depth is not None else 0.0
+        pressure = max(p99_pressure, depth_pressure)
+        if live == 0:
+            wanted = max(1, len(self._all()))
+        elif pressure > 1.0:
+            wanted = min(int(math.ceil(live * _SCALE_UP_CAP)),
+                         int(math.ceil(live * pressure)))
+        elif pressure < _SCALE_DOWN_BAND and live > 1:
+            # hysteresis band: only shrink when clearly idle, and never
+            # below one replica
+            wanted = max(1, int(math.ceil(live * max(pressure, 0.1)
+                                          / 0.8)))
+        else:
+            wanted = live
+        with self._lock:
+            self._autoscale = {
+                "wanted_replicas": wanted,
+                "pressure": round(pressure, 4),
+                "p99_ms": round(p99, 3) if p99 is not None else None,
+                "slo_p99_ms": self._slo_p99_ms,
+                "avg_queue_depth": round(avg_depth, 2)
+                if avg_depth is not None else None,
+                "live": live,
+            }
+        telemetry.gauge_set("fleet_wanted_replicas", wanted)
+        telemetry.gauge_set("router_replicas_ready", live)
+
+    # -- placement ----------------------------------------------------------
+    def pick(self, exclude=()) -> Optional[_Replica]:
+        """Least-loaded routable replica: fresh+healthy first, then
+        stale-or-degraded (deprioritized, still better than shedding);
+        ejected / not-ready / excluded never.  None = empty fleet."""
+        fresh: List[Tuple[float, _Replica]] = []
+        backup: List[Tuple[float, _Replica]] = []
+        for rep in self._all():
+            if rep.url in exclude or not rep.ready():
+                continue
+            tier = backup if (rep.stale(self._stale_s)
+                              or rep.degraded()) else fresh
+            tier.append((rep.load(), rep))
+        pool = fresh or backup
+        if not pool:
+            return None
+        return min(pool, key=lambda t: t[0])[1]
+
+    # -- forwarding ---------------------------------------------------------
+    def _count(self, key: str, n: int = 1):
+        with self._lock:
+            self._n[key] += n
+
+    def _send(self, rep: _Replica, route: str, body: bytes,
+              trace_id: Optional[str]) -> Tuple[int, bytes, str]:
+        req = urllib.request.Request(
+            rep.url + route, data=body,
+            headers={"Content-Type": "application/json",
+                     TRACE_HEADER: trace_id or ""})
+        with self._lock:
+            rep.inflight += 1
+        try:
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.request_timeout_s) as r:
+                    return (r.status, r.read(),
+                            r.headers.get("Content-Type",
+                                          "application/json"))
+            except urllib.error.HTTPError as e:
+                # the replica ANSWERED (400/404/500/503-shed): its
+                # verdict passes through verbatim, never retried
+                data = e.read()
+                return (e.code, data,
+                        e.headers.get("Content-Type",
+                                      "application/json"))
+        finally:
+            with self._lock:
+                rep.inflight -= 1
+
+    def route(self, route: str, body: bytes,
+              trace_id: Optional[str] = None) -> dict:
+        """Place one request: pick → forward → (on connect failure)
+        strike + retry once on an alternate.  Returns ``{"code",
+        "body", "content_type", "replica", "retried"}``; a fleet with
+        no routable replica yields the explicit 503
+        ``no_ready_replicas`` payload."""
+        self._count("requests")
+        stat_add("router_http_requests")
+        t0 = time.monotonic()
+        tried: List[str] = []
+        rep = self.pick()
+        retried = False
+        while rep is not None:
+            try:
+                code, data, ctype = self._send(rep, route, body,
+                                               trace_id)
+            except Exception as e:  # noqa: BLE001 — sort, don't die
+                with self._lock:
+                    rep.errors += 1
+                if _is_connect_error(e) and not tried:
+                    # the replica is gone or mid-restart: strike its
+                    # health (fast path to ejection) and try ONE
+                    # alternate — the request never started executing
+                    tried.append(rep.url)
+                    self._poll_failed(rep, f"connect: {e}")
+                    self._count("retries")
+                    stat_add("router_retries")
+                    retried = True
+                    rep = self.pick(exclude=tried)
+                    continue
+                self._count("replica_errors")
+                stat_add("router_replica_errors")
+                logger.warning("forward to %s failed: %s", rep.url, e)
+                return {"code": 502,
+                        "body": json.dumps(
+                            {"error": "replica_error",
+                             "replica": rep.url,
+                             "detail": f"{type(e).__name__}: {e}",
+                             "trace_id": trace_id}).encode(),
+                        "content_type": "application/json",
+                        "replica": rep.url, "retried": retried}
+            with self._lock:
+                rep.routed += 1
+                if retried:
+                    rep.retries_to += 1
+            self._count("routed")
+            stat_add("router_requests_routed")
+            if code == 200:
+                ms = (time.monotonic() - t0) * 1e3
+                self._h_request.observe(ms, trace_id=trace_id)
+                telemetry.histogram_observe("router_request_ms", ms,
+                                            trace_id=trace_id)
+                with self._lock:
+                    self._recent.append((time.monotonic(), ms))
+            return {"code": code, "body": data, "content_type": ctype,
+                    "replica": rep.url, "retried": retried}
+        # fleet empty (or emptied by the retry exclusion)
+        self._count("no_ready")
+        stat_add("router_no_ready_replicas")
+        return {"code": 503,
+                "body": json.dumps(
+                    {"error": "overloaded",
+                     "reason": "no_ready_replicas",
+                     "detail": f"{len(self._all())} registered, 0 "
+                               f"routable", "trace_id": trace_id}
+                ).encode(),
+                "content_type": "application/json", "replica": None,
+                "retried": retried}
+
+    # -- introspection ------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            n = dict(self._n)
+            auto = dict(self._autoscale)
+        reps = [r.snapshot(self._stale_s) for r in self._all()]
+        return {
+            "counters": n,
+            "replicas": reps,
+            "routable": sum(1 for r in reps
+                            if r["ready"] and not r["ejected"]),
+            "request_ms": self._h_request.summary(),
+            "autoscale": auto,
+        }
+
+    def healthz(self) -> Tuple[int, dict]:
+        reps = self._all()
+        routable = [r for r in reps if r.ready()]
+        status = "ok" if routable else "no_ready_replicas"
+        return (200 if routable else 503), {
+            "status": status,
+            "pid": os.getpid(),
+            "time": time.time(),
+            "uptime_s": round(time.time() - self._started, 3),
+            "replicas": len(reps),
+            "routable": len(routable),
+            "autoscale": dict(self._autoscale),
+        }
+
+    def statusz(self) -> dict:
+        return {
+            "pid": os.getpid(),
+            "time": time.time(),
+            "process_uptime_s": process_uptime_s(),
+            "router_uptime_s": round(time.time() - self._started, 3),
+            "restart_count": int(
+                os.environ.get("PADDLE_TPU_RESTART_COUNT", "0") or 0),
+            "poll_interval_ms": self._poll_s * 1e3,
+            "stale_ms": self._stale_s * 1e3,
+            "eject_after": self.eject_after,
+            "slo_p99_ms": self._slo_p99_ms,
+            "flags": all_flags(),
+            "fleet": self.stats(),
+        }
+
+
+class _RouterHandler(_JsonHandler):
+    router: Router = None
+    access_log: _AccessLog = None
+
+    logger = logger
+
+    def do_GET(self):
+        route = self.path.split("?", 1)[0]
+        if route == "/healthz":
+            code, payload = self.router.healthz()
+            self._reply(code, payload)
+        elif route == "/metrics":
+            if not telemetry.enabled():
+                self._reply(503, {"error": "telemetry disabled",
+                                  "detail": "FLAGS_telemetry=0"})
+                return
+            self._reply_raw(200, telemetry.prometheus_text().encode(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+        elif route == "/statusz":
+            self._reply(200, self.router.statusz())
+        else:
+            self._reply(404, {"error": "not found", "path": self.path})
+
+    def do_POST(self):
+        try:
+            n = int(self.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            n = 0
+        body = self.rfile.read(n) if n > 0 else b""
+        route = self.path.split("?", 1)[0]
+        if route not in ("/predict", "/generate"):
+            self._reply(404, {"error": "not found", "path": self.path})
+            return
+        # forward the caller's trace id or mint one: the replica's
+        # serving/request root adopts it, so the hop below and the
+        # replica's spans share ONE trace
+        trace_id = parse_trace_header(self.headers.get(TRACE_HEADER)) \
+            or (telemetry.new_trace_id() if telemetry.enabled()
+                else None)
+        t0 = time.monotonic()
+        root = telemetry.span_begin("router/request", detached=True,
+                                    trace_id=trace_id, path=route)
+        fwd = telemetry.span_begin(
+            "router/forward", detached=True,
+            parent=root.context() if root is not None else None,
+            trace_id=trace_id)
+        res = None
+        try:
+            res = self.router.route(route, body, trace_id)
+            if fwd is not None:
+                fwd.attrs["replica"] = res["replica"]
+                fwd.attrs["retried"] = res["retried"]
+                fwd.attrs["status"] = res["code"]
+        except Exception as e:  # noqa: BLE001 — a routing bug must
+            # answer 500, not drop the connection (and must not leak
+            # the open hop spans)
+            logger.exception("router route(%s) raised", route)
+            res = {"code": 500,
+                   "body": json.dumps(
+                       {"error": "router internal",
+                        "detail": f"{type(e).__name__}: {e}",
+                        "trace_id": trace_id}).encode(),
+                   "content_type": "application/json", "replica": None,
+                   "retried": False}
+            if fwd is not None:
+                fwd.attrs["status"] = 500
+        finally:
+            telemetry.span_end(fwd)
+            if root is not None:
+                root.attrs["status"] = res["code"] if res else 500
+            telemetry.span_end(root)
+        self._reply_raw(res["code"], res["body"], res["content_type"],
+                        trace_id=trace_id)
+        ms = (time.monotonic() - t0) * 1e3
+        self.access_log.write({
+            "ts": round(time.time(), 6), "method": "POST",
+            "path": route, "status": res["code"],
+            "ms": round(ms, 3), "trace_id": trace_id, "tier": "router",
+            "replica": res["replica"], "retried": res["retried"]})
+
+
+class RouterServer:
+    """Own the router listener + serve_forever thread (the router tier
+    analog of :class:`~paddle_tpu.serving.server.ServingServer`).
+    ``port=0`` binds ephemeral; ``close()`` stops the listener and the
+    router's poll thread."""
+
+    def __init__(self, router: Router, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.router = router
+        self.access_log = _AccessLog()
+        handler = type("BoundRouterHandler", (_RouterHandler,),
+                       {"router": router, "access_log": self.access_log})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "RouterServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.1}, name="router-http",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError as e:
+            logger.warning("router listener shutdown: %s", e)
+        if self._thread is not None:
+            self._thread.join(5.0)
+        self.router.close()
+        self.access_log.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+
+def serve_router(replicas, host: str = "127.0.0.1", port: int = 0,
+                 **router_kw) -> RouterServer:
+    """Create + start a :class:`RouterServer` over ``replicas``."""
+    return RouterServer(Router(replicas, **router_kw), host,
+                        port).start()

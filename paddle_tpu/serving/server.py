@@ -54,6 +54,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -67,6 +68,22 @@ from .engine import OverloadedError, RequestFailed, ServingEngine
 __all__ = ["ServingServer", "serve"]
 
 logger = logging.getLogger("paddle_tpu.serving.http")
+
+# cross-tier trace propagation: the fleet router mints (or forwards) a
+# trace id in this header; the replica's serving/request root span
+# adopts it, so one served request is ONE trace across both tiers
+TRACE_HEADER = "X-PaddleTPU-Trace"
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9_-]{1,64}$")
+
+
+def parse_trace_header(value) -> Optional[str]:
+    """Validate an incoming trace-id header: a short url-safe token or
+    nothing (a malformed id is dropped, never adopted — trace identity
+    must stay greppable and log-safe)."""
+    if not value:
+        return None
+    value = value.strip()
+    return value if _TRACE_ID_RE.match(value) else None
 
 
 class _AccessLog:
@@ -130,27 +147,42 @@ class _AccessLog:
             self._close_locked()
 
 
-class _Handler(BaseHTTPRequestHandler):
-    # set by ServingServer on the subclass
-    engine: ServingEngine = None
-    request_timeout_s: Optional[float] = None
-    access_log: _AccessLog = None
+class _JsonHandler(BaseHTTPRequestHandler):
+    """Shared reply framing for every serving-tier HTTP handler (the
+    replica front end here and the fleet router's): keep-alive
+    HTTP/1.1 with explicit Content-Length and the optional cross-tier
+    trace-id response header — one place to change, so the two tiers'
+    wire framing cannot drift apart."""
+
+    logger = logger  # subclasses re-point at their tier's logger
 
     protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):  # quiet: route through logging
-        logger.debug("%s " + fmt, self.address_string(), *args)
+        self.logger.debug("%s " + fmt, self.address_string(), *args)
 
-    def _reply(self, code: int, payload: dict):
+    def _reply(self, code: int, payload: dict,
+               trace_id: Optional[str] = None):
         body = json.dumps(payload).encode()
-        self._reply_raw(code, body, "application/json")
+        self._reply_raw(code, body, "application/json",
+                        trace_id=trace_id)
 
-    def _reply_raw(self, code: int, body: bytes, content_type: str):
+    def _reply_raw(self, code: int, body: bytes, content_type: str,
+                   trace_id: Optional[str] = None):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        if trace_id:
+            self.send_header(TRACE_HEADER, trace_id)
         self.end_headers()
         self.wfile.write(body)
+
+
+class _Handler(_JsonHandler):
+    # set by ServingServer on the subclass
+    engine: ServingEngine = None
+    request_timeout_s: Optional[float] = None
+    access_log: _AccessLog = None
 
     # -- GET introspection plane --------------------------------------------
     def do_GET(self):
@@ -266,23 +298,25 @@ class _Handler(BaseHTTPRequestHandler):
             return
         stat_add("serving_http_requests")
         t0 = time.monotonic()
+        hop_trace = parse_trace_header(self.headers.get(TRACE_HEADER))
         if route == "/predict":
-            code, payload, trace = self._predict(body)
+            code, payload, trace = self._predict(body, hop_trace)
         else:
-            code, payload, trace = self._generate(body)
-        self._reply(code, payload)
+            code, payload, trace = self._generate(body, hop_trace)
+        tid = ((trace or {}).get("trace_id") or payload.get("trace_id")
+               or hop_trace)
+        self._reply(code, payload, trace_id=tid)
         ms = (time.monotonic() - t0) * 1e3
         rec = {"ts": round(time.time(), 6), "method": "POST",
                "path": route, "status": code, "ms": round(ms, 3),
-               "trace_id": (trace or {}).get("trace_id")
-               or payload.get("trace_id")}
+               "trace_id": tid}
         if trace:
             rec["rows"] = trace.get("rows")
             rec["phases"] = trace.get("phases")
             rec["request_status"] = trace.get("status")
         self.access_log.write(rec)
 
-    def _generate(self, body: bytes):
+    def _generate(self, body: bytes, hop_trace: Optional[str] = None):
         """One POST /generate body — ``{"prompt": [token ids],
         "max_new_tokens": N?}`` — against the attached GenerationEngine.
         404 when no generator is attached, 503 on overload sheds
@@ -303,7 +337,8 @@ class _Handler(BaseHTTPRequestHandler):
                          "detail": f"{type(e).__name__}: {e}"}, None
         t0 = time.monotonic()
         try:
-            fut = self.engine.submit_generate(prompt, max_new_tokens=mnt)
+            fut = self.engine.submit_generate(prompt, max_new_tokens=mnt,
+                                              trace_id=hop_trace)
             res = fut.result(self.request_timeout_s)
         except OverloadedError as e:
             return 503, {"error": "overloaded", "reason": e.reason,
@@ -326,7 +361,7 @@ class _Handler(BaseHTTPRequestHandler):
                               "queue_wait_ms": res.get("queue_wait_ms"),
                               "predict_ms": res.get("prefill_ms")}}
 
-    def _predict(self, body: bytes):
+    def _predict(self, body: bytes, hop_trace: Optional[str] = None):
         """Run one /predict body; returns (http_code, payload,
         trace_record_or_None) so do_POST can both reply and access-log
         without re-deciding anything."""
@@ -341,7 +376,7 @@ class _Handler(BaseHTTPRequestHandler):
         t0 = time.monotonic()
         fut = None
         try:
-            fut = self.engine.submit(inputs)
+            fut = self.engine.submit(inputs, trace_id=hop_trace)
             outputs = fut.result(self.request_timeout_s)
         except OverloadedError as e:
             return 503, {"error": "overloaded", "reason": e.reason,

@@ -249,6 +249,7 @@ class _SpanCtx:
 
 def span_begin(name: str, parent: Optional[SpanContext] = None,
                links=None, detached: bool = False,
+               trace_id: Optional[str] = None,
                **attrs) -> Optional[Span]:
     """Open a span without a ``with`` block (executor hot path); pair
     with :func:`span_end`.  Returns None when telemetry is disabled.
@@ -261,7 +262,11 @@ def span_begin(name: str, parent: Optional[SpanContext] = None,
     stacked span from elsewhere would strand it), or when it outlives
     the caller (a request root span spanning submit→respond must not
     adopt later same-thread spans as children).
-    ``links`` — SpanContexts of other traces to reference."""
+    ``links`` — SpanContexts of other traces to reference.
+    ``trace_id`` — adopt an externally-minted trace id at a root span
+    (the cross-process propagation half: a router/replica hop carries
+    the id in a header and both tiers' spans join one trace).  Ignored
+    when a parent supplies the trace."""
     if not enabled():
         return None
     if parent is not None:
@@ -270,7 +275,8 @@ def span_begin(name: str, parent: Optional[SpanContext] = None,
         stack = _stack()
         top = stack[-1] if stack else None
         parent_id = top.span_id if top is not None else None
-        trace_id = top.trace_id if top is not None else None
+        if top is not None:
+            trace_id = top.trace_id
     span = Span(name, attrs, parent_id, threading.get_ident(),
                 trace_id=trace_id, links=links)
     if not detached:

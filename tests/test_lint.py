@@ -123,19 +123,21 @@ def test_perf_gate_smoke_on_committed_fixtures():
 
 
 def test_every_serving_flag_is_documented_in_readme():
-    """Every registered `FLAGS_serving_*` flag (the sharded-serving
-    mesh/degradation flags included) must appear backtick-quoted in
-    the README flag tables — a serving knob that isn't documented
-    can't be operated, and the sharded topology flags
-    (`FLAGS_serving_mesh`, `FLAGS_serving_group_degraded_after`)
-    change what /healthz reports, so they must never drift
-    undocumented."""
+    """Every registered serving-plane flag — `FLAGS_serving_*` plus
+    the fleet tier's `FLAGS_router_*` / `FLAGS_fleet_*` — must appear
+    backtick-quoted in the README flag tables: a serving knob that
+    isn't documented can't be operated, and the router flags change
+    routing/ejection behavior and the autoscaling signal, so they
+    must never drift undocumented."""
     from paddle_tpu import flags
 
     names = sorted(n for n in flags.all_flags()
-                   if n.startswith("FLAGS_serving"))
+                   if n.startswith(("FLAGS_serving", "FLAGS_router",
+                                    "FLAGS_fleet")))
     assert "FLAGS_serving_mesh" in names  # the lint must see the new
     assert "FLAGS_serving_group_degraded_after" in names  # sharded set
+    assert "FLAGS_router_slo_p99_ms" in names  # ...and the fleet set
+    assert "FLAGS_fleet_max_restarts" in names
     with open(os.path.join(REPO, "README.md"), encoding="utf-8") as f:
         readme = f.read()
     missing = [n for n in names if f"`{n}`" not in readme]

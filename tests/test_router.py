@@ -1,0 +1,594 @@
+"""Fleet front-end matrix: router placement, health ejection,
+readiness gating, trace continuity, crash/rollout availability, and
+the shaped-loadgen per-phase SLO contract.
+
+Two tiers of test: in-process (real ServingServers behind a Router in
+one process — placement, ejection, retry, traces, all deterministic
+via injected health snapshots and a manual poll) and subprocess (a
+real :class:`FleetSupervisor` fleet of replica processes — crash →
+respawn, drain-aware rolling restart, loadgen e2e over live sockets).
+"""
+import importlib.util
+import json
+import os
+import signal
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import telemetry
+from paddle_tpu.serving import (FleetSupervisor, Router, RouterServer,
+                                ServingEngine, serve)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_loadgen():
+    spec = importlib.util.spec_from_file_location(
+        "serving_loadgen_router_tests",
+        os.path.join(REPO, "tools", "serving_loadgen.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+lg = _load_loadgen()
+
+TINY = dict(feat=4, hidden=8, depth=1, classes=2)
+TINY_ARGV = ["--feat", "4", "--hidden", "8", "--depth", "1",
+             "--classes", "2", "--workers", "1", "--max-batch", "2",
+             "--max-delay-ms", "1", "--deadline-ms", "60000"]
+
+
+def _mini_replica(ready_gate=False, warm=True, port=0, **sizes):
+    cfg = dict(TINY, **sizes)
+    predictor, shapes = lg.build_synthetic(**cfg)
+    eng = ServingEngine(predictor, workers=1, max_batch=2,
+                        max_delay_ms=1.0, deadline_ms=60000.0,
+                        ready_requires_warmup=ready_gate)
+    if warm:
+        eng.warmup(shapes)
+    srv = serve(eng, port=port)
+    return eng, srv, shapes
+
+
+def _inject_health(router, url, depth=0, inflight=0, status="ok",
+                   ready=True, age_s=0.0, cap=64):
+    """Deterministic routing-view control: write the health snapshot
+    the poll thread would have produced."""
+    rep = router._replicas[url.rstrip("/")]
+    rep.health = {"status": status, "ready": ready,
+                  "serving": {"queue_depth": depth,
+                              "inflight_rows": inflight,
+                              "queue_cap": cap}}
+    rep.health_ts = time.monotonic() - age_s
+    rep.poll_failures = 0
+    rep.ejected = False
+    return rep
+
+
+def _post(url, body, trace=None, timeout=30.0):
+    headers = {"Content-Type": "application/json"}
+    if trace:
+        headers["X-PaddleTPU-Trace"] = trace
+    req = urllib.request.Request(url + "/predict", data=body,
+                                 headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+BODY = json.dumps({"inputs": {"x": [[0.1, 0.2, 0.3, 0.4]]}}).encode()
+
+
+# ---------------------------------------------------------------------------
+# placement + tiering (unit: injected health, no poll thread)
+# ---------------------------------------------------------------------------
+
+def test_least_loaded_placement_and_tiering():
+    urls = ["http://a:1", "http://b:1", "http://c:1"]
+    router = Router(urls, autostart=False, stale_ms=2000.0)
+    _inject_health(router, urls[0], depth=5)
+    _inject_health(router, urls[1], depth=1)
+    _inject_health(router, urls[2], depth=9)
+    assert router.pick().url == "http://b:1"
+
+    # router-side inflight counts toward the score (burst sensitivity
+    # between polls)
+    router._replicas["http://b:1"].inflight = 10
+    assert router.pick().url == "http://a:1"
+    router._replicas["http://b:1"].inflight = 0
+
+    # degraded: deprioritized below ANY fresh-ok replica, even a
+    # busier one
+    _inject_health(router, urls[1], depth=0, status="degraded")
+    assert router.pick().url == "http://a:1"
+
+    # stale: same second tier
+    _inject_health(router, urls[0], depth=0, age_s=10.0)
+    _inject_health(router, urls[2], depth=3)
+    assert router.pick().url == "http://c:1"
+
+    # a fleet of only stale/degraded replicas still serves (better
+    # than shedding) — least-loaded within the backup tier
+    _inject_health(router, urls[2], depth=3, age_s=10.0)
+    assert router.pick() is not None
+
+    # ejected / not-ready / draining are never picked
+    for u in urls:
+        router._replicas[u].ejected = True
+    assert router.pick() is None
+    _inject_health(router, urls[0], ready=False)
+    assert router.pick() is None
+    _inject_health(router, urls[0], status="draining")
+    assert router.pick() is None
+    # exclusion (the retry path's alternate-pick)
+    _inject_health(router, urls[0])
+    assert router.pick(exclude=("http://a:1",)) is None
+
+
+def test_skewed_load_routes_to_the_idle_replica():
+    """Integration: a replica reporting a deep queue receives nothing
+    while a fresh idle sibling exists."""
+    eng_a, srv_a, shapes = _mini_replica()
+    eng_b, srv_b, _ = _mini_replica()
+    router = Router([srv_a.url, srv_b.url], autostart=False)
+    server = RouterServer(router).start()
+    try:
+        router.poll_once()
+        # replica A suddenly deep in queue (snapshot injected; no poll
+        # thread to overwrite it)
+        _inject_health(router, srv_a.url, depth=50)
+        for _ in range(10):
+            code, _, _ = _post(server.url, BODY)
+            assert code == 200
+        assert eng_b.stats()["counters"]["requests"] == 10
+        assert eng_a.stats()["counters"]["requests"] == 0
+        st = router.stats()
+        assert st["counters"]["routed"] == 10
+        by_url = {r["url"]: r for r in st["replicas"]}
+        assert by_url[srv_b.url]["routed"] == 10
+        assert by_url[srv_a.url]["routed"] == 0
+    finally:
+        server.close()
+        srv_a.close()
+        srv_b.close()
+
+
+# ---------------------------------------------------------------------------
+# empty-fleet 503 + readiness gating
+# ---------------------------------------------------------------------------
+
+def test_no_ready_replicas_503_and_warmup_readiness_gate():
+    router = Router([], autostart=False)
+    server = RouterServer(router).start()
+    eng = srv = None
+    try:
+        # empty fleet: explicit 503 with the documented reason
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.url, BODY)
+        assert e.value.code == 503
+        doc = json.loads(e.value.read())
+        assert doc["reason"] == "no_ready_replicas"
+        code, payload = router.healthz()
+        assert code == 503 and payload["status"] == "no_ready_replicas"
+
+        # a warming replica (ready_requires_warmup, buckets not yet
+        # primed) registers but is NOT routable
+        eng, srv, shapes = _mini_replica(ready_gate=True, warm=False)
+        router.add_replica(srv.url)
+        router.poll_once()
+        assert eng.health()["ready"] is False
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.url, BODY)
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["reason"] == \
+            "no_ready_replicas"
+
+        # warmup primes the buckets -> ready flips -> traffic flows
+        eng.warmup(shapes)
+        assert eng.health()["ready"] is True
+        router.poll_once()
+        code, _, _ = _post(server.url, BODY)
+        assert code == 200
+        assert router.healthz()[0] == 200
+    finally:
+        server.close()
+        if srv is not None:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# stale-health ejection + recovery, retry-on-connect-refused
+# ---------------------------------------------------------------------------
+
+def test_stale_health_ejection_and_recovery():
+    eng, srv, shapes = _mini_replica()
+    port = srv.port
+    router = Router([srv.url], autostart=False, stale_ms=400.0,
+                    eject_after=2)
+    server = RouterServer(router).start()
+    try:
+        router.poll_once()
+        assert router.pick() is not None
+
+        # kill the replica: polls fail, the replica ejects after the
+        # configured streak, the fleet goes empty
+        url = srv.url
+        srv.close()
+        router.poll_once()
+        router.poll_once()
+        snap = router.stats()["replicas"][0]
+        assert snap["ejected"] is True and snap["poll_failures"] >= 2
+        assert router.pick() is None
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.url, BODY)
+        assert e.value.code == 503
+
+        # a new process binds the SAME port (the fleet supervisor pins
+        # ports for exactly this reason): one good poll re-admits it
+        eng2, srv2, _ = _mini_replica(port=port)
+        assert srv2.url == url
+        try:
+            router.poll_once()
+            snap = router.stats()["replicas"][0]
+            assert snap["ejected"] is False
+            assert router.stats()["counters"]["recoveries"] >= 1
+            code, _, _ = _post(server.url, BODY)
+            assert code == 200
+        finally:
+            srv2.close()
+    finally:
+        server.close()
+
+
+def test_retry_on_connect_refused_lands_on_alternate():
+    eng_b, srv_b, shapes = _mini_replica()
+    dead_url = f"http://127.0.0.1:{_free_port()}"
+    router = Router([dead_url, srv_b.url], autostart=False,
+                    eject_after=1)
+    server = RouterServer(router).start()
+    try:
+        router.poll_once()
+        # forge the dead replica as the less-loaded fresh choice so
+        # the router tries it FIRST
+        _inject_health(router, dead_url, depth=0)
+        _inject_health(router, srv_b.url, depth=5)
+        code, doc, _ = _post(server.url, BODY)
+        assert code == 200 and "outputs" in doc
+        st = router.stats()
+        assert st["counters"]["retries"] == 1
+        by_url = {r["url"]: r for r in st["replicas"]}
+        assert by_url[srv_b.url]["retries_to"] == 1
+        # the connect failure counted as a health strike -> with
+        # eject_after=1 the dead replica is already out
+        assert by_url[dead_url]["ejected"] is True
+    finally:
+        server.close()
+        srv_b.close()
+
+
+# ---------------------------------------------------------------------------
+# trace continuity across the hop
+# ---------------------------------------------------------------------------
+
+def test_trace_continuity_across_router_hop(tmp_path):
+    pt.set_flags({"FLAGS_telemetry": True, "FLAGS_trace_sample": 1.0,
+                  "FLAGS_serving_access_log":
+                      str(tmp_path / "access.jsonl")})
+    try:
+        eng, srv, shapes = _mini_replica()
+        router = Router([srv.url], autostart=False)
+        server = RouterServer(router).start()
+        try:
+            router.poll_once()
+            wanted = "cafef00d" * 3  # caller-supplied trace id
+            code, doc, headers = _post(server.url, BODY, trace=wanted)
+            assert code == 200
+            # the response carries the id end to end
+            assert doc["trace_id"] == wanted
+            assert headers.get("X-PaddleTPU-Trace") == wanted
+            # ...and a request WITHOUT a header gets a router-minted id
+            code, doc2, _ = _post(server.url, BODY)
+            assert code == 200 and doc2["trace_id"]
+
+            # one trace across both tiers: the router hop spans AND the
+            # replica's serving spans share the caller's trace id
+            names = {s.name for s in telemetry.get_spans()
+                     if s.trace_id == wanted}
+            assert {"router/request", "router/forward",
+                    "serving/request", "serving/predict"} <= names
+
+            # both access logs name the trace: the router line is
+            # tagged tier=router, the replica line carries phases
+            with open(tmp_path / "access.jsonl") as f:
+                recs = [json.loads(line) for line in f]
+            mine = [r for r in recs if r["trace_id"] == wanted]
+            tiers = {r.get("tier", "replica") for r in mine}
+            assert tiers == {"router", "replica"}
+        finally:
+            server.close()
+            srv.close()
+    finally:
+        pt.set_flags({"FLAGS_serving_access_log": ""})
+
+
+def test_router_metrics_scrape_is_strict_prometheus():
+    pt.set_flags({"FLAGS_telemetry": True})
+    spec = importlib.util.spec_from_file_location(
+        "check_stat_catalog_router_tests",
+        os.path.join(REPO, "tools", "check_stat_catalog.py"))
+    csc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(csc)
+
+    eng, srv, shapes = _mini_replica()
+    router = Router([srv.url], autostart=False)
+    server = RouterServer(router).start()
+    try:
+        router.poll_once()
+        assert _post(server.url, BODY)[0] == 200
+        with urllib.request.urlopen(server.url + "/metrics",
+                                    timeout=30) as r:
+            assert r.status == 200
+            assert "version=0.0.4" in r.headers["Content-Type"]
+            text = r.read().decode()
+    finally:
+        server.close()
+        srv.close()
+    errs = csc.validate_exposition(text)
+    assert errs == [], errs[:10]
+    assert "paddle_tpu_router_http_requests" in text
+    assert "paddle_tpu_fleet_wanted_replicas" in text
+
+
+# ---------------------------------------------------------------------------
+# traffic shapes + per-phase SLO (loadgen units)
+# ---------------------------------------------------------------------------
+
+def test_traffic_shape_math_and_per_phase_slo():
+    sine = lg.TrafficShape("sine", 100.0, 8.0, amplitude=1.0)
+    assert sine.rate(2.0) == pytest.approx(200.0)   # crest of 1 cycle
+    assert sine.rate(6.0) == pytest.approx(5.0)     # clamped trough
+    assert sine.phase(2.0) == "crest"
+    assert sine.phase(6.0) == "trough"
+
+    burst = lg.TrafficShape("burst", 100.0, 8.0, amplitude=2.0,
+                            period_s=2.0, burst_frac=0.25)
+    assert burst.rate(0.1) == pytest.approx(300.0)
+    assert burst.rate(1.0) == pytest.approx(100.0)
+    assert burst.phase(2.1) == "burst" and burst.phase(3.0) == "base"
+
+    step = lg.TrafficShape("step", 100.0, 8.0, amplitude=0.5)
+    assert step.rate(1.0) == pytest.approx(100.0)
+    assert step.rate(5.0) == pytest.approx(150.0)
+    assert step.phase(1.0) == "low" and step.phase(5.0) == "high"
+
+    with pytest.raises(ValueError):
+        lg.TrafficShape("square", 1.0, 1.0)
+
+    # per-phase SLO: a crest that sheds must fail even when the run's
+    # aggregate passes
+    rep = {"mode": "open", "requests": 100, "ok": 95, "shed": 5,
+           "failed": 0, "shed_rate": 0.05,
+           "latency_ms": {"count": 95, "p99": 10.0},
+           "phases": {
+               "crest": {"requests": 50, "ok": 45, "shed": 5,
+                         "failed": 0, "shed_rate": 0.10,
+                         "latency_ms": {"count": 45, "p99": 30.0}},
+               "trough": {"requests": 50, "ok": 50, "shed": 0,
+                          "failed": 0, "shed_rate": 0.0,
+                          "latency_ms": {"count": 50, "p99": 5.0}},
+               "never": {"requests": 0, "ok": 0, "shed": 0,
+                         "failed": 0, "shed_rate": 0.0,
+                         "latency_ms": {"count": 0}},
+           }}
+    slo = lg.check_slo(rep, p99_ms=20.0, shed_pct=8.0)
+    assert not slo["ok"]
+    joined = " ".join(slo["violations"])
+    assert "open[crest]" in joined and "trough" not in joined
+    assert "never" not in joined  # a phase the clock never entered
+    # generous budgets pass every phase
+    assert lg.check_slo(rep, p99_ms=50.0, shed_pct=20.0)["ok"]
+
+
+def test_shaped_open_loop_reports_phases():
+    eng, srv, shapes = _mini_replica()
+    try:
+        traffic = lg.TrafficShape("burst", 80.0, 1.0, amplitude=1.0,
+                                  period_s=0.5, burst_frac=0.5)
+        rep = lg.run_open_loop(eng, lg.feed_maker(shapes, rows=1),
+                               qps=80.0, duration_s=1.0,
+                               traffic=traffic)
+        assert rep["traffic"]["shape"] == "burst"
+        assert set(rep["phases"]) <= {"burst", "base"}
+        assert sum(p["requests"] for p in rep["phases"].values()) \
+            == rep["requests"]
+        for p in rep["phases"].values():
+            assert p["ok"] + p["shed"] + p["failed"] == p["requests"]
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# live fleet (subprocess replicas): crash, rollout, loadgen e2e
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet():
+    sup = FleetSupervisor(replicas=2, replica_argv=TINY_ARGV,
+                          max_restarts=3, backoff_ms=100.0)
+    try:
+        sup.wait_ready(timeout_s=240)
+        yield sup
+    finally:
+        sup.close()
+
+
+def _router_over(fleet_sup):
+    router = Router(fleet_sup.endpoints(), poll_interval_ms=60.0,
+                    stale_ms=2000.0, eject_after=2)
+    server = RouterServer(router).start()
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        router.poll_once()
+        if router.stats()["routable"] == len(fleet_sup.endpoints()):
+            return router, server
+        time.sleep(0.1)
+    server.close()
+    raise AssertionError("fleet never became fully routable")
+
+
+def test_fleet_replica_crash_respawns_without_nonshed_failures(fleet):
+    router, server = _router_over(fleet)
+    make_feed = lg.feed_maker({"x": (4,)}, rows=1)
+    box = {}
+
+    def _traffic():
+        box["rep"] = lg.run_open_loop_http(server.url, make_feed,
+                                           qps=40.0, duration_s=6.0)
+
+    try:
+        t = threading.Thread(target=_traffic, daemon=True)
+        t.start()
+        time.sleep(1.0)
+        victim = fleet._replicas[0]
+        old_pid = victim.proc.pid
+        os.kill(old_pid, signal.SIGKILL)
+        t.join(timeout=60.0)
+        assert not t.is_alive()
+        rep = box["rep"]
+        # the router keeps serving through the crash: connect-refused
+        # requests retry onto the surviving replica; only requests
+        # IN FLIGHT on the victim at the kill instant may fail
+        assert rep["ok"] > 0.8 * rep["requests"], rep
+        assert rep["failed"] <= 8, rep
+        # the supervisor respawned the victim at the same URL
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if victim.proc.pid != old_pid \
+                    and victim.proc.poll() is None:
+                h = None
+                try:
+                    with urllib.request.urlopen(
+                            victim.url + "/healthz", timeout=2) as r:
+                        h = json.loads(r.read())
+                except OSError:
+                    pass  # ok: successor still binding/warming
+                if h and h.get("ready"):
+                    break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("crashed replica never respawned "
+                                 "ready")
+        assert victim.crash_restarts == 1
+        router.poll_once()
+        assert _post(server.url, BODY)[0] == 200
+    finally:
+        server.close()
+
+
+def test_rolling_restart_zero_nonshed_failure_window(fleet):
+    router, server = _router_over(fleet)
+    make_feed = lg.feed_maker({"x": (4,)}, rows=1)
+    box = {}
+
+    def _traffic():
+        box["rep"] = lg.run_open_loop_http(server.url, make_feed,
+                                           qps=30.0, duration_s=14.0)
+
+    try:
+        t = threading.Thread(target=_traffic, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        report = fleet.rolling_restart(ready_timeout_s=120.0)
+        t.join(timeout=90.0)
+        assert not t.is_alive()
+        # the rollout itself: every replica drained (exit 0) and its
+        # successor reported ready before the next one went down
+        for entry in report["replicas"]:
+            assert entry.get("exit_rc") == 0, report
+            assert entry.get("successor_ready") is True, report
+        # the availability contract: ZERO non-shed failures across the
+        # whole window (sheds are allowed — they are explicit
+        # backpressure — failures are not)
+        rep = box["rep"]
+        assert rep["failed"] == 0, rep
+        assert rep["ok"] > 0, rep
+    finally:
+        server.close()
+
+
+def test_fleet_replica_serves_generate_through_router():
+    """A --generate replica serves routed POST /generate (without the
+    flag the replica's 404 passes through verbatim — README contract);
+    the trace header is adopted by the generation path too."""
+    sup = FleetSupervisor(
+        replicas=1,
+        replica_argv=TINY_ARGV + ["--generate", "--gen-vocab", "32",
+                                  "--gen-hidden", "16",
+                                  "--gen-layers", "1",
+                                  "--gen-heads", "2",
+                                  "--gen-intermediate", "32",
+                                  "--gen-slots", "2",
+                                  "--gen-max-seq", "32"],
+        max_restarts=0)
+    server = None
+    try:
+        sup.wait_ready(timeout_s=240)
+        router, server = _router_over(sup)
+        body = json.dumps({"prompt": [1, 2, 3],
+                           "max_new_tokens": 4}).encode()
+        req = urllib.request.Request(
+            server.url + "/generate", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-PaddleTPU-Trace": "feedc0de01"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            doc = json.loads(r.read())
+            assert r.status == 200
+        assert doc["tokens"] and doc["finish"] in ("eos", "length",
+                                                   "cache_full")
+        assert doc["trace_id"] == "feedc0de01"
+    finally:
+        if server is not None:
+            server.close()
+        sup.close()
+
+
+def test_loadgen_live_fleet_e2e_with_per_phase_slo(fleet, tmp_path):
+    router, server = _router_over(fleet)
+    out = tmp_path / "report.json"
+    try:
+        rc = lg.main(["--url", server.url, "--feat", "4",
+                      "--mode", "open", "--qps", "30",
+                      "--duration", "2.0",
+                      "--shape", "burst", "--traffic-amplitude", "1.0",
+                      "--slo-p99-ms", "30000", "--slo-shed-pct", "60",
+                      "--out", str(out)])
+        assert rc == 0
+        rep = json.loads(out.read_text())
+        assert rep["traffic"]["shape"] == "burst"
+        assert rep["phases"] and rep["slo"]["ok"]
+        # per-phase SLO goes load-bearing: an impossible p99 budget
+        # must fail with phase-labeled violations and exit 1
+        rc = lg.main(["--url", server.url, "--feat", "4",
+                      "--mode", "open", "--qps", "30",
+                      "--duration", "1.0",
+                      "--traffic", "sine", "--slo-p99-ms", "0.001",
+                      "--out", str(out)])
+        assert rc == 1
+        rep = json.loads(out.read_text())
+        assert any("[" in v for v in rep["slo"]["violations"])
+    finally:
+        server.close()

@@ -105,6 +105,27 @@ def compare_leg(name: str, new: dict, base: dict,
                    reason="mp2 weight-sharded serving no longer "
                           "bit-exact vs the unsharded predictor")
         return res
+    # router rollout-availability rule, also checked before every
+    # skip: the rolling-restart contract is ZERO non-shed request
+    # failures across the window — a failure is a correctness break
+    # (drain or retry stopped working), which core contention can
+    # slow down but never cause
+    rollout = new.get("rollout")
+    if isinstance(rollout, dict):
+        failed = rollout.get("failed")
+        if failed is None:
+            # the window measured nothing (traffic thread died/hung):
+            # a vacuous pass must not satisfy the zero-failure contract
+            res.update(status="regression",
+                       reason="rolling-restart window has no measured "
+                              "failure count (traffic produced no "
+                              "report)")
+            return res
+        if failed > 0:
+            res.update(status="regression",
+                       reason=f"rolling restart saw {failed} non-shed "
+                              f"request failure(s) (contract: zero)")
+            return res
     nk, bk = new.get("device_kind"), base.get("device_kind")
     if nk is not None and bk is not None and nk != bk:
         res.update(status="skipped",
@@ -164,6 +185,18 @@ def compare_leg(name: str, new: dict, base: dict,
         res.update(status="regression",
                    reason=f"dp p99 now {p99r_new}x the single-chip "
                           f"p99 (was {p99r_base}x; tol {tol})")
+    # router-leg extra: the fleet tier's contract is >= 2x closed-loop
+    # qps at 4 replicas vs 1 — raw qps can track the baseline while
+    # the scaling itself quietly collapses (e.g. the router started
+    # serializing on one replica), so the ratio gates explicitly when
+    # the baseline proved it on this device kind
+    s4_new = new.get("speedup_4v1")
+    s4_base = base.get("speedup_4v1")
+    if res["status"] == "ok" and s4_new is not None \
+            and s4_base is not None and s4_new < 2.0 <= s4_base:
+        res.update(status="regression",
+                   reason=f"speedup_4v1 fell to {s4_new} (< 2x fleet "
+                          f"scaling contract; baseline {s4_base})")
     return res
 
 
@@ -378,6 +411,68 @@ def run_smoke() -> int:
     r = compare_bench(core_bound, docs + [with_sharded])
     check("sharded core-bound capture skips", r["ok"] and any(
         x["leg"] == "sharded_serving" and x["status"] == "skipped"
+        for x in r["legs"]))
+
+    # router leg (synthetic capable-host fixture, like the sharded
+    # one: the 2-core CI host flags its own captures anomalous, so the
+    # >=2x-at-4-replicas and zero-rollout-failure contracts are proven
+    # on fixture numbers): generic noise gate + the speedup_4v1 floor
+    # + the rollout-failure rule (which no anomaly/mismatch shields)
+    router_leg = {
+        "metric": "router_fleet4_closed_loop_qps",
+        "value": 3600.0, "unit": "requests/sec", "device_kind": "cpu",
+        "stats": {"rounds": 3, "median": 3600.0, "p10": 3450.0,
+                  "p90": 3750.0, "min": 3400.0, "max": 3800.0},
+        "p99_ms": 16.0, "direct_qps": 1000.0, "direct_p99_ms": 15.0,
+        "qps_by_replicas": {"1": 950.0, "2": 1880.0, "4": 3600.0},
+        "speedup_4v1": 3.79, "p99_vs_direct": 1.07,
+        "rollout": {"requests": 600, "ok": 588, "shed": 12,
+                    "failed": 0, "rollout_s": 9.5},
+    }
+    with_router = json.loads(json.dumps(latest))
+    with_router.setdefault("legs", {})["router"] = router_leg
+    r = compare_bench(with_router, docs + [with_router])
+    check("router self-compare passes", r["ok"],
+          json.dumps([x for x in r["legs"]
+                      if x["status"] == "regression"]))
+    r = compare_bench(_degrade(with_router, 0.70), docs + [with_router])
+    check("router 30%-degraded fails", not r["ok"])
+    collapsed = json.loads(json.dumps(with_router))
+    collapsed["legs"]["router"]["speedup_4v1"] = 1.5
+    r = compare_bench(collapsed, docs + [with_router])
+    check("router scaling-collapse fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "speedup_4v1" in x.get("reason", "") for x in r["legs"]))
+    broken_rollout = json.loads(json.dumps(with_router))
+    broken_rollout["legs"]["router"]["rollout"]["failed"] = 3
+    # an anomaly flag must NOT shield a rollout-availability break
+    broken_rollout["legs"]["router"]["anomaly"] = "core-bound host"
+    r = compare_bench(broken_rollout, docs + [with_router])
+    check("router rollout-failure fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "rolling restart" in x.get("reason", "")
+        for x in r["legs"]))
+    anom_router_base = json.loads(json.dumps(with_router))
+    anom_router_base["legs"]["router"]["anomaly"] = "core-bound host"
+    r = compare_bench(broken_rollout, docs + [anom_router_base])
+    check("router rollout-failure fails past anomalous baseline",
+          not r["ok"])
+    vacuous = json.loads(json.dumps(with_router))
+    vacuous["legs"]["router"]["rollout"] = {
+        "requests": None, "ok": None, "shed": None, "failed": None,
+        "error": "rollout traffic produced no report"}
+    r = compare_bench(vacuous, docs + [with_router])
+    check("router vacuous-rollout fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "no measured failure count" in x.get("reason", "")
+        for x in r["legs"]))
+    core_bound_router = json.loads(json.dumps(with_router))
+    core_bound_router["legs"]["router"]["anomaly"] = \
+        "host has 2 cores for 4 replica processes"
+    core_bound_router["legs"]["router"]["speedup_4v1"] = 1.1
+    r = compare_bench(core_bound_router, docs + [with_router])
+    check("router core-bound capture skips", r["ok"] and any(
+        x["leg"] == "router" and x["status"] == "skipped"
         for x in r["legs"]))
 
     # op gate on its own committed baseline

@@ -20,6 +20,15 @@ instead of in-process engine stats) — and emits one JSON report:
   the engine does, so overload actually overloads).
 * ``--mode both`` runs closed then open and nests the two reports.
 
+**Traffic shapes** (``--traffic const|sine|burst|step``, or a bare
+``--shape sine``): the open-loop clock follows a diurnal ``sine``,
+periodic ``burst``, or capacity-cliff ``step`` profile
+(:class:`TrafficShape`; ``--traffic-amplitude`` / ``--traffic-period``
+/ ``--traffic-burst-frac`` size it).  The report gains a ``phases``
+block — per-phase requests / qps / p99 / shed — and the SLO
+assertions below are evaluated in EVERY phase, so overload at the
+crest fails the run even when the trough averages it away.
+
 **SLO assertions** (ROADMAP item 5 — capacity regressions fail
 loudly): ``--slo-p99-ms X`` and/or ``--slo-shed-pct Y`` make the run
 load-bearing — the report gains an ``"slo"`` block listing every
@@ -117,6 +126,166 @@ def feed_maker(shapes: Dict[str, tuple], rows: int = 1,
 
 
 # ---------------------------------------------------------------------------
+# traffic shapes (open loop): diurnal / bursty offered-load profiles
+# ---------------------------------------------------------------------------
+
+TRAFFIC_SHAPES = ("const", "sine", "burst", "step")
+
+
+class TrafficShape:
+    """Time-varying offered load for the open loop.
+
+    Real traffic is not a constant-qps clock: it swells and ebbs
+    (diurnal), spikes (retry storms, cache stampedes), and steps
+    (a feature launch).  ``rate(t)`` gives the instantaneous target
+    qps at ``t`` seconds into the run and ``phase(t)`` labels the
+    regime, so the report can show qps/p99/shed PER PHASE — overload
+    behavior at the crest is visible instead of averaged away by the
+    trough.
+
+    * ``const`` — ``base`` throughout (phase ``steady``; the legacy
+      behavior).
+    * ``sine`` — ``base * (1 + A*sin(2πt/period))``: a compressed
+      diurnal curve (phases ``crest`` / ``trough``); default period =
+      the whole run (one cycle).
+    * ``burst`` — ``base`` with ``base*(1+A)`` bursts for the first
+      ``burst_frac`` of every period (phases ``burst`` / ``base``);
+      default period = duration/4 (four bursts).
+    * ``step`` — ``base`` for the first half, ``base*(1+A)`` after
+      (phases ``low`` / ``high``): a capacity cliff.
+
+    ``amplitude`` is relative: 1.0 doubles the rate at the peak."""
+
+    def __init__(self, shape: str, base_qps: float, duration_s: float,
+                 amplitude: float = 1.0,
+                 period_s: Optional[float] = None,
+                 burst_frac: float = 0.25):
+        if shape not in TRAFFIC_SHAPES:
+            raise ValueError(f"unknown traffic shape {shape!r}; "
+                             f"one of {TRAFFIC_SHAPES}")
+        self.shape = shape
+        self.base = float(base_qps)
+        self.duration = float(duration_s)
+        self.amplitude = float(amplitude)
+        if period_s is None:
+            period_s = duration_s if shape == "sine" \
+                else max(duration_s / 4.0, 1e-3)
+        self.period = float(period_s)
+        self.burst_frac = float(burst_frac)
+
+    def rate(self, t: float) -> float:
+        b, a = self.base, self.amplitude
+        if self.shape == "sine":
+            import math
+            r = b * (1.0 + a * math.sin(2.0 * math.pi * t / self.period))
+            return max(r, 0.05 * b)  # the trough still offers load
+        if self.shape == "burst":
+            return b * (1.0 + a) if (t % self.period) \
+                < self.burst_frac * self.period else b
+        if self.shape == "step":
+            return b * (1.0 + a) if t >= self.duration / 2.0 else b
+        return b
+
+    def phase(self, t: float) -> str:
+        if self.shape == "sine":
+            import math
+            return "crest" if math.sin(
+                2.0 * math.pi * t / self.period) >= 0.0 else "trough"
+        if self.shape == "burst":
+            return "burst" if (t % self.period) \
+                < self.burst_frac * self.period else "base"
+        if self.shape == "step":
+            return "high" if t >= self.duration / 2.0 else "low"
+        return "steady"
+
+    def describe(self) -> dict:
+        return {"shape": self.shape, "base_qps": self.base,
+                "amplitude": self.amplitude,
+                "period_s": round(self.period, 3),
+                "burst_frac": self.burst_frac
+                if self.shape == "burst" else None}
+
+
+def _arrival_clock(qps: float, duration_s: float,
+                   traffic: Optional[TrafficShape] = None):
+    """Paced arrival generator: yields ``(i, phase, now)`` at each
+    arrival instant.  With ``traffic`` the inter-arrival gap follows
+    the shape's instantaneous rate; without, a fixed ``1/qps`` clock
+    (byte-identical to the legacy pacing)."""
+    t0 = time.monotonic()
+    end = t0 + duration_s
+    next_at = t0
+    n = 0
+    while True:
+        now = time.monotonic()
+        if now >= end:
+            return
+        if now < next_at:
+            time.sleep(min(next_at - now, 0.01))
+            continue
+        rel = next_at - t0
+        rate = traffic.rate(rel) if traffic is not None else qps
+        phase = traffic.phase(rel) if traffic is not None else None
+        next_at += 1.0 / max(rate, 1e-6)
+        yield n, phase, now
+        n += 1
+
+
+class _PhaseBook:
+    """Per-phase tallies for a shaped open-loop run.
+
+    Phase time is ACTIVE time — the sum of inter-arrival gaps spent
+    inside each contiguous visit to the phase — not last-arrival minus
+    first-arrival.  A periodic shape (`burst`, `sine`, multi-cycle
+    `step`) re-enters a phase many times across the run; first-to-last
+    would span every interval spent in the OTHER phases and dilute the
+    reported qps/offered_qps by the duty cycle."""
+
+    def __init__(self):
+        self.phases: Dict[str, dict] = {}
+        self._cur_phase: Optional[str] = None
+        self._last_ts: Optional[float] = None
+
+    def _get(self, phase: str) -> dict:
+        ph = self.phases.get(phase)
+        if ph is None:
+            ph = self.phases[phase] = {
+                "requests": 0, "ok": 0, "shed": 0, "failed": 0,
+                "lat": [], "active_s": 0.0}
+        return ph
+
+    def arrival(self, phase: str, now: float):
+        ph = self._get(phase)
+        ph["requests"] += 1
+        if self._cur_phase == phase and self._last_ts is not None:
+            ph["active_s"] += now - self._last_ts
+        self._cur_phase = phase
+        self._last_ts = now
+
+    def outcome(self, phase: str, outcome: str,
+                ms: Optional[float] = None):
+        ph = self._get(phase)
+        ph[outcome] += 1
+        if ms is not None:
+            ph["lat"].append(ms)
+
+    def report(self) -> Dict[str, dict]:
+        out = {}
+        for name, ph in self.phases.items():
+            wall = max(ph["active_s"], 1e-3)
+            out[name] = {
+                "requests": ph["requests"], "ok": ph["ok"],
+                "shed": ph["shed"], "failed": ph["failed"],
+                "qps": round(ph["ok"] / wall, 2),
+                "offered_qps": round(ph["requests"] / wall, 2),
+                "shed_rate": round(ph["shed"] / max(ph["requests"], 1),
+                                   4),
+                "latency_ms": _percentiles(ph["lat"]),
+            }
+        return out
+
+
+# ---------------------------------------------------------------------------
 # loops
 # ---------------------------------------------------------------------------
 
@@ -189,15 +358,19 @@ def run_closed_loop(engine, make_feed, n_requests: int,
 
 
 def run_open_loop(engine, make_feed, qps: float, duration_s: float,
-                  timeout_s: float = 60.0, collectors: int = 8) -> dict:
+                  timeout_s: float = 60.0, collectors: int = 8,
+                  traffic: Optional[TrafficShape] = None) -> dict:
     """Fixed-rate arrivals: one pacing thread submits on a ``1/qps``
     clock; a collector pool stamps completions.  Sheds at submit() count
     against the offered load (that IS the overload behavior under
-    test)."""
+    test).  ``traffic`` (a :class:`TrafficShape`) replaces the fixed
+    clock with a diurnal/bursty profile and adds per-phase qps/p99/shed
+    to the report."""
     from paddle_tpu.serving import OverloadedError, ServingError
 
     lat, lock = [], threading.Lock()
     counts = {"ok": 0, "shed": 0, "failed": 0}
+    phases = _PhaseBook() if traffic is not None else None
     pending: queue_mod.Queue = queue_mod.Queue()
 
     def collector():
@@ -205,46 +378,46 @@ def run_open_loop(engine, make_feed, qps: float, duration_s: float,
             item = pending.get()
             if item is None:
                 return
-            fut, t0 = item
+            fut, t0, phase = item
             try:
                 fut.result(timeout_s)
                 ms = (time.monotonic() - t0) * 1e3
                 with lock:
                     counts["ok"] += 1
                     lat.append(ms)
+                    if phases is not None:
+                        phases.outcome(phase, "ok", ms)
             except OverloadedError:
                 with lock:
                     counts["shed"] += 1
+                    if phases is not None:
+                        phases.outcome(phase, "shed")
             except (ServingError, TimeoutError):
                 with lock:
                     counts["failed"] += 1
+                    if phases is not None:
+                        phases.outcome(phase, "failed")
 
     pool = [threading.Thread(target=collector, daemon=True)
             for _ in range(collectors)]
     for t in pool:
         t.start()
 
-    period = 1.0 / qps
     n = 0
     t0 = time.monotonic()
-    end = t0 + duration_s
-    next_at = t0
-    while True:
-        now = time.monotonic()
-        if now >= end:
-            break
-        if now < next_at:
-            time.sleep(min(next_at - now, 0.01))
-            continue
-        next_at += period
-        i = n
-        n += 1
+    for i, phase, now in _arrival_clock(qps, duration_s, traffic):
+        n = i + 1
+        if phases is not None:
+            with lock:
+                phases.arrival(phase, now)
         try:
             fut = engine.submit(make_feed(i))
-            pending.put((fut, now))
+            pending.put((fut, now, phase))
         except OverloadedError:
             with lock:
                 counts["shed"] += 1
+                if phases is not None:
+                    phases.outcome(phase, "shed")
     for _ in pool:
         pending.put(None)
     for t in pool:
@@ -253,6 +426,9 @@ def run_open_loop(engine, make_feed, qps: float, duration_s: float,
     rep = _report("open", n, counts["ok"], counts["shed"],
                   counts["failed"], wall, lat, engine)
     rep["target_qps"] = qps
+    if traffic is not None:
+        rep["traffic"] = traffic.describe()
+        rep["phases"] = phases.report()
     return rep
 
 
@@ -454,7 +630,14 @@ def _encode_bodies(make_feed, n: int = 16) -> List[bytes]:
 
 def _http_predict(url: str, body: bytes, timeout_s: float) -> str:
     """One POST /predict -> 'ok' | 'shed' (503 backpressure) |
-    'failed'."""
+    'failed'.
+
+    Not every 503 is a shed: a replica's admission 503s (queue_full /
+    deadline / draining) are explicit backpressure and count as shed,
+    but the fleet router's ``no_ready_replicas`` 503 means ZERO
+    routable replicas — total availability loss, the exact event the
+    rolling-restart zero-non-shed-failure contract exists to catch —
+    and must count as failed, never as an allowed shed."""
     req = urllib.request.Request(
         url, data=body, headers={"Content-Type": "application/json"})
     try:
@@ -463,10 +646,16 @@ def _http_predict(url: str, body: bytes, timeout_s: float) -> str:
             return "ok"
     except urllib.error.HTTPError as e:
         try:
-            e.read()  # drain: keep-alive must not desync
+            payload = e.read()  # drain: keep-alive must not desync
         except OSError:
-            pass  # ok: error body already gone with the connection
-        return "shed" if e.code == 503 else "failed"
+            payload = b""  # ok: error body gone with the connection
+        if e.code != 503:
+            return "failed"
+        try:
+            reason = json.loads(payload).get("reason")
+        except (ValueError, AttributeError):
+            reason = None
+        return "failed" if reason == "no_ready_replicas" else "shed"
     except (OSError, TimeoutError, ValueError):
         return "failed"
 
@@ -526,17 +715,20 @@ def run_closed_loop_http(base_url: str, make_feed, n_requests: int,
 
 def run_open_loop_http(base_url: str, make_feed, qps: float,
                        duration_s: float, timeout_s: float = 60.0,
-                       collectors: int = 16) -> dict:
+                       collectors: int = 16,
+                       traffic: Optional[TrafficShape] = None) -> dict:
     """Open loop over HTTP: one pacing thread enqueues request bodies
     on a ``1/qps`` clock; a poster pool sends them.  Arrivals stay on
     the clock regardless of completions (the client-side queue absorbs
     a slow server, so offered load does not back off), though with
     every poster busy the in-flight concurrency caps at the pool
-    size."""
+    size.  ``traffic`` shapes the clock (diurnal/bursty) and adds
+    per-phase qps/p99/shed to the report."""
     url = base_url.rstrip("/") + "/predict"
     bodies = _encode_bodies(make_feed)
     lat, lock = [], threading.Lock()
     counts = {"ok": 0, "shed": 0, "failed": 0}
+    phases = _PhaseBook() if traffic is not None else None
     pending: queue_mod.Queue = queue_mod.Queue()
 
     def poster():
@@ -544,34 +736,30 @@ def run_open_loop_http(base_url: str, make_feed, qps: float,
             item = pending.get()
             if item is None:
                 return
-            body, t0 = item
+            body, t0, phase = item
             outcome = _http_predict(url, body, timeout_s)
             ms = (time.monotonic() - t0) * 1e3
             with lock:
                 counts[outcome] += 1
                 if outcome == "ok":
                     lat.append(ms)
+                if phases is not None:
+                    phases.outcome(phase, outcome,
+                                   ms if outcome == "ok" else None)
 
     pool = [threading.Thread(target=poster, daemon=True)
             for _ in range(collectors)]
     for t in pool:
         t.start()
 
-    period = 1.0 / qps
     n = 0
     t0 = time.monotonic()
-    end = t0 + duration_s
-    next_at = t0
-    while True:
-        now = time.monotonic()
-        if now >= end:
-            break
-        if now < next_at:
-            time.sleep(min(next_at - now, 0.01))
-            continue
-        next_at += period
-        pending.put((bodies[n % len(bodies)], now))
-        n += 1
+    for i, phase, now in _arrival_clock(qps, duration_s, traffic):
+        n = i + 1
+        if phases is not None:
+            with lock:
+                phases.arrival(phase, now)
+        pending.put((bodies[i % len(bodies)], now, phase))
     for _ in pool:
         pending.put(None)
     for t in pool:
@@ -582,6 +770,9 @@ def run_open_loop_http(base_url: str, make_feed, qps: float,
     rep["target_qps"] = qps
     rep["url"] = base_url
     rep["statusz"] = _http_statusz(base_url)
+    if traffic is not None:
+        rep["traffic"] = traffic.describe()
+        rep["phases"] = phases.report()
     return rep
 
 
@@ -605,6 +796,23 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
     measured the wrong capacity."""
     violations = []
 
+    def _one_phase(ph: dict, label: str):
+        lat = ph.get("latency_ms") or {}
+        if p99_ms is not None:
+            p99 = lat.get("p99")
+            if p99 is None:
+                violations.append(f"{label}: no completed requests — "
+                                  f"p99 unmeasurable")
+            elif p99 > p99_ms:
+                violations.append(f"{label}: p99 {p99}ms > SLO "
+                                  f"{p99_ms}ms")
+        if shed_pct is not None:
+            rate = ph.get("shed_rate")
+            if rate is not None and rate * 100.0 > shed_pct:
+                violations.append(
+                    f"{label}: shed rate {rate * 100.0:.2f}% > SLO "
+                    f"{shed_pct}%")
+
     def _one(rep: dict, label: str):
         lat = rep.get("latency_ms") or {}
         if p99_ms is not None:
@@ -621,6 +829,12 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
                 violations.append(
                     f"{label}: shed rate {rate * 100.0:.2f}% > SLO "
                     f"{shed_pct}%")
+        # shaped-traffic runs: the SLO binds in EVERY phase — a crest
+        # that sheds half its load must not pass on the run's average
+        for name, ph in (rep.get("phases") or {}).items():
+            if not ph.get("requests"):
+                continue  # a phase the clock never entered
+            _one_phase(ph, f"{label}[{name}]")
         if fail_degraded:
             st = rep.get("statusz") or {}
             # in-process reports carry `groups` flat; a live /statusz
@@ -682,6 +896,22 @@ def main(argv=None) -> int:
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--qps", type=float, default=200.0)
     ap.add_argument("--duration", type=float, default=2.0)
+    ap.add_argument("--traffic", choices=TRAFFIC_SHAPES, default=None,
+                    help="open-loop offered-load profile: const (fixed "
+                         "clock), sine (diurnal), burst (periodic "
+                         "spikes), step (capacity cliff); also "
+                         "accepted as a bare --shape value.  The "
+                         "report gains per-phase qps/p99/shed and the "
+                         "SLO is asserted in every phase")
+    ap.add_argument("--traffic-amplitude", type=float, default=1.0,
+                    help="relative swing: 1.0 doubles the rate at the "
+                         "peak")
+    ap.add_argument("--traffic-period", type=float, default=None,
+                    help="shape period in seconds (default: the whole "
+                         "run for sine, duration/4 for burst)")
+    ap.add_argument("--traffic-burst-frac", type=float, default=0.25,
+                    help="fraction of each burst period spent at the "
+                         "spiked rate")
     ap.add_argument("--workers", type=int, default=None)
     ap.add_argument("--max-batch", type=int, default=None)
     ap.add_argument("--max-delay-ms", type=float, default=None)
@@ -742,12 +972,41 @@ def main(argv=None) -> int:
                     help="assert shed rate <= this (percent); "
                          "violation exits 1")
     args = ap.parse_args(argv)
+    # `--shape sine` convenience: a bare traffic-shape name given via
+    # --shape (which otherwise takes name=d0,d1 feed specs) selects
+    # the traffic profile — the spelling the fleet runbooks use
+    if args.shape:
+        feeds = []
+        for spec in args.shape:
+            if spec in TRAFFIC_SHAPES and "=" not in spec:
+                args.traffic = spec
+            else:
+                feeds.append(spec)
+        args.shape = feeds
+    traffic = None
+    if args.traffic:
+        traffic = TrafficShape(args.traffic, args.qps, args.duration,
+                               amplitude=args.traffic_amplitude,
+                               period_s=args.traffic_period,
+                               burst_frac=args.traffic_burst_frac)
     if args.sharded and args.generate:
         # the generate branch would silently drive a plain single-mesh
         # GenerationEngine while the report claimed a sharded health
         # check ran — refuse instead (GenerationEngine(mesh=...) is the
         # in-process API for mesh-partitioned generation)
         ap.error("--sharded cannot combine with --generate")
+    if traffic is not None and args.traffic != "const":
+        # shapes only exist on the one-shot open loop: running anyway
+        # would print a report with no phases block while the operator
+        # believes the crest was survived — refuse instead of
+        # silently measuring a constant clock
+        if args.generate:
+            ap.error("--traffic shapes are not supported by the "
+                     "--generate loops yet; drop --traffic or "
+                     "--generate")
+        if args.mode == "closed":
+            ap.error("--traffic shapes apply to the open loop; use "
+                     "--mode open or --mode both")
 
     def finish(report: dict) -> int:
         rc = 0
@@ -778,14 +1037,15 @@ def main(argv=None) -> int:
                           args.concurrency),
                       "open": run_open_loop_http(args.url, make_feed,
                                                  args.qps,
-                                                 args.duration)}
+                                                 args.duration,
+                                                 traffic=traffic)}
         elif args.mode == "closed":
             report = run_closed_loop_http(args.url, make_feed,
                                           args.requests,
                                           args.concurrency)
         else:
             report = run_open_loop_http(args.url, make_feed, args.qps,
-                                        args.duration)
+                                        args.duration, traffic=traffic)
         return finish(report)
 
     if args.generate:
@@ -870,7 +1130,8 @@ def main(argv=None) -> int:
                                           args.concurrency)),
                       "open": _with_groups(
                           run_open_loop(engine, make_feed, args.qps,
-                                        args.duration))}
+                                        args.duration,
+                                        traffic=traffic))}
         elif args.mode == "closed":
             report = _with_groups(
                 run_closed_loop(engine, make_feed, args.requests,
@@ -878,7 +1139,7 @@ def main(argv=None) -> int:
         else:
             report = _with_groups(
                 run_open_loop(engine, make_feed, args.qps,
-                              args.duration))
+                              args.duration, traffic=traffic))
     finally:
         engine.close()
 
