@@ -1366,6 +1366,77 @@ def run_decode():
     }
 
 
+# ---------------------------------------------------------------------------
+# Chaos leg: availability under injected crash/hang/slow/poison faults
+# ---------------------------------------------------------------------------
+
+def run_chaos():
+    """Fleet fault-containment leg (`legs.chaos`): tools/chaos.py's
+    crash + hang + slow + poison scenarios against a live replica
+    fleet under open-loop load through the router.  The headline
+    ``value`` is non-poisoned availability % (injected damage
+    included); the leg also publishes p99-under-fault and the
+    injected-vs-collateral failure split.  `tools/perf_gate.py`
+    HARD-fails any capture with collateral (non-injected) failures or
+    poison leaks — no anomaly flag or device mismatch shields a
+    containment break — and gates availability against the committed
+    floor.  Sized by BENCH_CHAOS_{REPLICAS,QPS,DURATION_S,SCENARIOS}.
+    On hosts with fewer cores than replicas+router the recoveries are
+    core-bound and the leg flags `anomaly` (the containment rules
+    still gate)."""
+    import importlib.util
+
+    import jax
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "chaos.py")
+    spec = importlib.util.spec_from_file_location("chaos_bench", path)
+    chaos = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(chaos)
+
+    env = os.environ.get
+    replicas = int(env("BENCH_CHAOS_REPLICAS", "3"))
+    qps = float(env("BENCH_CHAOS_QPS", "40"))
+    duration_s = float(env("BENCH_CHAOS_DURATION_S", "6"))
+    scenarios = tuple(s for s in env("BENCH_CHAOS_SCENARIOS",
+                                     "crash,hang,slow,poison").split(",")
+                      if s)
+    report = chaos.run_chaos(replicas=replicas, qps=qps,
+                             duration_s=duration_s,
+                             scenarios=scenarios,
+                             availability_pct=99.0,
+                             log=lambda *a: None)
+    totals = report["totals"]
+    out = {
+        "metric": "chaos_availability_pct",
+        "value": report["availability_pct"],
+        "unit": "%",
+        "device_kind": getattr(jax.devices()[0], "device_kind",
+                               str(jax.devices()[0])),
+        "availability_floor": report["availability_floor"],
+        "collateral_failures": totals["collateral_failures"],
+        "injected_failures": totals["injected_failures"],
+        "poison_leaks": totals["poison_leaks"],
+        "p99_under_fault_ms": report["p99_under_fault_ms"],
+        "requests": totals["requests"],
+        "ok_requests": totals["ok"],
+        "shed": totals["shed"],
+        "scenarios": {
+            name: {k: v for k, v in rep.items() if k != "notes"}
+            for name, rep in report["scenarios"].items()},
+        "harness_ok": report["ok"],
+        "errors": report["errors"],
+        "config": report["config"],
+    }
+    cores = os.cpu_count() or 1
+    if cores < replicas + 1:
+        out["anomaly"] = (
+            f"host has {cores} cores for {replicas} replica processes "
+            f"+ the router; recovery timing is core-bound (the "
+            f"collateral/leak containment rules still gate)")
+    return out
+
+
 def main():
     import jax
 
@@ -1441,6 +1512,14 @@ def main():
                 out["legs"]["llama_decode"] = run_decode()
             except Exception as e:
                 out["legs"]["llama_decode"] = {
+                    "error": f"{type(e).__name__}: {e}"}
+        # chaos leg: availability under injected crash/hang/slow/
+        # poison faults against a live fleet (BENCH_CHAOS=0 skips)
+        if os.environ.get("BENCH_CHAOS", "1") == "1":
+            try:
+                out["legs"]["chaos"] = run_chaos()
+            except Exception as e:
+                out["legs"]["chaos"] = {
                     "error": f"{type(e).__name__}: {e}"}
 
     print(json.dumps(out))

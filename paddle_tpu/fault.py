@@ -15,19 +15,30 @@ Spec grammar (``FLAGS_fault_inject``)::
              | '~' P        fire with probability P per hit, seeded by
                             FLAGS_fault_seed (deterministic across reruns)
 
+Kinds may carry a parameter after a second colon — ``delay:250``
+sleeps 250 ms at the site (a *slow* fault: nothing raises, latency
+grows), and ``hang`` sleeps :data:`HANG_MS` (an effective wedge —
+what the stuck-worker watchdog, router forward timeouts, and the
+fleet liveness deadline exist to contain).  Instrumented sites apply
+them through :func:`maybe_delay`.
+
 Sites are names agreed between the injector and the instrumented code;
 the ones wired in-tree:
 
-    =============  ============================  =====================
-    site           instrumented in               kinds understood
-    =============  ============================  =====================
-    ckpt_write     checkpoint.save_checkpoint    raise | torn | partial
-    loss           train_guard.TrainGuard.step   nan
-    step           train_guard.TrainGuard.step   sigterm
-    metrics_write  telemetry exporters           raise
-    serve_request  serving/engine.py submit      shed | fail
-    serve_batch    serving/engine.py _run_batch  fail
-    =============  ============================  =====================
+    =============  ================================  ===================
+    site           instrumented in                   kinds understood
+    =============  ================================  ===================
+    ckpt_write     checkpoint.save_checkpoint        raise | torn | partial
+    loss           train_guard.TrainGuard.step       nan
+    step           train_guard.TrainGuard.step       sigterm
+    metrics_write  telemetry exporters               raise
+    serve_request  serving/engine.py submit          shed | fail
+    serve_batch    serving/engine.py _run_batch      fail | delay:ms | hang
+    prefill        serving/generation.py _prefill    fail | delay:ms | hang
+    decode_step    serving/generation.py decode      fail | delay:ms | hang
+    replica_health serving/server.py /healthz        fail | delay:ms | hang
+    router_forward serving/router.py route           fail | delay:ms | hang
+    =============  ================================  ===================
 
 Every fired fault bumps ``faults_injected`` plus a per-site/kind
 ``fault_<site>_<kind>`` counter.
@@ -36,12 +47,19 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from typing import List, Optional
 
 from .flags import flag_value
 from .monitor import stat_add
 
-__all__ = ["InjectedFault", "FaultInjector", "configure", "fire", "reset"]
+__all__ = ["InjectedFault", "FaultInjector", "configure", "fire",
+           "reset", "delay_ms_of", "maybe_delay", "HANG_MS"]
+
+# what "hang" means in wall time: long enough that every watchdog /
+# timeout under test fires first, short enough that a leaked daemon
+# thread unwinds within a test session
+HANG_MS = 60_000.0
 
 
 class InjectedFault(OSError):
@@ -159,3 +177,30 @@ def reset():
 def fire(site: str) -> Optional[str]:
     """Module-level shorthand for the process-wide injector's fire()."""
     return _get().fire(site)
+
+
+def delay_ms_of(kind: Optional[str]) -> Optional[float]:
+    """The sleep a fired kind encodes: ``delay:250`` -> 250.0,
+    ``hang`` -> :data:`HANG_MS`, anything else (incl. None) -> None."""
+    if not kind:
+        return None
+    if kind == "hang":
+        return HANG_MS
+    if kind.startswith("delay:"):
+        try:
+            return float(kind.split(":", 1)[1])
+        except ValueError:
+            return None
+    return None
+
+
+def maybe_delay(kind: Optional[str]) -> bool:
+    """Apply a fired slow/hang fault at the call site: sleeps the
+    encoded duration for ``delay:ms`` / ``hang`` kinds and returns
+    True; returns False (no sleep) for every other kind so the caller
+    can go on to interpret e.g. ``fail``."""
+    ms = delay_ms_of(kind)
+    if ms is None:
+        return False
+    time.sleep(ms / 1e3)
+    return True

@@ -216,6 +216,46 @@ register_flag("FLAGS_serving_access_log", "",
               "request: trace_id, status, per-phase latency breakdown); "
               "empty defaults to <FLAGS_metrics_dir>/access.jsonl when a "
               "metrics dir is set, else disabled")
+register_flag("FLAGS_serving_bisect", True,
+              "serving engine: when a multi-request batch fails, "
+              "recursively split-and-retry it to isolate the poisoned "
+              "request(s) — exactly the offending requests error, every "
+              "other rider is served bit-exact (cost bounded at "
+              "(log2(batch)+1) re-dispatches of the original rows); "
+              "0 restores fail-the-whole-batch")
+register_flag("FLAGS_serving_poison_value", "",
+              "chaos/testing hook: a float sentinel; any batch (or "
+              "generation prompt) containing a feed value exactly equal "
+              "to it raises PoisonedInput at execution — a deterministic "
+              "stand-in for an input that crashes the model kernel, "
+              "used by the bisection fault matrix and tools/chaos.py; "
+              "empty disables (the serve path pays nothing)")
+register_flag("FLAGS_serving_worker_stuck_ms", 10000.0,
+              "serving engine: a dispatch worker whose current batch has "
+              "been executing longer than this reports status 'stuck' "
+              "(with stuck_ms) in worker_health()/ /healthz — the "
+              "engine-level status degrades so the router stops "
+              "preferring the replica; 0 disables the watchdog")
+register_flag("FLAGS_router_forward_timeout_ms", 0.0,
+              "fleet router: socket timeout for one replica forward — a "
+              "hung replica costs at most this per attempt (strikes its "
+              "health, retries once on an alternate, 504 when none); "
+              "a request's remaining deadline budget tightens it "
+              "further; 0 falls back to the router's request_timeout_s "
+              "(default 30s)")
+register_flag("FLAGS_router_default_deadline_ms", 0.0,
+              "fleet router: end-to-end deadline budget (ms) MINTED into "
+              "X-PaddleTPU-Deadline-Ms for requests that arrive without "
+              "one; the budget decrements across hops and replica "
+              "admission sheds hopeless requests at the queue; 0 mints "
+              "nothing (client-supplied headers still propagate)")
+register_flag("FLAGS_fleet_liveness_timeout_ms", 5000.0,
+              "fleet supervisor: a replica whose PID is alive but whose "
+              "/healthz has not answered for this long after previously "
+              "answering (SIGSTOP'd / wedged, invisible to exit-code "
+              "monitoring) is SIGKILLed and respawned through the crash "
+              "path (fleet_hung_kills); 0 disables the liveness "
+              "watchdog")
 register_flag("FLAGS_router_health_interval_ms", 200.0,
               "fleet router: cadence of the background /healthz poll "
               "against every registered replica (queue depth, inflight "

@@ -20,8 +20,8 @@ via ``engine.attach_generator(gen)`` and ``POST /generate`` routes to
 it (README "Generation serving").
 """
 from . import batcher  # noqa
-from .engine import (OverloadedError, RequestFailed, ServingEngine,  # noqa
-                     ServingError, ServingFuture)
+from .engine import (OverloadedError, PoisonedInput, RequestFailed,  # noqa
+                     ServingEngine, ServingError, ServingFuture)
 from .fleet import FleetSupervisor  # noqa
 from .generation import GenerationEngine  # noqa
 from .router import Router, RouterServer, serve_router  # noqa
@@ -30,7 +30,8 @@ from .sharded import (ReplicaGroupEngine, ShardedPredictor,  # noqa
                       serving_shard_rules)
 
 __all__ = ["ServingEngine", "ServingError", "OverloadedError",
-           "RequestFailed", "ServingFuture", "ServingServer", "serve",
+           "RequestFailed", "PoisonedInput", "ServingFuture",
+           "ServingServer", "serve",
            "GenerationEngine", "batcher", "ReplicaGroupEngine",
            "ShardedPredictor", "serving_shard_rules", "Router",
            "RouterServer", "serve_router", "FleetSupervisor"]

@@ -40,15 +40,46 @@ trafficable engine:
   feeds the HTTP ``/tracez`` endpoint.  Latency histograms record the
   request's trace_id as an exemplar, so a bad p99 points at a trace.
 
+* **Poison-request bisection** — when a multi-request batch raises,
+  the engine does not fail every rider: it recursively splits the
+  batch in half and retries each half, isolating exactly the
+  poisoned request(s) (:class:`PoisonedInput`, a kernel crash, an
+  injected fault) while every other request in the batch is served
+  **bit-exact** (sub-batches pad to their own bucket; bucket size
+  never changes a row's result — the standing ``np.array_equal``
+  serving invariant).  Cost is bounded: at most ``log2(batch)+1``
+  re-dispatches of the original row count.  ``FLAGS_serving_bisect=0``
+  restores fail-the-whole-batch.
+
+* **End-to-end deadlines** — ``submit(deadline_ms=...)`` adopts a
+  caller-propagated remaining budget (the HTTP front end reads it
+  from the ``X-PaddleTPU-Deadline-Ms`` header the fleet router mints
+  / decrements): the engine deadline tightens to it, and a request
+  whose budget is already spent sheds at the queue (reason
+  ``deadline``) instead of burning a batch slot.
+
+* **Stuck-worker watchdog** — a dispatch worker wedged inside a batch
+  longer than ``FLAGS_serving_worker_stuck_ms`` reports status
+  ``stuck`` (+ live ``stuck_ms``) in :meth:`worker_health`, degrading
+  the engine-level ``/healthz`` status so the fleet router stops
+  preferring the replica — a hang is visible even though the thread
+  cannot be killed in-process.
+
 Fault sites (``paddle_tpu/fault.py``): ``serve_request`` (kinds
 ``shed`` — forced admission shed — and ``fail`` — admission error) and
-``serve_batch`` (kind ``fail`` — the batch execution raises; only that
-batch's requests error, the engine keeps serving).
+``serve_batch`` (``fail`` — the batch execution raises; only the
+isolated request(s) error, the engine keeps serving — plus
+``delay:ms`` / ``hang`` slow faults that stall the worker at the
+dispatch point, which is what the stuck watchdog surfaces).
 
 Stats (README catalog): counters ``serving_requests``,
-``serving_requests_shed``, ``serving_batches``,
+``serving_requests_shed``, ``requests_shed_deadline`` (the subset of
+sheds whose budget ran out — admission or pickup), ``serving_batches``,
 ``serving_batch_exact_bucket``, ``serving_batch_failures``,
-``serving_pad_rows``, ``serving_no_sigterm``,
+``serving_batch_bisections`` (failed multi-request batches that
+entered split-and-retry), ``serving_poison_rows`` (rows of requests a
+bisection isolated as the poison), ``serving_pad_rows``,
+``serving_no_sigterm``,
 ``serving_sharded_batches`` / ``serving_sharded_batch_failures``
 (mesh-placed pools only, plus dynamic per-device ``_dev<i>``
 siblings); gauge ``serving_groups_degraded`` (workers past the
@@ -80,7 +111,7 @@ from ..monitor import process_start_time, stat_add
 from . import batcher
 
 __all__ = ["ServingError", "OverloadedError", "RequestFailed",
-           "ServingFuture", "ServingEngine"]
+           "PoisonedInput", "ServingFuture", "ServingEngine"]
 
 logger = logging.getLogger("paddle_tpu.serving")
 
@@ -104,6 +135,32 @@ class OverloadedError(ServingError):
 
 class RequestFailed(ServingError):
     """The batch this request rode in raised during execution."""
+
+
+class PoisonedInput(RuntimeError):
+    """A batch contained a feed value equal to the
+    ``FLAGS_serving_poison_value`` sentinel — the deterministic
+    stand-in for an input that crashes the model kernel (chaos harness
+    / bisection fault matrix).  Deliberately NOT a ServingError: it
+    surfaces to the engine exactly like a real execution crash and is
+    contained by the same bisection path."""
+
+
+def poison_sentinel_matches(a: np.ndarray, v: float) -> bool:
+    """True when array ``a`` contains the poison sentinel ``v``
+    exactly.  Dtype-cast aware — the ONE place this subtlety lives
+    (the one-shot engine and the generation prompt check both call
+    it): a sentinel unrepresentable in the array's dtype
+    (OverflowError) or silently SATURATING there (float16 casts 1e30
+    to inf with only a warning) never matches, so a legitimate
+    inf/extreme value in a feed cannot be misclassified as poison."""
+    try:
+        target = a.dtype.type(v)
+    except (OverflowError, ValueError):
+        return False
+    if np.isfinite(v) and not np.isfinite(target):
+        return False
+    return bool(np.any(a == target))
 
 
 class ServingFuture:
@@ -147,7 +204,8 @@ class ServingFuture:
 
 class _Request:
     __slots__ = ("arrays", "rows", "sig", "future", "t_submit",
-                 "t_picked", "trace_id", "sampled", "root", "spans")
+                 "t_picked", "t_deadline", "trace_id", "sampled",
+                 "root", "spans")
 
     def __init__(self, arrays: List[np.ndarray]):
         self.arrays = arrays
@@ -156,6 +214,7 @@ class _Request:
         self.future = ServingFuture()
         self.t_submit = time.monotonic()
         self.t_picked: Optional[float] = None
+        self.t_deadline: float = float("inf")  # set at admission
         # trace identity: stamped by ServingEngine._trace_begin (None
         # with telemetry off); `root` is the serving/request span when
         # head-sampled, `spans` every span opened for this request
@@ -246,7 +305,7 @@ class ServingEngine:
         self._health = [{"worker": i, "batches": 0, "failures": 0,
                          "consecutive_failures": 0, "degraded": False,
                          "in_flight_rows": 0, "rows_total": 0,
-                         "last_batch": None}
+                         "busy_since": None, "last_batch": None}
                         for i in range(self.workers)]
         # per-worker batch-latency histograms (engine-local, like
         # _h_request): per replica GROUP p50/p99 for worker_health —
@@ -264,7 +323,8 @@ class ServingEngine:
         # serve_request:fail admission errors)
         self._n = {"requests": 0, "served": 0, "shed": 0, "batches": 0,
                    "exact_bucket": 0, "batch_failures": 0, "pad_rows": 0,
-                   "sampled": 0}
+                   "sampled": 0, "shed_deadline": 0, "bisections": 0,
+                   "poison_rows": 0}
         self._n_lock = threading.Lock()
         self._h_request = telemetry.Histogram("serving_request_ms")
         self._h_wait = telemetry.Histogram("serving_queue_wait_ms")
@@ -457,15 +517,20 @@ class ServingEngine:
             raise ValueError(f"feeds disagree on batch dim: {shapes}")
         return arrays
 
-    def submit(self, feed, trace_id: Optional[str] = None
-               ) -> ServingFuture:
+    def submit(self, feed, trace_id: Optional[str] = None,
+               deadline_ms: Optional[float] = None) -> ServingFuture:
         """Admit one request (any batch size >= 1).  Returns a
         :class:`ServingFuture`; sheds with :class:`OverloadedError`
         when the queue is full or the engine is draining (the raised
         error carries the request's ``trace_id``).  ``trace_id`` adopts
         an externally-minted trace identity (the router hop forwards
         its ``X-PaddleTPU-Trace`` header here), so one served request
-        is ONE trace across both tiers."""
+        is ONE trace across both tiers.  ``deadline_ms`` is the
+        request's REMAINING end-to-end budget (the
+        ``X-PaddleTPU-Deadline-Ms`` header, decremented across hops):
+        it tightens the engine deadline, and a budget already spent
+        sheds right here (reason ``deadline``) — a hopeless request
+        must not burn a batch slot."""
         arrays = self.coerce_feed(feed)
         self._count("requests")
         stat_add("serving_requests")
@@ -475,10 +540,17 @@ class ServingEngine:
             # handler, loadgen) handle ServingError, not raw OSError
             raise RequestFailed("injected serve_request failure")
         req = _Request(arrays)
+        budget_s = self._deadline_s
+        if deadline_ms is not None:
+            budget_s = min(budget_s, float(deadline_ms) / 1e3)
+        req.t_deadline = req.t_submit + budget_s
         admit = self._trace_begin(req, trace_id=trace_id)
         with self._cv:
             if self._draining:
                 raise self._submit_shed(req, admit, "draining")
+            if budget_s <= 0:
+                raise self._submit_shed(req, admit, "deadline",
+                                        "budget exhausted upstream")
             if kind == "shed" or len(self._queue) >= self.queue_cap:
                 raise self._submit_shed(
                     req, admit,
@@ -604,6 +676,9 @@ class ServingEngine:
         (spans closed, trace recorded, trace_id attached)."""
         self._count("shed")
         stat_add("serving_requests_shed")
+        if reason == "deadline":
+            self._count("shed_deadline")
+            stat_add("requests_shed_deadline")
         telemetry.span_end(admit)
         if req.root is not None:
             req.root.attrs["status"] = "shed:" + reason
@@ -628,7 +703,7 @@ class ServingEngine:
         return self
 
     def submit_generate(self, prompt, max_new_tokens=None,
-                        trace_id=None):
+                        trace_id=None, deadline_ms=None):
         """Admit one generation request to the attached slot scheduler
         (future of the generation record); raises RuntimeError when no
         generator is attached."""
@@ -637,7 +712,8 @@ class ServingEngine:
                                "attach_generator() first")
         return self.generator.submit(prompt,
                                      max_new_tokens=max_new_tokens,
-                                     trace_id=trace_id)
+                                     trace_id=trace_id,
+                                     deadline_ms=deadline_ms)
 
     # -- scheduler ----------------------------------------------------------
     def _count(self, key: str, n: int = 1):
@@ -647,6 +723,9 @@ class ServingEngine:
     def _shed(self, req: _Request, reason: str):
         self._count("shed")
         stat_add("serving_requests_shed")
+        if reason == "deadline":
+            self._count("shed_deadline")
+            stat_add("requests_shed_deadline")
         waited_ms = (time.monotonic() - req.t_submit) * 1e3
         telemetry.span_end(self._wait_span_of(req))
         if req.root is not None:
@@ -664,7 +743,7 @@ class ServingEngine:
         now = time.monotonic()
         while self._queue:
             req = self._queue.popleft()
-            if now - req.t_submit > self._deadline_s:
+            if now > req.t_deadline:
                 self._shed(req, "deadline")
                 continue
             return req
@@ -681,7 +760,7 @@ class ServingEngine:
         now = time.monotonic()
         while self._queue and rows < max_rows:
             req = self._queue[0]
-            if now - req.t_submit > self._deadline_s:
+            if now > req.t_deadline:
                 self._queue.popleft()
                 self._shed(req, "deadline")
                 continue
@@ -775,16 +854,89 @@ class ServingEngine:
                 # dynamic _dev<i> siblings: catalog-exempt by convention
                 stat_add(f"{name}_dev{d}")
 
+    def _poison_check(self, batch: List[_Request]):
+        """The deterministic poison-input model (chaos/testing): any
+        feed value equal to ``FLAGS_serving_poison_value`` crashes the
+        whole dispatch — exactly like a kernel that dies on one bad
+        row — and the bisection path isolates it.  Free when the flag
+        is unset."""
+        pv = flag_value("FLAGS_serving_poison_value")
+        if not pv:
+            return
+        v = float(pv)
+        for r in batch:
+            for a in r.arrays:
+                if poison_sentinel_matches(a, v):
+                    raise PoisonedInput(
+                        f"batch contains poisoned input (sentinel {pv})")
+
+    def _execute(self, predictor, batch: List[_Request]
+                 ) -> List[List[np.ndarray]]:
+        """Execute ``batch`` as one padded dispatch (or the chunked
+        path for an oversized single request) and return per-request
+        output lists.  Raises on any failure — poison, kernel crash —
+        WITHOUT touching futures: callers (`_run_batch`, `_bisect`)
+        decide containment."""
+        self._poison_check(batch)
+        rows = sum(r.rows for r in batch)
+        bucket = batcher.bucket_for(rows, self.buckets)
+        if bucket is None:
+            # one oversized request (> largest bucket): chunk it
+            # across full batches and reassemble — still bit-exact
+            return [self._run_chunked(predictor, batch[0])]
+        padded, _real = batcher.pad_stack([r.arrays for r in batch],
+                                          bucket)
+        outs = predictor.run(padded)
+        per_req = batcher.split_rows(outs, [r.rows for r in batch])
+        self._book_batch(rows, bucket)
+        return per_req
+
+    def _resolve_ok(self, req: _Request, outputs, predict_ms: float,
+                    now: float):
+        rs = None
+        if req.root is not None:
+            rs = telemetry.span_begin("serving/respond",
+                                      parent=req.root.context(),
+                                      detached=True)
+            req.spans.append(rs)
+        ms = (now - req.t_submit) * 1e3
+        self._h_request.observe(ms, trace_id=req.trace_id)
+        telemetry.histogram_observe("serving_request_ms", ms,
+                                    trace_id=req.trace_id)
+        telemetry.span_end(rs)
+        telemetry.span_end(req.root)
+        req.future.trace = self._trace_finish(req, "ok", predict_ms)
+        req.future._resolve(outputs=outputs)
+
+    def _resolve_failed(self, req: _Request, cause: Exception,
+                        predict_ms: float, isolated: bool = False):
+        what = "request isolated by bisection" if isolated \
+            else "batch execution failed"
+        err = RequestFailed(f"{what}: {type(cause).__name__}: {cause}")
+        if req.root is not None:
+            req.root.attrs["status"] = "failed"
+            telemetry.span_end(req.root)
+        req.future.trace = self._trace_finish(req, "failed", predict_ms)
+        req.future._resolve(error=err)
+
     def _run_batch(self, predictor, batch: List[_Request],
                    widx: int = 0):
         rows = sum(r.rows for r in batch)
         with self._n_lock:
             self._health[widx]["in_flight_rows"] = rows
+            # stuck-worker watchdog arm: worker_health() reads the live
+            # wall time this worker has been inside the current batch
+            self._health[widx]["busy_since"] = time.monotonic()
         bucket = batcher.bucket_for(rows, self.buckets)
         t_run0 = time.monotonic()
         pspans = []
         try:
-            if fault.fire("serve_batch") == "fail":
+            kind = fault.fire("serve_batch")
+            # delay:ms / hang slow faults stall the worker HERE — the
+            # stuck watchdog and the router's forward timeout are what
+            # turn the stall into a visible, contained event
+            fault.maybe_delay(kind)
+            if kind == "fail":
                 raise fault.InjectedFault("injected serve_batch failure")
             # the batch span is its own trace (it belongs to no single
             # request); `links` record the fan-in to every sampled
@@ -802,17 +954,7 @@ class ServingEngine:
                             detached=True, rows=r.rows)
                         r.spans.append(ps)
                         pspans.append(ps)
-                if bucket is None:
-                    # one oversized request (> largest bucket): chunk it
-                    # across full batches and reassemble — still bit-exact
-                    per_req = [self._run_chunked(predictor, batch[0])]
-                else:
-                    padded, _real = batcher.pad_stack(
-                        [r.arrays for r in batch], bucket)
-                    outs = predictor.run(padded)
-                    per_req = batcher.split_rows(outs,
-                                                 [r.rows for r in batch])
-                    self._book_batch(rows, bucket)
+                per_req = self._execute(predictor, batch)
                 for ps in pspans:
                     telemetry.span_end(ps)
                 pspans = []
@@ -821,24 +963,12 @@ class ServingEngine:
             self._count("served", len(batch))
             self._book_worker(widx, predictor, True, rows, predict_ms)
             for req, outputs in zip(batch, per_req):
-                rs = None
-                if req.root is not None:
-                    rs = telemetry.span_begin("serving/respond",
-                                              parent=req.root.context(),
-                                              detached=True)
-                    req.spans.append(rs)
-                ms = (now - req.t_submit) * 1e3
-                self._h_request.observe(ms, trace_id=req.trace_id)
-                telemetry.histogram_observe("serving_request_ms", ms,
-                                            trace_id=req.trace_id)
-                telemetry.span_end(rs)
-                telemetry.span_end(req.root)
-                req.future.trace = self._trace_finish(req, "ok",
-                                                      predict_ms)
-                req.future._resolve(outputs=outputs)
+                self._resolve_ok(req, outputs, predict_ms, now)
         except Exception as e:  # noqa: BLE001 — a batch failure must not
-            # kill the worker: exactly this batch's requests error, the
-            # engine keeps serving (tested via serve_batch:fail@N)
+            # kill the worker: the poisoned request(s) error (isolated
+            # by bisection when the batch had riders), the engine keeps
+            # serving (tested via serve_batch:fail@N + the poison
+            # fault matrix)
             for ps in pspans:
                 telemetry.span_end(ps)
             self._count("batch_failures")
@@ -849,19 +979,70 @@ class ServingEngine:
                            len(batch), e)
             telemetry.log_event("serving_batch_failure", rows=rows,
                                error=f"{type(e).__name__}: {e}")
-            err = RequestFailed(f"batch execution failed: "
-                                f"{type(e).__name__}: {e}")
             predict_ms = (time.monotonic() - t_run0) * 1e3
-            for req in batch:
-                if req.root is not None:
-                    req.root.attrs["status"] = "failed"
-                    telemetry.span_end(req.root)
-                req.future.trace = self._trace_finish(req, "failed",
-                                                      predict_ms)
-                req.future._resolve(error=err)
+            if len(batch) > 1 and flag_value("FLAGS_serving_bisect"):
+                self._bisect(predictor, batch, widx, e)
+            else:
+                for req in batch:
+                    self._resolve_failed(req, e, predict_ms)
         finally:
             with self._n_lock:
                 self._health[widx]["in_flight_rows"] = 0
+                self._health[widx]["busy_since"] = None
+
+    def _bisect(self, predictor, batch: List[_Request], widx: int,
+                cause: Exception):
+        """Poison containment: split the failed batch in half and
+        retry each half, recursively, until every request is either
+        served (bit-exact — a sub-batch pads to its own bucket, and
+        bucket size never changes a row's result) or isolated alone
+        as the poison and failed with :class:`RequestFailed`.  Cost
+        is bounded: each bisection level re-dispatches at most the
+        original row count, and there are at most ``log2(len(batch))
+        + 1`` levels."""
+        self._count("bisections")
+        stat_add("serving_batch_bisections")
+        telemetry.log_event("serving_batch_bisection",
+                            requests=len(batch),
+                            cause=f"{type(cause).__name__}: {cause}")
+        stack = [list(batch)]
+        while stack:
+            group = stack.pop()
+            t0 = time.monotonic()
+            with self._n_lock:
+                # re-arm the stuck watchdog per dispatch: it measures
+                # ONE execution, not the whole (bounded but multi-
+                # dispatch) containment episode — a routine bisection
+                # must not read as a wedged worker
+                self._health[widx]["busy_since"] = t0
+            try:
+                per_req = self._execute(predictor, group)
+            except Exception as e:  # noqa: BLE001 — sort, don't die
+                if len(group) > 1:
+                    mid = len(group) // 2
+                    # front half on top: requests resolve in FIFO order
+                    stack.append(group[mid:])
+                    stack.append(group[:mid])
+                    continue
+                req = group[0]
+                self._count("poison_rows", req.rows)
+                stat_add("serving_poison_rows", req.rows)
+                logger.warning("bisection isolated a poisoned request "
+                               "(%d row(s)): %s", req.rows, e)
+                telemetry.log_event("serving_poison_isolated",
+                                    rows=req.rows,
+                                    error=f"{type(e).__name__}: {e}")
+                self._resolve_failed(req, e,
+                                     (time.monotonic() - t0) * 1e3,
+                                     isolated=True)
+                continue
+            now = time.monotonic()
+            predict_ms = (now - t0) * 1e3
+            self._count("served", len(group))
+            self._book_worker(widx, predictor, True,
+                              sum(r.rows for r in group), predict_ms)
+            for req, outputs in zip(group, per_req):
+                self._resolve_ok(req, outputs, predict_ms, now)
 
     def _run_chunked(self, predictor, req: _Request) -> List[np.ndarray]:
         chunks = []
@@ -903,9 +1084,17 @@ class ServingEngine:
         away engine-wide) and mean batch fill (``avg_batch_rows``) —
         plus, for mesh-placed predictors, the group's mesh axes,
         device ids, and any shards missing from the live device set.
-        ``status`` is ``ok | degraded | missing_shards`` (missing
-        shards win: a group whose devices vanished cannot serve at
-        all, degraded or not)."""
+        ``status`` is ``ok | degraded | stuck | missing_shards``
+        (missing shards win: a group whose devices vanished cannot
+        serve at all, degraded or not).  ``stuck`` is the dispatch
+        watchdog's verdict: the worker has been inside its CURRENT
+        batch longer than ``FLAGS_serving_worker_stuck_ms``
+        (``stuck_ms`` carries the live wall time) — the thread cannot
+        be killed in-process, but the engine status degrades so a
+        router stops preferring this replica."""
+        now = time.monotonic()
+        stuck_after = float(
+            flag_value("FLAGS_serving_worker_stuck_ms") or 0)
         with self._n_lock:
             snap = [dict(h, last_batch=dict(h["last_batch"])
                          if h["last_batch"] else None)
@@ -914,17 +1103,43 @@ class ServingEngine:
             h["predict_ms"] = self._h_worker[i].summary()
             h["avg_batch_rows"] = round(
                 h["rows_total"] / max(h["batches"], 1), 2)
+            busy = h.pop("busy_since")
+            h["stuck_ms"] = round((now - busy) * 1e3, 1) \
+                if busy is not None else None
+            h["stuck"] = bool(stuck_after > 0
+                              and h["stuck_ms"] is not None
+                              and h["stuck_ms"] >= stuck_after)
         for h, p in zip(snap, self._pool):
             placement = getattr(p, "placement", None)
             if placement is not None:
                 h.update(placement())
             h["status"] = ("missing_shards" if h.get("missing_shards")
+                           else "stuck" if h["stuck"]
                            else "degraded" if h["degraded"] else "ok")
         return snap
 
     def groups_degraded(self) -> int:
         with self._n_lock:
             return sum(1 for h in self._health if h["degraded"])
+
+    def retry_after_s(self) -> float:
+        """Backoff hint for 503 responses (the ``Retry-After`` header):
+        the estimated time for the current backlog to drain through
+        the worker pool — queued batches over pool width at the
+        measured per-batch p50 (the batching delay before anything is
+        measured) — bounded to [0.5, 30] s so a bad estimate can
+        neither hammer nor strand a well-behaved client."""
+        with self._cv:
+            depth = len(self._queue)
+        per_batch_s = self._max_delay_s
+        p50s = [h.summary().get("p50") for h in self._h_worker]
+        p50s = [p for p in p50s if p]
+        if p50s:
+            per_batch_s = max(per_batch_s, max(p50s) / 1e3)
+        batches_pending = math.ceil(depth / max(1, self.max_batch))
+        est = self._max_delay_s \
+            + (batches_pending / self.workers) * per_batch_s
+        return min(30.0, max(0.5, est))
 
     def stats(self) -> dict:
         """Engine-local serving stats (isolated from the process-global

@@ -22,6 +22,18 @@ own port, metrics dir, and ``PADDLE_TPU_REPLICA_ID`` env.
   accounting), and a healthy start (ready reached) resets the crash
   streak.
 
+* **Hung-replica liveness deadline.** Exit-code monitoring cannot see
+  a *hung* replica — SIGSTOP'd or wedged, its PID stays alive while
+  it silently holds forwards open.  A liveness thread polls each
+  replica's ``/healthz``; once a life has answered at least once, a
+  replica whose health then goes silent for
+  ``FLAGS_fleet_liveness_timeout_ms`` while its PID is alive is
+  **SIGKILLed** (``fleet_hung_kills``) and respawned through the
+  normal crash path (backoff + restart budget — a replica that hangs
+  repeatedly is as broken as one that crashes repeatedly).  The
+  deadline arms only after the first successful health response of a
+  life, so a successor paying its import/bind cost is never shot.
+
 * **Drain-aware rolling restart.** :meth:`rolling_restart` takes the
   fleet through a rollout ONE replica at a time: SIGTERM (the
   replica's existing drain path serves out everything admitted),
@@ -33,7 +45,8 @@ own port, metrics dir, and ``PADDLE_TPU_REPLICA_ID`` env.
   ``bench.py run_router`` and ``tests/test_router.py``).
 
 Stats (README catalog): counters ``fleet_restarts``,
-``fleet_rolling_restarts``; gauge ``fleet_replicas_live``.
+``fleet_rolling_restarts``, ``fleet_hung_kills``; gauge
+``fleet_replicas_live``.
 """
 from __future__ import annotations
 
@@ -96,6 +109,11 @@ class _Replica:
         self.failed = False       # past the restart budget: stays down
         self.in_rollout = False   # monitor keeps hands off
         self.respawn_at: Optional[float] = None  # backoff deadline
+        # liveness watchdog: monotonic ts of this LIFE's last good
+        # /healthz answer; None until the life answers once (the
+        # deadline must not fire on a successor still importing)
+        self.last_alive: Optional[float] = None
+        self.hung_kills = 0       # liveness SIGKILLs on this slot
 
 
 class FleetSupervisor:
@@ -114,6 +132,7 @@ class FleetSupervisor:
                  workdir: Optional[str] = None,
                  max_restarts: Optional[int] = None,
                  backoff_ms: Optional[float] = None,
+                 liveness_timeout_ms: Optional[float] = None,
                  autostart: bool = True):
         self.n = int(replicas if replicas is not None
                      else flag_value("FLAGS_fleet_replicas"))
@@ -128,12 +147,16 @@ class FleetSupervisor:
         self._backoff_s = float(
             backoff_ms if backoff_ms is not None
             else flag_value("FLAGS_fleet_restart_backoff_ms")) / 1e3
+        self._liveness_s = float(
+            liveness_timeout_ms if liveness_timeout_ms is not None
+            else flag_value("FLAGS_fleet_liveness_timeout_ms")) / 1e3
         self._lock = threading.Lock()
         self._replicas = [
             _Replica(i, os.path.join(self.workdir, f"replica-{i}"))
             for i in range(self.n)]
         self._closing = False
         self._monitor: Optional[threading.Thread] = None
+        self._liveness: Optional[threading.Thread] = None
         self._started = time.time()
         if autostart:
             self.start()
@@ -158,6 +181,8 @@ class FleetSupervisor:
                                  restart_count=rep.lives)
         rep.lives += 1
         rep.respawn_at = None
+        rep.last_alive = None  # liveness re-arms on this life's first
+        # successful health answer
         logger.info("replica %d spawned (pid %d, life %d, port %s)",
                     rep.idx, rep.proc.pid, rep.lives,
                     rep.port or "ephemeral")
@@ -172,6 +197,11 @@ class FleetSupervisor:
                                              name="fleet-monitor",
                                              daemon=True)
             self._monitor.start()
+        if self._liveness is None and self._liveness_s > 0:
+            self._liveness = threading.Thread(
+                target=self._liveness_loop, name="fleet-liveness",
+                daemon=True)
+            self._liveness.start()
 
     def _publish_live(self):
         live = sum(1 for r in self._replicas
@@ -275,6 +305,68 @@ class FleetSupervisor:
                             rc=rc, restart=rep.crash_restarts,
                             backoff_s=round(backoff, 3))
 
+    # -- hung-replica liveness watchdog -------------------------------------
+    def _liveness_loop(self):
+        """Health-poll every replica off the monitor's lock; a PID
+        that is alive but whose health went silent past the liveness
+        deadline (after answering at least once this life) gets
+        SIGKILL — the crash monitor then respawns it with the normal
+        backoff/budget accounting."""
+        interval = max(0.2, self._liveness_s / 4.0)
+        while not self._closing:
+            time.sleep(interval)
+            if self._closing:
+                return
+            for rep in self._replicas:
+                with self._lock:
+                    skip = (self._closing or rep.in_rollout
+                            or rep.failed or rep.proc is None
+                            or rep.respawn_at is not None
+                            or rep.url is None
+                            or rep.proc.poll() is not None)
+                    url = rep.url
+                    proc = rep.proc
+                if skip:
+                    continue
+                # the HTTP round-trip happens OUTSIDE the lock: a
+                # blackholed replica must not stall the crash monitor
+                h = _healthz(url, timeout=min(1.0, interval))
+                now = time.monotonic()
+                with self._lock:
+                    if (self._closing or rep.in_rollout
+                            or rep.proc is not proc
+                            or proc.poll() is not None):
+                        # the life this poll measured is gone (crash
+                        # respawn raced us): its answer must neither
+                        # arm nor trip the NEW life's deadline
+                        continue
+                    if h is not None:
+                        rep.last_alive = now
+                        continue
+                    hung = (rep.last_alive is not None
+                            and now - rep.last_alive > self._liveness_s)
+                    if not hung:
+                        continue
+                    stale_s = now - rep.last_alive
+                    rep.hung_kills += 1
+                stat_add("fleet_hung_kills")
+                logger.warning(
+                    "replica %d pid %d alive but health silent for "
+                    "%.1fs (> %.1fs liveness deadline); SIGKILL + "
+                    "respawn", rep.idx, proc.pid, stale_s,
+                    self._liveness_s)
+                telemetry.log_event("fleet_replica_hung",
+                                    replica=rep.idx,
+                                    pid=proc.pid,
+                                    stale_s=round(stale_s, 3))
+                try:
+                    # the verified life's handle — a respawn racing in
+                    # after the lock released must not catch the bullet
+                    proc.kill()  # SIGKILL works on a stopped PID
+                except OSError as e:
+                    logger.warning("hung-kill of replica %d failed: "
+                                   "%s", rep.idx, e)
+
     # -- rollout ------------------------------------------------------------
     def rolling_restart(self, ready_timeout_s: float = 120.0,
                         drain_timeout_s: float = 30.0) -> dict:
@@ -334,6 +426,7 @@ class FleetSupervisor:
                 "pid": r.proc.pid if r.proc is not None else None,
                 "alive": r.proc is not None and r.proc.poll() is None,
                 "lives": r.lives, "crash_restarts": r.crash_restarts,
+                "hung_kills": r.hung_kills,
                 "failed": r.failed, "in_rollout": r.in_rollout,
             } for r in self._replicas]
         return {"replicas": reps, "max_restarts": self.max_restarts,
@@ -347,6 +440,8 @@ class FleetSupervisor:
             self._closing = True
         if self._monitor is not None:
             self._monitor.join(timeout=5.0)
+        if self._liveness is not None:
+            self._liveness.join(timeout=5.0)
         for rep in self._replicas:
             if rep.proc is not None and rep.proc.poll() is None:
                 rep.proc.send_signal(signal.SIGTERM)

@@ -33,9 +33,22 @@ identified.  This module is the repo's answer:
   ``FLAGS_serving_deadline_ms`` before claiming a slot, ``draining``
   during shutdown.
 
+Fault containment: a *prefill* failure (poisoned prompt —
+``FLAGS_serving_poison_value`` sentinel token — injected ``prefill``
+fault, or a real crash) fails exactly that request while the grid
+keeps decoding; a *decode-step* failure fails the requests ACTIVE in
+the grid (their cache state is unknowable after a mid-step crash) but
+never the scheduler — the next queued request prefills into a clean
+slot and serving continues (``decode_step`` fault-matrix tested).
+``submit(deadline_ms=...)`` adopts the router-propagated remaining
+budget like the one-shot engine: a spent budget sheds at the queue.
+
 Stats (README catalog): counters ``serving_generate_requests``,
-``serving_generate_shed``, ``serving_prefills``,
-``serving_decode_steps``, ``serving_generated_tokens``,
+``serving_generate_shed``, ``requests_shed_deadline``,
+``serving_prefills``, ``serving_decode_steps``,
+``serving_decode_failures`` (decode-grid iterations that raised —
+each fails only the then-active requests),
+``serving_generated_tokens``,
 ``serving_prefill_tokens``, ``serving_slot_reclaims``; gauges
 ``serving_slot_occupancy``, ``serving_prefill_decode_ratio``,
 ``serving_kv_cache_bytes``, ``serving_decode_mfu``; histograms
@@ -52,11 +65,12 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .. import costmodel, telemetry
+from .. import costmodel, fault, telemetry
 from ..flags import flag_value
 from ..monitor import stat_add
 from . import batcher
-from .engine import OverloadedError, RequestFailed, ServingFuture
+from .engine import (OverloadedError, PoisonedInput, RequestFailed,
+                     ServingFuture, poison_sentinel_matches)
 from .sharded import describe_mesh as _describe_mesh
 
 __all__ = ["GenerationEngine", "GenRequest"]
@@ -72,7 +86,7 @@ class GenRequest:
     """One queued generation request."""
 
     __slots__ = ("prompt", "max_new_tokens", "future", "t_submit",
-                 "t_claimed", "trace_id", "prefill_ms")
+                 "t_claimed", "t_deadline", "trace_id", "prefill_ms")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int):
         self.prompt = prompt
@@ -80,6 +94,7 @@ class GenRequest:
         self.future = ServingFuture()
         self.t_submit = time.monotonic()
         self.t_claimed: Optional[float] = None
+        self.t_deadline: float = float("inf")  # set at admission
         self.trace_id: Optional[str] = None
         self.prefill_ms: float = 0.0
 
@@ -354,7 +369,8 @@ class GenerationEngine:
     # -- admission ----------------------------------------------------------
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
-               trace_id: Optional[str] = None) -> ServingFuture:
+               trace_id: Optional[str] = None,
+               deadline_ms: Optional[float] = None) -> ServingFuture:
         """Admit one generation request.  ``prompt``: 1-D int token ids
         (1 ≤ len ≤ the largest prefill bucket).  Returns a future whose
         ``result()`` is ``{"tokens", "prompt_len", "steps", "finish",
@@ -363,7 +379,9 @@ class GenerationEngine:
         is honored until the slot's cache fills, finishing
         ``"cache_full"`` (vs ``"length"`` for a genuinely met budget).
         Sheds with :class:`OverloadedError` (``queue_full`` /
-        ``draining``)."""
+        ``draining`` / ``deadline`` — ``deadline_ms`` is the request's
+        REMAINING end-to-end budget, router-propagated; a spent budget
+        sheds right here instead of claiming a decode slot)."""
         ids = np.asarray(prompt)
         if ids.ndim != 1 or ids.size < 1:
             raise ValueError(f"prompt must be a non-empty 1-D token id "
@@ -380,6 +398,10 @@ class GenerationEngine:
         mnt = max(1, int(max_new_tokens if max_new_tokens is not None
                          else self.max_new_tokens))
         req = GenRequest(ids.astype("int64"), mnt)
+        budget_s = self._deadline_s
+        if deadline_ms is not None:
+            budget_s = min(budget_s, float(deadline_ms) / 1e3)
+        req.t_deadline = req.t_submit + budget_s
         if telemetry.enabled():
             # an externally-minted id (the router hop's trace header)
             # wins: one generated sequence is one trace across tiers
@@ -389,6 +411,9 @@ class GenerationEngine:
         with self._cv:
             if self._draining:
                 raise self._shed_err(req, "draining")
+            if budget_s <= 0:
+                raise self._shed_err(req, "deadline",
+                                     "budget exhausted upstream")
             if len(self._queue) >= self.queue_cap:
                 raise self._shed_err(
                     req, "queue_full",
@@ -406,6 +431,8 @@ class GenerationEngine:
                   detail: str = "") -> OverloadedError:
         self._count("shed")
         stat_add("serving_generate_shed")
+        if reason == "deadline":
+            stat_add("requests_shed_deadline")
         err = OverloadedError(reason, detail)
         err.trace_id = req.trace_id
         return err
@@ -442,7 +469,7 @@ class GenerationEngine:
             req = None
             while self._queue:
                 cand = self._queue.popleft()
-                if now - cand.t_submit > self._deadline_s:
+                if now > cand.t_deadline:
                     self._shed(cand, "deadline")
                     continue
                 req = cand
@@ -487,8 +514,30 @@ class GenerationEngine:
                         f"prefill failed: {type(e).__name__}: {e}"))
                     slot.req = None
             if self._active():
-                self._decode_step()
+                try:
+                    self._decode_step()
+                except Exception as e:  # noqa: BLE001 — a decode-step
+                    # failure fails the ACTIVE requests (after a
+                    # mid-step crash their cache state is unknowable)
+                    # but never the scheduler: the next queued request
+                    # prefills into a clean slot and serving continues
+                    self._decode_failed(e)
             self._publish_gauges()
+
+    def _decode_failed(self, e: Exception):
+        active = self._active()
+        self._count("failed", len(active))
+        stat_add("serving_decode_failures")
+        logger.warning("decode step failed; failing %d active "
+                       "request(s): %s", len(active), e)
+        telemetry.log_event("serving_decode_failure",
+                            active=len(active),
+                            error=f"{type(e).__name__}: {e}")
+        err = RequestFailed(f"decode step failed: "
+                            f"{type(e).__name__}: {e}")
+        for s in active:
+            req, s.req, s.logits = s.req, None, []
+            req.future._resolve(error=err)
 
     # -- prefill ------------------------------------------------------------
     def _run_prefill_program(self, ids: np.ndarray, bucket: int,
@@ -510,8 +559,25 @@ class GenerationEngine:
             scope=self.scope, return_numpy=False)
         return outs
 
+    def _poison_check(self, prompt: np.ndarray):
+        """The generation half of the poison-input model: a prompt
+        carrying the ``FLAGS_serving_poison_value`` sentinel token
+        crashes its prefill — exactly that request fails (prefill
+        isolation), the grid keeps decoding."""
+        pv = flag_value("FLAGS_serving_poison_value")
+        if not pv:
+            return
+        if poison_sentinel_matches(prompt, float(pv)):
+            raise PoisonedInput(
+                f"prompt contains poisoned token (sentinel {pv})")
+
     def _prefill(self, slot: _Slot, req: GenRequest):
         t0 = time.monotonic()
+        kind = fault.fire("prefill")
+        fault.maybe_delay(kind)
+        if kind == "fail":
+            raise fault.InjectedFault("injected prefill failure")
+        self._poison_check(req.prompt)
         bucket = batcher.prompt_bucket_for(req.prompt.size,
                                            self.prefill_buckets)
         with telemetry.trace_span("generation/prefill",
@@ -553,6 +619,10 @@ class GenerationEngine:
 
     def _decode_step(self):
         t0 = time.monotonic()
+        kind = fault.fire("decode_step")
+        fault.maybe_delay(kind)
+        if kind == "fail":
+            raise fault.InjectedFault("injected decode_step failure")
         tokens = np.zeros((self.num_slots, 1), "int64")
         positions = np.zeros((self.num_slots,), "int32")
         active = self._active()
@@ -628,6 +698,18 @@ class GenerationEngine:
             slot.logits = []
         slot.req = None
         req.future._resolve(outputs=result)
+
+    def retry_after_s(self) -> float:
+        """Backoff hint for 503 sheds (the ``Retry-After`` header):
+        queued requests over the slot grid at the measured per-request
+        p50 generation time, bounded to [0.5, 30] s (the one-shot
+        engine's contract, sized for sequences instead of batches)."""
+        with self._cv:
+            depth = len(self._queue)
+        summ = self._h_gen.summary()
+        per_req_s = (summ.get("p50") or 250.0) / 1e3
+        est = (depth / max(1, self.num_slots) + 1) * per_req_s
+        return min(30.0, max(0.5, est))
 
     # -- introspection ------------------------------------------------------
     def _publish_gauges(self):

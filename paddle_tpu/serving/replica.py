@@ -121,6 +121,12 @@ def main(argv=None) -> int:
     ap.add_argument("--no-warmup-gate", action="store_true",
                     help="report ready immediately instead of gating "
                          "on bucket warmup (debugging only)")
+    ap.add_argument("--poison-value", default=None,
+                    help="set FLAGS_serving_poison_value in this "
+                         "replica (deterministic poison-input model "
+                         "for bisection/chaos testing — see README "
+                         "'Failure containment'); normally arrives as "
+                         "the flag env var instead")
     ap.add_argument("--generate", action="store_true",
                     help="also attach a slot-based GenerationEngine so "
                          "this replica serves POST /generate (the "
@@ -137,9 +143,12 @@ def main(argv=None) -> int:
     ap.add_argument("--gen-max-new", type=int, default=32)
     args = ap.parse_args(argv)
 
+    from ..flags import set_flags
     from .engine import ServingEngine
     from .server import serve
 
+    if args.poison_value:
+        set_flags({"FLAGS_serving_poison_value": args.poison_value})
     predictor, shapes = build_predictor(args)
     engine = ServingEngine(
         predictor, workers=args.workers, max_batch=args.max_batch,

@@ -29,10 +29,34 @@ not from a static round-robin.
   (refused / reset / remote-disconnected: the replica died or is
   mid-restart) books a health strike against that replica and retries
   ONCE on a different replica; served inference is idempotent, so a
-  replayed request changes nothing.  Timeouts and in-flight HTTP
-  errors are NOT retried (the work may have executed).  With no
-  routable replica at all the router answers **503**
-  ``{"error": "overloaded", "reason": "no_ready_replicas"}``.
+  replayed request changes nothing.  In-flight HTTP errors are NOT
+  retried.  With no routable replica at all the router answers
+  **503** ``{"error": "overloaded", "reason": "no_ready_replicas"}``
+  with a ``Retry-After`` header (poll-cadence-derived), so clients
+  back off instead of hammering an empty fleet; replica 503s forward
+  the replica's own ``Retry-After`` verbatim.
+
+* **Hung-replica containment** — every forward carries a socket
+  timeout (``FLAGS_router_forward_timeout_ms``, tightened by the
+  request's remaining deadline budget): a *hung* replica (SIGSTOP'd,
+  wedged — it still accepts connections, so connect-refused ejection
+  never sees it) costs one bounded attempt instead of pinning a
+  router thread until the client gives up.  A timeout strikes the
+  replica's health (the same consecutive-failure counter the poll
+  uses — repeated hangs eject it) and retries ONCE on an alternate
+  (inference is idempotent; the replay wastes at most one batch
+  slot); with no alternate, or a second timeout, the client gets
+  **504** ``{"error": "forward_timeout", "trace_id": ...}``.  The
+  listener itself never blocks — only the one handler thread waits.
+
+* **End-to-end deadlines** — an ``X-PaddleTPU-Deadline-Ms`` request
+  header (minted from ``FLAGS_router_default_deadline_ms`` when the
+  client sent none) is the request's REMAINING latency budget: the
+  router decrements its own elapsed time before every forward, the
+  forward timeout tightens to the remainder, and a spent budget
+  answers 503 ``deadline`` immediately — replica admission sheds on
+  the same header, so a hopeless request never burns a batch slot
+  anywhere in the fleet.
 
 * **Trace continuity** — the router forwards (or mints) an
   ``X-PaddleTPU-Trace`` id; its own ``router/request`` →
@@ -57,10 +81,17 @@ routing decision counters, autoscale signal).
 
 Stats (README catalog): counters ``router_http_requests``,
 ``router_requests_routed``, ``router_retries``,
+``router_forward_timeouts``, ``requests_shed_deadline``,
 ``router_no_ready_replicas``, ``router_replica_errors``,
 ``router_ejections``, ``router_recoveries``, ``router_health_polls``,
 ``router_health_poll_failures``; gauges ``router_replicas_ready``,
 ``fleet_wanted_replicas``; histogram ``router_request_ms``.
+
+Fault site (``paddle_tpu/fault.py``): ``router_forward`` — ``fail``
+simulates a connect-level forward failure (exercises the
+strike-and-retry path), ``delay:ms`` / ``hang`` stall the forward
+(what the timeout exists to bound) — the chaos harness's "slow"
+scenario injects here.
 """
 from __future__ import annotations
 
@@ -78,10 +109,11 @@ import urllib.request
 from http.server import ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
-from .. import telemetry
+from .. import fault, telemetry
 from ..flags import all_flags, flag_value
 from ..monitor import process_uptime_s, stat_add
-from .server import (TRACE_HEADER, _AccessLog, _JsonHandler,
+from .server import (DEADLINE_HEADER, TRACE_HEADER, _AccessLog,
+                     _JsonHandler, parse_deadline_header,
                      parse_trace_header)
 
 __all__ = ["Router", "RouterServer", "serve_router"]
@@ -103,6 +135,17 @@ def _is_connect_error(exc) -> bool:
         return True
     reason = getattr(exc, "reason", None)
     return isinstance(reason, _CONNECT_ERRORS)
+
+
+def _is_timeout_error(exc) -> bool:
+    """A forward that ran out its socket timeout: the replica accepted
+    the connection but never answered — the hung-replica signature
+    (connect-refused means DEAD, timeout means WEDGED; they take
+    different containment paths)."""
+    if isinstance(exc, TimeoutError):  # socket.timeout is an alias
+        return True
+    reason = getattr(exc, "reason", None)
+    return isinstance(reason, TimeoutError)
 
 
 class _Replica:
@@ -186,6 +229,7 @@ class Router:
                  stale_ms: Optional[float] = None,
                  eject_after: Optional[int] = None,
                  request_timeout_s: float = 30.0,
+                 forward_timeout_ms: Optional[float] = None,
                  autostart: bool = True):
         self._slo_p99_ms = float(
             slo_p99_ms if slo_p99_ms is not None
@@ -200,6 +244,13 @@ class Router:
             eject_after if eject_after is not None
             else flag_value("FLAGS_router_eject_after")))
         self.request_timeout_s = float(request_timeout_s)
+        # per-forward socket timeout: the most a hung replica can cost
+        # one attempt (0/unset falls back to the request timeout)
+        fwd = float(forward_timeout_ms if forward_timeout_ms is not None
+                    else flag_value("FLAGS_router_forward_timeout_ms")
+                    or 0.0)
+        self.forward_timeout_s = fwd / 1e3 if fwd > 0 \
+            else self.request_timeout_s
 
         self._lock = threading.Lock()
         self._replicas: Dict[str, _Replica] = {}
@@ -209,7 +260,8 @@ class Router:
         self._n = {"requests": 0, "routed": 0, "retries": 0,
                    "no_ready": 0, "replica_errors": 0, "ejections": 0,
                    "recoveries": 0, "health_polls": 0,
-                   "health_poll_failures": 0}
+                   "health_poll_failures": 0, "forward_timeouts": 0,
+                   "deadline_sheds": 0}
         self._h_request = telemetry.Histogram("router_request_ms")
         # sliding (ts, ms) window of served latencies -> SLO pressure
         self._recent: collections.deque = collections.deque(maxlen=2048)
@@ -298,10 +350,14 @@ class Router:
                 body = json.loads(r.read())
         except urllib.error.HTTPError as e:
             # a 503 /healthz is still an ANSWER (closed engine): parse
-            # it so status/ready reflect what the replica said
+            # it so status/ready reflect what the replica said — but
+            # only a body that IS a health document counts; a 500 with
+            # an error payload (broken health endpoint) must strike
             try:
                 body = json.loads(e.read())
             except (OSError, ValueError):
+                body = None
+            if not isinstance(body, dict) or "status" not in body:
                 self._poll_failed(rep, f"HTTP {e.code}")
                 return
         except (OSError, TimeoutError, ValueError) as e:
@@ -425,38 +481,66 @@ class Router:
             self._n[key] += n
 
     def _send(self, rep: _Replica, route: str, body: bytes,
-              trace_id: Optional[str]) -> Tuple[int, bytes, str]:
-        req = urllib.request.Request(
-            rep.url + route, data=body,
-            headers={"Content-Type": "application/json",
-                     TRACE_HEADER: trace_id or ""})
+              trace_id: Optional[str], timeout_s: float,
+              deadline_ms: Optional[float]
+              ) -> Tuple[int, bytes, str, Optional[str]]:
+        headers = {"Content-Type": "application/json",
+                   TRACE_HEADER: trace_id or ""}
+        if deadline_ms is not None:
+            # the REMAINING budget (already decremented by this
+            # router's elapsed time): replica admission sheds on it
+            headers[DEADLINE_HEADER] = f"{deadline_ms:.1f}"
+        req = urllib.request.Request(rep.url + route, data=body,
+                                     headers=headers)
         with self._lock:
             rep.inflight += 1
         try:
             try:
-                with urllib.request.urlopen(
-                        req, timeout=self.request_timeout_s) as r:
+                with urllib.request.urlopen(req, timeout=timeout_s) as r:
                     return (r.status, r.read(),
                             r.headers.get("Content-Type",
-                                          "application/json"))
+                                          "application/json"),
+                            r.headers.get("Retry-After"))
             except urllib.error.HTTPError as e:
                 # the replica ANSWERED (400/404/500/503-shed): its
                 # verdict passes through verbatim, never retried
                 data = e.read()
                 return (e.code, data,
                         e.headers.get("Content-Type",
-                                      "application/json"))
+                                      "application/json"),
+                        e.headers.get("Retry-After"))
         finally:
             with self._lock:
                 rep.inflight -= 1
 
+    def _shed_deadline(self, trace_id, deadline_ms, retried) -> dict:
+        self._count("deadline_sheds")
+        stat_add("requests_shed_deadline")
+        # every backpressure 503 carries a backoff hint (README
+        # contract): the budget is the CLIENT's — a retry with a fresh
+        # one can succeed immediately, so the hint is the floor
+        return {"code": 503,
+                "body": json.dumps(
+                    {"error": "overloaded", "reason": "deadline",
+                     "detail": f"deadline budget of {deadline_ms:.1f}ms "
+                               f"exhausted at the router",
+                     "retry_after_s": 1,
+                     "trace_id": trace_id}).encode(),
+                "content_type": "application/json", "replica": None,
+                "retried": retried, "retry_after": 1}
+
     def route(self, route: str, body: bytes,
-              trace_id: Optional[str] = None) -> dict:
-        """Place one request: pick → forward → (on connect failure)
-        strike + retry once on an alternate.  Returns ``{"code",
-        "body", "content_type", "replica", "retried"}``; a fleet with
-        no routable replica yields the explicit 503
-        ``no_ready_replicas`` payload."""
+              trace_id: Optional[str] = None,
+              deadline_ms: Optional[float] = None) -> dict:
+        """Place one request: pick → forward (bounded by the forward
+        timeout and the remaining deadline budget) → on a connect
+        failure OR a forward timeout, strike health + retry once on
+        an alternate.  Returns ``{"code", "body", "content_type",
+        "replica", "retried", "retry_after"}``; a fleet with no
+        routable replica yields the explicit 503 ``no_ready_replicas``
+        payload (with a backoff hint); a spent deadline yields 503
+        ``deadline`` without burning a forward; an unretryable hang
+        yields 504 ``forward_timeout``."""
         self._count("requests")
         stat_add("router_http_requests")
         t0 = time.monotonic()
@@ -464,23 +548,86 @@ class Router:
         rep = self.pick()
         retried = False
         while rep is not None:
+            remaining_ms = None
+            if deadline_ms is not None:
+                remaining_ms = deadline_ms \
+                    - (time.monotonic() - t0) * 1e3
+                if remaining_ms <= 0:
+                    return self._shed_deadline(trace_id, deadline_ms,
+                                               retried)
+            # deadline_bound: the socket timeout below is the CLIENT's
+            # remaining budget, not the hang bound — running it out
+            # means the deadline expired, which must neither strike a
+            # healthy replica's health nor read as a replica hang
+            deadline_bound = (remaining_ms is not None
+                              and remaining_ms / 1e3
+                              < self.forward_timeout_s)
+            timeout_s = self.forward_timeout_s if remaining_ms is None \
+                else max(0.05, min(self.forward_timeout_s,
+                                   remaining_ms / 1e3))
             try:
-                code, data, ctype = self._send(rep, route, body,
-                                               trace_id)
+                kind = fault.fire("router_forward")
+                fault.maybe_delay(kind)  # chaos 'slow': stall the hop
+                if kind == "fail":
+                    raise ConnectionRefusedError(
+                        "injected router_forward failure")
+                code, data, ctype, retry_after = self._send(
+                    rep, route, body, trace_id, timeout_s,
+                    remaining_ms)
             except Exception as e:  # noqa: BLE001 — sort, don't die
                 with self._lock:
                     rep.errors += 1
-                if _is_connect_error(e) and not tried:
-                    # the replica is gone or mid-restart: strike its
-                    # health (fast path to ejection) and try ONE
-                    # alternate — the request never started executing
+                timed_out = _is_timeout_error(e)
+                if timed_out and deadline_bound:
+                    # the client's budget ran out mid-forward: a
+                    # deadline shed, not a replica hang — the replica
+                    # may be perfectly healthy, just slower than this
+                    # request's remaining budget
+                    return self._shed_deadline(trace_id, deadline_ms,
+                                               retried)
+                if timed_out:
+                    # hung replica: strike the same consecutive-failure
+                    # counter the health poll uses (repeated hangs
+                    # eject) — a hang must never look healthier than a
+                    # crash
+                    self._count("forward_timeouts")
+                    stat_add("router_forward_timeouts")
+                    self._poll_failed(
+                        rep, f"forward timeout ({timeout_s:.2f}s)")
+                if (timed_out or _is_connect_error(e)) and not tried:
+                    # dead or wedged: try ONE alternate — inference is
+                    # idempotent, so a replay (even after a timeout,
+                    # where the work may have executed) wastes at most
+                    # one batch slot and changes no answer
                     tried.append(rep.url)
-                    self._poll_failed(rep, f"connect: {e}")
-                    self._count("retries")
-                    stat_add("router_retries")
-                    retried = True
-                    rep = self.pick(exclude=tried)
-                    continue
+                    if not timed_out:
+                        self._poll_failed(rep, f"connect: {e}")
+                    alt = self.pick(exclude=tried)
+                    if alt is not None:
+                        self._count("retries")
+                        stat_add("router_retries")
+                        retried = True
+                        rep = alt
+                        continue
+                    if not timed_out:
+                        # dead replica, empty fleet: the explicit
+                        # no_ready_replicas 503 below
+                        rep = None
+                        continue
+                    # a hang with no alternate surfaces as 504, not as
+                    # an empty fleet — the replica exists, it's wedged
+                if timed_out:
+                    logger.warning("forward to %s timed out after "
+                                   "%.2fs", rep.url, timeout_s)
+                    return {"code": 504,
+                            "body": json.dumps(
+                                {"error": "forward_timeout",
+                                 "replica": rep.url,
+                                 "timeout_ms": round(timeout_s * 1e3, 1),
+                                 "trace_id": trace_id}).encode(),
+                            "content_type": "application/json",
+                            "replica": rep.url, "retried": retried,
+                            "retry_after": None}
                 self._count("replica_errors")
                 stat_add("router_replica_errors")
                 logger.warning("forward to %s failed: %s", rep.url, e)
@@ -491,7 +638,8 @@ class Router:
                              "detail": f"{type(e).__name__}: {e}",
                              "trace_id": trace_id}).encode(),
                         "content_type": "application/json",
-                        "replica": rep.url, "retried": retried}
+                        "replica": rep.url, "retried": retried,
+                        "retry_after": None}
             with self._lock:
                 rep.routed += 1
                 if retried:
@@ -506,19 +654,25 @@ class Router:
                 with self._lock:
                     self._recent.append((time.monotonic(), ms))
             return {"code": code, "body": data, "content_type": ctype,
-                    "replica": rep.url, "retried": retried}
+                    "replica": rep.url, "retried": retried,
+                    "retry_after": retry_after}
         # fleet empty (or emptied by the retry exclusion)
         self._count("no_ready")
         stat_add("router_no_ready_replicas")
+        # backoff hint: by the next staleness window the fleet either
+        # recovered a replica or is still worth backing off from
+        retry_after = int(math.ceil(min(30.0, max(1.0, self._stale_s))))
         return {"code": 503,
                 "body": json.dumps(
                     {"error": "overloaded",
                      "reason": "no_ready_replicas",
                      "detail": f"{len(self._all())} registered, 0 "
-                               f"routable", "trace_id": trace_id}
+                               f"routable",
+                     "retry_after_s": retry_after,
+                     "trace_id": trace_id}
                 ).encode(),
                 "content_type": "application/json", "replica": None,
-                "retried": retried}
+                "retried": retried, "retry_after": retry_after}
 
     # -- introspection ------------------------------------------------------
     def stats(self) -> dict:
@@ -561,6 +715,9 @@ class Router:
             "stale_ms": self._stale_s * 1e3,
             "eject_after": self.eject_after,
             "slo_p99_ms": self._slo_p99_ms,
+            "forward_timeout_ms": self.forward_timeout_s * 1e3,
+            "default_deadline_ms": float(
+                flag_value("FLAGS_router_default_deadline_ms") or 0.0),
             "flags": all_flags(),
             "fleet": self.stats(),
         }
@@ -605,6 +762,15 @@ class _RouterHandler(_JsonHandler):
         trace_id = parse_trace_header(self.headers.get(TRACE_HEADER)) \
             or (telemetry.new_trace_id() if telemetry.enabled()
                 else None)
+        # forward the caller's deadline budget or mint the fleet
+        # default: every downstream hop decrements and sheds on it
+        deadline_ms = parse_deadline_header(
+            self.headers.get(DEADLINE_HEADER))
+        if deadline_ms is None:
+            dflt = float(flag_value("FLAGS_router_default_deadline_ms")
+                         or 0.0)
+            if dflt > 0:
+                deadline_ms = dflt
         t0 = time.monotonic()
         root = telemetry.span_begin("router/request", detached=True,
                                     trace_id=trace_id, path=route)
@@ -614,7 +780,8 @@ class _RouterHandler(_JsonHandler):
             trace_id=trace_id)
         res = None
         try:
-            res = self.router.route(route, body, trace_id)
+            res = self.router.route(route, body, trace_id,
+                                    deadline_ms=deadline_ms)
             if fwd is not None:
                 fwd.attrs["replica"] = res["replica"]
                 fwd.attrs["retried"] = res["retried"]
@@ -637,14 +804,23 @@ class _RouterHandler(_JsonHandler):
             if root is not None:
                 root.attrs["status"] = res["code"] if res else 500
             telemetry.span_end(root)
+        headers = None
+        if res.get("retry_after"):
+            # router-origin backoff hints AND replica Retry-After
+            # headers (their 503s pass through verbatim) both land on
+            # the client
+            headers = {"Retry-After": str(res["retry_after"])}
         self._reply_raw(res["code"], res["body"], res["content_type"],
-                        trace_id=trace_id)
+                        trace_id=trace_id, headers=headers)
         ms = (time.monotonic() - t0) * 1e3
-        self.access_log.write({
+        rec = {
             "ts": round(time.time(), 6), "method": "POST",
             "path": route, "status": res["code"],
             "ms": round(ms, 3), "trace_id": trace_id, "tier": "router",
-            "replica": res["replica"], "retried": res["retried"]})
+            "replica": res["replica"], "retried": res["retried"]}
+        if deadline_ms is not None:
+            rec["deadline_ms"] = deadline_ms
+        self.access_log.write(rec)
 
 
 class RouterServer:

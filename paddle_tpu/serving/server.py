@@ -14,8 +14,15 @@ Endpoints:
   ``{"outputs": [nested_list, ...], "shapes": [...], "ms": float,
   "trace_id": hex}``.  Overload/drain sheds → **503** ``{"error":
   "overloaded", "reason": "queue_full" | "deadline" | "draining" |
-  "injected"}`` (explicit backpressure, never unbounded queueing);
-  malformed body / wrong feeds → 400; batch execution failure → 500.
+  "injected", "retry_after_s": float}`` with a ``Retry-After`` header
+  derived from the engine's live backlog (explicit backpressure,
+  never unbounded queueing); malformed body / wrong feeds → 400;
+  batch execution failure → 500 (with poison bisection, exactly the
+  poisoned request 500s — its batchmates still answer 200
+  bit-exact).  An ``X-PaddleTPU-Deadline-Ms`` request header (the
+  remaining end-to-end budget, minted/decremented by the fleet
+  router) tightens the engine deadline: an exhausted budget sheds at
+  admission (503 ``deadline``) instead of burning a batch slot.
 * ``POST /generate`` — body ``{"prompt": [token ids],
   "max_new_tokens": N?}`` against the attached
   :class:`~paddle_tpu.serving.generation.GenerationEngine` (slot-based
@@ -53,6 +60,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import re
 import threading
@@ -75,6 +83,13 @@ logger = logging.getLogger("paddle_tpu.serving.http")
 TRACE_HEADER = "X-PaddleTPU-Trace"
 _TRACE_ID_RE = re.compile(r"^[A-Za-z0-9_-]{1,64}$")
 
+# end-to-end deadline propagation: the REMAINING latency budget (ms) a
+# request still has.  Minted by the client or the fleet router
+# (FLAGS_router_default_deadline_ms), decremented by the router's own
+# elapsed time before each forward, adopted by replica admission so a
+# hopeless request sheds at the queue instead of burning a batch slot.
+DEADLINE_HEADER = "X-PaddleTPU-Deadline-Ms"
+
 
 def parse_trace_header(value) -> Optional[str]:
     """Validate an incoming trace-id header: a short url-safe token or
@@ -84,6 +99,20 @@ def parse_trace_header(value) -> Optional[str]:
         return None
     value = value.strip()
     return value if _TRACE_ID_RE.match(value) else None
+
+
+def parse_deadline_header(value) -> Optional[float]:
+    """Validate an incoming remaining-budget header: a finite float of
+    milliseconds, or nothing (malformed / non-finite values are
+    dropped — a garbage header must not become an infinite or NaN
+    deadline)."""
+    if not value:
+        return None
+    try:
+        ms = float(str(value).strip())
+    except ValueError:
+        return None
+    return ms if math.isfinite(ms) else None
 
 
 class _AccessLog:
@@ -162,18 +191,22 @@ class _JsonHandler(BaseHTTPRequestHandler):
         self.logger.debug("%s " + fmt, self.address_string(), *args)
 
     def _reply(self, code: int, payload: dict,
-               trace_id: Optional[str] = None):
+               trace_id: Optional[str] = None,
+               headers: Optional[dict] = None):
         body = json.dumps(payload).encode()
         self._reply_raw(code, body, "application/json",
-                        trace_id=trace_id)
+                        trace_id=trace_id, headers=headers)
 
     def _reply_raw(self, code: int, body: bytes, content_type: str,
-                   trace_id: Optional[str] = None):
+                   trace_id: Optional[str] = None,
+                   headers: Optional[dict] = None):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         if trace_id:
             self.send_header(TRACE_HEADER, trace_id)
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
@@ -198,6 +231,16 @@ class _Handler(_JsonHandler):
         handler()
 
     def _get_healthz(self):
+        # chaos site: a hanging or failing health endpoint is how a
+        # wedged replica looks to the router's poll loop — delay:ms /
+        # hang kinds stall THIS handler thread (the poll times out and
+        # strikes), `fail` answers 500
+        kind = fault.fire("replica_health")
+        fault.maybe_delay(kind)
+        if kind == "fail":
+            self._reply(500, {"error": "injected replica_health "
+                                       "failure"})
+            return
         health = self.engine.health()
         self._reply(503 if health["status"] == "closed" else 200, health)
 
@@ -299,24 +342,37 @@ class _Handler(_JsonHandler):
         stat_add("serving_http_requests")
         t0 = time.monotonic()
         hop_trace = parse_trace_header(self.headers.get(TRACE_HEADER))
+        deadline_ms = parse_deadline_header(
+            self.headers.get(DEADLINE_HEADER))
         if route == "/predict":
-            code, payload, trace = self._predict(body, hop_trace)
+            code, payload, trace = self._predict(body, hop_trace,
+                                                 deadline_ms)
         else:
-            code, payload, trace = self._generate(body, hop_trace)
+            code, payload, trace = self._generate(body, hop_trace,
+                                                  deadline_ms)
         tid = ((trace or {}).get("trace_id") or payload.get("trace_id")
                or hop_trace)
-        self._reply(code, payload, trace_id=tid)
+        headers = None
+        if code == 503 and payload.get("retry_after_s"):
+            # explicit backpressure carries its backoff hint: clients
+            # (and the loadgen) back off instead of hammering
+            headers = {"Retry-After":
+                       str(int(math.ceil(payload["retry_after_s"])))}
+        self._reply(code, payload, trace_id=tid, headers=headers)
         ms = (time.monotonic() - t0) * 1e3
         rec = {"ts": round(time.time(), 6), "method": "POST",
                "path": route, "status": code, "ms": round(ms, 3),
                "trace_id": tid}
+        if deadline_ms is not None:
+            rec["deadline_ms"] = deadline_ms
         if trace:
             rec["rows"] = trace.get("rows")
             rec["phases"] = trace.get("phases")
             rec["request_status"] = trace.get("status")
         self.access_log.write(rec)
 
-    def _generate(self, body: bytes, hop_trace: Optional[str] = None):
+    def _generate(self, body: bytes, hop_trace: Optional[str] = None,
+                  deadline_ms: Optional[float] = None):
         """One POST /generate body — ``{"prompt": [token ids],
         "max_new_tokens": N?}`` — against the attached GenerationEngine.
         404 when no generator is attached, 503 on overload sheds
@@ -338,11 +394,13 @@ class _Handler(_JsonHandler):
         t0 = time.monotonic()
         try:
             fut = self.engine.submit_generate(prompt, max_new_tokens=mnt,
-                                              trace_id=hop_trace)
-            res = fut.result(self.request_timeout_s)
+                                              trace_id=hop_trace,
+                                              deadline_ms=deadline_ms)
+            res = fut.result(self._wait_s(deadline_ms))
         except OverloadedError as e:
             return 503, {"error": "overloaded", "reason": e.reason,
                          "detail": str(e),
+                         "retry_after_s": round(gen.retry_after_s(), 3),
                          "trace_id": getattr(e, "trace_id", None)}, None
         except ValueError as e:  # bad prompt shape/dtype/length
             return 400, {"error": "bad request", "detail": str(e)}, None
@@ -361,7 +419,19 @@ class _Handler(_JsonHandler):
                               "queue_wait_ms": res.get("queue_wait_ms"),
                               "predict_ms": res.get("prefill_ms")}}
 
-    def _predict(self, body: bytes, hop_trace: Optional[str] = None):
+    def _wait_s(self, deadline_ms: Optional[float]) -> Optional[float]:
+        """How long the handler thread blocks for the future: the
+        configured request timeout, tightened by the request's
+        remaining deadline budget (+ grace for the in-batch tail — a
+        deadline passing mid-batch still returns the real answer)."""
+        if deadline_ms is None:
+            return self.request_timeout_s
+        budget = deadline_ms / 1e3 + 5.0
+        return budget if self.request_timeout_s is None \
+            else min(self.request_timeout_s, budget)
+
+    def _predict(self, body: bytes, hop_trace: Optional[str] = None,
+                 deadline_ms: Optional[float] = None):
         """Run one /predict body; returns (http_code, payload,
         trace_record_or_None) so do_POST can both reply and access-log
         without re-deciding anything."""
@@ -376,11 +446,14 @@ class _Handler(_JsonHandler):
         t0 = time.monotonic()
         fut = None
         try:
-            fut = self.engine.submit(inputs, trace_id=hop_trace)
-            outputs = fut.result(self.request_timeout_s)
+            fut = self.engine.submit(inputs, trace_id=hop_trace,
+                                     deadline_ms=deadline_ms)
+            outputs = fut.result(self._wait_s(deadline_ms))
         except OverloadedError as e:
             return 503, {"error": "overloaded", "reason": e.reason,
                          "detail": str(e),
+                         "retry_after_s": round(
+                             self.engine.retry_after_s(), 3),
                          "trace_id": getattr(e, "trace_id", None)}, \
                 (fut.trace if fut is not None else None)
         except (ValueError, KeyError) as e:  # bad feed names/shapes

@@ -54,7 +54,11 @@ Degradation contract: a replica group whose batches keep failing
 sink its requests silently NOR stop the other groups (the
 ``serve_batch:fail`` fault matrix covers exactly this).  A group whose
 mesh devices are missing from the live device set reports
-``missing_shards``.
+``missing_shards``.  Poison-request *bisection* is inherited from the
+base scheduler unchanged: a poisoned row in a group's batch is
+isolated by split-and-retry on THAT group's mesh, its riders served
+bit-exact (``tests/test_fault_containment.py``), and the stuck-worker
+watchdog covers a wedged group dispatch thread the same way.
 
 Stats (README catalog): gauges ``serving_replica_groups``,
 ``serving_groups_degraded``; per-device counters

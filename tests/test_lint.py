@@ -138,6 +138,15 @@ def test_every_serving_flag_is_documented_in_readme():
     assert "FLAGS_serving_group_degraded_after" in names  # sharded set
     assert "FLAGS_router_slo_p99_ms" in names  # ...and the fleet set
     assert "FLAGS_fleet_max_restarts" in names
+    # ...and the fault-containment set (bisection, deadlines,
+    # watchdogs): these change failure semantics, the worst kind of
+    # knob to leave undocumented
+    assert "FLAGS_serving_bisect" in names
+    assert "FLAGS_serving_poison_value" in names
+    assert "FLAGS_serving_worker_stuck_ms" in names
+    assert "FLAGS_router_forward_timeout_ms" in names
+    assert "FLAGS_router_default_deadline_ms" in names
+    assert "FLAGS_fleet_liveness_timeout_ms" in names
     with open(os.path.join(REPO, "README.md"), encoding="utf-8") as f:
         readme = f.read()
     missing = [n for n in names if f"`{n}`" not in readme]

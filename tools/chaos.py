@@ -1,0 +1,493 @@
+#!/usr/bin/env python
+"""Fleet chaos harness: kill / hang / slow / poison scenarios against
+a LIVE replica fleet under open-loop load, asserting an availability
+budget.
+
+The serving tier's answer to the training tier's fault-matrix tests:
+every containment mechanism the stack claims — router connect-refused
+retry, forward timeouts + timeout retry (hung replicas), health
+ejection, supervisor crash respawn and the liveness SIGKILL, poison
+request bisection, deadline shedding — is exercised against real
+processes and real sockets, and the run FAILS unless:
+
+* **zero collateral failures** — every failed request must be
+  attributable to an injected fault (inside the fault window, or a
+  deliberately poisoned request); a failure outside any window means
+  containment leaked;
+* **zero poison leaks** — a poisoned request that returned 200 means
+  bisection served a row the model should have crashed on;
+* **availability >= the budget** (default 99%) over all non-poisoned
+  requests across every scenario, injected damage included.
+
+Scenarios (one shared fleet; traffic is open-loop ``POST /predict``
+through the router):
+
+=========  ==============================================  =============
+scenario   injection                                       recovery path
+=========  ==============================================  =============
+crash      SIGKILL one replica mid-traffic                 connect-refused retry +
+                                                           supervisor respawn
+hang       SIGSTOP one replica (PID alive, sockets open)   forward-timeout retry +
+                                                           health ejection +
+                                                           liveness SIGKILL/respawn
+slow       ``router_forward:delay:<ms>~<p>`` fault in the  none needed: slow is
+           router process (random per-forward delay)       not failure — zero
+                                                           failures allowed
+poison     every Nth request carries the
+           ``FLAGS_serving_poison_value`` sentinel         bisection: poisoned
+                                                           request 500s, riders
+                                                           answer bit-exact
+=========  ==============================================  =============
+
+Usage::
+
+    python tools/chaos.py --replicas 3 --qps 40 --duration 6 \
+        --scenarios crash,hang,slow,poison --availability-pct 99 \
+        --out chaos.json
+
+``bench.py run_chaos`` publishes the same report as ``legs.chaos``
+and ``tools/perf_gate.py`` hard-fails any capture with collateral
+failures or poison leaks (no anomaly flag shields them).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue as queue_mod
+import signal
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the poison sentinel: representable exactly in float32 and JSON, far
+# outside any real feature distribution
+POISON = 1e30
+
+DEFAULT_SCENARIOS = ("crash", "hang", "slow", "poison")
+
+
+# ---------------------------------------------------------------------------
+# traffic: open-loop POST /predict with per-request attribution
+# ---------------------------------------------------------------------------
+
+def _bodies(feat: int, n: int = 16, seed: int = 0) -> List[bytes]:
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        row = rng.rand(1, feat).astype("float32")
+        out.append(json.dumps({"inputs": {"x": row.tolist()}}).encode())
+    return out
+
+
+def _poison_body(feat: int) -> bytes:
+    row = [[POISON] + [0.5] * (feat - 1)]
+    return json.dumps({"inputs": {"x": row}}).encode()
+
+
+def _post(url: str, body: bytes, timeout_s: float):
+    """One POST → (outcome, http_status).  Same taxonomy as the
+    loadgen: replica/router backpressure 503s are ``shed`` (the
+    router's ``no_ready_replicas`` = total availability loss =
+    ``failed``), everything else non-200 is ``failed``."""
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            r.read()
+            return "ok", r.status
+    except urllib.error.HTTPError as e:
+        try:
+            payload = e.read()
+        except OSError:
+            payload = b""  # ok: error body gone with the connection
+        if e.code != 503:
+            return "failed", e.code
+        try:
+            reason = json.loads(payload).get("reason")
+        except (ValueError, AttributeError):
+            reason = None
+        return (("failed", e.code) if reason == "no_ready_replicas"
+                else ("shed", e.code))
+    except (OSError, TimeoutError, ValueError):
+        return "failed", None
+
+
+def run_traffic(url: str, feat: int, qps: float, duration_s: float,
+                poison_every: int = 0, timeout_s: float = 15.0,
+                workers: int = 16) -> List[dict]:
+    """Open-loop traffic: a pacing clock enqueues bodies at ``qps``; a
+    poster pool sends them.  Every request is recorded with its
+    monotonic start/end and whether it was deliberately poisoned —
+    the attribution the collateral-failure contract needs."""
+    predict = url.rstrip("/") + "/predict"
+    bodies = _bodies(feat)
+    poison = _poison_body(feat)
+    records: List[dict] = []
+    lock = threading.Lock()
+    pending: queue_mod.Queue = queue_mod.Queue()
+
+    def poster():
+        while True:
+            item = pending.get()
+            if item is None:
+                return
+            body, is_poison, t0 = item
+            outcome, status = _post(predict, body, timeout_s)
+            t1 = time.monotonic()
+            with lock:
+                records.append({"t0": t0, "t1": t1, "outcome": outcome,
+                                "status": status, "poison": is_poison,
+                                "ms": (t1 - t0) * 1e3})
+
+    pool = [threading.Thread(target=poster, daemon=True)
+            for _ in range(workers)]
+    for t in pool:
+        t.start()
+    period = 1.0 / max(qps, 0.001)
+    t_start = time.monotonic()
+    i = 0
+    while True:
+        now = time.monotonic()
+        if now - t_start >= duration_s:
+            break
+        is_poison = bool(poison_every and (i + 1) % poison_every == 0)
+        pending.put((poison if is_poison else bodies[i % len(bodies)],
+                     is_poison, now))
+        i += 1
+        sleep_for = t_start + i * period - time.monotonic()
+        if sleep_for > 0:
+            time.sleep(sleep_for)
+    for _ in pool:
+        pending.put(None)
+    for t in pool:
+        t.join()
+    return records
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+def classify(records: List[dict], windows: List[tuple]) -> dict:
+    """Attribute every outcome: a failure is *injected* when the
+    request was poisoned or its lifetime overlaps a fault window,
+    *collateral* otherwise (the hard-zero contract); a poisoned
+    request that returned 200 is a *leak* (bisection served a row the
+    model must crash on)."""
+    n = {"requests": len(records), "ok": 0, "shed": 0,
+         "injected_failures": 0, "collateral_failures": 0,
+         "poison_leaks": 0, "poisoned": 0}
+    ok_ms = []
+    for r in records:
+        if r["poison"]:
+            n["poisoned"] += 1
+        if r["outcome"] == "ok":
+            n["ok"] += 1
+            ok_ms.append(r["ms"])
+            if r["poison"]:
+                n["poison_leaks"] += 1
+        elif r["outcome"] == "shed":
+            n["shed"] += 1
+        else:
+            in_window = any(r["t1"] >= w0 and r["t0"] <= w1
+                            for w0, w1 in windows)
+            if r["poison"] or in_window:
+                n["injected_failures"] += 1
+            else:
+                n["collateral_failures"] += 1
+    nonpoison = n["requests"] - n["poisoned"]
+    failed_nonpoison = sum(
+        1 for r in records
+        if r["outcome"] not in ("ok", "shed") and not r["poison"])
+    n["availability_pct"] = round(
+        100.0 * (1.0 - failed_nonpoison / max(1, nonpoison)), 3)
+    if ok_ms:
+        ok_ms.sort()
+        n["p99_ms"] = round(
+            ok_ms[min(len(ok_ms) - 1,
+                      int(np.ceil(0.99 * len(ok_ms))) - 1)], 3)
+    else:
+        n["p99_ms"] = None
+    return n
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+def _wait_respawned_ready(rep, old_pid, timeout_s: float = 90.0
+                          ) -> Optional[float]:
+    """Block until the replica slot runs a NEW, ready process; returns
+    the monotonic recovery instant (None on timeout)."""
+    from paddle_tpu.serving.fleet import _healthz
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        proc = rep.proc
+        if proc is not None and proc.pid != old_pid \
+                and proc.poll() is None:
+            h = _healthz(rep.url, timeout=2.0)
+            if h is not None and h.get("ready"):
+                return time.monotonic()
+        time.sleep(0.1)
+    return None
+
+
+def _scenario(name: str, sup, router, url: str, cfg: dict) -> dict:
+    """Run one scenario's traffic with its injection; returns the
+    classified report + the raw records (for the aggregate)."""
+    from paddle_tpu import fault
+
+    qps, duration = cfg["qps"], cfg["duration_s"]
+    feat = cfg["feat"]
+    box: Dict[str, Optional[float]] = {"t_fault": None, "t_recover": None}
+    error = None
+    notes = {}
+    injector = None
+    poison_every = 0
+
+    if name in ("crash", "hang"):
+        victim = sup._replicas[0]
+        old_pid = victim.proc.pid
+        sig = signal.SIGKILL if name == "crash" else signal.SIGSTOP
+
+        def inject():
+            time.sleep(duration * 0.25)
+            box["t_fault"] = time.monotonic()
+            try:
+                os.kill(old_pid, sig)
+            except OSError as e:
+                box["error"] = f"inject: {e}"
+                return
+            box["t_recover"] = _wait_respawned_ready(victim, old_pid)
+
+        notes["victim"] = victim.url
+        if name == "hang":
+            notes["hung_kills_before"] = victim.hung_kills
+        injector = threading.Thread(target=inject, daemon=True)
+        injector.start()
+    elif name == "slow":
+        # injected in THIS process: the router's forward hop randomly
+        # stalls — latency rises, nothing may fail
+        fault.configure(f"router_forward:delay:{cfg['slow_delay_ms']}"
+                        f"~{cfg['slow_prob']}")
+        notes["delay_ms"] = cfg["slow_delay_ms"]
+        notes["delay_prob"] = cfg["slow_prob"]
+    elif name == "poison":
+        poison_every = cfg["poison_every"]
+        notes["poison_every"] = poison_every
+    else:
+        raise ValueError(f"unknown scenario {name!r}")
+
+    try:
+        records = run_traffic(url, feat, qps, duration,
+                              poison_every=poison_every,
+                              timeout_s=cfg["timeout_s"])
+    finally:
+        if name == "slow":
+            fault.configure("")  # restore: later scenarios run clean
+    if injector is not None:
+        injector.join(timeout=120.0)
+        if box.get("error"):
+            error = box["error"]
+        elif box["t_fault"] is None:
+            error = "injection never fired"
+        elif box["t_recover"] is None:
+            error = "victim never respawned ready"
+    if name == "hang" and error is None:
+        victim = sup._replicas[0]
+        notes["hung_kills_after"] = victim.hung_kills
+        if victim.hung_kills <= notes["hung_kills_before"]:
+            # the supervisor must have done the killing — a recovery
+            # via any other path means the watchdog did not fire
+            error = "liveness watchdog never SIGKILLed the hung replica"
+
+    windows = []
+    if box["t_fault"] is not None:
+        # +grace: the router may still be converging (poll cadence)
+        # right after the successor reports ready
+        w_end = (box["t_recover"] or time.monotonic()) + 1.0
+        windows.append((box["t_fault"], w_end))
+    rep = classify(records, windows)
+    rep["scenario"] = name
+    rep["notes"] = notes
+    if box["t_fault"] is not None and box["t_recover"] is not None:
+        rep["recovery_s"] = round(box["t_recover"] - box["t_fault"], 3)
+    if name == "poison" and error is None:
+        if rep["poisoned"] == 0:
+            error = "no poisoned requests were sent"
+        elif rep["injected_failures"] == 0 and rep["poison_leaks"] == 0:
+            # every poisoned request was shed before reaching a model:
+            # the run proved nothing about bisection
+            error = "no poisoned request reached a model"
+    if error is not None:
+        rep["error"] = error
+    rep["_records"] = records
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+def run_chaos(replicas: int = 3, qps: float = 40.0,
+              duration_s: float = 6.0,
+              scenarios=DEFAULT_SCENARIOS,
+              availability_pct: float = 99.0,
+              feat: int = 8, hidden: int = 32, depth: int = 1,
+              liveness_timeout_ms: float = 1500.0,
+              forward_timeout_ms: float = 800.0,
+              poison_every: int = 5,
+              slow_delay_ms: int = 40, slow_prob: float = 0.25,
+              timeout_s: float = 15.0,
+              workdir: Optional[str] = None,
+              log=print) -> dict:
+    """Spawn a fleet + router, run every scenario, and return the
+    availability report (``report["ok"]`` is the harness verdict)."""
+    from paddle_tpu.serving import FleetSupervisor, Router, RouterServer
+
+    cfg = {"qps": qps, "duration_s": duration_s, "feat": feat,
+           "poison_every": poison_every, "slow_delay_ms": slow_delay_ms,
+           "slow_prob": slow_prob, "timeout_s": timeout_s}
+    argv = ["--feat", str(feat), "--hidden", str(hidden),
+            "--depth", str(depth), "--max-batch", "8",
+            "--max-delay-ms", "2.0", "--queue-cap", "512",
+            "--deadline-ms", "30000"]
+    t_setup0 = time.monotonic()
+    sup = FleetSupervisor(
+        replicas=replicas, replica_argv=argv,
+        env={"FLAGS_serving_poison_value": str(POISON)},
+        max_restarts=8, backoff_ms=100.0,
+        liveness_timeout_ms=liveness_timeout_ms, workdir=workdir)
+    server = None
+    per_scenario = {}
+    all_records: List[dict] = []
+    fault_records: List[dict] = []
+    try:
+        urls = sup.wait_ready(timeout_s=300)
+        router = Router(urls, poll_interval_ms=100.0, stale_ms=1500.0,
+                        eject_after=2,
+                        forward_timeout_ms=forward_timeout_ms)
+        server = RouterServer(router).start()
+        router.poll_once()
+        log(f"chaos: fleet of {replicas} ready in "
+            f"{time.monotonic() - t_setup0:.1f}s; running "
+            f"{','.join(scenarios)} at {qps} qps x {duration_s}s each")
+        for name in scenarios:
+            rep = _scenario(name, sup, router, server.url, cfg)
+            records = rep.pop("_records")
+            all_records.extend(records)
+            if name in ("crash", "hang"):
+                fault_records.extend(records)
+            per_scenario[name] = rep
+            log(f"chaos: {name}: {rep['requests']} requests, "
+                f"{rep['ok']} ok, {rep['shed']} shed, "
+                f"{rep['injected_failures']} injected, "
+                f"{rep['collateral_failures']} collateral"
+                + (f", recovery {rep['recovery_s']}s"
+                   if "recovery_s" in rep else "")
+                + (f" ERROR: {rep['error']}" if "error" in rep else ""))
+            # let the fleet settle (router re-admits the recovered
+            # replica) before the next scenario's attribution starts
+            time.sleep(0.5)
+            router.poll_once()
+    finally:
+        if server is not None:
+            server.close()
+        sup.close()
+
+    # aggregate counts + availability over every record; the
+    # injected/collateral attribution needs each scenario's own fault
+    # window, so those three fold by summation instead
+    totals = classify(all_records, [])
+    for k in ("injected_failures", "collateral_failures",
+              "poison_leaks"):
+        totals[k] = sum(r[k] for r in per_scenario.values())
+    fault_ok_ms = sorted(r["ms"] for r in fault_records
+                         if r["outcome"] == "ok")
+    p99_under_fault = round(
+        fault_ok_ms[min(len(fault_ok_ms) - 1,
+                        int(np.ceil(0.99 * len(fault_ok_ms))) - 1)], 3) \
+        if fault_ok_ms else None
+    errors = {n: r["error"] for n, r in per_scenario.items()
+              if "error" in r}
+    ok = (not errors
+          and totals["collateral_failures"] == 0
+          and totals["poison_leaks"] == 0
+          and totals["availability_pct"] >= availability_pct)
+    return {
+        "ok": ok,
+        "availability_pct": totals["availability_pct"],
+        "availability_floor": availability_pct,
+        "p99_under_fault_ms": p99_under_fault,
+        "totals": {k: v for k, v in totals.items() if k != "p99_ms"},
+        "scenarios": per_scenario,
+        "errors": errors,
+        "config": {"replicas": replicas, "qps": qps,
+                   "duration_s": duration_s,
+                   "scenarios": list(scenarios),
+                   "feat": feat, "hidden": hidden, "depth": depth,
+                   "liveness_timeout_ms": liveness_timeout_ms,
+                   "forward_timeout_ms": forward_timeout_ms,
+                   "poison_every": poison_every},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--qps", type=float, default=40.0)
+    ap.add_argument("--duration", type=float, default=6.0,
+                    help="seconds of traffic per scenario")
+    ap.add_argument("--scenarios",
+                    default=",".join(DEFAULT_SCENARIOS),
+                    help="comma-separated subset of "
+                         "crash,hang,slow,poison")
+    ap.add_argument("--availability-pct", type=float, default=99.0)
+    ap.add_argument("--feat", type=int, default=8)
+    ap.add_argument("--hidden", type=int, default=32)
+    ap.add_argument("--depth", type=int, default=1)
+    ap.add_argument("--liveness-timeout-ms", type=float, default=1500.0)
+    ap.add_argument("--forward-timeout-ms", type=float, default=800.0)
+    ap.add_argument("--poison-every", type=int, default=5)
+    ap.add_argument("--out", help="write the JSON report here")
+    args = ap.parse_args(argv)
+
+    scenarios = tuple(s for s in args.scenarios.split(",") if s)
+    bad = sorted(set(scenarios) - set(DEFAULT_SCENARIOS))
+    if bad:
+        ap.error(f"unknown scenario(s) {bad}; "
+                 f"known: {','.join(DEFAULT_SCENARIOS)}")
+    report = run_chaos(
+        replicas=args.replicas, qps=args.qps,
+        duration_s=args.duration, scenarios=scenarios,
+        availability_pct=args.availability_pct, feat=args.feat,
+        hidden=args.hidden, depth=args.depth,
+        liveness_timeout_ms=args.liveness_timeout_ms,
+        forward_timeout_ms=args.forward_timeout_ms,
+        poison_every=args.poison_every)
+    text = json.dumps(report, indent=1, sort_keys=True)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    print(text)
+    print(f"CHAOS {'PASSED' if report['ok'] else 'FAILED'}: "
+          f"availability {report['availability_pct']}% "
+          f"(budget {args.availability_pct}%), "
+          f"{report['totals']['collateral_failures']} collateral, "
+          f"{report['totals']['poison_leaks']} leaks")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -126,6 +126,47 @@ def compare_leg(name: str, new: dict, base: dict,
                        reason=f"rolling restart saw {failed} non-shed "
                               f"request failure(s) (contract: zero)")
             return res
+    # chaos fault-containment rules, also checked before every skip:
+    # a collateral (non-injected) failure or a poisoned request served
+    # 200 is a correctness break — core contention can slow recovery,
+    # never cause either
+    if "collateral_failures" in new:
+        cf = new.get("collateral_failures")
+        if cf is None:
+            res.update(status="regression",
+                       reason="chaos run measured no collateral-"
+                              "failure count (vacuous window)")
+            return res
+        if cf > 0:
+            res.update(status="regression",
+                       reason=f"chaos saw {cf} collateral (non-"
+                              f"injected) request failure(s) "
+                              f"(contract: zero)")
+            return res
+        leaks = new.get("poison_leaks")
+        if leaks is None:
+            # like the collateral rule: a dropped field must not read
+            # as "zero leaks"
+            res.update(status="regression",
+                       reason="chaos run measured no poison-leak "
+                              "count (vacuous window)")
+            return res
+        if leaks > 0:
+            res.update(status="regression",
+                       reason=f"{leaks} poisoned request(s) answered "
+                              f"200 instead of failing (bisection "
+                              f"containment leak)")
+            return res
+        # the harness's own verdict: a scenario that errored (watchdog
+        # never fired, no poisoned request reached a model, victim
+        # never respawned) means a containment mechanism went
+        # unexercised or dead — counts alone can pass vacuously
+        if new.get("harness_ok") is False or new.get("errors"):
+            detail = new.get("errors") or "harness_ok=false"
+            res.update(status="regression",
+                       reason=f"chaos harness reported scenario "
+                              f"errors: {detail}")
+            return res
     nk, bk = new.get("device_kind"), base.get("device_kind")
     if nk is not None and bk is not None and nk != bk:
         res.update(status="skipped",
@@ -197,6 +238,16 @@ def compare_leg(name: str, new: dict, base: dict,
         res.update(status="regression",
                    reason=f"speedup_4v1 fell to {s4_new} (< 2x fleet "
                           f"scaling contract; baseline {s4_base})")
+    # chaos-leg extra: availability under fault must clear the
+    # committed floor.  Unlike the collateral rule this respects the
+    # anomaly skip above — a core-bound host genuinely slows recovery
+    # windows, which honestly costs availability
+    floor = new.get("availability_floor")
+    if res["status"] == "ok" and floor is not None \
+            and new_med < float(floor):
+        res.update(status="regression",
+                   reason=f"availability {new_med}% under the "
+                          f"{floor}% chaos budget")
     return res
 
 
@@ -473,6 +524,83 @@ def run_smoke() -> int:
     r = compare_bench(core_bound_router, docs + [with_router])
     check("router core-bound capture skips", r["ok"] and any(
         x["leg"] == "router" and x["status"] == "skipped"
+        for x in r["legs"]))
+
+    # chaos leg (synthetic fixture like the router/sharded ones): the
+    # generic noise gate applies, plus the collateral-failures /
+    # poison-leak hard rules (which no anomaly or device mismatch
+    # shields) and the availability floor (which the anomaly skip DOES
+    # shield — core contention honestly slows recovery windows)
+    chaos_leg = {
+        "metric": "chaos_availability_pct",
+        "value": 99.8, "unit": "%", "device_kind": "cpu",
+        "stats": {"rounds": 1, "median": 99.8, "p10": 99.6,
+                  "p90": 100.0, "min": 99.6, "max": 100.0},
+        "availability_floor": 99.0,
+        "collateral_failures": 0, "injected_failures": 9,
+        "poison_leaks": 0, "p99_under_fault_ms": 45.0,
+        "requests": 960,
+    }
+    with_chaos = json.loads(json.dumps(latest))
+    with_chaos.setdefault("legs", {})["chaos"] = chaos_leg
+    r = compare_bench(with_chaos, docs + [with_chaos])
+    check("chaos self-compare passes", r["ok"],
+          json.dumps([x for x in r["legs"]
+                      if x["status"] == "regression"]))
+    collateral = json.loads(json.dumps(with_chaos))
+    collateral["legs"]["chaos"]["collateral_failures"] = 1
+    # an anomaly flag must NOT shield a containment break
+    collateral["legs"]["chaos"]["anomaly"] = "core-bound host"
+    r = compare_bench(collateral, docs + [with_chaos])
+    check("chaos collateral-failure fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "collateral" in x.get("reason", "") for x in r["legs"]))
+    anom_chaos_base = json.loads(json.dumps(with_chaos))
+    anom_chaos_base["legs"]["chaos"]["anomaly"] = "core-bound host"
+    r = compare_bench(collateral, docs + [anom_chaos_base])
+    check("chaos collateral-failure fails past anomalous baseline",
+          not r["ok"])
+    vacuous_chaos = json.loads(json.dumps(with_chaos))
+    vacuous_chaos["legs"]["chaos"]["collateral_failures"] = None
+    r = compare_bench(vacuous_chaos, docs + [with_chaos])
+    check("chaos vacuous-collateral fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "vacuous" in x.get("reason", "") for x in r["legs"]))
+    leaked = json.loads(json.dumps(with_chaos))
+    leaked["legs"]["chaos"]["poison_leaks"] = 2
+    r = compare_bench(leaked, docs + [with_chaos])
+    check("chaos poison-leak fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "poison" in x.get("reason", "") for x in r["legs"]))
+    no_leak_field = json.loads(json.dumps(with_chaos))
+    del no_leak_field["legs"]["chaos"]["poison_leaks"]
+    r = compare_bench(no_leak_field, docs + [with_chaos])
+    check("chaos missing-leak-count fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "poison-leak" in x.get("reason", "") for x in r["legs"]))
+    harness_err = json.loads(json.dumps(with_chaos))
+    harness_err["legs"]["chaos"]["harness_ok"] = False
+    harness_err["legs"]["chaos"]["errors"] = {
+        "hang": "liveness watchdog never SIGKILLed the hung replica"}
+    harness_err["legs"]["chaos"]["anomaly"] = "core-bound host"
+    r = compare_bench(harness_err, docs + [with_chaos])
+    check("chaos harness-error fails even when anomalous",
+          not r["ok"] and any(
+              x["status"] == "regression"
+              and "harness" in x.get("reason", "") for x in r["legs"]))
+    low_avail = json.loads(json.dumps(with_chaos))
+    low_avail["legs"]["chaos"]["value"] = 98.2
+    low_avail["legs"]["chaos"]["stats"] = {
+        "rounds": 1, "median": 98.2, "p10": 98.0, "p90": 98.4}
+    r = compare_bench(low_avail, docs + [with_chaos])
+    check("chaos availability-floor fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "budget" in x.get("reason", "") for x in r["legs"]))
+    low_avail_anom = json.loads(json.dumps(low_avail))
+    low_avail_anom["legs"]["chaos"]["anomaly"] = "core-bound host"
+    r = compare_bench(low_avail_anom, docs + [with_chaos])
+    check("chaos core-bound low availability skips", r["ok"] and any(
+        x["leg"] == "chaos" and x["status"] == "skipped"
         for x in r["legs"]))
 
     # op gate on its own committed baseline
