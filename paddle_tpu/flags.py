@@ -162,6 +162,38 @@ register_flag("FLAGS_serving_max_new_tokens", 64,
               "tokens (a request's own max_new_tokens wins; a budget "
               "beyond the cache capacity left after the prompt decodes "
               "until the slot cache fills and finishes 'cache_full')")
+register_flag("FLAGS_serving_paged", False,
+              "generation engine: block-paged KV cache (vLLM-style "
+              "fixed-size pages + per-slot block tables) instead of the "
+              "dense per-slot [slots, n_kv, max_seq_len, D] reservation "
+              "— concurrency is bounded by LIVE tokens, not worst-case "
+              "sequence length; paged decode is bit-exact vs dense "
+              "(paddle_tpu/serving/generation.py).  0 keeps the dense "
+              "cache (the measured fallback)")
+register_flag("FLAGS_serving_kv_page_tokens", 16,
+              "paged KV cache: tokens per page (power of two dividing "
+              "FLAGS_serving_max_seq_len); smaller pages waste less on "
+              "short sequences but deepen the per-slot block table")
+register_flag("FLAGS_serving_kv_pages", 0,
+              "paged KV cache: physical pages in the per-layer pool "
+              "(page 0 is the reserved trash page garbage writes are "
+              "redirected to); 0 = auto-size to the dense capacity "
+              "(slots * max_seq_len / page_tokens + 1) — the pool HBM "
+              "footprint is pages * layers * 2 * n_kv_heads * "
+              "page_tokens * head_dim * 4 bytes")
+register_flag("FLAGS_serving_prefill_chunk", 0,
+              "paged generation: feed long prompts in slices of this "
+              "many tokens, one slice per scheduler iteration "
+              "interleaved with decode steps (SarathiServe-style "
+              "chunked prefill), so a long prompt no longer stalls the "
+              "whole grid's inter-token latency; 0 = whole-prompt "
+              "prefill (the bit-exact-vs-dense path)")
+register_flag("FLAGS_serving_prefix_reuse", True,
+              "paged generation: hash page-aligned prompt-prefix chunks "
+              "(system prompts, few-shot headers) and map index hits "
+              "into new slots copy-on-write — their prefill is skipped "
+              "entirely and the pages are shared refcounted until every "
+              "referencing slot finishes; 0 disables the prefix index")
 register_flag("FLAGS_trace_sample", 1.0,
               "head-sampling rate for serving request traces: fraction "
               "of requests (0..1, deterministic every-Nth spacing) that "

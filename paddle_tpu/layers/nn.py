@@ -22,7 +22,7 @@ __all__ = [
     "soft_relu", "log_loss", "clip", "clip_by_norm", "mean", "pad",
     "adaptive_pool2d", "flash_attention", "flash_attention_qkv",
     "rms_norm", "rope", "kv_cache_write", "kv_cache_insert",
-    "cached_attention",
+    "cached_attention", "kv_pool_write", "kv_pool_gather",
     "linear_chain_crf", "crf_decoding", "warpctc",
     "nce", "hsigmoid", "conv3d", "pool3d", "lrn", "row_conv",
     "shuffle_channel", "temporal_shift", "multiplex",
@@ -647,6 +647,39 @@ def kv_cache_insert(cache, new, slot, name=None):
                              "Slot": [slot]},
                      outputs={"Out": [cache]})
     return cache
+
+
+def kv_pool_write(pool, new, positions, block_table, lengths,
+                  name=None):
+    """Paged-cache write, in place: ``pool`` [P, Hkv, pt, D] gets row
+    (b, t) of ``new`` [B, Hkv, T, D] at logical position
+    ``positions[b] + t`` of slot b, routed through ``block_table``
+    [B, NP] to a physical page; rows with ``t >= lengths[b]`` go to
+    the reserved trash page 0.  Like :func:`kv_cache_write`, the
+    output aliases the pool variable so the executor donates the
+    buffer.  Returns the pool Variable."""
+    helper = LayerHelper("kv_pool_write", name=name)
+    helper.append_op("kv_pool_write",
+                     inputs={"Pool": [pool], "New": [new],
+                             "Positions": [positions],
+                             "BlockTable": [block_table],
+                             "Lengths": [lengths]},
+                     outputs={"Out": [pool]})
+    return pool
+
+
+def kv_pool_gather(pool, block_table, name=None):
+    """Gather a slot's pages back into the dense logical cache layout:
+    ``pool`` [P, Hkv, pt, D] through ``block_table`` [B, NP] ->
+    [B, Hkv, NP*pt, D] (column j = logical position j, exactly what
+    :func:`cached_attention` expects from a dense cache)."""
+    helper = LayerHelper("kv_pool_gather", name=name)
+    out = helper.create_variable_for_type_inference(pool.dtype)
+    helper.append_op("kv_pool_gather",
+                     inputs={"Pool": [pool],
+                             "BlockTable": [block_table]},
+                     outputs={"Out": [out]})
+    return out
 
 
 def cached_attention(q, cache_k, cache_v, positions, scale=None,

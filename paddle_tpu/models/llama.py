@@ -43,7 +43,8 @@ def _linear(x, size, pname=None, name=None):
 
 def llama_block(x, hidden, num_heads, num_kv_heads, seq_len, head_dim,
                 intermediate, name=None, attn_impl="auto",
-                kv_cache=None, positions=None, collect_kv=False):
+                kv_cache=None, positions=None, collect_kv=False,
+                block_table=None, kv_lengths=None):
     """One decoder layer. x: [B, S, H].
 
     ``name`` prefixes every parameter deterministically (required when
@@ -53,6 +54,14 @@ def llama_block(x, hidden, num_heads, num_kv_heads, seq_len, head_dim,
     Cache modes (mutually exclusive):
       * ``kv_cache=(cache_k, cache_v)`` with ``positions`` [B] int32 —
         cached decode: returns x with the caches updated in place.
+        With ``block_table`` [B, NP] + ``kv_lengths`` [B] the caches
+        are block-paged pools [P, n_kv, page_tokens, D]: the step's
+        K/V scatter into the slots' current pages (``kv_pool_write``)
+        and attention runs over the gathered logical view
+        (``kv_pool_gather`` -> ``cached_attention``, the identical
+        einsum the dense path runs — bit-exact).  ``seq_len`` > 1 in
+        this mode is a *prefill chunk*: S new tokens starting at
+        ``positions[b]`` attend the cache plus themselves causally.
       * ``collect_kv=True`` — prefill: returns ``(x, k, v)`` where
         k/v are the post-RoPE [B, n_kv, S, D] cache rows.
     """
@@ -82,9 +91,23 @@ def llama_block(x, hidden, num_heads, num_kv_heads, seq_len, head_dim,
         # then attend the new token(s) over the whole (updated) cache —
         # GQA expansion happens inside cached_attention
         cache_k, cache_v = kv_cache
-        cache_k = layers.kv_cache_write(cache_k, k, positions)
-        cache_v = layers.kv_cache_write(cache_v, v, positions)
-        attn = layers.cached_attention(q, cache_k, cache_v, positions)
+        if block_table is not None:
+            # paged: scatter into the slots' pages, then attend the
+            # gathered logical view — write-before-gather makes the
+            # fresh rows visible (mask admits j <= positions[b] + t,
+            # which includes this step's own columns)
+            cache_k = layers.kv_pool_write(cache_k, k, positions,
+                                           block_table, kv_lengths)
+            cache_v = layers.kv_pool_write(cache_v, v, positions,
+                                           block_table, kv_lengths)
+            gk = layers.kv_pool_gather(cache_k, block_table)
+            gv = layers.kv_pool_gather(cache_v, block_table)
+            attn = layers.cached_attention(q, gk, gv, positions)
+        else:
+            cache_k = layers.kv_cache_write(cache_k, k, positions)
+            cache_v = layers.kv_cache_write(cache_v, v, positions)
+            attn = layers.cached_attention(q, cache_k, cache_v,
+                                           positions)
     else:
         cache_k = cache_v = None
         new_k, new_v = k, v  # pre-expansion rows are what a cache stores
@@ -181,7 +204,8 @@ def build_llama_prefill(batch_size, seq_len, vocab_size=32000,
                         hidden=4096, num_layers=32, num_heads=32,
                         num_kv_heads=None, intermediate=11008,
                         name="llama", attn_impl="auto",
-                        cache_slots=None, max_seq_len=None):
+                        cache_slots=None, max_seq_len=None,
+                        paged=False, num_pages=None, page_tokens=None):
     """Prefill entry point: one causal forward over the (padded) prompt
     that populates a decode cache in one shot.
 
@@ -198,7 +222,13 @@ def build_llama_prefill(batch_size, seq_len, vocab_size=32000,
       ``<name>.cache_{k,v}_<i>`` at slot index feed ``slot`` [1] int32
       — the caches are mutated persistable state, so the prefill step
       donates them exactly like the decode step (no K/V fetch, no
-      host-side reinsert).
+      host-side reinsert).  With ``paged=True`` the caches are the
+      block-paged pools ``<name>.pool_{k,v}_<i>`` instead and the
+      slot feed is replaced by ``block_table`` [1, NP] int32 +
+      ``prompt_len`` [1] int32 (rows past the real prompt length are
+      redirected to the trash page).  The forward itself is the SAME
+      graph either way, so paged prefill logits are bit-exact vs
+      dense.
     * omitted: per-layer ``k_i``/``v_i`` [B, n_kv, S, D] rows come
       back as extra fetches for the caller to place.
 
@@ -214,7 +244,7 @@ def build_llama_prefill(batch_size, seq_len, vocab_size=32000,
     last_pos = layers.data("last_pos", [batch_size], dtype="int64",
                            append_batch_size=False)
     feeds = ["input_ids", "last_pos"]
-    slot = None
+    slot = block_table = prompt_len = zero_pos = None
     if cache_slots is not None:
         if batch_size != 1:
             raise ValueError("in-graph cache insert prefills one "
@@ -222,9 +252,22 @@ def build_llama_prefill(batch_size, seq_len, vocab_size=32000,
         if max_seq_len is None or seq_len > max_seq_len:
             raise ValueError(f"prefill bucket {seq_len} exceeds cache "
                              f"max_seq_len {max_seq_len}")
-        slot = layers.data("slot", [1], dtype="int32",
-                           append_batch_size=False)
-        feeds.append("slot")
+        if paged:
+            if not num_pages or not page_tokens:
+                raise ValueError("paged prefill needs num_pages and "
+                                 "page_tokens")
+            np_slot = max_seq_len // page_tokens
+            block_table = layers.data("block_table", [1, np_slot],
+                                      dtype="int32",
+                                      append_batch_size=False)
+            prompt_len = layers.data("prompt_len", [1], dtype="int32",
+                                     append_batch_size=False)
+            feeds += ["block_table", "prompt_len"]
+            zero_pos = layers.fill_constant([1], "int32", 0)
+        else:
+            slot = layers.data("slot", [1], dtype="int32",
+                               append_batch_size=False)
+            feeds.append("slot")
     x = layers.embedding(input_ids, size=[vocab_size, hidden],
                          param_attr=f"{name}.embed")
     kvs = []
@@ -234,7 +277,19 @@ def build_llama_prefill(batch_size, seq_len, vocab_size=32000,
                               seq_len, head_dim, intermediate,
                               name=f"{name}.blk{i}", attn_impl=attn_impl,
                               collect_kv=True)
-        if slot is not None:
+        if block_table is not None:
+            # paged: the prompt's K/V scatter across the slot's pages
+            # from logical position 0; pad-tail rows (>= prompt_len)
+            # go to the trash page
+            for kind, t in (("k", k), ("v", v)):
+                pool = block.create_var(
+                    name=f"{name}.pool_{kind}_{i}", persistable=True,
+                    shape=[num_pages, num_kv_heads, page_tokens,
+                           head_dim],
+                    dtype="float32", stop_gradient=True)
+                layers.kv_pool_write(pool, t, zero_pos, block_table,
+                                     prompt_len)
+        elif slot is not None:
             for kind, t in (("k", k), ("v", v)):
                 cache = block.create_var(
                     name=f"{name}.cache_{kind}_{i}", persistable=True,
@@ -266,7 +321,8 @@ def build_llama_prefill(batch_size, seq_len, vocab_size=32000,
 def build_llama_decode(num_slots, max_seq_len, vocab_size=32000,
                        hidden=4096, num_layers=32, num_heads=32,
                        num_kv_heads=None, intermediate=11008,
-                       name="llama"):
+                       name="llama", paged=False, num_pages=None,
+                       page_tokens=None):
     """Cached decode step over a fixed slot grid.
 
     Feeds: ``tokens`` [slots, 1] int64 (each slot's current token) and
@@ -277,6 +333,13 @@ def build_llama_decode(num_slots, max_seq_len, vocab_size=32000,
     every step updates the caches in place in HBM.  Fetches: ``logits``
     [slots, V] and greedy ``next_token`` [slots] int64.
 
+    ``paged=True`` swaps the per-slot reservation for the block-paged
+    pools ``<name>.pool_{k,v}_<i>`` [num_pages, n_kv, page_tokens, D]
+    and adds feeds ``block_tables`` [slots, NP] int32 (NP =
+    max_seq_len // page_tokens) and ``live`` [slots] int32 (1 = the
+    slot decodes this step, 0 = idle — its garbage write is redirected
+    to the trash page instead of landing in a live page).
+
     Returns ``(feed_names, fetches, cache_names)``."""
     from ..framework.core import default_main_program
 
@@ -286,18 +349,33 @@ def build_llama_decode(num_slots, max_seq_len, vocab_size=32000,
                          append_batch_size=False)
     positions = layers.data("positions", [num_slots], dtype="int32",
                             append_batch_size=False)
+    feeds = ["tokens", "positions"]
+    block_tables = live = None
+    if paged:
+        if not num_pages or not page_tokens:
+            raise ValueError("paged decode needs num_pages and "
+                             "page_tokens")
+        np_slot = max_seq_len // page_tokens
+        block_tables = layers.data("block_tables", [num_slots, np_slot],
+                                   dtype="int32",
+                                   append_batch_size=False)
+        live = layers.data("live", [num_slots], dtype="int32",
+                           append_batch_size=False)
+        feeds += ["block_tables", "live"]
     block = default_main_program().global_block()
     cache_names = []
     caches = []
     for i in range(num_layers):
-        ck = block.create_var(
-            name=f"{name}.cache_k_{i}", persistable=True,
-            shape=[num_slots, num_kv_heads, max_seq_len, head_dim],
-            dtype="float32", stop_gradient=True)
-        cv = block.create_var(
-            name=f"{name}.cache_v_{i}", persistable=True,
-            shape=[num_slots, num_kv_heads, max_seq_len, head_dim],
-            dtype="float32", stop_gradient=True)
+        if paged:
+            shape = [num_pages, num_kv_heads, page_tokens, head_dim]
+            knm, vnm = f"{name}.pool_k_{i}", f"{name}.pool_v_{i}"
+        else:
+            shape = [num_slots, num_kv_heads, max_seq_len, head_dim]
+            knm, vnm = f"{name}.cache_k_{i}", f"{name}.cache_v_{i}"
+        ck = block.create_var(name=knm, persistable=True, shape=shape,
+                              dtype="float32", stop_gradient=True)
+        cv = block.create_var(name=vnm, persistable=True, shape=shape,
+                              dtype="float32", stop_gradient=True)
         caches.append((ck, cv))
         cache_names += [ck.name, cv.name]
     x = layers.embedding(tokens, size=[vocab_size, hidden],
@@ -305,10 +383,82 @@ def build_llama_decode(num_slots, max_seq_len, vocab_size=32000,
     for i, (ck, cv) in enumerate(caches):
         x = llama_block(x, hidden, num_heads, num_kv_heads, 1, head_dim,
                         intermediate, name=f"{name}.blk{i}",
-                        kv_cache=(ck, cv), positions=positions)
+                        kv_cache=(ck, cv), positions=positions,
+                        block_table=block_tables, kv_lengths=live)
     x = layers.rms_norm(x, param_attr=f"{name}.ln_f")
     logits = _linear(x, vocab_size, pname=f"{name}.head.w")  # [slots,1,V]
     logits = layers.squeeze(logits, [1])                     # [slots, V]
     next_token = layers.argmax(logits, axis=-1)              # [slots]
-    return ["tokens", "positions"], \
+    return feeds, \
+        {"logits": logits, "next_token": next_token}, cache_names
+
+
+def build_llama_prefill_chunk(chunk_len, max_seq_len, num_pages,
+                              page_tokens, vocab_size=32000,
+                              hidden=4096, num_layers=32, num_heads=32,
+                              num_kv_heads=None, intermediate=11008,
+                              name="llama"):
+    """Paged prefill *continuation*: one slice of a prompt attends the
+    slot's already-populated pages plus itself causally — the program
+    behind both **chunked prefill** (a long prompt feeds in
+    ``FLAGS_serving_prefill_chunk`` slices interleaved with decode
+    steps) and **shared-prefix reuse** (a prefix-index hit maps the
+    shared pages and only the prompt tail runs here).
+
+    Feeds: ``chunk_ids`` [1, C] int64 (right-padded slice),
+    ``base`` [1] int32 (tokens already in the slot's cache = the
+    logical position of the chunk's first token), ``block_table``
+    [1, NP] int32, ``chunk_len`` [1] int32 (real rows; the pad tail
+    writes to the trash page), ``last_off`` [1] int64 (index of the
+    last real token within the chunk).  Fetches: ``logits`` [1, V] at
+    ``last_off`` and greedy ``next_token`` [1] — meaningful only for
+    a prompt's final chunk.
+
+    Returns ``(feed_names, fetches, cache_names)``."""
+    from ..framework.core import default_main_program
+
+    num_kv_heads = num_kv_heads or num_heads
+    head_dim = hidden // num_heads
+    np_slot = max_seq_len // page_tokens
+    chunk_ids = layers.data("chunk_ids", [1, chunk_len], dtype="int64",
+                            append_batch_size=False)
+    base = layers.data("base", [1], dtype="int32",
+                       append_batch_size=False)
+    block_table = layers.data("block_table", [1, np_slot],
+                              dtype="int32", append_batch_size=False)
+    ck_len = layers.data("chunk_len", [1], dtype="int32",
+                         append_batch_size=False)
+    last_off = layers.data("last_off", [1], dtype="int64",
+                           append_batch_size=False)
+    block = default_main_program().global_block()
+    cache_names = []
+    caches = []
+    for i in range(num_layers):
+        ck = block.create_var(
+            name=f"{name}.pool_k_{i}", persistable=True,
+            shape=[num_pages, num_kv_heads, page_tokens, head_dim],
+            dtype="float32", stop_gradient=True)
+        cv = block.create_var(
+            name=f"{name}.pool_v_{i}", persistable=True,
+            shape=[num_pages, num_kv_heads, page_tokens, head_dim],
+            dtype="float32", stop_gradient=True)
+        caches.append((ck, cv))
+        cache_names += [ck.name, cv.name]
+    x = layers.embedding(chunk_ids, size=[vocab_size, hidden],
+                         param_attr=f"{name}.embed")
+    for i, (ck, cv) in enumerate(caches):
+        # rope offset = base per row; cached_attention's validity mask
+        # (j <= base + t) is exactly causal-over-prefix-plus-chunk
+        x = llama_block(x, hidden, num_heads, num_kv_heads, chunk_len,
+                        head_dim, intermediate, name=f"{name}.blk{i}",
+                        kv_cache=(ck, cv), positions=base,
+                        block_table=block_table, kv_lengths=ck_len)
+    x = layers.rms_norm(x, param_attr=f"{name}.ln_f")
+    all_logits = _linear(x, vocab_size, pname=f"{name}.head.w")
+    rows = layers.range(0, 1, 1, dtype="int64")              # [1]
+    coords = layers.stack([rows, last_off], axis=1)          # [1, 2]
+    logits = layers.gather_nd(all_logits, coords)            # [1, V]
+    next_token = layers.argmax(logits, axis=-1)              # [1] int64
+    return ["chunk_ids", "base", "block_table", "chunk_len",
+            "last_off"], \
         {"logits": logits, "next_token": next_token}, cache_names

@@ -21,6 +21,20 @@ the prefix:
   across lengths (asserted in ``tests/test_generation.py``).
 
 Both are inference-only (``grad=None``): the decode path never trains.
+
+Paged variants (``kv_pool_write`` / ``kv_pool_gather``) back the
+block-paged cache (PagedAttention, Kwon et al., SOSP '23): a flat
+per-layer pool ``[num_pages, n_kv, page_tokens, D]`` replaces the dense
+per-slot reservation, and a per-slot block table maps logical page
+index -> physical page.  ``kv_pool_gather`` reconstructs a slot's
+logical ``[B, n_kv, NP*page_tokens, D]`` cache view from its pages, so
+``cached_attention`` runs the *identical* einsum at the *identical*
+contraction length as the dense path — which is what keeps paged
+decode bit-exact against dense (columns beyond the live length differ
+only in garbage the ``-1e30`` mask turns into exact zeros either way).
+Physical page 0 is the reserved **trash page**: rows a write must
+discard (idle slots, pad-tail rows of a chunk) are redirected there
+instead of branching, so the scatter stays a single fused op.
 """
 from __future__ import annotations
 
@@ -69,6 +83,82 @@ def _kv_cache_insert(ctx, op):
     z = jnp.int32(0)
     out = jax.lax.dynamic_update_slice(
         cache, new.astype(cache.dtype), (slot.reshape(()), z, z, z))
+    ctx.set_output(op, "Out", out)
+
+
+def _kv_pool_write_infer(op, block):
+    p = in_var(op, block, "Pool")
+    set_out(op, block, "Out", p.shape, p.dtype)
+
+
+@register_op("kv_pool_write", infer=_kv_pool_write_infer, grad=None,
+             stateful_outputs=("Out",))
+def _kv_pool_write(ctx, op):
+    """Paged cache write: Pool [P, Hkv, pt, D], New [B, Hkv, T, D],
+    Positions [B] int (logical base position per row), BlockTable
+    [B, NP] int (logical page -> physical page), Lengths [B] int
+    (valid rows per batch row).  Row (b, t) of New lands at logical
+    position ``positions[b] + t``, i.e. physical page
+    ``block_table[b, (positions[b]+t) // pt]`` at in-page offset
+    ``(positions[b]+t) % pt``.  Rows with ``t >= lengths[b]`` (idle
+    slots, the pad tail of a bucketed prefill chunk) are redirected to
+    the reserved trash page 0 — one scatter, no branches.  The output
+    aliases the pool variable name, so the executor donates the buffer
+    exactly like the dense ``kv_cache_write`` (in-place HBM update)."""
+    import jax.numpy as jnp
+
+    pool = ctx.get_input(op, "Pool")
+    new = ctx.get_input(op, "New")
+    pos = ctx.get_input(op, "Positions").astype(jnp.int32)
+    bt = ctx.get_input(op, "BlockTable").astype(jnp.int32)
+    length = ctx.get_input(op, "Lengths").astype(jnp.int32)
+    P, Hkv, pt, D = pool.shape
+    B, _, T, _ = new.shape
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    logical = pos[:, None] + t                        # [B, T]
+    page_idx = jnp.clip(logical // pt, 0, bt.shape[1] - 1)
+    phys = jnp.take_along_axis(bt, page_idx, axis=1,  # [B, T]
+                               mode="clip")
+    off = logical % pt
+    valid = t < length[:, None]
+    # invalid rows all collapse onto trash slot (0, 0): duplicate
+    # scatter indices there are fine — the trash page is never read
+    # unmasked
+    phys = jnp.where(valid, phys, 0)
+    off = jnp.where(valid, off, 0)
+    rows = jnp.transpose(new, (0, 2, 1, 3)).reshape(B * T, Hkv, D)
+    out = pool.at[phys.reshape(-1), :, off.reshape(-1), :].set(
+        rows.astype(pool.dtype))
+    ctx.set_output(op, "Out", out)
+
+
+def _kv_pool_gather_infer(op, block):
+    pool = in_var(op, block, "Pool")
+    bt = in_var(op, block, "BlockTable")
+    P, hkv, pt, d = pool.shape
+    b, np_ = bt.shape
+    set_out(op, block, "Out", (b, hkv, np_ * pt, d), pool.dtype)
+
+
+@register_op("kv_pool_gather", infer=_kv_pool_gather_infer, grad=None)
+def _kv_pool_gather(ctx, op):
+    """Reassemble a slot's logical cache view from its pages: Pool
+    [P, Hkv, pt, D] gathered through BlockTable [B, NP] ->
+    [B, Hkv, NP*pt, D].  Column j of the output is logical position j
+    of slot b — the exact dense-cache layout, so the downstream
+    ``cached_attention`` einsum (and therefore its XLA reduction
+    tiling) is byte-identical to the dense path's.  Unmapped block-
+    table entries read the trash page; those columns sit beyond the
+    slot's validity limit and mask to exact zeros."""
+    import jax.numpy as jnp
+
+    pool = ctx.get_input(op, "Pool")
+    bt = ctx.get_input(op, "BlockTable").astype(jnp.int32)
+    P, Hkv, pt, D = pool.shape
+    B, NP = bt.shape
+    pages = jnp.take(pool, bt.reshape(-1), axis=0, mode="clip")
+    out = jnp.transpose(pages.reshape(B, NP, Hkv, pt, D),
+                        (0, 2, 1, 3, 4)).reshape(B, Hkv, NP * pt, D)
     ctx.set_output(op, "Out", out)
 
 

@@ -29,7 +29,8 @@ import numpy as np
 
 __all__ = ["bucket_sizes", "bucket_for", "signature_of",
            "describe_signature", "pad_stack", "split_rows", "fill_pct",
-           "prompt_buckets", "prompt_bucket_for", "pad_prompt"]
+           "prompt_buckets", "prompt_bucket_for", "pad_prompt",
+           "chunk_spans"]
 
 
 def bucket_sizes(max_batch: int) -> Tuple[int, ...]:
@@ -154,6 +155,21 @@ def prompt_bucket_for(length: int, buckets: Sequence[int]) -> int:
         raise ValueError(f"prompt of {length} tokens exceeds the "
                          f"largest prefill bucket {buckets[-1]}")
     return b
+
+
+def chunk_spans(start: int, end: int, chunk: int
+                ) -> List[Tuple[int, int]]:
+    """Split the un-prefilled prompt span ``[start, end)`` into
+    consecutive ``(lo, hi)`` chunked-prefill slices of at most
+    ``chunk`` tokens (``chunk <= 0`` -> the whole span in one slice).
+    The pure scheduling half of chunked prefill: the engine runs one
+    span per scheduler iteration, interleaved with decode steps."""
+    if end <= start:
+        return []
+    if chunk <= 0:
+        return [(start, end)]
+    return [(lo, min(lo + chunk, end))
+            for lo in range(start, end, chunk)]
 
 
 def pad_prompt(ids: np.ndarray, bucket: int, pad_id: int = 0

@@ -27,6 +27,28 @@ identified.  This module is the repo's answer:
   slots keep generating.  ``continuous=False`` restores FIFO head-run
   static batching (claim only when every slot is idle, i.e. batch
   drain) — the measured baseline the bench leg compares against.
+* **Paged KV cache** (``FLAGS_serving_paged``, PagedAttention-style) —
+  the dense per-slot reservation strands a worst-case sequence's HBM
+  per short chat turn; paged mode swaps it for a flat per-layer pool
+  ``[num_pages, n_kv, page_tokens, D]`` plus per-slot block tables, so
+  concurrency is bounded by LIVE tokens.  :class:`PagePool` allocates
+  physical pages on demand (page 0 is the reserved trash page);
+  running out finishes the starved slot ``cache_full`` after trying to
+  evict idle prefix-index pages.  Paged decode is **bit-exact vs
+  dense** token-for-token AND logit-for-logit (``kv_pool_gather``
+  reconstructs the dense logical layout, so ``cached_attention`` runs
+  the identical einsum; asserted in ``tests/test_paged_generation.py``).
+* **Shared-prefix reuse** — :class:`PrefixIndex` hashes page-aligned
+  prompt-prefix chunks (system prompts, few-shot headers); a hit maps
+  the shared pages into the new slot copy-on-write (refcounted,
+  mutation-free: decode and tail-prefill writes only ever touch pages
+  *past* the shared prefix) and skips their prefill entirely.
+* **Chunked prefill** (``FLAGS_serving_prefill_chunk``) — long prompts
+  feed in fixed-size slices, ONE slice per scheduler iteration
+  interleaved with decode steps (SarathiServe-style), so a long prompt
+  no longer stalls the whole grid's inter-token latency.  A prefix-hit
+  tail prefill rides the same chunk program with ``base`` set past the
+  shared pages.
 * **Admission control** — bounded queue reusing the serving
   :class:`~paddle_tpu.serving.engine.OverloadedError` semantics:
   ``queue_full`` at submit, ``deadline`` when a request outlives
@@ -49,9 +71,16 @@ Stats (README catalog): counters ``serving_generate_requests``,
 ``serving_decode_failures`` (decode-grid iterations that raised —
 each fails only the then-active requests),
 ``serving_generated_tokens``,
-``serving_prefill_tokens``, ``serving_slot_reclaims``; gauges
+``serving_prefill_tokens``, ``serving_slot_reclaims``,
+``serving_prefix_hits``, ``serving_prefix_tokens_saved``,
+``serving_prefill_chunks``, ``serving_kv_page_evictions``,
+``serving_kv_pool_stalls``; gauges
 ``serving_slot_occupancy``, ``serving_prefill_decode_ratio``,
-``serving_kv_cache_bytes``, ``serving_decode_mfu``; histograms
+``serving_kv_cache_bytes`` (allocated cache capacity — the page pool
+in paged mode, the dense reservation otherwise),
+``serving_kv_live_bytes`` (bytes of pages actually referenced by live
+sequences or the prefix index), ``serving_kv_pages_free``,
+``serving_kv_pages_live``, ``serving_decode_mfu``; histograms
 ``serving_generate_ms``, ``serving_prefill_ms``,
 ``serving_decode_step_ms``.
 """
@@ -73,7 +102,8 @@ from .engine import (OverloadedError, PoisonedInput, RequestFailed,
                      ServingFuture, poison_sentinel_matches)
 from .sharded import describe_mesh as _describe_mesh
 
-__all__ = ["GenerationEngine", "GenRequest"]
+__all__ = ["GenerationEngine", "GenRequest", "PagePool", "PrefixIndex",
+           "PoolExhausted"]
 
 logger = logging.getLogger("paddle_tpu.serving.generation")
 
@@ -99,11 +129,138 @@ class GenRequest:
         self.prefill_ms: float = 0.0
 
 
+class PoolExhausted(Exception):
+    """The paged KV pool has no free page and nothing evictable."""
+
+
+class PagePool:
+    """Host-side physical-page allocator for the paged KV cache.
+
+    Physical page 0 is the reserved **trash page** (garbage writes —
+    idle slots, chunk pad tails — are redirected there in-graph) and is
+    never handed out.  Pages are refcounted: a slot holds one ref per
+    mapped page, the prefix index holds one per registered page; a page
+    returns to the free list when its count hits zero.  Not
+    thread-safe on its own — the engine mutates it only from the
+    scheduler thread."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError(f"paged KV pool needs >= 2 pages (one is "
+                             f"the reserved trash page), got {num_pages}")
+        self.num_pages = int(num_pages)
+        self._free: collections.deque = collections.deque(
+            range(1, num_pages))
+        self._ref = [0] * num_pages
+
+    def alloc(self) -> Optional[int]:
+        """One free page at refcount 1, or None when exhausted."""
+        if not self._free:
+            return None
+        p = self._free.popleft()
+        self._ref[p] = 1
+        return p
+
+    def incref(self, pages: Sequence[int]):
+        for p in pages:
+            self._ref[p] += 1
+
+    def decref(self, pages: Sequence[int]):
+        for p in pages:
+            self._ref[p] -= 1
+            if self._ref[p] < 0:
+                raise AssertionError(f"page {p} refcount underflow")
+            if self._ref[p] == 0:
+                self._free.append(p)
+
+    def refcount(self, page: int) -> int:
+        return self._ref[page]
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def live_pages(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+
+class PrefixIndex:
+    """Shared-prefix page index: page-aligned prompt-prefix chunk ->
+    physical page holding its K/V.
+
+    Keys are the exact token bytes of the prompt's first ``(i+1) *
+    page_tokens`` tokens, so a hit is an exact prefix match chained
+    from position 0 (no hash collisions, no partial pages).  Lookup is
+    capped one token short of the whole prompt — at least one token
+    must prefill to produce the first next-token logits.  Entries hold
+    one pool ref each; :meth:`evict_one` drops the LRU entry whose page
+    only the index still references (pages mapped into live slots are
+    never evicted — the no-collateral contract chaos asserts)."""
+
+    def __init__(self, pool: PagePool, page_tokens: int):
+        self._pool = pool
+        self._pt = int(page_tokens)
+        self._entries: "collections.OrderedDict[bytes, int]" = \
+            collections.OrderedDict()
+
+    def lookup(self, prompt: np.ndarray) -> List[int]:
+        """Longest indexed page chain prefixing ``prompt`` (< its full
+        length); hit entries refresh their LRU position."""
+        max_pages = max(0, (int(prompt.size) - 1) // self._pt)
+        pages = []
+        for i in range(max_pages):
+            key = prompt[:(i + 1) * self._pt].tobytes()
+            p = self._entries.get(key)
+            if p is None:
+                break
+            self._entries.move_to_end(key)
+            pages.append(p)
+        return pages
+
+    def register(self, prompt: np.ndarray, pages: Sequence[int]):
+        """Publish a freshly prefilled prompt's fully-covered pages.
+        A key that raced in from another slot keeps its existing page
+        (this slot's copy stays private and frees with the slot)."""
+        for i, p in enumerate(pages):
+            key = prompt[:(i + 1) * self._pt].tobytes()
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                continue
+            self._entries[key] = p
+            self._pool.incref([p])
+
+    def evict_one(self) -> bool:
+        """Free the LRU index-only page; False when every indexed page
+        is still mapped into a live slot (nothing safely evictable)."""
+        for key, p in list(self._entries.items()):
+            if self._pool.refcount(p) == 1:
+                del self._entries[key]
+                self._pool.decref([p])
+                return True
+        return False
+
+    def flush(self) -> int:
+        """Drop EVERY entry (decref all index-held pages) and return
+        how many were dropped — the integrity valve for a mid-step
+        executor crash, after which the donated pool buffers (and
+        therefore every indexed page's K/V) are unknowable."""
+        n = len(self._entries)
+        for p in self._entries.values():
+            self._pool.decref([p])
+        self._entries.clear()
+        return n
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
 class _Slot:
     """Per-slot decode state: cache offset, step count, deadline."""
 
     __slots__ = ("idx", "req", "position", "steps", "tokens", "t_start",
-                 "logits")
+                 "logits", "pages", "prefill_pos", "hit_tokens",
+                 "decoding")
 
     def __init__(self, idx: int):
         self.idx = idx
@@ -113,6 +270,10 @@ class _Slot:
         self.tokens: List[int] = []
         self.t_start = 0.0
         self.logits: List[np.ndarray] = []  # keep_logits only
+        self.pages: List[int] = []   # paged: block table, logical order
+        self.prefill_pos = 0         # paged: next position to prefill
+        self.hit_tokens = 0          # paged: tokens served by the index
+        self.decoding = False        # prefill complete, in the grid
 
     @property
     def active(self) -> bool:
@@ -139,7 +300,9 @@ class GenerationEngine:
                  max_new_tokens=None, queue_cap=None, deadline_ms=None,
                  continuous=True, autostart=True, name="llama",
                  attn_impl="auto", seed=0, keep_logits=False,
-                 mesh=None, shard_rules=None):
+                 mesh=None, shard_rules=None, paged=None,
+                 page_tokens=None, num_pages=None, prefill_chunk=None,
+                 prefix_reuse=None):
         import paddle_tpu as pt
         from ..models.llama import build_llama_decode, build_llama_prefill
 
@@ -183,6 +346,49 @@ class GenerationEngine:
         self._build_fn_prefill = build_llama_prefill
         self._seed = seed
 
+        # paged KV cache config (None kwargs fall back to flags)
+        self.paged = bool(flag_value("FLAGS_serving_paged")
+                          if paged is None else paged)
+        self.page_tokens = 0
+        self.num_pages = 0
+        self.pages_per_slot = 0
+        self.prefill_chunk = 0
+        self.prefix_reuse = False
+        self._pool: Optional[PagePool] = None
+        self._prefix: Optional[PrefixIndex] = None
+        if self.paged:
+            pt_ = int(page_tokens if page_tokens is not None
+                      else flag_value("FLAGS_serving_kv_page_tokens"))
+            if pt_ < 1 or (pt_ & (pt_ - 1)):
+                raise ValueError(f"FLAGS_serving_kv_page_tokens must be "
+                                 f"a power of two, got {pt_}")
+            if self.max_seq_len % pt_:
+                # bit-exactness requires the gathered logical view to
+                # be exactly max_seq_len columns wide (the dense
+                # contraction length) — no ragged last page
+                raise ValueError(
+                    f"max_seq_len {self.max_seq_len} is not a multiple "
+                    f"of page_tokens {pt_}")
+            self.page_tokens = pt_
+            self.pages_per_slot = self.max_seq_len // pt_
+            auto = self.num_slots * self.pages_per_slot + 1
+            self.num_pages = int(
+                num_pages if num_pages is not None
+                else (flag_value("FLAGS_serving_kv_pages") or auto))
+            self.prefill_chunk = int(
+                prefill_chunk if prefill_chunk is not None
+                else flag_value("FLAGS_serving_prefill_chunk"))
+            self.prefix_reuse = bool(
+                prefix_reuse if prefix_reuse is not None
+                else flag_value("FLAGS_serving_prefix_reuse"))
+            self._pool = PagePool(self.num_pages)
+            if self.prefix_reuse:
+                self._prefix = PrefixIndex(self._pool, pt_)
+        self._paged_prefill_progs: Dict[int, tuple] = {}
+        self._chunk_progs: Dict[int, tuple] = {}
+        self._prefill_rr = 0  # chunked-prefill round-robin cursor
+        self._peak_active = 0
+
         # programs + executors: decode gets its own executor so its
         # compile-cache entry (and cost/memory manifest) is isolated —
         # cache_info()["entries"][0] IS the decode step
@@ -213,7 +419,9 @@ class GenerationEngine:
         self._n = {"requests": 0, "shed": 0, "served": 0, "prefills": 0,
                    "decode_steps": 0, "generated_tokens": 0,
                    "prefill_tokens": 0, "slot_reclaims": 0,
-                   "failed": 0}
+                   "failed": 0, "prefix_hits": 0,
+                   "prefix_tokens_saved": 0, "prefill_chunks": 0,
+                   "page_evictions": 0, "pool_stalls": 0}
         self._n_lock = threading.Lock()
         self._h_gen = telemetry.Histogram("serving_generate_ms")
         self._h_prefill = telemetry.Histogram("serving_prefill_ms")
@@ -236,7 +444,8 @@ class GenerationEngine:
         with pt.program_guard(main, startup):
             feeds, fetches, cache_names = build_llama_decode(
                 self.num_slots, self.max_seq_len, name=self.name,
-                **self.model)
+                paged=self.paged, num_pages=self.num_pages or None,
+                page_tokens=self.page_tokens or None, **self.model)
         self._decode_prog = main
         self._decode_feeds = feeds
         self._decode_fetches = fetches
@@ -277,8 +486,12 @@ class GenerationEngine:
         import jax
         import jax.numpy as jnp
 
-        shape = (self.num_slots, self._n_kv, self.max_seq_len,
-                 self._head_dim)
+        if self.paged:
+            shape = (self.num_pages, self._n_kv, self.page_tokens,
+                     self._head_dim)
+        else:
+            shape = (self.num_slots, self._n_kv, self.max_seq_len,
+                     self._head_dim)
         cache_sh = None
         self.kv_shard_axis = None
         if self.mesh is not None:
@@ -294,8 +507,34 @@ class GenerationEngine:
                 n, jax.device_put(zeros, cache_sh)
                 if cache_sh is not None else zeros.copy())
             total += int(np.prod(shape)) * 4
+        # capacity actually ALLOCATED (pool in paged mode, dense
+        # reservation otherwise) — not the dense worst case
         self.kv_cache_bytes = total
+        # bytes one page costs across every layer's K+V pool
+        self.page_bytes = (len(self.cache_names) * self._n_kv
+                           * self.page_tokens * self._head_dim * 4) \
+            if self.paged else 0
         telemetry.gauge_set("serving_kv_cache_bytes", total)
+        self._publish_pool_gauges()
+
+    def _publish_pool_gauges(self):
+        if self._pool is None:
+            return
+        telemetry.gauge_set("serving_kv_pages_free",
+                            self._pool.free_pages)
+        telemetry.gauge_set("serving_kv_pages_live",
+                            self._pool.live_pages)
+        telemetry.gauge_set("serving_kv_live_bytes",
+                            self._pool.live_pages * self.page_bytes)
+
+    @property
+    def kv_live_bytes(self) -> int:
+        """Bytes of pool pages referenced by live sequences or the
+        prefix index right now (== kv_cache_bytes for the dense
+        cache, whose reservation is always fully held)."""
+        if self._pool is None:
+            return self.kv_cache_bytes
+        return self._pool.live_pages * self.page_bytes
 
     def _prefill_prog_for(self, bucket: int):
         import paddle_tpu as pt
@@ -313,16 +552,104 @@ class GenerationEngine:
             entry = self._prefill_progs[bucket] = (main, fetches)
         return entry
 
+    def _paged_prefill_prog_for(self, bucket: int):
+        """Whole-prompt paged prefill: the dense prefill forward with
+        the K/V scattered into pages instead of a dense slot — logits
+        (and therefore token streams) bit-exact vs dense."""
+        import paddle_tpu as pt
+
+        entry = self._paged_prefill_progs.get(bucket)
+        if entry is None:
+            main, startup = pt.Program(), pt.Program()
+            startup._is_startup = True
+            startup.random_seed = main.random_seed = self._seed
+            with pt.program_guard(main, startup):
+                _feeds, fetches = self._build_fn_prefill(
+                    1, bucket, name=self.name, attn_impl=self.attn_impl,
+                    cache_slots=self.num_slots,
+                    max_seq_len=self.max_seq_len, paged=True,
+                    num_pages=self.num_pages,
+                    page_tokens=self.page_tokens, **self.model)
+            entry = self._paged_prefill_progs[bucket] = (main, fetches)
+        return entry
+
+    def _chunk_prog_for(self, bucket: int):
+        """Prefill-continuation program (chunked prefill / prefix-hit
+        tail): ``bucket`` new tokens attend the slot's pages plus
+        themselves causally."""
+        import paddle_tpu as pt
+        from ..models.llama import build_llama_prefill_chunk
+
+        entry = self._chunk_progs.get(bucket)
+        if entry is None:
+            main, startup = pt.Program(), pt.Program()
+            startup._is_startup = True
+            startup.random_seed = main.random_seed = self._seed
+            with pt.program_guard(main, startup):
+                _feeds, fetches, _names = build_llama_prefill_chunk(
+                    bucket, self.max_seq_len, self.num_pages,
+                    self.page_tokens, name=self.name, **self.model)
+            entry = self._chunk_progs[bucket] = (main, fetches)
+        return entry
+
+    def _chunk_buckets(self) -> List[int]:
+        """Prefill-bucket lengths the chunk program can be asked for:
+        with chunking on, every slice (prefix-hit tails included) is at
+        most the chunk size, so only buckets up to its own are needed;
+        chunking off, a prefix-hit tail can be any prefill bucket."""
+        if self.prefill_chunk > 0:
+            cap = batcher.prompt_bucket_for(
+                min(self.prefill_chunk, self.max_prompt_len),
+                self.prefill_buckets)
+            return [b for b in self.prefill_buckets if b <= cap]
+        return list(self.prefill_buckets)
+
     def warmup(self) -> int:
         """Compile every prefill bucket + the decode step now (off the
-        request path).  Returns the number of programs compiled."""
+        request path).  Returns the number of programs compiled.
+        Paged warmup dispatches run with all-zero block tables and
+        zero valid lengths, so every write lands on the trash page."""
         compiled = 0
-        for b in self.prefill_buckets:
-            if b not in self._prefill_progs:
-                self._run_prefill_program(
-                    np.zeros((b,), "int64"), b, slot=0)
-                compiled += 1
-        # one throwaway decode dispatch compiles the grid step
+        if not self.paged:
+            for b in self.prefill_buckets:
+                if b not in self._prefill_progs:
+                    self._run_prefill_program(
+                        np.zeros((b,), "int64"), b, slot=0)
+                    compiled += 1
+            self._run_decode_program(
+                np.zeros((self.num_slots, 1), "int64"),
+                np.zeros((self.num_slots,), "int32"))
+            return compiled + 1
+        np_slot = self.pages_per_slot
+        if self.prefill_chunk <= 0:
+            for b in self.prefill_buckets:
+                if b not in self._paged_prefill_progs:
+                    prog, fetches = self._paged_prefill_prog_for(b)
+                    self._prefill_exe.run(
+                        prog,
+                        feed={"input_ids": np.zeros((1, b), "int64"),
+                              "last_pos": np.zeros((1,), "int64"),
+                              "block_table": np.zeros((1, np_slot),
+                                                      "int32"),
+                              "prompt_len": np.zeros((1,), "int32")},
+                        fetch_list=[fetches["next_token"]],
+                        scope=self.scope, return_numpy=False)
+                    compiled += 1
+        if self.prefill_chunk > 0 or self.prefix_reuse:
+            for b in self._chunk_buckets():
+                if b not in self._chunk_progs:
+                    prog, fetches = self._chunk_prog_for(b)
+                    self._prefill_exe.run(
+                        prog,
+                        feed={"chunk_ids": np.zeros((1, b), "int64"),
+                              "base": np.zeros((1,), "int32"),
+                              "block_table": np.zeros((1, np_slot),
+                                                      "int32"),
+                              "chunk_len": np.zeros((1,), "int32"),
+                              "last_off": np.zeros((1,), "int64")},
+                        fetch_list=[fetches["next_token"]],
+                        scope=self.scope, return_numpy=False)
+                    compiled += 1
         self._run_decode_program(np.zeros((self.num_slots, 1), "int64"),
                                  np.zeros((self.num_slots,), "int32"))
         return compiled + 1
@@ -482,6 +809,10 @@ class GenerationEngine:
             slot.steps = 0
             slot.tokens = []
             slot.t_start = now
+            slot.pages = []
+            slot.prefill_pos = 0
+            slot.hit_tokens = 0
+            slot.decoding = False
             claimed.append((slot, req))
             if busy_before:
                 # the continuous-batching event: a new sequence enters
@@ -489,6 +820,12 @@ class GenerationEngine:
                 self._count("slot_reclaims")
                 stat_add("serving_slot_reclaims")
         return claimed
+
+    def _decoding_slots(self) -> List[_Slot]:
+        return [s for s in self._slots if s.active and s.decoding]
+
+    def _prefilling_slots(self) -> List[_Slot]:
+        return [s for s in self._slots if s.active and not s.decoding]
 
     def _loop(self):
         while True:
@@ -504,16 +841,33 @@ class GenerationEngine:
                 claimed = self._claim_locked()
             for slot, req in claimed:
                 try:
-                    self._prefill(slot, req)
+                    self._begin(slot, req)
                 except Exception as e:  # noqa: BLE001 — a prefill failure
                     # must not kill the scheduler: exactly this request
                     # errors, the grid keeps decoding
-                    self._count("failed")
-                    logger.warning("prefill failed: %s", e)
-                    req.future._resolve(error=RequestFailed(
-                        f"prefill failed: {type(e).__name__}: {e}"))
-                    slot.req = None
-            if self._active():
+                    self._fail_request(slot, req, "prefill", e)
+            # chunked prefill: advance ONE pending slice per iteration
+            # (round-robin over prefilling slots), so a long prompt
+            # pays out between decode steps instead of stalling the
+            # grid — the dense path never leaves slots prefilling
+            pending = self._prefilling_slots()
+            if pending:
+                slot = pending[self._prefill_rr % len(pending)]
+                self._prefill_rr += 1
+                try:
+                    self._prefill_advance(slot)
+                except PoolExhausted as e:
+                    # transient saturation, not a broken request: live
+                    # sequences will free pages as they finish, so put
+                    # the request back at the queue head (its own
+                    # deadline still bounds the wait).  Only a pool
+                    # that cannot serve the prompt even with every
+                    # other slot idle is a hard failure
+                    self._requeue_or_fail(slot, e)
+                except Exception as e:  # noqa: BLE001 — same isolation
+                    # as a dense prefill failure: this request only
+                    self._fail_request(slot, slot.req, "prefill", e)
+            if self._decoding_slots():
                 try:
                     self._decode_step()
                 except Exception as e:  # noqa: BLE001 — a decode-step
@@ -524,7 +878,77 @@ class GenerationEngine:
                     self._decode_failed(e)
             self._publish_gauges()
 
+    def _begin(self, slot: _Slot, req: GenRequest):
+        """Post-claim admission work.  Dense: the whole prefill, here
+        and now.  Paged: poison/fault checks + the prefix-index
+        mapping only — the prompt itself pays out via
+        :meth:`_prefill_advance` (one slice per scheduler iteration)."""
+        if not self.paged:
+            self._prefill(slot, req)
+            slot.decoding = True
+            return
+        kind = fault.fire("prefill")
+        fault.maybe_delay(kind)
+        if kind == "fail":
+            raise fault.InjectedFault("injected prefill failure")
+        # poison fails the request BEFORE any page is mapped or
+        # registered: a poisoned prompt sharing a cached prefix never
+        # touches (or evicts) the pages other slots still reference
+        self._poison_check(req.prompt)
+        if self._prefix is not None:
+            hit = self._prefix.lookup(req.prompt)
+            if hit:
+                self._pool.incref(hit)
+                slot.pages = list(hit)
+                slot.hit_tokens = len(hit) * self.page_tokens
+                self._count("prefix_hits")
+                stat_add("serving_prefix_hits")
+                self._count("prefix_tokens_saved", slot.hit_tokens)
+                stat_add("serving_prefix_tokens_saved",
+                         slot.hit_tokens)
+        slot.prefill_pos = slot.hit_tokens
+
+    def _requeue_or_fail(self, slot: _Slot, e: Exception):
+        """Pool exhausted mid-prefill.  With other sequences live the
+        condition is transient — release this slot's pages and put the
+        request back at the QUEUE HEAD (fairness preserved; its
+        deadline still sheds it if starvation persists).  With the
+        grid otherwise empty the pool simply cannot hold the prompt:
+        fail it, a retry can never succeed."""
+        req = slot.req
+        others = [s for s in self._slots if s.active and s is not slot]
+        if not others:
+            self._fail_request(slot, req, "prefill", e)
+            return
+        self._count("pool_stalls")
+        stat_add("serving_kv_pool_stalls")
+        logger.debug("kv pool exhausted mid-prefill; requeueing "
+                     "request (%d live slots hold the pages)",
+                     len(others))
+        self._release_pages(slot)
+        slot.req = None
+        slot.decoding = False
+        slot.logits = []
+        with self._cv:
+            self._queue.appendleft(req)
+            self._cv.notify_all()
+
+    def _fail_request(self, slot: _Slot, req: GenRequest, phase: str,
+                      e: Exception):
+        self._count("failed")
+        logger.warning("%s failed: %s", phase, e)
+        self._release_pages(slot)
+        req.future._resolve(error=RequestFailed(
+            f"{phase} failed: {type(e).__name__}: {e}"))
+        slot.req = None
+        slot.decoding = False
+        slot.logits = []
+
     def _decode_failed(self, e: Exception):
+        # fail EVERY active slot, mid-prefill ones included: the step
+        # donated the same cache (or page-pool) buffers a concurrent
+        # chunked prefill writes into, so after a mid-step crash no
+        # slot's cache state is knowable
         active = self._active()
         self._count("failed", len(active))
         stat_add("serving_decode_failures")
@@ -537,7 +961,18 @@ class GenerationEngine:
                             f"{type(e).__name__}: {e}")
         for s in active:
             req, s.req, s.logits = s.req, None, []
+            s.decoding = False
+            self._release_pages(s)
             req.future._resolve(error=err)
+        if self._prefix is not None:
+            # the crashed step donated the pool buffers, so every
+            # indexed page's K/V is as unknowable as the slots' —
+            # a later prefix hit must not serve possibly-corrupt rows
+            dropped = self._prefix.flush()
+            if dropped:
+                logger.warning("flushed %d prefix-index entries after "
+                               "decode-step failure", dropped)
+            self._publish_pool_gauges()
 
     # -- prefill ------------------------------------------------------------
     def _run_prefill_program(self, ids: np.ndarray, bucket: int,
@@ -602,16 +1037,163 @@ class GenerationEngine:
         slot.tokens = [first]
         self._book_token(slot, first)
 
+    # -- paged prefill ------------------------------------------------------
+    def _release_pages(self, slot: _Slot):
+        """Drop the slot's refs on its pages (shared prefix pages fall
+        back to the index's ref; private pages free) and refresh the
+        pool gauges."""
+        if self._pool is not None and slot.pages:
+            self._pool.decref(slot.pages)
+            self._publish_pool_gauges()
+        slot.pages = []
+        slot.hit_tokens = 0
+        slot.prefill_pos = 0
+
+    def _ensure_pages(self, slot: _Slot, n_tokens: int):
+        """Grow the slot's block table to cover ``n_tokens`` logical
+        tokens, evicting idle prefix-index pages when the free list
+        runs dry.  Raises :class:`PoolExhausted` when nothing is left
+        to evict — the caller turns that into ``cache_full`` (decode)
+        or a failed request (prefill)."""
+        needed = -(-int(n_tokens) // self.page_tokens)  # ceil
+        while len(slot.pages) < needed:
+            p = self._pool.alloc()
+            if p is None:
+                if self._prefix is not None and self._prefix.evict_one():
+                    self._count("page_evictions")
+                    stat_add("serving_kv_page_evictions")
+                    continue
+                raise PoolExhausted(
+                    f"kv page pool exhausted ({self._pool.live_pages}"
+                    f"/{self.num_pages - 1} pages live, nothing "
+                    f"evictable)")
+            slot.pages.append(p)
+        self._publish_pool_gauges()
+
+    def _slot_block_table(self, slot: _Slot) -> np.ndarray:
+        bt = np.zeros((self.pages_per_slot,), "int32")
+        bt[:len(slot.pages)] = slot.pages
+        return bt
+
+    def _prefill_advance(self, slot: _Slot):
+        """One prefill slice for one paged slot: either the whole
+        prompt through the paged full-prefill program (chunking off,
+        no prefix hit — the path that is bit-exact vs dense), or the
+        next ``prefill_chunk`` tokens (or the whole prefix-hit tail)
+        through the chunk program.  The final slice yields the first
+        generated token and flips the slot into the decode grid."""
+        req = slot.req
+        prompt = req.prompt
+        t0 = time.monotonic()
+        n_prompt = int(prompt.size)
+        if slot.prefill_pos == 0 and self.prefill_chunk <= 0:
+            bucket = batcher.prompt_bucket_for(n_prompt,
+                                               self.prefill_buckets)
+            self._ensure_pages(slot, n_prompt)
+            prog, fetches = self._paged_prefill_prog_for(bucket)
+            fetch = [fetches["next_token"]]
+            if self.keep_logits:
+                fetch.append(fetches["logits"])
+            with telemetry.trace_span("generation/prefill",
+                                      tokens=n_prompt, bucket=bucket,
+                                      slot=slot.idx, paged=True):
+                outs = self._prefill_exe.run(
+                    prog,
+                    feed={"input_ids":
+                          batcher.pad_prompt(prompt, bucket)[None],
+                          "last_pos": np.asarray([n_prompt - 1],
+                                                 "int64"),
+                          "block_table":
+                          self._slot_block_table(slot)[None],
+                          "prompt_len": np.asarray([n_prompt],
+                                                   "int32")},
+                    fetch_list=fetch, scope=self.scope,
+                    return_numpy=False)
+            req.prefill_ms += (time.monotonic() - t0) * 1e3
+            self._complete_prefill(slot, req, outs)
+            return
+        # chunk continuation (chunked prefill and/or prefix-hit tail):
+        # this iteration runs the FIRST remaining span; later spans
+        # run on later iterations, decode steps in between
+        start, end = batcher.chunk_spans(
+            slot.prefill_pos, n_prompt, self.prefill_chunk)[0]
+        n = end - start
+        bucket = batcher.prompt_bucket_for(n, self.prefill_buckets)
+        self._ensure_pages(slot, start + n)
+        prog, fetches = self._chunk_prog_for(bucket)
+        last = start + n >= n_prompt
+        fetch = [fetches["next_token"]]
+        if self.keep_logits:
+            fetch.append(fetches["logits"])
+        chunk = np.zeros((bucket,), "int64")
+        chunk[:n] = prompt[start:start + n]
+        with telemetry.trace_span("generation/prefill_chunk",
+                                  tokens=n, base=start, bucket=bucket,
+                                  slot=slot.idx):
+            outs = self._prefill_exe.run(
+                prog,
+                feed={"chunk_ids": chunk[None],
+                      "base": np.asarray([start], "int32"),
+                      "block_table": self._slot_block_table(slot)[None],
+                      "chunk_len": np.asarray([n], "int32"),
+                      "last_off": np.asarray([n - 1], "int64")},
+                fetch_list=fetch, scope=self.scope, return_numpy=False)
+        self._count("prefill_chunks")
+        stat_add("serving_prefill_chunks")
+        req.prefill_ms += (time.monotonic() - t0) * 1e3
+        slot.prefill_pos = start + n
+        if last:
+            self._complete_prefill(slot, req, outs)
+
+    def _complete_prefill(self, slot: _Slot, req: GenRequest, outs):
+        """Shared tail of every paged prefill path: book the first
+        generated token, publish the prompt's fully-covered pages to
+        the prefix index, and enter the decode grid."""
+        first = int(np.asarray(outs[0].numpy())[0])
+        slot.logits = [np.asarray(outs[1].numpy())[0]] \
+            if self.keep_logits else []
+        n_prompt = int(req.prompt.size)
+        self._t_prefill_total += req.prefill_ms
+        self._h_prefill.observe(req.prefill_ms, trace_id=req.trace_id)
+        telemetry.histogram_observe("serving_prefill_ms",
+                                    req.prefill_ms,
+                                    trace_id=req.trace_id)
+        self._count("prefills")
+        # prefix-hit tokens never ran a prefill pass — count only the
+        # tokens this engine actually computed
+        self._count("prefill_tokens", n_prompt - slot.hit_tokens)
+        stat_add("serving_prefills")
+        stat_add("serving_prefill_tokens", n_prompt - slot.hit_tokens)
+        if self._prefix is not None:
+            full = n_prompt // self.page_tokens
+            if full:
+                self._prefix.register(req.prompt, slot.pages[:full])
+                self._publish_pool_gauges()
+        slot.prefill_pos = n_prompt
+        slot.position = n_prompt
+        slot.tokens = [first]
+        slot.decoding = True
+        self._book_token(slot, first)
+
     # -- decode -------------------------------------------------------------
     def _run_decode_program(self, tokens: np.ndarray,
-                            positions: np.ndarray):
+                            positions: np.ndarray,
+                            block_tables: Optional[np.ndarray] = None,
+                            live: Optional[np.ndarray] = None):
+        feed = {"tokens": tokens, "positions": positions}
+        if self.paged:
+            if block_tables is None:
+                block_tables = np.zeros(
+                    (self.num_slots, self.pages_per_slot), "int32")
+            if live is None:
+                live = np.zeros((self.num_slots,), "int32")
+            feed["block_tables"] = block_tables
+            feed["live"] = live
         fetch = [self._decode_fetches["next_token"]]
         if self.keep_logits:
             fetch.append(self._decode_fetches["logits"])
         outs = self._decode_exe.run(
-            self._decode_prog,
-            feed={"tokens": tokens, "positions": positions},
-            fetch_list=fetch,
+            self._decode_prog, feed=feed, fetch_list=fetch,
             scope=self.scope, return_numpy=False)
         next_tokens = np.asarray(outs[0].numpy())
         logits = np.asarray(outs[1].numpy()) if self.keep_logits else None
@@ -623,16 +1205,38 @@ class GenerationEngine:
         fault.maybe_delay(kind)
         if kind == "fail":
             raise fault.InjectedFault("injected decode_step failure")
+        if self.paged:
+            # pool-exhaustion guard: a slot about to cross into an
+            # unmapped page must get one BEFORE the step (the write
+            # would land on the trash page and corrupt nothing, but
+            # the token would be attention-blind to itself); a slot
+            # the pool cannot serve even after eviction finishes
+            # cache_full with everything it generated so far
+            for s in list(self._decoding_slots()):
+                try:
+                    self._ensure_pages(s, s.position + 1)
+                except PoolExhausted:
+                    self._finish(s, "cache_full")
         tokens = np.zeros((self.num_slots, 1), "int64")
         positions = np.zeros((self.num_slots,), "int32")
-        active = self._active()
+        active = self._decoding_slots()
+        if not active:
+            return
         for s in active:
             tokens[s.idx, 0] = s.tokens[-1]
             positions[s.idx] = s.position
+        bt = live = None
+        if self.paged:
+            bt = np.zeros((self.num_slots, self.pages_per_slot),
+                          "int32")
+            live = np.zeros((self.num_slots,), "int32")
+            for s in active:
+                bt[s.idx] = self._slot_block_table(s)
+                live[s.idx] = 1
         with telemetry.trace_span("generation/decode_step",
                                   active=len(active)):
-            next_tokens, logits = self._run_decode_program(tokens,
-                                                           positions)
+            next_tokens, logits = self._run_decode_program(
+                tokens, positions, bt, live)
         ms = (time.monotonic() - t0) * 1e3
         self._t_decode_total += ms
         self._h_step.observe(ms)
@@ -696,7 +1300,11 @@ class GenerationEngine:
         if self.keep_logits:
             result["logits"] = slot.logits
             slot.logits = []
+        if slot.hit_tokens:
+            result["prefix_hit_tokens"] = slot.hit_tokens
         slot.req = None
+        slot.decoding = False
+        self._release_pages(slot)
         req.future._resolve(outputs=result)
 
     def retry_after_s(self) -> float:
@@ -713,9 +1321,13 @@ class GenerationEngine:
 
     # -- introspection ------------------------------------------------------
     def _publish_gauges(self):
+        active = len(self._active())
+        if active > self._peak_active:
+            # peak concurrency feeds the paged bench's sequences-per-GB
+            # headline, so it is tracked even with telemetry off
+            self._peak_active = active
         if not telemetry.enabled():
             return
-        active = len(self._active())
         telemetry.gauge_set("serving_slot_occupancy",
                             active / self.num_slots)
         if self._t_decode_total > 0:
@@ -763,6 +1375,22 @@ class GenerationEngine:
             "max_seq_len": self.max_seq_len,
             "prefill_buckets": list(self.prefill_buckets),
             "kv_cache_bytes": self.kv_cache_bytes,
+            "kv_live_bytes": self.kv_live_bytes,
+            "peak_active_slots": self._peak_active,
+            "paged": None if not self.paged else {
+                "page_tokens": self.page_tokens,
+                "num_pages": self.num_pages,
+                "pages_per_slot": self.pages_per_slot,
+                "pages_free": self._pool.free_pages,
+                "pages_live": self._pool.live_pages,
+                "page_bytes": self.page_bytes,
+                "prefill_chunk": self.prefill_chunk,
+                "prefix_reuse": self.prefix_reuse,
+                "prefix_index_entries":
+                    len(self._prefix) if self._prefix else 0,
+                "prefix_hit_rate": round(
+                    n["prefix_hits"] / max(n["prefills"], 1), 4),
+            },
             "mesh": None if self.mesh is None
             else _describe_mesh(self.mesh),
             "kv_shard_axis": getattr(self, "kv_shard_axis", None),

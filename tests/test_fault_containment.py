@@ -19,7 +19,9 @@ Three tiers:
   invisible to exit-code monitoring — is ejected by the router,
   SIGKILLed by the supervisor's liveness deadline, and respawned; the
   chaos harness (tools/chaos.py) runs crash+hang+slow+poison against
-  a 3-replica fleet under load with zero collateral failures.
+  a 3-replica fleet under load with zero collateral failures, plus the
+  paged-generation poison scenario (a poisoned prompt sharing a cached
+  prefix is isolated without evicting or corrupting the shared pages).
 """
 import importlib.util
 import json
@@ -663,11 +665,21 @@ def test_chaos_harness_smoke_three_replica_fleet():
     assert report["availability_pct"] >= 99.0, report
     assert report["ok"] is True
     scen = report["scenarios"]
-    assert set(scen) == {"crash", "hang", "slow", "poison"}
+    assert set(scen) == {"crash", "hang", "slow", "poison",
+                         "poison_paged"}
     # poison scenario proved bisection end-to-end: the poisoned
     # requests failed (injected), their batchmates did not
     assert scen["poison"]["injected_failures"] >= 1
     assert scen["poison"]["collateral_failures"] == 0
+    # paged-path poison containment: every poisoned prompt sharing a
+    # cached prefix failed at the prefill check; zero collateral means
+    # no clean stream drifted and no shared page was evicted or
+    # corrupted (the scenario errors on either, which report["errors"]
+    # == {} above already rules out)
+    assert scen["poison_paged"]["injected_failures"] >= 1
+    assert scen["poison_paged"]["collateral_failures"] == 0
+    assert scen["poison_paged"]["poison_leaks"] == 0
+    assert scen["poison_paged"]["notes"]["page_evictions"] == 0
     # both process-level faults recovered
     assert scen["crash"]["recovery_s"] > 0
     assert scen["hang"]["recovery_s"] > 0

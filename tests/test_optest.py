@@ -1127,6 +1127,10 @@ SKIP = {
        "unit; all three via cached-decode bit-exactness vs the "
        "uncached forward, tolerance 0)" for op in [
            "kv_cache_write", "kv_cache_insert", "cached_attention"]},
+    **{op: "tests/test_paged_generation.py (scatter/gather round trip "
+       "+ trash-page redirect unit; both via paged-decode "
+       "bit-exactness vs the dense cache, tolerance 0)" for op in [
+           "kv_pool_write", "kv_pool_gather"]},
     "masked_select": "dynamic shape; covered via layers.masked_select "
                      "usage in tests/test_models.py",
     "unique": "dynamic shape; lowering returns padded/size pair",

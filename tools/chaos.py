@@ -22,22 +22,34 @@ processes and real sockets, and the run FAILS unless:
 Scenarios (one shared fleet; traffic is open-loop ``POST /predict``
 through the router):
 
-=========  ==============================================  =============
-scenario   injection                                       recovery path
-=========  ==============================================  =============
-crash      SIGKILL one replica mid-traffic                 connect-refused retry +
+=============  ==========================================  =============
+scenario       injection                                   recovery path
+=============  ==========================================  =============
+crash          SIGKILL one replica mid-traffic             connect-refused retry +
                                                            supervisor respawn
-hang       SIGSTOP one replica (PID alive, sockets open)   forward-timeout retry +
-                                                           health ejection +
+hang           SIGSTOP one replica (PID alive, sockets     forward-timeout retry +
+               open)                                       health ejection +
                                                            liveness SIGKILL/respawn
-slow       ``router_forward:delay:<ms>~<p>`` fault in the  none needed: slow is
-           router process (random per-forward delay)       not failure — zero
-                                                           failures allowed
-poison     every Nth request carries the
-           ``FLAGS_serving_poison_value`` sentinel         bisection: poisoned
+slow           ``router_forward:delay:<ms>~<p>`` fault in  none needed: slow is
+               the router process (random per-forward      not failure — zero
+               delay)                                      failures allowed
+poison         every Nth request carries the
+               ``FLAGS_serving_poison_value`` sentinel     bisection: poisoned
                                                            request 500s, riders
                                                            answer bit-exact
-=========  ==============================================  =============
+poison_paged   every Nth *generation prompt* carries a     prefill-time poison
+               poisoned token while sharing a cached       check fires BEFORE any
+               prefix with clean prompts (in-process       shared page is mapped:
+               paged GenerationEngine, prefix reuse on)    exactly the poisoned
+                                                           request fails; the
+                                                           shared pages are
+                                                           neither evicted nor
+                                                           corrupted — every
+                                                           clean stream stays
+                                                           bit-exact and later
+                                                           borrowers still hit
+                                                           the prefix index
+=============  ==========================================  =============
 
 Usage::
 
@@ -71,7 +83,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # outside any real feature distribution
 POISON = 1e30
 
-DEFAULT_SCENARIOS = ("crash", "hang", "slow", "poison")
+# the generation-path sentinel must be a real token id (prompts are
+# int ids, not floats); the paged scenario keeps every legitimate
+# token >= POISON_TOKEN + 1 so only deliberate prompts carry it
+POISON_TOKEN = 7
+
+DEFAULT_SCENARIOS = ("crash", "hang", "slow", "poison", "poison_paged")
 
 
 # ---------------------------------------------------------------------------
@@ -334,6 +351,128 @@ def _scenario(name: str, sup, router, url: str, cfg: dict) -> dict:
     return rep
 
 
+def _scenario_poison_paged(cfg: dict) -> dict:
+    """Paged-path poison containment, in-process (the page pool and
+    prefix index live inside a GenerationEngine, not behind the
+    router): clean prompts sharing a system header decode bit-exact
+    against a poison-free reference while every Nth prompt — sharing
+    the SAME cached prefix — carries the poison token.
+
+    The contract under test: the prefill-time poison check fires
+    BEFORE the prefix index maps any shared page into the slot, so a
+    poisoned prompt (a) fails exactly itself, (b) evicts nothing, and
+    (c) cannot corrupt the shared pages other slots are concurrently
+    reading — asserted by bit-exact rider streams AND by a post-storm
+    borrower that must still hit the index and match the reference."""
+    import paddle_tpu as pt
+    from paddle_tpu.serving import GenerationEngine
+
+    model = dict(vocab_size=64, hidden=32, num_layers=2, num_heads=4,
+                 num_kv_heads=2, intermediate=64)
+    eng_kw = dict(num_slots=4, max_seq_len=64, max_new_tokens=8,
+                  attn_impl="xla", seed=0, queue_cap=256,
+                  deadline_ms=600000.0, paged=True, page_tokens=8,
+                  prefill_chunk=0, prefix_reuse=True)
+    poison_every = max(2, int(cfg.get("poison_every", 5)))
+    rng = np.random.RandomState(5)
+    # all legitimate tokens sit above the sentinel id
+    header = rng.randint(POISON_TOKEN + 1, 64, size=32).tolist()
+    tails = [rng.randint(POISON_TOKEN + 1, 64, size=6).tolist()
+             for _ in range(9)]
+    n_steps = 3
+    error = None
+    notes: Dict[str, object] = {}
+    records: List[dict] = []
+
+    # poison-free reference streams run on the SAME engine before the
+    # sentinel flag arms (the poison check reads the flag per prefill),
+    # so stream equality is exact and only one engine pays the
+    # program-build cost; the reference pass also pre-warms the prefix
+    # index, making the storm all-borrowers — the sharper COW test
+    old_flag = pt.get_flags("FLAGS_serving_poison_value")[
+        "FLAGS_serving_poison_value"]
+    eng = GenerationEngine(model, **eng_kw)
+    try:
+        want = [eng.generate(header + t, n_steps)["tokens"]
+                for t in tails]
+        pt.set_flags({"FLAGS_serving_poison_value":
+                      str(float(POISON_TOKEN))})
+
+        def run_one(i, poisoned):
+            prompt = header + tails[i]
+            if poisoned:
+                prompt = prompt[:-1] + [POISON_TOKEN]
+            t0 = time.monotonic()
+            return i, poisoned, t0, eng.submit(prompt, n_steps)
+
+        # the donor populates the prefix index first, then the storm:
+        # clean borrowers and poisoned prompts in flight CONCURRENTLY
+        donor = run_one(0, False)
+        futs = [donor] + [run_one(i, i % poison_every == 0)
+                          for i in range(1, len(tails) - 1)]
+        for i, poisoned, t0, fut in futs:
+            rec = {"t0": t0, "poison": poisoned, "status": None}
+            try:
+                res = fut.result(120)
+                # a clean stream that drifted from the reference means
+                # a poisoned neighbor corrupted shared state: that is
+                # a containment break, counted as a (collateral)
+                # failure even though the HTTP-level answer was 200
+                rec["outcome"] = "ok" if (poisoned
+                                          or res["tokens"] == want[i]) \
+                    else "failed"
+                if not poisoned and res["tokens"] != want[i]:
+                    notes.setdefault("corrupted", []).append(i)
+            except Exception:  # noqa: BLE001 — the failure taxonomy is
+                # the record's job; poisoned failures are the injection
+                rec["outcome"] = "failed"
+            rec["t1"] = time.monotonic()
+            rec["ms"] = (rec["t1"] - rec["t0"]) * 1e3
+            records.append(rec)
+
+        hits_during = eng.stats()["counters"]["prefix_hits"]
+        # post-storm borrower: the shared pages must still be indexed
+        # (not evicted by the poisoned prompts) and bit-exact
+        last = len(tails) - 1
+        t0 = time.monotonic()
+        res = eng.generate(header + tails[last], n_steps)
+        records.append({"t0": t0, "t1": time.monotonic(),
+                        "ms": (time.monotonic() - t0) * 1e3,
+                        "status": None, "poison": False,
+                        "outcome": "ok" if res["tokens"] == want[last]
+                        else "failed"})
+        st = eng.stats()
+        notes["prefix_hits"] = st["counters"]["prefix_hits"]
+        notes["prefix_index_entries"] = \
+            st["paged"]["prefix_index_entries"]
+        notes["page_evictions"] = st["counters"]["page_evictions"]
+        if res["tokens"] != want[last]:
+            error = "post-storm borrower stream drifted (shared " \
+                    "pages corrupted?)"
+        elif st["counters"]["prefix_hits"] <= hits_during:
+            error = "post-storm borrower missed the prefix index " \
+                    "(poisoned prompts evicted shared pages?)"
+        elif notes.get("corrupted"):
+            error = f"clean stream(s) {notes['corrupted']} drifted " \
+                    f"from the poison-free reference"
+    finally:
+        pt.set_flags({"FLAGS_serving_poison_value": old_flag})
+        eng.close()
+
+    rep = classify(records, [])
+    rep["scenario"] = "poison_paged"
+    rep["notes"] = notes
+    if error is None:
+        if rep["poisoned"] == 0:
+            error = "no poisoned prompts were submitted"
+        elif rep["poison_leaks"] == 0 and rep["injected_failures"] == 0:
+            error = "no poisoned prompt reached the prefill check"
+    if error is not None:
+        rep["error"] = error
+    rep["_records"] = records
+    return rep
+
+
 # ---------------------------------------------------------------------------
 # orchestrator
 # ---------------------------------------------------------------------------
@@ -382,7 +521,13 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
             f"{time.monotonic() - t_setup0:.1f}s; running "
             f"{','.join(scenarios)} at {qps} qps x {duration_s}s each")
         for name in scenarios:
-            rep = _scenario(name, sup, router, server.url, cfg)
+            if name == "poison_paged":
+                # in-process paged-generation containment: needs no
+                # fleet traffic, but runs inside the same harness so
+                # its counters fold into the same hard-zero contract
+                rep = _scenario_poison_paged(cfg)
+            else:
+                rep = _scenario(name, sup, router, server.url, cfg)
             records = rep.pop("_records")
             all_records.extend(records)
             if name in ("crash", "hang"):
@@ -452,7 +597,7 @@ def main(argv=None) -> int:
     ap.add_argument("--scenarios",
                     default=",".join(DEFAULT_SCENARIOS),
                     help="comma-separated subset of "
-                         "crash,hang,slow,poison")
+                         "crash,hang,slow,poison,poison_paged")
     ap.add_argument("--availability-pct", type=float, default=99.0)
     ap.add_argument("--feat", type=int, default=8)
     ap.add_argument("--hidden", type=int, default=32)

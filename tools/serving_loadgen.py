@@ -63,10 +63,19 @@ batch-drain scheduling.  Closed loop measures saturated
 on the ``--qps`` clock for latency/shed behavior at a target rate.
 ``--gen-static`` schedules FIFO head-run (batch drain) instead of
 continuous slot reclaim — the A/B the bench leg publishes.
+``--gen-paged`` (with ``--gen-page-tokens``/``--gen-pages``/
+``--gen-prefill-chunk``) swaps in the block-paged KV cache, and
+``--gen-prompt-dist shared-prefix --gen-prefix-tokens N`` makes every
+prompt one fixed N-token header + a random tail — the chat workload
+where the paged engine's prefix index skips the header's prefill.
+With ``--url`` the same workload posts ``/generate`` against a live
+replica or fleet router and the report embeds the target's
+``/statusz`` generation block (prefix-hit rate included).
 
-Used by ``bench.py run_serving``/``run_decode`` (the ``legs.serving``
-and ``legs.llama_decode`` entries), ``tests/test_serving.py``, and
-``tests/test_generation.py``.
+Used by ``bench.py run_serving``/``run_decode``/``run_paged_decode``
+(the ``legs.serving``, ``legs.llama_decode`` and
+``legs.llama_paged_decode`` entries), ``tests/test_serving.py``,
+``tests/test_generation.py``, and ``tests/test_paged_generation.py``.
 """
 from __future__ import annotations
 
@@ -439,7 +448,9 @@ def run_open_loop(engine, make_feed, qps: float, duration_s: float,
 def prompt_maker(vocab_size: int, prompt_min: int, prompt_max: int,
                  out_mean: float, out_max: int, seed: int = 0,
                  pool: int = 64,
-                 dist: str = "geometric") -> Callable[[int], tuple]:
+                 dist: str = "geometric",
+                 prompt_dist: str = "uniform",
+                 prefix_tokens: int = 0) -> Callable[[int], tuple]:
     """Deterministic per-request ``(prompt_ids, max_new_tokens)``
     factory.  Prompt lengths are uniform in [prompt_min, prompt_max];
     output lengths draw from ``dist`` with mean ``out_mean`` clamped to
@@ -453,7 +464,14 @@ def prompt_maker(vocab_size: int, prompt_min: int, prompt_max: int,
     long (~3.3x mean, same overall mean) — the chat-style mix where
     most turns are brief and a quarter run long, driving the grid's
     longest sequence to ~3.3x the mean (the harsher, more realistic
-    test of slot reclaim)."""
+    test of slot reclaim).
+
+    ``prompt_dist="shared-prefix"``: every prompt is one fixed
+    ``prefix_tokens``-token header (drawn once — the system prompt /
+    few-shot preamble of a chat product) followed by a random
+    [prompt_min, prompt_max]-token tail — the workload where the paged
+    engine's prefix index turns the header's prefill into a page-table
+    hit.  ``"uniform"`` keeps fully random prompts."""
     rng = np.random.RandomState(seed)
     reqs = []
     if dist == "bimodal":
@@ -462,9 +480,20 @@ def prompt_maker(vocab_size: int, prompt_min: int, prompt_max: int,
         long_ = (out_mean - (1.0 - p_long) * short) / p_long
     elif dist != "geometric":
         raise ValueError(f"unknown output-length dist {dist!r}")
+    header = None
+    if prompt_dist == "shared-prefix":
+        if prefix_tokens < 1:
+            raise ValueError("shared-prefix prompts need "
+                             "prefix_tokens >= 1")
+        header = rng.randint(1, vocab_size,
+                             size=prefix_tokens).astype("int64")
+    elif prompt_dist != "uniform":
+        raise ValueError(f"unknown prompt dist {prompt_dist!r}")
     for _ in range(pool):
         plen = int(rng.randint(prompt_min, prompt_max + 1))
         prompt = rng.randint(1, vocab_size, size=plen).astype("int64")
+        if header is not None:
+            prompt = np.concatenate([header, prompt])
         if dist == "bimodal":
             mean = long_ if rng.random_sample() < p_long else short
         else:
@@ -710,6 +739,91 @@ def run_closed_loop_http(base_url: str, make_feed, n_requests: int,
     rep["concurrency"] = concurrency
     rep["url"] = base_url
     rep["statusz"] = _http_statusz(base_url)
+    return rep
+
+
+def _http_generate(url: str, body: bytes, timeout_s: float) -> tuple:
+    """One POST /generate -> ('ok'|'shed'|'failed', generated token
+    count).  Same 503 taxonomy as :func:`_http_predict`."""
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            doc = json.loads(r.read())
+            return "ok", len(doc.get("tokens") or [])
+    except urllib.error.HTTPError as e:
+        try:
+            payload = e.read()
+        except OSError:
+            payload = b""  # ok: error body gone with the connection
+        if e.code != 503:
+            return "failed", 0
+        try:
+            reason = json.loads(payload).get("reason")
+        except (ValueError, AttributeError):
+            reason = None
+        return (("failed", 0) if reason == "no_ready_replicas"
+                else ("shed", 0))
+    except (OSError, TimeoutError, ValueError):
+        return "failed", 0
+
+
+def run_closed_loop_generate_http(base_url: str, make_prompt,
+                                  n_requests: int, concurrency: int,
+                                  timeout_s: float = 120.0) -> dict:
+    """Closed loop of ``POST /generate`` against a live server or
+    fleet router: the shared-prefix workload drivable end-to-end.  The
+    report embeds the target's ``/statusz`` generation block —
+    including the paged cache's prefix-hit rate — so the prefix-reuse
+    win is observable from the outside."""
+    url = base_url.rstrip("/") + "/generate"
+    tickets = iter(range(n_requests))
+    ticket_lock = threading.Lock()
+    lat, lock = [], threading.Lock()
+    counts = {"ok": 0, "shed": 0, "failed": 0, "tokens": 0}
+
+    def caller():
+        while True:
+            with ticket_lock:
+                i = next(tickets, None)
+            if i is None:
+                return
+            prompt, out_len = make_prompt(i)
+            body = json.dumps({"prompt": np.asarray(prompt).tolist(),
+                               "max_new_tokens": int(out_len)}).encode()
+            t0 = time.monotonic()
+            outcome, tokens = _http_generate(url, body, timeout_s)
+            ms = (time.monotonic() - t0) * 1e3
+            with lock:
+                counts[outcome] += 1
+                counts["tokens"] += tokens
+                if outcome == "ok":
+                    lat.append(ms)
+
+    threads = [threading.Thread(target=caller, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    rep = _gen_report("closed", n_requests, counts["ok"],
+                      counts["shed"], counts["failed"], wall, lat,
+                      counts["tokens"], None)
+    rep["concurrency"] = concurrency
+    rep["url"] = base_url
+    sz = _http_statusz(base_url)
+    rep["statusz"] = sz
+    gen_stats = None
+    if isinstance(sz, dict):
+        gen_stats = ((sz.get("engine") or {}).get("generator")
+                     or {}).get("stats")
+    if isinstance(gen_stats, dict):
+        rep["generation"] = gen_stats
+        paged = gen_stats.get("paged")
+        if isinstance(paged, dict):
+            rep["prefix_hit_rate"] = paged.get("prefix_hit_rate")
     return rep
 
 
@@ -964,6 +1078,32 @@ def main(argv=None) -> int:
     ap.add_argument("--gen-static", action="store_true",
                     help="FIFO head-run (batch drain) scheduling "
                          "instead of continuous slot reclaim")
+    ap.add_argument("--gen-prompt-dist",
+                    choices=("uniform", "shared-prefix"),
+                    default="uniform",
+                    help="prompt shape: fully random, or a fixed "
+                         "--gen-prefix-tokens system-prompt header + "
+                         "random tail (the chat workload where the "
+                         "paged engine's prefix index skips the "
+                         "header's prefill)")
+    ap.add_argument("--gen-prefix-tokens", type=int, default=32,
+                    help="shared-prefix mode: tokens in the common "
+                         "header every prompt starts with")
+    ap.add_argument("--gen-paged", action="store_true",
+                    help="block-paged KV cache (page pool + per-slot "
+                         "block tables + prefix reuse) instead of the "
+                         "dense per-slot reservation "
+                         "(FLAGS_serving_paged for a live replica)")
+    ap.add_argument("--gen-page-tokens", type=int, default=None,
+                    help="paged: tokens per KV page (default "
+                         "FLAGS_serving_kv_page_tokens)")
+    ap.add_argument("--gen-pages", type=int, default=None,
+                    help="paged: physical pages in the pool (default "
+                         "auto-size to the dense capacity)")
+    ap.add_argument("--gen-prefill-chunk", type=int, default=None,
+                    help="paged: chunked-prefill slice size (0 = "
+                         "whole-prompt prefill; default "
+                         "FLAGS_serving_prefill_chunk)")
     ap.add_argument("--out", help="also write the JSON report here")
     ap.add_argument("--slo-p99-ms", type=float, default=None,
                     help="assert p99 latency <= this (ms); violation "
@@ -1026,6 +1166,21 @@ def main(argv=None) -> int:
                 f.write(text + "\n")
         return rc
 
+    if args.url and args.generate:
+        # remote generation target (replica or fleet router): paced
+        # POST /generate; prefix-hit rate rides in from /statusz
+        if args.mode != "closed":
+            ap.error("--url --generate supports --mode closed only")
+        make_prompt = prompt_maker(
+            args.gen_vocab, args.gen_prompt_min, args.gen_prompt_max,
+            args.gen_out_mean, args.gen_out_max,
+            dist=args.gen_out_dist, prompt_dist=args.gen_prompt_dist,
+            prefix_tokens=args.gen_prefix_tokens
+            if args.gen_prompt_dist == "shared-prefix" else 0)
+        report = run_closed_loop_generate_http(
+            args.url, make_prompt, args.requests, args.concurrency)
+        return finish(report)
+
     if args.url:
         # remote target: no model, no engine — just paced HTTP traffic
         shapes = _parse_shapes(args.shape) or {"x": (args.feat,)}
@@ -1055,18 +1210,29 @@ def main(argv=None) -> int:
                      num_layers=args.gen_layers, num_heads=args.gen_heads,
                      num_kv_heads=args.gen_kv_heads,
                      intermediate=args.gen_intermediate)
+        paged_kw = {}
+        if args.gen_paged:
+            paged_kw = dict(paged=True,
+                            page_tokens=args.gen_page_tokens,
+                            num_pages=args.gen_pages,
+                            prefill_chunk=args.gen_prefill_chunk)
         gen = GenerationEngine(
             model, num_slots=args.gen_slots, max_seq_len=args.gen_max_seq,
             max_new_tokens=args.gen_out_max,
             continuous=not args.gen_static,
             queue_cap=args.queue_cap or 4 * args.requests,
-            deadline_ms=args.deadline_ms or 600000.0)
+            deadline_ms=args.deadline_ms or 600000.0, **paged_kw)
         gen.warmup()
+        shared = args.gen_prompt_dist == "shared-prefix"
+        prefix = args.gen_prefix_tokens if shared else 0
+        tail_max = min(args.gen_prompt_max,
+                       max(1, gen.max_prompt_len - prefix))
         make_prompt = prompt_maker(args.gen_vocab, args.gen_prompt_min,
-                                   min(args.gen_prompt_max,
-                                       gen.max_prompt_len),
+                                   tail_max,
                                    args.gen_out_mean, args.gen_out_max,
-                                   dist=args.gen_out_dist)
+                                   dist=args.gen_out_dist,
+                                   prompt_dist=args.gen_prompt_dist,
+                                   prefix_tokens=prefix)
         try:
             if args.mode == "both":
                 report = {"mode": "both",
