@@ -8,6 +8,12 @@ Architecture (vs. the reference, see SURVEY.md):
     not NCCL ops.
   * The imperative mode shares the same op lowerings via an eager tracer.
 """
+# the lock-order sanitizer must patch threading BEFORE any module
+# constructs its locks, so this hook runs first (no-op unless
+# FLAGS_debug_lock_order is set in the environment)
+from . import locksan as _locksan  # noqa: E402
+_locksan.install_from_flag()
+
 from . import ops  # registers the operator library
 from .framework.core import (Program, Variable, Parameter, OpRole,  # noqa
                              default_main_program, default_startup_program,

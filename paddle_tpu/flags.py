@@ -317,6 +317,15 @@ register_flag("FLAGS_fleet_max_restarts", 3,
               "accounting); past the budget the replica stays down and "
               "fleet_replicas_live drops.  Rolling-restart respawns "
               "are planned exits and do not count")
+register_flag("FLAGS_debug_lock_order", False,
+              "runtime lock-order sanitizer (paddle_tpu/locksan.py): "
+              "wrap every threading.Lock/RLock constructed after "
+              "import in an order-recording shim, assert the observed "
+              "per-thread acquisition graph stays acyclic, and record "
+              "inversions in locksan.violations().  Debug/test only: "
+              "costs a thread-local append per acquire plus a graph "
+              "check on nested acquires; 0 (default) patches nothing "
+              "and costs nothing")
 register_flag("FLAGS_fleet_restart_backoff_ms", 200.0,
               "fleet supervisor: base crash-respawn backoff; doubles "
               "per consecutive crash of the same replica (capped at "

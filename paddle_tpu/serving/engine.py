@@ -400,8 +400,9 @@ class ServingEngine:
         warmup) the shape buckets are compiled + primed.  Surfaces as
         the ``ready`` field in ``/healthz`` — the fleet router refuses
         to place traffic on a replica until this flips true."""
-        if self._draining or self._closed:
-            return False
+        with self._cv:  # _draining/_closed are written under _cv
+            if self._draining or self._closed:
+                return False
         return self._warmed or not self._ready_requires_warmup
 
     def start(self):
@@ -471,8 +472,9 @@ class ServingEngine:
         if self._hbm_sampling:
             self._hbm_sampling = False
             observatory.stop_hbm_sampler()
-        telemetry.log_event("serving_drained", served=self._n["served"],
-                            shed=self._n["shed"])
+        with self._n_lock:
+            served, shed_n = self._n["served"], self._n["shed"]
+        telemetry.log_event("serving_drained", served=served, shed=shed_n)
         telemetry.flush()
 
     def __enter__(self):
@@ -1151,6 +1153,7 @@ class ServingEngine:
         with self._cv:
             depth = len(self._queue)
             peak = self._peak_depth
+            draining = self._draining
         return {
             "queue_depth": depth,
             "inflight_rows": inflight,
@@ -1158,7 +1161,7 @@ class ServingEngine:
             "queue_cap": self.queue_cap,
             "workers": self.workers,
             "buckets": list(self.buckets),
-            "draining": self._draining,
+            "draining": draining,
             "counters": n,
             "groups_degraded": self.groups_degraded(),
             "bucket_hit_rate": round(
@@ -1221,13 +1224,19 @@ class ServingEngine:
             # other groups are healthy), but a balancer and an operator
             # must see the damage
             status = "degraded"
-        if self._draining:
+        with self._cv:
+            draining, closed = self._draining, self._closed
+        if draining:
             status = "draining"
-        if self._closed:
+        if closed:
             status = "closed"
+        # ready computed from the SAME snapshot as status (a second
+        # ready() would re-take _cv and could disagree mid-close)
+        ready = not (draining or closed) and (
+            self._warmed or not self._ready_requires_warmup)
         out = {
             "status": status,
-            "ready": self.ready(),
+            "ready": ready,
             "pid": os.getpid(),
             "time": time.time(),
             "uptime_s": round(time.time() - self._started, 3),

@@ -154,7 +154,11 @@ class FleetSupervisor:
         self._replicas = [
             _Replica(i, os.path.join(self.workdir, f"replica-{i}"))
             for i in range(self.n)]
-        self._closing = False
+        # an Event, not a lock-guarded bool: the monitor/liveness loop
+        # headers poll it every cycle, and an Event read is race-free
+        # WITHOUT contending the supervisor lock (which rolling
+        # restarts hold across whole replica drains)
+        self._closing = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self._liveness: Optional[threading.Thread] = None
         self._started = time.time()
@@ -261,10 +265,10 @@ class FleetSupervisor:
 
     # -- crash monitor ------------------------------------------------------
     def _monitor_loop(self):
-        while not self._closing:
+        while not self._closing.is_set():
             time.sleep(_MONITOR_POLL_S)
             with self._lock:
-                if self._closing:
+                if self._closing.is_set():
                     return
                 for rep in self._replicas:
                     self._check_one(rep)
@@ -313,13 +317,13 @@ class FleetSupervisor:
         SIGKILL — the crash monitor then respawns it with the normal
         backoff/budget accounting."""
         interval = max(0.2, self._liveness_s / 4.0)
-        while not self._closing:
+        while not self._closing.is_set():
             time.sleep(interval)
-            if self._closing:
+            if self._closing.is_set():
                 return
             for rep in self._replicas:
                 with self._lock:
-                    skip = (self._closing or rep.in_rollout
+                    skip = (self._closing.is_set() or rep.in_rollout
                             or rep.failed or rep.proc is None
                             or rep.respawn_at is not None
                             or rep.url is None
@@ -333,7 +337,7 @@ class FleetSupervisor:
                 h = _healthz(url, timeout=min(1.0, interval))
                 now = time.monotonic()
                 with self._lock:
-                    if (self._closing or rep.in_rollout
+                    if (self._closing.is_set() or rep.in_rollout
                             or rep.proc is not proc
                             or proc.poll() is not None):
                         # the life this poll measured is gone (crash
@@ -435,9 +439,9 @@ class FleetSupervisor:
 
     def close(self, timeout_s: float = 30.0):
         with self._lock:
-            if self._closing:
+            if self._closing.is_set():
                 return
-            self._closing = True
+            self._closing.set()
         if self._monitor is not None:
             self._monitor.join(timeout=5.0)
         if self._liveness is not None:

@@ -683,8 +683,10 @@ class GenerationEngine:
             self._shed(req, "draining")
         if self._thread is not None:
             self._thread.join(timeout)
+        with self._n_lock:
+            served, shed_n = self._n["served"], self._n["shed"]
         telemetry.log_event("generation_drained",
-                            served=self._n["served"], shed=self._n["shed"])
+                            served=served, shed=shed_n)
 
     def __enter__(self):
         return self
@@ -1365,6 +1367,7 @@ class GenerationEngine:
         with self._cv:
             depth = len(self._queue)
             active = len(self._active())
+            draining = self._draining
         return {
             "queue_depth": depth,
             "queue_cap": self.queue_cap,
@@ -1394,7 +1397,7 @@ class GenerationEngine:
             "mesh": None if self.mesh is None
             else _describe_mesh(self.mesh),
             "kv_shard_axis": getattr(self, "kv_shard_axis", None),
-            "draining": self._draining,
+            "draining": draining,
             "counters": n,
             "tokens_per_request": round(
                 n["generated_tokens"] / max(n["served"], 1), 2),

@@ -693,6 +693,8 @@ class Router:
         reps = self._all()
         routable = [r for r in reps if r.ready()]
         status = "ok" if routable else "no_ready_replicas"
+        with self._lock:  # _autoscale is recomputed under _lock
+            auto = dict(self._autoscale)
         return (200 if routable else 503), {
             "status": status,
             "pid": os.getpid(),
@@ -700,7 +702,7 @@ class Router:
             "uptime_s": round(time.time() - self._started, 3),
             "replicas": len(reps),
             "routable": len(routable),
-            "autoscale": dict(self._autoscale),
+            "autoscale": auto,
         }
 
     def statusz(self) -> dict:

@@ -148,16 +148,38 @@ def test_deadline_triggered_partial_batch_bit_exact(small_model):
 
 
 def test_concurrent_submitters_get_batched(small_model):
+    """Also the runtime lock-order sanitizer's serving leg
+    (FLAGS_debug_lock_order semantics): the engine's locks are
+    constructed under locksan, the full submit/dispatch/respond
+    traffic runs order-checked, and the observed acquisition graph
+    must stay acyclic — zero recorded inversions."""
+    from paddle_tpu import locksan
+
     p, xs = small_model
-    with ServingEngine(p, workers=2, max_batch=8, max_delay_ms=5.0,
-                       deadline_ms=60000) as eng:
-        futs = [eng.submit({"x": xs[i:i + 1]}) for i in range(32)]
-        ref = p.run({"x": xs[:32]})[0]
-        for i, f in enumerate(futs):
-            assert np.array_equal(f.result(60)[0], ref[i:i + 1])
-        stats = eng.stats()
-        assert stats["counters"]["batches"] < stats["counters"]["requests"]
-        assert stats["counters"]["requests"] == 32
+    # an env-enabled session sanitizer (FLAGS_debug_lock_order=1) is
+    # left exactly as found: no clearing its accumulated state, no
+    # disabling it afterwards — this leg only asserts it recorded
+    # nothing NEW
+    was_enabled = locksan.enabled()
+    before = locksan.violations()
+    if not was_enabled:
+        locksan.clear_violations()
+        locksan.enable(raise_on_violation=False)
+    try:
+        with ServingEngine(p, workers=2, max_batch=8, max_delay_ms=5.0,
+                           deadline_ms=60000) as eng:
+            futs = [eng.submit({"x": xs[i:i + 1]}) for i in range(32)]
+            ref = p.run({"x": xs[:32]})[0]
+            for i, f in enumerate(futs):
+                assert np.array_equal(f.result(60)[0], ref[i:i + 1])
+            stats = eng.stats()
+            assert stats["counters"]["batches"] \
+                < stats["counters"]["requests"]
+            assert stats["counters"]["requests"] == 32
+    finally:
+        if not was_enabled:
+            locksan.disable()
+    assert locksan.violations() == ([] if not was_enabled else before)
 
 
 def test_feed_validation(small_model):

@@ -1,0 +1,2 @@
+# tools/ is a package so `python -m tools.graftcheck` works; the
+# standalone scripts in here remain directly runnable by path.

@@ -1,66 +1,51 @@
 #!/usr/bin/env python
-"""Lint: fail on ``except ...: pass`` handlers that silently swallow the
-failure.
+"""Lint: fail on ``except ...: pass`` handlers that silently swallow
+the failure.
 
-A robustness regression shipped exactly this way once: checkpoint.py's
-orbax path fell back to pickle under a bare ``except Exception: pass``,
-hiding every storage error.  This gate rejects any handler whose body is
-a lone ``pass`` unless the except/pass line carries an explicit waiver
-comment ``# ok: <reason>`` (for genuinely-expected control flow, e.g.
-``except StopIteration``).  Handlers that log or bump a monitor stat have
-a multi-statement body and pass automatically.
+THIN SHIM: the analysis lives in graftcheck
+(``tools/graftcheck/passes/exception_policy.py``, rule
+``bare-except-pass``) — this CLI remains so existing docs/commands
+keep working.  Prefer::
 
-Usage: python tools/check_no_bare_pass.py [root ...]   (default: paddle_tpu)
+    python -m tools.graftcheck --rule exception-policy
+
+Handlers whose body is a lone ``pass`` must log, bump a monitor stat,
+or carry an explicit waiver comment ``# ok: <reason>``.
+
+Usage: python tools/check_no_bare_pass.py [root ...] (default: paddle_tpu)
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-WAIVER = "# ok:"
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-
-def check_file(path: str):
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, path)
-    except SyntaxError as e:
-        return [(path, e.lineno or 0, f"syntax error: {e.msg}")]
-    lines = src.splitlines()
-    bad = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        if len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
-            waived = any(WAIVER in lines[ln - 1]
-                         for ln in (node.lineno, node.body[0].lineno)
-                         if 0 < ln <= len(lines))
-            if not waived:
-                bad.append((path, node.lineno,
-                            "`except: pass` swallows the failure -- log "
-                            "it, bump a monitor stat, or waive with "
-                            "`# ok: <reason>`"))
-    return bad
+from tools.graftcheck import core  # noqa: E402
 
 
 def main(*roots: str) -> int:
     roots = roots or ("paddle_tpu",)
-    bad = []
-    for root in roots:
-        if os.path.isfile(root):
-            bad += check_file(root)
-            continue
-        for dirpath, _dirs, files in os.walk(root):
-            for name in sorted(files):
-                if name.endswith(".py"):
-                    bad += check_file(os.path.join(dirpath, name))
-    for path, lineno, msg in bad:
-        print(f"{path}:{lineno}: {msg}")
-    if bad:
-        print(f"{len(bad)} bare `except: pass` handler(s) found")
-    return 1 if bad else 0
+    # one code path with `python -m tools.graftcheck`: syntax errors
+    # fail the gate (an unparseable file could hide any number of
+    # handlers), and gc-ok/baseline waivers apply identically — the
+    # shim and the framework CLI must never disagree
+    try:
+        report = core.run(roots=roots,
+                          rule_filter=["exception-policy"])
+    except FileNotFoundError as e:
+        print(f"check_no_bare_pass: {e}", file=sys.stderr)
+        return 2
+    for v in report.violations:
+        print(v.render())
+    n_rule = sum(v.rule == "bare-except-pass"
+                 for v in report.violations)
+    extra = len(report.violations) - n_rule
+    if report.violations:
+        print(f"{n_rule} bare `except: pass` handler(s) found"
+              + (f" (+{extra} other finding(s))" if extra else ""))
+    return 0 if report.ok else 1
 
 
 if __name__ == "__main__":

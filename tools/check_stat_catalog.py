@@ -1,29 +1,17 @@
 #!/usr/bin/env python
-"""Lint: every metric name used in paddle_tpu/ must be documented.
+"""Lint: every metric name used in paddle_tpu/ must be documented —
+plus the strict Prometheus text-exposition validator.
 
-Counters, gauges, and histograms are only useful if an operator can
-find out what they mean — and names drift silently: a renamed stat
-breaks every dashboard reading the old one with no test failing.  This
-gate extracts every *literal* metric name passed to the monitor /
-telemetry APIs and requires each to appear (backtick-quoted) in the
-README's stat catalog ("Observability" section).
+THIN SHIM: the analysis lives in graftcheck
+(``tools/graftcheck/passes/stat_catalog.py``, rule
+``stat-undocumented``) — this CLI remains so existing docs/commands
+keep working.  Prefer::
 
-Recognized call shapes (first argument must be a string literal;
-dynamic f-string names like ``fault_<site>_<kind>`` are out of scope):
+    python -m tools.graftcheck --rule stat-catalog
 
-* bare calls:      ``stat_add(n)``, ``stat_get(n)``, ``gauge_set(n, v)``,
-                   ``histogram_observe(n, v)``
-* monitor handles: ``monitor.get(n)`` / ``_monitor.get(n)``
-* telemetry attrs: ``telemetry.gauge_set/histogram_observe/timer(n)``
-* registry attrs:  ``metrics.gauge/histogram/timer(n)``
-
-This tool also owns the strict Prometheus text-exposition validator
-(:func:`validate_exposition`): the serving ``/metrics`` endpoint and
-the ``metrics.prom`` textfile claim the format, so tier-1
-(``tests/test_lint.py``) scrapes a live ``/metrics`` response and
-fails the build on any violation — missing/duplicated ``# HELP`` /
-``# TYPE`` lines, bad metric-name charset, malformed samples, or
-duplicate series.
+``--validate-prom`` validates a Prometheus exposition file (a
+``/metrics`` scrape or ``metrics.prom``); findings carry ``file:line``
+provenance in the shared graftcheck violation format.
 
 Usage: python tools/check_stat_catalog.py [--readme README.md] [--list]
        [--validate-prom FILE]  [root ...]   (default root: paddle_tpu)
@@ -31,206 +19,18 @@ Usage: python tools/check_stat_catalog.py [--readme README.md] [--list]
 from __future__ import annotations
 
 import argparse
-import ast
 import os
-import re
 import sys
 
-BARE_FUNCS = {"stat_add", "stat_get", "gauge_set", "histogram_observe"}
-TELEMETRY_ATTRS = {"gauge_set", "histogram_observe", "timer"}
-REGISTRY_ATTRS = {"gauge", "histogram", "timer"}
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-
-def _first_str_arg(node: ast.Call):
-    if node.args and isinstance(node.args[0], ast.Constant) \
-            and isinstance(node.args[0].value, str):
-        return node.args[0].value
-    return None
-
-
-def _value_id(node) -> str:
-    """Best-effort identifier of an attribute's object ('telemetry',
-    '_monitor', 'self._metrics' -> '_metrics', ...)."""
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    return ""
-
-
-def extract_names(path: str):
-    """(name, path, lineno) for every literal metric name in one file."""
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, path)
-    except SyntaxError as e:
-        raise SystemExit(f"{path}:{e.lineno}: syntax error: {e.msg}")
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        hit = False
-        if isinstance(func, ast.Name) and func.id in BARE_FUNCS:
-            hit = True
-        elif isinstance(func, ast.Attribute):
-            # exact-id match (modulo leading underscores for module
-            # aliases like `_monitor`): a substring match would drag in
-            # ordinary dict .get() calls on unrelated names
-            vid = _value_id(func.value).lstrip("_")
-            if func.attr == "get" and vid == "monitor":
-                hit = True
-            elif func.attr in TELEMETRY_ATTRS and vid == "telemetry":
-                hit = True
-            elif func.attr in REGISTRY_ATTRS and vid == "metrics":
-                hit = True
-        if not hit:
-            continue
-        name = _first_str_arg(node)
-        if name is not None:
-            out.append((name, path, node.lineno))
-    return out
-
-
-# ---------------------------------------------------------------------------
-# strict Prometheus text-exposition validation
-# ---------------------------------------------------------------------------
-
-PROM_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
-PROM_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
-_SAMPLE_RE = re.compile(
-    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"           # metric name
-    r"(\{[^{}]*\})?"                          # optional {labels}
-    r" (-?(?:[0-9.eE+-]+|\+?Inf|-Inf|NaN))"   # value (one space before)
-    r"( [0-9]+)?$")                           # optional ms timestamp
-_LABELS_RE = re.compile(
-    r'^\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
-    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*,?)?\}$')
-
-
-def _family_of(name: str, typed: dict) -> str:
-    """Map a histogram/summary component sample back to its family
-    (``x_bucket``/``x_sum``/``x_count`` -> ``x`` when ``x`` is typed
-    histogram or summary)."""
-    for suffix in ("_bucket", "_sum", "_count"):
-        if name.endswith(suffix):
-            base = name[: -len(suffix)]
-            if typed.get(base) in ("histogram", "summary"):
-                return base
-    return name
-
-
-def validate_exposition(text: str):
-    """Strictly validate Prometheus text exposition format.  Returns a
-    list of ``"line N: problem"`` strings (empty = valid).
-
-    Enforced: every non-comment line is a well-formed sample
-    (``name{labels} value [timestamp]``); metric names match the
-    Prometheus charset; every sample's family carries ``# HELP`` and
-    ``# TYPE`` lines that PRECEDE its samples; at most one HELP/TYPE
-    per family; TYPE values are real Prometheus types; no duplicate
-    series (same name + label set); histogram families expose
-    ``_bucket``/``_sum``/``_count`` with a ``+Inf`` bucket."""
-    errors = []
-    helped: dict = {}
-    typed: dict = {}
-    sampled_families = set()
-    seen_series = {}
-    bucket_infs = {}
-
-    for lineno, line in enumerate(text.splitlines(), 1):
-        def err(msg):
-            errors.append(f"line {lineno}: {msg} -- {line[:80]!r}")
-
-        if not line.strip():
-            continue
-        if line.startswith("#"):
-            parts = line.split(None, 3)
-            kind = parts[1] if len(parts) > 1 else ""
-            if kind not in ("HELP", "TYPE"):
-                continue  # free-form comment: allowed
-            if len(parts) < 3:
-                err(f"{kind} line without a metric name")
-                continue
-            name = parts[2]
-            if not PROM_NAME_RE.match(name):
-                err(f"bad metric name {name!r} in {kind} line")
-                continue
-            book = helped if kind == "HELP" else typed
-            if name in book:
-                err(f"duplicate # {kind} for {name}")
-            if kind == "HELP":
-                if len(parts) < 4 or not parts[3].strip():
-                    err(f"HELP for {name} has empty docstring")
-                helped.setdefault(name, lineno)
-            else:
-                t = parts[3].strip() if len(parts) > 3 else ""
-                if t not in PROM_TYPES:
-                    err(f"TYPE for {name} is {t!r}, not one of "
-                        f"{sorted(PROM_TYPES)}")
-                typed.setdefault(name, t)
-                if name in sampled_families:
-                    err(f"# TYPE for {name} appears after its samples")
-            continue
-        m = _SAMPLE_RE.match(line)
-        if not m:
-            err("malformed sample line (want 'name{labels} value "
-                "[timestamp]', single spaces)")
-            continue
-        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
-        if labels and not _LABELS_RE.match(labels):
-            err(f"malformed label set {labels!r}")
-        try:
-            float(value.replace("Inf", "inf").replace("NaN", "nan"))
-        except ValueError:
-            err(f"unparseable sample value {value!r}")
-        series = (name, labels)
-        if series in seen_series:
-            err(f"duplicate series {name}{labels} (first at line "
-                f"{seen_series[series]})")
-        else:
-            seen_series[series] = lineno
-        fam = _family_of(name, typed)
-        sampled_families.add(fam)
-        if fam not in typed:
-            err(f"sample for {name} with no preceding # TYPE {fam}")
-        elif fam not in helped:
-            err(f"sample for {name} with no # HELP {fam}")
-        if typed.get(fam) == "histogram" and name == fam + "_bucket":
-            if 'le="+Inf"' in labels:
-                bucket_infs[fam] = True
-            bucket_infs.setdefault(fam, False)
-
-    for fam, has_inf in sorted(bucket_infs.items()):
-        if not has_inf:
-            errors.append(f"histogram {fam} has no le=\"+Inf\" bucket")
-    for fam in sorted(f for f, t in typed.items() if t == "histogram"):
-        if fam in sampled_families:
-            for part in ("_sum", "_count"):
-                if (fam + part, "") not in seen_series:
-                    errors.append(f"histogram {fam} is missing "
-                                  f"{fam}{part}")
-    return errors
-
-
-CATALOG_MARKER = "**Stat catalog**"
-
-
-def catalog_names(readme_path: str) -> set:
-    """Backtick-quoted identifiers in the README's stat-catalog section
-    (from the CATALOG_MARKER to the next `## ` heading).  Scoping to
-    the catalog matters: a metric name that happens to collide with any
-    backticked word elsewhere in the README (a flag, a heartbeat field)
-    must not pass as documented.  Falls back to the whole file when the
-    marker is absent (minimal/test READMEs)."""
-    with open(readme_path, encoding="utf-8") as f:
-        text = f.read()
-    start = text.find(CATALOG_MARKER)
-    if start >= 0:
-        end = text.find("\n## ", start)
-        text = text[start:end if end >= 0 else len(text)]
-    return set(re.findall(r"`([A-Za-z_][A-Za-z0-9_]*)`", text))
+from tools.graftcheck import core  # noqa: E402
+from tools.graftcheck.core import walk_files  # noqa: E402
+from tools.graftcheck.passes import stat_catalog as _sc  # noqa: E402
+from tools.graftcheck.passes.stat_catalog import (  # noqa: E402,F401
+    catalog_names, extract_names, extract_names_from_tree,
+    validate_exposition, validate_exposition_violations)
 
 
 def main(argv=None) -> int:
@@ -247,44 +47,49 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.validate_prom:
         if args.validate_prom == "-":
-            text = sys.stdin.read()
+            text, src = sys.stdin.read(), "<stdin>"
         else:
             with open(args.validate_prom, encoding="utf-8") as f:
                 text = f.read()
-        errs = validate_exposition(text)
-        for e in errs:
-            print(e)
+            src = args.validate_prom
+        errs = validate_exposition_violations(text, src)
+        for v in errs:
+            print(v.render())
         if errs:
             print(f"{len(errs)} exposition-format violation(s)")
         return 1 if errs else 0
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     roots = args.roots or [os.path.join(here, "paddle_tpu")]
-    readme = args.readme or os.path.join(here, "README.md")
 
-    found = []
-    for root in roots:
-        if os.path.isfile(root):
-            found += extract_names(root)
-            continue
-        for dirpath, _dirs, files in os.walk(root):
-            for name in sorted(files):
-                if name.endswith(".py"):
-                    found += extract_names(os.path.join(dirpath, name))
     if args.list:
-        for n in sorted({n for n, _, _ in found}):
+        found = set()
+        for sf in walk_files(roots):
+            if sf.tree is None:
+                raise SystemExit(f"{sf.path}:{sf.parse_error.lineno}: "
+                                 f"syntax error: {sf.parse_error.msg}")
+            found |= {n for n, _ in extract_names_from_tree(sf.tree)}
+        for n in sorted(found):
             print(n)
         return 0
 
-    documented = catalog_names(readme)
-    missing = sorted({(n, p, ln) for n, p, ln in found
-                      if n not in documented})
-    for n, p, ln in missing:
-        print(f"{p}:{ln}: metric {n!r} is not in the README stat "
-              f"catalog ({os.path.basename(readme)}) -- document it "
-              f"(backtick-quoted) or rename it to a documented one")
-    if missing:
-        print(f"{len(missing)} undocumented metric name use(s)")
-    return 1 if missing else 0
+    # one code path with `python -m tools.graftcheck`: gc-ok/baseline
+    # waivers and syntax-error handling apply identically
+    if args.readme:
+        _sc.README_PATH = args.readme
+    try:
+        report = core.run(roots=roots, rule_filter=["stat-catalog"])
+    except FileNotFoundError as e:
+        print(f"check_stat_catalog: {e}", file=sys.stderr)
+        return 2
+    for v in report.violations:
+        print(v.render())
+    n_rule = sum(v.rule == "stat-undocumented"
+                 for v in report.violations)
+    extra = len(report.violations) - n_rule
+    if report.violations:
+        print(f"{n_rule} undocumented metric name use(s)"
+              + (f" (+{extra} other finding(s))" if extra else ""))
+    return 0 if report.ok else 1
 
 
 if __name__ == "__main__":

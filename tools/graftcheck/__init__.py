@@ -1,0 +1,14 @@
+"""graftcheck: unified AST-based static analysis for this repo.
+
+One framework (``tools/graftcheck/core.py``), pluggable passes
+(``tools/graftcheck/passes/``), one violation format
+(``file:line rule-id message``), one waiver/baseline mechanism, one
+CLI::
+
+    python -m tools.graftcheck [--rule PASS-OR-RULE] [--json] [roots...]
+
+Wired into tier-1 via ``tests/test_lint.py``; the full rule catalog
+with triggering examples lives in README "Static analysis".
+"""
+from .core import (DEFAULT_ROOTS, Report, Violation, all_passes, main,  # noqa: F401
+                   run)

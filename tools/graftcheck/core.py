@@ -1,0 +1,380 @@
+"""graftcheck core: shared file walking, parsed-AST caching, the
+violation format, waiver machinery, and the pass runner.
+
+Every pass is a function ``(files: List[SourceFile]) -> List[Violation]``
+registered in :mod:`tools.graftcheck.passes`.  Violations share one
+format everywhere (CLI text, ``--json``, the baseline file)::
+
+    file:line rule-id message
+
+and carry a stable ``key`` (a symbol path like
+``serving/generation.py::GenerationEngine._draining``) so waivers
+survive line drift.
+
+Waivers, two layers:
+
+* **inline** — a violation whose source line (or the line above it)
+  carries ``# gc-ok: <rule-id> <reason>`` (or ``# gc-ok: *``) is
+  suppressed; the reason is mandatory.
+* **baseline file** (``tools/graftcheck/baseline.txt``) — one waiver
+  per line: ``rule-id  path  key  -- reason``.  Matching is on
+  (rule, path, key), never on line numbers.  A baseline entry that no
+  longer matches anything is itself reported (``stale-waiver``) so the
+  file can only shrink as findings are fixed.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_ROOTS = ("paddle_tpu", "tools")
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.txt")
+_INLINE_WAIVER_RE = re.compile(r"#\s*gc-ok:\s*(\S+)\s*(.*)")
+
+
+def call_name(call: "ast.Call") -> str:
+    """Terminal name of a call's function: ``f(...)`` -> ``f``,
+    ``a.b.f(...)`` -> ``f`` (the shared helper every pass matches
+    API calls with)."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str          # rule id, e.g. "lock-bare-access"
+    path: str          # repo-relative, forward slashes
+    line: int
+    key: str           # stable symbol path for waiver matching
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "key": self.key, "message": self.message}
+
+    def sort_key(self):
+        return (self.path, self.line, self.rule, self.key, self.message)
+
+
+class SourceFile:
+    """One parsed source file, shared across passes (parse once)."""
+
+    def __init__(self, abspath: str, relpath: str):
+        self.abspath = abspath
+        self.path = relpath.replace(os.sep, "/")
+        with open(abspath, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(self.text, abspath)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = e
+
+    def line_text(self, lineno: int) -> str:
+        if 0 < lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def inline_waiver(self, lineno: int, rule: str) -> bool:
+        """``# gc-ok: <rule> <reason>`` (or ``* <reason>``) on the
+        line or the line above suppresses a finding there.  The
+        reason is mandatory, exactly like baseline entries: a
+        reason-less waiver does not waive."""
+        for ln in (lineno, lineno - 1):
+            m = _INLINE_WAIVER_RE.search(self.line_text(ln))
+            if m and m.group(1) in (rule, "*") and m.group(2).strip():
+                return True
+        return False
+
+
+def walk_files(roots: Sequence[str], repo: str = REPO,
+               exclude: Sequence[str] = ()) -> List[SourceFile]:
+    """Every ``.py`` under the given roots, sorted by repo-relative
+    path so output order is deterministic.  Relative roots resolve
+    against the CURRENT directory first (the historical shim-CLI
+    behavior), falling back to the repo root (so the default
+    ``paddle_tpu tools`` roots work from anywhere).  A root that
+    exists in neither place is an error: a mistargeted lint that
+    silently scans zero files is a false green."""
+    out: List[SourceFile] = []
+    seen = set()
+    for root in roots:
+        if os.path.isabs(root):
+            absroot = root
+        elif os.path.exists(os.path.abspath(root)):
+            absroot = os.path.abspath(root)
+        else:
+            absroot = os.path.join(repo, root)
+        if not os.path.exists(absroot):
+            raise FileNotFoundError(
+                f"graftcheck root not found: {root!r} (neither "
+                f"{os.path.abspath(root)} nor {absroot})")
+        if os.path.isfile(absroot):
+            paths = [absroot]
+        else:
+            paths = []
+            for dirpath, dirs, files in os.walk(absroot):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__",))
+                paths += [os.path.join(dirpath, f) for f in sorted(files)
+                          if f.endswith(".py")]
+        for p in paths:
+            rel = os.path.relpath(p, repo).replace(os.sep, "/")
+            if rel in seen:
+                continue
+            if any(fnmatch.fnmatch(rel, pat) for pat in exclude):
+                continue
+            seen.add(rel)
+            out.append(SourceFile(p, rel))
+    out.sort(key=lambda sf: sf.path)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline (waiver) file
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Waiver:
+    rule: str
+    path: str
+    key: str
+    reason: str
+    lineno: int
+    used: bool = False
+
+    def matches(self, v: Violation) -> bool:
+        return (self.rule in (v.rule, "*") and self.path == v.path
+                and fnmatch.fnmatch(v.key, self.key))
+
+
+def load_baseline(path: str) -> Tuple[List[Waiver], List[Violation]]:
+    """Parse the baseline file.  Format errors (a waiver without a
+    ``--``-separated reason) are violations themselves: an exception
+    with no recorded justification is indistinguishable from a
+    forgotten bug."""
+    waivers: List[Waiver] = []
+    errors: List[Violation] = []
+    if not os.path.exists(path):
+        return waivers, errors
+    rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+    with open(path, encoding="utf-8") as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            head, sep, reason = line.partition("--")
+            parts = head.split()
+            if len(parts) != 3 or not sep or not reason.strip():
+                errors.append(Violation(
+                    "baseline-format", rel, lineno, f"line{lineno}",
+                    "baseline entries are 'rule-id path key -- reason' "
+                    f"(got {line[:60]!r})"))
+                continue
+            waivers.append(Waiver(parts[0], parts[1], parts[2],
+                                  reason.strip(), lineno))
+    return waivers, errors
+
+
+# ---------------------------------------------------------------------------
+# pass registry + runner
+# ---------------------------------------------------------------------------
+
+PassFn = Callable[[List[SourceFile]], List[Violation]]
+
+
+@dataclass
+class Pass:
+    name: str           # pass name for --rule selection
+    rules: Tuple[str, ...]  # rule ids this pass can emit
+    fn: PassFn
+    doc: str = ""
+
+
+_PASSES: Dict[str, Pass] = {}
+
+
+def register_pass(name: str, rules: Sequence[str], doc: str = ""):
+    def deco(fn: PassFn) -> PassFn:
+        _PASSES[name] = Pass(name, tuple(rules), fn, doc)
+        return fn
+    return deco
+
+
+def all_passes() -> Dict[str, Pass]:
+    # import for side effect: the passes package registers on import
+    from . import passes  # noqa: F401
+    return dict(_PASSES)
+
+
+def run(roots: Sequence[str] = DEFAULT_ROOTS,
+        rule_filter: Optional[Sequence[str]] = None,
+        baseline_path: Optional[str] = DEFAULT_BASELINE,
+        repo: str = REPO,
+        exclude: Sequence[str] = ()) -> "Report":
+    """Run the selected passes over the tree and apply waivers.
+
+    ``rule_filter`` selects by pass name OR rule id.  Returns a
+    :class:`Report`; ``report.violations`` is what should fail a build.
+    """
+    passes = all_passes()
+    selected = []
+    if rule_filter:
+        wanted = set(rule_filter)
+        for p in passes.values():
+            if p.name in wanted or wanted.intersection(p.rules):
+                selected.append(p)
+        unknown = wanted - {p.name for p in passes.values()} \
+            - {r for p in passes.values() for r in p.rules}
+        if unknown:
+            raise ValueError(f"unknown rule(s)/pass(es): {sorted(unknown)}; "
+                             f"known passes: {sorted(passes)}")
+    else:
+        selected = list(passes.values())
+    selected.sort(key=lambda p: p.name)
+
+    files = walk_files(roots, repo=repo, exclude=exclude)
+    raw: List[Violation] = []
+    for sf in files:
+        if sf.parse_error is not None:
+            raw.append(Violation("syntax-error", sf.path,
+                                 sf.parse_error.lineno or 0, "syntax",
+                                 f"syntax error: {sf.parse_error.msg}"))
+    by_path = {sf.path: sf for sf in files}
+    for p in selected:
+        raw += p.fn(files)
+
+    waivers: List[Waiver] = []
+    if baseline_path:
+        waivers, berrs = load_baseline(baseline_path)
+        raw += berrs
+
+    kept: List[Violation] = []
+    waived: List[Tuple[Violation, str]] = []
+    for v in raw:
+        sf = by_path.get(v.path)
+        if sf is not None and sf.inline_waiver(v.line, v.rule):
+            waived.append((v, "inline gc-ok"))
+            continue
+        w = next((w for w in waivers if w.matches(v)), None)
+        if w is not None:
+            w.used = True
+            waived.append((v, w.reason))
+            continue
+        kept.append(v)
+    # a waiver nothing matched is dead weight — or a typo silently
+    # disarming a real rule; only enforced when its rule actually ran
+    # AND its target file was in this scan (a subset-root run cannot
+    # prove an out-of-scope waiver stale)
+    ran_rules = {r for p in selected for r in p.rules}
+    for w in waivers:
+        if not w.used and w.path in by_path \
+                and (w.rule in ran_rules or w.rule == "*"):
+            rel = os.path.relpath(baseline_path, repo).replace(os.sep, "/")
+            kept.append(Violation(
+                "stale-waiver", rel, w.lineno,
+                f"{w.rule}:{w.key}",
+                f"baseline waiver matches nothing: {w.rule} {w.path} "
+                f"{w.key}"))
+    kept.sort(key=Violation.sort_key)
+    waived.sort(key=lambda t: t[0].sort_key())
+    return Report(kept, waived, [p.name for p in selected], len(files))
+
+
+@dataclass
+class Report:
+    violations: List[Violation]
+    waived: List[Tuple[Violation, str]]
+    passes_run: List[str]
+    files_scanned: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def render_text(self, show_waived: bool = False) -> str:
+        out = [v.render() for v in self.violations]
+        if show_waived:
+            out += [f"{v.render()}  [waived: {reason}]"
+                    for v, reason in self.waived]
+        tail = (f"{len(self.violations)} violation(s), "
+                f"{len(self.waived)} waived, "
+                f"{self.files_scanned} files, "
+                f"passes: {', '.join(self.passes_run)}")
+        return "\n".join(out + [tail])
+
+    def render_json(self) -> str:
+        # stable and sorted so CI diffs are reviewable
+        return json.dumps({
+            "violations": [v.as_dict() for v in self.violations],
+            "waived": [{**v.as_dict(), "reason": r}
+                       for v, r in self.waived],
+            "passes": sorted(self.passes_run),
+            "files_scanned": self.files_scanned,
+            "ok": self.ok,
+        }, indent=2, sort_keys=True)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftcheck",
+        description="repo-wide static analysis (see README 'Static "
+                    "analysis'): lock discipline, resource pairing, "
+                    "donation safety, flag/stat hygiene, exception "
+                    "policy")
+    ap.add_argument("roots", nargs="*", default=None,
+                    help=f"directories/files to scan (default: "
+                         f"{' '.join(DEFAULT_ROOTS)})")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this pass or rule id (repeatable, "
+                         "comma-separable)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="stable sorted JSON report on stdout")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="waiver file (empty string disables)")
+    ap.add_argument("--show-waived", action="store_true",
+                    help="also print waived findings with reasons")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list passes and their rule ids, then exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name, p in sorted(all_passes().items()):
+            print(f"{name}: {', '.join(p.rules)}")
+            if p.doc:
+                print(f"    {p.doc}")
+        return 0
+
+    rules = None
+    if args.rule:
+        rules = [r for spec in args.rule for r in spec.split(",") if r]
+    try:
+        report = run(roots=args.roots or DEFAULT_ROOTS,
+                     rule_filter=rules,
+                     baseline_path=args.baseline or None)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"graftcheck: {e}", file=sys.stderr)
+        return 2
+    sys.stdout.write((report.render_json() if args.as_json
+                      else report.render_text(args.show_waived)) + "\n")
+    return 0 if report.ok else 1

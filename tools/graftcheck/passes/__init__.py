@@ -1,0 +1,4 @@
+"""graftcheck passes.  Importing this package registers every pass
+with :mod:`tools.graftcheck.core` (see ``register_pass``)."""
+from . import (donation_safety, exception_policy, flag_hygiene,  # noqa: F401
+               lock_discipline, resource_pairing, stat_catalog)

@@ -1,0 +1,128 @@
+"""Donation-safety checker for buffer-aliasing ops.
+
+The decode ops whose output aliases an input variable
+(``kv_cache_write`` / ``kv_cache_insert`` / ``kv_pool_write`` — the
+mutated-persistable contract in ``ops/decode_ops.py``) make the
+executor *donate* the input buffer to XLA: after the call, the
+Python-side variable the caller passed in refers to a buffer XLA has
+already overwritten (or freed).  The only safe patterns are
+
+* rebinding in the same statement::
+
+      cache_k = layers.kv_cache_write(cache_k, k, positions)
+
+* never touching the donated name again.
+
+Rule ``donation-use-after-alias`` flags any *later read* of the
+donated first argument in the same function (statement order by line
+— an approximation of control flow, which is exactly right for the
+straight-line graph-builder code these ops live in).  A re-assignment
+of the name re-arms it.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from ..core import SourceFile, Violation, call_name, register_pass
+from .resource_pairing import _functions, _own_nodes
+
+# op name -> index of the donated positional argument / keyword name
+ALIAS_OPS: Dict[str, tuple] = {
+    "kv_cache_write": (0, "cache"),
+    "kv_cache_insert": (0, "cache"),
+    "kv_pool_write": (0, "pool"),
+}
+
+
+_op_name = call_name
+
+
+@register_pass(
+    "donation-safety", ("donation-use-after-alias",),
+    doc="a variable donated to an output-aliasing op (kv_cache_write "
+        "et al.) must be rebound or never read again")
+def run(files: List[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        if not any(op in sf.text for op in ALIAS_OPS):
+            continue  # cheap prefilter: few files touch aliasing ops
+        for qn, fn in _functions(sf):
+            out += _check_fn(sf, qn, fn)
+    return out
+
+
+def _check_fn(sf: SourceFile, qn: str, fn: ast.AST) -> List[Violation]:
+    out: List[Violation] = []
+    # every Store to each name, by line (rebinding re-arms the name)
+    stores: Dict[str, List[int]] = {}
+    loads: Dict[str, List[int]] = {}
+    donations: List[tuple] = []  # (name, call_line, op, rebound_same_stmt)
+
+    assigns = [n for n in _own_nodes(fn)
+               if isinstance(n, (ast.Assign, ast.AnnAssign))]
+
+    def _target_names(a) -> set:
+        """Every Name bound by an assignment, through tuple/starred
+        nesting (`cache_k, cache_v = ...` rebinds both)."""
+        targets = a.targets if isinstance(a, ast.Assign) else [a.target]
+        names = set()
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+        return names
+
+    for n in _own_nodes(fn):
+        if isinstance(n, ast.Name):
+            book = stores if isinstance(n.ctx, (ast.Store, ast.Del)) \
+                else loads
+            book.setdefault(n.id, []).append(n.lineno)
+        if isinstance(n, ast.Call):
+            op = _op_name(n)
+            if op not in ALIAS_OPS:
+                continue
+            idx, kw_name = ALIAS_OPS[op]
+            donated = None
+            if len(n.args) > idx:
+                donated = n.args[idx]
+            else:
+                for kw in n.keywords:
+                    if kw.arg == kw_name:
+                        donated = kw.value
+            if not isinstance(donated, ast.Name):
+                continue
+            rebound = any(
+                (a.value is not None
+                 and (a.value is n or _contains(a.value, n)))
+                and donated.id in _target_names(a)
+                for a in assigns)
+            donations.append((donated.id, n.lineno, op, rebound))
+
+    for name, call_line, op, rebound in donations:
+        if rebound:
+            continue
+        # a Store strictly after the call re-arms the name; any Load
+        # after the call and before the next Store is use-after-alias
+        next_store = min((ln for ln in stores.get(name, [])
+                          if ln > call_line), default=None)
+        for use in sorted(loads.get(name, [])):
+            if use <= call_line:
+                continue
+            if next_store is not None and use >= next_store:
+                break
+            out.append(Violation(
+                "donation-use-after-alias", sf.path, use,
+                f"{qn}:{name}",
+                f"{name!r} was donated to {op}() at line {call_line}; "
+                f"its buffer is aliased/dead — rebind "
+                f"(`{name} = {op}({name}, ...)`) or use the op's "
+                f"output variable"))
+            break  # one finding per donation is enough signal
+    return out
+
+
+def _contains(root: ast.AST, target: ast.AST) -> bool:
+    return any(sub is target for sub in ast.walk(root))

@@ -1,0 +1,59 @@
+"""Exception-policy pass: no silent ``except ...: pass``.
+
+The graftcheck port of ``tools/check_no_bare_pass.py`` (which remains
+as a thin CLI shim).  A handler whose body is a lone ``pass`` swallows
+the failure invisibly — the exact shape that once hid every storage
+error behind checkpoint.py's orbax fallback.  Handlers must log, bump
+a monitor stat, or carry the historical explicit waiver comment
+``# ok: <reason>`` on the except/pass line (kept for compatibility;
+``# gc-ok: bare-except-pass <reason>`` works too).
+
+Rule id: ``bare-except-pass``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import SourceFile, Violation, register_pass
+
+WAIVER = "# ok:"
+
+
+def _walk_scoped(node: ast.AST, qual: str = ""):
+    """(qualname, ExceptHandler) pairs — keys stay line-stable by
+    anchoring to the enclosing def/class path, per the baseline
+    contract."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            sub = f"{qual}.{child.name}" if qual else child.name
+            yield from _walk_scoped(child, sub)
+        else:
+            if isinstance(child, ast.ExceptHandler):
+                yield qual, child
+            yield from _walk_scoped(child, qual)
+
+
+@register_pass(
+    "exception-policy", ("bare-except-pass",),
+    doc="`except ...: pass` must log, count, or carry an explicit "
+        "`# ok: <reason>` waiver")
+def run(files: List[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for qual, node in _walk_scoped(sf.tree):
+            if len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
+                waived = any(
+                    WAIVER in sf.line_text(ln)
+                    for ln in (node.lineno, node.body[0].lineno))
+                if not waived:
+                    out.append(Violation(
+                        "bare-except-pass", sf.path, node.lineno,
+                        f"{qual or '<module>'}:except",
+                        "`except: pass` swallows the failure -- log "
+                        "it, bump a monitor stat, or waive with "
+                        "`# ok: <reason>`"))
+    return out

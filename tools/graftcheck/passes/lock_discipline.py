@@ -1,0 +1,524 @@
+"""Lock-discipline race detector + lock-acquisition-order checker.
+
+Two rules over every class in the tree:
+
+``lock-bare-access``
+    Per class, infer which ``self._*`` attributes the author considers
+    lock-protected: any attribute *written or mutated* inside a
+    ``with self._lock:``-style block (outside construction).  Then
+    flag every access (read, write, or mutation) of such an attribute
+    performed with **no lock held** in another non-construction method
+    — but only for classes that actually run threads (a
+    ``threading.Thread(...)`` constructed anywhere in the class, or an
+    explicit ``# graftcheck: threaded`` marker on the class line).
+    Construction-phase methods (``__init__`` plus methods reachable
+    *only* from construction-phase methods within the class) are
+    exempt on both sides: nothing races before the first thread
+    starts.  Accesses inside nested functions/lambdas are ignored on
+    both sides (their execution context is unknowable statically).
+
+``lock-order``
+    Build the lock-acquisition graph: an edge A -> B for every site
+    that acquires B while holding A — directly nested ``with`` blocks,
+    plus one level of interprocedural closure inside the class (a call
+    ``self.m()`` while holding A contributes edges to every lock ``m``
+    acquires, transitively through intra-class calls).  Any edge that
+    participates in a cycle is a deadlock-potential finding, as is a
+    *directly nested* re-acquisition of the same non-reentrant
+    ``threading.Lock``.
+
+Lock identity is the *creation site* ``Class.attr`` (or a
+module-level name), not the instance: two instances of the same class
+interleaving A->B and B->A is exactly the deadlock this catches.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import SourceFile, Violation, register_pass
+
+LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition",
+              "Semaphore": "Semaphore",
+              "BoundedSemaphore": "Semaphore"}
+# reentrant kinds: directly nested re-acquisition is legal
+REENTRANT = {"RLock", "Condition"}
+MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+            "pop", "popleft", "popitem", "remove", "discard", "clear",
+            "update", "add", "setdefault", "sort", "reverse",
+            "move_to_end"}
+THREADED_MARKER = "# graftcheck: threaded"
+# pseudo-lock representing "the caller holds the class lock" (the
+# `*_locked`-suffix method convention)
+CALLER_HELD = "<caller-held>"
+
+
+def _short(lock_id: str) -> str:
+    """Human form of a path-qualified lock id for messages (the path
+    is already in the violation's location)."""
+    if lock_id == CALLER_HELD:
+        return "a caller-held lock (*_locked convention)"
+    return lock_id.split("::", 1)[-1]
+
+
+def _lock_ctor_kind(node: ast.AST) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    return LOCK_CTORS.get(name or "")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@dataclass
+class Access:
+    attr: str
+    kind: str            # "read" | "write" | "mutate"
+    line: int
+    held: Optional[frozenset]  # None = unknown context (nested func)
+    method: str
+
+
+@dataclass
+class MethodInfo:
+    name: str
+    node: ast.AST
+    accesses: List[Access] = field(default_factory=list)
+    # locks acquired anywhere in the method body (own with-blocks)
+    acquires: Set[str] = field(default_factory=set)
+    # (held_lock, acquired_lock, line) nesting events
+    nestings: List[Tuple[str, str, int]] = field(default_factory=list)
+    # (held_locks_frozenset, callee_method_name, line)
+    calls_while_held: List[Tuple[frozenset, str, int]] = \
+        field(default_factory=list)
+    intra_calls: Set[str] = field(default_factory=set)
+    # directly nested same-lock re-acquisition sites
+    self_nest: List[Tuple[str, int]] = field(default_factory=list)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    path: str
+    line: int
+    lock_attrs: Dict[str, str] = field(default_factory=dict)  # attr->kind
+    methods: Dict[str, MethodInfo] = field(default_factory=dict)
+    spawns_thread: bool = False
+    marker: bool = False
+
+    def init_phase(self) -> Set[str]:
+        """Methods reachable ONLY from construction: ``__init__`` plus
+        the fixpoint of methods all of whose intra-class callers are
+        construction-phase.  A method nobody in the class calls is an
+        entry point, never construction-phase."""
+        callers: Dict[str, Set[str]] = {m: set() for m in self.methods}
+        for m in self.methods.values():
+            for callee in m.intra_calls:
+                if callee in callers:
+                    callers[callee].add(m.name)
+        phase = {"__init__"} & set(self.methods)
+        changed = True
+        while changed:
+            changed = False
+            for name, cs in callers.items():
+                if name in phase or not cs:
+                    continue
+                if cs <= phase:
+                    phase.add(name)
+                    changed = True
+        return phase
+
+
+class _MethodWalker:
+    """Walks one method body tracking the set of held locks per
+    statement; records attribute accesses, lock nestings, and
+    intra-class calls."""
+
+    def __init__(self, cls: ClassInfo, mi: MethodInfo,
+                 module_locks: Dict[str, str]):
+        self.cls = cls
+        self.mi = mi
+        self.module_locks = module_locks
+
+    # -- lock resolution ----------------------------------------------------
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        """The lock id a with-item acquires, or None if it is not a
+        known lock.  Ids are PATH-qualified (``path::Class.X`` /
+        ``path::NAME``) so two unrelated classes that happen to share
+        a name never share lock-order graph nodes."""
+        a = _self_attr(expr)
+        if a is not None and a in self.cls.lock_attrs:
+            return f"{self.cls.path}::{self.cls.name}.{a}"
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return f"{self.cls.path}::{expr.id}"
+        return None
+
+    def _lock_kind(self, lock_id: str) -> str:
+        rest = lock_id.split("::", 1)[-1]
+        if "." in rest:
+            return self.cls.lock_attrs.get(rest.split(".", 1)[1], "Lock")
+        return self.module_locks.get(rest, "Lock")
+
+    # -- traversal ----------------------------------------------------------
+    def walk(self):
+        node = self.mi.node
+        held0 = frozenset()
+        if self.mi.name.endswith("_locked"):
+            # repo convention: a `*_locked` method documents that its
+            # CALLER holds the class lock — accesses inside are
+            # lock-protected by contract, not bare
+            held0 = frozenset({CALLER_HELD})
+        self._stmts(node.body, held0)
+
+    def _stmts(self, stmts, held: frozenset):
+        for st in stmts:
+            self._stmt(st, held)
+
+    def _stmt(self, st: ast.stmt, held: frozenset):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            # nested scope: runs at an unknowable time/context
+            for sub in ast.walk(st):
+                a = _self_attr(sub)
+                if a is not None:
+                    self.mi.accesses.append(Access(
+                        a, "read", sub.lineno, None, self.mi.name))
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in st.items:
+                self._expr(item.context_expr, held, lock_of_with=True)
+                lid = self._lock_id(item.context_expr)
+                if item.optional_vars is not None:
+                    self._expr(item.optional_vars, held)
+                if lid is not None:
+                    for h in held | frozenset(acquired):
+                        if h == lid:
+                            if self._lock_kind(lid) not in REENTRANT:
+                                self.mi.self_nest.append(
+                                    (lid, item.context_expr.lineno))
+                        else:
+                            self.mi.nestings.append(
+                                (h, lid, item.context_expr.lineno))
+                    acquired.append(lid)
+                    self.mi.acquires.add(lid)
+            self._stmts(st.body, held | frozenset(acquired))
+            return
+        if isinstance(st, ast.Try):
+            self._stmts(st.body, held)
+            for h in st.handlers:
+                if h.type is not None:
+                    self._expr(h.type, held)
+                self._stmts(h.body, held)
+            self._stmts(st.orelse, held)
+            self._stmts(st.finalbody, held)
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            self._expr(st.test, held)
+            self._stmts(st.body, held)
+            self._stmts(st.orelse, held)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._assign_target(st.target, held)
+            self._expr(st.iter, held)
+            self._stmts(st.body, held)
+            self._stmts(st.orelse, held)
+            return
+        if isinstance(st, ast.Assign):
+            self._expr(st.value, held)
+            for t in st.targets:
+                self._assign_target(t, held)
+            return
+        if isinstance(st, ast.AugAssign):
+            self._expr(st.value, held)
+            # an augmented target is read AND written
+            self._assign_target(st.target, held, aug=True)
+            return
+        if isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._expr(st.value, held)
+            self._assign_target(st.target, held)
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._assign_target(t, held)
+            return
+        if isinstance(st, (ast.Return, ast.Expr)):
+            if st.value is not None:
+                self._expr(st.value, held)
+            return
+        if isinstance(st, (ast.Raise,)):
+            if st.exc is not None:
+                self._expr(st.exc, held)
+            if st.cause is not None:
+                self._expr(st.cause, held)
+            return
+        if isinstance(st, ast.Assert):
+            self._expr(st.test, held)
+            if st.msg is not None:
+                self._expr(st.msg, held)
+            return
+        # Pass/Break/Continue/Import/Global/Nonlocal: nothing to do
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child, held)
+
+    def _assign_target(self, t: ast.expr, held: frozenset,
+                       aug: bool = False):
+        a = _self_attr(t)
+        if a is not None:
+            self.mi.accesses.append(Access(a, "write", t.lineno, held,
+                                           self.mi.name))
+            return
+        if isinstance(t, ast.Subscript):
+            base = _self_attr(t.value)
+            if base is not None:
+                self.mi.accesses.append(Access(base, "mutate", t.lineno,
+                                               held, self.mi.name))
+            else:
+                self._expr(t.value, held)
+            self._expr(t.slice, held)
+            return
+        if isinstance(t, ast.Attribute):
+            self._expr(t.value, held)
+            return
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._assign_target(e, held, aug=aug)
+            return
+        if isinstance(t, ast.Starred):
+            self._assign_target(t.value, held, aug=aug)
+            return
+        self._expr(t, held)
+
+    def _expr(self, e: ast.expr, held: frozenset,
+              lock_of_with: bool = False):
+        if e is None:
+            return
+        if isinstance(e, (ast.Lambda,)):
+            for sub in ast.walk(e.body):
+                a = _self_attr(sub)
+                if a is not None:
+                    self.mi.accesses.append(Access(
+                        a, "read", sub.lineno, None, self.mi.name))
+            return
+        if isinstance(e, ast.Call):
+            f = e.func
+            handled_func = False
+            if isinstance(f, ast.Attribute):
+                base_attr = _self_attr(f.value)
+                if base_attr is not None:
+                    # self.X.method(...): mutation when method mutates
+                    kind = "mutate" if f.attr in MUTATORS else "read"
+                    self.mi.accesses.append(Access(
+                        base_attr, kind, f.lineno, held, self.mi.name))
+                    handled_func = True
+                elif isinstance(f.value, ast.Name) and \
+                        f.value.id == "self":
+                    pass  # plain self.m(...) handled below
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id == "self":
+                # intra-class call
+                self.mi.intra_calls.add(f.attr)
+                if held:
+                    self.mi.calls_while_held.append(
+                        (held, f.attr, e.lineno))
+                handled_func = True
+            if not handled_func:
+                self._expr(f, held)
+            for a in e.args:
+                self._expr(a, held)
+            for kw in e.keywords:
+                self._expr(kw.value, held)
+            return
+        a = _self_attr(e)
+        if a is not None:
+            if not (lock_of_with and a in self.cls.lock_attrs):
+                self.mi.accesses.append(Access(a, "read", e.lineno, held,
+                                               self.mi.name))
+            return
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+
+
+def collect_module(sf: SourceFile):
+    """(classes, module_locks) for one file."""
+    module_locks: Dict[str, str] = {}
+    classes: List[ClassInfo] = []
+    if sf.tree is None:
+        return classes, module_locks
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            kind = _lock_ctor_kind(node.value)
+            if kind:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        module_locks[t.id] = kind
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        ci = ClassInfo(node.name, sf.path, node.lineno)
+        ci.marker = THREADED_MARKER in sf.line_text(node.lineno)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                kind = _lock_ctor_kind(sub.value)
+                if kind:
+                    for t in sub.targets:
+                        a = _self_attr(t)
+                        if a is not None:
+                            ci.lock_attrs[a] = kind
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                nm = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else "")
+                if nm == "Thread":
+                    ci.spawns_thread = True
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mi = MethodInfo(item.name, item)
+                ci.methods[item.name] = mi
+                _MethodWalker(ci, mi, module_locks).walk()
+        classes.append(ci)
+    return classes, module_locks
+
+
+def _transitive_acquires(ci: ClassInfo) -> Dict[str, Set[str]]:
+    """For each method: the locks it can acquire, transitively through
+    intra-class calls (fixpoint; recursion converges)."""
+    acq = {m.name: set(m.acquires) for m in ci.methods.values()}
+    changed = True
+    while changed:
+        changed = False
+        for m in ci.methods.values():
+            for callee in m.intra_calls:
+                extra = acq.get(callee, set()) - acq[m.name]
+                if extra:
+                    acq[m.name] |= extra
+                    changed = True
+    return acq
+
+
+@register_pass(
+    "lock-discipline", ("lock-bare-access", "lock-order"),
+    doc="per-class lock-protected attribute inference + cross-method "
+        "bare-access race detection + lock-acquisition-order cycles")
+def run(files: List[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    # global lock-order graph: edge -> first site observed
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    for sf in files:
+        classes, _module_locks = collect_module(sf)
+        for ci in classes:
+            if not ci.lock_attrs and not any(
+                    m.nestings or m.self_nest for m in ci.methods.values()):
+                continue
+            init_phase = ci.init_phase()
+            threaded = ci.spawns_thread or ci.marker
+
+            # --- protected-attribute inference + bare accesses -------------
+            protected: Dict[str, Set[str]] = {}  # attr -> protecting locks
+            for m in ci.methods.values():
+                if m.name in init_phase:
+                    continue
+                for acc in m.accesses:
+                    if acc.held and acc.kind in ("write", "mutate") \
+                            and acc.attr not in ci.lock_attrs:
+                        protected.setdefault(acc.attr, set()).update(
+                            acc.held)
+            if threaded and protected:
+                seen = set()
+                for m in ci.methods.values():
+                    if m.name in init_phase:
+                        continue
+                    for acc in m.accesses:
+                        if acc.attr not in protected \
+                                or acc.held is None:
+                            continue
+                        # identity matters: holding an UNRELATED lock
+                        # is not protection (reading under _n_lock an
+                        # attr written under _cv is still a race);
+                        # CALLER_HELD is a wildcard on either side
+                        want = protected[acc.attr]
+                        if acc.held & want or CALLER_HELD in acc.held \
+                                or CALLER_HELD in want:
+                            continue
+                        dkey = (acc.attr, m.name)
+                        if dkey in seen:
+                            continue
+                        seen.add(dkey)
+                        locks = ", ".join(
+                            _short(l) for l in sorted(want))
+                        how = ("with no lock held" if not acc.held
+                               else "holding only " + ", ".join(
+                                   _short(l)
+                                   for l in sorted(acc.held)))
+                        out.append(Violation(
+                            "lock-bare-access", sf.path, acc.line,
+                            f"{ci.name}.{m.name}.{acc.attr}",
+                            f"self.{acc.attr} is written under {locks} "
+                            f"elsewhere in {ci.name} but accessed here "
+                            f"{how} ({acc.kind}) — take the "
+                            f"protecting lock or waive with a reason"))
+
+            # --- lock-order graph ------------------------------------------
+            tacq = _transitive_acquires(ci)
+            for m in ci.methods.values():
+                for held, acquired, line in m.nestings:
+                    if CALLER_HELD not in (held, acquired):
+                        edges.setdefault((held, acquired),
+                                         (sf.path, line))
+                for held, callee, line in m.calls_while_held:
+                    for lid in tacq.get(callee, ()):  # interprocedural
+                        for h in held:
+                            if h != lid and h != CALLER_HELD:
+                                edges.setdefault((h, lid),
+                                                 (sf.path, line))
+                for lid, line in m.self_nest:
+                    out.append(Violation(
+                        "lock-order", sf.path, line,
+                        f"{_short(lid)}->{_short(lid)}",
+                        f"non-reentrant {_short(lid)} re-acquired "
+                        f"while already held (self-deadlock)"))
+
+    # --- cycle detection over the global graph -----------------------------
+    adj: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+
+    def reaches(src: str, dst: str) -> bool:
+        seen, stack = set(), [src]
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(adj.get(n, ()))
+        return False
+
+    for (a, b), (path, line) in sorted(edges.items()):
+        if reaches(b, a):
+            back = edges.get((b, a))
+            via = (f"(reverse edge at {back[0]}:{back[1]})" if back
+                   else "(via intermediate locks)")
+            out.append(Violation(
+                "lock-order", path, line,
+                f"{_short(a)}->{_short(b)}",
+                f"acquiring {_short(b)} while holding {_short(a)} "
+                f"conflicts with an observed opposite ordering {via} "
+                f"— deadlock potential; pick one global order"))
+    return out

@@ -1,0 +1,269 @@
+"""Stat-catalog hygiene + the strict Prometheus exposition validator.
+
+The graftcheck port of ``tools/check_stat_catalog.py`` (which remains
+as a thin CLI shim importing from here).  Rule ``stat-undocumented``:
+every *literal* metric name passed to the monitor / telemetry APIs
+must appear backtick-quoted in the README's stat catalog — renamed
+stats silently break every dashboard reading the old name.
+
+This module also owns :func:`validate_exposition` (strict Prometheus
+text-format validation).  :func:`validate_exposition_violations`
+returns the same findings as :class:`~tools.graftcheck.core.Violation`
+records carrying ``file:line`` provenance — family-level errors
+(missing ``_sum``/``_count``, no ``+Inf`` bucket) anchor to the
+family's ``# TYPE`` line instead of printing a bare metric name.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional, Tuple
+
+from ..core import REPO, SourceFile, Violation, register_pass
+
+BARE_FUNCS = {"stat_add", "stat_get", "gauge_set", "histogram_observe"}
+TELEMETRY_ATTRS = {"gauge_set", "histogram_observe", "timer"}
+REGISTRY_ATTRS = {"gauge", "histogram", "timer"}
+
+CATALOG_MARKER = "**Stat catalog**"
+# module-level so tests can point the pass at a fixture README
+README_PATH = os.path.join(REPO, "README.md")
+
+
+def _first_str_arg(node: ast.Call):
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def _value_id(node) -> str:
+    """Best-effort identifier of an attribute's object ('telemetry',
+    '_monitor', 'self._metrics' -> '_metrics', ...)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def extract_names_from_tree(tree: ast.AST) -> List[Tuple[str, int]]:
+    """(name, lineno) for every literal metric name in a parsed tree."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        hit = False
+        if isinstance(func, ast.Name) and func.id in BARE_FUNCS:
+            hit = True
+        elif isinstance(func, ast.Attribute):
+            # exact-id match (modulo leading underscores for module
+            # aliases like `_monitor`): a substring match would drag in
+            # ordinary dict .get() calls on unrelated names
+            vid = _value_id(func.value).lstrip("_")
+            if func.attr == "get" and vid == "monitor":
+                hit = True
+            elif func.attr in TELEMETRY_ATTRS and vid == "telemetry":
+                hit = True
+            elif func.attr in REGISTRY_ATTRS and vid == "metrics":
+                hit = True
+        if not hit:
+            continue
+        name = _first_str_arg(node)
+        if name is not None:
+            out.append((name, node.lineno))
+    return out
+
+
+def extract_names(path: str):
+    """(name, path, lineno) triples for one file — the historical
+    shim-facing API."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, path)
+    except SyntaxError as e:
+        raise SystemExit(f"{path}:{e.lineno}: syntax error: {e.msg}")
+    return [(n, path, ln) for n, ln in extract_names_from_tree(tree)]
+
+
+def catalog_names(readme_path: str) -> set:
+    """Backtick-quoted identifiers in the README's stat-catalog section
+    (from the CATALOG_MARKER to the next `## ` heading).  Scoping to
+    the catalog matters: a metric name that happens to collide with any
+    backticked word elsewhere in the README (a flag, a heartbeat field)
+    must not pass as documented.  Falls back to the whole file when the
+    marker is absent (minimal/test READMEs)."""
+    with open(readme_path, encoding="utf-8") as f:
+        text = f.read()
+    start = text.find(CATALOG_MARKER)
+    if start >= 0:
+        end = text.find("\n## ", start)
+        text = text[start:end if end >= 0 else len(text)]
+    return set(re.findall(r"`([A-Za-z_][A-Za-z0-9_]*)`", text))
+
+
+@register_pass(
+    "stat-catalog", ("stat-undocumented",),
+    doc="every literal metric name used through the monitor/telemetry "
+        "APIs must be in the README stat catalog")
+def run(files: List[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    readme = README_PATH
+    documented = catalog_names(readme) if os.path.exists(readme) else set()
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for name, line in extract_names_from_tree(sf.tree):
+            if name not in documented:
+                out.append(Violation(
+                    "stat-undocumented", sf.path, line, name,
+                    f"metric {name!r} is not in the README stat "
+                    f"catalog -- document it (backtick-quoted) or "
+                    f"rename it to a documented one"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# strict Prometheus text-exposition validation
+# ---------------------------------------------------------------------------
+
+PROM_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+PROM_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"           # metric name
+    r"(\{[^{}]*\})?"                          # optional {labels}
+    r" (-?(?:[0-9.eE+-]+|\+?Inf|-Inf|NaN))"   # value (one space before)
+    r"( [0-9]+)?$")                           # optional ms timestamp
+_LABELS_RE = re.compile(
+    r'^\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*,?)?\}$')
+
+
+def _family_of(name: str, typed: dict) -> str:
+    """Map a histogram/summary component sample back to its family
+    (``x_bucket``/``x_sum``/``x_count`` -> ``x`` when ``x`` is typed
+    histogram or summary)."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if typed.get(base) in ("histogram", "summary"):
+                return base
+    return name
+
+
+def _validate_exposition_impl(text: str) -> List[Tuple[int, str]]:
+    """Strict Prometheus text-exposition validation; returns
+    ``(lineno, message)`` pairs.  Family-level findings (missing
+    ``+Inf`` bucket / ``_sum`` / ``_count``) carry the family's
+    ``# TYPE`` line — provenance the bare-name messages used to lack.
+
+    Enforced: every non-comment line is a well-formed sample
+    (``name{labels} value [timestamp]``); metric names match the
+    Prometheus charset; every sample's family carries ``# HELP`` and
+    ``# TYPE`` lines that PRECEDE its samples; at most one HELP/TYPE
+    per family; TYPE values are real Prometheus types; no duplicate
+    series (same name + label set); histogram families expose
+    ``_bucket``/``_sum``/``_count`` with a ``+Inf`` bucket."""
+    errors: List[Tuple[int, str]] = []
+    helped: dict = {}
+    typed: dict = {}
+    type_line: dict = {}
+    sampled_families = set()
+    seen_series: dict = {}
+    bucket_infs: dict = {}
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        def err(msg):
+            errors.append((lineno, f"{msg} -- {line[:80]!r}"))
+
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            kind = parts[1] if len(parts) > 1 else ""
+            if kind not in ("HELP", "TYPE"):
+                continue  # free-form comment: allowed
+            if len(parts) < 3:
+                err(f"{kind} line without a metric name")
+                continue
+            name = parts[2]
+            if not PROM_NAME_RE.match(name):
+                err(f"bad metric name {name!r} in {kind} line")
+                continue
+            book = helped if kind == "HELP" else typed
+            if name in book:
+                err(f"duplicate # {kind} for {name}")
+            if kind == "HELP":
+                if len(parts) < 4 or not parts[3].strip():
+                    err(f"HELP for {name} has empty docstring")
+                helped.setdefault(name, lineno)
+            else:
+                t = parts[3].strip() if len(parts) > 3 else ""
+                if t not in PROM_TYPES:
+                    err(f"TYPE for {name} is {t!r}, not one of "
+                        f"{sorted(PROM_TYPES)}")
+                typed.setdefault(name, t)
+                type_line.setdefault(name, lineno)
+                if name in sampled_families:
+                    err(f"# TYPE for {name} appears after its samples")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            err("malformed sample line (want 'name{labels} value "
+                "[timestamp]', single spaces)")
+            continue
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        if labels and not _LABELS_RE.match(labels):
+            err(f"malformed label set {labels!r}")
+        try:
+            float(value.replace("Inf", "inf").replace("NaN", "nan"))
+        except ValueError:
+            err(f"unparseable sample value {value!r}")
+        series = (name, labels)
+        if series in seen_series:
+            err(f"duplicate series {name}{labels} (first at line "
+                f"{seen_series[series]})")
+        else:
+            seen_series[series] = lineno
+        fam = _family_of(name, typed)
+        sampled_families.add(fam)
+        if fam not in typed:
+            err(f"sample for {name} with no preceding # TYPE {fam}")
+        elif fam not in helped:
+            err(f"sample for {name} with no # HELP {fam}")
+        if typed.get(fam) == "histogram" and name == fam + "_bucket":
+            if 'le="+Inf"' in labels:
+                bucket_infs[fam] = True
+            bucket_infs.setdefault(fam, False)
+
+    for fam, has_inf in sorted(bucket_infs.items()):
+        if not has_inf:
+            errors.append((type_line.get(fam, 0),
+                           f"histogram {fam} has no le=\"+Inf\" bucket"))
+    for fam in sorted(f for f, t in typed.items() if t == "histogram"):
+        if fam in sampled_families:
+            for part in ("_sum", "_count"):
+                if (fam + part, "") not in seen_series:
+                    errors.append((type_line.get(fam, 0),
+                                   f"histogram {fam} is missing "
+                                   f"{fam}{part}"))
+    return errors
+
+
+def validate_exposition(text: str) -> List[str]:
+    """Historical string API: ``["line N: problem", ...]`` (empty =
+    valid) — what tests and the old CLI consume."""
+    return [f"line {ln}: {msg}"
+            for ln, msg in _validate_exposition_impl(text)]
+
+
+def validate_exposition_violations(text: str,
+                                   path: str = "<prom>"
+                                   ) -> List[Violation]:
+    """The same findings in the shared graftcheck violation format,
+    each carrying ``file:line`` provenance."""
+    return [Violation("prom-format", path, ln, f"prom@{ln}", msg)
+            for ln, msg in _validate_exposition_impl(text)]
