@@ -1566,7 +1566,8 @@ def run_chaos():
     qps = float(env("BENCH_CHAOS_QPS", "40"))
     duration_s = float(env("BENCH_CHAOS_DURATION_S", "6"))
     scenarios = tuple(s for s in env("BENCH_CHAOS_SCENARIOS",
-                                     "crash,hang,slow,poison").split(",")
+                                     "baseline,crash,hang,slow,"
+                                     "poison").split(",")
                       if s)
     report = chaos.run_chaos(replicas=replicas, qps=qps,
                              duration_s=duration_s,
@@ -1584,6 +1585,7 @@ def run_chaos():
         "collateral_failures": totals["collateral_failures"],
         "injected_failures": totals["injected_failures"],
         "poison_leaks": totals["poison_leaks"],
+        "alert_errors": totals.get("alert_errors"),
         "p99_under_fault_ms": report["p99_under_fault_ms"],
         "requests": totals["requests"],
         "ok_requests": totals["ok"],

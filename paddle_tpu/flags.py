@@ -330,3 +330,46 @@ register_flag("FLAGS_fleet_restart_backoff_ms", 200.0,
               "fleet supervisor: base crash-respawn backoff; doubles "
               "per consecutive crash of the same replica (capped at "
               "5s), resets after a healthy start")
+register_flag("FLAGS_tsdb", True,
+              "in-process time-series store (paddle_tpu/tsdb.py): the "
+              "telemetry flush cadence records every counter/gauge and "
+              "each histogram's count/p50/p99 as (ts, value) rings for "
+              "windowed rate/delta/quantile queries — the layer the "
+              "fleet observatory, burn-rate alerts, and the autoscale "
+              "signal read.  0 disables recording (and the monitors go "
+              "evidence-blind); FLAGS_telemetry=0 disables it too")
+register_flag("FLAGS_tsdb_points", 512,
+              "tsdb ring capacity per series: memory is hard-bounded "
+              "at max_series x points x ~60 bytes per store.  At the "
+              "default 10s FLAGS_metrics_interval cadence, 512 points "
+              "is ~85 minutes of history")
+register_flag("FLAGS_slo_availability_pct", 99.0,
+              "availability objective the burn-rate monitor alerts "
+              "against: the error budget is (100 - this)% of requests "
+              "over the alerting windows (SRE-workbook multi-window "
+              "burn rate; paddle_tpu/tsdb.py BurnRateMonitor)")
+register_flag("FLAGS_slo_p99_ms", 0.0,
+              "latency SLO threshold for the burn-rate monitor's p99 "
+              "spec: the budget is 1% of requests above this many ms. "
+              "0 inherits FLAGS_router_slo_p99_ms (one knob for the "
+              "autoscale signal and the alert by default)")
+register_flag("FLAGS_slo_fast_window_s", 60.0,
+              "burn-rate FAST window: an alert needs this window's "
+              "burn over threshold too (proves the problem is still "
+              "happening), and clearing is judged on it alone (a "
+              "recovered fleet clears in about one fast window)")
+register_flag("FLAGS_slo_slow_window_s", 300.0,
+              "burn-rate SLOW window: an alert needs this window's "
+              "burn over threshold (proves the problem is real, not "
+              "one bad scrape).  Must be longer than the fast window")
+register_flag("FLAGS_slo_burn_threshold", 2.0,
+              "burn-rate alert threshold: fire when BOTH windows burn "
+              "error budget at >= this multiple of the sustainable "
+              "rate (1.0 = exactly consuming the budget); clear with "
+              "hysteresis when the fast window drops below half of it")
+register_flag("FLAGS_router_federate", True,
+              "fleet router: scrape every replica's /metrics on the "
+              "health-poll cadence, keep per-replica windowed series "
+              "in the router tsdb, and serve the fleet aggregate on "
+              "GET /fleetz plus replica-labeled fleet_* series on the "
+              "router's own /metrics.  0 = health polling only")

@@ -106,6 +106,7 @@ import numpy as np
 from .. import fault
 from .. import observatory
 from .. import telemetry
+from .. import tsdb
 from ..flags import flag_value
 from ..monitor import process_start_time, stat_add
 from . import batcher
@@ -705,17 +706,22 @@ class ServingEngine:
         return self
 
     def submit_generate(self, prompt, max_new_tokens=None,
-                        trace_id=None, deadline_ms=None):
+                        trace_id=None, deadline_ms=None,
+                        on_token=None, timeline=None):
         """Admit one generation request to the attached slot scheduler
         (future of the generation record); raises RuntimeError when no
-        generator is attached."""
+        generator is attached.  ``on_token``/``timeline`` pass through
+        to :meth:`GenerationEngine.submit` (per-token streaming
+        callback and the per-sequence timeline switch)."""
         if self.generator is None:
             raise RuntimeError("no GenerationEngine attached; call "
                                "attach_generator() first")
         return self.generator.submit(prompt,
                                      max_new_tokens=max_new_tokens,
                                      trace_id=trace_id,
-                                     deadline_ms=deadline_ms)
+                                     deadline_ms=deadline_ms,
+                                     on_token=on_token,
+                                     timeline=timeline)
 
     # -- scheduler ----------------------------------------------------------
     def _count(self, key: str, n: int = 1):
@@ -905,6 +911,12 @@ class ServingEngine:
         self._h_request.observe(ms, trace_id=req.trace_id)
         telemetry.histogram_observe("serving_request_ms", ms,
                                     trace_id=req.trace_id)
+        if telemetry.enabled() and tsdb.enabled():
+            # raw per-request latency series: the replica burn-rate
+            # monitor's latency evidence must be WINDOWED samples —
+            # the histogram's p99 is lifetime-cumulative, and a spec
+            # reading it would latch firing long after recovery
+            tsdb.default().record("serving_request_ms", ms, cap=4096)
         telemetry.span_end(rs)
         telemetry.span_end(req.root)
         req.future.trace = self._trace_finish(req, "ok", predict_ms)
@@ -1181,12 +1193,17 @@ class ServingEngine:
             recent = list(self._tracez_recent)
             slow = list(self._tracez_slow)
         rate = flag_value("FLAGS_trace_sample")
-        return {
+        out = {
             "sample_rate": float(rate) if rate is not None else 0.0,
             "tail_keep": self._tail_keep,
             "recent_sampled": recent[::-1],
             "slowest": slow,
         }
+        if self.generator is not None:
+            # finished-sequence timelines: the TTFT/ITL exemplars'
+            # trace ids resolve against this block
+            out["generation"] = self.generator.tracez()
+        return out
 
     def introspect(self) -> dict:
         """The engine half of ``/statusz``: stats + per-predictor

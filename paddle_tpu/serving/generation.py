@@ -65,6 +65,30 @@ slot and serving continues (``decode_step`` fault-matrix tested).
 ``submit(deadline_ms=...)`` adopts the router-propagated remaining
 budget like the one-shot engine: a spent budget sheds at the queue.
 
+**Per-sequence timelines** — every request carries a trace-linked
+timeline record (admit → claim → prefix-hit → prefill/chunk slices →
+first token → each decode token → finish), returned on the result as
+``timeline`` (relative-ms offsets) and kept in a bounded recent/slowest
+store surfaced by :meth:`GenerationEngine.tracez` (the ``/tracez``
+``generation`` block).  Two latency histograms derive from it, both
+with trace-id exemplars: ``serving_ttft_ms`` (time to first token,
+admission to the first generated token — queue wait, prefix mapping,
+and every chunked-prefill slice *including the decode steps
+interleaved between slices* all count, because that is what the user
+waits) and ``serving_inter_token_ms`` (the gap between consecutive
+generated tokens of one sequence — chunk-induced stalls on OTHER
+sequences land here, which is exactly the SarathiServe trade the
+chunk flag tunes).  A ``generation/sequence`` span brackets each
+request under its trace id with the prefill/chunk/decode spans as
+children, and per-slot occupancy transitions emit a Perfetto counter
+track (``generation_slots`` via ``telemetry.counter_sample``).
+``submit(on_token=...)`` registers a per-token callback ((token_id,
+monotonic_ts), called on the scheduler thread, exceptions contained)
+— the HTTP ``stream`` mode and the loadgen's client-side TTFT/ITL
+measurement hang off it.  All of it is admission-time gated: with
+``FLAGS_telemetry=0`` and no callback, the per-token cost is zero
+extra work.
+
 Stats (README catalog): counters ``serving_generate_requests``,
 ``serving_generate_shed``, ``requests_shed_deadline``,
 ``serving_prefills``, ``serving_decode_steps``,
@@ -82,7 +106,8 @@ in paged mode, the dense reservation otherwise),
 sequences or the prefix index), ``serving_kv_pages_free``,
 ``serving_kv_pages_live``, ``serving_decode_mfu``; histograms
 ``serving_generate_ms``, ``serving_prefill_ms``,
-``serving_decode_step_ms``.
+``serving_decode_step_ms``, ``serving_ttft_ms``,
+``serving_inter_token_ms``.
 """
 from __future__ import annotations
 
@@ -116,7 +141,9 @@ class GenRequest:
     """One queued generation request."""
 
     __slots__ = ("prompt", "max_new_tokens", "future", "t_submit",
-                 "t_claimed", "t_deadline", "trace_id", "prefill_ms")
+                 "t_claimed", "t_deadline", "trace_id", "prefill_ms",
+                 "on_token", "record_timeline", "events", "t_tokens",
+                 "t_first", "t_last")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int):
         self.prompt = prompt
@@ -127,6 +154,18 @@ class GenRequest:
         self.t_deadline: float = float("inf")  # set at admission
         self.trace_id: Optional[str] = None
         self.prefill_ms: float = 0.0
+        # timeline machinery (admission-gated: record_timeline=False
+        # and on_token=None keep the per-token path append-free)
+        self.on_token = None          # callable(token_id, monotonic_ts)
+        self.record_timeline = False
+        self.events: List[tuple] = []  # (label, monotonic_ts, extra)
+        self.t_tokens: List[float] = []  # per generated token
+        self.t_first: Optional[float] = None
+        self.t_last: Optional[float] = None
+
+    def note(self, label: str, ts: float, extra=None):
+        if self.record_timeline:
+            self.events.append((label, ts, extra))
 
 
 class PoolExhausted(Exception):
@@ -260,11 +299,12 @@ class _Slot:
 
     __slots__ = ("idx", "req", "position", "steps", "tokens", "t_start",
                  "logits", "pages", "prefill_pos", "hit_tokens",
-                 "decoding")
+                 "decoding", "span")
 
     def __init__(self, idx: int):
         self.idx = idx
         self.req: Optional[GenRequest] = None
+        self.span = None  # generation/sequence root (telemetry on)
         self.position = 0     # pre-step sequence length = cache offset
         self.steps = 0        # decode steps taken for this request
         self.tokens: List[int] = []
@@ -426,9 +466,21 @@ class GenerationEngine:
         self._h_gen = telemetry.Histogram("serving_generate_ms")
         self._h_prefill = telemetry.Histogram("serving_prefill_ms")
         self._h_step = telemetry.Histogram("serving_decode_step_ms")
+        self._h_ttft = telemetry.Histogram("serving_ttft_ms")
+        self._h_itl = telemetry.Histogram("serving_inter_token_ms")
         self._t_prefill_total = 0.0
         self._t_decode_total = 0.0
         self._decode_rate_ema: Optional[float] = None
+        # finished-sequence timeline store (the /tracez generation
+        # block): recent ring + always-kept slowest-N tail, like the
+        # one-shot engine's trace store
+        self._timeline_lock = threading.Lock()
+        self._timelines_recent: collections.deque = collections.deque(
+            maxlen=max(1, int(flag_value("FLAGS_tracez_recent") or 32)))
+        self._timelines_slow: List[dict] = []
+        self._tail_keep = max(0, int(
+            flag_value("FLAGS_trace_tail_keep") or 8))
+        self._occ_vec: Optional[tuple] = None  # last slot-track sample
 
         if autostart:
             self.start()
@@ -699,18 +751,29 @@ class GenerationEngine:
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
                trace_id: Optional[str] = None,
-               deadline_ms: Optional[float] = None) -> ServingFuture:
+               deadline_ms: Optional[float] = None,
+               on_token=None,
+               timeline: Optional[bool] = None) -> ServingFuture:
         """Admit one generation request.  ``prompt``: 1-D int token ids
         (1 ≤ len ≤ the largest prefill bucket).  Returns a future whose
         ``result()`` is ``{"tokens", "prompt_len", "steps", "finish",
-        "trace_id", "queue_wait_ms", "prefill_ms", "total_ms"}``.
+        "trace_id", "queue_wait_ms", "prefill_ms", "ttft_ms",
+        "total_ms", "timeline"?}``.
         A budget larger than the cache capacity left after the prompt
         is honored until the slot's cache fills, finishing
         ``"cache_full"`` (vs ``"length"`` for a genuinely met budget).
         Sheds with :class:`OverloadedError` (``queue_full`` /
         ``draining`` / ``deadline`` — ``deadline_ms`` is the request's
         REMAINING end-to-end budget, router-propagated; a spent budget
-        sheds right here instead of claiming a decode slot)."""
+        sheds right here instead of claiming a decode slot).
+
+        ``on_token`` — optional per-token callback ``(token_id,
+        monotonic_ts)`` invoked on the scheduler thread the moment
+        each token is booked (the streaming/TTFT hook); it must be
+        fast and never raise (exceptions are contained and logged, the
+        sequence keeps generating).  ``timeline`` — force the
+        per-sequence timeline record on/off; default follows
+        ``FLAGS_telemetry`` (off ⇒ zero per-token bookkeeping)."""
         ids = np.asarray(prompt)
         if ids.ndim != 1 or ids.size < 1:
             raise ValueError(f"prompt must be a non-empty 1-D token id "
@@ -735,6 +798,10 @@ class GenerationEngine:
             # an externally-minted id (the router hop's trace header)
             # wins: one generated sequence is one trace across tiers
             req.trace_id = trace_id or telemetry.new_trace_id()
+        req.on_token = on_token
+        req.record_timeline = bool(telemetry.enabled()
+                                   if timeline is None else timeline)
+        req.note("admit", req.t_submit)
         self._count("requests")
         stat_add("serving_generate_requests")
         with self._cv:
@@ -806,6 +873,7 @@ class GenerationEngine:
             if req is None:
                 break
             req.t_claimed = now
+            req.note("claim", now, {"slot": slot.idx})
             slot.req = req
             slot.position = 0
             slot.steps = 0
@@ -815,6 +883,7 @@ class GenerationEngine:
             slot.prefill_pos = 0
             slot.hit_tokens = 0
             slot.decoding = False
+            slot.span = None
             claimed.append((slot, req))
             if busy_before:
                 # the continuous-batching event: a new sequence enters
@@ -848,6 +917,8 @@ class GenerationEngine:
                     # must not kill the scheduler: exactly this request
                     # errors, the grid keeps decoding
                     self._fail_request(slot, req, "prefill", e)
+            if claimed:
+                self._sample_slot_track()
             # chunked prefill: advance ONE pending slice per iteration
             # (round-robin over prefilling slots), so a long prompt
             # pays out between decode steps instead of stalling the
@@ -885,6 +956,13 @@ class GenerationEngine:
         and now.  Paged: poison/fault checks + the prefix-index
         mapping only — the prompt itself pays out via
         :meth:`_prefill_advance` (one slice per scheduler iteration)."""
+        # the per-sequence timeline span: trace-linked root bracketing
+        # claim→finish under the request's trace id, the prefill /
+        # chunk / decode spans hang under it
+        slot.span = telemetry.span_begin(
+            "generation/sequence", detached=True,
+            trace_id=req.trace_id, slot=slot.idx,
+            prompt_len=int(req.prompt.size))
         if not self.paged:
             self._prefill(slot, req)
             slot.decoding = True
@@ -903,12 +981,24 @@ class GenerationEngine:
                 self._pool.incref(hit)
                 slot.pages = list(hit)
                 slot.hit_tokens = len(hit) * self.page_tokens
+                req.note("prefix_hit", time.monotonic(),
+                         {"tokens": slot.hit_tokens})
                 self._count("prefix_hits")
                 stat_add("serving_prefix_hits")
                 self._count("prefix_tokens_saved", slot.hit_tokens)
                 stat_add("serving_prefix_tokens_saved",
                          slot.hit_tokens)
         slot.prefill_pos = slot.hit_tokens
+
+    def _end_seq_span(self, slot: _Slot, outcome: str):
+        """Close the slot's generation/sequence span (safe when none —
+        telemetry off or pre-claim failure)."""
+        if slot.span is not None:
+            slot.span.attrs["outcome"] = outcome
+            if slot.req is not None:
+                slot.span.attrs["steps"] = slot.steps
+            telemetry.span_end(slot.span)
+            slot.span = None
 
     def _requeue_or_fail(self, slot: _Slot, e: Exception):
         """Pool exhausted mid-prefill.  With other sequences live the
@@ -927,10 +1017,13 @@ class GenerationEngine:
         logger.debug("kv pool exhausted mid-prefill; requeueing "
                      "request (%d live slots hold the pages)",
                      len(others))
+        self._end_seq_span(slot, "requeued")
+        req.note("requeue", time.monotonic())
         self._release_pages(slot)
         slot.req = None
         slot.decoding = False
         slot.logits = []
+        self._sample_slot_track()
         with self._cv:
             self._queue.appendleft(req)
             self._cv.notify_all()
@@ -939,12 +1032,14 @@ class GenerationEngine:
                       e: Exception):
         self._count("failed")
         logger.warning("%s failed: %s", phase, e)
+        self._end_seq_span(slot, f"failed:{phase}")
         self._release_pages(slot)
         req.future._resolve(error=RequestFailed(
             f"{phase} failed: {type(e).__name__}: {e}"))
         slot.req = None
         slot.decoding = False
         slot.logits = []
+        self._sample_slot_track()
 
     def _decode_failed(self, e: Exception):
         # fail EVERY active slot, mid-prefill ones included: the step
@@ -962,10 +1057,12 @@ class GenerationEngine:
         err = RequestFailed(f"decode step failed: "
                             f"{type(e).__name__}: {e}")
         for s in active:
+            self._end_seq_span(s, "failed:decode_step")
             req, s.req, s.logits = s.req, None, []
             s.decoding = False
             self._release_pages(s)
             req.future._resolve(error=err)
+        self._sample_slot_track()
         if self._prefix is not None:
             # the crashed step donated the pool buffers, so every
             # indexed page's K/V is as unknowable as the slots' —
@@ -1018,6 +1115,8 @@ class GenerationEngine:
         bucket = batcher.prompt_bucket_for(req.prompt.size,
                                            self.prefill_buckets)
         with telemetry.trace_span("generation/prefill",
+                                  parent=slot.span.context()
+                                  if slot.span is not None else None,
                                   tokens=int(req.prompt.size),
                                   bucket=bucket, slot=slot.idx):
             outs = self._run_prefill_program(req.prompt, bucket,
@@ -1025,8 +1124,10 @@ class GenerationEngine:
             first = int(np.asarray(outs[0].numpy())[0])
             slot.logits = [np.asarray(outs[1].numpy())[0]] \
                 if self.keep_logits else []
-        ms = (time.monotonic() - t0) * 1e3
+        now = time.monotonic()
+        ms = (now - t0) * 1e3
         req.prefill_ms = ms
+        req.note("prefill", now, {"tokens": int(req.prompt.size)})
         self._t_prefill_total += ms
         self._h_prefill.observe(ms, trace_id=req.trace_id)
         telemetry.histogram_observe("serving_prefill_ms", ms,
@@ -1037,7 +1138,7 @@ class GenerationEngine:
         stat_add("serving_prefill_tokens", int(req.prompt.size))
         slot.position = int(req.prompt.size)
         slot.tokens = [first]
-        self._book_token(slot, first)
+        self._book_token(slot, first, now)
 
     # -- paged prefill ------------------------------------------------------
     def _release_pages(self, slot: _Slot):
@@ -1097,6 +1198,8 @@ class GenerationEngine:
             if self.keep_logits:
                 fetch.append(fetches["logits"])
             with telemetry.trace_span("generation/prefill",
+                                      parent=slot.span.context()
+                                      if slot.span is not None else None,
                                       tokens=n_prompt, bucket=bucket,
                                       slot=slot.idx, paged=True):
                 outs = self._prefill_exe.run(
@@ -1130,6 +1233,8 @@ class GenerationEngine:
         chunk = np.zeros((bucket,), "int64")
         chunk[:n] = prompt[start:start + n]
         with telemetry.trace_span("generation/prefill_chunk",
+                                  parent=slot.span.context()
+                                  if slot.span is not None else None,
                                   tokens=n, base=start, bucket=bucket,
                                   slot=slot.idx):
             outs = self._prefill_exe.run(
@@ -1142,7 +1247,9 @@ class GenerationEngine:
                 fetch_list=fetch, scope=self.scope, return_numpy=False)
         self._count("prefill_chunks")
         stat_add("serving_prefill_chunks")
-        req.prefill_ms += (time.monotonic() - t0) * 1e3
+        now = time.monotonic()
+        req.prefill_ms += (now - t0) * 1e3
+        req.note("chunk", now, {"base": start, "tokens": n})
         slot.prefill_pos = start + n
         if last:
             self._complete_prefill(slot, req, outs)
@@ -1175,7 +1282,7 @@ class GenerationEngine:
         slot.position = n_prompt
         slot.tokens = [first]
         slot.decoding = True
-        self._book_token(slot, first)
+        self._book_token(slot, first, time.monotonic())
 
     # -- decode -------------------------------------------------------------
     def _run_decode_program(self, tokens: np.ndarray,
@@ -1235,11 +1342,16 @@ class GenerationEngine:
             for s in active:
                 bt[s.idx] = self._slot_block_table(s)
                 live[s.idx] = 1
+        # the grid step serves N sequences at once: link their
+        # sequence-span contexts, the fan-in convention batch spans use
+        links = [s.span.context() for s in active
+                 if s.span is not None] or None
         with telemetry.trace_span("generation/decode_step",
-                                  active=len(active)):
+                                  links=links, active=len(active)):
             next_tokens, logits = self._run_decode_program(
                 tokens, positions, bt, live)
-        ms = (time.monotonic() - t0) * 1e3
+        t1 = time.monotonic()
+        ms = (t1 - t0) * 1e3
         self._t_decode_total += ms
         self._h_step.observe(ms)
         telemetry.histogram_observe("serving_decode_step_ms", ms)
@@ -1256,15 +1368,49 @@ class GenerationEngine:
             s.tokens.append(tok)
             if logits is not None:
                 s.logits.append(logits[s.idx])
-            self._book_token(s, tok)
+            # one timestamp for the whole grid step: per-token
+            # bookkeeping adds no extra clock reads to the step
+            self._book_token(s, tok, t1)
 
-    def _book_token(self, slot: _Slot, tok: int):
+    def _book_token(self, slot: _Slot, tok: int, now: float):
         """Account one generated token and finish the slot on EOS /
         token budget / cache exhaustion — freeing it for the next
-        queued request at the very next scheduler iteration."""
+        queued request at the very next scheduler iteration.  ``now``
+        is the caller's already-taken post-step timestamp (the whole
+        grid shares one clock read): it feeds the sequence timeline,
+        the TTFT / inter-token histograms, and the per-token
+        callback."""
         self._count("generated_tokens")
         stat_add("serving_generated_tokens")
         req = slot.req
+        tele = telemetry.enabled()
+        if req.record_timeline:
+            # _timeline_record is the only consumer: an on_token-only
+            # request (streaming with telemetry off) pays no list
+            req.t_tokens.append(now)
+        if req.t_first is None:
+            req.t_first = now
+            if tele:
+                ttft = (now - req.t_submit) * 1e3
+                self._h_ttft.observe(ttft, trace_id=req.trace_id)
+                telemetry.histogram_observe("serving_ttft_ms", ttft,
+                                            trace_id=req.trace_id)
+        elif tele:
+            itl = (now - (req.t_last if req.t_last is not None
+                          else req.t_first)) * 1e3
+            self._h_itl.observe(itl, trace_id=req.trace_id)
+            telemetry.histogram_observe("serving_inter_token_ms", itl,
+                                        trace_id=req.trace_id)
+        req.t_last = now
+        if req.on_token is not None:
+            try:
+                req.on_token(tok, now)
+            except Exception as e:  # noqa: BLE001 — a broken stream
+                # consumer must not take down the scheduler (or the
+                # other sequences riding this grid step)
+                logger.warning("on_token callback failed (token "
+                               "dropped from stream): %s", e)
+                req.on_token = None
         finish = None
         if tok == self.eos_id:
             finish = "eos"
@@ -1283,6 +1429,7 @@ class GenerationEngine:
     def _finish(self, slot: _Slot, finish: str):
         req = slot.req
         now = time.monotonic()
+        req.note("finish", now, {"reason": finish})
         total_ms = (now - req.t_submit) * 1e3
         self._count("served")
         self._h_gen.observe(total_ms, trace_id=req.trace_id)
@@ -1297,6 +1444,8 @@ class GenerationEngine:
             "queue_wait_ms": round(
                 ((req.t_claimed or now) - req.t_submit) * 1e3, 3),
             "prefill_ms": round(req.prefill_ms, 3),
+            "ttft_ms": round((req.t_first - req.t_submit) * 1e3, 3)
+            if req.t_first is not None else None,
             "total_ms": round(total_ms, 3),
         }
         if self.keep_logits:
@@ -1304,10 +1453,63 @@ class GenerationEngine:
             slot.logits = []
         if slot.hit_tokens:
             result["prefix_hit_tokens"] = slot.hit_tokens
+        if req.record_timeline:
+            result["timeline"] = self._timeline_record(req, result)
+            self._store_timeline(result)
+        self._end_seq_span(slot, finish)
         slot.req = None
         slot.decoding = False
         self._release_pages(slot)
+        self._sample_slot_track()
         req.future._resolve(outputs=result)
+
+    def _timeline_record(self, req: GenRequest, result: dict) -> dict:
+        """The per-sequence timeline as relative-ms offsets from
+        admission — the Dapper-style record behind TTFT/ITL: every
+        phase boundary (claim, prefix hit, each prefill slice, every
+        token, finish) as the user's clock saw it."""
+        t0 = req.t_submit
+
+        def rel(t):
+            return round((t - t0) * 1e3, 3)
+
+        events = []
+        for label, t, extra in req.events:
+            ev = {"at_ms": rel(t), "event": label}
+            if extra:
+                ev.update(extra)
+            events.append(ev)
+        token_ms = [rel(t) for t in req.t_tokens]
+        tl = {"trace_id": req.trace_id, "events": events,
+              "token_ms": token_ms,
+              "ttft_ms": result.get("ttft_ms")}
+        if len(token_ms) >= 2:
+            gaps = [round(b - a, 3)
+                    for a, b in zip(token_ms, token_ms[1:])]
+            gaps_sorted = sorted(gaps)
+            tl["inter_token_ms"] = {
+                "p50": gaps_sorted[len(gaps_sorted) // 2],
+                "max": gaps_sorted[-1],
+                "mean": round(sum(gaps) / len(gaps), 3),
+            }
+        return tl
+
+    def _store_timeline(self, result: dict):
+        """Bounded finished-sequence store for ``/tracez``: recent
+        ring + always-kept slowest-N by total latency (exemplar trace
+        ids from the TTFT/ITL histograms resolve here)."""
+        rec = {k: result[k] for k in ("trace_id", "finish", "steps",
+                                      "prompt_len", "queue_wait_ms",
+                                      "prefill_ms", "ttft_ms",
+                                      "total_ms") if k in result}
+        rec["timeline"] = result.get("timeline")
+        with self._timeline_lock:
+            self._timelines_recent.append(rec)
+            if self._tail_keep:
+                self._timelines_slow.append(rec)
+                self._timelines_slow.sort(
+                    key=lambda r: -(r.get("total_ms") or 0.0))
+                del self._timelines_slow[self._tail_keep:]
 
     def retry_after_s(self) -> float:
         """Backoff hint for 503 sheds (the ``Retry-After`` header):
@@ -1322,6 +1524,34 @@ class GenerationEngine:
         return min(30.0, max(0.5, est))
 
     # -- introspection ------------------------------------------------------
+    def _sample_slot_track(self):
+        """Per-slot occupancy as a Perfetto counter track
+        (``generation_slots``): one stacked series per slot (0/1) plus
+        the active total, sampled only on occupancy TRANSITIONS
+        (claim/finish) so a long decode burst costs ring entries at
+        the rate slots turn over, not per step."""
+        if not telemetry.enabled():
+            return
+        vec = tuple(1.0 if s.active else 0.0 for s in self._slots)
+        if vec == self._occ_vec:
+            return
+        self._occ_vec = vec
+        series = {f"slot{i}": v for i, v in enumerate(vec)}
+        series["active"] = float(sum(vec))
+        telemetry.counter_sample("generation_slots", series)
+
+    def tracez(self) -> dict:
+        """The ``/tracez`` ``generation`` block: recent finished
+        sequence timelines (newest first) + the slowest-N tail, plus
+        the live TTFT / inter-token exemplars — a histogram exemplar's
+        trace id resolves to its full timeline here."""
+        with self._timeline_lock:
+            recent = list(self._timelines_recent)
+            slow = list(self._timelines_slow)
+        return {"recent": recent[::-1], "slowest": slow,
+                "ttft_exemplars": self._h_ttft.exemplars(),
+                "inter_token_exemplars": self._h_itl.exemplars()}
+
     def _publish_gauges(self):
         active = len(self._active())
         if active > self._peak_active:
@@ -1407,6 +1637,8 @@ class GenerationEngine:
             "generate_ms": self._h_gen.summary(),
             "prefill_ms": self._h_prefill.summary(),
             "decode_step_ms": self._h_step.summary(),
+            "ttft_ms": self._h_ttft.summary(),
+            "inter_token_ms": self._h_itl.summary(),
         }
 
     def introspect(self) -> dict:

@@ -72,20 +72,54 @@ not from a static round-robin.
   (capped at 4x live), scale-down only below the 0.4 hysteresis
   low-water mark — the hook a real autoscaler consumes.
 
+* **Metrics federation** (``FLAGS_router_federate``) — the health-poll
+  loop also scrapes each replica's ``/metrics`` (one strict-exposition
+  parse via :mod:`paddle_tpu.promtext` — the same implementation the
+  lint validates with), keeps per-replica windowed series in a
+  router-local :class:`paddle_tpu.tsdb.TSDB` and computes fleet
+  aggregates: counters SUM across replicas (windowed rates from the
+  series), gauges report sum AND max, latency histograms merge
+  bucket-vector-wise so the fleet p99 interpolates exactly like one
+  replica's.  ``GET /fleetz`` serves the whole view (per-replica +
+  aggregate windows, SLO/alert state, tsdb occupancy) and the
+  router's own ``/metrics`` grows ``paddle_tpu_fleet_*`` families:
+  one ``replica="host:port"``-labeled sample per replica plus the
+  unlabeled fleet aggregate.
+
+* **SLO burn-rate alerting** — a
+  :class:`paddle_tpu.tsdb.BurnRateMonitor` evaluates on every poll
+  sweep over the router's windowed series: request availability
+  (errors = no-ready + replica-error + forward-timeout outcomes over
+  routed requests), replica availability (failed health polls over
+  polls — the crash/hang detector), and the latency SLO (share of
+  served requests over ``FLAGS_slo_p99_ms`` /
+  ``FLAGS_router_slo_p99_ms``).  Alerts fire when both the fast and
+  slow windows burn over ``FLAGS_slo_burn_threshold`` and clear with
+  hysteresis; the ``alerts`` block rides ``/statusz`` and ``/fleetz``
+  and the chaos harness asserts fire-inside-fault-window /
+  clear-after / silent-on-clean.  The ``fleet_wanted_replicas``
+  autoscale signal reads its p99 from the same windowed series
+  (``router_request_ms`` samples in the tsdb) instead of a private
+  ad-hoc deque.
+
 Endpoints: ``POST /predict`` / ``POST /generate`` (forwarded;
 replica responses — including overload 503s — pass through
 verbatim), ``GET /healthz`` (503 when the fleet has no routable
-replica), ``GET /metrics`` (strict Prometheus, live registry),
-``GET /statusz`` (fleet topology, per-replica health/ejection state,
-routing decision counters, autoscale signal).
+replica), ``GET /metrics`` (strict Prometheus, live registry +
+fleet-labeled federation families), ``GET /fleetz`` (federated
+per-replica + aggregate windowed series, SLO state), ``GET /statusz``
+(fleet topology, per-replica health/ejection state, routing decision
+counters, autoscale signal, alerts).
 
 Stats (README catalog): counters ``router_http_requests``,
 ``router_requests_routed``, ``router_retries``,
 ``router_forward_timeouts``, ``requests_shed_deadline``,
 ``router_no_ready_replicas``, ``router_replica_errors``,
 ``router_ejections``, ``router_recoveries``, ``router_health_polls``,
-``router_health_poll_failures``; gauges ``router_replicas_ready``,
-``fleet_wanted_replicas``; histogram ``router_request_ms``.
+``router_health_poll_failures``, ``router_scrapes``,
+``router_scrape_failures``; gauges ``router_replicas_ready``,
+``fleet_wanted_replicas``, ``fleet_replicas_up``; histogram
+``router_request_ms``.
 
 Fault site (``paddle_tpu/fault.py``): ``router_forward`` — ``fail``
 simulates a connect-level forward failure (exercises the
@@ -95,7 +129,6 @@ scenario injects here.
 """
 from __future__ import annotations
 
-import collections
 import concurrent.futures
 import http.client
 import json
@@ -109,7 +142,7 @@ import urllib.request
 from http.server import ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
-from .. import fault, telemetry
+from .. import fault, promtext, telemetry, tsdb
 from ..flags import all_flags, flag_value
 from ..monitor import process_uptime_s, stat_add
 from .server import (DEADLINE_HEADER, TRACE_HEADER, _AccessLog,
@@ -128,6 +161,14 @@ _CONNECT_ERRORS = (ConnectionRefusedError, ConnectionResetError,
 _LATENCY_WINDOW_S = 10.0    # sliding window feeding the SLO pressure
 _SCALE_UP_CAP = 4.0         # wanted <= 4x live per signal recompute
 _SCALE_DOWN_BAND = 0.4      # hysteresis: shrink only below this
+_PROM_PREFIX = "paddle_tpu_"
+
+
+def _short_family(name: str) -> str:
+    """Scraped family name -> catalog name (the exporter prefixes
+    every family with ``paddle_tpu_``)."""
+    return name[len(_PROM_PREFIX):] if name.startswith(_PROM_PREFIX) \
+        else name
 
 
 def _is_connect_error(exc) -> bool:
@@ -153,6 +194,9 @@ class _Replica:
 
     def __init__(self, url: str):
         self.url = url.rstrip("/")
+        # stable per-replica label: host:port survives respawns (the
+        # supervisor pins ports), so one replica is one series forever
+        self.rid = self.url.split("://", 1)[-1]
         self.health: Optional[dict] = None     # last good /healthz body
         self.health_ts: float = 0.0            # monotonic, last success
         self.poll_failures = 0                 # consecutive
@@ -162,6 +206,10 @@ class _Replica:
         self.routed = 0
         self.retries_to = 0                    # retries that landed here
         self.errors = 0
+        # federation: the last good /metrics parse
+        self.scrape: Optional[Dict[str, promtext.Family]] = None
+        self.scrape_ts: float = 0.0
+        self.scrape_failures = 0               # consecutive
 
     # -- routing view -------------------------------------------------------
     def ready(self) -> bool:
@@ -212,6 +260,11 @@ class _Replica:
             "retries_to": self.retries_to,
             "errors": self.errors,
             "last_error": self.last_error,
+            "rid": self.rid,
+            "scrape_age_ms": round(
+                (time.monotonic() - self.scrape_ts) * 1e3, 1)
+            if self.scrape_ts else None,
+            "scrape_failures": self.scrape_failures,
         }
 
 
@@ -230,6 +283,11 @@ class Router:
                  eject_after: Optional[int] = None,
                  request_timeout_s: float = 30.0,
                  forward_timeout_ms: Optional[float] = None,
+                 federate: Optional[bool] = None,
+                 slo_fast_s: Optional[float] = None,
+                 slo_slow_s: Optional[float] = None,
+                 slo_burn_threshold: Optional[float] = None,
+                 slo_availability_pct: Optional[float] = None,
                  autostart: bool = True):
         self._slo_p99_ms = float(
             slo_p99_ms if slo_p99_ms is not None
@@ -261,10 +319,35 @@ class Router:
                    "no_ready": 0, "replica_errors": 0, "ejections": 0,
                    "recoveries": 0, "health_polls": 0,
                    "health_poll_failures": 0, "forward_timeouts": 0,
-                   "deadline_sheds": 0}
+                   "deadline_sheds": 0, "scrapes": 0,
+                   "scrape_failures": 0}
         self._h_request = telemetry.Histogram("router_request_ms")
-        # sliding (ts, ms) window of served latencies -> SLO pressure
-        self._recent: collections.deque = collections.deque(maxlen=2048)
+        # the windowed-series store behind the autoscale signal, the
+        # federated fleet view, and the burn-rate monitor.  Router-
+        # local (NOT the process default): in-process tests run router
+        # and replicas in one process and the fleet view must not read
+        # its own replica-side series
+        self._db = tsdb.TSDB()
+        self.federate = bool(flag_value("FLAGS_router_federate")
+                             if federate is None else federate)
+        slo_latency_ms = float(flag_value("FLAGS_slo_p99_ms") or 0.0) \
+            or self._slo_p99_ms
+        self.burn_monitor = tsdb.BurnRateMonitor(
+            self._db,
+            [tsdb.SloSpec("availability", "availability",
+                          error_series="router_request_failures",
+                          total_series="router_requests_total",
+                          objective_pct=slo_availability_pct),
+             tsdb.SloSpec("replica_availability", "availability",
+                          error_series="router_poll_failures_total",
+                          total_series="router_polls_total",
+                          objective_pct=slo_availability_pct),
+             tsdb.SloSpec("p99", "latency",
+                          latency_series="router_request_ms",
+                          threshold_ms=slo_latency_ms,
+                          objective_pct=99.0)],
+            fast_s=slo_fast_s, slow_s=slo_slow_s,
+            threshold=slo_burn_threshold)
         self._autoscale = {"wanted_replicas": None, "pressure": None,
                            "p99_ms": None, "slo_p99_ms": self._slo_p99_ms,
                            "avg_queue_depth": None, "live": 0}
@@ -339,6 +422,8 @@ class Router:
             join_s = max(0.5, self._stale_s / 2.0) + 1.0
             concurrent.futures.wait(futs, timeout=join_s)
         self._recompute_autoscale()
+        self._record_sweep_series()
+        self.burn_monitor.evaluate()
 
     def _poll_replica(self, rep: _Replica):
         self._count("health_polls")
@@ -384,6 +469,68 @@ class Router:
             self._count("recoveries")
             stat_add("router_recoveries")
             telemetry.log_event("router_replica_recovered", url=rep.url)
+        if self.federate:
+            self._scrape_replica(rep, timeout)
+
+    def _scrape_replica(self, rep: _Replica, timeout: float):
+        """Pull one replica's ``/metrics`` on the poll cadence and
+        record its counter/gauge families as per-replica series (name
+        pattern ``<family>[<host:port>]``) plus each histogram's
+        ``_count``.  The parse is best-effort per family (a malformed
+        family must not blind the fleet view to the rest); a failed
+        scrape keeps the last good parse but stops advancing its
+        series, so windowed rates age to None instead of freezing."""
+        self._count("scrapes")
+        stat_add("router_scrapes")
+        try:
+            with urllib.request.urlopen(rep.url + "/metrics",
+                                        timeout=timeout) as r:
+                text = r.read().decode("utf-8", "replace")
+            fams = promtext.parse_exposition(text)
+        except (OSError, TimeoutError, ValueError,
+                urllib.error.HTTPError) as e:
+            self._count("scrape_failures")
+            stat_add("router_scrape_failures")
+            with self._lock:
+                rep.scrape_failures += 1
+            logger.debug("scrape of %s failed: %s", rep.url, e)
+            return
+        now = time.monotonic()
+        with self._lock:
+            rep.scrape = fams
+            rep.scrape_ts = now
+            rep.scrape_failures = 0
+        for name, fam in fams.items():
+            short = _short_family(name)
+            if fam.type in ("counter", "gauge"):
+                v = fam.value()
+                if v is not None:
+                    self._db.record(f"{short}[{rep.rid}]", v, ts=now)
+            elif fam.type == "histogram":
+                self._db.record(f"{short}_count[{rep.rid}]",
+                                fam.histogram_count(), ts=now)
+
+    def _record_sweep_series(self):
+        """Per-sweep bookkeeping series: the router's own counters
+        (the burn-rate monitor's evidence) and fleet-level gauges."""
+        now = time.monotonic()
+        with self._lock:
+            n = dict(self._n)
+        # client-visible request failures: an empty fleet, a dead
+        # forward, or an unretryable hang — NOT deadline sheds (the
+        # client's own budget) and NOT replica-side admission 503s
+        # (explicit backpressure passing through verbatim)
+        self._db.record("router_request_failures",
+                        n["no_ready"] + n["replica_errors"]
+                        + n["forward_timeouts"], ts=now)
+        self._db.record("router_requests_total", n["requests"], ts=now)
+        self._db.record("router_polls_total", n["health_polls"], ts=now)
+        self._db.record("router_poll_failures_total",
+                        n["health_poll_failures"], ts=now)
+        up = sum(1 for r in self._all()
+                 if r.health is not None and not r.ejected)
+        self._db.record("fleet_replicas_up", up, ts=now)
+        telemetry.gauge_set("fleet_replicas_up", up)
 
     def _poll_failed(self, rep: _Replica, detail: str):
         self._count("health_poll_failures")
@@ -406,14 +553,12 @@ class Router:
 
     # -- autoscaling signal -------------------------------------------------
     def _window_p99(self) -> Optional[float]:
-        cutoff = time.monotonic() - _LATENCY_WINDOW_S
-        with self._lock:
-            vals = [ms for ts, ms in self._recent if ts >= cutoff]
-        if not vals:
-            return None
-        vals.sort()
-        return vals[min(len(vals) - 1,
-                        int(math.ceil(0.99 * len(vals))) - 1)]
+        """p99 of served latencies over the trailing window, read from
+        the SAME tsdb series (`router_request_ms`) the burn-rate
+        monitor and /fleetz expose — one windowed store, no private
+        deque to drift from it."""
+        return self._db.quantile("router_request_ms", 99,
+                                 _LATENCY_WINDOW_S)
 
     def _recompute_autoscale(self):
         routable = [r for r in self._all() if r.ready()]
@@ -651,8 +796,9 @@ class Router:
                 self._h_request.observe(ms, trace_id=trace_id)
                 telemetry.histogram_observe("router_request_ms", ms,
                                             trace_id=trace_id)
-                with self._lock:
-                    self._recent.append((time.monotonic(), ms))
+                # per-request latency series (bigger ring than the
+                # sweep-cadence series: it records per request)
+                self._db.record("router_request_ms", ms, cap=4096)
             return {"code": code, "body": data, "content_type": ctype,
                     "replica": rep.url, "retried": retried,
                     "retry_after": retry_after}
@@ -674,6 +820,150 @@ class Router:
                 "content_type": "application/json", "replica": None,
                 "retried": retried, "retry_after": retry_after}
 
+    # -- federation ---------------------------------------------------------
+    def fleet_metrics(self, window_s: float = 60.0) -> dict:
+        """The federated fleet view: per-replica latest samples plus
+        the aggregate — counters SUM (total and windowed per-second
+        rate, monotonic-reset aware through the tsdb), gauges sum AND
+        max (a fleet queue depth is a sum; a fleet HBM peak is a max
+        — expose both, let the consumer pick), histograms merged
+        bucket-vector-wise with interpolated fleet p50/p99."""
+        reps = self._all()
+        with self._lock:
+            scrapes = [(r.rid, r.url, r.scrape, r.scrape_ts, r)
+                       for r in reps]
+        now = time.monotonic()
+        per_replica: Dict[str, dict] = {}
+        counters: Dict[str, dict] = {}
+        gauges: Dict[str, dict] = {}
+        hists: Dict[str, dict] = {}
+        for rid, url, fams, ts, rep in scrapes:
+            entry = {
+                "url": url,
+                "up": fams is not None and not rep.ejected,
+                "ready": rep.ready(),
+                "scrape_age_ms": round((now - ts) * 1e3, 1)
+                if ts else None,
+                "counters": {}, "gauges": {},
+            }
+            per_replica[rid] = entry
+            if not fams:
+                continue
+            for name, fam in fams.items():
+                short = _short_family(name)
+                if fam.type == "counter":
+                    v = fam.value()
+                    if v is None:
+                        continue
+                    entry["counters"][short] = v
+                    agg = counters.setdefault(
+                        short, {"total": 0.0, "rate_per_s": None,
+                                "replicas": 0})
+                    agg["total"] += v
+                    agg["replicas"] += 1
+                    rate = self._db.rate(f"{short}[{rid}]", window_s,
+                                         now=now)
+                    if rate is not None:
+                        agg["rate_per_s"] = (agg["rate_per_s"] or 0.0) \
+                            + rate
+                elif fam.type == "gauge":
+                    v = fam.value()
+                    if v is None:
+                        continue
+                    entry["gauges"][short] = v
+                    agg = gauges.setdefault(
+                        short, {"sum": 0.0, "max": None, "replicas": 0})
+                    agg["sum"] += v
+                    agg["max"] = v if agg["max"] is None \
+                        else max(agg["max"], v)
+                    agg["replicas"] += 1
+                elif fam.type == "histogram":
+                    agg = hists.setdefault(
+                        short, {"count": 0.0, "sum": 0.0,
+                                "buckets": {}, "replicas": 0})
+                    agg["count"] += fam.histogram_count()
+                    agg["sum"] += fam.histogram_sum()
+                    agg["replicas"] += 1
+                    for ub, cum in fam.histogram_buckets():
+                        agg["buckets"][ub] = \
+                            agg["buckets"].get(ub, 0.0) + cum
+        for short, agg in counters.items():
+            agg["total"] = round(agg["total"], 6)
+        for short, agg in hists.items():
+            merged = sorted(agg.pop("buckets").items())
+            agg["p50"] = promtext.merged_histogram_percentile(merged, 50)
+            agg["p99"] = promtext.merged_histogram_percentile(merged, 99)
+            agg["buckets"] = [[("+Inf" if math.isinf(ub) else ub), c]
+                              for ub, c in merged]
+        return {"window_s": window_s,
+                "replicas": per_replica,
+                "aggregate": {"counters": counters, "gauges": gauges,
+                              "histograms": hists}}
+
+    def fleetz(self, window_s: float = 60.0) -> dict:
+        """The ``GET /fleetz`` payload: federation + windowed router
+        series + SLO/alert state + autoscale — the one JSON document
+        ROADMAP's autoscaling loop and canary judge consume."""
+        fm = self.fleet_metrics(window_s) if self.federate else {
+            "window_s": window_s, "replicas": {}, "aggregate": None,
+            "disabled": "FLAGS_router_federate=0"}
+        with self._lock:
+            auto = dict(self._autoscale)
+        fm.update({
+            "time": time.time(),
+            "federate": self.federate,
+            "router": {
+                "request_ms": {
+                    "p50": self._db.quantile("router_request_ms", 50,
+                                             window_s),
+                    "p99": self._db.quantile("router_request_ms", 99,
+                                             window_s),
+                    "samples": len(self._db.window("router_request_ms",
+                                                   window_s)),
+                },
+                "requests_rate_per_s": self._db.rate(
+                    "router_requests_total", window_s),
+                "failures_rate_per_s": self._db.rate(
+                    "router_request_failures", window_s),
+                "replicas_up": self._db.last("fleet_replicas_up"),
+            },
+            "slo": self.burn_monitor.state(),
+            "autoscale": auto,
+            "tsdb": self._db.stats(),
+        })
+        return fm
+
+    def fleet_prometheus_text(self) -> str:
+        """``paddle_tpu_fleet_*`` families for the router's
+        ``/metrics``: per-replica ``replica="host:port"``-labeled
+        samples plus the unlabeled fleet aggregate (sum for counters
+        and gauges), in strict exposition format (validated live by
+        the router tests).  Scraping the router yields the whole
+        fleet, labeled — the Prometheus-shaped half of federation."""
+        if not self.federate:
+            return ""
+        fm = self.fleet_metrics()
+        lines = []
+        per_rep = fm["replicas"]
+        for kind_key, kind in (("counters", "counter"),
+                               ("gauges", "gauge")):
+            fams = fm["aggregate"][kind_key]
+            for short in sorted(fams):
+                pn = f"{_PROM_PREFIX}fleet_{short}"
+                lines.append(f"# HELP {pn} fleet-aggregated {short} "
+                             f"(sum over replicas; per-replica samples "
+                             f"labeled)")
+                lines.append(f"# TYPE {pn} {kind}")
+                for rid in sorted(per_rep):
+                    v = per_rep[rid][kind_key].get(short)
+                    if v is not None:
+                        lines.append(f'{pn}{{replica="{rid}"}} {v}')
+                agg = fams[short]
+                total = agg["total"] if kind == "counter" \
+                    else agg["sum"]
+                lines.append(f"{pn} {total}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
     # -- introspection ------------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
@@ -687,6 +977,7 @@ class Router:
                             if r["ready"] and not r["ejected"]),
             "request_ms": self._h_request.summary(),
             "autoscale": auto,
+            "slo": self.burn_monitor.state(),
         }
 
     def healthz(self) -> Tuple[int, dict]:
@@ -703,6 +994,7 @@ class Router:
             "replicas": len(reps),
             "routable": len(routable),
             "autoscale": auto,
+            "alerts_firing": self.burn_monitor.firing(),
         }
 
     def statusz(self) -> dict:
@@ -732,7 +1024,7 @@ class _RouterHandler(_JsonHandler):
     logger = logger
 
     def do_GET(self):
-        route = self.path.split("?", 1)[0]
+        route, _, query = self.path.partition("?")
         if route == "/healthz":
             code, payload = self.router.healthz()
             self._reply(code, payload)
@@ -741,12 +1033,218 @@ class _RouterHandler(_JsonHandler):
                 self._reply(503, {"error": "telemetry disabled",
                                   "detail": "FLAGS_telemetry=0"})
                 return
-            self._reply_raw(200, telemetry.prometheus_text().encode(),
+            # local registry families + the federated fleet_* families
+            # (per-replica labeled samples + unlabeled aggregates) in
+            # ONE strict exposition document
+            text = telemetry.prometheus_text() \
+                + self.router.fleet_prometheus_text()
+            self._reply_raw(200, text.encode(),
                             "text/plain; version=0.0.4; charset=utf-8")
+        elif route == "/fleetz":
+            window_s = 60.0
+            for part in query.split("&"):
+                k, _, v = part.partition("=")
+                if k == "window_s" and v:
+                    try:
+                        window_s = max(1.0, float(v))
+                    except ValueError:
+                        self._reply(400, {"error": "bad request",
+                                          "detail": f"window_s={v!r} "
+                                                    "is not a number"})
+                        return
+            self._reply(200, self.router.fleetz(window_s))
         elif route == "/statusz":
             self._reply(200, self.router.statusz())
         else:
             self._reply(404, {"error": "not found", "path": self.path})
+
+    def _wants_stream(self, route: str, body: bytes) -> bool:
+        """A ``/generate`` body asking for the NDJSON streaming
+        contract: such a response must be forwarded LINE BY LINE —
+        buffering it through the normal route() path would deliver
+        every token at once and silently destroy the client-side
+        TTFT/ITL measurement the contract exists for."""
+        if route != "/generate" or b'"stream"' not in body:
+            return False
+        try:
+            return bool(json.loads(body or b"{}").get("stream"))
+        except (ValueError, AttributeError):
+            return False  # malformed body: let the replica 400 it
+
+    def _forward_stream(self, route: str, body: bytes,
+                        trace_id: Optional[str],
+                        deadline_ms: Optional[float], t0: float):
+        """Streaming forward with route()'s exact containment
+        taxonomy: pick → POST, where the CONNECT + response-HEADERS
+        phase is bounded by the deadline-tightened forward timeout (a
+        replica streams its headers at admission, before the first
+        token, so a wedged one is caught here exactly like a one-shot
+        hop — strike, one retry on an alternate, 504 when none; a
+        deadline-bound timeout is a deadline shed).  Once headers
+        arrive the socket timeout widens to the request timeout for
+        the body copy: a stream legitimately pauses between tokens
+        far longer than a hop, and once bytes went out no retry is
+        possible anyway, so a mid-stream stall just ends the copy.
+        Replica non-200s pass through verbatim (and count as routed,
+        like route()); the ``router_forward`` fault site covers every
+        attempt so the chaos slow/fail scenarios exercise streams
+        too."""
+        router = self.router
+        router._count("requests")
+        stat_add("router_http_requests")
+        tried: List[str] = []
+        rep = router.pick()
+        retried = False
+        while rep is not None:
+            remaining_ms = None
+            if deadline_ms is not None:
+                remaining_ms = deadline_ms \
+                    - (time.monotonic() - t0) * 1e3
+                if remaining_ms <= 0:
+                    res = router._shed_deadline(trace_id, deadline_ms,
+                                                retried)
+                    self._reply_raw(res["code"], res["body"],
+                                    res["content_type"],
+                                    trace_id=trace_id)
+                    return res["code"], None
+            deadline_bound = (remaining_ms is not None
+                              and remaining_ms / 1e3
+                              < router.forward_timeout_s)
+            timeout_s = router.forward_timeout_s \
+                if remaining_ms is None \
+                else max(0.05, min(router.forward_timeout_s,
+                                   remaining_ms / 1e3))
+            headers = {"Content-Type": "application/json",
+                       TRACE_HEADER: trace_id or ""}
+            if remaining_ms is not None:
+                headers[DEADLINE_HEADER] = f"{remaining_ms:.1f}"
+            host_port = rep.url.split("://", 1)[-1]
+            with router._lock:
+                rep.inflight += 1
+            conn = None
+            try:
+                kind = fault.fire("router_forward")
+                fault.maybe_delay(kind)  # chaos 'slow' covers streams
+                if kind == "fail":
+                    raise ConnectionRefusedError(
+                        "injected router_forward failure")
+                conn = http.client.HTTPConnection(host_port,
+                                                  timeout=timeout_s)
+                conn.request("POST", route, body, headers)
+                resp = conn.getresponse()  # headers: forward-timeout
+            except Exception as e:  # noqa: BLE001 — sort, don't die
+                with router._lock:
+                    rep.inflight -= 1
+                    rep.errors += 1
+                if conn is not None:
+                    conn.close()
+                timed_out = _is_timeout_error(e)
+                if timed_out and deadline_bound:
+                    res = router._shed_deadline(trace_id, deadline_ms,
+                                                retried)
+                    self._reply_raw(res["code"], res["body"],
+                                    res["content_type"],
+                                    trace_id=trace_id)
+                    return res["code"], rep.url
+                if timed_out:
+                    router._count("forward_timeouts")
+                    stat_add("router_forward_timeouts")
+                    router._poll_failed(
+                        rep, f"forward timeout ({timeout_s:.2f}s)")
+                if (timed_out or _is_connect_error(e)) and not tried:
+                    tried.append(rep.url)
+                    if not timed_out:
+                        router._poll_failed(rep, f"connect: {e}")
+                    alt = router.pick(exclude=tried)
+                    if alt is not None:
+                        router._count("retries")
+                        stat_add("router_retries")
+                        retried = True
+                        rep = alt
+                        continue
+                    if not timed_out:
+                        rep = None
+                        continue
+                if timed_out:
+                    self._reply(504, {"error": "forward_timeout",
+                                      "replica": rep.url,
+                                      "timeout_ms": round(
+                                          timeout_s * 1e3, 1),
+                                      "trace_id": trace_id},
+                                trace_id=trace_id)
+                    return 504, rep.url
+                router._count("replica_errors")
+                stat_add("router_replica_errors")
+                logger.warning("stream forward to %s failed: %s",
+                               rep.url, e)
+                self._reply(502, {"error": "replica_error",
+                                  "replica": rep.url,
+                                  "detail": f"{type(e).__name__}: {e}",
+                                  "trace_id": trace_id},
+                            trace_id=trace_id)
+                return 502, rep.url
+            try:
+                if resp.status != 200:
+                    # the replica ANSWERED (shed/400/...): nothing
+                    # was streamed, the verdict passes through
+                    # verbatim — and counts as routed, like route()
+                    data = resp.read()
+                    ra = resp.headers.get("Retry-After")
+                    self._reply_raw(
+                        resp.status, data,
+                        resp.headers.get("Content-Type",
+                                         "application/json"),
+                        trace_id=trace_id,
+                        headers={"Retry-After": ra} if ra else None)
+                else:
+                    # headers out, then the line-by-line copy: the
+                    # client's first token line arrives when the
+                    # replica's does.  Body reads get the WIDE timeout
+                    if conn.sock is not None:
+                        conn.sock.settimeout(router.request_timeout_s)
+                    self.send_response(resp.status)
+                    self.send_header(
+                        "Content-Type",
+                        resp.headers.get("Content-Type",
+                                         "application/x-ndjson"))
+                    self.send_header("Connection", "close")
+                    if trace_id:
+                        self.send_header(TRACE_HEADER, trace_id)
+                    self.end_headers()
+                    self.close_connection = True
+                    try:
+                        for raw in resp:
+                            self.wfile.write(raw)
+                            self.wfile.flush()
+                    except OSError:
+                        pass  # ok: client hung up mid-stream; the
+                        # replica finishes its sequence regardless
+            finally:
+                conn.close()
+                with router._lock:
+                    rep.inflight -= 1
+                    rep.routed += 1
+                    if retried:
+                        rep.retries_to += 1
+            router._count("routed")
+            stat_add("router_requests_routed")
+            if resp.status == 200:
+                ms = (time.monotonic() - t0) * 1e3
+                router._h_request.observe(ms, trace_id=trace_id)
+                telemetry.histogram_observe("router_request_ms", ms,
+                                            trace_id=trace_id)
+                router._db.record("router_request_ms", ms, cap=4096)
+            return resp.status, rep.url
+        router._count("no_ready")
+        stat_add("router_no_ready_replicas")
+        retry_after = int(math.ceil(min(30.0, max(1.0,
+                                                  router._stale_s))))
+        self._reply(503, {"error": "overloaded",
+                          "reason": "no_ready_replicas",
+                          "retry_after_s": retry_after,
+                          "trace_id": trace_id}, trace_id=trace_id,
+                    headers={"Retry-After": str(retry_after)})
+        return 503, None
 
     def do_POST(self):
         try:
@@ -774,6 +1272,29 @@ class _RouterHandler(_JsonHandler):
             if dflt > 0:
                 deadline_ms = dflt
         t0 = time.monotonic()
+        if self._wants_stream(route, body):
+            root = telemetry.span_begin("router/request", detached=True,
+                                        trace_id=trace_id, path=route,
+                                        stream=True)
+            try:
+                code, replica = self._forward_stream(
+                    route, body, trace_id, deadline_ms, t0)
+            except Exception as e:  # noqa: BLE001 — a passthrough bug
+                # must not drop the connection silently (headers may
+                # already be out; best-effort close, honest log line)
+                logger.exception("stream forward (%s) raised", route)
+                code, replica = 500, None
+            finally:
+                if root is not None:
+                    root.attrs["status"] = code
+                telemetry.span_end(root)
+            self.access_log.write({
+                "ts": round(time.time(), 6), "method": "POST",
+                "path": route, "status": code,
+                "ms": round((time.monotonic() - t0) * 1e3, 3),
+                "trace_id": trace_id, "tier": "router",
+                "replica": replica, "stream": True})
+            return
         root = telemetry.span_begin("router/request", detached=True,
                                     trace_id=trace_id, path=route)
         fwd = telemetry.span_begin(

@@ -24,11 +24,17 @@ Endpoints:
   router) tightens the engine deadline: an exhausted budget sheds at
   admission (503 ``deadline``) instead of burning a batch slot.
 * ``POST /generate`` — body ``{"prompt": [token ids],
-  "max_new_tokens": N?}`` against the attached
+  "max_new_tokens": N?, "stream": bool?}`` against the attached
   :class:`~paddle_tpu.serving.generation.GenerationEngine` (slot-based
   continuous batching).  200 → ``{"tokens": [...], "prompt_len",
   "steps", "finish": "eos" | "length" | "cache_full", "trace_id",
-  "queue_wait_ms", "prefill_ms", "total_ms", "ms"}``.  Sheds → **503**
+  "queue_wait_ms", "prefill_ms", "ttft_ms", "total_ms", "ms",
+  "timeline"?}`` (``timeline``: the per-sequence phase/token record —
+  telemetry on).  With ``"stream": true`` the response is NDJSON —
+  one ``{"i", "token"}`` line per token AS IT IS GENERATED, then one
+  ``{"done": true, ...result}`` summary line; framed by ``Connection:
+  close`` (no Content-Length), which is what lets a client measure
+  true TTFT and inter-token latency.  Sheds → **503**
   like ``/predict``; malformed or over-long prompts → 400; no
   generator attached → 404.
 * ``GET /healthz`` — 200 with :meth:`ServingEngine.health` (serving
@@ -113,6 +119,43 @@ def parse_deadline_header(value) -> Optional[float]:
     except ValueError:
         return None
     return ms if math.isfinite(ms) else None
+
+
+_slo_monitor = None
+_slo_monitor_lock = threading.Lock()
+
+
+def replica_slo_monitor():
+    """The replica-tier burn-rate monitor (lazily built, process-wide):
+    availability over batch failures vs batches served (cadence-fed by
+    :func:`telemetry.maybe_flush`), latency over the raw per-request
+    ``serving_request_ms`` samples the engine records at resolve time.
+    The fleet router runs the fleet-level twin over federated series;
+    this one makes a single replica's ``/statusz`` alert-capable on
+    its own."""
+    global _slo_monitor
+    from .. import tsdb
+
+    if _slo_monitor is None:
+        with _slo_monitor_lock:
+            if _slo_monitor is None:
+                slo_ms = float(flag_value("FLAGS_slo_p99_ms") or 0.0) \
+                    or float(flag_value("FLAGS_router_slo_p99_ms")
+                             or 250.0)
+                _slo_monitor = tsdb.BurnRateMonitor(tsdb.default(), [
+                    tsdb.SloSpec("availability", "availability",
+                                 error_series="serving_batch_failures",
+                                 total_series="serving_batches"),
+                    # raw per-request samples (the engine records them
+                    # at resolve time), NOT the histogram's p99 series:
+                    # lifetime-cumulative percentiles would latch the
+                    # alert long after a spike recovered
+                    tsdb.SloSpec("p99", "latency",
+                                 latency_series="serving_request_ms",
+                                 threshold_ms=slo_ms,
+                                 objective_pct=99.0),
+                ])
+    return _slo_monitor
 
 
 class _AccessLog:
@@ -258,12 +301,20 @@ class _Handler(_JsonHandler):
 
     def _get_statusz(self):
         """Operator snapshot — works with telemetry off too (flags and
-        engine state carry no telemetry dependency)."""
+        engine state carry no telemetry dependency; the tsdb/alerts
+        blocks are None then)."""
+        from .. import tsdb as _tsdb
+
         tele = {"enabled": telemetry.enabled(),
                 "access_log": self.access_log.path(),
                 "metrics_dir": flag_value("FLAGS_metrics_dir") or None,
                 "trace_sample": flag_value("FLAGS_trace_sample"),
                 "trace_tail_keep": flag_value("FLAGS_trace_tail_keep")}
+        slo = None
+        db_stats = None
+        if telemetry.enabled() and _tsdb.enabled():
+            slo = replica_slo_monitor().evaluate()
+            db_stats = _tsdb.default().stats()
         self._reply(200, {
             "pid": os.getpid(),
             "time": time.time(),
@@ -276,6 +327,8 @@ class _Handler(_JsonHandler):
             "flags": all_flags(),
             "device": {"peaks": costmodel.device_peaks(),
                        "hbm": observatory.hbm_snapshot()},
+            "slo": slo,
+            "tsdb": db_stats,
             "engine": self.engine.introspect(),
         })
 
@@ -352,13 +405,19 @@ class _Handler(_JsonHandler):
                                                   deadline_ms)
         tid = ((trace or {}).get("trace_id") or payload.get("trace_id")
                or hop_trace)
-        headers = None
-        if code == 503 and payload.get("retry_after_s"):
-            # explicit backpressure carries its backoff hint: clients
-            # (and the loadgen) back off instead of hammering
-            headers = {"Retry-After":
-                       str(int(math.ceil(payload["retry_after_s"])))}
-        self._reply(code, payload, trace_id=tid, headers=headers)
+        if code is None:
+            # a streaming reply already went out on the wire
+            # (_generate_stream); only the access log is left
+            code = payload.get("http_status", 200)
+        else:
+            headers = None
+            if code == 503 and payload.get("retry_after_s"):
+                # explicit backpressure carries its backoff hint:
+                # clients (and the loadgen) back off instead of
+                # hammering
+                headers = {"Retry-After":
+                           str(int(math.ceil(payload["retry_after_s"])))}
+            self._reply(code, payload, trace_id=tid, headers=headers)
         ms = (time.monotonic() - t0) * 1e3
         rec = {"ts": round(time.time(), 6), "method": "POST",
                "path": route, "status": code, "ms": round(ms, 3),
@@ -388,9 +447,13 @@ class _Handler(_JsonHandler):
             if not isinstance(prompt, list):
                 raise TypeError("'prompt' must be a list of token ids")
             mnt = doc.get("max_new_tokens")
+            stream = bool(doc.get("stream"))
         except (KeyError, TypeError, ValueError) as e:
             return 400, {"error": "bad request",
                          "detail": f"{type(e).__name__}: {e}"}, None
+        if stream:
+            return self._generate_stream(gen, prompt, mnt, hop_trace,
+                                         deadline_ms)
         t0 = time.monotonic()
         try:
             fut = self.engine.submit_generate(prompt, max_new_tokens=mnt,
@@ -418,6 +481,109 @@ class _Handler(_JsonHandler):
                           "phases": {
                               "queue_wait_ms": res.get("queue_wait_ms"),
                               "predict_ms": res.get("prefill_ms")}}
+
+    def _generate_stream(self, gen, prompt, mnt,
+                         hop_trace: Optional[str],
+                         deadline_ms: Optional[float]):
+        """``{"stream": true}`` generation: one NDJSON line per token,
+        written the moment the scheduler books it (the engine's
+        ``on_token`` hook feeds a handler-side queue, so a slow client
+        never blocks the decode grid), then a final ``{"done": true,
+        ...}`` summary line carrying the full result record (timeline
+        included).  No Content-Length — the response frames by
+        ``Connection: close``, which urllib and the loadgen read
+        line-by-line; that is what makes CLIENT-side TTFT and
+        inter-token latency measurable at all.  Admission sheds and
+        bad prompts still answer plain JSON (nothing streamed yet).
+        Returns ``(None, summary, trace)``: None tells ``do_POST`` the
+        bytes are already on the wire."""
+        import queue as queue_mod
+
+        q: queue_mod.Queue = queue_mod.Queue()
+        t0 = time.monotonic()
+        try:
+            fut = self.engine.submit_generate(
+                prompt, max_new_tokens=mnt, trace_id=hop_trace,
+                deadline_ms=deadline_ms,
+                on_token=lambda tok, ts: q.put((tok, ts)))
+        except OverloadedError as e:
+            return 503, {"error": "overloaded", "reason": e.reason,
+                         "detail": str(e),
+                         "retry_after_s": round(gen.retry_after_s(), 3),
+                         "trace_id": getattr(e, "trace_id", None)}, None
+        except ValueError as e:
+            return 400, {"error": "bad request", "detail": str(e)}, None
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Connection", "close")
+        if hop_trace:
+            self.send_header(TRACE_HEADER, hop_trace)
+        self.end_headers()
+        self.close_connection = True
+        wait_s = self._wait_s(deadline_ms)
+        t_give_up = None if wait_s is None else t0 + wait_s
+        n = 0
+        client_gone = False
+        timed_out = False
+        while True:
+            try:
+                tok, ts = q.get(timeout=0.05)
+            except queue_mod.Empty:
+                if fut.done() and q.empty():
+                    break
+                if t_give_up is not None \
+                        and time.monotonic() > t_give_up:
+                    timed_out = True
+                    break
+                continue
+            n += 1
+            if client_gone:
+                continue  # drain for accounting, write nothing
+            line = json.dumps({"i": n, "token": int(tok)}) + "\n"
+            try:
+                self.wfile.write(line.encode())
+                self.wfile.flush()
+            except OSError:
+                # the client hung up mid-stream: the sequence keeps
+                # generating (no cancellation), we just stop writing
+                client_gone = True
+        final = {"done": True}
+        status = 200
+        try:
+            # the loop only exits with the future resolved or the wait
+            # budget spent — never block the handler a second time
+            res = dict(fut.result(0.001))
+            res.pop("logits", None)
+            res["ms"] = round((time.monotonic() - t0) * 1e3, 3)
+            res["streamed_tokens"] = n
+            final.update(res)
+        except (RequestFailed, TimeoutError) as e:
+            status = 500
+            final.update({"error": "request failed",
+                          "detail": "stream timeout" if timed_out
+                          else str(e)})
+        except OverloadedError as e:
+            # shed after admission (draining close): surfaced on the
+            # final line — the HTTP status is long gone
+            status = 503
+            final.update({"error": "overloaded", "reason": e.reason,
+                          "detail": str(e)})
+        if not client_gone:
+            try:
+                self.wfile.write((json.dumps(final) + "\n").encode())
+                self.wfile.flush()
+            except OSError:
+                client_gone = True
+        summary = {"http_status": status, "stream": True,
+                   "streamed_tokens": n, "client_gone": client_gone,
+                   "trace_id": final.get("trace_id") or hop_trace}
+        trace = {"trace_id": summary["trace_id"],
+                 "rows": final.get("steps"),
+                 "status": ("ok:" + final.get("finish", "")
+                            if status == 200 else f"error:{status}"),
+                 "phases": {"queue_wait_ms": final.get("queue_wait_ms"),
+                            "predict_ms": final.get("prefill_ms")}}
+        return None, summary, trace
 
     def _wait_s(self, deadline_ms: Optional[float]) -> Optional[float]:
         """How long the handler thread blocks for the future: the

@@ -958,30 +958,54 @@ _flush_state = {"last": 0.0}
 _flush_lock = threading.Lock()
 
 
+def _tsdb_sample():
+    """Record the live registry into the in-process time-series store
+    (:mod:`paddle_tpu.tsdb`) — the windowed-history half of the flush
+    cadence.  It needs no metrics dir (the store is in-memory) and is
+    itself gated on ``FLAGS_tsdb``."""
+    from . import tsdb
+    tsdb.sample_registry(metrics)
+
+
 def maybe_flush() -> bool:
-    """Hot-path cadence check: flush the file exporters if at least
-    ``FLAGS_metrics_interval`` seconds passed since the last flush.
-    Costs one monotonic read + a comparison when it's not yet time."""
-    if not enabled() or _metrics_dir() is None:
+    """Hot-path cadence check: sample the time-series store and flush
+    the file exporters if at least ``FLAGS_metrics_interval`` seconds
+    passed since the last flush.  Costs one monotonic read + a
+    comparison when it's not yet time.  Returns True only when the
+    file exporters ran (the tsdb sample also fires on the cadence
+    WITHOUT a metrics dir — windowed queries must work in-memory-only
+    deployments)."""
+    if not enabled():
         return False
     now = time.monotonic()
     # explicit 0.0 means flush every step — `or` would eat it
     interval = flag_value("FLAGS_metrics_interval")
     interval = 10.0 if interval is None else float(interval)
+    # lock-free fast path: this runs on EVERY executor step, and with
+    # the tsdb in the cadence it now runs even without a metrics dir —
+    # the not-yet-time check must cost a read and a compare, not a
+    # lock acquisition (double-checked under the lock before firing)
+    if now - _flush_state["last"] < interval:
+        return False
     with _flush_lock:
         if now - _flush_state["last"] < interval:
             return False
         _flush_state["last"] = now
-    flush(force=False)
+    if _metrics_dir() is None:
+        _tsdb_sample()
+        return False
+    flush(force=False)  # flush() samples the tsdb too
     return True
 
 
 def flush(force: bool = True):
-    """Write every exporter now: Prometheus textfile, heartbeat, and the
-    span ring as ``trace.json``.  ``force=True`` also resets the cadence
-    clock (used at run end: TrainGuard.close/finalize, Executor.close)."""
+    """Write every exporter now: the tsdb sample, Prometheus textfile,
+    heartbeat, and the span ring as ``trace.json``.  ``force=True``
+    also resets the cadence clock (used at run end:
+    TrainGuard.close/finalize, Executor.close)."""
     if not enabled():
         return
+    _tsdb_sample()
     d = _metrics_dir()
     if d is None:
         return

@@ -665,8 +665,17 @@ def test_chaos_harness_smoke_three_replica_fleet():
     assert report["availability_pct"] >= 99.0, report
     assert report["ok"] is True
     scen = report["scenarios"]
-    assert set(scen) == {"crash", "hang", "slow", "poison",
+    assert set(scen) == {"baseline", "crash", "hang", "slow", "poison",
                          "poison_paged"}
+    # burn-rate alert contract: clean scenarios silent, every fault
+    # window saw an alert fire and clear (errors == {} above already
+    # rules out violations; these check the recorded evidence)
+    assert totals["alert_errors"] == 0
+    assert scen["baseline"]["alerts"]["fired"] == []
+    for fault_scen in ("crash", "hang"):
+        al = scen[fault_scen]["alerts"]
+        assert al["fired_in_window"], (fault_scen, al)
+        assert al["cleared"] is True, (fault_scen, al)
     # poison scenario proved bisection end-to-end: the poisoned
     # requests failed (injected), their batchmates did not
     assert scen["poison"]["injected_failures"] >= 1

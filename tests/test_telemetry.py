@@ -467,7 +467,11 @@ def test_trace_export_tool_merges_spans_and_events(tmp_path):
     with open(out) as f:
         doc = json.load(f)
     assert doc["traceEvents"]
-    assert all(e["name"].startswith("ckpt/") for e in doc["traceEvents"])
+    # spans narrow to the filter; counter tracks ('C') keep riding —
+    # a filtered view must not lose its occupancy/HBM context
+    assert all(e["name"].startswith("ckpt/") or e.get("ph") == "C"
+               for e in doc["traceEvents"])
+    assert any(e["name"].startswith("ckpt/") for e in doc["traceEvents"])
 
 
 def test_trace_export_merges_multiple_metrics_dirs(tmp_path):
@@ -481,6 +485,14 @@ def test_trace_export_merges_multiple_metrics_dirs(tmp_path):
     with telemetry.trace_span("serving/queue_wait", parent=root.context()):
         pass
     telemetry.span_end(root)
+    # a replica's generation observability artifacts: the sequence
+    # timeline span (trace-linked) + the per-slot occupancy counter
+    # track — both must survive the merge under this source's pid
+    seq = telemetry.span_begin("generation/sequence", detached=True,
+                               slot=0, prompt_len=4)
+    telemetry.span_end(seq)
+    telemetry.counter_sample("generation_slots",
+                             {"slot0": 1.0, "slot1": 0.0, "active": 1.0})
     os.makedirs(serve_dir, exist_ok=True)
     telemetry.export_chrome_trace(os.path.join(serve_dir, "trace.json"))
 
@@ -505,6 +517,14 @@ def test_trace_export_merges_multiple_metrics_dirs(tmp_path):
             pids_by_name.setdefault(e["name"], set()).add(e["pid"])
     assert pids_by_name["executor/step"] == {1}
     assert pids_by_name["serving/request"] == {2}
+    # the serving source's sequence timeline + slot-occupancy counter
+    # track landed in ITS process group, as 'X'/'C' events
+    assert pids_by_name["generation/sequence"] == {2}
+    assert pids_by_name["generation_slots"] == {2}
+    slots = [e for e in evs if e["name"] == "generation_slots"]
+    assert slots and all(e["ph"] == "C" for e in slots)
+    assert slots[0]["args"] == {"slot0": 1.0, "slot1": 0.0,
+                                "active": 1.0}
     # the serving spans kept one trace_id across the merge
     sv = [e for e in evs
           if e["name"] in ("serving/request", "serving/queue_wait")]

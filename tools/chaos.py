@@ -17,7 +17,17 @@ processes and real sockets, and the run FAILS unless:
 * **zero poison leaks** — a poisoned request that returned 200 means
   bisection served a row the model should have crashed on;
 * **availability >= the budget** (default 99%) over all non-poisoned
-  requests across every scenario, injected damage included.
+  requests across every scenario, injected damage included;
+
+* **the burn-rate alert contract holds** — the router's multi-window
+  SLO burn-rate monitor (paddle_tpu/tsdb.py, windows scaled to
+  scenario time) must FIRE inside every crash/hang fault window (a
+  dead or wedged replica burns replica-availability budget at 10-30x)
+  and CLEAR after recovery, and a clean scenario — the leading
+  ``baseline`` (no injection at all), ``slow``, ``poison`` — must
+  raise ZERO alerts (the false-positive guard).  Both verdicts are
+  scenario errors riding the same hard gate as collateral failures
+  (``totals.alert_errors`` in the report).
 
 Scenarios (one shared fleet; traffic is open-loop ``POST /predict``
 through the router):
@@ -88,7 +98,53 @@ POISON = 1e30
 # token >= POISON_TOKEN + 1 so only deliberate prompts carry it
 POISON_TOKEN = 7
 
-DEFAULT_SCENARIOS = ("crash", "hang", "slow", "poison", "poison_paged")
+DEFAULT_SCENARIOS = ("baseline", "crash", "hang", "slow", "poison",
+                     "poison_paged")
+
+# burn-rate scaling for the chaos run: scenario durations are seconds,
+# not SRE hours, so the router's alert windows shrink to fractions of
+# one scenario (fast proves "still happening", slow proves "real")
+_ALERT_CLEAR_GRACE_S = 5.0
+
+
+class _AlertSampler:
+    """Samples the router burn-rate monitor's firing set on a fast
+    clock while a scenario runs, so assertions can ask 'did an alert
+    fire INSIDE the fault window' and 'was it clear at the end' from
+    the recorded (t, names) trail instead of racing the live state."""
+
+    def __init__(self, router, period_s: float = 0.05):
+        self._router = router
+        self._period = period_s
+        self.samples: List[tuple] = []  # (monotonic_t, (name, ...))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="chaos-alert-sampler",
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self._period):
+            self.samples.append(
+                (time.monotonic(),
+                 tuple(self._router.burn_monitor.firing())))
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def fired_between(self, t0: float, t1: float) -> List[str]:
+        names = set()
+        for t, firing in self.samples:
+            if t0 <= t <= t1:
+                names.update(firing)
+        return sorted(names)
+
+    def fired_ever(self) -> List[str]:
+        names = set()
+        for _, firing in self.samples:
+            names.update(firing)
+        return sorted(names)
 
 
 # ---------------------------------------------------------------------------
@@ -271,7 +327,12 @@ def _scenario(name: str, sup, router, url: str, cfg: dict) -> dict:
     injector = None
     poison_every = 0
 
-    if name in ("crash", "hang"):
+    if name == "baseline":
+        # clean traffic, no injection: the burn-rate false-positive
+        # guard (zero alerts allowed) plus the usual hard-zero
+        # collateral contract
+        pass
+    elif name in ("crash", "hang"):
         victim = sup._replicas[0]
         old_pid = victim.proc.pid
         sig = signal.SIGKILL if name == "crash" else signal.SIGSTOP
@@ -304,6 +365,7 @@ def _scenario(name: str, sup, router, url: str, cfg: dict) -> dict:
     else:
         raise ValueError(f"unknown scenario {name!r}")
 
+    sampler = _AlertSampler(router)
     try:
         records = run_traffic(url, feat, qps, duration,
                               poison_every=poison_every,
@@ -333,9 +395,44 @@ def _scenario(name: str, sup, router, url: str, cfg: dict) -> dict:
         # right after the successor reports ready
         w_end = (box["t_recover"] or time.monotonic()) + 1.0
         windows.append((box["t_fault"], w_end))
+
+    # burn-rate alert contract.  Fault scenarios (a window exists):
+    # an alert must FIRE inside the window and CLEAR after recovery.
+    # Clean scenarios (baseline / slow / poison): any firing alert is
+    # a false positive.  Both are scenario errors — they ride the same
+    # hard gate as collateral failures.
+    alerts: Dict[str, object] = {}
+    if windows:
+        w0, w1 = windows[0]
+        # the fast window must age past the fault before the clear
+        # verdict; sample until cleared or the grace runs out
+        clear_deadline = time.monotonic() \
+            + router.burn_monitor.fast_s + _ALERT_CLEAR_GRACE_S
+        while time.monotonic() < clear_deadline \
+                and router.burn_monitor.firing():
+            time.sleep(0.1)
+        sampler.stop()
+        fired = sampler.fired_between(w0, w1)
+        still = router.burn_monitor.firing()
+        alerts = {"fired_in_window": fired, "cleared": not still,
+                  "still_firing": still}
+        if error is None and not fired:
+            error = ("burn-rate alert never fired inside the "
+                     f"{name} fault window")
+        elif error is None and still:
+            error = (f"burn-rate alert(s) {still} never cleared "
+                     f"after {name} recovery")
+    else:
+        sampler.stop()
+        fired = sampler.fired_ever()
+        alerts = {"fired": fired, "expected": "none"}
+        if error is None and fired:
+            error = (f"false-positive burn-rate alert(s) {fired} "
+                     f"during clean scenario {name}")
     rep = classify(records, windows)
     rep["scenario"] = name
     rep["notes"] = notes
+    rep["alerts"] = alerts
     if box["t_fault"] is not None and box["t_recover"] is not None:
         rep["recovery_s"] = round(box["t_recover"] - box["t_fault"], 3)
     if name == "poison" and error is None:
@@ -512,9 +609,16 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
     fault_records: List[dict] = []
     try:
         urls = sup.wait_ready(timeout_s=300)
+        # burn-rate windows scaled to scenario time: fast ~ a quarter
+        # scenario (clears quickly after recovery), slow ~ most of one
+        # (a single bad scrape cannot page).  Alert threshold stays the
+        # flag default — the chaos faults burn budget at 10-30x
+        fast_s = max(1.0, duration_s / 4.0)
+        slow_s = max(fast_s * 2.0, duration_s * 0.75)
         router = Router(urls, poll_interval_ms=100.0, stale_ms=1500.0,
                         eject_after=2,
-                        forward_timeout_ms=forward_timeout_ms)
+                        forward_timeout_ms=forward_timeout_ms,
+                        slo_fast_s=fast_s, slo_slow_s=slow_s)
         server = RouterServer(router).start()
         router.poll_once()
         log(f"chaos: fleet of {replicas} ready in "
@@ -533,12 +637,16 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
             if name in ("crash", "hang"):
                 fault_records.extend(records)
             per_scenario[name] = rep
+            al = rep.get("alerts") or {}
             log(f"chaos: {name}: {rep['requests']} requests, "
                 f"{rep['ok']} ok, {rep['shed']} shed, "
                 f"{rep['injected_failures']} injected, "
                 f"{rep['collateral_failures']} collateral"
                 + (f", recovery {rep['recovery_s']}s"
                    if "recovery_s" in rep else "")
+                + (f", alerts fired {al['fired_in_window']} "
+                   f"cleared={al['cleared']}"
+                   if "fired_in_window" in al else "")
                 + (f" ERROR: {rep['error']}" if "error" in rep else ""))
             # let the fleet settle (router re-admits the recovered
             # replica) before the next scenario's attribution starts
@@ -556,6 +664,12 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
     for k in ("injected_failures", "collateral_failures",
               "poison_leaks"):
         totals[k] = sum(r[k] for r in per_scenario.values())
+    # alert-contract verdicts: missed fires, missed clears, and false
+    # positives all land in scenario errors; this count gives the gate
+    # (and the bench leg) a single number to hard-zero
+    totals["alert_errors"] = sum(
+        1 for r in per_scenario.values()
+        if "error" in r and "burn-rate alert" in r["error"])
     fault_ok_ms = sorted(r["ms"] for r in fault_records
                          if r["outcome"] == "ok")
     p99_under_fault = round(

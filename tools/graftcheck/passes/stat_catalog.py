@@ -6,21 +6,51 @@ every *literal* metric name passed to the monitor / telemetry APIs
 must appear backtick-quoted in the README's stat catalog — renamed
 stats silently break every dashboard reading the old name.
 
-This module also owns :func:`validate_exposition` (strict Prometheus
-text-format validation).  :func:`validate_exposition_violations`
-returns the same findings as :class:`~tools.graftcheck.core.Violation`
-records carrying ``file:line`` provenance — family-level errors
-(missing ``_sum``/``_count``, no ``+Inf`` bucket) anchor to the
-family's ``# TYPE`` line instead of printing a bare metric name.
+This module also fronts the strict Prometheus text-format validation
+(:func:`validate_exposition`): the implementation lives in
+``paddle_tpu/promtext.py`` — the SAME module the fleet router's
+federation scraper parses replica ``/metrics`` with, so the validator
+and the scraper can never disagree about the format.  It is loaded
+here by file path (never ``import paddle_tpu``): the lint must not
+import the heavyweight package it is analyzing.
+:func:`validate_exposition_violations` returns the findings as
+:class:`~tools.graftcheck.core.Violation` records carrying
+``file:line`` provenance — family-level errors (missing
+``_sum``/``_count``, no ``+Inf`` bucket) anchor to the family's
+``# TYPE`` line instead of printing a bare metric name.
 """
 from __future__ import annotations
 
 import ast
+import importlib.util
 import os
 import re
+import sys
 from typing import List, Optional, Tuple
 
 from ..core import REPO, SourceFile, Violation, register_pass
+
+
+def _load_promtext():
+    """The shared exposition module, WITHOUT importing the paddle_tpu
+    package (promtext.py is stdlib-only by contract; an already-loaded
+    runtime copy is reused so the two views share one module)."""
+    mod = sys.modules.get("paddle_tpu.promtext")
+    if mod is not None:
+        return mod
+    mod = sys.modules.get("_graftcheck_promtext")
+    if mod is not None:
+        return mod
+    path = os.path.join(REPO, "paddle_tpu", "promtext.py")
+    spec = importlib.util.spec_from_file_location("_graftcheck_promtext",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_graftcheck_promtext"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+promtext = _load_promtext()
 
 BARE_FUNCS = {"stat_add", "stat_get", "gauge_set", "histogram_observe"}
 TELEMETRY_ATTRS = {"gauge_set", "histogram_observe", "timer"}
@@ -127,130 +157,26 @@ def run(files: List[SourceFile]) -> List[Violation]:
 
 
 # ---------------------------------------------------------------------------
-# strict Prometheus text-exposition validation
+# strict Prometheus text-exposition validation (shared implementation:
+# paddle_tpu/promtext.py — see _load_promtext above)
 # ---------------------------------------------------------------------------
 
-PROM_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
-PROM_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
-_SAMPLE_RE = re.compile(
-    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"           # metric name
-    r"(\{[^{}]*\})?"                          # optional {labels}
-    r" (-?(?:[0-9.eE+-]+|\+?Inf|-Inf|NaN))"   # value (one space before)
-    r"( [0-9]+)?$")                           # optional ms timestamp
-_LABELS_RE = re.compile(
-    r'^\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
-    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*,?)?\}$')
-
-
-def _family_of(name: str, typed: dict) -> str:
-    """Map a histogram/summary component sample back to its family
-    (``x_bucket``/``x_sum``/``x_count`` -> ``x`` when ``x`` is typed
-    histogram or summary)."""
-    for suffix in ("_bucket", "_sum", "_count"):
-        if name.endswith(suffix):
-            base = name[: -len(suffix)]
-            if typed.get(base) in ("histogram", "summary"):
-                return base
-    return name
+# historical re-exports: tests and the check_stat_catalog shim import
+# these names from here
+PROM_NAME_RE = promtext.PROM_NAME_RE
+PROM_TYPES = promtext.PROM_TYPES
+_SAMPLE_RE = promtext.SAMPLE_RE
+_LABELS_RE = promtext.LABELS_RE
+_family_of = promtext._family_of
 
 
 def _validate_exposition_impl(text: str) -> List[Tuple[int, str]]:
     """Strict Prometheus text-exposition validation; returns
-    ``(lineno, message)`` pairs.  Family-level findings (missing
-    ``+Inf`` bucket / ``_sum`` / ``_count``) carry the family's
-    ``# TYPE`` line — provenance the bare-name messages used to lack.
-
-    Enforced: every non-comment line is a well-formed sample
-    (``name{labels} value [timestamp]``); metric names match the
-    Prometheus charset; every sample's family carries ``# HELP`` and
-    ``# TYPE`` lines that PRECEDE its samples; at most one HELP/TYPE
-    per family; TYPE values are real Prometheus types; no duplicate
-    series (same name + label set); histogram families expose
-    ``_bucket``/``_sum``/``_count`` with a ``+Inf`` bucket."""
-    errors: List[Tuple[int, str]] = []
-    helped: dict = {}
-    typed: dict = {}
-    type_line: dict = {}
-    sampled_families = set()
-    seen_series: dict = {}
-    bucket_infs: dict = {}
-
-    for lineno, line in enumerate(text.splitlines(), 1):
-        def err(msg):
-            errors.append((lineno, f"{msg} -- {line[:80]!r}"))
-
-        if not line.strip():
-            continue
-        if line.startswith("#"):
-            parts = line.split(None, 3)
-            kind = parts[1] if len(parts) > 1 else ""
-            if kind not in ("HELP", "TYPE"):
-                continue  # free-form comment: allowed
-            if len(parts) < 3:
-                err(f"{kind} line without a metric name")
-                continue
-            name = parts[2]
-            if not PROM_NAME_RE.match(name):
-                err(f"bad metric name {name!r} in {kind} line")
-                continue
-            book = helped if kind == "HELP" else typed
-            if name in book:
-                err(f"duplicate # {kind} for {name}")
-            if kind == "HELP":
-                if len(parts) < 4 or not parts[3].strip():
-                    err(f"HELP for {name} has empty docstring")
-                helped.setdefault(name, lineno)
-            else:
-                t = parts[3].strip() if len(parts) > 3 else ""
-                if t not in PROM_TYPES:
-                    err(f"TYPE for {name} is {t!r}, not one of "
-                        f"{sorted(PROM_TYPES)}")
-                typed.setdefault(name, t)
-                type_line.setdefault(name, lineno)
-                if name in sampled_families:
-                    err(f"# TYPE for {name} appears after its samples")
-            continue
-        m = _SAMPLE_RE.match(line)
-        if not m:
-            err("malformed sample line (want 'name{labels} value "
-                "[timestamp]', single spaces)")
-            continue
-        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
-        if labels and not _LABELS_RE.match(labels):
-            err(f"malformed label set {labels!r}")
-        try:
-            float(value.replace("Inf", "inf").replace("NaN", "nan"))
-        except ValueError:
-            err(f"unparseable sample value {value!r}")
-        series = (name, labels)
-        if series in seen_series:
-            err(f"duplicate series {name}{labels} (first at line "
-                f"{seen_series[series]})")
-        else:
-            seen_series[series] = lineno
-        fam = _family_of(name, typed)
-        sampled_families.add(fam)
-        if fam not in typed:
-            err(f"sample for {name} with no preceding # TYPE {fam}")
-        elif fam not in helped:
-            err(f"sample for {name} with no # HELP {fam}")
-        if typed.get(fam) == "histogram" and name == fam + "_bucket":
-            if 'le="+Inf"' in labels:
-                bucket_infs[fam] = True
-            bucket_infs.setdefault(fam, False)
-
-    for fam, has_inf in sorted(bucket_infs.items()):
-        if not has_inf:
-            errors.append((type_line.get(fam, 0),
-                           f"histogram {fam} has no le=\"+Inf\" bucket"))
-    for fam in sorted(f for f, t in typed.items() if t == "histogram"):
-        if fam in sampled_families:
-            for part in ("_sum", "_count"):
-                if (fam + part, "") not in seen_series:
-                    errors.append((type_line.get(fam, 0),
-                                   f"histogram {fam} is missing "
-                                   f"{fam}{part}"))
-    return errors
+    ``(lineno, message)`` pairs (see ``paddle_tpu/promtext.py`` for
+    the enforced rules).  Family-level findings (missing ``+Inf``
+    bucket / ``_sum`` / ``_count``) carry the family's ``# TYPE``
+    line — provenance the bare-name messages used to lack."""
+    return promtext.validate_lines(text)
 
 
 def validate_exposition(text: str) -> List[str]:

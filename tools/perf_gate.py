@@ -157,6 +157,18 @@ def compare_leg(name: str, new: dict, base: dict,
                               f"200 instead of failing (bisection "
                               f"containment leak)")
             return res
+        # burn-rate alert contract (observability hard rule, like the
+        # two above — no anomaly flag shields it): a fault window the
+        # alert missed, a recovery it never cleared after, or a clean
+        # scenario it paged on.  None is allowed — captures predate
+        # the alerting layer
+        alert_errors = new.get("alert_errors")
+        if alert_errors:
+            res.update(status="regression",
+                       reason=f"chaos saw {alert_errors} burn-rate "
+                              f"alert-contract violation(s) (missed "
+                              f"fire / missed clear / false positive)")
+            return res
         # the harness's own verdict: a scenario that errored (watchdog
         # never fired, no poisoned request reached a model, victim
         # never respawned) means a containment mechanism went
@@ -671,6 +683,15 @@ def run_smoke() -> int:
     check("chaos missing-leak-count fails", not r["ok"] and any(
         x["status"] == "regression"
         and "poison-leak" in x.get("reason", "") for x in r["legs"]))
+    alert_err = json.loads(json.dumps(with_chaos))
+    alert_err["legs"]["chaos"]["alert_errors"] = 2
+    alert_err["legs"]["chaos"]["anomaly"] = "core-bound host"
+    r = compare_bench(alert_err, docs + [with_chaos])
+    check("chaos alert-contract violation fails even when anomalous",
+          not r["ok"] and any(
+              x["status"] == "regression"
+              and "burn-rate" in x.get("reason", "")
+              for x in r["legs"]))
     harness_err = json.loads(json.dumps(with_chaos))
     harness_err["legs"]["chaos"]["harness_ok"] = False
     harness_err["legs"]["chaos"]["errors"] = {

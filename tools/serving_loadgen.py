@@ -506,12 +506,48 @@ def prompt_maker(vocab_size: int, prompt_min: int, prompt_max: int,
 
 def _gen_report(mode: str, n: int, ok: int, shed: int, failed: int,
                 wall_s: float, lat_ms: List[float], tokens: int,
-                engine) -> dict:
+                engine, ttft_ms: Optional[List[float]] = None,
+                itl_ms: Optional[List[float]] = None) -> dict:
     rep = _report(mode, n, ok, shed, failed, wall_s, lat_ms, engine)
     rep["generated_tokens"] = tokens
     rep["tokens_per_sec"] = round(tokens / wall_s, 2) if wall_s > 0 \
         else 0.0
+    if ttft_ms is not None:
+        # CLIENT-side time-to-first-token: submit (or POST) instant to
+        # the first token's arrival at the caller — queue wait,
+        # prefix mapping, and chunked-prefill interleave all included,
+        # because the user waits through all of them
+        rep["ttft_ms"] = _percentiles(ttft_ms)
+    if itl_ms is not None:
+        # client-side inter-token gaps, pooled across requests: the
+        # p99 is "how long does a token ever stall", the decode-smooth
+        # number the chunked-prefill knob trades against
+        rep["inter_token_ms"] = _percentiles(itl_ms)
     return rep
+
+
+class _TokenClock:
+    """Per-request token-arrival recorder for the in-process loops:
+    the engine's ``on_token`` hook stamps arrivals on the caller's
+    clock; :meth:`fold` reduces them to a TTFT and inter-token gaps."""
+
+    __slots__ = ("t0", "arrivals")
+
+    def __init__(self, t0: float):
+        self.t0 = t0
+        self.arrivals: List[float] = []
+
+    def on_token(self, tok, ts):
+        self.arrivals.append(time.monotonic())
+
+    def fold(self) -> tuple:
+        """-> (ttft_ms or None, [gap_ms, ...])."""
+        if not self.arrivals:
+            return None, []
+        ttft = (self.arrivals[0] - self.t0) * 1e3
+        gaps = [(b - a) * 1e3
+                for a, b in zip(self.arrivals, self.arrivals[1:])]
+        return ttft, gaps
 
 
 def run_closed_loop_generate(engine, make_prompt, n_requests: int,
@@ -526,6 +562,8 @@ def run_closed_loop_generate(engine, make_prompt, n_requests: int,
     tickets = iter(range(n_requests))
     ticket_lock = threading.Lock()
     lat, lock = [], threading.Lock()
+    ttfts: List[float] = []
+    itls: List[float] = []
     counts = {"ok": 0, "shed": 0, "failed": 0, "tokens": 0}
 
     def caller():
@@ -536,14 +574,20 @@ def run_closed_loop_generate(engine, make_prompt, n_requests: int,
                 return
             prompt, out_len = make_prompt(i)
             t0 = time.monotonic()
+            clock = _TokenClock(t0)
             try:
-                res = engine.generate(prompt, out_len,
-                                      timeout=timeout_s)
+                res = engine.submit(prompt, out_len,
+                                    on_token=clock.on_token
+                                    ).result(timeout_s)
                 ms = (time.monotonic() - t0) * 1e3
+                ttft, gaps = clock.fold()
                 with lock:
                     counts["ok"] += 1
                     counts["tokens"] += len(res["tokens"])
                     lat.append(ms)
+                    if ttft is not None:
+                        ttfts.append(ttft)
+                    itls.extend(gaps)
             except OverloadedError:
                 with lock:
                     counts["shed"] += 1
@@ -564,7 +608,8 @@ def run_closed_loop_generate(engine, make_prompt, n_requests: int,
     wall = time.monotonic() - t0
     rep = _gen_report("closed", n_requests, counts["ok"],
                       counts["shed"], counts["failed"], wall, lat,
-                      counts["tokens"], engine)
+                      counts["tokens"], engine, ttft_ms=ttfts,
+                      itl_ms=itls)
     rep["concurrency"] = concurrency
     return rep
 
@@ -580,6 +625,8 @@ def run_open_loop_generate(engine, make_prompt, qps: float,
     from paddle_tpu.serving import OverloadedError, ServingError
 
     lat, lock = [], threading.Lock()
+    ttfts: List[float] = []
+    itls: List[float] = []
     counts = {"ok": 0, "shed": 0, "failed": 0, "tokens": 0}
     pending: queue_mod.Queue = queue_mod.Queue()
 
@@ -588,14 +635,18 @@ def run_open_loop_generate(engine, make_prompt, qps: float,
             item = pending.get()
             if item is None:
                 return
-            fut, t0 = item
+            fut, t0, clock = item
             try:
                 res = fut.result(timeout_s)
                 ms = (time.monotonic() - t0) * 1e3
+                ttft, gaps = clock.fold()
                 with lock:
                     counts["ok"] += 1
                     counts["tokens"] += len(res["tokens"])
                     lat.append(ms)
+                    if ttft is not None:
+                        ttfts.append(ttft)
+                    itls.extend(gaps)
             except OverloadedError:
                 with lock:
                     counts["shed"] += 1
@@ -623,9 +674,11 @@ def run_open_loop_generate(engine, make_prompt, qps: float,
         next_at += period
         prompt, out_len = make_prompt(n)
         n += 1
+        clock = _TokenClock(now)
         try:
-            fut = engine.submit(prompt, out_len)
-            pending.put((fut, now))
+            fut = engine.submit(prompt, out_len,
+                                on_token=clock.on_token)
+            pending.put((fut, now, clock))
         except OverloadedError:
             with lock:
                 counts["shed"] += 1
@@ -640,7 +693,7 @@ def run_open_loop_generate(engine, make_prompt, qps: float,
     wall = time.monotonic() - t0
     rep = _gen_report("open", n, counts["ok"], counts["shed"],
                       counts["failed"], wall, lat, counts["tokens"],
-                      engine)
+                      engine, ttft_ms=ttfts, itl_ms=itls)
     rep["target_qps"] = qps
     return rep
 
@@ -768,18 +821,83 @@ def _http_generate(url: str, body: bytes, timeout_s: float) -> tuple:
         return "failed", 0
 
 
+def _http_generate_stream(url: str, body: bytes, timeout_s: float
+                          ) -> tuple:
+    """One streaming POST /generate: read the NDJSON line-by-line,
+    stamping each token line's ARRIVAL on this client's clock — the
+    honest TTFT/ITL measurement (a whole-response timer cannot see
+    token pacing at all).  -> (outcome, token_count, ttft_ms or None,
+    [inter-token gap ms, ...])."""
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    t0 = time.monotonic()
+    arrivals: List[float] = []
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            final = None
+            for raw in r:
+                now = time.monotonic()
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    return "failed", 0, None, []
+                if doc.get("done"):
+                    final = doc
+                    break
+                if "token" in doc:
+                    arrivals.append(now)
+            if final is None:
+                # token count 0 like the non-stream path: a broken
+                # stream's partial tokens must not inflate the report's
+                # tokens_per_sec vs the identical non-stream run
+                return "failed", 0, None, []
+            if "error" in final:
+                # the stream's final line carries what the non-stream
+                # path says with an HTTP status: overloaded = explicit
+                # backpressure = shed, anything else failed
+                return (("shed" if final.get("error") == "overloaded"
+                         else "failed"), 0, None, [])
+    except urllib.error.HTTPError as e:
+        try:
+            payload = e.read()
+        except OSError:
+            payload = b""  # ok: error body gone with the connection
+        if e.code != 503:
+            return "failed", 0, None, []
+        try:
+            reason = json.loads(payload).get("reason")
+        except (ValueError, AttributeError):
+            reason = None
+        return ("failed" if reason == "no_ready_replicas" else "shed",
+                0, None, [])
+    except (OSError, TimeoutError, ValueError):
+        return "failed", 0, None, []
+    ttft = (arrivals[0] - t0) * 1e3 if arrivals else None
+    gaps = [(b_ - a_) * 1e3 for a_, b_ in zip(arrivals, arrivals[1:])]
+    return "ok", len(arrivals), ttft, gaps
+
+
 def run_closed_loop_generate_http(base_url: str, make_prompt,
                                   n_requests: int, concurrency: int,
-                                  timeout_s: float = 120.0) -> dict:
+                                  timeout_s: float = 120.0,
+                                  stream: bool = False) -> dict:
     """Closed loop of ``POST /generate`` against a live server or
     fleet router: the shared-prefix workload drivable end-to-end.  The
     report embeds the target's ``/statusz`` generation block —
     including the paged cache's prefix-hit rate — so the prefix-reuse
-    win is observable from the outside."""
+    win is observable from the outside.  ``stream=True`` switches to
+    the NDJSON streaming contract and measures per-token arrivals
+    client-side (the report gains ``ttft_ms``/``inter_token_ms``
+    percentile blocks)."""
     url = base_url.rstrip("/") + "/generate"
     tickets = iter(range(n_requests))
     ticket_lock = threading.Lock()
     lat, lock = [], threading.Lock()
+    ttfts: List[float] = []
+    itls: List[float] = []
     counts = {"ok": 0, "shed": 0, "failed": 0, "tokens": 0}
 
     def caller():
@@ -789,16 +907,27 @@ def run_closed_loop_generate_http(base_url: str, make_prompt,
             if i is None:
                 return
             prompt, out_len = make_prompt(i)
-            body = json.dumps({"prompt": np.asarray(prompt).tolist(),
-                               "max_new_tokens": int(out_len)}).encode()
+            doc = {"prompt": np.asarray(prompt).tolist(),
+                   "max_new_tokens": int(out_len)}
+            if stream:
+                doc["stream"] = True
+            body = json.dumps(doc).encode()
             t0 = time.monotonic()
-            outcome, tokens = _http_generate(url, body, timeout_s)
+            if stream:
+                outcome, tokens, ttft, gaps = _http_generate_stream(
+                    url, body, timeout_s)
+            else:
+                outcome, tokens = _http_generate(url, body, timeout_s)
+                ttft, gaps = None, []
             ms = (time.monotonic() - t0) * 1e3
             with lock:
                 counts[outcome] += 1
                 counts["tokens"] += tokens
                 if outcome == "ok":
                     lat.append(ms)
+                    if ttft is not None:
+                        ttfts.append(ttft)
+                    itls.extend(gaps)
 
     threads = [threading.Thread(target=caller, daemon=True)
                for _ in range(concurrency)]
@@ -810,9 +939,12 @@ def run_closed_loop_generate_http(base_url: str, make_prompt,
     wall = time.monotonic() - t0
     rep = _gen_report("closed", n_requests, counts["ok"],
                       counts["shed"], counts["failed"], wall, lat,
-                      counts["tokens"], None)
+                      counts["tokens"], None,
+                      ttft_ms=ttfts if stream else None,
+                      itl_ms=itls if stream else None)
     rep["concurrency"] = concurrency
     rep["url"] = base_url
+    rep["stream"] = stream
     sz = _http_statusz(base_url)
     rep["statusz"] = sz
     gen_stats = None
@@ -896,7 +1028,9 @@ def run_open_loop_http(base_url: str, make_feed, qps: float,
 
 def check_slo(report: dict, p99_ms: Optional[float] = None,
               shed_pct: Optional[float] = None,
-              fail_degraded: bool = False) -> dict:
+              fail_degraded: bool = False,
+              ttft_ms: Optional[float] = None,
+              itl_ms: Optional[float] = None) -> dict:
     """Evaluate the SLO against one report (recursing into the nested
     closed/open halves of ``--mode both``).  Returns
     ``{"p99_ms_limit", "shed_pct_limit", "violations": [...], "ok"}``;
@@ -907,7 +1041,11 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
     ``missing_shards`` — in the report's ``groups`` block (or the
     embedded ``statusz.groups`` when driving a live server) is a
     violation: a load test that "passed" while a group was down
-    measured the wrong capacity."""
+    measured the wrong capacity.  ``ttft_ms`` / ``itl_ms`` bound the
+    generation report's client-measured p99 time-to-first-token and
+    inter-token gap — a bound given against a report that never
+    measured them (no per-token clock) is itself a violation, never a
+    vacuous pass."""
     violations = []
 
     def _one_phase(ph: dict, label: str):
@@ -943,6 +1081,22 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
                 violations.append(
                     f"{label}: shed rate {rate * 100.0:.2f}% > SLO "
                     f"{shed_pct}%")
+        for bound, key, label_ in ((ttft_ms, "ttft_ms", "TTFT"),
+                                   (itl_ms, "inter_token_ms",
+                                    "inter-token")):
+            if bound is None:
+                continue
+            blk = rep.get(key)
+            p99 = (blk or {}).get("p99")
+            if p99 is None:
+                if "latency_ms" in rep:  # a leaf report, not "both"
+                    violations.append(
+                        f"{label}: no per-token measurements — "
+                        f"{label_} p99 unmeasurable (run --generate "
+                        f"with token timing / --gen-stream)")
+            elif p99 > bound:
+                violations.append(f"{label}: {label_} p99 {p99}ms > "
+                                  f"SLO {bound}ms")
         # shaped-traffic runs: the SLO binds in EVERY phase — a crest
         # that sheds half its load must not pass on the run's average
         for name, ph in (rep.get("phases") or {}).items():
@@ -970,6 +1124,10 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
         _one(report, report.get("mode", "report"))
     out = {"p99_ms_limit": p99_ms, "shed_pct_limit": shed_pct,
            "violations": violations, "ok": not violations}
+    if ttft_ms is not None:
+        out["ttft_ms_limit"] = ttft_ms
+    if itl_ms is not None:
+        out["itl_ms_limit"] = itl_ms
     if fail_degraded:
         out["fail_degraded"] = True
     return out
@@ -1111,6 +1269,20 @@ def main(argv=None) -> int:
     ap.add_argument("--slo-shed-pct", type=float, default=None,
                     help="assert shed rate <= this (percent); "
                          "violation exits 1")
+    ap.add_argument("--slo-ttft-ms", type=float, default=None,
+                    help="assert client-measured p99 time-to-first-"
+                         "token <= this (ms); needs a --generate run "
+                         "with per-token timing (in-process loops "
+                         "always have it; --url needs --gen-stream)")
+    ap.add_argument("--slo-itl-ms", type=float, default=None,
+                    help="assert client-measured p99 inter-token gap "
+                         "<= this (ms); same measurement requirement "
+                         "as --slo-ttft-ms")
+    ap.add_argument("--gen-stream", action="store_true",
+                    help="--url --generate: use the NDJSON streaming "
+                         "/generate contract and record each token's "
+                         "client-side arrival (enables ttft_ms / "
+                         "inter_token_ms report blocks over HTTP)")
     args = ap.parse_args(argv)
     # `--shape sine` convenience: a bare traffic-shape name given via
     # --shape (which otherwise takes name=d0,d1 feed specs) selects
@@ -1151,9 +1323,12 @@ def main(argv=None) -> int:
     def finish(report: dict) -> int:
         rc = 0
         if args.slo_p99_ms is not None or args.slo_shed_pct is not None \
-                or args.sharded:
+                or args.slo_ttft_ms is not None \
+                or args.slo_itl_ms is not None or args.sharded:
             slo = check_slo(report, args.slo_p99_ms, args.slo_shed_pct,
-                            fail_degraded=args.sharded)
+                            fail_degraded=args.sharded,
+                            ttft_ms=args.slo_ttft_ms,
+                            itl_ms=args.slo_itl_ms)
             report["slo"] = slo
             if not slo["ok"]:
                 for v in slo["violations"]:
@@ -1178,7 +1353,8 @@ def main(argv=None) -> int:
             prefix_tokens=args.gen_prefix_tokens
             if args.gen_prompt_dist == "shared-prefix" else 0)
         report = run_closed_loop_generate_http(
-            args.url, make_prompt, args.requests, args.concurrency)
+            args.url, make_prompt, args.requests, args.concurrency,
+            stream=args.gen_stream)
         return finish(report)
 
     if args.url:

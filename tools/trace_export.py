@@ -26,7 +26,18 @@ Usage::
         [--metrics-dir DIR]   source dir (repeatable; when given, a
                               lone positional arg is the OUTPUT path)
         [--filter SUBSTR]     keep only spans whose name contains SUBSTR
+                              (counter tracks and metadata always pass:
+                              a filtered view keeps its occupancy/HBM
+                              context)
         [--no-events]         skip the events.jsonl markers
+
+Counter tracks ride along: the span ring's 'C'-phase samples — the
+HBM timeline, the generation engine's per-slot occupancy track
+(``generation_slots``) — merge with the spans, re-pidded per source
+like everything else, so a multi-replica fleet export shows every
+replica's slot occupancy as its own stacked counter track beside its
+sequence timelines (``generation/sequence`` spans, trace-id-linked to
+``/tracez``).
 
 Load the output in https://ui.perfetto.dev (or chrome://tracing).
 """
@@ -94,7 +105,13 @@ def _load_source(src: str, name_filter: str,
                                    "events.jsonl")
     events = load_span_events(trace_path)
     if name_filter:
-        events = [e for e in events if name_filter in e.get("name", "")]
+        # the name filter narrows SPANS; counter tracks ('C': per-slot
+        # occupancy, the HBM timeline) and metadata ('M') survive any
+        # filter — a filtered view without its counter context is how
+        # "the grid looked idle" misreadings happen
+        events = [e for e in events
+                  if e.get("ph") in ("C", "M")
+                  or name_filter in e.get("name", "")]
     markers = []
     if include_events and os.path.isfile(events_path):
         markers = load_event_markers(events_path)
