@@ -194,6 +194,28 @@ register_flag("FLAGS_serving_prefix_reuse", True,
               "into new slots copy-on-write — their prefill is skipped "
               "entirely and the pages are shared refcounted until every "
               "referencing slot finishes; 0 disables the prefix index")
+register_flag("FLAGS_serving_role", "both",
+              "disaggregated serving role of this GenerationEngine / "
+              "replica: 'both' (colocated prefill+decode, the default), "
+              "'prefill' (runs paged prefill and exports each prompt's "
+              "populated pages as a KVSegment, never occupies a decode "
+              "slot), 'decode' (accepts segments via adopt()/POST "
+              "/adopt and runs only the decode grid).  Non-'both' "
+              "roles require FLAGS_serving_paged=1")
+register_flag("FLAGS_disagg_reprefill", False,
+              "disaggregated routing: when the cache-holding decode "
+              "replica dies mid-generation the router fails the "
+              "request with the explicit 'affinity_lost' taxonomy by "
+              "default (never a silent re-prefill); 1 lets the router "
+              "restart the whole prefill->adopt pipeline once on "
+              "surviving replicas instead")
+register_flag("FLAGS_disagg_transport", "device",
+              "in-process KV-segment handoff transport (DisaggPair "
+              "default): 'device' = device-to-device jax.device_put "
+              "between the engines' (sub-)meshes, zero host copy; "
+              "'bytes' = serialize through the KVSegment wire codec — "
+              "the exact bytes POST /adopt carries, i.e. what a "
+              "cross-host transport pays")
 register_flag("FLAGS_trace_sample", 1.0,
               "head-sampling rate for serving request traces: fraction "
               "of requests (0..1, deterministic every-Nth spacing) that "

@@ -20,6 +20,9 @@ via ``engine.attach_generator(gen)`` and ``POST /generate`` routes to
 it (README "Generation serving").
 """
 from . import batcher  # noqa
+from .disagg import (DeviceTransport, DisaggPair,  # noqa
+                     HostBytesTransport, KVSegment, SegmentMismatch,
+                     SegmentTransport)
 from .engine import (OverloadedError, PoisonedInput, RequestFailed,  # noqa
                      ServingEngine, ServingError, ServingFuture)
 from .fleet import FleetSupervisor  # noqa
@@ -34,4 +37,6 @@ __all__ = ["ServingEngine", "ServingError", "OverloadedError",
            "ServingServer", "serve",
            "GenerationEngine", "batcher", "ReplicaGroupEngine",
            "ShardedPredictor", "serving_shard_rules", "Router",
-           "RouterServer", "serve_router", "FleetSupervisor"]
+           "RouterServer", "serve_router", "FleetSupervisor",
+           "KVSegment", "SegmentMismatch", "SegmentTransport",
+           "DeviceTransport", "HostBytesTransport", "DisaggPair"]

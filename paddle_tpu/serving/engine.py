@@ -1263,4 +1263,7 @@ class ServingEngine:
         }
         if self.generator is not None:
             out["generation"] = self.generator.stats()
+            # the disagg role, top-level: the router's affinity
+            # placement reads it off every health poll
+            out["role"] = getattr(self.generator, "role", "both")
         return out

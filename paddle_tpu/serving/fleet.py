@@ -94,9 +94,10 @@ def _healthz(url: str, timeout: float = 2.0) -> Optional[dict]:
 class _Replica:
     """Supervisor-side state for one replica slot."""
 
-    def __init__(self, idx: int, rdir: str):
+    def __init__(self, idx: int, rdir: str, role: Optional[str] = None):
         self.idx = idx
         self.dir = rdir
+        self.role = role      # disagg role argv (None = supervisor-wide)
         self.endpoint_file = os.path.join(rdir, "endpoint.json")
         self.log_path = os.path.join(rdir, "replica.log")
         self.metrics_dir = os.path.join(rdir, "metrics")
@@ -133,11 +134,27 @@ class FleetSupervisor:
                  max_restarts: Optional[int] = None,
                  backoff_ms: Optional[float] = None,
                  liveness_timeout_ms: Optional[float] = None,
+                 roles: Optional[List[str]] = None,
                  autostart: bool = True):
         self.n = int(replicas if replicas is not None
-                     else flag_value("FLAGS_fleet_replicas"))
+                     else (len(roles) if roles is not None
+                           else flag_value("FLAGS_fleet_replicas")))
         if self.n < 1:
             raise ValueError("FleetSupervisor needs >= 1 replica")
+        # role-aware fleet: one disagg role per replica slot
+        # (prefill|decode|both), appended to its argv as --role and
+        # PINNED across respawns like the port — a crashed prefill
+        # replica's successor is a prefill replica
+        if roles is not None:
+            if len(roles) != self.n:
+                raise ValueError(f"roles has {len(roles)} entries for "
+                                 f"{self.n} replicas")
+            bad = [r for r in roles
+                   if r not in ("both", "prefill", "decode")]
+            if bad:
+                raise ValueError(f"unknown role(s) {bad}; want "
+                                 f"both|prefill|decode")
+        self.roles = list(roles) if roles is not None else None
         self.replica_argv = list(replica_argv or [])
         self.env = dict(env or {})
         self.workdir = workdir or tempfile.mkdtemp(prefix="fleet-")
@@ -152,7 +169,8 @@ class FleetSupervisor:
             else flag_value("FLAGS_fleet_liveness_timeout_ms")) / 1e3
         self._lock = threading.Lock()
         self._replicas = [
-            _Replica(i, os.path.join(self.workdir, f"replica-{i}"))
+            _Replica(i, os.path.join(self.workdir, f"replica-{i}"),
+                     role=self.roles[i] if self.roles else None)
             for i in range(self.n)]
         # an Event, not a lock-guarded bool: the monitor/liveness loop
         # headers poll it every cycle, and an Event read is race-free
@@ -176,6 +194,8 @@ class FleetSupervisor:
         cmd = [sys.executable, "-u", "-m", "paddle_tpu.serving.replica",
                "--endpoint-file", rep.endpoint_file,
                "--port", str(rep.port or 0), *self.replica_argv]
+        if rep.role is not None:
+            cmd += ["--role", rep.role]
         env = dict(self.env)
         env.update({
             "PADDLE_TPU_REPLICA_ID": str(rep.idx),
@@ -427,6 +447,7 @@ class FleetSupervisor:
         with self._lock:
             reps = [{
                 "replica": r.idx, "url": r.url, "port": r.port,
+                "role": r.role,
                 "pid": r.proc.pid if r.proc is not None else None,
                 "alive": r.proc is not None and r.proc.poll() is None,
                 "lives": r.lives, "crash_restarts": r.crash_restarts,

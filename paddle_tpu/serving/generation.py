@@ -143,11 +143,12 @@ class GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "future", "t_submit",
                  "t_claimed", "t_deadline", "trace_id", "prefill_ms",
                  "on_token", "record_timeline", "events", "t_tokens",
-                 "t_first", "t_last")
+                 "t_first", "t_last", "segment")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
+        self.segment = None  # adopted KVSegment (decode-role handoff)
         self.future = ServingFuture()
         self.t_submit = time.monotonic()
         self.t_claimed: Optional[float] = None
@@ -342,7 +343,7 @@ class GenerationEngine:
                  attn_impl="auto", seed=0, keep_logits=False,
                  mesh=None, shard_rules=None, paged=None,
                  page_tokens=None, num_pages=None, prefill_chunk=None,
-                 prefix_reuse=None):
+                 prefix_reuse=None, role=None):
         import paddle_tpu as pt
         from ..models.llama import build_llama_decode, build_llama_prefill
 
@@ -424,8 +425,24 @@ class GenerationEngine:
             self._pool = PagePool(self.num_pages)
             if self.prefix_reuse:
                 self._prefix = PrefixIndex(self._pool, pt_)
+        # disaggregated serving role: "both" (colocated, the default)
+        # runs prefill AND the decode grid; "prefill" exports each
+        # prompt's populated pages as a KVSegment instead of decoding;
+        # "decode" accepts segments via adopt() and never prefills
+        self.role = str(role if role is not None
+                        else flag_value("FLAGS_serving_role") or "both")
+        if self.role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be both|prefill|decode, got "
+                             f"{self.role!r}")
+        if self.role != "both" and not self.paged:
+            raise ValueError(
+                f"role={self.role!r} requires the paged KV cache "
+                f"(paged=True / FLAGS_serving_paged=1): the KV-segment "
+                f"handoff is page-block-based")
+        self._fingerprint: Optional[str] = None
         self._paged_prefill_progs: Dict[int, tuple] = {}
         self._chunk_progs: Dict[int, tuple] = {}
+        self._adopt_scatter = None  # donated jit, built on first adopt
         self._prefill_rr = 0  # chunked-prefill round-robin cursor
         self._peak_active = 0
 
@@ -461,7 +478,9 @@ class GenerationEngine:
                    "prefill_tokens": 0, "slot_reclaims": 0,
                    "failed": 0, "prefix_hits": 0,
                    "prefix_tokens_saved": 0, "prefill_chunks": 0,
-                   "page_evictions": 0, "pool_stalls": 0}
+                   "page_evictions": 0, "pool_stalls": 0,
+                   "segments_exported": 0, "segments_adopted": 0,
+                   "adopt_rejects": 0}
         self._n_lock = threading.Lock()
         self._h_gen = telemetry.Histogram("serving_generate_ms")
         self._h_prefill = telemetry.Histogram("serving_prefill_ms")
@@ -673,6 +692,13 @@ class GenerationEngine:
                 np.zeros((self.num_slots,), "int32"))
             return compiled + 1
         np_slot = self.pages_per_slot
+        if self.role == "decode":
+            # a decode-role engine never prefills: the decode step is
+            # its only program
+            self._run_decode_program(
+                np.zeros((self.num_slots, 1), "int64"),
+                np.zeros((self.num_slots,), "int32"))
+            return 1
         if self.prefill_chunk <= 0:
             for b in self.prefill_buckets:
                 if b not in self._paged_prefill_progs:
@@ -702,6 +728,9 @@ class GenerationEngine:
                         fetch_list=[fetches["next_token"]],
                         scope=self.scope, return_numpy=False)
                     compiled += 1
+        if self.role == "prefill":
+            # a prefill-role engine never runs the decode grid
+            return compiled
         self._run_decode_program(np.zeros((self.num_slots, 1), "int64"),
                                  np.zeros((self.num_slots,), "int32"))
         return compiled + 1
@@ -774,6 +803,9 @@ class GenerationEngine:
         sequence keeps generating).  ``timeline`` — force the
         per-sequence timeline record on/off; default follows
         ``FLAGS_telemetry`` (off ⇒ zero per-token bookkeeping)."""
+        if self.role == "decode":
+            raise ValueError("decode-role engine accepts KV segments "
+                             "via adopt(), not prompts (role=decode)")
         ids = np.asarray(prompt)
         if ids.ndim != 1 or ids.size < 1:
             raise ValueError(f"prompt must be a non-empty 1-D token id "
@@ -822,6 +854,111 @@ class GenerationEngine:
                  timeout: Optional[float] = None) -> dict:
         """Blocking one-shot: ``submit(...).result(timeout)``."""
         return self.submit(prompt, max_new_tokens).result(timeout)
+
+    # -- disaggregated handoff (KV segments) --------------------------------
+    def fingerprint(self) -> str:
+        """The segment-compatibility fingerprint (model sizes, page
+        geometry, name prefix, weight seed) — equal fingerprints mean
+        a segment exported here adopts bit-exactly there."""
+        if self._fingerprint is None:
+            from .disagg import config_fingerprint
+            self._fingerprint = config_fingerprint(
+                self.model, self.page_tokens, self.max_seq_len,
+                self.name, self._seed)
+        return self._fingerprint
+
+    def _check_segment(self, seg):
+        """Structural + fingerprint admission check for adopt(); a
+        reject here means decoding the segment could only produce
+        garbage (wrong weights, wrong page geometry, truncated
+        payload)."""
+        from .disagg import SegmentMismatch
+        if seg.fingerprint != self.fingerprint():
+            self._count("adopt_rejects")
+            stat_add("serving_adopt_rejects")
+            raise SegmentMismatch(
+                f"segment fingerprint {seg.fingerprint} != engine "
+                f"{self.fingerprint()} (model/page-geometry/seed "
+                f"drift)")
+        n_layers = len(self.cache_names) // 2
+        needed = -(-seg.position // self.page_tokens)
+        if (seg.page_tokens != self.page_tokens
+                or seg.n_layers != n_layers
+                or seg.n_pages != needed
+                or not seg.tokens
+                or seg.position < 1
+                or seg.position > self.max_seq_len
+                # prompt_len feeds a host allocation and the result
+                # record — a crafted header must not OOM the replica
+                or seg.prompt_len < 1
+                or seg.prompt_len > seg.position):
+            self._count("adopt_rejects")
+            stat_add("serving_adopt_rejects")
+            raise SegmentMismatch(
+                f"segment structure invalid: page_tokens="
+                f"{seg.page_tokens}/{self.page_tokens}, layers="
+                f"{seg.n_layers}/{n_layers}, pages={seg.n_pages} "
+                f"(need {needed} for position {seg.position}), "
+                f"tokens={len(seg.tokens)}")
+
+    def adopt(self, segment, max_new_tokens: Optional[int] = None,
+              trace_id: Optional[str] = None,
+              deadline_ms: Optional[float] = None,
+              on_token=None,
+              timeline: Optional[bool] = None) -> ServingFuture:
+        """Adopt an exported :class:`~paddle_tpu.serving.disagg.
+        KVSegment` into this engine's page pool and decode it to
+        completion — the decode half of the disaggregated pipeline.
+
+        Admission mirrors :meth:`submit` (queue cap / draining /
+        deadline shedding with the same taxonomy); page allocation at
+        claim time is refcount-integrated with the local pool and, on
+        exhaustion, evicts idle prefix pages or requeues exactly like
+        a local prefill.  A fingerprint or structure mismatch raises
+        :class:`~paddle_tpu.serving.disagg.SegmentMismatch`
+        immediately (never queued).  The result record's ``tokens``
+        is the FULL stream — the segment's already-generated tokens
+        (replayed through ``on_token`` so a streaming client sees one
+        uninterrupted sequence) followed by everything decoded
+        here."""
+        if self.role == "prefill":
+            raise ValueError("prefill-role engine cannot adopt "
+                             "segments (it has no decode grid)")
+        if not self.paged:
+            raise ValueError("adopt() requires the paged KV cache")
+        self._check_segment(segment)
+        mnt = max(1, int(max_new_tokens if max_new_tokens is not None
+                         else self.max_new_tokens))
+        # the dummy prompt only carries the length for result
+        # accounting — the segment's pages already hold the K/V
+        req = GenRequest(np.zeros((segment.prompt_len,), "int64"), mnt)
+        req.segment = segment
+        budget_s = self._deadline_s
+        if deadline_ms is not None:
+            budget_s = min(budget_s, float(deadline_ms) / 1e3)
+        req.t_deadline = req.t_submit + budget_s
+        if telemetry.enabled():
+            req.trace_id = (trace_id or segment.trace_id
+                            or telemetry.new_trace_id())
+        req.on_token = on_token
+        req.record_timeline = bool(telemetry.enabled()
+                                   if timeline is None else timeline)
+        req.note("admit", req.t_submit, {"adopted": True})
+        self._count("requests")
+        stat_add("serving_generate_requests")
+        with self._cv:
+            if self._draining:
+                raise self._shed_err(req, "draining")
+            if budget_s <= 0:
+                raise self._shed_err(req, "deadline",
+                                     "budget exhausted upstream")
+            if len(self._queue) >= self.queue_cap:
+                raise self._shed_err(
+                    req, "queue_full",
+                    f"{len(self._queue)}/{self.queue_cap} queued")
+            self._queue.append(req)
+            self._cv.notify_all()
+        return req.future
 
     def _shed_err(self, req: GenRequest, reason: str,
                   detail: str = "") -> OverloadedError:
@@ -913,10 +1050,19 @@ class GenerationEngine:
             for slot, req in claimed:
                 try:
                     self._begin(slot, req)
-                except Exception as e:  # noqa: BLE001 — a prefill failure
-                    # must not kill the scheduler: exactly this request
-                    # errors, the grid keeps decoding
-                    self._fail_request(slot, req, "prefill", e)
+                except PoolExhausted as e:
+                    # segment adoption allocates its pages at claim
+                    # time: exhaustion is the SAME transient the
+                    # prefill path sees — evictions already ran, so
+                    # requeue behind live sequences (or fail when the
+                    # pool can never hold it)
+                    self._requeue_or_fail(slot, e)
+                except Exception as e:  # noqa: BLE001 — a prefill/adopt
+                    # failure must not kill the scheduler: exactly this
+                    # request errors, the grid keeps decoding
+                    self._fail_request(slot, req,
+                                       "adopt" if req.segment is not None
+                                       else "prefill", e)
             if claimed:
                 self._sample_slot_track()
             # chunked prefill: advance ONE pending slice per iteration
@@ -962,7 +1108,11 @@ class GenerationEngine:
         slot.span = telemetry.span_begin(
             "generation/sequence", detached=True,
             trace_id=req.trace_id, slot=slot.idx,
-            prompt_len=int(req.prompt.size))
+            prompt_len=int(req.prompt.size),
+            adopted=req.segment is not None)
+        if req.segment is not None:
+            self._adopt_begin(slot, req)
+            return
         if not self.paged:
             self._prefill(slot, req)
             slot.decoding = True
@@ -990,6 +1140,102 @@ class GenerationEngine:
                          slot.hit_tokens)
         slot.prefill_pos = slot.hit_tokens
 
+    def _adopt_begin(self, slot: _Slot, req: GenRequest):
+        """Materialize an adopted segment into this pool: allocate the
+        pages (refcounted; eviction/requeue semantics identical to a
+        local prefill via :meth:`_ensure_pages`), scatter the
+        segment's page blocks into them, replay the already-generated
+        tokens, and enter the decode grid at the recorded position.
+        Raises :class:`PoolExhausted` for the scheduler's requeue
+        path."""
+        import jax.numpy as jnp
+
+        seg = req.segment
+        t0 = time.monotonic()
+        kind = fault.fire("adopt")
+        fault.maybe_delay(kind)
+        if kind == "fail":
+            raise fault.InjectedFault("injected adopt failure")
+        if self._adopt_scatter is None:
+            import jax
+            # donated scatter: the pool buffer is consumed and updated
+            # IN PLACE (same contract as the decode step's donation) —
+            # adoption cost scales with the segment, not the pool.
+            # One compile per distinct segment page count, bounded by
+            # pages_per_slot
+            self._adopt_scatter = jax.jit(
+                lambda pool, idx, rows: pool.at[idx].set(rows),
+                donate_argnums=(0,))
+        with telemetry.trace_span("generation/segment_adopt",
+                                  parent=slot.span.context()
+                                  if slot.span is not None else None,
+                                  position=seg.position,
+                                  pages=seg.n_pages,
+                                  bytes=seg.nbytes, slot=slot.idx):
+            self._ensure_pages(slot, seg.position)  # may raise
+            phys = jnp.asarray(
+                np.asarray(slot.pages[:seg.n_pages], "int32"))
+            for i, (k_pages, v_pages) in enumerate(seg.layers):
+                for kind_, arr in (("k", k_pages), ("v", v_pages)):
+                    name = f"{self.name}.pool_{kind_}_{i}"
+                    pool = self.scope.find_var(name)
+                    pool = self._adopt_scatter(
+                        pool, phys,
+                        jnp.asarray(np.asarray(arr), pool.dtype))
+                    self.scope.set_var(name, pool)
+        slot.position = seg.position
+        slot.prefill_pos = seg.position
+        slot.tokens = list(seg.tokens)
+        slot.steps = 0
+        slot.logits = [np.asarray(r) for r in np.asarray(seg.logits)] \
+            if (self.keep_logits and seg.logits is not None) else []
+        slot.decoding = True
+        now = time.monotonic()
+        ms = (now - t0) * 1e3
+        self._count("segments_adopted")
+        stat_add("serving_segments_adopted")
+        stat_add("serving_segment_adopt_bytes", seg.nbytes)
+        telemetry.histogram_observe("serving_segment_adopt_ms", ms,
+                                    trace_id=req.trace_id)
+        req.note("adopt", now, {"tokens": len(seg.tokens),
+                                "position": seg.position,
+                                "bytes": seg.nbytes,
+                                "ms": round(ms, 3)})
+        # replay the remotely generated tokens: the stream consumer
+        # sees one uninterrupted sequence, and TTFT here honestly
+        # measures adopt-admission to first token availability
+        tele = telemetry.enabled()
+        for tok in seg.tokens:
+            if req.record_timeline:
+                req.t_tokens.append(now)
+            if req.t_first is None:
+                req.t_first = now
+                if tele:
+                    ttft = (now - req.t_submit) * 1e3
+                    self._h_ttft.observe(ttft, trace_id=req.trace_id)
+                    telemetry.histogram_observe(
+                        "serving_ttft_ms", ttft, trace_id=req.trace_id)
+            if req.on_token is not None:
+                try:
+                    req.on_token(tok, now)
+                except Exception as e:  # noqa: BLE001 — same containment
+                    # contract as _book_token's replay
+                    logger.warning("on_token callback failed (token "
+                                   "dropped from stream): %s", e)
+                    req.on_token = None
+        req.t_last = now
+        self._publish_pool_gauges()
+        # a segment can arrive already finished (EOS at prefill, or a
+        # budget the replay alone meets) — same precedence as
+        # _book_token: eos > length > cache_full
+        last = slot.tokens[-1]
+        if last == self.eos_id:
+            self._finish(slot, "eos")
+        elif len(slot.tokens) >= req.max_new_tokens:
+            self._finish(slot, "length")
+        elif slot.position >= self.max_seq_len:
+            self._finish(slot, "cache_full")
+
     def _end_seq_span(self, slot: _Slot, outcome: str):
         """Close the slot's generation/sequence span (safe when none —
         telemetry off or pre-claim failure)."""
@@ -1010,7 +1256,9 @@ class GenerationEngine:
         req = slot.req
         others = [s for s in self._slots if s.active and s is not slot]
         if not others:
-            self._fail_request(slot, req, "prefill", e)
+            self._fail_request(slot, req,
+                               "adopt" if req.segment is not None
+                               else "prefill", e)
             return
         self._count("pool_stalls")
         stat_add("serving_kv_pool_stalls")
@@ -1281,8 +1529,94 @@ class GenerationEngine:
         slot.prefill_pos = n_prompt
         slot.position = n_prompt
         slot.tokens = [first]
+        if self.role == "prefill":
+            # disaggregated prefill: export the populated pages as a
+            # KVSegment instead of entering the decode grid — the
+            # slot (and its pages) free for the next prompt now
+            self._export_segment(slot, req)
+            return
         slot.decoding = True
         self._book_token(slot, first, time.monotonic())
+
+    def _export_segment(self, slot: _Slot, req: GenRequest):
+        """Gather the slot's populated pages into a detached
+        :class:`~paddle_tpu.serving.disagg.KVSegment` and resolve the
+        request with it (``finish="exported"``).  The gather copies
+        page content, so the slot's pages release immediately —
+        shared prefix pages fall back to the index's ref and keep
+        serving later hits on THIS replica."""
+        import jax.numpy as jnp
+
+        from .disagg import KVSegment
+
+        t0 = time.monotonic()
+        n_prompt = slot.position
+        needed = -(-n_prompt // self.page_tokens)
+        idx = jnp.asarray(np.asarray(slot.pages[:needed], "int32"))
+        with telemetry.trace_span("generation/segment_export",
+                                  parent=slot.span.context()
+                                  if slot.span is not None else None,
+                                  tokens=n_prompt, pages=int(needed),
+                                  slot=slot.idx):
+            layers = []
+            for i in range(len(self.cache_names) // 2):
+                k_pool = self.scope.find_var(
+                    f"{self.name}.pool_k_{i}")
+                v_pool = self.scope.find_var(
+                    f"{self.name}.pool_v_{i}")
+                layers.append((jnp.take(k_pool, idx, axis=0),
+                               jnp.take(v_pool, idx, axis=0)))
+            seg = KVSegment(
+                self.fingerprint(), n_prompt, n_prompt,
+                list(slot.tokens), self.page_tokens, layers,
+                logits=np.stack(slot.logits)
+                if self.keep_logits and slot.logits else None,
+                trace_id=req.trace_id)
+        now = time.monotonic()
+        ms = (now - t0) * 1e3
+        # the prefill's first next-token was generated HERE (the
+        # adopter only replays it)
+        self._count("generated_tokens")
+        stat_add("serving_generated_tokens")
+        self._count("segments_exported")
+        stat_add("serving_segments_exported")
+        stat_add("serving_segment_export_bytes", seg.nbytes)
+        telemetry.histogram_observe("serving_segment_export_ms", ms,
+                                    trace_id=req.trace_id)
+        req.note("export", now, {"bytes": seg.nbytes, "pages": needed,
+                                 "ms": round(ms, 3)})
+        total_ms = (now - req.t_submit) * 1e3
+        self._count("served")
+        self._h_gen.observe(total_ms, trace_id=req.trace_id)
+        telemetry.histogram_observe("serving_generate_ms", total_ms,
+                                    trace_id=req.trace_id)
+        result = {
+            "tokens": [int(t) for t in slot.tokens],
+            "prompt_len": n_prompt,
+            "steps": 0,
+            "finish": "exported",
+            "trace_id": req.trace_id,
+            "queue_wait_ms": round(
+                ((req.t_claimed or now) - req.t_submit) * 1e3, 3),
+            "prefill_ms": round(req.prefill_ms, 3),
+            "ttft_ms": None,
+            "total_ms": round(total_ms, 3),
+            "segment": seg,
+            "segment_bytes": seg.nbytes,
+        }
+        if slot.hit_tokens:
+            result["prefix_hit_tokens"] = slot.hit_tokens
+        if req.record_timeline:
+            result["timeline"] = self._timeline_record(req, result)
+            self._store_timeline(
+                {k: v for k, v in result.items() if k != "segment"})
+        self._end_seq_span(slot, "exported")
+        slot.req = None
+        slot.decoding = False
+        slot.logits = []
+        self._release_pages(slot)
+        self._sample_slot_track()
+        req.future._resolve(outputs=result)
 
     # -- decode -------------------------------------------------------------
     def _run_decode_program(self, tokens: np.ndarray,
@@ -1601,6 +1935,7 @@ class GenerationEngine:
         return {
             "queue_depth": depth,
             "queue_cap": self.queue_cap,
+            "role": self.role,
             "slots": self.num_slots,
             "slots_active": active,
             "slot_occupancy": round(active / self.num_slots, 4),

@@ -141,12 +141,28 @@ def main(argv=None) -> int:
     ap.add_argument("--gen-slots", type=int, default=4)
     ap.add_argument("--gen-max-seq", type=int, default=64)
     ap.add_argument("--gen-max-new", type=int, default=32)
+    ap.add_argument("--role", choices=("both", "prefill", "decode"),
+                    default=None,
+                    help="disaggregated serving role (see README "
+                         "'Disaggregated serving'): 'prefill' exports "
+                         "KV segments from /generate, 'decode' adopts "
+                         "them via POST /adopt; default follows "
+                         "FLAGS_serving_role.  Non-'both' roles force "
+                         "the paged KV cache on")
+    ap.add_argument("--gen-paged", action="store_true",
+                    help="build the generator with the paged KV cache "
+                         "(implied by --role prefill|decode)")
+    ap.add_argument("--gen-page-tokens", type=int, default=None)
+    ap.add_argument("--gen-pages", type=int, default=None)
     args = ap.parse_args(argv)
 
     from ..flags import set_flags
     from .engine import ServingEngine
     from .server import serve
 
+    if args.role and args.role != "both" and not args.generate:
+        raise SystemExit("--role prefill|decode requires --generate "
+                         "(the role governs the generation engine)")
     if args.poison_value:
         set_flags({"FLAGS_serving_poison_value": args.poison_value})
     predictor, shapes = build_predictor(args)
@@ -157,7 +173,13 @@ def main(argv=None) -> int:
         ready_requires_warmup=not args.no_warmup_gate)
     gen = None
     if args.generate:
+        from ..flags import flag_value
         from .generation import GenerationEngine
+        role = args.role or str(flag_value("FLAGS_serving_role")
+                                or "both")
+        # specialized roles are page-block handoffs by definition:
+        # force the paged cache on even without --gen-paged
+        paged = True if (args.gen_paged or role != "both") else None
         gen = GenerationEngine(
             dict(vocab_size=args.gen_vocab, hidden=args.gen_hidden,
                  num_layers=args.gen_layers, num_heads=args.gen_heads,
@@ -166,7 +188,8 @@ def main(argv=None) -> int:
             num_slots=args.gen_slots, max_seq_len=args.gen_max_seq,
             max_new_tokens=args.gen_max_new,
             queue_cap=args.queue_cap,
-            deadline_ms=args.deadline_ms)
+            deadline_ms=args.deadline_ms, role=role, paged=paged,
+            page_tokens=args.gen_page_tokens, num_pages=args.gen_pages)
         engine.attach_generator(gen)
     server = serve(engine, host=args.host, port=args.port)
     server.install_sigterm()

@@ -226,6 +226,28 @@ class _Replica:
     def degraded(self) -> bool:
         return bool(self.health) and self.health.get("status") == "degraded"
 
+    def role(self) -> str:
+        """Disagg role learned from /healthz (absent = 'both': every
+        pre-disagg replica serves the full pipeline)."""
+        return (self.health or {}).get("role") or "both"
+
+    def serves(self, role: Optional[str]) -> bool:
+        """Can this replica take a hop of kind ``role``?  'prefill'
+        and 'decode' hops accept a specialized replica OR a 'both'
+        one; None = any replica (the /predict path is role-blind).
+        A 'decode' hop additionally requires the replica to be
+        adopt-capable (paged generation engine) — a dense 'both'
+        replica would 404 the /adopt, turning a valid request into a
+        client-visible error."""
+        if role is None:
+            return True
+        if self.role() not in (role, "both"):
+            return False
+        if role == "decode":
+            gen = (self.health or {}).get("generation") or {}
+            return gen.get("paged") is not None
+        return True
+
     def load(self) -> float:
         """Least-loaded score: replica-reported queue depth + rows in
         flight on its workers, plus requests THIS router already sent
@@ -246,6 +268,7 @@ class _Replica:
         return {
             "url": self.url,
             "ready": self.ready(),
+            "role": self.role(),
             "ejected": self.ejected,
             "stale": self.stale(stale_s) if self.health else True,
             "status": (self.health or {}).get("status"),
@@ -320,7 +343,8 @@ class Router:
                    "recoveries": 0, "health_polls": 0,
                    "health_poll_failures": 0, "forward_timeouts": 0,
                    "deadline_sheds": 0, "scrapes": 0,
-                   "scrape_failures": 0}
+                   "scrape_failures": 0, "disagg_generations": 0,
+                   "affinity_lost": 0, "reprefills": 0}
         self._h_request = telemetry.Histogram("router_request_ms")
         # the windowed-series store behind the autoscale signal, the
         # federated fleet view, and the burn-rate monitor.  Router-
@@ -603,14 +627,19 @@ class Router:
         telemetry.gauge_set("router_replicas_ready", live)
 
     # -- placement ----------------------------------------------------------
-    def pick(self, exclude=()) -> Optional[_Replica]:
+    def pick(self, exclude=(), role: Optional[str] = None
+             ) -> Optional[_Replica]:
         """Least-loaded routable replica: fresh+healthy first, then
         stale-or-degraded (deprioritized, still better than shedding);
-        ejected / not-ready / excluded never.  None = empty fleet."""
+        ejected / not-ready / excluded never.  ``role`` restricts the
+        pool to replicas serving that disagg hop ('prefill'/'decode';
+        'both'-role replicas qualify for either).  None = empty
+        fleet."""
         fresh: List[Tuple[float, _Replica]] = []
         backup: List[Tuple[float, _Replica]] = []
         for rep in self._all():
-            if rep.url in exclude or not rep.ready():
+            if rep.url in exclude or not rep.ready() \
+                    or not rep.serves(role):
                 continue
             tier = backup if (rep.stale(self._stale_s)
                               or rep.degraded()) else fresh
@@ -627,9 +656,10 @@ class Router:
 
     def _send(self, rep: _Replica, route: str, body: bytes,
               trace_id: Optional[str], timeout_s: float,
-              deadline_ms: Optional[float]
+              deadline_ms: Optional[float],
+              content_type: str = "application/json"
               ) -> Tuple[int, bytes, str, Optional[str]]:
-        headers = {"Content-Type": "application/json",
+        headers = {"Content-Type": content_type,
                    TRACE_HEADER: trace_id or ""}
         if deadline_ms is not None:
             # the REMAINING budget (already decremented by this
@@ -676,7 +706,8 @@ class Router:
 
     def route(self, route: str, body: bytes,
               trace_id: Optional[str] = None,
-              deadline_ms: Optional[float] = None) -> dict:
+              deadline_ms: Optional[float] = None,
+              role: Optional[str] = None, count: bool = True) -> dict:
         """Place one request: pick → forward (bounded by the forward
         timeout and the remaining deadline budget) → on a connect
         failure OR a forward timeout, strike health + retry once on
@@ -685,12 +716,16 @@ class Router:
         routable replica yields the explicit 503 ``no_ready_replicas``
         payload (with a backoff hint); a spent deadline yields 503
         ``deadline`` without burning a forward; an unretryable hang
-        yields 504 ``forward_timeout``."""
-        self._count("requests")
-        stat_add("router_http_requests")
+        yields 504 ``forward_timeout``.  ``role`` restricts placement
+        to a disagg hop's capable replicas; ``count=False`` lets the
+        disaggregated pipeline reuse this as its prefill hop without
+        double-counting the request."""
+        if count:
+            self._count("requests")
+            stat_add("router_http_requests")
         t0 = time.monotonic()
         tried: List[str] = []
-        rep = self.pick()
+        rep = self.pick(role=role)
         retried = False
         while rep is not None:
             remaining_ms = None
@@ -747,7 +782,7 @@ class Router:
                     tried.append(rep.url)
                     if not timed_out:
                         self._poll_failed(rep, f"connect: {e}")
-                    alt = self.pick(exclude=tried)
+                    alt = self.pick(exclude=tried, role=role)
                     if alt is not None:
                         self._count("retries")
                         stat_add("router_retries")
@@ -791,14 +826,11 @@ class Router:
                     rep.retries_to += 1
             self._count("routed")
             stat_add("router_requests_routed")
-            if code == 200:
-                ms = (time.monotonic() - t0) * 1e3
-                self._h_request.observe(ms, trace_id=trace_id)
-                telemetry.histogram_observe("router_request_ms", ms,
-                                            trace_id=trace_id)
-                # per-request latency series (bigger ring than the
-                # sweep-cadence series: it records per request)
-                self._db.record("router_request_ms", ms, cap=4096)
+            if code == 200 and count:
+                # count=False = a disagg pipeline hop: the caller
+                # observes the WHOLE request once — a hop's latency
+                # must not pollute the SLO/autoscale series
+                self._observe_request(t0, trace_id)
             return {"code": code, "body": data, "content_type": ctype,
                     "replica": rep.url, "retried": retried,
                     "retry_after": retry_after}
@@ -819,6 +851,269 @@ class Router:
                 ).encode(),
                 "content_type": "application/json", "replica": None,
                 "retried": retried, "retry_after": retry_after}
+
+    # -- disaggregated generate: prefill hop -> segment -> adopt hop --------
+    def disagg_active(self) -> bool:
+        """True when the fleet is role-split (>= 1 ready replica
+        reports a specialized 'prefill' or 'decode' role).  ALL
+        ``/generate`` traffic then takes the two-hop pipeline — a
+        'both'-role replica still qualifies for either hop, so mixed
+        fleets keep serving."""
+        return any(r.ready() and r.role() in ("prefill", "decode")
+                   for r in self._all())
+
+    @staticmethod
+    def _split_generate_body(body: bytes):
+        """(prefill_body, max_new_tokens, stream): the prefill hop
+        must not carry ``stream`` (its reply is a segment, not
+        tokens) and the adopt hop needs ``max_new_tokens`` as a query
+        arg.  A malformed body passes through untouched — the prefill
+        replica 400s it verbatim."""
+        try:
+            doc = json.loads(body or b"{}")
+        except ValueError:
+            return body, None, False
+        if not isinstance(doc, dict):
+            return body, None, False
+        stream = bool(doc.pop("stream", False))
+        if stream:
+            body = json.dumps(doc).encode()
+        return body, doc.get("max_new_tokens"), stream
+
+    def _count_affinity_lost(self, rep_url: str, trace_id,
+                             detail: str, stream: bool = False):
+        """Book one affinity-loss event (counter + log event) — split
+        from the response builder so the stream pipeline books the
+        SAME evidence per event whether or not a reprefill heals it
+        (counter parity with the non-stream path)."""
+        self._count("affinity_lost")
+        stat_add("router_affinity_lost")
+        telemetry.log_event("router_affinity_lost", replica=rep_url,
+                            trace_id=trace_id, detail=detail,
+                            stream=stream)
+
+    def _affinity_lost_res(self, rep_url: str, trace_id, detail: str,
+                           retried: bool, count: bool = True) -> dict:
+        """The explicit mid-generation-death taxonomy: the replica
+        holding this generation's KV cache died after adoption began.
+        NEVER silently re-prefilled — ``FLAGS_disagg_reprefill=1`` is
+        the only path that retries, and it marks the response."""
+        if count:
+            self._count_affinity_lost(rep_url, trace_id, detail)
+        return {"code": 502,
+                "body": json.dumps(
+                    {"error": "affinity_lost",
+                     "reason": "affinity_lost",
+                     "replica": rep_url,
+                     "detail": f"cache-holding decode replica died "
+                               f"mid-generation: {detail}",
+                     "trace_id": trace_id}).encode(),
+                "content_type": "application/json", "replica": rep_url,
+                "retried": retried, "retry_after": None,
+                "_affinity_lost": True}
+
+    def route_generate(self, body: bytes,
+                       trace_id: Optional[str] = None,
+                       deadline_ms: Optional[float] = None) -> dict:
+        """Disaggregated ``/generate`` (non-stream): forward the
+        prompt to least-loaded PREFILL capacity (retry-once semantics
+        of :meth:`route` — a prefill hop is stateless-on-failure and
+        safely replayable), receive the serialized KV segment, then
+        pin the decode to one decode-capable replica's ``POST
+        /adopt``.  A decode replica that dies after the segment went
+        out fails the request with the explicit ``affinity_lost``
+        taxonomy; ``FLAGS_disagg_reprefill=1`` instead restarts the
+        whole pipeline ONCE (marked ``reprefilled`` in the access
+        log).  A 'both'-role replica answering the prefill hop with a
+        full result short-circuits — mixed fleets degrade to
+        colocated serving, never to an error."""
+        from .disagg import SEGMENT_CONTENT_TYPE
+
+        self._count("requests")
+        stat_add("router_http_requests")
+        self._count("disagg_generations")
+        stat_add("router_disagg_generations")
+        t0 = time.monotonic()
+        pre_body, mnt, _stream = self._split_generate_body(body)
+        allow_reprefill = bool(flag_value("FLAGS_disagg_reprefill"))
+        attempts = 0
+        dead_decode: List[str] = []
+        while True:
+            span = telemetry.span_begin("router/prefill_hop",
+                                        detached=True,
+                                        trace_id=trace_id)
+            try:
+                pre = self.route("/generate", pre_body, trace_id,
+                                 deadline_ms=self._remaining(
+                                     deadline_ms, t0),
+                                 role="prefill", count=False)
+                if span is not None:
+                    span.attrs["status"] = pre["code"]
+                    span.attrs["replica"] = pre["replica"]
+            finally:
+                telemetry.span_end(span)
+            if pre["code"] != 200 \
+                    or pre["content_type"] != SEGMENT_CONTENT_TYPE:
+                # shed / error / or a both-role replica's full answer:
+                # passes through verbatim (and a 200 short-circuit is
+                # a completed generation, not a handoff)
+                if pre["code"] == 200:
+                    self._observe_request(t0, trace_id)
+                return pre
+            seg_bytes = pre["body"]
+            stat_add("router_segment_bytes", len(seg_bytes))
+            res = self._adopt_hop(seg_bytes, mnt, trace_id,
+                                  deadline_ms, t0, pre["replica"],
+                                  exclude=dead_decode)
+            if res.pop("_affinity_lost", False):
+                if allow_reprefill and attempts == 0:
+                    attempts += 1
+                    if res.get("replica"):
+                        # the reprefilled pipeline must not hand the
+                        # fresh segment back to the replica that just
+                        # died with the old one
+                        dead_decode.append(res["replica"])
+                    self._count("reprefills")
+                    stat_add("router_reprefills")
+                    telemetry.log_event("router_reprefill",
+                                        trace_id=trace_id)
+                    continue
+                return res
+            if res["code"] == 200:
+                self._observe_request(t0, trace_id)
+                if attempts:
+                    res["reprefilled"] = True
+            return res
+
+    def _remaining(self, deadline_ms, t0) -> Optional[float]:
+        if deadline_ms is None:
+            return None
+        return deadline_ms - (time.monotonic() - t0) * 1e3
+
+    def _observe_request(self, t0: float, trace_id):
+        ms = (time.monotonic() - t0) * 1e3
+        self._h_request.observe(ms, trace_id=trace_id)
+        telemetry.histogram_observe("router_request_ms", ms,
+                                    trace_id=trace_id)
+        self._db.record("router_request_ms", ms, cap=4096)
+
+    def _adopt_hop(self, seg_bytes: bytes, mnt, trace_id,
+                   deadline_ms, t0, prefill_url: str,
+                   exclude=()) -> dict:
+        """Ship the segment to one decode-capable replica and pin the
+        generation there.  A CONNECT-refused replica never received
+        the segment — strike + try one alternate (safe); any failure
+        after the POST went out is a mid-generation death of the
+        cache holder → ``affinity_lost``."""
+        query = "/adopt"
+        if mnt is not None:
+            query += f"?max_new_tokens={int(mnt)}"
+        tried: List[str] = list(exclude)
+        retried = False
+        span = telemetry.span_begin("router/adopt_hop", detached=True,
+                                    trace_id=trace_id,
+                                    bytes=len(seg_bytes))
+        try:
+            while True:
+                rep = self.pick(exclude=tried, role="decode")
+                if rep is None:
+                    self._count("no_ready")
+                    stat_add("router_no_ready_replicas")
+                    retry_after = int(math.ceil(
+                        min(30.0, max(1.0, self._stale_s))))
+                    return {"code": 503,
+                            "body": json.dumps(
+                                {"error": "overloaded",
+                                 "reason": "no_ready_replicas",
+                                 "detail": "no decode-capable replica "
+                                           "for the adopt hop",
+                                 "retry_after_s": retry_after,
+                                 "trace_id": trace_id}).encode(),
+                            "content_type": "application/json",
+                            "replica": None, "retried": retried,
+                            "retry_after": retry_after}
+                remaining_ms = self._remaining(deadline_ms, t0)
+                if remaining_ms is not None and remaining_ms <= 0:
+                    return self._shed_deadline(trace_id, deadline_ms,
+                                               retried)
+                deadline_bound = (remaining_ms is not None
+                                  and remaining_ms / 1e3
+                                  < self.forward_timeout_s)
+                timeout_s = self.forward_timeout_s \
+                    if remaining_ms is None \
+                    else max(0.05, min(self.forward_timeout_s,
+                                       remaining_ms / 1e3))
+                try:
+                    kind = fault.fire("router_forward")
+                    fault.maybe_delay(kind)
+                    if kind == "fail":
+                        raise ConnectionRefusedError(
+                            "injected router_forward failure")
+                    code, data, ctype, retry_after = self._send(
+                        rep, query, seg_bytes, trace_id, timeout_s,
+                        remaining_ms,
+                        content_type="application/octet-stream")
+                except Exception as e:  # noqa: BLE001 — sort, don't die
+                    with self._lock:
+                        rep.errors += 1
+                    timed_out = _is_timeout_error(e)
+                    if timed_out and deadline_bound:
+                        return self._shed_deadline(
+                            trace_id, deadline_ms, retried)
+                    refused = (isinstance(e, ConnectionRefusedError)
+                               or isinstance(
+                                   getattr(e, "reason", None),
+                                   ConnectionRefusedError))
+                    if refused:
+                        # the segment never left this process: an
+                        # alternate decode replica adopts it safely
+                        self._poll_failed(rep, f"connect: {e}")
+                        if not retried:
+                            tried.append(rep.url)
+                            self._count("retries")
+                            stat_add("router_retries")
+                            retried = True
+                            continue
+                        # refused AGAIN: no adoption ever began, so
+                        # this is a dead replica, not a lost cache —
+                        # affinity taxonomy must not fire
+                        self._count("replica_errors")
+                        stat_add("router_replica_errors")
+                        return {"code": 502,
+                                "body": json.dumps(
+                                    {"error": "replica_error",
+                                     "replica": rep.url,
+                                     "detail": f"adopt connect: {e}",
+                                     "trace_id": trace_id}).encode(),
+                                "content_type": "application/json",
+                                "replica": rep.url, "retried": retried,
+                                "retry_after": None}
+                    if timed_out:
+                        self._count("forward_timeouts")
+                        stat_add("router_forward_timeouts")
+                        self._poll_failed(
+                            rep,
+                            f"adopt timeout ({timeout_s:.2f}s)")
+                    return self._affinity_lost_res(
+                        rep.url, trace_id,
+                        f"{type(e).__name__}: {e}", retried)
+                with self._lock:
+                    rep.routed += 1
+                    if retried:
+                        rep.retries_to += 1
+                self._count("routed")
+                stat_add("router_requests_routed")
+                if span is not None:
+                    span.attrs["replica"] = rep.url
+                    span.attrs["status"] = code
+                return {"code": code, "body": data,
+                        "content_type": ctype, "replica": rep.url,
+                        "retried": retried, "retry_after": retry_after,
+                        "disagg": {"prefill": prefill_url,
+                                   "decode": rep.url,
+                                   "segment_bytes": len(seg_bytes)}}
+        finally:
+            telemetry.span_end(span)
 
     # -- federation ---------------------------------------------------------
     def fleet_metrics(self, window_s: float = 60.0) -> dict:
@@ -986,6 +1281,9 @@ class Router:
         status = "ok" if routable else "no_ready_replicas"
         with self._lock:  # _autoscale is recomputed under _lock
             auto = dict(self._autoscale)
+        roles: Dict[str, int] = {}
+        for r in routable:
+            roles[r.role()] = roles.get(r.role(), 0) + 1
         return (200 if routable else 503), {
             "status": status,
             "pid": os.getpid(),
@@ -993,6 +1291,8 @@ class Router:
             "uptime_s": round(time.time() - self._started, 3),
             "replicas": len(reps),
             "routable": len(routable),
+            "roles": roles,
+            "disagg": self.disagg_active(),
             "autoscale": auto,
             "alerts_firing": self.burn_monitor.firing(),
         }
@@ -1246,6 +1546,273 @@ class _RouterHandler(_JsonHandler):
                     headers={"Retry-After": str(retry_after)})
         return 503, None
 
+    # -- disaggregated streaming (prefill hop -> pinned adopt stream) -------
+    def _disagg_stream(self, body: bytes, trace_id: Optional[str],
+                       deadline_ms: Optional[float], t0: float):
+        """Streamed ``/generate`` on a role-split fleet: non-stream
+        prefill hop (retryable), then the NDJSON decode stream pinned
+        to the adopting replica.  Pre-stream adopt failures follow the
+        affinity taxonomy (connect-refused → one alternate;
+        ``FLAGS_disagg_reprefill=1`` → one full-pipeline restart);
+        once bytes are on the wire a dead decode replica ends the
+        stream with a best-effort ``affinity_lost`` error line — the
+        segment (and therefore the generation) died with it."""
+        from .disagg import SEGMENT_CONTENT_TYPE
+
+        router = self.router
+        router._count("requests")
+        stat_add("router_http_requests")
+        router._count("disagg_generations")
+        stat_add("router_disagg_generations")
+        pre_body, mnt, _ = router._split_generate_body(body)
+        allow_reprefill = bool(flag_value("FLAGS_disagg_reprefill"))
+        attempts = 0
+        dead_decode: List[str] = []
+        while True:
+            span = telemetry.span_begin("router/prefill_hop",
+                                        detached=True,
+                                        trace_id=trace_id, stream=True)
+            try:
+                pre = router.route(
+                    "/generate", pre_body, trace_id,
+                    deadline_ms=router._remaining(deadline_ms, t0),
+                    role="prefill", count=False)
+                if span is not None:
+                    span.attrs["status"] = pre["code"]
+                    span.attrs["replica"] = pre["replica"]
+            finally:
+                telemetry.span_end(span)
+            if pre["code"] != 200 \
+                    or pre["content_type"] != SEGMENT_CONTENT_TYPE:
+                # a 200 here is a both-role replica's FULL non-stream
+                # answer (mixed fleet): still a valid reply body —
+                # stream framing is lost, correctness is not
+                ra = pre.get("retry_after")
+                self._reply_raw(pre["code"], pre["body"],
+                                pre["content_type"], trace_id=trace_id,
+                                headers={"Retry-After": str(ra)}
+                                if ra else None)
+                if pre["code"] == 200:
+                    # the short-circuit IS the whole served request:
+                    # it must feed the SLO/autoscale series like
+                    # every other 200
+                    router._observe_request(t0, trace_id)
+                return pre["code"], pre["replica"]
+            seg_bytes = pre["body"]
+            stat_add("router_segment_bytes", len(seg_bytes))
+            outcome = self._adopt_stream_hop(seg_bytes, mnt, trace_id,
+                                             deadline_ms, t0,
+                                             exclude=dead_decode)
+            if outcome[0] == "retry":
+                # post-send death of the adopting replica: the
+                # affinity taxonomy books its evidence here whether
+                # or not a reprefill heals the request — counter
+                # parity with the non-stream pipeline
+                router._count_affinity_lost(outcome[1], trace_id,
+                                            outcome[2], stream=True)
+                if allow_reprefill and attempts == 0:
+                    attempts += 1
+                    if outcome[1]:
+                        dead_decode.append(outcome[1])
+                    router._count("reprefills")
+                    stat_add("router_reprefills")
+                    telemetry.log_event("router_reprefill",
+                                        trace_id=trace_id, stream=True)
+                    continue
+                res = router._affinity_lost_res(outcome[1], trace_id,
+                                                outcome[2], False,
+                                                count=False)
+                res.pop("_affinity_lost", None)
+                self._reply_raw(res["code"], res["body"],
+                                res["content_type"], trace_id=trace_id)
+                return res["code"], outcome[1]
+            return outcome[1], outcome[2]
+
+    def _adopt_stream_hop(self, seg_bytes: bytes, mnt,
+                          trace_id: Optional[str],
+                          deadline_ms: Optional[float], t0: float,
+                          exclude=()):
+        """One pinned adopt-stream attempt.  Returns ``("done", code,
+        replica)`` when a reply (stream or passthrough error) went to
+        the client, or ``("retry", replica_url, detail)`` when the
+        adopt failed BEFORE any byte reached the client (the caller
+        decides between affinity_lost and a reprefill)."""
+        router = self.router
+        query = "/adopt?stream=1"
+        if mnt is not None:
+            query += f"&max_new_tokens={int(mnt)}"
+        tried: List[str] = list(exclude)
+        retried = False
+        while True:
+            rep = router.pick(exclude=tried, role="decode")
+            if rep is None:
+                router._count("no_ready")
+                stat_add("router_no_ready_replicas")
+                retry_after = int(math.ceil(
+                    min(30.0, max(1.0, router._stale_s))))
+                self._reply(503, {"error": "overloaded",
+                                  "reason": "no_ready_replicas",
+                                  "detail": "no decode-capable replica "
+                                            "for the adopt hop",
+                                  "retry_after_s": retry_after,
+                                  "trace_id": trace_id},
+                            trace_id=trace_id,
+                            headers={"Retry-After": str(retry_after)})
+                return "done", 503, None
+            remaining_ms = router._remaining(deadline_ms, t0)
+            if remaining_ms is not None and remaining_ms <= 0:
+                res = router._shed_deadline(trace_id, deadline_ms,
+                                            retried)
+                self._reply_raw(res["code"], res["body"],
+                                res["content_type"], trace_id=trace_id)
+                return "done", res["code"], rep.url
+            deadline_bound = (remaining_ms is not None
+                              and remaining_ms / 1e3
+                              < router.forward_timeout_s)
+            timeout_s = router.forward_timeout_s \
+                if remaining_ms is None \
+                else max(0.05, min(router.forward_timeout_s,
+                                   remaining_ms / 1e3))
+            headers = {"Content-Type": "application/octet-stream",
+                       TRACE_HEADER: trace_id or ""}
+            if remaining_ms is not None:
+                headers[DEADLINE_HEADER] = f"{remaining_ms:.1f}"
+            host_port = rep.url.split("://", 1)[-1]
+            with router._lock:
+                rep.inflight += 1
+            conn = None
+            span = telemetry.span_begin("router/adopt_hop",
+                                        detached=True,
+                                        trace_id=trace_id, stream=True,
+                                        bytes=len(seg_bytes))
+            try:
+                try:
+                    kind = fault.fire("router_forward")
+                    fault.maybe_delay(kind)
+                    if kind == "fail":
+                        raise ConnectionRefusedError(
+                            "injected router_forward failure")
+                    conn = http.client.HTTPConnection(
+                        host_port, timeout=timeout_s)
+                    conn.request("POST", query, seg_bytes, headers)
+                    resp = conn.getresponse()
+                except Exception as e:  # noqa: BLE001 — taxonomy below
+                    with router._lock:
+                        rep.errors += 1
+                    if conn is not None:
+                        conn.close()
+                    timed_out = _is_timeout_error(e)
+                    if timed_out and deadline_bound:
+                        res = router._shed_deadline(
+                            trace_id, deadline_ms, retried)
+                        self._reply_raw(res["code"], res["body"],
+                                        res["content_type"],
+                                        trace_id=trace_id)
+                        return "done", res["code"], rep.url
+                    if isinstance(e, ConnectionRefusedError):
+                        # segment never delivered: an alternate decode
+                        # replica adopts it safely
+                        router._poll_failed(rep, f"connect: {e}")
+                        if not retried:
+                            tried.append(rep.url)
+                            router._count("retries")
+                            stat_add("router_retries")
+                            retried = True
+                            continue
+                        # refused again: dead replica, nothing ever
+                        # adopted — not an affinity loss
+                        router._count("replica_errors")
+                        stat_add("router_replica_errors")
+                        self._reply(502, {"error": "replica_error",
+                                          "replica": rep.url,
+                                          "detail": f"adopt connect: "
+                                                    f"{e}",
+                                          "trace_id": trace_id},
+                                    trace_id=trace_id)
+                        return "done", 502, rep.url
+                    if timed_out:
+                        router._count("forward_timeouts")
+                        stat_add("router_forward_timeouts")
+                        router._poll_failed(
+                            rep, f"adopt timeout ({timeout_s:.2f}s)")
+                    return "retry", rep.url, f"{type(e).__name__}: {e}"
+                if span is not None:
+                    span.attrs["replica"] = rep.url
+                    span.attrs["status"] = resp.status
+                if resp.status != 200:
+                    data = resp.read()
+                    ra = resp.headers.get("Retry-After")
+                    with router._lock:
+                        rep.routed += 1
+                    router._count("routed")
+                    stat_add("router_requests_routed")
+                    self._reply_raw(
+                        resp.status, data,
+                        resp.headers.get("Content-Type",
+                                         "application/json"),
+                        trace_id=trace_id,
+                        headers={"Retry-After": ra} if ra else None)
+                    return "done", resp.status, rep.url
+                # 200: copy the NDJSON stream, pinned — no retry is
+                # possible once bytes go out (the cache lives there)
+                if conn.sock is not None:
+                    conn.sock.settimeout(router.request_timeout_s)
+                self.send_response(resp.status)
+                self.send_header("Content-Type",
+                                 resp.headers.get(
+                                     "Content-Type",
+                                     "application/x-ndjson"))
+                self.send_header("Connection", "close")
+                if trace_id:
+                    self.send_header(TRACE_HEADER, trace_id)
+                self.end_headers()
+                self.close_connection = True
+                broken = None
+                try:
+                    while True:
+                        try:
+                            raw = resp.readline()
+                        except Exception as e:  # noqa: BLE001 — the
+                            # DECODE replica died mid-stream: the
+                            # generation's cache died with it — the
+                            # explicit taxonomy, surfaced as a final
+                            # error line since the 200 is long gone
+                            broken = f"{type(e).__name__}: {e}"
+                            break
+                        if not raw:
+                            break
+                        self.wfile.write(raw)
+                        self.wfile.flush()
+                except OSError:
+                    pass  # ok: OUR client hung up; the replica
+                    # finishes its sequence regardless
+                if broken is not None:
+                    router._count_affinity_lost(
+                        rep.url, trace_id, f"mid-stream: {broken}",
+                        stream=True)
+                    try:
+                        line = json.dumps(
+                            {"done": True, "error": "affinity_lost",
+                             "detail": broken,
+                             "trace_id": trace_id}) + "\n"
+                        self.wfile.write(line.encode())
+                        self.wfile.flush()
+                    except OSError:
+                        pass  # ok: client gone too
+                with router._lock:
+                    rep.routed += 1
+                router._count("routed")
+                stat_add("router_requests_routed")
+                if broken is None:
+                    router._observe_request(t0, trace_id)
+                return "done", resp.status, rep.url
+            finally:
+                telemetry.span_end(span)
+                if conn is not None:
+                    conn.close()
+                with router._lock:
+                    rep.inflight -= 1
+
     def do_POST(self):
         try:
             n = int(self.headers.get("Content-Length", 0) or 0)
@@ -1277,8 +1844,12 @@ class _RouterHandler(_JsonHandler):
                                         trace_id=trace_id, path=route,
                                         stream=True)
             try:
-                code, replica = self._forward_stream(
-                    route, body, trace_id, deadline_ms, t0)
+                if route == "/generate" and self.router.disagg_active():
+                    code, replica = self._disagg_stream(
+                        body, trace_id, deadline_ms, t0)
+                else:
+                    code, replica = self._forward_stream(
+                        route, body, trace_id, deadline_ms, t0)
             except Exception as e:  # noqa: BLE001 — a passthrough bug
                 # must not drop the connection silently (headers may
                 # already be out; best-effort close, honest log line)
@@ -1303,8 +1874,12 @@ class _RouterHandler(_JsonHandler):
             trace_id=trace_id)
         res = None
         try:
-            res = self.router.route(route, body, trace_id,
-                                    deadline_ms=deadline_ms)
+            if route == "/generate" and self.router.disagg_active():
+                res = self.router.route_generate(
+                    body, trace_id, deadline_ms=deadline_ms)
+            else:
+                res = self.router.route(route, body, trace_id,
+                                        deadline_ms=deadline_ms)
             if fwd is not None:
                 fwd.attrs["replica"] = res["replica"]
                 fwd.attrs["retried"] = res["retried"]
@@ -1343,6 +1918,10 @@ class _RouterHandler(_JsonHandler):
             "replica": res["replica"], "retried": res["retried"]}
         if deadline_ms is not None:
             rec["deadline_ms"] = deadline_ms
+        if res.get("disagg"):
+            rec["disagg"] = res["disagg"]
+        if res.get("reprefilled"):
+            rec["reprefilled"] = True
         self.access_log.write(rec)
 
 

@@ -388,8 +388,8 @@ class _Handler(_JsonHandler):
         except ValueError:
             n = 0
         body = self.rfile.read(n) if n > 0 else b""
-        route = self.path.split("?", 1)[0]
-        if route not in ("/predict", "/generate"):
+        route, _, query = self.path.partition("?")
+        if route not in ("/predict", "/generate", "/adopt"):
             self._reply(404, {"error": "not found", "path": self.path})
             return
         stat_add("serving_http_requests")
@@ -400,6 +400,9 @@ class _Handler(_JsonHandler):
         if route == "/predict":
             code, payload, trace = self._predict(body, hop_trace,
                                                  deadline_ms)
+        elif route == "/adopt":
+            code, payload, trace = self._adopt(body, query, hop_trace,
+                                               deadline_ms)
         else:
             code, payload, trace = self._generate(body, hop_trace,
                                                   deadline_ms)
@@ -452,6 +455,13 @@ class _Handler(_JsonHandler):
             return 400, {"error": "bad request",
                          "detail": f"{type(e).__name__}: {e}"}, None
         if stream:
+            if getattr(gen, "role", "both") == "prefill":
+                return 400, {"error": "bad request",
+                             "detail": "prefill-role replica cannot "
+                                       "stream — its /generate yields "
+                                       "a KV segment, not tokens (the "
+                                       "router owns the disaggregated "
+                                       "handoff)"}, None
             return self._generate_stream(gen, prompt, mnt, hop_trace,
                                          deadline_ms)
         t0 = time.monotonic()
@@ -473,6 +483,101 @@ class _Handler(_JsonHandler):
         res = dict(res)
         # keep_logits debug runs attach raw per-step logit arrays —
         # not JSON, and not part of the HTTP contract
+        res.pop("logits", None)
+        res["ms"] = round((time.monotonic() - t0) * 1e3, 3)
+        trace = {"trace_id": res.get("trace_id"),
+                 "rows": res.get("steps"),
+                 "status": "ok:" + res.get("finish", ""),
+                 "phases": {
+                     "queue_wait_ms": res.get("queue_wait_ms"),
+                     "predict_ms": res.get("prefill_ms")}}
+        seg = res.pop("segment", None)
+        if seg is not None:
+            # prefill-role export: the reply IS the serialized segment
+            # (octet payload the router ships to a decode replica's
+            # POST /adopt); the request-record metadata rides a header
+            from .disagg import SEGMENT_CONTENT_TYPE
+
+            data = seg.to_bytes()
+            meta = {k: res.get(k) for k in
+                    ("trace_id", "prompt_len", "prefill_ms",
+                     "queue_wait_ms", "total_ms", "ms")}
+            self._reply_raw(
+                200, data, SEGMENT_CONTENT_TYPE,
+                trace_id=res.get("trace_id"),
+                headers={"X-PaddleTPU-Segment-Meta": json.dumps(meta)})
+            return None, {"http_status": 200,
+                          "segment_bytes": len(data),
+                          "trace_id": res.get("trace_id")}, trace
+        return 200, res, trace
+
+    def _adopt(self, body: bytes, query: str,
+               hop_trace: Optional[str] = None,
+               deadline_ms: Optional[float] = None):
+        """One ``POST /adopt`` — body is a serialized
+        :class:`~paddle_tpu.serving.disagg.KVSegment`; query args
+        ``max_new_tokens`` and ``stream``.  404 when no decode-capable
+        paged generator is attached, 400 on a corrupt segment, **409**
+        on a fingerprint/geometry mismatch (the router surfaces it
+        verbatim — adopting would decode garbage), 503 on overload
+        sheds, 500 on a decode failure.  200 (or the NDJSON stream)
+        carries the same result record as ``/generate`` — ``tokens``
+        is the full sequence, the segment's tokens replayed first."""
+        gen = getattr(self.engine, "generator", None)
+        if gen is None or getattr(gen, "role", "both") == "prefill" \
+                or not getattr(gen, "paged", False):
+            return 404, {"error": "not found",
+                         "detail": "no adopt-capable (decode-role "
+                                   "paged) generation engine "
+                                   "attached"}, None
+        from .disagg import KVSegment, SegmentMismatch
+
+        try:
+            seg = KVSegment.from_bytes(body)
+        except ValueError as e:
+            return 400, {"error": "bad request",
+                         "detail": f"segment: {e}"}, None
+        stream = False
+        mnt = None
+        for part in query.split("&"):
+            k, _, v = part.partition("=")
+            if k == "stream" and v not in ("", "0", "false"):
+                stream = True
+            elif k == "max_new_tokens" and v:
+                try:
+                    mnt = int(v)
+                except ValueError:
+                    return 400, {"error": "bad request",
+                                 "detail": f"max_new_tokens={v!r} is "
+                                           "not an integer"}, None
+        trace_id = hop_trace or seg.trace_id
+
+        def submit(on_token=None):
+            return gen.adopt(seg, max_new_tokens=mnt,
+                             trace_id=trace_id,
+                             deadline_ms=deadline_ms,
+                             on_token=on_token)
+
+        if stream:
+            return self._adopt_stream(gen, submit, trace_id,
+                                      deadline_ms)
+        t0 = time.monotonic()
+        try:
+            res = submit().result(self._wait_s(deadline_ms))
+        except SegmentMismatch as e:
+            return 409, {"error": "segment_mismatch",
+                         "detail": str(e), "trace_id": trace_id}, None
+        except OverloadedError as e:
+            return 503, {"error": "overloaded", "reason": e.reason,
+                         "detail": str(e),
+                         "retry_after_s": round(gen.retry_after_s(), 3),
+                         "trace_id": getattr(e, "trace_id", None)}, None
+        except ValueError as e:
+            return 400, {"error": "bad request", "detail": str(e)}, None
+        except (RequestFailed, TimeoutError) as e:
+            return 500, {"error": "request failed",
+                         "detail": str(e)}, None
+        res = dict(res)
         res.pop("logits", None)
         res["ms"] = round((time.monotonic() - t0) * 1e3, 3)
         return 200, res, {"trace_id": res.get("trace_id"),
@@ -497,20 +602,40 @@ class _Handler(_JsonHandler):
         bad prompts still answer plain JSON (nothing streamed yet).
         Returns ``(None, summary, trace)``: None tells ``do_POST`` the
         bytes are already on the wire."""
+        return self._stream_from(
+            gen,
+            lambda on_token: self.engine.submit_generate(
+                prompt, max_new_tokens=mnt, trace_id=hop_trace,
+                deadline_ms=deadline_ms, on_token=on_token),
+            hop_trace, deadline_ms)
+
+    def _adopt_stream(self, gen, submit, trace_id, deadline_ms):
+        """Streaming adoption: identical NDJSON contract to streamed
+        ``/generate`` — the segment's replayed tokens arrive as the
+        first lines, then every locally decoded one."""
+        return self._stream_from(gen, submit, trace_id, deadline_ms)
+
+    def _stream_from(self, gen, submit, hop_trace: Optional[str],
+                     deadline_ms: Optional[float]):
+        """Shared NDJSON streaming core: ``submit(on_token)`` starts
+        the generation (a prompt submit or a segment adopt) and the
+        handler copies tokens to the wire as they are booked."""
         import queue as queue_mod
+
+        from .disagg import SegmentMismatch
 
         q: queue_mod.Queue = queue_mod.Queue()
         t0 = time.monotonic()
         try:
-            fut = self.engine.submit_generate(
-                prompt, max_new_tokens=mnt, trace_id=hop_trace,
-                deadline_ms=deadline_ms,
-                on_token=lambda tok, ts: q.put((tok, ts)))
+            fut = submit(lambda tok, ts: q.put((tok, ts)))
         except OverloadedError as e:
             return 503, {"error": "overloaded", "reason": e.reason,
                          "detail": str(e),
                          "retry_after_s": round(gen.retry_after_s(), 3),
                          "trace_id": getattr(e, "trace_id", None)}, None
+        except SegmentMismatch as e:
+            return 409, {"error": "segment_mismatch",
+                         "detail": str(e)}, None
         except ValueError as e:
             return 400, {"error": "bad request", "detail": str(e)}, None
         self.send_response(200)

@@ -52,3 +52,30 @@ def pytest_configure(config):
         "markers",
         "slow: excluded from the tier-1 run (-m 'not slow'); "
         "subprocess-heavy or long-wall-clock tests")
+
+
+def retry_flaky(retries: int = 1, delay_s: float = 2.0):
+    """Bounded single-retry for tests DOCUMENTED as in-suite flakes on
+    core-bound CI hosts (they pass reliably in isolation and on the
+    pristine tree under load — see the PR 12/13 notes in CHANGES.md).
+    This is NOT a general license to retry: apply only with an
+    in-docstring justification, and keep ``retries`` at 1 so a real
+    regression (which fails deterministically) still fails the suite
+    while a scheduler hiccup gets exactly one more shot after the
+    host load transient passes."""
+    import functools
+    import time as _time
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            for attempt in range(retries + 1):
+                try:
+                    return fn(*args, **kwargs)
+                except AssertionError:
+                    if attempt >= retries:
+                        raise
+                    _time.sleep(delay_s)
+        return wrapper
+
+    return deco

@@ -59,6 +59,25 @@ poison_paged   every Nth *generation prompt* carries a     prefill-time poison
                                                            bit-exact and later
                                                            borrowers still hit
                                                            the prefix index
+disagg_crash   role-split generation fleet (2 prefill +    router affinity
+               2 decode) under MIXED long-prompt/short-    containment: requests
+               chat /generate load; SIGKILL a prefill      on the dead replica
+               replica mid-handoff, then a decode          fail inside the fault
+               replica holding live adopted segments       window (affinity_lost
+                                                           for the decode kill —
+                                                           never silently
+                                                           re-prefilled), the
+                                                           survivors keep
+                                                           serving (zero
+                                                           collateral), the
+                                                           supervisor respawns
+                                                           both, burn-rate
+                                                           alerts fire in-window
+                                                           and clear, and after
+                                                           the storm every
+                                                           replica's page pool
+                                                           drains to ZERO live
+                                                           pages (no leak)
 =============  ==========================================  =============
 
 Usage::
@@ -99,7 +118,7 @@ POISON = 1e30
 POISON_TOKEN = 7
 
 DEFAULT_SCENARIOS = ("baseline", "crash", "hang", "slow", "poison",
-                     "poison_paged")
+                     "poison_paged", "disagg_crash")
 
 # burn-rate scaling for the chaos run: scenario durations are seconds,
 # not SRE hours, so the router's alert windows shrink to fractions of
@@ -195,13 +214,16 @@ def _post(url: str, body: bytes, timeout_s: float):
 
 def run_traffic(url: str, feat: int, qps: float, duration_s: float,
                 poison_every: int = 0, timeout_s: float = 15.0,
-                workers: int = 16) -> List[dict]:
+                workers: int = 16, route: str = "/predict",
+                bodies: Optional[List[bytes]] = None) -> List[dict]:
     """Open-loop traffic: a pacing clock enqueues bodies at ``qps``; a
     poster pool sends them.  Every request is recorded with its
     monotonic start/end and whether it was deliberately poisoned —
-    the attribution the collateral-failure contract needs."""
-    predict = url.rstrip("/") + "/predict"
-    bodies = _bodies(feat)
+    the attribution the collateral-failure contract needs.
+    ``route``/``bodies`` repoint the storm (the disagg scenario sends
+    generation bodies at ``/generate``)."""
+    predict = url.rstrip("/") + route
+    bodies = bodies if bodies is not None else _bodies(feat)
     poison = _poison_body(feat)
     records: List[dict] = []
     lock = threading.Lock()
@@ -570,6 +592,213 @@ def _scenario_poison_paged(cfg: dict) -> dict:
     return rep
 
 
+def _scenario_disagg_crash(cfg: dict, log=print) -> dict:
+    """Disaggregated-fleet containment: a role-split generation fleet
+    (2 prefill + 2 decode replicas) serves MIXED long-prompt/
+    short-chat ``/generate`` traffic through an affinity router while
+    a prefill replica is SIGKILLed mid-handoff and then a decode
+    replica is SIGKILLed while holding live adopted segments.
+
+    The contract: (a) zero collateral failures — every failed request
+    lies inside a fault window (prefill kills heal by the router's
+    connect-refused retry onto the surviving prefill replica; decode
+    kills surface as the explicit ``affinity_lost`` taxonomy, never a
+    silent re-prefill); (b) the burn-rate alert fires inside each
+    fault window and clears after recovery; (c) after the storm
+    drains, EVERY replica's page pool reports zero live pages — a
+    leaked page means a refcount path (export, adopt, failure) lost a
+    decref; (d) the supervisor respawned both victims ready, roles
+    pinned."""
+    import paddle_tpu  # noqa: F401 — flags registered
+    from paddle_tpu.serving import FleetSupervisor, Router, RouterServer
+    from paddle_tpu.serving.fleet import _healthz
+
+    duration = max(float(cfg["duration_s"]) * 1.5, 8.0)
+    qps = min(float(cfg["qps"]), 10.0)  # generation >> /predict cost
+    roles = ["prefill", "prefill", "decode", "decode"]
+    argv = ["--feat", "8", "--hidden", "16", "--depth", "1",
+            "--generate", "--gen-vocab", "64", "--gen-hidden", "32",
+            "--gen-layers", "2", "--gen-heads", "4",
+            "--gen-intermediate", "64", "--gen-slots", "4",
+            "--gen-max-seq", "64", "--gen-max-new", "8",
+            "--gen-page-tokens", "8",
+            "--queue-cap", "512", "--deadline-ms", "60000"]
+    # prefix reuse off: a drained pool must read EXACTLY zero live
+    # pages (with reuse on, index-held pages are by-design residents)
+    env = {"FLAGS_serving_prefix_reuse": "0"}
+    error = None
+    notes: Dict[str, object] = {"roles": roles}
+    records: List[dict] = []
+    windows: List[tuple] = []
+    alerts: Dict[str, object] = {}
+    leaked = None
+    sup = FleetSupervisor(replicas=4, roles=roles, replica_argv=argv,
+                          env=env, max_restarts=8, backoff_ms=100.0,
+                          liveness_timeout_ms=cfg.get(
+                              "liveness_timeout_ms", 1500.0))
+    server = None
+    sampler = None
+    try:
+        urls = sup.wait_ready(timeout_s=600)
+        fast_s = max(1.0, duration / 4.0)
+        slow_s = max(fast_s * 2.0, duration * 0.75)
+        # the adopt hop carries a WHOLE generation (prefill hop +
+        # decode to completion), not one /predict batch: derive its
+        # bound from the caller's knob but floor it well above a
+        # full generation on a contended host — a slow-but-healthy
+        # adopt timing out outside a fault window would read as a
+        # collateral failure and flake the hard-zero contract
+        fwd_ms = max(4.0 * float(cfg.get("forward_timeout_ms", 800.0)),
+                     5000.0)
+        router = Router(urls, poll_interval_ms=100.0, stale_ms=1500.0,
+                        eject_after=2, forward_timeout_ms=fwd_ms,
+                        slo_fast_s=fast_s, slo_slow_s=slow_s)
+        server = RouterServer(router).start()
+        router.poll_once()
+        if not router.disagg_active():
+            raise RuntimeError("role-split fleet did not report "
+                               "disagg roles through /healthz")
+        # mixed long-prompt/short-chat bodies — the exact traffic
+        # shape the subsystem exists to fix
+        rng = np.random.RandomState(7)
+        bodies = []
+        for _ in range(32):
+            if rng.random_sample() < 0.25:
+                n = int(rng.randint(36, 49))   # long-prompt burst
+            else:
+                n = int(rng.randint(4, 9))     # short chat turn
+            bodies.append(json.dumps(
+                {"prompt": rng.randint(8, 64, size=n).tolist(),
+                 "max_new_tokens": 4}).encode())
+        box: Dict[str, Optional[float]] = {}
+        victim_p, victim_d = sup._replicas[0], sup._replicas[2]
+        notes["victims"] = {"prefill": victim_p.url,
+                            "decode": victim_d.url}
+
+        def inject():
+            time.sleep(duration * 0.25)
+            old_p = victim_p.proc.pid
+            box["t1"] = time.monotonic()
+            try:
+                os.kill(old_p, signal.SIGKILL)   # mid-handoff
+            except OSError as e:
+                box["err"] = f"prefill kill: {e}"
+                return
+            time.sleep(duration * 0.3)
+            old_d = victim_d.proc.pid
+            box["t2"] = time.monotonic()
+            try:
+                os.kill(old_d, signal.SIGKILL)   # live segments die
+            except OSError as e:
+                box["err"] = f"decode kill: {e}"
+                return
+            box["r1"] = _wait_respawned_ready(victim_p, old_p)
+            box["r2"] = _wait_respawned_ready(victim_d, old_d)
+
+        sampler = _AlertSampler(router)
+        injector = threading.Thread(target=inject, daemon=True)
+        injector.start()
+        records = run_traffic(server.url, 8, qps, duration,
+                              timeout_s=cfg.get("timeout_s", 30.0),
+                              workers=8, route="/generate",
+                              bodies=bodies)
+        injector.join(timeout=180.0)
+        if box.get("err"):
+            error = box["err"]
+        elif box.get("t1") is None or box.get("t2") is None:
+            error = "injection never fired both kills"
+        elif box.get("r1") is None:
+            error = "prefill victim never respawned ready"
+        elif box.get("r2") is None:
+            error = "decode victim never respawned ready"
+        else:
+            windows = [(box["t1"], box["r1"] + 1.0),
+                       (box["t2"], box["r2"] + 1.0)]
+            notes["recovery_s"] = {
+                "prefill": round(box["r1"] - box["t1"], 3),
+                "decode": round(box["r2"] - box["t2"], 3)}
+        # burn-rate contract: fire inside EACH fault window, clear
+        # after recovery (same machinery as the crash/hang scenarios)
+        if windows:
+            clear_deadline = time.monotonic() \
+                + router.burn_monitor.fast_s + _ALERT_CLEAR_GRACE_S
+            while time.monotonic() < clear_deadline \
+                    and router.burn_monitor.firing():
+                time.sleep(0.1)
+        sampler.stop()
+        if windows:
+            fired = [sampler.fired_between(w0, w1)
+                     for w0, w1 in windows]
+            still = router.burn_monitor.firing()
+            alerts = {"fired_in_windows": fired,
+                      "cleared": not still, "still_firing": still}
+            if error is None and not all(fired):
+                error = ("burn-rate alert missed a disagg_crash "
+                         "fault window")
+            elif error is None and still:
+                error = (f"burn-rate alert(s) {still} never cleared "
+                         f"after disagg_crash recovery")
+        # leak check: once the queues drain, every replica's pool
+        # must hold ZERO live pages (reuse off) — retry until the
+        # fleet settles, then read the verdict
+        deadline = time.monotonic() + 60.0
+        live_view = []
+        while time.monotonic() < deadline:
+            live_view = []
+            for rep in sup._replicas:
+                h = _healthz(rep.url, timeout=2.0) or {}
+                g = h.get("generation") or {}
+                paged = g.get("paged") or {}
+                live_view.append({
+                    "url": rep.url, "role": rep.role,
+                    "pages_live": paged.get("pages_live"),
+                    "queue_depth": g.get("queue_depth"),
+                    "slots_active": g.get("slots_active")})
+            settled = (len(live_view) == 4 and all(
+                v["pages_live"] == 0 and v["queue_depth"] == 0
+                and v["slots_active"] == 0 for v in live_view))
+            if settled:
+                leaked = 0
+                break
+            time.sleep(0.5)
+        notes["pools_after"] = live_view
+        if leaked is None:
+            leaked = sum(v["pages_live"] or 0 for v in live_view)
+            if error is None:
+                error = (f"page pools never drained to zero after "
+                         f"the storm: {live_view}")
+        st = router.stats()["counters"]
+        notes["router"] = {k: st[k] for k in
+                           ("disagg_generations", "affinity_lost",
+                            "reprefills", "retries", "no_ready")}
+        if error is None and st["disagg_generations"] == 0:
+            error = "no request took the disaggregated pipeline"
+        if error is None and st["reprefills"] > 0:
+            error = ("router re-prefilled despite "
+                     "FLAGS_disagg_reprefill=0 (silent re-prefill "
+                     "is forbidden by the taxonomy)")
+    finally:
+        if sampler is not None:
+            sampler.stop()
+        if server is not None:
+            server.close()
+        sup.close()
+
+    rep = classify(records, windows)
+    rep["scenario"] = "disagg_crash"
+    rep["notes"] = notes
+    rep["alerts"] = alerts
+    rep["leaked_pages"] = leaked
+    if "recovery_s" in notes:
+        rep["recovery_s"] = max(notes["recovery_s"].values())
+    if error is None and rep["ok"] == 0:
+        error = "no generation request succeeded (fleet never served)"
+    if error is not None:
+        rep["error"] = error
+    rep["_records"] = records
+    return rep
+
+
 # ---------------------------------------------------------------------------
 # orchestrator
 # ---------------------------------------------------------------------------
@@ -592,7 +821,9 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
 
     cfg = {"qps": qps, "duration_s": duration_s, "feat": feat,
            "poison_every": poison_every, "slow_delay_ms": slow_delay_ms,
-           "slow_prob": slow_prob, "timeout_s": timeout_s}
+           "slow_prob": slow_prob, "timeout_s": timeout_s,
+           "liveness_timeout_ms": liveness_timeout_ms,
+           "forward_timeout_ms": forward_timeout_ms}
     argv = ["--feat", str(feat), "--hidden", str(hidden),
             "--depth", str(depth), "--max-batch", "8",
             "--max-delay-ms", "2.0", "--queue-cap", "512",
@@ -630,11 +861,16 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
                 # fleet traffic, but runs inside the same harness so
                 # its counters fold into the same hard-zero contract
                 rep = _scenario_poison_paged(cfg)
+            elif name == "disagg_crash":
+                # role-split generation fleet with its own router —
+                # spawned fresh so the kills cannot bleed into the
+                # shared /predict fleet's attribution
+                rep = _scenario_disagg_crash(cfg, log=log)
             else:
                 rep = _scenario(name, sup, router, server.url, cfg)
             records = rep.pop("_records")
             all_records.extend(records)
-            if name in ("crash", "hang"):
+            if name in ("crash", "hang", "disagg_crash"):
                 fault_records.extend(records)
             per_scenario[name] = rep
             al = rep.get("alerts") or {}
@@ -670,6 +906,11 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
     totals["alert_errors"] = sum(
         1 for r in per_scenario.values()
         if "error" in r and "burn-rate alert" in r["error"])
+    # disagg page-pool leak verdict (None when the scenario didn't
+    # run): perf_gate hard-zeroes it like collateral/leaks
+    if any("leaked_pages" in r for r in per_scenario.values()):
+        totals["leaked_pages"] = sum(
+            r.get("leaked_pages") or 0 for r in per_scenario.values())
     fault_ok_ms = sorted(r["ms"] for r in fault_records
                          if r["outcome"] == "ok")
     p99_under_fault = round(

@@ -169,6 +169,18 @@ def compare_leg(name: str, new: dict, base: dict,
                               f"alert-contract violation(s) (missed "
                               f"fire / missed clear / false positive)")
             return res
+        # disagg page-pool leak rule (hard, like collateral/leaks):
+        # a live page surviving the drained storm means a refcount
+        # path (export / adopt / failure) lost a decref — core
+        # contention can slow the drain, never leak a page.  None is
+        # allowed: captures predate the disagg scenario
+        leaked_pages = new.get("leaked_pages")
+        if leaked_pages:
+            res.update(status="regression",
+                       reason=f"chaos disagg_crash left "
+                              f"{leaked_pages} KV page(s) live after "
+                              f"the storm drained (refcount leak)")
+            return res
         # the harness's own verdict: a scenario that errored (watchdog
         # never fired, no poisoned request reached a model, victim
         # never respawned) means a containment mechanism went
@@ -179,6 +191,17 @@ def compare_leg(name: str, new: dict, base: dict,
                        reason=f"chaos harness reported scenario "
                               f"errors: {detail}")
             return res
+    # disagg vacuous-A/B rule, also checked before every skip: a leg
+    # that carries the ratio key but measured None means the A/B's
+    # decode grid never stepped — an empty measurement must not read
+    # as "no regression" on any host
+    if "disagg_vs_colocated_p99" in new \
+            and new.get("disagg_vs_colocated_p99") is None:
+        res.update(status="regression",
+                   reason="disagg leg has no measured decode-step "
+                          "p99 ratio (vacuous A/B: the decode grid "
+                          "never stepped)")
+        return res
     nk, bk = new.get("device_kind"), base.get("device_kind")
     if nk is not None and bk is not None and nk != bk:
         res.update(status="skipped",
@@ -298,6 +321,26 @@ def compare_leg(name: str, new: dict, base: dict,
                    reason=f"prefix hit rate {phr} under the "
                           f"{phr_floor} floor on the shared-prompt "
                           f"workload")
+    # disagg-leg extras: the disaggregated pipeline's reason to exist
+    # is decode-step p99 under the mixed workload.  (a) A leg that
+    # carries the key but measured nothing is vacuous — the A/B's
+    # decode grid never stepped, which no skip may shield; (b) once a
+    # baseline proved the p99 win (ratio <= 1.0) on this device kind,
+    # a fresh ratio collapsing past 1.0+tol is a regression even when
+    # raw tokens/sec keeps up (mirrors the dp p99 rule)
+    dvp = new.get("disagg_vs_colocated_p99")
+    if dvp is not None:
+        dvp_base = base.get("disagg_vs_colocated_p99")
+        # arm strictly on dvp_base <= 1.0 (the baseline PROVED the
+        # win), not <= 1.0+tol — a baseline inside the noise gap
+        # never proved anything and must not flap the gate
+        if res["status"] == "ok" and dvp_base is not None \
+                and dvp > 1.0 + tol and dvp_base <= 1.0:
+            res.update(status="regression",
+                       reason=f"disagg decode-step p99 now {dvp}x "
+                              f"colocated (was {dvp_base}x; tol "
+                              f"{tol}) — the handoff stopped paying "
+                              f"for itself")
     return res
 
 
@@ -504,6 +547,56 @@ def run_smoke() -> int:
         and "prefix hit rate" in x.get("reason", "")
         for x in r["legs"]))
 
+    # disagg leg (synthetic until a BENCH_r* capture carries it):
+    # generic noise gate + the decode-step p99 collapse rule (arms
+    # only where the baseline proved the < 1.0 win) + the
+    # vacuous-None hard rule
+    disagg_leg = {
+        "metric": "llama_disagg_tokens_per_sec",
+        "value": 1900.0, "unit": "tokens/sec",
+        "device_kind": "cpu",
+        "stats": {"rounds": 3, "median": 1900.0, "p10": 1780.0,
+                  "p90": 2050.0, "min": 1750.0, "max": 2100.0},
+        "colocated_tokens_per_sec": 1850.0,
+        "disagg_vs_colocated_tokens": 1.03,
+        "disagg_vs_colocated_p99": 0.62,
+        "p99_step_ms": 3.1, "colocated_p99_step_ms": 5.0,
+        "handoffs": 48,
+    }
+    with_disagg = json.loads(json.dumps(latest))
+    with_disagg.setdefault("legs", {})["llama_disagg"] = disagg_leg
+    r = compare_bench(with_disagg, docs + [with_disagg])
+    check("disagg self-compare passes", r["ok"],
+          json.dumps([x for x in r["legs"]
+                      if x["status"] == "regression"]))
+    r = compare_bench(_degrade(with_disagg, 0.70),
+                      docs + [with_disagg])
+    check("disagg 30%-degraded fails", not r["ok"])
+    p99_collapse = json.loads(json.dumps(with_disagg))
+    p99_collapse["legs"]["llama_disagg"]["disagg_vs_colocated_p99"] \
+        = 1.6
+    r = compare_bench(p99_collapse, docs + [with_disagg])
+    check("disagg p99-collapse fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "decode-step p99" in x.get("reason", "")
+        for x in r["legs"]))
+    # ...but a > 1.0 ratio must NOT flap when the baseline never
+    # proved the win (core-bound CPU smoke captures) — 1.05 sits in
+    # the (1.0, 1.0+tol] noise gap, the sharpest non-proof
+    never_won_d = json.loads(json.dumps(with_disagg))
+    never_won_d["legs"]["llama_disagg"]["disagg_vs_colocated_p99"] \
+        = 1.05
+    r = compare_bench(p99_collapse, docs + [never_won_d])
+    check("disagg >1.0 p99 vs >1.0 baseline passes", r["ok"],
+          json.dumps([x for x in r["legs"]
+                      if x["status"] == "regression"]))
+    vacuous_d = json.loads(json.dumps(with_disagg))
+    vacuous_d["legs"]["llama_disagg"]["disagg_vs_colocated_p99"] = None
+    r = compare_bench(vacuous_d, docs + [with_disagg])
+    check("disagg vacuous-None fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "vacuous A/B" in x.get("reason", "") for x in r["legs"]))
+
     # sharded-serving leg (synthetic capable-host fixture: the 2-core
     # CI sim flags its own captures anomalous, so the >=2x dp contract
     # is proven here on fixture numbers): generic noise gate + the
@@ -683,6 +776,15 @@ def run_smoke() -> int:
     check("chaos missing-leak-count fails", not r["ok"] and any(
         x["status"] == "regression"
         and "poison-leak" in x.get("reason", "") for x in r["legs"]))
+    page_leak = json.loads(json.dumps(with_chaos))
+    page_leak["legs"]["chaos"]["leaked_pages"] = 3
+    page_leak["legs"]["chaos"]["anomaly"] = "core-bound host"
+    r = compare_bench(page_leak, docs + [with_chaos])
+    check("chaos leaked-pages fails even when anomalous",
+          not r["ok"] and any(
+              x["status"] == "regression"
+              and "refcount leak" in x.get("reason", "")
+              for x in r["legs"]))
     alert_err = json.loads(json.dumps(with_chaos))
     alert_err["legs"]["chaos"]["alert_errors"] = 2
     alert_err["legs"]["chaos"]["anomaly"] = "core-bound host"

@@ -450,7 +450,9 @@ def prompt_maker(vocab_size: int, prompt_min: int, prompt_max: int,
                  pool: int = 64,
                  dist: str = "geometric",
                  prompt_dist: str = "uniform",
-                 prefix_tokens: int = 0) -> Callable[[int], tuple]:
+                 prefix_tokens: int = 0,
+                 long_frac: float = 0.25,
+                 long_tokens: int = 0) -> Callable[[int], tuple]:
     """Deterministic per-request ``(prompt_ids, max_new_tokens)``
     factory.  Prompt lengths are uniform in [prompt_min, prompt_max];
     output lengths draw from ``dist`` with mean ``out_mean`` clamped to
@@ -471,7 +473,15 @@ def prompt_maker(vocab_size: int, prompt_min: int, prompt_max: int,
     few-shot preamble of a chat product) followed by a random
     [prompt_min, prompt_max]-token tail — the workload where the paged
     engine's prefix index turns the header's prefill into a page-table
-    hit.  ``"uniform"`` keeps fully random prompts."""
+    hit.  ``"uniform"`` keeps fully random prompts.
+
+    ``prompt_dist="mixed"``: the **bimodal long-prompt/short-chat**
+    traffic shape disaggregated serving exists to fix — a
+    ``long_frac`` fraction of prompts are LONG (uniform in
+    ``[3*long_tokens//4, long_tokens]``; compute-bound prefill bursts
+    that wreck colocated decode p99) and the rest are short chat
+    turns (uniform in [prompt_min, prompt_max]).  ``long_tokens`` is
+    required; tune ``long_frac`` to sweep the mix."""
     rng = np.random.RandomState(seed)
     reqs = []
     if dist == "bimodal":
@@ -487,10 +497,24 @@ def prompt_maker(vocab_size: int, prompt_min: int, prompt_max: int,
                              "prefix_tokens >= 1")
         header = rng.randint(1, vocab_size,
                              size=prefix_tokens).astype("int64")
+    elif prompt_dist == "mixed":
+        if long_tokens < max(1, prompt_max):
+            raise ValueError(f"mixed prompts need long_tokens > the "
+                             f"short prompt_max ({prompt_max}), got "
+                             f"{long_tokens}")
+        if not 0.0 < long_frac < 1.0:
+            raise ValueError(f"mixed prompts need 0 < long_frac < 1, "
+                             f"got {long_frac}")
     elif prompt_dist != "uniform":
         raise ValueError(f"unknown prompt dist {prompt_dist!r}")
     for _ in range(pool):
-        plen = int(rng.randint(prompt_min, prompt_max + 1))
+        if prompt_dist == "mixed" \
+                and rng.random_sample() < long_frac:
+            plen = int(rng.randint(max(prompt_min,
+                                       3 * long_tokens // 4),
+                                   long_tokens + 1))
+        else:
+            plen = int(rng.randint(prompt_min, prompt_max + 1))
         prompt = rng.randint(1, vocab_size, size=plen).astype("int64")
         if header is not None:
             prompt = np.concatenate([header, prompt])
@@ -1237,16 +1261,29 @@ def main(argv=None) -> int:
                     help="FIFO head-run (batch drain) scheduling "
                          "instead of continuous slot reclaim")
     ap.add_argument("--gen-prompt-dist",
-                    choices=("uniform", "shared-prefix"),
+                    choices=("uniform", "shared-prefix", "mixed"),
                     default="uniform",
-                    help="prompt shape: fully random, or a fixed "
+                    help="prompt shape: fully random; a fixed "
                          "--gen-prefix-tokens system-prompt header + "
                          "random tail (the chat workload where the "
                          "paged engine's prefix index skips the "
-                         "header's prefill)")
+                         "header's prefill); or 'mixed' — the bimodal "
+                         "long-prompt/short-chat blend (--gen-long-"
+                         "frac long prompts of ~--gen-long-tokens, "
+                         "the rest short chat turns) that "
+                         "disaggregated prefill/decode exists to fix")
     ap.add_argument("--gen-prefix-tokens", type=int, default=32,
                     help="shared-prefix mode: tokens in the common "
                          "header every prompt starts with")
+    ap.add_argument("--gen-long-frac", type=float, default=0.25,
+                    help="mixed mode: fraction of prompts that are "
+                         "long (tunable burst ratio)")
+    ap.add_argument("--gen-long-tokens", type=int, default=0,
+                    help="mixed mode: long-prompt length (drawn "
+                         "uniform in [3/4*N, N]); default 0 = the "
+                         "in-process engine's max prompt length, or "
+                         "half of --gen-max-seq for a remote --url "
+                         "target")
     ap.add_argument("--gen-paged", action="store_true",
                     help="block-paged KV cache (page pool + per-slot "
                          "block tables + prefix reuse) instead of the "
@@ -1351,7 +1388,13 @@ def main(argv=None) -> int:
             args.gen_out_mean, args.gen_out_max,
             dist=args.gen_out_dist, prompt_dist=args.gen_prompt_dist,
             prefix_tokens=args.gen_prefix_tokens
-            if args.gen_prompt_dist == "shared-prefix" else 0)
+            if args.gen_prompt_dist == "shared-prefix" else 0,
+            long_frac=args.gen_long_frac,
+            # remote default: half the replica's cache capacity
+            # (--gen-max-seq describes the target) — guaranteed under
+            # its largest prefill bucket, unlike a prompt_max multiple
+            long_tokens=args.gen_long_tokens
+            or max(args.gen_prompt_max + 1, args.gen_max_seq // 2))
         report = run_closed_loop_generate_http(
             args.url, make_prompt, args.requests, args.concurrency,
             stream=args.gen_stream)
@@ -1408,7 +1451,12 @@ def main(argv=None) -> int:
                                    args.gen_out_mean, args.gen_out_max,
                                    dist=args.gen_out_dist,
                                    prompt_dist=args.gen_prompt_dist,
-                                   prefix_tokens=prefix)
+                                   prefix_tokens=prefix,
+                                   long_frac=args.gen_long_frac,
+                                   long_tokens=min(
+                                       args.gen_long_tokens
+                                       or gen.max_prompt_len,
+                                       gen.max_prompt_len))
         try:
             if args.mode == "both":
                 report = {"mode": "both",
