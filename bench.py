@@ -1755,7 +1755,8 @@ def run_chaos():
     duration_s = float(env("BENCH_CHAOS_DURATION_S", "6"))
     scenarios = tuple(s for s in env("BENCH_CHAOS_SCENARIOS",
                                      "baseline,crash,hang,slow,"
-                                     "poison,disagg_crash").split(",")
+                                     "poison,disagg_crash,hot_swap"
+                                     ).split(",")
                       if s)
     report = chaos.run_chaos(replicas=replicas, qps=qps,
                              duration_s=duration_s,
@@ -1792,6 +1793,201 @@ def run_chaos():
             f"host has {cores} cores for {replicas} replica processes "
             f"+ the router; recovery timing is core-bound (the "
             f"collateral/leak containment rules still gate)")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rollout leg: hot-swap discipline + canary auto-revert/promotion
+# ---------------------------------------------------------------------------
+
+def run_rollout():
+    """Safe-rollout leg (`legs.rollout`): two live demonstrations,
+    both hard-gated by `tools/perf_gate.py`.
+
+    First the chaos harness's ``hot_swap`` scenario IS the
+    measurement — a rolling ``FleetSupervisor.hot_swap`` under mixed
+    open-loop ``/predict`` + ``/generate`` load, then a second rollout
+    with one replica SIGKILLed mid-commit: zero non-shed failures
+    outside the kill window (``rollout.failed``), zero torn-version
+    responses (``rollout.torn_responses``), restart-fallback
+    convergence, and bit-exact post-swap outputs.
+
+    Then a canary double-feature through a live router: a CLEAN
+    checkpoint must soak and promote with zero reverts
+    (``canary.false_reverts`` — a burn-rate judge that convicts good
+    weights makes rollouts un-shippable), and a NaN-poisoned
+    checkpoint (every request 500s under
+    ``FLAGS_serving_check_outputs``) must auto-revert on burn
+    evidence inside the soak window (``canary.revert_latency_s``
+    against ``revert_latency_bound_s``).  Sized by
+    BENCH_ROLLOUT_{QPS,DURATION_S,SOAK_S,FEAT}."""
+    import importlib.util
+    import tempfile
+    import threading
+
+    import jax
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "chaos.py")
+    spec = importlib.util.spec_from_file_location("chaos_rollout", path)
+    chaos = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(chaos)
+    lg = _load_serving_loadgen()
+
+    env = os.environ.get
+    qps = float(env("BENCH_ROLLOUT_QPS", "25"))
+    duration_s = float(env("BENCH_ROLLOUT_DURATION_S", "5"))
+    soak_s = float(env("BENCH_ROLLOUT_SOAK_S", "6"))
+    feat = int(env("BENCH_ROLLOUT_FEAT", "16"))
+
+    # hot-swap discipline under fire (own fleet, own verdicts)
+    cfg = {"qps": qps, "duration_s": duration_s, "feat": feat,
+           "timeout_s": 15.0, "liveness_timeout_ms": 1500.0}
+    rep = chaos._scenario_hot_swap(cfg, log=lambda *a: None)
+    rep.pop("_records", None)
+    notes = rep.get("notes") or {}
+    swap_clean = notes.get("swap_clean") or {}
+    swap_killed = notes.get("swap_killed") or {}
+    rollout = {
+        # collateral = failures OUTSIDE the SIGKILL window: the
+        # zero-non-shed contract a clean swap must hold
+        "failed": rep.get("collateral_failures"),
+        "torn_responses": rep.get("torn_responses"),
+        "injected_failures": rep.get("injected_failures"),
+        "shed": rep.get("shed"),
+        "requests": rep.get("requests"),
+        "swaps": 2,
+        "converged": bool(swap_clean.get("converged"))
+        and bool(swap_killed.get("converged")),
+        "clean_swap_s": swap_clean.get("duration_s"),
+        "killed_swap_s": swap_killed.get("duration_s"),
+        "fallbacks": swap_killed.get("fallbacks"),
+        "bit_exact": notes.get("bit_exact"),
+    }
+
+    # canary: clean promote + poisoned auto-revert through a router
+    from paddle_tpu.serving import (FleetSupervisor, Router,
+                                    RouterServer)
+    from paddle_tpu.serving.replica import build_synthetic_checkpoint
+
+    workdir = tempfile.mkdtemp(prefix="bench-rollout-")
+    dims = dict(feat=feat, hidden=16, depth=1, classes=8)
+    ck_good = os.path.join(workdir, "ck_good")
+    ck_bad = os.path.join(workdir, "ck_bad")
+    build_synthetic_checkpoint(ck_good, seed=21, **dims)
+    build_synthetic_checkpoint(ck_bad, seed=22, poison_nan=True,
+                               **dims)
+    argv = ["--feat", str(feat), "--hidden", "16", "--depth", "1",
+            "--max-batch", "8", "--max-delay-ms", "2.0",
+            "--queue-cap", "512"]
+    sup = FleetSupervisor(
+        replicas=3, replica_argv=argv,
+        env={"FLAGS_serving_check_outputs": "1"},
+        max_restarts=4, backoff_ms=100.0,
+        workdir=os.path.join(workdir, "fleet"))
+    server = None
+    stop = threading.Event()
+    canary = {}
+    try:
+        urls = sup.wait_ready(timeout_s=300)
+        router = Router(urls, poll_interval_ms=100.0, stale_ms=2000.0,
+                        eject_after=3)
+        server = RouterServer(router).start()
+        router.start()  # the poll loop drives the canary verdict
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            router.poll_once()
+            if router.healthz()[1]["routable"] == len(urls):
+                break
+            time.sleep(0.2)
+        make_feed = lg.feed_maker({"x": (feat,)}, rows=1)
+
+        def pump():
+            # steady traffic so the burn-rate judge has evidence;
+            # short windows re-check `stop` between them
+            while not stop.is_set():
+                lg.run_open_loop_http(server.url, make_feed, qps=qps,
+                                      duration_s=1.0, timeout_s=10.0,
+                                      collectors=4)
+
+        pump_t = threading.Thread(target=pump, daemon=True)
+        pump_t.start()
+
+        def soak(ck):
+            router.canary(ck, fraction=0.34, soak_s=soak_s)
+            deadline = time.monotonic() + 6.0 * soak_s + 60.0
+            while time.monotonic() < deadline:
+                st = router.canary_status()
+                last = st.get("last") or {}
+                if not st["active"] and last.get("state") in (
+                        "reverted", "promoted"):
+                    return last
+                time.sleep(0.2)
+            return {"state": "verdict_timeout"}
+
+        clean = soak(ck_good)
+        bad = soak(ck_bad)
+        counters = router.canary_status()["counters"]
+        reverted = bad.get("state") == "reverted"
+        canary = {
+            "false_reverts": (
+                1 if clean.get("state") == "reverted"
+                else 0 if clean.get("state") == "promoted"
+                else None),  # vacuous soak: perf_gate fails it
+            "promotions": counters.get("canary_promotions"),
+            "reverts": 1 if reverted else 0,
+            # detection + revert POSTs, start-of-soak to reverted:
+            # the judge must beat the promotion clock
+            "revert_latency_s": round(
+                bad.get("soak_elapsed_s", 0.0)
+                + bad.get("revert_latency_s", 0.0), 3)
+            if reverted else None,
+            "revert_latency_bound_s": soak_s,
+            "revert_reason": bad.get("reason"),
+            "clean_state": clean.get("state"),
+            "bad_state": bad.get("state"),
+        }
+        if not reverted:
+            canary["error"] = (f"poisoned canary did not revert: "
+                               f"{bad}")
+    finally:
+        stop.set()
+        if server is not None:
+            server.close()
+        sup.close()
+
+    errors = {}
+    if "error" in rep:
+        errors["hot_swap"] = rep["error"]
+    if "error" in canary:
+        errors["canary"] = canary["error"]
+    out = {
+        "metric": "rollout_availability_pct",
+        "value": rep.get("availability_pct"),
+        "unit": "%",
+        "device_kind": getattr(jax.devices()[0], "device_kind",
+                               str(jax.devices()[0])),
+        "stats": {"rounds": 1, "median": rep.get("availability_pct")},
+        "availability_floor": 99.0,
+        # top-level chaos-rule keys: the scenario's collateral /
+        # poison verdicts ride the same perf_gate hard rules as the
+        # chaos leg
+        "collateral_failures": rep.get("collateral_failures"),
+        "poison_leaks": rep.get("poison_leaks"),
+        "p99_under_fault_ms": rep.get("p99_ms"),
+        "rollout": rollout,
+        "canary": canary,
+        "harness_ok": not errors,
+        "errors": errors,
+        "config": {"qps": qps, "duration_s": duration_s,
+                   "soak_s": soak_s, "feat": feat},
+    }
+    cores = os.cpu_count() or 1
+    if cores < 4:
+        out["anomaly"] = (
+            f"host has {cores} cores for 3 replica processes + the "
+            f"router; swap/soak timing is core-bound (the torn-"
+            f"version / false-revert rules still gate)")
     return out
 
 
@@ -1894,6 +2090,14 @@ def main():
                 out["legs"]["chaos"] = run_chaos()
             except Exception as e:
                 out["legs"]["chaos"] = {
+                    "error": f"{type(e).__name__}: {e}"}
+        # rollout leg: hot-swap discipline + canary auto-revert /
+        # promotion against live fleets (BENCH_ROLLOUT=0 skips)
+        if os.environ.get("BENCH_ROLLOUT", "1") == "1":
+            try:
+                out["legs"]["rollout"] = run_rollout()
+            except Exception as e:
+                out["legs"]["rollout"] = {
                     "error": f"{type(e).__name__}: {e}"}
 
     print(json.dumps(out))

@@ -38,6 +38,7 @@ the ones wired in-tree:
     decode_step    serving/generation.py decode      fail | delay:ms | hang
     replica_health serving/server.py /healthz        fail | delay:ms | hang
     router_forward serving/router.py route           fail | delay:ms | hang
+    weight_swap    inference.py swap commit          fail | delay:ms
     =============  ================================  ===================
 
 Every fired fault bumps ``faults_injected`` plus a per-site/kind

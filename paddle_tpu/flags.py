@@ -395,3 +395,24 @@ register_flag("FLAGS_router_federate", True,
               "in the router tsdb, and serve the fleet aggregate on "
               "GET /fleetz plus replica-labeled fleet_* series on the "
               "router's own /metrics.  0 = health polling only")
+register_flag("FLAGS_swap_timeout_s", 30.0,
+              "in-place weight swap: max seconds to quiesce at a "
+              "drained-batch / decode-grid-step boundary before the "
+              "swap gives up (serving keeps running on the old "
+              "weights; paddle_tpu/serving/engine.py swap_weights)")
+register_flag("FLAGS_canary_fraction", 0.25,
+              "canary rollout: fraction of the fleet Router.canary "
+              "hot-swaps to the new checkpoint and weights the "
+              "traffic split by (bounded to [1, N-1] replicas; "
+              "paddle_tpu/serving/router.py)")
+register_flag("FLAGS_canary_soak_s", 60.0,
+              "canary rollout: soak window.  A canary that survives "
+              "this long without a per-version burn-rate alert (or a "
+              "canary replica crash) promotes to the rest of the "
+              "fleet; sustained burn before then auto-reverts")
+register_flag("FLAGS_serving_check_outputs", False,
+              "serving engine: reject batches whose outputs contain "
+              "non-finite values (RequestFailed for the batch's rows) "
+              "— the bad-checkpoint tripwire the canary burn-rate "
+              "judge feeds on.  Off by default: costs one isfinite "
+              "scan per batch on the serve path")

@@ -30,8 +30,44 @@ import numpy as np
 from .framework.core import Program, dtype_to_np
 from .framework.executor import Scope, analyze_block, lower_block
 
-__all__ = ["Config", "AnalysisConfig", "Predictor", "create_predictor",
+__all__ = ["Config", "AnalysisConfig", "Predictor", "SwapMismatch",
+           "weights_structure_fingerprint", "create_predictor",
            "load_portable"]
+
+
+class SwapMismatch(ValueError):
+    """A hot-swap checkpoint is structurally incompatible with the live
+    weights (missing parameter, shape or dtype drift).  Rejected at
+    admission — nothing is applied, the old weights keep serving.  The
+    HTTP ``/swap`` endpoint maps this to 409, exactly like a
+    :class:`~paddle_tpu.serving.disagg.SegmentMismatch`."""
+
+
+def weights_structure_fingerprint(doc: Dict[str, tuple]) -> str:
+    """sha256 fingerprint of a ``name -> (shape, dtype)`` weight-table
+    structure — the swap-admission sibling of
+    :func:`~paddle_tpu.serving.disagg.config_fingerprint`: equal
+    fingerprints mean a checkpoint's arrays drop into the live
+    compiled executables without recompilation or reshape."""
+    import hashlib
+    import json
+
+    payload = {n: [list(int(d) for d in shape), str(dtype)]
+               for n, (shape, dtype) in doc.items()}
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode()).hexdigest()[:24]
+
+
+def _weight_doc(named_arrays) -> Dict[str, tuple]:
+    """``name -> (shape, dtype)`` without forcing device arrays to
+    host (np.shape / .dtype are metadata reads on jax arrays)."""
+    doc = {}
+    for n, v in named_arrays:
+        dt = getattr(v, "dtype", None)
+        if dt is None:
+            dt = np.asarray(v).dtype
+        doc[n] = (tuple(np.shape(v)), str(np.dtype(dt)))
+    return doc
 
 
 class Config:
@@ -97,6 +133,11 @@ class Predictor:
         self._block = program.global_block()
         self._cache: Dict[tuple, object] = {}
         self._state_in = None
+        # last successful swap's replaced arrays (name -> device array):
+        # the single-level undo revert_weights() restores — retained so
+        # a canary revert is an instant in-memory flip, no checkpoint
+        # round-trip.  Costs one old model of HBM until the next swap.
+        self._prev_weights: Optional[Dict[str, object]] = None
         # run() is thread-safe: the per-shape compile cache (and the lazy
         # _state_in analysis) are guarded by this lock, so N threads can
         # share ONE predictor — first compile of a signature serializes,
@@ -247,6 +288,145 @@ class Predictor:
                 "manifests": {str(s): manifest_summary(m)
                               for s, m in sorted(entries,
                                                  key=lambda x: str(x[0]))}}
+
+    # -- in-place weight hot-swap -------------------------------------------
+    def _ensure_state_in(self) -> List[str]:
+        with self._lock:
+            if self._state_in is None:
+                state_in, _ = analyze_block(self._block, self.feed_names)
+                self._state_in = state_in
+            return self._state_in
+
+    def weights_doc(self) -> Dict[str, tuple]:
+        """``name -> (shape, dtype)`` of the live executor-state
+        weights — the structure a swap checkpoint must match."""
+        state_in = self._ensure_state_in()
+        pairs = []
+        for n in state_in:
+            v = self.scope.find_var(n)
+            if v is None:
+                raise RuntimeError(f"predictor: no value for {n!r}; was "
+                                   "the model saved with parameters?")
+            pairs.append((n, v))
+        return _weight_doc(pairs)
+
+    def weights_fingerprint(self) -> str:
+        """Structural sha256 of the live weight table (see
+        :func:`weights_structure_fingerprint`)."""
+        return weights_structure_fingerprint(self.weights_doc())
+
+    def _swap_place(self, name: str, value):
+        """Device placement for one incoming weight.  The sharded
+        subclass overrides this to re-place per its ShardingRules so
+        the swapped arrays drop into the same mesh-partitioned
+        executables."""
+        import jax
+
+        return jax.device_put(value)
+
+    def _rebind_cache_locked(self):
+        """Point every cached executable's state tuple at the CURRENT
+        scope arrays (call with the lock held, after the scope flip)."""
+        if self._state_in is None or not self._cache:
+            return
+        vals = tuple(self.scope.find_var(n) for n in self._state_in)
+        for sig, entry in list(self._cache.items()):
+            self._cache[sig] = (entry[0], vals,
+                                entry[2] if len(entry) > 2 else None)
+
+    def swap_weights(self, checkpoint, *, params_filename=None) -> dict:
+        """Hot-swap the weights under the live compiled executables —
+        zero recompiles, validated before anything is applied.
+
+        ``checkpoint``: a ``save_inference_model``-style directory
+        (its ``__params__`` pickle) or a ``name -> array`` dict.
+        Every executor-state weight must be present with the exact
+        live shape and dtype; any drift raises :class:`SwapMismatch`
+        with both structural fingerprints and nothing applied.  The
+        commit (device placement + scope flip + executable-state
+        rebind) runs under the predictor lock; a failure mid-commit
+        (the ``weight_swap`` fault site fires per array) rolls back
+        to the old arrays — a torn mix is never observable.  The
+        replaced arrays are retained for :meth:`revert_weights`."""
+        from . import fault, io
+
+        if isinstance(checkpoint, str):
+            path = os.path.join(checkpoint,
+                                params_filename or "__params__")
+            if not os.path.exists(path):
+                raise SwapMismatch(
+                    f"swap checkpoint {checkpoint!r} has no "
+                    f"{params_filename or '__params__'}")
+            new = io._read(path)
+        else:
+            new = dict(checkpoint)
+        live_doc = self.weights_doc()
+        problems = []
+        for n, (shape, dtype) in live_doc.items():
+            if n not in new:
+                problems.append(f"{n}: missing from checkpoint")
+                continue
+            got_shape = tuple(np.shape(new[n]))
+            got_dt = getattr(new[n], "dtype", None)
+            got_dtype = str(np.dtype(got_dt)) if got_dt is not None \
+                else str(np.asarray(new[n]).dtype)
+            if got_shape != shape:
+                problems.append(f"{n}: shape {got_shape} != live {shape}")
+            elif got_dtype != dtype:
+                problems.append(f"{n}: dtype {got_dtype} != live {dtype}")
+        if problems:
+            new_doc = _weight_doc([(n, v) for n, v in new.items()
+                                   if n in live_doc])
+            raise SwapMismatch(
+                f"checkpoint structure "
+                f"{weights_structure_fingerprint(new_doc)} != live "
+                f"{weights_structure_fingerprint(live_doc)}: "
+                + "; ".join(problems[:4])
+                + (f" (+{len(problems) - 4} more)"
+                   if len(problems) > 4 else ""))
+        state_in = self._ensure_state_in()
+        old_vals: Dict[str, object] = {}
+        with self._lock:
+            try:
+                for n in state_in:
+                    kind = fault.fire("weight_swap")
+                    fault.maybe_delay(kind)
+                    if kind == "fail":
+                        raise fault.InjectedFault(
+                            f"injected weight_swap failure at {n!r}")
+                    old_vals[n] = self.scope.find_var(n)
+                    self.scope.set_var(n, self._swap_place(n, new[n]))
+                self._rebind_cache_locked()
+            except BaseException:
+                # roll back: restore every already-flipped array and
+                # rebind the executables to the restored scope — the
+                # old weights keep serving, never a torn mix
+                for n, v in old_vals.items():
+                    self.scope.set_var(n, v)
+                self._rebind_cache_locked()
+                raise
+            self._prev_weights = old_vals
+        return {"replaced": len(state_in),
+                "fingerprint": weights_structure_fingerprint(live_doc)}
+
+    def revert_weights(self) -> dict:
+        """Restore the arrays the last successful :meth:`swap_weights`
+        replaced (single-level, in-memory — the canary auto-revert
+        path).  Raises :class:`SwapMismatch` when no prior swap left
+        anything to revert to."""
+        prev = self._prev_weights
+        if not prev:
+            raise SwapMismatch("nothing to revert: no prior successful "
+                               "swap retained its replaced weights")
+        return self.swap_weights(prev)
+
+    def rebind_weights(self):
+        """Rebind this predictor's cached executables to the current
+        scope arrays — the follow-up call for clones SHARING a scope
+        another predictor just swapped (their executables still hold
+        the old state tuples)."""
+        with self._lock:
+            self._rebind_cache_locked()
 
     def _clone_kwargs(self) -> dict:
         """Extra constructor kwargs a clone must inherit.  Subclasses

@@ -51,6 +51,18 @@ trafficable engine:
   re-dispatches of the original row count.  ``FLAGS_serving_bisect=0``
   restores fail-the-whole-batch.
 
+* **In-place weight hot-swap** — :meth:`swap_weights` admits a
+  structurally-identical checkpoint (shape/dtype drift rejected with
+  :class:`~paddle_tpu.inference.SwapMismatch` before anything flips),
+  quiesces dispatch at a drained-batch boundary (requests keep
+  queueing — a swap pauses, it never sheds), flips every pooled
+  predictor's weights under the SAME compiled executables (zero
+  recompiles; milliseconds, not a restart) and bumps the published
+  ``weights_version``.  A failed commit rolls back to the old arrays —
+  the engine never serves a torn mix of versions — and
+  :meth:`revert_weights` restores the previous weights instantly from
+  retained device arrays (the canary auto-revert path).
+
 * **End-to-end deadlines** — ``submit(deadline_ms=...)`` adopts a
   caller-propagated remaining budget (the HTTP front end reads it
   from the ``X-PaddleTPU-Deadline-Ms`` header the fleet router mints
@@ -126,7 +138,10 @@ class ServingError(RuntimeError):
 class OverloadedError(ServingError):
     """Explicit shed: the engine refused (or dropped) the request rather
     than queue unbounded latency.  ``reason`` is one of ``queue_full``,
-    ``deadline``, ``draining``, ``injected``."""
+    ``deadline``, ``draining``, ``injected`` — plus the weight-swap
+    refusals ``swap_busy`` (another swap is mid-flight) and
+    ``swap_timeout`` (the quiesce never reached a drained-batch
+    boundary inside ``FLAGS_swap_timeout_s``)."""
 
     def __init__(self, reason: str, detail: str = ""):
         super().__init__(f"serving overloaded ({reason})"
@@ -325,7 +340,8 @@ class ServingEngine:
         self._n = {"requests": 0, "served": 0, "shed": 0, "batches": 0,
                    "exact_bucket": 0, "batch_failures": 0, "pad_rows": 0,
                    "sampled": 0, "shed_deadline": 0, "bisections": 0,
-                   "poison_rows": 0}
+                   "poison_rows": 0, "weight_swaps": 0,
+                   "weight_swap_failures": 0}
         self._n_lock = threading.Lock()
         self._h_request = telemetry.Histogram("serving_request_ms")
         self._h_wait = telemetry.Histogram("serving_queue_wait_ms")
@@ -341,6 +357,19 @@ class ServingEngine:
         self._g_depth = telemetry.metrics.gauge("serving_queue_depth")
         self._g_peak = telemetry.metrics.gauge("serving_queue_depth_peak")
         self._peak_depth = 0  # engine-local high watermark (cv-guarded)
+
+        # in-place weight hot-swap state: the published version starts
+        # at 1 (the spawn checkpoint) and bumps on every successful
+        # swap/revert.  _paused holds worker dispatch at the drained-
+        # batch boundary while a swap quiesces + commits (submits keep
+        # queueing — a swap pauses, it never sheds); _dispatching
+        # counts batches from pickup (under _cv, inside _next_batch)
+        # to completion, so the quiesce wait has no pickup-to-run
+        # blind spot the per-worker in_flight_rows bookkeeping leaves.
+        self.weights_version = 1
+        self._swap_lock = threading.Lock()
+        self._paused = False
+        self._dispatching = 0
 
         # request-trace store for /tracez: a ring of recent head-sampled
         # traces + the slowest-N tail (kept regardless of sampling)
@@ -405,6 +434,15 @@ class ServingEngine:
             if self._draining or self._closed:
                 return False
         return self._warmed or not self._ready_requires_warmup
+
+    def warming(self) -> bool:
+        """True while readiness is gated on a warmup that has not yet
+        finished.  The HTTP front door sheds data-plane work in this
+        state: warmup runs prefill/decode programs *directly* (outside
+        the scheduler's decode-grid step boundary), so a request
+        admitted mid-warmup would race the warmup pass on the donated
+        KV buffers and abort the process."""
+        return self._ready_requires_warmup and not self._warmed
 
     def start(self):
         if self._threads:
@@ -695,6 +733,155 @@ class ServingEngine:
         """Blocking one-shot: ``submit(feed).result(timeout)``."""
         return self.submit(feed).result(timeout)
 
+    # -- in-place weight hot-swap -------------------------------------------
+    @staticmethod
+    def _load_swap_checkpoint(checkpoint) -> dict:
+        """Checkpoint dir -> ``{name: array}``, loaded ONCE for the
+        whole pool (a ReplicaGroupEngine must not re-read the file per
+        group); an in-memory dict passes through untouched (engine-
+        level revert, tests)."""
+        if isinstance(checkpoint, dict):
+            return dict(checkpoint)
+        from .. import io
+        from ..inference import SwapMismatch
+        path = os.path.join(str(checkpoint), "__params__")
+        if not os.path.exists(path):
+            raise SwapMismatch(
+                f"swap checkpoint {str(checkpoint)!r} has no __params__")
+        return io._read(path)
+
+    def swap_weights(self, checkpoint, *,
+                     timeout_s: Optional[float] = None) -> dict:
+        """Hot-swap the pool's weights in place: the executables
+        outlive the weights.
+
+        ``checkpoint`` is a ``save_inference_model`` directory (or an
+        in-memory ``{name: array}`` dict).  The new arrays are
+        validated against the live weight structure FIRST — any
+        shape/dtype/missing-name drift raises
+        :class:`~paddle_tpu.inference.SwapMismatch` (HTTP ``/swap``
+        maps it to 409) before a single array flips, exactly the
+        admission discipline ``KVSegment`` adoption uses.  Then worker
+        dispatch pauses, the quiesce waits for every in-flight batch
+        to complete (bounded by ``FLAGS_swap_timeout_s`` — on timeout
+        the engine keeps serving the OLD weights), and every distinct
+        predictor commits the new arrays under its compiled programs
+        (sharded pools re-place per their ``ShardingRules``).  Success
+        bumps the published ``weights_version``; any commit failure
+        rolls back to the old arrays — a torn mix of versions is never
+        served.  Queued requests ride through untouched: a swap
+        pauses, it never sheds."""
+        if timeout_s is None:
+            timeout_s = float(flag_value("FLAGS_swap_timeout_s") or 30.0)
+        arrays = self._load_swap_checkpoint(checkpoint)
+        return self._swap_apply(lambda p: p.swap_weights(arrays),
+                                timeout_s, "swap")
+
+    def revert_weights(self, *,
+                       timeout_s: Optional[float] = None) -> dict:
+        """Instantly restore the weights replaced by the last
+        successful :meth:`swap_weights` from the retained device
+        arrays — no checkpoint round-trip (the canary auto-revert
+        path).  Same quiesce + version-bump discipline as a forward
+        swap; :class:`~paddle_tpu.inference.SwapMismatch` when there
+        is nothing to revert to."""
+        if timeout_s is None:
+            timeout_s = float(flag_value("FLAGS_swap_timeout_s") or 30.0)
+        return self._swap_apply(lambda p: p.revert_weights(),
+                                timeout_s, "revert")
+
+    def _swap_apply(self, apply_fn, timeout_s: float, what: str) -> dict:
+        """Shared swap/revert machinery: serialize (``swap_busy``),
+        refuse during drain (``draining``), pause dispatch, quiesce to
+        the drained-batch boundary (``swap_timeout``), apply across
+        the pool, bump + publish the version."""
+        t0 = time.monotonic()
+        if not self._swap_lock.acquire(timeout=timeout_s):
+            raise OverloadedError("swap_busy",
+                                  "another weight swap is mid-flight")
+        try:
+            with self._cv:
+                if self._draining or self._closed:
+                    raise OverloadedError("draining",
+                                          "no weight swap during drain")
+                self._paused = True
+                self._cv.notify_all()
+            try:
+                deadline = t0 + timeout_s
+                with self._cv:
+                    while self._dispatching > 0:
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            raise OverloadedError(
+                                "swap_timeout",
+                                f"{self._dispatching} batch(es) still "
+                                f"in flight after {timeout_s}s quiesce")
+                        self._cv.wait(min(left, 0.05))
+                self._swap_pool(apply_fn)
+            finally:
+                with self._cv:
+                    self._paused = False
+                    self._cv.notify_all()
+            with self._n_lock:
+                self.weights_version += 1
+                self._n["weight_swaps"] += 1
+                version = self.weights_version
+            stat_add("serving_weight_swaps")
+            telemetry.gauge_set("serving_weights_version", version)
+            ms = round((time.monotonic() - t0) * 1e3, 3)
+            telemetry.log_event("serving_weight_swap", op=what,
+                                version=version, swap_ms=ms)
+            logger.info("weight %s committed: version=%d in %.1fms",
+                        what, version, ms)
+            return {"weights_version": version, "swap_ms": ms}
+        except OverloadedError:
+            raise  # a refusal (busy/draining/timeout) is not a failure
+        except BaseException:
+            self._count("weight_swap_failures")
+            stat_add("serving_weight_swap_failures")
+            raise
+        finally:
+            self._swap_lock.release()
+
+    def _swap_pool(self, apply_fn):
+        """Apply one weight flip across every distinct predictor in
+        the pool (plus the base).  Predictors sharing a Scope get ONE
+        real commit (the first) and a cache rebind for the rest — the
+        shared-executable pool and plain clones both resolve to a
+        single device_put sweep.  On a mid-pool failure every
+        predictor already flipped is rolled back before re-raising, so
+        a multi-group engine (ReplicaGroupEngine: one private scope
+        per dp group) never keeps a torn mix of versions across
+        groups; within one predictor, ``Predictor.swap_weights`` is
+        already atomic."""
+        uniq = list(dict.fromkeys(self._pool))
+        if self._base not in uniq:
+            uniq.append(self._base)
+        done = []
+        swapped_scopes = set()
+        try:
+            for p in uniq:
+                sid = id(p.scope)
+                if sid in swapped_scopes:
+                    p.rebind_weights()
+                    done.append((p, "rebind"))
+                else:
+                    apply_fn(p)
+                    swapped_scopes.add(sid)
+                    done.append((p, "swap"))
+        except BaseException:
+            for q, mode in reversed(done):
+                try:
+                    if mode == "swap":
+                        q.revert_weights()
+                    else:
+                        q.rebind_weights()
+                except Exception:  # noqa: BLE001 — rollback is best
+                    # effort across groups; the re-raise below still
+                    # reports the original commit failure
+                    logger.exception("weight-swap rollback failed")
+            raise
+
     # -- generation routing -------------------------------------------------
     def attach_generator(self, generator) -> "ServingEngine":
         """Attach a :class:`~paddle_tpu.serving.generation.
@@ -786,6 +973,12 @@ class ServingEngine:
         with self._cv:
             first = None
             while first is None:
+                if self._paused:
+                    # a weight swap is quiescing/committing: hold at
+                    # the drained-batch boundary (requests keep
+                    # queueing; the swap's finally unpauses)
+                    self._cv.wait(0.05)
+                    continue
                 first = self._pop_live_locked()
                 if first is None:
                     if self._draining:
@@ -806,6 +999,10 @@ class ServingEngine:
                 if left <= 0:
                     break
                 self._cv.wait(left)
+            # booked while still holding _cv: the swap quiesce reads
+            # _dispatching under the same lock, so a batch is never
+            # invisible between pickup and _run_batch's bookkeeping
+            self._dispatching += 1
             depth = len(self._queue)
         if telemetry.enabled():
             self._g_depth.set(depth)  # dequeue-time refresh
@@ -878,6 +1075,23 @@ class ServingEngine:
                     raise PoisonedInput(
                         f"batch contains poisoned input (sentinel {pv})")
 
+    def _check_outputs(self, outs):
+        """``FLAGS_serving_check_outputs``: reject a dispatch whose
+        float outputs contain non-finite values — the bad-checkpoint
+        tripwire (a NaN weight rollout fails its requests loudly here,
+        which is the failure evidence the canary burn-rate judge feeds
+        on) instead of silently returning garbage.  Off by default:
+        the scan costs a pass over every output."""
+        if not flag_value("FLAGS_serving_check_outputs"):
+            return
+        for o in outs:
+            a = np.asarray(o)
+            if np.issubdtype(a.dtype, np.floating) \
+                    and not np.all(np.isfinite(a)):
+                raise RequestFailed(
+                    "non-finite value in model output "
+                    "(bad checkpoint / numerical blowup)")
+
     def _execute(self, predictor, batch: List[_Request]
                  ) -> List[List[np.ndarray]]:
         """Execute ``batch`` as one padded dispatch (or the chunked
@@ -895,6 +1109,7 @@ class ServingEngine:
         padded, _real = batcher.pad_stack([r.arrays for r in batch],
                                           bucket)
         outs = predictor.run(padded)
+        self._check_outputs(outs)
         per_req = batcher.split_rows(outs, [r.rows for r in batch])
         self._book_batch(rows, bucket)
         return per_req
@@ -1003,6 +1218,9 @@ class ServingEngine:
             with self._n_lock:
                 self._health[widx]["in_flight_rows"] = 0
                 self._health[widx]["busy_since"] = None
+            with self._cv:
+                self._dispatching -= 1
+                self._cv.notify_all()  # wake a quiescing swap
 
     def _bisect(self, predictor, batch: List[_Request], widx: int,
                 cause: Exception):
@@ -1065,6 +1283,7 @@ class ServingEngine:
             bucket = batcher.bucket_for(part[0].shape[0], self.buckets)
             padded, real = batcher.pad_stack([part], bucket)
             outs = predictor.run(padded)
+            self._check_outputs(outs)
             chunks.append([np.asarray(o)[:real] for o in outs])
             self._book_batch(real, bucket)
         return [np.concatenate([c[i] for c in chunks], axis=0)
@@ -1162,6 +1381,7 @@ class ServingEngine:
         with self._n_lock:
             n = dict(self._n)
             inflight = sum(h["in_flight_rows"] for h in self._health)
+            version = self.weights_version
         with self._cv:
             depth = len(self._queue)
             peak = self._peak_depth
@@ -1174,6 +1394,7 @@ class ServingEngine:
             "workers": self.workers,
             "buckets": list(self.buckets),
             "draining": draining,
+            "weights_version": version,
             "counters": n,
             "groups_degraded": self.groups_degraded(),
             "bucket_hit_rate": round(
@@ -1251,9 +1472,12 @@ class ServingEngine:
         # ready() would re-take _cv and could disagree mid-close)
         ready = not (draining or closed) and (
             self._warmed or not self._ready_requires_warmup)
+        with self._n_lock:
+            version = self.weights_version
         out = {
             "status": status,
             "ready": ready,
+            "weights_version": version,
             "pid": os.getpid(),
             "time": time.time(),
             "uptime_s": round(time.time() - self._started, 3),

@@ -44,9 +44,20 @@ own port, metrics dir, and ``PADDLE_TPU_REPLICA_ID`` env.
   traffic through a rollout with zero non-shed failures (asserted by
   ``bench.py run_router`` and ``tests/test_router.py``).
 
+* **In-place hot-swap rollout.** :meth:`hot_swap` rolls a new weights
+  checkpoint through the fleet ONE replica at a time via ``POST
+  /swap`` — no process restart, no recompile, the replica's queue
+  rides through.  Each replica must report the new
+  ``weights_version`` and ``ready`` on ``/healthz`` before the next
+  is touched.  A replica that refuses the swap (409 structural
+  mismatch, 503 wedged quiesce, a dead socket) falls back
+  automatically to the restart path — SIGTERM drain, respawn at the
+  same port, re-swap the fresh process — so a rollout converges even
+  when a replica's live state has drifted.
+
 Stats (README catalog): counters ``fleet_restarts``,
-``fleet_rolling_restarts``, ``fleet_hung_kills``; gauge
-``fleet_replicas_live``.
+``fleet_rolling_restarts``, ``fleet_hung_kills``, ``fleet_hot_swaps``,
+``fleet_hot_swap_fallbacks``; gauge ``fleet_replicas_live``.
 """
 from __future__ import annotations
 
@@ -58,6 +69,7 @@ import sys
 import tempfile
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
@@ -441,6 +453,138 @@ class FleetSupervisor:
                             duration_s=round(time.monotonic() - t0, 3))
         return {"replicas": out,
                 "duration_s": round(time.monotonic() - t0, 3)}
+
+    @staticmethod
+    def _post_swap(url: str, body: dict, timeout_s: float = 35.0):
+        """POST /swap to one replica; returns ``(status, payload)``
+        with the payload parsed for error codes too (409/503 carry
+        the refusal detail), or ``(None, {...})`` when the socket
+        itself failed."""
+        req = urllib.request.Request(
+            url.rstrip("/") + "/swap", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except ValueError:
+                return e.code, {}
+        except (OSError, TimeoutError, ValueError) as e:
+            return None, {"error": f"{type(e).__name__}: {e}"}
+
+    def _verify_swapped(self, rep: _Replica, version,
+                        deadline: float) -> bool:
+        """The per-replica rollout gate: ``/healthz`` must report
+        ``ready`` AND the expected ``weights_version`` before the
+        next replica is touched — a swap that 200'd but never became
+        visible is a failed swap."""
+        while time.monotonic() < deadline:
+            h = _healthz(rep.url)
+            if (h is not None and h.get("ready")
+                    and (version is None
+                         or h.get("weights_version") == version)):
+                return True
+            if rep.proc.poll() is not None:
+                return False
+            time.sleep(0.05)
+        return False
+
+    def hot_swap(self, checkpoint_dir: str,
+                 ready_timeout_s: float = 120.0,
+                 drain_timeout_s: float = 30.0,
+                 target: str = "predict") -> dict:
+        """Roll ``checkpoint_dir`` through the fleet in place: ``POST
+        /swap`` one replica at a time, each verified (new
+        ``weights_version`` visible on ``/healthz`` + ``ready``)
+        before the next — milliseconds per replica, zero respawns,
+        zero recompiles, the replica's queued requests ride through.
+
+        A replica that refuses (409 mismatch / 503 quiesce timeout /
+        dead socket) or whose new version never becomes visible falls
+        back to the restart path automatically: SIGTERM drain →
+        respawn at the same port → wait ready → re-swap the fresh
+        process (``fleet_hot_swap_fallbacks``).  Per-replica outcomes
+        are returned, ``converged`` only when every live replica ended
+        on the new weights."""
+        stat_add("fleet_hot_swaps")
+        t0 = time.monotonic()
+        body = {"dir": checkpoint_dir, "target": target}
+        out = []
+        converged = True
+        for rep in self._replicas:
+            if rep.failed or rep.proc is None or rep.url is None:
+                out.append({"replica": rep.idx, "skipped": "down"})
+                continue
+            with self._lock:
+                rep.in_rollout = True
+            try:
+                t_rep = time.monotonic()
+                code, payload = self._post_swap(rep.url, body)
+                entry = {"replica": rep.idx, "swap_status": code}
+                ok = False
+                if code == 200:
+                    ok = self._verify_swapped(
+                        rep, payload.get("weights_version"),
+                        time.monotonic() + ready_timeout_s)
+                    entry["swap_ms"] = payload.get("swap_ms")
+                    entry["weights_version"] = \
+                        payload.get("weights_version")
+                if not ok:
+                    entry["rejected"] = payload.get("error") \
+                        or payload.get("detail") or "verify failed"
+                    ok = self._swap_fallback_restart(
+                        rep, body, entry, ready_timeout_s,
+                        drain_timeout_s)
+                entry["ok"] = ok
+                entry["total_s"] = round(time.monotonic() - t_rep, 3)
+                out.append(entry)
+                converged = converged and ok
+            finally:
+                with self._lock:
+                    rep.in_rollout = False
+        dur = round(time.monotonic() - t0, 3)
+        telemetry.log_event("fleet_hot_swap", replicas=len(out),
+                            converged=converged, duration_s=dur)
+        return {"replicas": out, "converged": converged,
+                "duration_s": dur}
+
+    def _swap_fallback_restart(self, rep: _Replica, body: dict,
+                               entry: dict, ready_timeout_s: float,
+                               drain_timeout_s: float) -> bool:
+        """The rollout's safety net: a replica that cannot swap in
+        place is drained, respawned at its pinned port, and the FRESH
+        process swapped — same net effect (new weights at the same
+        URL), restart cost instead of milliseconds."""
+        stat_add("fleet_hot_swap_fallbacks")
+        logger.warning("replica %d refused the hot swap (%s); falling "
+                       "back to restart", rep.idx,
+                       entry.get("rejected"))
+        rep.proc.send_signal(signal.SIGTERM)
+        try:
+            rep.proc.wait(drain_timeout_s)
+        except Exception:  # subprocess.TimeoutExpired
+            logger.warning("replica %d did not drain in %.1fs; killing",
+                           rep.idx, drain_timeout_s)
+            rep.proc.kill()
+            rep.proc.wait(5.0)
+        self._spawn(rep)
+        if not self._wait_replica_ready(
+                rep, time.monotonic() + ready_timeout_s):
+            entry["fallback"] = "successor never ready"
+            return False
+        code, payload = self._post_swap(rep.url, body)
+        entry["fallback"] = {"swap_status": code,
+                             "weights_version":
+                                 payload.get("weights_version")}
+        if code != 200:
+            entry["fallback"]["rejected"] = payload.get("error") \
+                or payload.get("detail")
+            return False
+        return self._verify_swapped(
+            rep, payload.get("weights_version"),
+            time.monotonic() + ready_timeout_s)
 
     # -- introspection / teardown -------------------------------------------
     def statusz(self) -> dict:

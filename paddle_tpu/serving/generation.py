@@ -113,6 +113,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
@@ -472,6 +473,13 @@ class GenerationEngine:
         self._draining = False
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        # in-place weight hot-swap: a validated swap is handed to the
+        # scheduler thread here and commits at the next decode-grid-
+        # step boundary (executors re-read the scope per call and the
+        # cache vars are untouched, so in-flight KV pages and token
+        # streams ride through the flip).  (arrays, Event, result box).
+        self._pending_swap = None
+        self.weights_version = 1
 
         self._n = {"requests": 0, "shed": 0, "served": 0, "prefills": 0,
                    "decode_steps": 0, "generated_tokens": 0,
@@ -855,6 +863,155 @@ class GenerationEngine:
         """Blocking one-shot: ``submit(...).result(timeout)``."""
         return self.submit(prompt, max_new_tokens).result(timeout)
 
+    # -- in-place weight hot-swap -------------------------------------------
+    def _weight_names(self) -> List[str]:
+        """The swap surface: every scope array that is NOT a KV cache
+        (the cache/pool vars carry live sequence state and must ride
+        through a swap untouched)."""
+        caches = set(self.cache_names)
+        return [n for n in self.scope.local_var_names()
+                if n not in caches]
+
+    def swap_weights(self, checkpoint, *,
+                     timeout_s: Optional[float] = None) -> dict:
+        """Hot-swap the decode/prefill weights in place at a
+        decode-grid-step boundary.
+
+        Validates the checkpoint (dir or ``{name: array}`` dict)
+        against the live weight structure on THIS thread — shape /
+        dtype / missing-name drift raises
+        :class:`~paddle_tpu.inference.SwapMismatch` before anything
+        flips — then hands the commit to the scheduler thread, which
+        applies it between grid steps: the executors re-read the scope
+        every call and the cache vars are untouched, so in-flight
+        sequences keep their KV pages and token streams and simply
+        decode the next token under the new weights.  A failed commit
+        rolls back to the old arrays.  Bounded by
+        ``FLAGS_swap_timeout_s``."""
+        from ..inference import (SwapMismatch, _weight_doc,
+                                 weights_structure_fingerprint)
+        if timeout_s is None:
+            timeout_s = float(flag_value("FLAGS_swap_timeout_s") or 30.0)
+        if isinstance(checkpoint, dict):
+            new = dict(checkpoint)
+        else:
+            path = os.path.join(str(checkpoint), "__params__")
+            if not os.path.exists(path):
+                raise SwapMismatch(
+                    f"swap checkpoint {str(checkpoint)!r} has no "
+                    f"__params__")
+            from .. import io
+            new = io._read(path)
+        names = self._weight_names()
+        live_doc = _weight_doc(
+            (n, self.scope.find_var(n)) for n in names)
+        new_doc = _weight_doc(
+            (n, new[n]) for n in names if n in new)
+        problems = []
+        for n in names:
+            if n not in new:
+                problems.append(f"{n}: missing from checkpoint")
+            elif new_doc[n] != live_doc[n]:
+                problems.append(f"{n}: checkpoint {new_doc[n]} != "
+                                f"live {live_doc[n]}")
+        if problems:
+            raise SwapMismatch(
+                f"checkpoint structure "
+                f"{weights_structure_fingerprint(new_doc)} != live "
+                f"{weights_structure_fingerprint(live_doc)}: "
+                + "; ".join(problems[:4]))
+        arrays = {n: new[n] for n in names}
+        if self._thread is None:
+            # no scheduler running (tests, pre-start): commit inline —
+            # every instant is a grid-step boundary
+            return self._commit_swap(arrays)
+        ev = threading.Event()
+        box: Dict[str, object] = {}
+        with self._cv:
+            if self._draining or self._closed:
+                raise SwapMismatch("no weight swap during drain")
+            if self._pending_swap is not None:
+                raise SwapMismatch("another weight swap is mid-flight")
+            self._pending_swap = (arrays, ev, box)
+            self._cv.notify_all()
+        if not ev.wait(timeout_s):
+            raise SwapMismatch(
+                f"swap not committed within {timeout_s}s "
+                f"(scheduler never reached a grid-step boundary)")
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _apply_pending_swap(self):
+        """Scheduler-thread half: commit the handed-off swap at the
+        grid-step boundary and wake the caller."""
+        with self._cv:
+            pending = self._pending_swap
+        if pending is None:
+            return
+        arrays, ev, box = pending
+        try:
+            box["result"] = self._commit_swap(arrays)
+        except BaseException as e:  # noqa: BLE001 — hand the caller
+            # the failure; the scheduler itself must keep decoding
+            box["error"] = e
+        finally:
+            with self._cv:
+                self._pending_swap = None
+            ev.set()
+
+    def _commit_swap(self, arrays: Dict[str, np.ndarray]) -> dict:
+        """Flip every weight array in the scope (validated upstream),
+        re-placing per the mesh sharding rules when mesh-partitioned.
+        Atomic: any failure — including an injected ``weight_swap``
+        fault — restores every already-flipped array before
+        re-raising."""
+        import jax
+
+        t0 = time.monotonic()
+        old_vals: Dict[str, object] = {}
+        try:
+            for n in sorted(arrays):
+                kind = fault.fire("weight_swap")
+                fault.maybe_delay(kind)
+                if kind == "fail":
+                    raise fault.InjectedFault(
+                        "injected weight_swap failure")
+                old_vals[n] = self.scope.find_var(n)
+                v = arrays[n]
+                if self.mesh is not None:
+                    from jax.sharding import NamedSharding
+                    sh = NamedSharding(
+                        self.mesh,
+                        self._shard_rules.spec(n, np.shape(v)))
+                    self.scope.set_var(n, jax.device_put(v, sh))
+                else:
+                    self.scope.set_var(n, jax.device_put(v))
+        except BaseException:
+            for n, v in old_vals.items():
+                self.scope.set_var(n, v)
+            stat_add("serving_weight_swap_failures")
+            raise
+        self._prev_weights = old_vals
+        self.weights_version += 1
+        stat_add("serving_weight_swaps")
+        ms = round((time.monotonic() - t0) * 1e3, 3)
+        telemetry.log_event("generation_weight_swap",
+                            version=self.weights_version, swap_ms=ms,
+                            replaced=len(arrays))
+        return {"weights_version": self.weights_version,
+                "swap_ms": ms, "replaced": len(arrays)}
+
+    def revert_weights(self) -> dict:
+        """Restore the weights replaced by the last successful swap
+        (retained device arrays — no checkpoint round-trip)."""
+        from ..inference import SwapMismatch
+        prev = getattr(self, "_prev_weights", None)
+        if not prev:
+            raise SwapMismatch("no previous weights retained "
+                               "(nothing swapped yet)")
+        return self.swap_weights(prev)
+
     # -- disaggregated handoff (KV segments) --------------------------------
     def fingerprint(self) -> str:
         """The segment-compatibility fingerprint (model sizes, page
@@ -1037,12 +1194,20 @@ class GenerationEngine:
 
     def _loop(self):
         while True:
+            # decode-grid-step boundary: the previous iteration's
+            # decode step fully committed, the next has not started —
+            # the one safe instant to flip weights under live slots
+            # (the apply reads the handoff box under _cv and returns
+            # immediately when no swap is pending)
+            self._apply_pending_swap()
             with self._cv:
                 while True:
                     if self._queue and self._can_claim_locked():
                         break
                     if self._active():
                         break
+                    if self._pending_swap is not None:
+                        break  # an idle grid must still commit swaps
                     if self._draining and not self._queue:
                         return
                     self._cv.wait(0.02)
@@ -1963,6 +2128,7 @@ class GenerationEngine:
             else _describe_mesh(self.mesh),
             "kv_shard_axis": getattr(self, "kv_shard_axis", None),
             "draining": draining,
+            "weights_version": self.weights_version,
             "counters": n,
             "tokens_per_request": round(
                 n["generated_tokens"] / max(n["served"], 1), 2),

@@ -61,6 +61,52 @@ def _write_endpoint(path: str, payload: dict):
     os.replace(tmp, path)
 
 
+def build_synthetic_checkpoint(dirname: str, *, feat: int = 64,
+                               hidden: int = 256, depth: int = 2,
+                               classes: int = 8, seed: int = 0,
+                               poison_nan: bool = False):
+    """Write a hot-swap checkpoint (``__params__``) structurally
+    identical to the synthetic-MLP replica's live weights — the
+    rollout bench / chaos / tests mint "new model versions" with this
+    (different ``seed`` = different weights, same structure; different
+    ``hidden`` etc. = a deliberate :class:`SwapMismatch` 409).
+    ``poison_nan=True`` fills every array with NaN: with
+    ``FLAGS_serving_check_outputs=1`` on the replicas, that checkpoint
+    fails every request it serves — the deterministic bad-rollout the
+    canary burn-rate judge must catch and auto-revert.
+
+    Resets the unique-name counter before building so parameter names
+    match a FRESH replica process (``rep_fc0.w_0`` ...), which is how
+    the spawned fleet names them."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from .. import io
+    from ..framework.core import reset_unique_name
+
+    reset_unique_name()
+    main, startup = pt.Program(), pt.Program()
+    startup._is_startup = True
+    startup.random_seed = main.random_seed = seed
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [feat])
+        h = x
+        for i in range(depth):
+            h = layers.fc(h, hidden, act="relu", name=f"rep_fc{i}")
+        layers.fc(h, classes, name="rep_head")
+    scope = pt.Scope()
+    pt.Executor().run(startup, scope=scope)
+    arrays = {}
+    for n in scope.local_var_names():
+        a = np.array(scope.find_var(n))
+        if poison_nan:
+            a[...] = np.nan
+        arrays[n] = a
+    os.makedirs(dirname, exist_ok=True)
+    io._write(os.path.join(dirname, "__params__"), arrays)
+    return sorted(arrays)
+
+
 def build_predictor(args):
     """(predictor, per_row_shapes) from the CLI args."""
     if args.model_dir:

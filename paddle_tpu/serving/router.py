@@ -58,6 +58,22 @@ not from a static round-robin.
   the same header, so a hopeless request never burns a batch slot
   anywhere in the fleet.
 
+* **Canary rollouts** — ``canary(checkpoint_dir, fraction)``
+  hot-swaps a new checkpoint onto a minority of replicas (``POST
+  /swap`` per replica; fleet-atomic admission — one refusal reverts
+  the rest and the canary never starts) and splits traffic by weights
+  version: an error-feedback accumulator in ``pick()`` routes exactly
+  ``fraction`` of requests to the canary subset.  A dedicated
+  short-window :class:`~paddle_tpu.tsdb.BurnRateMonitor` judges the
+  canary side's availability and p99 from per-version series
+  (``router_canary_requests`` / ``router_canary_failures`` /
+  ``router_canary_request_ms``): sustained burn — or a canary replica
+  crashing mid-soak — auto-reverts every canary replica to the
+  retained previous weights (``router_canary_reverts``); a clean
+  ``FLAGS_canary_soak_s`` soak promotes the checkpoint to the rest of
+  the fleet (``router_canary_promotions``).  See README "Safe
+  rollouts".
+
 * **Trace continuity** — the router forwards (or mints) an
   ``X-PaddleTPU-Trace`` id; its own ``router/request`` →
   ``router/forward`` spans and the replica's ``serving/request`` tree
@@ -145,8 +161,8 @@ from typing import Dict, List, Optional, Tuple
 from .. import fault, promtext, telemetry, tsdb
 from ..flags import all_flags, flag_value
 from ..monitor import process_uptime_s, stat_add
-from .server import (DEADLINE_HEADER, TRACE_HEADER, _AccessLog,
-                     _JsonHandler, parse_deadline_header,
+from .server import (DEADLINE_HEADER, TRACE_HEADER, VERSION_HEADER,
+                     _AccessLog, _JsonHandler, parse_deadline_header,
                      parse_trace_header)
 
 __all__ = ["Router", "RouterServer", "serve_router"]
@@ -231,6 +247,12 @@ class _Replica:
         pre-disagg replica serves the full pipeline)."""
         return (self.health or {}).get("role") or "both"
 
+    def weights_version(self) -> Optional[int]:
+        """The replica's published weights version from its last good
+        health poll (None until one lands)."""
+        v = (self.health or {}).get("weights_version")
+        return int(v) if v is not None else None
+
     def serves(self, role: Optional[str]) -> bool:
         """Can this replica take a hop of kind ``role``?  'prefill'
         and 'decode' hops accept a specialized replica OR a 'both'
@@ -284,6 +306,7 @@ class _Replica:
             "errors": self.errors,
             "last_error": self.last_error,
             "rid": self.rid,
+            "weights_version": self.weights_version(),
             "scrape_age_ms": round(
                 (time.monotonic() - self.scrape_ts) * 1e3, 1)
             if self.scrape_ts else None,
@@ -344,7 +367,11 @@ class Router:
                    "health_poll_failures": 0, "forward_timeouts": 0,
                    "deadline_sheds": 0, "scrapes": 0,
                    "scrape_failures": 0, "disagg_generations": 0,
-                   "affinity_lost": 0, "reprefills": 0}
+                   "affinity_lost": 0, "reprefills": 0,
+                   "canary_starts": 0, "canary_reverts": 0,
+                   "canary_promotions": 0, "canary_requests": 0,
+                   "canary_failures": 0, "base_requests": 0,
+                   "base_failures": 0}
         self._h_request = telemetry.Histogram("router_request_ms")
         # the windowed-series store behind the autoscale signal, the
         # federated fleet view, and the burn-rate monitor.  Router-
@@ -372,6 +399,15 @@ class Router:
                           objective_pct=99.0)],
             fast_s=slo_fast_s, slow_s=slo_slow_s,
             threshold=slo_burn_threshold)
+        # canary rollout: None, or the live soak's state dict (see
+        # canary()).  _canary_accum is the deterministic traffic-split
+        # accumulator — an error-feedback counter hits the requested
+        # fraction EXACTLY over any window, where a PRNG would let a
+        # short soak over- or under-expose the canary by luck
+        self._canary: Optional[dict] = None
+        self._canary_monitor: Optional[tsdb.BurnRateMonitor] = None
+        self._canary_accum = 0.0
+        self._last_canary: Optional[dict] = None
         self._autoscale = {"wanted_replicas": None, "pressure": None,
                            "p99_ms": None, "slo_p99_ms": self._slo_p99_ms,
                            "avg_queue_depth": None, "live": 0}
@@ -448,6 +484,7 @@ class Router:
         self._recompute_autoscale()
         self._record_sweep_series()
         self.burn_monitor.evaluate()
+        self._canary_evaluate()
 
     def _poll_replica(self, rep: _Replica):
         self._count("health_polls")
@@ -555,6 +592,25 @@ class Router:
                  if r.health is not None and not r.ejected)
         self._db.record("fleet_replicas_up", up, ts=now)
         telemetry.gauge_set("fleet_replicas_up", up)
+        with self._lock:
+            epoch = (self._canary or {}).get("epoch")
+        if epoch is not None:
+            # the canary judge's evidence: per-version request/failure
+            # counters (availability burn) — latency samples land per
+            # request in _canary_observe.  Stable names feed /fleetz;
+            # the #epoch twins feed this canary's judge (see canary())
+            self._db.record("router_canary_requests",
+                            n["canary_requests"], ts=now)
+            self._db.record("router_canary_failures",
+                            n["canary_failures"], ts=now)
+            self._db.record(f"router_canary_requests#{epoch}",
+                            n["canary_requests"], ts=now)
+            self._db.record(f"router_canary_failures#{epoch}",
+                            n["canary_failures"], ts=now)
+            self._db.record("router_base_requests",
+                            n["base_requests"], ts=now)
+            self._db.record("router_base_failures",
+                            n["base_failures"], ts=now)
 
     def _poll_failed(self, rep: _Replica, detail: str):
         self._count("health_poll_failures")
@@ -634,7 +690,16 @@ class Router:
         ejected / not-ready / excluded never.  ``role`` restricts the
         pool to replicas serving that disagg hop ('prefill'/'decode';
         'both'-role replicas qualify for either).  None = empty
-        fleet."""
+        fleet.
+
+        During a canary soak, placement splits by weights version: an
+        error-feedback accumulator sends exactly
+        ``canary['fraction']`` of picks to the canary subset and the
+        rest to the base subset — within each side the normal
+        least-loaded/fresh-first order holds, and a side with no
+        routable replica spills to the other (availability beats
+        split fidelity; the judge sees the spill as missing canary
+        traffic, never as client errors)."""
         fresh: List[Tuple[float, _Replica]] = []
         backup: List[Tuple[float, _Replica]] = []
         for rep in self._all():
@@ -644,6 +709,26 @@ class Router:
             tier = backup if (rep.stale(self._stale_s)
                               or rep.degraded()) else fresh
             tier.append((rep.load(), rep))
+        canary_urls = None
+        want_canary = False
+        with self._lock:
+            if self._canary is not None and (fresh or backup):
+                canary_urls = set(self._canary["urls"])
+                self._canary_accum += self._canary["fraction"]
+                want_canary = self._canary_accum >= 1.0
+                if want_canary:
+                    self._canary_accum -= 1.0
+        if canary_urls is not None:
+            def side(tier, canary_side):
+                return [t for t in tier
+                        if (t[1].url in canary_urls) == canary_side]
+            order = (side(fresh, want_canary)
+                     or side(backup, want_canary)
+                     or side(fresh, not want_canary)
+                     or side(backup, not want_canary))
+            if order:
+                return min(order, key=lambda t: t[0])[1]
+            return None
         pool = fresh or backup
         if not pool:
             return None
@@ -658,7 +743,8 @@ class Router:
               trace_id: Optional[str], timeout_s: float,
               deadline_ms: Optional[float],
               content_type: str = "application/json"
-              ) -> Tuple[int, bytes, str, Optional[str]]:
+              ) -> Tuple[int, bytes, str, Optional[str],
+                         Optional[str]]:
         headers = {"Content-Type": content_type,
                    TRACE_HEADER: trace_id or ""}
         if deadline_ms is not None:
@@ -675,7 +761,8 @@ class Router:
                     return (r.status, r.read(),
                             r.headers.get("Content-Type",
                                           "application/json"),
-                            r.headers.get("Retry-After"))
+                            r.headers.get("Retry-After"),
+                            r.headers.get(VERSION_HEADER))
             except urllib.error.HTTPError as e:
                 # the replica ANSWERED (400/404/500/503-shed): its
                 # verdict passes through verbatim, never retried
@@ -683,7 +770,8 @@ class Router:
                 return (e.code, data,
                         e.headers.get("Content-Type",
                                       "application/json"),
-                        e.headers.get("Retry-After"))
+                        e.headers.get("Retry-After"),
+                        e.headers.get(VERSION_HEADER))
         finally:
             with self._lock:
                 rep.inflight -= 1
@@ -751,7 +839,7 @@ class Router:
                 if kind == "fail":
                     raise ConnectionRefusedError(
                         "injected router_forward failure")
-                code, data, ctype, retry_after = self._send(
+                code, data, ctype, retry_after, version = self._send(
                     rep, route, body, trace_id, timeout_s,
                     remaining_ms)
             except Exception as e:  # noqa: BLE001 — sort, don't die
@@ -799,6 +887,8 @@ class Router:
                 if timed_out:
                     logger.warning("forward to %s timed out after "
                                    "%.2fs", rep.url, timeout_s)
+                    if count:
+                        self._canary_observe(rep.url, 504, t0)
                     return {"code": 504,
                             "body": json.dumps(
                                 {"error": "forward_timeout",
@@ -811,6 +901,8 @@ class Router:
                 self._count("replica_errors")
                 stat_add("router_replica_errors")
                 logger.warning("forward to %s failed: %s", rep.url, e)
+                if count:
+                    self._canary_observe(rep.url, 502, t0)
                 return {"code": 502,
                         "body": json.dumps(
                             {"error": "replica_error",
@@ -826,6 +918,8 @@ class Router:
                     rep.retries_to += 1
             self._count("routed")
             stat_add("router_requests_routed")
+            if count:
+                self._canary_observe(rep.url, code, t0)
             if code == 200 and count:
                 # count=False = a disagg pipeline hop: the caller
                 # observes the WHOLE request once — a hop's latency
@@ -833,7 +927,8 @@ class Router:
                 self._observe_request(t0, trace_id)
             return {"code": code, "body": data, "content_type": ctype,
                     "replica": rep.url, "retried": retried,
-                    "retry_after": retry_after}
+                    "retry_after": retry_after,
+                    "weights_version": version}
         # fleet empty (or emptied by the retry exclusion)
         self._count("no_ready")
         stat_add("router_no_ready_replicas")
@@ -851,6 +946,330 @@ class Router:
                 ).encode(),
                 "content_type": "application/json", "replica": None,
                 "retried": retried, "retry_after": retry_after}
+
+    # -- canary rollout -----------------------------------------------------
+    @staticmethod
+    def _swap_post(url: str, body: bytes, timeout_s: float = 35.0
+                   ) -> Tuple[Optional[int], dict]:
+        """POST a ``/swap`` body to one replica: ``(status, payload)``
+        with an HTTPError's body parsed (409/503 verdicts carry JSON)
+        and a socket-level failure as ``(None, {"error": ...})``."""
+        req = urllib.request.Request(
+            url.rstrip("/") + "/swap", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                return r.status, json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except (OSError, ValueError):
+                payload = {}
+            return e.code, payload
+        except (OSError, TimeoutError, ValueError) as e:
+            return None, {"error": f"{type(e).__name__}: {e}"}
+
+    def canary(self, checkpoint_dir: str,
+               fraction: Optional[float] = None,
+               soak_s: Optional[float] = None,
+               target: str = "predict",
+               swap_timeout_s: float = 35.0) -> dict:
+        """Start a canary rollout: hot-swap ``checkpoint_dir`` onto a
+        minority of ready replicas (``ceil(fraction * N)``, clamped to
+        ``[1, N-1]`` so both versions always serve), then split traffic
+        by weights version (see :meth:`pick`) and judge the canary
+        side with its own short-window burn-rate monitor.  The poll
+        loop drives the verdict: sustained burn — or a canary replica
+        crashing mid-soak — auto-reverts every canary replica to the
+        retained previous weights; a clean soak of ``soak_s`` promotes
+        the checkpoint to the rest of the fleet.
+
+        Admission is atomic at the FLEET level too: if any chosen
+        replica refuses the swap (409 structural mismatch, 503
+        draining), the already-swapped ones are reverted and the
+        canary never starts.  Raises ``ValueError`` on a canary
+        already soaking / bad fraction, ``RuntimeError`` when the
+        fleet cannot split (fewer than 2 ready replicas) or a swap is
+        refused."""
+        frac = float(fraction if fraction is not None
+                     else flag_value("FLAGS_canary_fraction"))
+        if not 0.0 < frac < 1.0:
+            raise ValueError(f"canary fraction must be in (0, 1), "
+                             f"got {frac}")
+        soak = float(soak_s if soak_s is not None
+                     else flag_value("FLAGS_canary_soak_s"))
+        with self._lock:
+            if self._canary is not None:
+                raise ValueError("a canary is already soaking "
+                                 "(cancel_canary() first)")
+        ready = [r for r in self._all() if r.ready()]
+        if len(ready) < 2:
+            raise RuntimeError(
+                f"canary needs >= 2 ready replicas to split traffic "
+                f"({len(ready)} ready)")
+        k = max(1, min(len(ready) - 1,
+                       int(math.ceil(frac * len(ready)))))
+        chosen = sorted(ready, key=lambda r: r.rid)[:k]
+        body = json.dumps({"dir": checkpoint_dir,
+                           "target": target}).encode()
+        swapped: List[str] = []
+        versions: Dict[str, int] = {}
+        swaps = []
+        for rep in chosen:
+            status, payload = self._swap_post(rep.url, body,
+                                              swap_timeout_s)
+            swaps.append({"url": rep.url, "status": status,
+                          "payload": payload})
+            if status == 200:
+                swapped.append(rep.url)
+                versions[rep.url] = int(
+                    payload.get("weights_version") or 0)
+                continue
+            # fleet-level atomicity: undo the minority already swapped
+            # before refusing — a rejected canary must leave ZERO
+            # replicas on the new version
+            rb = json.dumps({"revert": True,
+                             "target": target}).encode()
+            for url in swapped:
+                self._swap_post(url, rb, swap_timeout_s)
+            raise RuntimeError(
+                f"canary swap refused by {rep.url}: "
+                f"HTTP {status} {payload}")
+        # short-window judge: the soak bounds the evidence horizon, so
+        # the burn windows scale down with it (a 60s soak judges on
+        # 6s/20s windows) — the fleet-wide monitor's 60s/300s pair
+        # would never convict inside the soak.  The judge reads
+        # EPOCH-SUFFIXED series: the stable router_canary_* names are
+        # shared across rollouts, and a fresh canary's burn window can
+        # still contain the previous canary's failure deltas — stale
+        # evidence must not convict a clean checkpoint
+        with self._lock:
+            epoch = self._n["canary_starts"] + 1
+        fast = max(1.0, soak / 10.0)
+        slow = max(fast * 2.0, soak / 3.0)
+        monitor = tsdb.BurnRateMonitor(
+            self._db,
+            [tsdb.SloSpec("canary_availability", "availability",
+                          error_series=f"router_canary_failures#{epoch}",
+                          total_series=f"router_canary_requests#{epoch}"),
+             tsdb.SloSpec("canary_p99", "latency",
+                          latency_series=f"router_canary_request_ms#{epoch}",
+                          threshold_ms=self._slo_p99_ms,
+                          objective_pct=99.0)],
+            fast_s=fast, slow_s=slow)
+        with self._lock:
+            self._canary = {
+                "dir": checkpoint_dir, "fraction": frac,
+                "soak_s": soak, "target": target, "epoch": epoch,
+                "t0": time.monotonic(), "time": time.time(),
+                "urls": list(swapped), "versions": versions,
+                "swap_timeout_s": float(swap_timeout_s)}
+            self._canary_monitor = monitor
+            self._canary_accum = 0.0
+            self._n["canary_starts"] += 1
+        stat_add("router_canary_starts")
+        telemetry.log_event("router_canary_started",
+                            dir=checkpoint_dir, fraction=frac,
+                            soak_s=soak, replicas=len(swapped))
+        logger.info("canary soaking: %s on %d/%d replicas (%.0f%% of "
+                    "traffic, %.0fs soak)", checkpoint_dir,
+                    len(swapped), len(ready), frac * 100, soak)
+        return {"state": "soaking", "urls": list(swapped),
+                "versions": versions, "fraction": frac,
+                "soak_s": soak, "swaps": swaps}
+
+    def _canary_observe(self, rep_url: str, code: int, t0: float):
+        """Book one routed request as canary- or base-side evidence.
+        5xx answers are burn (500 = the model failed the request, 502
+        / 504 = the replica died or hung under it) — EXCEPT 503,
+        which is explicit admission backpressure: load shedding is the
+        queue's verdict, not the new weights'."""
+        with self._lock:
+            c = self._canary
+            if c is None:
+                return
+            side = "canary" if rep_url in c["urls"] else "base"
+            epoch = c["epoch"]
+            self._n[side + "_requests"] += 1
+            if code >= 500 and code != 503:
+                self._n[side + "_failures"] += 1
+        if code == 200:
+            ms = (time.monotonic() - t0) * 1e3
+            self._db.record(f"router_{side}_request_ms", ms, cap=4096)
+            if side == "canary":
+                self._db.record(f"router_canary_request_ms#{epoch}",
+                                ms, cap=4096)
+
+    def _canary_evaluate(self):
+        """The poll-loop judge: crash evidence + burn verdict + soak
+        clock.  Any canary replica ejected, deregistered, or respawned
+        onto a DIFFERENT weights version (the supervisor's restart
+        fallback reverts to baseline) is evidence against the canary —
+        a rollout that kills its replica must never soak to promotion
+        just because the corpse stopped serving errors."""
+        with self._lock:
+            c = self._canary
+            monitor = self._canary_monitor
+        if c is None or monitor is None:
+            return
+        now = time.monotonic()
+        lost = []
+        for url in c["urls"]:
+            with self._lock:
+                rep = self._replicas.get(url)
+            if rep is None or rep.ejected:
+                lost.append(url)
+                continue
+            v = rep.weights_version()
+            if (v is not None and rep.health_ts > c["t0"]
+                    and v != c["versions"].get(url, v)):
+                lost.append(url)
+        verdict = monitor.evaluate(now)
+        firing = [a["name"] for a in verdict["alerts"]
+                  if a["state"] == "firing"]
+        if lost or firing:
+            reason = " + ".join(
+                ([f"replica_lost:{','.join(lost)}"] if lost else [])
+                + [f"burn:{n}" for n in firing])
+            self._canary_revert(reason, lost=lost, verdict=verdict)
+        elif now - c["t0"] >= c["soak_s"]:
+            self._canary_promote(verdict=verdict)
+
+    def _canary_revert(self, reason: str, lost=(), verdict=None
+                       ) -> Optional[dict]:
+        """Swap every canary replica back to the retained previous
+        weights and end the soak.  Clears the canary state FIRST so
+        placement stops preferring the bad version while the revert
+        POSTs run; replicas in ``lost`` respawned onto baseline
+        weights already — there is nothing to revert there."""
+        with self._lock:
+            c = self._canary
+            self._canary = None
+            self._canary_monitor = None
+            if c is not None:
+                # transient verdict: status must never show "inactive,
+                # no outcome" while the revert POSTs are in flight
+                self._last_canary = {"state": "reverting",
+                                     "dir": c["dir"], "reason": reason}
+        if c is None:
+            return None
+        t_detect = time.monotonic()
+        rb = json.dumps({"revert": True,
+                         "target": c["target"]}).encode()
+        reverts = []
+        failures = 0
+        for url in c["urls"]:
+            if url in lost:
+                reverts.append({"url": url, "status": "lost"})
+                continue
+            status, payload = self._swap_post(url, rb,
+                                              c["swap_timeout_s"])
+            reverts.append({"url": url, "status": status,
+                            "payload": payload})
+            failures += status != 200
+        latency_s = time.monotonic() - t_detect
+        out = {
+            "state": "reverted", "dir": c["dir"], "reason": reason,
+            "time": time.time(),
+            "soak_elapsed_s": round(t_detect - c["t0"], 3),
+            "revert_latency_s": round(latency_s, 3),
+            "lost": list(lost), "reverts": reverts,
+            "revert_failures": failures,
+            "fraction": c["fraction"], "urls": c["urls"],
+        }
+        if verdict is not None:
+            out["verdict"] = verdict
+        with self._lock:
+            self._last_canary = out
+            self._n["canary_reverts"] += 1
+        stat_add("router_canary_reverts")
+        telemetry.log_event("router_canary_reverted", reason=reason,
+                            dir=c["dir"],
+                            revert_latency_s=out["revert_latency_s"],
+                            revert_failures=failures)
+        logger.warning("canary REVERTED (%s): %s off %d replicas in "
+                       "%.2fs", reason, c["dir"], len(c["urls"]),
+                       latency_s)
+        return out
+
+    def _canary_promote(self, verdict=None) -> Optional[dict]:
+        """Clean soak: roll the canary checkpoint out to the rest of
+        the fleet.  A base replica refusing its swap here is recorded
+        (and counted) but does not resurrect the canary — the verdict
+        on the WEIGHTS is already in; finishing a partially-refused
+        rollout is a fleet operation (hot_swap / restart), not a
+        judging problem."""
+        with self._lock:
+            c = self._canary
+            self._canary = None
+            self._canary_monitor = None
+            if c is not None:
+                self._last_canary = {"state": "promoting",
+                                     "dir": c["dir"]}
+        if c is None:
+            return None
+        body = json.dumps({"dir": c["dir"],
+                           "target": c["target"]}).encode()
+        promotions = []
+        failures = 0
+        for rep in self._all():
+            if rep.url in c["urls"] or not rep.ready():
+                continue
+            status, payload = self._swap_post(rep.url, body,
+                                              c["swap_timeout_s"])
+            promotions.append({"url": rep.url, "status": status,
+                               "payload": payload})
+            failures += status != 200
+        out = {
+            "state": "promoted", "dir": c["dir"],
+            "time": time.time(),
+            "soak_elapsed_s": round(time.monotonic() - c["t0"], 3),
+            "promotions": promotions, "promote_failures": failures,
+            "fraction": c["fraction"], "urls": c["urls"],
+        }
+        if verdict is not None:
+            out["verdict"] = verdict
+        with self._lock:
+            self._last_canary = out
+            self._n["canary_promotions"] += 1
+        stat_add("router_canary_promotions")
+        telemetry.log_event("router_canary_promoted", dir=c["dir"],
+                            promote_failures=failures,
+                            replicas=len(promotions))
+        logger.info("canary PROMOTED: %s to %d more replicas "
+                    "(%d refusals)", c["dir"], len(promotions),
+                    failures)
+        return out
+
+    def cancel_canary(self, reason: str = "operator"
+                      ) -> Optional[dict]:
+        """Operator abort: revert the soak now, whatever the burn
+        state.  None when no canary is active."""
+        return self._canary_revert(f"cancelled:{reason}")
+
+    def canary_status(self) -> dict:
+        """The ``canary`` block for /statusz /fleetz: live soak state
+        (with its judge's burn windows) + the last finished rollout's
+        verdict + the lifetime counters."""
+        with self._lock:
+            c = dict(self._canary) if self._canary else None
+            monitor = self._canary_monitor
+            last = self._last_canary
+            n = {k: self._n[k] for k in
+                 ("canary_starts", "canary_reverts",
+                  "canary_promotions", "canary_requests",
+                  "canary_failures", "base_requests",
+                  "base_failures")}
+        out = {"active": c is not None, "counters": n, "last": last}
+        if c is not None:
+            out["current"] = {
+                "dir": c["dir"], "fraction": c["fraction"],
+                "soak_s": c["soak_s"], "target": c["target"],
+                "urls": c["urls"], "versions": c["versions"],
+                "elapsed_s": round(time.monotonic() - c["t0"], 3),
+                "slo": monitor.state() if monitor else None,
+            }
+        return out
 
     # -- disaggregated generate: prefill hop -> segment -> adopt hop --------
     def disagg_active(self) -> bool:
@@ -1049,7 +1468,7 @@ class Router:
                     if kind == "fail":
                         raise ConnectionRefusedError(
                             "injected router_forward failure")
-                    code, data, ctype, retry_after = self._send(
+                    code, data, ctype, retry_after, _ = self._send(
                         rep, query, seg_bytes, trace_id, timeout_s,
                         remaining_ms,
                         content_type="application/octet-stream")
@@ -1223,6 +1642,7 @@ class Router:
                 "replicas_up": self._db.last("fleet_replicas_up"),
             },
             "slo": self.burn_monitor.state(),
+            "canary": self.canary_status(),
             "autoscale": auto,
             "tsdb": self._db.stats(),
         })
@@ -1273,14 +1693,16 @@ class Router:
             "request_ms": self._h_request.summary(),
             "autoscale": auto,
             "slo": self.burn_monitor.state(),
+            "canary": self.canary_status(),
         }
 
     def healthz(self) -> Tuple[int, dict]:
         reps = self._all()
         routable = [r for r in reps if r.ready()]
         status = "ok" if routable else "no_ready_replicas"
-        with self._lock:  # _autoscale is recomputed under _lock
+        with self._lock:  # _autoscale/_canary are written under _lock
             auto = dict(self._autoscale)
+            canary_active = self._canary is not None
         roles: Dict[str, int] = {}
         for r in routable:
             roles[r.role()] = roles.get(r.role(), 0) + 1
@@ -1295,6 +1717,7 @@ class Router:
             "disagg": self.disagg_active(),
             "autoscale": auto,
             "alerts_firing": self.burn_monitor.firing(),
+            "canary_active": canary_active,
         }
 
     def statusz(self) -> dict:
@@ -1510,6 +1933,9 @@ class _RouterHandler(_JsonHandler):
                     self.send_header("Connection", "close")
                     if trace_id:
                         self.send_header(TRACE_HEADER, trace_id)
+                    wv = resp.headers.get(VERSION_HEADER)
+                    if wv:
+                        self.send_header(VERSION_HEADER, wv)
                     self.end_headers()
                     self.close_connection = True
                     try:
@@ -1528,6 +1954,7 @@ class _RouterHandler(_JsonHandler):
                         rep.retries_to += 1
             router._count("routed")
             stat_add("router_requests_routed")
+            router._canary_observe(rep.url, resp.status, t0)
             if resp.status == 200:
                 ms = (time.monotonic() - t0) * 1e3
                 router._h_request.observe(ms, trace_id=trace_id)
@@ -1902,14 +2329,19 @@ class _RouterHandler(_JsonHandler):
             if root is not None:
                 root.attrs["status"] = res["code"] if res else 500
             telemetry.span_end(root)
-        headers = None
+        headers = {}
         if res.get("retry_after"):
             # router-origin backoff hints AND replica Retry-After
             # headers (their 503s pass through verbatim) both land on
             # the client
-            headers = {"Retry-After": str(res["retry_after"])}
+            headers["Retry-After"] = str(res["retry_after"])
+        if res.get("weights_version"):
+            # the serving replica's weights version passes through to
+            # the client — canary observability and the loadgen's
+            # per-phase version distribution both read it here
+            headers[VERSION_HEADER] = str(res["weights_version"])
         self._reply_raw(res["code"], res["body"], res["content_type"],
-                        trace_id=trace_id, headers=headers)
+                        trace_id=trace_id, headers=headers or None)
         ms = (time.monotonic() - t0) * 1e3
         rec = {
             "ts": round(time.time(), 6), "method": "POST",

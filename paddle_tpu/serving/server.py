@@ -37,6 +37,20 @@ Endpoints:
   true TTFT and inter-token latency.  Sheds → **503**
   like ``/predict``; malformed or over-long prompts → 400; no
   generator attached → 404.
+* ``POST /swap`` — in-place weight hot-swap: body ``{"dir":
+  checkpoint_dir}`` (or ``{"revert": true}`` to restore the previous
+  weights, ``"target": "generate"`` to swap the attached generation
+  engine instead of the predict pool).  200 → ``{"weights_version",
+  "swap_ms"}``; **409** ``{"error": "swap_mismatch"}`` when the
+  checkpoint's structure (shape/dtype/name set) drifts from the live
+  weights — rejected at admission, never half-applied, exactly the
+  ``/adopt`` fingerprint discipline; **503** while draining or when
+  another swap is mid-flight / the quiesce timed out (the replica
+  keeps serving the old weights).  Every ``/predict``, ``/generate``
+  and ``/swap`` response carries the live ``X-PaddleTPU-Weights-
+  Version`` header, and ``/healthz`` + ``/statusz`` publish
+  ``weights_version`` — how the fleet supervisor and the canary
+  router verify a rollout replica-by-replica.
 * ``GET /healthz`` — 200 with :meth:`ServingEngine.health` (serving
   stats + the telemetry heartbeat's process fields); 503 once the
   engine is closed — a load balancer drains the instance on SIGTERM.
@@ -95,6 +109,13 @@ _TRACE_ID_RE = re.compile(r"^[A-Za-z0-9_-]{1,64}$")
 # elapsed time before each forward, adopted by replica admission so a
 # hopeless request sheds at the queue instead of burning a batch slot.
 DEADLINE_HEADER = "X-PaddleTPU-Deadline-Ms"
+
+# weight-rollout visibility: every data-plane response names the
+# weights version that was live when it was answered, so a client (the
+# chaos harness, the loadgen, the canary router) can assert a swap
+# flipped atomically — per replica the observed version is monotonic,
+# never a torn mix
+VERSION_HEADER = "X-PaddleTPU-Weights-Version"
 
 
 def parse_trace_header(value) -> Optional[str]:
@@ -389,10 +410,24 @@ class _Handler(_JsonHandler):
             n = 0
         body = self.rfile.read(n) if n > 0 else b""
         route, _, query = self.path.partition("?")
-        if route not in ("/predict", "/generate", "/adopt"):
+        if route not in ("/predict", "/generate", "/adopt", "/swap"):
             self._reply(404, {"error": "not found", "path": self.path})
             return
         stat_add("serving_http_requests")
+        if self.engine.warming():
+            # a warming replica must not admit work: warmup runs the
+            # compiled programs directly, outside the scheduler's step
+            # boundary, so an early request would race it on the
+            # donated KV buffers.  The router never places traffic
+            # here pre-ready; direct clients get explicit backpressure.
+            stat_add("serving_http_warming_shed")
+            self._reply(503, {"error": "overloaded",
+                              "reason": "warming",
+                              "retry_after_s": 1.0},
+                        headers={"Retry-After": "1",
+                                 VERSION_HEADER:
+                                 str(self.engine.weights_version)})
+            return
         t0 = time.monotonic()
         hop_trace = parse_trace_header(self.headers.get(TRACE_HEADER))
         deadline_ms = parse_deadline_header(
@@ -403,6 +438,8 @@ class _Handler(_JsonHandler):
         elif route == "/adopt":
             code, payload, trace = self._adopt(body, query, hop_trace,
                                                deadline_ms)
+        elif route == "/swap":
+            code, payload, trace = self._swap(body, hop_trace)
         else:
             code, payload, trace = self._generate(body, hop_trace,
                                                   deadline_ms)
@@ -413,13 +450,16 @@ class _Handler(_JsonHandler):
             # (_generate_stream); only the access log is left
             code = payload.get("http_status", 200)
         else:
-            headers = None
+            # every data-plane reply names the weights version that
+            # answered it (the torn-version chaos check reads this)
+            headers = {VERSION_HEADER:
+                       str(self.engine.weights_version)}
             if code == 503 and payload.get("retry_after_s"):
                 # explicit backpressure carries its backoff hint:
                 # clients (and the loadgen) back off instead of
                 # hammering
-                headers = {"Retry-After":
-                           str(int(math.ceil(payload["retry_after_s"])))}
+                headers["Retry-After"] = \
+                    str(int(math.ceil(payload["retry_after_s"])))
             self._reply(code, payload, trace_id=tid, headers=headers)
         ms = (time.monotonic() - t0) * 1e3
         rec = {"ts": round(time.time(), 6), "method": "POST",
@@ -641,6 +681,8 @@ class _Handler(_JsonHandler):
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Connection", "close")
+        self.send_header(VERSION_HEADER,
+                         str(self.engine.weights_version))
         if hop_trace:
             self.send_header(TRACE_HEADER, hop_trace)
         self.end_headers()
@@ -760,6 +802,65 @@ class _Handler(_JsonHandler):
             "ms": round((time.monotonic() - t0) * 1e3, 3),
             "trace_id": (trace or {}).get("trace_id"),
         }, trace
+
+    def _swap(self, body: bytes, hop_trace: Optional[str] = None):
+        """One ``POST /swap`` — the control-plane half of a safe
+        rollout.  The engine does all the real work (validate →
+        quiesce → commit-or-rollback); this handler only maps its
+        error taxonomy onto HTTP: structural drift → **409** (the
+        replica refused at admission, nothing flipped — the fleet
+        supervisor falls back to a restart), drain / a concurrent
+        swap / a quiesce timeout → **503** (the old weights keep
+        serving; retry later), anything past validation → **500**
+        (committed arrays were rolled back)."""
+        from ..inference import SwapMismatch
+        try:
+            doc = json.loads(body or b"{}")
+            revert = bool(doc.get("revert"))
+            ckpt_dir = doc.get("dir")
+            target = doc.get("target", "predict")
+            timeout_s = doc.get("timeout_s")
+            if not revert and not isinstance(ckpt_dir, str):
+                raise TypeError("'dir' (checkpoint directory) required "
+                                "unless 'revert' is true")
+            if target not in ("predict", "generate"):
+                raise ValueError(f"unknown swap target {target!r}")
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"error": "bad request",
+                         "detail": f"{type(e).__name__}: {e}"}, None
+        eng = self.engine
+        if target == "generate":
+            eng = getattr(self.engine, "generator", None)
+            if eng is None:
+                return 404, {"error": "not found",
+                             "detail": "no generation engine "
+                                       "attached"}, None
+        kw = {} if timeout_s is None else {"timeout_s": float(timeout_s)}
+        try:
+            if revert:
+                res = eng.revert_weights(**({} if target == "generate"
+                                            else kw))
+            else:
+                res = eng.swap_weights(ckpt_dir, **kw)
+        except SwapMismatch as e:
+            return 409, {"error": "swap_mismatch", "detail": str(e),
+                         "trace_id": hop_trace}, None
+        except OverloadedError as e:
+            return 503, {"error": "overloaded", "reason": e.reason,
+                         "detail": str(e),
+                         "retry_after_s": round(
+                             self.engine.retry_after_s(), 3),
+                         "trace_id": hop_trace}, None
+        except Exception as e:  # noqa: BLE001 — commit failure (rolled
+            # back); the replica still serves the old weights
+            logger.warning("/swap failed (rolled back): %s", e)
+            return 500, {"error": "swap failed",
+                         "detail": f"{type(e).__name__}: {e}",
+                         "trace_id": hop_trace}, None
+        res = dict(res)
+        res["target"] = target
+        res["trace_id"] = hop_trace
+        return 200, res, None
 
 
 class ServingServer:

@@ -240,6 +240,21 @@ class ShardedPredictor(Predictor):
         state_vals = tuple(self.scope.find_var(n) for n in state_in)
         return fn, state_vals
 
+    def _swap_place(self, name: str, value):
+        """Hot-swap placement under the live sharded executables: the
+        incoming array re-places per the SAME rule table construction
+        used (:func:`place_block_state`), so the swapped weight drops
+        into the compiled programs' input shardings unchanged.  Shape
+        is already validated equal to the live array's, so the rule
+        lookup resolves to the identical spec."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        var = self._block._find_var_recursive(name)
+        shape = var.shape if var is not None else np.shape(value)
+        sh = NamedSharding(self.mesh, self.rules.spec(name, shape))
+        return jax.device_put(value, sh)
+
     def _feed_sharding(self, a):
         """Batch dim over the mesh's batch axes when it divides; else
         replicate (correct for every bucket, dp-parallel for the ones

@@ -653,11 +653,12 @@ def test_chaos_harness_smoke_three_replica_fleet():
     (non-injected) failures, zero poison leaks, availability >= 99%,
     and every recovery path actually fired."""
     chaos = _load_tool("chaos")
-    # the classic six explicitly: disagg_crash (in DEFAULT_SCENARIOS
-    # for the CLI/bench) spawns its own 4-replica generation fleet —
-    # far too heavy for a tier-1 smoke on a core-bound host; it runs
-    # live via bench.py run_chaos / tools/chaos.py and its page-leak
-    # verdict is hard-zeroed by tools/perf_gate.py
+    # the classic six explicitly: disagg_crash and hot_swap (both in
+    # DEFAULT_SCENARIOS for the CLI/bench) each spawn their own
+    # multi-replica fleet — far too heavy for a tier-1 smoke on a
+    # core-bound host; they run live via bench.py run_chaos /
+    # run_rollout, and their page-leak / torn-version verdicts are
+    # hard-zeroed by tools/perf_gate.py
     report = chaos.run_chaos(replicas=3, qps=30.0, duration_s=2.5,
                              availability_pct=99.0,
                              liveness_timeout_ms=1200.0,

@@ -264,9 +264,10 @@ def test_live_metrics_scrape_is_strict_prometheus():
     try:
         make_feed = lg.feed_maker(shapes, rows=1)
         # traffic first, so the scrape covers the serving histograms
-        assert lg._http_predict(srv.url + "/predict",
-                                lg._encode_bodies(make_feed, 1)[0],
-                                60.0) == "ok"
+        outcome, _version = lg._http_predict(
+            srv.url + "/predict",
+            lg._encode_bodies(make_feed, 1)[0], 60.0)
+        assert outcome == "ok"
         with urllib.request.urlopen(srv.url + "/metrics",
                                     timeout=30) as r:
             assert r.status == 200

@@ -323,9 +323,10 @@ def test_profilez_endpoint_contract(tmp_path):
             pt.set_flags({"FLAGS_telemetry": True})
         # one served request compiles one bucket -> manifests appear
         make_feed = lg.feed_maker(shapes, rows=1)
-        assert lg._http_predict(srv.url + "/predict",
-                                lg._encode_bodies(make_feed, 1)[0],
-                                60.0) == "ok"
+        outcome, _version = lg._http_predict(
+            srv.url + "/predict",
+            lg._encode_bodies(make_feed, 1)[0], 60.0)
+        assert outcome == "ok"
         # /statusz grew the device block (peaks + hbm snapshot)
         with urllib.request.urlopen(srv.url + "/statusz",
                                     timeout=30) as r:

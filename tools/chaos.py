@@ -78,6 +78,20 @@ disagg_crash   role-split generation fleet (2 prefill +    router affinity
                                                            replica's page pool
                                                            drains to ZERO live
                                                            pages (no leak)
+hot_swap       rolling ``hot_swap`` weight rollout under   quiesce-and-commit
+               mixed /predict + /generate load, then a     swap discipline (zero
+               second rollout with one replica SIGKILLed   non-shed failures
+               MID-COMMIT (``weight_swap:delay`` fault     outside the kill
+               widens the window)                          window), monotonic
+                                                           per-replica
+                                                           weights-version flip
+                                                           (zero torn
+                                                           responses), restart
+                                                           fallback converges
+                                                           the killed slot,
+                                                           post-swap outputs
+                                                           bit-exact vs a fresh
+                                                           predictor
 =============  ==========================================  =============
 
 Usage::
@@ -98,6 +112,7 @@ import os
 import queue as queue_mod
 import signal
 import sys
+import tempfile
 import threading
 import time
 import urllib.error
@@ -118,7 +133,7 @@ POISON = 1e30
 POISON_TOKEN = 7
 
 DEFAULT_SCENARIOS = ("baseline", "crash", "hang", "slow", "poison",
-                     "poison_paged", "disagg_crash")
+                     "poison_paged", "disagg_crash", "hot_swap")
 
 # burn-rate scaling for the chaos run: scenario durations are seconds,
 # not SRE hours, so the router's alert windows shrink to fractions of
@@ -799,6 +814,289 @@ def _scenario_disagg_crash(cfg: dict, log=print) -> dict:
     return rep
 
 
+def _scenario_hot_swap(cfg: dict, log=print) -> dict:
+    """Hot-swap discipline under fire: a fleet serving MIXED open-loop
+    ``/predict`` + ``/generate`` load takes a clean rolling hot-swap,
+    then a second rolling swap with one replica SIGKILLed MID-SWAP
+    (``weight_swap:delay`` fault widens the commit window so the kill
+    reliably lands inside it; the supervisor's restart fallback must
+    converge the slot anyway).
+
+    The contract: (a) zero non-shed failures outside the kill window —
+    a clean swap quiesces and queues, it never errors live traffic;
+    (b) zero torn-version responses — per replica, the published
+    ``X-PaddleTPU-Weights-Version`` must flip monotonically (a request
+    that STARTED after a new-version response finished may never
+    observe an older version; the killed replica may reset to baseline
+    exactly once, at the kill); (c) post-rollout outputs are BIT-EXACT
+    against a fresh in-process predictor loaded from the same
+    checkpoint — swapped-in-place weights and freshly-built weights
+    must be indistinguishable; (d) both rollouts report converged."""
+    from paddle_tpu import io
+    from paddle_tpu.framework.core import reset_unique_name
+    from paddle_tpu.serving import FleetSupervisor
+    from paddle_tpu.serving.replica import build_synthetic_checkpoint
+
+    feat = int(cfg["feat"])
+    duration = max(float(cfg["duration_s"]) * 2.5, 12.0)
+    qps = min(float(cfg["qps"]), 30.0)
+    dims = dict(feat=feat, hidden=16, depth=1, classes=8)
+    argv = ["--feat", str(feat), "--hidden", "16", "--depth", "1",
+            "--generate", "--gen-vocab", "64", "--gen-hidden", "32",
+            "--gen-layers", "2", "--gen-heads", "4",
+            "--gen-intermediate", "64", "--gen-slots", "4",
+            "--gen-max-seq", "64", "--gen-max-new", "4",
+            "--max-batch", "8", "--max-delay-ms", "2.0",
+            "--queue-cap", "512"]
+    # widen each replica's swap-commit window (per-array device_put
+    # delay) so the mid-swap SIGKILL lands INSIDE a commit instead of
+    # racing a millisecond flip
+    env = {"FLAGS_fault_inject": "weight_swap:delay:150~1.0"}
+    workdir = tempfile.mkdtemp(prefix="chaos-hotswap-")
+    ck_v2 = os.path.join(workdir, "ck_v2")
+    ck_v3 = os.path.join(workdir, "ck_v3")
+    build_synthetic_checkpoint(ck_v2, seed=11, **dims)
+    build_synthetic_checkpoint(ck_v3, seed=12, **dims)
+
+    error = None
+    notes: Dict[str, object] = {}
+    records: List[dict] = []
+    windows: List[tuple] = []
+    rec_lock = threading.Lock()
+    stop = threading.Event()
+    sup = FleetSupervisor(replicas=3, replica_argv=argv, env=env,
+                          max_restarts=8, backoff_ms=100.0,
+                          liveness_timeout_ms=cfg.get(
+                              "liveness_timeout_ms", 1500.0),
+                          workdir=os.path.join(workdir, "fleet"))
+    try:
+        urls = sup.wait_ready(timeout_s=300)
+        rng = np.random.RandomState(3)
+        predict_bodies = _bodies(feat, seed=3)
+        gen_bodies = [json.dumps(
+            {"prompt": rng.randint(1, 64, size=int(n)).tolist(),
+             "max_new_tokens": 3}).encode()
+            for n in rng.randint(4, 12, size=16)]
+
+        def one_request(i):
+            """Round-robin direct-to-replica with one failover retry
+            on a dead socket — the client plays router so the torn
+            check keeps exact per-replica attribution."""
+            gen = i % 4 == 3  # 25% generation load riding along
+            body = (gen_bodies if gen else predict_bodies)[
+                i % len(predict_bodies)]
+            route = "/generate" if gen else "/predict"
+            t0 = time.monotonic()
+            for attempt in range(2):
+                url = urls[(i + attempt) % len(urls)]
+                req = urllib.request.Request(
+                    url + route, data=body,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(
+                            req, timeout=cfg["timeout_s"]) as r:
+                        r.read()
+                        outcome, status = "ok", r.status
+                        version = r.headers.get(
+                            "X-PaddleTPU-Weights-Version")
+                        break
+                except urllib.error.HTTPError as e:
+                    try:
+                        e.read()
+                    except OSError:
+                        pass  # ok: draining the error body is best-effort
+                    outcome = "shed" if e.code == 503 else "failed"
+                    status = e.code
+                    version = e.headers.get(
+                        "X-PaddleTPU-Weights-Version")
+                    break
+                except (OSError, TimeoutError, ValueError):
+                    outcome, status, version = "failed", None, None
+                    # connect-level death: fail over once, like the
+                    # router's connect-refused retry
+            t1 = time.monotonic()
+            with rec_lock:
+                records.append({
+                    "t0": t0, "t1": t1, "outcome": outcome,
+                    "status": status, "ms": (t1 - t0) * 1e3,
+                    "poison": False, "url": url,
+                    "version": int(version) if version else None})
+
+        def storm():
+            period = 1.0 / max(qps, 0.001)
+            t_start = time.monotonic()
+            i = 0
+            posters: List[threading.Thread] = []
+            while not stop.is_set() \
+                    and time.monotonic() - t_start < duration:
+                th = threading.Thread(target=one_request, args=(i,),
+                                      daemon=True)
+                th.start()
+                posters.append(th)
+                i += 1
+                sleep_for = t_start + i * period - time.monotonic()
+                if sleep_for > 0:
+                    time.sleep(sleep_for)
+            for th in posters:
+                th.join(timeout=cfg["timeout_s"] + 5.0)
+
+        traffic = threading.Thread(target=storm, daemon=True)
+        traffic.start()
+        time.sleep(duration * 0.15)
+
+        # phase 1: clean rolling hot-swap under load — no fault
+        # window, so ANY failure it causes is collateral
+        res1 = sup.hot_swap(ck_v2)
+        notes["swap_clean"] = {
+            "converged": res1["converged"],
+            "duration_s": res1["duration_s"],
+            "statuses": [r.get("swap_status") for r in
+                         res1["replicas"]]}
+        if not res1["converged"]:
+            error = f"clean hot swap did not converge: {res1}"
+
+        time.sleep(duration * 0.15)
+
+        # phase 2: rolling swap with the middle replica SIGKILLed
+        # mid-commit (in_rollout + the injected commit delay time the
+        # kill inside the swap)
+        victim = sup._replicas[1]
+        box: Dict[str, Optional[float]] = {"t_kill": None}
+
+        def killer():
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if victim.in_rollout:
+                    time.sleep(0.25)  # inside the delayed commit
+                    try:
+                        os.kill(victim.proc.pid, signal.SIGKILL)
+                        box["t_kill"] = time.monotonic()
+                    except OSError as e:
+                        box["err"] = f"kill: {e}"
+                    return
+                time.sleep(0.002)
+
+        kth = threading.Thread(target=killer, daemon=True)
+        kth.start()
+        res2 = sup.hot_swap(ck_v3) if error is None else None
+        kth.join(timeout=90.0)
+        t_swap2_done = time.monotonic()
+        if error is None:
+            notes["swap_killed"] = {
+                "converged": res2["converged"],
+                "duration_s": res2["duration_s"],
+                "victim": victim.url,
+                "fallbacks": sum(1 for r in res2["replicas"]
+                                 if "fallback" in r)}
+            if box.get("err"):
+                error = box["err"]
+            elif box["t_kill"] is None:
+                error = "SIGKILL never landed mid-swap"
+            elif not res2["converged"]:
+                error = (f"post-kill rollout did not converge "
+                         f"(restart fallback failed): {res2}")
+            else:
+                # +1s grace: round-robin clients may still be timing
+                # out on the respawned socket right at ready
+                windows.append((box["t_kill"], t_swap2_done + 1.0))
+
+        traffic.join(timeout=duration + 60.0)
+        stop.set()
+
+        # torn-version check: per replica, happens-before monotonic —
+        # for any request A started strictly after request B finished,
+        # version(A) >= version(B).  The killed replica is checked per
+        # segment (before / after the kill): its respawn legitimately
+        # resets the counter to baseline exactly once
+        torn = 0
+        seen_versions: Dict[str, List[int]] = {}
+        with rec_lock:
+            recs = list(records)
+        for url in urls:
+            mine = [r for r in recs
+                    if r["url"] == url and r["version"] is not None]
+            seen_versions[url] = sorted(
+                {r["version"] for r in mine})
+            segments = [mine]
+            if url == victim.url and box.get("t_kill"):
+                segments = [
+                    [r for r in mine if r["t1"] <= box["t_kill"]],
+                    [r for r in mine if r["t0"] > box["t_kill"]]]
+            for seg in segments:
+                by_t1 = sorted(seg, key=lambda r: r["t1"])
+                by_t0 = sorted(seg, key=lambda r: r["t0"])
+                max_done = 0
+                j = 0
+                for a in by_t0:
+                    while j < len(by_t1) and by_t1[j]["t1"] < a["t0"]:
+                        max_done = max(max_done,
+                                       by_t1[j]["version"])
+                        j += 1
+                    if a["version"] < max_done:
+                        torn += 1
+        notes["versions_seen"] = seen_versions
+        notes["torn_responses"] = torn
+        if error is None and torn:
+            error = (f"{torn} torn-version response(s): a replica "
+                     f"served an older weights version after a newer "
+                     f"one was already visible")
+
+        # bit-exact: every replica's post-rollout answer must equal a
+        # FRESH in-process predictor loaded from the same checkpoint
+        if error is None:
+            import paddle_tpu as pt
+            from paddle_tpu import layers
+            from paddle_tpu.inference import Predictor
+
+            reset_unique_name()
+            main, startup = pt.Program(), pt.Program()
+            startup._is_startup = True
+            with pt.program_guard(main, startup):
+                x = layers.data("x", [feat])
+                h = layers.fc(x, 16, act="relu", name="rep_fc0")
+                out = layers.fc(h, 8, name="rep_head")
+            scope = pt.Scope()
+            pt.Executor().run(startup, scope=scope)
+            ref = Predictor(main, ["x"], [out], scope=scope)
+            ref.swap_weights(io._read(os.path.join(ck_v3,
+                                                   "__params__")))
+            probe = np.linspace(-1.0, 1.0, feat,
+                                dtype="float32").reshape(1, feat)
+            want = ref.run({"x": probe})[0].tolist()
+            body = json.dumps({"inputs": {"x": probe.tolist()}}
+                              ).encode()
+            mismatched = []
+            for url in urls:
+                req = urllib.request.Request(
+                    url + "/predict", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30.0) as r:
+                    got = json.loads(r.read())["outputs"][0]
+                if got != want:
+                    mismatched.append(url)
+            notes["bit_exact"] = not mismatched
+            if mismatched:
+                error = (f"post-swap outputs diverged from a fresh "
+                         f"predictor on {mismatched} — the swap "
+                         f"discipline leaked state")
+    finally:
+        stop.set()
+        sup.close()
+
+    rep = classify(records, windows)
+    rep["scenario"] = "hot_swap"
+    rep["notes"] = notes
+    rep["torn_responses"] = notes.get("torn_responses")
+    if error is None and rep["ok"] == 0:
+        error = "no request succeeded (fleet never served)"
+    if error is None and rep.get("torn_responses") is None:
+        error = "torn-version check never ran"
+    if error is not None:
+        rep["error"] = error
+    rep["_records"] = records
+    return rep
+
+
 # ---------------------------------------------------------------------------
 # orchestrator
 # ---------------------------------------------------------------------------
@@ -866,11 +1164,16 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
                 # spawned fresh so the kills cannot bleed into the
                 # shared /predict fleet's attribution
                 rep = _scenario_disagg_crash(cfg, log=log)
+            elif name == "hot_swap":
+                # rolling weight swap + mid-swap SIGKILL against its
+                # own fleet (direct per-replica traffic so the torn-
+                # version check keeps exact attribution)
+                rep = _scenario_hot_swap(cfg, log=log)
             else:
                 rep = _scenario(name, sup, router, server.url, cfg)
             records = rep.pop("_records")
             all_records.extend(records)
-            if name in ("crash", "hang", "disagg_crash"):
+            if name in ("crash", "hang", "disagg_crash", "hot_swap"):
                 fault_records.extend(records)
             per_scenario[name] = rep
             al = rep.get("alerts") or {}
@@ -911,6 +1214,12 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
     if any("leaked_pages" in r for r in per_scenario.values()):
         totals["leaked_pages"] = sum(
             r.get("leaked_pages") or 0 for r in per_scenario.values())
+    # hot-swap torn-version verdict (None when the scenario didn't
+    # run): a single torn response breaks the rollout contract, so
+    # perf_gate hard-zeroes the sum
+    if any("torn_responses" in r for r in per_scenario.values()):
+        totals["torn_responses"] = sum(
+            r.get("torn_responses") or 0 for r in per_scenario.values())
     fault_ok_ms = sorted(r["ms"] for r in fault_records
                          if r["outcome"] == "ok")
     p99_under_fault = round(

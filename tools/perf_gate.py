@@ -126,6 +126,67 @@ def compare_leg(name: str, new: dict, base: dict,
                        reason=f"rolling restart saw {failed} non-shed "
                               f"request failure(s) (contract: zero)")
             return res
+        # torn-version rule (hard, like the failure rule above): a
+        # response carrying an older weights_version after a newer one
+        # was already visible on the same replica means the atomic
+        # flip tore mid-swap.  The dedicated rollout leg must MEASURE
+        # the count — missing there is a vacuous pass; plain
+        # rolling-restart windows predate the check and simply don't
+        # carry the key
+        torn = rollout.get("torn_responses",
+                           None if name == "rollout" else 0)
+        if torn is None:
+            res.update(status="regression",
+                       reason="rollout leg has no measured torn-"
+                              "version count (vacuous hot-swap "
+                              "window)")
+            return res
+        if torn > 0:
+            res.update(status="regression",
+                       reason=f"hot swap served {torn} torn-version "
+                              f"response(s) — an older weights_version "
+                              f"after a newer one was visible "
+                              f"(contract: zero)")
+            return res
+    # canary rollout rules, also checked before every skip: a CLEAN
+    # canary that reverted means the burn-rate judge convicted a good
+    # checkpoint (false positive — rollouts become un-shippable), and
+    # a BAD canary whose revert took longer than the bound means the
+    # judge is too slow to protect traffic.  Core contention can slow
+    # a soak, never fabricate burn on a clean version
+    canary = new.get("canary")
+    if isinstance(canary, dict):
+        fr = canary.get("false_reverts")
+        if fr is None:
+            res.update(status="regression",
+                       reason="canary leg has no measured false-"
+                              "revert count (vacuous soak: the clean "
+                              "canary never ran)")
+            return res
+        if fr > 0:
+            res.update(status="regression",
+                       reason=f"{fr} clean canary rollout(s) were "
+                              f"auto-reverted (burn-rate false "
+                              f"positive; contract: zero)")
+            return res
+        lat = canary.get("revert_latency_s")
+        bound = canary.get("revert_latency_bound_s")
+        if canary.get("reverts"):
+            # a bad canary was injected: the revert must be measured
+            # and inside the leg's own bound
+            if lat is None:
+                res.update(status="regression",
+                           reason="canary auto-revert happened but "
+                                  "its latency went unmeasured "
+                                  "(vacuous revert evidence)")
+                return res
+            if bound is not None and lat > bound:
+                res.update(status="regression",
+                           reason=f"canary auto-revert took "
+                                  f"{lat:.1f}s, past the "
+                                  f"{bound:.1f}s bound — the judge "
+                                  f"is too slow to protect traffic")
+                return res
     # chaos fault-containment rules, also checked before every skip:
     # a collateral (non-injected) failure or a poisoned request served
     # 200 is a correctness break — core contention can slow recovery,
@@ -818,6 +879,72 @@ def run_smoke() -> int:
     check("chaos core-bound low availability skips", r["ok"] and any(
         x["leg"] == "chaos" and x["status"] == "skipped"
         for x in r["legs"]))
+
+    # rollout leg (synthetic fixture like the chaos one): generic
+    # noise gate + the torn-version / false-revert / revert-latency
+    # hard rules, which no anomaly flag or device mismatch shields
+    rollout_leg = {
+        "metric": "rollout_availability_pct",
+        "value": 99.9, "unit": "%", "device_kind": "cpu",
+        "stats": {"rounds": 1, "median": 99.9, "p10": 99.7,
+                  "p90": 100.0, "min": 99.7, "max": 100.0},
+        "availability_floor": 99.0,
+        "rollout": {"failed": 0, "torn_responses": 0,
+                    "swaps": 3, "converged": True},
+        "canary": {"false_reverts": 0, "reverts": 1,
+                   "revert_latency_s": 0.8,
+                   "revert_latency_bound_s": 6.0,
+                   "promotions": 1},
+    }
+    with_rollout = json.loads(json.dumps(latest))
+    with_rollout.setdefault("legs", {})["rollout"] = rollout_leg
+    r = compare_bench(with_rollout, docs + [with_rollout])
+    check("rollout self-compare passes", r["ok"],
+          json.dumps([x for x in r["legs"]
+                      if x["status"] == "regression"]))
+    torn = json.loads(json.dumps(with_rollout))
+    torn["legs"]["rollout"]["rollout"]["torn_responses"] = 1
+    torn["legs"]["rollout"]["anomaly"] = "core-bound host"
+    r = compare_bench(torn, docs + [with_rollout])
+    check("rollout torn-version fails even when anomalous",
+          not r["ok"] and any(
+              x["status"] == "regression"
+              and "torn-version" in x.get("reason", "")
+              for x in r["legs"]))
+    no_torn = json.loads(json.dumps(with_rollout))
+    del no_torn["legs"]["rollout"]["rollout"]["torn_responses"]
+    r = compare_bench(no_torn, docs + [with_rollout])
+    check("rollout missing-torn-count fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "vacuous" in x.get("reason", "") for x in r["legs"]))
+    false_rev = json.loads(json.dumps(with_rollout))
+    false_rev["legs"]["rollout"]["canary"]["false_reverts"] = 1
+    false_rev["legs"]["rollout"]["anomaly"] = "core-bound host"
+    r = compare_bench(false_rev, docs + [with_rollout])
+    check("canary false-revert fails even when anomalous",
+          not r["ok"] and any(
+              x["status"] == "regression"
+              and "false positive" in x.get("reason", "")
+              for x in r["legs"]))
+    vac_canary = json.loads(json.dumps(with_rollout))
+    vac_canary["legs"]["rollout"]["canary"]["false_reverts"] = None
+    r = compare_bench(vac_canary, docs + [with_rollout])
+    check("canary vacuous-soak fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "vacuous soak" in x.get("reason", "") for x in r["legs"]))
+    slow_rev = json.loads(json.dumps(with_rollout))
+    slow_rev["legs"]["rollout"]["canary"]["revert_latency_s"] = 9.5
+    r = compare_bench(slow_rev, docs + [with_rollout])
+    check("canary slow-revert fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "too slow" in x.get("reason", "") for x in r["legs"]))
+    unmeasured_rev = json.loads(json.dumps(with_rollout))
+    unmeasured_rev["legs"]["rollout"]["canary"]["revert_latency_s"] \
+        = None
+    r = compare_bench(unmeasured_rev, docs + [with_rollout])
+    check("canary unmeasured-revert fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "unmeasured" in x.get("reason", "") for x in r["legs"]))
 
     # op gate on its own committed baseline
     op_base_path = os.path.join(REPO, "tools", "op_bench_baseline.json")

@@ -260,7 +260,7 @@ class _PhaseBook:
         if ph is None:
             ph = self.phases[phase] = {
                 "requests": 0, "ok": 0, "shed": 0, "failed": 0,
-                "lat": [], "active_s": 0.0}
+                "lat": [], "active_s": 0.0, "versions": {}}
         return ph
 
     def arrival(self, phase: str, now: float):
@@ -272,11 +272,18 @@ class _PhaseBook:
         self._last_ts = now
 
     def outcome(self, phase: str, outcome: str,
-                ms: Optional[float] = None):
+                ms: Optional[float] = None,
+                version: Optional[int] = None):
         ph = self._get(phase)
         ph[outcome] += 1
         if ms is not None:
             ph["lat"].append(ms)
+        if outcome == "ok" and version is not None:
+            # per-phase weights_version distribution: a hot swap
+            # mid-run shows up as the old version draining out of one
+            # phase and the new one taking over the next
+            ph["versions"][str(version)] = \
+                ph["versions"].get(str(version), 0) + 1
 
     def report(self) -> Dict[str, dict]:
         out = {}
@@ -291,6 +298,8 @@ class _PhaseBook:
                                    4),
                 "latency_ms": _percentiles(ph["lat"]),
             }
+            if ph["versions"]:
+                out[name]["weights_versions"] = dict(ph["versions"])
         return out
 
 
@@ -734,9 +743,13 @@ def _encode_bodies(make_feed, n: int = 16) -> List[bytes]:
                        ).encode() for i in range(n)]
 
 
-def _http_predict(url: str, body: bytes, timeout_s: float) -> str:
-    """One POST /predict -> 'ok' | 'shed' (503 backpressure) |
-    'failed'.
+def _http_predict(url: str, body: bytes,
+                  timeout_s: float) -> tuple:
+    """One POST /predict -> ``('ok' | 'shed' | 'failed', version)``
+    where ``version`` is the ``X-PaddleTPU-Weights-Version`` response
+    header (replicas and the router both publish it; ``None`` when
+    the server predates it or the connection died) — the rollout
+    bench watches the distribution flip during a hot swap.
 
     Not every 503 is a shed: a replica's admission 503s (queue_full /
     deadline / draining) are explicit backpressure and count as shed,
@@ -749,21 +762,23 @@ def _http_predict(url: str, body: bytes, timeout_s: float) -> str:
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as r:
             r.read()
-            return "ok"
+            v = r.headers.get("X-PaddleTPU-Weights-Version")
+            return "ok", (int(v) if v else None)
     except urllib.error.HTTPError as e:
         try:
             payload = e.read()  # drain: keep-alive must not desync
         except OSError:
             payload = b""  # ok: error body gone with the connection
         if e.code != 503:
-            return "failed"
+            return "failed", None
         try:
             reason = json.loads(payload).get("reason")
         except (ValueError, AttributeError):
             reason = None
-        return "failed" if reason == "no_ready_replicas" else "shed"
+        return ("failed" if reason == "no_ready_replicas"
+                else "shed"), None
     except (OSError, TimeoutError, ValueError):
-        return "failed"
+        return "failed", None
 
 
 def _http_statusz(base_url: str, timeout_s: float = 10.0
@@ -796,13 +811,17 @@ def run_closed_loop_http(base_url: str, make_feed, n_requests: int,
                 return
             body = bodies[i % len(bodies)]
             t0 = time.monotonic()
-            outcome = _http_predict(url, body, timeout_s)
+            outcome, version = _http_predict(url, body, timeout_s)
             ms = (time.monotonic() - t0) * 1e3
             with lock:
                 counts[outcome] += 1
                 if outcome == "ok":
                     lat.append(ms)
+                    if version is not None:
+                        versions[str(version)] = \
+                            versions.get(str(version), 0) + 1
 
+    versions: Dict[str, int] = {}
     threads = [threading.Thread(target=caller, daemon=True)
                for _ in range(concurrency)]
     t0 = time.monotonic()
@@ -816,6 +835,8 @@ def run_closed_loop_http(base_url: str, make_feed, n_requests: int,
     rep["concurrency"] = concurrency
     rep["url"] = base_url
     rep["statusz"] = _http_statusz(base_url)
+    if versions:
+        rep["weights_versions"] = versions
     return rep
 
 
@@ -998,6 +1019,7 @@ def run_open_loop_http(base_url: str, make_feed, qps: float,
     bodies = _encode_bodies(make_feed)
     lat, lock = [], threading.Lock()
     counts = {"ok": 0, "shed": 0, "failed": 0}
+    versions: Dict[str, int] = {}
     phases = _PhaseBook() if traffic is not None else None
     pending: queue_mod.Queue = queue_mod.Queue()
 
@@ -1007,15 +1029,19 @@ def run_open_loop_http(base_url: str, make_feed, qps: float,
             if item is None:
                 return
             body, t0, phase = item
-            outcome = _http_predict(url, body, timeout_s)
+            outcome, version = _http_predict(url, body, timeout_s)
             ms = (time.monotonic() - t0) * 1e3
             with lock:
                 counts[outcome] += 1
                 if outcome == "ok":
                     lat.append(ms)
+                    if version is not None:
+                        versions[str(version)] = \
+                            versions.get(str(version), 0) + 1
                 if phases is not None:
                     phases.outcome(phase, outcome,
-                                   ms if outcome == "ok" else None)
+                                   ms if outcome == "ok" else None,
+                                   version=version)
 
     pool = [threading.Thread(target=poster, daemon=True)
             for _ in range(collectors)]
@@ -1040,6 +1066,8 @@ def run_open_loop_http(base_url: str, make_feed, qps: float,
     rep["target_qps"] = qps
     rep["url"] = base_url
     rep["statusz"] = _http_statusz(base_url)
+    if versions:
+        rep["weights_versions"] = versions
     if traffic is not None:
         rep["traffic"] = traffic.describe()
         rep["phases"] = phases.report()
@@ -1054,7 +1082,8 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
               shed_pct: Optional[float] = None,
               fail_degraded: bool = False,
               ttft_ms: Optional[float] = None,
-              itl_ms: Optional[float] = None) -> dict:
+              itl_ms: Optional[float] = None,
+              expect_version: Optional[int] = None) -> dict:
     """Evaluate the SLO against one report (recursing into the nested
     closed/open halves of ``--mode both``).  Returns
     ``{"p99_ms_limit", "shed_pct_limit", "violations": [...], "ok"}``;
@@ -1069,8 +1098,33 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
     generation report's client-measured p99 time-to-first-token and
     inter-token gap — a bound given against a report that never
     measured them (no per-token clock) is itself a violation, never a
-    vacuous pass."""
+    vacuous pass.  ``expect_version`` asserts that EVERY completed
+    request carried that ``weights_version`` response header (the
+    post-rollout check: a stale version answering means a replica was
+    skipped or silently reverted); a report that never observed any
+    version against the bound is again a violation, not a vacuous
+    pass."""
     violations = []
+
+    def _versions(rep: dict, label: str):
+        if expect_version is None:
+            return
+        dist = rep.get("weights_versions")
+        if not dist:
+            if not rep.get("ok"):
+                return  # zero completions already violates via p99
+            violations.append(
+                f"{label}: --expect-version {expect_version} given "
+                f"but no response carried a weights_version header "
+                f"(server predates the rollout layer?)")
+            return
+        stale = {v: n for v, n in dist.items()
+                 if v != str(expect_version)}
+        if stale:
+            violations.append(
+                f"{label}: {sum(stale.values())} response(s) carried "
+                f"weights_version {sorted(stale)} != expected "
+                f"{expect_version}")
 
     def _one_phase(ph: dict, label: str):
         lat = ph.get("latency_ms") or {}
@@ -1121,6 +1175,7 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
             elif p99 > bound:
                 violations.append(f"{label}: {label_} p99 {p99}ms > "
                                   f"SLO {bound}ms")
+        _versions(rep, label)
         # shaped-traffic runs: the SLO binds in EVERY phase — a crest
         # that sheds half its load must not pass on the run's average
         for name, ph in (rep.get("phases") or {}).items():
@@ -1152,6 +1207,8 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
         out["ttft_ms_limit"] = ttft_ms
     if itl_ms is not None:
         out["itl_ms_limit"] = itl_ms
+    if expect_version is not None:
+        out["expect_version"] = expect_version
     if fail_degraded:
         out["fail_degraded"] = True
     return out
@@ -1320,6 +1377,12 @@ def main(argv=None) -> int:
                          "/generate contract and record each token's "
                          "client-side arrival (enables ttft_ms / "
                          "inter_token_ms report blocks over HTTP)")
+    ap.add_argument("--expect-version", type=int, default=None,
+                    help="assert every completed request carried this "
+                         "weights_version response header (the post-"
+                         "rollout convergence check); a run that never "
+                         "observed the header violates too, never a "
+                         "vacuous pass")
     args = ap.parse_args(argv)
     # `--shape sine` convenience: a bare traffic-shape name given via
     # --shape (which otherwise takes name=d0,d1 feed specs) selects
@@ -1361,11 +1424,13 @@ def main(argv=None) -> int:
         rc = 0
         if args.slo_p99_ms is not None or args.slo_shed_pct is not None \
                 or args.slo_ttft_ms is not None \
-                or args.slo_itl_ms is not None or args.sharded:
+                or args.slo_itl_ms is not None or args.sharded \
+                or args.expect_version is not None:
             slo = check_slo(report, args.slo_p99_ms, args.slo_shed_pct,
                             fail_degraded=args.sharded,
                             ttft_ms=args.slo_ttft_ms,
-                            itl_ms=args.slo_itl_ms)
+                            itl_ms=args.slo_itl_ms,
+                            expect_version=args.expect_version)
             report["slo"] = slo
             if not slo["ok"]:
                 for v in slo["violations"]:
