@@ -1533,6 +1533,214 @@ def run_paged_decode():
     }
 
 
+def run_spec_decode():
+    """Speculative-vs-plain decode A/B (`legs.llama_spec_decode`):
+    the SAME paged engine config (slots/pages/prefix reuse all equal)
+    run twice per workload, differing only in ``speculate`` — the
+    n-gram self-drafter + one-chunk verifier vs the one-token grid
+    step.  Greedy argmax acceptance is bit-exact, so this leg gates
+    *throughput shape*, not correctness (the exactness gates live in
+    tests/test_spec_decode.py and the chaos ``spec_storm`` leg).
+
+    Two workloads, acceptance rate reported for each: the
+    repetition-heavy ``shared-prefix`` chat shape (fixed header +
+    short random tail; greedy decode on the tiny bench model settles
+    into cyclic continuations the prompt-lookup drafter predicts,
+    while the random header feeds it spurious short-gram matches —
+    measured acceptance lands near 0.3) carries the headline
+    tokens/sec and the ``acceptance_floor`` gate, a TRIPWIRE set
+    well under the measured rate: acceptance is deterministic given
+    config (greedy argmax + history-only drafting), so a rate under
+    the floor means the drafter or verifier broke, not that the chip
+    was busy.  The ``mixed`` long-prompt/short-chat shape is the
+    control — published, not floor-gated.
+    ``spec_vs_plain_tokens`` is collapse-gated like
+    ``speedup_vs_static`` (only where a baseline proved the win: on
+    core-bound CPU hosts verify-chunk compute competes with the grid
+    step and the ratio may sit under 1.0 — that is an anomaly flag,
+    never a hard fail).  ``leaked_pages`` (pool live pages after
+    drain + prefix flush, max over all four runs) and the rollback
+    counter balance are hard-zeroed in tools/perf_gate.py on every
+    host.  Sized by BENCH_SPEC_{VOCAB,HIDDEN,LAYERS,HEADS,KV_HEADS,
+    INTER,SLOTS,MAX_SEQ,PAGE_TOKENS,PAGES,TOKENS,NGRAM,PREFIX,
+    TAIL_MAX,LONG_TOKENS,REQUESTS,OUT_MEAN,OUT_MAX,ROUNDS,
+    ACCEPT_FLOOR}."""
+    from paddle_tpu.serving import GenerationEngine
+
+    lg = _load_serving_loadgen()
+    env = os.environ.get
+    vocab = int(env("BENCH_SPEC_VOCAB", "256"))
+    hidden = int(env("BENCH_SPEC_HIDDEN", "64"))
+    layers_n = int(env("BENCH_SPEC_LAYERS", "2"))
+    heads = int(env("BENCH_SPEC_HEADS", "4"))
+    kv_heads = int(env("BENCH_SPEC_KV_HEADS", str(heads)))
+    inter = int(env("BENCH_SPEC_INTER", str(2 * hidden)))
+    slots = int(env("BENCH_SPEC_SLOTS", "8"))
+    max_seq = int(env("BENCH_SPEC_MAX_SEQ", "256"))
+    page_tokens = int(env("BENCH_SPEC_PAGE_TOKENS", "16"))
+    num_pages = int(env("BENCH_SPEC_PAGES",
+                        str(slots * max_seq // page_tokens + 1)))
+    spec_tokens = int(env("BENCH_SPEC_TOKENS", "4"))
+    spec_ngram = int(env("BENCH_SPEC_NGRAM", "3"))
+    prefix_tokens = int(env("BENCH_SPEC_PREFIX", "64"))
+    tail_max = int(env("BENCH_SPEC_TAIL_MAX", "8"))
+    long_tokens = int(env("BENCH_SPEC_LONG_TOKENS", "96"))
+    n_req = int(env("BENCH_SPEC_REQUESTS", "32"))
+    out_mean = float(env("BENCH_SPEC_OUT_MEAN", "32"))
+    out_max = int(env("BENCH_SPEC_OUT_MAX", "96"))
+    rounds = int(env("BENCH_SPEC_ROUNDS", "3"))
+    accept_floor = float(env("BENCH_SPEC_ACCEPT_FLOOR", "0.15"))
+    model = dict(vocab_size=vocab, hidden=hidden, num_layers=layers_n,
+                 num_heads=heads, num_kv_heads=kv_heads,
+                 intermediate=inter)
+    workloads = {
+        "shared-prefix": lg.prompt_maker(
+            vocab, 4, tail_max, out_mean, out_max, dist="bimodal",
+            prompt_dist="shared-prefix", prefix_tokens=prefix_tokens),
+        "mixed": lg.prompt_maker(
+            vocab, 4, tail_max, out_mean, out_max, dist="bimodal",
+            prompt_dist="mixed", long_tokens=long_tokens),
+    }
+
+    def one_mode(speculate, make_prompt):
+        kw = dict(paged=True, page_tokens=page_tokens,
+                  num_pages=num_pages, prefix_reuse=True)
+        if speculate:
+            kw.update(speculate=True, spec_tokens=spec_tokens,
+                      spec_ngram=spec_ngram)
+        eng = GenerationEngine(model, num_slots=slots,
+                               max_seq_len=max_seq,
+                               max_new_tokens=out_max,
+                               queue_cap=4 * n_req,
+                               deadline_ms=600000.0, **kw)
+        eng.warmup()
+        try:
+            reps = [lg.run_closed_loop_generate(eng, make_prompt,
+                                                n_req,
+                                                concurrency=2 * slots)
+                    for _ in range(rounds)]
+            st = eng.stats()
+            # the hard-zero input: after the closed loop drains, the
+            # only legitimate page holder is the prefix index — flush
+            # it and anything still live is a leak (a rejected draft
+            # whose rollback under-released, exactly what the
+            # refcount discipline must never allow)
+            if eng._prefix is not None:
+                eng._prefix.flush()
+            leaked = eng.stats()["paged"]["pages_live"]
+            extras = {
+                "p99_step_ms": st["decode_step_ms"].get("p99"),
+                "p99_verify_ms": st["spec_verify_ms"].get("p99"),
+                "speculate": st["speculate"],
+                "leaked_pages": int(leaked),
+            }
+        finally:
+            eng.close()
+        return reps, extras
+
+    def ab(make_prompt):
+        plain_reps, plain_x = one_mode(False, make_prompt)
+        spec_reps, spec_x = one_mode(True, make_prompt)
+        rates = [r["tokens_per_sec"] for r in spec_reps]
+        plain_rates = [r["tokens_per_sec"] for r in plain_reps]
+        spec_rep = spec_reps[
+            rates.index(sorted(rates)[len(rates) // 2])]
+        plain_rep = plain_reps[
+            plain_rates.index(
+                sorted(plain_rates)[len(plain_rates) // 2])]
+        return {
+            "rates": rates,
+            "plain_rates": plain_rates,
+            "spec_rep": spec_rep,
+            "plain_rep": plain_rep,
+            "spec_x": spec_x,
+            "plain_x": plain_x,
+        }
+
+    import jax
+
+    device = jax.devices()[0]
+    runs = {name: ab(mk) for name, mk in workloads.items()}
+    head = runs["shared-prefix"]
+    rates = head["rates"]
+    tps = float(np.median(rates))
+    tps_plain = float(np.median(head["plain_rates"]))
+    leaked = max(r["spec_x"]["leaked_pages"] for r in runs.values())
+    leaked = max(leaked, max(r["plain_x"]["leaked_pages"]
+                             for r in runs.values()))
+
+    def wl_summary(r):
+        sp = r["spec_x"]["speculate"]
+        return {
+            "tokens_per_sec": round(
+                float(np.median(r["rates"])), 2),
+            "plain_tokens_per_sec": round(
+                float(np.median(r["plain_rates"])), 2),
+            "spec_vs_plain_tokens": round(
+                float(np.median(r["rates"]))
+                / max(float(np.median(r["plain_rates"])), 1e-9), 3),
+            "acceptance_rate": sp["acceptance_rate"],
+            "drafts": sp["drafts"],
+            "tokens_proposed": sp["tokens_proposed"],
+            "tokens_accepted": sp["tokens_accepted"],
+            "rollbacks": sp["rollbacks"],
+            "p99_verify_ms": r["spec_x"]["p99_verify_ms"],
+        }
+
+    sp = head["spec_x"]["speculate"]
+    return {
+        "metric": "llama_spec_decode_tokens_per_sec_per_chip",
+        "value": round(tps, 2),
+        "unit": "tokens/sec/chip",
+        "device_kind": getattr(device, "device_kind", str(device)),
+        "stats": {
+            "rounds": rounds,
+            "median": round(tps, 2),
+            "p10": round(float(np.percentile(rates, 10)), 2),
+            "p90": round(float(np.percentile(rates, 90)), 2),
+            "min": round(min(rates), 2),
+            "max": round(max(rates), 2),
+        },
+        "plain_tokens_per_sec": round(tps_plain, 2),
+        "spec_vs_plain_tokens": round(tps / max(tps_plain, 1e-9), 3),
+        # headline acceptance = the repetition-heavy workload the
+        # drafter is built for; the floor arms the perf_gate rule
+        "acceptance_rate": sp["acceptance_rate"],
+        "acceptance_floor": accept_floor,
+        "spec_drafts": sp["drafts"],
+        "spec_tokens_proposed": sp["tokens_proposed"],
+        "spec_tokens_accepted": sp["tokens_accepted"],
+        "spec_rollbacks": sp["rollbacks"],
+        "leaked_pages": leaked,
+        # client-observed inter-token gap: accepted tokens replay in a
+        # burst per verify, so spec p99 reflects the verify cadence
+        "p99_intertoken_ms":
+            head["spec_rep"]["inter_token_ms"].get("p99"),
+        "plain_p99_intertoken_ms":
+            head["plain_rep"]["inter_token_ms"].get("p99"),
+        "p99_verify_ms": head["spec_x"]["p99_verify_ms"],
+        "p99_step_ms": head["spec_x"]["p99_step_ms"],
+        "plain_p99_step_ms": head["plain_x"]["p99_step_ms"],
+        "p99_ms": head["spec_rep"]["latency_ms"].get("p99"),
+        "plain_p99_ms": head["plain_rep"]["latency_ms"].get("p99"),
+        "workloads": {name: wl_summary(r)
+                      for name, r in runs.items()},
+        "closed": head["spec_rep"],
+        "plain": head["plain_rep"],
+        "config": {"vocab": vocab, "hidden": hidden,
+                   "layers": layers_n, "heads": heads,
+                   "kv_heads": kv_heads, "inter": inter,
+                   "slots": slots, "max_seq": max_seq,
+                   "page_tokens": page_tokens, "num_pages": num_pages,
+                   "spec_tokens": spec_tokens,
+                   "spec_ngram": spec_ngram,
+                   "prefix_tokens": prefix_tokens,
+                   "tail_max": tail_max, "long_tokens": long_tokens,
+                   "requests": n_req, "out_mean": out_mean,
+                   "out_max": out_max, "rounds": rounds},
+    }
+
+
 def run_disagg():
     """Disaggregated-vs-colocated A/B (`legs.llama_disagg`) on the
     MIXED long-prompt/short-chat workload at equal chip count: the
@@ -2074,6 +2282,14 @@ def main():
                 out["legs"]["llama_paged_decode"] = run_paged_decode()
             except Exception as e:
                 out["legs"]["llama_paged_decode"] = {
+                    "error": f"{type(e).__name__}: {e}"}
+        # speculative-decode leg: n-gram self-drafts + one-chunk
+        # verification vs plain paged decode (BENCH_SPEC=0 skips)
+        if os.environ.get("BENCH_SPEC", "1") == "1":
+            try:
+                out["legs"]["llama_spec_decode"] = run_spec_decode()
+            except Exception as e:
+                out["legs"]["llama_spec_decode"] = {
                     "error": f"{type(e).__name__}: {e}"}
         # disaggregated prefill/decode A/B on the mixed workload
         # (BENCH_DISAGG=0 skips)

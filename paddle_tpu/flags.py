@@ -194,6 +194,29 @@ register_flag("FLAGS_serving_prefix_reuse", True,
               "into new slots copy-on-write — their prefill is skipped "
               "entirely and the pages are shared refcounted until every "
               "referencing slot finishes; 0 disables the prefix index")
+register_flag("FLAGS_serving_speculate", False,
+              "paged generation: speculative decoding — a prompt-lookup "
+              "n-gram drafter proposes up to FLAGS_serving_spec_tokens "
+              "tokens per slot per scheduler iteration from the "
+              "sequence's OWN prompt+generated history (no second "
+              "model), a single chunk-shaped verify program scores the "
+              "draft against the paged cache, and the longest "
+              "argmax-agreeing prefix (plus the one bonus token) is "
+              "accepted — bit-exact vs plain greedy decode, token-for-"
+              "token and logit-for-logit.  Rejected draft tokens roll "
+              "their provisionally-written KV pages back through the "
+              "refcounted pool.  Requires FLAGS_serving_paged=1")
+register_flag("FLAGS_serving_spec_tokens", 4,
+              "speculative decoding: maximum draft tokens proposed per "
+              "slot per verify (the verify chunk scores draft+1 rows); "
+              "larger drafts amortize more grid steps on repetitive "
+              "text but waste verify compute when acceptance is low")
+register_flag("FLAGS_serving_spec_ngram", 3,
+              "speculative decoding: longest n-gram suffix the prompt-"
+              "lookup drafter matches against the sequence history "
+              "(falls back to shorter n-grams down to 1; a slot with "
+              "no match this iteration takes the plain one-token grid "
+              "step)")
 register_flag("FLAGS_serving_role", "both",
               "disaggregated serving role of this GenerationEngine / "
               "replica: 'both' (colocated prefill+decode, the default), "

@@ -462,3 +462,74 @@ def build_llama_prefill_chunk(chunk_len, max_seq_len, num_pages,
     return ["chunk_ids", "base", "block_table", "chunk_len",
             "last_off"], \
         {"logits": logits, "next_token": next_token}, cache_names
+
+
+def build_llama_verify(chunk_len, max_seq_len, num_pages, page_tokens,
+                       vocab_size=32000, hidden=4096, num_layers=32,
+                       num_heads=32, num_kv_heads=None,
+                       intermediate=11008, name="llama"):
+    """Speculative-decode verifier: the prefill-continuation forward
+    (:func:`build_llama_prefill_chunk`) fetching EVERY row's greedy
+    argmax + logits instead of one gathered row.
+
+    The chunk carries ``[pending_token, draft_1..draft_K]`` at
+    ``base`` = the slot's committed position; row ``t``'s argmax is
+    the token a plain decode step would emit after committing the
+    chunk's first ``t+1`` tokens, so the longest prefix with
+    ``draft_{t+1} == argmax(row t)`` (plus the one bonus token row
+    ``a`` yields) is exactly the plain greedy stream — bit-exact,
+    tolerance 0.  Rows write their K/V into the slot's pages as a
+    chunked prefill would (``chunk_len`` masks the pad tail to the
+    trash page); rejected rows' garbage K/V is masked by the causal
+    validity window (``j <= base + t``) and overwritten by the next
+    real write at that position, so rollback is page ACCOUNTING, not
+    a device-side undo.
+
+    Feeds: ``chunk_ids`` [1, C] int64, ``base`` [1] int32,
+    ``block_table`` [1, NP] int32, ``chunk_len`` [1] int32.
+    Fetches: ``tokens`` [1, C] int64 (per-row greedy argmax) and
+    ``logits`` [1, C, V].  The head projects ALL rows before the
+    argmax — gathering hidden rows first would re-tile the
+    contraction and drift ~5e-8 off the decode-step GEMM, breaking
+    the acceptance contract (see :func:`build_llama_prefill`).
+
+    Returns ``(feed_names, fetches, cache_names)``."""
+    from ..framework.core import default_main_program
+
+    num_kv_heads = num_kv_heads or num_heads
+    head_dim = hidden // num_heads
+    np_slot = max_seq_len // page_tokens
+    chunk_ids = layers.data("chunk_ids", [1, chunk_len], dtype="int64",
+                            append_batch_size=False)
+    base = layers.data("base", [1], dtype="int32",
+                       append_batch_size=False)
+    block_table = layers.data("block_table", [1, np_slot],
+                              dtype="int32", append_batch_size=False)
+    ck_len = layers.data("chunk_len", [1], dtype="int32",
+                         append_batch_size=False)
+    block = default_main_program().global_block()
+    cache_names = []
+    caches = []
+    for i in range(num_layers):
+        ck = block.create_var(
+            name=f"{name}.pool_k_{i}", persistable=True,
+            shape=[num_pages, num_kv_heads, page_tokens, head_dim],
+            dtype="float32", stop_gradient=True)
+        cv = block.create_var(
+            name=f"{name}.pool_v_{i}", persistable=True,
+            shape=[num_pages, num_kv_heads, page_tokens, head_dim],
+            dtype="float32", stop_gradient=True)
+        caches.append((ck, cv))
+        cache_names += [ck.name, cv.name]
+    x = layers.embedding(chunk_ids, size=[vocab_size, hidden],
+                         param_attr=f"{name}.embed")
+    for i, (ck, cv) in enumerate(caches):
+        x = llama_block(x, hidden, num_heads, num_kv_heads, chunk_len,
+                        head_dim, intermediate, name=f"{name}.blk{i}",
+                        kv_cache=(ck, cv), positions=base,
+                        block_table=block_table, kv_lengths=ck_len)
+    x = layers.rms_norm(x, param_attr=f"{name}.ln_f")
+    all_logits = _linear(x, vocab_size, pname=f"{name}.head.w")
+    tokens = layers.argmax(all_logits, axis=-1)              # [1, C]
+    return ["chunk_ids", "base", "block_table", "chunk_len"], \
+        {"logits": all_logits, "tokens": tokens}, cache_names

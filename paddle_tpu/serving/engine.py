@@ -894,12 +894,13 @@ class ServingEngine:
 
     def submit_generate(self, prompt, max_new_tokens=None,
                         trace_id=None, deadline_ms=None,
-                        on_token=None, timeline=None):
+                        on_token=None, timeline=None, speculate=None):
         """Admit one generation request to the attached slot scheduler
         (future of the generation record); raises RuntimeError when no
-        generator is attached.  ``on_token``/``timeline`` pass through
-        to :meth:`GenerationEngine.submit` (per-token streaming
-        callback and the per-sequence timeline switch)."""
+        generator is attached.  ``on_token``/``timeline``/``speculate``
+        pass through to :meth:`GenerationEngine.submit` (per-token
+        streaming callback, the per-sequence timeline switch, and the
+        per-request speculative-decoding override)."""
         if self.generator is None:
             raise RuntimeError("no GenerationEngine attached; call "
                                "attach_generator() first")
@@ -908,7 +909,8 @@ class ServingEngine:
                                      trace_id=trace_id,
                                      deadline_ms=deadline_ms,
                                      on_token=on_token,
-                                     timeline=timeline)
+                                     timeline=timeline,
+                                     speculate=speculate)
 
     # -- scheduler ----------------------------------------------------------
     def _count(self, key: str, n: int = 1):

@@ -49,6 +49,22 @@ identified.  This module is the repo's answer:
   no longer stalls the whole grid's inter-token latency.  A prefix-hit
   tail prefill rides the same chunk program with ``base`` set past the
   shared pages.
+* **Speculative decoding** (``FLAGS_serving_speculate``) — self-
+  speculation over the paged cache: a prompt-lookup drafter
+  (:func:`ngram_draft` — longest n-gram suffix match over the
+  sequence's OWN prompt+generated history, no second model) proposes
+  up to ``FLAGS_serving_spec_tokens`` tokens per slot per scheduler
+  iteration; one chunk-shaped verify program
+  (``build_llama_verify``) scores ``[pending, draft...]`` against the
+  slot's pages in a single prefill-shaped call, and the longest
+  argmax-agreeing prefix plus the one bonus token is accepted —
+  **bit-exact vs plain greedy decode** (tokens AND logits, tolerance
+  0; the verify rows ARE the decode-step forward, batched).  Rejected
+  draft tokens roll their provisionally-grown KV pages back through
+  the refcounted pool (page accounting only — the garbage rows are
+  causally masked and overwritten by the next real write).  Slots
+  with no usable draft, or ``submit(speculate=False)``, take the
+  unchanged one-token grid step — mixed grids per iteration.
 * **Admission control** — bounded queue reusing the serving
   :class:`~paddle_tpu.serving.engine.OverloadedError` semantics:
   ``queue_full`` at submit, ``deadline`` when a request outlives
@@ -98,7 +114,10 @@ each fails only the then-active requests),
 ``serving_prefill_tokens``, ``serving_slot_reclaims``,
 ``serving_prefix_hits``, ``serving_prefix_tokens_saved``,
 ``serving_prefill_chunks``, ``serving_kv_page_evictions``,
-``serving_kv_pool_stalls``; gauges
+``serving_kv_pool_stalls``, ``serving_spec_drafts``,
+``serving_spec_tokens_proposed``, ``serving_spec_tokens_accepted``,
+``serving_spec_rollbacks``; gauges
+``serving_spec_acceptance_rate``,
 ``serving_slot_occupancy``, ``serving_prefill_decode_ratio``,
 ``serving_kv_cache_bytes`` (allocated cache capacity — the page pool
 in paged mode, the dense reservation otherwise),
@@ -106,8 +125,8 @@ in paged mode, the dense reservation otherwise),
 sequences or the prefix index), ``serving_kv_pages_free``,
 ``serving_kv_pages_live``, ``serving_decode_mfu``; histograms
 ``serving_generate_ms``, ``serving_prefill_ms``,
-``serving_decode_step_ms``, ``serving_ttft_ms``,
-``serving_inter_token_ms``.
+``serving_decode_step_ms``, ``serving_spec_verify_ms``,
+``serving_ttft_ms``, ``serving_inter_token_ms``.
 """
 from __future__ import annotations
 
@@ -129,7 +148,7 @@ from .engine import (OverloadedError, PoisonedInput, RequestFailed,
 from .sharded import describe_mesh as _describe_mesh
 
 __all__ = ["GenerationEngine", "GenRequest", "PagePool", "PrefixIndex",
-           "PoolExhausted"]
+           "PoolExhausted", "ngram_draft"]
 
 logger = logging.getLogger("paddle_tpu.serving.generation")
 
@@ -144,12 +163,13 @@ class GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "future", "t_submit",
                  "t_claimed", "t_deadline", "trace_id", "prefill_ms",
                  "on_token", "record_timeline", "events", "t_tokens",
-                 "t_first", "t_last", "segment")
+                 "t_first", "t_last", "segment", "speculate")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.segment = None  # adopted KVSegment (decode-role handoff)
+        self.speculate = None  # per-request override (None = engine)
         self.future = ServingFuture()
         self.t_submit = time.monotonic()
         self.t_claimed: Optional[float] = None
@@ -172,6 +192,34 @@ class GenRequest:
 
 class PoolExhausted(Exception):
     """The paged KV pool has no free page and nothing evictable."""
+
+
+def ngram_draft(history: np.ndarray, k: int, max_ngram: int) -> List[int]:
+    """Prompt-lookup drafter: propose up to ``k`` tokens by matching
+    the longest suffix n-gram of ``history`` (``max_ngram`` down to 1)
+    against an earlier occurrence in ``history`` itself, and reading
+    off the tokens that followed it — self-speculation, no second
+    model (Saxena's prompt-lookup decoding / LLMA).  The LAST earlier
+    occurrence wins (recent context predicts repetitive continuations
+    best).  Returns ``[]`` on a miss; the caller falls back to the
+    plain one-token grid step, so a bad draft costs a verify, never
+    correctness — acceptance is gated on the verifier's argmax."""
+    h = np.asarray(history).ravel()
+    n = int(h.size)
+    k = int(k)
+    if k < 1 or n < 2:
+        return []
+    for g in range(min(int(max_ngram), n - 1), 0, -1):
+        suffix = h[n - g:]
+        # candidate start positions of earlier occurrences: the match
+        # must END before the history's last token so at least one
+        # follow-on token exists to propose
+        for start in range(n - g - 1, -1, -1):
+            if np.array_equal(h[start:start + g], suffix):
+                follow = h[start + g:start + g + k]
+                if follow.size:
+                    return [int(t) for t in follow]
+    return []
 
 
 class PagePool:
@@ -344,7 +392,8 @@ class GenerationEngine:
                  attn_impl="auto", seed=0, keep_logits=False,
                  mesh=None, shard_rules=None, paged=None,
                  page_tokens=None, num_pages=None, prefill_chunk=None,
-                 prefix_reuse=None, role=None):
+                 prefix_reuse=None, role=None, speculate=None,
+                 spec_tokens=None, spec_ngram=None):
         import paddle_tpu as pt
         from ..models.llama import build_llama_decode, build_llama_prefill
 
@@ -440,9 +489,34 @@ class GenerationEngine:
                 f"role={self.role!r} requires the paged KV cache "
                 f"(paged=True / FLAGS_serving_paged=1): the KV-segment "
                 f"handoff is page-block-based")
+        # speculative decoding (self-speculation; paged-only — the
+        # verify program scores the draft against the slot's pages and
+        # the rollback discipline IS page accounting)
+        self.speculate = bool(flag_value("FLAGS_serving_speculate")
+                              if speculate is None else speculate)
+        self.spec_tokens = int(
+            spec_tokens if spec_tokens is not None
+            else flag_value("FLAGS_serving_spec_tokens"))
+        self.spec_ngram = int(
+            spec_ngram if spec_ngram is not None
+            else flag_value("FLAGS_serving_spec_ngram"))
+        if self.speculate:
+            if not self.paged:
+                raise ValueError(
+                    "speculate=True requires the paged KV cache "
+                    "(paged=True / FLAGS_serving_paged=1): the verify "
+                    "chunk scores drafts against the slot's pages and "
+                    "rejected tokens roll back through the page pool")
+            if self.spec_tokens < 1:
+                raise ValueError(f"spec_tokens must be >= 1, got "
+                                 f"{self.spec_tokens}")
+            if self.spec_ngram < 1:
+                raise ValueError(f"spec_ngram must be >= 1, got "
+                                 f"{self.spec_ngram}")
         self._fingerprint: Optional[str] = None
         self._paged_prefill_progs: Dict[int, tuple] = {}
         self._chunk_progs: Dict[int, tuple] = {}
+        self._verify_progs: Dict[int, tuple] = {}
         self._adopt_scatter = None  # donated jit, built on first adopt
         self._prefill_rr = 0  # chunked-prefill round-robin cursor
         self._peak_active = 0
@@ -488,11 +562,14 @@ class GenerationEngine:
                    "prefix_tokens_saved": 0, "prefill_chunks": 0,
                    "page_evictions": 0, "pool_stalls": 0,
                    "segments_exported": 0, "segments_adopted": 0,
-                   "adopt_rejects": 0}
+                   "adopt_rejects": 0, "spec_drafts": 0,
+                   "spec_tokens_proposed": 0,
+                   "spec_tokens_accepted": 0, "spec_rollbacks": 0}
         self._n_lock = threading.Lock()
         self._h_gen = telemetry.Histogram("serving_generate_ms")
         self._h_prefill = telemetry.Histogram("serving_prefill_ms")
         self._h_step = telemetry.Histogram("serving_decode_step_ms")
+        self._h_verify = telemetry.Histogram("serving_spec_verify_ms")
         self._h_ttft = telemetry.Histogram("serving_ttft_ms")
         self._h_itl = telemetry.Histogram("serving_inter_token_ms")
         self._t_prefill_total = 0.0
@@ -683,6 +760,35 @@ class GenerationEngine:
             return [b for b in self.prefill_buckets if b <= cap]
         return list(self.prefill_buckets)
 
+    def _verify_prog_for(self, bucket: int):
+        """Speculative-verify program: the chunk forward fetching
+        EVERY row's argmax + logits (``build_llama_verify``) — one
+        call scores a whole draft against the slot's pages."""
+        import paddle_tpu as pt
+        from ..models.llama import build_llama_verify
+
+        entry = self._verify_progs.get(bucket)
+        if entry is None:
+            main, startup = pt.Program(), pt.Program()
+            startup._is_startup = True
+            startup.random_seed = main.random_seed = self._seed
+            with pt.program_guard(main, startup):
+                _feeds, fetches, _names = build_llama_verify(
+                    bucket, self.max_seq_len, self.num_pages,
+                    self.page_tokens, name=self.name, **self.model)
+            entry = self._verify_progs[bucket] = (main, fetches)
+        return entry
+
+    def _verify_buckets(self) -> List[int]:
+        """Bucket lengths the verify program can be asked for: the
+        chunk is ``[pending, draft...]`` — at most ``spec_tokens + 1``
+        rows — so only buckets up to that length's own bucket compile
+        (with the default K=4, exactly one: bucket 8)."""
+        cap = batcher.prompt_bucket_for(
+            min(self.spec_tokens + 1, self.max_prompt_len),
+            self.prefill_buckets)
+        return [b for b in self.prefill_buckets if b <= cap]
+
     def warmup(self) -> int:
         """Compile every prefill bucket + the decode step now (off the
         request path).  Returns the number of programs compiled.
@@ -701,12 +807,29 @@ class GenerationEngine:
             return compiled + 1
         np_slot = self.pages_per_slot
         if self.role == "decode":
-            # a decode-role engine never prefills: the decode step is
-            # its only program
+            # a decode-role engine never prefills: the decode step
+            # (plus the verify program when speculating) is all it runs
+            compiled = 0
+            if self.speculate:
+                for b in self._verify_buckets():
+                    if b not in self._verify_progs:
+                        prog, fetches = self._verify_prog_for(b)
+                        self._prefill_exe.run(
+                            prog,
+                            feed={"chunk_ids": np.zeros((1, b),
+                                                        "int64"),
+                                  "base": np.zeros((1,), "int32"),
+                                  "block_table": np.zeros(
+                                      (1, np_slot), "int32"),
+                                  "chunk_len": np.zeros((1,),
+                                                        "int32")},
+                            fetch_list=[fetches["tokens"]],
+                            scope=self.scope, return_numpy=False)
+                        compiled += 1
             self._run_decode_program(
                 np.zeros((self.num_slots, 1), "int64"),
                 np.zeros((self.num_slots,), "int32"))
-            return 1
+            return compiled + 1
         if self.prefill_chunk <= 0:
             for b in self.prefill_buckets:
                 if b not in self._paged_prefill_progs:
@@ -739,6 +862,20 @@ class GenerationEngine:
         if self.role == "prefill":
             # a prefill-role engine never runs the decode grid
             return compiled
+        if self.speculate:
+            for b in self._verify_buckets():
+                if b not in self._verify_progs:
+                    prog, fetches = self._verify_prog_for(b)
+                    self._prefill_exe.run(
+                        prog,
+                        feed={"chunk_ids": np.zeros((1, b), "int64"),
+                              "base": np.zeros((1,), "int32"),
+                              "block_table": np.zeros((1, np_slot),
+                                                      "int32"),
+                              "chunk_len": np.zeros((1,), "int32")},
+                        fetch_list=[fetches["tokens"]],
+                        scope=self.scope, return_numpy=False)
+                    compiled += 1
         self._run_decode_program(np.zeros((self.num_slots, 1), "int64"),
                                  np.zeros((self.num_slots,), "int32"))
         return compiled + 1
@@ -790,7 +927,8 @@ class GenerationEngine:
                trace_id: Optional[str] = None,
                deadline_ms: Optional[float] = None,
                on_token=None,
-               timeline: Optional[bool] = None) -> ServingFuture:
+               timeline: Optional[bool] = None,
+               speculate: Optional[bool] = None) -> ServingFuture:
         """Admit one generation request.  ``prompt``: 1-D int token ids
         (1 ≤ len ≤ the largest prefill bucket).  Returns a future whose
         ``result()`` is ``{"tokens", "prompt_len", "steps", "finish",
@@ -810,7 +948,12 @@ class GenerationEngine:
         fast and never raise (exceptions are contained and logged, the
         sequence keeps generating).  ``timeline`` — force the
         per-sequence timeline record on/off; default follows
-        ``FLAGS_telemetry`` (off ⇒ zero per-token bookkeeping)."""
+        ``FLAGS_telemetry`` (off ⇒ zero per-token bookkeeping).
+        ``speculate`` — per-request speculative-decoding override:
+        ``False`` opts this sequence out of drafting (it rides the
+        plain grid step even on a speculating engine — bit-exact
+        either way, this knob only trades verify compute); ``True``
+        or ``None`` follow the engine's ``speculate`` setting."""
         if self.role == "decode":
             raise ValueError("decode-role engine accepts KV segments "
                              "via adopt(), not prompts (role=decode)")
@@ -830,6 +973,7 @@ class GenerationEngine:
         mnt = max(1, int(max_new_tokens if max_new_tokens is not None
                          else self.max_new_tokens))
         req = GenRequest(ids.astype("int64"), mnt)
+        req.speculate = speculate
         budget_s = self._deadline_s
         if deadline_ms is not None:
             budget_s = min(budget_s, float(deadline_ms) / 1e3)
@@ -1251,9 +1395,22 @@ class GenerationEngine:
                 except Exception as e:  # noqa: BLE001 — same isolation
                     # as a dense prefill failure: this request only
                     self._fail_request(slot, slot.req, "prefill", e)
+            # speculative round first: slots whose draft verified this
+            # iteration already advanced (often several tokens) and are
+            # skipped by the grid step; the rest ride it unchanged —
+            # mixed grids per iteration
+            served = frozenset()
+            if self.speculate and self._decoding_slots():
+                try:
+                    served = self._speculate_round()
+                except Exception as e:  # noqa: BLE001 — a verify crash
+                    # is a decode-grid crash: it donated the same pool
+                    # buffers, so the active slots' cache state is
+                    # unknowable (same containment as the grid step)
+                    self._decode_failed(e)
             if self._decoding_slots():
                 try:
-                    self._decode_step()
+                    self._decode_step(skip=served)
                 except Exception as e:  # noqa: BLE001 — a decode-step
                     # failure fails the ACTIVE requests (after a
                     # mid-step crash their cache state is unknowable)
@@ -1591,6 +1748,39 @@ class GenerationEngine:
         bt[:len(slot.pages)] = slot.pages
         return bt
 
+    def _acquire_draft_pages(self, slot: _Slot, n_tokens: int) -> int:
+        """Provisionally grow the slot's block table to hold a draft's
+        verify rows.  Returns the page count to KEEP on rollback (the
+        pre-draft table length).  On exhaustion the partial growth is
+        rolled back HERE and :class:`PoolExhausted` re-raised — the
+        caller falls through to the plain one-token step with the
+        block table exactly as it found it."""
+        keep = len(slot.pages)
+        try:
+            self._ensure_pages(slot, n_tokens)
+        except PoolExhausted:
+            # _ensure_pages appends as it allocates: drop the partial
+            # growth so the draft leaks nothing
+            self._rollback_draft_pages(slot, keep)
+            raise
+        return keep
+
+    def _rollback_draft_pages(self, slot: _Slot, keep_pages: int) -> int:
+        """Drop the slot's refs on draft pages past ``keep_pages`` —
+        the accounting half of draft rejection.  The rejected rows'
+        K/V needs no device-side undo: rows past the committed
+        position are outside every later step's causal validity
+        window (``j <= base + t``) and the next real write at that
+        position overwrites them.  Pairs with
+        :meth:`_acquire_draft_pages` (graftcheck's resource-pairing
+        pass polices the pairing)."""
+        dropped = slot.pages[keep_pages:]
+        if dropped:
+            self._pool.decref(dropped)
+            del slot.pages[keep_pages:]
+            self._publish_pool_gauges()
+        return len(dropped)
+
     def _prefill_advance(self, slot: _Slot):
         """One prefill slice for one paged slot: either the whole
         prompt through the paged full-prefill program (chunking off,
@@ -1807,7 +1997,121 @@ class GenerationEngine:
         logits = np.asarray(outs[1].numpy()) if self.keep_logits else None
         return next_tokens, logits
 
-    def _decode_step(self):
+    def _speculate_round(self) -> frozenset:
+        """One speculative draft/verify per eligible decoding slot.
+        Returns the slot indices that advanced (>= 1 token each) —
+        this iteration's grid step skips them; ineligible slots (per-
+        request opt-out, no n-gram match, budget/capacity leaves no
+        draft room, pool exhausted) fall through to it unchanged.
+
+        Per slot: the prompt-lookup drafter proposes up to K tokens
+        from the sequence's own history; the verify chunk
+        ``[pending, draft...]`` runs at ``base = position`` (row 0
+        writes the pending token's K/V exactly where the plain step
+        would); ``a`` = longest prefix with ``draft[i] == argmax(row
+        i)`` and rows ``0..a`` commit — ``a + 1`` tokens booked
+        through :meth:`_book_token` in order, never fewer than the
+        plain step's one.  Draft pages past the new position roll
+        back through the pool."""
+        served = set()
+        for slot in list(self._decoding_slots()):
+            req = slot.req
+            if req.speculate is False:
+                continue
+            cap = min(self.spec_tokens,
+                      req.max_new_tokens - len(slot.tokens) - 1,
+                      self.max_seq_len - slot.position - 1)
+            if cap < 1:
+                continue
+            history = np.concatenate(
+                [req.prompt, np.asarray(slot.tokens, "int64")])
+            draft = ngram_draft(history, cap, self.spec_ngram)
+            if not draft:
+                continue
+            t0 = time.monotonic()
+            # the verify IS a decode-grid dispatch: it donates the
+            # same pool buffers, so it shares the decode_step fault
+            # site (chaos's mid-verify faults land here)
+            kind = fault.fire("decode_step")
+            fault.maybe_delay(kind)
+            if kind == "fail":
+                raise fault.InjectedFault(
+                    "injected decode_step failure (spec verify)")
+            c = len(draft) + 1  # [pending, draft...]
+            try:
+                keep = self._acquire_draft_pages(
+                    slot, slot.position + c)
+            except PoolExhausted:
+                # transient: live sequences will free pages; the slot
+                # rides the plain step (whose own ensure/cache_full
+                # path still governs hard exhaustion)
+                continue
+            self._count("spec_drafts")
+            stat_add("serving_spec_drafts")
+            self._count("spec_tokens_proposed", len(draft))
+            stat_add("serving_spec_tokens_proposed", len(draft))
+            bucket = batcher.prompt_bucket_for(c, self.prefill_buckets)
+            prog, fetches = self._verify_prog_for(bucket)
+            chunk = np.zeros((bucket,), "int64")
+            chunk[0] = slot.tokens[-1]
+            chunk[1:c] = draft
+            fetch = [fetches["tokens"]]
+            if self.keep_logits:
+                fetch.append(fetches["logits"])
+            with telemetry.trace_span("generation/spec_verify",
+                                      parent=slot.span.context()
+                                      if slot.span is not None else None,
+                                      draft=len(draft), bucket=bucket,
+                                      slot=slot.idx):
+                outs = self._prefill_exe.run(
+                    prog,
+                    feed={"chunk_ids": chunk[None],
+                          "base": np.asarray([slot.position], "int32"),
+                          "block_table":
+                          self._slot_block_table(slot)[None],
+                          "chunk_len": np.asarray([c], "int32")},
+                    fetch_list=fetch, scope=self.scope,
+                    return_numpy=False)
+            m = np.asarray(outs[0].numpy())[0]
+            logits_arr = np.asarray(outs[1].numpy())[0] \
+                if self.keep_logits else None
+            a = 0
+            while a < len(draft) and int(draft[a]) == int(m[a]):
+                a += 1
+            t1 = time.monotonic()
+            ms = (t1 - t0) * 1e3
+            self._t_decode_total += ms
+            self._h_verify.observe(ms, trace_id=req.trace_id)
+            telemetry.histogram_observe("serving_spec_verify_ms", ms,
+                                        trace_id=req.trace_id)
+            self._count("spec_tokens_accepted", a)
+            stat_add("serving_spec_tokens_accepted", a)
+            if a < len(draft):
+                self._count("spec_rollbacks")
+                stat_add("serving_spec_rollbacks")
+            # book rows 0..a in order: row j's argmax is the token a
+            # plain step would emit after committing the chunk's first
+            # j+1 tokens — the stream (and logits) are the plain
+            # stream, several steps at once.  One clock read for the
+            # burst: the tokens genuinely became available together
+            for j in range(a + 1):
+                tok = int(m[j])
+                slot.position += 1
+                slot.steps += 1
+                slot.tokens.append(tok)
+                if logits_arr is not None:
+                    slot.logits.append(logits_arr[j])
+                self._book_token(slot, tok, t1)
+                if slot.req is None:
+                    break  # finished mid-burst (_finish freed pages)
+            if slot.req is not None:
+                self._rollback_draft_pages(
+                    slot, max(keep,
+                              -(-slot.position // self.page_tokens)))
+            served.add(slot.idx)
+        return frozenset(served)
+
+    def _decode_step(self, skip: frozenset = frozenset()):
         t0 = time.monotonic()
         kind = fault.fire("decode_step")
         fault.maybe_delay(kind)
@@ -1821,13 +2125,16 @@ class GenerationEngine:
             # the pool cannot serve even after eviction finishes
             # cache_full with everything it generated so far
             for s in list(self._decoding_slots()):
+                if s.idx in skip:
+                    continue
                 try:
                     self._ensure_pages(s, s.position + 1)
                 except PoolExhausted:
                     self._finish(s, "cache_full")
         tokens = np.zeros((self.num_slots, 1), "int64")
         positions = np.zeros((self.num_slots,), "int32")
-        active = self._decoding_slots()
+        active = [s for s in self._decoding_slots()
+                  if s.idx not in skip]
         if not active:
             return
         for s in active:
@@ -2061,6 +2368,13 @@ class GenerationEngine:
             return
         telemetry.gauge_set("serving_slot_occupancy",
                             active / self.num_slots)
+        if self.speculate:
+            with self._n_lock:
+                prop = self._n["spec_tokens_proposed"]
+                acc = self._n["spec_tokens_accepted"]
+            if prop:
+                telemetry.gauge_set("serving_spec_acceptance_rate",
+                                    acc / prop)
         if self._t_decode_total > 0:
             telemetry.gauge_set(
                 "serving_prefill_decode_ratio",
@@ -2124,6 +2438,17 @@ class GenerationEngine:
                 "prefix_hit_rate": round(
                     n["prefix_hits"] / max(n["prefills"], 1), 4),
             },
+            "speculate": None if not self.speculate else {
+                "spec_tokens": self.spec_tokens,
+                "spec_ngram": self.spec_ngram,
+                "drafts": n["spec_drafts"],
+                "tokens_proposed": n["spec_tokens_proposed"],
+                "tokens_accepted": n["spec_tokens_accepted"],
+                "rollbacks": n["spec_rollbacks"],
+                "acceptance_rate": round(
+                    n["spec_tokens_accepted"]
+                    / max(n["spec_tokens_proposed"], 1), 4),
+            },
             "mesh": None if self.mesh is None
             else _describe_mesh(self.mesh),
             "kv_shard_axis": getattr(self, "kv_shard_axis", None),
@@ -2138,6 +2463,7 @@ class GenerationEngine:
             "generate_ms": self._h_gen.summary(),
             "prefill_ms": self._h_prefill.summary(),
             "decode_step_ms": self._h_step.summary(),
+            "spec_verify_ms": self._h_verify.summary(),
             "ttft_ms": self._h_ttft.summary(),
             "inter_token_ms": self._h_itl.summary(),
         }

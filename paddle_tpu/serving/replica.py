@@ -200,6 +200,16 @@ def main(argv=None) -> int:
                          "(implied by --role prefill|decode)")
     ap.add_argument("--gen-page-tokens", type=int, default=None)
     ap.add_argument("--gen-pages", type=int, default=None)
+    ap.add_argument("--gen-speculate", action="store_true",
+                    help="enable speculative decoding on the generator "
+                         "(n-gram self-drafts verified in one chunk "
+                         "call — bit-exact vs plain decode; implies "
+                         "the paged KV cache; see README 'Speculative "
+                         "decoding').  Per-request opt-out rides the "
+                         "/generate body's 'speculate' field")
+    ap.add_argument("--gen-spec-tokens", type=int, default=None,
+                    help="max draft tokens per verify (default "
+                         "FLAGS_serving_spec_tokens)")
     args = ap.parse_args(argv)
 
     from ..flags import set_flags
@@ -223,9 +233,11 @@ def main(argv=None) -> int:
         from .generation import GenerationEngine
         role = args.role or str(flag_value("FLAGS_serving_role")
                                 or "both")
-        # specialized roles are page-block handoffs by definition:
-        # force the paged cache on even without --gen-paged
-        paged = True if (args.gen_paged or role != "both") else None
+        # specialized roles (and speculation's verify-against-pages
+        # contract) are page-block-based by definition: force the
+        # paged cache on even without --gen-paged
+        paged = True if (args.gen_paged or args.gen_speculate
+                         or role != "both") else None
         gen = GenerationEngine(
             dict(vocab_size=args.gen_vocab, hidden=args.gen_hidden,
                  num_layers=args.gen_layers, num_heads=args.gen_heads,
@@ -235,7 +247,9 @@ def main(argv=None) -> int:
             max_new_tokens=args.gen_max_new,
             queue_cap=args.queue_cap,
             deadline_ms=args.deadline_ms, role=role, paged=paged,
-            page_tokens=args.gen_page_tokens, num_pages=args.gen_pages)
+            page_tokens=args.gen_page_tokens, num_pages=args.gen_pages,
+            speculate=True if args.gen_speculate else None,
+            spec_tokens=args.gen_spec_tokens)
         engine.attach_generator(gen)
     server = serve(engine, host=args.host, port=args.port)
     server.install_sigterm()

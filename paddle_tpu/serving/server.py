@@ -491,6 +491,9 @@ class _Handler(_JsonHandler):
                 raise TypeError("'prompt' must be a list of token ids")
             mnt = doc.get("max_new_tokens")
             stream = bool(doc.get("stream"))
+            speculate = doc.get("speculate")
+            if speculate is not None and not isinstance(speculate, bool):
+                raise TypeError("'speculate' must be a boolean")
         except (KeyError, TypeError, ValueError) as e:
             return 400, {"error": "bad request",
                          "detail": f"{type(e).__name__}: {e}"}, None
@@ -503,12 +506,13 @@ class _Handler(_JsonHandler):
                                        "router owns the disaggregated "
                                        "handoff)"}, None
             return self._generate_stream(gen, prompt, mnt, hop_trace,
-                                         deadline_ms)
+                                         deadline_ms, speculate)
         t0 = time.monotonic()
         try:
             fut = self.engine.submit_generate(prompt, max_new_tokens=mnt,
                                               trace_id=hop_trace,
-                                              deadline_ms=deadline_ms)
+                                              deadline_ms=deadline_ms,
+                                              speculate=speculate)
             res = fut.result(self._wait_s(deadline_ms))
         except OverloadedError as e:
             return 503, {"error": "overloaded", "reason": e.reason,
@@ -629,7 +633,8 @@ class _Handler(_JsonHandler):
 
     def _generate_stream(self, gen, prompt, mnt,
                          hop_trace: Optional[str],
-                         deadline_ms: Optional[float]):
+                         deadline_ms: Optional[float],
+                         speculate: Optional[bool] = None):
         """``{"stream": true}`` generation: one NDJSON line per token,
         written the moment the scheduler books it (the engine's
         ``on_token`` hook feeds a handler-side queue, so a slow client
@@ -646,7 +651,8 @@ class _Handler(_JsonHandler):
             gen,
             lambda on_token: self.engine.submit_generate(
                 prompt, max_new_tokens=mnt, trace_id=hop_trace,
-                deadline_ms=deadline_ms, on_token=on_token),
+                deadline_ms=deadline_ms, on_token=on_token,
+                speculate=speculate),
             hop_trace, deadline_ms)
 
     def _adopt_stream(self, gen, submit, trace_id, deadline_ms):

@@ -340,6 +340,36 @@ def test_pair_refcount_detected_and_clean_twin(tmp_path):
     assert r.violations == []
 
 
+def test_pair_draft_detected_and_clean_twin(tmp_path):
+    bad = """
+    class Speculator:
+        def round(self, slot):
+            keep = self._acquire_draft_pages(slot, 4)
+            return keep                 # no rollback/release path
+    """
+    r = run_on(tmp_path, bad, ["resource-pairing"])
+    assert rules_of(r) == ["pair-draft"]
+    assert r.violations[0].key.endswith(":draft-pages")
+
+    good = """
+    class Speculator:
+        def round(self, slot):
+            keep = self._acquire_draft_pages(slot, 4)
+            self._rollback_draft_pages(slot, keep)
+
+        def fail_path(self, slot):
+            self._acquire_draft_pages(slot, 4)
+            self._release_pages(slot)   # whole-slot release also pairs
+
+        def _acquire_draft_pages(self, slot, n):
+            # the helper itself is exempt: it rolls back internally
+            # on the exhaustion path before re-raising
+            return len(slot.pages)
+    """
+    r = run_on(tmp_path, good, ["resource-pairing"])
+    assert r.violations == []
+
+
 # ---------------------------------------------------------------------------
 # donation-safety
 # ---------------------------------------------------------------------------
@@ -371,6 +401,51 @@ def test_donation_use_after_alias_detected_and_clean_twin(tmp_path):
         cache_k, cache_v = (layers.kv_cache_write(cache_k, k, pos),
                             layers.kv_cache_write(cache_v, v, pos))
         return layers.matmul(cache_k, cache_v)
+    """
+    r = run_on(tmp_path, good, ["donation-safety"])
+    assert r.violations == []
+
+
+def test_donation_jit_callable_detected_and_clean_twin(tmp_path):
+    bad = """
+    import jax
+
+    class Engine:
+        def build(self):
+            self._adopt_scatter = jax.jit(
+                lambda pool, idx, rows: pool.at[idx].set(rows),
+                donate_argnums=(0,))
+
+        def adopt(self, pool, idx, rows):
+            self._adopt_scatter(pool, idx, rows)
+            return pool.sum()           # reads the donated buffer
+    """
+    r = run_on(tmp_path, bad, ["donation-safety"])
+    assert rules_of(r) == ["donation-use-after-alias"]
+    assert r.violations[0].key.endswith(":pool")
+
+    good = """
+    import jax
+
+    class Engine:
+        def build(self, donate_state):
+            self._adopt_scatter = jax.jit(
+                lambda pool, idx, rows: pool.at[idx].set(rows),
+                donate_argnums=(0,) if donate_state else ())
+
+        def adopt(self, pool, idx, rows):
+            pool = self._adopt_scatter(pool, idx, rows)
+            return pool.sum()           # rebound same statement
+
+        def multiline(self, pool, idx,
+                      rows):
+            out = self._adopt_scatter(pool,
+                                      idx, rows)
+            return out                  # donated name never read after
+
+        def plain(self, pool):
+            self._undonated(pool)
+            return pool.sum()           # not a donating callable
     """
     r = run_on(tmp_path, good, ["donation-safety"])
     assert r.violations == []

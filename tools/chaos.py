@@ -59,6 +59,22 @@ poison_paged   every Nth *generation prompt* carries a     prefill-time poison
                                                            bit-exact and later
                                                            borrowers still hit
                                                            the prefix index
+spec_storm     poisoned prompts + a ``decode_step:fail``   speculation never
+               fault detonated MID-VERIFY while            widens the blast
+               concurrent slots speculate over a shared    radius: fault victims
+               cached prefix (in-process paged             fail inside their own
+               GenerationEngine, FLAGS_serving_speculate   window (injected),
+               on)                                         surviving clean
+                                                           streams stay
+                                                           bit-exact vs the
+                                                           speculation-on
+                                                           reference, rollback
+                                                           counters balance
+                                                           (accepted <=
+                                                           proposed, rollbacks
+                                                           <= drafts), and the
+                                                           page pool drains to
+                                                           ZERO live pages
 disagg_crash   role-split generation fleet (2 prefill +    router affinity
                2 decode) under MIXED long-prompt/short-    containment: requests
                chat /generate load; SIGKILL a prefill      on the dead replica
@@ -133,7 +149,8 @@ POISON = 1e30
 POISON_TOKEN = 7
 
 DEFAULT_SCENARIOS = ("baseline", "crash", "hang", "slow", "poison",
-                     "poison_paged", "disagg_crash", "hot_swap")
+                     "poison_paged", "spec_storm", "disagg_crash",
+                     "hot_swap")
 
 # burn-rate scaling for the chaos run: scenario durations are seconds,
 # not SRE hours, so the router's alert windows shrink to fractions of
@@ -600,6 +617,177 @@ def _scenario_poison_paged(cfg: dict) -> dict:
         if rep["poisoned"] == 0:
             error = "no poisoned prompts were submitted"
         elif rep["poison_leaks"] == 0 and rep["injected_failures"] == 0:
+            error = "no poisoned prompt reached the prefill check"
+    if error is not None:
+        rep["error"] = error
+    rep["_records"] = records
+    return rep
+
+
+def _scenario_spec_storm(cfg: dict) -> dict:
+    """Speculative-decoding storm, in-process (extends the
+    ``poison_paged`` family): concurrent speculating slots share a
+    cached prefix while every Nth prompt carries the poison token AND
+    a ``decode_step:fail`` fault detonates MID-VERIFY (the verify
+    chunk fires the same decode_step fault site as the plain step).
+
+    The contract under test: speculation never widens the blast
+    radius.  A mid-verify fault fails exactly the requests active at
+    that instant (injected, window = each victim's own lifetime), a
+    poisoned prompt fails exactly itself, and every clean stream that
+    COMPLETES is bit-exact against the speculation-on poison-free
+    reference — drift is collateral.  Afterward the rollback
+    accounting must balance (accepted <= proposed, rollbacks <=
+    drafts) and the page pool must drain to ZERO live pages once the
+    prefix index is flushed: rejected drafts and fault-killed slots
+    alike return every provisionally-held page."""
+    import paddle_tpu as pt
+    from paddle_tpu import fault as fault_mod
+    from paddle_tpu.serving import GenerationEngine
+
+    model = dict(vocab_size=64, hidden=32, num_layers=2, num_heads=4,
+                 num_kv_heads=2, intermediate=64)
+    eng_kw = dict(num_slots=4, max_seq_len=64, max_new_tokens=8,
+                  attn_impl="xla", seed=0, queue_cap=256,
+                  deadline_ms=600000.0, paged=True, page_tokens=8,
+                  prefill_chunk=0, prefix_reuse=True,
+                  speculate=True, spec_tokens=4, spec_ngram=3)
+    poison_every = max(2, int(cfg.get("poison_every", 5)))
+    # periodic prompts so the n-gram drafter fires every round: the
+    # suffix trigram always has an earlier occurrence in the header,
+    # and the distinct repetitive tails keep the streams per-request
+    header = [11, 23, 42, 9] * 8
+    tails = [[20 + i, 33, 20 + i, 33, 20 + i, 33] for i in range(9)]
+    n_steps = 6
+    error = None
+    notes: Dict[str, object] = {}
+    records: List[dict] = []
+    windows: List[tuple] = []
+
+    # speculation-on reference streams run on the SAME engine before
+    # the poison flag and the fault injector arm — bit-exactness of
+    # spec-vs-plain is the tentpole's own gate; here the reference
+    # fixes the target the storm's survivors must still hit
+    old_flag = pt.get_flags("FLAGS_serving_poison_value")[
+        "FLAGS_serving_poison_value"]
+    eng = GenerationEngine(model, **eng_kw)
+    try:
+        want = [eng.generate(header + t, n_steps)["tokens"]
+                for t in tails]
+        sp0 = eng.stats()["speculate"]
+        pt.set_flags({"FLAGS_serving_poison_value":
+                      str(float(POISON_TOKEN))})
+        # the 9th decode_step hit lands a few scheduler iterations in,
+        # with several speculating slots in flight; one-shot (not
+        # sticky) so the post-storm borrower decodes fault-free
+        fault_mod.configure("decode_step:fail@9")
+
+        def run_one(i, poisoned):
+            prompt = header + tails[i]
+            if poisoned:
+                prompt = prompt[:-1] + [POISON_TOKEN]
+            t0 = time.monotonic()
+            return i, poisoned, t0, eng.submit(prompt, n_steps)
+
+        futs = [run_one(0, False)] \
+            + [run_one(i, i % poison_every == 0)
+               for i in range(1, len(tails) - 1)]
+        victims = 0
+        poison_hits = 0
+        for i, poisoned, t0, fut in futs:
+            rec = {"t0": t0, "poison": poisoned, "status": None}
+            try:
+                res = fut.result(120)
+                # a clean stream that COMPLETED but drifted means the
+                # storm corrupted shared state: collateral (no window
+                # covers a successful-but-wrong answer)
+                rec["outcome"] = "ok" if (poisoned
+                                          or res["tokens"] == want[i]) \
+                    else "failed"
+                if not poisoned and res["tokens"] != want[i]:
+                    notes.setdefault("corrupted", []).append(i)
+            except Exception as e:  # noqa: BLE001 — taxonomy below
+                rec["outcome"] = "failed"
+                rec["t1"] = time.monotonic()
+                if "injected decode_step" in str(e):
+                    # mid-verify fault victim: injected by
+                    # construction, so its own lifetime is the window
+                    victims += 1
+                    windows.append((t0, rec["t1"]))
+                elif poisoned:
+                    poison_hits += 1
+            rec.setdefault("t1", time.monotonic())
+            rec["ms"] = (rec["t1"] - rec["t0"]) * 1e3
+            if rec["outcome"] == "failed" and rec["poison"]:
+                poison_hits = max(poison_hits, 1)
+            records.append(rec)
+
+        # disarm before the post-storm borrower: it must decode (and
+        # speculate) clean, bit-exact, after the fault flushed the
+        # prefix index and rolled every victim's pages back
+        fault_mod.reset()
+        last = len(tails) - 1
+        t0 = time.monotonic()
+        res = eng.generate(header + tails[last], n_steps)
+        records.append({"t0": t0, "t1": time.monotonic(),
+                        "ms": (time.monotonic() - t0) * 1e3,
+                        "status": None, "poison": False,
+                        "outcome": "ok" if res["tokens"] == want[last]
+                        else "failed"})
+        st = eng.stats()
+        sp = st["speculate"]
+        notes["spec"] = {k: sp[k] for k in
+                         ("drafts", "tokens_proposed",
+                          "tokens_accepted", "rollbacks",
+                          "acceptance_rate")}
+        notes["fault_victims"] = victims
+        # drain accounting: every request resolved, so only the
+        # prefix index may legitimately hold pages; flush it and the
+        # pool must hit zero — anything left is a leaked draft page
+        deadline = time.monotonic() + 5.0
+        live = st["paged"]["pages_live"]
+        while time.monotonic() < deadline:
+            time.sleep(0.05)
+            now_live = eng.stats()["paged"]["pages_live"]
+            if now_live == live:
+                break
+            live = now_live
+        eng._prefix.flush()
+        leaked = eng.stats()["paged"]["pages_live"]
+        notes["leaked_pages"] = leaked
+        if res["tokens"] != want[last]:
+            error = "post-storm borrower stream drifted (rollback " \
+                    "left corrupt state behind?)"
+        elif notes.get("corrupted"):
+            error = f"clean stream(s) {notes['corrupted']} drifted " \
+                    f"from the speculation-on reference"
+        elif victims == 0:
+            error = "decode_step fault never fired mid-verify"
+        elif sp["drafts"] <= sp0["drafts"]:
+            error = "no drafts proposed during the storm " \
+                    "(speculation never exercised)"
+        elif sp["tokens_accepted"] > sp["tokens_proposed"]:
+            error = f"accepted {sp['tokens_accepted']} > proposed " \
+                    f"{sp['tokens_proposed']} (counter imbalance)"
+        elif sp["rollbacks"] > sp["drafts"]:
+            error = f"rollbacks {sp['rollbacks']} > drafts " \
+                    f"{sp['drafts']} (counter imbalance)"
+        elif leaked > 0:
+            error = f"{leaked} page(s) still live after drain " \
+                    f"(rejected-draft rollback leaked)"
+    finally:
+        fault_mod.reset()
+        pt.set_flags({"FLAGS_serving_poison_value": old_flag})
+        eng.close()
+
+    rep = classify(records, windows)
+    rep["scenario"] = "spec_storm"
+    rep["notes"] = notes
+    rep["leaked_pages"] = notes.get("leaked_pages")
+    if error is None:
+        if rep["poisoned"] == 0:
+            error = "no poisoned prompts were submitted"
+        elif rep["poison_leaks"] == 0 and poison_hits == 0:
             error = "no poisoned prompt reached the prefill check"
     if error is not None:
         rep["error"] = error
@@ -1159,6 +1347,12 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
                 # fleet traffic, but runs inside the same harness so
                 # its counters fold into the same hard-zero contract
                 rep = _scenario_poison_paged(cfg)
+            elif name == "spec_storm":
+                # speculative-decoding storm: poison + mid-verify
+                # decode_step faults against concurrent speculating
+                # slots; in-process like poison_paged so the rollback
+                # and leak counters fold into the same hard-zero gates
+                rep = _scenario_spec_storm(cfg)
             elif name == "disagg_crash":
                 # role-split generation fleet with its own router —
                 # spawned fresh so the kills cannot bleed into the
@@ -1261,7 +1455,8 @@ def main(argv=None) -> int:
     ap.add_argument("--scenarios",
                     default=",".join(DEFAULT_SCENARIOS),
                     help="comma-separated subset of "
-                         "crash,hang,slow,poison,poison_paged")
+                         "crash,hang,slow,poison,poison_paged,"
+                         "spec_storm,disagg_crash,hot_swap")
     ap.add_argument("--availability-pct", type=float, default=99.0)
     ap.add_argument("--feat", type=int, default=8)
     ap.add_argument("--hidden", type=int, default=32)

@@ -27,6 +27,14 @@ Three rules:
     Class-level balance: a class that increfs/allocs must decref
     *somewhere* (a class that only ever takes references cannot give
     them back).
+
+``pair-draft``
+    Speculative-decode draft-page discipline: a function that calls
+    ``_acquire_draft_pages`` (provisional KV pages for an unverified
+    draft) must also call ``_rollback_draft_pages`` or
+    ``_release_pages`` in the same function — a rejected draft whose
+    pages are never rolled back (or a fault path that skips the
+    slot-release) strands refcounts the pool can only leak.
 """
 from __future__ import annotations
 
@@ -132,9 +140,11 @@ def _name_escapes(fn: ast.AST, name: str, after_line: int,
 
 
 @register_pass(
-    "resource-pairing", ("pair-span", "pair-acquire", "pair-refcount"),
+    "resource-pairing", ("pair-span", "pair-acquire", "pair-refcount",
+                         "pair-draft"),
     doc="span_begin/span_end, lock acquire/release (exception-safe), "
-        "and PagePool alloc/incref/decref pairing")
+        "PagePool alloc/incref/decref pairing, and speculative "
+        "draft-page acquire/rollback pairing")
 def run(files: List[SourceFile]) -> List[Violation]:
     out: List[Violation] = []
     for sf in files:
@@ -145,7 +155,8 @@ def run(files: List[SourceFile]) -> List[Violation]:
         has_span = "span_begin" in sf.text
         has_acq = ".acquire(" in sf.text
         has_ref = "incref" in sf.text or ".alloc(" in sf.text
-        if not (has_span or has_acq or has_ref):
+        has_draft = "_acquire_draft_pages" in sf.text
+        if not (has_span or has_acq or has_ref or has_draft):
             continue
         for qn, fn in _functions(sf):
             if has_span:
@@ -154,6 +165,8 @@ def run(files: List[SourceFile]) -> List[Violation]:
                 out += _check_acquires(sf, qn, fn)
             if has_ref:
                 out += _check_refcounts_fn(sf, qn, fn)
+            if has_draft:
+                out += _check_draft_pages(sf, qn, fn)
         if has_ref:
             out += _check_refcounts_class(sf)
     return out
@@ -230,6 +243,37 @@ def _check_acquires(sf: SourceFile, qn: str, fn: ast.AST) -> List[Violation]:
                 f"{recv}.release() is not on the exception path (no "
                 f"finally) — an exception after acquire leaves "
                 f"{recv} held forever; use `with` or try/finally"))
+    return out
+
+
+# -- pair-draft --------------------------------------------------------------
+
+def _check_draft_pages(sf: SourceFile, qn: str,
+                       fn: ast.AST) -> List[Violation]:
+    """A caller of _acquire_draft_pages holds provisional page refs
+    for a draft that may be rejected; without a _rollback_draft_pages
+    (or a whole-slot _release_pages) in the same function there is no
+    path that gives the rejected rows' pages back."""
+    out: List[Violation] = []
+    acquire_line = None
+    has_rollback = False
+    for n in _own_nodes(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        name = _func_name(n)
+        if name == "_acquire_draft_pages":
+            acquire_line = acquire_line or n.lineno
+        elif name in ("_rollback_draft_pages", "_release_pages"):
+            has_rollback = True
+    if acquire_line is not None and not has_rollback \
+            and getattr(fn, "name", "") != "_acquire_draft_pages":
+        # the acquire helper itself rolls back internally on the
+        # exhaustion path; every OTHER caller owes an explicit pair
+        out.append(Violation(
+            "pair-draft", sf.path, acquire_line, f"{qn}:draft-pages",
+            "_acquire_draft_pages() without _rollback_draft_pages() "
+            "or _release_pages() in this function — rejected-draft "
+            "pages have no give-back path and leak refcounts"))
     return out
 
 

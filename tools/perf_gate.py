@@ -263,6 +263,65 @@ def compare_leg(name: str, new: dict, base: dict,
                           "p99 ratio (vacuous A/B: the decode grid "
                           "never stepped)")
         return res
+    # speculative-decode hard rules, also checked before every skip:
+    # core contention can slow the verify chunk (the tokens/sec ratio
+    # honestly sits under 1.0 on core-bound hosts — that is what the
+    # anomaly flag and the baseline-armed collapse rule are for), but
+    # it can never leak a page, unbalance the rollback counters, or
+    # lower greedy-argmax acceptance on a deterministic workload
+    if "spec_tokens_proposed" in new:
+        sl = new.get("leaked_pages")
+        if sl is None:
+            res.update(status="regression",
+                       reason="spec leg measured no leaked-page count "
+                              "(vacuous drain: the pool was never "
+                              "checked after rejected drafts)")
+            return res
+        if sl > 0:
+            res.update(status="regression",
+                       reason=f"spec decode left {sl} KV page(s) live "
+                              f"after drain (rejected-draft rollback "
+                              f"refcount leak)")
+            return res
+        prop = new.get("spec_tokens_proposed")
+        acc = new.get("spec_tokens_accepted")
+        drafts = new.get("spec_drafts")
+        rb = new.get("spec_rollbacks")
+        if None in (prop, acc, drafts, rb):
+            res.update(status="regression",
+                       reason="spec leg is missing draft/accept/"
+                              "rollback counters (vacuous speculation "
+                              "window)")
+            return res
+        if acc > prop:
+            res.update(status="regression",
+                       reason=f"spec accepted {acc} draft tokens out "
+                              f"of {prop} proposed — the acceptance "
+                              f"bookkeeping overcounts")
+            return res
+        if rb > drafts:
+            res.update(status="regression",
+                       reason=f"spec rolled back {rb} drafts but only "
+                              f"{drafts} were issued — the rollback "
+                              f"bookkeeping overcounts")
+            return res
+        ar = new.get("acceptance_rate")
+        ar_floor = new.get("acceptance_floor")
+        if ar_floor is not None:
+            if ar is None:
+                res.update(status="regression",
+                           reason="spec leg declares an acceptance "
+                                  "floor but measured no acceptance "
+                                  "rate (vacuous: the drafter never "
+                                  "fired)")
+                return res
+            if ar < float(ar_floor):
+                res.update(status="regression",
+                           reason=f"spec acceptance rate {ar} under "
+                                  f"the {ar_floor} floor on the "
+                                  f"repetition-heavy workload (the "
+                                  f"drafter or verifier broke)")
+                return res
     nk, bk = new.get("device_kind"), base.get("device_kind")
     if nk is not None and bk is not None and nk != bk:
         res.update(status="skipped",
@@ -382,6 +441,20 @@ def compare_leg(name: str, new: dict, base: dict,
                    reason=f"prefix hit rate {phr} under the "
                           f"{phr_floor} floor on the shared-prompt "
                           f"workload")
+    # spec-decode extra: once a baseline proved speculative decode
+    # beats the plain grid step on a device kind, a fresh ratio under
+    # 1.0 means the speedup collapsed (verify got slower than the K+1
+    # steps it replaces) even when raw tokens/sec keeps up — arms only
+    # where the baseline had the win, like paged_vs_dense_tokens
+    # (core-bound CPU smoke captures honestly sit under 1.0)
+    svp_new = new.get("spec_vs_plain_tokens")
+    svp_base = base.get("spec_vs_plain_tokens")
+    if res["status"] == "ok" and svp_new is not None \
+            and svp_base is not None and svp_new < 1.0 <= svp_base:
+        res.update(status="regression",
+                   reason=f"spec_vs_plain_tokens collapsed to "
+                          f"{svp_new} (baseline {svp_base}: "
+                          f"speculation beat the plain grid step)")
     # disagg-leg extras: the disaggregated pipeline's reason to exist
     # is decode-step p99 under the mixed workload.  (a) A leg that
     # carries the key but measured nothing is vacuous — the A/B's
@@ -657,6 +730,104 @@ def run_smoke() -> int:
     check("disagg vacuous-None fails", not r["ok"] and any(
         x["status"] == "regression"
         and "vacuous A/B" in x.get("reason", "") for x in r["legs"]))
+
+    # spec-decode leg (synthetic capable-host fixture, like the
+    # sharded one: core-bound CPU captures flag the speedup anomalous,
+    # so the >1.0 ratio is proven on fixture numbers): generic noise
+    # gate + the acceptance floor / rollback balance / leaked pages
+    # hard rules (which no anomaly flag shields) + the
+    # spec-vs-plain collapse rule (which arms only where the baseline
+    # proved the win)
+    spec_leg = {
+        "metric": "llama_spec_decode_tokens_per_sec_per_chip",
+        "value": 2600.0, "unit": "tokens/sec/chip",
+        "device_kind": "cpu",
+        "stats": {"rounds": 3, "median": 2600.0, "p10": 2450.0,
+                  "p90": 2750.0, "min": 2400.0, "max": 2800.0},
+        "plain_tokens_per_sec": 1900.0,
+        "spec_vs_plain_tokens": 1.37,
+        "acceptance_rate": 0.62, "acceptance_floor": 0.3,
+        "spec_drafts": 400, "spec_tokens_proposed": 1500,
+        "spec_tokens_accepted": 930, "spec_rollbacks": 210,
+        "leaked_pages": 0,
+    }
+    with_spec = json.loads(json.dumps(latest))
+    with_spec.setdefault("legs", {})["llama_spec_decode"] = spec_leg
+    r = compare_bench(with_spec, docs + [with_spec])
+    check("spec self-compare passes", r["ok"],
+          json.dumps([x for x in r["legs"]
+                      if x["status"] == "regression"]))
+    r = compare_bench(_degrade(with_spec, 0.70), docs + [with_spec])
+    check("spec 30%-degraded fails", not r["ok"])
+    low_accept = json.loads(json.dumps(with_spec))
+    low_accept["legs"]["llama_spec_decode"]["acceptance_rate"] = 0.05
+    # an anomaly flag must NOT shield a dead drafter
+    low_accept["legs"]["llama_spec_decode"]["anomaly"] = \
+        "core-bound host"
+    r = compare_bench(low_accept, docs + [with_spec])
+    check("spec acceptance-floor breach fails even when anomalous",
+          not r["ok"] and any(
+              x["status"] == "regression"
+              and "acceptance rate" in x.get("reason", "")
+              for x in r["legs"]))
+    vac_accept = json.loads(json.dumps(with_spec))
+    vac_accept["legs"]["llama_spec_decode"]["acceptance_rate"] = None
+    r = compare_bench(vac_accept, docs + [with_spec])
+    check("spec vacuous-acceptance fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "vacuous" in x.get("reason", "") for x in r["legs"]))
+    spec_collapse = json.loads(json.dumps(with_spec))
+    spec_collapse["legs"]["llama_spec_decode"]["spec_vs_plain_tokens"] \
+        = 0.8
+    r = compare_bench(spec_collapse, docs + [with_spec])
+    check("spec slower-than-plain collapse fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "spec_vs_plain_tokens" in x.get("reason", "")
+        for x in r["legs"]))
+    # ...but a sub-1.0 ratio must NOT flap when the baseline never
+    # proved the win (core-bound CPU smoke captures)
+    never_won_s = json.loads(json.dumps(with_spec))
+    never_won_s["legs"]["llama_spec_decode"]["spec_vs_plain_tokens"] \
+        = 0.9
+    r = compare_bench(spec_collapse, docs + [never_won_s])
+    check("spec sub-1.0 vs sub-1.0 baseline passes", r["ok"],
+          json.dumps([x for x in r["legs"]
+                      if x["status"] == "regression"]))
+    imbalance = json.loads(json.dumps(with_spec))
+    imbalance["legs"]["llama_spec_decode"]["spec_tokens_accepted"] \
+        = 1600
+    imbalance["legs"]["llama_spec_decode"]["anomaly"] = \
+        "core-bound host"
+    r = compare_bench(imbalance, docs + [with_spec])
+    check("spec accept>propose imbalance fails even when anomalous",
+          not r["ok"] and any(
+              x["status"] == "regression"
+              and "overcounts" in x.get("reason", "")
+              for x in r["legs"]))
+    rb_imbalance = json.loads(json.dumps(with_spec))
+    rb_imbalance["legs"]["llama_spec_decode"]["spec_rollbacks"] = 500
+    r = compare_bench(rb_imbalance, docs + [with_spec])
+    check("spec rollback>draft imbalance fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "rollback bookkeeping" in x.get("reason", "")
+        for x in r["legs"]))
+    page_leak_s = json.loads(json.dumps(with_spec))
+    page_leak_s["legs"]["llama_spec_decode"]["leaked_pages"] = 2
+    page_leak_s["legs"]["llama_spec_decode"]["anomaly"] = \
+        "core-bound host"
+    r = compare_bench(page_leak_s, docs + [with_spec])
+    check("spec leaked-pages fails even when anomalous",
+          not r["ok"] and any(
+              x["status"] == "regression"
+              and "refcount leak" in x.get("reason", "")
+              for x in r["legs"]))
+    vac_leak = json.loads(json.dumps(with_spec))
+    vac_leak["legs"]["llama_spec_decode"]["leaked_pages"] = None
+    r = compare_bench(vac_leak, docs + [with_spec])
+    check("spec vacuous-leak-count fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "vacuous drain" in x.get("reason", "")
+        for x in r["legs"]))
 
     # sharded-serving leg (synthetic capable-host fixture: the 2-core
     # CI sim flags its own captures anomalous, so the >=2x dp contract

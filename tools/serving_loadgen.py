@@ -64,7 +64,10 @@ on the ``--qps`` clock for latency/shed behavior at a target rate.
 ``--gen-static`` schedules FIFO head-run (batch drain) instead of
 continuous slot reclaim — the A/B the bench leg publishes.
 ``--gen-paged`` (with ``--gen-page-tokens``/``--gen-pages``/
-``--gen-prefill-chunk``) swaps in the block-paged KV cache, and
+``--gen-prefill-chunk``) swaps in the block-paged KV cache,
+``--gen-speculate``/``--gen-spec-tokens`` turn on speculative
+decoding (the report embeds the measured acceptance rate;
+``--slo-accept-rate`` floors it — unmeasured is a violation), and
 ``--gen-prompt-dist shared-prefix --gen-prefix-tokens N`` makes every
 prompt one fixed N-token header + a random tail — the chat workload
 where the paged engine's prefix index skips the header's prefill.
@@ -545,6 +548,12 @@ def _gen_report(mode: str, n: int, ok: int, shed: int, failed: int,
     rep["generated_tokens"] = tokens
     rep["tokens_per_sec"] = round(tokens / wall_s, 2) if wall_s > 0 \
         else 0.0
+    spec = (rep.get("engine") or {}).get("speculate") \
+        if isinstance(rep.get("engine"), dict) else None
+    if isinstance(spec, dict):
+        # measured acceptance rate at report level, same spot the HTTP
+        # loop embeds it from /statusz — check_slo's accept_rate input
+        rep["spec_acceptance_rate"] = spec.get("acceptance_rate")
     if ttft_ms is not None:
         # CLIENT-side time-to-first-token: submit (or POST) instant to
         # the first token's arrival at the caller — queue wait,
@@ -1001,6 +1010,11 @@ def run_closed_loop_generate_http(base_url: str, make_prompt,
         paged = gen_stats.get("paged")
         if isinstance(paged, dict):
             rep["prefix_hit_rate"] = paged.get("prefix_hit_rate")
+        spec = gen_stats.get("speculate")
+        if isinstance(spec, dict):
+            # live acceptance rate from /statusz, like prefix_hit_rate
+            # — the measured-or-violation input to check_slo
+            rep["spec_acceptance_rate"] = spec.get("acceptance_rate")
     return rep
 
 
@@ -1083,7 +1097,8 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
               fail_degraded: bool = False,
               ttft_ms: Optional[float] = None,
               itl_ms: Optional[float] = None,
-              expect_version: Optional[int] = None) -> dict:
+              expect_version: Optional[int] = None,
+              accept_rate: Optional[float] = None) -> dict:
     """Evaluate the SLO against one report (recursing into the nested
     closed/open halves of ``--mode both``).  Returns
     ``{"p99_ms_limit", "shed_pct_limit", "violations": [...], "ok"}``;
@@ -1103,7 +1118,11 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
     post-rollout check: a stale version answering means a replica was
     skipped or silently reverted); a report that never observed any
     version against the bound is again a violation, not a vacuous
-    pass."""
+    pass.  ``accept_rate`` floors the speculative-decoding acceptance
+    rate the report embedded from the engine's live stats
+    (``spec_acceptance_rate``); a bound given against a report that
+    never measured it (speculation off, or a server without the
+    stats block) is a violation — never a vacuous pass."""
     violations = []
 
     def _versions(rep: dict, label: str):
@@ -1175,6 +1194,19 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
             elif p99 > bound:
                 violations.append(f"{label}: {label_} p99 {p99}ms > "
                                   f"SLO {bound}ms")
+        if accept_rate is not None:
+            rate = rep.get("spec_acceptance_rate")
+            if rate is None:
+                if "latency_ms" in rep:  # a leaf report, not "both"
+                    violations.append(
+                        f"{label}: --slo-accept-rate {accept_rate} "
+                        f"given but no measured acceptance rate in "
+                        f"the report (speculation off, or the server "
+                        f"exposes no speculate stats block)")
+            elif rate < accept_rate:
+                violations.append(
+                    f"{label}: spec acceptance rate {rate} < SLO "
+                    f"floor {accept_rate}")
         _versions(rep, label)
         # shaped-traffic runs: the SLO binds in EVERY phase — a crest
         # that sheds half its load must not pass on the run's average
@@ -1209,6 +1241,8 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
         out["itl_ms_limit"] = itl_ms
     if expect_version is not None:
         out["expect_version"] = expect_version
+    if accept_rate is not None:
+        out["accept_rate_limit"] = accept_rate
     if fail_degraded:
         out["fail_degraded"] = True
     return out
@@ -1356,6 +1390,15 @@ def main(argv=None) -> int:
                     help="paged: chunked-prefill slice size (0 = "
                          "whole-prompt prefill; default "
                          "FLAGS_serving_prefill_chunk)")
+    ap.add_argument("--gen-speculate", action="store_true",
+                    help="speculative decoding on the in-process "
+                         "engine (n-gram self-drafts, one-chunk "
+                         "verify, bit-exact acceptance; implies "
+                         "--gen-paged) — the report embeds the "
+                         "measured acceptance rate")
+    ap.add_argument("--gen-spec-tokens", type=int, default=None,
+                    help="speculative: max draft tokens per verify "
+                         "(default FLAGS_serving_spec_tokens)")
     ap.add_argument("--out", help="also write the JSON report here")
     ap.add_argument("--slo-p99-ms", type=float, default=None,
                     help="assert p99 latency <= this (ms); violation "
@@ -1377,6 +1420,12 @@ def main(argv=None) -> int:
                          "/generate contract and record each token's "
                          "client-side arrival (enables ttft_ms / "
                          "inter_token_ms report blocks over HTTP)")
+    ap.add_argument("--slo-accept-rate", type=float, default=None,
+                    help="assert the speculative-decoding acceptance "
+                         "rate >= this floor (0..1), read from the "
+                         "report's embedded engine stats; a run with "
+                         "no measured acceptance rate (speculation "
+                         "off) violates too, never a vacuous pass")
     ap.add_argument("--expect-version", type=int, default=None,
                     help="assert every completed request carried this "
                          "weights_version response header (the post-"
@@ -1425,12 +1474,14 @@ def main(argv=None) -> int:
         if args.slo_p99_ms is not None or args.slo_shed_pct is not None \
                 or args.slo_ttft_ms is not None \
                 or args.slo_itl_ms is not None or args.sharded \
-                or args.expect_version is not None:
+                or args.expect_version is not None \
+                or args.slo_accept_rate is not None:
             slo = check_slo(report, args.slo_p99_ms, args.slo_shed_pct,
                             fail_degraded=args.sharded,
                             ttft_ms=args.slo_ttft_ms,
                             itl_ms=args.slo_itl_ms,
-                            expect_version=args.expect_version)
+                            expect_version=args.expect_version,
+                            accept_rate=args.slo_accept_rate)
             report["slo"] = slo
             if not slo["ok"]:
                 for v in slo["violations"]:
@@ -1495,11 +1546,16 @@ def main(argv=None) -> int:
                      num_kv_heads=args.gen_kv_heads,
                      intermediate=args.gen_intermediate)
         paged_kw = {}
-        if args.gen_paged:
+        if args.gen_paged or args.gen_speculate:
+            # speculation verifies against the slot's pages: it
+            # implies the paged cache
             paged_kw = dict(paged=True,
                             page_tokens=args.gen_page_tokens,
                             num_pages=args.gen_pages,
                             prefill_chunk=args.gen_prefill_chunk)
+        if args.gen_speculate:
+            paged_kw.update(speculate=True,
+                            spec_tokens=args.gen_spec_tokens)
         gen = GenerationEngine(
             model, num_slots=args.gen_slots, max_seq_len=args.gen_max_seq,
             max_new_tokens=args.gen_out_max,
