@@ -39,6 +39,7 @@ the ones wired in-tree:
     replica_health serving/server.py /healthz        fail | delay:ms | hang
     router_forward serving/router.py route           fail | delay:ms | hang
     weight_swap    inference.py swap commit          fail | delay:ms
+    blackbox_dump  blackbox.py postmortem write      raise
     =============  ================================  ===================
 
 Every fired fault bumps ``faults_injected`` plus a per-site/kind

@@ -433,6 +433,23 @@ register_flag("FLAGS_canary_soak_s", 60.0,
               "this long without a per-version burn-rate alert (or a "
               "canary replica crash) promotes to the rest of the "
               "fleet; sustained burn before then auto-reverts")
+register_flag("FLAGS_blackbox", True,
+              "black-box flight recorder (paddle_tpu/blackbox.py): "
+              "bounded in-memory rings of recent log events, metric "
+              "snapshots, and per-request last words, dumped to "
+              "<FLAGS_metrics_dir>/postmortem/<pid>-<reason>.json on "
+              "fatal signals, uncaught scheduler exceptions, and "
+              "explicit request.  0 = zero per-request work (one dict "
+              "lookup, nothing recorded, no dumps); FLAGS_telemetry=0 "
+              "disables it too")
+register_flag("FLAGS_blackbox_events", 256,
+              "flight recorder: capacity of the last-K event ring "
+              "(mirrored telemetry log_event records); oldest drop "
+              "first")
+register_flag("FLAGS_blackbox_requests", 64,
+              "flight recorder: max in-flight request last-words "
+              "entries held at once; admissions past the cap are "
+              "not recorded (counted in the ring's dropped field)")
 register_flag("FLAGS_serving_check_outputs", False,
               "serving engine: reject batches whose outputs contain "
               "non-finite values (RequestFailed for the batch's rows) "

@@ -115,6 +115,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from .. import blackbox
 from .. import fault
 from .. import observatory
 from .. import telemetry
@@ -221,7 +222,7 @@ class ServingFuture:
 class _Request:
     __slots__ = ("arrays", "rows", "sig", "future", "t_submit",
                  "t_picked", "t_deadline", "trace_id", "sampled",
-                 "root", "spans")
+                 "root", "spans", "bb")
 
     def __init__(self, arrays: List[np.ndarray]):
         self.arrays = arrays
@@ -238,6 +239,9 @@ class _Request:
         self.sampled = False
         self.root = None
         self.spans: List = []
+        # flight-recorder last-words token (None when blackbox is off
+        # or the in-flight cap is reached)
+        self.bb: Optional[int] = None
 
 
 class ServingEngine:
@@ -586,6 +590,8 @@ class ServingEngine:
             budget_s = min(budget_s, float(deadline_ms) / 1e3)
         req.t_deadline = req.t_submit + budget_s
         admit = self._trace_begin(req, trace_id=trace_id)
+        req.bb = blackbox.request_begin(req.trace_id, "predict",
+                                        rows=req.rows)
         with self._cv:
             if self._draining:
                 raise self._submit_shed(req, admit, "draining")
@@ -680,6 +686,11 @@ class ServingEngine:
         """Build the request's trace record, feed the /tracez store
         (recent ring if sampled; slowest-N tail regardless), and return
         it.  Called after the request's spans are closed."""
+        if req.bb is not None:
+            # the request responded (ok, failed, or shed) — its last
+            # words leave the flight recorder with it
+            blackbox.request_end(req.bb)
+            req.bb = None
         if req.trace_id is None:
             return None
         now = time.monotonic()
@@ -1009,8 +1020,12 @@ class ServingEngine:
         if telemetry.enabled():
             self._g_depth.set(depth)  # dequeue-time refresh
         now = time.monotonic()
+        batch_rows = sum(r.rows for r in batch)
         for req in batch:
             req.t_picked = now
+            if req.bb is not None:
+                blackbox.request_phase(req.bb, "executing",
+                                       batch_rows=batch_rows)
             # the queue_wait span ends HERE, on the dispatch thread —
             # the cross-thread half of the request's trace
             telemetry.span_end(self._wait_span_of(req))
@@ -1021,11 +1036,19 @@ class ServingEngine:
         return batch
 
     def _worker_loop(self, widx, predictor):
-        while True:
-            batch = self._next_batch()
-            if batch is None:
-                return
-            self._run_batch(predictor, batch, widx)
+        # _run_batch resolves per-request failures into futures; an
+        # exception escaping to HERE means the dispatch thread itself
+        # is dying — dump the flight recorder before it goes (the
+        # re-raise feeds threading.excepthook for the log line)
+        try:
+            while True:
+                batch = self._next_batch()
+                if batch is None:
+                    return
+                self._run_batch(predictor, batch, widx)
+        except BaseException as e:
+            blackbox.dump_exception(f"serving_worker_{widx}", e)
+            raise
 
     def _book_worker(self, widx: int, predictor, ok: bool, rows: int,
                      predict_ms: Optional[float] = None):

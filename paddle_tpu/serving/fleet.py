@@ -55,9 +55,21 @@ own port, metrics dir, and ``PADDLE_TPU_REPLICA_ID`` env.
   same port, re-swap the fresh process — so a rollout converges even
   when a replica's live state has drifted.
 
+* **Postmortem pipeline.** Every replica death is harvested for the
+  flight-recorder artifacts its life left in
+  ``<metrics_dir>/postmortem/`` (self-dumps, the rolling dump, the
+  supervisor's own hung-kill mark — :mod:`paddle_tpu.blackbox`) and
+  **attributed**: ``clean_exit`` / ``hung_kill`` /
+  ``signal:<NAME>`` (WTERMSIG decoded) / ``crash:<reason>`` /
+  ``unexplained`` (died rc>0 with no self-dump — the count chaos
+  hard-zeroes).  The attribution rides the respawn log/event, per-
+  replica ``statusz()``, and the router's ``/fleetz``+``/debugz``
+  via :meth:`attach_router`.
+
 Stats (README catalog): counters ``fleet_restarts``,
 ``fleet_rolling_restarts``, ``fleet_hung_kills``, ``fleet_hot_swaps``,
-``fleet_hot_swap_fallbacks``; gauge ``fleet_replicas_live``.
+``fleet_hot_swap_fallbacks``, ``fleet_postmortems_collected``,
+``fleet_deaths_unexplained``; gauge ``fleet_replicas_live``.
 """
 from __future__ import annotations
 
@@ -73,7 +85,7 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
-from .. import telemetry
+from .. import blackbox, telemetry
 from ..distributed.launch import spawn_process
 from ..flags import flag_value
 from ..monitor import stat_add
@@ -127,6 +139,11 @@ class _Replica:
         # deadline must not fire on a successor still importing)
         self.last_alive: Optional[float] = None
         self.hung_kills = 0       # liveness SIGKILLs on this slot
+        # crash forensics: the most recent death's attribution record
+        # and the slot's running artifact/unexplained tallies
+        self.last_death: Optional[dict] = None
+        self.postmortems = 0      # artifacts harvested across deaths
+        self.unexplained = 0      # deaths with no explanation
 
 
 class FleetSupervisor:
@@ -305,6 +322,37 @@ class FleetSupervisor:
                 for rep in self._replicas:
                     self._check_one(rep)
 
+    def _book_death(self, rep: _Replica, rc: Optional[int]) -> dict:
+        """Harvest + attribute one replica death (the postmortem
+        pipeline): collect whatever the dead life left in its
+        ``postmortem/`` dir, classify the death, book the counters,
+        and remember the record on the slot.  Called with the
+        supervisor lock held; the work is a directory listing."""
+        pid = rep.proc.pid if rep.proc is not None else None
+        arts = blackbox.harvest(rep.metrics_dir, pid) \
+            if pid is not None else []
+        attribution = blackbox.attribute_death(rc, arts)
+        rec = {"pid": pid, "rc": rc,
+               "signal": blackbox.signal_name(rc),
+               "attribution": attribution,
+               "postmortems": [a["path"] for a in arts],
+               "time": round(time.time(), 3)}
+        rep.last_death = rec
+        if arts:
+            rep.postmortems += len(arts)
+            stat_add("fleet_postmortems_collected")
+        if attribution == "unexplained":
+            rep.unexplained += 1
+            stat_add("fleet_deaths_unexplained")
+        return rec
+
+    @staticmethod
+    def _rc_str(rc: Optional[int]) -> str:
+        """``-9 (SIGKILL)`` instead of a bare ``-9`` — every log line
+        that reports a death names the signal (WTERMSIG decoded)."""
+        sig = blackbox.signal_name(rc)
+        return f"{rc} ({sig})" if sig else str(rc)
+
     def _check_one(self, rep: _Replica):
         if rep.in_rollout or rep.failed or rep.proc is None:
             return
@@ -320,13 +368,17 @@ class FleetSupervisor:
         # rolling_restart / close, which hold the rollout flag or
         # _closing)
         self._publish_live()
+        death = self._book_death(rep, rc)
         if rep.crash_restarts >= self.max_restarts:
             rep.failed = True
             logger.error("replica %d exited rc=%s past the restart "
-                         "budget (%d); staying down", rep.idx, rc,
-                         self.max_restarts)
+                         "budget (%d); staying down [%s]", rep.idx,
+                         self._rc_str(rc), self.max_restarts,
+                         death["attribution"])
             telemetry.log_event("fleet_replica_failed", replica=rep.idx,
-                                rc=rc)
+                                rc=rc, signal=death["signal"],
+                                attribution=death["attribution"],
+                                postmortems=len(death["postmortems"]))
             return
         rep.crash_restarts += 1
         rep.crash_streak += 1
@@ -334,11 +386,16 @@ class FleetSupervisor:
                       _BACKOFF_CAP_S)
         rep.respawn_at = time.monotonic() + backoff
         stat_add("fleet_restarts")
-        logger.warning("replica %d crashed rc=%s; respawn %d/%d in "
-                       "%.2fs", rep.idx, rc, rep.crash_restarts,
+        logger.warning("replica %d crashed rc=%s [%s, %d postmortem(s)]"
+                       "; respawn %d/%d in %.2fs", rep.idx,
+                       self._rc_str(rc), death["attribution"],
+                       len(death["postmortems"]), rep.crash_restarts,
                        self.max_restarts, backoff)
         telemetry.log_event("fleet_replica_crash", replica=rep.idx,
-                            rc=rc, restart=rep.crash_restarts,
+                            rc=rc, signal=death["signal"],
+                            attribution=death["attribution"],
+                            postmortems=len(death["postmortems"]),
+                            restart=rep.crash_restarts,
                             backoff_s=round(backoff, 3))
 
     # -- hung-replica liveness watchdog -------------------------------------
@@ -395,6 +452,14 @@ class FleetSupervisor:
                                     replica=rep.idx,
                                     pid=proc.pid,
                                     stale_s=round(stale_s, 3))
+                # the kill mark goes down BEFORE the bullet: a
+                # SIGSTOP'd/wedged process cannot dump its own flight
+                # recorder, so the supervisor leaves the evidence the
+                # crash monitor will harvest (attribution hung_kill)
+                blackbox.write_kill_mark(
+                    rep.metrics_dir, proc.pid, replica=rep.idx,
+                    stale_s=round(stale_s, 3),
+                    liveness_timeout_s=self._liveness_s)
                 try:
                     # the verified life's handle — a respawn racing in
                     # after the lock released must not catch the bullet
@@ -433,6 +498,15 @@ class FleetSupervisor:
                     rep.proc.kill()
                     rc = rep.proc.wait(5.0)
                 drain_s = time.monotonic() - t_rep
+                with self._lock:
+                    # every death is booked, planned ones included: a
+                    # drain that actually died by signal (or left a
+                    # self-dump) must not hide inside a rollout
+                    death = self._book_death(rep, rc)
+                if death["attribution"] != "clean_exit":
+                    logger.warning(
+                        "replica %d rollout exit rc=%s [%s]", rep.idx,
+                        self._rc_str(rc), death["attribution"])
                 self._spawn(rep)
                 ok = self._wait_replica_ready(
                     rep, time.monotonic() + ready_timeout_s)
@@ -561,14 +635,23 @@ class FleetSupervisor:
         logger.warning("replica %d refused the hot swap (%s); falling "
                        "back to restart", rep.idx,
                        entry.get("rejected"))
-        rep.proc.send_signal(signal.SIGTERM)
+        if rep.proc.poll() is None:
+            rep.proc.send_signal(signal.SIGTERM)
         try:
-            rep.proc.wait(drain_timeout_s)
+            rc = rep.proc.wait(drain_timeout_s)
         except Exception:  # subprocess.TimeoutExpired
             logger.warning("replica %d did not drain in %.1fs; killing",
                            rep.idx, drain_timeout_s)
             rep.proc.kill()
-            rep.proc.wait(5.0)
+            rc = rep.proc.wait(5.0)
+        with self._lock:
+            # a replica that DIED mid-swap (vs refusing it) reaches
+            # this path with the monitor's hands off (in_rollout):
+            # its death is booked here so the postmortem pipeline
+            # sees every death, rollout or not
+            death = self._book_death(rep, rc)
+        entry["death"] = {"rc": rc, "signal": death["signal"],
+                          "attribution": death["attribution"]}
         self._spawn(rep)
         if not self._wait_replica_ready(
                 rep, time.monotonic() + ready_timeout_s):
@@ -597,10 +680,36 @@ class FleetSupervisor:
                 "lives": r.lives, "crash_restarts": r.crash_restarts,
                 "hung_kills": r.hung_kills,
                 "failed": r.failed, "in_rollout": r.in_rollout,
+                "last_death": r.last_death,
+                "postmortems_collected": r.postmortems,
+                "unexplained_deaths": r.unexplained,
             } for r in self._replicas]
         return {"replicas": reps, "max_restarts": self.max_restarts,
                 "workdir": self.workdir,
                 "uptime_s": round(time.time() - self._started, 3)}
+
+    def forensics(self) -> dict:
+        """The crash-forensics summary ``/fleetz`` carries when this
+        supervisor is attached to a router: per-replica latest death
+        attribution plus the fleet-wide artifact/unexplained
+        tallies."""
+        with self._lock:
+            deaths = [dict(r.last_death, replica=r.idx)
+                      for r in self._replicas
+                      if r.last_death is not None]
+            collected = sum(r.postmortems for r in self._replicas)
+            unexplained = sum(r.unexplained for r in self._replicas)
+        return {"deaths": deaths,
+                "postmortems_collected": collected,
+                "unexplained_deaths": unexplained}
+
+    def attach_router(self, router):
+        """Surface this supervisor's death attributions on the
+        router's ``/fleetz`` (``supervision`` block) and federated
+        ``/debugz`` — the co-located-fleet wiring (one process runs
+        both tiers; nothing crosses the network)."""
+        router.supervisor = self
+        return router
 
     def close(self, timeout_s: float = 30.0):
         with self._lock:

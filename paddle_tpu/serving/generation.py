@@ -139,7 +139,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .. import costmodel, fault, telemetry
+from .. import blackbox, costmodel, fault, telemetry
 from ..flags import flag_value
 from ..monitor import stat_add
 from . import batcher
@@ -163,7 +163,7 @@ class GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "future", "t_submit",
                  "t_claimed", "t_deadline", "trace_id", "prefill_ms",
                  "on_token", "record_timeline", "events", "t_tokens",
-                 "t_first", "t_last", "segment", "speculate")
+                 "t_first", "t_last", "segment", "speculate", "bb")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int):
         self.prompt = prompt
@@ -184,6 +184,9 @@ class GenRequest:
         self.t_tokens: List[float] = []  # per generated token
         self.t_first: Optional[float] = None
         self.t_last: Optional[float] = None
+        # flight-recorder last-words token (None when blackbox is off
+        # or the in-flight cap is reached)
+        self.bb: Optional[int] = None
 
     def note(self, label: str, ts: float, extra=None):
         if self.record_timeline:
@@ -883,7 +886,7 @@ class GenerationEngine:
     # -- lifecycle ----------------------------------------------------------
     def start(self):
         if self._thread is None:
-            self._thread = threading.Thread(target=self._loop,
+            self._thread = threading.Thread(target=self._loop_guarded,
                                             name="generation-scheduler",
                                             daemon=True)
             self._thread.start()
@@ -986,6 +989,8 @@ class GenerationEngine:
         req.record_timeline = bool(telemetry.enabled()
                                    if timeline is None else timeline)
         req.note("admit", req.t_submit)
+        req.bb = blackbox.request_begin(req.trace_id, "generate",
+                                        prompt_len=int(ids.size))
         self._count("requests")
         stat_add("serving_generate_requests")
         with self._cv:
@@ -1245,6 +1250,8 @@ class GenerationEngine:
         req.record_timeline = bool(telemetry.enabled()
                                    if timeline is None else timeline)
         req.note("admit", req.t_submit, {"adopted": True})
+        req.bb = blackbox.request_begin(req.trace_id, "adopt",
+                                        prompt_len=int(segment.prompt_len))
         self._count("requests")
         stat_add("serving_generate_requests")
         with self._cv:
@@ -1263,6 +1270,7 @@ class GenerationEngine:
 
     def _shed_err(self, req: GenRequest, reason: str,
                   detail: str = "") -> OverloadedError:
+        blackbox.request_end(req.bb)
         self._count("shed")
         stat_add("serving_generate_shed")
         if reason == "deadline":
@@ -1312,6 +1320,9 @@ class GenerationEngine:
                 break
             req.t_claimed = now
             req.note("claim", now, {"slot": slot.idx})
+            if req.bb is not None:
+                blackbox.request_phase(req.bb, "prefill",
+                                       slot=slot.idx)
             slot.req = req
             slot.position = 0
             slot.steps = 0
@@ -1335,6 +1346,17 @@ class GenerationEngine:
 
     def _prefilling_slots(self) -> List[_Slot]:
         return [s for s in self._slots if s.active and not s.decoding]
+
+    def _loop_guarded(self):
+        # per-request failures resolve futures inside _loop; an
+        # exception escaping the scheduler loop itself kills every
+        # in-flight sequence at once — dump the flight recorder
+        # before the thread dies (then re-raise into excepthook)
+        try:
+            self._loop()
+        except BaseException as e:
+            blackbox.dump_exception("generation_scheduler", e)
+            raise
 
     def _loop(self):
         while True:
@@ -1438,6 +1460,8 @@ class GenerationEngine:
         if not self.paged:
             self._prefill(slot, req)
             slot.decoding = True
+            if req.bb is not None:
+                blackbox.request_phase(req.bb, "decoding")
             return
         kind = fault.fire("prefill")
         fault.maybe_delay(kind)
@@ -1512,6 +1536,8 @@ class GenerationEngine:
         slot.logits = [np.asarray(r) for r in np.asarray(seg.logits)] \
             if (self.keep_logits and seg.logits is not None) else []
         slot.decoding = True
+        if req.bb is not None:
+            blackbox.request_phase(req.bb, "decoding")
         now = time.monotonic()
         ms = (now - t0) * 1e3
         self._count("segments_adopted")
@@ -1604,6 +1630,7 @@ class GenerationEngine:
         logger.warning("%s failed: %s", phase, e)
         self._end_seq_span(slot, f"failed:{phase}")
         self._release_pages(slot)
+        blackbox.request_end(req.bb)
         req.future._resolve(error=RequestFailed(
             f"{phase} failed: {type(e).__name__}: {e}"))
         slot.req = None
@@ -1631,6 +1658,7 @@ class GenerationEngine:
             req, s.req, s.logits = s.req, None, []
             s.decoding = False
             self._release_pages(s)
+            blackbox.request_end(req.bb)
             req.future._resolve(error=err)
         self._sample_slot_track()
         if self._prefix is not None:
@@ -1891,6 +1919,8 @@ class GenerationEngine:
             self._export_segment(slot, req)
             return
         slot.decoding = True
+        if req.bb is not None:
+            blackbox.request_phase(req.bb, "decoding")
         self._book_token(slot, first, time.monotonic())
 
     def _export_segment(self, slot: _Slot, req: GenRequest):
@@ -1971,6 +2001,7 @@ class GenerationEngine:
         slot.logits = []
         self._release_pages(slot)
         self._sample_slot_track()
+        blackbox.request_end(req.bb)
         req.future._resolve(outputs=result)
 
     # -- decode -------------------------------------------------------------
@@ -2267,6 +2298,7 @@ class GenerationEngine:
         slot.decoding = False
         self._release_pages(slot)
         self._sample_slot_track()
+        blackbox.request_end(req.bb)
         req.future._resolve(outputs=result)
 
     def _timeline_record(self, req: GenRequest, result: dict) -> dict:

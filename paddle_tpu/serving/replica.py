@@ -212,9 +212,16 @@ def main(argv=None) -> int:
                          "FLAGS_serving_spec_tokens)")
     args = ap.parse_args(argv)
 
+    from .. import blackbox
     from ..flags import set_flags
     from .engine import ServingEngine
     from .server import serve
+
+    # arm crash forensics before anything heavy runs: faulthandler +
+    # fatal-signal handlers + the thread excepthook, so even a crash
+    # inside predictor build / warmup leaves a postmortem (main thread,
+    # so the signal handlers are installable)
+    blackbox.install()
 
     if args.role and args.role != "both" and not args.generate:
         raise SystemExit("--role prefill|decode requires --generate "

@@ -158,7 +158,7 @@ import urllib.request
 from http.server import ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
-from .. import fault, promtext, telemetry, tsdb
+from .. import blackbox, fault, promtext, telemetry, tsdb
 from ..flags import all_flags, flag_value
 from ..monitor import process_uptime_s, stat_add
 from .server import (DEADLINE_HEADER, TRACE_HEADER, VERSION_HEADER,
@@ -411,6 +411,10 @@ class Router:
         self._autoscale = {"wanted_replicas": None, "pressure": None,
                            "p99_ms": None, "slo_p99_ms": self._slo_p99_ms,
                            "avg_queue_depth": None, "live": 0}
+        # a co-located FleetSupervisor may attach itself here (see
+        # FleetSupervisor.attach_router) so /fleetz and /debugz carry
+        # death attributions next to the routing view
+        self.supervisor = None
         self._closed = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
         # persistent poll workers (idle threads are cheap; per-sweep
@@ -460,8 +464,15 @@ class Router:
         return False
 
     def _poll_loop(self):
-        while not self._closed.wait(self._poll_s):
-            self.poll_once()
+        # an exception escaping poll_once kills health polling for the
+        # whole fleet (every replica would go stale and eject) — dump
+        # the flight recorder before the thread dies
+        try:
+            while not self._closed.wait(self._poll_s):
+                self.poll_once()
+        except BaseException as e:
+            blackbox.dump_exception("router_poll_loop", e)
+            raise
 
     def poll_once(self):
         """One health sweep over every replica + an autoscale-signal
@@ -1646,6 +1657,11 @@ class Router:
             "autoscale": auto,
             "tsdb": self._db.stats(),
         })
+        if self.supervisor is not None:
+            # death attributions + postmortem inventory from the
+            # attached FleetSupervisor — the crash-forensics half of
+            # the fleet document
+            fm["supervision"] = self.supervisor.forensics()
         return fm
 
     def fleet_prometheus_text(self) -> str:
@@ -1720,6 +1736,34 @@ class Router:
             "canary_active": canary_active,
         }
 
+    def debugz(self, timeout: float = 5.0) -> dict:
+        """The federated one-shot debug bundle: every replica's
+        ``GET /debugz`` document keyed by its url, plus the router's
+        own state (statusz + fleetz + its own flight-recorder ring) —
+        one fetch freezes the whole fleet for offline diagnosis.  A
+        replica that cannot answer contributes ``{"error": ...}``
+        instead of failing the bundle (a debug fetch during an
+        incident must degrade, never 500)."""
+        replicas = {}
+        for rep in self._all():
+            try:
+                with urllib.request.urlopen(rep.url + "/debugz",
+                                            timeout=timeout) as r:
+                    replicas[rep.url] = json.loads(r.read())
+            except (OSError, TimeoutError, ValueError) as e:
+                replicas[rep.url] = {
+                    "error": f"{type(e).__name__}: {e}"}
+        return {
+            "bundle": "paddle_tpu.debugz.v1",
+            "tier": "router",
+            "statusz": self.statusz(),
+            "fleetz": self.fleetz(),
+            "blackbox": blackbox.snapshot(),
+            "metrics": telemetry.metrics.snapshot()
+            if telemetry.enabled() else None,
+            "replicas": replicas,
+        }
+
     def statusz(self) -> dict:
         return {
             "pid": os.getpid(),
@@ -1778,6 +1822,8 @@ class _RouterHandler(_JsonHandler):
             self._reply(200, self.router.fleetz(window_s))
         elif route == "/statusz":
             self._reply(200, self.router.statusz())
+        elif route == "/debugz":
+            self._reply(200, self.router.debugz())
         else:
             self._reply(404, {"error": "not found", "path": self.path})
 

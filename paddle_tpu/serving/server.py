@@ -88,7 +88,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from .. import costmodel, fault, observatory, telemetry
+from .. import blackbox, costmodel, fault, observatory, telemetry
 from ..flags import all_flags, flag_value
 from ..monitor import process_uptime_s, stat_add
 from .engine import OverloadedError, RequestFailed, ServingEngine
@@ -288,6 +288,7 @@ class _Handler(_JsonHandler):
                    "/metrics": self._get_metrics,
                    "/statusz": self._get_statusz,
                    "/tracez": self._get_tracez,
+                   "/debugz": self._get_debugz,
                    "/profilez": self._get_profilez}.get(route)
         if handler is None:
             self._reply(404, {"error": "not found", "path": self.path})
@@ -320,10 +321,10 @@ class _Handler(_JsonHandler):
         self._reply_raw(200, text.encode(),
                         "text/plain; version=0.0.4; charset=utf-8")
 
-    def _get_statusz(self):
-        """Operator snapshot — works with telemetry off too (flags and
-        engine state carry no telemetry dependency; the tsdb/alerts
-        blocks are None then)."""
+    def _statusz_doc(self) -> dict:
+        """The /statusz payload (also the spine of a /debugz bundle) —
+        works with telemetry off too (flags and engine state carry no
+        telemetry dependency; the tsdb/alerts blocks are None then)."""
         from .. import tsdb as _tsdb
 
         tele = {"enabled": telemetry.enabled(),
@@ -336,7 +337,7 @@ class _Handler(_JsonHandler):
         if telemetry.enabled() and _tsdb.enabled():
             slo = replica_slo_monitor().evaluate()
             db_stats = _tsdb.default().stats()
-        self._reply(200, {
+        return {
             "pid": os.getpid(),
             "time": time.time(),
             "process_uptime_s": process_uptime_s(),
@@ -351,7 +352,30 @@ class _Handler(_JsonHandler):
             "slo": slo,
             "tsdb": db_stats,
             "engine": self.engine.introspect(),
-        })
+        }
+
+    def _get_statusz(self):
+        self._reply(200, self._statusz_doc())
+
+    def _get_debugz(self):
+        """One-shot debug bundle: statusz + tracez + the live metric
+        registry + the blackbox flight-recorder ring in one JSON doc —
+        one fetch captures everything a postmortem would have, from a
+        process that is still alive.  ``?dump=1`` additionally writes
+        a postmortem file (reason ``requested``) and reports its
+        path.  Always 200: each block degrades to a disabled marker
+        rather than failing the bundle."""
+        doc = {"bundle": "paddle_tpu.debugz.v1",
+               "statusz": self._statusz_doc(),
+               "tracez": self.engine.tracez()
+               if telemetry.enabled() else None,
+               "metrics": telemetry.metrics.snapshot()
+               if telemetry.enabled() else None,
+               "blackbox": blackbox.snapshot()}
+        query = self.path.partition("?")[2]
+        if any(p in ("dump=1", "dump=true") for p in query.split("&")):
+            doc["dump_path"] = blackbox.dump("requested")
+        self._reply(200, doc)
 
     def _get_tracez(self):
         if not telemetry.enabled():

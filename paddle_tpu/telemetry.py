@@ -927,6 +927,14 @@ def write_heartbeat(path: Optional[str] = None) -> Optional[str]:
     return path
 
 
+# taps the black-box flight recorder (paddle_tpu/blackbox.py) hooks at
+# import time; telemetry stays import-independent of blackbox (blackbox
+# imports telemetry, never the reverse) so the tap is a plain callable
+# attribute, None until blackbox is loaded
+_blackbox_event_tap = None   # (kind, fields_dict) -> None
+_blackbox_flush_tap = None   # () -> None
+
+
 def log_event(kind: str, **fields):
     """Append one machine-parseable line to ``events.jsonl``
     (step timings, guard resolutions, checkpoint publishes, restarts).
@@ -934,6 +942,11 @@ def log_event(kind: str, **fields):
     line (``telemetry_events_dropped``) instead of raising."""
     if not enabled():
         return
+    # the flight recorder mirrors every event into its in-memory ring
+    # even without a metrics dir (the ring needs no filesystem; the
+    # dump path checks for one itself)
+    if _blackbox_event_tap is not None:
+        _blackbox_event_tap(kind, fields)
     d = _metrics_dir()
     if d is None:
         return
@@ -1006,6 +1019,9 @@ def flush(force: bool = True):
     if not enabled():
         return
     _tsdb_sample()
+    # flight-recorder cadence: metric-snapshot ring + rolling dump
+    if _blackbox_flush_tap is not None:
+        _blackbox_flush_tap()
     d = _metrics_dir()
     if d is None:
         return

@@ -368,6 +368,42 @@ def _wait_respawned_ready(rep, old_pid, timeout_s: float = 90.0
     return None
 
 
+def _postmortem_verdict(victim, old_pid: int,
+                        expect_attr: Optional[str] = None,
+                        timeout_s: float = 30.0):
+    """The crash-forensics contract for one induced death: the
+    supervisor must BOOK the death (harvest + attribution), the
+    harvest must have collected at least one flight-recorder artifact
+    (the fault-window evidence — a self/rolling dump or the
+    supervisor's kill mark), and the attribution must not be
+    ``unexplained`` (and must match ``expect_attr`` when the scenario
+    knows exactly how it killed).  Returns ``(death_record, error)``
+    — ``death_record`` None when the death was never booked."""
+    deadline = time.monotonic() + timeout_s
+    death = None
+    while time.monotonic() < deadline:
+        d = victim.last_death
+        if d is not None and d.get("pid") == old_pid:
+            death = d
+            break
+        time.sleep(0.1)
+    if death is None:
+        return None, (f"supervisor never booked the induced death of "
+                      f"pid {old_pid} (no harvest/attribution)")
+    if not death["postmortems"]:
+        return death, (f"no postmortem collected for induced death "
+                       f"pid {old_pid} ({death['attribution']})")
+    if death["attribution"] == "unexplained":
+        return death, (f"induced death pid {old_pid} attributed "
+                       f"unexplained despite {len(death['postmortems'])}"
+                       f" artifact(s)")
+    if expect_attr is not None and death["attribution"] != expect_attr:
+        return death, (f"induced death pid {old_pid} attributed "
+                       f"{death['attribution']!r}, expected "
+                       f"{expect_attr!r}")
+    return death, None
+
+
 def _scenario(name: str, sup, router, url: str, cfg: dict) -> dict:
     """Run one scenario's traffic with its injection; returns the
     classified report + the raw records (for the aggregate)."""
@@ -442,6 +478,23 @@ def _scenario(name: str, sup, router, url: str, cfg: dict) -> dict:
             # the supervisor must have done the killing — a recovery
             # via any other path means the watchdog did not fire
             error = "liveness watchdog never SIGKILLed the hung replica"
+    unexplained_deaths = None
+    if name in ("crash", "hang"):
+        # crash-forensics contract: the induced death must be booked,
+        # carry >=1 harvested artifact, and be attributed exactly as
+        # induced (SIGKILL decodes to signal:SIGKILL; the watchdog's
+        # kill mark decodes to hung_kill).  The per-scenario
+        # unexplained count rides into totals for the perf_gate
+        # hard-zero (None = the death was never even booked)
+        death, pm_err = _postmortem_verdict(
+            sup._replicas[0], old_pid,
+            "signal:SIGKILL" if name == "crash" else "hung_kill")
+        notes["postmortem"] = death
+        if death is not None:
+            unexplained_deaths = \
+                1 if death["attribution"] == "unexplained" else 0
+        if error is None and pm_err is not None:
+            error = pm_err
 
     windows = []
     if box["t_fault"] is not None:
@@ -487,6 +540,8 @@ def _scenario(name: str, sup, router, url: str, cfg: dict) -> dict:
     rep["scenario"] = name
     rep["notes"] = notes
     rep["alerts"] = alerts
+    if name in ("crash", "hang"):
+        rep["unexplained_deaths"] = unexplained_deaths
     if box["t_fault"] is not None and box["t_recover"] is not None:
         rep["recovery_s"] = round(box["t_recover"] - box["t_fault"], 3)
     if name == "poison" and error is None:
@@ -880,7 +935,7 @@ def _scenario_disagg_crash(cfg: dict, log=print) -> dict:
 
         def inject():
             time.sleep(duration * 0.25)
-            old_p = victim_p.proc.pid
+            old_p = box["pid_p"] = victim_p.proc.pid
             box["t1"] = time.monotonic()
             try:
                 os.kill(old_p, signal.SIGKILL)   # mid-handoff
@@ -888,7 +943,7 @@ def _scenario_disagg_crash(cfg: dict, log=print) -> dict:
                 box["err"] = f"prefill kill: {e}"
                 return
             time.sleep(duration * 0.3)
-            old_d = victim_d.proc.pid
+            old_d = box["pid_d"] = victim_d.proc.pid
             box["t2"] = time.monotonic()
             try:
                 os.kill(old_d, signal.SIGKILL)   # live segments die
@@ -920,6 +975,29 @@ def _scenario_disagg_crash(cfg: dict, log=print) -> dict:
             notes["recovery_s"] = {
                 "prefill": round(box["r1"] - box["t1"], 3),
                 "decode": round(box["r2"] - box["t2"], 3)}
+        # crash-forensics contract for BOTH induced kills (same
+        # verdict as the plain crash scenario): booked, artifacted,
+        # attributed signal:SIGKILL
+        unexplained = None
+        if box.get("pid_p") is not None or box.get("pid_d") is not None:
+            unexplained = 0
+            notes["postmortems"] = {}
+            for label, vic, pid in (("prefill", victim_p,
+                                     box.get("pid_p")),
+                                    ("decode", victim_d,
+                                     box.get("pid_d"))):
+                if pid is None:
+                    continue
+                death, pm_err = _postmortem_verdict(
+                    vic, pid, "signal:SIGKILL")
+                notes["postmortems"][label] = death
+                if death is None:
+                    unexplained = None
+                elif death["attribution"] == "unexplained" \
+                        and unexplained is not None:
+                    unexplained += 1
+                if error is None and pm_err is not None:
+                    error = pm_err
         # burn-rate contract: fire inside EACH fault window, clear
         # after recovery (same machinery as the crash/hang scenarios)
         if windows:
@@ -992,6 +1070,7 @@ def _scenario_disagg_crash(cfg: dict, log=print) -> dict:
     rep["notes"] = notes
     rep["alerts"] = alerts
     rep["leaked_pages"] = leaked
+    rep["unexplained_deaths"] = unexplained
     if "recovery_s" in notes:
         rep["recovery_s"] = max(notes["recovery_s"].values())
     if error is None and rep["ok"] == 0:
@@ -1157,6 +1236,7 @@ def _scenario_hot_swap(cfg: dict, log=print) -> dict:
                 if victim.in_rollout:
                     time.sleep(0.25)  # inside the delayed commit
                     try:
+                        box["pid"] = victim.proc.pid
                         os.kill(victim.proc.pid, signal.SIGKILL)
                         box["t_kill"] = time.monotonic()
                     except OSError as e:
@@ -1187,6 +1267,19 @@ def _scenario_hot_swap(cfg: dict, log=print) -> dict:
                 # +1s grace: round-robin clients may still be timing
                 # out on the respawned socket right at ready
                 windows.append((box["t_kill"], t_swap2_done + 1.0))
+        # crash-forensics contract: the mid-swap SIGKILL is a death
+        # like any other — the fallback-restart path must have booked
+        # it (harvested + attributed signal:SIGKILL)
+        unexplained = None
+        if box.get("pid") is not None:
+            death, pm_err = _postmortem_verdict(
+                victim, box["pid"], "signal:SIGKILL")
+            notes["postmortem"] = death
+            if death is not None:
+                unexplained = \
+                    1 if death["attribution"] == "unexplained" else 0
+            if error is None and pm_err is not None:
+                error = pm_err
 
         traffic.join(timeout=duration + 60.0)
         stop.set()
@@ -1275,6 +1368,7 @@ def _scenario_hot_swap(cfg: dict, log=print) -> dict:
     rep["scenario"] = "hot_swap"
     rep["notes"] = notes
     rep["torn_responses"] = notes.get("torn_responses")
+    rep["unexplained_deaths"] = unexplained
     if error is None and rep["ok"] == 0:
         error = "no request succeeded (fleet never served)"
     if error is None and rep.get("torn_responses") is None:
@@ -1414,6 +1508,16 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
     if any("torn_responses" in r for r in per_scenario.values()):
         totals["torn_responses"] = sum(
             r.get("torn_responses") or 0 for r in per_scenario.values())
+    # crash-forensics verdict: every induced death must be harvested
+    # AND explained.  A per-scenario None means a death was never even
+    # booked — that vacuousness propagates to the total (perf_gate
+    # treats present-but-None as a failed rule, not a pass)
+    pm_scens = [r for r in per_scenario.values()
+                if "unexplained_deaths" in r]
+    if pm_scens:
+        totals["unexplained_deaths"] = None \
+            if any(r["unexplained_deaths"] is None for r in pm_scens) \
+            else sum(r["unexplained_deaths"] for r in pm_scens)
     fault_ok_ms = sorted(r["ms"] for r in fault_records
                          if r["outcome"] == "ok")
     p99_under_fault = round(
@@ -1425,6 +1529,7 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
     ok = (not errors
           and totals["collateral_failures"] == 0
           and totals["poison_leaks"] == 0
+          and totals.get("unexplained_deaths", 0) == 0
           and totals["availability_pct"] >= availability_pct)
     return {
         "ok": ok,

@@ -242,6 +242,28 @@ def compare_leg(name: str, new: dict, base: dict,
                               f"{leaked_pages} KV page(s) live after "
                               f"the storm drained (refcount leak)")
             return res
+        # crash-forensics rule (hard, like collateral/leaks): every
+        # induced death must be harvested and attributed — a death
+        # the supervisor cannot explain means the flight recorder,
+        # the kill-mark path, or the harvest broke.  Present-but-None
+        # is a vacuous verdict (a death was never even booked) and
+        # fails too; the key absent is allowed — captures predate the
+        # forensics layer
+        if "unexplained_deaths" in new:
+            ud = new.get("unexplained_deaths")
+            if ud is None:
+                res.update(status="regression",
+                           reason="chaos run measured no unexplained-"
+                                  "death count (vacuous forensics: an "
+                                  "induced death was never booked)")
+                return res
+            if ud > 0:
+                res.update(status="regression",
+                           reason=f"chaos saw {ud} unexplained replica "
+                                  f"death(s) — died rc>0 with no "
+                                  f"postmortem artifact (contract: "
+                                  f"zero)")
+                return res
         # the harness's own verdict: a scenario that errored (watchdog
         # never fired, no poisoned request reached a model, victim
         # never respawned) means a containment mechanism went
@@ -969,6 +991,7 @@ def run_smoke() -> int:
         "availability_floor": 99.0,
         "collateral_failures": 0, "injected_failures": 9,
         "poison_leaks": 0, "p99_under_fault_ms": 45.0,
+        "unexplained_deaths": 0,
         "requests": 960,
     }
     with_chaos = json.loads(json.dumps(latest))
@@ -1026,6 +1049,23 @@ def run_smoke() -> int:
               x["status"] == "regression"
               and "burn-rate" in x.get("reason", "")
               for x in r["legs"]))
+    unexplained = json.loads(json.dumps(with_chaos))
+    unexplained["legs"]["chaos"]["unexplained_deaths"] = 1
+    # forensics is a containment contract: no anomaly flag shields it
+    unexplained["legs"]["chaos"]["anomaly"] = "core-bound host"
+    r = compare_bench(unexplained, docs + [with_chaos])
+    check("chaos unexplained-death fails even when anomalous",
+          not r["ok"] and any(
+              x["status"] == "regression"
+              and "unexplained" in x.get("reason", "")
+              for x in r["legs"]))
+    vacuous_deaths = json.loads(json.dumps(with_chaos))
+    vacuous_deaths["legs"]["chaos"]["unexplained_deaths"] = None
+    r = compare_bench(vacuous_deaths, docs + [with_chaos])
+    check("chaos vacuous-forensics fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "vacuous forensics" in x.get("reason", "")
+        for x in r["legs"]))
     harness_err = json.loads(json.dumps(with_chaos))
     harness_err["legs"]["chaos"]["harness_ok"] = False
     harness_err["legs"]["chaos"]["errors"] = {

@@ -87,6 +87,7 @@ import json
 import os
 import queue as queue_mod
 import sys
+import tempfile
 import threading
 import time
 import urllib.error
@@ -800,6 +801,26 @@ def _http_statusz(base_url: str, timeout_s: float = 10.0
         return None
 
 
+def fetch_debugz(base_url: str, out_path: str,
+                 timeout_s: float = 10.0) -> Optional[str]:
+    """Pull the target's one-shot ``/debugz`` forensics bundle (statusz
+    + tracez + metrics + blackbox ring in one doc) and save it to
+    ``out_path``.  Called on SLO violation so the evidence of WHY the
+    run failed is captured at the moment of failure, not re-derived
+    later from a server that has since moved on.  Returns the saved
+    path, or None when the target is unreachable or predates /debugz —
+    never raises (the SLO verdict itself must not depend on this)."""
+    try:
+        with urllib.request.urlopen(base_url.rstrip("/") + "/debugz",
+                                    timeout=timeout_s) as r:
+            doc = json.loads(r.read())
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+        return out_path
+    except (OSError, TimeoutError, ValueError):
+        return None
+
+
 def run_closed_loop_http(base_url: str, make_feed, n_requests: int,
                          concurrency: int,
                          timeout_s: float = 60.0) -> dict:
@@ -1487,6 +1508,19 @@ def main(argv=None) -> int:
                 for v in slo["violations"]:
                     print(f"SLO VIOLATION: {v}", file=sys.stderr)
                 rc = 1
+                if args.url:
+                    # grab the target's forensics bundle while the
+                    # violating state is still live on the server
+                    base = (os.path.splitext(args.out)[0]
+                            if args.out else
+                            os.path.join(tempfile.gettempdir(),
+                                         f"loadgen-{os.getpid()}"))
+                    path = fetch_debugz(args.url,
+                                        base + ".debugz.json")
+                    slo["debugz"] = path
+                    if path:
+                        print(f"SLO VIOLATION: /debugz bundle saved "
+                              f"to {path}", file=sys.stderr)
         text = json.dumps(report)
         print(text)
         if args.out:

@@ -39,11 +39,25 @@ replica's slot occupancy as its own stacked counter track beside its
 sequence timelines (``generation/sequence`` spans, trace-id-linked to
 ``/tracez``).
 
+Postmortems ride along too: a source dir's ``postmortem/*.json``
+flight-recorder dumps (paddle_tpu/blackbox.py) each carry the dead
+process's final span ring under ``trace_events``.  Every dead pid
+becomes one more process track group — labelled with the pid and the
+death reason — so a crashed replica's last seconds sit on the same
+wall-clock timeline as the survivors that kept serving around it.  A
+crash/exception dump supersedes the cadence ``rolling`` dump from the
+same life (the crash dump is written later and contains the final
+ring); a ring whose pid is already on the source's own trace.json
+timeline is skipped (a live run's rolling dump mirrors its trace —
+merging it would double every span); torn dumps are skipped with a
+warning, never fatal.
+
 Load the output in https://ui.perfetto.dev (or chrome://tracing).
 """
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import sys
@@ -93,29 +107,86 @@ def load_event_markers(jsonl_path: str) -> list:
     return markers
 
 
+def _filter_spans(events: list, name_filter: str) -> list:
+    # the name filter narrows SPANS; counter tracks ('C': per-slot
+    # occupancy, the HBM timeline) and metadata ('M') survive any
+    # filter — a filtered view without its counter context is how
+    # "the grid looked idle" misreadings happen
+    if not name_filter:
+        return events
+    return [e for e in events
+            if e.get("ph") in ("C", "M")
+            or name_filter in e.get("name", "")]
+
+
+def load_postmortems(pm_dir: str, name_filter: str = "",
+                     exclude_pids=()) -> list:
+    """``postmortem/*.json`` flight-recorder dumps -> one extra track
+    group per dead pid.  Each dump carries the dead process's final
+    span ring under ``trace_events``; a crash/exception dump
+    supersedes the cadence ``rolling`` dump from the same life, so
+    each dead pid contributes exactly one ring.  ``exclude_pids``
+    drops rings whose pid is already on the source's own timeline (a
+    live run's rolling dump mirrors its trace.json — merging it would
+    duplicate every span).  Unreadable (torn) dumps are skipped with
+    a warning — the export must keep working exactly when processes
+    died mid-write."""
+    by_pid = {}
+    exclude = set(exclude_pids)
+    for path in sorted(glob.glob(os.path.join(pm_dir, "*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: {path}: skipping unreadable postmortem "
+                  f"(torn write?): {e}", file=sys.stderr)
+            continue
+        events = doc.get("trace_events")
+        if not isinstance(events, list):
+            continue
+        pid = doc.get("pid") or 0
+        if pid in exclude:
+            continue
+        reason = doc.get("reason", "unknown")
+        prev = by_pid.get(pid)
+        if prev is not None and reason == "rolling" \
+                and prev["reason"] != "rolling":
+            continue
+        spans = _filter_spans(
+            [e for e in events if isinstance(e, dict)], name_filter)
+        by_pid[pid] = {"src": path, "reason": reason,
+                       "label": f"postmortem pid {pid} ({reason})",
+                       "spans": spans, "markers": []}
+    return [by_pid[k] for k in sorted(by_pid)]
+
+
 def _load_source(src: str, name_filter: str,
                  include_events: bool) -> dict:
-    """One metrics dir (or trace.json) -> its span events + markers."""
+    """One metrics dir (or trace.json) -> its span events + markers
+    (+ the dir's postmortem dumps as extra track-group parts)."""
     if os.path.isdir(src):
         trace_path = os.path.join(src, "trace.json")
         events_path = os.path.join(src, "events.jsonl")
+        pm_dir = os.path.join(src, "postmortem")
     else:
         trace_path = src
         events_path = os.path.join(os.path.dirname(src) or ".",
                                    "events.jsonl")
-    events = load_span_events(trace_path)
-    if name_filter:
-        # the name filter narrows SPANS; counter tracks ('C': per-slot
-        # occupancy, the HBM timeline) and metadata ('M') survive any
-        # filter — a filtered view without its counter context is how
-        # "the grid looked idle" misreadings happen
-        events = [e for e in events
-                  if e.get("ph") in ("C", "M")
-                  or name_filter in e.get("name", "")]
+        pm_dir = None
+    raw = load_span_events(trace_path)
+    events = _filter_spans(raw, name_filter)
     markers = []
     if include_events and os.path.isfile(events_path):
         markers = load_event_markers(events_path)
-    return {"src": src, "spans": events, "markers": markers}
+    postmortems = []
+    if pm_dir is not None and os.path.isdir(pm_dir):
+        # pids already on this source's timeline are alive (or the
+        # latest life): their rolling dump would duplicate trace.json
+        live_pids = {e.get("pid") for e in raw}
+        postmortems = load_postmortems(pm_dir, name_filter,
+                                       exclude_pids=live_pids)
+    return {"src": src, "spans": events, "markers": markers,
+            "postmortems": postmortems}
 
 
 def export(src, out: str, name_filter: str = "",
@@ -133,16 +204,25 @@ def export(src, out: str, name_filter: str = "",
     if not srcs:
         raise SystemExit("no source dir given")
     loaded = [_load_source(s, name_filter, include_events) for s in srcs]
+    # flatten: each source, then its dead replicas' postmortem rings as
+    # extra track groups of their own
+    parts, n_postmortems = [], 0
+    for src_part in loaded:
+        pm = src_part.pop("postmortems", [])
+        parts.append(src_part)
+        parts.extend(pm)
+        n_postmortems += len(pm)
     events = []
     n_spans = n_markers = 0
-    for i, part in enumerate(loaded):
+    for i, part in enumerate(parts):
         n_spans += len(part["spans"])
         n_markers += len(part["markers"])
-        if len(loaded) == 1:
+        if len(parts) == 1:
             events += part["spans"] + part["markers"]
             continue
         pid = i + 1
-        label = os.path.basename(os.path.normpath(part["src"])) \
+        label = part.get("label") \
+            or os.path.basename(os.path.normpath(part["src"])) \
             or part["src"]
         events.append({"ph": "M", "name": "process_name", "pid": pid,
                        "tid": 0, "ts": 0.0,
@@ -155,7 +235,7 @@ def export(src, out: str, name_filter: str = "",
     with open(out, "w") as f:
         json.dump(doc, f)
     return {"out": out, "spans": n_spans, "markers": n_markers,
-            "sources": len(loaded)}
+            "sources": len(loaded), "postmortems": n_postmortems}
 
 
 def main(argv=None) -> int:
@@ -188,9 +268,11 @@ def main(argv=None) -> int:
     info = export(srcs if len(srcs) > 1 else srcs[0],
                   out or "perfetto_trace.json",
                   args.filter, include_events=not args.no_events)
+    pm = info.get("postmortems", 0)
+    pm_note = f" (+{pm} postmortem ring(s))" if pm else ""
     print(f"wrote {info['out']}: {info['spans']} span(s), "
           f"{info['markers']} event marker(s) from {info['sources']} "
-          f"source(s) — load in https://ui.perfetto.dev")
+          f"source(s){pm_note} — load in https://ui.perfetto.dev")
     return 0
 
 
