@@ -71,6 +71,9 @@ per-chip baseline. vs_baseline = our samples/sec/chip / 105.
 Config via env: BENCH_SEQ (128|512), BENCH_BATCH (per-chip),
 BENCH_ATTN (unfused|xla|pallas), BENCH_LEGS=0 to skip the seq-512 leg,
 PEAK_TFLOPS (per-chip peak override), BENCH_DROPOUT, BENCH_DISPATCH.
+Serving-tier legs each gate on their own env switch (BENCH_SERVING,
+BENCH_RECSYS, BENCH_SHARDED, BENCH_ROUTER, BENCH_DECODE, BENCH_PAGED,
+BENCH_SPEC, BENCH_DISAGG, BENCH_CHAOS, BENCH_ROLLOUT — 0 skips).
 
 Measured dead ends (same-session A/B): pallas fused-dropout kernel
 with in-kernel PRNG at seq-128 (775 vs 847 — pallas_call boundaries
@@ -813,6 +816,143 @@ def run_serving():
         "config": {"feat": feat, "hidden": hidden, "depth": depth,
                    "requests": n_req, "workers": workers,
                    "max_batch": max_batch},
+    }
+
+
+def run_recsys():
+    """Recommender-serving leg (`legs.wide_deep_recsys`): closed-loop
+    qps of the Wide&Deep small-feed path — sparse id slots through the
+    ep-sharded embedding tier (hot-row cache in front of per-shard AOT
+    gather executables) + dense floats through the serving net — under
+    zipfian ids at two skews.  The hot skew is the production shape
+    (its hit rate must clear the committed floor, carried in-leg as
+    ``hit_floor``); the cold skew publishes the cache's sensitivity to
+    skew.  ``degraded_lookups`` must stay 0 — every shard is alive for
+    the whole leg, so a degraded row means the gather path broke (the
+    gate hard-zeroes it).  The gather-path efficiency block reads
+    flops/bytes off the largest compiled gather signature's XLA
+    manifest through the shared cost module.  Sized by BENCH_RECSYS_
+    {SLOTS,DENSE,VOCAB,DIM,SHARDS,CACHE_ROWS,REQUESTS,MAX_BATCH,
+    ROUNDS,ZIPF_HOT,ZIPF_COLD,HIT_FLOOR}."""
+    import jax
+
+    from paddle_tpu.serving import ServingEngine, batcher
+    from paddle_tpu.serving.embedding import build_recsys_predictor
+
+    lg = _load_serving_loadgen()
+    env = os.environ.get
+    slots = int(env("BENCH_RECSYS_SLOTS", "26"))
+    dense = int(env("BENCH_RECSYS_DENSE", "13"))
+    vocab = int(env("BENCH_RECSYS_VOCAB", "100000"))
+    dim = int(env("BENCH_RECSYS_DIM", "8"))
+    shards = int(env("BENCH_RECSYS_SHARDS", "4"))
+    cache_rows = int(env("BENCH_RECSYS_CACHE_ROWS", "4096"))
+    n_req = int(env("BENCH_RECSYS_REQUESTS", "384"))
+    max_batch = int(env("BENCH_RECSYS_MAX_BATCH", "64"))
+    rounds = int(env("BENCH_RECSYS_ROUNDS", "3"))
+    zipf_hot = float(env("BENCH_RECSYS_ZIPF_HOT", "1.2"))
+    zipf_cold = float(env("BENCH_RECSYS_ZIPF_COLD", "0.8"))
+    hit_floor = float(env("BENCH_RECSYS_HIT_FLOOR", "0.5"))
+    # feed pool wide enough that the distinct-id working set overflows
+    # the hot-row cache — otherwise both skews cache fully and the
+    # hot/cold contrast (the leg's reason for two phases) is muted
+    pool = int(env("BENCH_RECSYS_FEED_POOL", "512"))
+
+    pred, shapes = build_recsys_predictor(
+        num_sparse=slots, num_dense=dense, vocab=vocab, embed_dim=dim,
+        shards=shards, cache_rows=cache_rows)
+    # thousands-of-QPS small feeds ride the fan-in bucket ladder: tight
+    # pow2 rungs at the small end where recsys batches actually land
+    buckets = batcher.fanin_bucket_sizes(max_batch)
+    engine = ServingEngine(pred, workers=2, max_batch=max_batch,
+                           buckets=buckets, max_delay_ms=2.0,
+                           queue_cap=4 * n_req, deadline_ms=60000.0,
+                           warmup_shapes=shapes)
+    cache = pred.table.cache
+    t_wall = [0.0]
+
+    def phase(skew, seed):
+        make_feed = lg.recsys_feed_maker(slots, dense, vocab,
+                                         zipf=skew, rows=1, seed=seed,
+                                         pool_size=pool)
+        # untimed warm round: pays the gather-pad + bucket compiles so
+        # the measured rounds see steady state (the p10/p90 spread is
+        # the gate's noise floor — a compile round would drown it)
+        lg.run_closed_loop(engine, make_feed, n_req,
+                           concurrency=2 * max_batch)
+        # per-phase hit rate = hit delta over probe delta from a cold
+        # cache, so neither the warm round's residency nor the other
+        # skew's can pollute it
+        cache.flush()
+        s0 = cache.stats()
+        reps = [lg.run_closed_loop(engine, make_feed, n_req,
+                                   concurrency=2 * max_batch)
+                for _ in range(rounds)]
+        t_wall[0] += sum(r["wall_s"] for r in reps)
+        s1 = cache.stats()
+        probes = (s1["hits"] - s0["hits"]) \
+            + (s1["misses"] - s0["misses"])
+        hr = round((s1["hits"] - s0["hits"]) / probes, 4) \
+            if probes else None
+        return reps, hr
+
+    try:
+        hot_reps, hot_hr = phase(zipf_hot, seed=0)
+        cold_reps, cold_hr = phase(zipf_cold, seed=1)
+    finally:
+        engine.close()
+
+    hot_qps = [r["qps"] for r in hot_reps]
+    med = float(np.median(hot_qps))
+    emb = pred.embedding_stats()
+    rows_per_sec = round(emb["counters"]["rows"] / max(t_wall[0], 1e-9),
+                         1)
+    # gather-path efficiency: rows/sec against the largest compiled
+    # signature's manifest.  The gather is a pure memory op, so
+    # bw_util is the meaningful number (mfu ~0 by construction)
+    ginfo = pred.table.gather_cache_info()
+    manifests = ginfo.get("manifests") or {}
+    gather = {"compiled": ginfo.get("compiled"),
+              "signatures": ginfo.get("signatures")}
+    if manifests:
+        sig = max(manifests, key=lambda k: int(k.rsplit("pad", 1)[1]))
+        man = manifests[sig]
+        pad = int(sig.rsplit("pad", 1)[1])
+        flops_per_row = (man.get("flops") or 0.0) / pad
+        gather["signature"] = sig
+        gather["manifest"] = man
+        if man:
+            gather["efficiency"] = _efficiency_block(
+                rows_per_sec, flops_per_row, man, jax.devices()[0],
+                samples_per_exec=pad)
+    device = jax.devices()[0]
+    return {
+        "metric": "recsys_closed_loop_qps",
+        "value": round(med, 2),
+        "unit": "requests/sec",
+        "device_kind": getattr(device, "device_kind", str(device)),
+        "stats": {"rounds": rounds, "median": round(med, 2),
+                  "p10": round(float(np.percentile(hot_qps, 10)), 2),
+                  "p90": round(float(np.percentile(hot_qps, 90)), 2),
+                  "min": round(min(hot_qps), 2),
+                  "max": round(max(hot_qps), 2)},
+        "p99_ms": float(np.median(
+            [r["latency_ms"].get("p99", 0.0) for r in hot_reps])),
+        "hit_rate": {"hot": hot_hr, "cold": cold_hr},
+        "hit_floor": hit_floor,
+        "degraded_lookups": emb["counters"]["degraded"],
+        "rows_per_sec": rows_per_sec,
+        "qps_rounds": {"hot": hot_qps,
+                       "cold": [r["qps"] for r in cold_reps]},
+        "gather": gather,
+        "embedding": emb,
+        "closed_hot": hot_reps[-1],
+        "config": {"slots": slots, "dense": dense, "vocab": vocab,
+                   "dim": dim, "shards": shards,
+                   "cache_rows": cache_rows, "requests": n_req,
+                   "max_batch": max_batch, "rounds": rounds,
+                   "buckets": list(buckets), "feed_pool": pool,
+                   "zipf": {"hot": zipf_hot, "cold": zipf_cold}},
     }
 
 
@@ -1963,7 +2103,8 @@ def run_chaos():
     duration_s = float(env("BENCH_CHAOS_DURATION_S", "6"))
     scenarios = tuple(s for s in env("BENCH_CHAOS_SCENARIOS",
                                      "baseline,crash,hang,slow,"
-                                     "poison,disagg_crash,hot_swap"
+                                     "poison,disagg_crash,"
+                                     "embedding_shard_crash,hot_swap"
                                      ).split(",")
                       if s)
     report = chaos.run_chaos(replicas=replicas, qps=qps,
@@ -1984,6 +2125,7 @@ def run_chaos():
         "poison_leaks": totals["poison_leaks"],
         "alert_errors": totals.get("alert_errors"),
         "leaked_pages": totals.get("leaked_pages"),
+        "leaked_rows": totals.get("leaked_rows"),
         "p99_under_fault_ms": report["p99_under_fault_ms"],
         "requests": totals["requests"],
         "ok_requests": totals["ok"],
@@ -2251,6 +2393,15 @@ def main():
             except Exception as e:
                 out["legs"]["serving"] = {"error": f"{type(e).__name__}: "
                                                    f"{e}"}
+        # recommender-serving leg: ep-sharded embedding lookups +
+        # hot-row cache under zipfian small feeds (BENCH_RECSYS=0
+        # skips)
+        if os.environ.get("BENCH_RECSYS", "1") == "1":
+            try:
+                out["legs"]["wide_deep_recsys"] = run_recsys()
+            except Exception as e:
+                out["legs"]["wide_deep_recsys"] = {
+                    "error": f"{type(e).__name__}: {e}"}
         # sharded-serving leg: dp replica groups + mp weight sharding
         # on the 8-device sim (BENCH_SHARDED=0 skips)
         if os.environ.get("BENCH_SHARDED", "1") == "1":

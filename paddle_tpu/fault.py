@@ -25,22 +25,28 @@ them through :func:`maybe_delay`.
 Sites are names agreed between the injector and the instrumented code;
 the ones wired in-tree:
 
-    =============  ================================  ===================
-    site           instrumented in                   kinds understood
-    =============  ================================  ===================
-    ckpt_write     checkpoint.save_checkpoint        raise | torn | partial
-    loss           train_guard.TrainGuard.step       nan
-    step           train_guard.TrainGuard.step       sigterm
-    metrics_write  telemetry exporters               raise
-    serve_request  serving/engine.py submit          shed | fail
-    serve_batch    serving/engine.py _run_batch      fail | delay:ms | hang
-    prefill        serving/generation.py _prefill    fail | delay:ms | hang
-    decode_step    serving/generation.py decode      fail | delay:ms | hang
-    replica_health serving/server.py /healthz        fail | delay:ms | hang
-    router_forward serving/router.py route           fail | delay:ms | hang
-    weight_swap    inference.py swap commit          fail | delay:ms
-    blackbox_dump  blackbox.py postmortem write      raise
-    =============  ================================  ===================
+    ================  ================================  ===================
+    site              instrumented in                   kinds understood
+    ================  ================================  ===================
+    ckpt_write        checkpoint.save_checkpoint        raise | torn | partial
+    loss              train_guard.TrainGuard.step       nan
+    step              train_guard.TrainGuard.step       sigterm
+    metrics_write     telemetry exporters               raise
+    serve_request     serving/engine.py submit          shed | fail
+    serve_batch       serving/engine.py _run_batch      fail | delay:ms | hang
+    prefill           serving/generation.py _prefill    fail | delay:ms | hang
+    decode_step       serving/generation.py decode      fail | delay:ms | hang
+    replica_health    serving/server.py /healthz        fail | delay:ms | hang
+    router_forward    serving/router.py route           fail | delay:ms | hang
+    weight_swap       inference.py swap commit          fail | delay:ms
+    blackbox_dump     blackbox.py postmortem write      raise
+    embedding_gather  serving/embedding.py lookup       fail | delay:ms
+    ================  ================================  ===================
+
+    (``embedding_gather:fail`` does NOT raise: the tier's degradation
+    contract serves the affected shard's rows from cache/default-row
+    and books ``serving_embedding_degraded`` — the injected fault
+    proves degraded-not-failed end to end.)
 
 Every fired fault bumps ``faults_injected`` plus a per-site/kind
 ``fault_<site>_<kind>`` counter.

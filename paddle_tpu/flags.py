@@ -307,6 +307,38 @@ register_flag("FLAGS_serving_poison_value", "",
               "stand-in for an input that crashes the model kernel, "
               "used by the bisection fault matrix and tools/chaos.py; "
               "empty disables (the serve path pays nothing)")
+register_flag("FLAGS_embedding_shards", 0,
+              "recommender serving tier (paddle_tpu/serving/embedding.py):"
+              " number of row shards the embedding table splits into "
+              "across the ep device ring (shards cycle the local devices "
+              "when they outnumber them, so a larger-than-HBM table "
+              "still places).  0 = one shard per local device")
+register_flag("FLAGS_embedding_placement", "mod",
+              "embedding tier row-placement rule: 'mod' stripes row r "
+              "onto shard r %% shards (uniform under any id "
+              "distribution — the default), 'range' gives shard s the "
+              "contiguous block [s*ceil(vocab/shards), ...) (locality "
+              "for range-partitioned id spaces).  Both reassemble "
+              "bit-exact vs the unsharded table")
+register_flag("FLAGS_embedding_cache_rows", 4096,
+              "embedding tier hot-row cache capacity in ROWS (refcounted"
+              " LRU fronting the shard gathers, PrefixIndex-style): a "
+              "hit skips the device gather for that id; eviction only "
+              "takes rows no in-flight lookup has pinned.  0 disables "
+              "the cache (every id gathers)")
+register_flag("FLAGS_serving_recsys_max_batch", 64,
+              "default ServingEngine max_batch for --recsys replicas "
+              "(the many-small-requests regime wants a much larger "
+              "fan-in than the dense default FLAGS_serving_max_batch): "
+              "thousands of 1-row lookup-dominated requests amortize "
+              "into few large gathers")
+register_flag("FLAGS_serving_recsys_fanin", True,
+              "recsys replicas batch over the fan-in bucket ladder "
+              "(batcher.fanin_bucket_sizes: dense powers of two up to 8,"
+              " then sparse 4x jumps to max_batch) instead of the full "
+              "power-of-two ladder — fewer mid-ladder executables where "
+              "tiny-request traffic never lands; 0 restores pow2 "
+              "buckets")
 register_flag("FLAGS_serving_worker_stuck_ms", 10000.0,
               "serving engine: a dispatch worker whose current batch has "
               "been executing longer than this reports status 'stuck' "

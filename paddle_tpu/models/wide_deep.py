@@ -12,16 +12,20 @@ hashed into one shared table space) + ``num_dense`` continuous features.
   * logit = wide + deep; loss = sigmoid cross entropy; metric = AUC.
 
 With ``is_sparse=True`` (the default) the embedding tables take the
-lookup_table sparse path, so under fleet PS mode they are transpiled to
-server-resident tables (distributed/ps/worker.py) and the declared vocab
-can exceed device HBM — set ``is_distributed=True`` for the
-lazy-initialized LARGE_VOCAB server tables.
+lookup_table sparse path so the declared vocab can exceed one device's
+HBM.  ``is_distributed=True`` marks the tables for OUT-OF-GRAPH
+residency: on this stack that no longer means PS transpilation — the
+serving path row-shards the table across the local device ring via
+``serving/embedding.py`` (``ShardedEmbeddingTable``; ``mod``/``range``
+placement, hot-row cache), and :func:`wide_deep_serving_net` is the
+dense remainder that runs AFTER the tier's gather.  Training-side
+lookups stay in-graph regardless of the flag.
 """
 from __future__ import annotations
 
 from .. import layers
 
-__all__ = ["wide_deep_net"]
+__all__ = ["wide_deep_net", "wide_deep_serving_net"]
 
 
 def wide_deep_net(num_sparse: int = 26, num_dense: int = 13,
@@ -63,3 +67,37 @@ def wide_deep_net(num_sparse: int = 26, num_dense: int = 13,
         layers.sigmoid_cross_entropy_with_logits(logit, label))
     return {"sparse_ids": sparse_ids, "dense_x": dense_x, "label": label,
             "logit": logit, "prob": prob, "loss": loss}
+
+
+def wide_deep_serving_net(num_sparse: int = 26, num_dense: int = 13,
+                          embed_dim: int = 10,
+                          hidden: (tuple) = (400, 400, 400)):
+    """The dense remainder of Wide&Deep for the serving tier: identical
+    math to :func:`wide_deep_net` AFTER the embedding lookups, fed the
+    already-gathered rows instead of ids.  The tier
+    (``serving/embedding.py``) gathers one fused ``[vocab, 1+embed_dim]``
+    row per id and feeds ``wide_rows`` (``[b, num_sparse, 1]``, the wide
+    column) and ``deep_rows`` (``[b, num_sparse, embed_dim]``) here —
+    so sharding/caching can never perturb the model: the graph below is
+    the same fc/concat/sigmoid pipeline either way."""
+    wide_rows = layers.data("wide_rows", shape=[num_sparse, 1],
+                            dtype="float32", append_batch_size=True)
+    deep_rows = layers.data("deep_rows", shape=[num_sparse, embed_dim],
+                            dtype="float32", append_batch_size=True)
+    dense_x = layers.data("dense_x", shape=[num_dense], dtype="float32",
+                          append_batch_size=True)
+
+    wide_sum = layers.reduce_sum(wide_rows, dim=1)       # [b, 1]
+    wide_dense = layers.fc(dense_x, size=1, name="wide_fc")
+    wide_logit = wide_sum + wide_dense
+
+    flat = layers.flatten(deep_rows, axis=1)     # [b, num_sparse*dim]
+    x = layers.concat([flat, dense_x], axis=1)
+    for i, h in enumerate(hidden):
+        x = layers.fc(x, size=h, act="relu", name=f"deep_fc{i}")
+    deep_logit = layers.fc(x, size=1, name="deep_out")
+
+    logit = wide_logit + deep_logit
+    prob = layers.sigmoid(logit)
+    return {"wide_rows": wide_rows, "deep_rows": deep_rows,
+            "dense_x": dense_x, "logit": logit, "prob": prob}

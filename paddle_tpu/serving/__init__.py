@@ -23,6 +23,9 @@ from . import batcher  # noqa
 from .disagg import (DeviceTransport, DisaggPair,  # noqa
                      HostBytesTransport, KVSegment, SegmentMismatch,
                      SegmentTransport)
+from .embedding import (EmbeddingPredictor, HotRowCache,  # noqa
+                        RowSharding, ShardedEmbeddingTable,
+                        build_recsys_predictor)
 from .engine import (OverloadedError, PoisonedInput, RequestFailed,  # noqa
                      ServingEngine, ServingError, ServingFuture)
 from .fleet import FleetSupervisor  # noqa
@@ -39,4 +42,6 @@ __all__ = ["ServingEngine", "ServingError", "OverloadedError",
            "ShardedPredictor", "serving_shard_rules", "Router",
            "RouterServer", "serve_router", "FleetSupervisor",
            "KVSegment", "SegmentMismatch", "SegmentTransport",
-           "DeviceTransport", "HostBytesTransport", "DisaggPair"]
+           "DeviceTransport", "HostBytesTransport", "DisaggPair",
+           "RowSharding", "HotRowCache", "ShardedEmbeddingTable",
+           "EmbeddingPredictor", "build_recsys_predictor"]

@@ -27,10 +27,10 @@ from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["bucket_sizes", "bucket_for", "signature_of",
-           "describe_signature", "pad_stack", "split_rows", "fill_pct",
-           "prompt_buckets", "prompt_bucket_for", "pad_prompt",
-           "chunk_spans"]
+__all__ = ["bucket_sizes", "fanin_bucket_sizes", "bucket_for",
+           "signature_of", "describe_signature", "pad_stack",
+           "split_rows", "fill_pct", "prompt_buckets",
+           "prompt_bucket_for", "pad_prompt", "chunk_spans"]
 
 
 def bucket_sizes(max_batch: int) -> Tuple[int, ...]:
@@ -44,6 +44,28 @@ def bucket_sizes(max_batch: int) -> Tuple[int, ...]:
     while b < max_batch:
         sizes.add(b)
         b *= 2
+    return tuple(sorted(sizes))
+
+
+def fanin_bucket_sizes(max_batch: int,
+                       dense_to: int = 8) -> Tuple[int, ...]:
+    """Bucket ladder for the many-small-requests (recsys fan-in)
+    regime: dense powers of two up to ``dense_to`` (singleton probes
+    and tiny feeds still find a snug bucket), then strides of 4x
+    (large fan-in batches tolerate more padding, and each bucket is a
+    compiled executable — a pow2 ladder to 256 is 9 executables, this
+    one is 7 with better top-end spacing).  max_batch=256, dense_to=8
+    -> (1, 2, 4, 8, 32, 128, 256); ``max_batch`` always included so a
+    full fan-in batch never pads."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if dense_to < 1:
+        raise ValueError(f"dense_to must be >= 1, got {dense_to}")
+    sizes = {max_batch}
+    b = 1
+    while b < max_batch:
+        sizes.add(b)
+        b *= 2 if b < dense_to else 4
     return tuple(sorted(sizes))
 
 

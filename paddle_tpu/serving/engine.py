@@ -111,7 +111,7 @@ import os
 import signal
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -267,10 +267,15 @@ class ServingEngine:
                  warmup_shapes=None, autostart: bool = True,
                  share_executables: bool = True,
                  pool: Optional[List] = None,
-                 ready_requires_warmup: bool = False):
+                 ready_requires_warmup: bool = False,
+                 buckets: Optional[Sequence[int]] = None):
         from ..inference import Predictor
 
-        if not isinstance(predictor, Predictor):
+        if not isinstance(predictor, Predictor) and \
+                not getattr(predictor, "predictor_like", False):
+            # duck-typed predictors (EmbeddingPredictor: the recsys
+            # tier front) already speak the contract; everything else
+            # (a program, a save_inference_model dir) gets wrapped
             predictor = Predictor(predictor)
         self._base = predictor
         if pool is not None:
@@ -284,7 +289,16 @@ class ServingEngine:
                                or 1)
         self.max_batch = int(max_batch if max_batch is not None
                              else flag_value("FLAGS_serving_max_batch"))
-        self.buckets = batcher.bucket_sizes(self.max_batch)
+        if buckets is not None:
+            # explicit bucket ladder (recsys replicas pass the fan-in
+            # ladder from batcher.fanin_bucket_sizes); the top bucket
+            # IS the batch ceiling
+            self.buckets = tuple(sorted({int(b) for b in buckets}))
+            if not self.buckets or self.buckets[0] < 1:
+                raise ValueError(f"bad bucket ladder {buckets!r}")
+            self.max_batch = self.buckets[-1]
+        else:
+            self.buckets = batcher.bucket_sizes(self.max_batch)
         delay = (max_delay_ms if max_delay_ms is not None
                  else flag_value("FLAGS_serving_max_delay_ms"))
         self._max_delay_s = float(delay) / 1e3
@@ -531,10 +545,17 @@ class ServingEngine:
     def _feed_dtypes(self) -> List:
         dts = getattr(self, "_feed_dtypes_cache", None)
         if dts is None:
-            from ..framework.core import dtype_to_np
-            dts = self._feed_dtypes_cache = [
-                dtype_to_np(self._base._block.var(n).dtype)
-                for n in self._base.feed_names]
+            declared = getattr(self._base, "feed_dtypes", None)
+            if declared is not None:
+                # duck-typed predictors declare dtypes directly — an
+                # EmbeddingPredictor's sparse_ids feed has no program
+                # block var (the lookup happens outside the graph)
+                dts = self._feed_dtypes_cache = list(declared())
+            else:
+                from ..framework.core import dtype_to_np
+                dts = self._feed_dtypes_cache = [
+                    dtype_to_np(self._base._block.var(n).dtype)
+                    for n in self._base.feed_names]
         return dts
 
     def coerce_feed(self, feed) -> List[np.ndarray]:
@@ -1472,6 +1493,10 @@ class ServingEngine:
         }
         if self.generator is not None:
             out["generator"] = self.generator.introspect()
+        emb = getattr(self._base, "embedding_stats", None)
+        if emb is not None:
+            out["capabilities"] = ["embedding"]
+            out["embedding"] = emb()
         return out
 
     def health(self) -> dict:
@@ -1515,4 +1540,11 @@ class ServingEngine:
             # the disagg role, top-level: the router's affinity
             # placement reads it off every health poll
             out["role"] = getattr(self.generator, "role", "both")
+        emb = getattr(self._base, "embedding_stats", None)
+        if emb is not None:
+            # the capability list, top-level: the router learns it off
+            # every health poll exactly like the disagg role, and
+            # steers sparse-id requests to replicas that carry it
+            out["capabilities"] = ["embedding"]
+            out["embedding"] = emb()
         return out

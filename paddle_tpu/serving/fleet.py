@@ -179,10 +179,11 @@ class FleetSupervisor:
                 raise ValueError(f"roles has {len(roles)} entries for "
                                  f"{self.n} replicas")
             bad = [r for r in roles
-                   if r not in ("both", "prefill", "decode")]
+                   if r not in ("both", "prefill", "decode",
+                                "embedding")]
             if bad:
                 raise ValueError(f"unknown role(s) {bad}; want "
-                                 f"both|prefill|decode")
+                                 f"both|prefill|decode|embedding")
         self.roles = list(roles) if roles is not None else None
         self.replica_argv = list(replica_argv or [])
         self.env = dict(env or {})
@@ -223,7 +224,12 @@ class FleetSupervisor:
         cmd = [sys.executable, "-u", "-m", "paddle_tpu.serving.replica",
                "--endpoint-file", rep.endpoint_file,
                "--port", str(rep.port or 0), *self.replica_argv]
-        if rep.role is not None:
+        if rep.role == "embedding":
+            # fleet-level role -> replica-level capability: the recsys
+            # replica has no disagg role (its /healthz carries the
+            # 'embedding' capability instead; the router steers by it)
+            cmd += ["--recsys"]
+        elif rep.role is not None:
             cmd += ["--role", rep.role]
         env = dict(self.env)
         env.update({

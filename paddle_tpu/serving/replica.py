@@ -109,6 +109,17 @@ def build_synthetic_checkpoint(dirname: str, *, feat: int = 64,
 
 def build_predictor(args):
     """(predictor, per_row_shapes) from the CLI args."""
+    if getattr(args, "recsys", False):
+        # Wide&Deep recsys replica: the sharded embedding tier + dense
+        # remainder.  The replica advertises the `embedding` capability
+        # in /healthz (the router steers sparse_ids requests here)
+        from .embedding import build_recsys_predictor
+        return build_recsys_predictor(
+            num_sparse=args.rec_slots, num_dense=args.rec_dense,
+            vocab=args.rec_vocab, embed_dim=args.rec_dim,
+            hidden=tuple(int(h) for h in args.rec_hidden.split(",") if h),
+            seed=args.seed, shards=args.rec_shards,
+            cache_rows=args.rec_cache_rows)
     if args.model_dir:
         from ..inference import Predictor
         shapes = _parse_shapes(args.shape)
@@ -210,6 +221,28 @@ def main(argv=None) -> int:
     ap.add_argument("--gen-spec-tokens", type=int, default=None,
                     help="max draft tokens per verify (default "
                          "FLAGS_serving_spec_tokens)")
+    ap.add_argument("--recsys", action="store_true",
+                    help="serve the Wide&Deep recsys path: sparse_ids+"
+                         "dense_x feed through the ep-sharded embedding "
+                         "tier (see README 'Recommender serving'); the "
+                         "replica advertises the 'embedding' capability "
+                         "in /healthz and batches over the fan-in "
+                         "bucket ladder")
+    ap.add_argument("--rec-slots", type=int, default=26,
+                    help="sparse slots per example (Criteo: 26)")
+    ap.add_argument("--rec-dense", type=int, default=13,
+                    help="dense features per example (Criteo: 13)")
+    ap.add_argument("--rec-vocab", type=int, default=100000)
+    ap.add_argument("--rec-dim", type=int, default=8,
+                    help="deep embedding dim (wide column rides fused)")
+    ap.add_argument("--rec-hidden", default="64,32",
+                    help="comma-separated deep MLP widths")
+    ap.add_argument("--rec-shards", type=int, default=None,
+                    help="embedding shard count (default "
+                         "FLAGS_embedding_shards; 0 = one per device)")
+    ap.add_argument("--rec-cache-rows", type=int, default=None,
+                    help="hot-row cache capacity (default "
+                         "FLAGS_embedding_cache_rows)")
     args = ap.parse_args(argv)
 
     from .. import blackbox
@@ -229,11 +262,24 @@ def main(argv=None) -> int:
     if args.poison_value:
         set_flags({"FLAGS_serving_poison_value": args.poison_value})
     predictor, shapes = build_predictor(args)
+    buckets = None
+    max_batch = args.max_batch
+    if args.recsys:
+        # thousands-of-QPS tiny-feed regime: wider default batch
+        # ceiling + the fan-in bucket ladder (dense at the bottom for
+        # singleton probes, 4x strides at the top for big fan-ins)
+        from ..flags import flag_value
+        from . import batcher
+        if max_batch is None:
+            max_batch = int(
+                flag_value("FLAGS_serving_recsys_max_batch") or 64)
+        if flag_value("FLAGS_serving_recsys_fanin"):
+            buckets = batcher.fanin_bucket_sizes(max_batch)
     engine = ServingEngine(
-        predictor, workers=args.workers, max_batch=args.max_batch,
+        predictor, workers=args.workers, max_batch=max_batch,
         max_delay_ms=args.max_delay_ms, queue_cap=args.queue_cap,
         deadline_ms=args.deadline_ms,
-        ready_requires_warmup=not args.no_warmup_gate)
+        ready_requires_warmup=not args.no_warmup_gate, buckets=buckets)
     gen = None
     if args.generate:
         from ..flags import flag_value

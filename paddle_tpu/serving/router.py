@@ -247,6 +247,13 @@ class _Replica:
         pre-disagg replica serves the full pipeline)."""
         return (self.health or {}).get("role") or "both"
 
+    def capabilities(self) -> tuple:
+        """Extra serving capabilities learned from /healthz (e.g.
+        'embedding' from recsys replicas) — absent = none.  Learned
+        like the disagg role: off every health poll, never configured
+        router-side."""
+        return tuple((self.health or {}).get("capabilities") or ())
+
     def weights_version(self) -> Optional[int]:
         """The replica's published weights version from its last good
         health poll (None until one lands)."""
@@ -260,9 +267,17 @@ class _Replica:
         A 'decode' hop additionally requires the replica to be
         adopt-capable (paged generation engine) — a dense 'both'
         replica would 404 the /adopt, turning a valid request into a
-        client-visible error."""
+        client-visible error.  Capability steering is symmetric: an
+        'embedding' hop (a /predict body carrying sparse_ids) requires
+        the capability — a dense replica has no sparse_ids feed and
+        would 400 it — and a 'dense' hop excludes embedding replicas,
+        whose only model is the recsys net."""
         if role is None:
             return True
+        if role == "embedding":
+            return "embedding" in self.capabilities()
+        if role == "dense":
+            return "embedding" not in self.capabilities()
         if self.role() not in (role, "both"):
             return False
         if role == "decode":
@@ -291,6 +306,7 @@ class _Replica:
             "url": self.url,
             "ready": self.ready(),
             "role": self.role(),
+            "capabilities": list(self.capabilities()),
             "ejected": self.ejected,
             "stale": self.stale(stale_s) if self.health else True,
             "status": (self.health or {}).get("status"),
@@ -1292,6 +1308,15 @@ class Router:
         return any(r.ready() and r.role() in ("prefill", "decode")
                    for r in self._all())
 
+    def embedding_active(self) -> bool:
+        """True when >= 1 ready replica advertises the 'embedding'
+        capability — only then does the front door steer sparse-id
+        /predict bodies by capability (a capability-free fleet keeps
+        the role-blind path: nothing could serve the hop, so
+        constraining it would just manufacture 503s)."""
+        return any(r.ready() and "embedding" in r.capabilities()
+                   for r in self._all())
+
     @staticmethod
     def _split_generate_body(body: bytes):
         """(prefill_body, max_new_tokens, stream): the prefill hop
@@ -1720,8 +1745,11 @@ class Router:
             auto = dict(self._autoscale)
             canary_active = self._canary is not None
         roles: Dict[str, int] = {}
+        capabilities: Dict[str, int] = {}
         for r in routable:
             roles[r.role()] = roles.get(r.role(), 0) + 1
+            for c in r.capabilities():
+                capabilities[c] = capabilities.get(c, 0) + 1
         return (200 if routable else 503), {
             "status": status,
             "pid": os.getpid(),
@@ -1730,7 +1758,9 @@ class Router:
             "replicas": len(reps),
             "routable": len(routable),
             "roles": roles,
+            "capabilities": capabilities,
             "disagg": self.disagg_active(),
+            "embedding": self.embedding_active(),
             "autoscale": auto,
             "alerts_firing": self.burn_monitor.firing(),
             "canary_active": canary_active,
@@ -2351,8 +2381,20 @@ class _RouterHandler(_JsonHandler):
                 res = self.router.route_generate(
                     body, trace_id, deadline_ms=deadline_ms)
             else:
+                # capability steering: a sparse-id /predict body can
+                # only be served by an embedding-capable replica (byte
+                # probe, not a JSON parse — the body is forwarded
+                # verbatim either way, and a false positive on a
+                # capability-free fleet is impossible: the gate below
+                # requires a live capable replica first)
+                role = None
+                if (route == "/predict"
+                        and self.router.embedding_active()):
+                    role = ("embedding" if b'"sparse_ids"' in body
+                            else "dense")
                 res = self.router.route(route, body, trace_id,
-                                        deadline_ms=deadline_ms)
+                                        deadline_ms=deadline_ms,
+                                        role=role)
             if fwd is not None:
                 fwd.attrs["replica"] = res["replica"]
                 fwd.attrs["retried"] = res["retried"]

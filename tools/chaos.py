@@ -94,6 +94,23 @@ disagg_crash   role-split generation fleet (2 prefill +    router affinity
                                                            replica's page pool
                                                            drains to ZERO live
                                                            pages (no leak)
+embedding_     recsys fleet (3 ``--recsys`` replicas, the  degraded-not-failed:
+shard_crash    ep-sharded embedding tier) under zipfian    fault-hit lookups
+               sparse-id /predict load routed by the       serve cache/default
+               ``embedding`` capability; a fleet-wide      rows and still 200
+               ``embedding_gather:fail~p`` fault degrades  (booked as
+               random shard gathers, then one replica is   ``serving_embedding_
+               SIGKILLed mid-storm                         degraded``, bounded);
+                                                           the kill heals by
+                                                           router retry +
+                                                           supervisor respawn
+                                                           (zero collateral),
+                                                           postmortem attributed,
+                                                           hot-row hit rate
+                                                           reported first-class,
+                                                           and every cache's
+                                                           pinned refcounts
+                                                           drain to ZERO
 hot_swap       rolling ``hot_swap`` weight rollout under   quiesce-and-commit
                mixed /predict + /generate load, then a     swap discipline (zero
                second rollout with one replica SIGKILLed   non-shed failures
@@ -150,7 +167,7 @@ POISON_TOKEN = 7
 
 DEFAULT_SCENARIOS = ("baseline", "crash", "hang", "slow", "poison",
                      "poison_paged", "spec_storm", "disagg_crash",
-                     "hot_swap")
+                     "embedding_shard_crash", "hot_swap")
 
 # burn-rate scaling for the chaos run: scenario durations are seconds,
 # not SRE hours, so the router's alert windows shrink to fractions of
@@ -1081,6 +1098,200 @@ def _scenario_disagg_crash(cfg: dict, log=print) -> dict:
     return rep
 
 
+def _scenario_embedding_shard_crash(cfg: dict, log=print) -> dict:
+    """Recsys-tier containment: a fleet of 3 ``--recsys`` replicas
+    (each running the ep-sharded embedding tier + hot-row cache)
+    serves zipfian sparse-id ``/predict`` traffic steered by the
+    ``embedding`` capability, while (a) a fleet-wide
+    ``embedding_gather:fail~p`` fault degrades random shard gathers
+    in-process and (b) one replica is SIGKILLed mid-storm.
+
+    The contract: (a) zero collateral failures — fault-hit lookups
+    DEGRADE (cache/default rows, still 200, booked as
+    ``serving_embedding_degraded``) instead of failing, and the kill's
+    failures lie inside its window (router connect-refused retry +
+    supervisor respawn); (b) degraded service is bounded and counted —
+    degraded rows > 0 (the fault really fired) and <= ``bound_pct`` of
+    all looked-up rows (degradation must not swallow the feed);
+    (c) the kill is harvested and attributed ``signal:SIGKILL``;
+    (d) after the storm drains, EVERY replica's hot-row cache reports
+    zero pinned rows — a leaked pin means a lookup path lost an unpin;
+    (e) the hot-row hit rate rides ``/healthz`` as a first-class stat
+    on every replica (the zipfian load makes it meaningfully > 0)."""
+    import paddle_tpu  # noqa: F401 — flags registered
+    from paddle_tpu.serving import FleetSupervisor, Router, RouterServer
+    from paddle_tpu.serving.fleet import _healthz
+
+    duration = max(float(cfg["duration_s"]), 6.0)
+    qps = float(cfg["qps"])
+    fail_prob = 0.08
+    bound_pct = 30.0
+    roles = ["embedding"] * 3
+    argv = ["--rec-vocab", "2000", "--rec-dim", "4",
+            "--rec-slots", "8", "--rec-dense", "4",
+            "--rec-hidden", "16", "--rec-shards", "4",
+            "--rec-cache-rows", "256",
+            "--queue-cap", "512", "--deadline-ms", "60000"]
+    env = {"FLAGS_fault_inject": f"embedding_gather:fail~{fail_prob}"}
+    error = None
+    notes: Dict[str, object] = {"roles": roles,
+                                "gather_fail_prob": fail_prob,
+                                "degraded_bound_pct": bound_pct}
+    records: List[dict] = []
+    windows: List[tuple] = []
+    leaked = None
+    unexplained = None
+    sup = FleetSupervisor(replicas=3, roles=roles, replica_argv=argv,
+                          env=env, max_restarts=8, backoff_ms=100.0,
+                          liveness_timeout_ms=cfg.get(
+                              "liveness_timeout_ms", 1500.0))
+    server = None
+    try:
+        urls = sup.wait_ready(timeout_s=600)
+        fwd_ms = max(4.0 * float(cfg.get("forward_timeout_ms", 800.0)),
+                     5000.0)
+        router = Router(urls, poll_interval_ms=100.0, stale_ms=1500.0,
+                        eject_after=2, forward_timeout_ms=fwd_ms)
+        server = RouterServer(router).start()
+        router.poll_once()
+        if not router.embedding_active():
+            raise RuntimeError("recsys fleet did not advertise the "
+                               "embedding capability through /healthz")
+        # zipfian recsys bodies — hot ids concentrated enough that the
+        # hot-row cache does real work (the hit-rate assertion below)
+        rng = np.random.RandomState(11)
+        w = 1.0 / np.power(np.arange(1, 2001, dtype=np.float64), 1.2)
+        cdf = np.cumsum(w)
+        cdf /= cdf[-1]
+        bodies = []
+        for _ in range(32):
+            ids = np.searchsorted(
+                cdf, rng.random_sample((1, 8))).astype(np.int64)
+            bodies.append(json.dumps(
+                {"inputs": {"sparse_ids": ids.tolist(),
+                            "dense_x": rng.rand(1, 4).round(4).tolist()
+                            }}).encode())
+        box: Dict[str, Optional[float]] = {}
+        victim = sup._replicas[0]
+        notes["victim"] = victim.url
+
+        def inject():
+            time.sleep(duration * 0.35)
+            old = box["pid"] = victim.proc.pid
+            box["t_kill"] = time.monotonic()
+            try:
+                os.kill(old, signal.SIGKILL)
+            except OSError as e:
+                box["err"] = f"kill: {e}"
+                return
+            box["t_ready"] = _wait_respawned_ready(victim, old)
+
+        injector = threading.Thread(target=inject, daemon=True)
+        injector.start()
+        records = run_traffic(server.url, 8, qps, duration,
+                              timeout_s=cfg.get("timeout_s", 30.0),
+                              workers=8, bodies=bodies)
+        injector.join(timeout=180.0)
+        if box.get("err"):
+            error = box["err"]
+        elif box.get("t_kill") is None:
+            error = "injection never fired the kill"
+        elif box.get("t_ready") is None:
+            error = "victim never respawned ready"
+        else:
+            windows = [(box["t_kill"], box["t_ready"] + 1.0)]
+            notes["recovery_s"] = round(
+                box["t_ready"] - box["t_kill"], 3)
+        # crash-forensics contract for the induced kill
+        if box.get("pid") is not None:
+            death, pm_err = _postmortem_verdict(victim, box["pid"],
+                                                "signal:SIGKILL")
+            notes["postmortem"] = death
+            unexplained = (None if death is None else
+                           int(death["attribution"] == "unexplained"))
+            if error is None and pm_err is not None:
+                error = pm_err
+        # settle, then read every replica's embedding block: degraded
+        # booked + bounded, pinned refcounts drained, hit rate present
+        deadline = time.monotonic() + 60.0
+        emb_view = []
+        settled = False
+        while time.monotonic() < deadline and not settled:
+            emb_view = []
+            for rep_ in sup._replicas:
+                h = _healthz(rep_.url, timeout=2.0) or {}
+                emb = h.get("embedding") or {}
+                hot = emb.get("hot_rows") or {}
+                cnt = emb.get("counters") or {}
+                serving = h.get("serving") or {}
+                emb_view.append({
+                    "url": rep_.url,
+                    "hit_rate": emb.get("hit_rate"),
+                    "pinned": hot.get("pinned"),
+                    "rows_cached": hot.get("rows"),
+                    "evictions": hot.get("evictions"),
+                    "bytes": hot.get("bytes"),
+                    "rows_looked_up": cnt.get("rows"),
+                    "degraded": cnt.get("degraded"),
+                    "degraded_rows": cnt.get("degraded_rows"),
+                    "queue_depth": serving.get("queue_depth")})
+            settled = (len(emb_view) == 3 and all(
+                v["pinned"] == 0 and v["queue_depth"] == 0
+                for v in emb_view))
+            if not settled:
+                time.sleep(0.5)
+        notes["embedding_after"] = emb_view
+        if settled:
+            leaked = 0
+        else:
+            leaked = sum(v["pinned"] or 0 for v in emb_view)
+            if error is None:
+                error = (f"hot-row pins never drained to zero after "
+                         f"the storm: {emb_view}")
+        total_rows = sum(v["rows_looked_up"] or 0 for v in emb_view)
+        degraded_rows = sum(v["degraded_rows"] or 0 for v in emb_view)
+        notes["degraded_rows"] = degraded_rows
+        notes["total_rows"] = total_rows
+        if error is None and degraded_rows == 0:
+            error = ("embedding_gather fault never degraded a row — "
+                     "the degradation path went unexercised")
+        if error is None and total_rows > 0 \
+                and degraded_rows > bound_pct / 100.0 * total_rows:
+            error = (f"degraded rows {degraded_rows} exceed "
+                     f"{bound_pct}% of {total_rows} looked-up rows — "
+                     f"degradation swallowed the feed")
+        # the hit rate must ride /healthz as a first-class stat (and
+        # the zipfian skew makes it really > 0 on the survivors)
+        missing = [v["url"] for v in emb_view if v["hit_rate"] is None]
+        if error is None and missing:
+            error = (f"replicas {missing} report no hot-row hit rate "
+                     f"in /healthz")
+        if error is None and not any(
+                (v["hit_rate"] or 0) > 0 for v in emb_view):
+            error = "no replica measured a non-zero hot-row hit rate"
+    finally:
+        if server is not None:
+            server.close()
+        sup.close()
+
+    rep = classify(records, windows)
+    rep["scenario"] = "embedding_shard_crash"
+    rep["notes"] = notes
+    rep["leaked_rows"] = leaked
+    rep["unexplained_deaths"] = unexplained
+    rep["degraded_rows"] = notes.get("degraded_rows")
+    rep["hit_rates"] = [v["hit_rate"]
+                        for v in notes.get("embedding_after", [])]
+    if "recovery_s" in notes:
+        rep["recovery_s"] = notes["recovery_s"]
+    if error is None and rep["ok"] == 0:
+        error = "no recsys request succeeded (fleet never served)"
+    if error is not None:
+        rep["error"] = error
+    rep["_records"] = records
+    return rep
+
+
 def _scenario_hot_swap(cfg: dict, log=print) -> dict:
     """Hot-swap discipline under fire: a fleet serving MIXED open-loop
     ``/predict`` + ``/generate`` load takes a clean rolling hot-swap,
@@ -1452,6 +1663,11 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
                 # spawned fresh so the kills cannot bleed into the
                 # shared /predict fleet's attribution
                 rep = _scenario_disagg_crash(cfg, log=log)
+            elif name == "embedding_shard_crash":
+                # recsys fleet with its own router: shard-gather
+                # faults + a SIGKILL must degrade (cache/default rows)
+                # rather than fail, with pins drained afterwards
+                rep = _scenario_embedding_shard_crash(cfg, log=log)
             elif name == "hot_swap":
                 # rolling weight swap + mid-swap SIGKILL against its
                 # own fleet (direct per-replica traffic so the torn-
@@ -1461,7 +1677,8 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
                 rep = _scenario(name, sup, router, server.url, cfg)
             records = rep.pop("_records")
             all_records.extend(records)
-            if name in ("crash", "hang", "disagg_crash", "hot_swap"):
+            if name in ("crash", "hang", "disagg_crash",
+                        "embedding_shard_crash", "hot_swap"):
                 fault_records.extend(records)
             per_scenario[name] = rep
             al = rep.get("alerts") or {}
@@ -1508,6 +1725,12 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
     if any("torn_responses" in r for r in per_scenario.values()):
         totals["torn_responses"] = sum(
             r.get("torn_responses") or 0 for r in per_scenario.values())
+    # embedding-tier pin-leak verdict (None when the scenario didn't
+    # run): a row still pinned after the storm means a lookup lost its
+    # unpin — perf_gate hard-zeroes the sum like leaked_pages
+    if any("leaked_rows" in r for r in per_scenario.values()):
+        totals["leaked_rows"] = sum(
+            r.get("leaked_rows") or 0 for r in per_scenario.values())
     # crash-forensics verdict: every induced death must be harvested
     # AND explained.  A per-scenario None means a death was never even
     # booked — that vacuousness propagates to the total (perf_gate
@@ -1561,7 +1784,8 @@ def main(argv=None) -> int:
                     default=",".join(DEFAULT_SCENARIOS),
                     help="comma-separated subset of "
                          "crash,hang,slow,poison,poison_paged,"
-                         "spec_storm,disagg_crash,hot_swap")
+                         "spec_storm,disagg_crash,"
+                         "embedding_shard_crash,hot_swap")
     ap.add_argument("--availability-pct", type=float, default=99.0)
     ap.add_argument("--feat", type=int, default=8)
     ap.add_argument("--hidden", type=int, default=32)

@@ -242,6 +242,18 @@ def compare_leg(name: str, new: dict, base: dict,
                               f"{leaked_pages} KV page(s) live after "
                               f"the storm drained (refcount leak)")
             return res
+        # embedding pin-leak rule (hard, like leaked_pages): a hot
+        # row still pinned after the recsys storm drained means a
+        # lookup path lost its unpin — core contention can slow the
+        # drain, never leak a pin.  None is allowed: captures predate
+        # the embedding_shard_crash scenario
+        leaked_rows = new.get("leaked_rows")
+        if leaked_rows:
+            res.update(status="regression",
+                       reason=f"chaos embedding_shard_crash left "
+                              f"{leaked_rows} hot row(s) pinned after "
+                              f"the storm drained (refcount leak)")
+            return res
         # crash-forensics rule (hard, like collateral/leaks): every
         # induced death must be harvested and attributed — a death
         # the supervisor cannot explain means the flight recorder,
@@ -343,6 +355,45 @@ def compare_leg(name: str, new: dict, base: dict,
                                   f"the {ar_floor} floor on the "
                                   f"repetition-heavy workload (the "
                                   f"drafter or verifier broke)")
+                return res
+    # recsys embedding-tier hard rules, also checked before every
+    # skip: the clean bench keeps every shard alive, so a degraded
+    # lookup is a correctness break (a gather failed mid-leg), and a
+    # present-but-None count is a vacuous window — core contention
+    # can slow lookups, never degrade them.  The hot-row hit-rate
+    # floor rides the leg (like prefix_hit_floor): under it the cache
+    # is dead (hashing/eviction broke) even when throughput keeps up,
+    # and no anomaly flag shields either rule
+    if "degraded_lookups" in new:
+        dl = new.get("degraded_lookups")
+        if dl is None:
+            res.update(status="regression",
+                       reason="recsys leg measured no degraded-lookup "
+                              "count (vacuous window: the embedding "
+                              "tier never booked its counters)")
+            return res
+        if dl > 0:
+            res.update(status="regression",
+                       reason=f"recsys bench saw {dl} degraded "
+                              f"lookup(s) with every shard alive "
+                              f"(contract: zero)")
+            return res
+        hr_floor = new.get("hit_floor")
+        if hr_floor is not None:
+            hr = (new.get("hit_rate") or {}).get("hot")
+            if hr is None:
+                res.update(status="regression",
+                           reason="recsys leg declares a hot-row hit-"
+                                  "rate floor but measured no hot-"
+                                  "phase hit rate (vacuous: the cache "
+                                  "was never probed)")
+                return res
+            if hr < float(hr_floor):
+                res.update(status="regression",
+                           reason=f"recsys hot-row hit rate {hr} "
+                                  f"under the {hr_floor} floor on the "
+                                  f"zipfian hot workload (the hot-row "
+                                  f"cache is dead)")
                 return res
     nk, bk = new.get("device_kind"), base.get("device_kind")
     if nk is not None and bk is not None and nk != bk:
@@ -849,6 +900,74 @@ def run_smoke() -> int:
     check("spec vacuous-leak-count fails", not r["ok"] and any(
         x["status"] == "regression"
         and "vacuous drain" in x.get("reason", "")
+        for x in r["legs"]))
+
+    # recsys leg (synthetic until a BENCH_r* capture carries it):
+    # generic noise gate + the degraded-lookup hard zero + the hot-row
+    # hit-rate floor (both of which no anomaly flag shields)
+    recsys_leg = {
+        "metric": "recsys_closed_loop_qps",
+        "value": 1800.0, "unit": "requests/sec", "device_kind": "cpu",
+        "stats": {"rounds": 3, "median": 1800.0, "p10": 1700.0,
+                  "p90": 1900.0, "min": 1650.0, "max": 1950.0},
+        "p99_ms": 18.0,
+        "hit_rate": {"hot": 0.82, "cold": 0.41}, "hit_floor": 0.5,
+        "degraded_lookups": 0,
+    }
+    with_rec = json.loads(json.dumps(latest))
+    with_rec.setdefault("legs", {})["wide_deep_recsys"] = recsys_leg
+    r = compare_bench(with_rec, docs + [with_rec])
+    check("recsys self-compare passes", r["ok"],
+          json.dumps([x for x in r["legs"]
+                      if x["status"] == "regression"]))
+    r = compare_bench(_degrade(with_rec, 0.70), docs + [with_rec])
+    check("recsys 30%-degraded fails", not r["ok"])
+    degraded_rec = json.loads(json.dumps(with_rec))
+    degraded_rec["legs"]["wide_deep_recsys"]["degraded_lookups"] = 3
+    # an anomaly flag must NOT shield a degraded-lookup break
+    degraded_rec["legs"]["wide_deep_recsys"]["anomaly"] = \
+        "core-bound host"
+    r = compare_bench(degraded_rec, docs + [with_rec])
+    check("recsys degraded-lookups fails even when anomalous",
+          not r["ok"] and any(
+              x["status"] == "regression"
+              and "degraded lookup" in x.get("reason", "")
+              for x in r["legs"]))
+    vac_degraded = json.loads(json.dumps(with_rec))
+    vac_degraded["legs"]["wide_deep_recsys"]["degraded_lookups"] = None
+    r = compare_bench(vac_degraded, docs + [with_rec])
+    check("recsys vacuous-degraded-count fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "vacuous window" in x.get("reason", "")
+        for x in r["legs"]))
+    dead_cache = json.loads(json.dumps(with_rec))
+    dead_cache["legs"]["wide_deep_recsys"]["hit_rate"]["hot"] = 0.3
+    r = compare_bench(dead_cache, docs + [with_rec])
+    check("recsys dead-hot-row-cache fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "hot-row hit rate" in x.get("reason", "")
+        for x in r["legs"]))
+    vac_hit = json.loads(json.dumps(with_rec))
+    vac_hit["legs"]["wide_deep_recsys"]["hit_rate"]["hot"] = None
+    r = compare_bench(vac_hit, docs + [with_rec])
+    check("recsys vacuous-hit-rate fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "never probed" in x.get("reason", "") for x in r["legs"]))
+    # chaos embedding pin-leak rule rides the chaos leg's counters
+    # (synthetic leg: no checked-in capture carries one yet)
+    chaos_rec = json.loads(json.dumps(latest))
+    chaos_rec.setdefault("legs", {})["chaos"] = {
+        "metric": "chaos_availability_pct", "value": 100.0,
+        "unit": "percent", "device_kind": "cpu",
+        "stats": {"rounds": 1, "median": 100.0, "p10": 100.0,
+                  "p90": 100.0, "min": 100.0, "max": 100.0},
+        "collateral_failures": 0, "poison_leaks": 0,
+        "leaked_rows": 2,
+    }
+    r = compare_bench(chaos_rec, docs + [chaos_rec])
+    check("chaos leaked-rows fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "pinned after" in x.get("reason", "")
         for x in r["legs"]))
 
     # sharded-serving leg (synthetic capable-host fixture: the 2-core

@@ -75,10 +75,23 @@ With ``--url`` the same workload posts ``/generate`` against a live
 replica or fleet router and the report embeds the target's
 ``/statusz`` generation block (prefix-hit rate included).
 
-Used by ``bench.py run_serving``/``run_decode``/``run_paged_decode``
-(the ``legs.serving``, ``legs.llama_decode`` and
-``legs.llama_paged_decode`` entries), ``tests/test_serving.py``,
-``tests/test_generation.py``, and ``tests/test_paged_generation.py``.
+**Recsys mode** (``--recsys``): drives the Wide&Deep recommender path
+— zipfian int64 ``sparse_ids`` (``--rec-slots/--rec-vocab/--rec-zipf``
+shape the skew; ~1.2 is recommender-hot, 0 is uniform/cache-hostile)
+plus dense features, served through the ep-sharded embedding tier
+(:mod:`paddle_tpu.serving.embedding`) behind a fan-in-bucketed engine.
+The report embeds the tier's LIVE hot-row cache hit rate (top-level
+``hit_rate`` + the full ``embedding`` stats block; with ``--url`` it
+reads the target's ``/statusz``), and ``--slo-hit-rate`` floors it —
+an unmeasured floor is a violation, matching the acceptance-rate
+precedent.
+
+Used by ``bench.py run_serving``/``run_decode``/``run_paged_decode``/
+``run_recsys`` (the ``legs.serving``, ``legs.llama_decode``,
+``legs.llama_paged_decode`` and ``legs.wide_deep_recsys`` entries),
+``tests/test_serving.py``, ``tests/test_generation.py``,
+``tests/test_paged_generation.py``, and
+``tests/test_recsys_serving.py``.
 """
 from __future__ import annotations
 
@@ -135,6 +148,39 @@ def feed_maker(shapes: Dict[str, tuple], rows: int = 1,
     for _ in range(16):
         pool.append({n: rng.rand(rows, *s).astype("float32")
                      for n, s in shapes.items()})
+    return lambda i: pool[i % len(pool)]
+
+
+def zipf_ids(rng, vocab: int, size, s: float) -> np.ndarray:
+    """Bounded zipfian id sampler: ids 0..vocab-1 with
+    P(rank k) ∝ 1/(k+1)^s via inverse-CDF — unlike np.random.zipf
+    this is bounded to the vocab (no rejection loop), works for any
+    s >= 0 (s=0 = uniform), and is deterministic under the seeded
+    ``rng``.  The skew knob is what makes the hot-row cache testable:
+    s≈1.2 concentrates most probability mass in a few hundred ids
+    (recommender reality), s≈0 spreads it flat (cache-hostile)."""
+    w = 1.0 / np.power(np.arange(1, vocab + 1, dtype=np.float64), s)
+    cdf = np.cumsum(w)
+    cdf /= cdf[-1]
+    return np.searchsorted(cdf,
+                           rng.random_sample(size)).astype(np.int64)
+
+
+def recsys_feed_maker(slots: int, dense: int, vocab: int,
+                      zipf: float = 1.2, rows: int = 1, seed: int = 0,
+                      pool_size: int = 64) -> Callable[[int], dict]:
+    """Per-request recsys feed factory: zipfian int64 ``sparse_ids``
+    (``[rows, slots]``) + uniform float32 ``dense_x`` (``[rows,
+    dense]``), pre-generated and cycled like :func:`feed_maker`.  The
+    pool is larger than the dense maker's (64 vs 16): the hit-rate
+    measurement needs enough DISTINCT hot ids in flight that the cache
+    is doing real work, not replaying 16 memoized feeds."""
+    rng = np.random.RandomState(seed)
+    pool = []
+    for _ in range(pool_size):
+        pool.append({
+            "sparse_ids": zipf_ids(rng, vocab, (rows, slots), zipf),
+            "dense_x": rng.rand(rows, dense).astype("float32")})
     return lambda i: pool[i % len(pool)]
 
 
@@ -1119,7 +1165,8 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
               ttft_ms: Optional[float] = None,
               itl_ms: Optional[float] = None,
               expect_version: Optional[int] = None,
-              accept_rate: Optional[float] = None) -> dict:
+              accept_rate: Optional[float] = None,
+              hit_rate: Optional[float] = None) -> dict:
     """Evaluate the SLO against one report (recursing into the nested
     closed/open halves of ``--mode both``).  Returns
     ``{"p99_ms_limit", "shed_pct_limit", "violations": [...], "ok"}``;
@@ -1143,7 +1190,11 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
     rate the report embedded from the engine's live stats
     (``spec_acceptance_rate``); a bound given against a report that
     never measured it (speculation off, or a server without the
-    stats block) is a violation — never a vacuous pass."""
+    stats block) is a violation — never a vacuous pass.  ``hit_rate``
+    floors the hot-row cache hit rate a ``--recsys`` run embedded
+    from the embedding tier's live stats (in-process engine stats, or
+    the target's ``/statusz`` embedding block over HTTP); exactly the
+    acceptance-rate precedent — an unmeasured bound is a violation."""
     violations = []
 
     def _versions(rep: dict, label: str):
@@ -1228,6 +1279,19 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
                 violations.append(
                     f"{label}: spec acceptance rate {rate} < SLO "
                     f"floor {accept_rate}")
+        if hit_rate is not None:
+            rate = rep.get("hit_rate")
+            if rate is None:
+                if "latency_ms" in rep:  # a leaf report, not "both"
+                    violations.append(
+                        f"{label}: --slo-hit-rate {hit_rate} given "
+                        f"but no measured hot-row hit rate in the "
+                        f"report (not a --recsys run, or the server "
+                        f"exposes no embedding stats block)")
+            elif rate < hit_rate:
+                violations.append(
+                    f"{label}: hot-row hit rate {rate} < SLO floor "
+                    f"{hit_rate}")
         _versions(rep, label)
         # shaped-traffic runs: the SLO binds in EVERY phase — a crest
         # that sheds half its load must not pass on the run's average
@@ -1264,6 +1328,8 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
         out["expect_version"] = expect_version
     if accept_rate is not None:
         out["accept_rate_limit"] = accept_rate
+    if hit_rate is not None:
+        out["hit_rate_limit"] = hit_rate
     if fail_degraded:
         out["fail_degraded"] = True
     return out
@@ -1344,6 +1410,36 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh", default=None, metavar="dp=4,mp=2",
                     help="serving-mesh spec (sharded mode; explicit "
                          "--groups/--mp/--ep win)")
+    ap.add_argument("--recsys", action="store_true",
+                    help="drive the Wide&Deep recsys path: zipfian "
+                         "sparse_ids + dense_x feeds through the "
+                         "ep-sharded embedding tier (in-process via "
+                         "build_recsys_predictor, or POST the same "
+                         "bodies at a --url target); the report "
+                         "embeds the live hot-row hit rate "
+                         "(--slo-hit-rate floors it)")
+    ap.add_argument("--rec-slots", type=int, default=26,
+                    help="sparse slots per example (Criteo: 26)")
+    ap.add_argument("--rec-dense", type=int, default=13,
+                    help="dense features per example (Criteo: 13)")
+    ap.add_argument("--rec-vocab", type=int, default=100000,
+                    help="embedding vocab (rows in the sharded table)")
+    ap.add_argument("--rec-dim", type=int, default=8,
+                    help="deep embedding dim (the wide column rides "
+                         "fused in the same table)")
+    ap.add_argument("--rec-zipf", type=float, default=1.2,
+                    help="zipf skew of the sparse-id distribution: "
+                         "~1.2 = recommender-hot (cache-friendly), "
+                         "0 = uniform (cache-hostile)")
+    ap.add_argument("--rec-hidden", default="64,32",
+                    help="comma-separated deep MLP widths "
+                         "(in-process --recsys)")
+    ap.add_argument("--rec-shards", type=int, default=None,
+                    help="embedding shard count (default "
+                         "FLAGS_embedding_shards; 0 = one per device)")
+    ap.add_argument("--rec-cache-rows", type=int, default=None,
+                    help="hot-row cache capacity (default "
+                         "FLAGS_embedding_cache_rows)")
     ap.add_argument("--generate", action="store_true",
                     help="drive a slot-based GenerationEngine "
                          "(autoregressive decode) instead of the "
@@ -1447,6 +1543,13 @@ def main(argv=None) -> int:
                          "report's embedded engine stats; a run with "
                          "no measured acceptance rate (speculation "
                          "off) violates too, never a vacuous pass")
+    ap.add_argument("--slo-hit-rate", type=float, default=None,
+                    help="assert the hot-row cache hit rate >= this "
+                         "floor (0..1), read from the --recsys "
+                         "report's embedded embedding stats (live "
+                         "/statusz with --url); a run with no "
+                         "measured hit rate violates too, never a "
+                         "vacuous pass")
     ap.add_argument("--expect-version", type=int, default=None,
                     help="assert every completed request carried this "
                          "weights_version response header (the post-"
@@ -1496,13 +1599,15 @@ def main(argv=None) -> int:
                 or args.slo_ttft_ms is not None \
                 or args.slo_itl_ms is not None or args.sharded \
                 or args.expect_version is not None \
-                or args.slo_accept_rate is not None:
+                or args.slo_accept_rate is not None \
+                or args.slo_hit_rate is not None:
             slo = check_slo(report, args.slo_p99_ms, args.slo_shed_pct,
                             fail_degraded=args.sharded,
                             ttft_ms=args.slo_ttft_ms,
                             itl_ms=args.slo_itl_ms,
                             expect_version=args.expect_version,
-                            accept_rate=args.slo_accept_rate)
+                            accept_rate=args.slo_accept_rate,
+                            hit_rate=args.slo_hit_rate)
             report["slo"] = slo
             if not slo["ok"]:
                 for v in slo["violations"]:
@@ -1552,24 +1657,43 @@ def main(argv=None) -> int:
 
     if args.url:
         # remote target: no model, no engine — just paced HTTP traffic
-        shapes = _parse_shapes(args.shape) or {"x": (args.feat,)}
-        make_feed = feed_maker(shapes, rows=args.rows)
+        if args.recsys:
+            make_feed = recsys_feed_maker(
+                args.rec_slots, args.rec_dense, args.rec_vocab,
+                zipf=args.rec_zipf, rows=args.rows)
+
+            def _with_hit_rate(rep: dict) -> dict:
+                # live hot-row hit rate off the target's /statusz
+                # embedding block — the measurement --slo-hit-rate
+                # floors (a router target exposes no embedding block;
+                # the floor then violates, never passes vacuously)
+                emb = ((rep.get("statusz") or {}).get("engine")
+                       or {}).get("embedding") or {}
+                if emb.get("hit_rate") is not None:
+                    rep["hit_rate"] = emb["hit_rate"]
+                    rep["embedding"] = emb
+                return rep
+        else:
+            shapes = _parse_shapes(args.shape) or {"x": (args.feat,)}
+            make_feed = feed_maker(shapes, rows=args.rows)
+
+            def _with_hit_rate(rep: dict) -> dict:
+                return rep
         if args.mode == "both":
             report = {"mode": "both",
-                      "closed": run_closed_loop_http(
+                      "closed": _with_hit_rate(run_closed_loop_http(
                           args.url, make_feed, args.requests,
-                          args.concurrency),
-                      "open": run_open_loop_http(args.url, make_feed,
-                                                 args.qps,
-                                                 args.duration,
-                                                 traffic=traffic)}
+                          args.concurrency)),
+                      "open": _with_hit_rate(run_open_loop_http(
+                          args.url, make_feed, args.qps,
+                          args.duration, traffic=traffic))}
         elif args.mode == "closed":
-            report = run_closed_loop_http(args.url, make_feed,
-                                          args.requests,
-                                          args.concurrency)
+            report = _with_hit_rate(run_closed_loop_http(
+                args.url, make_feed, args.requests, args.concurrency))
         else:
-            report = run_open_loop_http(args.url, make_feed, args.qps,
-                                        args.duration, traffic=traffic)
+            report = _with_hit_rate(run_open_loop_http(
+                args.url, make_feed, args.qps, args.duration,
+                traffic=traffic))
         return finish(report)
 
     if args.generate:
@@ -1633,6 +1757,66 @@ def main(argv=None) -> int:
         return finish(report)
 
     from paddle_tpu.serving import ServingEngine
+
+    if args.recsys:
+        # in-process recsys: the sharded embedding tier + dense
+        # remainder behind a fan-in-bucketed engine — the same build
+        # a --recsys replica process does
+        from paddle_tpu.flags import flag_value
+        from paddle_tpu.serving import batcher, build_recsys_predictor
+
+        if args.sharded:
+            ap.error("--recsys cannot combine with --sharded (the "
+                     "embedding tier shards itself)")
+        predictor, shapes = build_recsys_predictor(
+            num_sparse=args.rec_slots, num_dense=args.rec_dense,
+            vocab=args.rec_vocab, embed_dim=args.rec_dim,
+            hidden=tuple(int(h) for h in args.rec_hidden.split(",")
+                         if h),
+            shards=args.rec_shards, cache_rows=args.rec_cache_rows)
+        max_batch = args.max_batch or int(
+            flag_value("FLAGS_serving_recsys_max_batch") or 64)
+        engine = ServingEngine(
+            predictor, workers=args.workers, max_delay_ms=args.max_delay_ms,
+            queue_cap=args.queue_cap, deadline_ms=args.deadline_ms,
+            warmup_shapes=shapes,
+            buckets=batcher.fanin_bucket_sizes(max_batch)
+            if flag_value("FLAGS_serving_recsys_fanin")
+            else batcher.bucket_sizes(max_batch))
+        make_feed = recsys_feed_maker(
+            args.rec_slots, args.rec_dense, args.rec_vocab,
+            zipf=args.rec_zipf, rows=args.rows)
+
+        def _with_embedding(rep: dict) -> dict:
+            # the tier's live stats: hit_rate top-level (the
+            # --slo-hit-rate measurement) + the full block
+            emb = predictor.embedding_stats()
+            rep["hit_rate"] = emb["hit_rate"]
+            rep["embedding"] = emb
+            return rep
+
+        try:
+            if args.mode == "both":
+                report = {"mode": "both",
+                          "closed": _with_embedding(
+                              run_closed_loop(engine, make_feed,
+                                              args.requests,
+                                              args.concurrency)),
+                          "open": _with_embedding(
+                              run_open_loop(engine, make_feed,
+                                            args.qps, args.duration,
+                                            traffic=traffic))}
+            elif args.mode == "closed":
+                report = _with_embedding(
+                    run_closed_loop(engine, make_feed, args.requests,
+                                    args.concurrency))
+            else:
+                report = _with_embedding(
+                    run_open_loop(engine, make_feed, args.qps,
+                                  args.duration, traffic=traffic))
+        finally:
+            engine.close()
+        return finish(report)
 
     if args.model_dir:
         from paddle_tpu.inference import Predictor
