@@ -488,3 +488,24 @@ register_flag("FLAGS_serving_check_outputs", False,
               "— the bad-checkpoint tripwire the canary burn-rate "
               "judge feeds on.  Off by default: costs one isfinite "
               "scan per batch on the serve path")
+register_flag("FLAGS_usage", True,
+              "per-tenant usage ledger (paddle_tpu/serving/usage.py): "
+              "attribute every request's cost vector (requests, "
+              "tokens, steps, flops, KV page-seconds, cache hits, "
+              "sheds, failures) to its X-PaddleTPU-Tenant, exposed on "
+              "/usagez and federated into /fleetz.  0 = zero "
+              "per-request work (one dict lookup, no ledger, no "
+              "per-tenant series); FLAGS_telemetry=0 disables the "
+              "per-tenant latency/SLO series but the ledger still "
+              "books counters")
+register_flag("FLAGS_usage_top_k", 32,
+              "usage ledger: space-saving heavy-hitter sketch width — "
+              "at most this many tenants tracked exactly at once; the "
+              "rest aggregate into the ~other bucket (memory is "
+              "hard-capped at top_k + 1 cost vectors per replica "
+              "regardless of tenant cardinality)")
+register_flag("FLAGS_usage_default_tenant", "~default",
+              "usage ledger: tenant every unattributed request books "
+              "under when no X-PaddleTPU-Tenant header / submit("
+              "tenant=) is given (kept distinct from ~other, the "
+              "sketch's demoted-tenant aggregate)")

@@ -56,6 +56,7 @@ import numpy as np
 from .. import fault, telemetry
 from ..flags import flag_value
 from ..monitor import stat_add
+from . import usage
 
 __all__ = ["RowSharding", "HotRowCache", "ShardedEmbeddingTable",
            "EmbeddingPredictor", "build_recsys_predictor"]
@@ -452,6 +453,12 @@ class ShardedEmbeddingTable:
                 self._n["degraded_rows"] += degraded_rows
         stat_add("serving_embedding_lookups")
         stat_add("serving_embedding_rows", int(flat.size))
+        if pinned and usage.enabled():
+            # thread-local handoff to the batching engine: lookup runs
+            # inside predictor.run on the worker thread, and the batch
+            # mixes tenants — the engine takes these hits right after
+            # the dispatch and splits them row-weighted per tenant
+            usage.note_hot_row_hits(len(pinned))
         if n_oob:
             stat_add("serving_embedding_oob_rows", n_oob)
         if degraded_rows:

@@ -123,6 +123,7 @@ from .. import tsdb
 from ..flags import flag_value
 from ..monitor import process_start_time, stat_add
 from . import batcher
+from . import usage
 
 __all__ = ["ServingError", "OverloadedError", "RequestFailed",
            "PoisonedInput", "ServingFuture", "ServingEngine"]
@@ -222,7 +223,7 @@ class ServingFuture:
 class _Request:
     __slots__ = ("arrays", "rows", "sig", "future", "t_submit",
                  "t_picked", "t_deadline", "trace_id", "sampled",
-                 "root", "spans", "bb")
+                 "root", "spans", "bb", "tenant")
 
     def __init__(self, arrays: List[np.ndarray]):
         self.arrays = arrays
@@ -242,6 +243,9 @@ class _Request:
         # flight-recorder last-words token (None when blackbox is off
         # or the in-flight cap is reached)
         self.bb: Optional[int] = None
+        # usage-ledger tenant key (None with FLAGS_usage=0: the ledger
+        # does zero per-request work, including this attribution)
+        self.tenant: Optional[str] = None
 
 
 class ServingEngine:
@@ -361,6 +365,10 @@ class ServingEngine:
                    "poison_rows": 0, "weight_swaps": 0,
                    "weight_swap_failures": 0}
         self._n_lock = threading.Lock()
+        # per-(predictor, bucket) manifest-flops cache for usage
+        # attribution: cache_info() walks the compile cache, so its
+        # price is paid once per bucket, not per batch (_n_lock-guarded)
+        self._usage_flops: dict = {}
         self._h_request = telemetry.Histogram("serving_request_ms")
         self._h_wait = telemetry.Histogram("serving_queue_wait_ms")
         self._h_fill = telemetry.Histogram("serving_batch_fill_pct",
@@ -584,7 +592,8 @@ class ServingEngine:
         return arrays
 
     def submit(self, feed, trace_id: Optional[str] = None,
-               deadline_ms: Optional[float] = None) -> ServingFuture:
+               deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None) -> ServingFuture:
         """Admit one request (any batch size >= 1).  Returns a
         :class:`ServingFuture`; sheds with :class:`OverloadedError`
         when the queue is full or the engine is draining (the raised
@@ -600,19 +609,34 @@ class ServingEngine:
         arrays = self.coerce_feed(feed)
         self._count("requests")
         stat_add("serving_requests")
+        if usage.enabled():
+            # booked at the SAME site as the global counters above:
+            # per-tenant sums stay equal to them at tolerance 0
+            tenant = usage.normalize_tenant(tenant)
+            usage.ledger().book(tenant, requests=1,
+                                tokens_in=int(arrays[0].shape[0]))
+        else:
+            tenant = None
         kind = fault.fire("serve_request")
         if kind == "fail":
             # stay inside the serving error taxonomy: callers (HTTP
             # handler, loadgen) handle ServingError, not raw OSError
             raise RequestFailed("injected serve_request failure")
         req = _Request(arrays)
+        req.tenant = tenant
         budget_s = self._deadline_s
         if deadline_ms is not None:
             budget_s = min(budget_s, float(deadline_ms) / 1e3)
         req.t_deadline = req.t_submit + budget_s
         admit = self._trace_begin(req, trace_id=trace_id)
-        req.bb = blackbox.request_begin(req.trace_id, "predict",
-                                        rows=req.rows)
+        if tenant is not None:
+            # last words carry the tenant: a crash names its victim
+            # traffic in the flight recorder
+            req.bb = blackbox.request_begin(req.trace_id, "predict",
+                                            rows=req.rows, tenant=tenant)
+        else:
+            req.bb = blackbox.request_begin(req.trace_id, "predict",
+                                            rows=req.rows)
         with self._cv:
             if self._draining:
                 raise self._submit_shed(req, admit, "draining")
@@ -749,6 +773,8 @@ class ServingEngine:
         (spans closed, trace recorded, trace_id attached)."""
         self._count("shed")
         stat_add("serving_requests_shed")
+        if req.tenant is not None and usage.enabled():
+            usage.ledger().book(req.tenant, sheds=1)
         if reason == "deadline":
             self._count("shed_deadline")
             stat_add("requests_shed_deadline")
@@ -926,7 +952,8 @@ class ServingEngine:
 
     def submit_generate(self, prompt, max_new_tokens=None,
                         trace_id=None, deadline_ms=None,
-                        on_token=None, timeline=None, speculate=None):
+                        on_token=None, timeline=None, speculate=None,
+                        tenant=None):
         """Admit one generation request to the attached slot scheduler
         (future of the generation record); raises RuntimeError when no
         generator is attached.  ``on_token``/``timeline``/``speculate``
@@ -942,7 +969,8 @@ class ServingEngine:
                                      deadline_ms=deadline_ms,
                                      on_token=on_token,
                                      timeline=timeline,
-                                     speculate=speculate)
+                                     speculate=speculate,
+                                     tenant=tenant)
 
     # -- scheduler ----------------------------------------------------------
     def _count(self, key: str, n: int = 1):
@@ -952,6 +980,8 @@ class ServingEngine:
     def _shed(self, req: _Request, reason: str):
         self._count("shed")
         stat_add("serving_requests_shed")
+        if req.tenant is not None and usage.enabled():
+            usage.ledger().book(req.tenant, sheds=1)
         if reason == "deadline":
             self._count("shed_deadline")
             stat_add("requests_shed_deadline")
@@ -1151,14 +1181,65 @@ class ServingEngine:
         if bucket is None:
             # one oversized request (> largest bucket): chunk it
             # across full batches and reassemble — still bit-exact
-            return [self._run_chunked(predictor, batch[0])]
+            outs = [self._run_chunked(predictor, batch[0])]
+            if usage.enabled():
+                self._book_usage(predictor, batch, None)
+            return outs
         padded, _real = batcher.pad_stack([r.arrays for r in batch],
                                           bucket)
         outs = predictor.run(padded)
         self._check_outputs(outs)
         per_req = batcher.split_rows(outs, [r.rows for r in batch])
         self._book_batch(rows, bucket)
+        if usage.enabled():
+            self._book_usage(predictor, batch, bucket)
         return per_req
+
+    def _book_usage(self, predictor, batch: List[_Request],
+                    bucket: Optional[int]):
+        """Per-tenant cost capture for one successful dispatch: the
+        hot-row hits the gather path noted on this worker thread
+        (thread-local handoff — a batch mixes tenants) and the
+        executable's manifest flops, split across the batch's requests
+        row-weighted (largest-remainder: the integer parts sum exactly,
+        so conservation holds at tolerance 0)."""
+        hits = usage.take_hot_row_hits()
+        fl = self._bucket_flops(predictor, bucket) if bucket else 0
+        if not hits and not fl:
+            return
+        led = usage.ledger()
+        weights = [r.rows for r in batch]
+        for r, h, f in zip(batch, usage.split_ints(hits, weights),
+                           usage.split_ints(fl, weights)):
+            if (h or f) and r.tenant is not None:
+                led.book(r.tenant, hot_row_hits=h, flops=f)
+
+    def _bucket_flops(self, predictor, bucket: int) -> int:
+        """Manifest flops of the executable serving ``bucket`` rows on
+        ``predictor`` (0 when no manifest — CPU test backends compile
+        without cost models).  Memoized per (predictor, bucket)."""
+        key = (id(predictor), bucket)
+        with self._n_lock:
+            fl = self._usage_flops.get(key)
+        if fl is not None:
+            return fl
+        fl = 0
+        info = None
+        try:
+            info = predictor.cache_info()
+            mans = (info or {}).get("manifests") or {}
+            probe = f"(({bucket},"
+            for sig, man in mans.items():
+                if man and probe in str(sig):
+                    fl = int(man.get("flops") or 0)
+                    break
+        except Exception:  # noqa: BLE001 — attribution must never
+            # fail a dispatch; an unpriceable executable books 0 flops
+            return 0
+        if info and not info.get("busy"):
+            with self._n_lock:
+                self._usage_flops[key] = fl
+        return fl
 
     def _resolve_ok(self, req: _Request, outputs, predict_ms: float,
                     now: float):
@@ -1172,6 +1253,10 @@ class ServingEngine:
         self._h_request.observe(ms, trace_id=req.trace_id)
         telemetry.histogram_observe("serving_request_ms", ms,
                                     trace_id=req.trace_id)
+        if req.tenant is not None and usage.enabled():
+            led = usage.ledger()
+            led.book(req.tenant, served=1)
+            led.observe_latency(req.tenant, ms)
         if telemetry.enabled() and tsdb.enabled():
             # raw per-request latency series: the replica burn-rate
             # monitor's latency evidence must be WINDOWED samples —
@@ -1188,6 +1273,8 @@ class ServingEngine:
         what = "request isolated by bisection" if isolated \
             else "batch execution failed"
         err = RequestFailed(f"{what}: {type(cause).__name__}: {cause}")
+        if req.tenant is not None and usage.enabled():
+            usage.ledger().book(req.tenant, failures=1)
         if req.root is not None:
             req.root.attrs["status"] = "failed"
             telemetry.span_end(req.root)

@@ -143,6 +143,7 @@ from .. import blackbox, costmodel, fault, telemetry
 from ..flags import flag_value
 from ..monitor import stat_add
 from . import batcher
+from . import usage
 from .engine import (OverloadedError, PoisonedInput, RequestFailed,
                      ServingFuture, poison_sentinel_matches)
 from .sharded import describe_mesh as _describe_mesh
@@ -163,7 +164,8 @@ class GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "future", "t_submit",
                  "t_claimed", "t_deadline", "trace_id", "prefill_ms",
                  "on_token", "record_timeline", "events", "t_tokens",
-                 "t_first", "t_last", "segment", "speculate", "bb")
+                 "t_first", "t_last", "segment", "speculate", "bb",
+                 "tenant")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int):
         self.prompt = prompt
@@ -187,6 +189,9 @@ class GenRequest:
         # flight-recorder last-words token (None when blackbox is off
         # or the in-flight cap is reached)
         self.bb: Optional[int] = None
+        # usage-ledger tenant key (None with FLAGS_usage=0: the ledger
+        # does zero per-request work, including this attribution)
+        self.tenant: Optional[str] = None
 
     def note(self, label: str, ts: float, extra=None):
         if self.record_timeline:
@@ -352,7 +357,7 @@ class _Slot:
 
     __slots__ = ("idx", "req", "position", "steps", "tokens", "t_start",
                  "logits", "pages", "prefill_pos", "hit_tokens",
-                 "decoding", "span")
+                 "decoding", "span", "page_us", "page_t", "page_tenant")
 
     def __init__(self, idx: int):
         self.idx = idx
@@ -367,6 +372,15 @@ class _Slot:
         self.prefill_pos = 0         # paged: next position to prefill
         self.hit_tokens = 0          # paged: tokens served by the index
         self.decoding = False        # prefill complete, in the grid
+        # KV page-second integration (usage ledger): page_us
+        # accumulates held-pages-×-wall-time in µs, marked forward at
+        # every block-table change and booked at release.  page_tenant
+        # snapshots the request's tenant at claim because every finish
+        # path clears slot.req BEFORE releasing the pages; None (usage
+        # off / untracked) keeps the whole integration zero-work
+        self.page_us = 0
+        self.page_t = 0.0
+        self.page_tenant: Optional[str] = None
 
     @property
     def active(self) -> bool:
@@ -569,6 +583,9 @@ class GenerationEngine:
                    "spec_tokens_proposed": 0,
                    "spec_tokens_accepted": 0, "spec_rollbacks": 0}
         self._n_lock = threading.Lock()
+        # per-bucket manifest-flops cache for usage attribution: the
+        # executor cache walk is paid once per bucket, not per dispatch
+        self._usage_flops: Dict[int, int] = {}
         self._h_gen = telemetry.Histogram("serving_generate_ms")
         self._h_prefill = telemetry.Histogram("serving_prefill_ms")
         self._h_step = telemetry.Histogram("serving_decode_step_ms")
@@ -931,7 +948,8 @@ class GenerationEngine:
                deadline_ms: Optional[float] = None,
                on_token=None,
                timeline: Optional[bool] = None,
-               speculate: Optional[bool] = None) -> ServingFuture:
+               speculate: Optional[bool] = None,
+               tenant: Optional[str] = None) -> ServingFuture:
         """Admit one generation request.  ``prompt``: 1-D int token ids
         (1 ≤ len ≤ the largest prefill bucket).  Returns a future whose
         ``result()`` is ``{"tokens", "prompt_len", "steps", "finish",
@@ -989,10 +1007,23 @@ class GenerationEngine:
         req.record_timeline = bool(telemetry.enabled()
                                    if timeline is None else timeline)
         req.note("admit", req.t_submit)
-        req.bb = blackbox.request_begin(req.trace_id, "generate",
-                                        prompt_len=int(ids.size))
+        if usage.enabled():
+            req.tenant = usage.normalize_tenant(tenant)
+            # last words carry the tenant: a crash names its victim
+            # traffic in the flight recorder
+            req.bb = blackbox.request_begin(req.trace_id, "generate",
+                                            prompt_len=int(ids.size),
+                                            tenant=req.tenant)
+        else:
+            req.bb = blackbox.request_begin(req.trace_id, "generate",
+                                            prompt_len=int(ids.size))
         self._count("requests")
         stat_add("serving_generate_requests")
+        if req.tenant is not None:
+            # booked at the SAME site as the global counters above:
+            # per-tenant sums stay equal to them at tolerance 0
+            usage.ledger().book(req.tenant, requests=1,
+                                tokens_in=int(ids.size))
         with self._cv:
             if self._draining:
                 raise self._shed_err(req, "draining")
@@ -1211,7 +1242,8 @@ class GenerationEngine:
               trace_id: Optional[str] = None,
               deadline_ms: Optional[float] = None,
               on_token=None,
-              timeline: Optional[bool] = None) -> ServingFuture:
+              timeline: Optional[bool] = None,
+              tenant: Optional[str] = None) -> ServingFuture:
         """Adopt an exported :class:`~paddle_tpu.serving.disagg.
         KVSegment` into this engine's page pool and decode it to
         completion — the decode half of the disaggregated pipeline.
@@ -1250,10 +1282,22 @@ class GenerationEngine:
         req.record_timeline = bool(telemetry.enabled()
                                    if timeline is None else timeline)
         req.note("admit", req.t_submit, {"adopted": True})
-        req.bb = blackbox.request_begin(req.trace_id, "adopt",
-                                        prompt_len=int(segment.prompt_len))
+        if usage.enabled():
+            req.tenant = usage.normalize_tenant(tenant)
+            req.bb = blackbox.request_begin(
+                req.trace_id, "adopt",
+                prompt_len=int(segment.prompt_len), tenant=req.tenant)
+        else:
+            req.bb = blackbox.request_begin(
+                req.trace_id, "adopt",
+                prompt_len=int(segment.prompt_len))
         self._count("requests")
         stat_add("serving_generate_requests")
+        if req.tenant is not None:
+            # tokens_in stays on the prefill tier (it already booked
+            # the prompt); the decode tier books the request + its
+            # decode-side cost under the SAME propagated tenant
+            usage.ledger().book(req.tenant, requests=1)
         with self._cv:
             if self._draining:
                 raise self._shed_err(req, "draining")
@@ -1273,6 +1317,8 @@ class GenerationEngine:
         blackbox.request_end(req.bb)
         self._count("shed")
         stat_add("serving_generate_shed")
+        if req.tenant is not None:
+            usage.ledger().book(req.tenant, sheds=1)
         if reason == "deadline":
             stat_add("requests_shed_deadline")
         err = OverloadedError(reason, detail)
@@ -1454,6 +1500,9 @@ class GenerationEngine:
             trace_id=req.trace_id, slot=slot.idx,
             prompt_len=int(req.prompt.size),
             adopted=req.segment is not None)
+        # page-second attribution arms here (None keeps every mark a
+        # single attribute check — the FLAGS_usage=0 zero-work path)
+        slot.page_tenant = req.tenant
         if req.segment is not None:
             self._adopt_begin(slot, req)
             return
@@ -1475,12 +1524,15 @@ class GenerationEngine:
             hit = self._prefix.lookup(req.prompt)
             if hit:
                 self._pool.incref(hit)
+                self._mark_pages(slot)  # page hold starts here
                 slot.pages = list(hit)
                 slot.hit_tokens = len(hit) * self.page_tokens
                 req.note("prefix_hit", time.monotonic(),
                          {"tokens": slot.hit_tokens})
                 self._count("prefix_hits")
                 stat_add("serving_prefix_hits")
+                if req.tenant is not None:
+                    usage.ledger().book(req.tenant, prefix_hits=1)
                 self._count("prefix_tokens_saved", slot.hit_tokens)
                 stat_add("serving_prefix_tokens_saved",
                          slot.hit_tokens)
@@ -1627,6 +1679,8 @@ class GenerationEngine:
     def _fail_request(self, slot: _Slot, req: GenRequest, phase: str,
                       e: Exception):
         self._count("failed")
+        if req.tenant is not None:
+            usage.ledger().book(req.tenant, failures=1)
         logger.warning("%s failed: %s", phase, e)
         self._end_seq_span(slot, f"failed:{phase}")
         self._release_pages(slot)
@@ -1657,6 +1711,8 @@ class GenerationEngine:
             self._end_seq_span(s, "failed:decode_step")
             req, s.req, s.logits = s.req, None, []
             s.decoding = False
+            if req.tenant is not None:
+                usage.ledger().book(req.tenant, failures=1)
             self._release_pages(s)
             blackbox.request_end(req.bb)
             req.future._resolve(error=err)
@@ -1703,6 +1759,41 @@ class GenerationEngine:
             raise PoisonedInput(
                 f"prompt contains poisoned token (sentinel {pv})")
 
+    # -- usage flops pricing ------------------------------------------------
+    def _exe_flops(self, bucket: int) -> int:
+        """Manifest flops of the prefill-side executable at ``bucket``
+        (the padded prompt/chunk/verify feed is ``(1, bucket)``) — 0
+        when the backend exposes no cost analysis (CPU test backends).
+        Memoized per bucket: the executor cache walk is paid once."""
+        fl = self._usage_flops.get(bucket)
+        if fl is not None:
+            return fl
+        fl = 0
+        try:
+            probe = f"(1, {int(bucket)})"
+            for e in self._prefill_exe.cache_info()["entries"]:
+                man = e.get("manifest")
+                if man and probe in str(e.get("signature") or ""):
+                    fl = int(man.get("flops") or 0)
+                    break
+        except Exception:  # noqa: BLE001 — attribution must never
+            # fail a dispatch; an unpriceable executable books 0 flops
+            return 0
+        self._usage_flops[bucket] = fl
+        return fl
+
+    def _decode_flops(self) -> int:
+        """Manifest flops of one decode grid step (0 when absent)."""
+        fl = self._usage_flops.get(-1)
+        if fl is not None:
+            return fl
+        man = self.decode_manifest()
+        if not man:
+            return 0
+        fl = int(man.get("flops") or 0)
+        self._usage_flops[-1] = fl
+        return fl
+
     def _prefill(self, slot: _Slot, req: GenRequest):
         t0 = time.monotonic()
         kind = fault.fire("prefill")
@@ -1734,18 +1825,45 @@ class GenerationEngine:
         self._count("prefill_tokens", int(req.prompt.size))
         stat_add("serving_prefills")
         stat_add("serving_prefill_tokens", int(req.prompt.size))
+        if req.tenant is not None:
+            usage.ledger().book(req.tenant, prefill_steps=1,
+                                flops=self._exe_flops(bucket))
         slot.position = int(req.prompt.size)
         slot.tokens = [first]
         self._book_token(slot, first, now)
 
     # -- paged prefill ------------------------------------------------------
+    def _mark_pages(self, slot: _Slot, now: Optional[float] = None):
+        """Advance the slot's KV page-second integral (µs × pages
+        held) up to ``now`` — called before EVERY block-table change
+        so the integral prices exactly what the pool saw.  One
+        attribute check and out when the slot carries no tenant
+        (usage off): the integration costs nothing then."""
+        if slot.page_tenant is None:
+            return
+        t = time.monotonic() if now is None else now
+        if slot.pages and slot.page_t:
+            slot.page_us += int((t - slot.page_t) * 1e6) * len(slot.pages)
+        slot.page_t = t
+
     def _release_pages(self, slot: _Slot):
         """Drop the slot's refs on its pages (shared prefix pages fall
         back to the index's ref; private pages free) and refresh the
-        pool gauges."""
+        pool gauges.  Books the sequence's accumulated KV
+        page-seconds to its tenant — this is the single exit every
+        hold path (finish, fail, requeue, export, decode crash)
+        funnels through."""
         if self._pool is not None and slot.pages:
+            self._mark_pages(slot)
             self._pool.decref(slot.pages)
             self._publish_pool_gauges()
+        if slot.page_tenant is not None:
+            if slot.page_us:
+                usage.ledger().book(slot.page_tenant,
+                                    page_us=slot.page_us)
+            slot.page_tenant = None
+        slot.page_us = 0
+        slot.page_t = 0.0
         slot.pages = []
         slot.hit_tokens = 0
         slot.prefill_pos = 0
@@ -1757,6 +1875,8 @@ class GenerationEngine:
         to evict — the caller turns that into ``cache_full`` (decode)
         or a failed request (prefill)."""
         needed = -(-int(n_tokens) // self.page_tokens)  # ceil
+        if len(slot.pages) < needed:
+            self._mark_pages(slot)
         while len(slot.pages) < needed:
             p = self._pool.alloc()
             if p is None:
@@ -1804,6 +1924,7 @@ class GenerationEngine:
         pass polices the pairing)."""
         dropped = slot.pages[keep_pages:]
         if dropped:
+            self._mark_pages(slot)
             self._pool.decref(dropped)
             del slot.pages[keep_pages:]
             self._publish_pool_gauges()
@@ -1846,6 +1967,9 @@ class GenerationEngine:
                     fetch_list=fetch, scope=self.scope,
                     return_numpy=False)
             req.prefill_ms += (time.monotonic() - t0) * 1e3
+            if req.tenant is not None:
+                usage.ledger().book(req.tenant,
+                                    flops=self._exe_flops(bucket))
             self._complete_prefill(slot, req, outs)
             return
         # chunk continuation (chunked prefill and/or prefix-hit tail):
@@ -1878,6 +2002,9 @@ class GenerationEngine:
                 fetch_list=fetch, scope=self.scope, return_numpy=False)
         self._count("prefill_chunks")
         stat_add("serving_prefill_chunks")
+        if req.tenant is not None:
+            usage.ledger().book(req.tenant,
+                                flops=self._exe_flops(bucket))
         now = time.monotonic()
         req.prefill_ms += (now - t0) * 1e3
         req.note("chunk", now, {"base": start, "tokens": n})
@@ -1904,6 +2031,8 @@ class GenerationEngine:
         self._count("prefill_tokens", n_prompt - slot.hit_tokens)
         stat_add("serving_prefills")
         stat_add("serving_prefill_tokens", n_prompt - slot.hit_tokens)
+        if req.tenant is not None:
+            usage.ledger().book(req.tenant, prefill_steps=1)
         if self._prefix is not None:
             full = n_prompt // self.page_tokens
             if full:
@@ -1963,6 +2092,8 @@ class GenerationEngine:
         # adopter only replays it)
         self._count("generated_tokens")
         stat_add("serving_generated_tokens")
+        if req.tenant is not None:
+            usage.ledger().book(req.tenant, tokens_out=1)
         self._count("segments_exported")
         stat_add("serving_segments_exported")
         stat_add("serving_segment_export_bytes", seg.nbytes)
@@ -1975,6 +2106,10 @@ class GenerationEngine:
         self._h_gen.observe(total_ms, trace_id=req.trace_id)
         telemetry.histogram_observe("serving_generate_ms", total_ms,
                                     trace_id=req.trace_id)
+        if req.tenant is not None:
+            led = usage.ledger()
+            led.book(req.tenant, served=1)
+            led.observe_latency(req.tenant, total_ms)
         result = {
             "tokens": [int(t) for t in slot.tokens],
             "prompt_len": n_prompt,
@@ -2117,6 +2252,9 @@ class GenerationEngine:
                                         trace_id=req.trace_id)
             self._count("spec_tokens_accepted", a)
             stat_add("serving_spec_tokens_accepted", a)
+            if req.tenant is not None:
+                usage.ledger().book(req.tenant,
+                                    flops=self._exe_flops(bucket))
             if a < len(draft):
                 self._count("spec_rollbacks")
                 stat_add("serving_spec_rollbacks")
@@ -2194,6 +2332,18 @@ class GenerationEngine:
         telemetry.histogram_observe("serving_decode_step_ms", ms)
         self._count("decode_steps")
         stat_add("serving_decode_steps")
+        tenants = [s for s in active if s.req.tenant is not None]
+        if tenants:
+            # one grid dispatch serves N sequences: each participant
+            # books one decode_step (sequence-step, NOT dispatch —
+            # documented in the README cost-vector schema) and its
+            # row-weighted share of the step's manifest flops
+            # (largest-remainder: integer shares sum exactly)
+            led = usage.ledger()
+            shares = usage.split_ints(self._decode_flops(),
+                                      [1] * len(tenants))
+            for s, f in zip(tenants, shares):
+                led.book(s.req.tenant, decode_steps=1, flops=f)
         dt = ms / 1e3
         self._decode_rate_ema = (1.0 / dt if self._decode_rate_ema is None
                                  else 0.9 * self._decode_rate_ema
@@ -2220,6 +2370,10 @@ class GenerationEngine:
         self._count("generated_tokens")
         stat_add("serving_generated_tokens")
         req = slot.req
+        if req.tenant is not None:
+            # same site as the global counter above: per-tenant
+            # tokens_out sums stay equal to it at tolerance 0
+            usage.ledger().book(req.tenant, tokens_out=1)
         tele = telemetry.enabled()
         if req.record_timeline:
             # _timeline_record is the only consumer: an on_token-only
@@ -2272,6 +2426,10 @@ class GenerationEngine:
         self._h_gen.observe(total_ms, trace_id=req.trace_id)
         telemetry.histogram_observe("serving_generate_ms", total_ms,
                                     trace_id=req.trace_id)
+        if req.tenant is not None:
+            led = usage.ledger()
+            led.book(req.tenant, served=1)
+            led.observe_latency(req.tenant, total_ms)
         result = {
             "tokens": [int(t) for t in slot.tokens],
             "prompt_len": int(req.prompt.size),

@@ -161,8 +161,10 @@ from typing import Dict, List, Optional, Tuple
 from .. import blackbox, fault, promtext, telemetry, tsdb
 from ..flags import all_flags, flag_value
 from ..monitor import process_uptime_s, stat_add
-from .server import (DEADLINE_HEADER, TRACE_HEADER, VERSION_HEADER,
-                     _AccessLog, _JsonHandler, parse_deadline_header,
+from . import usage
+from .server import (DEADLINE_HEADER, TENANT_HEADER, TRACE_HEADER,
+                     VERSION_HEADER, _AccessLog, _JsonHandler,
+                     parse_deadline_header, parse_tenant_header,
                      parse_trace_header)
 
 __all__ = ["Router", "RouterServer", "serve_router"]
@@ -594,6 +596,18 @@ class Router:
                 v = fam.value()
                 if v is not None:
                     self._db.record(f"{short}[{rep.rid}]", v, ts=now)
+                if short.startswith("serving_tenant_"):
+                    # per-tenant labeled samples get their own series
+                    # per (family, tenant, replica): the reset-aware
+                    # evidence /fleetz federates — delta/rate survive
+                    # a replica SIGKILL-respawn where raw cross-fleet
+                    # sums would dip and double-count
+                    for s in fam.samples:
+                        t = s.labels.get("tenant")
+                        if t:
+                            self._db.record(
+                                f"{short}{{{t}}}[{rep.rid}]",
+                                s.value, ts=now)
             elif fam.type == "histogram":
                 self._db.record(f"{short}_count[{rep.rid}]",
                                 fam.histogram_count(), ts=now)
@@ -619,6 +633,31 @@ class Router:
                  if r.health is not None and not r.ejected)
         self._db.record("fleet_replicas_up", up, ts=now)
         telemetry.gauge_set("fleet_replicas_up", up)
+        # fleet_tenant_* rollup series: the latest scraped per-tenant
+        # counters summed across replicas, one series per
+        # (family, tenant).  Dashboards read these; the conservation
+        # math in /fleetz reads the per-replica series instead (these
+        # raw sums dip on a replica respawn, those stay reset-aware)
+        tenant_sums: Dict[str, float] = {}
+        for rep in self._all():
+            with self._lock:
+                fams = rep.scrape
+            if not fams:
+                continue
+            for name, fam in fams.items():
+                short = _short_family(name)
+                if fam.type != "counter" \
+                        or not short.startswith("serving_tenant_"):
+                    continue
+                field = short[len("serving_tenant_"):]
+                for s in fam.samples:
+                    t = s.labels.get("tenant")
+                    if t:
+                        key = f"fleet_tenant_{field}{{{t}}}"
+                        tenant_sums[key] = \
+                            tenant_sums.get(key, 0.0) + s.value
+        for key, v in tenant_sums.items():
+            self._db.record(key, v, ts=now)
         with self._lock:
             epoch = (self._canary or {}).get("epoch")
         if epoch is not None:
@@ -769,7 +808,8 @@ class Router:
     def _send(self, rep: _Replica, route: str, body: bytes,
               trace_id: Optional[str], timeout_s: float,
               deadline_ms: Optional[float],
-              content_type: str = "application/json"
+              content_type: str = "application/json",
+              tenant: Optional[str] = None
               ) -> Tuple[int, bytes, str, Optional[str],
                          Optional[str]]:
         headers = {"Content-Type": content_type,
@@ -778,6 +818,11 @@ class Router:
             # the REMAINING budget (already decremented by this
             # router's elapsed time): replica admission sheds on it
             headers[DEADLINE_HEADER] = f"{deadline_ms:.1f}"
+        if tenant:
+            # the attribution identity rides EVERY hop — on a disagg
+            # pipeline the prefill and decode cost must land on the
+            # same tenant ledger
+            headers[TENANT_HEADER] = tenant
         req = urllib.request.Request(rep.url + route, data=body,
                                      headers=headers)
         with self._lock:
@@ -822,7 +867,8 @@ class Router:
     def route(self, route: str, body: bytes,
               trace_id: Optional[str] = None,
               deadline_ms: Optional[float] = None,
-              role: Optional[str] = None, count: bool = True) -> dict:
+              role: Optional[str] = None, count: bool = True,
+              tenant: Optional[str] = None) -> dict:
         """Place one request: pick → forward (bounded by the forward
         timeout and the remaining deadline budget) → on a connect
         failure OR a forward timeout, strike health + retry once on
@@ -868,7 +914,7 @@ class Router:
                         "injected router_forward failure")
                 code, data, ctype, retry_after, version = self._send(
                     rep, route, body, trace_id, timeout_s,
-                    remaining_ms)
+                    remaining_ms, tenant=tenant)
             except Exception as e:  # noqa: BLE001 — sort, don't die
                 with self._lock:
                     rep.errors += 1
@@ -1369,7 +1415,8 @@ class Router:
 
     def route_generate(self, body: bytes,
                        trace_id: Optional[str] = None,
-                       deadline_ms: Optional[float] = None) -> dict:
+                       deadline_ms: Optional[float] = None,
+                       tenant: Optional[str] = None) -> dict:
         """Disaggregated ``/generate`` (non-stream): forward the
         prompt to least-loaded PREFILL capacity (retry-once semantics
         of :meth:`route` — a prefill hop is stateless-on-failure and
@@ -1401,7 +1448,8 @@ class Router:
                 pre = self.route("/generate", pre_body, trace_id,
                                  deadline_ms=self._remaining(
                                      deadline_ms, t0),
-                                 role="prefill", count=False)
+                                 role="prefill", count=False,
+                                 tenant=tenant)
                 if span is not None:
                     span.attrs["status"] = pre["code"]
                     span.attrs["replica"] = pre["replica"]
@@ -1419,7 +1467,7 @@ class Router:
             stat_add("router_segment_bytes", len(seg_bytes))
             res = self._adopt_hop(seg_bytes, mnt, trace_id,
                                   deadline_ms, t0, pre["replica"],
-                                  exclude=dead_decode)
+                                  exclude=dead_decode, tenant=tenant)
             if res.pop("_affinity_lost", False):
                 if allow_reprefill and attempts == 0:
                     attempts += 1
@@ -1454,7 +1502,7 @@ class Router:
 
     def _adopt_hop(self, seg_bytes: bytes, mnt, trace_id,
                    deadline_ms, t0, prefill_url: str,
-                   exclude=()) -> dict:
+                   exclude=(), tenant: Optional[str] = None) -> dict:
         """Ship the segment to one decode-capable replica and pin the
         generation there.  A CONNECT-refused replica never received
         the segment — strike + try one alternate (safe); any failure
@@ -1507,7 +1555,8 @@ class Router:
                     code, data, ctype, retry_after, _ = self._send(
                         rep, query, seg_bytes, trace_id, timeout_s,
                         remaining_ms,
-                        content_type="application/octet-stream")
+                        content_type="application/octet-stream",
+                        tenant=tenant)
                 except Exception as e:  # noqa: BLE001 — sort, don't die
                     with self._lock:
                         rep.errors += 1
@@ -1587,6 +1636,7 @@ class Router:
         counters: Dict[str, dict] = {}
         gauges: Dict[str, dict] = {}
         hists: Dict[str, dict] = {}
+        tenants: Dict[str, Dict[str, dict]] = {}
         for rid, url, fams, ts, rep in scrapes:
             entry = {
                 "url": url,
@@ -1601,6 +1651,32 @@ class Router:
                 continue
             for name, fam in fams.items():
                 short = _short_family(name)
+                if (fam.type == "counter"
+                        and short.startswith("serving_tenant_")):
+                    # per-tenant rollup: "total" sums the latest raw
+                    # counters (dashboard view); "delta"/"rate_per_s"
+                    # sum per-replica reset-aware windows — THOSE are
+                    # the conservation-bearing numbers across a
+                    # replica SIGKILL-respawn (raw totals dip when a
+                    # respawned counter restarts from zero)
+                    field = short[len("serving_tenant_"):]
+                    for s in fam.samples:
+                        t = s.labels.get("tenant")
+                        if not t:
+                            continue
+                        agg = tenants.setdefault(field, {}).setdefault(
+                            t, {"total": 0.0, "delta": None,
+                                "rate_per_s": None, "replicas": 0})
+                        agg["total"] += s.value
+                        agg["replicas"] += 1
+                        series = f"{short}{{{t}}}[{rid}]"
+                        d = self._db.delta(series, window_s, now=now)
+                        if d is not None:
+                            agg["delta"] = (agg["delta"] or 0.0) + d
+                        r = self._db.rate(series, window_s, now=now)
+                        if r is not None:
+                            agg["rate_per_s"] = \
+                                (agg["rate_per_s"] or 0.0) + r
                 if fam.type == "counter":
                     v = fam.value()
                     if v is None:
@@ -1648,7 +1724,8 @@ class Router:
         return {"window_s": window_s,
                 "replicas": per_replica,
                 "aggregate": {"counters": counters, "gauges": gauges,
-                              "histograms": hists}}
+                              "histograms": hists,
+                              "tenants": tenants}}
 
     def fleetz(self, window_s: float = 60.0) -> dict:
         """The ``GET /fleetz`` payload: federation + windowed router
@@ -1843,11 +1920,20 @@ class _RouterHandler(_JsonHandler):
                 k, _, v = part.partition("=")
                 if k == "window_s" and v:
                     try:
-                        window_s = max(1.0, float(v))
+                        window_s = float(v)
                     except ValueError:
                         self._reply(400, {"error": "bad request",
                                           "detail": f"window_s={v!r} "
                                                     "is not a number"})
+                        return
+                    if not math.isfinite(window_s) or window_s <= 0:
+                        # explicit 400, never a silent clamp: a caller
+                        # asking for a zero/negative window would get
+                        # an answer for a window it never requested
+                        self._reply(400, {"error": "bad request",
+                                          "detail": f"window_s={v!r} "
+                                                    "must be a positive "
+                                                    "finite number"})
                         return
             self._reply(200, self.router.fleetz(window_s))
         elif route == "/statusz":
@@ -1872,7 +1958,8 @@ class _RouterHandler(_JsonHandler):
 
     def _forward_stream(self, route: str, body: bytes,
                         trace_id: Optional[str],
-                        deadline_ms: Optional[float], t0: float):
+                        deadline_ms: Optional[float], t0: float,
+                        tenant: Optional[str] = None):
         """Streaming forward with route()'s exact containment
         taxonomy: pick → POST, where the CONNECT + response-HEADERS
         phase is bounded by the deadline-tightened forward timeout (a
@@ -1917,6 +2004,8 @@ class _RouterHandler(_JsonHandler):
                        TRACE_HEADER: trace_id or ""}
             if remaining_ms is not None:
                 headers[DEADLINE_HEADER] = f"{remaining_ms:.1f}"
+            if tenant:
+                headers[TENANT_HEADER] = tenant
             host_port = rep.url.split("://", 1)[-1]
             with router._lock:
                 rep.inflight += 1
@@ -2051,7 +2140,8 @@ class _RouterHandler(_JsonHandler):
 
     # -- disaggregated streaming (prefill hop -> pinned adopt stream) -------
     def _disagg_stream(self, body: bytes, trace_id: Optional[str],
-                       deadline_ms: Optional[float], t0: float):
+                       deadline_ms: Optional[float], t0: float,
+                       tenant: Optional[str] = None):
         """Streamed ``/generate`` on a role-split fleet: non-stream
         prefill hop (retryable), then the NDJSON decode stream pinned
         to the adopting replica.  Pre-stream adopt failures follow the
@@ -2079,7 +2169,7 @@ class _RouterHandler(_JsonHandler):
                 pre = router.route(
                     "/generate", pre_body, trace_id,
                     deadline_ms=router._remaining(deadline_ms, t0),
-                    role="prefill", count=False)
+                    role="prefill", count=False, tenant=tenant)
                 if span is not None:
                     span.attrs["status"] = pre["code"]
                     span.attrs["replica"] = pre["replica"]
@@ -2105,7 +2195,8 @@ class _RouterHandler(_JsonHandler):
             stat_add("router_segment_bytes", len(seg_bytes))
             outcome = self._adopt_stream_hop(seg_bytes, mnt, trace_id,
                                              deadline_ms, t0,
-                                             exclude=dead_decode)
+                                             exclude=dead_decode,
+                                             tenant=tenant)
             if outcome[0] == "retry":
                 # post-send death of the adopting replica: the
                 # affinity taxonomy books its evidence here whether
@@ -2134,7 +2225,7 @@ class _RouterHandler(_JsonHandler):
     def _adopt_stream_hop(self, seg_bytes: bytes, mnt,
                           trace_id: Optional[str],
                           deadline_ms: Optional[float], t0: float,
-                          exclude=()):
+                          exclude=(), tenant: Optional[str] = None):
         """One pinned adopt-stream attempt.  Returns ``("done", code,
         replica)`` when a reply (stream or passthrough error) went to
         the client, or ``("retry", replica_url, detail)`` when the
@@ -2180,6 +2271,8 @@ class _RouterHandler(_JsonHandler):
                        TRACE_HEADER: trace_id or ""}
             if remaining_ms is not None:
                 headers[DEADLINE_HEADER] = f"{remaining_ms:.1f}"
+            if tenant:
+                headers[TENANT_HEADER] = tenant
             host_port = rep.url.split("://", 1)[-1]
             with router._lock:
                 rep.inflight += 1
@@ -2341,6 +2434,11 @@ class _RouterHandler(_JsonHandler):
                          or 0.0)
             if dflt > 0:
                 deadline_ms = dflt
+        # the attribution identity: forwarded verbatim on every hop so
+        # both halves of a disagg pipeline bill the same tenant.
+        # FLAGS_usage=0 keeps the header unread — zero per-request work
+        tenant = parse_tenant_header(self.headers.get(TENANT_HEADER)) \
+            if usage.enabled() else None
         t0 = time.monotonic()
         if self._wants_stream(route, body):
             root = telemetry.span_begin("router/request", detached=True,
@@ -2349,10 +2447,12 @@ class _RouterHandler(_JsonHandler):
             try:
                 if route == "/generate" and self.router.disagg_active():
                     code, replica = self._disagg_stream(
-                        body, trace_id, deadline_ms, t0)
+                        body, trace_id, deadline_ms, t0,
+                        tenant=tenant)
                 else:
                     code, replica = self._forward_stream(
-                        route, body, trace_id, deadline_ms, t0)
+                        route, body, trace_id, deadline_ms, t0,
+                        tenant=tenant)
             except Exception as e:  # noqa: BLE001 — a passthrough bug
                 # must not drop the connection silently (headers may
                 # already be out; best-effort close, honest log line)
@@ -2379,7 +2479,8 @@ class _RouterHandler(_JsonHandler):
         try:
             if route == "/generate" and self.router.disagg_active():
                 res = self.router.route_generate(
-                    body, trace_id, deadline_ms=deadline_ms)
+                    body, trace_id, deadline_ms=deadline_ms,
+                    tenant=tenant)
             else:
                 # capability steering: a sparse-id /predict body can
                 # only be served by an embedding-capable replica (byte
@@ -2394,7 +2495,7 @@ class _RouterHandler(_JsonHandler):
                             else "dense")
                 res = self.router.route(route, body, trace_id,
                                         deadline_ms=deadline_ms,
-                                        role=role)
+                                        role=role, tenant=tenant)
             if fwd is not None:
                 fwd.attrs["replica"] = res["replica"]
                 fwd.attrs["retried"] = res["retried"]

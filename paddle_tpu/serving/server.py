@@ -91,6 +91,7 @@ from typing import Optional
 from .. import blackbox, costmodel, fault, observatory, telemetry
 from ..flags import all_flags, flag_value
 from ..monitor import process_uptime_s, stat_add
+from . import usage
 from .engine import OverloadedError, RequestFailed, ServingEngine
 
 __all__ = ["ServingServer", "serve"]
@@ -117,6 +118,13 @@ DEADLINE_HEADER = "X-PaddleTPU-Deadline-Ms"
 # never a torn mix
 VERSION_HEADER = "X-PaddleTPU-Weights-Version"
 
+# per-tenant usage attribution: the tenant id a request's cost vector
+# books under (paddle_tpu/serving/usage.py).  The router stamps it
+# through BOTH hops of the disaggregated pipeline, so prefill and
+# decode cost land on the same tenant; absent/malformed values book
+# under FLAGS_usage_default_tenant
+TENANT_HEADER = "X-PaddleTPU-Tenant"
+
 
 def parse_trace_header(value) -> Optional[str]:
     """Validate an incoming trace-id header: a short url-safe token or
@@ -140,6 +148,16 @@ def parse_deadline_header(value) -> Optional[float]:
     except ValueError:
         return None
     return ms if math.isfinite(ms) else None
+
+
+def parse_tenant_header(value) -> Optional[str]:
+    """Validate an incoming tenant header: a short log-safe token or
+    nothing (a malformed id is dropped here and books under the
+    default tenant — a garbage header must not mint ledger keys)."""
+    if not value:
+        return None
+    value = str(value).strip()
+    return value if usage.TENANT_RE.match(value) else None
 
 
 _slo_monitor = None
@@ -289,6 +307,7 @@ class _Handler(_JsonHandler):
                    "/statusz": self._get_statusz,
                    "/tracez": self._get_tracez,
                    "/debugz": self._get_debugz,
+                   "/usagez": self._get_usagez,
                    "/profilez": self._get_profilez}.get(route)
         if handler is None:
             self._reply(404, {"error": "not found", "path": self.path})
@@ -318,8 +337,29 @@ class _Handler(_JsonHandler):
                               "detail": "FLAGS_telemetry=0"})
             return
         text = telemetry.prometheus_text()
+        if usage.enabled() and usage.peek_ledger() is not None:
+            # labeled per-tenant families ride the same scrape (the
+            # router's federation reads them from here)
+            text += usage.peek_ledger().prometheus_text()
         self._reply_raw(200, text.encode(),
                         "text/plain; version=0.0.4; charset=utf-8")
+
+    def _get_usagez(self):
+        """Per-tenant cost vectors, heavy-hitter sketch occupancy, the
+        live conservation check, and per-tenant SLO burn state.  200
+        with ``{"enabled": false}`` when ``FLAGS_usage=0`` (an
+        observatory dashboard polls this without special-casing), and
+        an empty ledger view before the first booked request."""
+        if not usage.enabled():
+            self._reply(200, {"enabled": False,
+                              "detail": "FLAGS_usage=0"})
+            return
+        led = usage.peek_ledger()
+        if led is None:
+            self._reply(200, {"enabled": True, "tenants": {},
+                              "totals": {}, "detail": "nothing booked"})
+            return
+        self._reply(200, led.usagez())
 
     def _statusz_doc(self) -> dict:
         """The /statusz payload (also the spine of a /debugz bundle) —
@@ -351,7 +391,29 @@ class _Handler(_JsonHandler):
                        "hbm": observatory.hbm_snapshot()},
             "slo": slo,
             "tsdb": db_stats,
+            "usage": self._usage_block(),
             "engine": self.engine.introspect(),
+        }
+
+    @staticmethod
+    def _usage_block() -> dict:
+        """The /statusz usage summary: enough to see attribution is
+        live and conserved without the full /usagez payload."""
+        if not usage.enabled():
+            return {"enabled": False}
+        led = usage.peek_ledger()
+        if led is None:
+            return {"enabled": True, "tenants": 0, "booked": False}
+        snap = led.snapshot()
+        cons = led.conservation()
+        return {
+            "enabled": True,
+            "booked": True,
+            "tenants": len(snap["tenants"]) - 1,  # minus ~other
+            "totals": snap["totals"],
+            "sketch": led.sketch_stats(),
+            "conservation_ok": all(v["delta"] == 0
+                                   for v in cons.values()),
         }
 
     def _get_statusz(self):
@@ -456,17 +518,20 @@ class _Handler(_JsonHandler):
         hop_trace = parse_trace_header(self.headers.get(TRACE_HEADER))
         deadline_ms = parse_deadline_header(
             self.headers.get(DEADLINE_HEADER))
+        # FLAGS_usage=0 zero-work contract: the header is not even read
+        tenant = parse_tenant_header(self.headers.get(TENANT_HEADER)) \
+            if usage.enabled() else None
         if route == "/predict":
             code, payload, trace = self._predict(body, hop_trace,
-                                                 deadline_ms)
+                                                 deadline_ms, tenant)
         elif route == "/adopt":
             code, payload, trace = self._adopt(body, query, hop_trace,
-                                               deadline_ms)
+                                               deadline_ms, tenant)
         elif route == "/swap":
             code, payload, trace = self._swap(body, hop_trace)
         else:
             code, payload, trace = self._generate(body, hop_trace,
-                                                  deadline_ms)
+                                                  deadline_ms, tenant)
         tid = ((trace or {}).get("trace_id") or payload.get("trace_id")
                or hop_trace)
         if code is None:
@@ -498,7 +563,8 @@ class _Handler(_JsonHandler):
         self.access_log.write(rec)
 
     def _generate(self, body: bytes, hop_trace: Optional[str] = None,
-                  deadline_ms: Optional[float] = None):
+                  deadline_ms: Optional[float] = None,
+                  tenant: Optional[str] = None):
         """One POST /generate body — ``{"prompt": [token ids],
         "max_new_tokens": N?}`` — against the attached GenerationEngine.
         404 when no generator is attached, 503 on overload sheds
@@ -530,13 +596,14 @@ class _Handler(_JsonHandler):
                                        "router owns the disaggregated "
                                        "handoff)"}, None
             return self._generate_stream(gen, prompt, mnt, hop_trace,
-                                         deadline_ms, speculate)
+                                         deadline_ms, speculate, tenant)
         t0 = time.monotonic()
         try:
             fut = self.engine.submit_generate(prompt, max_new_tokens=mnt,
                                               trace_id=hop_trace,
                                               deadline_ms=deadline_ms,
-                                              speculate=speculate)
+                                              speculate=speculate,
+                                              tenant=tenant)
             res = fut.result(self._wait_s(deadline_ms))
         except OverloadedError as e:
             return 503, {"error": "overloaded", "reason": e.reason,
@@ -581,7 +648,8 @@ class _Handler(_JsonHandler):
 
     def _adopt(self, body: bytes, query: str,
                hop_trace: Optional[str] = None,
-               deadline_ms: Optional[float] = None):
+               deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None):
         """One ``POST /adopt`` — body is a serialized
         :class:`~paddle_tpu.serving.disagg.KVSegment`; query args
         ``max_new_tokens`` and ``stream``.  404 when no decode-capable
@@ -624,7 +692,8 @@ class _Handler(_JsonHandler):
             return gen.adopt(seg, max_new_tokens=mnt,
                              trace_id=trace_id,
                              deadline_ms=deadline_ms,
-                             on_token=on_token)
+                             on_token=on_token,
+                             tenant=tenant)
 
         if stream:
             return self._adopt_stream(gen, submit, trace_id,
@@ -658,7 +727,8 @@ class _Handler(_JsonHandler):
     def _generate_stream(self, gen, prompt, mnt,
                          hop_trace: Optional[str],
                          deadline_ms: Optional[float],
-                         speculate: Optional[bool] = None):
+                         speculate: Optional[bool] = None,
+                         tenant: Optional[str] = None):
         """``{"stream": true}`` generation: one NDJSON line per token,
         written the moment the scheduler books it (the engine's
         ``on_token`` hook feeds a handler-side queue, so a slow client
@@ -676,7 +746,7 @@ class _Handler(_JsonHandler):
             lambda on_token: self.engine.submit_generate(
                 prompt, max_new_tokens=mnt, trace_id=hop_trace,
                 deadline_ms=deadline_ms, on_token=on_token,
-                speculate=speculate),
+                speculate=speculate, tenant=tenant),
             hop_trace, deadline_ms)
 
     def _adopt_stream(self, gen, submit, trace_id, deadline_ms):
@@ -794,7 +864,8 @@ class _Handler(_JsonHandler):
             else min(self.request_timeout_s, budget)
 
     def _predict(self, body: bytes, hop_trace: Optional[str] = None,
-                 deadline_ms: Optional[float] = None):
+                 deadline_ms: Optional[float] = None,
+                 tenant: Optional[str] = None):
         """Run one /predict body; returns (http_code, payload,
         trace_record_or_None) so do_POST can both reply and access-log
         without re-deciding anything."""
@@ -810,7 +881,8 @@ class _Handler(_JsonHandler):
         fut = None
         try:
             fut = self.engine.submit(inputs, trace_id=hop_trace,
-                                     deadline_ms=deadline_ms)
+                                     deadline_ms=deadline_ms,
+                                     tenant=tenant)
             outputs = fut.result(self._wait_s(deadline_ms))
         except OverloadedError as e:
             return 503, {"error": "overloaded", "reason": e.reason,

@@ -395,6 +395,118 @@ def test_fleetz_statusz_and_healthz_carry_alerts(two_replica_fleet):
         router2.close()
 
 
+def test_fleetz_window_s_rejects_nonpositive_and_nonnumeric(
+        two_replica_fleet):
+    """``/fleetz?window_s=`` must 400 on garbage instead of silently
+    clamping: a dashboard asking for a zero/negative/NaN window would
+    otherwise get numbers computed over a window it never asked for."""
+    router, rserver, servers = two_replica_fleet
+    for bad in ("0", "-5", "abc", "nan", "inf", "-inf"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                rserver.url + f"/fleetz?window_s={bad}", timeout=30)
+        assert ei.value.code == 400, bad
+    # an EMPTY value means "not given": the default window answers
+    with urllib.request.urlopen(rserver.url + "/fleetz?window_s=",
+                                timeout=30) as r:
+        assert json.loads(r.read())["window_s"] == 60.0
+    # a legitimate window still answers
+    with urllib.request.urlopen(rserver.url + "/fleetz?window_s=12.5",
+                                timeout=30) as r:
+        assert json.loads(r.read())["window_s"] == 12.5
+
+
+def test_usage_federation_multi_tenant_conservation(two_replica_fleet):
+    """The usage observatory end to end on a live fleet THROUGH the
+    router: tenant headers survive the forward hop, replicas book and
+    conserve at tolerance 0, labeled per-tenant samples federate into
+    per-(tenant, replica) series, /fleetz rolls them up, and the sweep
+    records ``fleet_tenant_*`` dashboard series."""
+    from paddle_tpu.serving import usage
+
+    router, rserver, servers = two_replica_fleet
+    tenants = ("tenant-red", "tenant-blue")
+    body = json.dumps(
+        {"inputs": {"x": np.random.RandomState(0)
+                    .rand(1, 4).tolist()}}).encode()
+    for i in range(8):
+        req = urllib.request.Request(
+            rserver.url + "/predict", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-PaddleTPU-Tenant": tenants[i % 2]})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+    router.poll_once()
+    time.sleep(0.25)
+    router.poll_once()  # two sweeps: windowed deltas need motion
+    # (1) every replica conserves at tolerance 0 and measured both
+    # tenants' latency (the in-process servers share one ledger, so
+    # the same conserved truth shows on each)
+    for s in servers:
+        with urllib.request.urlopen(s.url + "/usagez", timeout=30) as r:
+            uz = json.loads(r.read())
+        assert uz["enabled"] is True
+        for field, c in uz["conservation"].items():
+            assert c["delta"] == 0, (s.url, field, c)
+        for t in tenants:
+            assert uz["tenants"][t]["vector"]["requests"] > 0
+            assert uz["tenants"][t]["request_ms"]["p99"] is not None
+        assert uz["sketch"]["within_bound"] is True
+    # (2) the replica exposition carries labeled samples + a bare
+    # all-tenant total that equals their sum (the federation's anchor)
+    with urllib.request.urlopen(servers[0].url + "/metrics",
+                                timeout=30) as r:
+        text = r.read().decode()
+    assert promtext.validate_lines(text) == []
+    fams = promtext.parse_exposition(text, strict=True)
+    fam = fams["paddle_tpu_serving_tenant_requests"]
+    labeled = [s for s in fam.samples if "tenant" in s.labels]
+    bare = [s for s in fam.samples if not s.labels]
+    assert len(bare) == 1 and labeled
+    assert bare[0].value == sum(s.value for s in labeled)
+    assert {t for t in tenants} <= {s.labels["tenant"] for s in labeled}
+    # (3) /fleetz federates per-tenant rollups: totals summed across
+    # replicas, reset-aware deltas measured, and the per-tenant sum
+    # equals the all-tenant family total at tolerance 0
+    with urllib.request.urlopen(rserver.url + "/fleetz?window_s=60",
+                                timeout=30) as r:
+        fz = json.loads(r.read())
+    ften = fz["aggregate"]["tenants"]
+    assert "requests" in ften
+    for t in tenants:
+        assert ften["requests"][t]["total"] > 0
+        assert ften["requests"][t]["replicas"] == 2
+        assert ften["requests"][t]["delta"] is not None
+    fam_total = fz["aggregate"]["counters"][
+        "serving_tenant_requests"]["total"]
+    assert sum(v["total"] for v in ften["requests"].values()) \
+        == fam_total
+    # (4) the sweep recorded fleet_tenant_* series for dashboards
+    for t in tenants:
+        assert router._db.last(f"fleet_tenant_requests{{{t}}}") \
+            is not None
+    # (5) per-(tenant, replica) series exist for every replica — the
+    # reset-aware evidence conservation leans on after a respawn
+    for rep_ in router._all():
+        for t in tenants:
+            assert router._db.points(
+                f"serving_tenant_requests{{{t}}}[{rep_.rid}]"), (
+                rep_.rid, t)
+    # stray: a malformed header books to the default tenant, never a
+    # new key (the sketch's key-space guard, end to end)
+    req = urllib.request.Request(
+        rserver.url + "/predict", data=body,
+        headers={"Content-Type": "application/json",
+                 "X-PaddleTPU-Tenant": "bad tenant!!"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 200
+    with urllib.request.urlopen(servers[0].url + "/usagez",
+                                timeout=30) as r:
+        uz = json.loads(r.read())
+    assert "bad tenant!!" not in uz["tenants"]
+    assert usage.default_tenant() in uz["tenants"]
+
+
 def test_router_burn_alert_fires_on_dead_fleet_and_clears():
     """Deterministic alert cycle without processes: health polls
     against an unbound port fail -> replica_availability burns -> the

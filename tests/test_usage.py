@@ -1,0 +1,253 @@
+"""Usage observatory: the per-tenant cost ledger and its space-saving
+heavy-hitter sketch.
+
+Contracts under test, straight from the ledger's docstrings:
+
+* **top-K exactness** — a tenant admitted before the sketch fills and
+  never demoted keeps an EXACT vector (err == 0) no matter how
+  adversarially the long tail churns around it;
+* **conservation** — per-field sums over tracked tenants plus
+  ``~other`` equal the ledger totals at tolerance 0, always, including
+  under demotion storms;
+* **determinism** — demotion picks the minimum-weight tenant with a
+  lexicographic tie-break, so identical booking sequences produce
+  identical sketches;
+* **memory bound** — at most ``top_k`` tracked vectors (+1 for
+  ``~other``) regardless of tenant cardinality;
+* **zero work when off** — ``FLAGS_usage=0`` never constructs the
+  ledger singleton (``peek_ledger() is None`` is the witness) and the
+  serving request path books nothing.
+"""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.serving import ServingEngine, usage
+from paddle_tpu.serving.usage import (COST_FIELDS, OTHER_TENANT,
+                                      UsageLedger, split_ints)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_loadgen():
+    spec = importlib.util.spec_from_file_location(
+        "serving_loadgen_usage_tests",
+        os.path.join(REPO, "tools", "serving_loadgen.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _assert_conserved(led: UsageLedger):
+    cons = led.conservation()
+    assert set(cons) == set(COST_FIELDS)
+    for field, c in cons.items():
+        assert c["delta"] == 0, (field, c)
+
+
+# ---------------------------------------------------------------------------
+# integer cost splitting
+# ---------------------------------------------------------------------------
+
+def test_split_ints_sums_exactly_and_is_deterministic():
+    rng = np.random.RandomState(3)
+    for _ in range(200):
+        total = int(rng.randint(0, 10_000))
+        weights = [int(x) for x in rng.randint(0, 50, size=rng.randint(
+            1, 9))]
+        shares = split_ints(total, weights)
+        assert sum(shares) == total
+        assert shares == split_ints(total, weights)
+        assert all(s >= 0 for s in shares)
+    assert split_ints(7, []) == []
+    # zero weights split evenly, remainder by index order
+    assert sum(split_ints(10, [0, 0, 0])) == 10
+
+
+def test_tenant_normalization_guards_the_key_space():
+    assert usage.normalize_tenant(None) == usage.default_tenant()
+    assert usage.normalize_tenant("") == usage.default_tenant()
+    # a claim on the reserved aggregate bucket is remapped, not booked
+    assert usage.normalize_tenant(OTHER_TENANT) == usage.default_tenant()
+    assert usage.normalize_tenant("x" * 65) == usage.default_tenant()
+    assert usage.normalize_tenant("no spaces!") == usage.default_tenant()
+    assert usage.normalize_tenant("org:team.svc-1") == "org:team.svc-1"
+
+
+# ---------------------------------------------------------------------------
+# heavy-hitter sketch
+# ---------------------------------------------------------------------------
+
+def test_topk_exact_under_adversarial_interleaving():
+    """Four heavy tenants booked early must survive a churning tail of
+    hundreds of one-shot tenants with EXACT vectors: the tail demotes
+    only itself (min weight) while the heavies' weights keep them
+    pinned in the sketch."""
+    led = UsageLedger(top_k=8)
+    heavies = [f"heavy-{i}" for i in range(4)]
+    booked = dict.fromkeys(heavies, 0)
+    # seed each heavy past any single's possible inherited weight
+    for h in heavies:
+        for _ in range(50):
+            led.book(h, requests=1, tokens_in=3)
+            booked[h] += 1
+    rng = np.random.RandomState(0)
+    for i in range(600):
+        led.book(f"one-shot-{i}", requests=1, tokens_in=1)
+        h = heavies[int(rng.randint(len(heavies)))]
+        led.book(h, requests=1, tokens_in=3)
+        booked[h] += 1
+    snap = led.snapshot()
+    for h in heavies:
+        assert h in snap["tenants"], h
+        vec = snap["tenants"][h]
+        assert vec["requests"] == booked[h]
+        assert vec["tokens_in"] == 3 * booked[h]
+    # exactness is certified: a never-demoted tenant carries err == 0
+    uz = led.usagez()
+    for h in heavies:
+        assert uz["tenants"][h]["err"] == 0
+    _assert_conserved(led)
+
+
+def test_other_bucket_conserves_demoted_and_trailing_costs():
+    led = UsageLedger(top_k=4)
+    for i in range(40):
+        led.book(f"t-{i:02d}", requests=1, tokens_out=5, page_us=7)
+    snap = led.snapshot()
+    # 40 tenants through a 4-slot sketch: everything demoted landed in
+    # ~other and nothing was lost — per-field conservation at 0
+    # (snapshot nests ~other inside "tenants" alongside the tracked 4)
+    assert len(snap["tenants"]) <= 4 + 1
+    _assert_conserved(led)
+    assert snap["totals"]["requests"] == 40
+    assert snap["totals"]["tokens_out"] == 200
+    # a demoted tenant's TRAILING costs (requests=0 bookings: tokens
+    # still decoding, pages still held) aggregate into ~other instead
+    # of re-churning the sketch
+    gone = sorted(set(f"t-{i:02d}" for i in range(40))
+                  - set(snap["tenants"]))[0]
+    before = led.snapshot()["tenants"]
+    other_before = before[OTHER_TENANT]["tokens_out"]
+    key = led.book(gone, tokens_out=9)
+    assert key == OTHER_TENANT
+    after = led.snapshot()
+    assert after["tenants"].keys() == before.keys()
+    assert after["tenants"][OTHER_TENANT]["tokens_out"] == \
+        other_before + 9
+    _assert_conserved(led)
+
+
+def test_demotion_is_deterministic_min_weight_lexicographic():
+    def run():
+        led = UsageLedger(top_k=3)
+        # equal weights: b, a, c each one request
+        for t in ("b", "a", "c"):
+            led.book(t, requests=1)
+        # full sketch + a new requester: the tie among (a, b, c) breaks
+        # to the lexicographically smallest — 'a' is demoted
+        led.book("d", requests=1)
+        return led
+
+    led = run()
+    snap = led.snapshot()
+    assert set(snap["tenants"]) == {"b", "c", "d", OTHER_TENANT}
+    # a's exact vector folded into ~other
+    assert snap["tenants"][OTHER_TENANT]["requests"] == 1
+    # the newcomer inherits the victim's weight as its overestimate
+    assert led.usagez()["tenants"]["d"]["err"] == 1
+    assert led.sketch_stats()["demotions"] == 1
+    # identical sequences -> identical sketches, bit for bit
+    led2 = run()
+    assert led2.snapshot() == snap
+    assert led2.usagez()["tenants"].keys() == led.usagez()[
+        "tenants"].keys()
+    _assert_conserved(led)
+
+
+def test_sketch_memory_hard_bound_under_high_cardinality():
+    led = UsageLedger(top_k=16)
+    rng = np.random.RandomState(1)
+    for i in range(5000):
+        led.book(f"tenant-{int(rng.randint(100000)):06d}", requests=1,
+                 tokens_in=int(rng.randint(10)))
+        if i % 500 == 0:
+            assert len(led._tenants) <= led.top_k
+    sk = led.sketch_stats()
+    assert sk["tracked"] <= sk["top_k"] == 16
+    assert sk["capacity_vectors"] == 17
+    assert sk["within_bound"] is True
+    assert sk["demotions"] > 0
+    _assert_conserved(led)
+
+
+# ---------------------------------------------------------------------------
+# flag-off zero work + live engine conservation
+# ---------------------------------------------------------------------------
+
+def _tiny_engine(lg):
+    pred, _shapes = lg.build_synthetic(4, 8, 1)
+    eng = ServingEngine(pred, workers=1)
+    eng.warmup({"x": (4,)})
+    return eng
+
+
+def test_flags_usage_off_does_zero_per_request_work():
+    lg = _load_loadgen()
+    pt.set_flags({"FLAGS_usage": False})
+    usage.reset_ledger()
+    try:
+        assert not usage.enabled()
+        eng = _tiny_engine(lg)
+        feed = {"x": np.random.RandomState(0).rand(1, 4)
+                .astype("float32")}
+        for _ in range(3):
+            eng.predict(feed, timeout=60)
+        # a tenant kwarg with the flag off must not resurrect the path
+        eng.submit(feed, tenant="acme").result(60)
+        eng.close()
+        # the witness: the singleton was NEVER constructed — no vector,
+        # no histogram, no lock was ever allocated on the request path
+        assert usage.peek_ledger() is None
+    finally:
+        pt.set_flags({"FLAGS_usage": True})
+        usage.reset_ledger()
+
+
+def test_engine_books_tenants_and_conserves_against_totals():
+    lg = _load_loadgen()
+    pt.set_flags({"FLAGS_usage": True})
+    usage.reset_ledger()
+    try:
+        eng = _tiny_engine(lg)
+        feed = {"x": np.random.RandomState(0).rand(1, 4)
+                .astype("float32")}
+        for i in range(6):
+            eng.submit(feed, tenant=("acme" if i % 2 else "umbrella")
+                       ).result(60)
+        # headerless traffic books to the default tenant, never drops
+        eng.predict(feed, timeout=60)
+        eng.close()
+        led = usage.peek_ledger()
+        assert led is not None
+        snap = led.snapshot()
+        assert snap["tenants"]["acme"]["requests"] == 3
+        assert snap["tenants"]["umbrella"]["requests"] == 3
+        assert snap["tenants"][usage.default_tenant()]["requests"] == 1
+        # the tentpole contract: per-tenant sums equal the global
+        # counters at tolerance 0 — and the ledger totals saw every
+        # request the engine's own counter did (7 of them)
+        assert snap["totals"]["requests"] == 7
+        assert snap["totals"]["served"] == 7
+        _assert_conserved(led)
+        # per-tenant latency measured for every tracked tenant
+        uz = led.usagez()
+        for t in ("acme", "umbrella"):
+            rm = uz["tenants"][t]["request_ms"]
+            assert rm is not None and rm["count"] == 3
+            assert rm["p99"] is not None
+    finally:
+        usage.reset_ledger()

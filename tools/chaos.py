@@ -167,7 +167,8 @@ POISON_TOKEN = 7
 
 DEFAULT_SCENARIOS = ("baseline", "crash", "hang", "slow", "poison",
                      "poison_paged", "spec_storm", "disagg_crash",
-                     "embedding_shard_crash", "hot_swap")
+                     "embedding_shard_crash", "hot_swap",
+                     "noisy_neighbor")
 
 # burn-rate scaling for the chaos run: scenario durations are seconds,
 # not SRE hours, so the router's alert windows shrink to fractions of
@@ -233,13 +234,16 @@ def _poison_body(feat: int) -> bytes:
     return json.dumps({"inputs": {"x": row}}).encode()
 
 
-def _post(url: str, body: bytes, timeout_s: float):
+def _post(url: str, body: bytes, timeout_s: float,
+          tenant: Optional[str] = None):
     """One POST → (outcome, http_status).  Same taxonomy as the
     loadgen: replica/router backpressure 503s are ``shed`` (the
     router's ``no_ready_replicas`` = total availability loss =
     ``failed``), everything else non-200 is ``failed``."""
-    req = urllib.request.Request(
-        url, data=body, headers={"Content-Type": "application/json"})
+    headers = {"Content-Type": "application/json"}
+    if tenant:
+        headers["X-PaddleTPU-Tenant"] = tenant
+    req = urllib.request.Request(url, data=body, headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as r:
             r.read()
@@ -264,13 +268,16 @@ def _post(url: str, body: bytes, timeout_s: float):
 def run_traffic(url: str, feat: int, qps: float, duration_s: float,
                 poison_every: int = 0, timeout_s: float = 15.0,
                 workers: int = 16, route: str = "/predict",
-                bodies: Optional[List[bytes]] = None) -> List[dict]:
+                bodies: Optional[List[bytes]] = None,
+                tenant_of=None) -> List[dict]:
     """Open-loop traffic: a pacing clock enqueues bodies at ``qps``; a
     poster pool sends them.  Every request is recorded with its
     monotonic start/end and whether it was deliberately poisoned —
     the attribution the collateral-failure contract needs.
     ``route``/``bodies`` repoint the storm (the disagg scenario sends
-    generation bodies at ``/generate``)."""
+    generation bodies at ``/generate``); ``tenant_of(i)`` stamps the
+    i-th request with a usage-attribution tenant header and records it
+    (the noisy-neighbor scenario's client-side ground truth)."""
     predict = url.rstrip("/") + route
     bodies = bodies if bodies is not None else _bodies(feat)
     poison = _poison_body(feat)
@@ -283,12 +290,14 @@ def run_traffic(url: str, feat: int, qps: float, duration_s: float,
             item = pending.get()
             if item is None:
                 return
-            body, is_poison, t0 = item
-            outcome, status = _post(predict, body, timeout_s)
+            body, is_poison, t0, tenant = item
+            outcome, status = _post(predict, body, timeout_s,
+                                    tenant=tenant)
             t1 = time.monotonic()
             with lock:
                 records.append({"t0": t0, "t1": t1, "outcome": outcome,
                                 "status": status, "poison": is_poison,
+                                "tenant": tenant,
                                 "ms": (t1 - t0) * 1e3})
 
     pool = [threading.Thread(target=poster, daemon=True)
@@ -304,7 +313,8 @@ def run_traffic(url: str, feat: int, qps: float, duration_s: float,
             break
         is_poison = bool(poison_every and (i + 1) % poison_every == 0)
         pending.put((poison if is_poison else bodies[i % len(bodies)],
-                     is_poison, now))
+                     is_poison, now,
+                     tenant_of(i) if tenant_of is not None else None))
         i += 1
         sleep_for = t_start + i * period - time.monotonic()
         if sleep_for > 0:
@@ -1292,6 +1302,285 @@ def _scenario_embedding_shard_crash(cfg: dict, log=print) -> dict:
     return rep
 
 
+def _get_json(url: str, timeout_s: float = 5.0) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            return json.loads(r.read().decode("utf-8", "replace"))
+    except (OSError, TimeoutError, ValueError,
+            urllib.error.HTTPError):
+        return None
+
+
+def _epoch_total(points, boundaries) -> float:
+    """True lifetime total of a counter series that may have been
+    reset by process respawns: every boundary timestamp starts a new
+    epoch (a fresh process whose counter restarted from zero), so the
+    lifetime total is the sum of each epoch's final sample.  A naive
+    ``last(series)`` read would lose every pre-respawn epoch — the
+    dip the reset-aware federation exists to survive."""
+    total, last, bi = 0.0, None, 0
+    bounds = sorted(boundaries)
+    for ts, v in points:
+        while bi < len(bounds) and ts >= bounds[bi]:
+            if last is not None:
+                total += last
+            last = None
+            bi += 1
+        last = v
+    if last is not None:
+        total += last
+    return total
+
+
+def _scenario_noisy_neighbor(cfg: dict, log=print) -> dict:
+    """Usage-observatory forensics: a 3-replica dense fleet behind its
+    own federating router serves multi-tenant ``/predict`` traffic —
+    one zipf-hot hog tenant floods (~80% of offered load) while three
+    background tenants trickle — and one replica is SIGKILLed
+    mid-storm.
+
+    The contract: (a) **attribution** — the hog's share of booked
+    per-tenant request cost is at least 90% of its client-side share
+    (a dropped tenant header anywhere on the path collapses the hog
+    into ``~default`` and fails this); (b) **measurement** — every
+    replica, including the respawned victim, reports a measured
+    per-tenant request p99 for every background tenant via
+    ``/usagez`` (noisy-neighbor forensics needs the victims' latency,
+    not just the hog's volume); (c) **conservation across the
+    respawn** — on every replica the live ledger's per-field deltas
+    are zero, AND the router's federated per-(tenant, replica) series
+    conserve at tolerance 0 against the per-replica all-tenant totals
+    when both are summed epoch-aware across the SIGKILL reset (raw
+    last-value reads would drop the victim's pre-kill bookings);
+    (d) the sketch stays within its hard memory bound on every
+    replica; (e) the kill is harvested and attributed
+    ``signal:SIGKILL``."""
+    import paddle_tpu  # noqa: F401 — flags registered
+    from paddle_tpu.serving import FleetSupervisor, Router, RouterServer
+    from paddle_tpu.serving import usage
+    from paddle_tpu.serving.fleet import _healthz
+
+    duration = max(float(cfg["duration_s"]), 6.0)
+    qps = float(cfg["qps"])
+    feat = cfg["feat"]
+    hog = "tenant-hog"
+    bg = ["tenant-bg-0", "tenant-bg-1", "tenant-bg-2"]
+    tenant_names = [hog] + bg + [usage.OTHER_TENANT,
+                                 usage.default_tenant()]
+    fields = list(usage.COST_FIELDS)
+    argv = ["--feat", str(feat), "--hidden", "16", "--depth", "1",
+            "--max-batch", "8", "--max-delay-ms", "2.0",
+            "--queue-cap", "512", "--deadline-ms", "30000"]
+    error = None
+    notes: Dict[str, object] = {"hog": hog, "background": bg}
+    records: List[dict] = []
+    windows: List[tuple] = []
+    unexplained = None
+    conservation_delta = None
+    attribution_ratio = None
+    sketch_violations = None
+    sup = FleetSupervisor(replicas=3, replica_argv=argv,
+                          max_restarts=8, backoff_ms=100.0,
+                          liveness_timeout_ms=cfg.get(
+                              "liveness_timeout_ms", 1500.0))
+    server = None
+    try:
+        urls = sup.wait_ready(timeout_s=600)
+        fwd_ms = max(4.0 * float(cfg.get("forward_timeout_ms", 800.0)),
+                     5000.0)
+        router = Router(urls, poll_interval_ms=100.0, stale_ms=1500.0,
+                        eject_after=2, forward_timeout_ms=fwd_ms)
+        server = RouterServer(router).start()
+        router.poll_once()
+
+        # 4 requests in 5 go to the hog; the rest round-robin the
+        # background trickle — the zipf-hot shape at deterministic odds
+        def tenant_of(i: int) -> str:
+            return hog if i % 5 else bg[(i // 5) % len(bg)]
+
+        box: Dict[str, Optional[float]] = {}
+        victim = sup._replicas[0]
+        notes["victim"] = victim.url
+
+        def inject():
+            time.sleep(duration * 0.35)
+            old = box["pid"] = victim.proc.pid
+            box["t_kill"] = time.monotonic()
+            try:
+                os.kill(old, signal.SIGKILL)
+            except OSError as e:
+                box["err"] = f"kill: {e}"
+                return
+            box["t_ready"] = _wait_respawned_ready(victim, old)
+
+        injector = threading.Thread(target=inject, daemon=True)
+        injector.start()
+        records = run_traffic(server.url, feat, qps, duration,
+                              timeout_s=cfg.get("timeout_s", 30.0),
+                              workers=8, tenant_of=tenant_of)
+        injector.join(timeout=180.0)
+        if box.get("err"):
+            error = box["err"]
+        elif box.get("t_kill") is None:
+            error = "injection never fired the kill"
+        elif box.get("t_ready") is None:
+            error = "victim never respawned ready"
+        else:
+            windows = [(box["t_kill"], box["t_ready"] + 1.0)]
+            notes["recovery_s"] = round(
+                box["t_ready"] - box["t_kill"], 3)
+        if box.get("pid") is not None:
+            death, pm_err = _postmortem_verdict(victim, box["pid"],
+                                                "signal:SIGKILL")
+            notes["postmortem"] = death
+            unexplained = (None if death is None else
+                           int(death["attribution"] == "unexplained"))
+            if error is None and pm_err is not None:
+                error = pm_err
+        # direct per-replica background probes: forensics needs the
+        # background tenants' latency MEASURED on every replica —
+        # including the respawned victim, whose ledger restarted empty
+        probe = _bodies(feat, n=1, seed=7)[0]
+        probe_ok: Dict[str, int] = {}
+        for rep_ in sup._replicas:
+            for t in bg:
+                for _ in range(3):
+                    outcome, _status = _post(
+                        rep_.url.rstrip("/") + "/predict", probe,
+                        cfg.get("timeout_s", 30.0), tenant=t)
+                    if outcome == "ok":
+                        probe_ok[t] = probe_ok.get(t, 0) + 1
+        # settle: queues drained on every replica, then one more poll
+        # so the federation's final scrape sees every booking
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            depths = []
+            for rep_ in sup._replicas:
+                h = _healthz(rep_.url, timeout=2.0) or {}
+                depths.append((h.get("serving") or {}).get(
+                    "queue_depth"))
+            if len(depths) == 3 and all(d == 0 for d in depths):
+                break
+            time.sleep(0.3)
+        router.poll_once()
+        # (b) + (d): per-replica /usagez — background p99 measured
+        # everywhere, ledger conservation zero, sketch within bound
+        ledger_delta = 0
+        sketch_violations = 0
+        unmeasured: List[str] = []
+        usage_after = []
+        for rep_ in sup._replicas:
+            uz = _get_json(rep_.url.rstrip("/") + "/usagez")
+            if uz is None:
+                unmeasured.append(f"{rep_.url}: /usagez unreachable")
+                continue
+            tenants = uz.get("tenants") or {}
+            for t in bg:
+                p99 = ((tenants.get(t) or {}).get("request_ms")
+                       or {}).get("p99")
+                if p99 is None:
+                    unmeasured.append(f"{rep_.url}: {t} p99 missing")
+            for f, c in (uz.get("conservation") or {}).items():
+                ledger_delta = max(ledger_delta, abs(c["delta"]))
+            sk = uz.get("sketch") or {}
+            if not (sk.get("within_bound")
+                    and sk.get("tracked", 1 << 30) <= sk.get("top_k", 0)
+                    and sk.get("capacity_vectors")
+                    == sk.get("top_k", 0) + 1):
+                sketch_violations += 1
+            usage_after.append({
+                "url": rep_.url,
+                "requests": {t: (tenants.get(t) or {}).get(
+                    "vector", {}).get("requests", 0)
+                    for t in [hog] + bg},
+                "sketch": sk})
+        notes["usage_after"] = usage_after
+        if error is None and unmeasured:
+            error = ("background tenant latency unmeasured: "
+                     + "; ".join(unmeasured))
+        if error is None and sketch_violations:
+            error = (f"{sketch_violations} replica(s) violate the "
+                     f"sketch memory bound")
+        # (c): federated conservation at tolerance 0, epoch-aware
+        # across the victim's SIGKILL reset.  The victim's series
+        # restart from zero mid-run; splitting every one of its series
+        # at the first post-kill scrape and summing epoch-final values
+        # recovers the true lifetime totals on both sides, so the
+        # per-tenant sum must equal the all-tenant total EXACTLY
+        fed_delta = 0.0
+        booked: Dict[str, float] = {t: 0.0 for t in tenant_names}
+        for rep_ in sup._replicas:
+            rid = rep_.url.split("://", 1)[-1]
+            t_kill = box.get("t_kill")
+            bounds: List[float] = []
+            if rep_ is victim and t_kill is not None:
+                pts = router._db.points(
+                    f"serving_tenant_requests[{rid}]")
+                bounds = [ts for ts, _ in pts if ts > t_kill][:1]
+            for f in fields:
+                labeled = 0.0
+                for t in tenant_names:
+                    v = _epoch_total(router._db.points(
+                        f"serving_tenant_{f}{{{t}}}[{rid}]"), bounds)
+                    labeled += v
+                    if f == "requests":
+                        booked[t] += v
+                total = _epoch_total(router._db.points(
+                    f"serving_tenant_{f}[{rid}]"), bounds)
+                fed_delta = max(fed_delta, abs(labeled - total))
+        conservation_delta = max(float(ledger_delta), fed_delta)
+        notes["ledger_conservation_delta"] = ledger_delta
+        notes["federated_conservation_delta"] = fed_delta
+        if error is None and conservation_delta != 0:
+            error = (f"per-tenant usage does not conserve across the "
+                     f"respawn: ledger delta {ledger_delta}, "
+                     f"federated delta {fed_delta}")
+        # (a): attribution — the hog's booked share must track its
+        # client-side share (>= 90% of it); a header dropped on any
+        # hop folds the hog into ~default and collapses this ratio
+        ok_by_tenant: Dict[str, int] = dict(probe_ok)
+        for r in records:
+            if r["outcome"] == "ok" and r.get("tenant"):
+                ok_by_tenant[r["tenant"]] = \
+                    ok_by_tenant.get(r["tenant"], 0) + 1
+        client_total = sum(ok_by_tenant.values())
+        booked_total = sum(booked.values())
+        notes["booked_requests"] = {t: booked[t] for t in tenant_names}
+        notes["client_ok_requests"] = ok_by_tenant
+        if client_total and booked_total:
+            client_share = ok_by_tenant.get(hog, 0) / client_total
+            booked_share = booked[hog] / booked_total
+            attribution_ratio = round(
+                booked_share / client_share, 4) if client_share else None
+            notes["hog_client_share"] = round(client_share, 4)
+            notes["hog_booked_share"] = round(booked_share, 4)
+        if error is None and (attribution_ratio is None
+                              or attribution_ratio < 0.9):
+            error = (f"hog attribution ratio {attribution_ratio} "
+                     f"below the 0.9 floor — excess cost was not "
+                     f"booked to the noisy tenant")
+    finally:
+        if server is not None:
+            server.close()
+        sup.close()
+
+    rep = classify(records, windows)
+    rep["scenario"] = "noisy_neighbor"
+    rep["notes"] = notes
+    rep["unexplained_deaths"] = unexplained
+    rep["usage_conservation_delta"] = conservation_delta
+    rep["hog_attribution_ratio"] = attribution_ratio
+    rep["sketch_violations"] = sketch_violations
+    if "recovery_s" in notes:
+        rep["recovery_s"] = notes["recovery_s"]
+    if error is None and rep["ok"] == 0:
+        error = "no multi-tenant request succeeded (fleet never served)"
+    if error is not None:
+        rep["error"] = error
+    rep["_records"] = records
+    return rep
+
+
 def _scenario_hot_swap(cfg: dict, log=print) -> dict:
     """Hot-swap discipline under fire: a fleet serving MIXED open-loop
     ``/predict`` + ``/generate`` load takes a clean rolling hot-swap,
@@ -1673,12 +1962,19 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
                 # own fleet (direct per-replica traffic so the torn-
                 # version check keeps exact attribution)
                 rep = _scenario_hot_swap(cfg, log=log)
+            elif name == "noisy_neighbor":
+                # multi-tenant usage forensics against its own fleet:
+                # a hog tenant floods, background tenants trickle, one
+                # replica dies mid-storm — attribution, per-tenant
+                # latency, and conservation must survive the respawn
+                rep = _scenario_noisy_neighbor(cfg, log=log)
             else:
                 rep = _scenario(name, sup, router, server.url, cfg)
             records = rep.pop("_records")
             all_records.extend(records)
             if name in ("crash", "hang", "disagg_crash",
-                        "embedding_shard_crash", "hot_swap"):
+                        "embedding_shard_crash", "hot_swap",
+                        "noisy_neighbor"):
                 fault_records.extend(records)
             per_scenario[name] = rep
             al = rep.get("alerts") or {}
@@ -1731,6 +2027,30 @@ def run_chaos(replicas: int = 3, qps: float = 40.0,
     if any("leaked_rows" in r for r in per_scenario.values()):
         totals["leaked_rows"] = sum(
             r.get("leaked_rows") or 0 for r in per_scenario.values())
+    # usage-observatory verdicts (None when noisy_neighbor didn't run,
+    # or when it ran but could not measure — perf_gate treats a
+    # present-but-None value as a failed rule, never a pass):
+    # conservation delta hard-zeroes, the hog attribution ratio has a
+    # floor, and the sketch bound violation count hard-zeroes
+    if any("usage_conservation_delta" in r
+           for r in per_scenario.values()):
+        vals = [r["usage_conservation_delta"]
+                for r in per_scenario.values()
+                if "usage_conservation_delta" in r]
+        totals["usage_conservation_delta"] = \
+            None if any(v is None for v in vals) else max(vals)
+    if any("hog_attribution_ratio" in r
+           for r in per_scenario.values()):
+        vals = [r["hog_attribution_ratio"]
+                for r in per_scenario.values()
+                if "hog_attribution_ratio" in r]
+        totals["hog_attribution_ratio"] = \
+            None if any(v is None for v in vals) else min(vals)
+    if any("sketch_violations" in r for r in per_scenario.values()):
+        vals = [r["sketch_violations"] for r in per_scenario.values()
+                if "sketch_violations" in r]
+        totals["sketch_violations"] = \
+            None if any(v is None for v in vals) else sum(vals)
     # crash-forensics verdict: every induced death must be harvested
     # AND explained.  A per-scenario None means a death was never even
     # booked — that vacuousness propagates to the total (perf_gate
@@ -1785,7 +2105,8 @@ def main(argv=None) -> int:
                     help="comma-separated subset of "
                          "crash,hang,slow,poison,poison_paged,"
                          "spec_storm,disagg_crash,"
-                         "embedding_shard_crash,hot_swap")
+                         "embedding_shard_crash,hot_swap,"
+                         "noisy_neighbor")
     ap.add_argument("--availability-pct", type=float, default=99.0)
     ap.add_argument("--feat", type=int, default=8)
     ap.add_argument("--hidden", type=int, default=32)

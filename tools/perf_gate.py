@@ -276,6 +276,63 @@ def compare_leg(name: str, new: dict, base: dict,
                                   f"postmortem artifact (contract: "
                                   f"zero)")
                 return res
+        # usage-conservation rule (hard, like collateral/leaks): the
+        # per-tenant cost vectors must sum EXACTLY to the global
+        # counters — tolerance 0, through a SIGKILL-respawn.  Present-
+        # but-None is a vacuous verdict (the scenario ran but could
+        # not measure conservation) and fails too; the key absent is
+        # allowed — captures predate the usage observatory
+        if "usage_conservation_delta" in new:
+            ucd = new.get("usage_conservation_delta")
+            if ucd is None:
+                res.update(status="regression",
+                           reason="chaos run measured no usage-"
+                                  "conservation delta (vacuous: per-"
+                                  "tenant attribution never verified)")
+                return res
+            if ucd != 0:
+                res.update(status="regression",
+                           reason=f"per-tenant usage does not conserve:"
+                                  f" delta {ucd} against the global "
+                                  f"counters (contract: exactly zero)")
+                return res
+        # noisy-neighbor attribution floor (hard): the hog tenant's
+        # booked cost share must be at least 90% of its client-side
+        # share — a tenant header dropped on any hop folds the hog
+        # into the default tenant and collapses this ratio.  None is
+        # vacuous (unmeasured) and fails; absent is allowed
+        if "hog_attribution_ratio" in new:
+            har = new.get("hog_attribution_ratio")
+            if har is None:
+                res.update(status="regression",
+                           reason="chaos noisy_neighbor measured no "
+                                  "hog attribution ratio (vacuous: "
+                                  "excess cost never attributed)")
+                return res
+            if har < 0.9:
+                res.update(status="regression",
+                           reason=f"hog attribution ratio {har} below "
+                                  f"the 0.9 floor — excess cost was "
+                                  f"not booked to the noisy tenant")
+                return res
+        # heavy-hitter sketch memory bound (hard): no replica may ever
+        # hold more than top_k tracked vectors (+1 for ~other) no
+        # matter the tenant cardinality.  None is vacuous and fails;
+        # absent is allowed
+        if "sketch_violations" in new:
+            sv = new.get("sketch_violations")
+            if sv is None:
+                res.update(status="regression",
+                           reason="chaos run measured no sketch-bound "
+                                  "verdict (vacuous: memory bound "
+                                  "never checked)")
+                return res
+            if sv > 0:
+                res.update(status="regression",
+                           reason=f"{sv} replica(s) violated the "
+                                  f"heavy-hitter sketch memory bound "
+                                  f"(contract: <= top_k + 1 vectors)")
+                return res
         # the harness's own verdict: a scenario that errored (watchdog
         # never fired, no poisoned request reached a model, victim
         # never respawned) means a containment mechanism went
@@ -1111,6 +1168,9 @@ def run_smoke() -> int:
         "collateral_failures": 0, "injected_failures": 9,
         "poison_leaks": 0, "p99_under_fault_ms": 45.0,
         "unexplained_deaths": 0,
+        "usage_conservation_delta": 0,
+        "hog_attribution_ratio": 0.97,
+        "sketch_violations": 0,
         "requests": 960,
     }
     with_chaos = json.loads(json.dumps(latest))
@@ -1185,6 +1245,47 @@ def run_smoke() -> int:
         x["status"] == "regression"
         and "vacuous forensics" in x.get("reason", "")
         for x in r["legs"]))
+    # usage-observatory hard rules: conservation hard-zeroes (and a
+    # vacuous None fails), the hog attribution ratio has a 0.9 floor,
+    # and the sketch memory bound hard-zeroes — none shielded by an
+    # anomaly flag (attribution is a correctness contract, not perf)
+    unconserved = json.loads(json.dumps(with_chaos))
+    unconserved["legs"]["chaos"]["usage_conservation_delta"] = 3
+    unconserved["legs"]["chaos"]["anomaly"] = "core-bound host"
+    r = compare_bench(unconserved, docs + [with_chaos])
+    check("chaos usage-conservation break fails even when anomalous",
+          not r["ok"] and any(
+              x["status"] == "regression"
+              and "conserve" in x.get("reason", "")
+              for x in r["legs"]))
+    vacuous_usage = json.loads(json.dumps(with_chaos))
+    vacuous_usage["legs"]["chaos"]["usage_conservation_delta"] = None
+    r = compare_bench(vacuous_usage, docs + [with_chaos])
+    check("chaos vacuous usage-conservation fails",
+          not r["ok"] and any(
+              x["status"] == "regression"
+              and "vacuous" in x.get("reason", "")
+              and "attribution" in x.get("reason", "")
+              for x in r["legs"]))
+    misattributed = json.loads(json.dumps(with_chaos))
+    misattributed["legs"]["chaos"]["hog_attribution_ratio"] = 0.4
+    r = compare_bench(misattributed, docs + [with_chaos])
+    check("chaos hog-attribution floor fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "0.9 floor" in x.get("reason", "") for x in r["legs"]))
+    vacuous_attr = json.loads(json.dumps(with_chaos))
+    vacuous_attr["legs"]["chaos"]["hog_attribution_ratio"] = None
+    r = compare_bench(vacuous_attr, docs + [with_chaos])
+    check("chaos vacuous hog-attribution fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "never attributed" in x.get("reason", "")
+        for x in r["legs"]))
+    sketch_burst = json.loads(json.dumps(with_chaos))
+    sketch_burst["legs"]["chaos"]["sketch_violations"] = 2
+    r = compare_bench(sketch_burst, docs + [with_chaos])
+    check("chaos sketch-bound violation fails", not r["ok"] and any(
+        x["status"] == "regression"
+        and "sketch" in x.get("reason", "") for x in r["legs"]))
     harness_err = json.loads(json.dumps(with_chaos))
     harness_err["legs"]["chaos"]["harness_ok"] = False
     harness_err["legs"]["chaos"]["errors"] = {

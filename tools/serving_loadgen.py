@@ -166,6 +166,26 @@ def zipf_ids(rng, vocab: int, size, s: float) -> np.ndarray:
                            rng.random_sample(size)).astype(np.int64)
 
 
+TENANT_HEADER = "X-PaddleTPU-Tenant"
+
+
+def tenant_picker(n: int, dist: str = "zipf", seed: int = 0,
+                  pool: int = 4096) -> Callable[[int], str]:
+    """Deterministic request-index -> tenant-name assignment for
+    multi-tenant runs (``--tenants N``): ``zipf`` concentrates most of
+    the traffic on ``tenant-00`` (the noisy-neighbor shape the usage
+    observatory exists to attribute), ``uniform`` spreads it evenly.
+    Pre-sampled pool, cycled by request index — host RNG off the
+    timed path, same run same assignment."""
+    rng = np.random.RandomState(seed)
+    if dist == "uniform":
+        ids = rng.randint(0, n, size=pool)
+    else:
+        ids = zipf_ids(rng, n, pool, 1.2)
+    names = [f"tenant-{i:02d}" for i in range(n)]
+    return lambda i: names[int(ids[i % pool])]
+
+
 def recsys_feed_maker(slots: int, dense: int, vocab: int,
                       zipf: float = 1.2, rows: int = 1, seed: int = 0,
                       pool_size: int = 64) -> Callable[[int], dict]:
@@ -381,7 +401,9 @@ def _report(mode: str, n: int, ok: int, shed: int, failed: int,
 
 
 def run_closed_loop(engine, make_feed, n_requests: int,
-                    concurrency: int, timeout_s: float = 60.0) -> dict:
+                    concurrency: int, timeout_s: float = 60.0,
+                    tenant_of: Optional[Callable[[int], str]] = None
+                    ) -> dict:
     """``concurrency`` synchronous callers sharing a ticket counter."""
     from paddle_tpu.serving import OverloadedError, ServingError
 
@@ -399,7 +421,11 @@ def run_closed_loop(engine, make_feed, n_requests: int,
             feed = make_feed(i)
             t0 = time.monotonic()
             try:
-                engine.predict(feed, timeout=timeout_s)
+                if tenant_of is None:
+                    engine.predict(feed, timeout=timeout_s)
+                else:
+                    engine.submit(feed, tenant=tenant_of(i)) \
+                        .result(timeout_s)
                 ms = (time.monotonic() - t0) * 1e3
                 with lock:
                     counts["ok"] += 1
@@ -427,7 +453,9 @@ def run_closed_loop(engine, make_feed, n_requests: int,
 
 def run_open_loop(engine, make_feed, qps: float, duration_s: float,
                   timeout_s: float = 60.0, collectors: int = 8,
-                  traffic: Optional[TrafficShape] = None) -> dict:
+                  traffic: Optional[TrafficShape] = None,
+                  tenant_of: Optional[Callable[[int], str]] = None
+                  ) -> dict:
     """Fixed-rate arrivals: one pacing thread submits on a ``1/qps``
     clock; a collector pool stamps completions.  Sheds at submit() count
     against the offered load (that IS the overload behavior under
@@ -479,7 +507,9 @@ def run_open_loop(engine, make_feed, qps: float, duration_s: float,
             with lock:
                 phases.arrival(phase, now)
         try:
-            fut = engine.submit(make_feed(i))
+            kw = {"tenant": tenant_of(i)} if tenant_of is not None \
+                else {}
+            fut = engine.submit(make_feed(i), **kw)
             pending.put((fut, now, phase))
         except OverloadedError:
             with lock:
@@ -641,7 +671,9 @@ class _TokenClock:
 
 def run_closed_loop_generate(engine, make_prompt, n_requests: int,
                              concurrency: int,
-                             timeout_s: float = 120.0) -> dict:
+                             timeout_s: float = 120.0,
+                             tenant_of: Optional[
+                                 Callable[[int], str]] = None) -> dict:
     """Closed loop against a GenerationEngine: ``concurrency``
     synchronous callers submit→wait→repeat; the slot grid sees a
     standing queue, so the measured ``tokens_per_sec`` is the
@@ -665,9 +697,11 @@ def run_closed_loop_generate(engine, make_prompt, n_requests: int,
             t0 = time.monotonic()
             clock = _TokenClock(t0)
             try:
+                kw = {"tenant": tenant_of(i)} \
+                    if tenant_of is not None else {}
                 res = engine.submit(prompt, out_len,
-                                    on_token=clock.on_token
-                                    ).result(timeout_s)
+                                    on_token=clock.on_token,
+                                    **kw).result(timeout_s)
                 ms = (time.monotonic() - t0) * 1e3
                 ttft, gaps = clock.fold()
                 with lock:
@@ -705,7 +739,9 @@ def run_closed_loop_generate(engine, make_prompt, n_requests: int,
 
 def run_open_loop_generate(engine, make_prompt, qps: float,
                            duration_s: float, timeout_s: float = 120.0,
-                           collectors: int = 8) -> dict:
+                           collectors: int = 8,
+                           tenant_of: Optional[
+                               Callable[[int], str]] = None) -> dict:
     """Open loop against a GenerationEngine: request arrivals on a
     fixed ``1/qps`` clock regardless of completions (offered load does
     not back off when the grid saturates — submit-time sheds ARE the
@@ -762,11 +798,12 @@ def run_open_loop_generate(engine, make_prompt, qps: float,
             continue
         next_at += period
         prompt, out_len = make_prompt(n)
+        kw = {"tenant": tenant_of(n)} if tenant_of is not None else {}
         n += 1
         clock = _TokenClock(now)
         try:
             fut = engine.submit(prompt, out_len,
-                                on_token=clock.on_token)
+                                on_token=clock.on_token, **kw)
             pending.put((fut, now, clock))
         except OverloadedError:
             with lock:
@@ -800,7 +837,8 @@ def _encode_bodies(make_feed, n: int = 16) -> List[bytes]:
 
 
 def _http_predict(url: str, body: bytes,
-                  timeout_s: float) -> tuple:
+                  timeout_s: float,
+                  tenant: Optional[str] = None) -> tuple:
     """One POST /predict -> ``('ok' | 'shed' | 'failed', version)``
     where ``version`` is the ``X-PaddleTPU-Weights-Version`` response
     header (replicas and the router both publish it; ``None`` when
@@ -813,8 +851,10 @@ def _http_predict(url: str, body: bytes,
     routable replicas — total availability loss, the exact event the
     rolling-restart zero-non-shed-failure contract exists to catch —
     and must count as failed, never as an allowed shed."""
-    req = urllib.request.Request(
-        url, data=body, headers={"Content-Type": "application/json"})
+    headers = {"Content-Type": "application/json"}
+    if tenant:
+        headers[TENANT_HEADER] = tenant
+    req = urllib.request.Request(url, data=body, headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as r:
             r.read()
@@ -847,6 +887,35 @@ def _http_statusz(base_url: str, timeout_s: float = 10.0
         return None
 
 
+def fetch_usagez(base_url: str, timeout_s: float = 10.0
+                 ) -> Optional[dict]:
+    """Pull the target's per-tenant ``/usagez`` breakdown (a replica
+    endpoint).  A fleet router exposes no /usagez — fall back to the
+    ``/fleetz`` per-tenant aggregate so a multi-tenant run through the
+    router still embeds the fleet-level attribution (per-tenant
+    latency summaries stay replica-only, so a tenant-p99 SLO bound
+    against a router report violates as unmeasured, never passes
+    vacuously).  Never raises."""
+    base = base_url.rstrip("/")
+    try:
+        with urllib.request.urlopen(base + "/usagez",
+                                    timeout=timeout_s) as r:
+            return json.loads(r.read())
+    except (OSError, TimeoutError, ValueError):
+        pass  # ok: routers have no /usagez — the /fleetz fallback next
+    try:
+        with urllib.request.urlopen(base + "/fleetz",
+                                    timeout=timeout_s) as r:
+            doc = json.loads(r.read())
+        agg = (doc.get("aggregate") or {}).get("tenants")
+        if agg is not None:
+            return {"fleet": True, "tenant_families": agg}
+    except (OSError, TimeoutError, ValueError):
+        pass  # ok: no usage endpoint at all — report embeds None and
+        #     a tenant SLO bound then violates as unmeasured
+    return None
+
+
 def fetch_debugz(base_url: str, out_path: str,
                  timeout_s: float = 10.0) -> Optional[str]:
     """Pull the target's one-shot ``/debugz`` forensics bundle (statusz
@@ -869,7 +938,9 @@ def fetch_debugz(base_url: str, out_path: str,
 
 def run_closed_loop_http(base_url: str, make_feed, n_requests: int,
                          concurrency: int,
-                         timeout_s: float = 60.0) -> dict:
+                         timeout_s: float = 60.0,
+                         tenant_of: Optional[
+                             Callable[[int], str]] = None) -> dict:
     """Closed loop over HTTP: ``concurrency`` synchronous posters
     sharing a ticket counter against a live server."""
     url = base_url.rstrip("/") + "/predict"
@@ -887,7 +958,9 @@ def run_closed_loop_http(base_url: str, make_feed, n_requests: int,
                 return
             body = bodies[i % len(bodies)]
             t0 = time.monotonic()
-            outcome, version = _http_predict(url, body, timeout_s)
+            outcome, version = _http_predict(
+                url, body, timeout_s,
+                tenant=tenant_of(i) if tenant_of else None)
             ms = (time.monotonic() - t0) * 1e3
             with lock:
                 counts[outcome] += 1
@@ -916,11 +989,14 @@ def run_closed_loop_http(base_url: str, make_feed, n_requests: int,
     return rep
 
 
-def _http_generate(url: str, body: bytes, timeout_s: float) -> tuple:
+def _http_generate(url: str, body: bytes, timeout_s: float,
+                   tenant: Optional[str] = None) -> tuple:
     """One POST /generate -> ('ok'|'shed'|'failed', generated token
     count).  Same 503 taxonomy as :func:`_http_predict`."""
-    req = urllib.request.Request(
-        url, data=body, headers={"Content-Type": "application/json"})
+    headers = {"Content-Type": "application/json"}
+    if tenant:
+        headers[TENANT_HEADER] = tenant
+    req = urllib.request.Request(url, data=body, headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as r:
             doc = json.loads(r.read())
@@ -942,15 +1018,17 @@ def _http_generate(url: str, body: bytes, timeout_s: float) -> tuple:
         return "failed", 0
 
 
-def _http_generate_stream(url: str, body: bytes, timeout_s: float
-                          ) -> tuple:
+def _http_generate_stream(url: str, body: bytes, timeout_s: float,
+                          tenant: Optional[str] = None) -> tuple:
     """One streaming POST /generate: read the NDJSON line-by-line,
     stamping each token line's ARRIVAL on this client's clock — the
     honest TTFT/ITL measurement (a whole-response timer cannot see
     token pacing at all).  -> (outcome, token_count, ttft_ms or None,
     [inter-token gap ms, ...])."""
-    req = urllib.request.Request(
-        url, data=body, headers={"Content-Type": "application/json"})
+    headers = {"Content-Type": "application/json"}
+    if tenant:
+        headers[TENANT_HEADER] = tenant
+    req = urllib.request.Request(url, data=body, headers=headers)
     t0 = time.monotonic()
     arrivals: List[float] = []
     try:
@@ -1004,7 +1082,10 @@ def _http_generate_stream(url: str, body: bytes, timeout_s: float
 def run_closed_loop_generate_http(base_url: str, make_prompt,
                                   n_requests: int, concurrency: int,
                                   timeout_s: float = 120.0,
-                                  stream: bool = False) -> dict:
+                                  stream: bool = False,
+                                  tenant_of: Optional[
+                                      Callable[[int], str]] = None
+                                  ) -> dict:
     """Closed loop of ``POST /generate`` against a live server or
     fleet router: the shared-prefix workload drivable end-to-end.  The
     report embeds the target's ``/statusz`` generation block —
@@ -1033,12 +1114,14 @@ def run_closed_loop_generate_http(base_url: str, make_prompt,
             if stream:
                 doc["stream"] = True
             body = json.dumps(doc).encode()
+            tenant = tenant_of(i) if tenant_of else None
             t0 = time.monotonic()
             if stream:
                 outcome, tokens, ttft, gaps = _http_generate_stream(
-                    url, body, timeout_s)
+                    url, body, timeout_s, tenant=tenant)
             else:
-                outcome, tokens = _http_generate(url, body, timeout_s)
+                outcome, tokens = _http_generate(url, body, timeout_s,
+                                                 tenant=tenant)
                 ttft, gaps = None, []
             ms = (time.monotonic() - t0) * 1e3
             with lock:
@@ -1088,7 +1171,9 @@ def run_closed_loop_generate_http(base_url: str, make_prompt,
 def run_open_loop_http(base_url: str, make_feed, qps: float,
                        duration_s: float, timeout_s: float = 60.0,
                        collectors: int = 16,
-                       traffic: Optional[TrafficShape] = None) -> dict:
+                       traffic: Optional[TrafficShape] = None,
+                       tenant_of: Optional[
+                           Callable[[int], str]] = None) -> dict:
     """Open loop over HTTP: one pacing thread enqueues request bodies
     on a ``1/qps`` clock; a poster pool sends them.  Arrivals stay on
     the clock regardless of completions (the client-side queue absorbs
@@ -1109,8 +1194,9 @@ def run_open_loop_http(base_url: str, make_feed, qps: float,
             item = pending.get()
             if item is None:
                 return
-            body, t0, phase = item
-            outcome, version = _http_predict(url, body, timeout_s)
+            body, t0, phase, tenant = item
+            outcome, version = _http_predict(url, body, timeout_s,
+                                             tenant=tenant)
             ms = (time.monotonic() - t0) * 1e3
             with lock:
                 counts[outcome] += 1
@@ -1136,7 +1222,8 @@ def run_open_loop_http(base_url: str, make_feed, qps: float,
         if phases is not None:
             with lock:
                 phases.arrival(phase, now)
-        pending.put((bodies[i % len(bodies)], now, phase))
+        pending.put((bodies[i % len(bodies)], now, phase,
+                     tenant_of(i) if tenant_of else None))
     for _ in pool:
         pending.put(None)
     for t in pool:
@@ -1166,7 +1253,8 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
               itl_ms: Optional[float] = None,
               expect_version: Optional[int] = None,
               accept_rate: Optional[float] = None,
-              hit_rate: Optional[float] = None) -> dict:
+              hit_rate: Optional[float] = None,
+              tenant_p99_ms: Optional[float] = None) -> dict:
     """Evaluate the SLO against one report (recursing into the nested
     closed/open halves of ``--mode both``).  Returns
     ``{"p99_ms_limit", "shed_pct_limit", "violations": [...], "ok"}``;
@@ -1318,6 +1406,31 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
         _one(report["open"], "open")
     else:
         _one(report, report.get("mode", "report"))
+    if tenant_p99_ms is not None:
+        # the per-tenant latency SLO binds on the report's embedded
+        # /usagez breakdown — one bound, EVERY tenant.  A tenant whose
+        # latency was never measured (all sheds, a router-only fetch
+        # with no replica histograms, usage disabled) is a violation,
+        # never a vacuous pass: an SLO that skips unmeasured tenants
+        # is exactly how a noisy neighbor's victims go unnoticed.
+        tenants = (report.get("usage") or {}).get("tenants") or {}
+        if not tenants:
+            violations.append(
+                f"usage: --slo-tenant-p99-ms {tenant_p99_ms} given "
+                f"but the report embeds no per-tenant usage breakdown "
+                f"(FLAGS_usage=0 target, router without replica "
+                f"/usagez, or a run without --tenants)")
+        for t, blk in sorted(tenants.items()):
+            p99 = ((blk or {}).get("request_ms") or {}).get("p99")
+            if p99 is None:
+                violations.append(
+                    f"usage[{t}]: no measured request p99 — tenant "
+                    f"latency unmeasurable against SLO "
+                    f"{tenant_p99_ms}ms")
+            elif p99 > tenant_p99_ms:
+                violations.append(
+                    f"usage[{t}]: p99 {p99}ms > tenant SLO "
+                    f"{tenant_p99_ms}ms")
     out = {"p99_ms_limit": p99_ms, "shed_pct_limit": shed_pct,
            "violations": violations, "ok": not violations}
     if ttft_ms is not None:
@@ -1330,6 +1443,8 @@ def check_slo(report: dict, p99_ms: Optional[float] = None,
         out["accept_rate_limit"] = accept_rate
     if hit_rate is not None:
         out["hit_rate_limit"] = hit_rate
+    if tenant_p99_ms is not None:
+        out["tenant_p99_ms_limit"] = tenant_p99_ms
     if fail_degraded:
         out["fail_degraded"] = True
     return out
@@ -1550,6 +1665,24 @@ def main(argv=None) -> int:
                          "/statusz with --url); a run with no "
                          "measured hit rate violates too, never a "
                          "vacuous pass")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="multi-tenant run: assign each request one of "
+                         "N tenant identities (tenant-00..) via the "
+                         "X-PaddleTPU-Tenant header (--url) or the "
+                         "submit(tenant=) kwarg (in-process); the "
+                         "report embeds the target's /usagez per-"
+                         "tenant breakdown")
+    ap.add_argument("--tenant-dist", choices=("zipf", "uniform"),
+                    default="zipf",
+                    help="tenant traffic mix: zipf concentrates most "
+                         "load on tenant-00 (noisy-neighbor shape), "
+                         "uniform spreads it evenly")
+    ap.add_argument("--slo-tenant-p99-ms", type=float, default=None,
+                    help="assert EVERY tenant's p99 request latency "
+                         "<= this (ms), read from the report's "
+                         "embedded /usagez breakdown; a tenant with "
+                         "no measured latency violates too, never a "
+                         "vacuous pass")
     ap.add_argument("--expect-version", type=int, default=None,
                     help="assert every completed request carried this "
                          "weights_version response header (the post-"
@@ -1574,6 +1707,8 @@ def main(argv=None) -> int:
                                amplitude=args.traffic_amplitude,
                                period_s=args.traffic_period,
                                burst_frac=args.traffic_burst_frac)
+    tenant_of = tenant_picker(args.tenants, args.tenant_dist) \
+        if args.tenants > 0 else None
     if args.sharded and args.generate:
         # the generate branch would silently drive a plain single-mesh
         # GenerationEngine while the report claimed a sharded health
@@ -1595,19 +1730,35 @@ def main(argv=None) -> int:
 
     def finish(report: dict) -> int:
         rc = 0
+        if args.tenants or args.slo_tenant_p99_ms is not None:
+            # embed the per-tenant attribution next to the latency
+            # report — check_slo's tenant bound reads it, operators
+            # diff it against the client-side mix
+            if args.url:
+                report["usage"] = fetch_usagez(args.url)
+            else:
+                try:
+                    from paddle_tpu.serving import usage as usage_mod
+                    led = usage_mod.peek_ledger()
+                    report["usage"] = led.usagez() \
+                        if led is not None else None
+                except Exception:  # noqa: BLE001 — report must print
+                    report["usage"] = None
         if args.slo_p99_ms is not None or args.slo_shed_pct is not None \
                 or args.slo_ttft_ms is not None \
                 or args.slo_itl_ms is not None or args.sharded \
                 or args.expect_version is not None \
                 or args.slo_accept_rate is not None \
-                or args.slo_hit_rate is not None:
+                or args.slo_hit_rate is not None \
+                or args.slo_tenant_p99_ms is not None:
             slo = check_slo(report, args.slo_p99_ms, args.slo_shed_pct,
                             fail_degraded=args.sharded,
                             ttft_ms=args.slo_ttft_ms,
                             itl_ms=args.slo_itl_ms,
                             expect_version=args.expect_version,
                             accept_rate=args.slo_accept_rate,
-                            hit_rate=args.slo_hit_rate)
+                            hit_rate=args.slo_hit_rate,
+                            tenant_p99_ms=args.slo_tenant_p99_ms)
             report["slo"] = slo
             if not slo["ok"]:
                 for v in slo["violations"]:
@@ -1652,7 +1803,7 @@ def main(argv=None) -> int:
             or max(args.gen_prompt_max + 1, args.gen_max_seq // 2))
         report = run_closed_loop_generate_http(
             args.url, make_prompt, args.requests, args.concurrency,
-            stream=args.gen_stream)
+            stream=args.gen_stream, tenant_of=tenant_of)
         return finish(report)
 
     if args.url:
@@ -1683,17 +1834,19 @@ def main(argv=None) -> int:
             report = {"mode": "both",
                       "closed": _with_hit_rate(run_closed_loop_http(
                           args.url, make_feed, args.requests,
-                          args.concurrency)),
+                          args.concurrency, tenant_of=tenant_of)),
                       "open": _with_hit_rate(run_open_loop_http(
                           args.url, make_feed, args.qps,
-                          args.duration, traffic=traffic))}
+                          args.duration, traffic=traffic,
+                          tenant_of=tenant_of))}
         elif args.mode == "closed":
             report = _with_hit_rate(run_closed_loop_http(
-                args.url, make_feed, args.requests, args.concurrency))
+                args.url, make_feed, args.requests, args.concurrency,
+                tenant_of=tenant_of))
         else:
             report = _with_hit_rate(run_open_loop_http(
                 args.url, make_feed, args.qps, args.duration,
-                traffic=traffic))
+                traffic=traffic, tenant_of=tenant_of))
         return finish(report)
 
     if args.generate:
@@ -1741,17 +1894,19 @@ def main(argv=None) -> int:
                 report = {"mode": "both",
                           "closed": run_closed_loop_generate(
                               gen, make_prompt, args.requests,
-                              args.concurrency),
+                              args.concurrency, tenant_of=tenant_of),
                           "open": run_open_loop_generate(
                               gen, make_prompt, args.qps,
-                              args.duration)}
+                              args.duration, tenant_of=tenant_of)}
             elif args.mode == "closed":
                 report = run_closed_loop_generate(gen, make_prompt,
                                                   args.requests,
-                                                  args.concurrency)
+                                                  args.concurrency,
+                                                  tenant_of=tenant_of)
             else:
                 report = run_open_loop_generate(gen, make_prompt,
-                                                args.qps, args.duration)
+                                                args.qps, args.duration,
+                                                tenant_of=tenant_of)
         finally:
             gen.close()
         return finish(report)
@@ -1801,19 +1956,23 @@ def main(argv=None) -> int:
                           "closed": _with_embedding(
                               run_closed_loop(engine, make_feed,
                                               args.requests,
-                                              args.concurrency)),
+                                              args.concurrency,
+                                              tenant_of=tenant_of)),
                           "open": _with_embedding(
                               run_open_loop(engine, make_feed,
                                             args.qps, args.duration,
-                                            traffic=traffic))}
+                                            traffic=traffic,
+                                            tenant_of=tenant_of))}
             elif args.mode == "closed":
                 report = _with_embedding(
                     run_closed_loop(engine, make_feed, args.requests,
-                                    args.concurrency))
+                                    args.concurrency,
+                                    tenant_of=tenant_of))
             else:
                 report = _with_embedding(
                     run_open_loop(engine, make_feed, args.qps,
-                                  args.duration, traffic=traffic))
+                                  args.duration, traffic=traffic,
+                                  tenant_of=tenant_of))
         finally:
             engine.close()
         return finish(report)
@@ -1856,19 +2015,23 @@ def main(argv=None) -> int:
                       "closed": _with_groups(
                           run_closed_loop(engine, make_feed,
                                           args.requests,
-                                          args.concurrency)),
+                                          args.concurrency,
+                                          tenant_of=tenant_of)),
                       "open": _with_groups(
                           run_open_loop(engine, make_feed, args.qps,
                                         args.duration,
-                                        traffic=traffic))}
+                                        traffic=traffic,
+                                        tenant_of=tenant_of))}
         elif args.mode == "closed":
             report = _with_groups(
                 run_closed_loop(engine, make_feed, args.requests,
-                                args.concurrency))
+                                args.concurrency,
+                                tenant_of=tenant_of))
         else:
             report = _with_groups(
                 run_open_loop(engine, make_feed, args.qps,
-                              args.duration, traffic=traffic))
+                              args.duration, traffic=traffic,
+                              tenant_of=tenant_of))
     finally:
         engine.close()
 
